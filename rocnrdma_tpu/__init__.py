@@ -1,0 +1,20 @@
+"""rocnrdma_tpu — a TPU-native collective-communication transport & benchmark framework.
+
+Capability contract: the component inventory C1-C13 of SURVEY.md §2, i.e. the
+capabilities of the reference repo ``awmliu/ROCnRDMA`` (a HIP/RCCL RDMA
+transport; empty at the surveyed v0 snapshot, so the contract is defined by
+``BASELINE.json``) re-designed TPU-first:
+
+- the reference's ``ibv_*`` queue-pair / ``hipMemRegister`` layer becomes a
+  thin runtime shim over XLA's collectives on ICI (``rocnrdma_tpu.runtime``);
+- the rccl-net plugin surface becomes a ``jax.Array``-native transport
+  (``rocnrdma_tpu.transport``);
+- the repo's own ring/tree allreduce and all-to-all schedules become
+  jit-compiled ``lax.ppermute`` programs under ``shard_map``
+  (``rocnrdma_tpu.collectives``);
+- the multi-node RDMA path maps to DCN cross-slice collectives
+  (hierarchical schedules over a 2-axis ``('slice','intra')`` mesh);
+- the CPU/gloo loopback oracle becomes the CPU fake-device backend.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+"""Benchmark CLIs (L5 of SURVEY.md §1): the reference's ``bench_allreduce``
+entrypoint family, rebuilt. ``python -m rocnrdma_tpu.bench.bench_allreduce``
+(or the ``bench_allreduce`` console script) is the north-star entrypoint
+(BASELINE.json:5)."""

@@ -1,0 +1,19 @@
+"""``bench_allgather`` — allgather bus-bandwidth (component C3,
+BASELINE.json:9). Size convention: ``--sizes`` is the OUTPUT per-rank size S;
+each rank contributes S/n."""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_allgather", "allgather").parse_args(argv)
+    runner.run_sweep("bench_allgather", "allgather", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

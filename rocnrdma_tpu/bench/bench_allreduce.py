@@ -1,0 +1,33 @@
+"""``bench_allreduce`` — the north-star entrypoint (BASELINE.json:5).
+
+Reports allreduce bus-bandwidth in GB/s/chip (the headline metric,
+BASELINE.json:2) for the explicit ring/tree/hierarchical schedules and the
+fused XLA lowering.
+
+Examples::
+
+    # the BASELINE.json:7 CPU/gloo oracle config
+    bench_allreduce --preset loopback2 --fake-devices 2
+
+    # 8-rank sweep on fake CPU devices
+    bench_allreduce --preset ring8 --platform cpu --fake-devices 8
+
+    # whatever hardware jax sees, 64 MiB fused vs ring
+    bench_allreduce --sizes 64M --algos ring,fused
+"""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_allreduce", "allreduce").parse_args(argv)
+    runner.run_sweep("bench_allreduce", "allreduce", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

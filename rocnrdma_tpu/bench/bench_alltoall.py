@@ -1,0 +1,18 @@
+"""``bench_alltoall`` — alltoall algorithmic bandwidth (BASELINE.json:2,11),
+the MoE dispatch/combine primitive (component C2)."""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_alltoall", "alltoall").parse_args(argv)
+    runner.run_sweep("bench_alltoall", "alltoall", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Named measurement presets — the five configs of BASELINE.json:6-12
+(SURVEY.md §5 "Config/flag system").
+
+A preset fixes the topology and sweep; CLI flags override individual fields.
+Hardware-scale presets (``tree64``, ``multislice``) describe the real-TPU
+config; on the CPU oracle they auto-scale down (fewer fake ranks, capped
+sizes) unless ``--strict-preset`` insists on the literal config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from rocnrdma_tpu.metrics import GiB, KiB, MiB
+
+
+@dataclasses.dataclass(frozen=True)
+class Preset:
+    name: str
+    baseline_config: str        # the BASELINE.json line this preset realises
+    n_ranks: int
+    mesh2d: tuple | None        # (slices, per_slice) for hierarchical presets
+    sizes: tuple                # bytes per rank
+    dtypes: tuple
+    algos: tuple
+    check: bool = True          # verify vs numpy before timing
+
+    def scaled_to(self, n_devices: int, max_bytes: int) -> "Preset":
+        """Shrink to what the current backend can actually host."""
+        n = min(self.n_ranks, n_devices)
+        # keep power-of-two rank counts for tree presets
+        if "tree" in self.algos:
+            while n & (n - 1):
+                n -= 1
+        mesh2d = self.mesh2d
+        if mesh2d is not None:
+            s = min(mesh2d[0], max(2, n_devices // max(1, mesh2d[1])))
+            per = n_devices // s
+            mesh2d = (s, per)
+            n = s * per
+        sizes = tuple(b for b in self.sizes if b <= max_bytes) \
+            or (min(min(self.sizes), max_bytes),)
+        return dataclasses.replace(self, n_ranks=n, mesh2d=mesh2d, sizes=sizes)
+
+
+def _sweep(lo: int, hi: int) -> tuple:
+    out, b = [], lo
+    while b <= hi:
+        out.append(b)
+        b *= 4
+    return tuple(out)
+
+
+PRESETS = {
+    # BASELINE.json:7 — CPU/gloo reference path, the correctness anchor.
+    "loopback2": Preset(
+        name="loopback2",
+        baseline_config="2-rank loopback allreduce, 4 KiB fp32 (CPU/gloo reference path)",
+        n_ranks=2, mesh2d=None, sizes=(4 * KiB,), dtypes=("float32",),
+        algos=("ring", "fused")),
+    # BASELINE.json:8
+    "ring8": Preset(
+        name="ring8",
+        baseline_config="8-rank single-host ring allreduce, 256 MiB fp32/bf16 sweep",
+        n_ranks=8, mesh2d=None, sizes=_sweep(4 * KiB, 256 * MiB),
+        dtypes=("float32", "bfloat16"), algos=("ring", "ring_bidir", "fused")),
+    # BASELINE.json:9
+    "tree64": Preset(
+        name="tree64",
+        baseline_config="64-rank tree allreduce + allgather, 1 GiB (single ICI slice)",
+        n_ranks=64, mesh2d=None, sizes=(1 * GiB,), dtypes=("float32",),
+        algos=("tree", "fused")),
+    # BASELINE.json:11 — hierarchical over DCN; 2 x v5p-128 on hardware,
+    # simulated as 2 "slices" of fake CPU devices on the oracle.
+    "multislice": Preset(
+        name="multislice",
+        baseline_config="Multi-slice 2xv5p-128 hierarchical allreduce + MoE alltoall over DCN",
+        n_ranks=256, mesh2d=(2, 128), sizes=_sweep(1 * MiB, 256 * MiB),
+        dtypes=("float32",), algos=("hierarchical", "fused")),
+}
+# BASELINE.json:10 (llama8b-ddp) is a workload, not a sweep; it lives in
+# rocnrdma_tpu/workloads (component C12) with its own CLI rather than here.
+
+
+def get_preset(name: str) -> Preset:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; know {sorted(PRESETS)}")
+    return PRESETS[name]

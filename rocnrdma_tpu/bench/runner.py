@@ -1,0 +1,226 @@
+"""Shared sweep runner behind the three bench CLIs (call stack 1-2 of
+SURVEY.md §3): parse flags → runtime init (L1) → Transport (L2) → schedule
+(L3) → timed loop → bus-bw report."""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import sys
+
+import jax
+import numpy as np
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.bench import presets as P
+from rocnrdma_tpu.bench.timing import time_fn
+from rocnrdma_tpu.transport import ALGOS, Transport
+
+_UNITS = {"": 1, "K": M.KiB, "M": M.MiB, "G": M.GiB}
+
+
+def parse_size(s: str) -> int:
+    s = s.strip().upper().rstrip("IB")
+    if s and s[-1] in _UNITS:
+        return int(float(s[:-1]) * _UNITS[s[-1]])
+    return int(s)
+
+
+def make_parser(bench_name: str, collective: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog=bench_name,
+        description=f"{collective} benchmark (TPU-native rebuild of the "
+                    f"reference's {bench_name} entrypoint)")
+    p.add_argument("--preset", choices=sorted(P.PRESETS), default=None,
+                   help="named BASELINE.json config; flags override fields")
+    p.add_argument("--ranks", type=int, default=None)
+    p.add_argument("--mesh2d", type=str, default=None, metavar="SLICESxPER",
+                   help="2-D ('slice','intra') mesh, e.g. 2x4 (hierarchical)")
+    p.add_argument("--sizes", type=str, default=None,
+                   help="comma list of per-rank bytes, e.g. 4K,1M,256M")
+    p.add_argument("--dtypes", type=str, default=None, help="e.g. float32,bfloat16")
+    p.add_argument("--algos", type=str, default=None, help=f"subset of {ALGOS}")
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--iters", type=int, default=10, help="calls per timed repeat")
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto",
+                   help="cpu = the fake-device oracle path (gloo analogue)")
+    p.add_argument("--fake-devices", type=int, default=None,
+                   help="force a CPU backend with N fake devices")
+    p.add_argument("--max-bytes", type=str, default=None,
+                   help="cap sweep sizes (preset auto-scaling)")
+    p.add_argument("--strict-preset", action="store_true",
+                   help="refuse to scale a preset down to the backend")
+    p.add_argument("--out", type=str, default=None, help="JSONL output path")
+    p.add_argument("--resume", action="store_true",
+                   help="skip sweep points already present in --out")
+    p.add_argument("--no-check", action="store_true",
+                   help="skip the numpy correctness check before timing")
+    p.add_argument("--profile", type=str, default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the timed loop")
+    return p
+
+
+def _setup_backend(args, need_ranks: int) -> None:
+    if args.fake_devices:
+        rt.force_cpu_devices(args.fake_devices)
+    elif args.platform == "cpu":
+        rt.force_cpu_devices(max(need_ranks, 2))
+
+
+def resolve_preset(args, collective: str) -> P.Preset:
+    """Merge preset defaults and CLI overrides into one concrete Preset."""
+    if args.preset:
+        pre = P.get_preset(args.preset)
+    else:
+        pre = P.Preset(name="custom", baseline_config="(custom flags)",
+                       n_ranks=args.ranks or 8, mesh2d=None,
+                       sizes=(4 * M.MiB,), dtypes=("float32",),
+                       algos=("fused",) if collective != "allreduce" else ("ring", "fused"))
+    import dataclasses
+    over = {}
+    if args.ranks:
+        over["n_ranks"] = args.ranks
+    if args.mesh2d:
+        s, per = args.mesh2d.lower().split("x")
+        over["mesh2d"] = (int(s), int(per))
+        over["n_ranks"] = int(s) * int(per)
+    if args.sizes:
+        over["sizes"] = tuple(parse_size(x) for x in args.sizes.split(","))
+    if args.dtypes:
+        over["dtypes"] = tuple(args.dtypes.split(","))
+    if args.algos:
+        over["algos"] = tuple(args.algos.split(","))
+    if args.no_check:
+        over["check"] = False
+    return dataclasses.replace(pre, **over)
+
+
+def _build_input(collective: str, n: int, mesh2d, size_bytes: int, dtype: str):
+    """Global input with leading mesh dims; returns (array, actual_bytes)."""
+    import jax.numpy as jnp
+    np_dtype = np.dtype(getattr(jnp, dtype))  # ml_dtypes covers bfloat16 etc.
+    itemsize = np_dtype.itemsize
+    elems = max(1, size_bytes // itemsize)
+    if collective in ("allgather",):
+        elems = max(n, elems // n * n)  # input chunk = S/n
+        per_rank = elems // n
+        shape = (n, per_rank)
+    elif collective in ("alltoall", "reducescatter"):
+        elems = max(n, elems // n * n)
+        shape = (n, n, elems // n) if collective == "alltoall" else (n, elems)
+    else:
+        shape = (n, elems)
+    if mesh2d is not None:
+        shape = mesh2d + shape[1:]
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(size=shape, dtype=np.float32).astype(np_dtype)
+    return x, elems * itemsize
+
+
+def _expected(collective: str, x: np.ndarray, mesh2d) -> np.ndarray:
+    xf = np.asarray(x, np.float32)
+    nlead = 2 if mesh2d is not None else 1
+    n = int(np.prod(xf.shape[:nlead]))
+    flat = xf.reshape((n,) + xf.shape[nlead:])  # rank-major view
+    if collective == "allreduce":
+        out = np.broadcast_to(flat.sum(axis=0), flat.shape)
+    elif collective == "reducescatter":
+        out = flat.sum(axis=0).reshape(n, -1)
+    elif collective == "allgather":
+        out = np.broadcast_to(flat.reshape(-1), (n, flat.size))
+    elif collective == "alltoall":
+        out = flat.transpose(1, 0, 2)
+    else:
+        raise ValueError(collective)
+    return out.reshape(xf.shape[:nlead] + out.shape[1:])
+
+
+def algos_for(collective: str, algos: tuple, is_2d: bool) -> tuple:
+    """Per-collective/mesh algorithm compatibility filter.
+
+    Presets bundle algos for a whole config (e.g. 'multislice' names
+    hierarchical allreduce AND MoE alltoall); each CLI keeps only the algos
+    its collective defines on the current mesh, falling back to 'fused'.
+    """
+    def ok(a):
+        if a == "auto" or a == "fused":
+            return True
+        if collective == "allreduce":
+            if a == "hierarchical":
+                return is_2d
+            return not is_2d  # ring/ring_bidir/tree ring a 1-D mesh
+        return a == "ring" and not is_2d
+    kept = tuple(a for a in algos if ok(a))
+    return kept or ("fused",)
+
+
+_OP = {"allreduce": "allreduce", "reducescatter": "reduce_scatter",
+       "allgather": "allgather", "alltoall": "alltoall"}
+
+
+def run_sweep(bench_name: str, collective: str, args) -> list:
+    pre = resolve_preset(args, collective)
+    _setup_backend(args, pre.n_ranks)
+    info = rt.init_runtime()
+    topo = info.topology
+
+    max_bytes = parse_size(args.max_bytes) if args.max_bytes else (
+        64 * M.MiB if topo.is_oracle else 4 * M.GiB)
+    if not args.strict_preset:
+        scaled = pre.scaled_to(topo.n_devices, max_bytes)
+        if scaled != pre:
+            print(f"# preset {pre.name!r} scaled to backend: ranks {pre.n_ranks}->"
+                  f"{scaled.n_ranks}, mesh2d {pre.mesh2d}->{scaled.mesh2d}, "
+                  f"{len(scaled.sizes)} size(s)", file=sys.stderr)
+        pre = scaled
+    if pre.n_ranks > topo.n_devices:
+        raise SystemExit(f"preset needs {pre.n_ranks} ranks; backend has "
+                         f"{topo.n_devices} devices (use --fake-devices or drop "
+                         f"--strict-preset)")
+
+    mesh = rt.slice_mesh(*pre.mesh2d) if pre.mesh2d else rt.rank_mesh(pre.n_ranks)
+    t = Transport(mesh)
+
+    algos = algos_for(collective, pre.algos, t.is_2d)
+    if set(algos) != set(pre.algos):
+        print(f"# algos for {collective} on this mesh: {algos} "
+              f"(preset named {pre.algos})", file=sys.stderr)
+
+    done = M.load_completed(args.out) if (args.out and args.resume) else set()
+    out_fp = open(args.out, "a") if args.out else None
+    prof = jax.profiler.trace(args.profile) if args.profile else contextlib.nullcontext()
+
+    records = []
+    with prof:
+        for dtype in pre.dtypes:
+            for size in pre.sizes:
+                x_np, actual = _build_input(collective, pre.n_ranks, pre.mesh2d,
+                                            size, dtype)
+                x = t.shard(x_np)
+                for algo in algos:
+                    key = (bench_name, collective, algo, pre.n_ranks, actual, dtype)
+                    if key in done:
+                        continue
+                    fn = t.jit_fn(_OP[collective], algo)
+                    if pre.check:
+                        got = np.asarray(fn(x), np.float32)
+                        want = _expected(collective, x_np, pre.mesh2d)
+                        rtol, atol = (1e-4, 1e-5) if dtype == "float32" else (5e-2, 5e-2)
+                        np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+                    tm = time_fn(fn, x, warmup=args.warmup, repeats=args.repeats,
+                                 calls_per_repeat=args.iters)
+                    rec = M.BenchRecord.measure(
+                        bench_name, collective, algo, pre.n_ranks, actual, dtype,
+                        tm.mean_s, platform=topo.platform, preset=pre.name,
+                        mesh2d=list(pre.mesh2d) if pre.mesh2d else None,
+                        min_s=tm.min_s, max_s=tm.max_s, checked=pre.check)
+                    records.append(rec)
+                    if out_fp:
+                        rec.write(out_fp)
+                del x
+    if out_fp:
+        out_fp.close()
+    print(M.format_table(records))
+    return records

@@ -1,0 +1,56 @@
+"""Timing discipline (SURVEY.md §7 "honest bus-bw accounting under jit").
+
+Rules encoded here:
+
+- compile is excluded: warmup iterations run (and block) before any timer
+  starts;
+- only steady-state device time counts: a repeat = ``calls_per_repeat``
+  back-to-back async dispatches with ONE ``block_until_ready`` at the end, so
+  Python dispatch overhead pipelines away instead of being billed to the
+  wire (for 4 KiB latency points the per-call span IS the latency, which is
+  what the loopback config measures);
+- the reported number is a trimmed mean over repeats: drop the fastest and
+  slowest repeat (clock jitter, background noise), mean the rest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+
+
+@dataclasses.dataclass
+class Timing:
+    mean_s: float          # trimmed-mean seconds per call
+    min_s: float
+    max_s: float
+    repeats: int
+    calls_per_repeat: int
+
+
+def trimmed_mean(xs: list[float]) -> float:
+    if len(xs) > 2:
+        xs = sorted(xs)[1:-1]
+    return sum(xs) / len(xs)
+
+
+def time_fn(fn, *args, warmup: int = 2, repeats: int = 5,
+            calls_per_repeat: int = 10) -> Timing:
+    """Time ``fn(*args)`` (a jitted callable) per the rules above."""
+    # At least one untimed call always runs: compile must never be billed to
+    # the first timed repeat, even with --warmup 0.
+    for _ in range(max(1, warmup)):
+        out = fn(*args)
+    jax.block_until_ready(out)
+
+    spans = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(calls_per_repeat):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        spans.append((time.perf_counter() - t0) / calls_per_repeat)
+    return Timing(mean_s=trimmed_mean(spans), min_s=min(spans), max_s=max(spans),
+                  repeats=repeats, calls_per_repeat=calls_per_repeat)

@@ -1,0 +1,30 @@
+"""Collective algorithm schedules (L3 of SURVEY.md §1).
+
+Two families:
+
+- ``schedule``: pure, device-free descriptions of the ring / halving-doubling /
+  rotation / hierarchical algorithms, with reference simulators. These are the
+  TPU rebuild of the reference's "its own ring/tree allreduce" (the
+  inspectable, educational path).
+- ``ring`` / ``tree`` / ``alltoall`` / ``hierarchical``: jit-compiled
+  implementations of those schedules as ``lax.ppermute`` programs under
+  ``jax.shard_map`` — axis-level primitives callable on any mesh axis.
+- ``fused``: the XLA-lowered fast path (``lax.psum`` / ``lax.all_to_all``),
+  the production default.
+"""
+
+from rocnrdma_tpu.collectives import schedule  # noqa: F401
+from rocnrdma_tpu.collectives.ring import (  # noqa: F401
+    ring_allgather,
+    ring_allreduce,
+    ring_reduce_scatter,
+)
+from rocnrdma_tpu.collectives.tree import hd_allreduce  # noqa: F401
+from rocnrdma_tpu.collectives.alltoall import rotation_alltoall  # noqa: F401
+from rocnrdma_tpu.collectives.hierarchical import hierarchical_allreduce  # noqa: F401
+from rocnrdma_tpu.collectives.fused import (  # noqa: F401
+    fused_allgather,
+    fused_allreduce,
+    fused_alltoall,
+    fused_reduce_scatter,
+)

@@ -1,0 +1,36 @@
+"""Alltoall — the MoE dispatch/combine primitive (component C7).
+
+Rotation algorithm (see ``schedule.py``): n-1 steps; at step s every rank
+ships the chunk destined ``s`` ranks ahead along a shift-by-``s`` ring
+permutation. Each step is one fused ICI exchange; all steps together move
+(n-1)/n of the buffer — the alltoall busbw factor.
+
+Axis-level primitive: call inside ``jax.shard_map``. Input ``x`` has leading
+dim n (chunk i is destined for rank i); output has chunk j = what rank j sent
+to me (i.e. the global transpose).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def rotation_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
+    n = lax.axis_size(axis_name)
+    if x.shape[0] != n:
+        raise ValueError(f"leading dim {x.shape[0]} != axis size {n}")
+    if n == 1:
+        return x
+    r = lax.axis_index(axis_name)
+    out = x
+    # Python loop: each step uses a DIFFERENT static permutation (shift by s),
+    # which lax.ppermute requires to be compile-time constant.
+    for s in range(1, n):
+        perm = [(i, (i + s) % n) for i in range(n)]
+        send_idx = (r + s) % n
+        chunk = lax.dynamic_index_in_dim(x, send_idx, axis=0, keepdims=False)
+        recvd = lax.ppermute(chunk, axis_name, perm=perm)
+        recv_slot = (r - s) % n
+        out = lax.dynamic_update_index_in_dim(out, recvd, recv_slot, axis=0)
+    return out

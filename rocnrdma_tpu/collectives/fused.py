@@ -1,0 +1,46 @@
+"""Fused XLA collectives — the production fast path (SURVEY.md §1 L3).
+
+The explicit schedules in this package exist to be inspectable and to own
+the algorithm; these wrappers are the one-op XLA lowerings that the
+transport's ``algo="fused"`` (and ``algo="auto"`` on the hot path) selects.
+XLA lowers them straight to the ICI collective engine — the bar the explicit
+schedules are benchmarked against.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def fused_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
+    return lax.psum(x, axis_name)
+
+
+def _total_size(axis_name) -> int:
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= lax.axis_size(a)
+        return n
+    return lax.axis_size(axis_name)
+
+
+def fused_reduce_scatter(x: jax.Array, axis_name) -> jax.Array:
+    """Rank r gets the reduced r-th 1/n of x (flattened), like ring_reduce_scatter."""
+    n = _total_size(axis_name)
+    flat = x.reshape(-1)
+    if flat.size % n:
+        raise ValueError(f"reduce_scatter buffer ({flat.size}) must divide by {n}")
+    return lax.psum_scatter(flat.reshape(n, -1), axis_name, scatter_dimension=0,
+                            tiled=False)
+
+
+def fused_allgather(x: jax.Array, axis_name: str) -> jax.Array:
+    """Concatenate every rank's x along a new leading dim, like ring_allgather."""
+    return lax.all_gather(x, axis_name, axis=0, tiled=False)
+
+
+def fused_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
+    """Global transpose over leading dim n, like rotation_alltoall."""
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)

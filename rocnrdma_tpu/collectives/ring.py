@@ -1,0 +1,150 @@
+"""Ring collectives as explicit ``lax.ppermute`` programs (component C4).
+
+These are axis-level primitives: call them INSIDE ``jax.shard_map`` on a
+per-device shard, naming the mesh axis to ring over. They compose — the
+hierarchical schedule runs them over different axes of a 2-D mesh.
+
+The schedule is exactly ``collectives/schedule.py``'s ring indices; the
+simulators there are the oracle the device tests compare against.
+
+Performance notes (SURVEY.md §7 "hard parts"):
+
+- The n-chunk ring is inherently pipelined: every step moves 1/n of the
+  buffer while the previous chunk's add is still in flight; XLA overlaps the
+  ``ppermute`` DMA with the accumulate under ``fori_loop`` on TPU.
+- ``bidir=True`` splits the buffer in half and runs two counter-rotating
+  rings in the same loop body. On a bidirectional ICI torus this doubles
+  link utilisation (each physical link carries traffic both directions
+  simultaneously), which is how an explicit schedule approaches the fused
+  ``psum``'s line rate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ring_perm(n: int, shift: int = 1) -> list[tuple[int, int]]:
+    return [(r, (r + shift) % n) for r in range(n)]
+
+
+def _chunked(x: jax.Array, n: int) -> tuple[jax.Array, int, tuple]:
+    """Flatten x and pad to (n, chunk_elems). Returns (buf, orig_size, shape)."""
+    shape = x.shape
+    flat = x.reshape(-1)
+    size = flat.size
+    chunk = -(-size // n)  # ceil
+    flat = jnp.pad(flat, (0, n * chunk - size))
+    return flat.reshape(n, chunk), size, shape
+
+
+def _unchunk(buf: jax.Array, size: int, shape: tuple) -> jax.Array:
+    return buf.reshape(-1)[:size].reshape(shape)
+
+
+def _rs_phase(buf: jax.Array, axis_name: str, n: int, shift: int,
+              offset: int = 0) -> jax.Array:
+    """Reduce-scatter phase: n-1 rotate-and-accumulate steps.
+
+    After the phase, rank r owns the fully-reduced chunk ``(r + d + offset)
+    mod n`` (d = ring direction). ``offset=0`` is the allreduce layout;
+    ``offset=-d`` lands the owned chunk at index r directly, which lets a
+    standalone reduce-scatter skip a layout-fixup hop.
+    """
+    r = lax.axis_index(axis_name)
+    d = 1 if shift == 1 else -1  # chunk-index direction follows ring direction
+    perm = _ring_perm(n, shift)
+
+    def step(s, buf):
+        send_idx = (r - d * s + offset) % n
+        chunk = lax.dynamic_index_in_dim(buf, send_idx, axis=0, keepdims=False)
+        recvd = lax.ppermute(chunk, axis_name, perm=perm)
+        recv_idx = (r - d * (s + 1) + offset) % n
+        mine = lax.dynamic_index_in_dim(buf, recv_idx, axis=0, keepdims=False)
+        return lax.dynamic_update_index_in_dim(buf, mine + recvd, recv_idx, axis=0)
+
+    return lax.fori_loop(0, n - 1, step, buf)
+
+
+def _ag_phase(buf: jax.Array, axis_name: str, n: int, shift: int,
+              owned_offset: int) -> jax.Array:
+    """Allgather phase: rotate completed chunks. ``owned_offset`` is the
+    offset of the chunk each rank starts with (+1 after a reduce-scatter in
+    the same direction, 0 for a standalone allgather)."""
+    r = lax.axis_index(axis_name)
+    d = 1 if shift == 1 else -1
+    perm = _ring_perm(n, shift)
+
+    def step(s, buf):
+        send_idx = (r + d * (owned_offset - s)) % n
+        chunk = lax.dynamic_index_in_dim(buf, send_idx, axis=0, keepdims=False)
+        recvd = lax.ppermute(chunk, axis_name, perm=perm)
+        recv_idx = (r + d * (owned_offset - s - 1)) % n
+        return lax.dynamic_update_index_in_dim(buf, recvd, recv_idx, axis=0)
+
+    return lax.fori_loop(0, n - 1, step, buf)
+
+
+def ring_allreduce(x: jax.Array, axis_name: str, *, bidir: bool = False) -> jax.Array:
+    """Allreduce (sum) via reduce-scatter + allgather over the ``axis_name`` ring.
+
+    Every rank ends with the elementwise sum of all ranks' ``x``.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if not bidir:
+        buf, size, shape = _chunked(x, n)
+        buf = _rs_phase(buf, axis_name, n, shift=1)
+        buf = _ag_phase(buf, axis_name, n, shift=1, owned_offset=1)
+        return _unchunk(buf, size, shape)
+
+    # Bidirectional: half the buffer rides the +1 ring, half the -1 ring.
+    flat = x.reshape(-1)
+    half = flat.size // 2
+    lo = ring_allreduce(flat[:half], axis_name)
+    hi = _bidir_partner(flat[half:], axis_name, n)
+    return jnp.concatenate([lo, hi]).reshape(x.shape)
+
+
+def _bidir_partner(x: jax.Array, axis_name: str, n: int) -> jax.Array:
+    buf, size, shape = _chunked(x, n)
+    buf = _rs_phase(buf, axis_name, n, shift=-1)
+    buf = _ag_phase(buf, axis_name, n, shift=-1, owned_offset=1)
+    return _unchunk(buf, size, shape)
+
+
+def ring_reduce_scatter(x: jax.Array, axis_name: str) -> jax.Array:
+    """Reduce-scatter (sum): rank r returns the fully-reduced r-th 1/n of x.
+
+    x must flatten to a multiple of the axis size.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x.reshape(-1)
+    flat = x.reshape(-1)
+    if flat.size % n:
+        raise ValueError(f"reduce_scatter buffer ({flat.size} elems) must divide by axis size {n}")
+    buf = flat.reshape(n, -1)
+    # offset=-1: the schedule ends with rank r owning chunk r — the
+    # conventional reduce-scatter layout — with no fixup hop.
+    buf = _rs_phase(buf, axis_name, n, shift=1, offset=-1)
+    r = lax.axis_index(axis_name)
+    return lax.dynamic_index_in_dim(buf, r, axis=0, keepdims=False)
+
+
+def ring_allgather(x: jax.Array, axis_name: str) -> jax.Array:
+    """Allgather: concatenate every rank's ``x`` along a new leading chunk dim.
+
+    Returns shape ``(n, *x.shape)``; rank order along dim 0.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x[None]
+    r = lax.axis_index(axis_name)
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, r, axis=0)
+    out = _ag_phase(out, axis_name, n, shift=1, owned_offset=0)
+    return out

@@ -1,0 +1,186 @@
+"""Pure schedule generation for the explicit collective algorithms.
+
+Device-free: every function here is plain Python/numpy, unit-testable without
+a mesh (SURVEY.md §4 "Unit" tier). The jit implementations in this package
+use exactly these index functions, and the simulators below are the oracle
+the device tests compare against.
+
+Algorithm notes (these ARE the design, so they live next to the indices):
+
+**Ring allreduce** (bandwidth-optimal; the reference repo's headline
+algorithm per BASELINE.json:5). Buffer on each of n ranks is split into n
+chunks. Phase 1, reduce-scatter, n-1 steps: at step s, rank r sends chunk
+``(r - s) mod n`` to rank ``(r+1) mod n`` and adds the chunk it receives into
+its buffer. After n-1 steps rank r holds the fully-reduced chunk
+``(r + 1) mod n``. Phase 2, allgather, n-1 steps: completed chunks rotate the
+same direction; at step s rank r sends chunk ``(r + 1 - s) mod n``. Total
+traffic per rank: ``2 (n-1)/n * S`` — the busbw factor in metrics.py.
+
+**Halving-doubling allreduce** (the "tree" algorithm: latency-optimal at
+log2(n) x 2 steps, same total traffic as ring). Requires n a power of two.
+Reduce-scatter by recursive halving: at step s the partner is
+``rank XOR mask`` with mask = n/2, n/4, ..., 1; each pair exchanges the half
+of their current segment that the partner will own and adds. Allgather by
+recursive doubling reverses the masks.
+
+**Alltoall rotation** (the MoE dispatch/combine primitive). n-1 steps; at
+step s, every rank sends the chunk destined for rank ``(r + s) mod n`` along
+a shift-by-s permutation and stores the chunk received from ``(r - s) mod n``
+into slot ``(r - s) mod n``.
+
+**Hierarchical allreduce** (multi-slice, BASELINE.json:11): on a 2-axis
+``('slice', 'intra')`` mesh, reduce-scatter over ICI (intra), allreduce the
+scattered shard across slices over DCN, then allgather over ICI. DCN traffic
+shrinks to S/intra per rank — the whole point of the hierarchy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Ring
+
+
+def ring_permutation(n: int, shift: int = 1) -> list[tuple[int, int]]:
+    """The (src, dst) pairs for a rotate-by-``shift`` step, as lax.ppermute wants."""
+    return [(r, (r + shift) % n) for r in range(n)]
+
+
+def ring_rs_send_chunk(n: int, step: int, rank: int) -> int:
+    """Chunk index ``rank`` transmits at reduce-scatter step ``step``."""
+    return (rank - step) % n
+
+
+def ring_rs_recv_chunk(n: int, step: int, rank: int) -> int:
+    """Chunk index ``rank`` receives (and accumulates) at RS step ``step``."""
+    return (rank - step - 1) % n
+
+
+def ring_owned_chunk(n: int, rank: int) -> int:
+    """Chunk fully reduced on ``rank`` after the n-1 reduce-scatter steps."""
+    return (rank + 1) % n
+
+
+def ring_ag_send_chunk(n: int, step: int, rank: int) -> int:
+    """Chunk index ``rank`` transmits at allgather step ``step``."""
+    return (rank + 1 - step) % n
+
+
+def ring_ag_recv_chunk(n: int, step: int, rank: int) -> int:
+    return (rank - step) % n
+
+
+# ---------------------------------------------------------------------------
+# Halving-doubling ("tree")
+
+
+def hd_masks(n: int) -> list[int]:
+    """Partner XOR masks for recursive halving: [n/2, n/4, ..., 1]."""
+    if n & (n - 1) or n < 1:
+        raise ValueError(f"halving-doubling needs a power-of-two rank count, got {n}")
+    masks = []
+    m = n >> 1
+    while m:
+        masks.append(m)
+        m >>= 1
+    return masks
+
+
+def hd_segment(n: int, rank: int, upto_step: int) -> tuple[int, int]:
+    """(start_chunk, n_chunks) of the buffer segment ``rank`` still owns after
+    ``upto_step`` halving steps, in units of 1/n-th chunks."""
+    start, length = 0, n
+    for mask in hd_masks(n)[:upto_step]:
+        length //= 2
+        if rank & mask:  # upper partner keeps the upper half
+            start += length
+    return start, length
+
+
+# ---------------------------------------------------------------------------
+# Alltoall rotation
+
+
+def a2a_send_chunk(n: int, step: int, rank: int) -> int:
+    """Chunk index ``rank`` transmits at rotation step ``step`` (1-based)."""
+    return (rank + step) % n
+
+
+def a2a_recv_slot(n: int, step: int, rank: int) -> int:
+    """Slot where ``rank`` stores the chunk received at rotation step ``step``."""
+    return (rank - step) % n
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical
+
+
+def hierarchical_phases() -> list[tuple[str, str]]:
+    """(collective, mesh_axis) phases of the 2-level allreduce."""
+    return [("reducescatter", "intra"), ("allreduce", "slice"), ("allgather", "intra")]
+
+
+# ---------------------------------------------------------------------------
+# Reference simulators (pure numpy message-passing; the unit-test oracle)
+
+
+def sim_ring_allreduce(bufs: np.ndarray) -> np.ndarray:
+    """Simulate the ring schedule on a (n, n*chunk) buffer array, one row per rank."""
+    n = bufs.shape[0]
+    bufs = bufs.reshape(n, n, -1).copy()  # (rank, chunk, elems)
+    for step in range(n - 1):
+        sent = {r: bufs[r, ring_rs_send_chunk(n, step, r)].copy() for r in range(n)}
+        for src, dst in ring_permutation(n):
+            bufs[dst, ring_rs_recv_chunk(n, step, dst)] += sent[src]
+    for step in range(n - 1):
+        sent = {r: bufs[r, ring_ag_send_chunk(n, step, r)].copy() for r in range(n)}
+        for src, dst in ring_permutation(n):
+            bufs[dst, ring_ag_recv_chunk(n, step, dst)] = sent[src]
+    return bufs.reshape(n, -1)
+
+
+def sim_hd_allreduce(bufs: np.ndarray) -> np.ndarray:
+    """Simulate halving-doubling on a (n, n*chunk) buffer array."""
+    n = bufs.shape[0]
+    bufs = bufs.reshape(n, n, -1).copy()
+    masks = hd_masks(n)
+    # recursive halving (reduce-scatter)
+    for s, mask in enumerate(masks):
+        sent = {}
+        for r in range(n):
+            start, length = hd_segment(n, r, s)
+            half = length // 2
+            # send the half the partner keeps
+            if r & mask:  # I keep upper; send lower
+                sent[r] = (start, half, bufs[r, start:start + half].copy())
+            else:
+                sent[r] = (start + half, half, bufs[r, start + half:start + length].copy())
+        for r in range(n):
+            p = r ^ mask
+            st, ln, data = sent[p]
+            bufs[r, st:st + ln] += data
+    # recursive doubling (allgather)
+    for s, mask in enumerate(reversed(masks)):
+        step = len(masks) - 1 - s
+        sent = {}
+        for r in range(n):
+            start, length = hd_segment(n, r, step + 1)
+            sent[r] = (start, length, bufs[r, start:start + length].copy())
+        for r in range(n):
+            p = r ^ mask
+            st, ln, data = sent[p]
+            bufs[r, st:st + ln] = data
+    return bufs.reshape(n, -1)
+
+
+def sim_alltoall(bufs: np.ndarray) -> np.ndarray:
+    """Simulate the rotation alltoall on a (n, n*chunk) array: out[j, i] = in[i, j]."""
+    n = bufs.shape[0]
+    bufs = bufs.reshape(n, n, -1)
+    out = bufs.copy()
+    for step in range(1, n):
+        sent = {r: bufs[r, a2a_send_chunk(n, step, r)].copy() for r in range(n)}
+        for src, dst in ring_permutation(n, shift=step):
+            out[dst, a2a_recv_slot(n, step, dst)] = sent[src]
+    return out.reshape(n, -1)

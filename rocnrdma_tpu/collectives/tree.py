@@ -1,0 +1,69 @@
+"""Halving-doubling allreduce — the "tree" algorithm (component C5).
+
+The latency-optimal counterpart to the ring: 2·log2(n) steps instead of
+2(n-1), same 2(n-1)/n·S total traffic. This is the schedule the reference's
+"tree allreduce" slot maps to on TPU (BASELINE.json:5,9) — on an ICI torus
+the XOR-partner exchanges are a natural fit for recursive halving.
+
+Axis-level primitive: call inside ``jax.shard_map``. Requires a power-of-two
+axis size (as the reference's tree did for its 64-rank config).
+
+Schedule indices match ``collectives/schedule.py`` (``hd_masks`` /
+``hd_segment``); ``sim_hd_allreduce`` is the oracle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rocnrdma_tpu.collectives.schedule import hd_masks
+
+
+def _pair_perm(n: int, mask: int) -> list[tuple[int, int]]:
+    """Pairwise exchange permutation: every rank sends to rank^mask."""
+    return [(r, r ^ mask) for r in range(n)]
+
+
+def hd_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
+    """Allreduce (sum) by recursive halving + recursive doubling."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    masks = hd_masks(n)  # raises on non-power-of-two
+    r = lax.axis_index(axis_name)
+
+    shape, size = x.shape, x.size
+    flat = x.reshape(-1)
+    chunk = -(-size // n)
+    buf = jnp.pad(flat, (0, n * chunk - size)).reshape(n, chunk)
+
+    # Recursive halving (reduce-scatter). Python loop: log2(n) steps, each
+    # with static segment length but rank-dependent (traced) start.
+    start = jnp.zeros((), jnp.int32)  # my segment start, in chunks
+    length = n
+    for mask in masks:
+        half = length // 2
+        upper = (r & mask).astype(bool)  # do I keep the upper half?
+        # send the half the partner keeps, receive into the half I keep
+        send_start = jnp.where(upper, start, start + half)
+        keep_start = jnp.where(upper, start + half, start)
+        sent = lax.dynamic_slice_in_dim(buf, send_start, half, axis=0)
+        recvd = lax.ppermute(sent, axis_name, perm=_pair_perm(n, mask))
+        kept = lax.dynamic_slice_in_dim(buf, keep_start, half, axis=0)
+        buf = lax.dynamic_update_slice_in_dim(buf, kept + recvd, keep_start, axis=0)
+        start, length = keep_start, half
+
+    # Recursive doubling (allgather): undo the halving, largest mask last.
+    for mask in reversed(masks):
+        # My segment is [start, start+length); the partner owns the sibling
+        # half of the parent segment — flip the 'length' bit of start.
+        partner_start = jnp.where((start // length) % 2 == 0, start + length, start - length)
+        mine = lax.dynamic_slice_in_dim(buf, start, length, axis=0)
+        recvd = lax.ppermute(mine, axis_name, perm=_pair_perm(n, mask))
+        buf = lax.dynamic_update_slice_in_dim(buf, recvd, partner_start, axis=0)
+        start = jnp.minimum(start, partner_start)
+        length *= 2
+
+    return buf.reshape(-1)[:size].reshape(shape)

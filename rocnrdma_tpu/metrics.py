@@ -1,0 +1,142 @@
+"""Bandwidth metric definitions — the contract of BASELINE.json:2.
+
+One module owns the bus-bandwidth / algorithmic-bandwidth formulas so every
+benchmark and test reports identically (SURVEY.md §5 "Metrics/logging").
+
+Conventions (matching the standard collective-benchmark accounting used by
+nccl-tests-style suites, which the reference's ``bench_allreduce`` followed):
+
+- ``size_bytes`` is the per-rank buffer size S (each rank holds S bytes before
+  and after the collective, except where noted).
+- **algbw** (algorithmic bandwidth) = S / t. What the caller observes.
+- **busbw** (bus bandwidth) = algbw x a topology factor that normalises for
+  the traffic the algorithm must move per link, so that a perfect
+  implementation of any collective on the same wire shows the same busbw:
+
+  ==============  ==================  =========================================
+  collective      busbw factor        rationale
+  ==============  ==================  =========================================
+  allreduce       2(n-1)/n            ring moves each byte out and back in:
+                                      reduce-scatter (n-1 chunk hops) +
+                                      allgather (n-1 chunk hops), chunks S/n.
+  allgather       (n-1)/n             each rank receives (n-1) chunks of S/n.
+  reducescatter   (n-1)/n             mirror of allgather.
+  alltoall        (n-1)/n             each rank sends (n-1) of its n chunks.
+  broadcast       1                   every byte crosses each link once.
+  ==============  ==================  =========================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import IO
+
+GiB = 1024**3
+MiB = 1024**2
+KiB = 1024
+
+_BUSBW_FACTOR = {
+    "allreduce": lambda n: 2.0 * (n - 1) / n,
+    "allgather": lambda n: (n - 1) / n,
+    "reducescatter": lambda n: (n - 1) / n,
+    "alltoall": lambda n: (n - 1) / n,
+    "broadcast": lambda n: 1.0,
+}
+
+
+def algbw_GBps(size_bytes: int, seconds: float) -> float:
+    """Algorithmic bandwidth in GB/s (decimal GB, as bandwidths are quoted)."""
+    return size_bytes / seconds / 1e9
+
+
+def busbw_GBps(collective: str, n_ranks: int, size_bytes: int, seconds: float) -> float:
+    """Bus bandwidth in GB/s/chip for ``collective`` over ``n_ranks`` ranks."""
+    if collective not in _BUSBW_FACTOR:
+        raise ValueError(f"unknown collective {collective!r}; know {sorted(_BUSBW_FACTOR)}")
+    if n_ranks < 1:
+        raise ValueError(f"n_ranks must be >= 1, got {n_ranks}")
+    if n_ranks == 1:
+        # Degenerate single-rank case: no wire traffic; busbw defined as 0 so
+        # single-chip smoke runs can't masquerade as line-rate numbers.
+        return 0.0
+    return algbw_GBps(size_bytes, seconds) * _BUSBW_FACTOR[collective](n_ranks)
+
+
+@dataclasses.dataclass
+class BenchRecord:
+    """One benchmark measurement row, serialisable to JSONL.
+
+    JSONL (one object per line) is the incremental format so an interrupted
+    sweep can resume by reading back completed rows (SURVEY.md §5
+    checkpoint/resume disposition).
+    """
+
+    bench: str            # e.g. "bench_allreduce"
+    collective: str       # key into the busbw table
+    algo: str             # "ring" | "tree" | "fused" | "hierarchical" | ...
+    n_ranks: int
+    size_bytes: int
+    dtype: str
+    mean_s: float         # trimmed-mean steady-state seconds per op
+    algbw_GBps: float
+    busbw_GBps: float
+    platform: str = ""
+    extra: dict = dataclasses.field(default_factory=dict)
+    ts: float = dataclasses.field(default_factory=time.time)
+
+    @classmethod
+    def measure(cls, bench, collective, algo, n_ranks, size_bytes, dtype,
+                mean_s, platform="", **extra):
+        return cls(
+            bench=bench, collective=collective, algo=algo, n_ranks=n_ranks,
+            size_bytes=size_bytes, dtype=dtype, mean_s=mean_s,
+            algbw_GBps=algbw_GBps(size_bytes, mean_s),
+            busbw_GBps=busbw_GBps(collective, n_ranks, size_bytes, mean_s),
+            platform=platform, extra=extra,
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    def write(self, fp: IO[str]) -> None:
+        fp.write(self.to_json() + "\n")
+        fp.flush()
+
+    def key(self) -> tuple:
+        """Identity of a sweep point, for resume-time dedup."""
+        return (self.bench, self.collective, self.algo, self.n_ranks,
+                self.size_bytes, self.dtype)
+
+
+def load_completed(path) -> set:
+    """Read back a (possibly partial) JSONL sweep; return the set of done keys."""
+    done = set()
+    try:
+        with open(path) as fp:
+            for line in fp:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line from an interrupted run
+                done.add((d["bench"], d["collective"], d["algo"],
+                          d["n_ranks"], d["size_bytes"], d["dtype"]))
+    except FileNotFoundError:
+        pass
+    return done
+
+
+def format_table(records: list) -> str:
+    """Human-readable stdout table for a list of BenchRecords."""
+    hdr = f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} {'dtype':>9} {'time(us)':>12} {'algbw GB/s':>11} {'busbw GB/s':>11}"
+    lines = [hdr, "-" * len(hdr)]
+    for r in records:
+        lines.append(
+            f"{r.collective:>13} {r.algo:>12} {r.n_ranks:>5} {r.size_bytes:>14} "
+            f"{r.dtype:>9} {r.mean_s * 1e6:>12.1f} {r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,24 @@
+"""Runtime shim (L1/L0 of SURVEY.md §1).
+
+The rebuild of the reference's lowest stratum — the ``ibv_*`` queue-pair
+layer, ``hipMemRegister`` pinning, and rank bootstrap. On TPU none of that
+exists as user code: device memory is managed by XLA, "registration" becomes
+buffer donation, and the wire is driven by compiled collectives. What remains
+is exactly what this package owns:
+
+- process bootstrap (``jax.distributed.initialize``) — the process boundary,
+- topology discovery (devices, slices, ICI vs DCN),
+- mesh construction (the 1-D rank ring and the 2-D ``('slice','intra')``
+  mesh the hierarchical schedules run over),
+- the CPU fake-device oracle bootstrap (the gloo-loopback analogue,
+  BASELINE.json:7).
+"""
+
+from rocnrdma_tpu.runtime.mesh import (  # noqa: F401
+    Topology,
+    detect_topology,
+    rank_mesh,
+    slice_mesh,
+)
+from rocnrdma_tpu.runtime.init import init_runtime, RuntimeInfo  # noqa: F401
+from rocnrdma_tpu.runtime.cpu_backend import force_cpu_devices  # noqa: F401

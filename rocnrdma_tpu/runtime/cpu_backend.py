@@ -1,0 +1,33 @@
+"""CPU fake-device oracle bootstrap (L0; the reference's CPU/gloo path).
+
+Must run before jax initialises a backend; bench entrypoints call this when
+``--platform cpu --fake-devices N`` is given, and the test suite's conftest
+does the equivalent. Uses ``jax.config`` (not just env vars) because the
+container may import jax at interpreter startup, freezing env-derived
+defaults before user code runs.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    """Configure an ``n``-fake-device CPU backend, or raise if it's too late."""
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except RuntimeError as e:
+        # config.update raises once backends are initialised; verify the
+        # existing layout is usable rather than silently benchmarking the
+        # wrong device count.
+        devs = jax.devices()
+        if devs[0].platform != "cpu" or len(devs) < n:
+            raise RuntimeError(
+                f"jax already initialised with {len(devs)} {devs[0].platform} "
+                f"device(s); cannot retro-fit {n} fake CPU devices "
+                f"(set JAX_PLATFORMS=cpu and the device count before startup): {e}"
+            ) from e

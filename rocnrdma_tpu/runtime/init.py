@@ -1,0 +1,74 @@
+"""Runtime bootstrap (call stack 5 of SURVEY.md §3).
+
+``init_runtime`` is the single entrypoint every CLI calls first. It
+
+1. optionally runs ``jax.distributed.initialize`` (the process boundary —
+   one process per host, coordinated by the JAX coordination service; the
+   rebuild of the reference's rank bootstrap/out-of-band exchange),
+2. probes the topology (rank/slice counts, platform),
+3. selects the oracle path when on the CPU backend (BASELINE.json:7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+
+from rocnrdma_tpu.runtime.mesh import Topology, detect_topology
+
+log = logging.getLogger("rocnrdma_tpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeInfo:
+    topology: Topology
+    distributed: bool   # did we run jax.distributed.initialize?
+
+
+def _should_init_distributed(coordinator, num_processes) -> bool:
+    if coordinator is not None or num_processes is not None:
+        return True
+    # Auto-detect common launcher environments (the coordination analogue of
+    # the reference's MPI/env bootstrap).
+    return any(v in os.environ for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS"))
+
+
+def init_runtime(coordinator: str | None = None,
+                 num_processes: int | None = None,
+                 process_id: int | None = None,
+                 timeout_s: int = 60) -> RuntimeInfo:
+    """Initialise the distributed runtime and probe the topology.
+
+    Surfacing coordinator timeouts (rather than hanging) is the minimal
+    failure-detection disposition of SURVEY.md §5: initialization failures
+    raise with the coordinator address in the message.
+    """
+    distributed = False
+    if _should_init_distributed(coordinator, num_processes):
+        coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS") \
+            or os.environ.get("COORDINATOR_ADDRESS")
+        kwargs = {}
+        if coordinator:
+            kwargs["coordinator_address"] = coordinator
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        kwargs["initialization_timeout"] = timeout_s
+        try:
+            jax.distributed.initialize(**kwargs)
+        except Exception as e:  # re-raise with the address for diagnosability
+            raise RuntimeError(
+                f"jax.distributed.initialize failed (coordinator={coordinator!r}, "
+                f"num_processes={num_processes}, process_id={process_id}): {e}"
+            ) from e
+        distributed = True
+
+    topo = detect_topology()
+    log.info("runtime: platform=%s devices=%d processes=%d slices=%d%s",
+             topo.platform, topo.n_devices, topo.n_processes, topo.n_slices,
+             " [CPU oracle path]" if topo.is_oracle else "")
+    return RuntimeInfo(topology=topo, distributed=distributed)

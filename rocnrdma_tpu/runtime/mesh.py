@@ -1,0 +1,105 @@
+"""Topology discovery and mesh construction (component C9, SURVEY.md §2).
+
+Axis naming contract used across the whole framework:
+
+- ``"rank"`` — the flat 1-D ring every single-level collective runs over.
+- ``("slice", "intra")`` — the 2-D mesh for hierarchical schedules: ``intra``
+  hops ride ICI (fast, in-slice), ``slice`` hops ride DCN (slow, cross-slice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+RANK_AXIS = "rank"
+SLICE_AXIS = "slice"
+INTRA_AXIS = "intra"
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """What the runtime learned about the machine (capability probe, §3 stack 5)."""
+
+    platform: str              # "tpu" | "cpu" | ...
+    n_devices: int
+    n_processes: int
+    process_index: int
+    n_slices: int
+    devices_per_slice: int
+    devices: tuple             # all devices, slice-major order
+
+    @property
+    def multi_slice(self) -> bool:
+        return self.n_slices > 1
+
+    @property
+    def is_oracle(self) -> bool:
+        """True on the CPU fake-device oracle backend (the gloo analogue)."""
+        return self.platform == "cpu"
+
+
+def _slice_index(d) -> int:
+    # TPU devices expose slice_index on multi-slice systems; CPU fakes and
+    # single-slice TPUs do not.
+    return getattr(d, "slice_index", 0) or 0
+
+
+def detect_topology(devices=None) -> Topology:
+    devices = list(devices) if devices is not None else jax.devices()
+    slices: dict[int, list] = {}
+    for d in devices:
+        slices.setdefault(_slice_index(d), []).append(d)
+    n_slices = len(slices)
+    per = {len(v) for v in slices.values()}
+    if len(per) != 1:
+        raise RuntimeError(f"ragged slices unsupported: sizes {sorted(per)}")
+    ordered = [d for s in sorted(slices) for d in slices[s]]
+    return Topology(
+        platform=devices[0].platform,
+        n_devices=len(devices),
+        n_processes=jax.process_count(),
+        process_index=jax.process_index(),
+        n_slices=n_slices,
+        devices_per_slice=per.pop(),
+        devices=tuple(ordered),
+    )
+
+
+def rank_mesh(n: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the ``rank`` axis — the ring the explicit schedules walk.
+
+    Device order is slice-major so that neighbouring ranks are in-slice
+    wherever possible (ring hops ride ICI, only the slice seams cross DCN).
+    """
+    topo = detect_topology(devices)
+    devs = topo.devices[: n or topo.n_devices]
+    if n is not None and len(devs) < n:
+        raise ValueError(f"asked for {n} ranks but only {topo.n_devices} devices")
+    return Mesh(np.array(devs), (RANK_AXIS,))
+
+
+def slice_mesh(n_slices: int | None = None, per_slice: int | None = None,
+               devices=None) -> Mesh:
+    """2-D ``('slice', 'intra')`` mesh for hierarchical/DCN schedules.
+
+    On single-slice (or CPU-oracle) systems, pass explicit factors to simulate
+    a multi-slice topology — e.g. ``slice_mesh(2, 4)`` carves 8 fake CPU
+    devices into 2 "slices" of 4, which is how the DCN path is tested without
+    hardware (SURVEY.md §4).
+    """
+    topo = detect_topology(devices)
+    if n_slices is None:
+        n_slices, per_slice = topo.n_slices, topo.devices_per_slice
+    elif per_slice is None:
+        if topo.n_devices % n_slices:
+            raise ValueError(f"{topo.n_devices} devices not divisible into {n_slices} slices")
+        per_slice = topo.n_devices // n_slices
+    need = n_slices * per_slice
+    if need > topo.n_devices:
+        raise ValueError(f"need {need} devices, have {topo.n_devices}")
+    grid = np.array(topo.devices[:need]).reshape(n_slices, per_slice)
+    return Mesh(grid, (SLICE_AXIS, INTRA_AXIS))

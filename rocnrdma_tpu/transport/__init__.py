@@ -1,0 +1,11 @@
+"""jax.Array-native transport (L2 of SURVEY.md §1; component C8).
+
+The rebuild of the reference's rccl-net plugin surface: where the reference
+exposed an ``ncclNet_t``-style vtable (init/listen/connect/regMr/isend/irecv)
+for a raw-RDMA backend, the TPU framework exposes ONE interface over global
+``jax.Array``s and lowers every collective to jit-compiled XLA programs —
+in-slice traffic rides ICI, cross-slice rides DCN, and "memory registration"
+is simply sharded device placement.
+"""
+
+from rocnrdma_tpu.transport.api import Transport, ALGOS  # noqa: F401

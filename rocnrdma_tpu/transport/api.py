@@ -1,0 +1,143 @@
+"""The Transport interface: global-array collectives over a device mesh.
+
+Call-stack position (SURVEY.md §3 stack 1): bench CLIs → ``Transport`` →
+axis-level schedule (``collectives/``) → ICI/DCN. A ``Transport`` wraps a
+mesh, owns the shard_map/jit plumbing, and exposes the collective verbs with
+an algorithm-selection policy:
+
+- ``"fused"``  — XLA's own lowering (``lax.psum`` etc.): the fast path.
+- ``"ring"`` / ``"ring_bidir"`` / ``"tree"`` — the explicit inspectable
+  schedules (1-D rank mesh).
+- ``"hierarchical"`` — 2-level ICI/DCN schedule (2-D ``('slice','intra')``
+  mesh).
+- ``"auto"`` — hierarchical on a multi-slice 2-D mesh, else fused.
+
+Data layout contract: the leading array dim(s) are the mesh axes — on a 1-D
+mesh ``x[r]`` is rank r's buffer; on a 2-D mesh ``x[s, i]`` is the buffer of
+rank (slice s, intra i). Results keep the same layout with every rank's row
+equal (allreduce), the gathered buffer (allgather), etc.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from rocnrdma_tpu import collectives as C
+from rocnrdma_tpu.runtime.mesh import INTRA_AXIS, RANK_AXIS, SLICE_AXIS, rank_mesh
+
+ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "hierarchical")
+
+
+class Transport:
+    """Collectives over a mesh. Build one per mesh; methods are jit-cached."""
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh if mesh is not None else rank_mesh()
+        self.axes = self.mesh.axis_names
+        if self.axes not in ((RANK_AXIS,), (SLICE_AXIS, INTRA_AXIS)):
+            raise ValueError(
+                f"mesh axes {self.axes} unsupported; use runtime.rank_mesh() or "
+                f"runtime.slice_mesh()")
+        self.n_ranks = math.prod(self.mesh.devices.shape)
+        self.is_2d = len(self.axes) == 2
+        self._cache = {}  # (op, algo) -> jitted global-array callable
+
+    # -- policy ------------------------------------------------------------
+
+    def _resolve(self, algo: str, op: str) -> str:
+        if algo not in ALGOS:
+            raise ValueError(f"unknown algo {algo!r}; know {ALGOS}")
+        if algo == "auto":
+            algo = "hierarchical" if (self.is_2d and op == "allreduce") else "fused"
+        if algo == "hierarchical" and not self.is_2d:
+            raise ValueError("hierarchical allreduce needs a 2-D ('slice','intra') mesh")
+        if algo in ("ring", "ring_bidir", "tree") and self.is_2d:
+            raise ValueError(f"algo {algo!r} runs on a 1-D rank mesh; "
+                             f"use 'hierarchical' or 'fused' on a 2-D mesh")
+        if algo == "hierarchical" and op != "allreduce":
+            raise ValueError(f"hierarchical schedule only defined for allreduce, not {op}")
+        return algo
+
+    def _spec(self) -> P:
+        return P(*self.axes)
+
+    def shard(self, x: jax.Array) -> jax.Array:
+        """Place a global buffer on the mesh, one leading row per rank
+        (the TPU analogue of memory registration/pinning)."""
+        return jax.device_put(x, NamedSharding(self.mesh, self._spec()))
+
+    # -- verbs -------------------------------------------------------------
+
+    def allreduce(self, x, algo: str = "auto"):
+        """(ranks..., S) -> same shape; every rank row = elementwise sum."""
+        return self._jit("allreduce", self._resolve(algo, "allreduce"))(x)
+
+    def reduce_scatter(self, x, algo: str = "auto"):
+        """(ranks..., S) -> (ranks..., S/n); rank r keeps the reduced r-th shard."""
+        return self._jit("reduce_scatter", self._resolve(algo, "reduce_scatter"))(x)
+
+    def allgather(self, x, algo: str = "auto"):
+        """(ranks..., c) -> (ranks..., n*c); every rank ends with the concatenation."""
+        return self._jit("allgather", self._resolve(algo, "allgather"))(x)
+
+    def alltoall(self, x, algo: str = "auto"):
+        """(ranks..., n, c) -> same shape, global transpose of rank x chunk dims."""
+        return self._jit("alltoall", self._resolve(algo, "alltoall"))(x)
+
+    def jit_fn(self, op: str, algo: str = "auto"):
+        """The compiled global-array callable (what the benches time)."""
+        return self._jit(op, self._resolve(algo, op))
+
+    # -- lowering ----------------------------------------------------------
+
+    def _jit(self, op: str, algo: str):
+        key = (op, algo)
+        if key not in self._cache:
+            self._cache[key] = self._build(op, algo)
+        return self._cache[key]
+
+    def _build(self, op: str, algo: str):
+        nlead = len(self.axes)
+        # Fused XLA collectives take the whole axis tuple on a 2-D mesh
+        # (ICI+DCN in one op); the explicit schedules ring a single axis.
+        fused_axes = self.axes if self.is_2d else RANK_AXIS
+
+        def local(fn):
+            # strip the per-device leading singleton mesh dims, run the
+            # axis-level collective, restore the leading dims
+            def wrapped(s):
+                return fn(s.reshape(s.shape[nlead:]))[(None,) * nlead]
+            return wrapped
+
+        if op == "allreduce":
+            fn = {
+                "fused": lambda v: C.fused_allreduce(v, fused_axes),
+                "ring": lambda v: C.ring_allreduce(v, RANK_AXIS),
+                "ring_bidir": lambda v: C.ring_allreduce(v, RANK_AXIS, bidir=True),
+                "tree": lambda v: C.hd_allreduce(v, RANK_AXIS),
+                "hierarchical": lambda v: C.hierarchical_allreduce(v),
+            }[algo]
+        elif op == "reduce_scatter":
+            fn = {"fused": lambda v: C.fused_reduce_scatter(v, fused_axes),
+                  "ring": lambda v: C.ring_reduce_scatter(v, RANK_AXIS)}.get(algo)
+        elif op == "allgather":
+            fn = {"fused": lambda v: C.fused_allgather(v, fused_axes).reshape(-1),
+                  "ring": lambda v: C.ring_allgather(v, RANK_AXIS).reshape(-1)}.get(algo)
+        elif op == "alltoall":
+            # "ring" here selects the rotation schedule — the ring-family
+            # alltoall (n-1 shifted ppermute steps).
+            fn = {"fused": lambda v: C.fused_alltoall(v, fused_axes),
+                  "ring": lambda v: C.rotation_alltoall(v, RANK_AXIS)}.get(algo)
+        else:
+            raise ValueError(f"unknown op {op!r}")
+        if fn is None:
+            raise ValueError(f"op {op!r} has no {algo!r} schedule")
+
+        spec = self._spec()
+        shmapped = jax.shard_map(local(fn), mesh=self.mesh,
+                                 in_specs=(spec,), out_specs=spec)
+        return jax.jit(shmapped)

@@ -1,0 +1,30 @@
+"""Test bootstrap: force an 8-fake-device CPU backend BEFORE backends init.
+
+This is the TPU rebuild of the reference's CPU/gloo loopback oracle
+(BASELINE.json:7, SURVEY.md §4): every multi-rank collective is exercised on
+fake CPU devices and compared against numpy, so the whole matrix runs with no
+cluster and no TPU.
+
+Note: env vars alone are NOT enough here — the container's sitecustomize may
+import jax at interpreter startup (pinning JAX_PLATFORMS from the ambient
+env), so we override through jax.config, which takes effect as long as no
+backend has been initialised yet.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 fake CPU devices, got {devs}"
+    return devs

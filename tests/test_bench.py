@@ -1,0 +1,106 @@
+"""End-to-end CLI tier: the bench entrypoints on the CPU oracle (in-process —
+conftest already provides the 8-fake-device backend)."""
+
+import json
+
+import pytest
+
+from rocnrdma_tpu.bench import bench_allgather, bench_allreduce, bench_alltoall
+from rocnrdma_tpu.bench import presets as P
+from rocnrdma_tpu.bench import runner
+from rocnrdma_tpu.metrics import GiB, KiB, MiB
+
+
+def test_parse_size():
+    assert runner.parse_size("4K") == 4 * KiB
+    assert runner.parse_size("256MiB") == 256 * MiB
+    assert runner.parse_size("1G") == GiB
+    assert runner.parse_size("12345") == 12345
+
+
+def _run(main, argv):
+    assert main(argv) == 0
+
+
+def test_bench_allreduce_loopback2(tmp_path, capsys):
+    out = tmp_path / "r.jsonl"
+    _run(bench_allreduce.main,
+         ["--preset", "loopback2", "--repeats", "2", "--iters", "2",
+          "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["algo"] for r in rows} == {"ring", "fused"}
+    assert all(r["n_ranks"] == 2 and r["size_bytes"] == 4096 for r in rows)
+    assert "busbw" in capsys.readouterr().out
+
+
+def test_bench_allreduce_resume_skips_done(tmp_path):
+    out = tmp_path / "r.jsonl"
+    argv = ["--preset", "loopback2", "--repeats", "2", "--iters", "2",
+            "--out", str(out), "--resume"]
+    _run(bench_allreduce.main, argv)
+    n1 = len(out.read_text().splitlines())
+    _run(bench_allreduce.main, argv)  # second run: everything already done
+    assert len(out.read_text().splitlines()) == n1
+
+
+def test_bench_alltoall_cli(tmp_path):
+    out = tmp_path / "a.jsonl"
+    _run(bench_alltoall.main,
+         ["--ranks", "4", "--sizes", "16K", "--algos", "ring,fused",
+          "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert all(r["collective"] == "alltoall" for r in rows)
+    assert all(r["extra"]["checked"] for r in rows)
+
+
+def test_bench_allgather_cli(tmp_path):
+    out = tmp_path / "g.jsonl"
+    _run(bench_allgather.main,
+         ["--ranks", "4", "--sizes", "16K", "--algos", "ring,fused",
+          "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert all(r["collective"] == "allgather" for r in rows)
+
+
+def test_bench_hierarchical_mesh2d(tmp_path):
+    out = tmp_path / "h.jsonl"
+    _run(bench_allreduce.main,
+         ["--mesh2d", "2x4", "--sizes", "16K", "--algos", "hierarchical,fused",
+          "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["algo"] for r in rows} == {"hierarchical", "fused"}
+    assert all(r["extra"]["mesh2d"] == [2, 4] for r in rows)
+
+
+def test_preset_scaling_caps_sizes():
+    pre = P.get_preset("tree64")
+    scaled = pre.scaled_to(n_devices=8, max_bytes=2 * MiB)
+    assert scaled.n_ranks == 8            # power of two kept for tree
+    assert scaled.sizes == (2 * MiB,)     # clamped, NOT the 1 GiB original
+    pre = P.get_preset("multislice")
+    scaled = pre.scaled_to(n_devices=8, max_bytes=64 * MiB)
+    assert scaled.mesh2d == (2, 4)
+    assert scaled.n_ranks == 8
+
+
+def test_strict_preset_refuses(tmp_path):
+    with pytest.raises(SystemExit):
+        bench_allreduce.main(["--preset", "tree64", "--strict-preset"])
+
+
+def test_bench_alltoall_multislice_preset(tmp_path):
+    # regression: the multislice preset names hierarchical (allreduce-only);
+    # bench_alltoall must filter to compatible algos instead of crashing.
+    out = tmp_path / "ms.jsonl"
+    _run(bench_alltoall.main,
+         ["--preset", "multislice", "--max-bytes", "64K",
+          "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows and all(r["algo"] == "fused" for r in rows)
+
+
+def test_warmup_zero_ok(tmp_path):
+    # regression: --warmup 0 must still exclude compile from timing, not crash
+    _run(bench_allreduce.main,
+         ["--ranks", "2", "--sizes", "4K", "--algos", "fused",
+          "--warmup", "0", "--repeats", "2", "--iters", "2"])

@@ -1,0 +1,45 @@
+import json
+
+import pytest
+
+from rocnrdma_tpu import metrics as M
+
+
+def test_busbw_allreduce_factor():
+    # 8 ranks, 1e9 bytes in 1 s -> algbw 1 GB/s, busbw 2*7/8 = 1.75
+    assert M.algbw_GBps(10**9, 1.0) == pytest.approx(1.0)
+    assert M.busbw_GBps("allreduce", 8, 10**9, 1.0) == pytest.approx(1.75)
+    assert M.busbw_GBps("allgather", 8, 10**9, 1.0) == pytest.approx(0.875)
+    assert M.busbw_GBps("alltoall", 8, 10**9, 1.0) == pytest.approx(0.875)
+
+
+def test_busbw_single_rank_is_zero():
+    assert M.busbw_GBps("allreduce", 1, 10**9, 1.0) == 0.0
+
+
+def test_busbw_unknown_collective():
+    with pytest.raises(ValueError):
+        M.busbw_GBps("gather", 8, 1, 1.0)
+
+
+def test_record_roundtrip(tmp_path):
+    r = M.BenchRecord.measure("bench_allreduce", "allreduce", "ring", 8,
+                              M.MiB, "float32", 1e-3, platform="cpu")
+    p = tmp_path / "out.jsonl"
+    with open(p, "w") as fp:
+        r.write(fp)
+    d = json.loads(p.read_text())
+    assert d["busbw_GBps"] == pytest.approx(r.busbw_GBps)
+    assert M.load_completed(p) == {r.key()}
+
+
+def test_load_completed_tolerates_torn_line(tmp_path):
+    r = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096, "float32", 1e-6)
+    p = tmp_path / "out.jsonl"
+    p.write_text(r.to_json() + "\n{\"bench\": \"tor")
+    assert M.load_completed(p) == {r.key()}
+
+
+def test_format_table_runs():
+    r = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096, "float32", 1e-6)
+    assert "busbw" in M.format_table([r])

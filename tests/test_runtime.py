@@ -1,0 +1,50 @@
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import runtime as rt
+
+
+def test_detect_topology(devices):
+    topo = rt.detect_topology()
+    assert topo.platform == "cpu"
+    assert topo.is_oracle
+    assert topo.n_devices >= 8
+    assert topo.n_slices == 1  # fake CPU devices have no slice_index
+    assert topo.n_devices == topo.n_slices * topo.devices_per_slice
+
+
+def test_rank_mesh_sizes(devices):
+    for n in (2, 8):
+        mesh = rt.rank_mesh(n)
+        assert mesh.axis_names == (rt.mesh.RANK_AXIS,)
+        assert mesh.devices.shape == (n,)
+
+
+def test_rank_mesh_too_many(devices):
+    with pytest.raises(ValueError):
+        rt.rank_mesh(10**6)
+
+
+def test_slice_mesh_simulated(devices):
+    mesh = rt.slice_mesh(2, 4)
+    assert mesh.axis_names == ("slice", "intra")
+    assert mesh.devices.shape == (2, 4)
+    # rows partition distinct devices
+    ids = [d.id for d in np.asarray(mesh.devices).ravel()]
+    assert len(set(ids)) == 8
+
+
+def test_slice_mesh_infers_per_slice(devices):
+    mesh = rt.slice_mesh(4)
+    assert mesh.devices.shape == (4, 2)
+
+
+def test_slice_mesh_indivisible(devices):
+    with pytest.raises(ValueError):
+        rt.slice_mesh(3)
+
+
+def test_init_runtime_local(devices):
+    info = rt.init_runtime()
+    assert not info.distributed
+    assert info.topology.is_oracle

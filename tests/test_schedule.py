@@ -1,0 +1,75 @@
+"""Unit tier (SURVEY.md §4): pure schedule generation, no devices."""
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.collectives import schedule as S
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5, 8, 16])
+def test_ring_rs_every_chunk_reduced_once(n):
+    # After n-1 RS steps, each rank's owned chunk must have accumulated every
+    # rank's contribution exactly once: track contributions symbolically.
+    contrib = {(r, c): {r} for r in range(n) for c in range(n)}
+    for step in range(n - 1):
+        sent = {r: contrib[(r, S.ring_rs_send_chunk(n, step, r))].copy() for r in range(n)}
+        for src, dst in S.ring_permutation(n):
+            contrib[(dst, S.ring_rs_recv_chunk(n, step, dst))] |= sent[src]
+    for r in range(n):
+        assert contrib[(r, S.ring_owned_chunk(n, r))] == set(range(n))
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_sim_ring_allreduce_matches_numpy(n):
+    rng = np.random.default_rng(0)
+    bufs = rng.normal(size=(n, n * 5)).astype(np.float32)
+    out = S.sim_ring_allreduce(bufs)
+    want = np.broadcast_to(bufs.sum(axis=0), out.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8, 16])
+def test_sim_hd_allreduce_matches_numpy(n):
+    rng = np.random.default_rng(1)
+    bufs = rng.normal(size=(n, n * 3)).astype(np.float32)
+    out = S.sim_hd_allreduce(bufs)
+    want = np.broadcast_to(bufs.sum(axis=0), out.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_hd_masks_rejects_non_pow2():
+    with pytest.raises(ValueError):
+        S.hd_masks(6)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_hd_segments_partition(n):
+    # After all halving steps, the owned segments of all ranks tile [0, n).
+    k = len(S.hd_masks(n))
+    segs = [S.hd_segment(n, r, k) for r in range(n)]
+    assert all(ln == 1 for _, ln in segs)
+    assert sorted(st for st, _ in segs) == list(range(n))
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_sim_alltoall_is_transpose(n):
+    rng = np.random.default_rng(2)
+    bufs = rng.normal(size=(n, n * 4)).astype(np.float32)
+    out = S.sim_alltoall(bufs).reshape(n, n, -1)
+    want = bufs.reshape(n, n, -1).transpose(1, 0, 2)
+    np.testing.assert_allclose(out, want)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_sim_alltoall_involution(n):
+    # alltoall . alltoall = identity (SURVEY.md §4 property test)
+    rng = np.random.default_rng(3)
+    bufs = rng.normal(size=(n, n * 2)).astype(np.float32)
+    np.testing.assert_allclose(S.sim_alltoall(S.sim_alltoall(bufs)), bufs)
+
+
+def test_hierarchical_phases_shape():
+    phases = S.hierarchical_phases()
+    assert phases[0] == ("reducescatter", "intra")
+    assert phases[1][1] == "slice"
+    assert phases[2] == ("allgather", "intra")

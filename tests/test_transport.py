@@ -1,0 +1,104 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.transport import Transport
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def t8():
+    return Transport(rt.rank_mesh(8))
+
+
+@pytest.fixture(scope="module")
+def t2d():
+    return Transport(rt.slice_mesh(2, 4))
+
+
+@pytest.mark.parametrize("algo", ["auto", "fused", "ring", "ring_bidir", "tree"])
+def test_allreduce_1d(t8, algo):
+    x = t8.shard(_rand((8, 100)))
+    out = np.asarray(t8.allreduce(x, algo))
+    np.testing.assert_allclose(out, np.broadcast_to(np.asarray(x).sum(0), out.shape),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("algo", ["auto", "fused", "hierarchical"])
+def test_allreduce_2d(t2d, algo):
+    x = t2d.shard(_rand((2, 4, 50), seed=1))
+    out = np.asarray(t2d.allreduce(x, algo))
+    np.testing.assert_allclose(out, np.broadcast_to(np.asarray(x).sum((0, 1)), out.shape),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("algo", ["fused", "ring"])
+def test_reduce_scatter(t8, algo):
+    x = t8.shard(_rand((8, 64), seed=2))
+    out = np.asarray(t8.reduce_scatter(x, algo))
+    np.testing.assert_allclose(out, np.asarray(x).sum(0).reshape(8, 8), rtol=1e-5)
+
+
+@pytest.mark.parametrize("algo", ["fused", "ring"])
+def test_allgather(t8, algo):
+    x = t8.shard(_rand((8, 5), seed=3))
+    out = np.asarray(t8.allgather(x, algo))
+    assert out.shape == (8, 40)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], np.asarray(x).reshape(-1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("algo", ["fused", "ring"])
+def test_alltoall(t8, algo):
+    x = t8.shard(_rand((8, 8, 3), seed=4))
+    out = np.asarray(t8.alltoall(x, algo))
+    np.testing.assert_allclose(out, np.asarray(x).transpose(1, 0, 2), rtol=1e-6)
+
+
+def test_policy_errors(t8, t2d):
+    x8 = _rand((8, 8))
+    with pytest.raises(ValueError):
+        t8.allreduce(x8, "hierarchical")  # needs 2-D mesh
+    with pytest.raises(ValueError):
+        t2d.allreduce(_rand((2, 4, 8)), "ring")  # ring needs 1-D mesh
+    with pytest.raises(ValueError):
+        t8.allreduce(x8, "nope")
+    with pytest.raises(ValueError):
+        t2d.allgather(_rand((2, 4, 8)), "hierarchical")
+
+
+def test_auto_policy(t8, t2d):
+    assert t8._resolve("auto", "allreduce") == "fused"
+    assert t2d._resolve("auto", "allreduce") == "hierarchical"
+    assert t2d._resolve("auto", "alltoall") == "fused"
+
+
+def test_bf16(t8):
+    x = t8.shard(_rand((8, 32), seed=5).astype(jnp.bfloat16))
+    out = np.asarray(t8.allreduce(x, "ring"), dtype=np.float32)
+    want = np.asarray(x, np.float32).sum(0)
+    np.testing.assert_allclose(out[0], want, rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("op", ["alltoall", "allgather", "reduce_scatter"])
+def test_fused_ops_on_2d_mesh(t2d, op):
+    # regression: non-allreduce collectives must work on a ('slice','intra')
+    # mesh — the MoE-alltoall-over-DCN capability (BASELINE.json:11).
+    n = 8
+    if op == "alltoall":
+        x = t2d.shard(_rand((2, 4, n, 3), seed=6))
+        out = np.asarray(t2d.alltoall(x, "fused"))
+        want = np.asarray(x).reshape(n, n, 3).transpose(1, 0, 2).reshape(2, 4, n, 3)
+    elif op == "allgather":
+        x = t2d.shard(_rand((2, 4, 5), seed=7))
+        out = np.asarray(t2d.allgather(x, "fused"))
+        want = np.broadcast_to(np.asarray(x).reshape(-1), (n, 40)).reshape(2, 4, 40)
+    else:
+        x = t2d.shard(_rand((2, 4, 16), seed=8))
+        out = np.asarray(t2d.reduce_scatter(x, "fused"))
+        want = np.asarray(x).reshape(n, 16).sum(0).reshape(n, -1).reshape(2, 4, 2)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
