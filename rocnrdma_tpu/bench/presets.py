@@ -36,8 +36,14 @@ class Preset:
         if mesh2d is not None:
             s = min(mesh2d[0], max(2, n_devices // max(1, mesh2d[1])))
             per = n_devices // s
-            mesh2d = (s, per)
-            n = s * per
+            if per < 1:
+                # backend too small for even a 2-slice simulation: fall back
+                # to a flat ring rather than a degenerate (s, 0) mesh
+                mesh2d = None
+                n = min(n, n_devices)
+            else:
+                mesh2d = (s, per)
+                n = s * per
         sizes = tuple(b for b in self.sizes if b <= max_bytes) \
             or (min(min(self.sizes), max_bytes),)
         return dataclasses.replace(self, n_ranks=n, mesh2d=mesh2d, sizes=sizes)

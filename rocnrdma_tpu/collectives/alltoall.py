@@ -15,6 +15,8 @@ from __future__ import annotations
 import jax
 from jax import lax
 
+from rocnrdma_tpu.collectives.schedule import ring_permutation
+
 
 def rotation_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
     n = lax.axis_size(axis_name)
@@ -27,7 +29,7 @@ def rotation_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
     # Python loop: each step uses a DIFFERENT static permutation (shift by s),
     # which lax.ppermute requires to be compile-time constant.
     for s in range(1, n):
-        perm = [(i, (i + s) % n) for i in range(n)]
+        perm = ring_permutation(n, shift=s)
         send_idx = (r + s) % n
         chunk = lax.dynamic_index_in_dim(x, send_idx, axis=0, keepdims=False)
         recvd = lax.ppermute(chunk, axis_name, perm=perm)

@@ -25,9 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-
-def _ring_perm(n: int, shift: int = 1) -> list[tuple[int, int]]:
-    return [(r, (r + shift) % n) for r in range(n)]
+# The one source of truth for ring step permutations: the jit schedules and
+# the simulator oracle must rotate identically (see schedule.py docstring).
+from rocnrdma_tpu.collectives.schedule import ring_permutation as _ring_perm
 
 
 def _chunked(x: jax.Array, n: int) -> tuple[jax.Array, int, tuple]:

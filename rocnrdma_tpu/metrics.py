@@ -106,8 +106,16 @@ class BenchRecord:
 
     def key(self) -> tuple:
         """Identity of a sweep point, for resume-time dedup."""
-        return (self.bench, self.collective, self.algo, self.n_ranks,
-                self.size_bytes, self.dtype)
+        return record_key(self.bench, self.collective, self.algo, self.n_ranks,
+                          self.size_bytes, self.dtype)
+
+
+def record_key(bench: str, collective: str, algo: str, n_ranks: int,
+               size_bytes: int, dtype: str) -> tuple:
+    """THE sweep-point identity. Every producer/consumer of resume keys
+    (BenchRecord.key, load_completed, the sweep runner) must build the tuple
+    through this function so the fields can never drift apart."""
+    return (bench, collective, algo, n_ranks, size_bytes, dtype)
 
 
 def load_completed(path) -> set:
@@ -123,8 +131,8 @@ def load_completed(path) -> set:
                     d = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # torn tail line from an interrupted run
-                done.add((d["bench"], d["collective"], d["algo"],
-                          d["n_ranks"], d["size_bytes"], d["dtype"]))
+                done.add(record_key(d["bench"], d["collective"], d["algo"],
+                                    d["n_ranks"], d["size_bytes"], d["dtype"]))
     except FileNotFoundError:
         pass
     return done

@@ -1,0 +1,11 @@
+"""Workloads (L4 of SURVEY.md §1): realistic traffic driving the transport.
+
+- ``llama_trace`` + ``ddp_replay`` — component C12 (BASELINE.json:10): the
+  Llama-3-8B DDP gradient-bucket trace, generated from the public model
+  shapes (no weights needed) and replayed through the collective API to
+  measure allreduce fusion/overlap.
+- ``moe`` — component C7 (BASELINE.json:11): expert-parallel
+  dispatch/combine, the alltoall traffic pattern of MoE training.
+"""
+
+from rocnrdma_tpu.workloads.llama_trace import LLAMA3_8B, generate_trace, Trace  # noqa: F401

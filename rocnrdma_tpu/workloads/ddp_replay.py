@@ -1,0 +1,177 @@
+"""DDP gradient-bucket trace replay (component C12; BASELINE.json:10).
+
+Replays a Llama-3-8B bucket trace (see ``llama_trace``) through the
+Transport's allreduce — the traffic a data-parallel trainer generates per
+step — and measures how much bucket-level overlap buys:
+
+- ``sequential``: allreduce each bucket and block before issuing the next
+  (zero overlap; the lower bound a naive trainer gets).
+- ``overlap``: issue every bucket's allreduce async in ready order, block
+  once at the end — models a trainer overlapping comm with backward compute;
+  the runtime/XLA pipelines the dispatches.
+- ``jit_fused``: ONE jit program allreducing all buckets — the whole step's
+  comm visible to XLA at once (upper bound: scheduler-level fusion).
+
+Full-size Llama-3-8B gradients are ~32 GiB/rank in fp32, so the replay
+scales bucket sizes by ``--scale`` (sizes shrink, count and order stay
+faithful) and reports both measured and full-size-equivalent numbers.
+
+Usage::
+
+    python -m rocnrdma_tpu.workloads.ddp_replay --fake-devices 8 --scale 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import numpy as np
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.bench.timing import trimmed_mean
+from rocnrdma_tpu.transport import Transport
+from rocnrdma_tpu.workloads.llama_trace import LLAMA3_8B, Trace, generate_trace
+
+MODES = ("sequential", "overlap", "jit_fused")
+
+
+def _bucket_arrays(t: Transport, trace: Trace, scale: int, dtype: str):
+    import jax.numpy as jnp
+    np_dtype = np.dtype(getattr(jnp, dtype))
+    shape_lead = t.mesh.devices.shape
+    rng = np.random.default_rng(0)
+    arrs = []
+    for b in trace.buckets:
+        n = max(1, b.numel // scale)
+        x = rng.standard_normal(size=shape_lead + (n,), dtype=np.float32)
+        arrs.append(t.shard(x.astype(np_dtype)))
+    return arrs
+
+
+def replay(t: Transport, bufs: list, algo: str, mode: str,
+           repeats: int = 5, window: int = 0) -> float:
+    """Seconds for one full-trace replay (trimmed mean over repeats).
+
+    ``window`` bounds outstanding async allreduces in ``overlap`` mode
+    (0 = unbounded). On the CPU oracle an unbounded burst of SEPARATE
+    collective executables can deadlock XLA's in-process communicator
+    (per-device thunk interleaving diverges across devices), so the caller
+    passes a small window there; one fused program (``jit_fused``) is always
+    safe because every device runs the same thunk order.
+    """
+    fn = t.jit_fn("allreduce", algo)
+    if mode == "jit_fused":
+        whole = jax.jit(lambda xs: [fn(x) for x in xs])
+        jax.block_until_ready(whole(bufs))  # compile
+        spans = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(whole(bufs))
+            spans.append(time.perf_counter() - t0)
+        return trimmed_mean(spans)
+
+    for b in bufs:  # compile each bucket shape (block EACH: see docstring)
+        fn(b).block_until_ready()
+    spans = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        if mode == "sequential":
+            for b in bufs:
+                fn(b).block_until_ready()
+        elif mode == "overlap":
+            pending = []
+            for b in bufs:
+                pending.append(fn(b))
+                if window and len(pending) >= window:
+                    pending.pop(0).block_until_ready()
+            jax.block_until_ready(pending)
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+        spans.append(time.perf_counter() - t0)
+    return trimmed_mean(spans)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ddp_replay",
+        description="Llama-3-8B DDP gradient-bucket allreduce replay")
+    p.add_argument("--bucket-mb", type=float, default=25.0)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--scale", type=int, default=1024,
+                   help="divide every bucket's numel by this (1 = full size)")
+    p.add_argument("--ranks", type=int, default=None)
+    p.add_argument("--mesh2d", type=str, default=None, metavar="SLICESxPER")
+    p.add_argument("--algo", default="auto")
+    p.add_argument("--modes", default=",".join(MODES))
+    p.add_argument("--window", type=int, default=None,
+                   help="max outstanding async allreduces in overlap mode "
+                        "(default: 4 on the CPU oracle, unbounded on TPU)")
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--fake-devices", type=int, default=None)
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    p.add_argument("--out", default=None, help="JSONL output path")
+    p.add_argument("--trace-out", default=None, help="write the trace JSON and exit")
+    args = p.parse_args(argv)
+
+    trace = generate_trace(LLAMA3_8B, bucket_mb=args.bucket_mb, dtype=args.dtype)
+    if args.trace_out:
+        with open(args.trace_out, "w") as fp:
+            fp.write(trace.to_json())
+        print(f"# wrote {len(trace.buckets)} buckets "
+              f"({trace.total_bytes / M.GiB:.2f} GiB) to {args.trace_out}")
+        return 0
+
+    if args.fake_devices:
+        rt.force_cpu_devices(args.fake_devices)
+    elif args.platform == "cpu":
+        rt.force_cpu_devices(args.ranks or 8)
+    info = rt.init_runtime()
+    topo = info.topology
+
+    if args.mesh2d:
+        s, per = (int(v) for v in args.mesh2d.lower().split("x"))
+        mesh = rt.slice_mesh(s, per)
+    else:
+        mesh = rt.rank_mesh(min(args.ranks or topo.n_devices, topo.n_devices))
+    t = Transport(mesh)
+
+    bufs = _bucket_arrays(t, trace, args.scale, args.dtype)
+    scaled_bytes = sum(int(np.prod(b.shape[len(mesh.devices.shape):])) *
+                       b.dtype.itemsize for b in bufs)
+    print(f"# {trace.model}: {len(bufs)} buckets, "
+          f"{trace.total_bytes / M.GiB:.2f} GiB full / "
+          f"{scaled_bytes / M.MiB:.1f} MiB at scale {args.scale}, "
+          f"{t.n_ranks} ranks, algo={args.algo}", file=sys.stderr)
+
+    window = args.window if args.window is not None else (4 if topo.is_oracle else 0)
+
+    out_fp = open(args.out, "a") if args.out else None
+    records = []
+    base = None
+    for mode in args.modes.split(","):
+        mean_s = replay(t, bufs, args.algo, mode, repeats=args.repeats,
+                        window=window)
+        base = base if base is not None else mean_s
+        rec = M.BenchRecord.measure(
+            "ddp_replay", "allreduce", args.algo, t.n_ranks, scaled_bytes,
+            args.dtype, mean_s, platform=topo.platform, mode=mode,
+            n_buckets=len(bufs), scale=args.scale,
+            full_bytes=trace.total_bytes, speedup_vs_sequential=base / mean_s)
+        records.append(rec)
+        if out_fp:
+            rec.write(out_fp)
+    if out_fp:
+        out_fp.close()
+    print(M.format_table(records))
+    for r in records:
+        print(f"#   {r.extra['mode']:>10}: {r.mean_s * 1e3:8.2f} ms/step  "
+              f"{r.extra['speedup_vs_sequential']:.2f}x vs sequential")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

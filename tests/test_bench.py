@@ -104,3 +104,12 @@ def test_warmup_zero_ok(tmp_path):
     _run(bench_allreduce.main,
          ["--ranks", "2", "--sizes", "4K", "--algos", "fused",
           "--warmup", "0", "--repeats", "2", "--iters", "2"])
+
+
+def test_preset_scaling_degenerate_mesh_falls_back_flat():
+    # regression: on a 1-device backend the multislice preset must not
+    # produce a (2, 0) mesh; it falls back to a flat ring.
+    pre = P.get_preset("multislice")
+    scaled = pre.scaled_to(n_devices=1, max_bytes=MiB)
+    assert scaled.mesh2d is None
+    assert scaled.n_ranks == 1

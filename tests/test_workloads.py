@@ -1,0 +1,81 @@
+import json
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.transport import Transport
+from rocnrdma_tpu.workloads import LLAMA3_8B, generate_trace, Trace
+from rocnrdma_tpu.workloads import ddp_replay, moe
+
+
+def test_llama3_8b_param_count():
+    # public 8B architecture: ~8.03B params; exact value is fixed by shapes
+    n = LLAMA3_8B.n_params()
+    assert n == 8_030_261_248, n
+
+
+def test_trace_reverse_order_and_capacity():
+    tr = generate_trace(LLAMA3_8B, bucket_mb=25.0)
+    # bucket 0 must start from the END of the model (backward-ready order)
+    assert tr.buckets[0].params[0] == "lm_head"
+    assert tr.buckets[-1].params[-1] == "embed_tokens"
+    # total bytes = param count * itemsize, nothing lost to bucketing
+    assert tr.total_bytes == LLAMA3_8B.n_params() * 4
+    # capacity respected except single-tensor oversize buckets
+    for b in tr.buckets:
+        assert b.bytes <= tr.bucket_cap_bytes or len(b.params) == 1
+
+
+def test_trace_json_roundtrip():
+    tr = generate_trace(LLAMA3_8B, bucket_mb=100.0, dtype="bfloat16")
+    tr2 = Trace.from_json(tr.to_json())
+    assert tr2 == tr
+    assert tr2.total_bytes == LLAMA3_8B.n_params() * 2
+
+
+def test_bucket_count_scales_with_cap():
+    small = generate_trace(LLAMA3_8B, bucket_mb=25.0)
+    big = generate_trace(LLAMA3_8B, bucket_mb=500.0)
+    assert len(big.buckets) < len(small.buckets)
+
+
+@pytest.mark.parametrize("mode", ddp_replay.MODES)
+def test_replay_modes_run(devices, mode):
+    t = Transport(rt.rank_mesh(4))
+    tr = generate_trace(LLAMA3_8B, bucket_mb=500.0)  # few, small buckets
+    bufs = ddp_replay._bucket_arrays(t, tr, 2 ** 16, "float32")
+    s = ddp_replay.replay(t, bufs, "fused", mode, repeats=1, window=2)
+    assert s > 0
+
+
+def test_replay_cli(tmp_path, capsys):
+    out = tmp_path / "ddp.jsonl"
+    assert ddp_replay.main(["--scale", "65536", "--bucket-mb", "500",
+                            "--ranks", "4", "--repeats", "1",
+                            "--out", str(out)]) == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["extra"]["mode"] for r in rows} == set(ddp_replay.MODES)
+    assert all(r["extra"]["full_bytes"] == LLAMA3_8B.n_params() * 4 for r in rows)
+
+
+def test_trace_out_cli(tmp_path):
+    p = tmp_path / "trace.json"
+    assert ddp_replay.main(["--trace-out", str(p)]) == 0
+    tr = Trace.from_json(p.read_text())
+    assert tr.model == "llama3-8b"
+
+
+def test_moe_roundtrip_and_cli(tmp_path):
+    out = tmp_path / "moe.jsonl"
+    # identity check runs inside main() when --expert-compute is off
+    assert moe.main(["--ranks", "4", "--tokens", "64", "--d-model", "16",
+                     "--repeats", "1", "--iters", "2", "--out", str(out)]) == 0
+    row = json.loads(out.read_text().splitlines()[0])
+    assert row["collective"] == "alltoall"
+    assert row["extra"]["capacity"] == 16
+
+
+def test_moe_2d_mesh():
+    assert moe.main(["--mesh2d", "2x4", "--tokens", "64", "--d-model", "8",
+                     "--repeats", "1", "--iters", "2"]) == 0
