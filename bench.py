@@ -32,9 +32,11 @@ import time
 
 # (hbm_GBps, ici_GBps) per chip, approximate public figures
 _ROOFLINE = {
+    # keys match substrings of jax device_kind (e.g. "TPU v5 lite", "TPU v6 lite")
     "v5 lite": (819.0, 400.0), "v5e": (819.0, 400.0),
+    "v6 lite": (1638.0, 900.0), "v6e": (1638.0, 900.0),
     "v5p": (2765.0, 1200.0), "v5": (2765.0, 1200.0),
-    "v4": (1228.0, 1200.0), "v6e": (1638.0, 900.0),
+    "v4": (1228.0, 1200.0),
 }
 _CPU_FALLBACK = (50.0, 10.0)  # oracle runs: keep vs_baseline finite
 

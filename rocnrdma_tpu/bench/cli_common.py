@@ -1,0 +1,35 @@
+"""Backend/mesh bootstrap shared by every CLI (bench sweeps and workloads).
+
+One place owns --fake-devices/--platform handling and SLICESxPER mesh
+parsing so the workload CLIs can never drift from the bench CLIs.
+"""
+
+from __future__ import annotations
+
+from rocnrdma_tpu import runtime as rt
+
+
+def setup_backend(fake_devices: int | None, platform: str,
+                  default_ranks: int | None = None) -> rt.RuntimeInfo:
+    """Apply CPU-oracle forcing flags, then init the runtime."""
+    if fake_devices:
+        rt.force_cpu_devices(fake_devices)
+    elif platform == "cpu":
+        rt.force_cpu_devices(max(default_ranks or 8, 2))
+    return rt.init_runtime()
+
+
+def parse_mesh2d(spec: str) -> tuple[int, int]:
+    """'SLICESxPER' -> (slices, per_slice), e.g. '2x4' -> (2, 4)."""
+    try:
+        s, per = spec.lower().split("x")
+        return int(s), int(per)
+    except ValueError as e:
+        raise SystemExit(f"--mesh2d wants SLICESxPER (e.g. 2x4), got {spec!r}") from e
+
+
+def build_mesh(mesh2d: str | None, ranks: int | None, topo: rt.Topology):
+    """The mesh every CLI runs over: 2-D when asked, else a capped 1-D ring."""
+    if mesh2d:
+        return rt.slice_mesh(*parse_mesh2d(mesh2d))
+    return rt.rank_mesh(min(ranks or topo.n_devices, topo.n_devices))

@@ -13,6 +13,7 @@ import numpy as np
 
 from rocnrdma_tpu import metrics as M
 from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.bench import cli_common
 from rocnrdma_tpu.bench import presets as P
 from rocnrdma_tpu.bench.timing import time_fn
 from rocnrdma_tpu.transport import ALGOS, Transport
@@ -62,13 +63,6 @@ def make_parser(bench_name: str, collective: str) -> argparse.ArgumentParser:
     return p
 
 
-def _setup_backend(args, need_ranks: int) -> None:
-    if args.fake_devices:
-        rt.force_cpu_devices(args.fake_devices)
-    elif args.platform == "cpu":
-        rt.force_cpu_devices(max(need_ranks, 2))
-
-
 def resolve_preset(args, collective: str) -> P.Preset:
     """Merge preset defaults and CLI overrides into one concrete Preset."""
     if args.preset:
@@ -83,9 +77,9 @@ def resolve_preset(args, collective: str) -> P.Preset:
     if args.ranks:
         over["n_ranks"] = args.ranks
     if args.mesh2d:
-        s, per = args.mesh2d.lower().split("x")
-        over["mesh2d"] = (int(s), int(per))
-        over["n_ranks"] = int(s) * int(per)
+        s, per = cli_common.parse_mesh2d(args.mesh2d)
+        over["mesh2d"] = (s, per)
+        over["n_ranks"] = s * per
     if args.sizes:
         over["sizes"] = tuple(parse_size(x) for x in args.sizes.split(","))
     if args.dtypes:
@@ -167,7 +161,9 @@ def algos_for(collective: str, algos: tuple, is_2d: bool) -> tuple:
         if collective == "allreduce":
             if a == "hierarchical":
                 return is_2d
-            return not is_2d  # ring/ring_bidir/tree ring a 1-D mesh
+            return not is_2d  # ring/ring_bidir/tree/pallas_ring ring a 1-D mesh
+        if collective == "allgather":
+            return a in ("ring", "pallas_ring") and not is_2d
         return a == "ring" and not is_2d
     kept = tuple(a for a in algos if ok(a))
     return kept or ("fused",)
@@ -176,11 +172,15 @@ def algos_for(collective: str, algos: tuple, is_2d: bool) -> tuple:
 _OP = {"allreduce": "allreduce", "reducescatter": "reduce_scatter",
        "allgather": "allgather", "alltoall": "alltoall"}
 
+# The pallas ring kernels keep the whole per-rank buffer (plus comm slots)
+# resident in VMEM (~16 MiB/chip); sweep points beyond this are skipped
+# rather than left to die in the Mosaic allocator mid-sweep.
+PALLAS_VMEM_CAP = 4 * M.MiB
+
 
 def run_sweep(bench_name: str, collective: str, args) -> list:
     pre = resolve_preset(args, collective)
-    _setup_backend(args, pre.n_ranks)
-    info = rt.init_runtime()
+    info = cli_common.setup_backend(args.fake_devices, args.platform, pre.n_ranks)
     topo = info.topology
 
     max_bytes = parse_size(args.max_bytes) if args.max_bytes else (
@@ -229,6 +229,11 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                 for algo in algos:
                     key = _key(algo, actual)
                     if key in done:
+                        continue
+                    if algo.startswith("pallas") and actual > PALLAS_VMEM_CAP:
+                        print(f"# skip {algo} at {actual} B: kernel is "
+                              f"VMEM-resident (cap {PALLAS_VMEM_CAP} B/rank)",
+                              file=sys.stderr)
                         continue
                     fn = t.jit_fn(_OP[collective], algo)
                     if pre.check:

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels (the hand-scheduled path under L3).
+
+``ring_pallas`` is the literal rebuild of the reference's RDMA data plane:
+where the reference posted ``ibv_post_send`` work requests on queue pairs and
+polled completions, these kernels drive the ICI with
+``pltpu.make_async_remote_copy`` (TPU inter-chip RDMA) synchronised by DMA
+semaphores — queue pairs become double-buffered communication slots, and
+completion polling becomes semaphore waits.
+"""
+
+from rocnrdma_tpu.ops.ring_pallas import (  # noqa: F401
+    pallas_ring_allgather,
+    pallas_ring_allreduce,
+)

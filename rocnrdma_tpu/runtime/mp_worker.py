@@ -1,0 +1,98 @@
+"""Worker entry for the multi-process simulation harness.
+
+Each worker is one "host": it owns one CPU device, joins the coordination
+service (``jax.distributed.initialize`` — the process boundary of SURVEY.md
+§3 stack 5), and participates in cross-process collectives the way a real
+multi-host TPU job would.
+
+Tasks:
+
+- ``allreduce``: global psum across all processes' devices via a jitted
+  computation over a global 1-D mesh; every rank checks the result.
+- ``alltoall``: same plumbing for the MoE primitive.
+- ``fault``: ``--fault-rank`` exits(3) BEFORE the init barrier; the others
+  must fail their (deadline-bounded) initialize with a clean error — the
+  coordinator-timeout surfacing disposition of SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mp_worker")
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--task", choices=("allreduce", "alltoall", "fault"),
+                   required=True)
+    p.add_argument("--fault-rank", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+
+    from rocnrdma_tpu.runtime.init import init_runtime
+
+    if args.task == "fault" and args.process_id == args.fault_rank:
+        # die before the barrier: the injected fault
+        print("FAULT: rank dying before init barrier", flush=True)
+        return 3
+
+    try:
+        info = init_runtime(coordinator=args.coordinator,
+                            num_processes=args.num_processes,
+                            process_id=args.process_id,
+                            timeout_s=15)
+    except RuntimeError as e:
+        if args.task == "fault":
+            # expected: surviving ranks surface the lost peer cleanly
+            print(f"CLEAN-ABORT: {e}", flush=True)
+            return 4
+        raise
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rocnrdma_tpu import runtime as rt
+
+    topo = info.topology
+    n = topo.n_devices
+    assert topo.n_processes == args.num_processes, topo
+    mesh = rt.rank_mesh(n)
+    sharding = NamedSharding(mesh, P("rank"))
+
+    # each process contributes its local row; make the global array from
+    # per-process shards (the multi-host jax.Array construction path)
+    rank = args.process_id
+    local = np.full((1, 8), float(rank + 1), np.float32)
+    garr = jax.make_array_from_process_local_data(sharding, local, (n, 8))
+
+    if args.task == "allreduce":
+        out = jax.jit(
+            lambda a: jnp.broadcast_to(a.sum(axis=0, keepdims=True), a.shape),
+            in_shardings=sharding, out_shardings=sharding)(garr)
+        got = np.asarray(out.addressable_shards[0].data)
+        want = np.full((1, 8), n * (n + 1) / 2.0, np.float32)
+        np.testing.assert_allclose(got, want)
+    else:  # alltoall
+        out = jax.jit(
+            lambda a: a.reshape(n, n, -1).swapaxes(0, 1).reshape(n, -1),
+            in_shardings=sharding, out_shardings=sharding)(garr)
+        got = np.asarray(out.addressable_shards[0].data)
+        # row r of the transpose gathers element r of every rank's buffer
+        np.testing.assert_allclose(
+            got.reshape(n, -1)[:, 0], np.arange(1, n + 1, dtype=np.float32))
+
+    print(f"OK rank={rank}/{n}", flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

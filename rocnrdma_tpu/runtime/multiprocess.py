@@ -1,0 +1,61 @@
+"""Multi-process CPU simulation harness (SURVEY.md §4 "Multi-process
+simulation"; groundwork for C13).
+
+Spawns N local python processes that each run ``jax.distributed.initialize``
+against a local coordinator — the REAL process-boundary code path that
+multi-host TPU deployments use (the rebuild of the reference's multi-node
+rank bootstrap), exercised on one machine with CPU devices.
+
+Also the fault-injection hook of SURVEY.md §5: ``task="fault"`` makes one
+rank die before reaching the init barrier, and the harness asserts the
+survivors abort with a clean error instead of hanging.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import subprocess
+import sys
+
+
+@dataclasses.dataclass
+class WorkerResult:
+    process_id: int
+    returncode: int
+    stdout: str
+    stderr: str
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_workers(n: int, task: str, timeout_s: float = 120.0,
+                fault_rank: int | None = None) -> list[WorkerResult]:
+    """Spawn ``n`` worker processes running ``task``; wait for all."""
+    coordinator = f"127.0.0.1:{free_port()}"
+    procs = []
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # workers get exactly 1 CPU device each
+    env["JAX_PLATFORMS"] = "cpu"
+    for i in range(n):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "rocnrdma_tpu.runtime.mp_worker",
+             "--coordinator", coordinator, "--num-processes", str(n),
+             "--process-id", str(i), "--task", task]
+            + (["--fault-rank", str(fault_rank)] if fault_rank is not None else []),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env))
+    results = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout_s)
+            results.append(WorkerResult(i, p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            results.append(WorkerResult(i, -9, out, err + "\n[HARNESS] timeout"))
+    return results

@@ -29,7 +29,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from rocnrdma_tpu import collectives as C
 from rocnrdma_tpu.runtime.mesh import INTRA_AXIS, RANK_AXIS, SLICE_AXIS, rank_mesh
 
-ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "hierarchical")
+
+def _pallas():
+    # deferred: pallas + its TPU interpret machinery only load when the
+    # remote-DMA data plane is actually selected
+    from rocnrdma_tpu import ops
+    return ops
+
+ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "hierarchical",
+         "pallas_ring")
 
 
 class Transport:
@@ -55,7 +63,7 @@ class Transport:
             algo = "hierarchical" if (self.is_2d and op == "allreduce") else "fused"
         if algo == "hierarchical" and not self.is_2d:
             raise ValueError("hierarchical allreduce needs a 2-D ('slice','intra') mesh")
-        if algo in ("ring", "ring_bidir", "tree") and self.is_2d:
+        if algo in ("ring", "ring_bidir", "tree", "pallas_ring") and self.is_2d:
             raise ValueError(f"algo {algo!r} runs on a 1-D rank mesh; "
                              f"use 'hierarchical' or 'fused' on a 2-D mesh")
         if algo == "hierarchical" and op != "allreduce":
@@ -120,13 +128,16 @@ class Transport:
                 "ring_bidir": lambda v: C.ring_allreduce(v, RANK_AXIS, bidir=True),
                 "tree": lambda v: C.hd_allreduce(v, RANK_AXIS),
                 "hierarchical": lambda v: C.hierarchical_allreduce(v),
+                "pallas_ring": lambda v: _pallas().pallas_ring_allreduce(v, RANK_AXIS),
             }[algo]
         elif op == "reduce_scatter":
             fn = {"fused": lambda v: C.fused_reduce_scatter(v, fused_axes),
                   "ring": lambda v: C.ring_reduce_scatter(v, RANK_AXIS)}.get(algo)
         elif op == "allgather":
             fn = {"fused": lambda v: C.fused_allgather(v, fused_axes).reshape(-1),
-                  "ring": lambda v: C.ring_allgather(v, RANK_AXIS).reshape(-1)}.get(algo)
+                  "ring": lambda v: C.ring_allgather(v, RANK_AXIS).reshape(-1),
+                  "pallas_ring": lambda v: _pallas().pallas_ring_allgather(
+                      v, RANK_AXIS).reshape(-1)}.get(algo)
         elif op == "alltoall":
             # "ring" here selects the rotation schedule — the ring-family
             # alltoall (n-1 shifted ppermute steps).
@@ -138,6 +149,9 @@ class Transport:
             raise ValueError(f"op {op!r} has no {algo!r} schedule")
 
         spec = self._spec()
+        # check_vma off for the pallas data plane: pallas_call outputs carry
+        # no varying-mesh-axes annotation for the checker to verify.
         shmapped = jax.shard_map(local(fn), mesh=self.mesh,
-                                 in_specs=(spec,), out_specs=spec)
+                                 in_specs=(spec,), out_specs=spec,
+                                 check_vma=not algo.startswith("pallas"))
         return jax.jit(shmapped)

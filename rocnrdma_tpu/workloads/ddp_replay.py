@@ -31,7 +31,7 @@ import jax
 import numpy as np
 
 from rocnrdma_tpu import metrics as M
-from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.bench import cli_common
 from rocnrdma_tpu.bench.timing import trimmed_mean
 from rocnrdma_tpu.transport import Transport
 from rocnrdma_tpu.workloads.llama_trace import LLAMA3_8B, Trace, generate_trace
@@ -125,18 +125,9 @@ def main(argv=None) -> int:
               f"({trace.total_bytes / M.GiB:.2f} GiB) to {args.trace_out}")
         return 0
 
-    if args.fake_devices:
-        rt.force_cpu_devices(args.fake_devices)
-    elif args.platform == "cpu":
-        rt.force_cpu_devices(args.ranks or 8)
-    info = rt.init_runtime()
+    info = cli_common.setup_backend(args.fake_devices, args.platform, args.ranks)
     topo = info.topology
-
-    if args.mesh2d:
-        s, per = (int(v) for v in args.mesh2d.lower().split("x"))
-        mesh = rt.slice_mesh(s, per)
-    else:
-        mesh = rt.rank_mesh(min(args.ranks or topo.n_devices, topo.n_devices))
+    mesh = cli_common.build_mesh(args.mesh2d, args.ranks, topo)
     t = Transport(mesh)
 
     bufs = _bucket_arrays(t, trace, args.scale, args.dtype)
@@ -149,27 +140,30 @@ def main(argv=None) -> int:
 
     window = args.window if args.window is not None else (4 if topo.is_oracle else 0)
 
-    out_fp = open(args.out, "a") if args.out else None
+    modes = args.modes.split(",")
+    means = {mode: replay(t, bufs, args.algo, mode, repeats=args.repeats,
+                          window=window) for mode in modes}
+    # speedups are only meaningful against an actually-measured sequential run
+    base = means.get("sequential")
+
     records = []
-    base = None
-    for mode in args.modes.split(","):
-        mean_s = replay(t, bufs, args.algo, mode, repeats=args.repeats,
-                        window=window)
-        base = base if base is not None else mean_s
-        rec = M.BenchRecord.measure(
+    for mode in modes:
+        extra = dict(mode=mode, n_buckets=len(bufs), scale=args.scale,
+                     full_bytes=trace.total_bytes)
+        if base is not None:
+            extra["speedup_vs_sequential"] = base / means[mode]
+        records.append(M.BenchRecord.measure(
             "ddp_replay", "allreduce", args.algo, t.n_ranks, scaled_bytes,
-            args.dtype, mean_s, platform=topo.platform, mode=mode,
-            n_buckets=len(bufs), scale=args.scale,
-            full_bytes=trace.total_bytes, speedup_vs_sequential=base / mean_s)
-        records.append(rec)
-        if out_fp:
-            rec.write(out_fp)
-    if out_fp:
-        out_fp.close()
+            args.dtype, means[mode], platform=topo.platform, **extra))
+    if args.out:
+        with open(args.out, "a") as fp:
+            for rec in records:
+                rec.write(fp)
     print(M.format_table(records))
     for r in records:
-        print(f"#   {r.extra['mode']:>10}: {r.mean_s * 1e3:8.2f} ms/step  "
-              f"{r.extra['speedup_vs_sequential']:.2f}x vs sequential")
+        speed = (f"  {r.extra['speedup_vs_sequential']:.2f}x vs sequential"
+                 if "speedup_vs_sequential" in r.extra else "")
+        print(f"#   {r.extra['mode']:>10}: {r.mean_s * 1e3:8.2f} ms/step{speed}")
     return 0
 
 

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rocnrdma_tpu import metrics as M
-from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.bench import cli_common
 from rocnrdma_tpu.bench.timing import trimmed_mean
 from rocnrdma_tpu.transport import Transport
 
@@ -68,18 +68,9 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
 
-    if args.fake_devices:
-        rt.force_cpu_devices(args.fake_devices)
-    elif args.platform == "cpu":
-        rt.force_cpu_devices(args.ranks or 8)
-    info = rt.init_runtime()
+    info = cli_common.setup_backend(args.fake_devices, args.platform, args.ranks)
     topo = info.topology
-
-    if args.mesh2d:
-        s, per = (int(v) for v in args.mesh2d.lower().split("x"))
-        mesh = rt.slice_mesh(s, per)
-    else:
-        mesh = rt.rank_mesh(min(args.ranks or topo.n_devices, topo.n_devices))
+    mesh = cli_common.build_mesh(args.mesh2d, args.ranks, topo)
     t = Transport(mesh)
     n = t.n_ranks
 

@@ -1,0 +1,36 @@
+"""Multi-process tier (SURVEY.md §4): real jax.distributed coordination on
+one machine — 2 worker processes, 1 CPU device each — plus fault injection.
+
+Slower than the in-process tiers (each worker pays a fresh jax import);
+kept small (n=2) for suite runtime.
+"""
+
+import pytest
+
+from rocnrdma_tpu.runtime.multiprocess import run_workers
+
+
+@pytest.mark.parametrize("task", ["allreduce", "alltoall"])
+def test_two_process_collective(task):
+    results = run_workers(2, task, timeout_s=180)
+    for r in results:
+        assert r.returncode == 0, f"rank {r.process_id} failed:\n{r.stderr[-2000:]}"
+        assert f"OK rank={r.process_id}/2" in r.stdout
+
+
+def test_fault_injection_clean_abort():
+    # rank 1 dies before the init barrier; rank 0 (the coordinator) must
+    # abort within its deadline — NOT hang (SURVEY.md §5). Depending on the
+    # jaxlib, the abort is either a catchable RuntimeError (our wrapper exits
+    # 4) or a LOG(FATAL) process termination with a diagnostic naming the
+    # dead peer; both are bounded-time clean aborts. A harness kill (-9)
+    # would mean a hang — the one unacceptable outcome.
+    results = run_workers(2, "fault", timeout_s=180, fault_rank=1)
+    assert results[1].returncode == 3, results[1]
+    assert "FAULT" in results[1].stdout
+    survivor = results[0]
+    assert survivor.returncode not in (0, -9), \
+        f"survivor: rc={survivor.returncode}\n{survivor.stderr[-2000:]}"
+    blob = survivor.stdout + survivor.stderr
+    assert ("CLEAN-ABORT" in blob or "DEADLINE_EXCEEDED" in blob
+            or "another task died" in blob), blob[-2000:]

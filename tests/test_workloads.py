@@ -79,3 +79,16 @@ def test_moe_roundtrip_and_cli(tmp_path):
 def test_moe_2d_mesh():
     assert moe.main(["--mesh2d", "2x4", "--tokens", "64", "--d-model", "8",
                      "--repeats", "1", "--iters", "2"]) == 0
+
+
+def test_replay_speedup_base_is_sequential_only(tmp_path, capsys):
+    # regression: with --modes not starting at sequential, no bogus
+    # "vs sequential" numbers may be emitted
+    out = tmp_path / "d2.jsonl"
+    assert ddp_replay.main(["--scale", "65536", "--bucket-mb", "500",
+                            "--ranks", "4", "--repeats", "1",
+                            "--modes", "jit_fused,overlap",
+                            "--out", str(out)]) == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert all("speedup_vs_sequential" not in r["extra"] for r in rows)
+    assert "vs sequential" not in capsys.readouterr().out
