@@ -58,6 +58,9 @@ def make_parser(bench_name: str, collective: str) -> argparse.ArgumentParser:
                    help="skip sweep points already present in --out")
     p.add_argument("--no-check", action="store_true",
                    help="skip the numpy correctness check before timing")
+    p.add_argument("--paranoid", action="store_true",
+                   help="run each collective twice and require bitwise-equal "
+                        "results (nondeterminism/race detector, SURVEY.md §5)")
     p.add_argument("--profile", type=str, default=None, metavar="DIR",
                    help="write a jax.profiler trace of the timed loop")
     return p
@@ -161,9 +164,13 @@ def algos_for(collective: str, algos: tuple, is_2d: bool) -> tuple:
         if collective == "allreduce":
             if a == "hierarchical":
                 return is_2d
-            return not is_2d  # ring/ring_bidir/tree/pallas_ring ring a 1-D mesh
+            # ring/ring_bidir/tree/pallas_ring ring a 1-D mesh; bruck is
+            # alltoall-only
+            return a != "bruck" and not is_2d
         if collective == "allgather":
             return a in ("ring", "pallas_ring") and not is_2d
+        if collective == "alltoall":
+            return a in ("ring", "bruck") and not is_2d
         return a == "ring" and not is_2d
     kept = tuple(a for a in algos if ok(a))
     return kept or ("fused",)
@@ -236,6 +243,15 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                               file=sys.stderr)
                         continue
                     fn = t.jit_fn(_OP[collective], algo)
+                    if args.paranoid:
+                        # same input, same schedule: any bit difference means
+                        # a data race or nondeterministic reduction order
+                        r1 = np.asarray(fn(x)).view(np.uint8)
+                        r2 = np.asarray(fn(x)).view(np.uint8)
+                        if not np.array_equal(r1, r2):
+                            raise AssertionError(
+                                f"paranoid: {collective}/{algo} nondeterministic "
+                                f"at {actual} B ({int((r1 != r2).sum())} bytes differ)")
                     if pre.check:
                         got = np.asarray(fn(x), np.float32)
                         want = _expected(collective, x_np, pre.mesh2d)

@@ -20,7 +20,10 @@ from rocnrdma_tpu.collectives.ring import (  # noqa: F401
     ring_reduce_scatter,
 )
 from rocnrdma_tpu.collectives.tree import hd_allreduce  # noqa: F401
-from rocnrdma_tpu.collectives.alltoall import rotation_alltoall  # noqa: F401
+from rocnrdma_tpu.collectives.alltoall import (  # noqa: F401
+    bruck_alltoall,
+    rotation_alltoall,
+)
 from rocnrdma_tpu.collectives.hierarchical import hierarchical_allreduce  # noqa: F401
 from rocnrdma_tpu.collectives.fused import (  # noqa: F401
     fused_allgather,

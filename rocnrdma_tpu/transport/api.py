@@ -37,7 +37,7 @@ def _pallas():
     return ops
 
 ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "hierarchical",
-         "pallas_ring")
+         "pallas_ring", "bruck")
 
 
 class Transport:
@@ -63,7 +63,8 @@ class Transport:
             algo = "hierarchical" if (self.is_2d and op == "allreduce") else "fused"
         if algo == "hierarchical" and not self.is_2d:
             raise ValueError("hierarchical allreduce needs a 2-D ('slice','intra') mesh")
-        if algo in ("ring", "ring_bidir", "tree", "pallas_ring") and self.is_2d:
+        if algo in ("ring", "ring_bidir", "tree", "pallas_ring", "bruck") \
+                and self.is_2d:
             raise ValueError(f"algo {algo!r} runs on a 1-D rank mesh; "
                              f"use 'hierarchical' or 'fused' on a 2-D mesh")
         if algo == "hierarchical" and op != "allreduce":
@@ -129,7 +130,7 @@ class Transport:
                 "tree": lambda v: C.hd_allreduce(v, RANK_AXIS),
                 "hierarchical": lambda v: C.hierarchical_allreduce(v),
                 "pallas_ring": lambda v: _pallas().pallas_ring_allreduce(v, RANK_AXIS),
-            }[algo]
+            }.get(algo)
         elif op == "reduce_scatter":
             fn = {"fused": lambda v: C.fused_reduce_scatter(v, fused_axes),
                   "ring": lambda v: C.ring_reduce_scatter(v, RANK_AXIS)}.get(algo)
@@ -140,9 +141,10 @@ class Transport:
                       v, RANK_AXIS).reshape(-1)}.get(algo)
         elif op == "alltoall":
             # "ring" here selects the rotation schedule — the ring-family
-            # alltoall (n-1 shifted ppermute steps).
+            # alltoall (n-1 shifted ppermute steps); "bruck" the log-step one.
             fn = {"fused": lambda v: C.fused_alltoall(v, fused_axes),
-                  "ring": lambda v: C.rotation_alltoall(v, RANK_AXIS)}.get(algo)
+                  "ring": lambda v: C.rotation_alltoall(v, RANK_AXIS),
+                  "bruck": lambda v: C.bruck_alltoall(v, RANK_AXIS)}.get(algo)
         else:
             raise ValueError(f"unknown op {op!r}")
         if fn is None:

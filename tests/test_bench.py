@@ -113,3 +113,23 @@ def test_preset_scaling_degenerate_mesh_falls_back_flat():
     scaled = pre.scaled_to(n_devices=1, max_bytes=MiB)
     assert scaled.mesh2d is None
     assert scaled.n_ranks == 1
+
+
+def test_bench_alltoall_bruck_and_paranoid(tmp_path):
+    out = tmp_path / "b.jsonl"
+    _run(bench_alltoall.main,
+         ["--ranks", "4", "--sizes", "16K", "--algos", "bruck,ring",
+          "--paranoid", "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["algo"] for r in rows} == {"bruck", "ring"}
+
+
+def test_bruck_filtered_for_allreduce(tmp_path):
+    # regression: bruck is alltoall-only; bench_allreduce must filter it
+    # (not die with a KeyError mid-sweep)
+    out = tmp_path / "bk.jsonl"
+    _run(bench_allreduce.main,
+         ["--ranks", "4", "--sizes", "4K", "--algos", "bruck,fused",
+          "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["algo"] for r in rows} == {"fused"}

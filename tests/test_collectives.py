@@ -142,3 +142,10 @@ def test_allreduce_rank_permutation_invariance(devices):
     out1 = np.asarray(run_on_ring(f, n, x))
     out2 = np.asarray(run_on_ring(f, n, x[perm]))
     np.testing.assert_allclose(out1, out2, rtol=1e-5)
+
+
+@pytest.mark.parametrize("n", [2, 3, 8])
+def test_bruck_alltoall_is_transpose(devices, n):
+    x = _rand(n, n * 5, seed=9).reshape(n, n, 5)
+    out = run_on_ring(lambda s: C.bruck_alltoall(s[0], RANK)[None], n, x)
+    np.testing.assert_allclose(np.asarray(out), x.transpose(1, 0, 2), rtol=1e-6)

@@ -73,3 +73,18 @@ def test_hierarchical_phases_shape():
     assert phases[0] == ("reducescatter", "intra")
     assert phases[1][1] == "slice"
     assert phases[2] == ("allgather", "intra")
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5, 8])
+def test_sim_bruck_matches_transpose(n):
+    rng = np.random.default_rng(4)
+    bufs = rng.normal(size=(n, n * 3)).astype(np.float32)
+    got = S.sim_bruck_alltoall(bufs)
+    want = S.sim_alltoall(bufs)  # rotation algorithm is the oracle
+    np.testing.assert_allclose(got, want)
+
+
+def test_bruck_phase_count_is_log():
+    assert S.bruck_phases(8) == [1, 2, 4]
+    assert S.bruck_phases(5) == [1, 2, 4]
+    assert S.bruck_phases(2) == [1]
