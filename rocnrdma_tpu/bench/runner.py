@@ -158,21 +158,12 @@ def algos_for(collective: str, algos: tuple, is_2d: bool) -> tuple:
     hierarchical allreduce AND MoE alltoall); each CLI keeps only the algos
     its collective defines on the current mesh, falling back to 'fused'.
     """
-    def ok(a):
-        if a == "auto" or a == "fused":
-            return True
-        if collective == "allreduce":
-            if a == "hierarchical":
-                return is_2d
-            # ring/ring_bidir/tree/pallas_ring ring a 1-D mesh; bruck is
-            # alltoall-only
-            return a != "bruck" and not is_2d
-        if collective == "allgather":
-            return a in ("ring", "pallas_ring") and not is_2d
-        if collective == "alltoall":
-            return a in ("ring", "bruck") and not is_2d
-        return a == "ring" and not is_2d
-    kept = tuple(a for a in algos if ok(a))
+    from rocnrdma_tpu.transport.api import supports
+
+    unknown = [a for a in algos if a not in ALGOS]
+    if unknown:
+        raise ValueError(f"unknown algo(s) {unknown}; know {ALGOS}")
+    kept = tuple(a for a in algos if supports(_OP[collective], a, is_2d))
     return kept or ("fused",)
 
 
@@ -243,17 +234,20 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                               file=sys.stderr)
                         continue
                     fn = t.jit_fn(_OP[collective], algo)
+                    r1 = None
                     if args.paranoid:
                         # same input, same schedule: any bit difference means
                         # a data race or nondeterministic reduction order
-                        r1 = np.asarray(fn(x)).view(np.uint8)
+                        r1 = np.asarray(fn(x))
                         r2 = np.asarray(fn(x)).view(np.uint8)
-                        if not np.array_equal(r1, r2):
+                        if not np.array_equal(r1.view(np.uint8), r2):
                             raise AssertionError(
                                 f"paranoid: {collective}/{algo} nondeterministic "
-                                f"at {actual} B ({int((r1 != r2).sum())} bytes differ)")
+                                f"at {actual} B ({int((r1.view(np.uint8) != r2).sum())} bytes differ)")
                     if pre.check:
-                        got = np.asarray(fn(x), np.float32)
+                        # reuse the paranoid run's bytes: no third execution
+                        got = (r1 if r1 is not None
+                               else np.asarray(fn(x))).astype(np.float32)
                         want = _expected(collective, x_np, pre.mesh2d)
                         rtol, atol = (1e-4, 1e-5) if dtype == "float32" else (5e-2, 5e-2)
                         np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)

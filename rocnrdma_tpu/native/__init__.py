@@ -1,0 +1,234 @@
+"""Native host-side queue pairs (the `ibv_*` layer rebuilt; SURVEY.md §1 L1).
+
+The reference's lowest stratum is InfiniBand verbs + `hipMemRegister`: native
+code that moves bytes between hosts and pins the buffers the NIC DMAs. The
+TPU rebuild's *device* data plane needs none of that (XLA owns ICI/DCN), but
+the framework keeps a native host control plane with the same shape: a C++
+shared-memory queue-pair library (`rqp.cpp`) compiled on demand with the
+system toolchain and driven here through ``ctypes`` — `listen / connect /
+accept / post_send / post_recv / poll_cq`, verbs semantics, zero HIP/ROCm.
+
+Used by the multi-process harness and the net-plugin vtable
+(`transport/plugin.py`) for out-of-band control messages, rendezvous, and the
+host-side (gloo-analogue) collective path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "rqp.cpp")
+_LIB_DIR = os.environ.get("RQP_LIB_DIR") or os.path.join(
+    os.path.dirname(__file__), "_build")
+_LIB = os.path.join(_LIB_DIR, "librqp.so")
+
+_build_lock = threading.Lock()
+_lib = None
+
+OP_SEND = 0
+OP_RECV = 1
+OK = 0
+ERR_TRUNC = 1
+
+
+class _CQE(ctypes.Structure):
+    _fields_ = [("wr_id", ctypes.c_int64), ("opcode", ctypes.c_int32),
+                ("status", ctypes.c_int32), ("len", ctypes.c_uint32),
+                ("pad", ctypes.c_uint32)]
+
+
+@dataclasses.dataclass(frozen=True)
+class Completion:
+    """One completion-queue entry (the ``ibv_wc`` analogue)."""
+
+    wr_id: int
+    opcode: int   # OP_SEND | OP_RECV
+    status: int   # OK | ERR_TRUNC
+    length: int
+
+
+def build(force: bool = False) -> str:
+    """Compile ``rqp.cpp`` → ``librqp.so`` with the system g++ (cached)."""
+    with _build_lock:
+        stale = (force or not os.path.exists(_LIB)
+                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if stale:
+            os.makedirs(_LIB_DIR, exist_ok=True)
+            tmp = _LIB + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+                 _SRC, "-pthread"],
+                check=True, capture_output=True, text=True)
+            os.replace(tmp, _LIB)  # atomic: concurrent builders don't clash
+    return _LIB
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build())
+    lib.rqp_listen.restype = ctypes.c_void_p
+    lib.rqp_listen.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    lib.rqp_connect.restype = ctypes.c_void_p
+    lib.rqp_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rqp_accept.restype = ctypes.c_int
+    lib.rqp_accept.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rqp_post_send.restype = ctypes.c_int64
+    lib.rqp_post_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint32]
+    lib.rqp_post_recv.restype = ctypes.c_int64
+    lib.rqp_post_recv.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_uint32]
+    lib.rqp_poll_cq.restype = ctypes.c_int
+    lib.rqp_poll_cq.argtypes = [ctypes.c_void_p, ctypes.POINTER(_CQE),
+                                ctypes.c_int]
+    lib.rqp_rx_pending.restype = ctypes.c_uint64
+    lib.rqp_rx_pending.argtypes = [ctypes.c_void_p]
+    lib.rqp_close.restype = None
+    lib.rqp_close.argtypes = [ctypes.c_void_p]
+    lib.rqp_unlink.restype = ctypes.c_int
+    lib.rqp_unlink.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    """True if the native library is (or can be) built on this machine."""
+    try:
+        _load()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+class QueuePair:
+    """One endpoint of a shared-memory queue pair.
+
+    ``QueuePair.listen(name)`` creates the channel; ``QueuePair.connect(name)``
+    attaches the peer. Both then use verbs-style ``post_send`` /
+    ``post_recv`` / ``poll_cq``. Posted receive *buffers* (bytearrays) stay
+    owned by the QP until their completion is polled, mirroring memory
+    registration: the buffer handed to ``post_recv`` is the registered MR.
+    """
+
+    def __init__(self, handle: int, name: str, is_listener: bool):
+        if not handle:
+            raise OSError(f"rqp: could not open queue pair {name!r}")
+        self._h = handle
+        self.name = name
+        self.is_listener = is_listener
+        self._recv_bufs: dict[int, bytearray] = {}
+        self._closed = False
+
+    # -- connection setup (listen / connect / accept) ----------------------
+
+    @classmethod
+    def listen(cls, name: str, capacity: int = 1 << 20) -> "QueuePair":
+        lib = _load()
+        lib.rqp_unlink(name.encode())  # drop stale segment from a dead run
+        return cls(lib.rqp_listen(name.encode(), capacity), name, True)
+
+    @classmethod
+    def connect(cls, name: str, timeout_s: float = 10.0) -> "QueuePair":
+        lib = _load()
+        return cls(lib.rqp_connect(name.encode(), int(timeout_s * 1000)),
+                   name, False)
+
+    def accept(self, timeout_s: float = 10.0) -> None:
+        """Block until the peer has attached."""
+        if _load().rqp_accept(self._h, int(timeout_s * 1000)) != 0:
+            raise TimeoutError(f"rqp: peer never attached to {self.name!r}")
+
+    # -- work requests -----------------------------------------------------
+
+    def post_send(self, data: bytes) -> int:
+        """Queue ``data`` for the peer; returns wr_id, or -1 if ring full."""
+        return _load().rqp_post_send(self._h, bytes(data), len(data))
+
+    def send(self, data: bytes, timeout_s: float = 10.0) -> int:
+        """``post_send`` with bounded retry on backpressure."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while True:
+            wr = self.post_send(data)
+            if wr >= 0:
+                return wr
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"rqp: send ring full on {self.name!r}")
+            time.sleep(0.0005)
+
+    def post_recv(self, nbytes: int) -> int:
+        """Register a receive buffer of ``nbytes``; returns its wr_id."""
+        buf = bytearray(nbytes)
+        cbuf = (ctypes.c_char * nbytes).from_buffer(buf)
+        wr = _load().rqp_post_recv(self._h, cbuf, nbytes)
+        if wr >= 0:
+            self._recv_bufs[wr] = buf
+        return wr
+
+    def poll_cq(self, max_cqes: int = 16) -> list[tuple[Completion, bytes | None]]:
+        """Drain completions; each recv completion carries its payload."""
+        arr = (_CQE * max_cqes)()
+        n = _load().rqp_poll_cq(self._h, arr, max_cqes)
+        out = []
+        for i in range(max(n, 0)):
+            c = Completion(arr[i].wr_id, arr[i].opcode, arr[i].status,
+                           arr[i].len)
+            payload = None
+            if c.opcode == OP_RECV:
+                payload = bytes(self._recv_bufs.pop(c.wr_id)[:c.length])
+            out.append((c, payload))
+        return out
+
+    def recv(self, timeout_s: float = 10.0) -> bytes:
+        """Blocking receive of exactly one message.
+
+        Posts its own 64 KiB buffer — but only when none is already
+        outstanding, so a retry after a timeout reuses the posted WR instead
+        of leaking one registered buffer per attempt.
+        """
+        import time
+        if not self._recv_bufs:
+            self.post_recv(1 << 16)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            for c, payload in self.poll_cq():
+                if c.opcode == OP_RECV:
+                    if c.status != OK:
+                        raise OSError(f"rqp: recv truncated on {self.name!r}")
+                    return payload
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"rqp: recv timed out on {self.name!r}")
+            time.sleep(0.0005)
+
+    def rx_pending(self) -> int:
+        """Unread bytes in the incoming ring (diagnostics)."""
+        return _load().rqp_rx_pending(self._h)
+
+    # -- teardown ----------------------------------------------------------
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            # drop ctypes views into posted bytearrays before freeing them
+            self._recv_bufs.clear()
+            _load().rqp_close(self._h)
+            if self.is_listener:
+                _load().rqp_unlink(self.name.encode())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

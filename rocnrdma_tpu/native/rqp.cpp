@@ -1,0 +1,307 @@
+// rqp — userspace shared-memory queue pairs with ibverbs-shaped semantics.
+//
+// The reference framework's L1 is an `ibv_*` queue-pair layer: create a QP,
+// exchange connection handles out-of-band, register memory, post send/recv
+// work requests, poll a completion queue. On TPU the *device* data plane is
+// XLA collectives over ICI/DCN (see rocnrdma_tpu/transport, /ops), but the
+// framework still needs a native host-side control/bootstrap plane — the
+// piece the reference built on verbs over the NIC. This file is that piece,
+// rebuilt for single-host multi-process simulation: POSIX shared memory in
+// place of the NIC, the same post_send / post_recv / poll_cq contract.
+//
+// One shm segment holds TWO unidirectional message rings (A->B and B->A).
+// The `listen` side creates the segment; the `connect` side opens it with
+// the rings swapped. Head/tail indices are C11-atomic monotonic counters in
+// the shared mapping, so a pair of processes (or threads) can drive the ring
+// lock-free (SPSC per direction). Messages are length-prefixed and padded to
+// 8 bytes; a message never wraps (the writer inserts a wrap marker instead),
+// which keeps payload copies contiguous for the reader.
+//
+// Exported C ABI (consumed by rocnrdma_tpu/native/__init__.py via ctypes):
+//   rqp_listen(name, capacity)      -> handle   (creates the segment)
+//   rqp_connect(name, timeout_ms)   -> handle   (opens it, swapped rings)
+//   rqp_accept(handle, timeout_ms)  -> 0/-1     (wait for peer attach)
+//   rqp_post_send(handle, buf, len) -> wr_id    (-1: ring full, retry)
+//   rqp_post_recv(handle, buf, cap) -> wr_id    (queue a receive buffer)
+//   rqp_poll_cq(handle, cqes, max)  -> n        (drain completions)
+//   rqp_close(handle)               / rqp_unlink(name)
+//
+// Completion semantics mirror verbs: a send completes once its bytes are in
+// the ring (buffer reusable); a receive completes when a message has been
+// copied into the oldest posted receive buffer. RQP_ERR_TRUNC is reported —
+// not silently dropped — when a message exceeds the posted buffer.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <string>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x52515031;  // "RQP1"
+constexpr uint32_t kAlign = 8;
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+
+struct Ring {
+  std::atomic<uint64_t> head;  // bytes written (monotonic)
+  std::atomic<uint64_t> tail;  // bytes consumed (monotonic)
+  char pad[48];                // keep the two counters off shared cache lines
+};
+
+struct ShmHdr {
+  uint32_t magic;
+  uint32_t capacity;               // data bytes per ring
+  std::atomic<uint32_t> attached;  // bit0 = listener, bit1 = connector
+  Ring ring[2];                    // ring[0]: listener->connector; ring[1]: reverse
+  // followed by: ring0 data[capacity], ring1 data[capacity]
+};
+
+struct RecvWr {
+  int64_t wr_id;
+  void* buf;
+  uint32_t cap;
+};
+
+struct PendingSendCqe {
+  int64_t wr_id;
+  uint32_t len;
+};
+
+struct Handle {
+  ShmHdr* hdr = nullptr;
+  size_t map_len = 0;
+  char* send_data = nullptr;  // data area of the ring this side writes
+  char* recv_data = nullptr;
+  Ring* send_ring = nullptr;
+  Ring* recv_ring = nullptr;
+  bool is_listener = false;
+  int64_t next_wr = 1;
+  std::deque<RecvWr> recv_q;          // posted receive buffers, FIFO
+  std::deque<PendingSendCqe> send_cq; // sends completed, not yet polled
+  std::string shm_name;
+};
+
+uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000 + uint64_t(ts.tv_nsec) / 1000000;
+}
+
+uint32_t pad8(uint32_t n) { return (n + (kAlign - 1)) & ~(kAlign - 1); }
+
+size_t map_len_for(uint32_t capacity) {
+  return sizeof(ShmHdr) + size_t(capacity) * 2;
+}
+
+Handle* attach(ShmHdr* hdr, size_t mlen, bool listener, const char* name) {
+  Handle* h = new Handle();
+  h->hdr = hdr;
+  h->map_len = mlen;
+  h->is_listener = listener;
+  h->shm_name = name;
+  char* data0 = reinterpret_cast<char*>(hdr) + sizeof(ShmHdr);
+  char* data1 = data0 + hdr->capacity;
+  if (listener) {
+    h->send_ring = &hdr->ring[0]; h->send_data = data0;
+    h->recv_ring = &hdr->ring[1]; h->recv_data = data1;
+  } else {
+    h->send_ring = &hdr->ring[1]; h->send_data = data1;
+    h->recv_ring = &hdr->ring[0]; h->recv_data = data0;
+  }
+  hdr->attached.fetch_or(listener ? 1u : 2u, std::memory_order_release);
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct rqp_cqe {
+  int64_t wr_id;
+  int32_t opcode;  // 0 = send, 1 = recv
+  int32_t status;  // 0 = ok, 1 = truncated
+  uint32_t len;
+  uint32_t pad_;
+};
+
+enum { RQP_OP_SEND = 0, RQP_OP_RECV = 1, RQP_OK = 0, RQP_ERR_TRUNC = 1 };
+
+void* rqp_listen(const char* name, uint32_t capacity) {
+  if (capacity < 64) return nullptr;
+  capacity = pad8(capacity);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t mlen = map_len_for(capacity);
+  if (ftruncate(fd, off_t(mlen)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, mlen, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  ShmHdr* hdr = static_cast<ShmHdr*>(mem);
+  std::memset(hdr, 0, sizeof(ShmHdr));
+  hdr->capacity = capacity;
+  std::atomic_thread_fence(std::memory_order_release);
+  hdr->magic = kMagic;
+  return attach(hdr, mlen, /*listener=*/true, name);
+}
+
+void* rqp_connect(const char* name, int timeout_ms) {
+  uint64_t deadline = now_ms() + uint64_t(timeout_ms < 0 ? 0 : timeout_ms);
+  for (;;) {
+    int fd = shm_open(name, O_RDWR, 0600);
+    if (fd >= 0) {
+      struct stat st;
+      if (fstat(fd, &st) == 0 && size_t(st.st_size) > sizeof(ShmHdr)) {
+        void* probe = mmap(nullptr, sizeof(ShmHdr), PROT_READ, MAP_SHARED, fd, 0);
+        if (probe != MAP_FAILED) {
+          uint32_t magic = static_cast<ShmHdr*>(probe)->magic;
+          uint32_t cap = static_cast<ShmHdr*>(probe)->capacity;
+          munmap(probe, sizeof(ShmHdr));
+          if (magic == kMagic) {
+            size_t mlen = map_len_for(cap);
+            void* mem =
+                mmap(nullptr, mlen, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+            close(fd);
+            if (mem == MAP_FAILED) return nullptr;
+            return attach(static_cast<ShmHdr*>(mem), mlen,
+                          /*listener=*/false, name);
+          }
+        }
+      }
+      close(fd);
+    }
+    if (now_ms() >= deadline) return nullptr;
+    usleep(1000);
+  }
+}
+
+int rqp_accept(void* hv, int timeout_ms) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (!h) return -1;
+  uint32_t want = h->is_listener ? 2u : 1u;
+  uint64_t deadline = now_ms() + uint64_t(timeout_ms < 0 ? 0 : timeout_ms);
+  while (!(h->hdr->attached.load(std::memory_order_acquire) & want)) {
+    if (now_ms() >= deadline) return -1;
+    usleep(1000);
+  }
+  return 0;
+}
+
+// Post a send WR: copy [len][payload] into the ring if it fits. The copy IS
+// the transfer (shm in place of the NIC DMA), so the completion is queued
+// immediately and surfaces at the next poll_cq — same contract the verbs
+// layer gives the caller: buffer reusable once the CQE is seen.
+int64_t rqp_post_send(void* hv, const void* buf, uint32_t len) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (!h || (len > 0 && !buf)) return -1;
+  Ring* r = h->send_ring;
+  uint32_t cap = h->hdr->capacity;
+  uint32_t need = 4 + pad8(len);
+  if (need + 4 > cap) return -1;  // can never fit (+4: wrap marker headroom)
+  uint64_t head = r->head.load(std::memory_order_relaxed);
+  uint64_t tail = r->tail.load(std::memory_order_acquire);
+  uint32_t off = uint32_t(head % cap);
+  uint32_t to_end = cap - off;
+  uint32_t advance = 0;
+  if (to_end < need) {
+    // not enough contiguous room: emit wrap marker, restart at offset 0
+    if (cap - (head - tail) < uint64_t(to_end) + need) return -1;  // full
+    if (to_end >= 4)
+      std::memcpy(h->send_data + off, &kWrapMarker, 4);
+    advance = to_end;
+    off = 0;
+  } else if (cap - (head - tail) < need) {
+    return -1;  // full
+  }
+  std::memcpy(h->send_data + off, &len, 4);
+  if (len) std::memcpy(h->send_data + off + 4, buf, len);
+  r->head.store(head + advance + need, std::memory_order_release);
+  int64_t id = h->next_wr++;
+  h->send_cq.push_back({id, len});
+  return id;
+}
+
+int64_t rqp_post_recv(void* hv, void* buf, uint32_t cap) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (!h || (cap > 0 && !buf)) return -1;
+  int64_t id = h->next_wr++;
+  h->recv_q.push_back({id, buf, cap});
+  return id;
+}
+
+int rqp_poll_cq(void* hv, rqp_cqe* cqes, int max_cqes) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (!h || !cqes || max_cqes <= 0) return -1;
+  int n = 0;
+  // send completions first (they were finished at post time)
+  while (n < max_cqes && !h->send_cq.empty()) {
+    PendingSendCqe c = h->send_cq.front();
+    h->send_cq.pop_front();
+    cqes[n++] = {c.wr_id, RQP_OP_SEND, RQP_OK, c.len, 0};
+  }
+  // then drain incoming messages into posted receive buffers
+  Ring* r = h->recv_ring;
+  uint32_t cap = h->hdr->capacity;
+  while (n < max_cqes && !h->recv_q.empty()) {
+    uint64_t tail = r->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->head.load(std::memory_order_acquire);
+    if (head == tail) break;  // nothing on the wire
+    uint32_t off = uint32_t(tail % cap);
+    uint32_t msg_len;
+    if (cap - off < 4) {  // implicit wrap (marker didn't fit either)
+      tail += cap - off;
+      off = 0;
+    }
+    std::memcpy(&msg_len, h->recv_data + off, 4);
+    if (msg_len == kWrapMarker) {
+      tail += cap - off;
+      off = 0;
+      std::memcpy(&msg_len, h->recv_data + off, 4);
+    }
+    RecvWr wr = h->recv_q.front();
+    h->recv_q.pop_front();
+    uint32_t copy_len = msg_len <= wr.cap ? msg_len : wr.cap;
+    if (copy_len && wr.buf)
+      std::memcpy(wr.buf, h->recv_data + off + 4, copy_len);
+    r->tail.store(tail + 4 + pad8(msg_len), std::memory_order_release);
+    cqes[n++] = {wr.wr_id, RQP_OP_RECV,
+                 msg_len <= wr.cap ? RQP_OK : RQP_ERR_TRUNC, copy_len, 0};
+  }
+  return n;
+}
+
+// How many bytes are sitting unread in the incoming ring (diagnostics).
+uint64_t rqp_rx_pending(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (!h) return 0;
+  Ring* r = h->recv_ring;
+  return r->head.load(std::memory_order_acquire) -
+         r->tail.load(std::memory_order_acquire);
+}
+
+void rqp_close(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  if (!h) return;
+  h->hdr->attached.fetch_and(h->is_listener ? ~1u : ~2u,
+                             std::memory_order_release);
+  munmap(h->hdr, h->map_len);
+  delete h;
+}
+
+int rqp_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

@@ -9,3 +9,10 @@ is simply sharded device placement.
 """
 
 from rocnrdma_tpu.transport.api import Transport, ALGOS  # noqa: F401
+from rocnrdma_tpu.transport.plugin import (  # noqa: F401
+    DeviceMeshNet,
+    HostQPNet,
+    NetProperties,
+    Request,
+    ring_allreduce_over_net,
+)

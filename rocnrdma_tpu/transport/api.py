@@ -39,6 +39,51 @@ def _pallas():
 ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "hierarchical",
          "pallas_ring", "bruck")
 
+# THE (op, algo) compatibility table — single source of truth, consumed by
+# Transport._build below and by the bench runner's algo filter. Each entry
+# maps an axis-level value ``v`` through the schedule; ``fused_axes`` is the
+# axis name (1-D mesh) or axis tuple (2-D mesh) the fused lowerings span.
+SCHEDULES = {
+    "allreduce": {
+        "fused": lambda v, fused_axes: C.fused_allreduce(v, fused_axes),
+        "ring": lambda v, _: C.ring_allreduce(v, RANK_AXIS),
+        "ring_bidir": lambda v, _: C.ring_allreduce(v, RANK_AXIS, bidir=True),
+        "tree": lambda v, _: C.hd_allreduce(v, RANK_AXIS),
+        "hierarchical": lambda v, _: C.hierarchical_allreduce(v),
+        "pallas_ring": lambda v, _: _pallas().pallas_ring_allreduce(v, RANK_AXIS),
+    },
+    "reduce_scatter": {
+        "fused": lambda v, fused_axes: C.fused_reduce_scatter(v, fused_axes),
+        "ring": lambda v, _: C.ring_reduce_scatter(v, RANK_AXIS),
+    },
+    "allgather": {
+        "fused": lambda v, fused_axes: C.fused_allgather(v, fused_axes).reshape(-1),
+        "ring": lambda v, _: C.ring_allgather(v, RANK_AXIS).reshape(-1),
+        "pallas_ring": lambda v, _: _pallas().pallas_ring_allgather(
+            v, RANK_AXIS).reshape(-1),
+    },
+    "alltoall": {
+        # "ring" selects the rotation schedule — the ring-family alltoall
+        # (n-1 shifted ppermute steps); "bruck" the log-step one.
+        "fused": lambda v, fused_axes: C.fused_alltoall(v, fused_axes),
+        "ring": lambda v, _: C.rotation_alltoall(v, RANK_AXIS),
+        "bruck": lambda v, _: C.bruck_alltoall(v, RANK_AXIS),
+    },
+}
+
+
+def supports(op: str, algo: str, is_2d: bool) -> bool:
+    """Does ``(op, algo)`` resolve on a mesh of this dimensionality?"""
+    if algo == "auto":
+        return True
+    if algo not in SCHEDULES.get(op, {}):
+        return False
+    if algo == "hierarchical":
+        return is_2d
+    if algo == "fused":
+        return True
+    return not is_2d  # every explicit schedule rings a 1-D rank mesh
+
 
 class Transport:
     """Collectives over a mesh. Build one per mesh; methods are jit-cached."""
@@ -59,16 +104,15 @@ class Transport:
     def _resolve(self, algo: str, op: str) -> str:
         if algo not in ALGOS:
             raise ValueError(f"unknown algo {algo!r}; know {ALGOS}")
+        if op not in SCHEDULES:
+            raise ValueError(f"unknown op {op!r}")
         if algo == "auto":
             algo = "hierarchical" if (self.is_2d and op == "allreduce") else "fused"
-        if algo == "hierarchical" and not self.is_2d:
-            raise ValueError("hierarchical allreduce needs a 2-D ('slice','intra') mesh")
-        if algo in ("ring", "ring_bidir", "tree", "pallas_ring", "bruck") \
-                and self.is_2d:
-            raise ValueError(f"algo {algo!r} runs on a 1-D rank mesh; "
-                             f"use 'hierarchical' or 'fused' on a 2-D mesh")
-        if algo == "hierarchical" and op != "allreduce":
-            raise ValueError(f"hierarchical schedule only defined for allreduce, not {op}")
+        if not supports(op, algo, self.is_2d):
+            raise ValueError(
+                f"op {op!r} has no {algo!r} schedule on a "
+                f"{'2-D' if self.is_2d else '1-D'} mesh; compatible here: "
+                f"{[a for a in SCHEDULES[op] if supports(op, a, self.is_2d)]}")
         return algo
 
     def _spec(self) -> P:
@@ -122,33 +166,10 @@ class Transport:
                 return fn(s.reshape(s.shape[nlead:]))[(None,) * nlead]
             return wrapped
 
-        if op == "allreduce":
-            fn = {
-                "fused": lambda v: C.fused_allreduce(v, fused_axes),
-                "ring": lambda v: C.ring_allreduce(v, RANK_AXIS),
-                "ring_bidir": lambda v: C.ring_allreduce(v, RANK_AXIS, bidir=True),
-                "tree": lambda v: C.hd_allreduce(v, RANK_AXIS),
-                "hierarchical": lambda v: C.hierarchical_allreduce(v),
-                "pallas_ring": lambda v: _pallas().pallas_ring_allreduce(v, RANK_AXIS),
-            }.get(algo)
-        elif op == "reduce_scatter":
-            fn = {"fused": lambda v: C.fused_reduce_scatter(v, fused_axes),
-                  "ring": lambda v: C.ring_reduce_scatter(v, RANK_AXIS)}.get(algo)
-        elif op == "allgather":
-            fn = {"fused": lambda v: C.fused_allgather(v, fused_axes).reshape(-1),
-                  "ring": lambda v: C.ring_allgather(v, RANK_AXIS).reshape(-1),
-                  "pallas_ring": lambda v: _pallas().pallas_ring_allgather(
-                      v, RANK_AXIS).reshape(-1)}.get(algo)
-        elif op == "alltoall":
-            # "ring" here selects the rotation schedule — the ring-family
-            # alltoall (n-1 shifted ppermute steps); "bruck" the log-step one.
-            fn = {"fused": lambda v: C.fused_alltoall(v, fused_axes),
-                  "ring": lambda v: C.rotation_alltoall(v, RANK_AXIS),
-                  "bruck": lambda v: C.bruck_alltoall(v, RANK_AXIS)}.get(algo)
-        else:
-            raise ValueError(f"unknown op {op!r}")
-        if fn is None:
+        schedule = SCHEDULES[op].get(algo)
+        if schedule is None:
             raise ValueError(f"op {op!r} has no {algo!r} schedule")
+        fn = lambda v: schedule(v, fused_axes)
 
         spec = self._spec()
         # check_vma off for the pallas data plane: pallas_call outputs carry

@@ -1,0 +1,388 @@
+"""The net-plugin vtable (component C8's plugin face; SURVEY.md §0, §2).
+
+The reference exposes its transport through RCCL's external-network-plugin
+ABI — an ``ncclNet_t``-compatible vtable: ``init / devices / getProperties /
+listen / connect / accept / regMr / isend / irecv / test / close`` — so the
+collective library can ride any wire that implements those verbs. This
+module rebuilds that surface TPU-natively, with the same two-plane split the
+reference had (NIC verbs under GPU collectives):
+
+- :class:`HostQPNet` — the *host/control plane*: the vtable over the native
+  shared-memory queue pairs (``rocnrdma_tpu.native``, the ``ibv_*``
+  analogue). Cross-process, byte-oriented, tag-matched. The gloo-analogue
+  host collectives (:func:`ring_allreduce_over_net`) ride exactly these
+  verbs, the way RCCL rides the plugin.
+- :class:`DeviceMeshNet` — the *device data plane*: the same vtable shape
+  over mesh point-to-point (``lax.ppermute`` with a single (src, dst) pair
+  under ``shard_map``). ``regMr`` is device placement (the
+  ``hipMemRegister`` analogue: a buffer becomes transferable by being laid
+  out on the mesh), ``isend``/``irecv`` dispatch the jitted copy, ``test``
+  is JAX's async-dispatch completion probe.
+
+SPMD caveat, stated rather than hidden: on the device plane a "send" and its
+matching "recv" are one collective program — both calls return the same
+in-flight transfer, and the payloads are arrays, not bytes. The two planes
+therefore share the vtable's *shape* (same verbs, same Request/completion
+discipline), not interchangeability: byte-oriented callers like
+:func:`ring_allreduce_over_net` require a plane whose
+``get_properties().byte_oriented`` is True, exactly as rccl-net callers
+branch on ``ncclNetProperties_t``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import uuid
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class NetProperties:
+    """``getProperties`` result (the ``ncclNetProperties_t`` analogue)."""
+
+    name: str
+    plane: str            # "host" | "device"
+    max_comms: int
+    max_inflight: int     # queued WRs per comm before backpressure
+    byte_oriented: bool   # host plane moves bytes; device plane moves arrays
+
+
+@dataclasses.dataclass
+class Request:
+    """An in-flight isend/irecv (the ``ncclNet`` request handle)."""
+
+    _test: object              # () -> (done, size)
+    done: bool = False
+    size: int = 0
+    payload: object = None     # completed irecv: bytes (host) / array (device)
+
+    def test(self):
+        if not self.done:
+            self.done, self.size, self.payload = self._test()
+        return self.done, self.size
+
+    def wait(self, timeout_s: float = 10.0):
+        import time
+        deadline = time.monotonic() + timeout_s
+        while not self.test()[0]:
+            if time.monotonic() >= deadline:
+                raise TimeoutError("net request timed out")
+            time.sleep(0.0005)
+        return self.payload
+
+
+# ---------------------------------------------------------------------------
+# Host plane: the vtable over native shared-memory queue pairs
+# ---------------------------------------------------------------------------
+
+
+class _HostComm:
+    """One connected endpoint; tag-matched messages over one QP."""
+
+    def __init__(self, qp):
+        self.qp = qp
+        self._unexpected: dict[int, list[bytes]] = {}  # tag -> payloads
+        self._posted = 0  # receive buffers posted but not yet completed
+
+    def _pump(self):
+        # drain the wire; stash every arrived message by tag
+        if self._posted < 4:
+            self.qp.post_recv(1 << 16)
+            self._posted += 1
+        got = False
+        for c, payload in self.qp.poll_cq():
+            from rocnrdma_tpu import native
+            if c.opcode == native.OP_RECV:
+                self._posted -= 1
+                if c.status != native.OK:
+                    raise OSError("host net: truncated message (>64 KiB frame)")
+                tag = int.from_bytes(payload[:4], "little")
+                self._unexpected.setdefault(tag, []).append(payload[4:])
+                got = True
+        return got
+
+    def close(self):
+        self.qp.close()
+
+
+class HostQPNet:
+    """``ncclNet_t``-shaped vtable over the native QP library (host plane).
+
+    One "device" (dev index 0): the shared-memory "NIC". Handles returned by
+    :meth:`listen` are plain strings, exchangeable over any out-of-band
+    channel (env, pipe, file) — the analogue of the OOB handle exchange the
+    reference does during plugin bootstrap.
+    """
+
+    MAX_FRAME = (1 << 16) - 4  # one message per 64 KiB recv buffer, minus tag
+
+    def __init__(self):
+        self._inited = False
+        self._comms: list[_HostComm] = []
+
+    # -- vtable ------------------------------------------------------------
+
+    def init(self) -> None:
+        from rocnrdma_tpu import native
+        if not native.available():
+            raise OSError("native rqp library unavailable (no g++?)")
+        self._inited = True
+
+    def devices(self) -> int:
+        return 1
+
+    def get_properties(self, dev: int = 0) -> NetProperties:
+        return NetProperties(name="shm-qp", plane="host", max_comms=1 << 16,
+                             max_inflight=1 << 10, byte_oriented=True)
+
+    def listen(self, dev: int = 0, capacity: int = 1 << 20):
+        """-> (handle, listen_comm). Give ``handle`` to the connecting peer."""
+        from rocnrdma_tpu import native
+        assert self._inited, "call init() first"
+        handle = f"/rqp_{uuid.uuid4().hex[:16]}"
+        qp = native.QueuePair.listen(handle, capacity)
+        return handle, qp
+
+    def connect(self, dev: int, handle: str, timeout_s: float = 10.0) -> _HostComm:
+        from rocnrdma_tpu import native
+        assert self._inited, "call init() first"
+        comm = _HostComm(native.QueuePair.connect(handle, timeout_s))
+        comm.qp.accept(timeout_s)
+        self._comms.append(comm)
+        return comm
+
+    def accept(self, listen_qp, timeout_s: float = 10.0) -> _HostComm:
+        listen_qp.accept(timeout_s)
+        comm = _HostComm(listen_qp)
+        self._comms.append(comm)
+        return comm
+
+    def reg_mr(self, comm: _HostComm, buffer) -> memoryview:
+        """Register ``buffer`` (bytes/bytearray/ndarray) for transfer."""
+        view = memoryview(buffer).cast("B")
+        if len(view) > self.MAX_FRAME:
+            raise ValueError(
+                f"host net frame limit is {self.MAX_FRAME} B, got {len(view)}; "
+                f"chunk at the caller (the collectives do)")
+        return view
+
+    def isend(self, comm: _HostComm, mr: memoryview, tag: int = 0,
+              timeout_s: float = 10.0, progress=None) -> Request:
+        """Queue ``mr`` on ``comm``. ``progress`` is the verbs progress-engine
+        hook: while the send ring backpressures, the caller's other comms
+        must keep draining (data inbound to THIS rank arrives on a different
+        QP than the one we are stuffing), or two mutually-sending ranks
+        deadlock. Collectives pass the recv comm's pump here.
+        """
+        import time
+        data = tag.to_bytes(4, "little") + bytes(mr)
+        deadline = time.monotonic() + timeout_s
+        while comm.qp.post_send(data) < 0:
+            comm._pump()
+            if progress is not None:
+                progress()
+            if time.monotonic() >= deadline:
+                raise TimeoutError("host net: send ring full, peer stalled")
+            time.sleep(0.0002)
+        # drain our own CQ so send completions don't pile up in the native
+        # deque over a long-lived comm (poll is the only thing that frees them)
+        comm._pump()
+        size = len(mr)
+        return Request(_test=lambda: (True, size, None))
+
+    def irecv(self, comm: _HostComm, nbytes: int, tag: int = 0) -> Request:
+        def probe():
+            ready = comm._unexpected.get(tag)
+            if not ready:
+                comm._pump()
+                ready = comm._unexpected.get(tag)
+            if ready:
+                payload = ready.pop(0)
+                return True, len(payload), payload
+            return False, 0, None
+        return Request(_test=probe)
+
+    def close_comm(self, comm: _HostComm) -> None:
+        comm.close()
+
+    def close(self) -> None:
+        for c in self._comms:
+            c.close()
+        self._comms.clear()
+
+
+# ---------------------------------------------------------------------------
+# Device plane: the vtable over mesh point-to-point
+# ---------------------------------------------------------------------------
+
+
+class DeviceMeshNet:
+    """The vtable shape over single-pair ``lax.ppermute`` on a 1-D mesh.
+
+    ``listen``/``connect``/``accept`` reduce to naming a (src, dst) rank
+    pair — the mesh is the fabric, already "connected" by XLA. ``reg_mr``
+    places the buffer on the mesh (rows = ranks). One isend/irecv pair is
+    one jitted SPMD copy: rank ``src``'s row lands in rank ``dst``'s row of
+    the output; every other row is zero.
+    """
+
+    def __init__(self, mesh=None):
+        from rocnrdma_tpu.runtime.mesh import RANK_AXIS, rank_mesh
+        self.mesh = mesh if mesh is not None else rank_mesh()
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError("DeviceMeshNet runs on a 1-D rank mesh")
+        self.axis = self.mesh.axis_names[0]
+        self.n_ranks = int(np.prod(self.mesh.devices.shape))
+        self._p2p_cache = {}
+        self._inited = False
+
+    def init(self) -> None:
+        self._inited = True
+
+    def devices(self) -> int:
+        return self.n_ranks
+
+    def get_properties(self, dev: int = 0) -> NetProperties:
+        return NetProperties(name=f"mesh-p2p[{dev}]", plane="device",
+                             max_comms=self.n_ranks * (self.n_ranks - 1),
+                             max_inflight=1, byte_oriented=False)
+
+    def listen(self, dev: int):
+        """-> (handle, listen_comm): the handle names the receiving rank."""
+        assert self._inited, "call init() first"
+        return f"rank:{dev}", dev
+
+    def connect(self, dev: int, handle: str):
+        """-> send_comm: the (src, dst) pair this comm will copy over."""
+        assert self._inited, "call init() first"
+        dst = int(handle.split(":", 1)[1])
+        return (dev, dst)
+
+    def accept(self, listen_comm: int):
+        return listen_comm
+
+    def reg_mr(self, comm, array):
+        """Lay the buffer out on the mesh: (n_ranks, ...) one row per rank."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if array.shape[0] != self.n_ranks:
+            raise ValueError(
+                f"leading dim must be n_ranks={self.n_ranks}, got {array.shape}")
+        return jax.device_put(array, NamedSharding(self.mesh, P(self.axis)))
+
+    def _p2p(self, src: int, dst: int):
+        key = (src, dst)
+        if key not in self._p2p_cache:
+            import jax
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            axis = self.axis
+
+            def shift(x):
+                return lax.ppermute(x, axis, [(src, dst)])
+
+            self._p2p_cache[key] = jax.jit(jax.shard_map(
+                shift, mesh=self.mesh, in_specs=P(axis), out_specs=P(axis)))
+        return self._p2p_cache[key]
+
+    def isend(self, send_comm, mr, tag: int = 0, timeout_s: float = 10.0,
+              progress=None) -> Request:
+        # timeout_s/progress accepted for signature parity with the host
+        # plane; XLA owns dispatch, so there is no backpressure to pump
+        src, dst = send_comm
+        out = self._p2p(src, dst)(mr)
+        return self._request(out)
+
+    def irecv(self, recv_comm, in_flight: Request, tag: int = 0) -> Request:
+        # SPMD: the transfer was dispatched by isend; recv observes it.
+        return in_flight
+
+    def _request(self, arr) -> Request:
+        def probe():
+            ready = arr.is_ready() if hasattr(arr, "is_ready") else True
+            if not ready:
+                return False, 0, None
+            return True, arr.nbytes, arr
+        return Request(_test=probe)
+
+    def test(self, req: Request):
+        return req.test()
+
+    def close(self) -> None:
+        self._p2p_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# A collective riding the vtable (the way RCCL rides the net plugin)
+# ---------------------------------------------------------------------------
+
+
+def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
+                            rank: int, n_ranks: int) -> np.ndarray:
+    """Host-plane ring allreduce built ONLY from the vtable verbs.
+
+    ``send_comm`` reaches rank ``(rank+1) % n``, ``recv_comm`` hears rank
+    ``(rank-1) % n``. Classic two-phase schedule — (n-1) reduce-scatter steps
+    then (n-1) allgather steps over the ring — with every hop an
+    ``isend``/``irecv`` pair, chunked to the plugin's frame limit. This is
+    the proof the vtable carries collectives, and doubles as the
+    cross-process gloo-analogue oracle path.
+    """
+    x = np.array(local, dtype=np.float32, copy=True).ravel()
+    n = n_ranks
+    if n == 1:
+        return x.reshape(np.shape(local))
+    bounds = [len(x) * i // n for i in range(n + 1)]
+    chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
+    frame = getattr(net, "MAX_FRAME", (1 << 16) - 4) // 4  # fp32 elems
+
+    steps = itertools.count(1)
+
+    def exchange(out_piece: np.ndarray, in_len: int) -> np.ndarray:
+        """One ring hop: send my piece right, receive peer's from the left.
+
+        With uneven chunking the outgoing and incoming pieces can differ in
+        length, so each direction is framed independently; tags are
+        (step, frame-index) pairs, identical on both ends because every rank
+        executes the same step sequence.
+        """
+        step = next(steps)
+        n_frames = max(-(-in_len // frame), -(-len(out_piece) // frame))
+        assert n_frames < (1 << 16), (
+            f"{n_frames} frames in one hop overflows the 16-bit frame-index "
+            f"tag field (piece > ~4 GB); widen the tag packing first")
+        tag = lambda fi: (step << 16) | fi
+        got = np.empty(in_len, np.float32)
+        # queue all chunked irecvs, then the isends, then drain — the plugin
+        # pumps receives while a send backpressures, so no deadlock
+        reqs = []
+        for fi, off in enumerate(range(0, in_len, frame)):
+            nb = min(frame, in_len - off) * 4
+            reqs.append((off, nb, net.irecv(recv_comm, nb, tag=tag(fi))))
+        # progress engine: while our send ring is full, keep draining the
+        # comm our inbound data arrives on, or two mutually-sending ranks
+        # stall each other
+        pump = getattr(recv_comm, "_pump", None)
+        for fi, off in enumerate(range(0, len(out_piece), frame)):
+            seg = np.ascontiguousarray(out_piece[off:off + frame])
+            net.isend(send_comm, net.reg_mr(send_comm, seg), tag=tag(fi),
+                      progress=pump)
+        for off, nb, r in reqs:
+            payload = r.wait()
+            got[off:off + nb // 4] = np.frombuffer(payload, np.float32)
+        return got
+
+    # reduce-scatter: after step k, chunk (rank - k) holds partial sums
+    for k in range(n - 1):
+        send_i, recv_i = rank - k, rank - k - 1
+        incoming = exchange(chunk(send_i), len(chunk(recv_i)))
+        chunk(recv_i)[:] += incoming
+    # allgather: circulate the fully-reduced chunks
+    for k in range(n - 1):
+        send_i, recv_i = rank + 1 - k, rank - k
+        incoming = exchange(chunk(send_i), len(chunk(recv_i)))
+        chunk(recv_i)[:] = incoming
+    return x.reshape(np.shape(local))

@@ -133,3 +133,13 @@ def test_bruck_filtered_for_allreduce(tmp_path):
           "--repeats", "2", "--iters", "2", "--out", str(out)])
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert {r["algo"] for r in rows} == {"fused"}
+
+
+def test_unknown_algo_rejected_not_filtered():
+    # regression: a typo'd algo must error out, NOT be silently dropped by
+    # the compatibility filter with a fallback to fused
+    with pytest.raises(ValueError, match="unknown algo"):
+        runner.algos_for("allreduce", ("bogus",), is_2d=False)
+    with pytest.raises(ValueError, match="unknown algo"):
+        _run(bench_allreduce.main,
+             ["--ranks", "2", "--sizes", "4K", "--algos", "bogus"])

@@ -1,0 +1,163 @@
+"""Native shared-memory queue-pair library (the ibv_* analogue, L1).
+
+Unit tier of SURVEY.md §4 for the host control plane: no jax devices at all —
+these tests exercise the C++ library's verbs contract (listen / connect /
+accept / post_send / post_recv / poll_cq), wrap-around framing, backpressure,
+truncation reporting, and a real two-process exchange.
+"""
+
+import os
+import subprocess
+import sys
+import uuid
+
+import pytest
+
+from rocnrdma_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native rqp library not buildable")
+
+
+def _name():
+    return f"/rqp_t_{uuid.uuid4().hex[:12]}"
+
+
+@pytest.fixture
+def pair():
+    name = _name()
+    a = native.QueuePair.listen(name, 1 << 16)
+    b = native.QueuePair.connect(name)
+    a.accept(); b.accept()
+    yield a, b
+    a.close(); b.close()
+
+
+def test_send_recv_roundtrip(pair):
+    a, b = pair
+    a.send(b"ping")
+    assert b.recv() == b"ping"
+    b.send(b"pong" * 1000)
+    assert a.recv() == b"pong" * 1000
+
+
+def test_empty_message(pair):
+    a, b = pair
+    a.send(b"")
+    assert b.recv() == b""
+
+
+def test_completion_queue_contract(pair):
+    a, b = pair
+    wr_send = a.post_send(b"x" * 100)
+    assert wr_send > 0
+    cqes = a.poll_cq()
+    send_c = [c for c, _ in cqes if c.opcode == native.OP_SEND]
+    assert [c.wr_id for c in send_c] == [wr_send]
+    assert send_c[0].status == native.OK and send_c[0].length == 100
+
+    wr_recv = b.post_recv(256)
+    cqes = b.poll_cq()
+    recv_c = [(c, p) for c, p in cqes if c.opcode == native.OP_RECV]
+    assert len(recv_c) == 1
+    c, payload = recv_c[0]
+    assert c.wr_id == wr_recv and c.length == 100 and payload == b"x" * 100
+
+
+def test_fifo_order_many_messages(pair):
+    a, b = pair
+    msgs = [bytes([i % 251]) * (i % 97) for i in range(300)]
+    for m in msgs:
+        a.send(m)
+        assert b.recv() == m  # drain as we go (ring smaller than total bytes)
+
+
+def test_wraparound_small_ring():
+    name = _name()
+    a = native.QueuePair.listen(name, 256)
+    b = native.QueuePair.connect(name)
+    for i in range(500):
+        m = bytes([i % 256]) * (i % 60)
+        a.send(m)
+        assert b.recv() == m, f"iteration {i}"
+    a.close(); b.close()
+
+
+def test_backpressure_full_ring():
+    name = _name()
+    a = native.QueuePair.listen(name, 256)
+    b = native.QueuePair.connect(name)
+    sent = 0
+    while a.post_send(b"z" * 64) >= 0:
+        sent += 1
+        assert sent < 100, "ring never filled"
+    assert sent >= 1
+    # draining on the receive side frees the ring again
+    assert b.recv() == b"z" * 64
+    assert a.post_send(b"w" * 64) >= 0
+    a.close(); b.close()
+
+
+def test_truncation_reported(pair):
+    a, b = pair
+    b.post_recv(8)
+    a.send(b"0123456789abcdef")
+    deadline = 200
+    while deadline:
+        cqes = b.poll_cq()
+        rc = [c for c, _ in cqes if c.opcode == native.OP_RECV]
+        if rc:
+            assert rc[0].status == native.ERR_TRUNC
+            assert rc[0].length == 8
+            return
+        deadline -= 1
+    pytest.fail("truncated recv never completed")
+
+
+def test_connect_timeout():
+    with pytest.raises(OSError):
+        native.QueuePair.connect(_name(), timeout_s=0.05)
+
+
+def test_listen_name_collision():
+    name = _name()
+    a = native.QueuePair.listen(name)
+    # second listen replaces the stale segment (fresh-run semantics)
+    b = native.QueuePair.listen(name)
+    c = native.QueuePair.connect(name)
+    b.send(b"fresh")
+    assert c.recv() == b"fresh"
+    a.close(); b.close(); c.close()
+
+
+_CHILD = r"""
+import sys
+from rocnrdma_tpu import native
+qp = native.QueuePair.connect(sys.argv[1], timeout_s=15)
+qp.accept(timeout_s=15)
+n = int(qp.recv(timeout_s=15).decode())
+for i in range(n):
+    msg = qp.recv(timeout_s=15)
+    qp.send(msg[::-1])
+qp.close()
+"""
+
+
+def test_two_process_exchange():
+    """A real cross-process exchange: child reverses every message."""
+    name = _name()
+    qp = native.QueuePair.listen(name, 1 << 16)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen([sys.executable, "-c", _CHILD, name],
+                             stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        qp.accept(timeout_s=15)
+        msgs = [f"message-{i}".encode() * (i + 1) for i in range(50)]
+        qp.send(str(len(msgs)).encode())
+        for m in msgs:
+            qp.send(m)
+            assert qp.recv(timeout_s=15) == m[::-1]
+        assert child.wait(timeout=15) == 0, child.stderr.read()
+    finally:
+        child.kill()
+        qp.close()

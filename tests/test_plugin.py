@@ -1,0 +1,272 @@
+"""Net-plugin vtable (transport/plugin.py — the rccl-net surface analogue).
+
+Covers both planes of SURVEY.md §2 C8: the host plane (vtable over native
+shm queue pairs; tag matching; a ring allreduce riding ONLY the verbs, the
+way RCCL rides the net plugin) and the device plane (vtable over mesh
+point-to-point on the 8-fake-device oracle backend).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.transport import (
+    DeviceMeshNet,
+    HostQPNet,
+    ring_allreduce_over_net,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native rqp library not buildable")
+
+
+# ---------------------------------------------------------------------------
+# host plane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def host_pair():
+    net = HostQPNet()
+    net.init()
+    handle, listen_qp = net.listen()
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault("send", net.connect(0, handle)))
+    t.start()
+    recv_comm = net.accept(listen_qp)
+    t.join(timeout=10)
+    yield net, out["send"], recv_comm
+    net.close()
+
+
+@needs_native
+def test_host_properties():
+    net = HostQPNet()
+    net.init()
+    assert net.devices() == 1
+    props = net.get_properties(0)
+    assert props.plane == "host" and props.byte_oriented
+    net.close()
+
+
+@needs_native
+def test_host_isend_irecv_roundtrip(host_pair):
+    net, send_comm, recv_comm = host_pair
+    payload = np.arange(1000, dtype=np.float32)
+    req = net.irecv(recv_comm, payload.nbytes, tag=7)
+    net.isend(send_comm, net.reg_mr(send_comm, payload), tag=7)
+    got = np.frombuffer(req.wait(), np.float32)
+    np.testing.assert_array_equal(got, payload)
+
+
+@needs_native
+def test_host_tag_matching_out_of_order(host_pair):
+    net, send_comm, recv_comm = host_pair
+    # send tags 1,2,3 but receive 3 first: matching must be by tag, not FIFO
+    for tag in (1, 2, 3):
+        net.isend(send_comm, net.reg_mr(send_comm, bytes([tag]) * 8), tag=tag)
+    r3 = net.irecv(recv_comm, 8, tag=3)
+    assert r3.wait() == bytes([3]) * 8
+    r1 = net.irecv(recv_comm, 8, tag=1)
+    r2 = net.irecv(recv_comm, 8, tag=2)
+    assert r1.wait() == bytes([1]) * 8 and r2.wait() == bytes([2]) * 8
+
+
+@needs_native
+def test_host_frame_limit_enforced(host_pair):
+    net, send_comm, _ = host_pair
+    with pytest.raises(ValueError, match="frame limit"):
+        net.reg_mr(send_comm, bytes(net.MAX_FRAME + 1))
+
+
+@needs_native
+def test_host_test_polls_without_blocking(host_pair):
+    net, send_comm, recv_comm = host_pair
+    req = net.irecv(recv_comm, 16, tag=9)
+    done, _ = req.test()
+    assert not done  # nothing sent yet
+    net.isend(send_comm, net.reg_mr(send_comm, b"a" * 16), tag=9)
+    assert req.wait() == b"a" * 16
+
+
+@needs_native
+def test_host_isend_drains_own_completions(host_pair):
+    """Send completions must not pile up in the native CQ deque across a
+    long-lived comm: isend drains them as it goes."""
+    net, send_comm, recv_comm = host_pair
+    for i in range(200):
+        net.isend(send_comm, net.reg_mr(send_comm, b"m" * 64), tag=i)
+    # everything was drained in-line; at most one poll's worth can remain
+    leftover = [c for c, _ in send_comm.qp.poll_cq(max_cqes=256)
+                if c.opcode == native.OP_SEND]
+    assert len(leftover) <= 16
+
+
+@needs_native
+def test_recv_timeout_retry_reuses_posted_buffer():
+    """recv() after a timeout must not leak one 64 KiB buffer per attempt."""
+    name = f"/rqp_retry_{id(object()):x}"
+    a = native.QueuePair.listen(name, 1 << 16)
+    b = native.QueuePair.connect(name)
+    for _ in range(5):
+        with pytest.raises(TimeoutError):
+            b.recv(timeout_s=0.02)
+    assert len(b._recv_bufs) == 1  # one outstanding WR, not five
+    a.send(b"finally")
+    assert b.recv() == b"finally"
+    assert len(b._recv_bufs) == 0
+    a.close(); b.close()
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "n_ranks,size",
+    # 700k fp32 → per-hop chunks of ~1.4 MB, larger than the 1 MiB QP ring:
+    # exercises the backpressure/progress-engine path end to end (a chunk
+    # can only cross the wire in multiple ring-fulls of frames)
+    [(2, 64), (3, 1000), (4, 100000), (2, 700000)])
+def test_ring_allreduce_over_net(n_ranks, size):
+    """The collective built purely from vtable verbs, across n_ranks threads
+    (each thread = one 'process' with its own send/recv comms)."""
+    net = HostQPNet()
+    net.init()
+    # ring wiring: rank r sends to r+1; r listens for r-1
+    handles = []
+    listens = []
+    for r in range(n_ranks):
+        h, lq = net.listen()
+        handles.append(h)
+        listens.append(lq)
+
+    rng = np.random.default_rng(42)
+    inputs = [rng.standard_normal(size).astype(np.float32)
+              for _ in range(n_ranks)]
+    want = np.sum(inputs, axis=0)
+    results: list = [None] * n_ranks
+    errors: list = []
+
+    def worker(rank):
+        try:
+            send_comm = net.connect(0, handles[(rank + 1) % n_ranks])
+            recv_comm = net.accept(listens[rank])
+            results[rank] = ring_allreduce_over_net(
+                net, send_comm, recv_comm, inputs[rank], rank, n_ranks)
+        except Exception as e:  # surface into the main thread
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(n_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    for r in range(n_ranks):
+        np.testing.assert_allclose(results[r], want, rtol=1e-5, atol=1e-5)
+    net.close()
+
+
+_RING_WORKER = r"""
+import sys
+import numpy as np
+from rocnrdma_tpu.transport import HostQPNet, ring_allreduce_over_net
+from rocnrdma_tpu import native
+
+job, rank, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+net = HostQPNet()
+net.init()
+# OOB handle exchange by deterministic name: rank r listens on its own
+# handle, connects to rank (r+1)'s — the bootstrap the reference does over
+# its out-of-band channel during plugin setup.
+my_handle = f"/rqp_{job}_{rank}"
+listen_qp = native.QueuePair.listen(my_handle, 1 << 20)
+send_comm = net.connect(0, f"/rqp_{job}_{(rank + 1) % n}", timeout_s=20)
+recv_comm = net.accept(listen_qp, timeout_s=20)
+
+local = np.random.default_rng(100 + rank).standard_normal(50000).astype(np.float32)
+got = ring_allreduce_over_net(net, send_comm, recv_comm, local, rank, n)
+want = np.sum([np.random.default_rng(100 + r).standard_normal(50000).astype(np.float32)
+               for r in range(n)], axis=0)
+np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+net.close()
+print(f"rank {rank} OK", flush=True)
+"""
+
+
+@needs_native
+def test_ring_allreduce_over_net_processes():
+    """The same vtable-borne collective with every rank its own OS process."""
+    import os
+    import subprocess
+    import sys
+    import uuid
+
+    n = 3
+    job = uuid.uuid4().hex[:10]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _RING_WORKER, job, str(r), str(n)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for r in range(n)]
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=90)
+        assert p.returncode == 0, f"rank {r} failed:\n{err}"
+        assert f"rank {r} OK" in out
+
+
+# ---------------------------------------------------------------------------
+# device plane (8-fake-device oracle backend from conftest)
+# ---------------------------------------------------------------------------
+
+
+def test_device_properties(devices):
+    net = DeviceMeshNet()
+    net.init()
+    assert net.devices() >= 8
+    assert net.get_properties(0).plane == "device"
+
+
+def test_device_p2p_copy(devices):
+    """isend/irecv moves rank 2's row into rank 5's row; others zero."""
+    net = DeviceMeshNet()
+    net.init()
+    n = net.n_ranks
+    handle, listen_comm = net.listen(5)
+    send_comm = net.connect(2, handle)
+    recv_comm = net.accept(listen_comm)
+    assert recv_comm == 5 and send_comm == (2, 5)
+
+    x = np.arange(n * 16, dtype=np.float32).reshape(n, 16)
+    mr = net.reg_mr(send_comm, x)
+    req = net.isend(send_comm, mr)
+    req2 = net.irecv(recv_comm, req)
+    out = np.asarray(req2.wait())
+    np.testing.assert_array_equal(out[5], x[2])
+    for r in range(n):
+        if r != 5:
+            assert not out[r].any()
+
+
+def test_device_reg_mr_shape_contract(devices):
+    net = DeviceMeshNet()
+    net.init()
+    with pytest.raises(ValueError, match="leading dim"):
+        net.reg_mr((0, 1), np.zeros((3, 4), np.float32))
+
+
+def test_device_p2p_chain(devices):
+    """Relay a buffer 0→1→2→3 through successive p2p copies."""
+    net = DeviceMeshNet()
+    net.init()
+    n = net.n_ranks
+    x = np.zeros((n, 8), np.float32)
+    x[0] = np.arange(8)
+    buf = net.reg_mr((0, 1), x)
+    for src in range(3):
+        send_comm = net.connect(src, f"rank:{src + 1}")
+        buf = net.isend(send_comm, buf).wait()
+    out = np.asarray(buf)
+    np.testing.assert_array_equal(out[3], np.arange(8, dtype=np.float32))
