@@ -25,9 +25,20 @@ from rocnrdma_tpu.collectives.alltoall import (  # noqa: F401
     rotation_alltoall,
 )
 from rocnrdma_tpu.collectives.hierarchical import hierarchical_allreduce  # noqa: F401
+from rocnrdma_tpu.collectives.rooted import (  # noqa: F401
+    binomial_broadcast,
+    binomial_gather,
+    binomial_reduce,
+    binomial_scatter,
+)
+from rocnrdma_tpu.collectives.reduce_op import REDUCE_OPS  # noqa: F401
 from rocnrdma_tpu.collectives.fused import (  # noqa: F401
     fused_allgather,
     fused_allreduce,
     fused_alltoall,
+    fused_broadcast,
+    fused_gather,
     fused_reduce_scatter,
+    fused_rooted_reduce,
+    fused_scatter,
 )

@@ -10,30 +10,41 @@ schedules are benchmarked against.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
-
-def fused_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
-    return lax.psum(x, axis_name)
+from rocnrdma_tpu.collectives.reduce_op import axis_total, finalize, fused_reduce
 
 
-def _total_size(axis_name) -> int:
+def fused_allreduce(x: jax.Array, axis_name: str, op: str = "sum") -> jax.Array:
+    return fused_reduce(x, axis_name, op=op)
+
+
+def global_rank(axis_name):
+    """Traced linear rank over a single axis or an axis tuple (row-major)."""
     if isinstance(axis_name, (tuple, list)):
-        n = 1
-        for a in axis_name:
-            n *= lax.axis_size(a)
-        return n
-    return lax.axis_size(axis_name)
+        r = lax.axis_index(axis_name[0])
+        for a in axis_name[1:]:
+            r = r * lax.axis_size(a) + lax.axis_index(a)
+        return r
+    return lax.axis_index(axis_name)
 
 
-def fused_reduce_scatter(x: jax.Array, axis_name) -> jax.Array:
+def fused_reduce_scatter(x: jax.Array, axis_name, op: str = "sum") -> jax.Array:
     """Rank r gets the reduced r-th 1/n of x (flattened), like ring_reduce_scatter."""
-    n = _total_size(axis_name)
+    n = axis_total(axis_name)
     flat = x.reshape(-1)
     if flat.size % n:
         raise ValueError(f"reduce_scatter buffer ({flat.size}) must divide by {n}")
-    return lax.psum_scatter(flat.reshape(n, -1), axis_name, scatter_dimension=0,
-                            tiled=False)
+    buf = flat.reshape(n, -1)
+    if op in ("sum", "avg"):
+        out = lax.psum_scatter(buf, axis_name, scatter_dimension=0, tiled=False)
+        return finalize(out, op, n)
+    # XLA's scatter-reduce collective is sum-only: reduce the whole buffer,
+    # then keep the local shard (bandwidth cost documented in reduce_op).
+    out = fused_reduce(buf, axis_name, op=op)
+    return lax.dynamic_index_in_dim(out, global_rank(axis_name), axis=0,
+                                    keepdims=False)
 
 
 def fused_allgather(x: jax.Array, axis_name: str) -> jax.Array:
@@ -44,3 +55,49 @@ def fused_allgather(x: jax.Array, axis_name: str) -> jax.Array:
 def fused_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
     """Global transpose over leading dim n, like rotation_alltoall."""
     return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+
+
+# ---------------------------------------------------------------------------
+# Rooted collectives (the RCCL broadcast/reduce/gather/scatter surface).
+# SPMD convention: ``root`` is a static Python int; off-root outputs are
+# zeroed so results are deterministic (RCCL leaves them undefined).
+
+
+def _is_root(axis_name, root: int):
+    return global_rank(axis_name) == root
+
+
+def fused_broadcast(x: jax.Array, axis_name, root: int = 0) -> jax.Array:
+    """Every rank ends with root's ``x``. Lowered as a masked psum — the
+    standard one-op XLA spelling of broadcast (zeros everywhere but root)."""
+    return lax.psum(jnp.where(_is_root(axis_name, root), x, 0).astype(x.dtype),
+                    axis_name)
+
+
+def fused_rooted_reduce(x: jax.Array, axis_name, root: int = 0,
+                        op: str = "sum") -> jax.Array:
+    """Root ends with the ``op``-reduction of all ranks' ``x``; others zeros."""
+    y = fused_reduce(x, axis_name, op=op)
+    return jnp.where(_is_root(axis_name, root), y, 0).astype(x.dtype)
+
+
+def fused_gather(x: jax.Array, axis_name, root: int = 0) -> jax.Array:
+    """Root ends with (n, *x.shape), row i = rank i's ``x``; others zeros."""
+    g = x
+    axes = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    for a in reversed(axes):
+        g = lax.all_gather(g, a, axis=0, tiled=False)
+    g = g.reshape((axis_total(axis_name),) + x.shape)
+    return jnp.where(_is_root(axis_name, root), g, 0).astype(x.dtype)
+
+
+def fused_scatter(x: jax.Array, axis_name, root: int = 0) -> jax.Array:
+    """Root's ``x`` (flattening to n·c) is split n ways; rank r gets chunk r."""
+    n = axis_total(axis_name)
+    flat = x.reshape(-1)
+    if flat.size % n:
+        raise ValueError(f"scatter buffer ({flat.size}) must divide by {n}")
+    buf = jnp.where(_is_root(axis_name, root), flat, 0).astype(x.dtype)
+    full = lax.psum(buf.reshape(n, -1), axis_name)
+    return lax.dynamic_index_in_dim(full, global_rank(axis_name), axis=0,
+                                    keepdims=False)

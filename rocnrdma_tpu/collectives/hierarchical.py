@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from rocnrdma_tpu.collectives.reduce_op import finalize, fused_reduce
 from rocnrdma_tpu.collectives.ring import (
     ring_allgather,
     ring_allreduce,
@@ -28,23 +29,29 @@ from rocnrdma_tpu.collectives.ring import (
 
 def hierarchical_allreduce(x: jax.Array, *, intra_axis: str = "intra",
                            slice_axis: str = "slice",
-                           cross_algo: str = "ring") -> jax.Array:
+                           cross_algo: str = "ring",
+                           op: str = "sum") -> jax.Array:
     """Allreduce over both mesh axes, ICI-heavy / DCN-light.
 
     ``cross_algo``: "ring" (explicit) or "fused" (``lax.psum``) for the
     cross-slice phase — DCN hops are latency-dominated, so the fused
     collective is usually right there even when the ICI phases are explicit.
+
+    ``op``: sum/prod/max/min/avg. ``avg`` runs the two levels as sums and
+    divides once at the end (dividing per level would double-divide).
     """
     n = lax.axis_size(intra_axis)
+    m = lax.axis_size(slice_axis)
+    inner = "sum" if op == "avg" else op  # single finalize at the end
     shape, size = x.shape, x.size
     flat = x.reshape(-1)
     pad = (-size) % n
     flat = jnp.pad(flat, (0, pad))
 
-    shard = ring_reduce_scatter(flat, intra_axis)          # ICI
+    shard = ring_reduce_scatter(flat, intra_axis, op=inner)     # ICI
     if cross_algo == "fused":
-        shard = lax.psum(shard, slice_axis)                # DCN
+        shard = fused_reduce(shard, slice_axis, op=inner)       # DCN
     else:
-        shard = ring_allreduce(shard, slice_axis)          # DCN
-    full = ring_allgather(shard, intra_axis).reshape(-1)   # ICI
-    return full[:size].reshape(shape)
+        shard = ring_allreduce(shard, slice_axis, op=inner)     # DCN
+    full = ring_allgather(shard, intra_axis).reshape(-1)        # ICI
+    return finalize(full[:size].reshape(shape), op, n * m)

@@ -1,0 +1,88 @@
+"""Reduction-operator registry for the allreduce family.
+
+The reference's RCCL surface reduces with ``ncclSum / ncclProd / ncclMax /
+ncclMin / ncclAvg`` (domain knowledge — the reference tree itself is empty,
+SURVEY.md §0); the sum-only collectives here grow the same set. One registry
+so every schedule (ring, tree, hierarchical, binomial reduce) combines
+identically:
+
+- ``combine(a, b)`` — the associative+commutative pairwise step the explicit
+  ``ppermute`` schedules apply. ``avg`` combines as ``sum``; the divide by
+  the axis size happens once, at the end (``finalize``) — dividing per step
+  would be wrong and slower.
+- ``fused(x, axis_name)`` — the one-op XLA lowering. ``sum/max/min`` map to
+  ``lax.psum/pmax/pmin``; XLA has no product collective, so ``prod`` lowers
+  to ``all_gather`` + local product (documented bandwidth cost: n·S instead
+  of 2(n-1)/n·S).
+
+Padding note: the ring/tree schedules pad buffers to a multiple of the axis
+size. Padded elements are reduced like any others and then sliced off, so
+the pad value never reaches a caller — no identity-element bookkeeping is
+needed per op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+REDUCE_OPS = ("sum", "prod", "max", "min", "avg")
+
+_COMBINE = {
+    "sum": jnp.add,
+    "avg": jnp.add,
+    "prod": jnp.multiply,
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+}
+
+
+def axis_total(axis_name) -> int:
+    """Total rank count over a single axis name or an axis tuple."""
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= lax.axis_size(a)
+        return n
+    return lax.axis_size(axis_name)
+
+
+def combine_fn(op: str):
+    """The pairwise combiner the explicit schedules fold with."""
+    try:
+        return _COMBINE[op]
+    except KeyError:
+        raise ValueError(f"unknown reduce op {op!r}; know {REDUCE_OPS}") from None
+
+
+def finalize(x: jax.Array, op: str, n_total: int) -> jax.Array:
+    """Post-schedule fixup: ``avg`` divides the summed result by the total
+    rank count once; every other op is already final."""
+    if op == "avg":
+        return (x / jnp.asarray(n_total, x.dtype)).astype(x.dtype)
+    return x
+
+
+def fused_reduce(x: jax.Array, axis_name, op: str = "sum") -> jax.Array:
+    """One-op XLA allreduce lowering for ``op`` over ``axis_name`` (a single
+    axis name or a tuple spanning a 2-D mesh)."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "avg":
+        n = axis_total(axis_name)
+        y = lax.psum(x, axis_name)
+        return (y / jnp.asarray(n, x.dtype)).astype(x.dtype)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "prod":
+        # XLA exposes no product collective: gather then reduce locally.
+        axes = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+        g = x
+        for a in axes:
+            g = lax.all_gather(g, a, axis=0, tiled=False)
+            g = jnp.prod(g, axis=0)
+        return g
+    raise ValueError(f"unknown reduce op {op!r}; know {REDUCE_OPS}")

@@ -27,6 +27,7 @@ from jax import lax
 
 # The one source of truth for ring step permutations: the jit schedules and
 # the simulator oracle must rotate identically (see schedule.py docstring).
+from rocnrdma_tpu.collectives.reduce_op import combine_fn, finalize
 from rocnrdma_tpu.collectives.schedule import ring_permutation as _ring_perm
 
 
@@ -45,7 +46,7 @@ def _unchunk(buf: jax.Array, size: int, shape: tuple) -> jax.Array:
 
 
 def _rs_phase(buf: jax.Array, axis_name: str, n: int, shift: int,
-              offset: int = 0) -> jax.Array:
+              offset: int = 0, combine=jnp.add) -> jax.Array:
     """Reduce-scatter phase: n-1 rotate-and-accumulate steps.
 
     After the phase, rank r owns the fully-reduced chunk ``(r + d + offset)
@@ -63,7 +64,8 @@ def _rs_phase(buf: jax.Array, axis_name: str, n: int, shift: int,
         recvd = lax.ppermute(chunk, axis_name, perm=perm)
         recv_idx = (r - d * (s + 1) + offset) % n
         mine = lax.dynamic_index_in_dim(buf, recv_idx, axis=0, keepdims=False)
-        return lax.dynamic_update_index_in_dim(buf, mine + recvd, recv_idx, axis=0)
+        return lax.dynamic_update_index_in_dim(buf, combine(mine, recvd),
+                                               recv_idx, axis=0)
 
     return lax.fori_loop(0, n - 1, step, buf)
 
@@ -87,52 +89,54 @@ def _ag_phase(buf: jax.Array, axis_name: str, n: int, shift: int,
     return lax.fori_loop(0, n - 1, step, buf)
 
 
-def ring_allreduce(x: jax.Array, axis_name: str, *, bidir: bool = False) -> jax.Array:
-    """Allreduce (sum) via reduce-scatter + allgather over the ``axis_name`` ring.
+def ring_allreduce(x: jax.Array, axis_name: str, *, bidir: bool = False,
+                   op: str = "sum") -> jax.Array:
+    """Allreduce via reduce-scatter + allgather over the ``axis_name`` ring.
 
-    Every rank ends with the elementwise sum of all ranks' ``x``.
+    Every rank ends with the elementwise ``op``-reduction of all ranks' ``x``
+    (``op`` one of reduce_op.REDUCE_OPS; default sum).
     """
     n = lax.axis_size(axis_name)
     if n == 1:
-        return x
+        return finalize(x, op, 1)
     if not bidir:
         buf, size, shape = _chunked(x, n)
-        buf = _rs_phase(buf, axis_name, n, shift=1)
+        buf = _rs_phase(buf, axis_name, n, shift=1, combine=combine_fn(op))
         buf = _ag_phase(buf, axis_name, n, shift=1, owned_offset=1)
-        return _unchunk(buf, size, shape)
+        return finalize(_unchunk(buf, size, shape), op, n)
 
     # Bidirectional: half the buffer rides the +1 ring, half the -1 ring.
     flat = x.reshape(-1)
     half = flat.size // 2
-    lo = ring_allreduce(flat[:half], axis_name)
-    hi = _bidir_partner(flat[half:], axis_name, n)
+    lo = ring_allreduce(flat[:half], axis_name, op=op)
+    hi = _bidir_partner(flat[half:], axis_name, n, op)
     return jnp.concatenate([lo, hi]).reshape(x.shape)
 
 
-def _bidir_partner(x: jax.Array, axis_name: str, n: int) -> jax.Array:
+def _bidir_partner(x: jax.Array, axis_name: str, n: int, op: str = "sum") -> jax.Array:
     buf, size, shape = _chunked(x, n)
-    buf = _rs_phase(buf, axis_name, n, shift=-1)
+    buf = _rs_phase(buf, axis_name, n, shift=-1, combine=combine_fn(op))
     buf = _ag_phase(buf, axis_name, n, shift=-1, owned_offset=1)
-    return _unchunk(buf, size, shape)
+    return finalize(_unchunk(buf, size, shape), op, n)
 
 
-def ring_reduce_scatter(x: jax.Array, axis_name: str) -> jax.Array:
-    """Reduce-scatter (sum): rank r returns the fully-reduced r-th 1/n of x.
+def ring_reduce_scatter(x: jax.Array, axis_name: str, op: str = "sum") -> jax.Array:
+    """Reduce-scatter: rank r returns the fully-``op``-reduced r-th 1/n of x.
 
     x must flatten to a multiple of the axis size.
     """
     n = lax.axis_size(axis_name)
     if n == 1:
-        return x.reshape(-1)
+        return finalize(x.reshape(-1), op, 1)
     flat = x.reshape(-1)
     if flat.size % n:
         raise ValueError(f"reduce_scatter buffer ({flat.size} elems) must divide by axis size {n}")
     buf = flat.reshape(n, -1)
     # offset=-1: the schedule ends with rank r owning chunk r — the
     # conventional reduce-scatter layout — with no fixup hop.
-    buf = _rs_phase(buf, axis_name, n, shift=1, offset=-1)
+    buf = _rs_phase(buf, axis_name, n, shift=1, offset=-1, combine=combine_fn(op))
     r = lax.axis_index(axis_name)
-    return lax.dynamic_index_in_dim(buf, r, axis=0, keepdims=False)
+    return finalize(lax.dynamic_index_in_dim(buf, r, axis=0, keepdims=False), op, n)
 
 
 def ring_allgather(x: jax.Array, axis_name: str) -> jax.Array:

@@ -1,0 +1,134 @@
+"""Rooted collectives — broadcast / reduce / gather / scatter (binomial).
+
+The reference's RCCL surface carries the rooted verbs (``ncclBroadcast``,
+``ncclReduce``, plus the gather/scatter patterns MPI and torch.distributed
+layer over RCCL p2p); the reference tree itself is empty (SURVEY.md §0), so
+these are rebuilt from the classic binomial-tree algorithms as explicit
+``lax.ppermute`` programs — ceil(log2 n) steps each, the latency-optimal
+family, the rooted counterpart of the halving-doubling allreduce in tree.py.
+
+Axis-level primitives: call INSIDE ``jax.shard_map``. ``root`` is a static
+Python int. Schedules and step pair-lists come from ``schedule.py``
+(``binomial_masks`` / ``bcast_pairs`` / ``gather_pairs``); the ``sim_*``
+functions there are the oracle the device tests compare against.
+
+SPMD conventions:
+
+- Every rank calls with the same shapes; only root's input is read by
+  scatter, and only root's output is meaningful after reduce/gather — we
+  zero the off-root outputs so results are deterministic (RCCL leaves them
+  undefined).
+- gather/scatter keep their buffers in *virtual-rank slot order* (vrank
+  ``(r - root) mod n``), which makes every binomial subtree a contiguous
+  slot range — so each step moves a static-size ``dynamic_slice`` (m slots)
+  instead of a full-buffer message. Slot dims are padded to the next power
+  of two; pad slots carry zeros and are dropped on exit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rocnrdma_tpu.collectives.reduce_op import combine_fn, finalize
+from rocnrdma_tpu.collectives.schedule import (
+    bcast_pairs,
+    binomial_masks,
+    gather_pairs,
+)
+
+
+def _vrank(axis_name: str, n: int, root: int):
+    return (lax.axis_index(axis_name) - root) % n
+
+
+def binomial_broadcast(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Every rank ends with root's ``x``. Recursive doubling: log2(n) steps,
+    whole-buffer messages."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    v = _vrank(axis_name, n, root)
+    for m in binomial_masks(n):
+        recvd = lax.ppermute(x, axis_name, perm=bcast_pairs(n, m, root))
+        x = jnp.where((v >= m) & (v < 2 * m), recvd, x)
+    return x
+
+
+def binomial_reduce(x: jax.Array, axis_name: str, root: int = 0,
+                    op: str = "sum") -> jax.Array:
+    """Root ends with the ``op``-reduction of all ranks' ``x``; others zeros.
+
+    The broadcast tree run in reverse: descending masks, receivers combine.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return finalize(x, op, 1)
+    combine = combine_fn(op)
+    v = _vrank(axis_name, n, root)
+    for m in reversed(binomial_masks(n)):
+        perm = [(d, s) for s, d in bcast_pairs(n, m, root)]  # reversed flow
+        recvd = lax.ppermute(x, axis_name, perm=perm)
+        x = jnp.where((v < m) & (v + m < n), combine(x, recvd), x)
+    x = finalize(x, op, n)
+    return jnp.where(v == 0, x, 0).astype(x.dtype)
+
+
+def _npad(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def binomial_gather(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Root ends with ``(n, *x.shape)``, row i = rank i's ``x``; others zeros.
+
+    Subtree gather: at step m, vranks ≡ m (mod 2m) ship their m-slot subtree
+    — message size m·|x| per step, n-1 slots total into root.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x[None]
+    v = _vrank(axis_name, n, root)
+    buf = jnp.zeros((_npad(n),) + x.shape, x.dtype)
+    buf = lax.dynamic_update_index_in_dim(buf, x, v, axis=0)
+    for m in binomial_masks(n):
+        sent = lax.dynamic_slice_in_dim(buf, v, m, axis=0)  # my subtree
+        recvd = lax.ppermute(sent, axis_name, perm=gather_pairs(n, m, root))
+        # receiver v stores the sender's subtree, which starts at slot v+m
+        updated = lax.dynamic_update_slice_in_dim(buf, recvd, v + m, axis=0)
+        buf = jnp.where((v % (2 * m) == 0) & (v + m < n), updated, buf)
+    # vrank slot s holds true rank (s + root) mod n; emit true-rank order
+    order = jnp.array([(t - root) % n for t in range(n)])
+    out = buf[order]
+    return jnp.where(v == 0, out, 0).astype(x.dtype)
+
+
+def binomial_scatter(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Root's ``x`` (flattening to n·c) is split n ways; rank r gets chunk r.
+
+    Halving scatter: descending masks; holders ship the upper half of their
+    2m-aligned block — message size m·c per step, n-1 chunks total from root.
+    """
+    n = lax.axis_size(axis_name)
+    flat = x.reshape(-1)
+    if n == 1:
+        return flat
+    if flat.size % n:
+        raise ValueError(f"scatter buffer ({flat.size} elems) must divide by axis size {n}")
+    v = _vrank(axis_name, n, root)
+    # root's chunks, rotated into vrank slot order (slot s = chunk (s+root)%n),
+    # padded to a power of two; off-root ranks start zeroed.
+    chunks = flat.reshape(n, -1)
+    order = jnp.array([(s + root) % n for s in range(n)])
+    buf = jnp.zeros((_npad(n),) + chunks.shape[1:], x.dtype)
+    buf = buf.at[:n].set(jnp.where(v == 0, chunks[order], 0).astype(x.dtype))
+    for m in reversed(binomial_masks(n)):
+        # upper half of my 2m-aligned block: the sender's payload AND the
+        # receiver's landing slots (same formula on both sides of the pair)
+        up = (v // (2 * m)) * (2 * m) + m
+        sent = lax.dynamic_slice_in_dim(buf, up, m, axis=0)
+        perm = [(s, d) for d, s in gather_pairs(n, m, root)]  # reversed flow
+        recvd = lax.ppermute(sent, axis_name, perm=perm)
+        updated = lax.dynamic_update_slice_in_dim(buf, recvd, up, axis=0)
+        buf = jnp.where(v % (2 * m) == m, updated, buf)
+    return lax.dynamic_index_in_dim(buf, v, axis=0, keepdims=False)

@@ -187,6 +187,117 @@ def sim_alltoall(bufs: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Binomial rooted collectives (broadcast / reduce / gather / scatter)
+#
+# All four run in ceil(log2 n) ppermute steps over "virtual ranks"
+# v = (rank - root) mod n, so any root reuses the root-0 schedule.
+#
+# **Broadcast** (recursive doubling): at step mask m = 1, 2, 4, ... the
+# vranks [0, m) that already hold the data send to vrank+m; receivers are
+# vranks [m, 2m). **Reduce** mirrors it with descending masks: vranks
+# [m, 2m) send to vrank-m, which combines.
+#
+# **Gather**: buffers live in vrank slot order so every subtree is
+# contiguous. At step m (ascending), vranks ≡ m (mod 2m) send their m-slot
+# subtree [v, v+m) to vrank-m, which stores it at [v, v+m) — message size
+# is static per step (m slots), start indices dynamic. **Scatter** reverses:
+# at step m (descending), vranks ≡ 0 (mod 2m) send the upper half
+# [v+m, v+2m) of their block to vrank+m. Slot buffers are padded to the next
+# power of two so wrap-around subtrees stay in range (pad slots carry zeros).
+
+
+def binomial_masks(n: int) -> list[int]:
+    """Step masks 1, 2, 4, ... < n (any n, not just powers of two)."""
+    out, m = [], 1
+    while m < n:
+        out.append(m)
+        m <<= 1
+    return out
+
+
+def bcast_pairs(n: int, mask: int, root: int = 0) -> list[tuple[int, int]]:
+    """(src, dst) true-rank pairs at broadcast step ``mask`` (reduce reverses)."""
+    return [((v + root) % n, (v + mask + root) % n)
+            for v in range(mask) if v + mask < n]
+
+
+def gather_pairs(n: int, mask: int, root: int = 0) -> list[tuple[int, int]]:
+    """(src, dst) true-rank pairs at gather step ``mask`` (scatter reverses)."""
+    return [((v + root) % n, (v - mask + root) % n)
+            for v in range(mask, n, 2 * mask)]
+
+
+def sim_binomial_broadcast(bufs: np.ndarray, root: int = 0) -> np.ndarray:
+    """Simulate the recursive-doubling broadcast: every row becomes row root."""
+    n = bufs.shape[0]
+    bufs = bufs.copy()
+    for m in binomial_masks(n):
+        sent = {src: bufs[src].copy() for src, _ in bcast_pairs(n, m, root)}
+        for src, dst in bcast_pairs(n, m, root):
+            bufs[dst] = sent[src]
+    return bufs
+
+
+def sim_binomial_reduce(bufs: np.ndarray, root: int = 0) -> np.ndarray:
+    """Simulate the mirrored reduce: row root = sum of all rows, others zero."""
+    n = bufs.shape[0]
+    bufs = bufs.astype(np.float64).copy()
+    for m in reversed(binomial_masks(n)):
+        pairs = [(d, s) for s, d in bcast_pairs(n, m, root)]  # reversed flow
+        sent = {src: bufs[src].copy() for src, _ in pairs}
+        for src, dst in pairs:
+            bufs[dst] += sent[src]
+    out = np.zeros_like(bufs)
+    out[root] = bufs[root]
+    return out
+
+
+def sim_binomial_gather(bufs: np.ndarray, root: int = 0) -> np.ndarray:
+    """Simulate the subtree gather on (n, chunk) rows. Returns (n, n*chunk):
+    row root = all rows concatenated in true-rank order, others zero."""
+    n, chunk = bufs.shape
+    npad = 1 << max(0, (n - 1).bit_length())
+    slot = np.zeros((n, npad, chunk), bufs.dtype)  # [holder, vrank slot, elems]
+    for r in range(n):
+        slot[r, (r - root) % n] = bufs[r]
+    for m in binomial_masks(n):
+        sent = {src: slot[src, (((src - root) % n)):((src - root) % n) + m].copy()
+                for src, _ in gather_pairs(n, m, root)}
+        for src, dst in gather_pairs(n, m, root):
+            v = (src - root) % n
+            slot[dst, v:v + m] = sent[src]
+    out = np.zeros((n, n * chunk), bufs.dtype)
+    # vrank slot v holds true rank (v + root) mod n; reorder to true-rank order
+    order = [(t - root) % n for t in range(n)]
+    out[root] = slot[root, order].reshape(-1)
+    return out
+
+
+def sim_binomial_scatter(bufs: np.ndarray, root: int = 0) -> np.ndarray:
+    """Simulate the halving scatter on (n, n*chunk) rows (only row root read).
+    Returns (n, chunk): row r = root's chunk r."""
+    n = bufs.shape[0]
+    chunk = bufs.shape[1] // n
+    npad = 1 << max(0, (n - 1).bit_length())
+    slot = np.zeros((n, npad, chunk), bufs.dtype)
+    # root's buffer, rotated into vrank slot order
+    full = bufs[root].reshape(n, chunk)
+    for v in range(n):
+        slot[root, v] = full[(v + root) % n]
+    for m in reversed(binomial_masks(n)):
+        pairs = [(d, s) for s, d in gather_pairs(n, m, root)]  # reversed flow
+        sent = {}
+        for src, dst in pairs:
+            v = (src - root) % n
+            up = (v // (2 * m)) * (2 * m) + m
+            sent[src] = slot[src, up:up + m].copy()
+        for src, dst in pairs:
+            v = (dst - root) % n
+            slot[dst, v:v + m] = sent[src]
+    return np.stack([slot[r, (r - root) % n] for r in range(n)])
+
+
+# ---------------------------------------------------------------------------
 # Bruck alltoall (log-step; latency-optimal for small messages)
 
 

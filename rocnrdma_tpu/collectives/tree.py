@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from rocnrdma_tpu.collectives.reduce_op import combine_fn, finalize
 from rocnrdma_tpu.collectives.schedule import hd_masks
 
 
@@ -26,11 +27,13 @@ def _pair_perm(n: int, mask: int) -> list[tuple[int, int]]:
     return [(r, r ^ mask) for r in range(n)]
 
 
-def hd_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
-    """Allreduce (sum) by recursive halving + recursive doubling."""
+def hd_allreduce(x: jax.Array, axis_name: str, op: str = "sum") -> jax.Array:
+    """Allreduce by recursive halving + recursive doubling (``op``: sum/prod/
+    max/min/avg per reduce_op.REDUCE_OPS)."""
     n = lax.axis_size(axis_name)
     if n == 1:
-        return x
+        return finalize(x, op, 1)
+    combine = combine_fn(op)
     masks = hd_masks(n)  # raises on non-power-of-two
     r = lax.axis_index(axis_name)
 
@@ -52,7 +55,8 @@ def hd_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
         sent = lax.dynamic_slice_in_dim(buf, send_start, half, axis=0)
         recvd = lax.ppermute(sent, axis_name, perm=_pair_perm(n, mask))
         kept = lax.dynamic_slice_in_dim(buf, keep_start, half, axis=0)
-        buf = lax.dynamic_update_slice_in_dim(buf, kept + recvd, keep_start, axis=0)
+        buf = lax.dynamic_update_slice_in_dim(buf, combine(kept, recvd),
+                                              keep_start, axis=0)
         start, length = keep_start, half
 
     # Recursive doubling (allgather): undo the halving, largest mask last.
@@ -66,4 +70,4 @@ def hd_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
         start = jnp.minimum(start, partner_start)
         length *= 2
 
-    return buf.reshape(-1)[:size].reshape(shape)
+    return finalize(buf.reshape(-1)[:size].reshape(shape), op, n)

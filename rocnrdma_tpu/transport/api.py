@@ -36,38 +36,86 @@ def _pallas():
     from rocnrdma_tpu import ops
     return ops
 
+
+def _raise(msg: str):
+    raise ValueError(msg)
+
 ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "hierarchical",
-         "pallas_ring", "bruck")
+         "pallas_ring", "bruck", "binomial")
 
 # THE (op, algo) compatibility table — single source of truth, consumed by
 # Transport._build below and by the bench runner's algo filter. Each entry
 # maps an axis-level value ``v`` through the schedule; ``fused_axes`` is the
 # axis name (1-D mesh) or axis tuple (2-D mesh) the fused lowerings span.
+# Keyword knobs (uniform across entries; each schedule reads what applies):
+# ``op`` — the reduction operator (reduce_op.REDUCE_OPS) for the reducing
+# verbs; ``root`` — static root rank for the rooted verbs.
 SCHEDULES = {
     "allreduce": {
-        "fused": lambda v, fused_axes: C.fused_allreduce(v, fused_axes),
-        "ring": lambda v, _: C.ring_allreduce(v, RANK_AXIS),
-        "ring_bidir": lambda v, _: C.ring_allreduce(v, RANK_AXIS, bidir=True),
-        "tree": lambda v, _: C.hd_allreduce(v, RANK_AXIS),
-        "hierarchical": lambda v, _: C.hierarchical_allreduce(v),
-        "pallas_ring": lambda v, _: _pallas().pallas_ring_allreduce(v, RANK_AXIS),
+        "fused": lambda v, fused_axes, op="sum", root=0:
+            C.fused_allreduce(v, fused_axes, op=op),
+        "ring": lambda v, _, op="sum", root=0:
+            C.ring_allreduce(v, RANK_AXIS, op=op),
+        "ring_bidir": lambda v, _, op="sum", root=0:
+            C.ring_allreduce(v, RANK_AXIS, bidir=True, op=op),
+        "tree": lambda v, _, op="sum", root=0:
+            C.hd_allreduce(v, RANK_AXIS, op=op),
+        "hierarchical": lambda v, _, op="sum", root=0:
+            C.hierarchical_allreduce(v, op=op),
+        "pallas_ring": lambda v, _, op="sum", root=0:
+            _pallas().pallas_ring_allreduce(v, RANK_AXIS) if op == "sum"
+            else _raise(f"pallas_ring allreduce is sum-only, got op={op!r}"),
     },
     "reduce_scatter": {
-        "fused": lambda v, fused_axes: C.fused_reduce_scatter(v, fused_axes),
-        "ring": lambda v, _: C.ring_reduce_scatter(v, RANK_AXIS),
+        "fused": lambda v, fused_axes, op="sum", root=0:
+            C.fused_reduce_scatter(v, fused_axes, op=op),
+        "ring": lambda v, _, op="sum", root=0:
+            C.ring_reduce_scatter(v, RANK_AXIS, op=op),
     },
     "allgather": {
-        "fused": lambda v, fused_axes: C.fused_allgather(v, fused_axes).reshape(-1),
-        "ring": lambda v, _: C.ring_allgather(v, RANK_AXIS).reshape(-1),
-        "pallas_ring": lambda v, _: _pallas().pallas_ring_allgather(
-            v, RANK_AXIS).reshape(-1),
+        "fused": lambda v, fused_axes, op="sum", root=0:
+            C.fused_allgather(v, fused_axes).reshape(-1),
+        "ring": lambda v, _, op="sum", root=0:
+            C.ring_allgather(v, RANK_AXIS).reshape(-1),
+        "pallas_ring": lambda v, _, op="sum", root=0:
+            _pallas().pallas_ring_allgather(v, RANK_AXIS).reshape(-1),
     },
     "alltoall": {
         # "ring" selects the rotation schedule — the ring-family alltoall
         # (n-1 shifted ppermute steps); "bruck" the log-step one.
-        "fused": lambda v, fused_axes: C.fused_alltoall(v, fused_axes),
-        "ring": lambda v, _: C.rotation_alltoall(v, RANK_AXIS),
-        "bruck": lambda v, _: C.bruck_alltoall(v, RANK_AXIS),
+        "fused": lambda v, fused_axes, op="sum", root=0:
+            C.fused_alltoall(v, fused_axes),
+        "ring": lambda v, _, op="sum", root=0:
+            C.rotation_alltoall(v, RANK_AXIS),
+        "bruck": lambda v, _, op="sum", root=0:
+            C.bruck_alltoall(v, RANK_AXIS),
+    },
+    # Rooted verbs (the RCCL broadcast/reduce + gather/scatter surface).
+    # Off-root rows of reduce/gather outputs are zeroed (deterministic where
+    # RCCL leaves them undefined).
+    "broadcast": {
+        "fused": lambda v, fused_axes, op="sum", root=0:
+            C.fused_broadcast(v, fused_axes, root=root),
+        "binomial": lambda v, _, op="sum", root=0:
+            C.binomial_broadcast(v, RANK_AXIS, root=root),
+    },
+    "reduce": {
+        "fused": lambda v, fused_axes, op="sum", root=0:
+            C.fused_rooted_reduce(v, fused_axes, root=root, op=op),
+        "binomial": lambda v, _, op="sum", root=0:
+            C.binomial_reduce(v, RANK_AXIS, root=root, op=op),
+    },
+    "gather": {
+        "fused": lambda v, fused_axes, op="sum", root=0:
+            C.fused_gather(v, fused_axes, root=root).reshape(-1),
+        "binomial": lambda v, _, op="sum", root=0:
+            C.binomial_gather(v, RANK_AXIS, root=root).reshape(-1),
+    },
+    "scatter": {
+        "fused": lambda v, fused_axes, op="sum", root=0:
+            C.fused_scatter(v, fused_axes, root=root),
+        "binomial": lambda v, _, op="sum", root=0:
+            C.binomial_scatter(v, RANK_AXIS, root=root),
     },
 }
 
@@ -125,13 +173,15 @@ class Transport:
 
     # -- verbs -------------------------------------------------------------
 
-    def allreduce(self, x, algo: str = "auto"):
-        """(ranks..., S) -> same shape; every rank row = elementwise sum."""
-        return self._jit("allreduce", self._resolve(algo, "allreduce"))(x)
+    def allreduce(self, x, algo: str = "auto", op: str = "sum"):
+        """(ranks..., S) -> same shape; every rank row = elementwise reduction
+        (``op``: sum/prod/max/min/avg)."""
+        return self._jit("allreduce", self._resolve(algo, "allreduce"), op=op)(x)
 
-    def reduce_scatter(self, x, algo: str = "auto"):
+    def reduce_scatter(self, x, algo: str = "auto", op: str = "sum"):
         """(ranks..., S) -> (ranks..., S/n); rank r keeps the reduced r-th shard."""
-        return self._jit("reduce_scatter", self._resolve(algo, "reduce_scatter"))(x)
+        return self._jit("reduce_scatter", self._resolve(algo, "reduce_scatter"),
+                         op=op)(x)
 
     def allgather(self, x, algo: str = "auto"):
         """(ranks..., c) -> (ranks..., n*c); every rank ends with the concatenation."""
@@ -141,19 +191,46 @@ class Transport:
         """(ranks..., n, c) -> same shape, global transpose of rank x chunk dims."""
         return self._jit("alltoall", self._resolve(algo, "alltoall"))(x)
 
-    def jit_fn(self, op: str, algo: str = "auto"):
+    def broadcast(self, x, algo: str = "auto", root: int = 0):
+        """(ranks..., S) -> same shape; every rank row = root's row."""
+        return self._jit("broadcast", self._resolve(algo, "broadcast"),
+                         root=root)(x)
+
+    def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum"):
+        """(ranks..., S) -> same shape; root's row = reduction, others zero."""
+        return self._jit("reduce", self._resolve(algo, "reduce"),
+                         root=root, op=op)(x)
+
+    def gather(self, x, algo: str = "auto", root: int = 0):
+        """(ranks..., c) -> (ranks..., n*c); root's row = concatenation in
+        rank order, others zero."""
+        return self._jit("gather", self._resolve(algo, "gather"), root=root)(x)
+
+    def scatter(self, x, algo: str = "auto", root: int = 0):
+        """(ranks..., n*c) -> (ranks..., c); rank r's row = chunk r of root's
+        row (only root's input is read)."""
+        return self._jit("scatter", self._resolve(algo, "scatter"), root=root)(x)
+
+    def jit_fn(self, verb: str, algo: str = "auto", **knobs):
         """The compiled global-array callable (what the benches time)."""
-        return self._jit(op, self._resolve(algo, op))
+        return self._jit(verb, self._resolve(algo, verb), **knobs)
 
     # -- lowering ----------------------------------------------------------
 
-    def _jit(self, op: str, algo: str):
-        key = (op, algo)
+    def _jit(self, verb: str, algo: str, **knobs):
+        root = knobs.get("root")
+        if root is not None and not 0 <= root < self.n_ranks:
+            raise ValueError(f"root {root} out of range for {self.n_ranks} ranks")
+        # normalize defaults so verb methods and bare jit_fn() calls share
+        # one compilation per distinct program
+        knobs = {k: v for k, v in knobs.items()
+                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)}
+        key = (verb, algo, tuple(sorted(knobs.items())))
         if key not in self._cache:
-            self._cache[key] = self._build(op, algo)
+            self._cache[key] = self._build(verb, algo, **knobs)
         return self._cache[key]
 
-    def _build(self, op: str, algo: str):
+    def _build(self, verb: str, algo: str, **knobs):
         nlead = len(self.axes)
         # Fused XLA collectives take the whole axis tuple on a 2-D mesh
         # (ICI+DCN in one op); the explicit schedules ring a single axis.
@@ -166,10 +243,10 @@ class Transport:
                 return fn(s.reshape(s.shape[nlead:]))[(None,) * nlead]
             return wrapped
 
-        schedule = SCHEDULES[op].get(algo)
+        schedule = SCHEDULES[verb].get(algo)
         if schedule is None:
-            raise ValueError(f"op {op!r} has no {algo!r} schedule")
-        fn = lambda v: schedule(v, fused_axes)
+            raise ValueError(f"op {verb!r} has no {algo!r} schedule")
+        fn = lambda v: schedule(v, fused_axes, **knobs)
 
         spec = self._spec()
         # check_vma off for the pallas data plane: pallas_call outputs carry

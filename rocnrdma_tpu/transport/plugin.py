@@ -200,6 +200,8 @@ class HostQPNet:
                 ready = comm._unexpected.get(tag)
             if ready:
                 payload = ready.pop(0)
+                if not ready:  # drop exhausted tag keys: callers use fresh
+                    del comm._unexpected[tag]  # tags per step, unbounded otherwise
                 return True, len(payload), payload
             return False, 0, None
         return Request(_test=probe)

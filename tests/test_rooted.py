@@ -1,0 +1,185 @@
+"""Rooted collectives (broadcast / reduce / gather / scatter): simulator
+unit tier, device tier vs numpy, and Transport-level wiring (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rocnrdma_tpu import collectives as C
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.collectives import schedule as S
+from rocnrdma_tpu.transport import Transport
+
+RANK = rt.mesh.RANK_AXIS
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return np.random.default_rng(seed).normal(size=shape).astype(dtype)
+
+
+def _run(fn, n, x):
+    mesh = rt.rank_mesh(n)
+    shmapped = jax.shard_map(fn, mesh=mesh, in_specs=(P(RANK),),
+                             out_specs=P(RANK))
+    return np.asarray(jax.jit(shmapped)(x))
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: pure-numpy simulators against direct semantics (device-free)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8])
+@pytest.mark.parametrize("root", [0, 1])
+def test_sim_broadcast(n, root):
+    root %= n
+    x = _rand((n, 7), seed=n)
+    out = S.sim_binomial_broadcast(x, root)
+    np.testing.assert_array_equal(out, np.broadcast_to(x[root], x.shape))
+
+
+@pytest.mark.parametrize("n", [2, 3, 6, 8])
+@pytest.mark.parametrize("root", [0, 2])
+def test_sim_reduce(n, root):
+    root %= n
+    x = _rand((n, 5), seed=n + 10)
+    out = S.sim_binomial_reduce(x, root)
+    np.testing.assert_allclose(out[root], x.sum(0), rtol=1e-6)
+    assert not out[np.arange(n) != root].any()
+
+
+@pytest.mark.parametrize("n", [2, 3, 6, 8])
+@pytest.mark.parametrize("root", [0, 1])
+def test_sim_gather(n, root):
+    root %= n
+    x = _rand((n, 4), seed=n + 20)
+    out = S.sim_binomial_gather(x, root)
+    np.testing.assert_array_equal(out[root], x.reshape(-1))
+    assert not out[np.arange(n) != root].any()
+
+
+@pytest.mark.parametrize("n", [2, 3, 6, 8])
+@pytest.mark.parametrize("root", [0, 3])
+def test_sim_scatter(n, root):
+    root %= n
+    x = _rand((n, n * 3), seed=n + 30)
+    out = S.sim_binomial_scatter(x, root)
+    np.testing.assert_array_equal(out, x[root].reshape(n, 3))
+
+
+# ---------------------------------------------------------------------------
+# Device tier: jit schedules vs numpy on the fake-device oracle
+
+
+@pytest.mark.parametrize("n", [2, 3, 8])
+@pytest.mark.parametrize("root", [0, 1])
+@pytest.mark.parametrize("impl", ["binomial", "fused"])
+def test_broadcast(devices, n, root, impl):
+    root %= n
+    x = _rand((n, 33), seed=1)
+    fn = C.binomial_broadcast if impl == "binomial" else C.fused_broadcast
+    out = _run(lambda s: fn(s[0], RANK, root=root)[None], n, x)
+    np.testing.assert_allclose(out, np.broadcast_to(x[root], x.shape), rtol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 3, 8])
+@pytest.mark.parametrize("root", [0, 2])
+@pytest.mark.parametrize("impl", ["binomial", "fused"])
+def test_reduce(devices, n, root, impl):
+    root %= n
+    x = _rand((n, 21), seed=2)
+    fn = C.binomial_reduce if impl == "binomial" else C.fused_rooted_reduce
+    out = _run(lambda s: fn(s[0], RANK, root=root)[None], n, x)
+    np.testing.assert_allclose(out[root], x.sum(0), rtol=1e-5, atol=1e-6)
+    assert not out[np.arange(n) != root].any()
+
+
+@pytest.mark.parametrize("n", [2, 3, 6, 8])
+@pytest.mark.parametrize("root", [0, 1])
+@pytest.mark.parametrize("impl", ["binomial", "fused"])
+def test_gather(devices, n, root, impl):
+    root %= n
+    x = _rand((n, 4), seed=3)
+    fn = C.binomial_gather if impl == "binomial" else C.fused_gather
+    out = _run(lambda s: fn(s[0], RANK, root=root).reshape(1, -1), n, x)
+    np.testing.assert_allclose(out[root], x.reshape(-1), rtol=1e-6)
+    assert not out[np.arange(n) != root].any()
+
+
+@pytest.mark.parametrize("n", [2, 3, 6, 8])
+@pytest.mark.parametrize("root", [0, 3])
+@pytest.mark.parametrize("impl", ["binomial", "fused"])
+def test_scatter(devices, n, root, impl):
+    root %= n
+    x = np.broadcast_to(_rand((n * 5,), seed=4), (n, n * 5)).copy()
+    # only root's row may be read: poison the others
+    x[np.arange(n) != root] = 999.0
+    fn = C.binomial_scatter if impl == "binomial" else C.fused_scatter
+    out = _run(lambda s: fn(s[0], RANK, root=root)[None], n, x)
+    np.testing.assert_allclose(out, x[root].reshape(n, 5), rtol=1e-6)
+
+
+def test_reduce_ops_rooted(devices):
+    x = _rand((8, 17), seed=5)
+    for op, want in [("max", x.max(0)), ("min", x.min(0)),
+                     ("prod", x.prod(0)), ("avg", x.mean(0))]:
+        out = _run(lambda s: C.binomial_reduce(s[0], RANK, root=0, op=op)[None],
+                   8, x)
+        np.testing.assert_allclose(out[0], want, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Transport tier
+
+
+@pytest.fixture(scope="module")
+def t8():
+    return Transport(rt.rank_mesh(8))
+
+
+@pytest.fixture(scope="module")
+def t2d():
+    return Transport(rt.slice_mesh(2, 4))
+
+
+@pytest.mark.parametrize("algo", ["auto", "fused", "binomial"])
+def test_transport_broadcast(t8, algo):
+    x = t8.shard(_rand((8, 12), seed=6))
+    out = np.asarray(t8.broadcast(x, algo, root=5))
+    np.testing.assert_allclose(out, np.broadcast_to(np.asarray(x)[5], out.shape),
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("algo", ["fused", "binomial"])
+def test_transport_reduce(t8, algo):
+    x = t8.shard(_rand((8, 10), seed=7))
+    out = np.asarray(t8.reduce(x, algo, root=3))
+    np.testing.assert_allclose(out[3], np.asarray(x).sum(0), rtol=1e-5)
+    assert not out[np.arange(8) != 3].any()
+
+
+@pytest.mark.parametrize("algo", ["fused", "binomial"])
+def test_transport_gather_scatter_roundtrip(t8, algo):
+    x = t8.shard(_rand((8, 6), seed=8))
+    g = t8.gather(x, algo, root=2)
+    assert np.asarray(g).shape == (8, 48)
+    back = np.asarray(t8.scatter(g, algo, root=2))
+    np.testing.assert_allclose(back, np.asarray(x), rtol=1e-6)
+
+
+def test_transport_rooted_2d_fused(t2d):
+    x = t2d.shard(_rand((2, 4, 9), seed=9))
+    out = np.asarray(t2d.broadcast(x, "fused", root=5))
+    np.testing.assert_allclose(
+        out, np.broadcast_to(np.asarray(x).reshape(8, 9)[5], (2, 4, 9)), rtol=1e-6)
+    red = np.asarray(t2d.reduce(x, "fused", root=5))
+    np.testing.assert_allclose(red.reshape(8, 9)[5],
+                               np.asarray(x).sum((0, 1)), rtol=1e-5)
+
+
+def test_transport_root_validation(t8):
+    x = t8.shard(_rand((8, 4), seed=10))
+    with pytest.raises(ValueError):
+        t8.broadcast(x, root=8)
+    with pytest.raises(ValueError):
+        t8.broadcast(x, root=-1)
