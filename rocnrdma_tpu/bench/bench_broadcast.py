@@ -1,0 +1,26 @@
+"""``bench_broadcast`` — broadcast sweep (the rccl-tests ``broadcast_perf``
+slot of the reference's benchmark family).
+
+Every rank ends with ``--root``'s buffer. busbw factor 1 (metrics.py).
+
+Examples::
+
+    bench_broadcast --ranks 8 --fake-devices 8 --sizes 4M
+    bench_broadcast --ranks 8 --algos binomial,fused --root 3
+"""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_broadcast", "broadcast").parse_args(argv)
+    runner.run_sweep("bench_broadcast", "broadcast", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""``bench_gather`` — rooted-gather sweep (the rccl-tests ``gather_perf``
+slot of the reference's benchmark family).
+
+``--root`` ends with every rank's chunk concatenated in rank order; other
+ranks' outputs are zeroed. busbw factor (n-1)/n (metrics.py).
+
+Examples::
+
+    bench_gather --ranks 8 --fake-devices 8 --sizes 4M
+    bench_gather --ranks 8 --algos binomial,fused --root 2
+"""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_gather", "gather").parse_args(argv)
+    runner.run_sweep("bench_gather", "gather", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

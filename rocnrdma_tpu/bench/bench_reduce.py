@@ -1,0 +1,28 @@
+"""``bench_reduce`` — rooted-reduce sweep (the rccl-tests ``reduce_perf``
+slot of the reference's benchmark family).
+
+``--root``'s buffer ends as the ``--redop``-reduction of all ranks'; other
+ranks' outputs are zeroed (deterministic where RCCL leaves them undefined).
+busbw factor 1 (metrics.py).
+
+Examples::
+
+    bench_reduce --ranks 8 --fake-devices 8 --sizes 4M
+    bench_reduce --ranks 8 --algos binomial,fused --root 5 --redop avg
+"""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_reduce", "reduce").parse_args(argv)
+    runner.run_sweep("bench_reduce", "reduce", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

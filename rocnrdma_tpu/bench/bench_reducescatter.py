@@ -1,0 +1,27 @@
+"""``bench_reducescatter`` — reduce-scatter sweep (the rccl-tests
+``reduce_scatter_perf`` slot of the reference's benchmark family).
+
+Rank r ends with the ``--redop``-reduced r-th 1/n of the buffer. busbw
+factor (n-1)/n (metrics.py).
+
+Examples::
+
+    bench_reducescatter --ranks 8 --fake-devices 8 --sizes 1M,16M
+    bench_reducescatter --ranks 8 --algos ring,fused --redop max
+"""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_reducescatter", "reducescatter").parse_args(argv)
+    runner.run_sweep("bench_reducescatter", "reducescatter", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

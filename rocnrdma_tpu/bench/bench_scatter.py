@@ -1,0 +1,27 @@
+"""``bench_scatter`` — rooted-scatter sweep (the rccl-tests ``scatter_perf``
+slot of the reference's benchmark family).
+
+``--root``'s buffer is split n ways; rank r ends with chunk r. busbw factor
+(n-1)/n (metrics.py).
+
+Examples::
+
+    bench_scatter --ranks 8 --fake-devices 8 --sizes 4M
+    bench_scatter --ranks 8 --algos binomial,fused --root 7
+"""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_scatter", "scatter").parse_args(argv)
+    runner.run_sweep("bench_scatter", "scatter", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""``bench_sendrecv`` — point-to-point shift-exchange sweep (the rccl-tests
+``sendrecv_perf`` slot; the raw primitive the reference's ibv_* queue pairs
+carried).
+
+Every rank sends its buffer to rank ``r + --shift`` (mod n) and receives
+from ``r - shift`` — one XLA CollectivePermute, the native ICI
+point-to-point op. busbw factor 1 (metrics.py): each rank moves S out and
+S in.
+
+Examples::
+
+    bench_sendrecv --ranks 8 --fake-devices 8 --sizes 1M,64M
+    bench_sendrecv --ranks 8 --shift 3
+"""
+
+from __future__ import annotations
+
+import sys
+
+from rocnrdma_tpu.bench import runner
+
+
+def main(argv=None) -> int:
+    args = runner.make_parser("bench_sendrecv", "sendrecv").parse_args(argv)
+    runner.run_sweep("bench_sendrecv", "sendrecv", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

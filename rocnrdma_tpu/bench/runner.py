@@ -45,6 +45,13 @@ def make_parser(bench_name: str, collective: str) -> argparse.ArgumentParser:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--repeats", type=int, default=5)
     p.add_argument("--iters", type=int, default=10, help="calls per timed repeat")
+    p.add_argument("--root", type=int, default=0,
+                   help="root rank (broadcast/reduce/gather/scatter only)")
+    p.add_argument("--shift", type=int, default=1,
+                   help="ring offset: send to rank+shift mod n (sendrecv only)")
+    p.add_argument("--redop", choices=("sum", "prod", "max", "min", "avg"),
+                   default="sum",
+                   help="reduction operator (allreduce/reducescatter/reduce)")
     p.add_argument("--platform", choices=("auto", "cpu"), default="auto",
                    help="cpu = the fake-device oracle path (gloo analogue)")
     p.add_argument("--fake-devices", type=int, default=None,
@@ -74,7 +81,7 @@ def resolve_preset(args, collective: str) -> P.Preset:
         pre = P.Preset(name="custom", baseline_config="(custom flags)",
                        n_ranks=args.ranks or 8, mesh2d=None,
                        sizes=(4 * M.MiB,), dtypes=("float32",),
-                       algos=("fused",) if collective != "allreduce" else ("ring", "fused"))
+                       algos=_DEFAULT_ALGOS.get(collective, ("fused",)))
     import dataclasses
     over = {}
     if args.ranks:
@@ -105,16 +112,16 @@ def _shape_and_bytes(collective: str, n: int, size_bytes: int, dtype: str):
     from the requested sweep size."""
     itemsize = _np_dtype(dtype).itemsize
     elems = max(1, size_bytes // itemsize)
-    if collective == "allgather":
+    if collective in ("allgather", "gather"):
         elems = max(n, elems // n * n)  # input chunk = S/n
         shape = (n, elems // n)
     elif collective == "alltoall":
         elems = max(n, elems // n * n)
         shape = (n, n, elems // n)
-    elif collective == "reducescatter":
+    elif collective in ("reducescatter", "scatter"):
         elems = max(n, elems // n * n)
         shape = (n, elems)
-    else:
+    else:  # allreduce / broadcast / reduce / sendrecv: full S per rank
         shape = (n, elems)
     return shape, elems * itemsize
 
@@ -133,19 +140,40 @@ def _build_input(collective: str, n: int, mesh2d, size_bytes: int, dtype: str):
     return x, actual
 
 
-def _expected(collective: str, x: np.ndarray, mesh2d) -> np.ndarray:
+def _np_reduce(flat: np.ndarray, op: str) -> np.ndarray:
+    """Rank-axis reduction matching reduce_op.REDUCE_OPS semantics."""
+    n = flat.shape[0]
+    red = {"sum": np.sum, "avg": np.sum, "prod": np.prod,
+           "max": np.max, "min": np.min}[op](flat, axis=0)
+    return red / n if op == "avg" else red
+
+
+def _expected(collective: str, x: np.ndarray, mesh2d, *, op: str = "sum",
+              root: int = 0, shift: int = 1) -> np.ndarray:
     xf = np.asarray(x, np.float32)
     nlead = 2 if mesh2d is not None else 1
     n = int(np.prod(xf.shape[:nlead]))
     flat = xf.reshape((n,) + xf.shape[nlead:])  # rank-major view
     if collective == "allreduce":
-        out = np.broadcast_to(flat.sum(axis=0), flat.shape)
+        out = np.broadcast_to(_np_reduce(flat, op), flat.shape)
     elif collective == "reducescatter":
-        out = flat.sum(axis=0).reshape(n, -1)
+        out = _np_reduce(flat, op).reshape(n, -1)
     elif collective == "allgather":
         out = np.broadcast_to(flat.reshape(-1), (n, flat.size))
     elif collective == "alltoall":
         out = flat.transpose(1, 0, 2)
+    elif collective == "broadcast":
+        out = np.broadcast_to(flat[root], flat.shape)
+    elif collective == "reduce":
+        out = np.zeros_like(flat)
+        out[root] = _np_reduce(flat, op)
+    elif collective == "gather":
+        out = np.zeros((n, flat.size), flat.dtype)
+        out[root] = flat.reshape(-1)
+    elif collective == "scatter":
+        out = flat[root].reshape(n, -1)  # row r = chunk r of root's buffer
+    elif collective == "sendrecv":
+        out = np.roll(flat, shift, axis=0)
     else:
         raise ValueError(collective)
     return out.reshape(xf.shape[:nlead] + out.shape[1:])
@@ -168,7 +196,23 @@ def algos_for(collective: str, algos: tuple, is_2d: bool) -> tuple:
 
 
 _OP = {"allreduce": "allreduce", "reducescatter": "reduce_scatter",
-       "allgather": "allgather", "alltoall": "alltoall"}
+       "allgather": "allgather", "alltoall": "alltoall",
+       "broadcast": "broadcast", "reduce": "reduce", "gather": "gather",
+       "scatter": "scatter", "sendrecv": "sendrecv"}
+
+# Collectives that reduce (honor --redop) / are rooted (honor --root).
+_REDUCING = ("allreduce", "reducescatter", "reduce")
+_ROOTED = ("broadcast", "reduce", "gather", "scatter")
+
+# Default algo pair when no preset/--algos names one: the explicit schedule
+# the collective owns, benchmarked against the fused XLA lowering.
+_DEFAULT_ALGOS = {
+    "allreduce": ("ring", "fused"), "reducescatter": ("ring", "fused"),
+    "allgather": ("ring", "fused"), "alltoall": ("ring", "fused"),
+    "broadcast": ("binomial", "fused"), "reduce": ("binomial", "fused"),
+    "gather": ("binomial", "fused"), "scatter": ("binomial", "fused"),
+    "sendrecv": ("fused",),
+}
 
 # The pallas ring kernels keep the whole per-rank buffer (plus comm slots)
 # resident in VMEM (~16 MiB/chip); sweep points beyond this are skipped
@@ -203,6 +247,17 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
         print(f"# algos for {collective} on this mesh: {algos} "
               f"(preset named {pre.algos})", file=sys.stderr)
 
+    # Per-collective knobs from the CLI; only what the verb understands.
+    knobs = {}
+    if collective in _REDUCING and args.redop != "sum":
+        knobs["op"] = args.redop
+    if collective in _ROOTED and args.root:
+        knobs["root"] = args.root
+    if collective == "sendrecv" and args.shift != 1:
+        knobs["shift"] = args.shift
+    check_knobs = {k: v for k, v in knobs.items() if k != "op"}
+    check_knobs["op"] = knobs.get("op", "sum")
+
     done = M.load_completed(args.out) if (args.out and args.resume) else set()
     out_fp = open(args.out, "a") if args.out else None
     prof = jax.profiler.trace(args.profile) if args.profile else contextlib.nullcontext()
@@ -216,7 +271,8 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                 # (actual bytes may round down from `size`, so check both).
                 def _key(algo, nbytes):
                     return M.record_key(bench_name, collective, algo,
-                                        pre.n_ranks, nbytes, dtype)
+                                        pre.n_ranks, nbytes, dtype,
+                                        M.knob_key(knobs))
                 if done and all(_key(a, size) in done or _key(a, _actual_bytes(
                         collective, pre.n_ranks, size, dtype)) in done
                         for a in algos):
@@ -233,7 +289,7 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                               f"VMEM-resident (cap {PALLAS_VMEM_CAP} B/rank)",
                               file=sys.stderr)
                         continue
-                    fn = t.jit_fn(_OP[collective], algo)
+                    fn = t.jit_fn(_OP[collective], algo, **knobs)
                     r1 = None
                     if args.paranoid:
                         # same input, same schedule: any bit difference means
@@ -248,7 +304,8 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                         # reuse the paranoid run's bytes: no third execution
                         got = (r1 if r1 is not None
                                else np.asarray(fn(x))).astype(np.float32)
-                        want = _expected(collective, x_np, pre.mesh2d)
+                        want = _expected(collective, x_np, pre.mesh2d,
+                                         **check_knobs)
                         rtol, atol = (1e-4, 1e-5) if dtype == "float32" else (5e-2, 5e-2)
                         np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
                     tm = time_fn(fn, x, warmup=args.warmup, repeats=args.repeats,
@@ -257,7 +314,8 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                         bench_name, collective, algo, pre.n_ranks, actual, dtype,
                         tm.mean_s, platform=topo.platform, preset=pre.name,
                         mesh2d=list(pre.mesh2d) if pre.mesh2d else None,
-                        min_s=tm.min_s, max_s=tm.max_s, checked=pre.check)
+                        min_s=tm.min_s, max_s=tm.max_s, checked=pre.check,
+                        **knobs)
                     records.append(rec)
                     if out_fp:
                         rec.write(out_fp)

@@ -41,4 +41,5 @@ from rocnrdma_tpu.collectives.fused import (  # noqa: F401
     fused_reduce_scatter,
     fused_rooted_reduce,
     fused_scatter,
+    fused_sendrecv,
 )

@@ -14,10 +14,24 @@ import jax.numpy as jnp
 from jax import lax
 
 from rocnrdma_tpu.collectives.reduce_op import axis_total, finalize, fused_reduce
+from rocnrdma_tpu.collectives.schedule import ring_permutation
 
 
 def fused_allreduce(x: jax.Array, axis_name: str, op: str = "sum") -> jax.Array:
     return fused_reduce(x, axis_name, op=op)
+
+
+def fused_sendrecv(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    """Pairwise shift exchange: every rank sends ``x`` to rank ``r+shift``
+    (mod n) and returns what it receives from ``r-shift`` — the
+    ncclSend/ncclRecv neighbor-exchange pattern of the reference's RCCL
+    surface, and the raw point-to-point primitive its ibv_* queue pairs
+    carried. Lowers to a single XLA CollectivePermute, the native ICI
+    point-to-point op. ``sim_sendrecv`` in schedule.py is the oracle."""
+    if isinstance(axis_name, (tuple, list)):
+        raise ValueError("sendrecv rings a single mesh axis")
+    n = lax.axis_size(axis_name)
+    return lax.ppermute(x, axis_name, perm=ring_permutation(n, shift % n))
 
 
 def global_rank(axis_name):

@@ -36,6 +36,7 @@ from rocnrdma_tpu.collectives.schedule import (
     bcast_pairs,
     binomial_masks,
     gather_pairs,
+    pow2_pad,
 )
 
 
@@ -75,10 +76,6 @@ def binomial_reduce(x: jax.Array, axis_name: str, root: int = 0,
     return jnp.where(v == 0, x, 0).astype(x.dtype)
 
 
-def _npad(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
-
-
 def binomial_gather(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
     """Root ends with ``(n, *x.shape)``, row i = rank i's ``x``; others zeros.
 
@@ -89,7 +86,7 @@ def binomial_gather(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
     if n == 1:
         return x[None]
     v = _vrank(axis_name, n, root)
-    buf = jnp.zeros((_npad(n),) + x.shape, x.dtype)
+    buf = jnp.zeros((pow2_pad(n),) + x.shape, x.dtype)
     buf = lax.dynamic_update_index_in_dim(buf, x, v, axis=0)
     for m in binomial_masks(n):
         sent = lax.dynamic_slice_in_dim(buf, v, m, axis=0)  # my subtree
@@ -120,7 +117,7 @@ def binomial_scatter(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
     # padded to a power of two; off-root ranks start zeroed.
     chunks = flat.reshape(n, -1)
     order = jnp.array([(s + root) % n for s in range(n)])
-    buf = jnp.zeros((_npad(n),) + chunks.shape[1:], x.dtype)
+    buf = jnp.zeros((pow2_pad(n),) + chunks.shape[1:], x.dtype)
     buf = buf.at[:n].set(jnp.where(v == 0, chunks[order], 0).astype(x.dtype))
     for m in reversed(binomial_masks(n)):
         # upper half of my 2m-aligned block: the sender's payload AND the

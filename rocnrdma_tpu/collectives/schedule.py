@@ -71,6 +71,12 @@ def ring_ag_recv_chunk(n: int, step: int, rank: int) -> int:
     return (rank - step) % n
 
 
+def sim_sendrecv(bufs: np.ndarray, shift: int = 1) -> np.ndarray:
+    """Simulate the pairwise shift exchange: out[r] = in[(r - shift) mod n]
+    (every rank sends to r+shift along ``ring_permutation(n, shift)``)."""
+    return np.roll(bufs, shift, axis=0)
+
+
 # ---------------------------------------------------------------------------
 # Halving-doubling ("tree")
 
@@ -215,6 +221,13 @@ def binomial_masks(n: int) -> list[int]:
     return out
 
 
+def pow2_pad(n: int) -> int:
+    """Slot-buffer length for the gather/scatter trees: n rounded up to the
+    next power of two, so wrap-around subtrees stay in range. The jit
+    schedules (rooted.py) and the sims below must pad identically."""
+    return 1 << max(0, (n - 1).bit_length())
+
+
 def bcast_pairs(n: int, mask: int, root: int = 0) -> list[tuple[int, int]]:
     """(src, dst) true-rank pairs at broadcast step ``mask`` (reduce reverses)."""
     return [((v + root) % n, (v + mask + root) % n)
@@ -256,7 +269,7 @@ def sim_binomial_gather(bufs: np.ndarray, root: int = 0) -> np.ndarray:
     """Simulate the subtree gather on (n, chunk) rows. Returns (n, n*chunk):
     row root = all rows concatenated in true-rank order, others zero."""
     n, chunk = bufs.shape
-    npad = 1 << max(0, (n - 1).bit_length())
+    npad = pow2_pad(n)
     slot = np.zeros((n, npad, chunk), bufs.dtype)  # [holder, vrank slot, elems]
     for r in range(n):
         slot[r, (r - root) % n] = bufs[r]
@@ -278,7 +291,7 @@ def sim_binomial_scatter(bufs: np.ndarray, root: int = 0) -> np.ndarray:
     Returns (n, chunk): row r = root's chunk r."""
     n = bufs.shape[0]
     chunk = bufs.shape[1] // n
-    npad = 1 << max(0, (n - 1).bit_length())
+    npad = pow2_pad(n)
     slot = np.zeros((n, npad, chunk), bufs.dtype)
     # root's buffer, rotated into vrank slot order
     full = bufs[root].reshape(n, chunk)

@@ -23,6 +23,10 @@ nccl-tests-style suites, which the reference's ``bench_allreduce`` followed):
   reducescatter   (n-1)/n             mirror of allgather.
   alltoall        (n-1)/n             each rank sends (n-1) of its n chunks.
   broadcast       1                   every byte crosses each link once.
+  reduce          1                   mirror of broadcast.
+  gather          (n-1)/n             root receives (n-1) chunks of S/n.
+  scatter         (n-1)/n             mirror of gather.
+  sendrecv        1                   every rank sends S and receives S.
   ==============  ==================  =========================================
 """
 
@@ -43,6 +47,10 @@ _BUSBW_FACTOR = {
     "reducescatter": lambda n: (n - 1) / n,
     "alltoall": lambda n: (n - 1) / n,
     "broadcast": lambda n: 1.0,
+    "reduce": lambda n: 1.0,          # every byte crosses each link once
+    "gather": lambda n: (n - 1) / n,  # root receives (n-1) chunks of S/n
+    "scatter": lambda n: (n - 1) / n, # mirror of gather
+    "sendrecv": lambda n: 1.0,        # S bytes out and S in per rank
 }
 
 
@@ -107,15 +115,28 @@ class BenchRecord:
     def key(self) -> tuple:
         """Identity of a sweep point, for resume-time dedup."""
         return record_key(self.bench, self.collective, self.algo, self.n_ranks,
-                          self.size_bytes, self.dtype)
+                          self.size_bytes, self.dtype, knob_key(self.extra))
+
+
+# Collective knobs that change the program (and so the sweep-point identity).
+# Producers record only non-default knobs, so old JSONL rows hash identically.
+_KNOB_KEYS = ("op", "root", "shift")
+
+
+def knob_key(extra: dict) -> tuple:
+    """Canonical (knob, value) tuple from a record's extra/knob dict."""
+    return tuple((k, extra[k]) for k in _KNOB_KEYS
+                 if extra.get(k) is not None)
 
 
 def record_key(bench: str, collective: str, algo: str, n_ranks: int,
-               size_bytes: int, dtype: str) -> tuple:
+               size_bytes: int, dtype: str, knobs: tuple = ()) -> tuple:
     """THE sweep-point identity. Every producer/consumer of resume keys
     (BenchRecord.key, load_completed, the sweep runner) must build the tuple
-    through this function so the fields can never drift apart."""
-    return (bench, collective, algo, n_ranks, size_bytes, dtype)
+    through this function so the fields can never drift apart. ``knobs`` is
+    a ``knob_key()`` tuple — a run with a different root/op/shift is a
+    different sweep point."""
+    return (bench, collective, algo, n_ranks, size_bytes, dtype) + tuple(knobs)
 
 
 def load_completed(path) -> set:
@@ -132,7 +153,8 @@ def load_completed(path) -> set:
                 except json.JSONDecodeError:
                     continue  # torn tail line from an interrupted run
                 done.add(record_key(d["bench"], d["collective"], d["algo"],
-                                    d["n_ranks"], d["size_bytes"], d["dtype"]))
+                                    d["n_ranks"], d["size_bytes"], d["dtype"],
+                                    knob_key(d.get("extra", {}))))
     except FileNotFoundError:
         pass
     return done

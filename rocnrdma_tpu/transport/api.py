@@ -49,7 +49,8 @@ ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "hierarchical",
 # axis name (1-D mesh) or axis tuple (2-D mesh) the fused lowerings span.
 # Keyword knobs (uniform across entries; each schedule reads what applies):
 # ``op`` — the reduction operator (reduce_op.REDUCE_OPS) for the reducing
-# verbs; ``root`` — static root rank for the rooted verbs.
+# verbs; ``root`` — static root rank for the rooted verbs; ``shift`` — static
+# ring offset for sendrecv.
 SCHEDULES = {
     "allreduce": {
         "fused": lambda v, fused_axes, op="sum", root=0:
@@ -117,6 +118,14 @@ SCHEDULES = {
         "binomial": lambda v, _, op="sum", root=0:
             C.binomial_scatter(v, RANK_AXIS, root=root),
     },
+    # Point-to-point shift exchange (the ncclSend/ncclRecv pairwise pattern;
+    # the reference's queue-pair primitive). One CollectivePermute — there is
+    # no "explicit vs fused" split, the single step IS the schedule. Knob:
+    # ``shift`` — static ring offset (rank r sends to r+shift mod n).
+    "sendrecv": {
+        "fused": lambda v, fused_axes, shift=1:
+            C.fused_sendrecv(v, RANK_AXIS, shift=shift),
+    },
 }
 
 
@@ -128,6 +137,8 @@ def supports(op: str, algo: str, is_2d: bool) -> bool:
         return False
     if algo == "hierarchical":
         return is_2d
+    if op == "sendrecv":
+        return not is_2d  # a shift permutation is only defined on one ring
     if algo == "fused":
         return True
     return not is_2d  # every explicit schedule rings a 1-D rank mesh
@@ -211,6 +222,13 @@ class Transport:
         row (only root's input is read)."""
         return self._jit("scatter", self._resolve(algo, "scatter"), root=root)(x)
 
+    def sendrecv(self, x, algo: str = "auto", shift: int = 1):
+        """(ranks, S) -> same shape; rank r's row = row (r - shift) mod n
+        (every rank sends to r+shift — the ncclSend/ncclRecv pairwise
+        exchange). 1-D rank mesh only; ``shift`` is a static int."""
+        return self._jit("sendrecv", self._resolve(algo, "sendrecv"),
+                         shift=shift)(x)
+
     def jit_fn(self, verb: str, algo: str = "auto", **knobs):
         """The compiled global-array callable (what the benches time)."""
         return self._jit(verb, self._resolve(algo, verb), **knobs)
@@ -224,7 +242,8 @@ class Transport:
         # normalize defaults so verb methods and bare jit_fn() calls share
         # one compilation per distinct program
         knobs = {k: v for k, v in knobs.items()
-                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)}
+                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
+                 and not (k == "shift" and v == 1)}
         key = (verb, algo, tuple(sorted(knobs.items())))
         if key not in self._cache:
             self._cache[key] = self._build(verb, algo, **knobs)

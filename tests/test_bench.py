@@ -143,3 +143,68 @@ def test_unknown_algo_rejected_not_filtered():
     with pytest.raises(ValueError, match="unknown algo"):
         _run(bench_allreduce.main,
              ["--ranks", "2", "--sizes", "4K", "--algos", "bogus"])
+
+
+@pytest.mark.parametrize("cli,collective,algos", [
+    ("bench_reducescatter", "reducescatter", {"ring", "fused"}),
+    ("bench_broadcast", "broadcast", {"binomial", "fused"}),
+    ("bench_reduce", "reduce", {"binomial", "fused"}),
+    ("bench_gather", "gather", {"binomial", "fused"}),
+    ("bench_scatter", "scatter", {"binomial", "fused"}),
+    ("bench_sendrecv", "sendrecv", {"fused"}),
+])
+def test_new_bench_clis(tmp_path, cli, collective, algos):
+    # the full rccl-tests-style perf family, each self-checked vs numpy
+    import importlib
+    mod = importlib.import_module(f"rocnrdma_tpu.bench.{cli}")
+    out = tmp_path / f"{collective}.jsonl"
+    _run(mod.main, ["--ranks", "4", "--sizes", "16K",
+                    "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows and all(r["collective"] == collective for r in rows)
+    assert {r["algo"] for r in rows} == algos
+    assert all(r["extra"]["checked"] for r in rows)
+
+
+def test_bench_reduce_root_and_redop(tmp_path):
+    from rocnrdma_tpu.bench import bench_reduce
+    out = tmp_path / "rr.jsonl"
+    _run(bench_reduce.main,
+         ["--ranks", "4", "--sizes", "16K", "--root", "2", "--redop", "max",
+          "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows and all(r["extra"]["root"] == 2 and r["extra"]["op"] == "max"
+                        for r in rows)
+
+
+def test_bench_sendrecv_shift_recorded(tmp_path):
+    from rocnrdma_tpu.bench import bench_sendrecv
+    out = tmp_path / "sr.jsonl"
+    _run(bench_sendrecv.main,
+         ["--ranks", "4", "--sizes", "16K", "--shift", "3",
+          "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows and all(r["extra"]["shift"] == 3 for r in rows)
+
+
+def test_bench_allreduce_redop_avg(tmp_path):
+    # --redop threads through the allreduce CLI's explicit AND fused paths
+    _run(bench_allreduce.main,
+         ["--ranks", "4", "--sizes", "4K", "--algos", "ring,tree,fused",
+          "--redop", "avg", "--repeats", "2", "--iters", "2"])
+
+
+def test_resume_distinguishes_knobs(tmp_path):
+    # regression: resume must NOT treat a run with different --root/--redop
+    # as already done (knobs are part of the sweep-point identity)
+    from rocnrdma_tpu.bench import bench_reduce
+    out = tmp_path / "k.jsonl"
+    base = ["--ranks", "4", "--sizes", "16K", "--repeats", "2", "--iters", "2",
+            "--out", str(out), "--resume"]
+    _run(bench_reduce.main, base)
+    n1 = len(out.read_text().splitlines())
+    _run(bench_reduce.main, base + ["--redop", "max", "--root", "2"])
+    n2 = len(out.read_text().splitlines())
+    assert n2 == 2 * n1
+    _run(bench_reduce.main, base + ["--redop", "max", "--root", "2"])
+    assert len(out.read_text().splitlines()) == n2

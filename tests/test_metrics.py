@@ -19,7 +19,15 @@ def test_busbw_single_rank_is_zero():
 
 def test_busbw_unknown_collective():
     with pytest.raises(ValueError):
-        M.busbw_GBps("gather", 8, 1, 1.0)
+        M.busbw_GBps("allfrobnicate", 8, 1, 1.0)
+
+
+def test_busbw_p2p_and_rooted_factors():
+    assert M.busbw_GBps("sendrecv", 8, 10**9, 1.0) == pytest.approx(1.0)
+    assert M.busbw_GBps("broadcast", 8, 10**9, 1.0) == pytest.approx(1.0)
+    assert M.busbw_GBps("reduce", 8, 10**9, 1.0) == pytest.approx(1.0)
+    assert M.busbw_GBps("gather", 8, 10**9, 1.0) == pytest.approx(0.875)
+    assert M.busbw_GBps("scatter", 8, 10**9, 1.0) == pytest.approx(0.875)
 
 
 def test_record_roundtrip(tmp_path):

@@ -102,3 +102,23 @@ def test_fused_ops_on_2d_mesh(t2d, op):
         out = np.asarray(t2d.reduce_scatter(x, "fused"))
         want = np.asarray(x).reshape(n, 16).sum(0).reshape(n, -1).reshape(2, 4, 2)
     np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("shift", [1, -1, 3])
+def test_sendrecv_shift(t8, shift):
+    # rank r receives row (r - shift) mod n: the ncclSend/ncclRecv pattern
+    x = t8.shard(_rand((8, 16), seed=9))
+    out = np.asarray(t8.sendrecv(x, shift=shift))
+    np.testing.assert_array_equal(out, np.roll(np.asarray(x), shift, axis=0))
+
+
+def test_sendrecv_roundtrip_identity(t8):
+    x = t8.shard(_rand((8, 16), seed=10))
+    back = np.asarray(t8.sendrecv(t8.sendrecv(x, shift=3), shift=-3))
+    np.testing.assert_array_equal(back, np.asarray(x))
+
+
+def test_sendrecv_2d_rejected(t2d):
+    # a shift permutation is only defined over one ring
+    with pytest.raises(ValueError):
+        t2d.sendrecv(t2d.shard(_rand((2, 4, 8), seed=11)))
