@@ -173,7 +173,8 @@ def _expected(collective: str, x: np.ndarray, mesh2d, *, op: str = "sum",
     elif collective == "scatter":
         out = flat[root].reshape(n, -1)  # row r = chunk r of root's buffer
     elif collective == "sendrecv":
-        out = np.roll(flat, shift, axis=0)
+        from rocnrdma_tpu.collectives.schedule import sim_sendrecv
+        out = sim_sendrecv(flat, shift)
     else:
         raise ValueError(collective)
     return out.reshape(xf.shape[:nlead] + out.shape[1:])

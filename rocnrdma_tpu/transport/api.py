@@ -10,7 +10,8 @@ an algorithm-selection policy:
   schedules (1-D rank mesh).
 - ``"hierarchical"`` — 2-level ICI/DCN schedule (2-D ``('slice','intra')``
   mesh).
-- ``"auto"`` — hierarchical on a multi-slice 2-D mesh, else fused.
+- ``"auto"`` — the measured tuning table (``transport/tuner.py``) when one
+  is attached, else hierarchical on a multi-slice 2-D mesh, else fused.
 
 Data layout contract: the leading array dim(s) are the mesh axes — on a 1-D
 mesh ``x[r]`` is rank r's buffer; on a 2-D mesh ``x[s, i]`` is the buffer of
@@ -145,9 +146,16 @@ def supports(op: str, algo: str, is_2d: bool) -> bool:
 
 
 class Transport:
-    """Collectives over a mesh. Build one per mesh; methods are jit-cached."""
+    """Collectives over a mesh. Build one per mesh; methods are jit-cached.
 
-    def __init__(self, mesh=None):
+    ``tuning`` — optional ``tuner.TuningTable`` (or a path to a saved one):
+    measured per-size algorithm winners consulted when resolving
+    ``algo="auto"`` (the RCCL tuning-table analogue). Without a table, auto
+    keeps the static policy: hierarchical for 2-D-mesh allreduce, else the
+    fused XLA lowering.
+    """
+
+    def __init__(self, mesh=None, tuning=None):
         self.mesh = mesh if mesh is not None else rank_mesh()
         self.axes = self.mesh.axis_names
         if self.axes not in ((RANK_AXIS,), (SLICE_AXIS, INTRA_AXIS)):
@@ -156,15 +164,34 @@ class Transport:
                 f"runtime.slice_mesh()")
         self.n_ranks = math.prod(self.mesh.devices.shape)
         self.is_2d = len(self.axes) == 2
+        if isinstance(tuning, str):
+            from rocnrdma_tpu.transport.tuner import TuningTable
+            tuning = TuningTable.load(tuning)
+        self.tuning = tuning
         self._cache = {}  # (op, algo) -> jitted global-array callable
 
     # -- policy ------------------------------------------------------------
 
-    def _resolve(self, algo: str, op: str) -> str:
-        if algo not in ALGOS:
-            raise ValueError(f"unknown algo {algo!r}; know {ALGOS}")
+    def _resolve(self, algo: str, op: str, nbytes: int | None = None) -> str:
         if op not in SCHEDULES:
             raise ValueError(f"unknown op {op!r}")
+        if algo == "model":
+            # analytic alpha-beta pick among the explicit schedules this mesh
+            # supports; Transport-level policy only (not a bench algo — a
+            # timed "model" row would just duplicate whichever schedule won)
+            from rocnrdma_tpu.transport.tuner import model_pick
+            cands = [a for a in SCHEDULES[op] if supports(op, a, self.is_2d)]
+            picked = (model_pick(op, self.n_ranks, nbytes, candidates=cands)
+                      if nbytes is not None else None)
+            algo = picked or "auto"
+        if algo not in ALGOS:
+            raise ValueError(f"unknown algo {algo!r}; know {ALGOS} + 'model'")
+        if algo == "auto" and self.tuning is not None and nbytes is not None:
+            tuned = self.tuning.lookup(
+                op, nbytes, self.n_ranks, len(self.axes),
+                self.mesh.devices.flat[0].platform)
+            if tuned is not None and supports(op, tuned, self.is_2d):
+                algo = tuned
         if algo == "auto":
             algo = "hierarchical" if (self.is_2d and op == "allreduce") else "fused"
         if not supports(op, algo, self.is_2d):
@@ -177,6 +204,18 @@ class Transport:
     def _spec(self) -> P:
         return P(*self.axes)
 
+    def _msg_bytes(self, verb: str, x) -> int | None:
+        """Message size S — the tuning-table/model size key, matching the
+        bench sweeps' ``size_bytes`` convention: for allgather/gather the
+        input row is already the S/n chunk (S = the gathered total = the
+        whole global input); every other verb's row is the full S."""
+        nbytes = getattr(x, "nbytes", None)
+        if nbytes is None:
+            return None
+        if verb in ("allgather", "gather"):
+            return max(1, nbytes)
+        return max(1, nbytes // self.n_ranks)
+
     def shard(self, x: jax.Array) -> jax.Array:
         """Place a global buffer on the mesh, one leading row per rank
         (the TPU analogue of memory registration/pinning)."""
@@ -187,40 +226,44 @@ class Transport:
     def allreduce(self, x, algo: str = "auto", op: str = "sum"):
         """(ranks..., S) -> same shape; every rank row = elementwise reduction
         (``op``: sum/prod/max/min/avg)."""
-        return self._jit("allreduce", self._resolve(algo, "allreduce"), op=op)(x)
+        return self._jit("allreduce", self._resolve(algo, "allreduce", self._msg_bytes("allreduce", x)), op=op)(x)
 
     def reduce_scatter(self, x, algo: str = "auto", op: str = "sum"):
         """(ranks..., S) -> (ranks..., S/n); rank r keeps the reduced r-th shard."""
-        return self._jit("reduce_scatter", self._resolve(algo, "reduce_scatter"),
+        return self._jit("reduce_scatter",
+                         self._resolve(algo, "reduce_scatter", self._msg_bytes("reduce_scatter", x)),
                          op=op)(x)
 
     def allgather(self, x, algo: str = "auto"):
         """(ranks..., c) -> (ranks..., n*c); every rank ends with the concatenation."""
-        return self._jit("allgather", self._resolve(algo, "allgather"))(x)
+        return self._jit("allgather", self._resolve(algo, "allgather", self._msg_bytes("allgather", x)))(x)
 
     def alltoall(self, x, algo: str = "auto"):
         """(ranks..., n, c) -> same shape, global transpose of rank x chunk dims."""
-        return self._jit("alltoall", self._resolve(algo, "alltoall"))(x)
+        return self._jit("alltoall", self._resolve(algo, "alltoall", self._msg_bytes("alltoall", x)))(x)
 
     def broadcast(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., S) -> same shape; every rank row = root's row."""
-        return self._jit("broadcast", self._resolve(algo, "broadcast"),
+        return self._jit("broadcast",
+                         self._resolve(algo, "broadcast", self._msg_bytes("broadcast", x)),
                          root=root)(x)
 
     def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum"):
         """(ranks..., S) -> same shape; root's row = reduction, others zero."""
-        return self._jit("reduce", self._resolve(algo, "reduce"),
+        return self._jit("reduce", self._resolve(algo, "reduce", self._msg_bytes("reduce", x)),
                          root=root, op=op)(x)
 
     def gather(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., c) -> (ranks..., n*c); root's row = concatenation in
         rank order, others zero."""
-        return self._jit("gather", self._resolve(algo, "gather"), root=root)(x)
+        return self._jit("gather", self._resolve(algo, "gather", self._msg_bytes("gather", x)),
+                         root=root)(x)
 
     def scatter(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., n*c) -> (ranks..., c); rank r's row = chunk r of root's
         row (only root's input is read)."""
-        return self._jit("scatter", self._resolve(algo, "scatter"), root=root)(x)
+        return self._jit("scatter", self._resolve(algo, "scatter", self._msg_bytes("scatter", x)),
+                         root=root)(x)
 
     def sendrecv(self, x, algo: str = "auto", shift: int = 1):
         """(ranks, S) -> same shape; rank r's row = row (r - shift) mod n

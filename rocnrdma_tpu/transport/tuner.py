@@ -1,0 +1,289 @@
+"""Algorithm selection: alpha-beta cost model + empirical autotuner.
+
+The reference stack's RCCL picks algorithm/protocol per collective from
+tuning tables (size x nranks -> ring|tree, with an external "tuner plugin"
+ABI for overrides). This module is that capability rebuilt TPU-native:
+
+- ``model_time(verb, algo, n, nbytes, alpha, beta)`` — the classic
+  alpha-beta (latency / inverse-bandwidth) cost model of each explicit
+  schedule in ``collectives/``. Pure function of the schedule structure:
+  step counts and per-step wire bytes come from the same schedules that
+  ``collectives/schedule.py`` simulates.
+- ``Autotuner.sweep(...)`` — the empirical path: times every compatible
+  algorithm at a size grid on the live mesh and records the winners.
+- ``TuningTable`` — persisted winners (JSON), consulted by
+  ``Transport(..., tuning=...)`` when resolving ``algo="auto"``; on a table
+  miss auto falls back to the static default (fused / hierarchical). The
+  analytic model is its own policy: ``algo="model"`` asks ``model_pick``
+  for the cheapest modeled schedule at this size (measurement-free — the
+  pick for hardware you have not swept yet).
+
+Size keys everywhere are the bench sweeps' ``size_bytes`` convention
+(``Transport._msg_bytes``): message size S per rank — for allgather/gather
+that is the gathered total, i.e. the whole global input.
+
+Size-bucket semantics match the RCCL-style table shape: a sorted list of
+``(max_bytes, algo)`` thresholds per (verb, n_ranks, mesh-dim, platform);
+lookup takes the first bucket whose ``max_bytes`` covers the message.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+
+# Default model constants (seconds, seconds/byte). These are order-of-
+# magnitude ICI figures (~1.5us dispatch+hop latency; ~1/(100 GB/s) per
+# link); the model's job is RANKING algorithms, and every ranking below is
+# driven by the ratio alpha/beta (the latency-bandwidth crossover point),
+# not the absolute scale.
+ALPHA_S = 1.5e-6
+BETA_S_PER_B = 1.0e-11
+
+
+def _L(n: int) -> int:
+    """ceil(log2 n) — step count of the log-depth schedules."""
+    return max(1, math.ceil(math.log2(n)))
+
+
+# (steps, wire_bytes_factor) per (verb, algo): T = steps*alpha + factor*S*beta.
+# ``factor`` is the serialized bytes-on-the-critical-link per buffer byte —
+# exactly the busbw accounting of metrics.py read backwards. ``ring_bidir``
+# halves the beta term (two counter-rotating rings share the load; links are
+# full-duplex) at the same step count. Bruck trades (n-1) steps for log2(n)
+# steps moving S/2 each — the small-message alltoall of the MPI literature.
+_MODEL = {
+    ("allreduce", "ring"): lambda n: (2 * (n - 1), 2 * (n - 1) / n),
+    ("allreduce", "ring_bidir"): lambda n: (2 * (n - 1), (n - 1) / n),
+    ("allreduce", "tree"): lambda n: (2 * _L(n), 2 * (n - 1) / n),
+    ("allreduce", "pallas_ring"): lambda n: (2 * (n - 1), 2 * (n - 1) / n),
+    ("reduce_scatter", "ring"): lambda n: (n - 1, (n - 1) / n),
+    ("allgather", "ring"): lambda n: (n - 1, (n - 1) / n),
+    ("allgather", "pallas_ring"): lambda n: (n - 1, (n - 1) / n),
+    ("alltoall", "ring"): lambda n: (n - 1, (n - 1) / n),   # rotation
+    ("alltoall", "bruck"): lambda n: (_L(n), _L(n) / 2),
+    ("broadcast", "binomial"): lambda n: (_L(n), _L(n)),
+    ("reduce", "binomial"): lambda n: (_L(n), _L(n)),
+    ("gather", "binomial"): lambda n: (_L(n), (n - 1) / n),
+    ("scatter", "binomial"): lambda n: (_L(n), (n - 1) / n),
+    ("sendrecv", "fused"): lambda n: (1, 1.0),
+}
+
+
+def model_time(verb: str, algo: str, n: int, nbytes: int,
+               alpha: float = ALPHA_S, beta: float = BETA_S_PER_B) -> float:
+    """Predicted seconds for ``algo`` moving an ``nbytes`` buffer over ``n``
+    ranks. Raises KeyError for pairs the model does not cover (fused XLA
+    lowerings are measured, not modeled — XLA's internal schedule is opaque)."""
+    steps, factor = _MODEL[(verb, algo)](n)
+    return steps * alpha + factor * nbytes * beta
+
+
+def model_pick(verb: str, n: int, nbytes: int, candidates=None,
+               alpha: float = ALPHA_S, beta: float = BETA_S_PER_B) -> str | None:
+    """Cheapest modeled algorithm for this point, or None if none modeled."""
+    best, best_t = None, float("inf")
+    for (v, algo), _ in _MODEL.items():
+        if v != verb or (candidates is not None and algo not in candidates):
+            continue
+        t = model_time(verb, algo, n, nbytes, alpha, beta)
+        if t < best_t:
+            best, best_t = algo, t
+    return best
+
+
+@dataclasses.dataclass
+class Bucket:
+    max_bytes: int  # bucket covers sizes <= max_bytes (last bucket: +inf)
+    algo: str
+
+
+class TuningTable:
+    """Measured winners: (verb, n_ranks, mesh_ndim, platform) -> [Bucket].
+
+    The persisted form is the whole point (BASELINE-style reproducibility):
+    a sweep on real hardware is captured once and reused by every later
+    ``Transport`` without re-timing.
+    """
+
+    def __init__(self, entries: dict | None = None):
+        # key: "verb|n|ndim|platform" -> sorted [Bucket]
+        self._entries: dict[str, list[Bucket]] = entries or {}
+
+    @staticmethod
+    def _key(verb: str, n_ranks: int, mesh_ndim: int, platform: str) -> str:
+        return f"{verb}|{n_ranks}|{mesh_ndim}|{platform}"
+
+    def set_buckets(self, verb: str, n_ranks: int, mesh_ndim: int,
+                    platform: str, buckets: list[Bucket]) -> None:
+        self._entries[self._key(verb, n_ranks, mesh_ndim, platform)] = sorted(
+            buckets, key=lambda b: b.max_bytes)
+
+    def lookup(self, verb: str, nbytes: int, n_ranks: int, mesh_ndim: int,
+               platform: str) -> str | None:
+        buckets = self._entries.get(self._key(verb, n_ranks, mesh_ndim, platform))
+        if not buckets:
+            return None
+        for b in buckets:
+            if nbytes <= b.max_bytes:
+                return b.algo
+        return buckets[-1].algo  # beyond the largest measured size
+
+    def merge(self, other: "TuningTable") -> None:
+        """Later tables win (re-tuning overwrites)."""
+        self._entries.update(other._entries)
+
+    # -- persistence -------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {k: [[b.max_bytes, b.algo] for b in v]
+                for k, v in self._entries.items()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TuningTable":
+        return cls({k: [Bucket(int(mb), a) for mb, a in v]
+                    for k, v in d.items()})
+
+    def save(self, path: str) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fp:
+            json.dump(self.to_dict(), fp, indent=1, sort_keys=True)
+        os.replace(tmp, path)  # atomic: a concurrent reader never sees a torn file
+
+    @classmethod
+    def load(cls, path: str) -> "TuningTable":
+        with open(path) as fp:
+            return cls.from_dict(json.load(fp))
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class Autotuner:
+    """Times every compatible algorithm per (verb, size) on a live Transport
+    and distills the winners into a TuningTable."""
+
+    def __init__(self, transport, warmup: int = 1, repeats: int = 3,
+                 calls_per_repeat: int = 3):
+        self.t = transport
+        self.warmup = warmup
+        self.repeats = repeats
+        self.calls = calls_per_repeat
+
+    def _candidates(self, verb: str, algos=None) -> list[str]:
+        from rocnrdma_tpu.transport.api import SCHEDULES, supports
+        cands = [a for a in SCHEDULES[verb] if supports(verb, a, self.t.is_2d)]
+        if algos is not None:
+            cands = [a for a in cands if a in algos]
+        else:
+            # the pallas data plane is opt-in: under CPU interpret mode it is
+            # orders of magnitude slower than a real run, which would both
+            # waste sweep time and poison the table with a meaningless loss
+            cands = [a for a in cands if not a.startswith("pallas")]
+        return cands
+
+    def _example(self, verb: str, size_bytes: int, dtype: str):
+        # the bench runner owns per-collective shape/divisibility rules;
+        # reuse them so tuner sizes mean exactly what sweep sizes mean
+        from rocnrdma_tpu.bench.runner import _build_input
+
+        collective = verb.replace("_", "")
+        mesh2d = self.t.mesh.devices.shape if self.t.is_2d else None
+        x, _ = _build_input(collective, self.t.n_ranks, mesh2d, size_bytes,
+                            dtype)
+        return self.t.shard(x)
+
+    def sweep(self, verbs, sizes, dtype: str = "float32",
+              algos=None, progress=None) -> TuningTable:
+        """Measure; return a table with one bucket list per swept verb."""
+        from rocnrdma_tpu.bench.timing import time_fn
+
+        table = TuningTable()
+        plat = self.t.mesh.devices.flat[0].platform
+        ndim = len(self.t.mesh.axis_names)
+        for verb in verbs:
+            buckets = []
+            for size in sorted(sizes):
+                xs = self._example(verb, size, dtype)
+                best, best_s = None, float("inf")
+                for algo in self._candidates(verb, algos):
+                    fn = self.t.jit_fn(verb, algo)
+                    timing = time_fn(fn, xs, warmup=self.warmup,
+                                     repeats=self.repeats,
+                                     calls_per_repeat=self.calls)
+                    if progress:
+                        progress(verb, size, algo, timing.mean_s)
+                    if timing.mean_s < best_s:
+                        best, best_s = algo, timing.mean_s
+                if best is not None:
+                    buckets.append(Bucket(size, best))
+            if buckets:
+                table.set_buckets(verb, self.t.n_ranks, ndim, plat,
+                                  _coalesce(buckets))
+        return table
+
+
+def _coalesce(buckets: list[Bucket]) -> list[Bucket]:
+    """Adjacent same-algo buckets collapse to the larger threshold."""
+    out: list[Bucket] = []
+    for b in sorted(buckets, key=lambda b: b.max_bytes):
+        if out and out[-1].algo == b.algo:
+            out[-1] = Bucket(b.max_bytes, b.algo)
+        else:
+            out.append(b)
+    return out
+
+
+def main(argv=None) -> int:
+    """CLI: tune on the live backend and write the table.
+
+    python -m rocnrdma_tpu.transport.tuner --fake-devices 8 \
+        --verbs allreduce,alltoall --sizes 4K,64K,1M --out tuning.json
+    """
+    import argparse
+
+    from rocnrdma_tpu.bench.cli_common import build_mesh, setup_backend
+    from rocnrdma_tpu.bench.runner import parse_size
+    from rocnrdma_tpu.transport import Transport
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--verbs", default="allreduce,alltoall,allgather")
+    p.add_argument("--sizes", default="4K,64K,1M,16M")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16", "float16"])
+    p.add_argument("--algos", default=None,
+                   help="comma list restricting the candidate algorithms")
+    p.add_argument("--ranks", type=int, default=None)
+    p.add_argument("--mesh2d", default=None)
+    p.add_argument("--fake-devices", type=int, default=None)
+    p.add_argument("--platform", default="any", choices=["any", "cpu"])
+    p.add_argument("--out", default="tuning.json")
+    p.add_argument("--merge", action="store_true",
+                   help="merge into an existing --out instead of replacing")
+    args = p.parse_args(argv)
+
+    info = setup_backend(args.fake_devices, args.platform, args.ranks)
+    mesh = build_mesh(args.mesh2d, args.ranks, info.topology)
+    t = Transport(mesh)
+    tuner = Autotuner(t)
+    sizes = [parse_size(s) for s in args.sizes.split(",")]
+
+    def progress(verb, size, algo, sec):
+        print(f"  {verb:>14} {size:>12} B {algo:>12} {sec * 1e6:>10.1f} us")
+
+    table = tuner.sweep(args.verbs.split(","), sizes, args.dtype,
+                        args.algos.split(",") if args.algos else None,
+                        progress=progress)
+    if args.merge and os.path.exists(args.out):
+        base = TuningTable.load(args.out)
+        base.merge(table)
+        table = base
+    table.save(args.out)
+    print(f"wrote {args.out}: {json.dumps(table.to_dict(), indent=1, sort_keys=True)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,177 @@
+"""Algorithm-selection tuner: cost model, table persistence, auto policy."""
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.transport import Transport
+from rocnrdma_tpu.transport.tuner import (
+    Autotuner, Bucket, TuningTable, model_pick, model_time)
+
+
+# ---------------------------------------------------------------- cost model
+
+def test_model_bruck_wins_small_alltoall():
+    # log-step schedule beats (n-1)-step rotation when latency dominates
+    n, small = 8, 256
+    assert (model_time("alltoall", "bruck", n, small)
+            < model_time("alltoall", "ring", n, small))
+
+
+def test_model_rotation_wins_large_alltoall():
+    # rotation moves (n-1)/n * S total; bruck moves log2(n)/2 * S — more wire
+    # bytes, so bandwidth-bound sizes flip the ranking
+    n, big = 8, 64 * M.MiB
+    assert (model_time("alltoall", "ring", n, big)
+            < model_time("alltoall", "bruck", n, big))
+
+
+def test_model_tree_wins_small_allreduce_ring_bidir_wins_large():
+    n = 8
+    assert model_pick("allreduce", n, 1024,
+                      candidates=("ring", "ring_bidir", "tree")) == "tree"
+    assert model_pick("allreduce", n, 256 * M.MiB,
+                      candidates=("ring", "ring_bidir", "tree")) == "ring_bidir"
+
+
+def test_model_unknown_pair_raises():
+    with pytest.raises(KeyError):
+        model_time("allreduce", "fused", 8, 1024)  # fused is measured, not modeled
+
+
+def test_model_pick_none_for_unmodeled_candidates():
+    assert model_pick("allreduce", 8, 1024, candidates=("fused",)) is None
+
+
+# --------------------------------------------------------------- table logic
+
+def _table_with(verb="allreduce", n=8, ndim=1, plat="cpu", buckets=None):
+    t = TuningTable()
+    t.set_buckets(verb, n, ndim, plat,
+                  buckets or [Bucket(4096, "tree"), Bucket(1 << 20, "ring_bidir")])
+    return t
+
+
+def test_table_lookup_buckets():
+    t = _table_with()
+    assert t.lookup("allreduce", 100, 8, 1, "cpu") == "tree"
+    assert t.lookup("allreduce", 4096, 8, 1, "cpu") == "tree"
+    assert t.lookup("allreduce", 4097, 8, 1, "cpu") == "ring_bidir"
+    # beyond the largest measured size: last bucket extends to +inf
+    assert t.lookup("allreduce", 1 << 30, 8, 1, "cpu") == "ring_bidir"
+    # a different (verb, ranks, ndim, platform) is a miss
+    assert t.lookup("allreduce", 100, 4, 1, "cpu") is None
+    assert t.lookup("alltoall", 100, 8, 1, "cpu") is None
+
+
+def test_table_save_load_merge(tmp_path):
+    path = str(tmp_path / "tuning.json")
+    t = _table_with()
+    t.save(path)
+    back = TuningTable.load(path)
+    assert back.lookup("allreduce", 100, 8, 1, "cpu") == "tree"
+
+    other = _table_with(verb="alltoall", buckets=[Bucket(1 << 20, "bruck")])
+    back.merge(other)
+    assert back.lookup("alltoall", 5, 8, 1, "cpu") == "bruck"
+    assert back.lookup("allreduce", 100, 8, 1, "cpu") == "tree"
+
+
+# ----------------------------------------------------------- transport wiring
+
+def test_auto_respects_tuning_table():
+    mesh = rt.rank_mesh(4)
+    table = TuningTable()
+    table.set_buckets("allreduce", 4, 1, "cpu", [Bucket(1 << 40, "ring")])
+    t = Transport(mesh, tuning=table)
+    assert t._resolve("auto", "allreduce", nbytes=1024) == "ring"
+    # verbs without a table entry keep the static default
+    assert t._resolve("auto", "alltoall", nbytes=1024) == "fused"
+    # explicit algo is never overridden
+    assert t._resolve("tree", "allreduce", nbytes=1024) == "tree"
+
+
+def test_auto_ignores_incompatible_tuned_algo():
+    # a 1-D table entry naming a 2-D-only schedule must not leak through
+    mesh = rt.rank_mesh(4)
+    table = TuningTable()
+    table.set_buckets("allreduce", 4, 1, "cpu", [Bucket(1 << 40, "hierarchical")])
+    t = Transport(mesh, tuning=table)
+    assert t._resolve("auto", "allreduce", nbytes=1024) == "fused"
+
+
+def test_tuned_transport_end_to_end(tmp_path):
+    mesh = rt.rank_mesh(4)
+    table = TuningTable()
+    table.set_buckets("allreduce", 4, 1, "cpu", [Bucket(1 << 40, "ring")])
+    path = str(tmp_path / "t.json")
+    table.save(path)
+    t = Transport(mesh, tuning=path)  # path form
+    x = t.shard(np.random.default_rng(0).normal(size=(4, 64)).astype(np.float32))
+    out = np.asarray(t.allreduce(x, "auto"))
+    np.testing.assert_allclose(
+        out, np.broadcast_to(np.asarray(x).sum(0), out.shape), rtol=1e-5, atol=1e-6)
+
+
+# -------------------------------------------------------------- empirical sweep
+
+def test_autotune_sweep_and_use():
+    mesh = rt.rank_mesh(4)
+    t = Transport(mesh)
+    tuner = Autotuner(t, warmup=1, repeats=1, calls_per_repeat=1)
+    seen = []
+    table = tuner.sweep(["allreduce"], [1024, 65536],
+                        algos=("fused", "ring", "tree"),
+                        progress=lambda *a: seen.append(a))
+    # every candidate timed at every size
+    assert {(v, s, a) for v, s, a, _ in seen} == {
+        ("allreduce", s, a) for s in (1024, 65536)
+        for a in ("fused", "ring", "tree")}
+    picked = table.lookup("allreduce", 2048, 4, 1, "cpu")
+    assert picked in ("fused", "ring", "tree")
+    # the table plugs straight back into a Transport and still computes
+    t2 = Transport(mesh, tuning=table)
+    x = t2.shard(np.random.default_rng(1).normal(size=(4, 32)).astype(np.float32))
+    out = np.asarray(t2.allreduce(x, "auto"))
+    np.testing.assert_allclose(
+        out, np.broadcast_to(np.asarray(x).sum(0), out.shape), rtol=1e-5, atol=1e-6)
+
+
+def test_model_policy_via_transport():
+    mesh = rt.rank_mesh(8)
+    t = Transport(mesh)
+    # small alltoall: the model picks the log-step schedule
+    assert t._resolve("model", "alltoall", nbytes=256) == "bruck"
+    # large alltoall: rotation moves fewer wire bytes
+    assert t._resolve("model", "alltoall", nbytes=64 * M.MiB) == "ring"
+    # no size available -> model degrades to auto's static default
+    assert t._resolve("model", "allreduce", nbytes=None) == "fused"
+    # end-to-end: model-resolved collective still computes correctly
+    x = t.shard(np.random.default_rng(2).normal(size=(8, 8, 16)).astype(np.float32))
+    out = np.asarray(t.alltoall(x, "model"))
+    np.testing.assert_allclose(out, np.asarray(x).transpose(1, 0, 2),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_allgather_size_key_matches_tuner_convention():
+    # the tuner records allgather buckets keyed by the gathered total S; the
+    # transport must look up with the same S for the identical input array
+    mesh = rt.rank_mesh(4)
+    t = Transport(mesh)
+    tuner = Autotuner(t)
+    S = 65536
+    xs = tuner._example("allgather", S, "float32")
+    assert t._msg_bytes("allgather", xs) == S
+    # full-row verbs key by the per-rank row S
+    xr = tuner._example("allreduce", S, "float32")
+    assert t._msg_bytes("allreduce", xr) == S
+
+
+def test_autotune_2d_mesh_candidates():
+    mesh = rt.slice_mesh(2, 2)
+    t = Transport(mesh)
+    tuner = Autotuner(t, warmup=1, repeats=1, calls_per_repeat=1)
+    table = tuner.sweep(["allreduce"], [1024])
+    picked = table.lookup("allreduce", 1024, 4, 2, "cpu")
+    assert picked in ("fused", "hierarchical")  # the only 2-D-legal algos
