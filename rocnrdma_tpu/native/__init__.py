@@ -21,7 +21,8 @@ import os
 import subprocess
 import threading
 
-_SRC = os.path.join(os.path.dirname(__file__), "rqp.cpp")
+_SRCS = [os.path.join(os.path.dirname(__file__), f)
+         for f in ("rqp.cpp", "rtcp.cpp")]
 _LIB_DIR = os.environ.get("RQP_LIB_DIR") or os.path.join(
     os.path.dirname(__file__), "_build")
 _LIB = os.path.join(_LIB_DIR, "librqp.so")
@@ -52,16 +53,16 @@ class Completion:
 
 
 def build(force: bool = False) -> str:
-    """Compile ``rqp.cpp`` → ``librqp.so`` with the system g++ (cached)."""
+    """Compile rqp.cpp + rtcp.cpp → ``librqp.so`` with system g++ (cached)."""
     with _build_lock:
         stale = (force or not os.path.exists(_LIB)
-                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+                 or os.path.getmtime(_LIB) < max(map(os.path.getmtime, _SRCS)))
         if stale:
             os.makedirs(_LIB_DIR, exist_ok=True)
             tmp = _LIB + f".tmp.{os.getpid()}"
             subprocess.run(
                 ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
-                 _SRC, "-pthread"],
+                 *_SRCS, "-pthread"],
                 check=True, capture_output=True, text=True)
             os.replace(tmp, _LIB)  # atomic: concurrent builders don't clash
     return _LIB
@@ -93,6 +94,30 @@ def _load():
     lib.rqp_close.argtypes = [ctypes.c_void_p]
     lib.rqp_unlink.restype = ctypes.c_int
     lib.rqp_unlink.argtypes = [ctypes.c_char_p]
+    lib.rtcp_listen.restype = ctypes.c_void_p
+    lib.rtcp_listen.argtypes = [ctypes.c_uint16]
+    lib.rtcp_listen_port.restype = ctypes.c_int
+    lib.rtcp_listen_port.argtypes = [ctypes.c_void_p]
+    lib.rtcp_accept.restype = ctypes.c_void_p
+    lib.rtcp_accept.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rtcp_connect.restype = ctypes.c_void_p
+    lib.rtcp_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                 ctypes.c_int]
+    lib.rtcp_post_send.restype = ctypes.c_int64
+    lib.rtcp_post_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint32]
+    lib.rtcp_post_recv.restype = ctypes.c_int64
+    lib.rtcp_post_recv.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_uint32]
+    lib.rtcp_poll_cq.restype = ctypes.c_int
+    lib.rtcp_poll_cq.argtypes = [ctypes.c_void_p, ctypes.POINTER(_CQE),
+                                 ctypes.c_int]
+    lib.rtcp_tx_pending.restype = ctypes.c_uint64
+    lib.rtcp_tx_pending.argtypes = [ctypes.c_void_p]
+    lib.rtcp_close.restype = None
+    lib.rtcp_close.argtypes = [ctypes.c_void_p]
+    lib.rtcp_close_listener.restype = None
+    lib.rtcp_close_listener.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -106,49 +131,39 @@ def available() -> bool:
         return False
 
 
-class QueuePair:
-    """One endpoint of a shared-memory queue pair.
+class _QpBase:
+    """Work-request plumbing shared by both wire planes (shm ``rqp_*`` and
+    TCP ``rtcp_*``): posted-receive buffer ownership, completion draining,
+    the bounded-retry blocking send/recv helpers, teardown. Subclasses bind
+    a C-symbol prefix and add their plane's connection setup."""
 
-    ``QueuePair.listen(name)`` creates the channel; ``QueuePair.connect(name)``
-    attaches the peer. Both then use verbs-style ``post_send`` /
-    ``post_recv`` / ``poll_cq``. Posted receive *buffers* (bytearrays) stay
-    owned by the QP until their completion is polled, mirroring memory
-    registration: the buffer handed to ``post_recv`` is the registered MR.
-    """
+    _PREFIX = ""                 # "rqp" | "rtcp"
+    MAX_MSG = (1 << 32) - 1      # u32 frame bound; planes may tighten
 
-    def __init__(self, handle: int, name: str, is_listener: bool):
+    def __init__(self, handle: int, name: str):
         if not handle:
-            raise OSError(f"rqp: could not open queue pair {name!r}")
+            raise OSError(f"{self._PREFIX}: could not open {name!r}")
         self._h = handle
         self.name = name
-        self.is_listener = is_listener
         self._recv_bufs: dict[int, bytearray] = {}
         self._closed = False
 
-    # -- connection setup (listen / connect / accept) ----------------------
-
-    @classmethod
-    def listen(cls, name: str, capacity: int = 1 << 20) -> "QueuePair":
-        lib = _load()
-        lib.rqp_unlink(name.encode())  # drop stale segment from a dead run
-        return cls(lib.rqp_listen(name.encode(), capacity), name, True)
-
-    @classmethod
-    def connect(cls, name: str, timeout_s: float = 10.0) -> "QueuePair":
-        lib = _load()
-        return cls(lib.rqp_connect(name.encode(), int(timeout_s * 1000)),
-                   name, False)
-
-    def accept(self, timeout_s: float = 10.0) -> None:
-        """Block until the peer has attached."""
-        if _load().rqp_accept(self._h, int(timeout_s * 1000)) != 0:
-            raise TimeoutError(f"rqp: peer never attached to {self.name!r}")
+    def _fn(self, op: str):
+        return getattr(_load(), f"{self._PREFIX}_{op}")
 
     # -- work requests -----------------------------------------------------
 
     def post_send(self, data: bytes) -> int:
-        """Queue ``data`` for the peer; returns wr_id, or -1 if ring full."""
-        return _load().rqp_post_send(self._h, bytes(data), len(data))
+        """Queue ``data`` for the peer; wr_id, or -1 on backpressure (retry),
+        or -2 when the connection is dead."""
+        data = bytes(data)
+        if len(data) > self.MAX_MSG:
+            # ctypes would silently wrap the u32 length — a >4 GiB payload
+            # must be an error, not a tiny frame with an OK completion
+            raise ValueError(
+                f"{self._PREFIX}: {len(data)} B message exceeds the "
+                f"{self.MAX_MSG} B frame bound; chunk at the caller")
+        return self._fn("post_send")(self._h, data, len(data))
 
     def send(self, data: bytes, timeout_s: float = 10.0) -> int:
         """``post_send`` with bounded retry on backpressure."""
@@ -158,15 +173,18 @@ class QueuePair:
             wr = self.post_send(data)
             if wr >= 0:
                 return wr
+            if wr == -2:
+                raise OSError(f"{self._PREFIX}: peer closed/reset on {self.name!r}")
             if time.monotonic() >= deadline:
-                raise TimeoutError(f"rqp: send ring full on {self.name!r}")
+                raise TimeoutError(f"{self._PREFIX}: send backpressured past "
+                                   f"deadline on {self.name!r}")
             time.sleep(0.0005)
 
     def post_recv(self, nbytes: int) -> int:
         """Register a receive buffer of ``nbytes``; returns its wr_id."""
         buf = bytearray(nbytes)
         cbuf = (ctypes.c_char * nbytes).from_buffer(buf)
-        wr = _load().rqp_post_recv(self._h, cbuf, nbytes)
+        wr = self._fn("post_recv")(self._h, cbuf, nbytes)
         if wr >= 0:
             self._recv_bufs[wr] = buf
         return wr
@@ -174,7 +192,9 @@ class QueuePair:
     def poll_cq(self, max_cqes: int = 16) -> list[tuple[Completion, bytes | None]]:
         """Drain completions; each recv completion carries its payload."""
         arr = (_CQE * max_cqes)()
-        n = _load().rqp_poll_cq(self._h, arr, max_cqes)
+        n = self._fn("poll_cq")(self._h, arr, max_cqes)
+        if n == -2:
+            raise OSError(f"{self._PREFIX}: peer closed/reset on {self.name!r}")
         out = []
         for i in range(max(n, 0)):
             c = Completion(arr[i].wr_id, arr[i].opcode, arr[i].status,
@@ -200,15 +220,13 @@ class QueuePair:
             for c, payload in self.poll_cq():
                 if c.opcode == OP_RECV:
                     if c.status != OK:
-                        raise OSError(f"rqp: recv truncated on {self.name!r}")
+                        raise OSError(
+                            f"{self._PREFIX}: recv truncated on {self.name!r}")
                     return payload
             if time.monotonic() >= deadline:
-                raise TimeoutError(f"rqp: recv timed out on {self.name!r}")
+                raise TimeoutError(
+                    f"{self._PREFIX}: recv timed out on {self.name!r}")
             time.sleep(0.0005)
-
-    def rx_pending(self) -> int:
-        """Unread bytes in the incoming ring (diagnostics)."""
-        return _load().rqp_rx_pending(self._h)
 
     # -- teardown ----------------------------------------------------------
 
@@ -217,9 +235,11 @@ class QueuePair:
             self._closed = True
             # drop ctypes views into posted bytearrays before freeing them
             self._recv_bufs.clear()
-            _load().rqp_close(self._h)
-            if self.is_listener:
-                _load().rqp_unlink(self.name.encode())
+            self._fn("close")(self._h)
+            self._post_close()
+
+    def _post_close(self) -> None:
+        """Plane-specific cleanup hook (shm unlink etc.)."""
 
     def __enter__(self):
         return self
@@ -232,3 +252,117 @@ class QueuePair:
             self.close()
         except Exception:
             pass
+
+
+class QueuePair(_QpBase):
+    """One endpoint of a shared-memory queue pair.
+
+    ``QueuePair.listen(name)`` creates the channel; ``QueuePair.connect(name)``
+    attaches the peer. Both then use verbs-style ``post_send`` /
+    ``post_recv`` / ``poll_cq``. Posted receive *buffers* (bytearrays) stay
+    owned by the QP until their completion is polled, mirroring memory
+    registration: the buffer handed to ``post_recv`` is the registered MR.
+    """
+
+    _PREFIX = "rqp"
+
+    def __init__(self, handle: int, name: str, is_listener: bool):
+        super().__init__(handle, name)
+        self.is_listener = is_listener
+
+    # -- connection setup (listen / connect / accept) ----------------------
+
+    @classmethod
+    def listen(cls, name: str, capacity: int = 1 << 20) -> "QueuePair":
+        lib = _load()
+        lib.rqp_unlink(name.encode())  # drop stale segment from a dead run
+        return cls(lib.rqp_listen(name.encode(), capacity), name, True)
+
+    @classmethod
+    def connect(cls, name: str, timeout_s: float = 10.0) -> "QueuePair":
+        lib = _load()
+        return cls(lib.rqp_connect(name.encode(), int(timeout_s * 1000)),
+                   name, False)
+
+    def accept(self, timeout_s: float = 10.0) -> None:
+        """Block until the peer has attached."""
+        if _load().rqp_accept(self._h, int(timeout_s * 1000)) != 0:
+            raise TimeoutError(f"rqp: peer never attached to {self.name!r}")
+
+    def rx_pending(self) -> int:
+        """Unread bytes in the incoming ring (diagnostics)."""
+        return _load().rqp_rx_pending(self._h)
+
+    def _post_close(self) -> None:
+        if self.is_listener:
+            _load().rqp_unlink(self.name.encode())
+
+
+class TcpListener:
+    """Listening endpoint of the TCP plane (``rtcp.cpp``).
+
+    ``TcpListener()`` binds an ephemeral port; ``.handle`` ("host:port") is
+    the out-of-band connection handle; ``.accept()`` yields one
+    :class:`TcpQueuePair` per inbound peer (a listener can serve many).
+    """
+
+    def __init__(self, port: int = 0, host: str | None = None):
+        self._h = _load().rtcp_listen(port)
+        if not self._h:
+            raise OSError(f"rtcp: could not listen on port {port}")
+        self.port = _load().rtcp_listen_port(self._h)
+        # the address peers dial: overridable for multi-host, loopback default
+        self.host = host or os.environ.get("RTCP_HOST", "127.0.0.1")
+        self.handle = f"{self.host}:{self.port}"
+        self._closed = False
+
+    def accept(self, timeout_s: float = 10.0) -> "TcpQueuePair":
+        conn = _load().rtcp_accept(self._h, int(timeout_s * 1000))
+        if not conn:
+            raise TimeoutError(f"rtcp: no peer dialed {self.handle!r}")
+        return TcpQueuePair(conn, self.handle)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            _load().rtcp_close_listener(self._h)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class TcpQueuePair(_QpBase):
+    """One connected TCP queue pair: ``QueuePair``'s verbs surface, cross-host.
+
+    Same work-request contract as the shm plane, a real socket underneath,
+    so callers like the net-plugin's ``_HostComm`` run unchanged over either
+    wire.
+    """
+
+    _PREFIX = "rtcp"
+    MAX_MSG = (64 << 20) - 4     # the rtcp tx-queue cap, minus frame header
+    is_listener = False          # no shm segment to unlink at close
+
+    @classmethod
+    def connect(cls, handle: str, timeout_s: float = 10.0) -> "TcpQueuePair":
+        """Dial a listener's ``"host:port"`` handle (retries until timeout)."""
+        host, port = handle.rsplit(":", 1)
+        conn = _load().rtcp_connect(host.encode(), int(port),
+                                    int(timeout_s * 1000))
+        return cls(conn, handle)
+
+    def accept(self, timeout_s: float = 10.0) -> None:
+        """Connected at construction — verbs parity no-op."""
+
+    def tx_pending(self) -> int:
+        """Bytes queued but not yet handed to the kernel (diagnostics)."""
+        return _load().rtcp_tx_pending(self._h)

@@ -1,0 +1,350 @@
+// rtcp — TCP queue pairs: the rqp verbs contract over a real network socket.
+//
+// rqp.cpp gives the framework ibverbs-shaped queue pairs whose "wire" is a
+// shared-memory segment — single-host only, the loopback analogue of the
+// reference's NIC. This file is the cross-host half: the SAME post_send /
+// post_recv / poll_cq contract over TCP, so the host control plane (and the
+// gloo-analogue collectives riding the net-plugin vtable) span machines the
+// way the reference's RDMA path did. RC-over-IP in spirit: reliable,
+// connected, message-framed (4-byte length prefix; TCP_NODELAY).
+//
+// Exported C ABI (consumed by rocnrdma_tpu/native/__init__.py via ctypes):
+//   rtcp_listen(port)                     -> listener (port 0 = ephemeral)
+//   rtcp_listen_port(l)                   -> bound port
+//   rtcp_accept(l, timeout_ms)            -> conn
+//   rtcp_connect(host, port, timeout_ms)  -> conn  (retries until deadline,
+//                                            so connect-before-listen races
+//                                            resolve like verbs rendezvous)
+//   rtcp_post_send(c, buf, len) -> wr_id  (-1: tx queue full, retry)
+//   rtcp_post_recv(c, buf, cap) -> wr_id
+//   rtcp_poll_cq(c, cqes, max)  -> n      (THE progress engine: flushes tx,
+//                                          parses rx frames, fills WRs)
+//   rtcp_tx_pending(c) / rtcp_close(c) / rtcp_close_listener(l)
+//
+// Completion semantics: a send completes once every byte of its frame has
+// been handed to the kernel (buffer reusable — the verbs contract); a recv
+// completes when a whole message has landed in the oldest posted buffer,
+// RQP_ERR_TRUNC if it didn't fit. Sockets are non-blocking; all progress
+// happens inside post_send/poll_cq calls — no background threads.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+// CQE layout shared with rqp.cpp (keep field-for-field identical).
+struct Cqe {
+  int64_t wr_id;
+  int32_t opcode;  // 0 = send, 1 = recv
+  int32_t status;  // 0 = ok, 1 = truncated
+  uint32_t len;
+  uint32_t pad_;
+};
+
+enum { OP_SEND = 0, OP_RECV = 1, ST_OK = 0, ST_TRUNC = 1 };
+
+constexpr uint64_t kTxCapBytes = 64ull << 20;  // pending-tx bound per conn
+constexpr int kMaxStagedMsgs = 64;             // parsed-but-unclaimed inbound
+// Largest frame a peer may announce. Our own sender can never exceed the tx
+// cap, so anything bigger is a corrupt or hostile header — without this cap
+// a 4-byte 0xFFFFFFFF header would drive a ~4 GiB reserve() on the receiver.
+constexpr uint32_t kMaxFrameBytes = uint32_t(kTxCapBytes);
+
+uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000 + uint64_t(ts.tv_nsec) / 1000000;
+}
+
+struct Listener {
+  int fd = -1;
+  uint16_t port = 0;
+};
+
+struct TxMsg {
+  int64_t wr_id;
+  std::vector<char> frame;  // [len u32][payload]
+  size_t sent = 0;
+};
+
+struct RecvWr {
+  int64_t wr_id;
+  void* buf;
+  uint32_t cap;
+};
+
+struct RxMsg {
+  std::vector<char> payload;
+};
+
+struct Conn {
+  int fd = -1;
+  int64_t next_wr = 1;
+  bool broken = false;
+  bool eof = false;  // peer sent orderly FIN
+  std::deque<TxMsg> txq;
+  uint64_t tx_bytes = 0;               // queued-not-yet-written bytes
+  std::deque<int64_t> send_done;       // completed sends awaiting poll
+  std::deque<RecvWr> recv_q;           // posted receive buffers, FIFO
+  std::deque<RxMsg> staged;            // parsed messages with no WR yet
+  // rx parse state
+  char hdr[4];
+  uint32_t hdr_have = 0;
+  std::vector<char> cur;               // payload in flight
+  uint32_t cur_len = 0;
+  bool mid_msg = false;
+};
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void tune(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  set_nonblock(fd);
+}
+
+// Flush as much queued tx as the kernel will take; emit send completions.
+void pump_tx(Conn* c) {
+  while (!c->txq.empty()) {
+    TxMsg& m = c->txq.front();
+    while (m.sent < m.frame.size()) {
+      ssize_t n = send(c->fd, m.frame.data() + m.sent, m.frame.size() - m.sent,
+                       MSG_NOSIGNAL);
+      if (n > 0) {
+        m.sent += size_t(n);
+        c->tx_bytes -= uint64_t(n);
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return;  // kernel buffer full; try again at next progress call
+      } else {
+        c->broken = true;
+        return;
+      }
+    }
+    c->send_done.push_back(m.wr_id);
+    c->txq.pop_front();
+  }
+}
+
+// Read whatever is on the socket, parsing frames. Stops pulling new frames
+// once `staged` is saturated so an unserviced peer backpressures through the
+// kernel socket buffer instead of growing our heap without bound.
+void pump_rx(Conn* c) {
+  for (;;) {
+    if (!c->mid_msg && int(c->staged.size()) >= kMaxStagedMsgs &&
+        c->recv_q.empty())
+      return;
+    if (!c->mid_msg) {
+      while (c->hdr_have < 4) {
+        ssize_t n = recv(c->fd, c->hdr + c->hdr_have, 4 - c->hdr_have, 0);
+        if (n > 0) {
+          c->hdr_have += uint32_t(n);
+        } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          return;
+        } else if (n == 0) {  // orderly shutdown
+          if (c->hdr_have != 0) c->broken = true;  // FIN mid-frame: torn
+          else c->eof = true;
+          return;
+        } else {
+          c->broken = true;
+          return;
+        }
+      }
+      std::memcpy(&c->cur_len, c->hdr, 4);
+      if (c->cur_len > kMaxFrameBytes) {  // protocol violation, not a frame
+        c->broken = true;
+        return;
+      }
+      c->hdr_have = 0;
+      c->mid_msg = true;
+      c->cur.clear();
+      c->cur.reserve(c->cur_len);
+    }
+    while (c->cur.size() < c->cur_len) {
+      char tmp[1 << 16];
+      size_t want = c->cur_len - c->cur.size();
+      if (want > sizeof(tmp)) want = sizeof(tmp);
+      ssize_t n = recv(c->fd, tmp, want, 0);
+      if (n > 0) {
+        c->cur.insert(c->cur.end(), tmp, tmp + n);
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return;
+      } else {
+        c->broken = true;
+        return;
+      }
+    }
+    c->staged.push_back({std::move(c->cur)});
+    c->cur.clear();
+    c->mid_msg = false;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rtcp_listen(uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  set_nonblock(fd);
+  Listener* l = new Listener();
+  l->fd = fd;
+  l->port = ntohs(addr.sin_port);
+  return l;
+}
+
+int rtcp_listen_port(void* lv) {
+  Listener* l = static_cast<Listener*>(lv);
+  return l ? int(l->port) : -1;
+}
+
+void* rtcp_accept(void* lv, int timeout_ms) {
+  Listener* l = static_cast<Listener*>(lv);
+  if (!l) return nullptr;
+  uint64_t deadline = now_ms() + uint64_t(timeout_ms < 0 ? 0 : timeout_ms);
+  for (;;) {
+    int fd = accept(l->fd, nullptr, nullptr);
+    if (fd >= 0) {
+      tune(fd);
+      Conn* c = new Conn();
+      c->fd = fd;
+      return c;
+    }
+    if (errno != EAGAIN && errno != EWOULDBLOCK) return nullptr;
+    if (now_ms() >= deadline) return nullptr;
+    struct pollfd p{l->fd, POLLIN, 0};
+    poll(&p, 1, 20);
+  }
+}
+
+void* rtcp_connect(const char* host, uint16_t port, int timeout_ms) {
+  uint64_t deadline = now_ms() + uint64_t(timeout_ms < 0 ? 0 : timeout_ms);
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%u", unsigned(port));
+  for (;;) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, portstr, &hints, &res) == 0 && res) {
+      int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0) {
+        if (connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+          freeaddrinfo(res);
+          tune(fd);
+          Conn* c = new Conn();
+          c->fd = fd;
+          return c;
+        }
+        close(fd);
+      }
+    }
+    if (res) freeaddrinfo(res);
+    if (now_ms() >= deadline) return nullptr;
+    usleep(2000);  // listener may not be up yet: rendezvous retry
+  }
+}
+
+int64_t rtcp_post_send(void* cv, const void* buf, uint32_t len) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c || (len > 0 && !buf)) return -1;
+  if (c->broken) return -2;  // dead conn, distinct from backpressure
+  pump_tx(c);  // opportunistic flush frees queue room
+  if (c->broken) return -2;
+  if (c->tx_bytes + 4 + len > kTxCapBytes) return -1;  // backpressure
+  TxMsg m;
+  int64_t id = m.wr_id = c->next_wr++;
+  m.frame.resize(4 + len);
+  std::memcpy(m.frame.data(), &len, 4);
+  if (len) std::memcpy(m.frame.data() + 4, buf, len);
+  c->tx_bytes += m.frame.size();
+  c->txq.push_back(std::move(m));
+  pump_tx(c);
+  return id;
+}
+
+int64_t rtcp_post_recv(void* cv, void* buf, uint32_t cap) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c || (cap > 0 && !buf)) return -1;
+  int64_t id = c->next_wr++;
+  c->recv_q.push_back({id, buf, cap});
+  return id;
+}
+
+int rtcp_poll_cq(void* cv, Cqe* cqes, int max_cqes) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c || !cqes || max_cqes <= 0) return -1;
+  pump_tx(c);
+  pump_rx(c);
+  int n = 0;
+  while (n < max_cqes && !c->send_done.empty()) {
+    cqes[n++] = {c->send_done.front(), OP_SEND, ST_OK, 0, 0};
+    c->send_done.pop_front();
+  }
+  while (n < max_cqes && !c->staged.empty() && !c->recv_q.empty()) {
+    RxMsg m = std::move(c->staged.front());
+    c->staged.pop_front();
+    RecvWr wr = c->recv_q.front();
+    c->recv_q.pop_front();
+    uint32_t msg_len = uint32_t(m.payload.size());
+    uint32_t copy_len = msg_len <= wr.cap ? msg_len : wr.cap;
+    if (copy_len && wr.buf) std::memcpy(wr.buf, m.payload.data(), copy_len);
+    cqes[n++] = {wr.wr_id, OP_RECV, msg_len <= wr.cap ? ST_OK : ST_TRUNC,
+                 copy_len, 0};
+  }
+  // peer gone (and everything it sent already drained): surfaced, not hung
+  if (n == 0 && (c->broken || (c->eof && c->staged.empty() && !c->mid_msg)))
+    return -2;
+  return n;
+}
+
+uint64_t rtcp_tx_pending(void* cv) {
+  Conn* c = static_cast<Conn*>(cv);
+  return c ? c->tx_bytes : 0;
+}
+
+void rtcp_close(void* cv) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c) return;
+  pump_tx(c);  // best-effort final flush
+  if (c->fd >= 0) close(c->fd);
+  delete c;
+}
+
+void rtcp_close_listener(void* lv) {
+  Listener* l = static_cast<Listener*>(lv);
+  if (!l) return;
+  if (l->fd >= 0) close(l->fd);
+  delete l;
+}
+
+}  // extern "C"

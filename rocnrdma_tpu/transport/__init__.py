@@ -14,5 +14,6 @@ from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     HostQPNet,
     NetProperties,
     Request,
+    TCPNet,
     ring_allreduce_over_net,
 )

@@ -215,6 +215,51 @@ class HostQPNet:
         self._comms.clear()
 
 
+class TCPNet(HostQPNet):
+    """The host-plane vtable over TCP queue pairs (``native/rtcp.cpp``) —
+    the cross-host wire. Handles are ``"host:port"`` strings, dialable from
+    any machine that can route to the listener; everything above the QP
+    (tag matching, ``_HostComm``, the gloo-analogue collectives) is shared
+    with the shm plane verbatim, the way the reference's net plugin served
+    both loopback and RDMA NICs through one vtable.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._listeners = []
+
+    def get_properties(self, dev: int = 0) -> NetProperties:
+        return NetProperties(name="tcp-qp", plane="host", max_comms=1 << 16,
+                             max_inflight=1 << 10, byte_oriented=True)
+
+    def listen(self, dev: int = 0, capacity: int = 1 << 20):
+        """-> (handle "host:port", listener). ``capacity`` is unused (TCP's
+        tx bound is the fixed 64 MiB rtcp queue cap, not a ring size)."""
+        from rocnrdma_tpu import native
+        assert self._inited, "call init() first"
+        listener = native.TcpListener()
+        self._listeners.append(listener)
+        return listener.handle, listener
+
+    def connect(self, dev: int, handle: str, timeout_s: float = 10.0) -> _HostComm:
+        from rocnrdma_tpu import native
+        assert self._inited, "call init() first"
+        comm = _HostComm(native.TcpQueuePair.connect(handle, timeout_s))
+        self._comms.append(comm)
+        return comm
+
+    def accept(self, listener, timeout_s: float = 10.0) -> _HostComm:
+        comm = _HostComm(listener.accept(timeout_s))
+        self._comms.append(comm)
+        return comm
+
+    def close(self) -> None:
+        super().close()
+        for l in self._listeners:
+            l.close()
+        self._listeners.clear()
+
+
 # ---------------------------------------------------------------------------
 # Device plane: the vtable over mesh point-to-point
 # ---------------------------------------------------------------------------
