@@ -131,7 +131,34 @@ def available() -> bool:
         return False
 
 
-class _QpBase:
+class _Closeable:
+    """Idempotent close + context-manager/teardown idiom, shared by every
+    native handle wrapper. Subclasses implement ``_do_close``."""
+
+    _closed = False
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._do_close()
+
+    def _do_close(self) -> None:
+        raise NotImplementedError
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _QpBase(_Closeable):
     """Work-request plumbing shared by both wire planes (shm ``rqp_*`` and
     TCP ``rtcp_*``): posted-receive buffer ownership, completion draining,
     the bounded-retry blocking send/recv helpers, teardown. Subclasses bind
@@ -230,28 +257,14 @@ class _QpBase:
 
     # -- teardown ----------------------------------------------------------
 
-    def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            # drop ctypes views into posted bytearrays before freeing them
-            self._recv_bufs.clear()
-            self._fn("close")(self._h)
-            self._post_close()
+    def _do_close(self) -> None:
+        # drop ctypes views into posted bytearrays before freeing them
+        self._recv_bufs.clear()
+        self._fn("close")(self._h)
+        self._post_close()
 
     def _post_close(self) -> None:
         """Plane-specific cleanup hook (shm unlink etc.)."""
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
 
 
 class QueuePair(_QpBase):
@@ -298,7 +311,7 @@ class QueuePair(_QpBase):
             _load().rqp_unlink(self.name.encode())
 
 
-class TcpListener:
+class TcpListener(_Closeable):
     """Listening endpoint of the TCP plane (``rtcp.cpp``).
 
     ``TcpListener()`` binds an ephemeral port; ``.handle`` ("host:port") is
@@ -314,7 +327,6 @@ class TcpListener:
         # the address peers dial: overridable for multi-host, loopback default
         self.host = host or os.environ.get("RTCP_HOST", "127.0.0.1")
         self.handle = f"{self.host}:{self.port}"
-        self._closed = False
 
     def accept(self, timeout_s: float = 10.0) -> "TcpQueuePair":
         conn = _load().rtcp_accept(self._h, int(timeout_s * 1000))
@@ -322,22 +334,8 @@ class TcpListener:
             raise TimeoutError(f"rtcp: no peer dialed {self.handle!r}")
         return TcpQueuePair(conn, self.handle)
 
-    def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            _load().rtcp_close_listener(self._h)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
+    def _do_close(self) -> None:
+        _load().rtcp_close_listener(self._h)
 
 
 class TcpQueuePair(_QpBase):

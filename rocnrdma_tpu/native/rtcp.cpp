@@ -46,6 +46,13 @@
 
 namespace {
 
+// The wire format (4-byte length prefix) is LITTLE-ENDIAN by definition —
+// the same byte order the Python layer pins for its tag headers. Every TPU
+// host this targets is little-endian; make that assumption fail loudly at
+// compile time rather than desynchronize framing at runtime.
+static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
+              "rtcp wire format is little-endian");
+
 // CQE layout shared with rqp.cpp (keep field-for-field identical).
 struct Cqe {
   int64_t wr_id;
@@ -258,7 +265,21 @@ void* rtcp_connect(const char* host, uint16_t port, int timeout_ms) {
     if (getaddrinfo(host, portstr, &hints, &res) == 0 && res) {
       int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
       if (fd >= 0) {
-        if (connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        set_nonblock(fd);  // BEFORE connect: the deadline must bound the
+                           // kernel SYN cycle, not just the retry loop
+        int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+        bool ok = (rc == 0);
+        if (!ok && errno == EINPROGRESS) {
+          uint64_t left = deadline > now_ms() ? deadline - now_ms() : 0;
+          struct pollfd p{fd, POLLOUT, 0};
+          if (poll(&p, 1, int(left)) > 0 && (p.revents & POLLOUT)) {
+            int err = 0;
+            socklen_t elen = sizeof(err);
+            ok = (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) == 0 &&
+                  err == 0);
+          }
+        }
+        if (ok) {
           freeaddrinfo(res);
           tune(fd);
           Conn* c = new Conn();
@@ -335,8 +356,21 @@ uint64_t rtcp_tx_pending(void* cv) {
 void rtcp_close(void* cv) {
   Conn* c = static_cast<Conn*>(cv);
   if (!c) return;
-  pump_tx(c);  // best-effort final flush
-  if (c->fd >= 0) close(c->fd);
+  // Queued frames belong to sends whose completions may already have been
+  // polled ("buffer reusable" != "delivered"); dropping them here would
+  // strand the peer. Drain with a bounded wait, then half-close so the
+  // peer reads clean EOF after the last frame.
+  uint64_t deadline = now_ms() + 5000;
+  while (!c->txq.empty() && !c->broken && now_ms() < deadline) {
+    pump_tx(c);
+    if (c->txq.empty() || c->broken) break;
+    struct pollfd p{c->fd, POLLOUT, 0};
+    poll(&p, 1, 50);
+  }
+  if (c->fd >= 0) {
+    shutdown(c->fd, SHUT_WR);
+    close(c->fd);
+  }
   delete c;
 }
 

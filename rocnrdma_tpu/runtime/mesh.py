@@ -57,7 +57,10 @@ def detect_topology(devices=None) -> Topology:
     per = {len(v) for v in slices.values()}
     if len(per) != 1:
         raise RuntimeError(f"ragged slices unsupported: sizes {sorted(per)}")
-    ordered = [d for s in sorted(slices) for d in slices[s]]
+    # within each slice, walk the physical ICI torus (snake order) so ring
+    # hops between neighbouring ranks are single physical links
+    from rocnrdma_tpu.runtime.topology import ring_order
+    ordered = [d for s in sorted(slices) for d in ring_order(slices[s])]
     return Topology(
         platform=devices[0].platform,
         n_devices=len(devices),

@@ -1,0 +1,84 @@
+"""Physical ring ordering over the ICI torus (performance leg of C4/C9).
+
+The explicit schedules (``collectives/ring.py``) hop rank r -> r+1 every
+step. If rank order is arbitrary (JAX's default id order), one logical hop
+may be several physical ICI hops, multiplying wire traffic by the average
+hop distance. This module orders devices along a boustrophedon ("snake")
+walk of their physical coordinates so consecutive ranks are torus
+neighbours — the TPU analogue of how the reference picked NIC-adjacent rank
+orders for its RDMA rings.
+
+TPU devices expose ``coords`` (their (x, y[, z]) position in the physical
+mesh) and ``core_on_chip``; CPU oracle fakes expose neither, and fall back
+to the given order (the oracle has no wire, so order is semantics-neutral).
+
+The snake rule: axis i runs reversed iff the sum of the *coordinate values*
+of axes 0..i-1 is odd. Consecutive snake positions then differ by exactly
+one step in exactly one axis (a physical ICI link); the single closing hop
+(last -> first) rides the torus wraparound where the platform has one.
+"""
+
+from __future__ import annotations
+
+
+def snake_rank(coord, dims) -> int:
+    """Position of ``coord`` along the boustrophedon walk of an N-D grid."""
+    rank, parity = 0, 0
+    for c, d in zip(coord, dims):
+        cc = (d - 1 - c) if parity % 2 else c
+        rank = rank * d + cc
+        parity += c
+    return rank
+
+
+def torus_distance(a, b, dims) -> int:
+    """ICI hops between coords ``a`` and ``b`` on a wrapped torus."""
+    dist = 0
+    for ca, cb, d in zip(a, b, dims):
+        step = abs(ca - cb)
+        dist += min(step, d - step)
+    return dist
+
+
+def grid_dims(coords) -> list[int]:
+    """Bounding-box extent per axis (devices may occupy a sub-grid)."""
+    return [max(c[i] for c in coords) + 1 for i in range(len(coords[0]))]
+
+
+def ring_order(devices) -> list:
+    """Order ``devices`` so consecutive ring hops are physical neighbours.
+
+    Devices without coordinates (CPU fakes) — or ragged/degenerate sets —
+    come back in the given order. Cores on one chip stay adjacent (their
+    "hop" is on-chip, distance 0).
+    """
+    coords = [getattr(d, "coords", None) for d in devices]
+    if len(devices) < 3 or any(c is None for c in coords):
+        return list(devices)
+    ndim = len(coords[0])
+    if any(len(c) != ndim for c in coords):
+        return list(devices)
+    dims = grid_dims(coords)
+    return sorted(
+        devices,
+        key=lambda d: (snake_rank(d.coords, dims),
+                       getattr(d, "core_on_chip", 0) or 0))
+
+
+def ring_hop_lengths(devices) -> list[int]:
+    """Torus distance of every ring hop (including the closing edge) —
+    diagnostics for "is this rank order physically contiguous?". Hops
+    touching a device without coords contribute 0 (no physical wire to
+    count)."""
+    n = len(devices)
+    coords = [getattr(d, "coords", None) for d in devices]
+    with_coords = [c for c in coords if c is not None]
+    dims = grid_dims(with_coords) if with_coords else []
+    out = []
+    for i in range(n):
+        a, b = coords[i], coords[(i + 1) % n]
+        if a is None or b is None or list(a) == list(b):
+            out.append(0)  # no wire, or sibling cores on one chip
+        else:
+            out.append(torus_distance(a, b, dims))
+    return out

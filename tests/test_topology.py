@@ -1,0 +1,116 @@
+"""Physical ring ordering (runtime/topology.py): every consecutive pair of
+ranks must sit one ICI hop apart on the torus."""
+
+import dataclasses
+import random
+
+import pytest
+
+from rocnrdma_tpu.runtime.topology import (
+    grid_dims, ring_hop_lengths, ring_order, snake_rank, torus_distance)
+
+
+@dataclasses.dataclass(frozen=True)
+class FakeDev:
+    id: int
+    coords: tuple
+    core_on_chip: int = 0
+
+
+def _grid(*dims):
+    devs = []
+    i = 0
+    if len(dims) == 2:
+        for x in range(dims[0]):
+            for y in range(dims[1]):
+                devs.append(FakeDev(i, (x, y)))
+                i += 1
+    else:
+        for x in range(dims[0]):
+            for y in range(dims[1]):
+                for z in range(dims[2]):
+                    devs.append(FakeDev(i, (x, y, z)))
+                    i += 1
+    return devs
+
+
+def test_snake_rank_bijective_2d():
+    dims = (4, 4)
+    ranks = {snake_rank((x, y), dims) for x in range(4) for y in range(4)}
+    assert ranks == set(range(16))
+
+
+@pytest.mark.parametrize("dims", [(2, 2), (4, 4), (4, 8), (2, 2, 2), (4, 4, 4)])
+def test_snake_consecutive_are_neighbors(dims):
+    devs = _grid(*dims)
+    random.Random(0).shuffle(devs)
+    ordered = ring_order(devs)
+    assert len(ordered) == len(devs)
+    for a, b in zip(ordered, ordered[1:]):
+        assert torus_distance(a.coords, b.coords, dims) == 1, (a, b)
+
+
+def test_closing_hop_rides_wraparound():
+    # on a wrapped torus the last->first hop is also one link when every
+    # snake-reversed axis has even extent (true of real TPU tori: 4x4, 4x8..)
+    devs = _grid(4, 4)
+    ordered = ring_order(devs)
+    hops = ring_hop_lengths(ordered)
+    assert hops == [1] * len(hops)
+
+
+def test_cores_on_one_chip_stay_adjacent():
+    devs = []
+    i = 0
+    for x in range(2):
+        for y in range(2):
+            for core in range(2):
+                devs.append(FakeDev(i, (x, y), core))
+                i += 1
+    random.Random(1).shuffle(devs)
+    ordered = ring_order(devs)
+    # pairs of same-chip cores must be consecutive, core 0 first
+    for j in range(0, len(ordered), 2):
+        assert ordered[j].coords == ordered[j + 1].coords
+        assert (ordered[j].core_on_chip, ordered[j + 1].core_on_chip) == (0, 1)
+    hops = ring_hop_lengths(ordered)
+    assert max(hops) == 1 and hops.count(0) == 4  # on-chip "hops" are free
+
+
+def test_no_coords_falls_back_to_given_order():
+    class Bare:
+        def __init__(self, i):
+            self.id = i
+    devs = [Bare(i) for i in range(8)]
+    assert ring_order(devs) == devs
+
+
+def test_snake_beats_naive_order_on_average_hop():
+    # the whole point: id order (row-major) pays a long hop at every row seam
+    dims = (4, 4)
+    devs = _grid(*dims)
+    naive = sum(torus_distance(a.coords, b.coords, dims)
+                for a, b in zip(devs, devs[1:]))
+    ordered = ring_order(devs)
+    snake = sum(torus_distance(a.coords, b.coords, dims)
+                for a, b in zip(ordered, ordered[1:]))
+    assert snake < naive
+    assert snake == len(devs) - 1  # every hop is exactly one link
+
+
+def test_grid_dims_subgrid():
+    devs = [FakeDev(0, (0, 0)), FakeDev(1, (0, 1)), FakeDev(2, (1, 0)),
+            FakeDev(3, (1, 1)), FakeDev(4, (2, 0)), FakeDev(5, (2, 1))]
+    assert grid_dims([d.coords for d in devs]) == [3, 2]
+    ordered = ring_order(devs)
+    for a, b in zip(ordered, ordered[1:]):
+        assert torus_distance(a.coords, b.coords, (3, 2)) == 1
+
+
+def test_mesh_builders_still_work_on_oracle(devices):
+    # CPU fakes have no coords: rank_mesh/slice_mesh keep their old behavior
+    from rocnrdma_tpu import runtime as rt
+    m1 = rt.rank_mesh(8)
+    assert m1.devices.shape == (8,)
+    m2 = rt.slice_mesh(2, 4)
+    assert m2.devices.shape == (2, 4)
