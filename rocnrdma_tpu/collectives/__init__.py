@@ -11,9 +11,23 @@ Two families:
   ``jax.shard_map`` — axis-level primitives callable on any mesh axis.
 - ``fused``: the XLA-lowered fast path (``lax.psum`` / ``lax.all_to_all``),
   the production default.
+- ``program``: the MSCCL analogue — a declarative schedule IR (Program/Step)
+  plus an executor and numpy oracle, so custom collectives are data, not
+  code.
 """
 
 from rocnrdma_tpu.collectives import schedule  # noqa: F401
+from rocnrdma_tpu.collectives import program  # noqa: F401
+from rocnrdma_tpu.collectives.program import (  # noqa: F401
+    Program,
+    ProgramError,
+    Step,
+    execute as execute_program,
+    prog_binomial_broadcast,
+    prog_ring_allgather,
+    prog_ring_allreduce,
+    sim_program,
+)
 from rocnrdma_tpu.collectives.ring import (  # noqa: F401
     ring_allgather,
     ring_allreduce,

@@ -369,6 +369,18 @@ void rtcp_close(void* cv) {
   }
   if (c->fd >= 0) {
     shutdown(c->fd, SHUT_WR);
+    // Drain (and discard) inbound bytes until the peer's EOF: close() on a
+    // socket with unread rx data sends RST, which would retroactively
+    // destroy the frames we just flushed out of the peer's receive buffer.
+    char sink[1 << 16];
+    while (now_ms() < deadline) {
+      ssize_t n = recv(c->fd, sink, sizeof(sink), 0);
+      if (n == 0 || (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) break;
+      if (n < 0) {
+        struct pollfd p{c->fd, POLLIN, 0};
+        poll(&p, 1, 50);
+      }
+    }
     close(c->fd);
   }
   delete c;

@@ -276,6 +276,26 @@ class Transport:
         """The compiled global-array callable (what the benches time)."""
         return self._jit(verb, self._resolve(algo, verb), **knobs)
 
+    def program_fn(self, prog):
+        """Compile a custom :class:`collectives.Program` (the MSCCL-analogue
+        schedule IR) into a global-array callable over this mesh's rank ring.
+        1-D meshes only — a Program's perm speaks flat rank ids."""
+        if self.is_2d:
+            raise ValueError("custom programs run on a 1-D rank mesh")
+        if prog.n_ranks != self.n_ranks:
+            raise ValueError(
+                f"program is for {prog.n_ranks} ranks, mesh has {self.n_ranks}")
+        from rocnrdma_tpu.collectives.program import execute, validate
+        validate(prog)
+
+        def local(s):
+            return execute(prog, s.reshape(s.shape[1:]), RANK_AXIS)[None]
+
+        shmapped = jax.shard_map(local, mesh=self.mesh,
+                                 in_specs=(self._spec(),),
+                                 out_specs=self._spec(), check_vma=False)
+        return jax.jit(shmapped)
+
     # -- lowering ----------------------------------------------------------
 
     def _jit(self, verb: str, algo: str, **knobs):
