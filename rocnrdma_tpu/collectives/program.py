@@ -160,7 +160,7 @@ def sim_program(p: Program, bufs: np.ndarray) -> np.ndarray:
     """Oracle: ``bufs[r]`` is rank r's buffer. Same chunking/padding rules
     as :func:`execute`; same result layout."""
     validate(p)
-    n, size = bufs.shape[0], bufs.shape[1:]
+    n = bufs.shape[0]
     assert n == p.n_ranks, f"bufs rows {n} != n_ranks {p.n_ranks}"
     flat = bufs.reshape(n, -1).astype(bufs.dtype)
     elems = flat.shape[1]

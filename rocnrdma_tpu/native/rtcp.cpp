@@ -369,17 +369,22 @@ void rtcp_close(void* cv) {
   }
   if (c->fd >= 0) {
     shutdown(c->fd, SHUT_WR);
-    // Drain (and discard) inbound bytes until the peer's EOF: close() on a
-    // socket with unread rx data sends RST, which would retroactively
-    // destroy the frames we just flushed out of the peer's receive buffer.
+    // Drain (and discard) already-arrived inbound bytes: close() on a socket
+    // with unread rx data sends RST, which would retroactively destroy the
+    // frames we just flushed out of the peer's receive buffer. Only wait
+    // briefly for the peer's EOF — a peer that keeps its end open must not
+    // turn close() into a multi-second stall.
     char sink[1 << 16];
-    while (now_ms() < deadline) {
+    uint64_t eof_deadline = now_ms() + 250;
+    for (;;) {
       ssize_t n = recv(c->fd, sink, sizeof(sink), 0);
-      if (n == 0 || (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) break;
-      if (n < 0) {
-        struct pollfd p{c->fd, POLLIN, 0};
-        poll(&p, 1, 50);
-      }
+      if (n > 0) continue;                      // discard pending data
+      if (n == 0) break;                        // peer EOF: clean
+      if (errno == EINTR) continue;             // signal: retry, not fatal
+      if (errno != EAGAIN && errno != EWOULDBLOCK) break;
+      if (now_ms() >= eof_deadline) break;      // peer still open: just go
+      struct pollfd p{c->fd, POLLIN, 0};
+      poll(&p, 1, 50);
     }
     close(c->fd);
   }
