@@ -208,3 +208,23 @@ def test_resume_distinguishes_knobs(tmp_path):
     assert n2 == 2 * n1
     _run(bench_reduce.main, base + ["--redop", "max", "--root", "2"])
     assert len(out.read_text().splitlines()) == n2
+
+
+def test_profile_flag_writes_xprof_trace(tmp_path):
+    prof = tmp_path / "prof"
+    _run(bench_allreduce.main,
+         ["--ranks", "2", "--sizes", "4K", "--algos", "fused",
+          "--repeats", "1", "--iters", "1", "--profile", str(prof)])
+    traces = list(prof.rglob("*.xplane.pb"))
+    assert traces, f"no xplane.pb under {prof}"
+
+
+def test_bf16_sweep_rows(tmp_path):
+    out = tmp_path / "bf16.jsonl"
+    _run(bench_allreduce.main,
+         ["--ranks", "4", "--sizes", "16K", "--algos", "ring,fused",
+          "--dtypes", "float32,bfloat16", "--repeats", "1", "--iters", "1",
+          "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["dtype"] for r in rows} == {"float32", "bfloat16"}
+    assert {r["algo"] for r in rows} == {"ring", "fused"}
