@@ -9,11 +9,19 @@ is simply sharded device placement.
 """
 
 from rocnrdma_tpu.transport.api import Transport, ALGOS  # noqa: F401
+from rocnrdma_tpu.transport.bootstrap import (  # noqa: F401
+    BootstrapClient,
+    BootstrapServer,
+    bootstrap_ring,
+)
 from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     DeviceMeshNet,
     HostQPNet,
     NetProperties,
     Request,
     TCPNet,
+    ring_allgather_over_net,
     ring_allreduce_over_net,
+    ring_alltoall_over_net,
+    ring_broadcast_over_net,
 )

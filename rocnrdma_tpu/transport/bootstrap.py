@@ -1,0 +1,179 @@
+"""Bootstrap rendezvous store — the NCCL-bootstrap / TCPStore analogue.
+
+The reference's plugin era begins with an out-of-band handle exchange: every
+rank publishes its listen handle and learns its peers' before any queue pair
+exists. RCCL does this over a bootstrap TCP ring seeded by a root address;
+torch does it with TCPStore. This module is that piece for the host planes
+here: a tiny key-value store served by rank 0 over the native TCP queue
+pairs, so N processes that share ONE ``"host:port"`` string can wire any
+topology — no filesystem, no shared memory, exactly what crossing real
+hosts requires.
+
+Protocol: length-framed JSON requests over a ``TcpQueuePair``, strict
+request→reply lockstep per client. Ops: ``set`` / ``get`` (non-blocking;
+client polls) / ``barrier_arrive`` + ``barrier_done`` / ``bye``.
+
+Usage::
+
+    srv = BootstrapServer(n_ranks=4)          # rank 0 (or a sidecar)
+    # share srv.handle out of band (argv, env, scheduler)
+    c = BootstrapClient(handle, rank)
+    peers = c.exchange("qp", my_qp_handle, n_ranks)   # all ranks' handles
+    c.barrier("wired", n_ranks)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from rocnrdma_tpu import native
+
+
+class BootstrapServer:
+    """Rank-0-side store. One daemon thread per client connection (rendezvous
+    fan-in is small and short-lived); state is a dict + barrier counters."""
+
+    def __init__(self, n_ranks: int, port: int = 0, host: str | None = None):
+        self.n_ranks = n_ranks
+        self._listener = native.TcpListener(port=port, host=host)
+        self.handle = self._listener.handle
+        self._kv: dict[str, str] = {}
+        self._barriers: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._threads: list[threading.Thread] = []
+        self._acceptor = threading.Thread(target=self._accept_loop, daemon=True)
+        self._acceptor.start()
+
+    # -- server side -------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn = self._listener.accept(timeout_s=0.25)
+            except TimeoutError:
+                continue
+            except OSError:
+                return  # listener closed under us
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        try:
+            while not self._closed:
+                try:
+                    req = json.loads(conn.recv(timeout_s=0.5))
+                except TimeoutError:
+                    continue
+                except OSError:
+                    return  # client went away
+                conn.send(json.dumps(self._handle(req)).encode())
+                if req.get("op") == "bye":
+                    return
+        finally:
+            conn.close()
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        with self._lock:
+            if op == "set":
+                self._kv[req["key"]] = req["value"]
+                return {"ok": True}
+            if op == "get":
+                if req["key"] in self._kv:
+                    return {"ok": True, "value": self._kv[req["key"]]}
+                return {"ok": False}
+            if op == "barrier_arrive":
+                self._barriers[req["key"]] = self._barriers.get(req["key"], 0) + 1
+                return {"ok": True}
+            if op == "barrier_done":
+                return {"ok": self._barriers.get(req["key"], 0) >= req["n"]}
+            if op == "bye":
+                return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def close(self):
+        self._closed = True
+        self._listener.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class BootstrapClient:
+    """One rank's connection to the store."""
+
+    def __init__(self, handle: str, rank: int, timeout_s: float = 30.0):
+        self.rank = rank
+        self._qp = native.TcpQueuePair.connect(handle, timeout_s)
+
+    def _rpc(self, **req) -> dict:
+        self._qp.send(json.dumps(req).encode())
+        return json.loads(self._qp.recv())
+
+    def set(self, key: str, value: str) -> None:
+        resp = self._rpc(op="set", key=key, value=value)
+        if not resp.get("ok"):
+            raise OSError(f"bootstrap set({key!r}) failed: {resp}")
+
+    def get(self, key: str, timeout_s: float = 30.0) -> str:
+        """Blocking get: polls until the key appears."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            resp = self._rpc(op="get", key=key)
+            if resp.get("ok"):
+                return resp["value"]
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"bootstrap key {key!r} never published")
+            time.sleep(0.01)
+
+    def barrier(self, key: str, n: int, timeout_s: float = 30.0) -> None:
+        self._rpc(op="barrier_arrive", key=key)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if self._rpc(op="barrier_done", key=key, n=n).get("ok"):
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"bootstrap barrier {key!r} timed out")
+            time.sleep(0.01)
+
+    def exchange(self, prefix: str, my_value: str, n: int,
+                 timeout_s: float = 30.0) -> list[str]:
+        """Publish ``my_value`` under ``prefix/rank``; return all n values
+        in rank order (the all-gather every bootstrap needs)."""
+        self.set(f"{prefix}/{self.rank}", my_value)
+        return [self.get(f"{prefix}/{r}", timeout_s) for r in range(n)]
+
+    def close(self):
+        try:
+            self._rpc(op="bye")
+        except Exception:
+            pass
+        self._qp.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
+                   timeout_s: float = 30.0):
+    """Wire the ring every net collective here expects, from ONE shared
+    address: listen, publish my handle, dial my successor, accept my
+    predecessor. Returns ``(send_comm, recv_comm, client)`` — close the
+    client after the job, the comms via ``net.close()``."""
+    client = BootstrapClient(store_handle, rank, timeout_s)
+    handle, listener = net.listen()
+    handles = client.exchange("ring", handle, n_ranks, timeout_s)
+    send_comm = net.connect(0, handles[(rank + 1) % n_ranks], timeout_s)
+    recv_comm = net.accept(listener, timeout_s)
+    client.barrier("ring-wired", n_ranks, timeout_s)
+    return send_comm, recv_comm, client

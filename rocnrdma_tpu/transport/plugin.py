@@ -363,73 +363,183 @@ class DeviceMeshNet:
 
 
 # ---------------------------------------------------------------------------
-# A collective riding the vtable (the way RCCL rides the net plugin)
+# Collectives riding the vtable (the way RCCL rides the net plugin)
 # ---------------------------------------------------------------------------
+
+
+class _RingWire:
+    """One rank's view of the ring for a single collective call: byte-level
+    ``exchange`` over the vtable verbs, with per-hop tag namespacing and
+    frame chunking to the plugin's limit.
+
+    ``send_comm`` reaches rank ``(rank+1) % n``; ``recv_comm`` hears rank
+    ``(rank-1) % n``. Tags are ``(hop << 16) | frame_index`` — identical on
+    both ends because every rank executes the same hop sequence.
+    """
+
+    def __init__(self, net, send_comm, recv_comm):
+        self.net = net
+        self.send_comm = send_comm
+        self.recv_comm = recv_comm
+        self.frame = getattr(net, "MAX_FRAME", (1 << 16) - 4)
+        self._hops = itertools.count(1)
+
+    def exchange(self, out: np.ndarray, in_nbytes: int,
+                 hop: int | None = None) -> np.ndarray:
+        """One ring hop: send ``out`` (uint8) right, receive ``in_nbytes``
+        from the left. Directions are framed independently (they may differ
+        in length with uneven chunking).
+
+        ``hop`` defaults to this wire's call counter — correct whenever every
+        rank makes the same sequence of exchange calls (allreduce, allgather,
+        alltoall). Schedules where ranks make DIFFERENT call sequences (the
+        pipelined broadcast: root only sends, relays recv+forward) must pass
+        an explicit hop so tags agree per ring edge."""
+        if hop is None:
+            hop = next(self._hops)
+        frame = self.frame
+        n_frames = max(-(-in_nbytes // frame), -(-len(out) // frame))
+        assert n_frames < (1 << 16), (
+            f"{n_frames} frames in one hop overflows the 16-bit frame-index "
+            f"tag field (piece > ~4 GB); widen the tag packing first")
+        tag = lambda fi: (hop << 16) | fi
+        got = np.empty(in_nbytes, np.uint8)
+        # queue all chunked irecvs, then the isends, then drain — the plugin
+        # pumps receives while a send backpressures, so no deadlock
+        reqs = []
+        for fi, off in enumerate(range(0, in_nbytes, frame)):
+            nb = min(frame, in_nbytes - off)
+            reqs.append((off, nb,
+                         self.net.irecv(self.recv_comm, nb, tag=tag(fi))))
+        # progress engine: while our send ring is full, keep draining the
+        # comm our inbound data arrives on, or two mutually-sending ranks
+        # stall each other
+        pump = getattr(self.recv_comm, "_pump", None)
+        for fi, off in enumerate(range(0, len(out), frame)):
+            seg = np.ascontiguousarray(out[off:off + frame])
+            self.net.isend(self.send_comm,
+                           self.net.reg_mr(self.send_comm, seg),
+                           tag=tag(fi), progress=pump)
+        for off, nb, r in reqs:
+            payload = r.wait()
+            got[off:off + nb] = np.frombuffer(payload, np.uint8)
+        return got
+
+
+def _as_bytes(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a).view(np.uint8).ravel()
 
 
 def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
                             rank: int, n_ranks: int) -> np.ndarray:
     """Host-plane ring allreduce built ONLY from the vtable verbs.
 
-    ``send_comm`` reaches rank ``(rank+1) % n``, ``recv_comm`` hears rank
-    ``(rank-1) % n``. Classic two-phase schedule — (n-1) reduce-scatter steps
-    then (n-1) allgather steps over the ring — with every hop an
-    ``isend``/``irecv`` pair, chunked to the plugin's frame limit. This is
-    the proof the vtable carries collectives, and doubles as the
+    Classic two-phase schedule — (n-1) reduce-scatter steps then (n-1)
+    allgather steps over the ring, reducing in the input's own dtype (like
+    every sibling here — pre-cast yourself if you want fp32 accumulation).
+    This is the proof the vtable carries collectives, and doubles as the
     cross-process gloo-analogue oracle path.
     """
-    x = np.array(local, dtype=np.float32, copy=True).ravel()
+    x = np.array(local, copy=True).ravel()
     n = n_ranks
     if n == 1:
         return x.reshape(np.shape(local))
+    wire = _RingWire(net, send_comm, recv_comm)
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
-    frame = getattr(net, "MAX_FRAME", (1 << 16) - 4) // 4  # fp32 elems
-
-    steps = itertools.count(1)
-
-    def exchange(out_piece: np.ndarray, in_len: int) -> np.ndarray:
-        """One ring hop: send my piece right, receive peer's from the left.
-
-        With uneven chunking the outgoing and incoming pieces can differ in
-        length, so each direction is framed independently; tags are
-        (step, frame-index) pairs, identical on both ends because every rank
-        executes the same step sequence.
-        """
-        step = next(steps)
-        n_frames = max(-(-in_len // frame), -(-len(out_piece) // frame))
-        assert n_frames < (1 << 16), (
-            f"{n_frames} frames in one hop overflows the 16-bit frame-index "
-            f"tag field (piece > ~4 GB); widen the tag packing first")
-        tag = lambda fi: (step << 16) | fi
-        got = np.empty(in_len, np.float32)
-        # queue all chunked irecvs, then the isends, then drain — the plugin
-        # pumps receives while a send backpressures, so no deadlock
-        reqs = []
-        for fi, off in enumerate(range(0, in_len, frame)):
-            nb = min(frame, in_len - off) * 4
-            reqs.append((off, nb, net.irecv(recv_comm, nb, tag=tag(fi))))
-        # progress engine: while our send ring is full, keep draining the
-        # comm our inbound data arrives on, or two mutually-sending ranks
-        # stall each other
-        pump = getattr(recv_comm, "_pump", None)
-        for fi, off in enumerate(range(0, len(out_piece), frame)):
-            seg = np.ascontiguousarray(out_piece[off:off + frame])
-            net.isend(send_comm, net.reg_mr(send_comm, seg), tag=tag(fi),
-                      progress=pump)
-        for off, nb, r in reqs:
-            payload = r.wait()
-            got[off:off + nb // 4] = np.frombuffer(payload, np.float32)
-        return got
 
     # reduce-scatter: after step k, chunk (rank - k) holds partial sums
     for k in range(n - 1):
         send_i, recv_i = rank - k, rank - k - 1
-        incoming = exchange(chunk(send_i), len(chunk(recv_i)))
-        chunk(recv_i)[:] += incoming
+        incoming = wire.exchange(_as_bytes(chunk(send_i)),
+                                 chunk(recv_i).nbytes)
+        chunk(recv_i)[:] += incoming.view(x.dtype)
     # allgather: circulate the fully-reduced chunks
     for k in range(n - 1):
         send_i, recv_i = rank + 1 - k, rank - k
-        incoming = exchange(chunk(send_i), len(chunk(recv_i)))
-        chunk(recv_i)[:] = incoming
+        incoming = wire.exchange(_as_bytes(chunk(send_i)),
+                                 chunk(recv_i).nbytes)
+        chunk(recv_i)[:] = incoming.view(x.dtype)
     return x.reshape(np.shape(local))
+
+
+def ring_allgather_over_net(net, send_comm, recv_comm, local: np.ndarray,
+                            rank: int, n_ranks: int) -> np.ndarray:
+    """Ring allgather over the verbs: every rank contributes ``local`` (all
+    ranks the same shape/dtype) and receives ``(n, *local.shape)`` in rank
+    order. n-1 hops, each circulating one rank's block."""
+    block = np.ascontiguousarray(local)
+    n = n_ranks
+    out = np.empty((n,) + block.shape, block.dtype)
+    out[rank] = block
+    if n == 1:
+        return out
+    wire = _RingWire(net, send_comm, recv_comm)
+    for k in range(n - 1):
+        send_i = (rank - k) % n
+        recv_i = (rank - k - 1) % n
+        incoming = wire.exchange(_as_bytes(out[send_i]), block.nbytes)
+        out[recv_i] = incoming.view(block.dtype).reshape(block.shape)
+    return out
+
+
+def ring_broadcast_over_net(net, send_comm, recv_comm, local: np.ndarray,
+                            rank: int, n_ranks: int, root: int = 0) -> np.ndarray:
+    """Chunked pipelined ring broadcast: the root pushes chunks rightward;
+    every rank forwards as it receives (the bandwidth-optimal non-tree
+    broadcast for a ring wire). Non-root ``local`` supplies shape/dtype."""
+    n = n_ranks
+    if n == 1:
+        return np.array(local, copy=True)
+    wire = _RingWire(net, send_comm, recv_comm)
+    # non-root contents are irrelevant: only shape/dtype matter, so skip the
+    # payload-sized copy and zero-fill there; root sends from a byte view
+    flat = (_as_bytes(local) if rank == root
+            else np.empty(local.nbytes, np.uint8))
+    # chunk the payload so forwarding pipelines: rank r starts relaying chunk
+    # c while chunk c+1 is still inbound upstream
+    n_chunks = max(1, min(n, local.nbytes // max(1, wire.frame) + 1))
+    bounds = [local.nbytes * i // n_chunks for i in range(n_chunks + 1)]
+    last = (rank - root) % n == n - 1  # ring tail: do not forward
+    for c in range(n_chunks):
+        lo, hi = bounds[c], bounds[c + 1]
+        # every edge carries chunk c exactly once -> hop c+1 is unique per
+        # edge even though ranks make different call sequences
+        if rank == root:
+            wire.exchange(flat[lo:hi], 0, hop=c + 1)
+        else:
+            incoming = wire.exchange(np.empty(0, np.uint8), hi - lo, hop=c + 1)
+            flat[lo:hi] = incoming
+            if not last:
+                wire.exchange(flat[lo:hi], 0, hop=c + 1)
+    if rank != root:
+        return flat.view(local.dtype).reshape(local.shape)
+    return np.array(local, copy=True)
+
+
+def ring_alltoall_over_net(net, send_comm, recv_comm, local: np.ndarray,
+                           rank: int, n_ranks: int) -> np.ndarray:
+    """Shift alltoall over the verbs: ``local`` is ``(n, ...)`` — block d is
+    this rank's payload for rank d. Each rank launches a "train" of its
+    n-1 outbound blocks; at hop s every rank pulls off the block addressed
+    to it and forwards the rest (train shrinks by one block per hop)."""
+    blocks = np.ascontiguousarray(local)
+    n = n_ranks
+    assert blocks.shape[0] == n, f"alltoall wants (n, ...), got {blocks.shape}"
+    out = np.empty_like(blocks)
+    out[rank] = blocks[rank]
+    if n == 1:
+        return out
+    wire = _RingWire(net, send_comm, recv_comm)
+    bnb = blocks[0].nbytes
+    # my outbound train: blocks for rank+1, rank+2, ... rank+n-1 (travel order)
+    train = np.concatenate(
+        [_as_bytes(blocks[(rank + off) % n]) for off in range(1, n)])
+    for s in range(1, n):
+        # incoming train originated at rank-s; its head block is mine
+        in_blocks = n - s
+        incoming = wire.exchange(train, in_blocks * bnb)
+        src = (rank - s) % n
+        out[src] = incoming[:bnb].view(blocks.dtype).reshape(blocks.shape[1:])
+        train = incoming[bnb:]  # forward the rest at the next hop
+    return out

@@ -1,0 +1,127 @@
+"""Bootstrap rendezvous store (transport/bootstrap.py): the one-address
+wire-up path every cross-host job needs."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.transport import (
+    BootstrapClient,
+    BootstrapServer,
+    TCPNet,
+    bootstrap_ring,
+    ring_allreduce_over_net,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable")
+
+
+@needs_native
+def test_set_get_and_blocking_get():
+    with BootstrapServer(n_ranks=2) as srv:
+        a = BootstrapClient(srv.handle, rank=0)
+        b = BootstrapClient(srv.handle, rank=1)
+        a.set("color", "teal")
+        assert b.get("color") == "teal"
+        # blocking get: key published by the OTHER client after a delay
+        t = threading.Timer(0.2, lambda: a.set("late", "bird"))
+        t.start()
+        assert b.get("late", timeout_s=5) == "bird"
+        with pytest.raises(TimeoutError):
+            b.get("never", timeout_s=0.3)
+        a.close(); b.close()
+
+
+@needs_native
+def test_exchange_and_barrier():
+    n = 3
+    with BootstrapServer(n_ranks=n) as srv:
+        results = [None] * n
+        def worker(rank):
+            c = BootstrapClient(srv.handle, rank)
+            results[rank] = c.exchange("addr", f"rank{rank}@host", n)
+            c.barrier("done", n)
+            c.close()
+        threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+        for t in threads: t.start()
+        for t in threads: t.join(timeout=30)
+        want = [f"rank{r}@host" for r in range(n)]
+        assert all(res == want for res in results), results
+
+
+@needs_native
+def test_barrier_times_out_when_short():
+    with BootstrapServer(n_ranks=2) as srv:
+        c = BootstrapClient(srv.handle, rank=0)
+        with pytest.raises(TimeoutError):
+            c.barrier("lonely", n=2, timeout_s=0.4)
+        c.close()
+
+
+@needs_native
+def test_bootstrap_ring_carries_allreduce():
+    """One shared address -> wired ring -> collective, all in threads."""
+    n = 3
+    net = TCPNet()
+    net.init()
+    rng = np.random.default_rng(5)
+    xs = [rng.standard_normal(1000).astype(np.float32) for _ in range(n)]
+    results = [None] * n
+    errors = []
+    with BootstrapServer(n_ranks=n) as srv:
+        def worker(rank):
+            try:
+                send, recv, client = bootstrap_ring(net, srv.handle, rank, n)
+                results[rank] = ring_allreduce_over_net(
+                    net, send, recv, xs[rank], rank, n)
+                client.close()
+            except Exception as e:
+                errors.append((rank, e))
+        threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+        for t in threads: t.start()
+        for t in threads: t.join(timeout=60)
+    assert not errors, errors
+    want = np.sum(xs, axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(results[r], want, rtol=1e-5, atol=1e-5)
+    net.close()
+
+
+_WORKER = r"""
+import sys
+import numpy as np
+from rocnrdma_tpu.transport import TCPNet, bootstrap_ring, ring_allreduce_over_net
+
+store, rank, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+net = TCPNet(); net.init()
+send, recv, client = bootstrap_ring(net, store, rank, n, timeout_s=60)
+local = np.full(30000, float(rank + 1), np.float32)
+got = ring_allreduce_over_net(net, send, recv, local, rank, n)
+assert np.allclose(got, sum(range(1, n + 1))), got[:4]
+client.close(); net.close()
+print(f"rank {rank} OK", flush=True)
+"""
+
+
+@needs_native
+def test_bootstrap_multiprocess_single_address():
+    """N OS processes that share ONLY the store's host:port string — the
+    exact shape of a real multi-host launch (address from the scheduler)."""
+    import os
+    import subprocess
+    import sys
+
+    n = 3
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    with BootstrapServer(n_ranks=n) as srv:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _WORKER, srv.handle, str(r), str(n)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+            for r in range(n)]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {r} failed:\n{err}"
+            assert f"rank {r} OK" in out
