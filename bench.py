@@ -49,32 +49,44 @@ def _roofline(device) -> tuple:
     return _CPU_FALLBACK
 
 
-def _marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int) -> float:
+def _marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int,
+                       trials: int = 3) -> float:
     """Seconds per op from the two-depth chained-loop difference.
 
-    Each depth's time is the MIN over repeats: measurement noise on a
-    relayed/tunneled backend is strictly additive (scheduling, transfer
-    contention), so the minimum is the best estimator of true device time —
-    the standard microbenchmark discipline (timeit does the same).
+    Depths are timed in back-to-back (f1, f2) PAIRS: the backend is bimodal
+    (observed ~25% slower windows spanning many seconds, likely
+    tunnel/tenancy contention), so the two depths must sample the same mode
+    or the difference is corrupted — an early version that timed all-f1 then
+    all-f2 measured 905 GB/s, above the chip's physical roofline. Per trial
+    the marginal is the MEDIAN over pairs (robust to one-sided jitter
+    outliers in either depth); the reported value is the MIN over trials,
+    i.e. the fastest mode the hardware demonstrated.
     """
     import numpy as np
 
     f1, f2 = make_chain(k1), make_chain(k2)
     np.asarray(f1(*x0)), np.asarray(f2(*x0))  # compile + warm; fetch = barrier
 
-    def run(f):
-        spans = []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            np.asarray(f(*x0))
-            spans.append(time.perf_counter() - t0)
-        return min(spans)
+    def once(f):
+        t0 = time.perf_counter()
+        np.asarray(f(*x0))
+        return time.perf_counter() - t0
 
-    t1, t2 = run(f1), run(f2)
-    marginal = (t2 - t1) / (k2 - k1)
-    if marginal <= 0:  # noise swamped the difference; fall back (pessimistic)
-        marginal = t2 / k2
-    return marginal
+    best = float("inf")
+    t2_min = float("inf")
+    for _ in range(trials):
+        pair_marginals = []
+        for _ in range(repeats):
+            t1, t2 = once(f1), once(f2)
+            t2_min = min(t2_min, t2)
+            m = (t2 - t1) / (k2 - k1)
+            if m > 0:
+                pair_marginals.append(m)
+        if pair_marginals:
+            best = min(best, float(np.median(pair_marginals)))
+    if not np.isfinite(best):  # noise swamped every round; fall back
+        best = t2_min / k2
+    return best
 
 
 def main() -> int:
@@ -123,7 +135,7 @@ def main() -> int:
                                out_specs=P("rank"), check_vma=False)
             return jax.jit(lambda v: sh(v)[0, 0])
 
-        sec = _marginal_s_per_op(make_chain, (x0,), k1=2, k2=8,
+        sec = _marginal_s_per_op(make_chain, (x0,), k1=2, k2=8 if on_cpu else 32,
                                  repeats=3 if on_cpu else 5)
         value = M.busbw_GBps("allreduce", n, elems * 4, sec)
         target = 0.9 * ici_bw
@@ -145,7 +157,12 @@ def main() -> int:
                 return lax.fori_loop(0, k, lambda _, y: y + bb, x).ravel()[0]
             return f
 
-        sec = _marginal_s_per_op(make_chain, (x0, b), k1=5, k2=25, repeats=5)
+        # The depth gap must make device work dominate tunnel jitter: the
+        # relayed backend adds ~90 ms fixed overhead per call fluctuating by
+        # tens of ms, so a 20-op gap (~24 ms of device work) measured 271-721
+        # GB/s run-to-run. A 120-op gap (~145 ms of device work) is stable to
+        # <1% (measured 662-665 GB/s across trials on v5e).
+        sec = _marginal_s_per_op(make_chain, (x0, b), k1=8, k2=128, repeats=5)
         moved = 3 * elems * 4  # 2 reads + 1 write per element
         value = moved / sec / 1e9
         target = 0.9 * hbm_bw
