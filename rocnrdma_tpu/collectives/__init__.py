@@ -3,10 +3,10 @@
 Two families:
 
 - ``schedule``: pure, device-free descriptions of the ring / halving-doubling /
-  rotation / hierarchical algorithms, with reference simulators. These are the
-  TPU rebuild of the reference's "its own ring/tree allreduce" (the
-  inspectable, educational path).
-- ``ring`` / ``tree`` / ``alltoall`` / ``hierarchical``: jit-compiled
+  double-binary-tree / rotation / hierarchical algorithms, with reference
+  simulators. These are the TPU rebuild of the reference's "its own ring/tree
+  allreduce" (the inspectable, educational path).
+- ``ring`` / ``tree`` / ``dtree`` / ``alltoall`` / ``hierarchical``: jit-compiled
   implementations of those schedules as ``lax.ppermute`` programs under
   ``jax.shard_map`` — axis-level primitives callable on any mesh axis.
 - ``fused``: the XLA-lowered fast path (``lax.psum`` / ``lax.all_to_all``),
@@ -34,6 +34,7 @@ from rocnrdma_tpu.collectives.ring import (  # noqa: F401
     ring_reduce_scatter,
 )
 from rocnrdma_tpu.collectives.tree import hd_allreduce  # noqa: F401
+from rocnrdma_tpu.collectives.dtree import dbtree_allreduce  # noqa: F401
 from rocnrdma_tpu.collectives.alltoall import (  # noqa: F401
     bruck_alltoall,
     rotation_alltoall,

@@ -1,0 +1,67 @@
+"""Double binary tree allreduce as an explicit ``lax.ppermute`` program.
+
+The TPU rebuild of the reference stack's flagship tree algorithm (NCCL/RCCL
+run a double binary tree for their default large-scale allreduce; the
+reference's "tree allreduce" slot, BASELINE.json:5). Two complementary
+in-order trees each reduce-then-broadcast half of the buffer, so leaf ranks
+of one tree carry interior send load in the other. Works for ANY rank count
+— the advantage over halving-doubling (``tree.py``), which needs a power of
+two.
+
+Axis-level primitive: call inside ``jax.shard_map``. The schedule indices
+and the step ordering proof live in ``collectives/schedule.py``
+(``dbtree_parents`` / ``dbtree_steps``); ``sim_dbtree_allreduce`` is the
+oracle.
+
+Mechanics per tree: each up/down substep is a PARTIAL ppermute — ranks
+outside the substep's destination set receive zeros, and a per-rank boolean
+(indexed from a static mask table) gates whether the received buffer is
+combined (up) or adopted (down). That keeps every step a full-axis
+collective with static shapes, which is what XLA wants, at the cost of
+idle-rank traffic — the price of expressing an asymmetric tree in SPMD.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from rocnrdma_tpu.collectives.reduce_op import combine_fn, finalize
+from rocnrdma_tpu.collectives.schedule import dbtree_parents, dbtree_steps
+
+
+def _dst_gate(n: int, pairs: list[tuple[int, int]], r: jax.Array) -> jax.Array:
+    """Boolean: is rank ``r`` a destination of this substep?"""
+    mask = np.zeros(n, bool)
+    mask[[d for _, d in pairs]] = True
+    return jnp.asarray(mask)[r]
+
+
+def dbtree_allreduce(x: jax.Array, axis_name: str, op: str = "sum") -> jax.Array:
+    """Allreduce via the double binary tree (``op``: sum/prod/max/min/avg)."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return finalize(x, op, 1)
+    combine = combine_fn(op)
+    r = lax.axis_index(axis_name)
+
+    shape, size = x.shape, x.size
+    half = -(-size // 2)
+    flat = jnp.pad(x.reshape(-1), (0, 2 * half - size))
+    halves = [flat[:half], flat[half:]]
+
+    for t, parents in enumerate(dbtree_parents(n)):
+        h = halves[t]
+        up, down = dbtree_steps(parents)
+        for pairs in up:  # reduce toward the root
+            recvd = lax.ppermute(h, axis_name, perm=pairs)
+            h = jnp.where(_dst_gate(n, pairs, r), combine(h, recvd), h)
+        for pairs in down:  # broadcast back down
+            recvd = lax.ppermute(h, axis_name, perm=pairs)
+            h = jnp.where(_dst_gate(n, pairs, r), recvd, h)
+        halves[t] = h
+
+    out = jnp.concatenate(halves)[:size].reshape(shape)
+    return finalize(out, op, n)

@@ -311,6 +311,116 @@ def sim_binomial_scatter(bufs: np.ndarray, root: int = 0) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Double binary tree allreduce
+#
+# The flagship tree algorithm of the reference's stack (NCCL/RCCL ship it as
+# their default large-scale allreduce): TWO complementary binary trees, each
+# reducing-then-broadcasting HALF of the buffer, so the per-rank send load of
+# tree edges is spread across both halves instead of idling the leaves.
+#
+# **Tree 1** is the in-order "Fenwick" tree on 1-based ranks: the root of a
+# range is the multiple of the largest power of two inside it, so every
+# odd 1-based rank (even 0-based rank) is a leaf — for ANY n, not just
+# powers of two (which is this schedule's advantage over halving-doubling).
+# **Tree 2** is tree 1 with all labels shifted by +1 mod n: leaves of tree 2
+# are exactly the internal ranks of tree 1 for even n (perfect complement),
+# and all-but-one for odd n. (RCCL mirrors instead of shifting for odd n; a
+# shift keeps complementarity strictly better here — the mirror of our tree
+# shape maps even leaves back onto even ranks when n is odd.)
+#
+# An allreduce over one tree = reduce up the edges + broadcast back down.
+# Each level contributes up to two ppermute substeps (left children, then
+# right children — in an in-order tree, left child < parent < right child,
+# so the split guarantees unique destinations per substep).
+
+
+def dbtree_parents(n: int) -> tuple[list[int], list[int]]:
+    """Parent arrays (parent[root] == -1) of the two complementary trees."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 ranks, got {n}")
+    p1 = [-1] * n
+
+    def build(lo: int, hi: int, par: int) -> None:
+        # in-order tree on 1-based [lo, hi]; ranges always have the form
+        # [k*2^m + 1, k*2^m + rem], whose root is lo - 1 + 2^floor(log2 size)
+        if lo > hi:
+            return
+        size = hi - lo + 1
+        root = lo - 1 + (1 << (size.bit_length() - 1))
+        p1[root - 1] = par - 1  # store 0-based
+        build(lo, root - 1, root)
+        build(root + 1, hi, root)
+
+    build(1, n, 0)  # sentinel parent 0 -> stored as -1
+    p2 = [-1 if p1[(r - 1) % n] == -1 else (p1[(r - 1) % n] + 1) % n
+          for r in range(n)]
+    return p1, p2
+
+
+def dbtree_depths(parents: list[int]) -> list[int]:
+    """Node depths (root = 0)."""
+    def depth(r: int) -> int:
+        d = 0
+        while parents[r] != -1:
+            r = parents[r]
+            d += 1
+        return d
+    return [depth(r) for r in range(len(parents))]
+
+
+def dbtree_steps(parents: list[int]) -> tuple[
+        list[list[tuple[int, int]]], list[list[tuple[int, int]]]]:
+    """(up, down) ppermute substeps for one tree.
+
+    ``up``: reduce phase, deepest level first; each substep is a list of
+    (child, parent) pairs with unique parents (a level's first children,
+    then its second children — NOT a label comparison, because tree 2's
+    +1 mod n shift wraps labels, so a "right" child can carry a smaller
+    label than its parent). A node's children always fire before the node's
+    own up-send, so partial sums are complete when forwarded. ``down``:
+    broadcast phase, the exact reverse with (parent, child) pairs.
+    """
+    n = len(parents)
+    depths = dbtree_depths(parents)
+    children: dict[int, list[int]] = {p: [] for p in range(n)}
+    for c in range(n):
+        if parents[c] != -1:
+            children[parents[c]].append(c)
+    up: list[list[tuple[int, int]]] = []
+    for d in range(max(depths), 0, -1):
+        for side in (0, 1):
+            pairs = [(c, parents[c]) for c in range(n)
+                     if depths[c] == d
+                     and children[parents[c]].index(c) == side]
+            if pairs:
+                up.append(pairs)
+    down = [[(p, c) for c, p in pairs] for pairs in reversed(up)]
+    return up, down
+
+
+def sim_dbtree_allreduce(bufs: np.ndarray) -> np.ndarray:
+    """Simulate the double-tree allreduce on (n, elems) rows (sum op)."""
+    n = bufs.shape[0]
+    half = -(-bufs.shape[1] // 2)
+    padded = np.zeros((n, 2 * half), bufs.dtype)
+    padded[:, :bufs.shape[1]] = bufs
+    halves = padded.reshape(n, 2, half).transpose(1, 0, 2).copy()
+    for t, parents in enumerate(dbtree_parents(n)):
+        h = halves[t]
+        up, down = dbtree_steps(parents)
+        for pairs in up:
+            sent = {c: h[c].copy() for c, _ in pairs}
+            for c, p in pairs:
+                h[p] += sent[c]
+        for pairs in down:
+            sent = {p: h[p].copy() for p, _ in pairs}
+            for p, c in pairs:
+                h[c] = sent[p]
+    out = halves.transpose(1, 0, 2).reshape(n, 2 * half)
+    return out[:, :bufs.shape[1]]
+
+
+# ---------------------------------------------------------------------------
 # Bruck alltoall (log-step; latency-optimal for small messages)
 
 

@@ -41,8 +41,8 @@ def _pallas():
 def _raise(msg: str):
     raise ValueError(msg)
 
-ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "hierarchical",
-         "pallas_ring", "bruck", "binomial")
+ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "dtree",
+         "hierarchical", "pallas_ring", "bruck", "binomial")
 
 # THE (op, algo) compatibility table — single source of truth, consumed by
 # Transport._build below and by the bench runner's algo filter. Each entry
@@ -62,6 +62,8 @@ SCHEDULES = {
             C.ring_allreduce(v, RANK_AXIS, bidir=True, op=op),
         "tree": lambda v, _, op="sum", root=0:
             C.hd_allreduce(v, RANK_AXIS, op=op),
+        "dtree": lambda v, _, op="sum", root=0:
+            C.dbtree_allreduce(v, RANK_AXIS, op=op),
         "hierarchical": lambda v, _, op="sum", root=0:
             C.hierarchical_allreduce(v, op=op),
         "pallas_ring": lambda v, _, op="sum", root=0:

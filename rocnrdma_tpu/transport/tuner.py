@@ -58,6 +58,9 @@ _MODEL = {
     ("allreduce", "ring"): lambda n: (2 * (n - 1), 2 * (n - 1) / n),
     ("allreduce", "ring_bidir"): lambda n: (2 * (n - 1), (n - 1) / n),
     ("allreduce", "tree"): lambda n: (2 * _L(n), 2 * (n - 1) / n),
+    # double tree: ~2 substeps/level x 2 phases x 2 trees; each rank wires
+    # about S/2 up + S/2 down per tree (leaf in one, interior in the other)
+    ("allreduce", "dtree"): lambda n: (8 * _L(n), 2.0),
     ("allreduce", "pallas_ring"): lambda n: (2 * (n - 1), 2 * (n - 1) / n),
     ("reduce_scatter", "ring"): lambda n: (n - 1, (n - 1) / n),
     ("allgather", "ring"): lambda n: (n - 1, (n - 1) / n),

@@ -1,0 +1,136 @@
+"""Double binary tree allreduce: schedule properties (unit tier), the numpy
+step simulator, and the jit schedule on the fake-device oracle (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rocnrdma_tpu import collectives as C
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.collectives.schedule import (
+    dbtree_depths,
+    dbtree_parents,
+    dbtree_steps,
+    sim_dbtree_allreduce,
+)
+
+RANK = rt.mesh.RANK_AXIS
+
+
+def _roots_children(parents):
+    roots = [r for r, p in enumerate(parents) if p == -1]
+    children = {r: [c for c, p in enumerate(parents) if p == r]
+                for r in range(len(parents))}
+    return roots, children
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 6, 7, 8, 12, 16, 63])
+def test_dbtree_is_a_binary_tree(n):
+    for parents in dbtree_parents(n):
+        roots, children = _roots_children(parents)
+        assert len(roots) == 1
+        assert all(len(cs) <= 2 for cs in children.values())
+        # connected: every node reaches the root without a cycle
+        for r in range(n):
+            seen = set()
+            while parents[r] != -1:
+                assert r not in seen
+                seen.add(r)
+                r = parents[r]
+            assert r == roots[0]
+
+
+@pytest.mark.parametrize("n", [2, 4, 6, 8, 12, 16])
+def test_dbtree_complementary_leaves_even_n(n):
+    """For even n the trees partition ranks: leaf in exactly one tree."""
+    p1, p2 = dbtree_parents(n)
+    (_, ch1), (_, ch2) = _roots_children(p1), _roots_children(p2)
+    leaves1 = {r for r in range(n) if not ch1[r]}
+    leaves2 = {r for r in range(n) if not ch2[r]}
+    assert leaves1 | leaves2 == set(range(n))
+    assert not (leaves1 & leaves2)
+
+
+@pytest.mark.parametrize("n", [3, 5, 7, 9, 15])
+def test_dbtree_leaves_odd_n(n):
+    """For odd n every rank is a leaf in at least one tree (one overlap)."""
+    p1, p2 = dbtree_parents(n)
+    (_, ch1), (_, ch2) = _roots_children(p1), _roots_children(p2)
+    leaves1 = {r for r in range(n) if not ch1[r]}
+    leaves2 = {r for r in range(n) if not ch2[r]}
+    assert leaves1 | leaves2 == set(range(n))
+
+
+@pytest.mark.parametrize("n", [2, 5, 8, 16, 64])
+def test_dbtree_depth_is_logarithmic(n):
+    for parents in dbtree_parents(n):
+        assert max(dbtree_depths(parents)) <= int(np.ceil(np.log2(n))) + 1
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 8, 16])
+def test_dbtree_steps_well_formed(n):
+    for parents in dbtree_parents(n):
+        up, down = dbtree_steps(parents)
+        depths = dbtree_depths(parents)
+        assert down == [[(p, c) for c, p in pairs] for pairs in reversed(up)]
+        sent = set()
+        for pairs in up:
+            dsts = [d for _, d in pairs]
+            assert len(dsts) == len(set(dsts))  # unique ppermute destinations
+            for c, p in pairs:
+                assert parents[c] == p
+                # a child sends only after all ITS children already sent
+                for cc in range(n):
+                    if parents[cc] == c:
+                        assert cc in sent
+                sent.add(c)
+        # every non-root sent exactly once
+        assert sent == {r for r in range(n) if parents[r] != -1}
+        assert all(depths[c] == depths[p] + 1 for pairs in up for c, p in pairs)
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 8])
+def test_sim_dbtree_matches_sum(n):
+    rng = np.random.default_rng(0)
+    bufs = rng.normal(size=(n, 21)).astype(np.float32)
+    out = sim_dbtree_allreduce(bufs)
+    np.testing.assert_allclose(out, np.broadcast_to(bufs.sum(0), bufs.shape),
+                               rtol=1e-5)
+
+
+def _run(n, x, op="sum"):
+    mesh = rt.rank_mesh(n)
+    shmapped = jax.shard_map(
+        lambda s: C.dbtree_allreduce(s[0], RANK, op=op)[None],
+        mesh=mesh, in_specs=(P(RANK),), out_specs=P(RANK))
+    return np.asarray(jax.jit(shmapped)(x))
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5, 6, 8])
+def test_dbtree_allreduce_matches_numpy(devices, n):
+    rng = np.random.default_rng(n)
+    x = rng.normal(size=(n, 103)).astype(np.float32)  # odd size: pad path
+    np.testing.assert_allclose(_run(n, x),
+                               np.broadcast_to(x.sum(0), x.shape), rtol=1e-5)
+
+
+@pytest.mark.parametrize("op,npf", [("max", np.max), ("min", np.min),
+                                    ("prod", np.prod), ("avg", np.mean)])
+def test_dbtree_allreduce_ops(devices, op, npf):
+    n = 5
+    rng = np.random.default_rng(9)
+    x = (rng.normal(size=(n, 17)) + 2.0).astype(np.float32)  # positive: prod-safe
+    want = np.broadcast_to(npf(x, axis=0), x.shape)
+    np.testing.assert_allclose(_run(n, x, op=op), want, rtol=1e-4)
+
+
+def test_dbtree_via_transport(devices):
+    from rocnrdma_tpu.transport import Transport
+
+    tr = Transport(rt.rank_mesh(8))
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 64)).astype(np.float32)
+    out = np.asarray(tr.allreduce(tr.shard(x), algo="dtree"))
+    np.testing.assert_allclose(out, np.broadcast_to(x.sum(0), x.shape),
+                               rtol=1e-5)
