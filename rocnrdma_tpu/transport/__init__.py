@@ -9,6 +9,7 @@ is simply sharded device placement.
 """
 
 from rocnrdma_tpu.transport.api import Transport, ALGOS  # noqa: F401
+from rocnrdma_tpu.transport.group import Group, GroupError, GroupHandle  # noqa: F401
 from rocnrdma_tpu.transport.bootstrap import (  # noqa: F401
     BootstrapClient,
     BootstrapServer,

@@ -278,6 +278,14 @@ class Transport:
         """The compiled global-array callable (what the benches time)."""
         return self._jit(verb, self._resolve(algo, verb), **knobs)
 
+    def group(self):
+        """Open an aggregation scope (the ncclGroupStart/End analogue): every
+        verb queued on the returned :class:`transport.group.Group` is traced
+        into ONE jitted program at ``with``-exit, so XLA schedules all the
+        collectives together. See ``transport/group.py``."""
+        from rocnrdma_tpu.transport.group import Group
+        return Group(self)
+
     def program_fn(self, prog):
         """Compile a custom :class:`collectives.Program` (the MSCCL-analogue
         schedule IR) into a global-array callable over this mesh's rank ring.
@@ -300,18 +308,40 @@ class Transport:
 
     # -- lowering ----------------------------------------------------------
 
-    def _jit(self, verb: str, algo: str, **knobs):
+    def _normalize_knobs(self, **knobs) -> dict:
+        """Validate knobs and strip defaults so every caller (verb methods,
+        bare jit_fn(), grouped calls) shares one compilation per program."""
         root = knobs.get("root")
         if root is not None and not 0 <= root < self.n_ranks:
             raise ValueError(f"root {root} out of range for {self.n_ranks} ranks")
-        # normalize defaults so verb methods and bare jit_fn() calls share
-        # one compilation per distinct program
-        knobs = {k: v for k, v in knobs.items()
-                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
-                 and not (k == "shift" and v == 1)}
+        return {k: v for k, v in knobs.items()
+                if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
+                and not (k == "shift" and v == 1)}
+
+    def _jit(self, verb: str, algo: str, **knobs):
+        knobs = self._normalize_knobs(**knobs)
         key = (verb, algo, tuple(sorted(knobs.items())))
         if key not in self._cache:
             self._cache[key] = self._build(verb, algo, **knobs)
+        return self._cache[key]
+
+    def _group_jit(self, sig: tuple):
+        """One jitted program running every (verb, algo, knobs) in ``sig``
+        over this mesh. Each call keeps its own shard_map (so each keeps the
+        exact ``check_vma`` setting it has when run standalone); all of them
+        trace into a single XLA module, which is where the aggregation
+        happens — the compiler sees every collective at once and is free to
+        interleave them, there being no data dependence between calls."""
+        key = ("__group__", sig)
+        if key in self._cache:
+            return self._cache[key]
+        mapped = [self._jit(verb, algo, **dict(knobs))
+                  for verb, algo, knobs in sig]
+
+        def run(*xs):
+            return tuple(fn(x) for fn, x in zip(mapped, xs))
+
+        self._cache[key] = jax.jit(run)
         return self._cache[key]
 
     def _build(self, verb: str, algo: str, **knobs):

@@ -1,0 +1,118 @@
+"""Grouped (aggregated) collectives — the ncclGroupStart/End analogue.
+
+In the reference's stack, group semantics batch many collective launches
+into one so the runtime can aggregate and overlap them (RCCL fuses small
+ops, launches channels concurrently, and defers blocking to the group end).
+The TPU-native translation is stronger than a launch trick: every queued
+verb is traced into ONE jitted XLA program, so the compiler sees all of
+them at once and is free to fuse, interleave and overlap their collective
+ops — the aggregation RCCL does by hand is XLA's scheduler doing its job.
+
+Usage::
+
+    t = Transport(mesh)
+    with t.group() as g:
+        h1 = g.allreduce(x1)                 # returns a GroupHandle
+        h2 = g.reduce_scatter(x2, algo="ring")
+        h3 = g.sendrecv(x3, shift=2)
+    y1, y2 = h1.result(), h2.result()        # materialised at group exit
+
+Handles defer like RCCL's in-group calls: touching ``.result()`` before the
+``with`` block closes raises, and the group executes exactly one compiled
+program per distinct op signature (cached on the Transport like every other
+schedule).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class GroupError(RuntimeError):
+    pass
+
+
+class GroupHandle:
+    """Deferred result of one queued verb (resolves at group exit)."""
+
+    def __init__(self, group: "Group", index: int):
+        self._group = group
+        self._index = index
+
+    def result(self) -> jax.Array:
+        if self._group._results is None:
+            raise GroupError(
+                "group not executed yet — leave the `with transport.group()` "
+                "block before reading results")
+        return self._group._results[self._index]
+
+
+class Group:
+    """Queue of collective calls, compiled and launched as one program."""
+
+    def __init__(self, transport):
+        self._t = transport
+        self._calls: list[tuple] = []  # (verb, algo, knobs, input)
+        self._results: list[jax.Array] | None = None
+        self._entered = False
+
+    # -- queueing (mirrors the Transport verb surface) ---------------------
+
+    def _queue(self, verb: str, x, algo: str, **knobs) -> GroupHandle:
+        if self._results is not None:
+            raise GroupError("group already executed; start a new group()")
+        knobs = self._t._normalize_knobs(**knobs)
+        resolved = self._t._resolve(algo, verb, self._t._msg_bytes(verb, x))
+        self._calls.append((verb, resolved, tuple(sorted(knobs.items())), x))
+        return GroupHandle(self, len(self._calls) - 1)
+
+    def allreduce(self, x, algo: str = "auto", op: str = "sum") -> GroupHandle:
+        return self._queue("allreduce", x, algo, op=op)
+
+    def reduce_scatter(self, x, algo: str = "auto", op: str = "sum") -> GroupHandle:
+        return self._queue("reduce_scatter", x, algo, op=op)
+
+    def allgather(self, x, algo: str = "auto") -> GroupHandle:
+        return self._queue("allgather", x, algo)
+
+    def alltoall(self, x, algo: str = "auto") -> GroupHandle:
+        return self._queue("alltoall", x, algo)
+
+    def broadcast(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
+        return self._queue("broadcast", x, algo, root=root)
+
+    def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum") -> GroupHandle:
+        return self._queue("reduce", x, algo, root=root, op=op)
+
+    def gather(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
+        return self._queue("gather", x, algo, root=root)
+
+    def scatter(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
+        return self._queue("scatter", x, algo, root=root)
+
+    def sendrecv(self, x, algo: str = "auto", shift: int = 1) -> GroupHandle:
+        return self._queue("sendrecv", x, algo, shift=shift)
+
+    # -- context manager ---------------------------------------------------
+
+    def __enter__(self) -> "Group":
+        if self._entered:
+            raise GroupError("a Group is single-use; start a new group()")
+        self._entered = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self._execute()
+        return False
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self) -> None:
+        if not self._calls:
+            self._results = []
+            return
+        sig = tuple((verb, algo, knobs) for verb, algo, knobs, _ in self._calls)
+        fn = self._t._group_jit(sig)
+        self._results = list(fn(*(x for _, _, _, x in self._calls)))
+        self._calls.clear()  # drop input references; results carry the data

@@ -1,0 +1,123 @@
+"""Grouped collectives (ncclGroupStart/End analogue): results match the
+individual verbs, handles defer until group exit, one program per signature."""
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.transport import GroupError, Transport
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+@pytest.fixture()
+def t8(devices):
+    return Transport(rt.rank_mesh(8))
+
+
+def test_group_matches_individual_calls(t8):
+    x1, x2, x3 = _rand((8, 40), 1), _rand((8, 64), 2), _rand((8, 8, 4), 3)
+    s1, s2, s3 = t8.shard(x1), t8.shard(x2), t8.shard(x3)
+    with t8.group() as g:
+        h1 = g.allreduce(s1)
+        h2 = g.reduce_scatter(s2, algo="ring")
+        h3 = g.alltoall(s3)
+    np.testing.assert_allclose(np.asarray(h1.result()),
+                               np.asarray(t8.allreduce(s1)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h2.result()),
+                               np.asarray(t8.reduce_scatter(s2, algo="ring")),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h3.result()),
+                               np.asarray(t8.alltoall(s3)), rtol=1e-6)
+
+
+def test_group_mixed_verbs_and_knobs(t8):
+    x1, x2, x3 = _rand((8, 24), 4), _rand((8, 24), 5), _rand((8, 16), 6)
+    s1, s2, s3 = t8.shard(x1), t8.shard(x2), t8.shard(x3)
+    with t8.group() as g:
+        h1 = g.broadcast(s1, root=3)
+        h2 = g.reduce(s2, root=2, op="max")
+        h3 = g.sendrecv(s3, shift=5)
+    want1 = np.broadcast_to(x1[3], x1.shape)
+    np.testing.assert_allclose(np.asarray(h1.result()), want1, rtol=1e-6)
+    want2 = np.zeros_like(x2)
+    want2[2] = x2.max(axis=0)
+    np.testing.assert_allclose(np.asarray(h2.result()), want2, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h3.result()), np.roll(x3, 5, axis=0),
+                               rtol=1e-6)
+
+
+def test_group_result_before_exit_raises(t8):
+    s = t8.shard(_rand((8, 16), 7))
+    with t8.group() as g:
+        h = g.allreduce(s)
+        with pytest.raises(GroupError, match="not executed"):
+            h.result()
+    h.result()  # fine after exit
+
+
+def test_group_queue_after_execute_raises(t8):
+    s = t8.shard(_rand((8, 16), 8))
+    with t8.group() as g:
+        g.allreduce(s)
+    with pytest.raises(GroupError, match="already executed"):
+        g.allreduce(s)
+
+
+def test_group_is_single_use(t8):
+    s = t8.shard(_rand((8, 16), 13))
+    with t8.group() as g:
+        g.allreduce(s)
+    with pytest.raises(GroupError, match="single-use"):
+        with g:
+            pass
+
+
+def test_group_empty_is_noop(t8):
+    with t8.group() as g:
+        pass
+    assert g._results == []
+
+
+def test_group_exception_skips_execution(t8):
+    s = t8.shard(_rand((8, 16), 9))
+    with pytest.raises(RuntimeError, match="boom"):
+        with t8.group() as g:
+            h = g.allreduce(s)
+            raise RuntimeError("boom")
+    with pytest.raises(GroupError):
+        h.result()
+
+
+def test_group_bad_root_raises_at_queue_time(t8):
+    s = t8.shard(_rand((8, 16), 10))
+    with t8.group() as g:
+        with pytest.raises(ValueError, match="root 9"):
+            g.broadcast(s, root=9)
+
+
+def test_group_shares_one_compiled_program(t8):
+    """Two identical-signature groups reuse the cached program object."""
+    s = t8.shard(_rand((8, 16), 11))
+    with t8.group() as g1:
+        g1.allreduce(s)
+        g1.allgather(s)
+    with t8.group() as g2:
+        g2.allreduce(s)
+        g2.allgather(s)
+    group_keys = [k for k in t8._cache if k[0] == "__group__"]
+    assert len(group_keys) == 1
+
+
+def test_group_on_2d_mesh(devices):
+    t = Transport(rt.slice_mesh(2, 4))
+    x = _rand((2, 4, 32), 12)
+    s = t.shard(x)
+    with t.group() as g:
+        h1 = g.allreduce(s, algo="hierarchical")
+        h2 = g.allreduce(s, algo="fused")
+    want = np.broadcast_to(x.sum((0, 1)), x.shape)
+    np.testing.assert_allclose(np.asarray(h1.result()), want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h2.result()), want, rtol=1e-5)
