@@ -32,8 +32,11 @@ _lib = None
 
 OP_SEND = 0
 OP_RECV = 1
+OP_WRITE = 2   # one-sided RDMA write completed (initiator-side CQE)
+OP_READ = 3    # one-sided RDMA read completed (initiator-side CQE)
 OK = 0
 ERR_TRUNC = 1
+ERR_REMOTE = 2  # remote denied the one-sided access (bad rkey/bounds)
 
 
 class _CQE(ctypes.Structure):
@@ -74,7 +77,8 @@ def _load():
         return _lib
     lib = ctypes.CDLL(build())
     lib.rqp_listen.restype = ctypes.c_void_p
-    lib.rqp_listen.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    lib.rqp_listen.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                               ctypes.c_uint32]
     lib.rqp_connect.restype = ctypes.c_void_p
     lib.rqp_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.rqp_accept.restype = ctypes.c_int
@@ -90,6 +94,21 @@ def _load():
                                 ctypes.c_int]
     lib.rqp_rx_pending.restype = ctypes.c_uint64
     lib.rqp_rx_pending.argtypes = [ctypes.c_void_p]
+    for pfx in ("rqp", "rtcp"):
+        reg = getattr(lib, f"{pfx}_reg_mr")
+        reg.restype = ctypes.c_int64
+        reg.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        addr = getattr(lib, f"{pfx}_mr_addr")
+        addr.restype = ctypes.c_void_p
+        addr.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        wr = getattr(lib, f"{pfx}_rdma_write")
+        wr.restype = ctypes.c_int64
+        wr.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
+                       ctypes.c_char_p, ctypes.c_uint32]
+        rd = getattr(lib, f"{pfx}_rdma_read")
+        rd.restype = ctypes.c_int64
+        rd.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
+                       ctypes.c_void_p, ctypes.c_uint32]
     lib.rqp_close.restype = None
     lib.rqp_close.argtypes = [ctypes.c_void_p]
     lib.rqp_unlink.restype = ctypes.c_int
@@ -173,6 +192,13 @@ class _QpBase(_Closeable):
         self._h = handle
         self.name = name
         self._recv_bufs: dict[int, bytearray] = {}
+        # one-sided read destinations: wr_id -> (bytearray, ctypes view);
+        # entries live until their completion is polled (the buffer is the
+        # registered local MR of the read, verbs-style)
+        self._read_bufs: dict[int, tuple] = {}
+        # completions drained by a blocking helper while waiting for its own
+        # wr; replayed (in order) by the next poll_cq so nothing is lost
+        self._pending_cqes: list[tuple] = []
         self._closed = False
 
     def _fn(self, op: str):
@@ -217,18 +243,24 @@ class _QpBase(_Closeable):
         return wr
 
     def poll_cq(self, max_cqes: int = 16) -> list[tuple[Completion, bytes | None]]:
-        """Drain completions; each recv completion carries its payload."""
+        """Drain completions; each recv completion carries its payload.
+        Completions stashed by a blocking helper are replayed first."""
+        out = self._pending_cqes
+        self._pending_cqes = []
         arr = (_CQE * max_cqes)()
         n = self._fn("poll_cq")(self._h, arr, max_cqes)
         if n == -2:
+            if out:  # deliver what we have; the error resurfaces next poll
+                return out
             raise OSError(f"{self._PREFIX}: peer closed/reset on {self.name!r}")
-        out = []
         for i in range(max(n, 0)):
             c = Completion(arr[i].wr_id, arr[i].opcode, arr[i].status,
                            arr[i].len)
             payload = None
             if c.opcode == OP_RECV:
                 payload = bytes(self._recv_bufs.pop(c.wr_id)[:c.length])
+            elif c.opcode == OP_READ:
+                self._read_bufs.pop(c.wr_id, None)  # dst now filled; release
             out.append((c, payload))
         return out
 
@@ -255,16 +287,162 @@ class _QpBase(_Closeable):
                     f"{self._PREFIX}: recv timed out on {self.name!r}")
             time.sleep(0.0005)
 
+    # -- one-sided RDMA ----------------------------------------------------
+
+    def reg_mr(self, nbytes: int) -> "MemoryRegion":
+        """Register an ``nbytes`` memory region with this QP (the
+        ``ibv_reg_mr`` analogue). Share ``mr.rkey`` with the peer out of
+        band (e.g. over ``send``); the peer then moves bytes with
+        ``rdma_write`` / ``rdma_read`` while this side's CPU stays out of
+        the path."""
+        rkey = self._fn("reg_mr")(self._h, nbytes)
+        if rkey < 0:
+            raise OSError(f"{self._PREFIX}: MR registration of {nbytes} B "
+                          f"failed on {self.name!r} (arena full?)")
+        return MemoryRegion(self, rkey, nbytes)
+
+    def post_rdma_write(self, rkey: int, data: bytes, offset: int = 0) -> int:
+        """One-sided write of ``data`` into the MR named by ``rkey`` at
+        ``offset``; wr_id (CQE opcode OP_WRITE), -1 on backpressure, raises
+        on invalid rkey/bounds (shm plane detects locally)."""
+        data = bytes(data)
+        if len(data) > self.MAX_MSG:
+            raise ValueError(
+                f"{self._PREFIX}: {len(data)} B one-sided write exceeds the "
+                f"{self.MAX_MSG} B bound; chunk at the caller")
+        if offset < 0:
+            raise ValueError(f"{self._PREFIX}: negative offset {offset}")
+        wr = self._fn("rdma_write")(self._h, rkey, offset, data, len(data))
+        if wr == -2:
+            raise OSError(f"{self._PREFIX}: peer closed/reset on {self.name!r}")
+        if wr == -3:
+            raise OSError(f"{self._PREFIX}: invalid rkey/bounds for one-sided "
+                          f"write on {self.name!r}")
+        return wr
+
+    def rdma_write(self, rkey: int, data: bytes, offset: int = 0,
+                   timeout_s: float = 10.0) -> None:
+        """Blocking one-sided write: post, then wait for the local CQE."""
+        self._await_rdma(
+            lambda: self.post_rdma_write(rkey, data, offset), OP_WRITE,
+            timeout_s)
+
+    def post_rdma_read(self, rkey: int, into: bytearray, offset: int = 0) -> int:
+        """One-sided read of ``len(into)`` bytes from the MR at ``offset``
+        into the caller's buffer; completes with a CQE (opcode OP_READ,
+        status ERR_REMOTE if the target denied the access). The buffer must
+        stay alive until the completion is polled — it IS the registered
+        local MR, verbs-style."""
+        n = len(into)
+        if n > self.MAX_MSG:
+            raise ValueError(
+                f"{self._PREFIX}: {n} B one-sided read exceeds the "
+                f"{self.MAX_MSG} B bound; chunk at the caller")
+        if offset < 0:
+            raise ValueError(f"{self._PREFIX}: negative offset {offset}")
+        cbuf = (ctypes.c_char * n).from_buffer(into)
+        wr = self._fn("rdma_read")(self._h, rkey, offset, cbuf, n)
+        if wr == -2:
+            raise OSError(f"{self._PREFIX}: peer closed/reset on {self.name!r}")
+        if wr == -3:
+            raise OSError(f"{self._PREFIX}: invalid rkey/bounds for one-sided "
+                          f"read on {self.name!r}")
+        if wr >= 0:
+            self._read_bufs[wr] = (into, cbuf)
+        return wr
+
+    def rdma_read(self, rkey: int, nbytes: int, offset: int = 0,
+                  timeout_s: float = 10.0) -> bytes:
+        """Blocking one-sided read; returns the fetched bytes."""
+        out = bytearray(nbytes)
+        self._await_rdma(
+            lambda: self.post_rdma_read(rkey, out, offset), OP_READ,
+            timeout_s)
+        return bytes(out)
+
+    def _await_rdma(self, post, opcode: int, timeout_s: float) -> None:
+        import time
+        deadline = time.monotonic() + timeout_s
+        while True:
+            wr = post()
+            if wr >= 0:
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"{self._PREFIX}: one-sided op backpressured past "
+                    f"deadline on {self.name!r}")
+            time.sleep(0.0005)
+        while True:
+            mine = None
+            for c, payload in self.poll_cq():
+                if mine is None and c.wr_id == wr and c.opcode == opcode:
+                    mine = c
+                else:
+                    # foreign CQEs drained while waiting are replayed by the
+                    # next poll_cq — verbs semantics: nothing is lost
+                    self._pending_cqes.append((c, payload))
+            if mine is not None:
+                if mine.status == ERR_REMOTE:
+                    raise OSError(
+                        f"{self._PREFIX}: remote denied one-sided access "
+                        f"(bad rkey/bounds) on {self.name!r}")
+                if mine.status != OK:
+                    raise OSError(
+                        f"{self._PREFIX}: one-sided op failed "
+                        f"(status {mine.status}) on {self.name!r}")
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"{self._PREFIX}: one-sided completion timed out on "
+                    f"{self.name!r}")
+            time.sleep(0.0005)
+
     # -- teardown ----------------------------------------------------------
 
     def _do_close(self) -> None:
         # drop ctypes views into posted bytearrays before freeing them
         self._recv_bufs.clear()
+        self._read_bufs.clear()
+        self._pending_cqes.clear()
         self._fn("close")(self._h)
         self._post_close()
 
     def _post_close(self) -> None:
         """Plane-specific cleanup hook (shm unlink etc.)."""
+
+
+class MemoryRegion:
+    """A registered memory region (the ``ibv_mr`` analogue).
+
+    ``rkey`` is the token the peer uses for one-sided access — ship it out
+    of band (typically over the QP's own send/recv). ``read``/``write`` give
+    the OWNER byte access to the region through the local mapping.
+    """
+
+    def __init__(self, qp: "_QpBase", rkey: int, nbytes: int):
+        self._qp = qp
+        self.rkey = rkey
+        self.nbytes = nbytes
+
+    def _addr(self) -> int:
+        addr = self._qp._fn("mr_addr")(self._qp._h, self.rkey)
+        if not addr:
+            raise OSError(f"{self._qp._PREFIX}: MR address lookup failed")
+        return addr
+
+    def read(self, offset: int = 0, nbytes: int | None = None) -> bytes:
+        nbytes = self.nbytes - offset if nbytes is None else nbytes
+        if offset < 0 or nbytes < 0 or offset + nbytes > self.nbytes:
+            raise ValueError(f"read [{offset}, {offset + nbytes}) outside "
+                             f"{self.nbytes} B MR")
+        return ctypes.string_at(self._addr() + offset, nbytes)
+
+    def write(self, data: bytes, offset: int = 0) -> None:
+        data = bytes(data)
+        if offset < 0 or offset + len(data) > self.nbytes:
+            raise ValueError(f"write [{offset}, {offset + len(data)}) outside "
+                             f"{self.nbytes} B MR")
+        ctypes.memmove(self._addr() + offset, data, len(data))
 
 
 class QueuePair(_QpBase):
@@ -286,10 +464,12 @@ class QueuePair(_QpBase):
     # -- connection setup (listen / connect / accept) ----------------------
 
     @classmethod
-    def listen(cls, name: str, capacity: int = 1 << 20) -> "QueuePair":
+    def listen(cls, name: str, capacity: int = 1 << 20,
+               mr_capacity: int = 1 << 20) -> "QueuePair":
         lib = _load()
         lib.rqp_unlink(name.encode())  # drop stale segment from a dead run
-        return cls(lib.rqp_listen(name.encode(), capacity), name, True)
+        return cls(lib.rqp_listen(name.encode(), capacity, mr_capacity),
+                   name, True)
 
     @classmethod
     def connect(cls, name: str, timeout_s: float = 10.0) -> "QueuePair":
@@ -347,7 +527,10 @@ class TcpQueuePair(_QpBase):
     """
 
     _PREFIX = "rtcp"
-    MAX_MSG = (64 << 20) - 4     # the rtcp tx-queue cap, minus frame header
+    # The 64 MiB tx/frame cap minus worst-case protocol overhead across every
+    # frame kind (MSG header 8 B, WRITE 24 B, READ_RESP 20 B), with slack —
+    # so any payload the bound admits fits every frame it may ride in.
+    MAX_MSG = (64 << 20) - 64
     is_listener = False          # no shm segment to unlink at close
 
     @classmethod

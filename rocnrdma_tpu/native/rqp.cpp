@@ -18,7 +18,7 @@
 // which keeps payload copies contiguous for the reader.
 //
 // Exported C ABI (consumed by rocnrdma_tpu/native/__init__.py via ctypes):
-//   rqp_listen(name, capacity)      -> handle   (creates the segment)
+//   rqp_listen(name, capacity, mr_capacity) -> handle (creates the segment)
 //   rqp_connect(name, timeout_ms)   -> handle   (opens it, swapped rings)
 //   rqp_accept(handle, timeout_ms)  -> 0/-1     (wait for peer attach)
 //   rqp_post_send(handle, buf, len) -> wr_id    (-1: ring full, retry)
@@ -26,10 +26,24 @@
 //   rqp_poll_cq(handle, cqes, max)  -> n        (drain completions)
 //   rqp_close(handle)               / rqp_unlink(name)
 //
+// One-sided RDMA (the ibv_wr_rdma_write / ibv_wr_rdma_read analogue). The
+// segment carries an MR arena split in two halves, one per side; an MR is a
+// bump-allocated span of the owner's half and its rkey (side|len|offset
+// packed in a u64) is meaningful to EITHER side, so the initiator moves
+// bytes with a direct memcpy into the shared mapping — the target's CPU is
+// not involved, which is the defining property of one-sided RDMA (here shm
+// stands in for the DMA engine):
+//   rqp_reg_mr(handle, len)                  -> rkey  (-1: arena full)
+//   rqp_mr_addr(handle, rkey)                -> local pointer (own mapping)
+//   rqp_rdma_write(handle, rkey, off, buf, len) -> wr_id (CQE opcode WRITE)
+//   rqp_rdma_read(handle, rkey, off, buf, len)  -> wr_id (CQE opcode READ)
+//
 // Completion semantics mirror verbs: a send completes once its bytes are in
 // the ring (buffer reusable); a receive completes when a message has been
 // copied into the oldest posted receive buffer. RQP_ERR_TRUNC is reported —
-// not silently dropped — when a message exceeds the posted buffer.
+// not silently dropped — when a message exceeds the posted buffer. One-sided
+// ops complete locally only (opcode RQP_OP_WRITE/READ); the target sees no
+// CQE, exactly like the verbs.
 
 #include <atomic>
 #include <cerrno>
@@ -59,9 +73,12 @@ struct Ring {
 struct ShmHdr {
   uint32_t magic;
   uint32_t capacity;               // data bytes per ring
+  uint32_t mr_capacity;            // MR arena bytes per side
   std::atomic<uint32_t> attached;  // bit0 = listener, bit1 = connector
+  std::atomic<uint32_t> mr_used[2];  // bump allocator per side's arena half
   Ring ring[2];                    // ring[0]: listener->connector; ring[1]: reverse
-  // followed by: ring0 data[capacity], ring1 data[capacity]
+  // followed by: ring0 data[capacity], ring1 data[capacity],
+  //              arena0[mr_capacity] (listener), arena1[mr_capacity]
 };
 
 struct RecvWr {
@@ -73,6 +90,7 @@ struct RecvWr {
 struct PendingSendCqe {
   int64_t wr_id;
   uint32_t len;
+  int32_t opcode;  // RQP_OP_SEND / RQP_OP_WRITE / RQP_OP_READ
 };
 
 struct Handle {
@@ -80,6 +98,7 @@ struct Handle {
   size_t map_len = 0;
   char* send_data = nullptr;  // data area of the ring this side writes
   char* recv_data = nullptr;
+  char* arena[2] = {nullptr, nullptr};  // MR arena halves (by side)
   Ring* send_ring = nullptr;
   Ring* recv_ring = nullptr;
   bool is_listener = false;
@@ -97,8 +116,16 @@ uint64_t now_ms() {
 
 uint32_t pad8(uint32_t n) { return (n + (kAlign - 1)) & ~(kAlign - 1); }
 
-size_t map_len_for(uint32_t capacity) {
-  return sizeof(ShmHdr) + size_t(capacity) * 2;
+size_t map_len_for(uint32_t capacity, uint32_t mr_capacity) {
+  return sizeof(ShmHdr) + (size_t(capacity) + size_t(mr_capacity)) * 2;
+}
+
+// rkey packing: [0][side:1][len:30][offset:32] — always non-negative, so
+// the -1 error return stays unambiguous. Side names the arena half the MR
+// lives in (0 = listener's), so a peer-received rkey resolves identically
+// from both mappings.
+int64_t pack_rkey(uint32_t side, uint32_t len, uint32_t off) {
+  return (int64_t(side) << 62) | (int64_t(len) << 32) | int64_t(off);
 }
 
 Handle* attach(ShmHdr* hdr, size_t mlen, bool listener, const char* name) {
@@ -109,6 +136,8 @@ Handle* attach(ShmHdr* hdr, size_t mlen, bool listener, const char* name) {
   h->shm_name = name;
   char* data0 = reinterpret_cast<char*>(hdr) + sizeof(ShmHdr);
   char* data1 = data0 + hdr->capacity;
+  h->arena[0] = data1 + hdr->capacity;
+  h->arena[1] = h->arena[0] + hdr->mr_capacity;
   if (listener) {
     h->send_ring = &hdr->ring[0]; h->send_data = data0;
     h->recv_ring = &hdr->ring[1]; h->recv_data = data1;
@@ -132,14 +161,17 @@ struct rqp_cqe {
   uint32_t pad_;
 };
 
-enum { RQP_OP_SEND = 0, RQP_OP_RECV = 1, RQP_OK = 0, RQP_ERR_TRUNC = 1 };
+enum { RQP_OP_SEND = 0, RQP_OP_RECV = 1, RQP_OP_WRITE = 2, RQP_OP_READ = 3,
+       RQP_OK = 0, RQP_ERR_TRUNC = 1 };
 
-void* rqp_listen(const char* name, uint32_t capacity) {
+void* rqp_listen(const char* name, uint32_t capacity, uint32_t mr_capacity) {
   if (capacity < 64) return nullptr;
   capacity = pad8(capacity);
+  mr_capacity = pad8(mr_capacity);
+  if (mr_capacity > (1u << 30) - 1) return nullptr;  // rkey len field: 30 bits
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
-  size_t mlen = map_len_for(capacity);
+  size_t mlen = map_len_for(capacity, mr_capacity);
   if (ftruncate(fd, off_t(mlen)) != 0) {
     close(fd);
     shm_unlink(name);
@@ -154,6 +186,7 @@ void* rqp_listen(const char* name, uint32_t capacity) {
   ShmHdr* hdr = static_cast<ShmHdr*>(mem);
   std::memset(hdr, 0, sizeof(ShmHdr));
   hdr->capacity = capacity;
+  hdr->mr_capacity = mr_capacity;
   std::atomic_thread_fence(std::memory_order_release);
   hdr->magic = kMagic;
   return attach(hdr, mlen, /*listener=*/true, name);
@@ -170,9 +203,10 @@ void* rqp_connect(const char* name, int timeout_ms) {
         if (probe != MAP_FAILED) {
           uint32_t magic = static_cast<ShmHdr*>(probe)->magic;
           uint32_t cap = static_cast<ShmHdr*>(probe)->capacity;
+          uint32_t mr_cap = static_cast<ShmHdr*>(probe)->mr_capacity;
           munmap(probe, sizeof(ShmHdr));
           if (magic == kMagic) {
-            size_t mlen = map_len_for(cap);
+            size_t mlen = map_len_for(cap, mr_cap);
             void* mem =
                 mmap(nullptr, mlen, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
             close(fd);
@@ -231,7 +265,7 @@ int64_t rqp_post_send(void* hv, const void* buf, uint32_t len) {
   if (len) std::memcpy(h->send_data + off + 4, buf, len);
   r->head.store(head + advance + need, std::memory_order_release);
   int64_t id = h->next_wr++;
-  h->send_cq.push_back({id, len});
+  h->send_cq.push_back({id, len, RQP_OP_SEND});
   return id;
 }
 
@@ -247,11 +281,11 @@ int rqp_poll_cq(void* hv, rqp_cqe* cqes, int max_cqes) {
   Handle* h = static_cast<Handle*>(hv);
   if (!h || !cqes || max_cqes <= 0) return -1;
   int n = 0;
-  // send completions first (they were finished at post time)
+  // send-side completions first (sends and one-sided ops finish at post time)
   while (n < max_cqes && !h->send_cq.empty()) {
     PendingSendCqe c = h->send_cq.front();
     h->send_cq.pop_front();
-    cqes[n++] = {c.wr_id, RQP_OP_SEND, RQP_OK, c.len, 0};
+    cqes[n++] = {c.wr_id, c.opcode, RQP_OK, c.len, 0};
   }
   // then drain incoming messages into posted receive buffers
   Ring* r = h->recv_ring;
@@ -282,6 +316,86 @@ int rqp_poll_cq(void* hv, rqp_cqe* cqes, int max_cqes) {
                  msg_len <= wr.cap ? RQP_OK : RQP_ERR_TRUNC, copy_len, 0};
   }
   return n;
+}
+
+// -- one-sided RDMA ---------------------------------------------------------
+
+// Register an MR of `len` bytes in THIS side's arena half; returns its rkey
+// (valid on either side), or -1 when the arena is exhausted (registration is
+// bump-allocated for the life of the segment, like a pinned region).
+int64_t rqp_reg_mr(void* hv, uint32_t len) {
+  Handle* h = static_cast<Handle*>(hv);
+  // len bound: fits the 30-bit rkey field AND keeps pad8/off+need arithmetic
+  // far from uint32 wraparound (a wrapped CAS would corrupt the watermark
+  // and retroactively invalidate every issued rkey)
+  if (!h || len == 0 || len > (1u << 30) - 1) return -1;
+  uint32_t side = h->is_listener ? 0 : 1;
+  uint32_t need = pad8(len);
+  std::atomic<uint32_t>& used = h->hdr->mr_used[side];
+  uint32_t off = used.load(std::memory_order_relaxed);
+  for (;;) {
+    if (uint64_t(off) + need > h->hdr->mr_capacity) return -1;
+    if (used.compare_exchange_weak(off, off + need,
+                                   std::memory_order_acq_rel))
+      break;
+  }
+  return pack_rkey(side, len, off);
+}
+
+bool unpack_rkey(Handle* h, int64_t rkey, uint64_t off, uint32_t len,
+                 char** ptr) {
+  if (rkey < 0) return false;
+  uint32_t side = uint32_t((rkey >> 62) & 1);
+  uint32_t mr_len = uint32_t((rkey >> 32) & 0x3FFFFFFFu);
+  uint32_t mr_off = uint32_t(rkey & 0xFFFFFFFFu);
+  // the MR must lie entirely inside space the owner actually registered
+  // (the bump-allocator watermark), so a forged in-capacity rkey is refused
+  uint32_t used = h->hdr->mr_used[side].load(std::memory_order_acquire);
+  if (mr_off + uint64_t(mr_len) > used) return false;
+  // overflow-safe access check: `off + len` could wrap uint64
+  if (off > mr_len || len > mr_len - off) return false;
+  *ptr = h->arena[side] + mr_off + off;
+  return true;
+}
+
+// Local pointer into an MR (own mapping) — both sides may use it; the rkey
+// carries which arena half the MR lives in.
+void* rqp_mr_addr(void* hv, int64_t rkey) {
+  Handle* h = static_cast<Handle*>(hv);
+  char* p = nullptr;
+  if (!h || !unpack_rkey(h, rkey, 0, 0, &p)) return nullptr;
+  return p;
+}
+
+// One-sided write: memcpy straight into the MR through the shared mapping
+// (the DMA). Completes locally (CQE opcode RQP_OP_WRITE); no target CQE.
+int64_t rqp_rdma_write(void* hv, int64_t rkey, uint64_t off, const void* buf,
+                       uint32_t len) {
+  Handle* h = static_cast<Handle*>(hv);
+  char* dst = nullptr;
+  if (!h || (len > 0 && !buf)) return -1;
+  if (!unpack_rkey(h, rkey, off, len, &dst)) return -3;  // bad rkey/bounds
+  if (len) std::memcpy(dst, buf, len);
+  // release: a subsequent ring message (the usual "data ready" signal)
+  // must not be observable before the written bytes
+  std::atomic_thread_fence(std::memory_order_release);
+  int64_t id = h->next_wr++;
+  h->send_cq.push_back({id, len, RQP_OP_WRITE});
+  return id;
+}
+
+// One-sided read: memcpy out of the MR into a local buffer.
+int64_t rqp_rdma_read(void* hv, int64_t rkey, uint64_t off, void* buf,
+                      uint32_t len) {
+  Handle* h = static_cast<Handle*>(hv);
+  char* src = nullptr;
+  if (!h || (len > 0 && !buf)) return -1;
+  if (!unpack_rkey(h, rkey, off, len, &src)) return -3;  // bad rkey/bounds
+  std::atomic_thread_fence(std::memory_order_acquire);
+  if (len) std::memcpy(buf, src, len);
+  int64_t id = h->next_wr++;
+  h->send_cq.push_back({id, len, RQP_OP_READ});
+  return id;
 }
 
 // How many bytes are sitting unread in the incoming ring (diagnostics).

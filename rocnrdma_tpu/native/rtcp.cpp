@@ -21,6 +21,24 @@
 //                                          parses rx frames, fills WRs)
 //   rtcp_tx_pending(c) / rtcp_close(c) / rtcp_close_listener(l)
 //
+// One-sided RDMA (ibv_wr_rdma_write/read over the socket). An MR here is a
+// heap buffer owned by the connection; WRITE and READ travel as typed frames
+// that the TARGET's progress engine applies directly to the MR — no posted
+// receive, no target CQE — the soft-NIC emulation of what the reference's
+// NIC did in hardware (iWARP does exactly this over TCP):
+//   rtcp_reg_mr(c, len)                  -> rkey (-1: failure)
+//   rtcp_mr_addr(c, rkey)                -> local pointer into the MR
+//   rtcp_rdma_write(c, rkey, off, buf, len) -> wr_id (CQE op WRITE on flush)
+//   rtcp_rdma_read(c, rkey, off, buf, len)  -> wr_id (CQE op READ on resp;
+//                                           status ST_RERR if remote denied)
+// A WRITE that violates the target's MR bounds breaks the connection (the
+// verbs QP-error analogue); a bad READ returns a denied response instead,
+// so the initiator gets a CQE, not a hang.
+//
+// Wire format: [len u32][type u32][body] little-endian. type 0 = MSG (user
+// payload), 1 = WRITE [rkey i64][off u64][data], 2 = READ_REQ [req i64]
+// [rkey i64][off u64][len u32], 3 = READ_RESP [req i64][status u32][data].
+//
 // Completion semantics: a send completes once every byte of its frame has
 // been handed to the kernel (buffer reusable — the verbs contract); a recv
 // completes when a whole message has landed in the oldest posted buffer,
@@ -62,10 +80,19 @@ struct Cqe {
   uint32_t pad_;
 };
 
-enum { OP_SEND = 0, OP_RECV = 1, ST_OK = 0, ST_TRUNC = 1 };
+enum { OP_SEND = 0, OP_RECV = 1, OP_WRITE = 2, OP_READ = 3,
+       ST_OK = 0, ST_TRUNC = 1, ST_RERR = 2 };
+
+enum : uint32_t { FR_MSG = 0, FR_WRITE = 1, FR_READ_REQ = 2, FR_READ_RESP = 3 };
 
 constexpr uint64_t kTxCapBytes = 64ull << 20;  // pending-tx bound per conn
-constexpr int kMaxStagedMsgs = 64;             // parsed-but-unclaimed inbound
+// Parsed-but-unclaimed inbound MSG bound. Generous on purpose: TCP is ONE
+// ordered stream, so refusing to stage a MSG head-of-line-blocks every
+// typed (one-sided) frame behind it. Below the bound we keep parsing so
+// RDMA traffic flows even when the user posts no receives; at the bound we
+// stop reading (kernel-buffer backpressure) — heap stays bounded either way.
+constexpr int kMaxStagedMsgs = 4096;
+constexpr uint64_t kMaxStagedBytes = 64ull << 20;
 // Largest frame a peer may announce. Our own sender can never exceed the tx
 // cap, so anything bigger is a corrupt or hostile header — without this cap
 // a 4-byte 0xFFFFFFFF header would drive a ~4 GiB reserve() on the receiver.
@@ -83,8 +110,9 @@ struct Listener {
 };
 
 struct TxMsg {
-  int64_t wr_id;
-  std::vector<char> frame;  // [len u32][payload]
+  int64_t wr_id;            // 0: internal frame (no completion emitted)
+  int32_t opcode = OP_SEND; // CQE opcode when the frame finishes flushing
+  std::vector<char> frame;  // [len u32][type u32][body]
   size_t sent = 0;
 };
 
@@ -98,6 +126,21 @@ struct RxMsg {
   std::vector<char> payload;
 };
 
+struct Mr {
+  std::vector<char> buf;
+};
+
+struct PendingRead {
+  int64_t wr_id;
+  void* buf;
+  uint32_t len;
+};
+
+struct SendDone {
+  int64_t wr_id;
+  int32_t opcode;
+};
+
 struct Conn {
   int fd = -1;
   int64_t next_wr = 1;
@@ -105,14 +148,20 @@ struct Conn {
   bool eof = false;  // peer sent orderly FIN
   std::deque<TxMsg> txq;
   uint64_t tx_bytes = 0;               // queued-not-yet-written bytes
-  std::deque<int64_t> send_done;       // completed sends awaiting poll
+  std::deque<SendDone> send_done;      // flushed sends/writes awaiting poll
   std::deque<RecvWr> recv_q;           // posted receive buffers, FIFO
   std::deque<RxMsg> staged;            // parsed messages with no WR yet
-  // rx parse state
-  char hdr[4];
+  uint64_t staged_bytes = 0;           // payload bytes held in `staged`
+  // one-sided state
+  std::vector<Mr> mrs;                 // rkey low bits index this
+  std::deque<Cqe> rdma_done;           // completed one-sided reads
+  std::vector<std::pair<int64_t, PendingRead>> pending_reads;  // req -> dst
+  int64_t next_req = 1;
+  // rx parse state ([len u32][type u32] read together, then the body)
+  char hdr[8];
   uint32_t hdr_have = 0;
-  std::vector<char> cur;               // payload in flight
-  uint32_t cur_len = 0;
+  std::vector<char> cur;               // type + body in flight
+  uint32_t cur_len = 0;                // total frame length (type + body)
   bool mid_msg = false;
 };
 
@@ -144,22 +193,155 @@ void pump_tx(Conn* c) {
         return;
       }
     }
-    c->send_done.push_back(m.wr_id);
+    if (m.wr_id != 0) c->send_done.push_back({m.wr_id, m.opcode});
     c->txq.pop_front();
   }
 }
 
-// Read whatever is on the socket, parsing frames. Stops pulling new frames
-// once `staged` is saturated so an unserviced peer backpressures through the
-// kernel socket buffer instead of growing our heap without bound.
+// Append a frame to the tx queue. wr_id 0 marks internal (protocol) frames
+// that complete silently. Returns false on backpressure (caller retries).
+bool queue_frame(Conn* c, int64_t wr_id, int32_t opcode, uint32_t type,
+                 const void* hdr_bytes, uint32_t hdr_len, const void* data,
+                 uint32_t data_len, bool respect_cap) {
+  // 64-bit arithmetic: data_len near 2^32 must reject, not wrap into a tiny
+  // frame whose memcpy then overruns the heap (the ABI's own guard — the
+  // Python MAX_MSG bound must not be the only thing standing)
+  uint64_t body64 = 4 + uint64_t(hdr_len) + data_len;
+  if (body64 > kMaxFrameBytes) return false;
+  uint32_t body_len = uint32_t(body64);
+  if (respect_cap && c->tx_bytes + 4 + body64 > kTxCapBytes) return false;
+  TxMsg m;
+  m.wr_id = wr_id;
+  m.opcode = opcode;
+  m.frame.resize(4 + body_len);
+  std::memcpy(m.frame.data(), &body_len, 4);
+  std::memcpy(m.frame.data() + 4, &type, 4);
+  if (hdr_len) std::memcpy(m.frame.data() + 8, hdr_bytes, hdr_len);
+  if (data_len)
+    std::memcpy(m.frame.data() + 8 + hdr_len, data, data_len);
+  c->tx_bytes += m.frame.size();
+  c->txq.push_back(std::move(m));
+  return true;
+}
+
+// Resolve rkey -> MR span with bounds checks (overflow-safe: `off + len`
+// could wrap uint64 on hostile frames, so compare subtractively).
+char* mr_span(Conn* c, int64_t rkey, uint64_t off, uint64_t len) {
+  if (rkey < 0) return nullptr;
+  uint32_t id = uint32_t(rkey & 0xFFFFFFFFu);
+  uint32_t mr_len = uint32_t((rkey >> 32) & 0x3FFFFFFFu);
+  if (id >= c->mrs.size()) return nullptr;
+  Mr& mr = c->mrs[id];
+  if (mr.buf.size() != mr_len) return nullptr;  // stale/forged rkey
+  if (off > mr.buf.size() || len > mr.buf.size() - off) return nullptr;
+  return mr.buf.data() + off;
+}
+
+// Apply one complete inbound frame (type + body in c->cur). Returns false
+// when the frame is a protocol violation (connection must break).
+bool dispatch_frame(Conn* c) {
+  if (c->cur.size() < 4) return false;
+  uint32_t type;
+  std::memcpy(&type, c->cur.data(), 4);
+  const char* body = c->cur.data() + 4;
+  size_t blen = c->cur.size() - 4;
+  switch (type) {
+    case FR_MSG: {
+      c->staged.push_back({std::vector<char>(body, body + blen)});
+      c->staged_bytes += blen;
+      return true;
+    }
+    case FR_WRITE: {  // [rkey i64][off u64][data] -> straight into the MR
+      if (blen < 16) return false;
+      int64_t rkey;
+      uint64_t off;
+      std::memcpy(&rkey, body, 8);
+      std::memcpy(&off, body + 8, 8);
+      char* dst = mr_span(c, rkey, off, blen - 16);
+      if (!dst) return false;  // remote access error: QP goes to error state
+      std::memcpy(dst, body + 16, blen - 16);
+      return true;
+    }
+    case FR_READ_REQ: {  // [req i64][rkey i64][off u64][len u32]
+      if (blen != 28) return false;
+      int64_t req, rkey;
+      uint64_t off;
+      uint32_t len;
+      std::memcpy(&req, body, 8);
+      std::memcpy(&rkey, body + 8, 8);
+      std::memcpy(&off, body + 16, 8);
+      std::memcpy(&len, body + 24, 4);
+      char* src = mr_span(c, rkey, off, len);
+      uint32_t status = src ? ST_OK : ST_RERR;
+      char rhdr[12];
+      std::memcpy(rhdr, &req, 8);
+      std::memcpy(rhdr + 8, &status, 4);
+      // response bypasses the tx cap: it must not deadlock behind user tx
+      queue_frame(c, 0, OP_SEND, FR_READ_RESP, rhdr, sizeof(rhdr),
+                  src, src ? len : 0, /*respect_cap=*/false);
+      return true;
+    }
+    case FR_READ_RESP: {  // [req i64][status u32][data]
+      if (blen < 12) return false;
+      int64_t req;
+      uint32_t status;
+      std::memcpy(&req, body, 8);
+      std::memcpy(&status, body + 8, 4);
+      for (auto it = c->pending_reads.begin(); it != c->pending_reads.end();
+           ++it) {
+        if (it->first != req) continue;
+        PendingRead pr = it->second;
+        c->pending_reads.erase(it);
+        uint32_t got = uint32_t(blen - 12);
+        uint32_t copy = got < pr.len ? got : pr.len;
+        if (status == ST_OK && copy && pr.buf)
+          std::memcpy(pr.buf, body + 12, copy);
+        c->rdma_done.push_back(
+            {pr.wr_id, OP_READ,
+             status != ST_OK ? int32_t(ST_RERR)
+                             : (got < pr.len ? int32_t(ST_TRUNC)
+                                             : int32_t(ST_OK)),
+             status == ST_OK ? copy : 0, 0});
+        return true;
+      }
+      return false;  // response to a request we never made
+    }
+    default:
+      return false;
+  }
+}
+
+// Read whatever is on the socket, parsing frames. Stops pulling a new MSG
+// frame once `staged` is saturated so an unserviced peer backpressures
+// through the kernel socket buffer instead of growing our heap without
+// bound — but only MSG frames: one-sided WRITE/READ frames must flow even
+// when the user posts no receives (that is the one-sided contract), so the
+// gate fires after the frame type is known (first 4 body bytes).
+// Should the in-flight frame wait before we pull/dispatch its body?
+// - FR_MSG waits when staging is hard-bounded and no receive is posted.
+// - FR_READ_REQ waits while our response backlog exceeds the tx cap: the
+//   responses bypass the cap (they must not deadlock behind user tx), so
+//   without this gate a peer posting reads it never polls would amplify its
+//   bounded requests into an unbounded response heap on our side. Gating
+//   reads cannot deadlock — pump_tx keeps draining regardless.
+// - One-sided WRITE frames are never gated (their contract).
+bool rx_gated(Conn* c) {
+  if (!c->mid_msg || c->cur.size() < 4) return false;
+  uint32_t type;
+  std::memcpy(&type, c->cur.data(), 4);
+  if (type == FR_MSG)
+    return (int(c->staged.size()) >= kMaxStagedMsgs ||
+            c->staged_bytes >= kMaxStagedBytes) &&
+           c->recv_q.empty();
+  if (type == FR_READ_REQ) return c->tx_bytes >= kTxCapBytes;
+  return false;
+}
+
 void pump_rx(Conn* c) {
   for (;;) {
-    if (!c->mid_msg && int(c->staged.size()) >= kMaxStagedMsgs &&
-        c->recv_q.empty())
-      return;
     if (!c->mid_msg) {
-      while (c->hdr_have < 4) {
-        ssize_t n = recv(c->fd, c->hdr + c->hdr_have, 4 - c->hdr_have, 0);
+      while (c->hdr_have < 8) {
+        ssize_t n = recv(c->fd, c->hdr + c->hdr_have, 8 - c->hdr_have, 0);
         if (n > 0) {
           c->hdr_have += uint32_t(n);
         } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
@@ -174,15 +356,19 @@ void pump_rx(Conn* c) {
         }
       }
       std::memcpy(&c->cur_len, c->hdr, 4);
-      if (c->cur_len > kMaxFrameBytes) {  // protocol violation, not a frame
-        c->broken = true;
+      if (c->cur_len > kMaxFrameBytes || c->cur_len < 4) {
+        c->broken = true;  // protocol violation (every frame has a type)
         return;
       }
       c->hdr_have = 0;
       c->mid_msg = true;
       c->cur.clear();
       c->cur.reserve(c->cur_len);
+      c->cur.insert(c->cur.end(), c->hdr + 4, c->hdr + 8);  // the type word
     }
+    // gate BEFORE pulling (or dispatching) body bytes, so a saturated MSG
+    // queue backpressures through the kernel socket buffer
+    if (rx_gated(c)) return;
     while (c->cur.size() < c->cur_len) {
       char tmp[1 << 16];
       size_t want = c->cur_len - c->cur.size();
@@ -197,7 +383,10 @@ void pump_rx(Conn* c) {
         return;
       }
     }
-    c->staged.push_back({std::move(c->cur)});
+    if (!dispatch_frame(c)) {
+      c->broken = true;
+      return;
+    }
     c->cur.clear();
     c->mid_msg = false;
   }
@@ -301,14 +490,70 @@ int64_t rtcp_post_send(void* cv, const void* buf, uint32_t len) {
   if (c->broken) return -2;  // dead conn, distinct from backpressure
   pump_tx(c);  // opportunistic flush frees queue room
   if (c->broken) return -2;
-  if (c->tx_bytes + 4 + len > kTxCapBytes) return -1;  // backpressure
-  TxMsg m;
-  int64_t id = m.wr_id = c->next_wr++;
-  m.frame.resize(4 + len);
-  std::memcpy(m.frame.data(), &len, 4);
-  if (len) std::memcpy(m.frame.data() + 4, buf, len);
-  c->tx_bytes += m.frame.size();
-  c->txq.push_back(std::move(m));
+  int64_t id = c->next_wr;
+  if (!queue_frame(c, id, OP_SEND, FR_MSG, nullptr, 0, buf, len,
+                   /*respect_cap=*/true))
+    return -1;  // backpressure
+  c->next_wr++;
+  pump_tx(c);
+  return id;
+}
+
+// -- one-sided RDMA ---------------------------------------------------------
+
+int64_t rtcp_reg_mr(void* cv, uint32_t len) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c || len == 0 || len > (1u << 30) - 1) return -1;
+  uint32_t id = uint32_t(c->mrs.size());
+  c->mrs.push_back({std::vector<char>(len, 0)});
+  return (int64_t(len) << 32) | int64_t(id);
+}
+
+void* rtcp_mr_addr(void* cv, int64_t rkey) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c) return nullptr;
+  return mr_span(c, rkey, 0, 0);
+}
+
+int64_t rtcp_rdma_write(void* cv, int64_t rkey, uint64_t off, const void* buf,
+                        uint32_t len) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c || (len > 0 && !buf)) return -1;
+  if (c->broken) return -2;
+  pump_tx(c);
+  if (c->broken) return -2;
+  char whdr[16];
+  std::memcpy(whdr, &rkey, 8);
+  std::memcpy(whdr + 8, &off, 8);
+  int64_t id = c->next_wr;
+  if (!queue_frame(c, id, OP_WRITE, FR_WRITE, whdr, sizeof(whdr), buf, len,
+                   /*respect_cap=*/true))
+    return -1;
+  c->next_wr++;
+  pump_tx(c);
+  return id;
+}
+
+int64_t rtcp_rdma_read(void* cv, int64_t rkey, uint64_t off, void* buf,
+                       uint32_t len) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c || (len > 0 && !buf)) return -1;
+  if (c->broken) return -2;
+  pump_tx(c);
+  if (c->broken) return -2;
+  int64_t req = c->next_req;
+  char rhdr[28];
+  std::memcpy(rhdr, &req, 8);
+  std::memcpy(rhdr + 8, &rkey, 8);
+  std::memcpy(rhdr + 16, &off, 8);
+  std::memcpy(rhdr + 24, &len, 4);
+  int64_t id = c->next_wr;
+  if (!queue_frame(c, 0, OP_SEND, FR_READ_REQ, rhdr, sizeof(rhdr), nullptr, 0,
+                   /*respect_cap=*/true))
+    return -1;
+  c->next_wr++;
+  c->next_req++;
+  c->pending_reads.push_back({req, {id, buf, len}});
   pump_tx(c);
   return id;
 }
@@ -328,12 +573,18 @@ int rtcp_poll_cq(void* cv, Cqe* cqes, int max_cqes) {
   pump_rx(c);
   int n = 0;
   while (n < max_cqes && !c->send_done.empty()) {
-    cqes[n++] = {c->send_done.front(), OP_SEND, ST_OK, 0, 0};
+    SendDone d = c->send_done.front();
     c->send_done.pop_front();
+    cqes[n++] = {d.wr_id, d.opcode, ST_OK, 0, 0};
+  }
+  while (n < max_cqes && !c->rdma_done.empty()) {
+    cqes[n++] = c->rdma_done.front();
+    c->rdma_done.pop_front();
   }
   while (n < max_cqes && !c->staged.empty() && !c->recv_q.empty()) {
     RxMsg m = std::move(c->staged.front());
     c->staged.pop_front();
+    c->staged_bytes -= uint64_t(m.payload.size());
     RecvWr wr = c->recv_q.front();
     c->recv_q.pop_front();
     uint32_t msg_len = uint32_t(m.payload.size());
