@@ -51,6 +51,9 @@ _BUSBW_FACTOR = {
     "gather": lambda n: (n - 1) / n,  # root receives (n-1) chunks of S/n
     "scatter": lambda n: (n - 1) / n, # mirror of gather
     "sendrecv": lambda n: 1.0,        # S bytes out and S in per rank
+    # FSDP/ZeRO-3 step (2 allgathers + 1 reduce-scatter of the params,
+    # reported against size = 3*param_bytes): each leg carries (n-1)/n
+    "fsdp": lambda n: (n - 1) / n,
 }
 
 

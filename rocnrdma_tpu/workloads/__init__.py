@@ -4,6 +4,9 @@
   Llama-3-8B DDP gradient-bucket trace, generated from the public model
   shapes (no weights needed) and replayed through the collective API to
   measure allreduce fusion/overlap.
+- ``fsdp_replay`` — the FSDP/ZeRO-3 sibling of C12: per-wrap-unit parameter
+  allgather (forward + backward) and gradient reduce-scatter, the sharded
+  data-parallel pattern (3·(n-1)/n·S wire traffic vs DDP's 2·(n-1)/n·S).
 - ``moe`` — component C7 (BASELINE.json:11): expert-parallel
   dispatch/combine, the alltoall traffic pattern of MoE training.
 """

@@ -1,0 +1,67 @@
+"""Shared replay-timing scaffold for the trace workloads (ddp/fsdp).
+
+Three timing disciplines over a step's collective sequence:
+
+- ``timed_sequential`` — block on every issue (zero overlap; lower bound).
+- ``timed_overlap`` — async issues with a bounded window. The window exists
+  for the CPU oracle: an unbounded burst of SEPARATE collective executables
+  can deadlock XLA's in-process communicator (per-device thunk interleaving
+  diverges across devices), so oracle runs pass a small window; real TPU
+  runs leave it unbounded. One fused program is always safe because every
+  device runs the same thunk order.
+- ``timed_fused`` — ONE jit program containing the whole step's comm (upper
+  bound: XLA schedules everything together).
+
+Each returns the trimmed-mean seconds per step; callers must have warmed
+every distinct (verb, shape) pair first so compiles never land in the timed
+region.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+from rocnrdma_tpu.bench.timing import trimmed_mean
+
+
+def default_window(topo) -> int:
+    """Overlap-window default: bounded on the CPU oracle (see module
+    docstring), unbounded (0) on real hardware."""
+    return 4 if topo.is_oracle else 0
+
+
+def _timed(run, repeats: int) -> float:
+    spans = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run()
+        spans.append(time.perf_counter() - t0)
+    return trimmed_mean(spans)
+
+
+def timed_sequential(thunks, repeats: int) -> float:
+    def run():
+        for th in thunks:
+            jax.block_until_ready(th())
+    return _timed(run, repeats)
+
+
+def timed_overlap(thunks, repeats: int, window: int) -> float:
+    def run():
+        pending = []
+        for th in thunks:
+            pending.append(th())
+            if window and len(pending) >= window:
+                jax.block_until_ready(pending.pop(0))
+        jax.block_until_ready(pending)
+    return _timed(run, repeats)
+
+
+def timed_fused(fn, args, repeats: int) -> float:
+    """``fn(*args)`` must be jit-traceable; args stay explicit so large
+    buffers enter as parameters, not embedded constants."""
+    whole = jax.jit(fn)
+    jax.block_until_ready(whole(*args))  # compile
+    return _timed(lambda: jax.block_until_ready(whole(*args)), repeats)

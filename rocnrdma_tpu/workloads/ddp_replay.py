@@ -25,15 +25,14 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 
 import jax
 import numpy as np
 
 from rocnrdma_tpu import metrics as M
 from rocnrdma_tpu.bench import cli_common
-from rocnrdma_tpu.bench.timing import trimmed_mean
 from rocnrdma_tpu.transport import Transport
+from rocnrdma_tpu.workloads import _replay
 from rocnrdma_tpu.workloads.llama_trace import LLAMA3_8B, Trace, generate_trace
 
 MODES = ("sequential", "overlap", "jit_fused")
@@ -57,42 +56,21 @@ def replay(t: Transport, bufs: list, algo: str, mode: str,
     """Seconds for one full-trace replay (trimmed mean over repeats).
 
     ``window`` bounds outstanding async allreduces in ``overlap`` mode
-    (0 = unbounded). On the CPU oracle an unbounded burst of SEPARATE
-    collective executables can deadlock XLA's in-process communicator
-    (per-device thunk interleaving diverges across devices), so the caller
-    passes a small window there; one fused program (``jit_fused``) is always
-    safe because every device runs the same thunk order.
+    (0 = unbounded); see ``workloads/_replay`` for why the CPU oracle
+    needs a bounded window and a fused program never does.
     """
     fn = t.jit_fn("allreduce", algo)
     if mode == "jit_fused":
-        whole = jax.jit(lambda xs: [fn(x) for x in xs])
-        jax.block_until_ready(whole(bufs))  # compile
-        spans = []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            jax.block_until_ready(whole(bufs))
-            spans.append(time.perf_counter() - t0)
-        return trimmed_mean(spans)
-
+        return _replay.timed_fused(lambda xs: [fn(x) for x in xs], (bufs,),
+                                   repeats)
     for b in bufs:  # compile each bucket shape (block EACH: see docstring)
         fn(b).block_until_ready()
-    spans = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        if mode == "sequential":
-            for b in bufs:
-                fn(b).block_until_ready()
-        elif mode == "overlap":
-            pending = []
-            for b in bufs:
-                pending.append(fn(b))
-                if window and len(pending) >= window:
-                    pending.pop(0).block_until_ready()
-            jax.block_until_ready(pending)
-        else:
-            raise ValueError(f"unknown mode {mode!r}")
-        spans.append(time.perf_counter() - t0)
-    return trimmed_mean(spans)
+    thunks = [lambda x=b: fn(x) for b in bufs]
+    if mode == "sequential":
+        return _replay.timed_sequential(thunks, repeats)
+    if mode == "overlap":
+        return _replay.timed_overlap(thunks, repeats, window)
+    raise ValueError(f"unknown mode {mode!r}")
 
 
 def main(argv=None) -> int:
@@ -138,7 +116,8 @@ def main(argv=None) -> int:
           f"{scaled_bytes / M.MiB:.1f} MiB at scale {args.scale}, "
           f"{t.n_ranks} ranks, algo={args.algo}", file=sys.stderr)
 
-    window = args.window if args.window is not None else (4 if topo.is_oracle else 0)
+    window = (args.window if args.window is not None
+              else _replay.default_window(topo))
 
     modes = args.modes.split(",")
     means = {mode: replay(t, bufs, args.algo, mode, repeats=args.repeats,

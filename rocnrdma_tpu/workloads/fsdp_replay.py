@@ -1,0 +1,189 @@
+"""FSDP/ZeRO-3 communication replay: allgather params, reduce-scatter grads.
+
+The sharded-data-parallel evolution of the DDP pattern (component C12's
+sibling): instead of replicating parameters and allreducing gradients, every
+rank owns a 1/n shard of each layer's parameters, and a training step's
+communication is
+
+- forward, layer 0..L:   allgather(layer params)   — materialise, compute, free
+- backward, layer L..0:  allgather(layer params)   — re-materialise for grads
+                         reduce_scatter(layer grads) — each rank keeps its shard
+
+Total wire traffic per rank is 3·(n-1)/n·S versus DDP's 2·(n-1)/n·S — the
+memory/bandwidth trade ZeRO-3 makes. Layer granularity follows FSDP's usual
+per-transformer-block wrapping; shapes come from the same public Llama-3-8B
+architecture as ``llama_trace`` (no weights needed — traffic depends only on
+parameter sizes and order).
+
+Modes mirror ``ddp_replay``:
+
+- ``sequential``: block on every collective (no prefetch; the lower bound).
+- ``overlap``: issue async with a bounded window — models FSDP's forward
+  prefetch / backward-prefetch overlapping the next layer's allgather with
+  the current layer's compute.
+- ``jit_fused``: the entire step's comm in ONE jit program (upper bound:
+  XLA schedules everything).
+
+Usage::
+
+    python -m rocnrdma_tpu.workloads.fsdp_replay --fake-devices 8 --scale 4096
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import numpy as np
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu.bench import cli_common
+from rocnrdma_tpu.transport import Transport
+from rocnrdma_tpu.workloads import _replay
+from rocnrdma_tpu.workloads.llama_trace import LLAMA3_8B, ModelSpec, _numel
+
+MODES = ("sequential", "overlap", "jit_fused")
+
+
+def flat_units(spec: ModelSpec) -> list[tuple[str, int]]:
+    """(unit name, numel) per FSDP wrap unit: one per transformer block,
+    plus the embedding and the norm+head, matching per-block auto-wrap."""
+    units: dict[str, int] = {}
+    for name, shape in spec.param_shapes():
+        if name.startswith("layers."):
+            unit = ".".join(name.split(".")[:2])  # "layers.N"
+        elif name == "embed_tokens":
+            unit = "embed"
+        else:
+            unit = "head"  # final norm + lm_head wrap together
+        units[unit] = units.get(unit, 0) + _numel(shape)
+    return list(units.items())
+
+
+def _unit_arrays(t: Transport, units, scale: int, dtype: str):
+    """Per-unit (shard, full) arrays: the persistent 1/n shard each rank
+    owns, and a full-size gradient buffer for the reduce_scatter leg."""
+    import jax.numpy as jnp
+    np_dtype = np.dtype(getattr(jnp, dtype))
+    lead = t.mesh.devices.shape
+    n = t.n_ranks
+    rng = np.random.default_rng(0)
+    shards, fulls = [], []
+    for _, numel in units:
+        per = max(1, numel // scale // n)  # shard numel, padded to n ranks
+        shard = rng.standard_normal(size=lead + (per,), dtype=np.float32)
+        grad = rng.standard_normal(size=lead + (n * per,), dtype=np.float32)
+        shards.append(t.shard(shard.astype(np_dtype)))
+        fulls.append(t.shard(grad.astype(np_dtype)))
+    return shards, fulls
+
+
+def step_plan(n_units: int) -> list[tuple[str, int]]:
+    """The step's collective sequence: ("ag"|"rs", unit index)."""
+    plan = [("ag", i) for i in range(n_units)]              # forward
+    for i in reversed(range(n_units)):                      # backward
+        plan.append(("ag", i))
+        plan.append(("rs", i))
+    return plan
+
+
+def replay(t: Transport, shards, fulls, algo: str, mode: str,
+           repeats: int = 5, window: int = 0) -> float:
+    """Seconds per full-step replay (trimmed mean over repeats)."""
+    ag = t.jit_fn("allgather", algo)
+    rs = t.jit_fn("reduce_scatter", algo)
+    plan = step_plan(len(shards))
+
+    def issue(kind, i):
+        return ag(shards[i]) if kind == "ag" else rs(fulls[i])
+
+    if mode == "jit_fused":
+        fn = lambda sh, fl: [ag(sh[i]) if k == "ag" else rs(fl[i])
+                             for k, i in plan]
+        return _replay.timed_fused(fn, (shards, fulls), repeats)
+
+    for kind, i in set(plan):  # warm EVERY (verb, unit shape) pair
+        jax.block_until_ready(issue(kind, i))
+    thunks = [lambda k=kind, j=i: issue(k, j) for kind, i in plan]
+    if mode == "sequential":
+        return _replay.timed_sequential(thunks, repeats)
+    if mode == "overlap":
+        return _replay.timed_overlap(thunks, repeats, window)
+    raise ValueError(f"unknown mode {mode!r}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fsdp_replay",
+        description="Llama-3-8B FSDP/ZeRO-3 allgather+reduce-scatter replay")
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--scale", type=int, default=4096,
+                   help="divide every unit's numel by this (1 = full size)")
+    p.add_argument("--ranks", type=int, default=None)
+    p.add_argument("--mesh2d", type=str, default=None, metavar="SLICESxPER")
+    p.add_argument("--algo", default="auto")
+    p.add_argument("--modes", default=",".join(MODES))
+    p.add_argument("--window", type=int, default=None,
+                   help="max outstanding async collectives in overlap mode "
+                        "(default: 4 on the CPU oracle, unbounded on TPU)")
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--fake-devices", type=int, default=None)
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    p.add_argument("--out", default=None, help="JSONL output path")
+    args = p.parse_args(argv)
+
+    info = cli_common.setup_backend(args.fake_devices, args.platform, args.ranks)
+    topo = info.topology
+    mesh = cli_common.build_mesh(args.mesh2d, args.ranks, topo)
+    t = Transport(mesh)
+
+    units = flat_units(LLAMA3_8B)
+    shards, fulls = _unit_arrays(t, units, args.scale, args.dtype)
+    import jax.numpy as jnp
+    itemsize = np.dtype(getattr(jnp, args.dtype)).itemsize
+    full_param_bytes = sum(numel for _, numel in units) * itemsize
+    # wire bytes per step per rank (algorithmic): 2 AG + 1 RS of everything
+    full_step_bytes = 3 * full_param_bytes
+    scaled_bytes = sum(
+        int(np.prod(f.shape[len(mesh.devices.shape):])) * f.dtype.itemsize
+        for f in fulls)
+
+    print(f"# {LLAMA3_8B.name} FSDP: {len(units)} wrap units, "
+          f"{full_param_bytes / M.GiB:.2f} GiB params "
+          f"({full_step_bytes / M.GiB:.2f} GiB step traffic) / "
+          f"{scaled_bytes / M.MiB:.1f} MiB at scale {args.scale}, "
+          f"{t.n_ranks} ranks, algo={args.algo}", file=sys.stderr)
+
+    window = (args.window if args.window is not None
+              else _replay.default_window(topo))
+    modes = args.modes.split(",")
+    means = {mode: replay(t, shards, fulls, args.algo, mode,
+                          repeats=args.repeats, window=window)
+             for mode in modes}
+    base = means.get("sequential")
+
+    records = []
+    for mode in modes:
+        extra = dict(mode=mode, n_units=len(units), scale=args.scale,
+                     full_bytes=full_step_bytes, pattern="fsdp")
+        if base is not None:
+            extra["speedup_vs_sequential"] = base / means[mode]
+        records.append(M.BenchRecord.measure(
+            "fsdp_replay", "fsdp", args.algo, t.n_ranks,
+            3 * scaled_bytes, args.dtype, means[mode],
+            platform=topo.platform, **extra))
+    if args.out:
+        with open(args.out, "a") as fp:
+            for rec in records:
+                rec.write(fp)
+    print(M.format_table(records))
+    for r in records:
+        speed = (f"  {r.extra['speedup_vs_sequential']:.2f}x vs sequential"
+                 if "speedup_vs_sequential" in r.extra else "")
+        print(f"#   {r.extra['mode']:>10}: {r.mean_s * 1e3:8.2f} ms/step{speed}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
