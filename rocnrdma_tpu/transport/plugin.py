@@ -47,6 +47,8 @@ class NetProperties:
     max_comms: int
     max_inflight: int     # queued WRs per comm before backpressure
     byte_oriented: bool   # host plane moves bytes; device plane moves arrays
+    one_sided: bool = False  # alloc_mr/iwrite/iread supported (optional
+                             # capability, like ncclNet's ptrSupport flags)
 
 
 @dataclasses.dataclass
@@ -85,6 +87,12 @@ class _HostComm:
         self.qp = qp
         self._unexpected: dict[int, list[bytes]] = {}  # tag -> payloads
         self._posted = 0  # receive buffers posted but not yet completed
+        # completed iwrite/iread wr_ids awaiting their Request's probe.
+        # Insertion-ordered and CAPPED: a fire-and-forget caller that never
+        # tests its Requests must not grow this without bound, so beyond the
+        # cap the oldest (necessarily never-probed) entries are evicted.
+        self._onesided_done: dict[int, int | None] = {}  # wr -> err status
+        self._ONESIDED_CAP = 4096
 
     def _pump(self):
         # drain the wire; stash every arrived message by tag
@@ -101,6 +109,11 @@ class _HostComm:
                 tag = int.from_bytes(payload[:4], "little")
                 self._unexpected.setdefault(tag, []).append(payload[4:])
                 got = True
+            elif c.opcode in (native.OP_WRITE, native.OP_READ):
+                self._onesided_done[c.wr_id] = (
+                    None if c.status == native.OK else c.status)
+                while len(self._onesided_done) > self._ONESIDED_CAP:
+                    self._onesided_done.pop(next(iter(self._onesided_done)))
         return got
 
     def close(self):
@@ -135,7 +148,8 @@ class HostQPNet:
 
     def get_properties(self, dev: int = 0) -> NetProperties:
         return NetProperties(name="shm-qp", plane="host", max_comms=1 << 16,
-                             max_inflight=1 << 10, byte_oriented=True)
+                             max_inflight=1 << 10, byte_oriented=True,
+                             one_sided=True)
 
     def listen(self, dev: int = 0, capacity: int = 1 << 20):
         """-> (handle, listen_comm). Give ``handle`` to the connecting peer."""
@@ -176,16 +190,9 @@ class HostQPNet:
         QP than the one we are stuffing), or two mutually-sending ranks
         deadlock. Collectives pass the recv comm's pump here.
         """
-        import time
         data = tag.to_bytes(4, "little") + bytes(mr)
-        deadline = time.monotonic() + timeout_s
-        while comm.qp.post_send(data) < 0:
-            comm._pump()
-            if progress is not None:
-                progress()
-            if time.monotonic() >= deadline:
-                raise TimeoutError("host net: send ring full, peer stalled")
-            time.sleep(0.0002)
+        self._post_backpressured(comm, lambda: comm.qp.post_send(data),
+                                 "send ring full", timeout_s, progress)
         # drain our own CQ so send completions don't pile up in the native
         # deque over a long-lived comm (poll is the only thing that frees them)
         comm._pump()
@@ -205,6 +212,74 @@ class HostQPNet:
                 return True, len(payload), payload
             return False, 0, None
         return Request(_test=probe)
+
+    # -- one-sided verbs (optional capability; see NetProperties.one_sided) --
+
+    def alloc_mr(self, comm: _HostComm, nbytes: int):
+        """Allocate + register an ``nbytes`` one-sided-accessible region on
+        this comm's QP (``ibv_reg_mr``). Ship ``.rkey`` to the peer out of
+        band (e.g. over isend); the owner touches content via ``.read`` /
+        ``.write``."""
+        return comm.qp.reg_mr(nbytes)
+
+    @staticmethod
+    def _post_backpressured(comm: _HostComm, post, what: str,
+                            timeout_s: float, progress) -> int:
+        """Retry ``post()`` until it yields a wr_id, pumping this comm (and
+        the caller's ``progress`` hook — other comms must keep draining or
+        two mutually-sending ranks deadlock) while backpressured."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while True:
+            wr = post()
+            if wr >= 0:
+                return wr
+            comm._pump()
+            if progress is not None:
+                progress()
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"host net: {what} backpressured, peer stalled")
+            time.sleep(0.0002)
+
+    def iwrite(self, comm: _HostComm, rkey: int, mr: memoryview,
+               offset: int = 0, timeout_s: float = 10.0,
+               progress=None) -> Request:
+        """One-sided put of ``mr`` into the peer MR named by ``rkey``: no
+        peer receive, no peer CQE — the soft-NIC applies it. Backpressure
+        handling mirrors :meth:`isend` (``progress`` keeps other comms
+        draining)."""
+        data = bytes(mr)
+        wr = self._post_backpressured(
+            comm, lambda: comm.qp.post_rdma_write(rkey, data, offset),
+            "one-sided write", timeout_s, progress)
+        size = len(data)
+        return Request(_test=lambda: self._onesided_probe(comm, wr, size, None))
+
+    def iread(self, comm: _HostComm, rkey: int, nbytes: int,
+              offset: int = 0, timeout_s: float = 10.0,
+              progress=None) -> Request:
+        """One-sided get from the peer MR; the completed Request's payload
+        carries the fetched bytes."""
+        into = bytearray(nbytes)
+        wr = self._post_backpressured(
+            comm, lambda: comm.qp.post_rdma_read(rkey, into, offset),
+            "one-sided read", timeout_s, progress)
+        return Request(
+            _test=lambda: self._onesided_probe(comm, wr, nbytes, into))
+
+    @staticmethod
+    def _onesided_probe(comm: _HostComm, wr: int, size: int, into):
+        if wr not in comm._onesided_done:
+            comm._pump()
+        if wr not in comm._onesided_done:
+            return False, 0, None
+        status = comm._onesided_done[wr]
+        if status is not None:
+            # terminal: leave the record so a retried test()/wait() re-raises
+            # the real error instead of spinning to a misleading timeout
+            raise OSError(f"host net: one-sided op denied (status {status})")
+        del comm._onesided_done[wr]
+        return True, size, bytes(into) if into is not None else None
 
     def close_comm(self, comm: _HostComm) -> None:
         comm.close()
@@ -230,7 +305,8 @@ class TCPNet(HostQPNet):
 
     def get_properties(self, dev: int = 0) -> NetProperties:
         return NetProperties(name="tcp-qp", plane="host", max_comms=1 << 16,
-                             max_inflight=1 << 10, byte_oriented=True)
+                             max_inflight=1 << 10, byte_oriented=True,
+                             one_sided=True)
 
     def listen(self, dev: int = 0, capacity: int = 1 << 20):
         """-> (handle "host:port", listener). ``capacity`` is unused (TCP's

@@ -106,6 +106,38 @@ def test_host_isend_drains_own_completions(host_pair):
 
 
 @needs_native
+def test_host_one_sided_write_and_read(host_pair):
+    """alloc_mr/iwrite/iread over the vtable: rkey ships via isend."""
+    net, send_comm, recv_comm = host_pair
+    assert net.get_properties(0).one_sided
+    mr = net.alloc_mr(recv_comm, 128)
+    net.isend(recv_comm, net.reg_mr(recv_comm, mr.rkey.to_bytes(8, "little")),
+              tag=7)
+    rkey = int.from_bytes(net.irecv(send_comm, 8, tag=7).wait(), "little")
+    req = net.iwrite(send_comm, rkey, memoryview(b"plugin-one-sided"))
+    assert req.wait() is None  # writes carry no payload
+    assert mr.read(0, 16) == b"plugin-one-sided"
+    mr.write(b"readable", offset=64)
+    assert net.iread(send_comm, rkey, 8, offset=64).wait() == b"readable"
+
+
+@needs_native
+def test_host_one_sided_bad_access_raises(host_pair):
+    net, send_comm, recv_comm = host_pair
+    net.alloc_mr(recv_comm, 16)
+    with pytest.raises(OSError):
+        # out-of-bounds on the shm plane raises at post time
+        net.iwrite(send_comm, (16 << 32) | (1 << 62), b"0" * 17).wait()
+
+
+def test_device_plane_reports_no_one_sided(devices):
+    from rocnrdma_tpu.transport.plugin import DeviceMeshNet
+    net = DeviceMeshNet()
+    net.init()
+    assert not net.get_properties(0).one_sided
+
+
+@needs_native
 def test_recv_timeout_retry_reuses_posted_buffer():
     """recv() after a timeout must not leak one 64 KiB buffer per attempt."""
     name = f"/rqp_retry_{id(object()):x}"
