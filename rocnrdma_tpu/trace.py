@@ -1,0 +1,283 @@
+"""Schedule event tracing — the NPKit analogue for explicit schedules.
+
+The reference stack ships NPKit: per-step timestamped events from inside
+its collectives, dumped as a timeline for postmortem analysis. Under XLA a
+host cannot timestamp individual steps of a compiled program (that is what
+``--profile``'s XProf trace is for — real device timings), but the explicit
+schedules here are DATA (``collectives/schedule.py``), so their step
+structure can be laid out exactly: which ranks exchange how many bytes at
+which step, with per-step durations from the same alpha-beta cost model the
+tuner uses. The output is a Chrome-trace JSON (load in ``chrome://tracing``
+or Perfetto) — one row per rank, one slice per schedule step.
+
+Two consumers:
+
+- eyeballing a schedule (is the dtree's load really balanced? where does
+  the hierarchical schedule serialize?);
+- diffing predicted vs profiled timelines (model says 12 steps x 80 us;
+  XProf shows where reality diverges).
+
+CLI::
+
+    python -m rocnrdma_tpu.trace --collective allreduce --algo dtree \
+        --ranks 8 --size 4M --out dtree.trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from rocnrdma_tpu.collectives import schedule as S
+from rocnrdma_tpu.transport.tuner import ALPHA_S, BETA_S_PER_B
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One rank's participation in one schedule step."""
+
+    name: str       # e.g. "rs step 3: send chunk 5 -> rank 2"
+    rank: int
+    step: int       # global step index (events with equal step run together)
+    nbytes: int     # bytes this rank transmits during the step
+
+
+def _dur_s(nbytes: int, alpha: float, beta: float) -> float:
+    return alpha + nbytes * beta
+
+
+# --------------------------------------------------------------------------
+# Event generation per algorithm (pure; walks the schedule indices)
+
+
+def ring_events(n: int, nbytes: int, bidir: bool = False) -> list[Event]:
+    chunk = nbytes // n
+    per_step = chunk // 2 if bidir else chunk
+    out = []
+    step = 0
+    for phase, phase_name in (("rs", "reduce-scatter"), ("ag", "allgather")):
+        for k in range(n - 1):
+            for r in range(n):
+                send = (S.ring_rs_send_chunk(n, k, r) if phase == "rs"
+                        else S.ring_ag_send_chunk(n, k, r))
+                arrow = "<->" if bidir else "->"
+                out.append(Event(
+                    f"{phase_name} step {k}: chunk {send} {arrow} rank {(r + 1) % n}",
+                    r, step, per_step))
+            step += 1
+    return out
+
+
+def hd_events(n: int, nbytes: int) -> list[Event]:
+    out = []
+    step = 0
+    seg = nbytes
+    for mask in S.hd_masks(n):  # recursive halving
+        seg //= 2
+        for r in range(n):
+            out.append(Event(f"halving xchg mask {mask}: {seg} B with rank {r ^ mask}",
+                             r, step, seg))
+        step += 1
+    for mask in reversed(S.hd_masks(n)):  # recursive doubling
+        for r in range(n):
+            out.append(Event(f"doubling xchg mask {mask}: {seg} B with rank {r ^ mask}",
+                             r, step, seg))
+        seg *= 2
+        step += 1
+    return out
+
+
+def dtree_events(n: int, nbytes: int) -> list[Event]:
+    half = nbytes // 2
+    out = []
+    step = 0
+    for t, parents in enumerate(S.dbtree_parents(n)):
+        up, down = S.dbtree_steps(parents)
+        for pairs in up:
+            for c, p in pairs:
+                out.append(Event(f"tree{t} reduce: rank {c} -> {p}",
+                                 c, step, half))
+            step += 1
+        for pairs in down:
+            for p, c in pairs:
+                out.append(Event(f"tree{t} bcast: rank {p} -> {c}",
+                                 p, step, half))
+            step += 1
+    return out
+
+
+def rotation_a2a_events(n: int, nbytes: int) -> list[Event]:
+    chunk = nbytes // n
+    out = []
+    for k in range(1, n):
+        for r in range(n):
+            out.append(Event(
+                f"rotation step {k}: chunk {S.a2a_send_chunk(n, k, r)} -> "
+                f"rank {(r + k) % n}", r, k - 1, chunk))
+    return out
+
+
+def bruck_a2a_events(n: int, nbytes: int) -> list[Event]:
+    chunk = nbytes // n
+    out = []
+    for step, k in enumerate(S.bruck_phases(n)):
+        moved = len(S.bruck_mask(n, k)) * chunk
+        for r in range(n):
+            out.append(Event(f"bruck phase {k}: {moved} B -> rank {(r + k) % n}",
+                             r, step, moved))
+    return out
+
+
+def binomial_events(n: int, nbytes: int, kind: str, root: int = 0) -> list[Event]:
+    out = []
+    masks = S.binomial_masks(n)
+    steps = list(enumerate(masks)) if kind == "broadcast" else \
+        list(enumerate(reversed(masks)))
+    for step, m in steps:
+        pairs = S.bcast_pairs(n, m, root)
+        if kind == "reduce":
+            pairs = [(d, s) for s, d in pairs]
+        for src, dst in pairs:
+            out.append(Event(f"{kind} mask {m}: rank {src} -> {dst}",
+                             src, step, nbytes))
+    return out
+
+
+def hierarchical_events(n_slices: int, per_slice: int,
+                        nbytes: int) -> list[Event]:
+    """Three sequential phases over the ('slice','intra') mesh; within a
+    phase, all participating rings run concurrently."""
+    out = []
+    step = 0
+    shard = nbytes // per_slice
+
+    def ranks_of(s, i):
+        return s * per_slice + i
+
+    # phase 1: reduce-scatter over intra (per slice), n-1 ring steps
+    for k in range(per_slice - 1):
+        for s in range(n_slices):
+            for i in range(per_slice):
+                out.append(Event(f"ici rs step {k} (slice {s})",
+                                 ranks_of(s, i), step, shard))
+        step += 1
+    # phase 2: allreduce of the shard across slices (ring over DCN)
+    for k in range(2 * (n_slices - 1)):
+        for s in range(n_slices):
+            for i in range(per_slice):
+                out.append(Event(f"dcn allreduce step {k}",
+                                 ranks_of(s, i), step, shard // n_slices))
+        step += 1
+    # phase 3: allgather over intra
+    for k in range(per_slice - 1):
+        for s in range(n_slices):
+            for i in range(per_slice):
+                out.append(Event(f"ici ag step {k} (slice {s})",
+                                 ranks_of(s, i), step, shard))
+        step += 1
+    return out
+
+
+_GENERATORS = {
+    ("allreduce", "ring"): lambda n, b: ring_events(n, b),
+    ("allreduce", "ring_bidir"): lambda n, b: ring_events(n, b, bidir=True),
+    ("allreduce", "tree"): hd_events,
+    ("allreduce", "dtree"): dtree_events,
+    ("alltoall", "ring"): rotation_a2a_events,
+    ("alltoall", "bruck"): bruck_a2a_events,
+    ("broadcast", "binomial"): lambda n, b: binomial_events(n, b, "broadcast"),
+    ("reduce", "binomial"): lambda n, b: binomial_events(n, b, "reduce"),
+}
+
+
+def schedule_events(collective: str, algo: str, n: int, nbytes: int,
+                    mesh2d: tuple[int, int] | None = None) -> list[Event]:
+    """The full event list of one collective call's schedule."""
+    if algo == "hierarchical":
+        if collective != "allreduce" or mesh2d is None:
+            raise ValueError("hierarchical tracing needs --collective "
+                             "allreduce and --mesh2d SLICESxPER")
+        return hierarchical_events(*mesh2d, nbytes)
+    gen = _GENERATORS.get((collective, algo))
+    if gen is None:
+        raise ValueError(
+            f"no schedule tracer for ({collective}, {algo}); know "
+            f"{sorted(_GENERATORS)} + ('allreduce', 'hierarchical')")
+    return gen(n, nbytes)
+
+
+def to_chrome_trace(events: list[Event], alpha: float = ALPHA_S,
+                    beta: float = BETA_S_PER_B) -> dict:
+    """Chrome-trace JSON: pid 0, one tid (row) per rank, one complete ("X")
+    slice per event. Step k starts when step k-1's LONGEST slice ends (the
+    schedule's barrier semantics — every exchange completes before the next
+    step)."""
+    if not events:
+        return {"traceEvents": []}
+    n_steps = max(e.step for e in events) + 1
+    start_us = [0.0] * (n_steps + 1)
+    for s in range(n_steps):
+        dur = max((_dur_s(e.nbytes, alpha, beta) for e in events
+                   if e.step == s), default=0.0)
+        start_us[s + 1] = start_us[s] + dur * 1e6
+    trace = []
+    for e in sorted(events, key=lambda e: (e.step, e.rank)):
+        trace.append({
+            "name": e.name, "ph": "X", "pid": 0, "tid": e.rank,
+            "ts": round(start_us[e.step], 3),
+            "dur": round(_dur_s(e.nbytes, alpha, beta) * 1e6, 3),
+            "args": {"bytes": e.nbytes, "step": e.step},
+        })
+    meta = [{"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+             "args": {"name": f"rank {tid}"}}
+            for tid in sorted({e.rank for e in events})]
+    return {"traceEvents": meta + trace,
+            "displayTimeUnit": "ms",
+            "otherData": {"total_us": round(start_us[-1], 3),
+                          "n_steps": n_steps}}
+
+
+def main(argv=None) -> int:
+    from rocnrdma_tpu.bench.runner import parse_size
+
+    p = argparse.ArgumentParser(
+        prog="rocnrdma_trace",
+        description="Emit a Chrome-trace timeline of an explicit schedule "
+                    "(the NPKit analogue; model-predicted durations)")
+    p.add_argument("--collective", default="allreduce")
+    p.add_argument("--algo", default="ring")
+    p.add_argument("--ranks", type=int, default=8)
+    p.add_argument("--size", default="4M", help="buffer bytes (e.g. 4M, 64K)")
+    p.add_argument("--mesh2d", default=None, metavar="SLICESxPER",
+                   help="for --algo hierarchical")
+    p.add_argument("--alpha", type=float, default=ALPHA_S,
+                   help="per-step latency seconds (tuner default)")
+    p.add_argument("--beta", type=float, default=BETA_S_PER_B,
+                   help="seconds per byte (tuner default)")
+    p.add_argument("--out", default=None, help="output path (default stdout)")
+    args = p.parse_args(argv)
+
+    mesh2d = None
+    if args.mesh2d:
+        s, per = args.mesh2d.lower().split("x")
+        mesh2d = (int(s), int(per))
+        args.ranks = mesh2d[0] * mesh2d[1]
+    events = schedule_events(args.collective, args.algo, args.ranks,
+                             parse_size(args.size), mesh2d)
+    doc = to_chrome_trace(events, args.alpha, args.beta)
+    payload = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(payload)
+        print(f"# {len(events)} events, {doc['otherData']['n_steps']} steps, "
+              f"predicted {doc['otherData']['total_us']:.0f} us -> {args.out}",
+              file=sys.stderr)
+    else:
+        print(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

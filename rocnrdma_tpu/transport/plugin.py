@@ -524,12 +524,8 @@ def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
 
-    # reduce-scatter: after step k, chunk (rank - k) holds partial sums
-    for k in range(n - 1):
-        send_i, recv_i = rank - k, rank - k - 1
-        incoming = wire.exchange(_as_bytes(chunk(send_i)),
-                                 chunk(recv_i).nbytes)
-        chunk(recv_i)[:] += incoming.view(x.dtype)
+    # reduce-scatter phase: rank r ends owning chunk (r + 1) mod n
+    _ring_reduce_phase(wire, x, chunk, rank, n)
     # allgather: circulate the fully-reduced chunks
     for k in range(n - 1):
         send_i, recv_i = rank + 1 - k, rank - k
@@ -537,6 +533,40 @@ def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
                                  chunk(recv_i).nbytes)
         chunk(recv_i)[:] = incoming.view(x.dtype)
     return x.reshape(np.shape(local))
+
+
+def _ring_reduce_phase(wire: "_RingWire", x: np.ndarray, chunk, rank: int,
+                       n: int, shift: int = 0) -> None:
+    """The n-1 reduce-scatter ring steps in place: at step k, send chunk
+    ``rank - k + shift``, accumulate into ``rank - k - 1 + shift``. After
+    the phase, rank r owns the fully-reduced chunk ``(r + 1 + shift) mod n``
+    — shift=0 is the allreduce layout, shift=-1 lands chunk r on rank r."""
+    for k in range(n - 1):
+        send_i, recv_i = rank - k + shift, rank - k - 1 + shift
+        incoming = wire.exchange(_as_bytes(chunk(send_i)),
+                                 chunk(recv_i).nbytes)
+        chunk(recv_i)[:] += incoming.view(x.dtype)
+
+
+def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
+                                 local: np.ndarray, rank: int,
+                                 n_ranks: int) -> np.ndarray:
+    """Ring reduce-scatter over the verbs: every rank contributes ``local``
+    (all ranks the same shape/dtype; flattened and split into n
+    floor-balanced element ranges) and gets back the fully-reduced range
+    ``r`` as a flat array — standard reduce-scatter semantics, composable
+    with ``ring_allgather_over_net``. The first phase of the allreduce,
+    exposed standalone for sharded-optimizer (ZeRO/FSDP-style) host paths.
+    """
+    x = np.array(local, copy=True).ravel()
+    n = n_ranks
+    if n == 1:
+        return x
+    wire = _RingWire(net, send_comm, recv_comm)
+    bounds = [len(x) * i // n for i in range(n + 1)]
+    chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
+    _ring_reduce_phase(wire, x, chunk, rank, n, shift=-1)
+    return np.array(chunk(rank), copy=True)
 
 
 def ring_allgather_over_net(net, send_comm, recv_comm, local: np.ndarray,

@@ -14,6 +14,7 @@ from rocnrdma_tpu.transport import (
     ring_allreduce_over_net,
     ring_alltoall_over_net,
     ring_broadcast_over_net,
+    ring_reduce_scatter_over_net,
 )
 
 needs_native = pytest.mark.skipif(
@@ -92,6 +93,22 @@ def test_alltoall_over_net(net_cls, n):
     for r in range(n):
         want = np.stack([mats[src][r] for src in range(n)])
         np.testing.assert_array_equal(res[r], want)
+
+
+@needs_native
+@pytest.mark.parametrize("net_cls", PLANES)
+@pytest.mark.parametrize("n", [2, 4])
+def test_reduce_scatter_over_net(net_cls, n):
+    rng = np.random.default_rng(5)
+    xs = [rng.standard_normal(n * 53).astype(np.float32) for _ in range(n)]
+    res = _run_ring(net_cls, n, lambda net, s, r, rank:
+                    ring_reduce_scatter_over_net(net, s, r, xs[rank], rank, n))
+    total = np.sum(xs, axis=0)
+    bounds = [len(total) * i // n for i in range(n + 1)]
+    for r in range(n):
+        # standard semantics: rank r keeps range r (composes with allgather)
+        want = total[bounds[r]:bounds[r + 1]]
+        np.testing.assert_allclose(res[r], want, rtol=1e-5, atol=1e-5)
 
 
 @needs_native

@@ -1,0 +1,99 @@
+"""Schedule event tracer (NPKit analogue): event structure, traffic
+accounting against the busbw factors, Chrome-trace output shape, CLI."""
+
+import json
+
+import pytest
+
+from rocnrdma_tpu import trace as T
+
+
+def _rank_bytes(events, rank):
+    return sum(e.nbytes for e in events if e.rank == rank)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ring_events_traffic(n):
+    nbytes = n * 128
+    ev = T.ring_events(n, nbytes)
+    assert max(e.step for e in ev) + 1 == 2 * (n - 1)
+    # every rank wires 2(n-1)/n * S — the allreduce busbw factor
+    for r in range(n):
+        assert _rank_bytes(ev, r) == 2 * (n - 1) * (nbytes // n)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_hd_events_traffic(n):
+    nbytes = n * 64
+    ev = T.hd_events(n, nbytes)
+    import math
+    assert max(e.step for e in ev) + 1 == 2 * int(math.log2(n))
+    for r in range(n):
+        # S/2 + S/4 + ... + S/n, twice = 2(n-1)/n * S
+        assert _rank_bytes(ev, r) == 2 * (nbytes - nbytes // n)
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 8])
+def test_dtree_events_structure(n):
+    ev = T.dtree_events(n, 1024)
+    # each tree: every non-root sends up once and receives down once
+    for t in (0, 1):
+        up = [e for e in ev if e.name.startswith(f"tree{t} reduce")]
+        down = [e for e in ev if e.name.startswith(f"tree{t} bcast")]
+        assert len(up) == n - 1
+        assert len(down) == n - 1
+
+
+def test_rotation_vs_bruck_step_counts():
+    n = 8
+    rot = T.rotation_a2a_events(n, n * 100)
+    bruck = T.bruck_a2a_events(n, n * 100)
+    assert max(e.step for e in rot) + 1 == n - 1
+    assert max(e.step for e in bruck) + 1 == 3  # ceil(log2 8)
+    # bruck moves more total bytes — the latency/bandwidth trade
+    assert _rank_bytes(bruck, 0) > _rank_bytes(rot, 0)
+
+
+def test_hierarchical_phases():
+    ev = T.hierarchical_events(2, 4, 4 * 1024)
+    n_steps = max(e.step for e in ev) + 1
+    assert n_steps == (4 - 1) + 2 * (2 - 1) + (4 - 1)
+    assert any("dcn" in e.name for e in ev)
+    assert any("ici rs" in e.name for e in ev)
+
+
+def test_chrome_trace_shape():
+    ev = T.schedule_events("allreduce", "ring", 4, 4 * 256)
+    doc = T.to_chrome_trace(ev)
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(slices) == len(ev)
+    assert len(metas) == 4  # one row name per rank
+    # steps are barriers: a step's slices all start when the previous ended
+    by_step = {}
+    for s in slices:
+        by_step.setdefault(s["args"]["step"], []).append(s)
+    starts = sorted({s["ts"] for s in slices})
+    assert len(starts) == len(by_step)
+    for step, group in by_step.items():
+        assert len({g["ts"] for g in group}) == 1
+    assert doc["otherData"]["total_us"] > 0
+
+
+def test_unknown_pair_raises():
+    with pytest.raises(ValueError, match="no schedule tracer"):
+        T.schedule_events("allreduce", "bruck", 4, 1024)
+    with pytest.raises(ValueError, match="hierarchical tracing"):
+        T.schedule_events("allreduce", "hierarchical", 8, 1024)
+
+
+def test_cli_writes_trace(tmp_path):
+    out = tmp_path / "t.json"
+    rc = T.main(["--collective", "allreduce", "--algo", "dtree",
+                 "--ranks", "6", "--size", "64K", "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]
+    rc = T.main(["--algo", "hierarchical", "--mesh2d", "2x4",
+                 "--size", "64K", "--out", str(out)])
+    assert rc == 0
