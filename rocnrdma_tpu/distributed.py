@@ -1,0 +1,203 @@
+"""Host-side process groups — the ``torch.distributed``(gloo) analogue.
+
+The reference stack is consumed through a process-group API: N processes
+call ``init_process_group`` with a master address, then issue collectives
+on host tensors; RCCL (device) or gloo (host) carries them. This module is
+that front door for the host plane here: rendezvous through the
+:mod:`transport.bootstrap` store (rank 0 doubles as the master), a TCP
+queue-pair ring wired by ``bootstrap_ring``, and numpy-array collectives
+riding the net-plugin verbs (`transport/plugin.py`) underneath — the same
+stack order as torch→gloo→TCP.
+
+Usage (each of N processes, possibly on different machines)::
+
+    from rocnrdma_tpu import distributed as dist
+
+    pg = dist.init_process_group(rank=r, world_size=n,
+                                 master_addr="10.0.0.1", master_port=29500)
+    total = pg.all_reduce(my_grads)            # sum by default
+    parts = pg.all_gather(my_shard)            # (n, *shard.shape)
+    pg.barrier()
+    pg.destroy()
+
+With no explicit arguments, ``init_process_group()`` reads the standard
+environment: ``RANK``, ``WORLD_SIZE``, ``MASTER_ADDR``, ``MASTER_PORT`` —
+drop-in for launchers that already export them.
+
+Device-plane collectives (jax.Array over ICI/DCN) live on
+:class:`transport.Transport`; this API is for host buffers (optimizer
+state, metrics, checkpoint shards) and for machines with no TPU at all.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from rocnrdma_tpu.transport import (
+    TCPNet,
+    bootstrap,
+    plugin,
+)
+
+
+class ProcessGroup:
+    """N ranks wired in a TCP ring with a shared rendezvous store.
+
+    ``group_name`` namespaces this group's store keys; distinct groups
+    sharing one long-lived sidecar store MUST use distinct names (the
+    store's keys and barrier counters persist for its lifetime).
+    """
+
+    def __init__(self, rank: int, world_size: int, store_handle: str,
+                 server: "bootstrap.BootstrapServer | None",
+                 timeout_s: float = 30.0, group_name: str = "default"):
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+        self._server = server  # only rank 0 (or an external sidecar) owns one
+        self._net = TCPNet()
+        self._net.init()
+        try:
+            if world_size > 1:
+                self._send, self._recv, self._client = bootstrap.bootstrap_ring(
+                    self._net, store_handle, rank, world_size, timeout_s,
+                    ns=f"pg/{group_name}/ring")
+            else:
+                self._send = self._recv = self._client = None
+        except BaseException:
+            # a failed rendezvous must not leak the net plane (or, via
+            # init_process_group, rank 0's master-port listener)
+            self._net.close()
+            raise
+        self._barrier_no = 0
+        self._destroyed = False
+
+    # -- collectives (numpy in, numpy out) ---------------------------------
+
+    def _ring(self, fn, *args, **kw):
+        return fn(self._net, self._send, self._recv, *args, **kw)
+
+    def all_reduce(self, x, op: str = "sum") -> np.ndarray:
+        """Elementwise reduction across ranks (op: sum/prod/max/min);
+        every rank gets the result, shape preserved."""
+        x = np.asarray(x)
+        if self.world_size == 1:
+            return x.copy()
+        return self._ring(plugin.ring_allreduce_over_net, x, self.rank,
+                          self.world_size, op=op)
+
+    def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
+        """Reduce across ranks; rank r keeps the r-th of n floor-balanced
+        element ranges of the flattened buffer."""
+        x = np.asarray(x)
+        if self.world_size == 1:
+            return x.ravel().copy()
+        return self._ring(plugin.ring_reduce_scatter_over_net, x, self.rank,
+                          self.world_size, op=op)
+
+    def all_gather(self, x) -> np.ndarray:
+        """Every rank contributes ``x`` (same shape everywhere); returns
+        ``(world_size, *x.shape)`` in rank order."""
+        x = np.asarray(x)
+        if self.world_size == 1:
+            return x[None].copy()
+        return self._ring(plugin.ring_allgather_over_net, x, self.rank,
+                          self.world_size)
+
+    def broadcast(self, x, src: int = 0) -> np.ndarray:
+        """Every rank returns rank ``src``'s buffer (non-src inputs size the
+        receive buffer)."""
+        x = np.asarray(x)
+        if self.world_size == 1:
+            return x.copy()
+        return self._ring(plugin.ring_broadcast_over_net, x, self.rank,
+                          self.world_size, root=src)
+
+    def all_to_all(self, x) -> np.ndarray:
+        """``x`` is ``(world_size, ...)``; row j goes to rank j. Returns the
+        rows addressed to this rank, in source-rank order."""
+        x = np.asarray(x)
+        if self.world_size == 1:
+            return x.copy()
+        return self._ring(plugin.ring_alltoall_over_net, x, self.rank,
+                          self.world_size)
+
+    def barrier(self, timeout_s: float = 30.0) -> None:
+        """Block until every rank arrives."""
+        if self.world_size == 1:
+            return
+        self._barrier_no += 1
+        self._client.barrier(f"pg/{self.group_name}/b{self._barrier_no}",
+                             self.world_size, timeout_s)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def destroy(self) -> None:
+        """Orderly teardown: every rank arrives at a final store barrier and
+        says goodbye to the store BEFORE rank 0 closes it (otherwise a peer
+        whose last barrier poll is still in flight gets its RPC cut — the
+        classic master-exits-first shutdown race)."""
+        if self._destroyed:
+            return
+        self._destroyed = True
+        if self._client is not None:
+            try:
+                self._client.barrier(f"pg/{self.group_name}/destroy",
+                                     self.world_size, timeout_s=10.0)
+            except (OSError, TimeoutError):
+                pass  # peers may have crashed; teardown must still complete
+            self._client.close()
+        self._net.close()
+        if self._server is not None:
+            self._server.wait_idle()  # all clients gone -> safe to close
+            self._server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.destroy()
+
+
+def init_process_group(rank: int | None = None,
+                       world_size: int | None = None,
+                       master_addr: str | None = None,
+                       master_port: int | None = None,
+                       store_handle: str | None = None,
+                       timeout_s: float = 30.0,
+                       group_name: str = "default") -> ProcessGroup:
+    """Create this process's :class:`ProcessGroup`.
+
+    Rendezvous: either pass ``store_handle`` (an already-running
+    :class:`bootstrap.BootstrapServer`'s ``"host:port"``) — in which case
+    distinct groups on that store need distinct ``group_name``s — or give
+    ``master_addr``/``master_port`` and rank 0 will serve the store itself
+    (the torch master semantics). Unset arguments fall back to the standard
+    ``RANK`` / ``WORLD_SIZE`` / ``MASTER_ADDR`` / ``MASTER_PORT`` env vars.
+    """
+    rank = int(os.environ["RANK"]) if rank is None else rank
+    world_size = (int(os.environ["WORLD_SIZE"]) if world_size is None
+                  else world_size)
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+
+    server = None
+    if world_size > 1 and store_handle is None:
+        master_addr = master_addr or os.environ.get("MASTER_ADDR", "127.0.0.1")
+        master_port = (master_port if master_port is not None
+                       else int(os.environ.get("MASTER_PORT", "29500")))
+        if rank == 0:
+            server = bootstrap.BootstrapServer(
+                n_ranks=world_size, port=master_port, host=master_addr)
+            store_handle = server.handle
+        else:
+            store_handle = f"{master_addr}:{master_port}"
+    try:
+        return ProcessGroup(rank, world_size, store_handle, server,
+                            timeout_s, group_name)
+    except BaseException:
+        if server is not None:  # failed rendezvous must free the master port
+            server.close()
+        raise

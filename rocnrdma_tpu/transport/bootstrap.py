@@ -95,8 +95,22 @@ class BootstrapServer:
                 return {"ok": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
+    def wait_idle(self, timeout_s: float = 5.0) -> None:
+        """Block until every client connection has wound down (sent ``bye``
+        or disconnected) — the orderly-shutdown handshake: close the server
+        only after this returns, so no client's in-flight RPC is cut."""
+        deadline = time.monotonic() + timeout_s
+        for t in list(self._threads):
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
     def close(self):
         self._closed = True
+        # join the acceptor BEFORE closing the listener: it may be blocked
+        # inside accept() on the native handle, and rtcp_close_listener
+        # frees that handle — close-under-accept is a use-after-free, and
+        # the kernel socket (the master port) stays bound until the thread
+        # lets go. The acceptor re-checks _closed every 0.25 s.
+        self._acceptor.join(timeout=2.0)
         self._listener.close()
 
     def __enter__(self):
@@ -165,15 +179,19 @@ class BootstrapClient:
 
 
 def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
-                   timeout_s: float = 30.0):
+                   timeout_s: float = 30.0, ns: str = "ring"):
     """Wire the ring every net collective here expects, from ONE shared
     address: listen, publish my handle, dial my successor, accept my
     predecessor. Returns ``(send_comm, recv_comm, client)`` — close the
-    client after the job, the comms via ``net.close()``."""
+    client after the job, the comms via ``net.close()``.
+
+    ``ns`` namespaces this ring's store keys: distinct groups sharing one
+    long-lived store MUST use distinct namespaces (keys and barrier
+    counters persist for the store's lifetime)."""
     client = BootstrapClient(store_handle, rank, timeout_s)
     handle, listener = net.listen()
-    handles = client.exchange("ring", handle, n_ranks, timeout_s)
+    handles = client.exchange(f"{ns}/h", handle, n_ranks, timeout_s)
     send_comm = net.connect(0, handles[(rank + 1) % n_ranks], timeout_s)
     recv_comm = net.accept(listener, timeout_s)
-    client.barrier("ring-wired", n_ranks, timeout_s)
+    client.barrier(f"{ns}/wired", n_ranks, timeout_s)
     return send_comm, recv_comm, client

@@ -507,14 +507,15 @@ def _as_bytes(a: np.ndarray) -> np.ndarray:
 
 
 def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
-                            rank: int, n_ranks: int) -> np.ndarray:
+                            rank: int, n_ranks: int,
+                            op: str = "sum") -> np.ndarray:
     """Host-plane ring allreduce built ONLY from the vtable verbs.
 
     Classic two-phase schedule — (n-1) reduce-scatter steps then (n-1)
-    allgather steps over the ring, reducing in the input's own dtype (like
-    every sibling here — pre-cast yourself if you want fp32 accumulation).
-    This is the proof the vtable carries collectives, and doubles as the
-    cross-process gloo-analogue oracle path.
+    allgather steps over the ring, reducing (``op``: sum/prod/max/min) in
+    the input's own dtype (like every sibling here — pre-cast yourself if
+    you want fp32 accumulation). This is the proof the vtable carries
+    collectives, and doubles as the cross-process gloo-analogue oracle path.
     """
     x = np.array(local, copy=True).ravel()
     n = n_ranks
@@ -525,7 +526,7 @@ def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
 
     # reduce-scatter phase: rank r ends owning chunk (r + 1) mod n
-    _ring_reduce_phase(wire, x, chunk, rank, n)
+    _ring_reduce_phase(wire, x, chunk, rank, n, op=op)
     # allgather: circulate the fully-reduced chunks
     for k in range(n - 1):
         send_i, recv_i = rank + 1 - k, rank - k
@@ -535,22 +536,27 @@ def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
     return x.reshape(np.shape(local))
 
 
+_NET_REDUCE_OPS = {"sum": np.add, "prod": np.multiply,
+                   "max": np.maximum, "min": np.minimum}
+
+
 def _ring_reduce_phase(wire: "_RingWire", x: np.ndarray, chunk, rank: int,
-                       n: int, shift: int = 0) -> None:
+                       n: int, shift: int = 0, op: str = "sum") -> None:
     """The n-1 reduce-scatter ring steps in place: at step k, send chunk
-    ``rank - k + shift``, accumulate into ``rank - k - 1 + shift``. After
+    ``rank - k + shift``, combine into ``rank - k - 1 + shift``. After
     the phase, rank r owns the fully-reduced chunk ``(r + 1 + shift) mod n``
     — shift=0 is the allreduce layout, shift=-1 lands chunk r on rank r."""
+    combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     for k in range(n - 1):
         send_i, recv_i = rank - k + shift, rank - k - 1 + shift
         incoming = wire.exchange(_as_bytes(chunk(send_i)),
                                  chunk(recv_i).nbytes)
-        chunk(recv_i)[:] += incoming.view(x.dtype)
+        combine(chunk(recv_i), incoming.view(x.dtype), out=chunk(recv_i))
 
 
 def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
                                  local: np.ndarray, rank: int,
-                                 n_ranks: int) -> np.ndarray:
+                                 n_ranks: int, op: str = "sum") -> np.ndarray:
     """Ring reduce-scatter over the verbs: every rank contributes ``local``
     (all ranks the same shape/dtype; flattened and split into n
     floor-balanced element ranges) and gets back the fully-reduced range
@@ -565,7 +571,7 @@ def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
     wire = _RingWire(net, send_comm, recv_comm)
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
-    _ring_reduce_phase(wire, x, chunk, rank, n, shift=-1)
+    _ring_reduce_phase(wire, x, chunk, rank, n, shift=-1, op=op)
     return np.array(chunk(rank), copy=True)
 
 
