@@ -1,0 +1,108 @@
+"""Topology dump CLI — the RCCL topo-dump (`NCCL_TOPO_DUMP_FILE`) analogue.
+
+Prints what the runtime knows about the machine: platform, device
+inventory, slice structure, physical coordinates, the snake ring order the
+explicit schedules use, and the per-hop ICI distances that order achieves
+(the "is my ring physically contiguous?" diagnostic). ``--json`` emits the
+same machine-readably, like the reference's XML topo dump.
+
+Usage::
+
+    python -m rocnrdma_tpu.runtime.topo_cli [--fake-devices 8] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def describe(devices=None) -> dict:
+    """The topology document (pure data; the CLI renders it)."""
+    import jax
+
+    from rocnrdma_tpu.runtime import mesh as rm
+    from rocnrdma_tpu.runtime import topology as tp
+
+    devices = list(devices if devices is not None else jax.devices())
+    topo = rm.detect_topology(devices)
+    ordered = tp.ring_order(devices)
+    coords = [getattr(d, "coords", None) for d in devices]
+    # mirror ring_order()'s degradation rules exactly: no coords, <3
+    # devices, or ragged ndim -> no hop analysis (instead of crashing)
+    have_coords = (len(devices) >= 3 and all(c is not None for c in coords)
+                   and len({len(c) for c in coords}) == 1)
+    doc = {
+        "platform": topo.platform,
+        "n_devices": topo.n_devices,
+        "n_processes": topo.n_processes,
+        "process_index": topo.process_index,
+        "n_slices": topo.n_slices,
+        "devices_per_slice": topo.devices_per_slice,
+        "is_oracle": topo.is_oracle,
+        "devices": [
+            {
+                "id": d.id,
+                "kind": getattr(d, "device_kind", "?"),
+                "process": getattr(d, "process_index", 0),
+                "coords": list(getattr(d, "coords", ()) or ()),
+                "core": getattr(d, "core_on_chip", 0) or 0,
+            }
+            for d in devices
+        ],
+        "ring_order": [d.id for d in ordered],
+    }
+    if have_coords:
+        doc["grid_dims"] = tp.grid_dims([d.coords for d in ordered])
+        doc["ring_hop_lengths"] = tp.ring_hop_lengths(ordered)
+        hops = doc["ring_hop_lengths"]
+        doc["ring_contiguous"] = all(h <= 1 for h in hops[:-1])
+    return doc
+
+
+def render(doc: dict) -> str:
+    lines = [
+        f"platform {doc['platform']}  devices {doc['n_devices']}  "
+        f"processes {doc['n_processes']} (this: {doc['process_index']})  "
+        f"slices {doc['n_slices']} x {doc['devices_per_slice']}"
+        f"{'  [CPU oracle]' if doc['is_oracle'] else ''}",
+        "",
+        f"{'id':>4} {'kind':>16} {'proc':>5} {'coords':>12} {'core':>5}",
+    ]
+    for d in doc["devices"]:
+        c = ",".join(map(str, d["coords"])) if d["coords"] else "-"
+        lines.append(f"{d['id']:>4} {d['kind']:>16} {d['process']:>5} "
+                     f"{c:>12} {d['core']:>5}")
+    lines.append("")
+    lines.append("snake ring order: " +
+                 " -> ".join(map(str, doc["ring_order"])))
+    if "ring_hop_lengths" in doc:
+        lines.append(f"grid dims: {doc['grid_dims']}  "
+                     f"hop lengths: {doc['ring_hop_lengths']}  "
+                     f"contiguous: {doc['ring_contiguous']}")
+    else:
+        lines.append("no hop analysis (needs >=3 devices with physical "
+                     "coordinates): ring order falls back to id order")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="rocnrdma_topo",
+        description="Dump the device/ICI topology and the snake ring order "
+                    "(the RCCL topo-dump analogue)")
+    p.add_argument("--fake-devices", type=int, default=None)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.fake_devices:
+        from rocnrdma_tpu.runtime.cpu_backend import force_cpu_devices
+        force_cpu_devices(args.fake_devices)
+    doc = describe()
+    print(json.dumps(doc) if args.json else render(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,6 +171,9 @@ class Transport:
             tuning = TuningTable.load(tuning)
         self.tuning = tuning
         self._cache = {}  # (op, algo) -> jitted global-array callable
+        # telemetry: per-(verb, algo) dispatch counts and input bytes — the
+        # RCCL debug-stats analogue, read via stats()/format_stats()
+        self._stats: dict[tuple, dict] = {}
 
     # -- policy ------------------------------------------------------------
 
@@ -218,6 +221,22 @@ class Transport:
             return max(1, nbytes)
         return max(1, nbytes // self.n_ranks)
 
+    def _count(self, verb: str, algo: str, x) -> None:
+        s = self._stats.setdefault((verb, algo), {"calls": 0, "bytes": 0})
+        s["calls"] += 1
+        s["bytes"] += int(getattr(x, "nbytes", 0) or 0)
+
+    def stats(self) -> dict:
+        """Per-(verb, algo) dispatch counts and cumulative input bytes since
+        construction (grouped calls count under their resolved algos)."""
+        return {f"{v}/{a}": dict(s) for (v, a), s in sorted(self._stats.items())}
+
+    def format_stats(self) -> str:
+        rows = [f"{'verb/algo':<28} {'calls':>8} {'MiB':>12}"]
+        for key, s in self.stats().items():
+            rows.append(f"{key:<28} {s['calls']:>8} {s['bytes'] / 2**20:>12.2f}")
+        return "\n".join(rows)
+
     def shard(self, x: jax.Array) -> jax.Array:
         """Place a global buffer on the mesh, one leading row per rank
         (the TPU analogue of memory registration/pinning)."""
@@ -225,54 +244,52 @@ class Transport:
 
     # -- verbs -------------------------------------------------------------
 
+    def _dispatch(self, verb: str, x, algo: str, **knobs):
+        resolved = self._resolve(algo, verb, self._msg_bytes(verb, x))
+        fn = self._jit(verb, resolved, **knobs)  # validates knobs first —
+        self._count(verb, resolved, x)           # rejected calls don't count
+        return fn(x)
+
     def allreduce(self, x, algo: str = "auto", op: str = "sum"):
         """(ranks..., S) -> same shape; every rank row = elementwise reduction
         (``op``: sum/prod/max/min/avg)."""
-        return self._jit("allreduce", self._resolve(algo, "allreduce", self._msg_bytes("allreduce", x)), op=op)(x)
+        return self._dispatch("allreduce", x, algo, op=op)
 
     def reduce_scatter(self, x, algo: str = "auto", op: str = "sum"):
         """(ranks..., S) -> (ranks..., S/n); rank r keeps the reduced r-th shard."""
-        return self._jit("reduce_scatter",
-                         self._resolve(algo, "reduce_scatter", self._msg_bytes("reduce_scatter", x)),
-                         op=op)(x)
+        return self._dispatch("reduce_scatter", x, algo, op=op)
 
     def allgather(self, x, algo: str = "auto"):
         """(ranks..., c) -> (ranks..., n*c); every rank ends with the concatenation."""
-        return self._jit("allgather", self._resolve(algo, "allgather", self._msg_bytes("allgather", x)))(x)
+        return self._dispatch("allgather", x, algo)
 
     def alltoall(self, x, algo: str = "auto"):
         """(ranks..., n, c) -> same shape, global transpose of rank x chunk dims."""
-        return self._jit("alltoall", self._resolve(algo, "alltoall", self._msg_bytes("alltoall", x)))(x)
+        return self._dispatch("alltoall", x, algo)
 
     def broadcast(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., S) -> same shape; every rank row = root's row."""
-        return self._jit("broadcast",
-                         self._resolve(algo, "broadcast", self._msg_bytes("broadcast", x)),
-                         root=root)(x)
+        return self._dispatch("broadcast", x, algo, root=root)
 
     def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum"):
         """(ranks..., S) -> same shape; root's row = reduction, others zero."""
-        return self._jit("reduce", self._resolve(algo, "reduce", self._msg_bytes("reduce", x)),
-                         root=root, op=op)(x)
+        return self._dispatch("reduce", x, algo, root=root, op=op)
 
     def gather(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., c) -> (ranks..., n*c); root's row = concatenation in
         rank order, others zero."""
-        return self._jit("gather", self._resolve(algo, "gather", self._msg_bytes("gather", x)),
-                         root=root)(x)
+        return self._dispatch("gather", x, algo, root=root)
 
     def scatter(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., n*c) -> (ranks..., c); rank r's row = chunk r of root's
         row (only root's input is read)."""
-        return self._jit("scatter", self._resolve(algo, "scatter", self._msg_bytes("scatter", x)),
-                         root=root)(x)
+        return self._dispatch("scatter", x, algo, root=root)
 
     def sendrecv(self, x, algo: str = "auto", shift: int = 1):
         """(ranks, S) -> same shape; rank r's row = row (r - shift) mod n
         (every rank sends to r+shift — the ncclSend/ncclRecv pairwise
         exchange). 1-D rank mesh only; ``shift`` is a static int."""
-        return self._jit("sendrecv", self._resolve(algo, "sendrecv"),
-                         shift=shift)(x)
+        return self._dispatch("sendrecv", x, algo, shift=shift)
 
     def jit_fn(self, verb: str, algo: str = "auto", **knobs):
         """The compiled global-array callable (what the benches time)."""

@@ -114,5 +114,7 @@ class Group:
             return
         sig = tuple((verb, algo, knobs) for verb, algo, knobs, _ in self._calls)
         fn = self._t._group_jit(sig)
+        for verb, algo, _, x in self._calls:
+            self._t._count(verb, algo, x)
         self._results = list(fn(*(x for _, _, _, x in self._calls)))
         self._calls.clear()  # drop input references; results carry the data

@@ -1,0 +1,64 @@
+"""Topology dump CLI (RCCL topo-dump analogue) and Transport telemetry."""
+
+import json
+
+import numpy as np
+
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.runtime import topo_cli
+from rocnrdma_tpu.transport import Transport
+
+
+class _FakeDev:
+    def __init__(self, i, coords):
+        self.id = i
+        self.coords = coords
+        self.device_kind = "fake tpu"
+        self.process_index = 0
+        self.core_on_chip = 0
+        self.platform = "tpu"
+        self.client = None
+
+
+def test_describe_oracle(devices):
+    doc = topo_cli.describe()
+    assert doc["platform"] == "cpu" and doc["is_oracle"]
+    assert doc["n_devices"] == 8
+    assert doc["ring_order"] == [d["id"] for d in doc["devices"]]
+    assert "ring_hop_lengths" not in doc  # no coords on fakes
+    out = topo_cli.render(doc)
+    assert "CPU oracle" in out and "snake ring order" in out
+
+
+def test_describe_with_coords_reports_contiguity(devices):
+    # a 2x4 grid: snake order must make every hop one physical step
+    fakes = [_FakeDev(i, (x, y)) for i, (x, y) in enumerate(
+        [(x, y) for x in range(2) for y in range(4)])]
+    doc = topo_cli.describe(fakes)
+    assert doc["grid_dims"] == [2, 4]
+    assert doc["ring_contiguous"] is True
+    assert all(h == 1 for h in doc["ring_hop_lengths"][:-1])
+    assert "hop lengths" in topo_cli.render(doc)
+
+
+def test_cli_json(devices, capsys):
+    assert topo_cli.main(["--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["n_devices"] == 8
+
+
+def test_transport_stats_count_calls_and_bytes(devices):
+    t = Transport(rt.rank_mesh(4))
+    x = t.shard(np.zeros((4, 256), np.float32))
+    t.allreduce(x)
+    t.allreduce(x)
+    t.allgather(x, algo="ring")
+    with t.group() as g:
+        g.alltoall(t.shard(np.zeros((4, 4, 2), np.float32)))
+    s = t.stats()
+    assert s["allreduce/fused"]["calls"] == 2
+    assert s["allreduce/fused"]["bytes"] == 2 * x.nbytes
+    assert s["allgather/ring"]["calls"] == 1
+    assert s["alltoall/fused"]["calls"] == 1
+    table = t.format_stats()
+    assert "allreduce/fused" in table and "calls" in table
