@@ -72,7 +72,9 @@ class ProcessGroup:
             self._net.close()
             raise
         self._barrier_no = 0
+        self._split_no = 0
         self._destroyed = False
+        self._store_handle = store_handle
 
     # -- collectives (numpy in, numpy out) ---------------------------------
 
@@ -131,6 +133,62 @@ class ProcessGroup:
         self._barrier_no += 1
         self._client.barrier(f"pg/{self.group_name}/b{self._barrier_no}",
                              self.world_size, timeout_s)
+
+    def monitored_barrier(self, timeout_s: float = 30.0) -> None:
+        """Barrier that NAMES the absent ranks on timeout (the failure-
+        detection barrier; torch's monitored_barrier). Each rank publishes
+        its arrival under its own store key, so the raised TimeoutError
+        reports exactly which ranks never showed up — the difference between
+        'something hung' and 'rank 3 is dead'."""
+        if self.world_size == 1:
+            return
+        import time
+        self._barrier_no += 1
+        key = f"pg/{self.group_name}/mb{self._barrier_no}"
+        self._client.set(f"{key}/{self.rank}", "1")
+        deadline = time.monotonic() + timeout_s
+        # one blocking get at a time (get() itself polls at 10 ms), so the
+        # aggregate store load stays O(world_size), not O(world_size^2)
+        for r in range(self.world_size):
+            try:
+                self._client.get(
+                    f"{key}/{r}",
+                    timeout_s=max(0.0, deadline - time.monotonic()))
+            except TimeoutError:
+                missing = []
+                for m in range(r, self.world_size):  # one naming sweep
+                    try:
+                        self._client.get(f"{key}/{m}", timeout_s=0.0)
+                    except TimeoutError:
+                        missing.append(m)
+                raise TimeoutError(
+                    f"monitored_barrier: rank(s) {missing} missing after "
+                    f"{timeout_s}s (group {self.group_name!r}, "
+                    f"world_size {self.world_size})") from None
+
+    def split(self, color: int, timeout_s: float = 30.0) -> "ProcessGroup | None":
+        """Partition the group into sub-groups by ``color`` (the
+        ``ncclCommSplit`` analogue): ranks passing the same color form a new
+        group, re-ranked by old rank order; a negative color opts out and
+        returns None. Collective — every rank of this group must call it."""
+        if self._destroyed:
+            raise RuntimeError("cannot split a destroyed group")
+        self._split_no += 1
+        if self.world_size == 1:
+            return ProcessGroup(0, 1, None, None, timeout_s,
+                                f"{self.group_name}/s{self._split_no}") \
+                if color >= 0 else None
+        ns = f"pg/{self.group_name}/split{self._split_no}"
+        colors = self._client.exchange(f"{ns}/c", str(color),
+                                       self.world_size, timeout_s)
+        members = [r for r, c in enumerate(colors) if int(c) == color]
+        if color < 0:
+            return None
+        # the parent's store outlives the child (server=None); the child's
+        # group_name namespaces its ring/barrier keys away from the parent's
+        return ProcessGroup(
+            members.index(self.rank), len(members), self._store_handle,
+            None, timeout_s, f"{self.group_name}/s{self._split_no}c{color}")
 
     # -- lifecycle ---------------------------------------------------------
 

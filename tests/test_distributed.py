@@ -174,6 +174,74 @@ def test_bad_rank_raises():
         dist.init_process_group(rank=5, world_size=2)
 
 
+def test_monitored_barrier_all_arrive(sidecar_store):
+    n = 3
+    store = sidecar_store(n)
+
+    def fn(pg):
+        pg.monitored_barrier(timeout_s=20)
+        return True
+
+    assert all(_run_group(n, fn, store_handle=store.handle))
+
+
+def test_monitored_barrier_names_missing_rank(sidecar_store):
+    """Rank 1 never arrives; survivors must learn exactly who is missing."""
+    n = 3
+    store = sidecar_store(n)
+    caught = []
+
+    def fn(pg):
+        if pg.rank == 1:
+            return "absent"  # simulated dead rank: skips the barrier
+        try:
+            pg.monitored_barrier(timeout_s=2.0)
+        except TimeoutError as e:
+            caught.append(str(e))
+            return "timeout"
+        return "passed"
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res == ["timeout", "absent", "timeout"]
+    assert all("[1]" in msg for msg in caught)
+
+
+def test_split_partitions_and_reranks(sidecar_store):
+    """4 ranks split into even/odd pairs; each pair allreduces privately."""
+    n = 4
+    store = sidecar_store(n)
+
+    def fn(pg):
+        sub = pg.split(color=pg.rank % 2)
+        try:
+            assert sub.world_size == 2
+            assert sub.rank == pg.rank // 2
+            out = sub.all_reduce(np.array([float(pg.rank)]))
+            return out[0]
+        finally:
+            sub.destroy()
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res == [2.0, 4.0, 2.0, 4.0]  # 0+2, 1+3 per color
+
+
+def test_split_opt_out(sidecar_store):
+    n = 3
+    store = sidecar_store(n)
+
+    def fn(pg):
+        sub = pg.split(color=0 if pg.rank < 2 else -1)
+        if pg.rank == 2:
+            return sub  # None: opted out
+        try:
+            return sub.all_reduce(np.array([1.0]))[0]
+        finally:
+            sub.destroy()
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res[0] == 2.0 and res[1] == 2.0 and res[2] is None
+
+
 def test_two_groups_share_sidecar_store(sidecar_store):
     """Distinct group_names keep barriers/rings independent on one store."""
     n = 2
