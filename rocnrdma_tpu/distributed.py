@@ -82,13 +82,21 @@ class ProcessGroup:
         return fn(self._net, self._send, self._recv, *args, **kw)
 
     def all_reduce(self, x, op: str = "sum") -> np.ndarray:
-        """Elementwise reduction across ranks (op: sum/prod/max/min);
+        """Elementwise reduction across ranks (op: sum/prod/max/min/avg);
         every rank gets the result, shape preserved."""
         x = np.asarray(x)
         if self.world_size == 1:
             return x.copy()
-        return self._ring(plugin.ring_allreduce_over_net, x, self.rank,
-                          self.world_size, op=op)
+        if op == "avg" and not np.issubdtype(x.dtype, np.floating):
+            raise ValueError(
+                f"all_reduce op='avg' needs a float dtype, got {x.dtype} "
+                f"(an integer average would silently truncate)")
+        wire_op = "sum" if op == "avg" else op
+        out = self._ring(plugin.ring_allreduce_over_net, x, self.rank,
+                         self.world_size, op=wire_op)
+        if op == "avg":
+            out = (out / self.world_size).astype(x.dtype)
+        return out
 
     def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
         """Reduce across ranks; rank r keeps the r-th of n floor-balanced

@@ -250,14 +250,16 @@ class Transport:
         self._count(verb, resolved, x)           # rejected calls don't count
         return fn(x)
 
-    def allreduce(self, x, algo: str = "auto", op: str = "sum"):
+    def allreduce(self, x, algo: str = "auto", op: str = "sum", acc=None):
         """(ranks..., S) -> same shape; every rank row = elementwise reduction
-        (``op``: sum/prod/max/min/avg)."""
-        return self._dispatch("allreduce", x, algo, op=op)
+        (``op``: sum/prod/max/min/avg). ``acc``: accumulate in this wider
+        dtype and cast back — e.g. ``acc="float32"`` on bf16 buffers, the
+        RCCL fp32-accumulation behavior (wire traffic is in ``acc``)."""
+        return self._dispatch("allreduce", x, algo, op=op, acc=acc)
 
-    def reduce_scatter(self, x, algo: str = "auto", op: str = "sum"):
+    def reduce_scatter(self, x, algo: str = "auto", op: str = "sum", acc=None):
         """(ranks..., S) -> (ranks..., S/n); rank r keeps the reduced r-th shard."""
-        return self._dispatch("reduce_scatter", x, algo, op=op)
+        return self._dispatch("reduce_scatter", x, algo, op=op, acc=acc)
 
     def allgather(self, x, algo: str = "auto"):
         """(ranks..., c) -> (ranks..., n*c); every rank ends with the concatenation."""
@@ -271,9 +273,10 @@ class Transport:
         """(ranks..., S) -> same shape; every rank row = root's row."""
         return self._dispatch("broadcast", x, algo, root=root)
 
-    def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum"):
+    def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum",
+               acc=None):
         """(ranks..., S) -> same shape; root's row = reduction, others zero."""
-        return self._dispatch("reduce", x, algo, root=root, op=op)
+        return self._dispatch("reduce", x, algo, root=root, op=op, acc=acc)
 
     def gather(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., c) -> (ranks..., n*c); root's row = concatenation in
@@ -331,9 +334,16 @@ class Transport:
         root = knobs.get("root")
         if root is not None and not 0 <= root < self.n_ranks:
             raise ValueError(f"root {root} out of range for {self.n_ranks} ranks")
+        if knobs.get("acc") is not None:
+            # canonicalize ("float32" / np.float32 / jnp.float32 -> one
+            # spelling, one cache entry) and fail here, not inside _build
+            try:
+                knobs["acc"] = jnp.dtype(knobs["acc"]).name
+            except TypeError as e:
+                raise ValueError(f"bad acc dtype {knobs['acc']!r}: {e}") from None
         return {k: v for k, v in knobs.items()
                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
-                and not (k == "shift" and v == 1)}
+                and not (k == "shift" and v == 1) and not (k == "acc" and v is None)}
 
     def _jit(self, verb: str, algo: str, **knobs):
         knobs = self._normalize_knobs(**knobs)
@@ -377,7 +387,16 @@ class Transport:
         schedule = SCHEDULES[verb].get(algo)
         if schedule is None:
             raise ValueError(f"op {verb!r} has no {algo!r} schedule")
-        fn = lambda v: schedule(v, fused_axes, **knobs)
+        # ``acc``: accumulate in a wider dtype and cast back (the NCCL/RCCL
+        # fp32-accumulation-for-bf16 behavior) — algorithm-agnostic, so it
+        # wraps the schedule instead of threading through each one
+        acc = knobs.pop("acc", None)
+        base = lambda v: schedule(v, fused_axes, **knobs)
+        if acc is None:
+            fn = base
+        else:
+            acc_dtype = jnp.dtype(acc)
+            fn = lambda v: base(v.astype(acc_dtype)).astype(v.dtype)
 
         spec = self._spec()
         # check_vma off for the pallas data plane: pallas_call outputs carry

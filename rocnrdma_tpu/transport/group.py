@@ -66,11 +66,13 @@ class Group:
         self._calls.append((verb, resolved, tuple(sorted(knobs.items())), x))
         return GroupHandle(self, len(self._calls) - 1)
 
-    def allreduce(self, x, algo: str = "auto", op: str = "sum") -> GroupHandle:
-        return self._queue("allreduce", x, algo, op=op)
+    def allreduce(self, x, algo: str = "auto", op: str = "sum",
+                  acc=None) -> GroupHandle:
+        return self._queue("allreduce", x, algo, op=op, acc=acc)
 
-    def reduce_scatter(self, x, algo: str = "auto", op: str = "sum") -> GroupHandle:
-        return self._queue("reduce_scatter", x, algo, op=op)
+    def reduce_scatter(self, x, algo: str = "auto", op: str = "sum",
+                       acc=None) -> GroupHandle:
+        return self._queue("reduce_scatter", x, algo, op=op, acc=acc)
 
     def allgather(self, x, algo: str = "auto") -> GroupHandle:
         return self._queue("allgather", x, algo)
@@ -81,8 +83,9 @@ class Group:
     def broadcast(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
         return self._queue("broadcast", x, algo, root=root)
 
-    def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum") -> GroupHandle:
-        return self._queue("reduce", x, algo, root=root, op=op)
+    def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum",
+               acc=None) -> GroupHandle:
+        return self._queue("reduce", x, algo, root=root, op=op, acc=acc)
 
     def gather(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
         return self._queue("gather", x, algo, root=root)

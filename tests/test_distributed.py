@@ -70,11 +70,17 @@ def test_all_reduce_ops(sidecar_store):
     n = 3
     store = sidecar_store(n)
     xs = [np.array([1.0, 5.0, 2.0], np.float32) * (r + 1) for r in range(n)]
-    res = _run_group(n, lambda pg: pg.all_reduce(xs[pg.rank], op="max"),
-                     store_handle=store.handle)
-    want = np.max(xs, axis=0)
-    for r in res:
-        np.testing.assert_array_equal(r, want)
+
+    def fn(pg):
+        return (pg.all_reduce(xs[pg.rank], op="max"),
+                pg.all_reduce(xs[pg.rank], op="avg"))
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    want_max = np.max(xs, axis=0)
+    want_avg = np.mean(xs, axis=0)
+    for mx, avg in res:
+        np.testing.assert_array_equal(mx, want_max)
+        np.testing.assert_allclose(avg, want_avg, rtol=1e-6)
 
 
 def test_gather_scatter_broadcast_alltoall(sidecar_store):

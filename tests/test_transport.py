@@ -122,3 +122,49 @@ def test_sendrecv_2d_rejected(t2d):
     # a shift permutation is only defined over one ring
     with pytest.raises(ValueError):
         t2d.sendrecv(t2d.shard(_rand((2, 4, 8), seed=11)))
+
+
+def test_allreduce_fp32_accumulation_beats_bf16(devices):
+    """acc="float32" on bf16 buffers: the RCCL fp32-accumulation behavior.
+
+    Values chosen so pure-bf16 chained adds lose the small addends (bf16 has
+    an 8-bit mantissa: 256 + 0.25 rounds back to 256), while fp32
+    accumulation keeps them.
+    """
+    import jax.numpy as jnp
+
+    from rocnrdma_tpu import runtime as rt
+    from rocnrdma_tpu.transport import Transport
+
+    n = 8
+    t = Transport(rt.rank_mesh(n))
+    x = np.full((n, 64), 0.25, np.float32)
+    x[0] = 256.0
+    want = x.sum(axis=0)  # 257.75
+    xb = t.shard(jnp.asarray(x, jnp.bfloat16))
+
+    plain = np.asarray(t.allreduce(xb, algo="ring")).astype(np.float32)
+    wide = np.asarray(t.allreduce(xb, algo="ring", acc="float32")).astype(np.float32)
+    err_plain = np.abs(plain[0] - want).max()
+    err_wide = np.abs(wide[0] - want).max()
+    assert err_wide < err_plain  # fp32 accumulation strictly closer
+    # wide result is exact up to the final bf16 cast of 257.75 -> 258
+    assert err_wide <= 0.5
+
+
+def test_acc_knob_in_group_and_cache(devices):
+    from rocnrdma_tpu import runtime as rt
+    from rocnrdma_tpu.transport import Transport
+
+    t = Transport(rt.rank_mesh(4))
+    x = t.shard(np.ones((4, 16), np.float32))
+    # acc=None normalizes away: same cache entry as the bare call
+    t.allreduce(x)
+    t.allreduce(x, acc=None)
+    keys = [k for k in t._cache if k[0] == "allreduce"]
+    assert len(keys) == 1
+    import jax.numpy as jnp
+    xb = t.shard(jnp.ones((4, 16), jnp.bfloat16))
+    with t.group() as g:
+        h = g.allreduce(xb, algo="tree", acc="float32")
+    np.testing.assert_allclose(np.asarray(h.result()).astype(np.float32), 4.0)
