@@ -51,10 +51,24 @@ def moe_step(t: Transport, algo: str, expert_compute: bool):
     return jax.jit(step) if expert_compute else step
 
 
+# Public MoE architectures as dispatch-shape presets: expert-parallel
+# alltoall traffic depends only on (d_model, n_experts) and the token
+# count, so the public configs pin realistic message shapes (no weights).
+MOE_MODELS = {
+    # Mixtral-8x7B: d_model 4096, 8 experts, top-2 routing -> 2 dispatches
+    # per token; with one expert per rank the natural EP world is 8.
+    "mixtral-8x7b": {"d_model": 4096, "n_experts": 8, "top_k": 2},
+}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="moe", description="MoE alltoall dispatch/combine bench")
     p.add_argument("--tokens", type=int, default=1024, help="tokens per rank")
     p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--model", choices=sorted(MOE_MODELS), default=None,
+                   help="public MoE architecture preset: sets --d-model and "
+                        "scales --tokens by its top_k (each token is "
+                        "dispatched top_k times)")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--ranks", type=int, default=None)
     p.add_argument("--mesh2d", type=str, default=None, metavar="SLICESxPER")
@@ -67,12 +81,26 @@ def main(argv=None) -> int:
     p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
+    spec = MOE_MODELS[args.model] if args.model else None
+    if spec:
+        args.d_model = spec["d_model"]
+        args.tokens *= spec["top_k"]  # each token dispatched top_k times
+        if args.ranks is None and args.mesh2d is None:
+            args.ranks = spec["n_experts"]  # default to the model's EP world
 
     info = cli_common.setup_backend(args.fake_devices, args.platform, args.ranks)
     topo = info.topology
     mesh = cli_common.build_mesh(args.mesh2d, args.ranks, topo)
     t = Transport(mesh)
     n = t.n_ranks
+    if spec:
+        print(f"# {args.model}: d_model={args.d_model}, "
+              f"top_k={spec['top_k']}, running {n} experts (one per rank)",
+              file=sys.stderr)
+        if n != spec["n_experts"]:
+            print(f"# WARNING: {args.model} has {spec['n_experts']} experts "
+                  f"but this mesh has {n} ranks — traffic shape is "
+                  f"{n}-expert, not the named model's", file=sys.stderr)
 
     cap = max(1, args.tokens // n)  # uniform routing: tokens/rank/expert
     np_dtype = np.dtype(getattr(jnp, args.dtype))
