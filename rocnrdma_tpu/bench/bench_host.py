@@ -1,0 +1,161 @@
+"""Host-plane transport benchmark: the native QP ring under real load.
+
+The device-plane benches (`bench_allreduce` et al.) measure XLA collectives
+over ICI; this one measures the plane this framework built itself — the
+C++ queue pairs (`native/rtcp.cpp`) carrying the ring collectives of
+`transport/plugin.py` through the process-group front door
+(`distributed.py`). It is the closest analogue of what the reference's
+`bench_allreduce` measured on ITS transport (verbs + NIC), and doubles as
+a soak test of the whole host stack: rendezvous store, ring wiring, tag
+framing, backpressure.
+
+Ranks are REAL OS processes (rank 0 of the bench re-executes this module
+as workers), because the host plane's progress engines spin in Python —
+threads would serialize on the GIL and understate the plane.
+
+Timing: per (collective, size): warmup, store barrier, ``iters`` back-to-
+back calls, stop; the recorded time is the MAX across ranks (a collective
+is as slow as its slowest rank) of the per-rank trimmed mean.
+
+Usage::
+
+    python -m rocnrdma_tpu.bench.bench_host --ranks 4 --sizes 64K,1M
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu.bench.runner import parse_size
+from rocnrdma_tpu.bench.timing import trimmed_mean
+
+COLLECTIVES = ("allreduce", "reducescatter", "allgather", "broadcast",
+               "alltoall")
+
+
+def _build_input(collective: str, n: int, elems: int, rng) -> np.ndarray:
+    if collective == "allgather":
+        return rng.standard_normal(max(1, elems // n)).astype(np.float32)
+    if collective == "alltoall":
+        per = max(1, elems // n)
+        return rng.standard_normal((n, per)).astype(np.float32)
+    return rng.standard_normal(elems).astype(np.float32)
+
+
+def _issue(pg, collective: str, x: np.ndarray):
+    if collective == "allreduce":
+        return pg.all_reduce(x)
+    if collective == "reducescatter":
+        return pg.reduce_scatter(x)
+    if collective == "allgather":
+        return pg.all_gather(x)
+    if collective == "broadcast":
+        return pg.broadcast(x, src=0)
+    if collective == "alltoall":
+        return pg.all_to_all(x)
+    raise ValueError(f"unknown collective {collective!r}")
+
+
+def worker(args) -> int:
+    from rocnrdma_tpu import distributed as dist
+
+    pg = dist.init_process_group()
+    rng = np.random.default_rng(pg.rank)
+    records = []
+    for collective in args.collectives.split(","):
+        for size in (parse_size(s) for s in args.sizes.split(",")):
+            elems = max(1, size // 4)
+            x = _build_input(collective, pg.world_size, elems, rng)
+            # record the bytes actually moved (per-rank chunks round down),
+            # matching the device benches' actual-bytes convention
+            actual = (x.nbytes * pg.world_size
+                      if collective == "allgather" else x.nbytes)
+            _issue(pg, collective, x)  # warmup (wires, buffers, branches)
+            spans = []
+            for _ in range(args.repeats):
+                pg.barrier()
+                t0 = time.perf_counter()
+                for _ in range(args.iters):
+                    _issue(pg, collective, x)
+                spans.append((time.perf_counter() - t0) / args.iters)
+            mine = trimmed_mean(spans)
+            # a collective is as slow as its slowest rank
+            sec = float(pg.all_reduce(np.array([mine]), op="max")[0])
+            if pg.rank == 0:
+                records.append(M.BenchRecord.measure(
+                    "bench_host", collective, "ring", pg.world_size, actual,
+                    "float32", sec, platform="host-tcp",
+                    iters=args.iters, repeats=args.repeats))
+    pg.barrier()
+    pg.destroy()
+    if pg.rank == 0:
+        for rec in records:
+            print(rec.to_json())
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_host",
+        description="Benchmark the native host-plane (TCP QP) ring collectives")
+    p.add_argument("--ranks", type=int, default=4)
+    p.add_argument("--sizes", default="64K,1M")
+    p.add_argument("--collectives", default=",".join(COLLECTIVES))
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--out", default=None, help="JSONL output path")
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return worker(args)
+
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cmd = [sys.executable, "-m", "rocnrdma_tpu.bench.bench_host", "--worker",
+           "--ranks", str(args.ranks), "--sizes", args.sizes,
+           "--collectives", args.collectives, "--repeats", str(args.repeats),
+           "--iters", str(args.iters)]
+    procs = []
+    try:
+        for r in range(args.ranks):
+            env = dict(os.environ, RANK=str(r), WORLD_SIZE=str(args.ranks),
+                       MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port))
+            procs.append(subprocess.Popen(
+                cmd, env=env, text=True,
+                stdout=subprocess.PIPE if r == 0 else subprocess.DEVNULL,
+                stderr=None if r == 0 else subprocess.DEVNULL))
+        out, _ = procs[0].communicate(timeout=600)
+        codes = [p.wait(timeout=600) for p in procs]
+    finally:
+        # never orphan CPU-spinning workers: a wedged rank or a timeout
+        # above must take the whole fleet down with it
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(codes):
+        print(out, file=sys.stderr)
+        raise SystemExit(f"worker exit codes {codes}")
+
+    records = [M.BenchRecord.from_json(line)
+               for line in out.splitlines() if line.strip()]
+    if args.out:
+        with open(args.out, "a") as fp:
+            for rec in records:
+                rec.write(fp)
+    print(M.format_table(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

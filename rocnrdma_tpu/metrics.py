@@ -111,6 +111,10 @@ class BenchRecord:
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
 
+    @classmethod
+    def from_json(cls, line: str) -> "BenchRecord":
+        return cls(**json.loads(line))
+
     def write(self, fp: IO[str]) -> None:
         fp.write(self.to_json() + "\n")
         fp.flush()

@@ -65,13 +65,18 @@ class Request:
             self.done, self.size, self.payload = self._test()
         return self.done, self.size
 
-    def wait(self, timeout_s: float = 10.0):
+    def wait(self, timeout_s: float = 10.0, progress=None):
+        """Block until done. ``progress``: extra per-cycle progress hook —
+        callers whose own outbound must keep flowing while they wait (the
+        ring hops pass their send comm's pump) supply it here."""
         import time
         deadline = time.monotonic() + timeout_s
         while not self.test()[0]:
+            if progress is not None:
+                progress()
             if time.monotonic() >= deadline:
                 raise TimeoutError("net request timed out")
-            time.sleep(0.0005)
+            time.sleep(0.0002)
         return self.payload
 
 
@@ -496,9 +501,33 @@ class _RingWire:
             self.net.isend(self.send_comm,
                            self.net.reg_mr(self.send_comm, seg),
                            tag=tag(fi), progress=pump)
+        # Wait for the inbound frames WHILE keeping our own outbound
+        # flowing. A hop larger than the kernel socket buffers leaves the
+        # tail of our frames in the user-space tx queue; the peer cannot
+        # feed us until it drains us and vice versa, so a wait that only
+        # pumps the recv comm deadlocks symmetrically (observed at 16 MB
+        # hops: both ranks time out with MBs stuck in their send queues).
+        import time as _time
+        send_pump = getattr(self.send_comm, "_pump", None)
         for off, nb, r in reqs:
-            payload = r.wait()
+            payload = r.wait(progress=send_pump)
             got[off:off + nb] = np.frombuffer(payload, np.uint8)
+        # Symmetric tail: a rank whose receives all completed early may
+        # still hold queued tx that nothing would otherwise flush — the
+        # peer would time out on frames we believe are sent. Flushing
+        # cannot deadlock: the peer always drains its inbound socket.
+        tx_pending = (getattr(self.send_comm.qp, "tx_pending", None)
+                      if hasattr(self.send_comm, "qp") else None)
+        deadline = _time.monotonic() + 30.0
+        while tx_pending is not None and tx_pending() > 0:
+            if send_pump is not None:
+                send_pump()
+            if pump is not None:
+                pump()
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("ring hop: peer stopped draining; "
+                                   "tx still queued after 30s")
+            _time.sleep(0.0002)
         return got
 
 

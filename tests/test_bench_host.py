@@ -1,0 +1,34 @@
+"""Host-plane transport bench: the CLI end-to-end across real processes."""
+
+import json
+
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.bench import bench_host
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable")
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    out = tmp_path / "host.jsonl"
+    rc = bench_host.main(["--ranks", "2", "--sizes", "64K",
+                          "--collectives", "allreduce,allgather",
+                          "--repeats", "2", "--iters", "2",
+                          "--out", str(out)])
+    assert rc == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["collective"] for r in rows} == {"allreduce", "allgather"}
+    assert all(r["platform"] == "host-tcp" and r["n_ranks"] == 2
+               and r["mean_s"] > 0 for r in rows)
+    table = capsys.readouterr().out
+    assert "allreduce" in table and "ring" in table
+
+
+def test_build_input_shapes():
+    import numpy as np
+    rng = np.random.default_rng(0)
+    assert bench_host._build_input("allreduce", 4, 100, rng).shape == (100,)
+    assert bench_host._build_input("allgather", 4, 100, rng).shape == (25,)
+    assert bench_host._build_input("alltoall", 4, 100, rng).shape == (4, 25)

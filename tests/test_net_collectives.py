@@ -112,6 +112,22 @@ def test_reduce_scatter_over_net(net_cls, n):
 
 
 @needs_native
+def test_large_hop_exceeding_kernel_buffers():
+    """Regression: a hop bigger than the kernel socket buffers must not
+    deadlock (each side's tail frames sit in the user-space tx queue; the
+    wait loop must pump the send comm too). TCP plane, 16 MB buffers."""
+    n = 2
+    rng = np.random.default_rng(7)
+    xs = [rng.standard_normal(4 * 1024 * 1024).astype(np.float32)
+          for _ in range(n)]
+    res = _run_ring(TCPNet, n, lambda net, s, r, rank:
+                    ring_allreduce_over_net(net, s, r, xs[rank], rank, n))
+    want = np.sum(xs, axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(res[r], want, rtol=1e-5, atol=1e-5)
+
+
+@needs_native
 @pytest.mark.parametrize("net_cls", PLANES)
 def test_sequential_collectives_share_comms(net_cls):
     """Back-to-back collectives on the same comms must not cross tags."""
