@@ -50,9 +50,9 @@ def _build_input(collective: str, n: int, elems: int, rng) -> np.ndarray:
     return rng.standard_normal(elems).astype(np.float32)
 
 
-def _issue(pg, collective: str, x: np.ndarray):
+def _issue(pg, collective: str, x: np.ndarray, transport: str = "msg"):
     if collective == "allreduce":
-        return pg.all_reduce(x)
+        return pg.all_reduce(x, transport=transport)
     if collective == "reducescatter":
         return pg.reduce_scatter(x)
     if collective == "allgather":
@@ -67,7 +67,7 @@ def _issue(pg, collective: str, x: np.ndarray):
 def worker(args) -> int:
     from rocnrdma_tpu import distributed as dist
 
-    pg = dist.init_process_group()
+    pg = dist.init_process_group(plane=args.plane)
     rng = np.random.default_rng(pg.rank)
     records = []
     for collective in args.collectives.split(","):
@@ -78,21 +78,23 @@ def worker(args) -> int:
             # matching the device benches' actual-bytes convention
             actual = (x.nbytes * pg.world_size
                       if collective == "allgather" else x.nbytes)
-            _issue(pg, collective, x)  # warmup (wires, buffers, branches)
+            _issue(pg, collective, x, args.transport)  # warmup
             spans = []
             for _ in range(args.repeats):
                 pg.barrier()
                 t0 = time.perf_counter()
                 for _ in range(args.iters):
-                    _issue(pg, collective, x)
+                    _issue(pg, collective, x, args.transport)
                 spans.append((time.perf_counter() - t0) / args.iters)
             mine = trimmed_mean(spans)
             # a collective is as slow as its slowest rank
             sec = float(pg.all_reduce(np.array([mine]), op="max")[0])
             if pg.rank == 0:
+                algo = ("ring_rdma" if args.transport == "rdma"
+                        and collective == "allreduce" else "ring")
                 records.append(M.BenchRecord.measure(
-                    "bench_host", collective, "ring", pg.world_size, actual,
-                    "float32", sec, platform="host-tcp",
+                    "bench_host", collective, algo, pg.world_size, actual,
+                    "float32", sec, platform=f"host-{args.plane}",
                     iters=args.iters, repeats=args.repeats))
     pg.barrier()
     pg.destroy()
@@ -107,6 +109,12 @@ def main(argv=None) -> int:
         prog="bench_host",
         description="Benchmark the native host-plane (TCP QP) ring collectives")
     p.add_argument("--ranks", type=int, default=4)
+    p.add_argument("--plane", choices=("tcp", "shm"), default="tcp",
+                   help="wire under the ring: TCP (cross-host) or shared "
+                        "memory (intra-node)")
+    p.add_argument("--transport", choices=("msg", "rdma"), default="msg",
+                   help="allreduce data path: two-sided send/recv or "
+                        "one-sided RDMA writes (put-based ring)")
     p.add_argument("--sizes", default="64K,1M")
     p.add_argument("--collectives", default=",".join(COLLECTIVES))
     p.add_argument("--repeats", type=int, default=5)
@@ -123,7 +131,8 @@ def main(argv=None) -> int:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     cmd = [sys.executable, "-m", "rocnrdma_tpu.bench.bench_host", "--worker",
-           "--ranks", str(args.ranks), "--sizes", args.sizes,
+           "--ranks", str(args.ranks), "--plane", args.plane,
+           "--transport", args.transport, "--sizes", args.sizes,
            "--collectives", args.collectives, "--repeats", str(args.repeats),
            "--iters", str(args.iters)]
     procs = []

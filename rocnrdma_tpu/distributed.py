@@ -36,10 +36,13 @@ import os
 import numpy as np
 
 from rocnrdma_tpu.transport import (
+    HostQPNet,
     TCPNet,
     bootstrap,
     plugin,
 )
+
+_PLANES = {"tcp": TCPNet, "shm": HostQPNet}
 
 
 class ProcessGroup:
@@ -52,12 +55,16 @@ class ProcessGroup:
 
     def __init__(self, rank: int, world_size: int, store_handle: str,
                  server: "bootstrap.BootstrapServer | None",
-                 timeout_s: float = 30.0, group_name: str = "default"):
+                 timeout_s: float = 30.0, group_name: str = "default",
+                 plane: str = "tcp"):
         self.rank = rank
         self.world_size = world_size
         self.group_name = group_name
+        self.plane = plane
         self._server = server  # only rank 0 (or an external sidecar) owns one
-        self._net = TCPNet()
+        if plane not in _PLANES:
+            raise ValueError(f"unknown plane {plane!r}; know {sorted(_PLANES)}")
+        self._net = _PLANES[plane]()
         self._net.init()
         try:
             if world_size > 1:
@@ -81,10 +88,17 @@ class ProcessGroup:
     def _ring(self, fn, *args, **kw):
         return fn(self._net, self._send, self._recv, *args, **kw)
 
-    def all_reduce(self, x, op: str = "sum") -> np.ndarray:
+    def all_reduce(self, x, op: str = "sum",
+                   transport: str = "msg") -> np.ndarray:
         """Elementwise reduction across ranks (op: sum/prod/max/min/avg);
-        every rank gets the result, shape preserved."""
+        every rank gets the result, shape preserved. ``transport``:
+        ``"msg"`` (two-sided send/recv ring) or ``"rdma"`` (one-sided
+        put-based ring — data written straight into peer MRs with doorbell
+        flags, no posted receives on the data path)."""
         x = np.asarray(x)
+        if transport not in ("msg", "rdma"):  # validate even at world size 1
+            raise ValueError(f"unknown transport {transport!r}; "
+                             f"know ('msg', 'rdma')")
         if self.world_size == 1:
             return x.copy()
         if op == "avg" and not np.issubdtype(x.dtype, np.floating):
@@ -92,8 +106,9 @@ class ProcessGroup:
                 f"all_reduce op='avg' needs a float dtype, got {x.dtype} "
                 f"(an integer average would silently truncate)")
         wire_op = "sum" if op == "avg" else op
-        out = self._ring(plugin.ring_allreduce_over_net, x, self.rank,
-                         self.world_size, op=wire_op)
+        fn = (plugin.ring_allreduce_rdma if transport == "rdma"
+              else plugin.ring_allreduce_over_net)
+        out = self._ring(fn, x, self.rank, self.world_size, op=wire_op)
         if op == "avg":
             out = (out / self.world_size).astype(x.dtype)
         return out
@@ -184,7 +199,8 @@ class ProcessGroup:
         self._split_no += 1
         if self.world_size == 1:
             return ProcessGroup(0, 1, None, None, timeout_s,
-                                f"{self.group_name}/s{self._split_no}") \
+                                f"{self.group_name}/s{self._split_no}",
+                                plane=self.plane) \
                 if color >= 0 else None
         ns = f"pg/{self.group_name}/split{self._split_no}"
         colors = self._client.exchange(f"{ns}/c", str(color),
@@ -196,7 +212,8 @@ class ProcessGroup:
         # group_name namespaces its ring/barrier keys away from the parent's
         return ProcessGroup(
             members.index(self.rank), len(members), self._store_handle,
-            None, timeout_s, f"{self.group_name}/s{self._split_no}c{color}")
+            None, timeout_s, f"{self.group_name}/s{self._split_no}c{color}",
+            plane=self.plane)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -233,7 +250,8 @@ def init_process_group(rank: int | None = None,
                        master_port: int | None = None,
                        store_handle: str | None = None,
                        timeout_s: float = 30.0,
-                       group_name: str = "default") -> ProcessGroup:
+                       group_name: str = "default",
+                       plane: str = "tcp") -> ProcessGroup:
     """Create this process's :class:`ProcessGroup`.
 
     Rendezvous: either pass ``store_handle`` (an already-running
@@ -242,6 +260,10 @@ def init_process_group(rank: int | None = None,
     ``master_addr``/``master_port`` and rank 0 will serve the store itself
     (the torch master semantics). Unset arguments fall back to the standard
     ``RANK`` / ``WORLD_SIZE`` / ``MASTER_ADDR`` / ``MASTER_PORT`` env vars.
+
+    ``plane``: the wire under the ring — ``"tcp"`` (cross-host; default) or
+    ``"shm"`` (shared-memory queue pairs: the intra-node fast path, all
+    ranks on one machine; the rendezvous store stays TCP either way).
     """
     rank = int(os.environ["RANK"]) if rank is None else rank
     world_size = (int(os.environ["WORLD_SIZE"]) if world_size is None
@@ -262,7 +284,7 @@ def init_process_group(rank: int | None = None,
             store_handle = f"{master_addr}:{master_port}"
     try:
         return ProcessGroup(rank, world_size, store_handle, server,
-                            timeout_s, group_name)
+                            timeout_s, group_name, plane)
     except BaseException:
         if server is not None:  # failed rendezvous must free the master port
             server.close()

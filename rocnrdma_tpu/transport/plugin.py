@@ -156,12 +156,18 @@ class HostQPNet:
                              max_inflight=1 << 10, byte_oriented=True,
                              one_sided=True)
 
-    def listen(self, dev: int = 0, capacity: int = 1 << 20):
-        """-> (handle, listen_comm). Give ``handle`` to the connecting peer."""
+    def listen(self, dev: int = 0, capacity: int = 1 << 20,
+               mr_capacity: int = 64 << 20):
+        """-> (handle, listen_comm). Give ``handle`` to the connecting peer.
+
+        ``mr_capacity`` sizes each side's one-sided MR arena; the generous
+        default matches the TCP plane's 64 MiB frame cap (shm pages are
+        allocated lazily on first touch, so an unused arena costs nothing)
+        and keeps the put-based ring viable for multi-MB chunks."""
         from rocnrdma_tpu import native
         assert self._inited, "call init() first"
         handle = f"/rqp_{uuid.uuid4().hex[:16]}"
-        qp = native.QueuePair.listen(handle, capacity)
+        qp = native.QueuePair.listen(handle, capacity, mr_capacity=mr_capacity)
         return handle, qp
 
     def connect(self, dev: int, handle: str, timeout_s: float = 10.0) -> _HostComm:
@@ -272,6 +278,13 @@ class HostQPNet:
         return Request(
             _test=lambda: self._onesided_probe(comm, wr, nbytes, into))
 
+    def read_mr_local(self, comm: _HostComm, mr, offset: int,
+                      nbytes: int) -> bytes:
+        """Read the OWNER's view of its own MR with peer writes visible.
+        shm plane: a local fenced copy through the QP (the arena is shared,
+        so the acquire fence pairs with the writer's release)."""
+        return comm.qp.rdma_read(mr.rkey, nbytes, offset)
+
     @staticmethod
     def _onesided_probe(comm: _HostComm, wr: int, size: int, into):
         if wr not in comm._onesided_done:
@@ -333,6 +346,15 @@ class TCPNet(HostQPNet):
         comm = _HostComm(listener.accept(timeout_s))
         self._comms.append(comm)
         return comm
+
+    def read_mr_local(self, comm: _HostComm, mr, offset: int,
+                      nbytes: int) -> bytes:
+        """TCP plane: MRs are conn-local heap buffers and peer writes apply
+        inside OUR progress engine — pump, then read directly (a
+        ``comm.qp.rdma_read`` here would go over the wire to the PEER's MR
+        table, which is a different region)."""
+        comm._pump()
+        return mr.read(offset, nbytes)
 
     def close(self) -> None:
         super().close()
@@ -516,18 +538,8 @@ class _RingWire:
         # still hold queued tx that nothing would otherwise flush — the
         # peer would time out on frames we believe are sent. Flushing
         # cannot deadlock: the peer always drains its inbound socket.
-        tx_pending = (getattr(self.send_comm.qp, "tx_pending", None)
-                      if hasattr(self.send_comm, "qp") else None)
-        deadline = _time.monotonic() + 30.0
-        while tx_pending is not None and tx_pending() > 0:
-            if send_pump is not None:
-                send_pump()
-            if pump is not None:
-                pump()
-            if _time.monotonic() >= deadline:
-                raise TimeoutError("ring hop: peer stopped draining; "
-                                   "tx still queued after 30s")
-            _time.sleep(0.0002)
+        _flush_tx(self.send_comm, 30.0, extra_pump=pump,
+                  what="ring hop: peer stopped draining")
         return got
 
 
@@ -602,6 +614,168 @@ def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
     _ring_reduce_phase(wire, x, chunk, rank, n, shift=-1, op=op)
     return np.array(chunk(rank), copy=True)
+
+
+def _flush_tx(comm, timeout_s: float, extra_pump=None,
+              what: str = "peer stopped draining") -> None:
+    """Pump until ``comm``'s user-space tx queue is empty. A send CQE means
+    "handed to the kernel", but with the kernel buffer full the tail stays
+    in user space — and a caller that stops touching the comm after its own
+    receives complete would strand it, starving the peer. No-op on comms
+    without a tx queue (shm plane, device plane)."""
+    import time as _time
+    tx_pending = (getattr(comm.qp, "tx_pending", None)
+                  if hasattr(comm, "qp") else None)
+    if tx_pending is None:
+        return
+    deadline = _time.monotonic() + timeout_s
+    while tx_pending() > 0:
+        comm._pump()
+        if extra_pump is not None:
+            extra_pump()
+        if _time.monotonic() >= deadline:
+            raise TimeoutError(f"tx flush: {what}; bytes still queued "
+                               f"after {timeout_s}s")
+        _time.sleep(0.0002)
+
+
+_RDMA_SETUP_TAG = 0x52444D41  # "RDMA": rkey-exchange tag namespace
+
+
+def _rdma_ring_state(net, send_comm, recv_comm, cap: int):
+    """Per-connection one-sided ring state, cached on the recv comm.
+
+    Layout of MY inbound data MR (registered on recv_comm, written by the
+    predecessor): ``[slot0: cap][slot1: cap][flag0: 8][flag1: 8]`` — the
+    writer puts a chunk into slot h%2 then puts the hop number h into
+    flag h%2 (same connection, so the data write is visible before the
+    doorbell). MY credit MR (on send_comm, written by the successor) holds
+    the last hop number the successor consumed; with 2 slots the writer
+    stalls until ``consumed >= h - 2`` before reusing a slot.
+
+    MR registration is bump-allocated for the connection's life, so the
+    state is cached per (comm pair, capacity) and capacities round up to a
+    power of two — re-registration happens only on growth.
+    """
+    cap = 1 << max(6, (cap - 1).bit_length())  # pow2, >= 64 B
+    state = getattr(recv_comm, "_rdma_ring", None)
+    if state is not None and state["cap"] >= cap:
+        return state
+    data_mr = net.alloc_mr(recv_comm, 2 * cap + 16)
+    credit_mr = net.alloc_mr(send_comm, 8)
+    req = net.irecv(send_comm, 8, tag=_RDMA_SETUP_TAG)
+    net.isend(recv_comm,
+              net.reg_mr(recv_comm, data_mr.rkey.to_bytes(8, "little")),
+              tag=_RDMA_SETUP_TAG)
+    peer_data_rkey = int.from_bytes(req.wait(), "little")
+    req = net.irecv(recv_comm, 8, tag=_RDMA_SETUP_TAG)
+    net.isend(send_comm,
+              net.reg_mr(send_comm, credit_mr.rkey.to_bytes(8, "little")),
+              tag=_RDMA_SETUP_TAG)
+    peer_credit_rkey = int.from_bytes(req.wait(), "little")
+    state = {"cap": cap, "data_mr": data_mr, "credit_mr": credit_mr,
+             "peer_data_rkey": peer_data_rkey,
+             "peer_credit_rkey": peer_credit_rkey, "hop": 0}
+    recv_comm._rdma_ring = state
+    return state
+
+
+def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
+                        rank: int, n_ranks: int, op: str = "sum",
+                        timeout_s: float = 30.0) -> np.ndarray:
+    """Ring allreduce whose DATA PATH is one-sided RDMA writes.
+
+    The put-based ring of real RDMA transports: each hop writes its chunk
+    straight into the successor's registered MR, then writes the hop number
+    as a doorbell flag; the receiver polls the flag, consumes, and writes a
+    credit back into the predecessor's MR so slots recycle safely (2-slot
+    double buffering). No posted receives and no recv CQEs on the data
+    path — only the one-time rkey exchange uses send/recv. Works on both
+    host planes: shm (direct memcpy through the shared arena, fenced) and
+    TCP (soft-NIC frames applied by the target's progress engine).
+    """
+    import time as _time
+
+    x = np.array(local, copy=True).ravel()
+    n = n_ranks
+    if n == 1:
+        return x.reshape(np.shape(local))
+    combine = _NET_REDUCE_OPS[op]
+    bounds = [len(x) * i // n for i in range(n + 1)]
+    chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
+    cap = max(chunk(i).nbytes for i in range(n))
+    st = _rdma_ring_state(net, send_comm, recv_comm, cap)
+    cap = st["cap"]
+    data_mr, credit_mr = st["data_mr"], st["credit_mr"]
+    send_pump = getattr(send_comm, "_pump", None)
+    recv_pump = getattr(recv_comm, "_pump", None)
+
+    def put(hop: int, out: np.ndarray) -> None:
+        # wait for slot credit, then data -> slot, doorbell -> flag.
+        # BOTH comms must pump while waiting: our own ACK to the
+        # predecessor may still sit in the recv comm's tx queue, and if
+        # every rank waits for credit while pumping only its send comm,
+        # no ACK ever flushes and the ring deadlocks globally.
+        deadline = _time.monotonic() + timeout_s
+        while hop > 2:
+            consumed = int.from_bytes(
+                net.read_mr_local(send_comm, credit_mr, 0, 8), "little")
+            if consumed >= hop - 2:
+                break
+            if recv_pump is not None:
+                recv_pump()
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("rdma ring: successor stopped consuming")
+            _time.sleep(0.0002)
+        slot = hop % 2
+        net.iwrite(send_comm, st["peer_data_rkey"], memoryview(out),
+                   offset=slot * cap)
+        net.iwrite(send_comm, st["peer_data_rkey"],
+                   hop.to_bytes(8, "little"), offset=2 * cap + 8 * slot)
+
+    def take(hop: int, nbytes: int) -> np.ndarray:
+        slot = hop % 2
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            flag = int.from_bytes(
+                net.read_mr_local(recv_comm, data_mr, 2 * cap + 8 * slot, 8),
+                "little")
+            if flag == hop:
+                break
+            if send_pump is not None:  # keep our own outbound flowing
+                send_pump()
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("rdma ring: predecessor's doorbell never rang")
+            _time.sleep(0.0002)
+        payload = net.read_mr_local(recv_comm, data_mr, slot * cap, nbytes)
+        # ack: predecessor may now reuse this slot
+        net.iwrite(recv_comm, st["peer_credit_rkey"],
+                   hop.to_bytes(8, "little"), offset=0)
+        return np.frombuffer(payload, np.uint8)
+
+    hop = st["hop"]
+    for k in range(n - 1):  # reduce-scatter phase
+        hop += 1
+        send_i, recv_i = rank - k, rank - k - 1
+        put(hop, _as_bytes(chunk(send_i)))
+        incoming = take(hop, chunk(recv_i).nbytes)
+        combine(chunk(recv_i), incoming.view(x.dtype), out=chunk(recv_i))
+    for k in range(n - 1):  # allgather phase
+        hop += 1
+        send_i, recv_i = rank + 1 - k, rank - k
+        put(hop, _as_bytes(chunk(send_i)))
+        incoming = take(hop, chunk(recv_i).nbytes)
+        chunk(recv_i)[:] = incoming.view(x.dtype)
+    st["hop"] = hop
+    # Flush BOTH comms' queued tx before returning: our final put (and the
+    # last credit ack) are fire-and-forget, and once a rank's own take is
+    # satisfied nothing else pumps — a fast rank would exit holding the
+    # slow rank's last hop in its user-space queue (observed at 16 MB:
+    # rank 0 finishes correct in 0.13 s, rank 1 times out on the doorbell
+    # with 3.2 MB stranded in rank 0's send queue).
+    for comm in (send_comm, recv_comm):
+        _flush_tx(comm, timeout_s, what="rdma ring: peer stopped draining at exit")
+    return x.reshape(np.shape(local))
 
 
 def ring_allgather_over_net(net, send_comm, recv_comm, local: np.ndarray,

@@ -248,6 +248,33 @@ def test_split_opt_out(sidecar_store):
     assert res[0] == 2.0 and res[1] == 2.0 and res[2] is None
 
 
+def test_shm_plane(sidecar_store):
+    """The intra-node wire: ring over shared-memory QPs, store still TCP."""
+    n = 3
+    store = sidecar_store(n)
+    xs = [np.full(2048, float(r + 1), np.float32) for r in range(n)]
+
+    def fn(pg):
+        assert pg.plane == "shm"
+        out = pg.all_reduce(xs[pg.rank])
+        sub = pg.split(color=0)  # sub-groups inherit the plane
+        try:
+            assert sub.plane == "shm"
+        finally:
+            sub.destroy()
+        return out
+
+    res = _run_group(n, fn, store_handle=store.handle, plane="shm")
+    want = np.sum(xs, axis=0)
+    for r in res:
+        np.testing.assert_array_equal(r, want)
+
+
+def test_bad_plane_raises():
+    with pytest.raises(ValueError, match="unknown plane"):
+        dist.init_process_group(rank=0, world_size=1, plane="infiniband")
+
+
 def test_two_groups_share_sidecar_store(sidecar_store):
     """Distinct group_names keep barriers/rings independent on one store."""
     n = 2
