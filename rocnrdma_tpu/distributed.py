@@ -80,6 +80,7 @@ class ProcessGroup:
             raise
         self._barrier_no = 0
         self._split_no = 0
+        self._shrink_no = 0
         self._destroyed = False
         self._store_handle = store_handle
 
@@ -215,22 +216,79 @@ class ProcessGroup:
             None, timeout_s, f"{self.group_name}/s{self._split_no}c{color}",
             plane=self.plane)
 
+    def shrink(self, grace_s: float = 2.0,
+               timeout_s: float = 30.0) -> "ProcessGroup":
+        """Elastic recovery: rebuild a working group from the SURVIVING
+        ranks after a failure (typically after ``monitored_barrier`` raised
+        naming the dead). Every survivor calls ``shrink``; each publishes
+        liveness, waits the grace window, the lowest surviving rank
+        proposes the member list, and a fresh re-ranked group is wired over
+        the same store. Raises for a rank that arrives after the window
+        closed (it must exit — the group has moved on).
+
+        The rendezvous store must still be reachable: run it as a sidecar
+        (or on a rank you trust to live) if you need elasticity — losing
+        the store host loses the group, the same root-of-bootstrap property
+        the reference stack's NCCL-style rendezvous has. Destroy the old
+        group afterwards with ``destroy(graceful=False)`` (a graceful
+        destroy would wait on the dead)."""
+        if self._destroyed:
+            raise RuntimeError("cannot shrink a destroyed group")
+        self._shrink_no += 1
+        if self.world_size == 1 or self._client is None:
+            raise RuntimeError("nothing to shrink: single-rank group")
+        import json
+        import time
+        ns = f"pg/{self.group_name}/shrink{self._shrink_no}"
+        self._client.set(f"{ns}/alive/{self.rank}", "1")
+        time.sleep(grace_s)
+        members_key = f"{ns}/members"
+        alive = []
+        for r in range(self.world_size):
+            try:
+                self._client.get(f"{ns}/alive/{r}", timeout_s=0.0)
+                alive.append(r)
+            except TimeoutError:
+                pass
+        if self.rank == min(alive):
+            # first-writer-wins: with skewed entry two ranks can each think
+            # themselves the minimum survivor; set-if-absent makes exactly
+            # one proposal stick, and the loser adopts it (split-brain —
+            # two ranks proceeding with different member lists — cannot
+            # happen; a rank missing from the winning list raises below)
+            self._client.set_if_absent(members_key, json.dumps(alive))
+        members = json.loads(self._client.get(members_key, timeout_s))
+        if self.rank not in members:
+            raise RuntimeError(
+                f"rank {self.rank} missed the shrink window; group "
+                f"re-formed as {members} without it — exit")
+        # in master mode this rank may own the store: hand it to the new
+        # group, or destroying the old one would cut every survivor off
+        server, self._server = self._server, None
+        return ProcessGroup(
+            members.index(self.rank), len(members), self._store_handle,
+            server, timeout_s, f"{self.group_name}/shrunk{self._shrink_no}",
+            plane=self.plane)
+
     # -- lifecycle ---------------------------------------------------------
 
-    def destroy(self) -> None:
+    def destroy(self, graceful: bool = True) -> None:
         """Orderly teardown: every rank arrives at a final store barrier and
         says goodbye to the store BEFORE rank 0 closes it (otherwise a peer
         whose last barrier poll is still in flight gets its RPC cut — the
-        classic master-exits-first shutdown race)."""
+        classic master-exits-first shutdown race). ``graceful=False`` skips
+        the barrier — for tearing down a group whose peers are known dead
+        (after ``shrink``), where waiting would only burn the timeout."""
         if self._destroyed:
             return
         self._destroyed = True
         if self._client is not None:
-            try:
-                self._client.barrier(f"pg/{self.group_name}/destroy",
-                                     self.world_size, timeout_s=10.0)
-            except (OSError, TimeoutError):
-                pass  # peers may have crashed; teardown must still complete
+            if graceful:
+                try:
+                    self._client.barrier(f"pg/{self.group_name}/destroy",
+                                         self.world_size, timeout_s=10.0)
+                except (OSError, TimeoutError):
+                    pass  # peers may have crashed; teardown must complete
             self._client.close()
         self._net.close()
         if self._server is not None:

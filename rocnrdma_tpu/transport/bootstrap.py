@@ -82,6 +82,11 @@ class BootstrapServer:
             if op == "set":
                 self._kv[req["key"]] = req["value"]
                 return {"ok": True}
+            if op == "setnx":  # set-if-absent: first writer wins, atomically
+                if req["key"] in self._kv:
+                    return {"ok": False, "value": self._kv[req["key"]]}
+                self._kv[req["key"]] = req["value"]
+                return {"ok": True, "value": req["value"]}
             if op == "get":
                 if req["key"] in self._kv:
                     return {"ok": True, "value": self._kv[req["key"]]}
@@ -135,6 +140,11 @@ class BootstrapClient:
         resp = self._rpc(op="set", key=key, value=value)
         if not resp.get("ok"):
             raise OSError(f"bootstrap set({key!r}) failed: {resp}")
+
+    def set_if_absent(self, key: str, value: str) -> str:
+        """Atomic first-writer-wins: returns the value actually stored
+        (ours if we won the race, the incumbent's otherwise)."""
+        return self._rpc(op="setnx", key=key, value=value)["value"]
 
     def get(self, key: str, timeout_s: float = 30.0) -> str:
         """Blocking get: polls until the key appears."""

@@ -709,6 +709,12 @@ def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
     data_mr, credit_mr = st["data_mr"], st["credit_mr"]
     send_pump = getattr(send_comm, "_pump", None)
     recv_pump = getattr(recv_comm, "_pump", None)
+    pending: list = []  # outstanding one-sided Requests, probed in waits
+
+    def probe_pending() -> None:
+        # surfaces a remote ERR_REMOTE denial (raised by test()) instead of
+        # letting it rot in the CQE cache until a misleading timeout
+        pending[:] = [r for r in pending if not r.test()[0]]
 
     def put(hop: int, out: np.ndarray) -> None:
         # wait for slot credit, then data -> slot, doorbell -> flag.
@@ -724,14 +730,16 @@ def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
                 break
             if recv_pump is not None:
                 recv_pump()
+            probe_pending()
             if _time.monotonic() >= deadline:
                 raise TimeoutError("rdma ring: successor stopped consuming")
             _time.sleep(0.0002)
         slot = hop % 2
-        net.iwrite(send_comm, st["peer_data_rkey"], memoryview(out),
-                   offset=slot * cap)
-        net.iwrite(send_comm, st["peer_data_rkey"],
-                   hop.to_bytes(8, "little"), offset=2 * cap + 8 * slot)
+        pending.append(net.iwrite(send_comm, st["peer_data_rkey"],
+                                  memoryview(out), offset=slot * cap))
+        pending.append(net.iwrite(send_comm, st["peer_data_rkey"],
+                                  hop.to_bytes(8, "little"),
+                                  offset=2 * cap + 8 * slot))
 
     def take(hop: int, nbytes: int) -> np.ndarray:
         slot = hop % 2
@@ -744,13 +752,14 @@ def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
                 break
             if send_pump is not None:  # keep our own outbound flowing
                 send_pump()
+            probe_pending()
             if _time.monotonic() >= deadline:
                 raise TimeoutError("rdma ring: predecessor's doorbell never rang")
             _time.sleep(0.0002)
         payload = net.read_mr_local(recv_comm, data_mr, slot * cap, nbytes)
         # ack: predecessor may now reuse this slot
-        net.iwrite(recv_comm, st["peer_credit_rkey"],
-                   hop.to_bytes(8, "little"), offset=0)
+        pending.append(net.iwrite(recv_comm, st["peer_credit_rkey"],
+                                  hop.to_bytes(8, "little"), offset=0))
         return np.frombuffer(payload, np.uint8)
 
     hop = st["hop"]

@@ -300,6 +300,143 @@ def test_init_failure_frees_master_port():
         s.bind(("127.0.0.1", port))
 
 
+def test_shrink_after_rank_death(sidecar_store):
+    """Elastic recovery: rank 1 vanishes; survivors detect it, shrink, and
+    keep computing in a re-ranked 2-rank group."""
+    n = 3
+    store = sidecar_store(n)
+    xs = [np.array([1.0, 10.0, 100.0], np.float32) * (r + 1) for r in range(n)]
+
+    def fn(pg):
+        if pg.rank == 1:
+            return "dead"  # simulated crash: never participates again
+        try:
+            pg.monitored_barrier(timeout_s=2.0)
+        except TimeoutError:
+            pass  # learned someone is missing
+        sub = pg.shrink(grace_s=1.0)
+        try:
+            assert sub.world_size == 2
+            assert sub.rank == (0 if pg.rank == 0 else 1)
+            out = sub.all_reduce(xs[pg.rank])
+            sub.barrier()
+            return out
+        finally:
+            sub.destroy()
+            pg.destroy(graceful=False)
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    want = xs[0] + xs[2]  # survivors only
+    np.testing.assert_array_equal(res[0], want)
+    assert res[1] == "dead"
+    np.testing.assert_array_equal(res[2], want)
+
+
+def test_shrink_skewed_entry_no_split_brain(sidecar_store):
+    """A survivor arriving after the window closed is EXCLUDED (raises),
+    never split-brained into a parallel group: first proposal wins via
+    set-if-absent."""
+    import time as _t
+    n = 3
+    store = sidecar_store(n)
+
+    def fn(pg):
+        if pg.rank == 1:
+            return "dead"
+        if pg.rank == 0:
+            _t.sleep(3.0)  # rank 0 is late; rank 2's window already closed
+        try:
+            sub = pg.shrink(grace_s=0.5)
+        except RuntimeError as e:
+            return f"excluded: {e}"
+        try:
+            return list(range(sub.world_size))
+        finally:
+            sub.destroy(graceful=False)
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res[1] == "dead"
+    assert res[2] == [0]          # rank 2 re-formed alone
+    assert "excluded" in res[0]   # rank 0 told to exit, not split-brained
+
+
+def test_set_if_absent_first_writer_wins(sidecar_store):
+    store = sidecar_store(1)
+    c = bootstrap.BootstrapClient(store.handle, rank=0)
+    assert c.set_if_absent("k", "first") == "first"
+    assert c.set_if_absent("k", "second") == "first"
+    assert c.get("k") == "first"
+    c.close()
+
+
+def test_shrink_single_rank_raises():
+    pg = dist.init_process_group(rank=0, world_size=1)
+    with pytest.raises(RuntimeError, match="nothing to shrink"):
+        pg.shrink()
+    pg.destroy()
+
+
+def test_shrink_real_process_killed(tmp_path):
+    """The real thing: SIGKILL one worker mid-job; survivors shrink and
+    finish with a correct reduced result."""
+    import signal
+    import subprocess
+    import sys
+    import time as _t
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    n = 3
+    script = tmp_path / "elastic.py"
+    script.write_text("""
+import sys, time
+import numpy as np
+from rocnrdma_tpu import distributed as dist
+
+pg = dist.init_process_group()
+pg.barrier()           # everyone alive and wired
+if pg.rank == 1:
+    open(sys.argv[1], "w").write("parked")   # tell the test to shoot now
+    time.sleep(120)    # parked until SIGKILLed by the test
+try:
+    pg.monitored_barrier(timeout_s=6.0)
+except TimeoutError as e:
+    print("rank", pg.rank, "detected:", e, flush=True)
+sub = pg.shrink(grace_s=2.0)
+out = sub.all_reduce(np.full(5, float(pg.rank + 1), np.float32))
+sub.barrier()
+sub.destroy()
+pg.destroy(graceful=False)
+assert np.all(out == 4.0), out   # ranks 0 and 2: 1 + 3
+print("rank", pg.rank, "recovered ok", flush=True)
+""")
+    park = tmp_path / "parked"
+    procs = []
+    for r in range(n):
+        import os
+        env = dict(os.environ, RANK=str(r), WORLD_SIZE=str(n),
+                   MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(park)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    try:
+        # kill rank 1 only once it is wired and parked (it signals by file)
+        deadline = _t.monotonic() + 60
+        while not park.exists():
+            assert _t.monotonic() < deadline, "rank 1 never parked"
+            _t.sleep(0.1)
+        procs[1].send_signal(signal.SIGKILL)
+        for r in (0, 2):
+            out, _ = procs[r].communicate(timeout=90)
+            assert procs[r].returncode == 0, f"rank {r}:\n{out}"
+            assert f"rank {r} recovered ok" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 _WORKER = """
 import sys
 import numpy as np
