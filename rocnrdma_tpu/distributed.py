@@ -150,6 +150,21 @@ class ProcessGroup:
         return self._ring(plugin.ring_alltoall_over_net, x, self.rank,
                           self.world_size)
 
+    def all_to_all_v(self, segments: list, counts, dtype="float32") -> list:
+        """Variable-count alltoall (the RCCL ``ncclAllToAllv`` extension):
+        ``segments[j]`` (``counts[self.rank, j]`` elements) goes to rank j;
+        returns the n received segments in source order. ``counts`` is the
+        full (n, n) element-count matrix, identical on every rank.
+        ``dtype`` is the wire dtype and MUST be passed explicitly when not
+        float32 — inferring it per rank from the segments would let ranks
+        disagree on itemsize (an empty list infers float64) and desync the
+        exchange byte counts."""
+        # world_size == 1 still routes through the plugin so counts/segment
+        # validation behaves identically to multi-rank runs
+        return self._ring(plugin.ring_alltoallv_over_net, segments,
+                          np.asarray(counts), self.rank, self.world_size,
+                          dtype=dtype)
+
     def barrier(self, timeout_s: float = 30.0) -> None:
         """Block until every rank arrives."""
         if self.world_size == 1:

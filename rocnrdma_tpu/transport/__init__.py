@@ -23,6 +23,8 @@ from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     TCPNet,
     ring_allgather_over_net,
     ring_allreduce_over_net,
+    ring_allreduce_rdma,
+    ring_alltoallv_over_net,
     ring_reduce_scatter_over_net,
     ring_alltoall_over_net,
     ring_broadcast_over_net,

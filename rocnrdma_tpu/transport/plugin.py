@@ -841,6 +841,55 @@ def ring_broadcast_over_net(net, send_comm, recv_comm, local: np.ndarray,
     return np.array(local, copy=True)
 
 
+def ring_alltoallv_over_net(net, send_comm, recv_comm, segments: list,
+                            counts: np.ndarray, rank: int, n_ranks: int,
+                            dtype=np.float32) -> list:
+    """Variable-count alltoall (the RCCL ``ncclAllToAllv`` extension beyond
+    stock NCCL): rank r sends ``segments[j]`` — ``counts[r, j]`` elements —
+    to rank j and receives ``counts[src, rank]`` elements from every src.
+    ``counts`` is the full (n, n) element-count matrix, known on every rank
+    (the MPI alltoallv contract), so only actual bytes travel — no padding
+    to a global max. Returns the n received segments in source order
+    (``out[rank]`` is the local segment).
+
+    Same train schedule as :func:`ring_alltoall_over_net`, with ragged
+    cars: every rank launches its n-1 outbound segments in travel order;
+    at hop s the arriving train originated at rank-s, its head car is
+    addressed to us (``counts[rank-s, rank]`` elements), and the rest is
+    forwarded. Each hop's train length is computable from ``counts`` alone.
+    """
+    n = n_ranks
+    dtype = np.dtype(dtype)
+    counts = np.asarray(counts, dtype=np.int64)
+    if counts.shape != (n, n):
+        raise ValueError(f"counts must be ({n}, {n}), got {counts.shape}")
+    if len(segments) != n:
+        raise ValueError(f"need {n} segments, got {len(segments)}")
+    segs = [np.ascontiguousarray(s, dtype=dtype).ravel() for s in segments]
+    for j, seg in enumerate(segs):
+        if seg.size != counts[rank, j]:
+            raise ValueError(
+                f"segment {j} has {seg.size} elements, "
+                f"counts[{rank}, {j}] says {counts[rank, j]}")
+    out: list = [None] * n
+    out[rank] = segs[rank].copy()
+    if n == 1:
+        return out
+    wire = _RingWire(net, send_comm, recv_comm)
+    isz = dtype.itemsize
+    train = np.concatenate(
+        [_as_bytes(segs[(rank + off) % n]) for off in range(1, n)])
+    for s in range(1, n):
+        o = (rank - s) % n  # the arriving train's origin
+        in_bytes = int(sum(counts[o, (o + off) % n]
+                           for off in range(s, n))) * isz
+        incoming = wire.exchange(train, in_bytes)
+        head = int(counts[o, rank]) * isz
+        out[o] = incoming[:head].view(dtype).copy()
+        train = incoming[head:]  # forward the rest at the next hop
+    return out
+
+
 def ring_alltoall_over_net(net, send_comm, recv_comm, local: np.ndarray,
                            rank: int, n_ranks: int) -> np.ndarray:
     """Shift alltoall over the verbs: ``local`` is ``(n, ...)`` — block d is

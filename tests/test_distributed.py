@@ -112,6 +112,29 @@ def test_gather_scatter_broadcast_alltoall(sidecar_store):
             a2a, np.stack([mats[src][r] for src in range(n)]))
 
 
+def test_all_to_all_v(sidecar_store):
+    n = 3
+    store = sidecar_store(n)
+    rng = np.random.default_rng(8)
+    counts = rng.integers(1, 9, size=(n, n))
+    segs = {r: [rng.standard_normal(counts[r, j]).astype(np.float32)
+                for j in range(n)] for r in range(n)}
+    res = _run_group(n, lambda pg: pg.all_to_all_v(segs[pg.rank], counts),
+                     store_handle=store.handle)
+    for r in range(n):
+        for src in range(n):
+            np.testing.assert_array_equal(res[r][src], segs[src][r])
+
+
+def test_all_to_all_v_single_rank_still_validates():
+    pg = dist.init_process_group(rank=0, world_size=1)
+    out = pg.all_to_all_v([np.arange(3.0, dtype=np.float32)], [[3]])
+    np.testing.assert_array_equal(out[0], [0.0, 1.0, 2.0])
+    with pytest.raises(ValueError, match="elements"):
+        pg.all_to_all_v([np.arange(3.0, dtype=np.float32)], [[5]])
+    pg.destroy()
+
+
 def test_reduce_scatter_composes_with_all_gather(sidecar_store):
     n = 4
     store = sidecar_store(n)

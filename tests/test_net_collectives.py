@@ -129,6 +129,40 @@ def test_large_hop_exceeding_kernel_buffers():
 
 @needs_native
 @pytest.mark.parametrize("net_cls", PLANES)
+@pytest.mark.parametrize("n", [2, 3, 4])
+def test_alltoallv_over_net(net_cls, n):
+    """Ragged counts, including empty segments."""
+    from rocnrdma_tpu.transport.plugin import ring_alltoallv_over_net
+
+    rng = np.random.default_rng(6)
+    counts = rng.integers(0, 23, size=(n, n))
+    counts[0, -1] = 0  # an empty lane
+    segs = {r: [rng.standard_normal(counts[r, j]).astype(np.float32)
+                for j in range(n)] for r in range(n)}
+    res = _run_ring(net_cls, n, lambda net, s, r, rank:
+                    ring_alltoallv_over_net(net, s, r, segs[rank], counts,
+                                            rank, n))
+    for r in range(n):
+        for src in range(n):
+            np.testing.assert_array_equal(res[r][src], segs[src][r])
+
+
+@needs_native
+def test_alltoallv_validates_counts():
+    from rocnrdma_tpu.transport.plugin import ring_alltoallv_over_net
+
+    def fn(net, s, r, rank):
+        with pytest.raises(ValueError, match="elements"):
+            ring_alltoallv_over_net(
+                net, s, r, [np.zeros(3, np.float32)] * 2,
+                np.array([[1, 2], [3, 4]]), rank, 2)
+        return True
+
+    assert all(_run_ring(TCPNet, 2, fn))
+
+
+@needs_native
+@pytest.mark.parametrize("net_cls", PLANES)
 def test_sequential_collectives_share_comms(net_cls):
     """Back-to-back collectives on the same comms must not cross tags."""
     n = 3
