@@ -290,6 +290,14 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                               f"VMEM-resident (cap {PALLAS_VMEM_CAP} B/rank)",
                               file=sys.stderr)
                         continue
+                    if (algo.startswith("pallas")
+                            and collective == "reducescatter"
+                            and (actual // np.dtype(dtype).itemsize)
+                            % (pre.n_ranks * 128) != 0):
+                        print(f"# skip {algo} at {actual} B: reduce-scatter "
+                              f"kernel needs size % (n*128) elems == 0",
+                              file=sys.stderr)
+                        continue
                     fn = t.jit_fn(_OP[collective], algo, **knobs)
                     r1 = None
                     if args.paranoid:

@@ -11,4 +11,5 @@ completion polling becomes semaphore waits.
 from rocnrdma_tpu.ops.ring_pallas import (  # noqa: F401
     pallas_ring_allgather,
     pallas_ring_allreduce,
+    pallas_ring_reduce_scatter,
 )

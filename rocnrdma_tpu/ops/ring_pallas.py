@@ -111,6 +111,18 @@ def _ring_allreduce_kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem,
                n=n, axis_name=axis_name, hops=hops)
 
 
+def _ring_reduce_scatter_kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem,
+                                caps_sem, *, n: int, axis_name: str):
+    my = lax.axis_index(axis_name)
+    o_ref[:] = x_ref[:]
+    _neighbour_barrier(axis_name, n)
+    # the -1-shifted reduce phase: after n-1 accumulate hops, chunk ``my``
+    # is fully reduced on rank ``my`` (see collectives/ring.py's offset note)
+    hops = [((my - s - 1) % n, (my - s - 2) % n, True) for s in range(n - 1)]
+    _ring_hops(o_ref, comm_buf, send_sem, recv_sem, caps_sem,
+               n=n, axis_name=axis_name, hops=hops)
+
+
 def _ring_allgather_kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem,
                            caps_sem, *, n: int, axis_name: str):
     my = lax.axis_index(axis_name)
@@ -143,6 +155,28 @@ def _pad_chunks(x: jax.Array, n: int, lanes: int = 128):
     return flat.reshape(n, per // lanes, lanes), size
 
 
+def _ring_call(kernel, buf: jax.Array, slot_shape: tuple, collective_id: int,
+               out_shape: tuple, interpret: bool | None):
+    """The shared pallas_call plumbing of every ring kernel here: one VMEM
+    in/out pair, a 2-slot comm buffer, send/recv DMA semaphores and the
+    credit semaphore (the double-buffer protocol `_ring_hops` implements —
+    change it HERE and in `_ring_hops` together)."""
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(out_shape, buf.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + slot_shape, buf.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=collective_id),
+        interpret=_interpret_mode(interpret),
+    )(buf)
+
+
 def pallas_ring_allreduce(x: jax.Array, axis_name: str,
                           interpret: bool | None = None) -> jax.Array:
     """Allreduce (sum) over the ``axis_name`` ring, remote-DMA data plane.
@@ -156,22 +190,32 @@ def pallas_ring_allreduce(x: jax.Array, axis_name: str,
         return x
     buf, size = _pad_chunks(x, n)
     kern = functools.partial(_ring_allreduce_kernel, n=n, axis_name=axis_name)
-    interp = _interpret_mode(interpret)
-    out = pl.pallas_call(
-        kern,
-        out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((2,) + buf.shape[1:], buf.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.REGULAR((2,)),
-        ],
-        compiler_params=pltpu.CompilerParams(collective_id=0),
-        interpret=interp,
-    )(buf)
+    out = _ring_call(kern, buf, buf.shape[1:], 0, buf.shape, interpret)
     return out.reshape(-1)[:size].reshape(x.shape)
+
+
+def pallas_ring_reduce_scatter(x: jax.Array, axis_name: str,
+                               interpret: bool | None = None) -> jax.Array:
+    """Reduce-scatter (sum) over the ring: rank r returns the fully-reduced
+    r-th 1/n of the flattened buffer (the layout `Transport.reduce_scatter`
+    expects). Needs ``x.size`` divisible by ``n * 128``: the kernel's comm
+    chunks are lane-padded in place, so an unaligned size would shift chunk
+    boundaries away from the semantic 1/n splits."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x.reshape(-1)
+    size = x.size
+    if size % (n * 128) != 0:
+        raise ValueError(
+            f"pallas_ring reduce_scatter needs size % (n*128) == 0, got "
+            f"size={size}, n={n} (pad at the caller)")
+    buf, _ = _pad_chunks(x, n)
+    kern = functools.partial(_ring_reduce_scatter_kernel, n=n,
+                             axis_name=axis_name)
+    out = _ring_call(kern, buf, buf.shape[1:], 2, buf.shape, interpret)
+    my = lax.axis_index(axis_name)
+    return lax.dynamic_index_in_dim(out, my, axis=0,
+                                    keepdims=False).reshape(-1)
 
 
 def pallas_ring_allgather(x: jax.Array, axis_name: str,
@@ -183,19 +227,6 @@ def pallas_ring_allgather(x: jax.Array, axis_name: str,
     chunk, size = _pad_chunks(x, 1)
     chunk = chunk[0]
     kern = functools.partial(_ring_allgather_kernel, n=n, axis_name=axis_name)
-    interp = _interpret_mode(interpret)
-    out = pl.pallas_call(
-        kern,
-        out_shape=jax.ShapeDtypeStruct((n,) + chunk.shape, chunk.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((2,) + chunk.shape, chunk.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.REGULAR((2,)),
-        ],
-        compiler_params=pltpu.CompilerParams(collective_id=1),
-        interpret=interp,
-    )(chunk)
+    out = _ring_call(kern, chunk, chunk.shape, 1, (n,) + chunk.shape,
+                     interpret)
     return out.reshape(n, -1)[:, :size].reshape((n,) + x.shape)

@@ -75,6 +75,9 @@ SCHEDULES = {
             C.fused_reduce_scatter(v, fused_axes, op=op),
         "ring": lambda v, _, op="sum", root=0:
             C.ring_reduce_scatter(v, RANK_AXIS, op=op),
+        "pallas_ring": lambda v, _, op="sum", root=0:
+            _pallas().pallas_ring_reduce_scatter(v, RANK_AXIS) if op == "sum"
+            else _raise(f"pallas_ring reduce_scatter is sum-only, got op={op!r}"),
     },
     "allgather": {
         "fused": lambda v, fused_axes, op="sum", root=0:

@@ -63,6 +63,7 @@ _MODEL = {
     ("allreduce", "dtree"): lambda n: (8 * _L(n), 2.0),
     ("allreduce", "pallas_ring"): lambda n: (2 * (n - 1), 2 * (n - 1) / n),
     ("reduce_scatter", "ring"): lambda n: (n - 1, (n - 1) / n),
+    ("reduce_scatter", "pallas_ring"): lambda n: (n - 1, (n - 1) / n),
     ("allgather", "ring"): lambda n: (n - 1, (n - 1) / n),
     ("allgather", "pallas_ring"): lambda n: (n - 1, (n - 1) / n),
     ("alltoall", "ring"): lambda n: (n - 1, (n - 1) / n),   # rotation

@@ -7,7 +7,11 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from rocnrdma_tpu import runtime as rt
-from rocnrdma_tpu.ops import pallas_ring_allgather, pallas_ring_allreduce
+from rocnrdma_tpu.ops import (
+    pallas_ring_allgather,
+    pallas_ring_allreduce,
+    pallas_ring_reduce_scatter,
+)
 from rocnrdma_tpu.transport import Transport
 
 RANK = rt.mesh.RANK_AXIS
@@ -66,3 +70,31 @@ def test_pallas_rejected_on_2d_mesh(devices):
     t = Transport(rt.slice_mesh(2, 4))
     with pytest.raises(ValueError):
         t.allreduce(np.zeros((2, 4, 8), np.float32), "pallas_ring")
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_pallas_reduce_scatter(devices, n):
+    x = np.random.default_rng(n).standard_normal(
+        (n, n * 2 * 128)).astype(np.float32)  # n*128-aligned
+    f = _shmap(lambda s: pallas_ring_reduce_scatter(s[0], RANK)[None], n)
+    out = np.asarray(f(x))
+    want = x.sum(axis=0).reshape(n, -1)  # rank r keeps shard r
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_reduce_scatter_rejects_unaligned(devices):
+    x = np.zeros((4, 1000), np.float32)
+    with pytest.raises(ValueError, match="n\\*128"):
+        f = _shmap(lambda s: pallas_ring_reduce_scatter(s[0], RANK)[None], 4)
+        f(x)
+
+
+def test_pallas_reduce_scatter_via_transport(devices):
+    t = Transport(rt.rank_mesh(4))
+    x = np.random.default_rng(0).standard_normal(
+        (4, 4 * 128)).astype(np.float32)
+    out = np.asarray(t.reduce_scatter(t.shard(x), algo="pallas_ring"))
+    np.testing.assert_allclose(out, x.sum(axis=0).reshape(4, -1),
+                               rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="sum-only"):
+        t.reduce_scatter(t.shard(x), algo="pallas_ring", op="max")
