@@ -7,8 +7,9 @@ and the explicit bidirectional ring — mirroring the Transport's selection
 policy; the winner is printed to stderr. On a single
 chip there is no wire, so the headline degrades to the on-chip half of the
 algorithm — the HBM-bound accumulate (2 reads + 1 write per element), the
-per-step kernel of the ring schedule — reported against the chip's HBM
-roofline so the number is honest about what it measures.
+per-step combine every implemented ring/tree schedule folds with — reported
+against the chip's HBM roofline so the number is honest about what it
+measures.
 
 Timing method: the op is chained K times inside ONE jitted ``lax.fori_loop``
 program and timed at two depths; the reported time is the marginal
@@ -162,7 +163,8 @@ def main() -> int:
         out = {"metric": "allreduce_busbw_GBps_per_chip", "value": round(value, 3),
                "unit": "GB/s", "vs_baseline": round(value / target, 4)}
     else:
-        # single chip: HBM-bound accumulate, the ring schedule's per-step kernel
+        # single chip: HBM-bound accumulate, the per-step combine kernel of
+        # the implemented ring/tree schedules (combine(mine, recvd))
         elems = (8 * M.MiB if on_cpu else 256 * M.MiB) // 4
         rng = np.random.default_rng(0)
         x0 = jnp.asarray(rng.standard_normal(size=(elems,), dtype=np.float32))
@@ -180,8 +182,9 @@ def main() -> int:
         # The depth gap must make device work dominate tunnel jitter: the
         # relayed backend adds ~90 ms fixed overhead per call fluctuating by
         # tens of ms, so a 20-op gap (~24 ms of device work) measured 271-721
-        # GB/s run-to-run. A 120-op gap (~145 ms of device work) is stable to
-        # <1% (measured 662-665 GB/s across trials on v5e).
+        # GB/s run-to-run. A 120-op gap (~145 ms of device work) measures
+        # 662-678 GB/s across whole runs (~1% within a speed mode;
+        # min-over-trials picks the fastest mode demonstrated).
         sec = _marginal_s_per_op(make_chain, (x0, b), k1=8, k2=128, repeats=5)
         moved = 3 * elems * 4  # 2 reads + 1 write per element
         value = moved / sec / 1e9
