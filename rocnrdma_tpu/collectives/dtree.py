@@ -28,8 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from rocnrdma_tpu.collectives.reduce_op import combine_fn, finalize
-from rocnrdma_tpu.collectives.schedule import dbtree_parents, dbtree_steps
+from rocnrdma_tpu.collectives.reduce_op import combine_fn, finalize, identity
+from rocnrdma_tpu.collectives.schedule import dbtree_parents, dbtree_up_levels
 
 
 def _dst_gate(n: int, pairs: list[tuple[int, int]], r: jax.Array) -> jax.Array:
@@ -51,13 +51,22 @@ def dbtree_allreduce(x: jax.Array, axis_name: str, op: str = "sum") -> jax.Array
     half = -(-size // 2)
     flat = jnp.pad(x.reshape(-1), (0, 2 * half - size))
     halves = [flat[:half], flat[half:]]
+    ident = identity(op, flat.dtype)
 
     for t, parents in enumerate(dbtree_parents(n)):
         h = halves[t]
-        up, down = dbtree_steps(parents)
-        for pairs in up:  # reduce toward the root
-            recvd = lax.ppermute(h, axis_name, perm=pairs)
-            h = jnp.where(_dst_gate(n, pairs, r), combine(h, recvd), h)
+        up_levels, down = dbtree_up_levels(parents)
+        for level in up_levels:  # reduce toward the root
+            # defer the combines: stash each substep's arrival (identity on
+            # non-receiving ranks), then fold the level in ONE elementwise
+            # pass — an interior node's two child contributions cost
+            # 3R+1W fused instead of two sequential 2R+1W passes
+            stashes = []
+            for pairs in level:
+                recvd = lax.ppermute(h, axis_name, perm=pairs)
+                stashes.append(jnp.where(_dst_gate(n, pairs, r), recvd, ident))
+            for s in stashes:
+                h = combine(h, s)
         for pairs in down:  # broadcast back down
             recvd = lax.ppermute(h, axis_name, perm=pairs)
             h = jnp.where(_dst_gate(n, pairs, r), recvd, h)

@@ -56,6 +56,26 @@ def combine_fn(op: str):
         raise ValueError(f"unknown reduce op {op!r}; know {REDUCE_OPS}") from None
 
 
+def identity(op: str, dtype) -> jax.Array:
+    """The op's identity element (combine(x, identity) == x) — what a
+    schedule substitutes for 'no contribution' when it defers/fuses combines
+    across partial-permute substeps."""
+    dtype = jnp.dtype(dtype)
+    if op in ("sum", "avg"):
+        return jnp.zeros((), dtype)
+    if op == "prod":
+        return jnp.ones((), dtype)
+    if op == "max":
+        # floats: -inf, NOT finfo.min — max(-inf, finfo.min) would clobber
+        # a legitimate -inf input (e.g. masked logits)
+        return jnp.asarray(-jnp.inf if jnp.issubdtype(dtype, jnp.floating)
+                           else jnp.iinfo(dtype).min, dtype)
+    if op == "min":
+        return jnp.asarray(jnp.inf if jnp.issubdtype(dtype, jnp.floating)
+                           else jnp.iinfo(dtype).max, dtype)
+    raise ValueError(f"unknown reduce op {op!r}; know {REDUCE_OPS}")
+
+
 def finalize(x: jax.Array, op: str, n_total: int) -> jax.Array:
     """Post-schedule fixup: ``avg`` divides the summed result by the total
     rank count once; every other op is already final."""

@@ -398,6 +398,22 @@ def dbtree_steps(parents: list[int]) -> tuple[
     return up, down
 
 
+def dbtree_up_levels(parents: list[int]) -> tuple[
+        list[list[list[tuple[int, int]]]], list[list[tuple[int, int]]]]:
+    """(up_levels, down): the up-phase substeps of ``dbtree_steps`` grouped
+    by tree level (deepest first) — each level holds 1-2 partial-permute
+    substeps whose receives a parent may DEFER and combine in one fused
+    pass — plus the unchanged down phase, so callers derive the schedule
+    once."""
+    depths = dbtree_depths(parents)
+    up, down = dbtree_steps(parents)
+    levels: dict[int, list] = {}
+    for pairs in up:
+        d = depths[pairs[0][0]]  # all of a substep's children share a depth
+        levels.setdefault(d, []).append(pairs)
+    return [levels[d] for d in sorted(levels, reverse=True)], down
+
+
 def sim_dbtree_allreduce(bufs: np.ndarray) -> np.ndarray:
     """Simulate the double-tree allreduce on (n, elems) rows (sum op)."""
     n = bufs.shape[0]

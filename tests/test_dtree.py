@@ -12,6 +12,7 @@ from rocnrdma_tpu.collectives.schedule import (
     dbtree_depths,
     dbtree_parents,
     dbtree_steps,
+    dbtree_up_levels,
     sim_dbtree_allreduce,
 )
 
@@ -90,6 +91,26 @@ def test_dbtree_steps_well_formed(n):
         assert all(depths[c] == depths[p] + 1 for pairs in up for c, p in pairs)
 
 
+@pytest.mark.parametrize("n", [2, 3, 5, 8, 16])
+def test_dbtree_up_levels_partition_steps(n):
+    """Levels hold the same substeps as the flat list, grouped by depth
+    (deepest first), so a parent's deferred combine sees both children."""
+    for parents in dbtree_parents(n):
+        up, down = dbtree_steps(parents)
+        levels, down2 = dbtree_up_levels(parents)
+        assert [p for lvl in levels for p in lvl] == up
+        assert down2 == down
+        depths = dbtree_depths(parents)
+        lvl_depths = [depths[lvl[0][0][0]] for lvl in levels]
+        assert lvl_depths == sorted(lvl_depths, reverse=True)
+        for lvl in levels:
+            assert 1 <= len(lvl) <= 2
+            # within a level, senders (children) never receive
+            senders = {c for pairs in lvl for c, _ in pairs}
+            receivers = {p for pairs in lvl for _, p in pairs}
+            assert not senders & receivers
+
+
 @pytest.mark.parametrize("n", [2, 3, 5, 8])
 def test_sim_dbtree_matches_sum(n):
     rng = np.random.default_rng(0)
@@ -123,6 +144,20 @@ def test_dbtree_allreduce_ops(devices, op, npf):
     x = (rng.normal(size=(n, 17)) + 2.0).astype(np.float32)  # positive: prod-safe
     want = np.broadcast_to(npf(x, axis=0), x.shape)
     np.testing.assert_allclose(_run(n, x, op=op), want, rtol=1e-4)
+
+
+def test_dbtree_max_preserves_infinities(devices):
+    """Regression: the deferred-combine identity must be -inf (not
+    finfo.min) or a legitimate all-rank -inf element gets clobbered."""
+    n = 5
+    x = np.full((n, 8), -np.inf, np.float32)
+    x[:, 0] = 3.0  # one finite lane
+    out = _run(n, x, op="max")
+    want = np.full((n, 8), -np.inf, np.float32)
+    want[:, 0] = 3.0
+    np.testing.assert_array_equal(out, want)
+    out_min = _run(n, np.full((n, 4), np.inf, np.float32), op="min")
+    np.testing.assert_array_equal(out_min, np.inf)
 
 
 def test_dbtree_via_transport(devices):
