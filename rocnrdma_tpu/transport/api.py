@@ -253,16 +253,25 @@ class Transport:
         self._count(verb, resolved, x)           # rejected calls don't count
         return fn(x)
 
-    def allreduce(self, x, algo: str = "auto", op: str = "sum", acc=None):
+    def allreduce(self, x, algo: str = "auto", op: str = "sum", acc=None,
+                  premul=None):
         """(ranks..., S) -> same shape; every rank row = elementwise reduction
         (``op``: sum/prod/max/min/avg). ``acc``: accumulate in this wider
         dtype and cast back — e.g. ``acc="float32"`` on bf16 buffers, the
-        RCCL fp32-accumulation behavior (wire traffic is in ``acc``)."""
-        return self._dispatch("allreduce", x, algo, op=op, acc=acc)
+        RCCL fp32-accumulation behavior (wire traffic is in ``acc``).
+        ``premul``: scale every contribution by this scalar before summing
+        (the ``ncclRedOpCreatePreMulSum`` analogue; requires op='sum' and a
+        float buffer). The scalar is a COMPILE-TIME constant — one cached
+        program per distinct value; for a per-step dynamic factor (e.g.
+        loss scaling) pre-scale the input array instead."""
+        return self._dispatch("allreduce", x, algo, op=op, acc=acc,
+                              premul=premul)
 
-    def reduce_scatter(self, x, algo: str = "auto", op: str = "sum", acc=None):
+    def reduce_scatter(self, x, algo: str = "auto", op: str = "sum", acc=None,
+                       premul=None):
         """(ranks..., S) -> (ranks..., S/n); rank r keeps the reduced r-th shard."""
-        return self._dispatch("reduce_scatter", x, algo, op=op, acc=acc)
+        return self._dispatch("reduce_scatter", x, algo, op=op, acc=acc,
+                              premul=premul)
 
     def allgather(self, x, algo: str = "auto"):
         """(ranks..., c) -> (ranks..., n*c); every rank ends with the concatenation."""
@@ -277,9 +286,10 @@ class Transport:
         return self._dispatch("broadcast", x, algo, root=root)
 
     def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum",
-               acc=None):
+               acc=None, premul=None):
         """(ranks..., S) -> same shape; root's row = reduction, others zero."""
-        return self._dispatch("reduce", x, algo, root=root, op=op, acc=acc)
+        return self._dispatch("reduce", x, algo, root=root, op=op, acc=acc,
+                              premul=premul)
 
     def gather(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., c) -> (ranks..., n*c); root's row = concatenation in
@@ -344,9 +354,16 @@ class Transport:
                 knobs["acc"] = jnp.dtype(knobs["acc"]).name
             except TypeError as e:
                 raise ValueError(f"bad acc dtype {knobs['acc']!r}: {e}") from None
+        if knobs.get("premul") is not None:
+            if knobs.get("op", "sum") != "sum":
+                raise ValueError(
+                    f"premul requires op='sum' (the ncclRedOpCreatePreMulSum "
+                    f"semantics), got op={knobs['op']!r}")
+            knobs["premul"] = float(knobs["premul"])  # one cache key per value
         return {k: v for k, v in knobs.items()
                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
-                and not (k == "shift" and v == 1) and not (k == "acc" and v is None)}
+                and not (k == "shift" and v == 1) and not (k == "acc" and v is None)
+                and not (k == "premul" and v is None)}
 
     def _jit(self, verb: str, algo: str, **knobs):
         knobs = self._normalize_knobs(**knobs)
@@ -394,12 +411,25 @@ class Transport:
         # fp32-accumulation-for-bf16 behavior) — algorithm-agnostic, so it
         # wraps the schedule instead of threading through each one
         acc = knobs.pop("acc", None)
-        base = lambda v: schedule(v, fused_axes, **knobs)
-        if acc is None:
-            fn = base
-        else:
+        # premul (the ncclRedOpCreatePreMulSum analogue): scale each rank's
+        # contribution BEFORE the sum — a pre-transform, not a combiner
+        # change, so it wraps any sum schedule and fuses into its first pass
+        premul = knobs.pop("premul", None)
+        fn = lambda v: schedule(v, fused_axes, **knobs)
+        if premul is not None:
+            def _premul_wrap(base):
+                def wrapped(v):
+                    if not jnp.issubdtype(v.dtype, jnp.floating):
+                        # NCCL restricts PreMulSum to float types too: an
+                        # int cast would truncate 0.25 to 0 and zero the sum
+                        raise ValueError(
+                            f"premul requires a float buffer, got {v.dtype}")
+                    return base(v * jnp.asarray(premul, v.dtype))
+                return wrapped
+            fn = _premul_wrap(fn)
+        if acc is not None:
             acc_dtype = jnp.dtype(acc)
-            fn = lambda v: base(v.astype(acc_dtype)).astype(v.dtype)
+            fn = (lambda base: lambda v: base(v.astype(acc_dtype)).astype(v.dtype))(fn)
 
         spec = self._spec()
         # check_vma off for the pallas data plane: pallas_call outputs carry

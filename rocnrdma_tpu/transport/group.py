@@ -67,12 +67,14 @@ class Group:
         return GroupHandle(self, len(self._calls) - 1)
 
     def allreduce(self, x, algo: str = "auto", op: str = "sum",
-                  acc=None) -> GroupHandle:
-        return self._queue("allreduce", x, algo, op=op, acc=acc)
+                  acc=None, premul=None) -> GroupHandle:
+        return self._queue("allreduce", x, algo, op=op, acc=acc,
+                           premul=premul)
 
     def reduce_scatter(self, x, algo: str = "auto", op: str = "sum",
-                       acc=None) -> GroupHandle:
-        return self._queue("reduce_scatter", x, algo, op=op, acc=acc)
+                       acc=None, premul=None) -> GroupHandle:
+        return self._queue("reduce_scatter", x, algo, op=op, acc=acc,
+                           premul=premul)
 
     def allgather(self, x, algo: str = "auto") -> GroupHandle:
         return self._queue("allgather", x, algo)
@@ -84,8 +86,9 @@ class Group:
         return self._queue("broadcast", x, algo, root=root)
 
     def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum",
-               acc=None) -> GroupHandle:
-        return self._queue("reduce", x, algo, root=root, op=op, acc=acc)
+               acc=None, premul=None) -> GroupHandle:
+        return self._queue("reduce", x, algo, root=root, op=op, acc=acc,
+                           premul=premul)
 
     def gather(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
         return self._queue("gather", x, algo, root=root)

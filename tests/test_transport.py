@@ -168,3 +168,42 @@ def test_acc_knob_in_group_and_cache(devices):
     with t.group() as g:
         h = g.allreduce(xb, algo="tree", acc="float32")
     np.testing.assert_allclose(np.asarray(h.result()).astype(np.float32), 4.0)
+
+
+def test_premul_sum(devices):
+    """The ncclRedOpCreatePreMulSum analogue: sum of alpha-scaled
+    contributions, composable with algo choice and wide accumulation."""
+    from rocnrdma_tpu import runtime as rt
+    from rocnrdma_tpu.transport import Transport
+
+    t = Transport(rt.rank_mesh(4))
+    x = np.arange(4 * 8, dtype=np.float32).reshape(4, 8)
+    s = t.shard(x)
+    want = 0.25 * x.sum(axis=0)
+    for algo in ("fused", "ring", "dtree"):
+        out = np.asarray(t.allreduce(s, algo=algo, premul=0.25))
+        np.testing.assert_allclose(out, np.broadcast_to(want, x.shape),
+                                   rtol=1e-5)
+    # gradient-averaging idiom: premul=1/n == allreduce avg for sums
+    np.testing.assert_allclose(
+        np.asarray(t.allreduce(s, premul=1 / 4)),
+        np.asarray(t.allreduce(s, op="avg")), rtol=1e-6)
+    with pytest.raises(ValueError, match="premul requires op='sum'"):
+        t.allreduce(s, op="max", premul=0.5)
+    # distinct alphas are distinct programs; same alpha shares one
+    t.allreduce(s, premul=0.25)
+    t.allreduce(s, premul=0.5)
+    keys = [k for k in t._cache
+            if k[0] == "allreduce" and any("premul" in str(kk) for kk in k[2])]
+    assert len(keys) == 4  # 0.25 on three algos + 0.5 on fused (1/4 == 0.25)
+    # integer buffers must be rejected, not silently zeroed (0.25 -> int 0)
+    with pytest.raises(ValueError, match="float buffer"):
+        t.allreduce(t.shard(np.ones((4, 8), np.int32)), premul=0.25)
+    # grouped launches carry the knob too
+    with t.group() as g:
+        h = g.allreduce(s, premul=0.5)
+        h2 = g.reduce(s, root=1, premul=0.5)
+    np.testing.assert_allclose(np.asarray(h.result()),
+                               np.broadcast_to(0.5 * x.sum(0), x.shape),
+                               rtol=1e-5)
+    assert np.allclose(np.asarray(h2.result())[1], 0.5 * x.sum(0), rtol=1e-5)
