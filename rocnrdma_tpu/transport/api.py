@@ -22,6 +22,8 @@ equal (allreduce), the gathered buffer (allgather), etc.
 from __future__ import annotations
 
 import math
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +42,12 @@ def _pallas():
 
 def _raise(msg: str):
     raise ValueError(msg)
+
+
+# RNR_DEBUG=1 logs one stderr line per collective dispatch (verb, resolved
+# algo, bytes, mesh) — the NCCL_DEBUG=INFO habit, for answering "which
+# algorithm did auto actually pick?" without a debugger.
+_DEBUG_LOG = os.environ.get("RNR_DEBUG", "") not in ("", "0")
 
 ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "dtree",
          "hierarchical", "pallas_ring", "bruck", "binomial")
@@ -227,11 +235,19 @@ class Transport:
     def _count(self, verb: str, algo: str, x) -> None:
         s = self._stats.setdefault((verb, algo), {"calls": 0, "bytes": 0})
         s["calls"] += 1
-        s["bytes"] += int(getattr(x, "nbytes", 0) or 0)
+        nbytes = int(getattr(x, "nbytes", 0) or 0)
+        s["bytes"] += nbytes
+        if _DEBUG_LOG:  # the NCCL_DEBUG=INFO analogue (env RNR_DEBUG=1)
+            print(f"# rnr {verb} algo={algo} bytes={nbytes} "
+                  f"ranks={self.n_ranks} mesh={'2d' if self.is_2d else '1d'}",
+                  file=sys.stderr)
 
     def stats(self) -> dict:
         """Per-(verb, algo) dispatch counts and cumulative input bytes since
-        construction (grouped calls count under their resolved algos)."""
+        construction (grouped calls count under their resolved algos).
+        Scope: the verb methods and grouped launches — bare ``jit_fn``
+        callables (what the benches time in hot loops) are NOT counted, and
+        likewise not logged by RNR_DEBUG."""
         return {f"{v}/{a}": dict(s) for (v, a), s in sorted(self._stats.items())}
 
     def format_stats(self) -> str:

@@ -62,3 +62,15 @@ def test_transport_stats_count_calls_and_bytes(devices):
     assert s["alltoall/fused"]["calls"] == 1
     table = t.format_stats()
     assert "allreduce/fused" in table and "calls" in table
+
+
+def test_rnr_debug_logs_dispatches(devices, capsys, monkeypatch):
+    """RNR_DEBUG=1 (the NCCL_DEBUG=INFO analogue) logs one line per call."""
+    from rocnrdma_tpu.transport import api
+
+    monkeypatch.setattr(api, "_DEBUG_LOG", True)
+    t = Transport(rt.rank_mesh(4))
+    x = t.shard(np.zeros((4, 32), np.float32))
+    t.allreduce(x, algo="ring")
+    err = capsys.readouterr().err
+    assert "# rnr allreduce algo=ring bytes=512 ranks=4 mesh=1d" in err
