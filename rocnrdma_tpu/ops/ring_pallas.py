@@ -15,9 +15,13 @@ VPU between hops:
     hipMemRegister pinning             refs pinned in VMEM by BlockSpec
     out-of-band rank exchange          neighbour barrier semaphore
 
-Current scope: buffers that fit VMEM per chip (chunk <= ~MBs). An
-HBM-resident variant that streams chunks HBM->VMEM around the same ring is
-the natural next step and keeps this kernel's wire protocol.
+Two residency tiers:
+
+- ``pallas_ring_{allreduce,reduce_scatter,allgather}`` — whole buffer in
+  VMEM (chunk <= ~MBs); the lowest-latency tier.
+- ``pallas_hbm_ring_allreduce`` — HBM-resident buffers streamed tile by
+  tile through VMEM staging around the same wire protocol (per-tile remote
+  DMA + credits); the capacity tier, sized by HBM instead of VMEM.
 
 Correctness tiers: interpret-mode (CPU) tests run the full multi-device
 schedule; on real multi-chip TPU the same code compiles natively
@@ -230,3 +234,119 @@ def pallas_ring_allgather(x: jax.Array, axis_name: str,
     out = _ring_call(kern, chunk, chunk.shape, 1, (n,) + chunk.shape,
                      interpret)
     return out.reshape(n, -1)[:, :size].reshape((n,) + x.shape)
+
+
+# ---------------------------------------------------------------------------
+# HBM-resident tier: stream tiles through VMEM staging around the ring
+
+
+def _hbm_ring_kernel(x_ref, o_ref, stage_send, comm_buf, stage_acc,
+                     local_sem, acc_sem, send_sem, recv_sem, caps_sem, *,
+                     n: int, n_tiles: int, axis_name: str):
+    """o_ref: (n, n_tiles, rows, 128) in HBM (aliases x_ref). Each ring hop
+    moves ONE tile: HBM -> VMEM staging -> remote comm slot -> accumulate
+    (or overwrite) into the receiver's HBM tile. Same slot/credit protocol
+    as ``_ring_hops``, at (step, tile) granularity.
+
+    Every DMA is started and waited immediately — the deliberate
+    simple-correct choice for this tier (pipelining the stage-up of tile
+    t+1 under tile t's RDMA would hide the local-DMA cost, but couples the
+    credit window to in-flight staging; do it only with native-hardware
+    profiles in hand). Only ``comm_buf`` is double-buffered — that is what
+    the credit protocol protects; staging is single because it is reused
+    only after its RDMA completes.
+    """
+    my = lax.axis_index(axis_name)
+    left = (my - 1) % n
+    right = (my + 1) % n
+    _neighbour_barrier(axis_name, n)
+
+    def mini_hop(g, send_idx, recv_idx, t, accumulate):
+        slot = g % 2
+        # stage my outbound tile (its HBM value is final for this step)
+        up = pltpu.make_async_copy(o_ref.at[send_idx, t], stage_send,
+                                   local_sem)
+        up.start()
+        up.wait()
+        if g >= 2:  # comm slot reused: wait for the consume credit
+            pltpu.semaphore_wait(caps_sem.at[slot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=stage_send, dst_ref=comm_buf.at[slot],
+            send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()
+        if accumulate:
+            # HBM -> VMEM, add, VMEM -> HBM
+            down = pltpu.make_async_copy(o_ref.at[recv_idx, t], stage_acc,
+                                         acc_sem)
+            down.start()
+            down.wait()
+            stage_acc[...] = stage_acc[...] + comm_buf[slot]
+            back = pltpu.make_async_copy(stage_acc, o_ref.at[recv_idx, t],
+                                         acc_sem)
+        else:
+            back = pltpu.make_async_copy(comm_buf.at[slot],
+                                         o_ref.at[recv_idx, t], acc_sem)
+        back.start()
+        back.wait()
+        pltpu.semaphore_signal(caps_sem.at[slot], inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    g = 0  # global mini-hop counter (slot parity + credit window)
+    for s in range(n - 1):          # reduce-scatter phase
+        for t in range(n_tiles):
+            mini_hop(g, (my - s) % n, (my - s - 1) % n, t, True)
+            g += 1
+    for s in range(n - 1):          # allgather phase
+        for t in range(n_tiles):
+            mini_hop(g, (my + 1 - s) % n, (my - s) % n, t, False)
+            g += 1
+    # drain trailing credits so semaphores end at zero
+    for slot in range(min(2, g)):
+        pltpu.semaphore_wait(caps_sem.at[slot], 1)
+
+
+def pallas_hbm_ring_allreduce(x: jax.Array, axis_name: str,
+                              tile_rows: int = 64,
+                              interpret: bool | None = None) -> jax.Array:
+    """Allreduce (sum) with HBM-resident buffers: the capacity tier.
+
+    The VMEM-resident kernels cap at a few MBs per rank; this variant keeps
+    the buffer in HBM (aliased in place) and streams (tile_rows, 128) tiles
+    through VMEM staging around the ring, so capacity is bounded by HBM.
+    VMEM footprint is 4 tiles (1 send stage, 2 comm slots, 1 accumulator)
+    regardless of buffer size. The schedule unrolls
+    ``2(n-1) * ceil(chunk/tile)`` mini-hops at trace time — keep tiles
+    reasonably large (default 32 KiB fp32) so the program stays small.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    lanes = 128
+    tile = tile_rows * lanes
+    buf, size = _pad_chunks(x, n, lanes=tile)  # (n, n_tiles, tile) + size
+    n_tiles = buf.shape[1]
+    buf = buf.reshape(n, n_tiles, tile_rows, lanes)
+    kern = functools.partial(_hbm_ring_kernel, n=n, n_tiles=n_tiles,
+                             axis_name=axis_name)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        input_output_aliases={0: 0},  # accumulate in place in HBM
+        scratch_shapes=[
+            pltpu.VMEM((tile_rows, lanes), buf.dtype),     # send staging
+            pltpu.VMEM((2, tile_rows, lanes), buf.dtype),  # comm slots
+            pltpu.VMEM((tile_rows, lanes), buf.dtype),     # accumulator
+            pltpu.SemaphoreType.DMA,                       # staging DMAs
+            pltpu.SemaphoreType.DMA,                       # acc DMAs
+            pltpu.SemaphoreType.DMA((2,)),                 # remote send
+            pltpu.SemaphoreType.DMA((2,)),                 # remote recv
+            pltpu.SemaphoreType.REGULAR((2,)),             # credits
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=3),
+        interpret=_interpret_mode(interpret),
+    )(buf)
+    return out.reshape(-1)[:size].reshape(x.shape)

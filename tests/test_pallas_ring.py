@@ -98,3 +98,32 @@ def test_pallas_reduce_scatter_via_transport(devices):
                                rtol=1e-4, atol=1e-5)
     with pytest.raises(ValueError, match="sum-only"):
         t.reduce_scatter(t.shard(x), algo="pallas_ring", op="max")
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_pallas_hbm_allreduce(devices, n):
+    from rocnrdma_tpu.ops import pallas_hbm_ring_allreduce
+
+    # multiple tiles per chunk + uneven size (pad path): 3 tiles of 8x128
+    x = np.random.default_rng(n).standard_normal(
+        (n, n * 2 * 8 * 128 + 57)).astype(np.float32)
+    f = _shmap(lambda s: pallas_hbm_ring_allreduce(
+        s[0], RANK, tile_rows=8)[None], n)
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.broadcast_to(x.sum(0), out.shape),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("trial", range(2))
+def test_pallas_hbm_allreduce_stress(devices, trial):
+    """Racier config: many mini-hops exercise slot recycling + credits."""
+    from rocnrdma_tpu.ops import pallas_hbm_ring_allreduce
+
+    n = 4
+    x = np.random.default_rng(100 + trial).standard_normal(
+        (n, n * 5 * 8 * 128)).astype(np.float32)  # 5 tiles/chunk -> 30 hops
+    f = _shmap(lambda s: pallas_hbm_ring_allreduce(
+        s[0], RANK, tile_rows=8)[None], n)
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.broadcast_to(x.sum(0), out.shape),
+                               rtol=1e-4, atol=1e-5)
