@@ -164,7 +164,8 @@ def _ring_call(kernel, buf: jax.Array, slot_shape: tuple, collective_id: int,
     """The shared pallas_call plumbing of every ring kernel here: one VMEM
     in/out pair, a 2-slot comm buffer, send/recv DMA semaphores and the
     credit semaphore (the double-buffer protocol `_ring_hops` implements —
-    change it HERE and in `_ring_hops` together)."""
+    change it HERE, in `_ring_hops`, AND in `_hbm_ring_kernel`, which carries
+    its own copy of the wait/signal/drain accounting around HBM staging)."""
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(out_shape, buf.dtype),

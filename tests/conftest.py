@@ -15,6 +15,16 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Several tests spawn real OS processes running worker scripts out of
+# tmp_path (`python /tmp/.../worker.py`): Python puts the SCRIPT's directory
+# on sys.path, not the cwd, and this package is used from the source tree,
+# not installed — so the workers can only import rocnrdma_tpu if the repo
+# root is on PYTHONPATH. Export it here, before any test builds its env.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ["PYTHONPATH"] = (
+    _REPO_ROOT + os.pathsep + os.environ["PYTHONPATH"]
+    if os.environ.get("PYTHONPATH") else _REPO_ROOT)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
