@@ -79,6 +79,10 @@ class ProcessGroup:
             self._net.close()
             raise
         self._barrier_no = 0
+        self._p2p: dict[tuple, "plugin._RingWire"] = {}  # (peer, dir) -> wire
+        self._p2p_seq: dict[int, dict] = {}     # peer -> (dir, tag) -> seq
+        self._p2p_listen: dict | None = None    # peer -> listener, once used
+        self._p2p_accepted: set[int] = set()
         self._split_no = 0
         self._shrink_no = 0
         self._destroyed = False
@@ -100,28 +104,24 @@ class ProcessGroup:
         if transport not in ("msg", "rdma"):  # validate even at world size 1
             raise ValueError(f"unknown transport {transport!r}; "
                              f"know ('msg', 'rdma')")
+        wire_op = self._avg_wire_op(x, op, "all_reduce")
         if self.world_size == 1:
             return x.copy()
-        if op == "avg" and not np.issubdtype(x.dtype, np.floating):
-            raise ValueError(
-                f"all_reduce op='avg' needs a float dtype, got {x.dtype} "
-                f"(an integer average would silently truncate)")
-        wire_op = "sum" if op == "avg" else op
         fn = (plugin.ring_allreduce_rdma if transport == "rdma"
               else plugin.ring_allreduce_over_net)
         out = self._ring(fn, x, self.rank, self.world_size, op=wire_op)
-        if op == "avg":
-            out = (out / self.world_size).astype(x.dtype)
-        return out
+        return self._avg_finalize(out, x, op)
 
     def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
-        """Reduce across ranks; rank r keeps the r-th of n floor-balanced
-        element ranges of the flattened buffer."""
+        """Reduce across ranks (op: sum/prod/max/min/avg); rank r keeps the
+        r-th of n floor-balanced element ranges of the flattened buffer."""
         x = np.asarray(x)
+        wire_op = self._avg_wire_op(x, op, "reduce_scatter")
         if self.world_size == 1:
             return x.ravel().copy()
-        return self._ring(plugin.ring_reduce_scatter_over_net, x, self.rank,
-                          self.world_size, op=op)
+        out = self._ring(plugin.ring_reduce_scatter_over_net, x, self.rank,
+                         self.world_size, op=wire_op)
+        return self._avg_finalize(out, x, op)
 
     def all_gather(self, x) -> np.ndarray:
         """Every rank contributes ``x`` (same shape everywhere); returns
@@ -136,6 +136,7 @@ class ProcessGroup:
         """Every rank returns rank ``src``'s buffer (non-src inputs size the
         receive buffer)."""
         x = np.asarray(x)
+        plugin._check_root(src, self.world_size)
         if self.world_size == 1:
             return x.copy()
         return self._ring(plugin.ring_broadcast_over_net, x, self.rank,
@@ -164,6 +165,184 @@ class ProcessGroup:
         return self._ring(plugin.ring_alltoallv_over_net, segments,
                           np.asarray(counts), self.rank, self.world_size,
                           dtype=dtype)
+
+    def _avg_wire_op(self, x, op: str, verb: str) -> str:
+        """Shared avg handling: validate the dtype, map avg to a sum on the
+        wire (finalized by :meth:`_avg_finalize`), and reject unknown ops —
+        identically at EVERY world size, so a script debugged at world size
+        1 cannot silently pass a knob that explodes at world size N."""
+        if op == "avg":
+            if not np.issubdtype(x.dtype, np.floating):
+                raise ValueError(
+                    f"{verb} op='avg' needs a float dtype, got {x.dtype} "
+                    f"(an integer average would silently truncate)")
+            return "sum"
+        plugin._NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
+        return op
+
+    def _avg_finalize(self, out, x, op: str):
+        if out is not None and op == "avg":
+            out = (out / self.world_size).astype(x.dtype)
+        return out
+
+    def reduce(self, x, dst: int = 0, op: str = "sum") -> np.ndarray | None:
+        """Rooted reduction: every rank contributes ``x``; only rank ``dst``
+        returns the reduced array (others return None, torch semantics).
+        Pipelined chain reduce toward the root under the hood."""
+        x = np.asarray(x)
+        wire_op = self._avg_wire_op(x, op, "reduce")
+        plugin._check_root(dst, self.world_size)
+        if self.world_size == 1:
+            return x.copy()
+        out = self._ring(plugin.ring_reduce_over_net, x, self.rank,
+                         self.world_size, root=dst, op=wire_op)
+        return self._avg_finalize(out, x, op)
+
+    def gather(self, x, dst: int = 0) -> np.ndarray | None:
+        """Rooted gather: every rank contributes ``x`` (same shape
+        everywhere); rank ``dst`` returns ``(world_size, *x.shape)`` in rank
+        order, others return None."""
+        x = np.asarray(x)
+        plugin._check_root(dst, self.world_size)
+        if self.world_size == 1:
+            return x[None].copy()
+        return self._ring(plugin.ring_gather_over_net, x, self.rank,
+                          self.world_size, root=dst)
+
+    def scatter(self, x, src: int = 0) -> np.ndarray:
+        """Rooted scatter: rank ``src`` passes ``(world_size, ...)`` — row j
+        goes to rank j; every OTHER rank passes a template of one row's
+        shape/dtype (contents ignored, it sizes the receive). Every rank
+        returns its row."""
+        x = np.asarray(x)
+        plugin._check_root(src, self.world_size)
+        if self.world_size == 1:
+            if x.shape[0] != 1:
+                raise ValueError(f"scatter root wants (1, ...), got {x.shape}")
+            return x[0].copy()
+        return self._ring(plugin.ring_scatter_over_net, x, self.rank,
+                          self.world_size, root=src)
+
+    # -- point-to-point ----------------------------------------------------
+    #
+    # Wiring rule (deadlock-freedom): a rank's FIRST p2p op — before it
+    # blocks on anything — creates one listener per peer and publishes every
+    # handle. Each direction then gets its own connection: sending to peer j
+    # dials j's pair-listener; receiving from j accepts on ours. The only
+    # blocking points left are (a) a sender waiting for its peer to START
+    # doing p2p at all (publish happens first, so any set of first contacts
+    # — including cycles like every rank send((r+1)%n) then recv((r-1)%n) —
+    # resolves), and (b) a recv waiting for its matching send, which is just
+    # blocking-receive semantics.
+
+    def _p2p_ns(self, peer: int) -> str:
+        lo, hi = min(self.rank, peer), max(self.rank, peer)
+        return f"pg/{self.group_name}/p2p/{lo}-{hi}"
+
+    def _p2p_publish(self) -> None:
+        """First p2p op on this rank: listen + publish for EVERY peer."""
+        if self._p2p_listen is not None:
+            return
+        self._p2p_listen = {}
+        for peer in range(self.world_size):
+            if peer == self.rank:
+                continue
+            handle, listener = self._net.listen()
+            self._p2p_listen[peer] = listener
+            self._client.set(f"{self._p2p_ns(peer)}/h/{self.rank}", handle)
+
+    def _p2p_progress(self) -> None:
+        """The p2p progress engine, hooked into every send's backpressure
+        and flush loops: poll-accept pending inbound dials and pump every
+        wired rx comm. This is what keeps SYMMETRIC (or cyclic) large sends
+        alive — two ranks mid-send can only drain each other if each pulls
+        the peer's inbound bytes off the wire while its own tx is stalled;
+        without it, payloads beyond kernel/ring buffering wedge both sides
+        (the reference stack solves this the same way: the net plugin's
+        progress engine runs inside every blocking verb)."""
+        for peer, listener in (self._p2p_listen or {}).items():
+            if peer not in self._p2p_accepted:
+                try:
+                    comm = self._net.accept(listener, timeout_s=0.0)
+                except (TimeoutError, OSError):
+                    continue
+                self._p2p_accepted.add(peer)
+                self._p2p[(peer, "rx")] = plugin._RingWire(
+                    self._net, comm, comm)
+                self._p2p_seq.setdefault(peer, {})
+        for (peer, d), wire in list(self._p2p.items()):
+            if d == "rx":
+                wire.recv_comm._pump()
+
+    def _p2p_wire(self, peer: int, direction: str, timeout_s: float = 30.0):
+        """The cached one-way wire to/from ``peer`` (``direction``: "tx" dials
+        the peer's pair-listener, "rx" accepts on ours)."""
+        if not 0 <= peer < self.world_size or peer == self.rank:
+            raise ValueError(f"bad peer {peer} for rank {self.rank} "
+                             f"(world_size {self.world_size})")
+        wire = self._p2p.get((peer, direction))
+        if wire is None:
+            self._p2p_publish()
+            if direction == "tx":
+                handle = self._client.get(f"{self._p2p_ns(peer)}/h/{peer}",
+                                          timeout_s)
+                comm = self._net.connect(0, handle, timeout_s)
+                # sends pump the whole p2p plane (see _p2p_progress)
+                wire = plugin._RingWire(self._net, comm, comm,
+                                        progress=self._p2p_progress,
+                                        timeout_s=timeout_s)
+            else:
+                comm = self._net.accept(self._p2p_listen[peer], timeout_s)
+                self._p2p_accepted.add(peer)
+                # one comm plays both _RingWire roles: receives probe their
+                # own comm, the flush of an (empty) tx queue is harmless
+                wire = plugin._RingWire(self._net, comm, comm,
+                                        timeout_s=timeout_s)
+            self._p2p[(peer, direction)] = wire
+            self._p2p_seq.setdefault(peer, {})
+        wire.timeout_s = timeout_s  # per-call deadline on a cached wire
+        return wire
+
+    @staticmethod
+    def _p2p_hop(tag: int, seq: int) -> int:
+        # the wire's tag field gives hops 16 bits; split them 6/10 between
+        # user tag and a wrapping per-direction sequence. The wrap is safe
+        # because p2p here is blocking and FIFO per pair — a tag can only
+        # collide with a message 1024 sends earlier, long since consumed.
+        if not 0 <= tag < 64:
+            raise ValueError(f"p2p tag must be in [0, 64), got {tag}")
+        return (tag << 10) | (seq % 1024)
+
+    def send(self, x, dst: int, tag: int = 0,
+             timeout_s: float = 60.0) -> None:
+        """Blocking point-to-point send of ``x`` to rank ``dst``. Messages
+        between a pair are delivered in send order; ``tag`` (0..63)
+        disambiguates concurrent streams, torch-style. ``timeout_s`` bounds
+        every wait (first-contact rendezvous, backpressure, flush) — raise
+        it for slow-consumer peers; blocking semantics are only as patient
+        as this deadline."""
+        x = np.asarray(x)
+        wire = self._p2p_wire(dst, "tx", timeout_s)
+        # counters are per-(direction, tag): tag streams are independently
+        # ordered, so a receiver may drain tag 7 before tag 0 (the verbs
+        # layer tag-matches out of order; see _HostComm._unexpected)
+        seq = self._p2p_seq[dst].get(("tx", tag), 0)
+        self._p2p_seq[dst][("tx", tag)] = seq + 1
+        wire.exchange(plugin._as_bytes(x), 0, hop=self._p2p_hop(tag, seq))
+
+    def recv(self, x_like, src: int, tag: int = 0,
+             timeout_s: float = 60.0) -> np.ndarray:
+        """Blocking point-to-point receive from rank ``src``; ``x_like``
+        supplies the expected shape/dtype (the recvbuff role). Returns the
+        received array. ``timeout_s`` bounds the wait for the matching send
+        — raise it for slow producers."""
+        template = np.asarray(x_like)
+        wire = self._p2p_wire(src, "rx", timeout_s)
+        seq = self._p2p_seq[src].get(("rx", tag), 0)
+        self._p2p_seq[src][("rx", tag)] = seq + 1
+        got = wire.exchange(np.empty(0, np.uint8), template.nbytes,
+                            hop=self._p2p_hop(tag, seq))
+        return got.view(template.dtype).reshape(template.shape)
 
     def barrier(self, timeout_s: float = 30.0) -> None:
         """Block until every rank arrives."""
@@ -305,6 +484,17 @@ class ProcessGroup:
                 except (OSError, TimeoutError):
                     pass  # peers may have crashed; teardown must complete
             self._client.close()
+        if self._p2p_listen and self.plane == "shm":
+            # shm listeners ARE queue pairs: accepted ones became net comms
+            # (closed by net.close()); never-accepted ones are invisible to
+            # the net and must be closed here. TCP listeners are net-tracked
+            # either way.
+            for peer, listener in self._p2p_listen.items():
+                if peer not in self._p2p_accepted:
+                    try:
+                        listener.close()
+                    except OSError:
+                        pass
         self._net.close()
         if self._server is not None:
             self._server.wait_idle()  # all clients gone -> safe to close

@@ -25,7 +25,10 @@ from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     ring_allreduce_over_net,
     ring_allreduce_rdma,
     ring_alltoallv_over_net,
+    ring_gather_over_net,
+    ring_reduce_over_net,
     ring_reduce_scatter_over_net,
+    ring_scatter_over_net,
     ring_alltoall_over_net,
     ring_broadcast_over_net,
 )

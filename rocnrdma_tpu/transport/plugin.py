@@ -478,12 +478,20 @@ class _RingWire:
     ``send_comm`` reaches rank ``(rank+1) % n``; ``recv_comm`` hears rank
     ``(rank-1) % n``. Tags are ``(hop << 16) | frame_index`` — identical on
     both ends because every rank executes the same hop sequence.
+
+    ``progress`` overrides the default extra progress hook (the recv comm's
+    pump) used while sends backpressure/flush — p2p tx wires slot a
+    plane-wide engine here. ``timeout_s`` bounds every blocking wait in an
+    exchange (request waits, send backpressure, tx flush).
     """
 
-    def __init__(self, net, send_comm, recv_comm):
+    def __init__(self, net, send_comm, recv_comm, progress=None,
+                 timeout_s: float = 30.0):
         self.net = net
         self.send_comm = send_comm
         self.recv_comm = recv_comm
+        self.progress = progress
+        self.timeout_s = timeout_s
         self.frame = getattr(net, "MAX_FRAME", (1 << 16) - 4)
         self._hops = itertools.count(1)
 
@@ -517,12 +525,14 @@ class _RingWire:
         # progress engine: while our send ring is full, keep draining the
         # comm our inbound data arrives on, or two mutually-sending ranks
         # stall each other
-        pump = getattr(self.recv_comm, "_pump", None)
+        pump = (self.progress if self.progress is not None
+                else getattr(self.recv_comm, "_pump", None))
         for fi, off in enumerate(range(0, len(out), frame)):
             seg = np.ascontiguousarray(out[off:off + frame])
             self.net.isend(self.send_comm,
                            self.net.reg_mr(self.send_comm, seg),
-                           tag=tag(fi), progress=pump)
+                           tag=tag(fi), timeout_s=self.timeout_s,
+                           progress=pump)
         # Wait for the inbound frames WHILE keeping our own outbound
         # flowing. A hop larger than the kernel socket buffers leaves the
         # tail of our frames in the user-space tx queue; the peer cannot
@@ -532,19 +542,28 @@ class _RingWire:
         import time as _time
         send_pump = getattr(self.send_comm, "_pump", None)
         for off, nb, r in reqs:
-            payload = r.wait(progress=send_pump)
+            payload = r.wait(timeout_s=self.timeout_s, progress=send_pump)
             got[off:off + nb] = np.frombuffer(payload, np.uint8)
         # Symmetric tail: a rank whose receives all completed early may
         # still hold queued tx that nothing would otherwise flush — the
         # peer would time out on frames we believe are sent. Flushing
         # cannot deadlock: the peer always drains its inbound socket.
-        _flush_tx(self.send_comm, 30.0, extra_pump=pump,
+        _flush_tx(self.send_comm, self.timeout_s, extra_pump=pump,
                   what="ring hop: peer stopped draining")
         return got
 
 
 def _as_bytes(a: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(a).view(np.uint8).ravel()
+
+
+def _pipeline_chunks(nbytes: int, frame: int, n: int) -> int:
+    """Chunk count for the pipelined rooted schedules (broadcast, chain
+    reduce): enough chunks that relaying overlaps with the next chunk's
+    arrival, capped at the rank count. Every rank on an edge MUST compute
+    the same value — hop tags are per chunk — so both schedules share this
+    one formula."""
+    return max(1, min(n, nbytes // max(1, frame) + 1))
 
 
 def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
@@ -813,6 +832,7 @@ def ring_broadcast_over_net(net, send_comm, recv_comm, local: np.ndarray,
     every rank forwards as it receives (the bandwidth-optimal non-tree
     broadcast for a ring wire). Non-root ``local`` supplies shape/dtype."""
     n = n_ranks
+    _check_root(root, n)
     if n == 1:
         return np.array(local, copy=True)
     wire = _RingWire(net, send_comm, recv_comm)
@@ -822,7 +842,7 @@ def ring_broadcast_over_net(net, send_comm, recv_comm, local: np.ndarray,
             else np.empty(local.nbytes, np.uint8))
     # chunk the payload so forwarding pipelines: rank r starts relaying chunk
     # c while chunk c+1 is still inbound upstream
-    n_chunks = max(1, min(n, local.nbytes // max(1, wire.frame) + 1))
+    n_chunks = _pipeline_chunks(local.nbytes, wire.frame, n)
     bounds = [local.nbytes * i // n_chunks for i in range(n_chunks + 1)]
     last = (rank - root) % n == n - 1  # ring tail: do not forward
     for c in range(n_chunks):
@@ -839,6 +859,104 @@ def ring_broadcast_over_net(net, send_comm, recv_comm, local: np.ndarray,
     if rank != root:
         return flat.view(local.dtype).reshape(local.shape)
     return np.array(local, copy=True)
+
+
+def _check_root(root: int, n: int) -> None:
+    # modular index arithmetic below would otherwise WRAP an out-of-range
+    # root and silently deliver the result to the wrong rank
+    if not 0 <= root < n:
+        raise ValueError(f"root {root} out of range for {n} ranks")
+
+
+def ring_reduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
+                         rank: int, n_ranks: int, root: int = 0,
+                         op: str = "sum") -> np.ndarray | None:
+    """Rooted reduce over the verbs: every rank contributes ``local`` (same
+    shape/dtype everywhere); only ``root`` gets the reduced result (others
+    return None — non-root outputs are undefined in the reference API too).
+
+    Chunked pipelined CHAIN reduce — the time-reversal of the pipelined ring
+    broadcast: partials flow ringward toward the root, each rank combining
+    its own contribution before forwarding, chunked so rank r relays chunk c
+    while chunk c+1 is still inbound upstream. Each non-root ring edge
+    carries every chunk exactly once, so per-chunk hop tags agree per edge
+    even though ranks make different call sequences.
+    """
+    n = n_ranks
+    _check_root(root, n)
+    if n == 1:
+        return np.array(local, copy=True)
+    combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
+    acc = np.array(local, copy=True).ravel()
+    wire = _RingWire(net, send_comm, recv_comm)
+    d = (root - rank) % n  # my hop distance to the root (0 = root)
+    n_chunks = _pipeline_chunks(acc.nbytes, wire.frame, n)
+    bounds = [acc.size * i // n_chunks for i in range(n_chunks + 1)]
+    for c in range(n_chunks):
+        lo, hi = bounds[c], bounds[c + 1]
+        seg = acc[lo:hi]
+        if d < n - 1:  # everyone but the chain head hears upstream first
+            incoming = wire.exchange(np.empty(0, np.uint8), seg.nbytes,
+                                     hop=c + 1)
+            combine(seg, incoming.view(acc.dtype), out=seg)
+        if d > 0:  # everyone but the root forwards its partial
+            wire.exchange(_as_bytes(seg), 0, hop=c + 1)
+    if rank != root:
+        return None
+    return acc.reshape(np.shape(local))
+
+
+def ring_gather_over_net(net, send_comm, recv_comm, local: np.ndarray,
+                         rank: int, n_ranks: int,
+                         root: int = 0) -> np.ndarray | None:
+    """Rooted gather over the verbs: every rank contributes ``local`` (same
+    shape/dtype everywhere); ``root`` returns ``(n, *local.shape)`` in rank
+    order, others return None.
+
+    A gather IS a ragged alltoall where only the root's column is non-empty,
+    so this rides :func:`ring_alltoallv_over_net`'s train schedule: each
+    block travels its ring distance to the root and is relayed by the ranks
+    between — no global-max padding, no extra machinery."""
+    block = np.ascontiguousarray(local)
+    n = n_ranks
+    _check_root(root, n)
+    counts = np.zeros((n, n), np.int64)
+    counts[:, root] = block.size
+    segs = [block.ravel() if j == root else np.empty(0, block.dtype)
+            for j in range(n)]
+    out = ring_alltoallv_over_net(net, send_comm, recv_comm, segs, counts,
+                                  rank, n, dtype=block.dtype)
+    if rank != root:
+        return None
+    return np.stack([o.reshape(block.shape) for o in out])
+
+
+def ring_scatter_over_net(net, send_comm, recv_comm, local: np.ndarray,
+                          rank: int, n_ranks: int,
+                          root: int = 0) -> np.ndarray:
+    """Rooted scatter over the verbs: ``root`` passes ``(n, ...)`` — row j
+    goes to rank j; every other rank passes a TEMPLATE of one row's
+    shape/dtype (contents ignored — it sizes the receive, the reference
+    API's recvbuff role). Every rank returns its row.
+
+    The ragged-alltoall dual of :func:`ring_gather_over_net`: only the
+    root's ROW of the count matrix is non-empty."""
+    n = n_ranks
+    _check_root(root, n)
+    buf = np.ascontiguousarray(local)
+    if rank == root:
+        if buf.shape[0] != n:
+            raise ValueError(f"scatter root wants (n, ...), got {buf.shape}")
+        row_shape, dtype, row_size = buf.shape[1:], buf.dtype, buf[0].size
+        segs = [np.ascontiguousarray(buf[j]).ravel() for j in range(n)]
+    else:
+        row_shape, dtype, row_size = buf.shape, buf.dtype, buf.size
+        segs = [np.empty(0, dtype) for _ in range(n)]
+    counts = np.zeros((n, n), np.int64)
+    counts[root, :] = row_size
+    out = ring_alltoallv_over_net(net, send_comm, recv_comm, segs, counts,
+                                  rank, n, dtype=dtype)
+    return out[root].reshape(row_shape)
 
 
 def ring_alltoallv_over_net(net, send_comm, recv_comm, segments: list,

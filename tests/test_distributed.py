@@ -112,6 +112,216 @@ def test_gather_scatter_broadcast_alltoall(sidecar_store):
             a2a, np.stack([mats[src][r] for src in range(n)]))
 
 
+def test_rooted_reduce_gather_scatter(sidecar_store):
+    n = 4
+    store = sidecar_store(n)
+    rng = np.random.default_rng(3)
+    xs = [rng.standard_normal((2, 9)).astype(np.float32) for _ in range(n)]
+    rows = rng.standard_normal((n, 6)).astype(np.float32)
+
+    def fn(pg):
+        r = pg.rank
+        return (pg.reduce(xs[r], dst=1),
+                pg.reduce(xs[r], dst=2, op="avg"),
+                pg.gather(xs[r], dst=0),
+                pg.scatter(rows if r == 3 else np.empty(6, np.float32),
+                           src=3))
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    for r in range(n):
+        red, avg, g, sc = res[r]
+        if r == 1:
+            np.testing.assert_allclose(red, np.sum(xs, axis=0), rtol=1e-5)
+        else:
+            assert red is None
+        if r == 2:
+            np.testing.assert_allclose(avg, np.mean(xs, axis=0), rtol=1e-5)
+        else:
+            assert avg is None
+        if r == 0:
+            np.testing.assert_array_equal(g, np.stack(xs))
+        else:
+            assert g is None
+        np.testing.assert_array_equal(sc, rows[r])
+
+
+def test_send_recv_p2p(sidecar_store):
+    """Blocking p2p with lazy pairwise wiring: ordered messages, a tagged
+    stream, a multi-frame payload, and a non-neighbor pair (0<->2)."""
+    n = 3
+    store = sidecar_store(n)
+    rng = np.random.default_rng(4)
+    big = rng.standard_normal(40000).astype(np.float32)  # multi-frame
+
+    def fn(pg):
+        r = pg.rank
+        if r == 0:
+            pg.send(np.arange(5, dtype=np.float32), dst=1)
+            pg.send(np.arange(5, dtype=np.float32) * 2, dst=1)  # ordering
+            pg.send(big, dst=2)                    # non-neighbor pair
+            return pg.recv(np.empty(3, np.int64), src=2, tag=7)
+        if r == 1:
+            a = pg.recv(np.empty(5, np.float32), src=0)
+            b = pg.recv(np.empty(5, np.float32), src=0)
+            return a, b
+        got = pg.recv(np.empty_like(big), src=0)
+        pg.send(np.array([9, 8, 7], np.int64), dst=0, tag=7)
+        return got
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    np.testing.assert_array_equal(res[0], [9, 8, 7])
+    np.testing.assert_array_equal(res[1][0], np.arange(5, dtype=np.float32))
+    np.testing.assert_array_equal(res[1][1],
+                                  np.arange(5, dtype=np.float32) * 2)
+    np.testing.assert_array_equal(res[2], big)
+
+
+def test_p2p_tag_streams_drain_out_of_order(sidecar_store):
+    """Tag streams are independently ordered: the receiver may drain tag 7
+    before tag 0 (the verbs layer tag-matches out of arrival order)."""
+    n = 2
+    store = sidecar_store(n)
+
+    def fn(pg):
+        if pg.rank == 0:
+            pg.send(np.array([1.0], np.float32), dst=1, tag=0)
+            pg.send(np.array([2.0], np.float32), dst=1, tag=7)
+            return None
+        b = pg.recv(np.empty(1, np.float32), src=0, tag=7)  # out of order
+        a = pg.recv(np.empty(1, np.float32), src=0, tag=0)
+        return a, b
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    np.testing.assert_array_equal(res[1][0], [1.0])
+    np.testing.assert_array_equal(res[1][1], [2.0])
+
+
+def test_rooted_verbs_reject_bad_root(sidecar_store):
+    store = sidecar_store(1)
+    pg = dist.init_process_group(rank=0, world_size=1,
+                                 store_handle=store.handle)
+    from rocnrdma_tpu.transport import plugin
+    for fn in (plugin.ring_reduce_over_net, plugin.ring_gather_over_net,
+               plugin.ring_scatter_over_net):
+        with pytest.raises(ValueError, match="out of range"):
+            fn(None, None, None, np.zeros(4, np.float32), 0, 4, root=4)
+    pg.destroy()
+
+
+def test_p2p_first_contact_cycle(sidecar_store):
+    """Regression: a CYCLE of first contacts across distinct pairs — every
+    rank send((r+1)%n) then recv((r-1)%n) — must not deadlock in pair
+    wiring. Each rank publishes all its pair-listener handles before its
+    first blocking wait, so the rendezvous cannot form a wait cycle."""
+    n = 3
+    store = sidecar_store(n)
+
+    def fn(pg):
+        r = pg.rank
+        pg.send(np.array([float(r)], np.float32), dst=(r + 1) % n)
+        return pg.recv(np.empty(1, np.float32), src=(r - 1) % n)
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    for r in range(n):
+        np.testing.assert_array_equal(res[r], [float((r - 1) % n)])
+
+
+def test_p2p_symmetric_large_sends(sidecar_store):
+    """Regression: both ranks send a payload beyond kernel/ring buffering
+    to each other BEFORE either posts its recv. Only the p2p progress
+    engine (poll-accept + pump inside the send's flush loop) lets the two
+    mid-send ranks drain each other."""
+    n = 2
+    store = sidecar_store(n)
+    rng = np.random.default_rng(6)
+    bufs = [rng.standard_normal(4 * 1024 * 1024).astype(np.float32)
+            for _ in range(n)]  # 16 MB each way
+
+    def fn(pg):
+        r = pg.rank
+        pg.send(bufs[r], dst=1 - r)
+        return pg.recv(np.empty_like(bufs[1 - r]), src=1 - r)
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    np.testing.assert_array_equal(res[0], bufs[1])
+    np.testing.assert_array_equal(res[1], bufs[0])
+
+
+def test_p2p_slow_producer_respects_caller_timeout(sidecar_store):
+    """Regression: a matched send/recv pair >10 s apart used to crash on
+    the wire's hidden internal 10 s deadlines; the caller's ``timeout_s``
+    now governs every wait."""
+    import time as _t
+    n = 2
+    store = sidecar_store(n)
+
+    def fn(pg):
+        if pg.rank == 0:
+            _t.sleep(12.0)  # beyond the old hard-coded Request.wait default
+            pg.send(np.array([3.0], np.float32), dst=1)
+            return None
+        return pg.recv(np.empty(1, np.float32), src=0, timeout_s=30.0)
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    np.testing.assert_array_equal(res[1], [3.0])
+
+
+def test_broadcast_rejects_bad_src(sidecar_store):
+    store = sidecar_store(1)
+    pg = dist.init_process_group(rank=0, world_size=1,
+                                 store_handle=store.handle)
+    with pytest.raises(ValueError, match="out of range"):
+        pg.broadcast(np.zeros(2, np.float32), src=-1)
+    with pytest.raises(KeyError):
+        pg.reduce_scatter(np.zeros(2, np.float32), op="bogus")
+    pg.destroy()
+
+
+def test_reduce_scatter_avg(sidecar_store):
+    n = 3
+    store = sidecar_store(n)
+    xs = [np.arange(6, dtype=np.float32) * (r + 1) for r in range(n)]
+    res = _run_group(n, lambda pg: pg.reduce_scatter(xs[pg.rank], op="avg"),
+                     store_handle=store.handle)
+    want = np.mean(xs, axis=0)
+    bounds = [6 * i // n for i in range(n + 1)]
+    for r in range(n):
+        np.testing.assert_allclose(res[r], want[bounds[r]:bounds[r + 1]],
+                                   rtol=1e-6)
+
+
+def test_rooted_verbs_validate_at_world_size_1(sidecar_store):
+    """Knob validation must be identical at every world size, or a script
+    debugged at world size 1 explodes only at world size N."""
+    store = sidecar_store(1)
+    pg = dist.init_process_group(rank=0, world_size=1,
+                                 store_handle=store.handle)
+    with pytest.raises(ValueError, match="out of range"):
+        pg.reduce(np.zeros(2, np.float32), dst=5)
+    with pytest.raises(ValueError, match="out of range"):
+        pg.gather(np.zeros(2, np.float32), dst=1)
+    with pytest.raises(ValueError, match="out of range"):
+        pg.scatter(np.zeros((1, 2), np.float32), src=3)
+    with pytest.raises(KeyError):
+        pg.reduce(np.zeros(2, np.float32), op="bogus")
+    with pytest.raises(ValueError, match="float dtype"):
+        pg.all_reduce(np.zeros(2, np.int32), op="avg")
+    np.testing.assert_array_equal(pg.reduce(np.ones(2, np.float32)), [1, 1])
+    pg.destroy()
+
+
+def test_p2p_rejects_bad_peer_and_tag(sidecar_store):
+    store = sidecar_store(1)
+    pg = dist.init_process_group(rank=0, world_size=1,
+                                 store_handle=store.handle)
+    with pytest.raises(ValueError, match="bad peer"):
+        pg.send(np.zeros(1), dst=0)   # self-send
+    assert dist.ProcessGroup._p2p_hop(63, 2047) < (1 << 16)
+    with pytest.raises(ValueError, match="p2p tag"):
+        dist.ProcessGroup._p2p_hop(64, 0)
+    pg.destroy()
+
+
 def test_all_to_all_v(sidecar_store):
     n = 3
     store = sidecar_store(n)

@@ -112,6 +112,55 @@ def test_reduce_scatter_over_net(net_cls, n):
 
 
 @needs_native
+@pytest.mark.parametrize("net_cls", PLANES)
+@pytest.mark.parametrize("n,root", [(2, 1), (4, 2), (3, 0)])
+def test_reduce_over_net(net_cls, n, root):
+    from rocnrdma_tpu.transport.plugin import ring_reduce_over_net
+    rng = np.random.default_rng(11)
+    # multi-chunk on the shm plane: > MAX_FRAME bytes forces pipelining
+    xs = [rng.standard_normal(50000).astype(np.float32) for _ in range(n)]
+    res = _run_ring(net_cls, n, lambda net, s, r, rank:
+                    ring_reduce_over_net(net, s, r, xs[rank], rank, n,
+                                         root=root))
+    want = np.sum(xs, axis=0)
+    for r in range(n):
+        if r == root:
+            np.testing.assert_allclose(res[r], want, rtol=1e-5, atol=1e-5)
+        else:
+            assert res[r] is None
+
+
+@needs_native
+@pytest.mark.parametrize("net_cls", PLANES)
+@pytest.mark.parametrize("n,root", [(2, 0), (4, 3)])
+def test_gather_scatter_over_net(net_cls, n, root):
+    from rocnrdma_tpu.transport.plugin import (
+        ring_gather_over_net,
+        ring_scatter_over_net,
+    )
+    rng = np.random.default_rng(12)
+    blocks = [rng.standard_normal((3, 17)).astype(np.float32)
+              for _ in range(n)]
+    rows = rng.standard_normal((n, 29)).astype(np.float32)
+
+    def fn(net, s, r, rank):
+        g = ring_gather_over_net(net, s, r, blocks[rank], rank, n, root=root)
+        sc = ring_scatter_over_net(
+            net, s, r, rows if rank == root else np.empty(29, np.float32),
+            rank, n, root=root)
+        return g, sc
+
+    res = _run_ring(net_cls, n, fn)
+    for r in range(n):
+        g, sc = res[r]
+        if r == root:
+            np.testing.assert_array_equal(g, np.stack(blocks))
+        else:
+            assert g is None
+        np.testing.assert_array_equal(sc, rows[r])
+
+
+@needs_native
 def test_large_hop_exceeding_kernel_buffers():
     """Regression: a hop bigger than the kernel socket buffers must not
     deadlock (each side's tail frames sit in the user-space tx queue; the
