@@ -39,7 +39,10 @@ from rocnrdma_tpu.collectives.alltoall import (  # noqa: F401
     bruck_alltoall,
     rotation_alltoall,
 )
-from rocnrdma_tpu.collectives.hierarchical import hierarchical_allreduce  # noqa: F401
+from rocnrdma_tpu.collectives.hierarchical import (  # noqa: F401
+    hierarchical_allreduce,
+    hierarchical_alltoall,
+)
 from rocnrdma_tpu.collectives.rooted import (  # noqa: F401
     binomial_broadcast,
     binomial_gather,

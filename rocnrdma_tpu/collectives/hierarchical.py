@@ -55,3 +55,57 @@ def hierarchical_allreduce(x: jax.Array, *, intra_axis: str = "intra",
         shard = ring_allreduce(shard, slice_axis, op=inner)     # DCN
     full = ring_allgather(shard, intra_axis).reshape(-1)        # ICI
     return finalize(full[:size].reshape(shape), op, n * m)
+
+
+def _alltoall_1d(x: jax.Array, axis_name: str, algo: str) -> jax.Array:
+    from rocnrdma_tpu.collectives import alltoall as A
+    if algo == "fused":
+        return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)
+    if algo == "rotation":
+        return A.rotation_alltoall(x, axis_name)
+    if algo == "bruck":
+        return A.bruck_alltoall(x, axis_name)
+    raise ValueError(f"unknown per-axis alltoall algo {algo!r}")
+
+
+def hierarchical_alltoall(x: jax.Array, *, intra_axis: str = "intra",
+                          slice_axis: str = "slice",
+                          intra_algo: str = "fused",
+                          cross_algo: str = "fused") -> jax.Array:
+    """Global alltoall over a 2-level ``('slice', 'intra')`` mesh, DCN-light
+    — the cross-slice MoE dispatch path (C7 composed with C13).
+
+    Semantics match the flat alltoall: input leading dim N = m·n in
+    slice-major global-rank order (chunk g is destined for global rank g);
+    output chunk g = what global rank g sent to this rank. The two-phase
+    schedule routes every chunk over ICI first and across the DCN exactly
+    once:
+
+        1. intra-slice alltoall (ICI) of destination-INTRA-INDEX bundles:
+           after it, rank (s, i) holds every block of its slice destined to
+           intra-index i of ANY slice, as ``[src_intra, dest_slice]``.
+        2. cross-slice alltoall (DCN) of destination-slice bundles between
+           same-intra-index ranks: ``[dest_slice]`` columns ship to their
+           slice, arriving as ``[src_slice, src_intra]`` — the final order.
+
+    Per-rank DCN bytes: (m-1)/m · S — the flat-alltoall factor over m ranks,
+    on 1/1 of the buffer, but carried by n parallel same-index pairs per
+    slice instead of every pair crossing (the hierarchical-allreduce
+    bandwidth argument applied to the transpose).
+
+    ``intra_algo``/``cross_algo``: "fused" (one XLA AllToAll; default) or
+    "rotation"/"bruck" for the explicit per-axis schedules.
+    """
+    n = lax.axis_size(intra_axis)
+    m = lax.axis_size(slice_axis)
+    if x.shape[0] != m * n:
+        raise ValueError(f"leading dim {x.shape[0]} != mesh size {m * n}")
+    b = x.reshape(m, n, *x.shape[1:])
+    # phase 1 (ICI): bundle by destination intra-index j — send b[:, j]
+    phase1 = _alltoall_1d(jnp.swapaxes(b, 0, 1), intra_axis, intra_algo)
+    # phase1[i', t] = block from (my_slice, i') destined (t, my_i)
+    # phase 2 (DCN): bundle by destination slice t — send phase1[:, t]
+    out = _alltoall_1d(jnp.swapaxes(phase1, 0, 1), slice_axis, cross_algo)
+    # out[t', i'] = block from global rank (t', i') destined to me
+    return out.reshape(x.shape)

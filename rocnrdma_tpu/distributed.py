@@ -320,7 +320,10 @@ class ProcessGroup:
         disambiguates concurrent streams, torch-style. ``timeout_s`` bounds
         every wait (first-contact rendezvous, backpressure, flush) — raise
         it for slow-consumer peers; blocking semantics are only as patient
-        as this deadline."""
+        as this deadline. A send that RAISES may have left partial frames
+        on the wire; the (peer, tag) stream is then undefined (standard
+        failed-blocking-send semantics) — tear down the group rather than
+        retry. A timed-out recv, by contrast, is cleanly retryable."""
         x = np.asarray(x)
         wire = self._p2p_wire(dst, "tx", timeout_s)
         # counters are per-(direction, tag): tag streams are independently
@@ -339,9 +342,12 @@ class ProcessGroup:
         template = np.asarray(x_like)
         wire = self._p2p_wire(src, "rx", timeout_s)
         seq = self._p2p_seq[src].get(("rx", tag), 0)
-        self._p2p_seq[src][("rx", tag)] = seq + 1
         got = wire.exchange(np.empty(0, np.uint8), template.nbytes,
                             hop=self._p2p_hop(tag, seq))
+        # advance only on success: a timed-out recv put nothing on the wire,
+        # so a retry (with a longer timeout) must re-post the SAME sequence
+        # number or the stream is permanently off by one
+        self._p2p_seq[src][("rx", tag)] = seq + 1
         return got.view(template.dtype).reshape(template.shape)
 
     def barrier(self, timeout_s: float = 30.0) -> None:

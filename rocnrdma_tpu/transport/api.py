@@ -104,6 +104,10 @@ SCHEDULES = {
             C.rotation_alltoall(v, RANK_AXIS),
         "bruck": lambda v, _, op="sum", root=0:
             C.bruck_alltoall(v, RANK_AXIS),
+        # 2-D mesh only: ICI redistribution, one DCN crossing per chunk —
+        # the cross-slice MoE dispatch path (C7 × C13)
+        "hierarchical": lambda v, _, op="sum", root=0:
+            C.hierarchical_alltoall(v),
     },
     # Rooted verbs (the RCCL broadcast/reduce + gather/scatter surface).
     # Off-root rows of reduce/gather outputs are zeroed (deterministic where
@@ -209,7 +213,12 @@ class Transport:
             if tuned is not None and supports(op, tuned, self.is_2d):
                 algo = tuned
         if algo == "auto":
-            algo = "hierarchical" if (self.is_2d and op == "allreduce") else "fused"
+            # 2-D mesh: the DCN-light two-level schedules are the default
+            # for the verbs that have one (cross-slice traffic is the
+            # bottleneck, not ICI)
+            algo = ("hierarchical"
+                    if self.is_2d and op in ("allreduce", "alltoall")
+                    else "fused")
         if not supports(op, algo, self.is_2d):
             raise ValueError(
                 f"op {op!r} has no {algo!r} schedule on a "

@@ -119,6 +119,35 @@ def test_hierarchical_allreduce(devices, slices, intra, cross):
     np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("slices,intra", [(2, 4), (4, 2), (2, 2)])
+@pytest.mark.parametrize("algos", [("fused", "fused"), ("rotation", "bruck")])
+def test_hierarchical_alltoall_is_transpose(devices, slices, intra, algos):
+    """Same transpose semantics as the flat alltoall, over the 2-level mesh
+    (slice-major global rank order)."""
+    N = slices * intra
+    x = _rand(N, N * 3, seed=9).reshape(slices, intra, N, 3)
+    mesh = rt.slice_mesh(slices, intra)
+    ia, ca = algos
+    fn = jax.shard_map(
+        lambda s: C.hierarchical_alltoall(
+            s[0, 0], intra_algo=ia, cross_algo=ca)[None, None],
+        mesh=mesh, in_specs=(P("slice", "intra"),),
+        out_specs=P("slice", "intra"))
+    out = np.asarray(jax.jit(fn)(x)).reshape(N, N, 3)
+    want = x.reshape(N, N, 3).transpose(1, 0, 2)  # the global transpose
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_hierarchical_alltoall_rejects_bad_leading(devices):
+    mesh = rt.slice_mesh(2, 4)
+    fn = jax.shard_map(
+        lambda s: C.hierarchical_alltoall(s[0, 0])[None, None],
+        mesh=mesh, in_specs=(P("slice", "intra"),),
+        out_specs=P("slice", "intra"))
+    with pytest.raises(ValueError, match="leading dim"):
+        jax.jit(fn)(np.zeros((2, 4, 5, 3), np.float32))
+
+
 @pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
 def test_allreduce_dtypes(devices, dtype):
     # bf16 path (BASELINE.json:8). Looser tolerance for bf16 accumulate.

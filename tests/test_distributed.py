@@ -266,6 +266,27 @@ def test_p2p_slow_producer_respects_caller_timeout(sidecar_store):
     np.testing.assert_array_equal(res[1], [3.0])
 
 
+def test_p2p_recv_retry_after_timeout(sidecar_store):
+    """Regression: a timed-out recv must be cleanly retryable — the seq
+    counter only advances on success, so the retry re-posts the SAME wire
+    tag the (late) sender eventually stamps."""
+    import time as _t
+    n = 2
+    store = sidecar_store(n)
+
+    def fn(pg):
+        if pg.rank == 0:
+            _t.sleep(4.0)
+            pg.send(np.array([5.0], np.float32), dst=1)
+            return None
+        with pytest.raises(TimeoutError):
+            pg.recv(np.empty(1, np.float32), src=0, timeout_s=1.0)
+        return pg.recv(np.empty(1, np.float32), src=0, timeout_s=30.0)
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    np.testing.assert_array_equal(res[1], [5.0])
+
+
 def test_broadcast_rejects_bad_src(sidecar_store):
     store = sidecar_store(1)
     pg = dist.init_process_group(rank=0, world_size=1,

@@ -73,8 +73,19 @@ def test_policy_errors(t8, t2d):
 
 def test_auto_policy(t8, t2d):
     assert t8._resolve("auto", "allreduce") == "fused"
+    assert t8._resolve("auto", "alltoall") == "fused"
+    # 2-D mesh: DCN-light two-level schedules by default
     assert t2d._resolve("auto", "allreduce") == "hierarchical"
-    assert t2d._resolve("auto", "alltoall") == "fused"
+    assert t2d._resolve("auto", "alltoall") == "hierarchical"
+
+
+def test_hierarchical_alltoall_on_2d_mesh(t2d):
+    n = 8
+    x = t2d.shard(_rand((2, 4, n, 3), seed=11))
+    out = np.asarray(t2d.alltoall(x, "hierarchical"))
+    want = (np.asarray(x).reshape(n, n, 3).transpose(1, 0, 2)
+            .reshape(2, 4, n, 3))
+    np.testing.assert_allclose(out, want, rtol=1e-6)
 
 
 def test_bf16(t8):
