@@ -9,6 +9,7 @@ completion polling becomes semaphore waits.
 """
 
 from rocnrdma_tpu.ops.ring_pallas import (  # noqa: F401
+    pallas_alltoall,
     pallas_hbm_ring_allreduce,
     pallas_ring_allgather,
     pallas_ring_allreduce,

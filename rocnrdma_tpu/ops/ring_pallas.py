@@ -238,6 +238,89 @@ def pallas_ring_allgather(x: jax.Array, axis_name: str,
 
 
 # ---------------------------------------------------------------------------
+# Alltoall: direct one-sided writes (no relay ring — every chunk takes one
+# remote DMA straight into its destination's output row, the way the
+# reference's one-sided RDMA_WRITE path skipped the send/recv rendezvous)
+
+
+def _global_barrier(axis_name: str, n: int) -> None:
+    """Block until EVERY rank entered the kernel. The neighbour barrier is
+    enough for ring relays (writes only reach neighbours); direct alltoall
+    writes land on arbitrary ranks, so all peers' buffers must exist."""
+    my = lax.axis_index(axis_name)
+    barrier = pltpu.get_barrier_semaphore()
+    for s in range(1, n):
+        pltpu.semaphore_signal(barrier, inc=1, device_id=(my + s) % n,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, n - 1)
+
+
+def _alltoall_kernel(x_ref, o_ref, send_sem, recv_sem, *,
+                     n: int, axis_name: str):
+    """Ships MY chunk for rank my+s straight into that rank's output row
+    ``my``, for every s — ALL n-1 DMAs in flight at once, then a drain of
+    n-1 send completions and n-1 arrivals (any order). Rows are distinct,
+    written-exactly-once destinations, so nothing forces serialization: no
+    comm slots, no credits, just the counting semaphores."""
+    my = lax.axis_index(axis_name)
+    o_ref[my] = x_ref[my]
+    _global_barrier(axis_name, n)
+    copies = []
+    for s in range(1, n):
+        dst = (my + s) % n
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[dst],   # my chunk destined for rank dst
+            dst_ref=o_ref.at[my],    # lands in THEIR row for source ``my``
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        copies.append(rdma)
+    for rdma in copies:
+        rdma.wait()
+
+
+def pallas_alltoall(x: jax.Array, axis_name: str,
+                    interpret: bool | None = None) -> jax.Array:
+    """Alltoall over ``axis_name``, one-sided remote-DMA data plane.
+
+    Same transpose semantics as ``collectives.rotation_alltoall``: input
+    leading dim n, chunk d destined for rank d; output chunk j = what rank
+    j sent here. Unlike the relay schedules (rotation: n-1 neighbour hops
+    per chunk budget; net-plugin train: forwarding), every chunk here takes
+    exactly ONE DMA to its destination — the ICI fabric routes it — which
+    is the wire-optimal alltoall and the device-side MoE dispatch tier.
+    """
+    n = lax.axis_size(axis_name)
+    if x.shape[0] != n:
+        raise ValueError(f"leading dim {x.shape[0]} != axis size {n}")
+    if n == 1:
+        return x
+    # pad each chunk ROW-wise to lanes (padding the flattened whole, as the
+    # ring kernels do, would shift chunk boundaries off the row boundaries)
+    rows = x.reshape(n, -1)
+    per = rows.shape[1]
+    pad = (-per) % 128
+    buf = jnp.pad(rows, ((0, 0), (0, pad))).reshape(n, -1, 128)
+    kern = functools.partial(_alltoall_kernel, n=n, axis_name=axis_name)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,   # outbound sends (serialized)
+            pltpu.SemaphoreType.DMA,   # inbound arrivals (counting)
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=4),
+        interpret=_interpret_mode(interpret),
+    )(buf)
+    return out.reshape(n, -1)[:, :per].reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
 # HBM-resident tier: stream tiles through VMEM staging around the ring
 
 

@@ -108,6 +108,9 @@ SCHEDULES = {
         # the cross-slice MoE dispatch path (C7 × C13)
         "hierarchical": lambda v, _, op="sum", root=0:
             C.hierarchical_alltoall(v),
+        # direct one-sided remote-DMA writes (one DMA per chunk, no relay)
+        "pallas_ring": lambda v, _, op="sum", root=0:
+            _pallas().pallas_alltoall(v, RANK_AXIS),
     },
     # Rooted verbs (the RCCL broadcast/reduce + gather/scatter surface).
     # Off-root rows of reduce/gather outputs are zeroed (deterministic where

@@ -68,6 +68,9 @@ _MODEL = {
     ("allgather", "pallas_ring"): lambda n: (n - 1, (n - 1) / n),
     ("alltoall", "ring"): lambda n: (n - 1, (n - 1) / n),   # rotation
     ("alltoall", "bruck"): lambda n: (_L(n), _L(n) / 2),
+    # direct one-sided writes, all n-1 DMAs concurrent: one latency step,
+    # the alltoall bandwidth factor
+    ("alltoall", "pallas_ring"): lambda n: (1, (n - 1) / n),
     ("broadcast", "binomial"): lambda n: (_L(n), _L(n)),
     ("reduce", "binomial"): lambda n: (_L(n), _L(n)),
     ("gather", "binomial"): lambda n: (_L(n), (n - 1) / n),

@@ -8,6 +8,7 @@ from jax.sharding import PartitionSpec as P
 
 from rocnrdma_tpu import runtime as rt
 from rocnrdma_tpu.ops import (
+    pallas_alltoall,
     pallas_ring_allgather,
     pallas_ring_allreduce,
     pallas_ring_reduce_scatter,
@@ -53,6 +54,24 @@ def test_pallas_allgather(devices, n):
     out = np.asarray(f(x)).reshape(n, n, 700)
     for r in range(n):
         np.testing.assert_allclose(out[r], x, rtol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 3, 8])
+def test_pallas_alltoall_is_transpose(devices, n):
+    # 77 trailing elems: deliberately lane-unaligned per chunk
+    x = np.random.default_rng(n).standard_normal((n, n, 77)).astype(np.float32)
+    f = _shmap(lambda s: pallas_alltoall(s[0], RANK)[None], n)
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, x.transpose(1, 0, 2), rtol=1e-6)
+
+
+def test_pallas_alltoall_involution(devices):
+    n = 4
+    x = np.random.default_rng(0).standard_normal((n, n, 128)).astype(np.float32)
+    f = _shmap(lambda s: pallas_alltoall(
+        pallas_alltoall(s[0], RANK), RANK)[None], n)
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, x, rtol=1e-6)
 
 
 def test_pallas_via_transport(devices):

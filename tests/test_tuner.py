@@ -141,10 +141,16 @@ def test_autotune_sweep_and_use():
 def test_model_policy_via_transport():
     mesh = rt.rank_mesh(8)
     t = Transport(mesh)
-    # small alltoall: the model picks the log-step schedule
-    assert t._resolve("model", "alltoall", nbytes=256) == "bruck"
-    # large alltoall: rotation moves fewer wire bytes
-    assert t._resolve("model", "alltoall", nbytes=64 * M.MiB) == "ring"
+    # small alltoall: one latency step beats every relay schedule
+    assert t._resolve("model", "alltoall", nbytes=256) == "pallas_ring"
+    # among the relay schedules, small favors the log-step one
+    assert model_pick("alltoall", 8, 256,
+                      candidates=("ring", "bruck")) == "bruck"
+    # large alltoall: pallas_ring and rotation tie on wire bytes, and one
+    # step still beats n-1
+    assert t._resolve("model", "alltoall", nbytes=64 * M.MiB) == "pallas_ring"
+    assert model_pick("alltoall", 8, 64 * M.MiB,
+                      candidates=("ring", "bruck")) == "ring"
     # no size available -> model degrades to auto's static default
     assert t._resolve("model", "allreduce", nbytes=None) == "fused"
     # end-to-end: model-resolved collective still computes correctly
