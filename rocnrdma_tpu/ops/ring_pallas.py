@@ -39,19 +39,23 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _neighbour_barrier(axis_name: str, n: int) -> None:
-    """Block until both ring neighbours entered the kernel: remote writes may
-    only start once the peer's buffers exist (the bootstrap handshake the
-    reference did over its out-of-band TCP exchange)."""
+def _entry_barrier(axis_name: str, n: int, offsets) -> None:
+    """Block until every rank at the given ring ``offsets`` entered the
+    kernel: remote writes may only start once the peer's buffers exist (the
+    bootstrap handshake the reference did over its out-of-band TCP
+    exchange). Ring relays pass ``(-1, +1)`` (writes only reach
+    neighbours); direct alltoall passes ``range(1, n)`` (writes land on
+    arbitrary ranks)."""
     my = lax.axis_index(axis_name)
     barrier = pltpu.get_barrier_semaphore()
-    left = (my - 1) % n
-    right = (my + 1) % n
-    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                           device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_wait(barrier, 2)
+    for off in offsets:
+        pltpu.semaphore_signal(barrier, inc=1, device_id=(my + off) % n,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, len(offsets))
+
+
+def _neighbour_barrier(axis_name: str, n: int) -> None:
+    _entry_barrier(axis_name, n, (-1, 1))
 
 
 def _ring_hops(o_ref, comm_buf, send_sem, recv_sem, caps_sem, *,
@@ -244,15 +248,7 @@ def pallas_ring_allgather(x: jax.Array, axis_name: str,
 
 
 def _global_barrier(axis_name: str, n: int) -> None:
-    """Block until EVERY rank entered the kernel. The neighbour barrier is
-    enough for ring relays (writes only reach neighbours); direct alltoall
-    writes land on arbitrary ranks, so all peers' buffers must exist."""
-    my = lax.axis_index(axis_name)
-    barrier = pltpu.get_barrier_semaphore()
-    for s in range(1, n):
-        pltpu.semaphore_signal(barrier, inc=1, device_id=(my + s) % n,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
-    pltpu.semaphore_wait(barrier, n - 1)
+    _entry_barrier(axis_name, n, range(1, n))
 
 
 def _alltoall_kernel(x_ref, o_ref, send_sem, recv_sem, *,

@@ -10,6 +10,10 @@ Tasks:
 - ``allreduce``: global psum across all processes' devices via a jitted
   computation over a global 1-D mesh; every rank checks the result.
 - ``alltoall``: same plumbing for the MoE primitive.
+- ``hierarchical``: the REAL multi-slice code path — each process hosts 2
+  fake devices (one "slice"), a 2-D ``('slice','intra')`` mesh spans the
+  process boundary (the DCN analogue), and the Transport's hierarchical
+  allreduce AND alltoall schedules run over it (C6/C7 x C13).
 - ``fault``: ``--fault-rank`` exits(3) BEFORE the init barrier; the others
   must fail their (deadline-bounded) initialize with a clean error — the
   coordinator-timeout surfacing disposition of SURVEY.md §5.
@@ -26,7 +30,8 @@ def main(argv=None) -> int:
     p.add_argument("--coordinator", required=True)
     p.add_argument("--num-processes", type=int, required=True)
     p.add_argument("--process-id", type=int, required=True)
-    p.add_argument("--task", choices=("allreduce", "alltoall", "fault"),
+    p.add_argument("--task",
+                   choices=("allreduce", "alltoall", "hierarchical", "fault"),
                    required=True)
     p.add_argument("--fault-rank", type=int, default=0)
     args = p.parse_args(argv)
@@ -34,7 +39,10 @@ def main(argv=None) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    # hierarchical: each process is one SLICE hosting 2 devices, so the
+    # slice axis crosses the process boundary (the DCN analogue)
+    jax.config.update("jax_num_cpu_devices",
+                      2 if args.task == "hierarchical" else 1)
 
     from rocnrdma_tpu.runtime.init import init_runtime
 
@@ -64,12 +72,43 @@ def main(argv=None) -> int:
     topo = info.topology
     n = topo.n_devices
     assert topo.n_processes == args.num_processes, topo
+    rank = args.process_id
+
+    if args.task == "hierarchical":
+        # the Transport's 2-level schedules over a mesh whose slice axis IS
+        # the process boundary: slice i = process i's 2 local devices
+        from rocnrdma_tpu.transport import Transport
+
+        n_slices = args.num_processes
+        mesh2 = rt.slice_mesh(n_slices, 2)
+        sharding2 = NamedSharding(mesh2, P("slice", "intra"))
+        nr = n_slices * 2
+        rng = np.random.default_rng(7)  # same seed every process
+        full = rng.standard_normal((n_slices, 2, nr, 8)).astype(np.float32)
+        garr2 = jax.make_array_from_process_local_data(
+            sharding2, full[rank:rank + 1], full.shape)
+        t = Transport(mesh2)
+
+        def check(verb, want_global):
+            out = t.jit_fn(verb, "hierarchical")(garr2)
+            for shard in out.addressable_shards:  # compare by global index
+                np.testing.assert_allclose(np.asarray(shard.data),
+                                           want_global[shard.index],
+                                           rtol=1e-5, atol=1e-6)
+
+        check("allreduce",
+              np.broadcast_to(full.sum((0, 1)), full.shape))
+        check("alltoall",
+              full.reshape(nr, nr, 8).transpose(1, 0, 2)
+                  .reshape(n_slices, 2, nr, 8))
+        print(f"OK rank={rank}/{args.num_processes} hierarchical", flush=True)
+        jax.distributed.shutdown()
+        return 0
+
     mesh = rt.rank_mesh(n)
     sharding = NamedSharding(mesh, P("rank"))
-
     # each process contributes its local row; make the global array from
     # per-process shards (the multi-host jax.Array construction path)
-    rank = args.process_id
     local = np.full((1, 8), float(rank + 1), np.float32)
     garr = jax.make_array_from_process_local_data(sharding, local, (n, 8))
 

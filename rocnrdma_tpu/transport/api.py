@@ -201,9 +201,16 @@ class Transport:
         if algo == "model":
             # analytic alpha-beta pick among the explicit schedules this mesh
             # supports; Transport-level policy only (not a bench algo — a
-            # timed "model" row would just duplicate whichever schedule won)
+            # timed "model" row would just duplicate whichever schedule won).
+            # Pallas candidates only exist on real TPU: everywhere else the
+            # kernels run in interpret mode, orders of magnitude off the
+            # model's wire-cost assumptions (same exclusion the Autotuner's
+            # sweep applies).
             from rocnrdma_tpu.transport.tuner import model_pick
-            cands = [a for a in SCHEDULES[op] if supports(op, a, self.is_2d)]
+            plat = self.mesh.devices.flat[0].platform
+            cands = [a for a in SCHEDULES[op]
+                     if supports(op, a, self.is_2d)
+                     and (plat == "tpu" or not a.startswith("pallas"))]
             picked = (model_pick(op, self.n_ranks, nbytes, candidates=cands)
                       if nbytes is not None else None)
             algo = picked or "auto"
@@ -388,13 +395,26 @@ class Transport:
                     f"premul requires op='sum' (the ncclRedOpCreatePreMulSum "
                     f"semantics), got op={knobs['op']!r}")
             knobs["premul"] = float(knobs["premul"])  # one cache key per value
+        if knobs.get("donate") is not None:
+            knobs["donate"] = bool(knobs["donate"])
         return {k: v for k, v in knobs.items()
                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
                 and not (k == "shift" and v == 1) and not (k == "acc" and v is None)
-                and not (k == "premul" and v is None)}
+                and not (k == "premul" and v is None)
+                and not (k == "donate" and not v)}
+
+    # verbs whose output shape differs from the input: donating would save
+    # nothing (XLA cannot reuse the buffer) while still invalidating the
+    # caller's array — a silent footgun, rejected up front
+    _SHAPE_CHANGING = ("reduce_scatter", "allgather", "gather", "scatter")
 
     def _jit(self, verb: str, algo: str, **knobs):
         knobs = self._normalize_knobs(**knobs)
+        if knobs.get("donate") and verb in self._SHAPE_CHANGING:
+            raise ValueError(
+                f"donate=True is useless on {verb!r}: its output shape "
+                f"differs from the input, so nothing is reused but the "
+                f"input buffer would still be invalidated")
         key = (verb, algo, tuple(sorted(knobs.items())))
         if key not in self._cache:
             self._cache[key] = self._build(verb, algo, **knobs)
@@ -435,6 +455,12 @@ class Transport:
         schedule = SCHEDULES[verb].get(algo)
         if schedule is None:
             raise ValueError(f"op {verb!r} has no {algo!r} schedule")
+        # ``donate``: hand the input buffer to XLA for in-place reuse — the
+        # zero-copy/user-buffer-registration analogue (ncclCommRegister /
+        # hipMemRegister): collectives whose output matches the input
+        # shape+sharding run without a second HBM allocation. The caller
+        # must treat the input as consumed (jax invalidates it).
+        donate = knobs.pop("donate", False)
         # ``acc``: accumulate in a wider dtype and cast back (the NCCL/RCCL
         # fp32-accumulation-for-bf16 behavior) — algorithm-agnostic, so it
         # wraps the schedule instead of threading through each one
@@ -465,4 +491,4 @@ class Transport:
         shmapped = jax.shard_map(local(fn), mesh=self.mesh,
                                  in_specs=(spec,), out_specs=spec,
                                  check_vma=not algo.startswith("pallas"))
-        return jax.jit(shmapped)
+        return jax.jit(shmapped, donate_argnums=(0,) if donate else ())

@@ -90,14 +90,20 @@ def model_time(verb: str, algo: str, n: int, nbytes: int,
 
 def model_pick(verb: str, n: int, nbytes: int, candidates=None,
                alpha: float = ALPHA_S, beta: float = BETA_S_PER_B) -> str | None:
-    """Cheapest modeled algorithm for this point, or None if none modeled."""
-    best, best_t = None, float("inf")
+    """Cheapest modeled algorithm for this point, or None if none modeled.
+
+    Ties break EXPLICITLY toward the non-pallas schedule (several pallas
+    rows model identically to their XLA-wire twins — same schedule, custom
+    data plane — and the XLA twin is the safer default), then toward
+    declaration order for determinism."""
+    best, best_key = None, (float("inf"), True)
     for (v, algo), _ in _MODEL.items():
         if v != verb or (candidates is not None and algo not in candidates):
             continue
-        t = model_time(verb, algo, n, nbytes, alpha, beta)
-        if t < best_t:
-            best, best_t = algo, t
+        key = (model_time(verb, algo, n, nbytes, alpha, beta),
+               algo.startswith("pallas"))
+        if key < best_key:
+            best, best_key = algo, key
     return best
 
 

@@ -18,6 +18,16 @@ def test_two_process_collective(task):
         assert f"OK rank={r.process_id}/2" in r.stdout
 
 
+def test_two_process_hierarchical_dcn_path():
+    """The real C13 shape: 2 processes x 2 devices, ('slice','intra') mesh
+    with the slice axis ON the process boundary; the Transport's
+    hierarchical allreduce and alltoall run over it."""
+    results = run_workers(2, "hierarchical", timeout_s=240)
+    for r in results:
+        assert r.returncode == 0, f"rank {r.rank}:\n{r.stdout}\n{r.stderr}"
+        assert "hierarchical" in r.stdout
+
+
 def test_fault_injection_clean_abort():
     # rank 1 dies before the init barrier; rank 0 (the coordinator) must
     # abort within its deadline — NOT hang (SURVEY.md §5). Depending on the

@@ -79,6 +79,24 @@ def test_auto_policy(t8, t2d):
     assert t2d._resolve("auto", "alltoall") == "hierarchical"
 
 
+def test_donated_buffer_consumed_and_correct(t8):
+    """donate=True (the ncclCommRegister/zero-copy analogue): the result is
+    right AND the input buffer is actually handed to XLA (invalidated)."""
+    x = t8.shard(_rand((8, 64), seed=13))
+    want = np.asarray(x).sum(0)
+    fn = t8.jit_fn("allreduce", "fused", donate=True)
+    out = np.asarray(fn(x))
+    np.testing.assert_allclose(out[0], want, rtol=1e-6)
+    assert x.is_deleted()
+    # non-donated path untouched by the knob (separate cache entries)
+    y = t8.shard(_rand((8, 64), seed=14))
+    t8.allreduce(y, "fused")
+    assert not y.is_deleted()
+    # shape-changing verbs reject the useless donation up front
+    with pytest.raises(ValueError, match="donate"):
+        t8.jit_fn("allgather", "fused", donate=True)
+
+
 def test_hierarchical_alltoall_on_2d_mesh(t2d):
     n = 8
     x = t2d.shard(_rand((2, 4, n, 3), seed=11))

@@ -141,16 +141,19 @@ def test_autotune_sweep_and_use():
 def test_model_policy_via_transport():
     mesh = rt.rank_mesh(8)
     t = Transport(mesh)
-    # small alltoall: one latency step beats every relay schedule
-    assert t._resolve("model", "alltoall", nbytes=256) == "pallas_ring"
-    # among the relay schedules, small favors the log-step one
-    assert model_pick("alltoall", 8, 256,
-                      candidates=("ring", "bruck")) == "bruck"
-    # large alltoall: pallas_ring and rotation tie on wire bytes, and one
-    # step still beats n-1
-    assert t._resolve("model", "alltoall", nbytes=64 * M.MiB) == "pallas_ring"
-    assert model_pick("alltoall", 8, 64 * M.MiB,
-                      candidates=("ring", "bruck")) == "ring"
+    # platform gate: on the CPU oracle the model never picks the pallas
+    # plane (interpret mode is orders of magnitude off the wire model);
+    # among the relay schedules small favors the log-step one, large the
+    # fewer-wire-bytes rotation
+    assert t._resolve("model", "alltoall", nbytes=256) == "bruck"
+    assert t._resolve("model", "alltoall", nbytes=64 * M.MiB) == "ring"
+    # the raw model (TPU candidates) ranks the direct-DMA alltoall first:
+    # one latency step, same wire bytes as rotation
+    assert model_pick("alltoall", 8, 256) == "pallas_ring"
+    assert model_pick("alltoall", 8, 64 * M.MiB) == "pallas_ring"
+    # ties between a pallas row and its XLA-wire twin break to the twin
+    assert model_pick("allreduce", 8, 64 * M.MiB,
+                      candidates=("ring", "pallas_ring")) == "ring"
     # no size available -> model degrades to auto's static default
     assert t._resolve("model", "allreduce", nbytes=None) == "fused"
     # end-to-end: model-resolved collective still computes correctly
