@@ -79,6 +79,9 @@ class ProcessGroup:
             self._net.close()
             raise
         self._barrier_no = 0
+        self._watchdog = None
+        self._watchdog_failed = None
+        self._dead: list[int] = []
         self._p2p: dict[tuple, "plugin._RingWire"] = {}  # (peer, dir) -> wire
         self._p2p_seq: dict[int, dict] = {}     # peer -> (dir, tag) -> seq
         self._p2p_listen: dict | None = None    # peer -> listener, once used
@@ -91,6 +94,7 @@ class ProcessGroup:
     # -- collectives (numpy in, numpy out) ---------------------------------
 
     def _ring(self, fn, *args, **kw):
+        self._check_alive()  # fail fast instead of hanging on the dead
         return fn(self._net, self._send, self._recv, *args, **kw)
 
     def all_reduce(self, x, op: str = "sum",
@@ -280,6 +284,7 @@ class ProcessGroup:
         if not 0 <= peer < self.world_size or peer == self.rank:
             raise ValueError(f"bad peer {peer} for rank {self.rank} "
                              f"(world_size {self.world_size})")
+        self._check_alive()
         wire = self._p2p.get((peer, direction))
         if wire is None:
             self._p2p_publish()
@@ -354,6 +359,7 @@ class ProcessGroup:
         """Block until every rank arrives."""
         if self.world_size == 1:
             return
+        self._check_alive()
         self._barrier_no += 1
         self._client.barrier(f"pg/{self.group_name}/b{self._barrier_no}",
                              self.world_size, timeout_s)
@@ -397,6 +403,7 @@ class ProcessGroup:
         returns None. Collective — every rank of this group must call it."""
         if self._destroyed:
             raise RuntimeError("cannot split a destroyed group")
+        self._check_alive()  # exchange() can never complete with a dead rank
         self._split_no += 1
         if self.world_size == 1:
             return ProcessGroup(0, 1, None, None, timeout_s,
@@ -470,6 +477,127 @@ class ProcessGroup:
             server, timeout_s, f"{self.group_name}/shrunk{self._shrink_no}",
             plane=self.plane)
 
+    # -- watchdog (the ProcessGroupNCCL watchdog / RCCL heartbeat analogue) --
+
+    def start_watchdog(self, interval_s: float = 1.0,
+                       timeout_s: float = 5.0) -> None:
+        """Asynchronous failure detection: a daemon thread publishes this
+        rank's heartbeat and watches its nearest alive RIGHT NEIGHBOUR's
+        (ring watching — O(1) store RPCs per rank per tick, the same
+        aggregate-load discipline as ``monitored_barrier``, vs O(n^2) for
+        full-mesh polling). A stalled — or never-published, same grace —
+        neighbour is flagged under a shared death key every rank polls, the
+        watcher re-targets the next alive rank (so adjacent deaths are
+        flagged in sequence), and the NEXT collective/p2p call raises
+        naming the dead instead of hanging to a wire timeout (the watchdog
+        role of the reference stack's NCCL/RCCL process groups). Every
+        rank should start its watchdog at about the same time: a rank that
+        delays past ``timeout_s`` reads as dead to its left neighbour.
+
+        The thread uses its OWN store connection (the RPC protocol is
+        strict request->reply lockstep per connection, so sharing the main
+        client across threads would interleave frames). If the thread
+        itself dies (store unreachable), that is recorded and surfaced by
+        the next verb — a broken detector must not masquerade as a quiet
+        one."""
+        if self.world_size == 1:
+            return
+        if self._watchdog is not None and self._watchdog.is_alive():
+            return
+        import threading
+        import time
+        self._watchdog_stop = threading.Event()
+        self._watchdog_failed = None
+        self._dead = []
+        ns = f"pg/{self.group_name}/hb"
+
+        def run():
+            client = None
+            try:
+                client = bootstrap.BootstrapClient(self._store_handle,
+                                                   self.rank)
+                beat = 0
+                seen: dict[int, tuple] = {}  # target -> (value, stamp)
+                dead: set[int] = set()
+                last_event = None
+
+                def get0(key):
+                    try:
+                        return client.get(key, timeout_s=0.0)
+                    except TimeoutError:
+                        return None
+
+                while not self._watchdog_stop.is_set():
+                    beat += 1
+                    try:
+                        client.set(f"{ns}/{self.rank}", str(beat))
+                        # death-event key: one get per tick; a sweep of the
+                        # per-victim keys only when its value changes
+                        ev = get0(f"{ns}/dead_v")
+                        if ev != last_event:
+                            last_event = ev
+                            for p in range(self.world_size):
+                                if p != self.rank and p not in dead \
+                                        and get0(f"{ns}/dead/{p}") is not None:
+                                    dead.add(p)
+                            self._dead = sorted(dead)
+                        # watch my nearest alive right neighbour
+                        target = next(
+                            (c for off in range(1, self.world_size)
+                             for c in [(self.rank + off) % self.world_size]
+                             if c not in dead), None)
+                        if target is not None:
+                            now = time.monotonic()
+                            hv = get0(f"{ns}/{target}")
+                            s = seen.get(target)
+                            if s is None or s[0] != hv:
+                                # first sight, or it beat: (re)stamp. A key
+                                # that NEVER publishes keeps hv=None and
+                                # times out below like any stalled beat.
+                                seen[target] = (hv, now)
+                            elif now - s[1] > timeout_s:
+                                dead.add(target)
+                                self._dead = sorted(dead)
+                                client.set(f"{ns}/dead/{target}", "1")
+                                client.set(f"{ns}/dead_v",
+                                           f"{self.rank}:{beat}")
+                    except TimeoutError:
+                        pass  # one slow store RPC: keep ticking, not die
+                    self._watchdog_stop.wait(interval_s)
+            except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+                self._watchdog_failed = repr(e)
+            finally:
+                if client is not None:
+                    client.close()
+
+        self._watchdog = threading.Thread(target=run, daemon=True)
+        self._watchdog.start()
+
+    def dead_ranks(self) -> list:
+        """Peers the watchdog currently considers dead (empty without a
+        running watchdog)."""
+        return list(self._dead)
+
+    def _check_alive(self) -> None:
+        if self._watchdog_failed:
+            raise RuntimeError(
+                f"watchdog thread died ({self._watchdog_failed}); failure "
+                f"detection is OFF for group {self.group_name!r} — "
+                f"start_watchdog() again or destroy")
+        if self._dead:
+            raise RuntimeError(
+                f"watchdog: rank(s) {self._dead} stopped heartbeating "
+                f"(group {self.group_name!r}); shrink() or destroy "
+                f"(a collective would hang on the dead)")
+
+    def stop_watchdog(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog_stop.set()
+            self._watchdog.join(timeout=5.0)
+            self._watchdog = None
+            self._watchdog_failed = None
+            self._dead = []
+
     # -- lifecycle ---------------------------------------------------------
 
     def destroy(self, graceful: bool = True) -> None:
@@ -482,6 +610,7 @@ class ProcessGroup:
         if self._destroyed:
             return
         self._destroyed = True
+        self.stop_watchdog()
         if self._client is not None:
             if graceful:
                 try:

@@ -630,6 +630,115 @@ def test_shrink_single_rank_raises():
     pg.destroy()
 
 
+def test_watchdog_quiet_when_all_alive(sidecar_store):
+    n = 2
+    store = sidecar_store(n)
+
+    def fn(pg):
+        pg.start_watchdog(interval_s=0.2, timeout_s=2.0)
+        import time as _t
+        _t.sleep(1.0)  # several beats
+        out = pg.all_reduce(np.ones(4, np.float32))  # verbs still work
+        assert pg.dead_ranks() == []
+        pg.stop_watchdog()
+        return out
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    for r in res:
+        np.testing.assert_array_equal(r, np.full(4, 2.0, np.float32))
+
+
+def test_watchdog_flags_never_published_peer(sidecar_store):
+    """Regression: a peer that NEVER publishes a heartbeat (died before its
+    first beat, or never started its watchdog) must be flagged after the
+    same grace as a stalled one — not ignored forever."""
+    import time as _t
+    n = 2
+    store = sidecar_store(n)
+
+    def fn(pg):
+        if pg.rank == 1:
+            _t.sleep(6.0)  # alive but silent: no watchdog, no heartbeat
+            return None
+        pg.start_watchdog(interval_s=0.2, timeout_s=1.5)
+        deadline = _t.monotonic() + 10
+        while pg.dead_ranks() != [1]:
+            assert _t.monotonic() < deadline, "never-published peer not flagged"
+            _t.sleep(0.1)
+        with pytest.raises(RuntimeError, match=r"watchdog.*\[1\]"):
+            pg.all_reduce(np.ones(2, np.float32))
+        pg.stop_watchdog()
+        return True
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res[0] is True
+
+
+def test_watchdog_detects_real_killed_rank(tmp_path):
+    """The async failure detector: SIGKILL a rank mid-job; survivors' NEXT
+    collective raises naming it (no hang), then they shrink and finish."""
+    import signal
+    import subprocess
+    import sys
+    import time as _t
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    n = 3
+    script = tmp_path / "watchdog.py"
+    script.write_text("""
+import sys, time
+import numpy as np
+from rocnrdma_tpu import distributed as dist
+
+pg = dist.init_process_group()
+pg.barrier()
+pg.start_watchdog(interval_s=0.3, timeout_s=2.5)
+if pg.rank == 1:
+    open(sys.argv[1], "w").write("parked")
+    time.sleep(120)   # parked until SIGKILLed
+deadline = time.monotonic() + 30
+while pg.dead_ranks() != [1]:
+    assert time.monotonic() < deadline, "watchdog never flagged rank 1"
+    time.sleep(0.1)
+try:
+    pg.all_reduce(np.ones(3, np.float32))
+    raise SystemExit("collective ran against a dead rank!")
+except RuntimeError as e:
+    assert "watchdog" in str(e) and "[1]" in str(e), e
+sub = pg.shrink(grace_s=2.0)
+out = sub.all_reduce(np.full(4, float(pg.rank + 1), np.float32))
+sub.destroy()
+pg.destroy(graceful=False)
+assert np.all(out == 4.0), out
+print("rank", pg.rank, "watchdog ok", flush=True)
+""")
+    park = tmp_path / "parked"
+    procs = []
+    for r in range(n):
+        import os
+        env = dict(os.environ, RANK=str(r), WORLD_SIZE=str(n),
+                   MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(park)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    try:
+        deadline = _t.monotonic() + 60
+        while not park.exists():
+            assert _t.monotonic() < deadline, "rank 1 never parked"
+            _t.sleep(0.1)
+        procs[1].send_signal(signal.SIGKILL)
+        for r in (0, 2):
+            out, _ = procs[r].communicate(timeout=90)
+            assert procs[r].returncode == 0, f"rank {r}:\n{out}"
+            assert f"rank {r} watchdog ok" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 def test_shrink_real_process_killed(tmp_path):
     """The real thing: SIGKILL one worker mid-job; survivors shrink and
     finish with a correct reduced result."""
