@@ -54,9 +54,9 @@ def _issue(pg, collective: str, x: np.ndarray, transport: str = "msg"):
     if collective == "allreduce":
         return pg.all_reduce(x, transport=transport)
     if collective == "reducescatter":
-        return pg.reduce_scatter(x)
+        return pg.reduce_scatter(x, transport=transport)
     if collective == "allgather":
-        return pg.all_gather(x)
+        return pg.all_gather(x, transport=transport)
     if collective == "broadcast":
         return pg.broadcast(x, src=0)
     if collective == "alltoall":
@@ -91,7 +91,8 @@ def worker(args) -> int:
             sec = float(pg.all_reduce(np.array([mine]), op="max")[0])
             if pg.rank == 0:
                 algo = ("ring_rdma" if args.transport == "rdma"
-                        and collective == "allreduce" else "ring")
+                        and collective in ("allreduce", "reducescatter",
+                                           "allgather") else "ring")
                 records.append(M.BenchRecord.measure(
                     "bench_host", collective, algo, pg.world_size, actual,
                     "float32", sec, platform=f"host-{args.plane}",
@@ -113,8 +114,10 @@ def main(argv=None) -> int:
                    help="wire under the ring: TCP (cross-host) or shared "
                         "memory (intra-node)")
     p.add_argument("--transport", choices=("msg", "rdma"), default="msg",
-                   help="allreduce data path: two-sided send/recv or "
-                        "one-sided RDMA writes (put-based ring)")
+                   help="data path for the reducing/gather rings "
+                        "(allreduce, reducescatter, allgather): two-sided "
+                        "send/recv or one-sided RDMA writes (put-based "
+                        "ring); broadcast/alltoall always ride send/recv")
     p.add_argument("--sizes", default="64K,1M")
     p.add_argument("--collectives", default=",".join(COLLECTIVES))
     p.add_argument("--repeats", type=int, default=5)

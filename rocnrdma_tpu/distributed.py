@@ -45,6 +45,12 @@ from rocnrdma_tpu.transport import (
 _PLANES = {"tcp": TCPNet, "shm": HostQPNet}
 
 
+def _check_transport(transport: str) -> None:
+    if transport not in ("msg", "rdma"):
+        raise ValueError(f"unknown transport {transport!r}; "
+                         f"know ('msg', 'rdma')")
+
+
 class ProcessGroup:
     """N ranks wired in a TCP ring with a shared rendezvous store.
 
@@ -105,9 +111,7 @@ class ProcessGroup:
         put-based ring — data written straight into peer MRs with doorbell
         flags, no posted receives on the data path)."""
         x = np.asarray(x)
-        if transport not in ("msg", "rdma"):  # validate even at world size 1
-            raise ValueError(f"unknown transport {transport!r}; "
-                             f"know ('msg', 'rdma')")
+        _check_transport(transport)  # validate even at world size 1
         wire_op = self._avg_wire_op(x, op, "all_reduce")
         if self.world_size == 1:
             return x.copy()
@@ -116,25 +120,33 @@ class ProcessGroup:
         out = self._ring(fn, x, self.rank, self.world_size, op=wire_op)
         return self._avg_finalize(out, x, op)
 
-    def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
+    def reduce_scatter(self, x, op: str = "sum",
+                       transport: str = "msg") -> np.ndarray:
         """Reduce across ranks (op: sum/prod/max/min/avg); rank r keeps the
-        r-th of n floor-balanced element ranges of the flattened buffer."""
+        r-th of n floor-balanced element ranges of the flattened buffer.
+        ``transport``: ``"msg"`` (send/recv ring) or ``"rdma"`` (one-sided
+        put-based ring, as in :meth:`all_reduce`)."""
         x = np.asarray(x)
+        _check_transport(transport)
         wire_op = self._avg_wire_op(x, op, "reduce_scatter")
         if self.world_size == 1:
             return x.ravel().copy()
-        out = self._ring(plugin.ring_reduce_scatter_over_net, x, self.rank,
-                         self.world_size, op=wire_op)
+        fn = (plugin.ring_reduce_scatter_rdma if transport == "rdma"
+              else plugin.ring_reduce_scatter_over_net)
+        out = self._ring(fn, x, self.rank, self.world_size, op=wire_op)
         return self._avg_finalize(out, x, op)
 
-    def all_gather(self, x) -> np.ndarray:
+    def all_gather(self, x, transport: str = "msg") -> np.ndarray:
         """Every rank contributes ``x`` (same shape everywhere); returns
-        ``(world_size, *x.shape)`` in rank order."""
+        ``(world_size, *x.shape)`` in rank order. ``transport`` as in
+        :meth:`all_reduce`."""
         x = np.asarray(x)
+        _check_transport(transport)
         if self.world_size == 1:
             return x[None].copy()
-        return self._ring(plugin.ring_allgather_over_net, x, self.rank,
-                          self.world_size)
+        fn = (plugin.ring_allgather_rdma if transport == "rdma"
+              else plugin.ring_allgather_over_net)
+        return self._ring(fn, x, self.rank, self.world_size)
 
     def broadcast(self, x, src: int = 0) -> np.ndarray:
         """Every rank returns rank ``src``'s buffer (non-src inputs size the

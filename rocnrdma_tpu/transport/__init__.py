@@ -23,7 +23,9 @@ from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     TCPNet,
     ring_allgather_over_net,
     ring_allreduce_over_net,
+    ring_allgather_rdma,
     ring_allreduce_rdma,
+    ring_reduce_scatter_rdma,
     ring_alltoallv_over_net,
     ring_gather_over_net,
     ring_reduce_over_net,

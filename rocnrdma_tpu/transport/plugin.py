@@ -699,30 +699,18 @@ def _rdma_ring_state(net, send_comm, recv_comm, cap: int):
     return state
 
 
-def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
-                        rank: int, n_ranks: int, op: str = "sum",
-                        timeout_s: float = 30.0) -> np.ndarray:
-    """Ring allreduce whose DATA PATH is one-sided RDMA writes.
-
-    The put-based ring of real RDMA transports: each hop writes its chunk
-    straight into the successor's registered MR, then writes the hop number
-    as a doorbell flag; the receiver polls the flag, consumes, and writes a
-    credit back into the predecessor's MR so slots recycle safely (2-slot
-    double buffering). No posted receives and no recv CQEs on the data
-    path — only the one-time rkey exchange uses send/recv. Works on both
-    host planes: shm (direct memcpy through the shared arena, fenced) and
-    TCP (soft-NIC frames applied by the target's progress engine).
-    """
+def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
+    """The put/take engine shared by every put-based ring collective:
+    returns ``(st, put, take, finish)``. ``put(hop, bytes)`` writes a chunk
+    into the successor's slot ``hop % 2`` and rings the doorbell;
+    ``take(hop, nbytes)`` polls the predecessor's doorbell, consumes, and
+    acks the credit; ``finish(hop)`` persists the hop counter and flushes
+    both comms' queued tx (a fast rank must not exit holding a slow rank's
+    last hop in its user-space queue — observed at 16 MB: rank 0 finishes
+    correct in 0.13 s, rank 1 times out on the doorbell with 3.2 MB
+    stranded in rank 0's send queue). The caller runs the phase loops."""
     import time as _time
 
-    x = np.array(local, copy=True).ravel()
-    n = n_ranks
-    if n == 1:
-        return x.reshape(np.shape(local))
-    combine = _NET_REDUCE_OPS[op]
-    bounds = [len(x) * i // n for i in range(n + 1)]
-    chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
-    cap = max(chunk(i).nbytes for i in range(n))
     st = _rdma_ring_state(net, send_comm, recv_comm, cap)
     cap = st["cap"]
     data_mr, credit_mr = st["data_mr"], st["credit_mr"]
@@ -735,7 +723,7 @@ def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
         # letting it rot in the CQE cache until a misleading timeout
         pending[:] = [r for r in pending if not r.test()[0]]
 
-    def put(hop: int, out: np.ndarray) -> None:
+    def put(hop: int, out) -> None:
         # wait for slot credit, then data -> slot, doorbell -> flag.
         # BOTH comms must pump while waiting: our own ACK to the
         # predecessor may still sit in the recv comm's tx queue, and if
@@ -781,29 +769,119 @@ def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
                                   hop.to_bytes(8, "little"), offset=0))
         return np.frombuffer(payload, np.uint8)
 
-    hop = st["hop"]
-    for k in range(n - 1):  # reduce-scatter phase
+    def finish(hop: int) -> None:
+        st["hop"] = hop
+        for comm in (send_comm, recv_comm):
+            _flush_tx(comm, timeout_s,
+                      what="rdma ring: peer stopped draining at exit")
+
+    return st, put, take, finish
+
+
+def _chunk_layout(x: np.ndarray, n: int):
+    """Floor-balanced n-way element ranges of a flat buffer: the chunk
+    accessor (index mod n) and the largest chunk's byte size (the slot
+    capacity). One definition for the whole rdma family — the layout must
+    agree across collectives sharing a connection's MR state."""
+    bounds = [len(x) * i // n for i in range(n + 1)]
+    chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
+    cap = max(chunk(i).nbytes for i in range(n))
+    return chunk, cap
+
+
+def _rdma_reduce_phase(put, take, chunk, x, rank: int, n: int, hop: int,
+                       shift: int = 0, op: str = "sum") -> int:
+    """The n-1 doorbell reduce hops in place (the put/take twin of the msg
+    plane's ``_ring_reduce_phase``): at step k, put chunk ``rank - k +
+    shift``, combine the taken chunk into ``rank - k - 1 + shift``. Returns
+    the advanced hop counter. shift=0 is the allreduce layout; shift=-1
+    lands chunk r fully reduced on rank r."""
+    combine = _NET_REDUCE_OPS[op]
+    for k in range(n - 1):
         hop += 1
-        send_i, recv_i = rank - k, rank - k - 1
+        send_i, recv_i = rank - k + shift, rank - k - 1 + shift
         put(hop, _as_bytes(chunk(send_i)))
         incoming = take(hop, chunk(recv_i).nbytes)
         combine(chunk(recv_i), incoming.view(x.dtype), out=chunk(recv_i))
+    return hop
+
+
+def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
+                        rank: int, n_ranks: int, op: str = "sum",
+                        timeout_s: float = 30.0) -> np.ndarray:
+    """Ring allreduce whose DATA PATH is one-sided RDMA writes.
+
+    The put-based ring of real RDMA transports: each hop writes its chunk
+    straight into the successor's registered MR, then writes the hop number
+    as a doorbell flag; the receiver polls the flag, consumes, and writes a
+    credit back into the predecessor's MR so slots recycle safely (2-slot
+    double buffering). No posted receives and no recv CQEs on the data
+    path — only the one-time rkey exchange uses send/recv. Works on both
+    host planes: shm (direct memcpy through the shared arena, fenced) and
+    TCP (soft-NIC frames applied by the target's progress engine).
+    """
+    x = np.array(local, copy=True).ravel()
+    n = n_ranks
+    if n == 1:
+        return x.reshape(np.shape(local))
+    chunk, cap = _chunk_layout(x, n)
+    st, put, take, finish = _rdma_ring_io(net, send_comm, recv_comm, cap,
+                                          timeout_s)
+    hop = _rdma_reduce_phase(put, take, chunk, x, rank, n, st["hop"], op=op)
     for k in range(n - 1):  # allgather phase
         hop += 1
         send_i, recv_i = rank + 1 - k, rank - k
         put(hop, _as_bytes(chunk(send_i)))
         incoming = take(hop, chunk(recv_i).nbytes)
         chunk(recv_i)[:] = incoming.view(x.dtype)
-    st["hop"] = hop
-    # Flush BOTH comms' queued tx before returning: our final put (and the
-    # last credit ack) are fire-and-forget, and once a rank's own take is
-    # satisfied nothing else pumps — a fast rank would exit holding the
-    # slow rank's last hop in its user-space queue (observed at 16 MB:
-    # rank 0 finishes correct in 0.13 s, rank 1 times out on the doorbell
-    # with 3.2 MB stranded in rank 0's send queue).
-    for comm in (send_comm, recv_comm):
-        _flush_tx(comm, timeout_s, what="rdma ring: peer stopped draining at exit")
+    finish(hop)
     return x.reshape(np.shape(local))
+
+
+def ring_reduce_scatter_rdma(net, send_comm, recv_comm, local: np.ndarray,
+                             rank: int, n_ranks: int, op: str = "sum",
+                             timeout_s: float = 30.0) -> np.ndarray:
+    """Reduce-scatter on the put-based one-sided data path: the -1-shifted
+    reduce phase of :func:`ring_allreduce_rdma` alone (rank r ends with the
+    fully-reduced range r), same doorbell/credit wire protocol."""
+    x = np.array(local, copy=True).ravel()
+    n = n_ranks
+    if n == 1:
+        return x
+    chunk, cap = _chunk_layout(x, n)
+    st, put, take, finish = _rdma_ring_io(net, send_comm, recv_comm, cap,
+                                          timeout_s)
+    # shift=-1: chunk r lands fully reduced on rank r
+    hop = _rdma_reduce_phase(put, take, chunk, x, rank, n, st["hop"],
+                             shift=-1, op=op)
+    finish(hop)
+    return np.array(chunk(rank), copy=True)
+
+
+def ring_allgather_rdma(net, send_comm, recv_comm, local: np.ndarray,
+                        rank: int, n_ranks: int,
+                        timeout_s: float = 30.0) -> np.ndarray:
+    """Allgather on the put-based one-sided data path: n-1 hops circulating
+    whole blocks through the successor's MR slots (doorbell + credit, no
+    posted receives). Returns ``(n, *local.shape)`` in rank order."""
+    block = np.ascontiguousarray(local)
+    n = n_ranks
+    out = np.empty((n,) + block.shape, block.dtype)
+    out[rank] = block
+    if n == 1:
+        return out
+    st, put, take, finish = _rdma_ring_io(net, send_comm, recv_comm,
+                                          block.nbytes, timeout_s)
+    hop = st["hop"]
+    for k in range(n - 1):
+        hop += 1
+        send_i = (rank - k) % n
+        recv_i = (rank - k - 1) % n
+        put(hop, _as_bytes(out[send_i]))
+        incoming = take(hop, block.nbytes)
+        out[recv_i] = incoming.view(block.dtype).reshape(block.shape)
+    finish(hop)
+    return out
 
 
 def ring_allgather_over_net(net, send_comm, recv_comm, local: np.ndarray,

@@ -9,8 +9,10 @@ import pytest
 from rocnrdma_tpu import native
 from rocnrdma_tpu.transport import HostQPNet, TCPNet
 from rocnrdma_tpu.transport.plugin import (
+    ring_allgather_rdma,
     ring_allreduce_over_net,
     ring_allreduce_rdma,
+    ring_reduce_scatter_rdma,
 )
 
 needs_native = pytest.mark.skipif(
@@ -151,3 +153,57 @@ def test_rdma_ring_grows_capacity():
         np.testing.assert_allclose(res[r][0], np.sum(small, axis=0), rtol=1e-5)
         np.testing.assert_allclose(res[r][1], np.sum(big, axis=0),
                                    rtol=1e-5, atol=1e-5)
+
+
+@needs_native
+@pytest.mark.parametrize("net_cls", PLANES)
+@pytest.mark.parametrize("n", [2, 3, 4])
+def test_rdma_reduce_scatter(net_cls, n):
+    rng = np.random.default_rng(7)
+    # 509 is odd: ragged floor-balanced chunks, unequal per-hop byte counts
+    xs = [rng.standard_normal(509).astype(np.float32) for _ in range(n)]
+    res = _run_ring(net_cls, n, lambda net, s, r, rank:
+                    ring_reduce_scatter_rdma(net, s, r, xs[rank], rank, n))
+    total = np.sum(xs, axis=0)
+    bounds = [len(total) * i // n for i in range(n + 1)]
+    for r in range(n):
+        np.testing.assert_allclose(res[r], total[bounds[r]:bounds[r + 1]],
+                                   rtol=1e-5, atol=1e-5)
+
+
+@needs_native
+@pytest.mark.parametrize("net_cls", PLANES)
+@pytest.mark.parametrize("n", [2, 3, 4])
+def test_rdma_allgather(net_cls, n):
+    rng = np.random.default_rng(8)
+    blocks = [rng.standard_normal(257).astype(np.float32) for _ in range(n)]
+    res = _run_ring(net_cls, n, lambda net, s, r, rank:
+                    ring_allgather_rdma(net, s, r, blocks[rank], rank, n))
+    want = np.stack(blocks)
+    for r in range(n):
+        np.testing.assert_array_equal(res[r], want)
+
+
+@needs_native
+def test_rdma_family_shares_connection_state():
+    """Back-to-back rdma collectives on the same comms share the doorbell
+    hop counter and MR state — the sequence must stay correct."""
+    n = 2
+    rng = np.random.default_rng(9)
+    xs = [rng.standard_normal(300).astype(np.float32) for _ in range(n)]
+
+    def fn(net, s, r, rank):
+        a = ring_allreduce_rdma(net, s, r, xs[rank], rank, n)
+        b = ring_reduce_scatter_rdma(net, s, r, xs[rank], rank, n)
+        c = ring_allgather_rdma(net, s, r, xs[rank], rank, n)
+        return a, b, c
+
+    res = _run_ring(TCPNet, n, fn)
+    total = np.sum(xs, axis=0)
+    bounds = [300 * i // n for i in range(n + 1)]
+    for r in range(n):
+        a, b, c = res[r]
+        np.testing.assert_allclose(a, total, rtol=1e-5)
+        np.testing.assert_allclose(b, total[bounds[r]:bounds[r + 1]],
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(c, np.stack(xs))
