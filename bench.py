@@ -138,6 +138,15 @@ def main() -> int:
             "fused": lambda y: C.fused_allreduce(y, "rank"),
             "ring_bidir": lambda y: C.ring_allreduce(y, "rank", bidir=True),
         }
+        if not on_cpu:
+            # real multi-chip TPU: the Pallas remote-DMA ring competes too
+            # (interpret mode on CPU would be pointless); best-of protects
+            # the headline if it is slow. The HBM-streaming tier is the one
+            # that HOLDS a 256 MiB/rank buffer — the VMEM-resident kernel
+            # would fail to compile at this size.
+            from rocnrdma_tpu import ops as O
+            algos["pallas_hbm"] = lambda y: O.pallas_hbm_ring_allreduce(
+                y, "rank", tile_rows=512)
 
         def make_chain(k, ar):
             def local(s):

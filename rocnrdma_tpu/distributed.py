@@ -51,6 +51,26 @@ def _check_transport(transport: str) -> None:
                          f"know ('msg', 'rdma')")
 
 
+class P2PHandle:
+    """An in-flight :meth:`ProcessGroup.isend`/:meth:`~ProcessGroup.irecv`
+    (the torch ``Work``/request handle). ``wait()`` blocks to completion
+    and, for a receive, returns the array; it is idempotent. A handle whose
+    ``wait()`` RAISED leaves its (peer, tag) stream undefined — tear the
+    group down rather than retry (the sequence slot was claimed at post
+    time, unlike blocking ``recv``)."""
+
+    def __init__(self, wait_fn):
+        self._wait_fn = wait_fn
+        self._done = False
+        self._result = None
+
+    def wait(self):
+        if not self._done:
+            self._result = self._wait_fn()
+            self._done = True
+        return self._result
+
+
 class ProcessGroup:
     """N ranks wired in a TCP ring with a shared rendezvous store.
 
@@ -286,9 +306,13 @@ class ProcessGroup:
                 self._p2p[(peer, "rx")] = plugin._RingWire(
                     self._net, comm, comm)
                 self._p2p_seq.setdefault(peer, {})
+        # pump EVERY wired comm, both directions: rx pumps deliver inbound
+        # frames; tx pumps drive queued user-space tx (an irecv wait issued
+        # before a send handle's flush must still make the outbound tail
+        # progress, or symmetric large batches wedge on full kernel buffers)
         for (peer, d), wire in list(self._p2p.items()):
-            if d == "rx":
-                wire.recv_comm._pump()
+            comm = wire.recv_comm if d == "rx" else wire.send_comm
+            comm._pump()
 
     def _p2p_wire(self, peer: int, direction: str, timeout_s: float = 30.0):
         """The cached one-way wire to/from ``peer`` (``direction``: "tx" dials
@@ -366,6 +390,107 @@ class ProcessGroup:
         # number or the stream is permanently off by one
         self._p2p_seq[src][("rx", tag)] = seq + 1
         return got.view(template.dtype).reshape(template.shape)
+
+    def isend(self, x, dst: int, tag: int = 0,
+              timeout_s: float = 60.0) -> P2PHandle:
+        """Non-blocking send: frames are queued on the wire immediately
+        (pumping the p2p plane under backpressure); ``wait()`` flushes the
+        tx queue. Shares the (peer, tag) sequence space with :meth:`send`,
+        so blocking and non-blocking calls interleave coherently."""
+        x = np.asarray(x)
+        wire = self._p2p_wire(dst, "tx", timeout_s)
+        seq = self._p2p_seq[dst].get(("tx", tag), 0)
+        self._claim_outstanding(dst, "tx", tag)
+        self._p2p_seq[dst][("tx", tag)] = seq + 1
+        wire.queue_send(plugin._as_bytes(x), self._p2p_hop(tag, seq),
+                        progress=self._p2p_progress)
+
+        def wait():
+            plugin._flush_tx(wire.send_comm, timeout_s,
+                             extra_pump=self._p2p_progress,
+                             what="isend: peer stopped draining")
+            self._release_outstanding(dst, "tx", tag)
+
+        return P2PHandle(wait)
+
+    def irecv(self, x_like, src: int, tag: int = 0,
+              timeout_s: float = 60.0) -> P2PHandle:
+        """Non-blocking receive: posts the frame receives now (claiming the
+        next sequence slot of the (peer, tag) stream — outstanding irecvs
+        on one stream match sends in post order); ``wait()`` drains them
+        and returns the array shaped like ``x_like``. FIRST contact with a
+        peer blocks wiring the receive connection until that peer dials
+        (i.e. first sends) — for symmetric first-contact exchanges, issue
+        through :meth:`batch_isend_irecv`, which orders the wiring so
+        cycles resolve."""
+        template = np.asarray(x_like)
+        wire = self._p2p_wire(src, "rx", timeout_s)
+        seq = self._p2p_seq[src].get(("rx", tag), 0)
+        self._claim_outstanding(src, "rx", tag)
+        self._p2p_seq[src][("rx", tag)] = seq + 1
+        nbytes = template.nbytes
+        reqs = wire.post_recvs(nbytes, self._p2p_hop(tag, seq))
+
+        def wait():
+            got = np.empty(nbytes, np.uint8)
+            for off, nb, r in reqs:
+                # _p2p_progress pumps every wired comm BOTH ways, so queued
+                # isend tx keeps draining while this recv blocks
+                payload = r.wait(timeout_s=timeout_s,
+                                 progress=self._p2p_progress)
+                got[off:off + nb] = np.frombuffer(payload, np.uint8)
+            self._release_outstanding(src, "rx", tag)
+            return got.view(template.dtype).reshape(template.shape)
+
+        return P2PHandle(wait)
+
+    def _claim_outstanding(self, peer: int, d: str, tag: int) -> None:
+        # the 10-bit seq wrap in _p2p_hop is only safe while fewer than
+        # 1024 ops are outstanding per (peer, direction, tag) stream: op
+        # k+1024 would reuse op k's wire tags while its frames are still
+        # in flight — a silent mismatch, so it is refused here
+        key = ("out", d, tag)
+        n = self._p2p_seq[peer].get(key, 0)
+        if n >= 1023:
+            raise RuntimeError(
+                f"too many outstanding p2p ops on (peer {peer}, {d}, "
+                f"tag {tag}): wait() some handles first (seq wrap window)")
+        self._p2p_seq[peer][key] = n + 1
+
+    def _release_outstanding(self, peer: int, d: str, tag: int) -> None:
+        key = ("out", d, tag)
+        self._p2p_seq[peer][key] = max(0, self._p2p_seq[peer].get(key, 1) - 1)
+
+    def batch_isend_irecv(self, ops, timeout_s: float = 60.0) -> list:
+        """Issue a batch of p2p ops together (the torch
+        ``batch_isend_irecv`` shape): ``ops`` is a list of
+        ``("send", array, peer[, tag])`` / ``("recv", array_like, peer[,
+        tag])`` tuples. Returns the handles in input order. Issue order
+        inside the batch: every send's OUTBOUND connection is wired first
+        (a dial never waits on the peer's progress), then receives post,
+        then sends — so a batch-shaped cycle of first contacts (the ring
+        exchange every rank runs in pipeline parallelism) can neither
+        stall on unwired receive connections nor on unposted buffers.
+        Call ``wait()`` on every handle."""
+        parsed = []
+        for op in ops:
+            kind, arr, peer = op[0], op[1], op[2]
+            tag = op[3] if len(op) > 3 else 0
+            if kind not in ("send", "recv"):
+                raise ValueError(f"batch op kind must be send/recv, "
+                                 f"got {kind!r}")
+            parsed.append((kind, arr, peer, tag))
+        for kind, _, peer, _ in parsed:  # dial every send target up front:
+            if kind == "send":           # unblocks the peers' rx accepts
+                self._p2p_wire(peer, "tx", timeout_s)
+        handles: dict[int, P2PHandle] = {}
+        for i, (kind, arr, peer, tag) in enumerate(parsed):
+            if kind == "recv":
+                handles[i] = self.irecv(arr, peer, tag, timeout_s)
+        for i, (kind, arr, peer, tag) in enumerate(parsed):
+            if kind == "send":
+                handles[i] = self.isend(arr, peer, tag, timeout_s)
+        return [handles[i] for i in range(len(parsed))]
 
     def barrier(self, timeout_s: float = 30.0) -> None:
         """Block until every rank arrives."""

@@ -495,6 +495,40 @@ class _RingWire:
         self.frame = getattr(net, "MAX_FRAME", (1 << 16) - 4)
         self._hops = itertools.count(1)
 
+    def _tag(self, hop: int, nbytes: int):
+        """The (hop, frame-index) tag packer — the ONE definition of the
+        wire tag layout, shared by exchange and the non-blocking p2p."""
+        n_frames = -(-nbytes // self.frame)
+        if n_frames >= (1 << 16):
+            raise ValueError(
+                f"{n_frames} frames in one message overflows the 16-bit "
+                f"frame-index tag field (> ~4 GB); chunk at the caller")
+        return lambda fi: (hop << 16) | fi
+
+    def queue_send(self, out: np.ndarray, hop: int, progress=None) -> None:
+        """Queue ``out`` (uint8) as chunked frames on the send comm (may
+        pump under backpressure; does NOT flush — callers flush or drain)."""
+        tag = self._tag(hop, len(out))
+        frame = self.frame
+        for fi, off in enumerate(range(0, len(out), frame)):
+            seg = np.ascontiguousarray(out[off:off + frame])
+            self.net.isend(self.send_comm,
+                           self.net.reg_mr(self.send_comm, seg),
+                           tag=tag(fi), timeout_s=self.timeout_s,
+                           progress=progress)
+
+    def post_recvs(self, nbytes: int, hop: int) -> list:
+        """Post the chunked frame receives for an ``nbytes`` inbound
+        message; returns ``[(offset, nbytes, Request), ...]`` to drain."""
+        tag = self._tag(hop, nbytes)
+        frame = self.frame
+        reqs = []
+        for fi, off in enumerate(range(0, nbytes, frame)):
+            nb = min(frame, nbytes - off)
+            reqs.append((off, nb,
+                         self.net.irecv(self.recv_comm, nb, tag=tag(fi))))
+        return reqs
+
     def exchange(self, out: np.ndarray, in_nbytes: int,
                  hop: int | None = None) -> np.ndarray:
         """One ring hop: send ``out`` (uint8) right, receive ``in_nbytes``
@@ -508,31 +542,16 @@ class _RingWire:
         an explicit hop so tags agree per ring edge."""
         if hop is None:
             hop = next(self._hops)
-        frame = self.frame
-        n_frames = max(-(-in_nbytes // frame), -(-len(out) // frame))
-        assert n_frames < (1 << 16), (
-            f"{n_frames} frames in one hop overflows the 16-bit frame-index "
-            f"tag field (piece > ~4 GB); widen the tag packing first")
-        tag = lambda fi: (hop << 16) | fi
         got = np.empty(in_nbytes, np.uint8)
         # queue all chunked irecvs, then the isends, then drain — the plugin
         # pumps receives while a send backpressures, so no deadlock
-        reqs = []
-        for fi, off in enumerate(range(0, in_nbytes, frame)):
-            nb = min(frame, in_nbytes - off)
-            reqs.append((off, nb,
-                         self.net.irecv(self.recv_comm, nb, tag=tag(fi))))
+        reqs = self.post_recvs(in_nbytes, hop)
         # progress engine: while our send ring is full, keep draining the
         # comm our inbound data arrives on, or two mutually-sending ranks
         # stall each other
         pump = (self.progress if self.progress is not None
                 else getattr(self.recv_comm, "_pump", None))
-        for fi, off in enumerate(range(0, len(out), frame)):
-            seg = np.ascontiguousarray(out[off:off + frame])
-            self.net.isend(self.send_comm,
-                           self.net.reg_mr(self.send_comm, seg),
-                           tag=tag(fi), timeout_s=self.timeout_s,
-                           progress=pump)
+        self.queue_send(out, hop, pump)
         # Wait for the inbound frames WHILE keeping our own outbound
         # flowing. A hop larger than the kernel socket buffers leaves the
         # tail of our frames in the user-space tx queue; the peer cannot

@@ -331,6 +331,104 @@ def test_rooted_verbs_validate_at_world_size_1(sidecar_store):
     pg.destroy()
 
 
+def test_batch_isend_irecv_pipeline_ring(sidecar_store):
+    """The pipeline-parallel neighbour exchange: every rank's FIRST p2p op
+    is a batch [recv(prev), send(next)] — the shape that deadlocks naive
+    wiring; batch ordering must resolve it and overlap both transfers."""
+    n = 3
+    store = sidecar_store(n)
+    rng = np.random.default_rng(15)
+    payloads = [rng.standard_normal(30000).astype(np.float32)
+                for _ in range(n)]  # multi-frame
+
+    def fn(pg):
+        r = pg.rank
+        handles = pg.batch_isend_irecv([
+            ("recv", np.empty_like(payloads[0]), (r - 1) % n),
+            ("send", payloads[r], (r + 1) % n),
+        ])
+        got = handles[0].wait()
+        handles[1].wait()
+        return got
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    for r in range(n):
+        np.testing.assert_array_equal(res[r], payloads[(r - 1) % n])
+
+
+def test_isend_irecv_interleave_with_blocking(sidecar_store):
+    """Handles share the (peer, tag) sequence space with blocking
+    send/recv, so mixed sequences stay paired; wait() is idempotent."""
+    n = 2
+    store = sidecar_store(n)
+
+    def fn(pg):
+        if pg.rank == 0:
+            h = pg.isend(np.array([1.0], np.float32), dst=1)
+            pg.send(np.array([2.0], np.float32), dst=1)     # same stream
+            h.wait()
+            h.wait()  # idempotent
+            return None
+        a = pg.recv(np.empty(1, np.float32), src=0)         # blocking
+        h = pg.irecv(np.empty(1, np.float32), src=0)        # non-blocking
+        b = h.wait()
+        return a, b
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    np.testing.assert_array_equal(res[1][0], [1.0])
+    np.testing.assert_array_equal(res[1][1], [2.0])
+
+
+def test_batch_symmetric_large_recv_waited_first(sidecar_store):
+    """Regression: both ranks batch a 16 MB send+recv and wait the RECV
+    handle first — the recv wait's progress hook must keep pumping the
+    queued isend tx, or both sides wedge on full kernel buffers."""
+    n = 2
+    store = sidecar_store(n)
+    rng = np.random.default_rng(16)
+    bufs = [rng.standard_normal(4 * 1024 * 1024).astype(np.float32)
+            for _ in range(n)]
+
+    def fn(pg):
+        r = pg.rank
+        handles = pg.batch_isend_irecv([
+            ("recv", np.empty_like(bufs[0]), 1 - r),
+            ("send", bufs[r], 1 - r),
+        ])
+        got = handles[0].wait()
+        handles[1].wait()
+        return got
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    np.testing.assert_array_equal(res[0], bufs[1])
+    np.testing.assert_array_equal(res[1], bufs[0])
+
+
+def test_isend_outstanding_cap(sidecar_store):
+    """The seq-wrap window is enforced: >1023 outstanding handles on one
+    (peer, direction, tag) stream is refused instead of silently colliding
+    wire tags."""
+    n = 2
+    store = sidecar_store(n)
+
+    def fn(pg):
+        if pg.rank == 1:
+            # drain everything rank 0 posts, then the handshake value
+            for _ in range(1023):
+                pg.recv(np.empty(1, np.float32), src=0)
+            return None
+        handles = [pg.isend(np.array([float(i)], np.float32), dst=1)
+                   for i in range(1023)]
+        with pytest.raises(RuntimeError, match="outstanding"):
+            pg.isend(np.zeros(1, np.float32), dst=1)
+        for h in handles:
+            h.wait()
+        return True
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res[0] is True
+
+
 def test_p2p_rejects_bad_peer_and_tag(sidecar_store):
     store = sidecar_store(1)
     pg = dist.init_process_group(rank=0, world_size=1,
