@@ -38,7 +38,7 @@ from rocnrdma_tpu.bench.runner import parse_size
 from rocnrdma_tpu.bench.timing import trimmed_mean
 
 COLLECTIVES = ("allreduce", "reducescatter", "allgather", "broadcast",
-               "alltoall")
+               "alltoall", "sendrecv")
 
 
 def _build_input(collective: str, n: int, elems: int, rng) -> np.ndarray:
@@ -61,6 +61,16 @@ def _issue(pg, collective: str, x: np.ndarray, transport: str = "msg"):
         return pg.broadcast(x, src=0)
     if collective == "alltoall":
         return pg.all_to_all(x)
+    if collective == "sendrecv":
+        # the neighbour shift exchange over the p2p verbs: send right,
+        # receive left, both in flight (the ncclSend/ncclRecv pattern)
+        handles = pg.batch_isend_irecv([
+            ("recv", x, (pg.rank - 1) % pg.world_size),
+            ("send", x, (pg.rank + 1) % pg.world_size),
+        ])
+        out = handles[0].wait()
+        handles[1].wait()
+        return out
     raise ValueError(f"unknown collective {collective!r}")
 
 

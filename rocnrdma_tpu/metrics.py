@@ -54,6 +54,10 @@ _BUSBW_FACTOR = {
     # FSDP/ZeRO-3 step (2 allgathers + 1 reduce-scatter of the params,
     # reported against size = 3*param_bytes): each leg carries (n-1)/n
     "fsdp": lambda n: (n - 1) / n,
+    # full MoE layer with real routing (2 alltoalls of the dispatch
+    # tensor + router/scatter/gather compute, reported against size =
+    # one dispatch tensor): wire bytes are 2 legs of (n-1)/n each
+    "moe_layer": lambda n: 2 * (n - 1) / n,
 }
 
 

@@ -51,6 +51,47 @@ def moe_step(t: Transport, algo: str, expert_compute: bool):
     return jax.jit(step) if expert_compute else step
 
 
+def moe_topk_step(t: Transport, algo: str, expert_compute: bool,
+                  n_experts: int, cap: int, top_k: int):
+    """The REAL MoE layer shape: router logits -> top-k gating with a
+    static capacity (tokens past capacity dropped, GShard-style; see
+    workloads/routing.py) -> alltoall dispatch -> expert -> alltoall
+    combine -> gate-weighted gather. Inputs per mesh position: tokens
+    ``(T, d)`` and router logits ``(T, E)``; output ``(T, d)`` plus the
+    keep mask for drop accounting."""
+    from rocnrdma_tpu.workloads import routing as R
+
+    a2a = t.jit_fn("alltoall", algo)
+
+    def expert(v):
+        return v * 2.0
+
+    def step(tokens, logits):
+        # global arrays (mesh lead dims + (T, d)); the routing math is
+        # per-mesh-position, so vmap it over the flattened lead — GSPMD
+        # keeps it local to each device, only the alltoalls communicate
+        lead = tokens.shape[:-2]
+        tokf = tokens.reshape((-1,) + tokens.shape[-2:])
+        logf = logits.reshape((-1,) + logits.shape[-2:])
+        gates, experts = jax.vmap(
+            lambda l: R.topk_route(l, top_k))(logf)
+        pos, keep = jax.vmap(
+            lambda e: R.dispatch_mask(e, n_experts, cap))(experts)
+        dispatch = jax.vmap(
+            lambda x_, e, p, m: R.build_dispatch(x_, e, p, m, n_experts,
+                                                 cap))(tokf, experts, pos,
+                                                       keep)
+        routed = a2a(dispatch.reshape(lead + dispatch.shape[1:]))
+        if expert_compute:
+            routed = expert(routed)
+        back = a2a(routed).reshape(dispatch.shape)
+        out = jax.vmap(R.combine)(back, gates, experts, pos, keep)
+        return (out.reshape(lead + out.shape[1:]),
+                keep.reshape(lead + keep.shape[1:]))
+
+    return jax.jit(step)
+
+
 # Public MoE architectures as dispatch-shape presets: expert-parallel
 # alltoall traffic depends only on (d_model, n_experts) and the token
 # count, so the public configs pin realistic message shapes (no weights).
@@ -75,6 +116,13 @@ def main(argv=None) -> int:
     p.add_argument("--algo", default="auto")
     p.add_argument("--expert-compute", action="store_true",
                    help="run the expert transform between dispatch and combine")
+    p.add_argument("--routing", choices=("uniform", "topk"), default="uniform",
+                   help="uniform: fixed-shape chunks (pure transport "
+                        "traffic); topk: real router -> top-k gating with "
+                        "static capacity and GShard-style token dropping "
+                        "(see workloads/routing.py)")
+    p.add_argument("--top-k", type=int, default=2)
+    p.add_argument("--capacity-factor", type=float, default=1.25)
     p.add_argument("--repeats", type=int, default=5)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--fake-devices", type=int, default=None)
@@ -84,7 +132,12 @@ def main(argv=None) -> int:
     spec = MOE_MODELS[args.model] if args.model else None
     if spec:
         args.d_model = spec["d_model"]
-        args.tokens *= spec["top_k"]  # each token dispatched top_k times
+        if args.routing == "topk":
+            # real routing accounts for top_k via expert capacity —
+            # scaling tokens too would double-count the dispatch traffic
+            args.top_k = spec["top_k"]
+        else:
+            args.tokens *= spec["top_k"]  # uniform emulation of k dispatches
         if args.ranks is None and args.mesh2d is None:
             args.ranks = spec["n_experts"]  # default to the model's EP world
 
@@ -102,38 +155,76 @@ def main(argv=None) -> int:
                   f"but this mesh has {n} ranks — traffic shape is "
                   f"{n}-expert, not the named model's", file=sys.stderr)
 
-    cap = max(1, args.tokens // n)  # uniform routing: tokens/rank/expert
     np_dtype = np.dtype(getattr(jnp, args.dtype))
     lead = t.mesh.devices.shape
-    x_np = np.random.default_rng(0).standard_normal(
-        size=lead + (n, cap, args.d_model), dtype=np.float32).astype(np_dtype)
-    x = t.shard(x_np)
+    rng0 = np.random.default_rng(0)
 
-    step = moe_step(t, args.algo, args.expert_compute)
+    if args.routing == "topk":
+        from rocnrdma_tpu.workloads import routing as R
 
-    # correctness: without compute, combine(dispatch(x)) must be identity
-    if not args.expert_compute:
-        rt_trip = np.asarray(step(x), np.float32)
-        np.testing.assert_allclose(rt_trip, np.asarray(x_np, np.float32),
-                                   rtol=1e-5, atol=1e-6)
+        cap = R.expert_capacity(args.tokens, n, args.top_k,
+                                args.capacity_factor)
+        tok_np = rng0.standard_normal(
+            size=lead + (args.tokens, args.d_model),
+            dtype=np.float32).astype(np_dtype)
+        log_np = rng0.standard_normal(
+            size=lead + (args.tokens, n), dtype=np.float32)
+        x = (t.shard(tok_np), t.shard(jnp.asarray(log_np)))
+        topk_step = moe_topk_step(t, args.algo, args.expert_compute,
+                                  n, cap, args.top_k)
+        step = lambda tokens, logits: topk_step(tokens, logits)[0]
 
-    out = step(x)
+        out0, keep = topk_step(*x)
+        stats = R.route_stats(np.asarray(keep))
+        print(f"# topk routing: top_k={args.top_k} capacity={cap} "
+              f"({args.capacity_factor}x): {stats['dropped']}/"
+              f"{stats['routed']} dropped "
+              f"({100 * stats['drop_rate']:.1f}%)", file=sys.stderr)
+        if not args.expert_compute and stats["dropped"] == 0:
+            # no drops + identity experts: gate weights sum to 1 per
+            # token, so the layer output IS the input — to the TOKEN
+            # dtype's precision (gates are weighted in it)
+            tol = 1e-4 if np_dtype.itemsize >= 4 else 5e-2
+            np.testing.assert_allclose(
+                np.asarray(out0, np.float32),
+                np.asarray(tok_np, np.float32), rtol=tol, atol=tol)
+    else:
+        cap = max(1, args.tokens // n)  # uniform: tokens/rank/expert
+        x_np = rng0.standard_normal(
+            size=lead + (n, cap, args.d_model),
+            dtype=np.float32).astype(np_dtype)
+        x = (t.shard(x_np),)
+        step = moe_step(t, args.algo, args.expert_compute)
+
+        # correctness: without compute, combine(dispatch(x)) is identity
+        if not args.expert_compute:
+            rt_trip = np.asarray(step(*x), np.float32)
+            np.testing.assert_allclose(rt_trip, np.asarray(x_np, np.float32),
+                                       rtol=1e-5, atol=1e-6)
+
+    out = step(*x)
     jax.block_until_ready(out)
     spans = []
     for _ in range(args.repeats):
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            out = step(x)
+            out = step(*x)
         jax.block_until_ready(out)
         spans.append((time.perf_counter() - t0) / args.iters)
     mean_s = trimmed_mean(spans)
 
     per_rank_bytes = n * cap * args.d_model * np_dtype.itemsize
-    # one step = 2 alltoalls (dispatch + combine)
+    # uniform: the step IS 2 bare alltoalls, so step/2 is honest alltoall
+    # time. topk: the step also runs router/scatter/gather compute, so the
+    # record keeps the FULL layer time under its own op name — splitting
+    # it in half would overstate alltoall latency by the routing share.
+    collective, sec = (("alltoall", mean_s / 2.0)
+                       if args.routing == "uniform"
+                       else ("moe_layer", mean_s))
     rec = M.BenchRecord.measure(
-        "moe", "alltoall", args.algo, n, per_rank_bytes, args.dtype,
-        mean_s / 2.0, platform=topo.platform, tokens=args.tokens,
-        d_model=args.d_model, capacity=cap,
+        "moe", collective, args.algo, n, per_rank_bytes, args.dtype,
+        sec, platform=topo.platform, tokens=args.tokens,
+        d_model=args.d_model, capacity=cap, routing=args.routing,
         expert_compute=args.expert_compute, step_ms=mean_s * 1e3)
     if args.out:
         with open(args.out, "a") as fp:

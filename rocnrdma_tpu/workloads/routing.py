@@ -1,0 +1,93 @@
+"""Top-k MoE routing with capacity factor — the static-shape dispatch.
+
+The reference stack's MoE benchmark (BASELINE.json:11 "MoE alltoall")
+measures the dispatch/combine exchange; this module supplies the routing
+that PRODUCES that exchange the way TPU MoE systems actually run it:
+XLA needs static shapes, so each expert has a fixed capacity
+``C = ceil(T * top_k / E * capacity_factor)`` and tokens routed past an
+expert's capacity are DROPPED (their combine weight is zero) — the
+Switch-Transformer/GShard discipline, not the ragged alltoallv the GPU
+stack uses. Everything here is jit-compatible dense one-hot algebra:
+argsort-free, MXU/VPU-friendly, and differentiable through the gates.
+
+Layout convention: one expert per EP rank, so the dispatch tensor
+``(E, C, d)`` is exactly the alltoall input (chunk e -> rank e) and the
+transpose semantics of every alltoall in this package apply unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(tokens: int, n_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """The static per-expert slot count."""
+    return max(1, int(-(-tokens * top_k * capacity_factor // n_experts)))
+
+
+def topk_route(logits: jnp.ndarray, top_k: int):
+    """Route each token to its top-k experts.
+
+    Returns ``(gates, experts)``, both ``(T, k)``: softmax-renormalized
+    combine weights over the chosen experts, and the expert ids.
+    """
+    gate_logits, experts = jax.lax.top_k(logits, top_k)       # (T, k)
+    gates = jax.nn.softmax(gate_logits, axis=-1)
+    return gates, experts
+
+
+def dispatch_mask(experts: jnp.ndarray, n_experts: int, capacity: int):
+    """Position bookkeeping for the static dispatch.
+
+    ``experts``: (T, k) expert ids in routing priority order (row-major:
+    token order breaks ties, matching GShard's position-in-expert rule).
+    Returns ``(pos, keep)`` both (T, k): each entry's slot within its
+    expert, and whether it fits under ``capacity`` (dropped otherwise).
+    """
+    flat = experts.reshape(-1)                                 # (T*k,)
+    onehot = jax.nn.one_hot(flat, n_experts, dtype=jnp.int32)  # (T*k, E)
+    # slot = how many earlier entries chose the same expert
+    pos_flat = (jnp.cumsum(onehot, axis=0) - 1) * onehot       # (T*k, E)
+    pos = pos_flat.sum(axis=1).reshape(experts.shape)          # (T, k)
+    keep = pos < capacity
+    return pos, keep
+
+
+def build_dispatch(x: jnp.ndarray, experts: jnp.ndarray, pos: jnp.ndarray,
+                   keep: jnp.ndarray, n_experts: int,
+                   capacity: int) -> jnp.ndarray:
+    """Scatter tokens into the ``(E, C, d)`` dispatch tensor (dropped
+    entries contribute nothing; unused slots stay zero)."""
+    T, k = experts.shape
+    flat_e = experts.reshape(-1)
+    flat_p = jnp.where(keep, pos, 0).reshape(-1)
+    contrib = jnp.where(keep.reshape(-1)[:, None], 1.0, 0.0)
+    tokens = jnp.repeat(x, k, axis=0) * contrib.astype(x.dtype)  # (T*k, d)
+    out = jnp.zeros((n_experts, capacity, x.shape[-1]), x.dtype)
+    return out.at[flat_e, flat_p].add(tokens)
+
+
+def combine(expert_out: jnp.ndarray, gates: jnp.ndarray,
+            experts: jnp.ndarray, pos: jnp.ndarray,
+            keep: jnp.ndarray) -> jnp.ndarray:
+    """Gather each token's surviving expert outputs back, gate-weighted:
+    ``(E, C, d) -> (T, d)``. Dropped entries contribute zero (the token
+    keeps only its surviving experts' terms — residual connections carry
+    the rest, as in the public MoE recipes)."""
+    T, k = experts.shape
+    flat_e = experts.reshape(-1)
+    flat_p = jnp.where(keep, pos, 0).reshape(-1)
+    picked = expert_out[flat_e, flat_p]                        # (T*k, d)
+    w = (gates * keep.astype(gates.dtype)).reshape(-1)[:, None]
+    return (picked * w.astype(picked.dtype)).reshape(
+        T, k, -1).sum(axis=1)
+
+
+def route_stats(keep: jnp.ndarray) -> dict:
+    """Drop-rate accounting (host-side, after device_get)."""
+    total = keep.size
+    kept = int(jnp.sum(keep))
+    return {"routed": total, "kept": kept, "dropped": total - kept,
+            "drop_rate": (total - kept) / total if total else 0.0}
