@@ -180,6 +180,30 @@ def hierarchical_events(n_slices: int, per_slice: int,
     return out
 
 
+def hierarchical_a2a_events(n_slices: int, per_slice: int,
+                            nbytes: int) -> list[Event]:
+    """Two sequential phases of the DCN-light transpose: an intra-slice
+    alltoall of destination-intra-index bundles (ICI rings per slice),
+    then a cross-slice alltoall between same-index ranks (DCN columns)."""
+    out = []
+    step = 0
+    for k in range(per_slice - 1):     # rotation alltoall over intra
+        for s in range(n_slices):
+            for i in range(per_slice):
+                out.append(Event(f"ici a2a step {k} (slice {s})",
+                                 s * per_slice + i, step,
+                                 nbytes // per_slice))
+        step += 1
+    for k in range(n_slices - 1):      # rotation alltoall over slices
+        for s in range(n_slices):
+            for i in range(per_slice):
+                out.append(Event(f"dcn a2a step {k} (column {i})",
+                                 s * per_slice + i, step,
+                                 nbytes // n_slices))
+        step += 1
+    return out
+
+
 _GENERATORS = {
     ("allreduce", "ring"): lambda n, b: ring_events(n, b),
     ("allreduce", "ring_bidir"): lambda n, b: ring_events(n, b, bidir=True),
@@ -196,15 +220,17 @@ def schedule_events(collective: str, algo: str, n: int, nbytes: int,
                     mesh2d: tuple[int, int] | None = None) -> list[Event]:
     """The full event list of one collective call's schedule."""
     if algo == "hierarchical":
-        if collective != "allreduce" or mesh2d is None:
+        if collective not in ("allreduce", "alltoall") or mesh2d is None:
             raise ValueError("hierarchical tracing needs --collective "
-                             "allreduce and --mesh2d SLICESxPER")
-        return hierarchical_events(*mesh2d, nbytes)
+                             "allreduce|alltoall and --mesh2d SLICESxPER")
+        gen2 = (hierarchical_events if collective == "allreduce"
+                else hierarchical_a2a_events)
+        return gen2(*mesh2d, nbytes)
     gen = _GENERATORS.get((collective, algo))
     if gen is None:
         raise ValueError(
             f"no schedule tracer for ({collective}, {algo}); know "
-            f"{sorted(_GENERATORS)} + ('allreduce', 'hierarchical')")
+            f"{sorted(_GENERATORS)} + (allreduce|alltoall, 'hierarchical')")
     return gen(n, nbytes)
 
 

@@ -54,6 +54,22 @@ def test_rotation_vs_bruck_step_counts():
     assert _rank_bytes(bruck, 0) > _rank_bytes(rot, 0)
 
 
+def test_hierarchical_a2a_phases():
+    ev = T.hierarchical_a2a_events(2, 4, 8 * 1024)
+    # per_slice-1 ICI steps then n_slices-1 DCN steps, every rank busy
+    steps = sorted({e.step for e in ev})
+    assert steps == [0, 1, 2, 3]
+    ici = [e for e in ev if e.name.startswith("ici")]
+    dcn = [e for e in ev if e.name.startswith("dcn")]
+    assert {e.step for e in ici} == {0, 1, 2}
+    assert {e.step for e in dcn} == {3}
+    assert all(e.nbytes == 8 * 1024 // 4 for e in ici)  # bundle = S/per
+    assert all(e.nbytes == 8 * 1024 // 2 for e in dcn)  # bundle = S/slices
+    via = T.schedule_events("alltoall", "hierarchical", 8, 8 * 1024,
+                            mesh2d=(2, 4))
+    assert len(via) == len(ev)
+
+
 def test_hierarchical_phases():
     ev = T.hierarchical_events(2, 4, 4 * 1024)
     n_steps = max(e.step for e in ev) + 1
