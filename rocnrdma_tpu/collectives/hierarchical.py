@@ -30,12 +30,22 @@ from rocnrdma_tpu.collectives.ring import (
 def hierarchical_allreduce(x: jax.Array, *, intra_axis: str = "intra",
                            slice_axis: str = "slice",
                            cross_algo: str = "ring",
+                           cross_dtype=None,
                            op: str = "sum") -> jax.Array:
     """Allreduce over both mesh axes, ICI-heavy / DCN-light.
 
     ``cross_algo``: "ring" (explicit) or "fused" (``lax.psum``) for the
     cross-slice phase — DCN hops are latency-dominated, so the fused
     collective is usually right there even when the ICI phases are explicit.
+
+    ``cross_dtype``: optional wire dtype for the CROSS-SLICE phase only
+    (e.g. ``"bfloat16"`` on fp32 buffers): the shard is cast down before
+    crossing the DCN and back after, halving the bytes on the slowest
+    link while both ICI phases stay full precision — the standard TPU
+    mixed-precision recipe for cross-slice gradient sync. Rounding applies
+    to the cross-slice partial sums only. No-op when it matches ``x``'s
+    dtype; only sum/avg are supported (a max/min in a coarser dtype would
+    change which element wins, not just its precision).
 
     ``op``: sum/prod/max/min/avg. ``avg`` runs the two levels as sums and
     divides once at the end (dividing per level would double-divide).
@@ -48,11 +58,21 @@ def hierarchical_allreduce(x: jax.Array, *, intra_axis: str = "intra",
     pad = (-size) % n
     flat = jnp.pad(flat, (0, pad))
 
+    wire = jnp.dtype(cross_dtype) if cross_dtype is not None else None
+    if wire is not None and wire != x.dtype and inner != "sum":
+        raise ValueError(
+            f"cross_dtype only composes with op sum/avg, got op={op!r}")
+
     shard = ring_reduce_scatter(flat, intra_axis, op=inner)     # ICI
+    orig = shard.dtype
+    if wire is not None and wire != orig:
+        shard = shard.astype(wire)
     if cross_algo == "fused":
         shard = fused_reduce(shard, slice_axis, op=inner)       # DCN
     else:
         shard = ring_allreduce(shard, slice_axis, op=inner)     # DCN
+    if wire is not None and wire != orig:
+        shard = shard.astype(orig)
     full = ring_allgather(shard, intra_axis).reshape(-1)        # ICI
     return finalize(full[:size].reshape(shape), op, n * m)
 

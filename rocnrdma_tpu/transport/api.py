@@ -72,8 +72,8 @@ SCHEDULES = {
             C.hd_allreduce(v, RANK_AXIS, op=op),
         "dtree": lambda v, _, op="sum", root=0:
             C.dbtree_allreduce(v, RANK_AXIS, op=op),
-        "hierarchical": lambda v, _, op="sum", root=0:
-            C.hierarchical_allreduce(v, op=op),
+        "hierarchical": lambda v, _, op="sum", root=0, cross_dtype=None:
+            C.hierarchical_allreduce(v, op=op, cross_dtype=cross_dtype),
         "pallas_ring": lambda v, _, op="sum", root=0:
             _pallas().pallas_ring_allreduce(v, RANK_AXIS) if op == "sum"
             else _raise(f"pallas_ring allreduce is sum-only, got op={op!r}"),
@@ -283,13 +283,21 @@ class Transport:
     # -- verbs -------------------------------------------------------------
 
     def _dispatch(self, verb: str, x, algo: str, **knobs):
+        # cross_dtype exists only on the hierarchical allreduce schedule:
+        # when the caller asks for it with a policy algo (auto/model), the
+        # knob IS the algorithm choice — resolving to fused/etc. by table
+        # or model and then rejecting the knob would make the same call
+        # succeed or fail with message size. An explicit algo still
+        # resolves normally and is validated in _build.
+        if knobs.get("cross_dtype") is not None and algo in ("auto", "model"):
+            algo = "hierarchical"
         resolved = self._resolve(algo, verb, self._msg_bytes(verb, x))
         fn = self._jit(verb, resolved, **knobs)  # validates knobs first —
         self._count(verb, resolved, x)           # rejected calls don't count
         return fn(x)
 
     def allreduce(self, x, algo: str = "auto", op: str = "sum", acc=None,
-                  premul=None):
+                  premul=None, cross_dtype=None):
         """(ranks..., S) -> same shape; every rank row = elementwise reduction
         (``op``: sum/prod/max/min/avg). ``acc``: accumulate in this wider
         dtype and cast back — e.g. ``acc="float32"`` on bf16 buffers, the
@@ -298,9 +306,12 @@ class Transport:
         (the ``ncclRedOpCreatePreMulSum`` analogue; requires op='sum' and a
         float buffer). The scalar is a COMPILE-TIME constant — one cached
         program per distinct value; for a per-step dynamic factor (e.g.
-        loss scaling) pre-scale the input array instead."""
+        loss scaling) pre-scale the input array instead. ``cross_dtype``:
+        hierarchical (2-D mesh) only — wire dtype for the cross-slice DCN
+        phase (e.g. ``"bfloat16"`` on fp32 buffers halves DCN bytes; both
+        ICI phases stay full precision)."""
         return self._dispatch("allreduce", x, algo, op=op, acc=acc,
-                              premul=premul)
+                              premul=premul, cross_dtype=cross_dtype)
 
     def reduce_scatter(self, x, algo: str = "auto", op: str = "sum", acc=None,
                        premul=None):
@@ -397,10 +408,18 @@ class Transport:
             knobs["premul"] = float(knobs["premul"])  # one cache key per value
         if knobs.get("donate") is not None:
             knobs["donate"] = bool(knobs["donate"])
+        if knobs.get("cross_dtype") is not None:
+            # canonicalize for one cache entry per dtype (like acc)
+            try:
+                knobs["cross_dtype"] = jnp.dtype(knobs["cross_dtype"]).name
+            except TypeError as e:
+                raise ValueError(
+                    f"bad cross_dtype {knobs['cross_dtype']!r}: {e}") from None
         return {k: v for k, v in knobs.items()
                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
                 and not (k == "shift" and v == 1) and not (k == "acc" and v is None)
                 and not (k == "premul" and v is None)
+                and not (k == "cross_dtype" and v is None)
                 and not (k == "donate" and not v)}
 
     # verbs whose output shape differs from the input: donating would save
@@ -455,6 +474,11 @@ class Transport:
         schedule = SCHEDULES[verb].get(algo)
         if schedule is None:
             raise ValueError(f"op {verb!r} has no {algo!r} schedule")
+        if "cross_dtype" in knobs and (verb, algo) != ("allreduce",
+                                                       "hierarchical"):
+            raise ValueError(
+                f"cross_dtype is a hierarchical-ALLREDUCE knob (the DCN "
+                f"wire dtype); got ({verb!r}, algo {algo!r})")
         # ``donate``: hand the input buffer to XLA for in-place reuse — the
         # zero-copy/user-buffer-registration analogue (ncclCommRegister /
         # hipMemRegister): collectives whose output matches the input

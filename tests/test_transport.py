@@ -79,6 +79,52 @@ def test_auto_policy(t8, t2d):
     assert t2d._resolve("auto", "alltoall") == "hierarchical"
 
 
+def test_cross_dtype_dcn_compression(t2d):
+    """bf16 on the DCN wire only: correct to bf16 rounding of the
+    cross-slice partials, full fp32 on both ICI phases."""
+    x = t2d.shard(_rand((2, 4, 64), seed=21))
+    out = np.asarray(t2d.allreduce(x, "hierarchical",
+                                   cross_dtype="bfloat16"))
+    want = np.broadcast_to(np.asarray(x).sum((0, 1)), out.shape)
+    # error bound: each slice's partial (|.| up to ~4 here) is bf16-rounded
+    # (eps ~8e-3) before the m=2 cross-slice sum -> abs error up to
+    # ~ m * eps * max|partial|; relative error blows up only near zero sums
+    np.testing.assert_allclose(out, want, rtol=2e-2, atol=1e-1)
+    # same-dtype request is a no-op (bitwise equal to the plain run)
+    a = np.asarray(t2d.allreduce(x, "hierarchical", cross_dtype="float32"))
+    b = np.asarray(t2d.allreduce(x, "hierarchical"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_cross_dtype_knob_validation(t8, t2d):
+    x2 = t2d.shard(_rand((2, 4, 8), seed=22))
+    with pytest.raises(ValueError, match="cross_dtype"):
+        t8.allreduce(t8.shard(_rand((8, 8))), "fused",
+                     cross_dtype="bfloat16")
+    with pytest.raises(ValueError, match="sum/avg"):
+        t2d.allreduce(x2, "hierarchical", op="max", cross_dtype="bfloat16")
+    with pytest.raises(ValueError, match="bad cross_dtype"):
+        t2d.allreduce(x2, "hierarchical", cross_dtype="notadtype")
+    # hierarchical ALLTOALL must reject it cleanly too (not a TypeError)
+    with pytest.raises(ValueError, match="cross_dtype"):
+        t2d.jit_fn("alltoall", "hierarchical", cross_dtype="bfloat16")
+
+
+def test_cross_dtype_forces_hierarchical_under_auto(t2d, tmp_path):
+    """auto/model with cross_dtype resolves to hierarchical even when a
+    tuning table would pick another algo — the knob IS the algo choice."""
+    from rocnrdma_tpu.transport.tuner import Bucket, TuningTable
+    table = TuningTable()
+    table.set_buckets("allreduce", 8, 2, "cpu", [Bucket(1 << 30, "fused")])
+    t = Transport(t2d.mesh, tuning=table)
+    x = t.shard(_rand((2, 4, 32), seed=23))
+    assert t._resolve("auto", "allreduce", nbytes=128) == "fused"  # table
+    out = np.asarray(t.allreduce(x, "auto", cross_dtype="bfloat16"))
+    want = np.broadcast_to(np.asarray(x).sum((0, 1)), out.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-2, atol=1e-2)
+    assert ("allreduce", "hierarchical") in t._stats  # the actual dispatch
+
+
 def test_donated_buffer_consumed_and_correct(t8):
     """donate=True (the ncclCommRegister/zero-copy analogue): the result is
     right AND the input buffer is actually handed to XLA (invalidated)."""
