@@ -62,6 +62,8 @@ def hierarchical_allreduce(x: jax.Array, *, intra_axis: str = "intra",
     if wire is not None and wire != x.dtype and inner != "sum":
         raise ValueError(
             f"cross_dtype only composes with op sum/avg, got op={op!r}")
+    if m == 1:
+        wire = None  # nothing crosses the DCN: casting would only round
 
     shard = ring_reduce_scatter(flat, intra_axis, op=inner)     # ICI
     orig = shard.dtype

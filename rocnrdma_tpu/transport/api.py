@@ -282,7 +282,8 @@ class Transport:
 
     # -- verbs -------------------------------------------------------------
 
-    def _dispatch(self, verb: str, x, algo: str, **knobs):
+    @staticmethod
+    def _force_algo(algo: str, **knobs) -> str:
         # cross_dtype exists only on the hierarchical allreduce schedule:
         # when the caller asks for it with a policy algo (auto/model), the
         # knob IS the algorithm choice — resolving to fused/etc. by table
@@ -290,7 +291,11 @@ class Transport:
         # succeed or fail with message size. An explicit algo still
         # resolves normally and is validated in _build.
         if knobs.get("cross_dtype") is not None and algo in ("auto", "model"):
-            algo = "hierarchical"
+            return "hierarchical"
+        return algo
+
+    def _dispatch(self, verb: str, x, algo: str, **knobs):
+        algo = self._force_algo(algo, **knobs)
         resolved = self._resolve(algo, verb, self._msg_bytes(verb, x))
         fn = self._jit(verb, resolved, **knobs)  # validates knobs first —
         self._count(verb, resolved, x)           # rejected calls don't count
@@ -355,6 +360,7 @@ class Transport:
 
     def jit_fn(self, verb: str, algo: str = "auto", **knobs):
         """The compiled global-array callable (what the benches time)."""
+        algo = self._force_algo(algo, **knobs)
         return self._jit(verb, self._resolve(algo, verb), **knobs)
 
     def group(self):
@@ -411,10 +417,20 @@ class Transport:
         if knobs.get("cross_dtype") is not None:
             # canonicalize for one cache entry per dtype (like acc)
             try:
-                knobs["cross_dtype"] = jnp.dtype(knobs["cross_dtype"]).name
+                dt = jnp.dtype(knobs["cross_dtype"])
             except TypeError as e:
                 raise ValueError(
                     f"bad cross_dtype {knobs['cross_dtype']!r}: {e}") from None
+            if not jnp.issubdtype(dt, jnp.floating):
+                # an int wire dtype would TRUNCATE the cross-slice partials
+                # (0.5 -> 0), not just round them — same rule as premul
+                raise ValueError(
+                    f"cross_dtype must be a float dtype, got {dt.name}")
+            if knobs.get("op", "sum") not in ("sum", "avg"):
+                raise ValueError(
+                    f"cross_dtype only composes with op sum/avg (a coarser-"
+                    f"dtype {knobs['op']} would change which element wins)")
+            knobs["cross_dtype"] = dt.name
         return {k: v for k, v in knobs.items()
                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
                 and not (k == "shift" and v == 1) and not (k == "acc" and v is None)

@@ -52,14 +52,17 @@ def _bucket_arrays(t: Transport, trace: Trace, scale: int, dtype: str):
 
 
 def replay(t: Transport, bufs: list, algo: str, mode: str,
-           repeats: int = 5, window: int = 0) -> float:
+           repeats: int = 5, window: int = 0,
+           cross_dtype=None) -> float:
     """Seconds for one full-trace replay (trimmed mean over repeats).
 
     ``window`` bounds outstanding async allreduces in ``overlap`` mode
     (0 = unbounded); see ``workloads/_replay`` for why the CPU oracle
     needs a bounded window and a fused program never does.
+    ``cross_dtype``: DCN wire dtype for the hierarchical schedule (2-D
+    meshes) — the mixed-precision cross-slice gradient sync knob.
     """
-    fn = t.jit_fn("allreduce", algo)
+    fn = t.jit_fn("allreduce", algo, cross_dtype=cross_dtype)
     if mode == "jit_fused":
         return _replay.timed_fused(lambda xs: [fn(x) for x in xs], (bufs,),
                                    repeats)
@@ -84,6 +87,10 @@ def main(argv=None) -> int:
     p.add_argument("--ranks", type=int, default=None)
     p.add_argument("--mesh2d", type=str, default=None, metavar="SLICESxPER")
     p.add_argument("--algo", default="auto")
+    p.add_argument("--cross-dtype", default=None, metavar="DTYPE",
+                   help="DCN wire dtype for the hierarchical schedule on "
+                        "--mesh2d runs (e.g. bfloat16: half the cross-slice "
+                        "bytes, ICI phases stay full precision)")
     p.add_argument("--modes", default=",".join(MODES))
     p.add_argument("--window", type=int, default=None,
                    help="max outstanding async allreduces in overlap mode "
@@ -121,14 +128,16 @@ def main(argv=None) -> int:
 
     modes = args.modes.split(",")
     means = {mode: replay(t, bufs, args.algo, mode, repeats=args.repeats,
-                          window=window) for mode in modes}
+                          window=window, cross_dtype=args.cross_dtype)
+             for mode in modes}
     # speedups are only meaningful against an actually-measured sequential run
     base = means.get("sequential")
 
     records = []
     for mode in modes:
         extra = dict(mode=mode, n_buckets=len(bufs), scale=args.scale,
-                     full_bytes=trace.total_bytes)
+                     full_bytes=trace.total_bytes,
+                     cross_dtype=args.cross_dtype)
         if base is not None:
             extra["speedup_vs_sequential"] = base / means[mode]
         records.append(M.BenchRecord.measure(

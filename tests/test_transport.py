@@ -108,6 +108,19 @@ def test_cross_dtype_knob_validation(t8, t2d):
     # hierarchical ALLTOALL must reject it cleanly too (not a TypeError)
     with pytest.raises(ValueError, match="cross_dtype"):
         t2d.jit_fn("alltoall", "hierarchical", cross_dtype="bfloat16")
+    # an int wire dtype would TRUNCATE the partials, not round them
+    with pytest.raises(ValueError, match="float dtype"):
+        t2d.allreduce(x2, "hierarchical", cross_dtype="int8")
+
+
+def test_cross_dtype_noop_on_single_slice_mesh():
+    """m=1: nothing crosses the DCN, so the knob must not round anything
+    (bitwise-identical to the plain hierarchical run)."""
+    t = Transport(rt.slice_mesh(1, 8))
+    x = t.shard(_rand((1, 8, 64), seed=24))
+    a = np.asarray(t.allreduce(x, "hierarchical", cross_dtype="bfloat16"))
+    b = np.asarray(t.allreduce(x, "hierarchical"))
+    np.testing.assert_array_equal(a, b)
 
 
 def test_cross_dtype_forces_hierarchical_under_auto(t2d, tmp_path):

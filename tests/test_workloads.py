@@ -49,6 +49,18 @@ def test_replay_modes_run(devices, mode):
     assert s > 0
 
 
+def test_replay_cross_dtype_2d(tmp_path, capsys):
+    """--cross-dtype on a 2-D mesh: hierarchical with bf16 DCN wire."""
+    out = tmp_path / "ddp_xd.jsonl"
+    assert ddp_replay.main(["--scale", "65536", "--bucket-mb", "500",
+                            "--mesh2d", "2x2", "--repeats", "1",
+                            "--modes", "sequential",
+                            "--cross-dtype", "bfloat16",
+                            "--out", str(out)]) == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows[0]["extra"]["cross_dtype"] == "bfloat16"
+
+
 def test_replay_cli(tmp_path, capsys):
     out = tmp_path / "ddp.jsonl"
     assert ddp_replay.main(["--scale", "65536", "--bucket-mb", "500",
