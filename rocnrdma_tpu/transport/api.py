@@ -216,6 +216,20 @@ class Transport:
             algo = picked or "auto"
         if algo not in ALGOS:
             raise ValueError(f"unknown algo {algo!r}; know {ALGOS} + 'model'")
+        if algo == "auto":
+            # RNR_ALGO env override (the NCCL_ALGO habit): force one
+            # algorithm fleet-wide without touching code. Only overrides
+            # the policy default — explicit per-call algos win — and only
+            # where the (op, mesh) supports it, so one env var doesn't
+            # break unrelated verbs.
+            forced = os.environ.get("RNR_ALGO", "").strip().lower()
+            if forced:
+                if forced not in ALGOS:
+                    raise ValueError(
+                        f"RNR_ALGO={forced!r} is not an algorithm; "
+                        f"know {ALGOS}")
+                if supports(op, forced, self.is_2d):
+                    algo = forced
         if algo == "auto" and self.tuning is not None and nbytes is not None:
             tuned = self.tuning.lookup(
                 op, nbytes, self.n_ranks, len(self.axes),

@@ -79,6 +79,20 @@ def test_auto_policy(t8, t2d):
     assert t2d._resolve("auto", "alltoall") == "hierarchical"
 
 
+def test_rnr_algo_env_override(t8, t2d, monkeypatch):
+    """RNR_ALGO (the NCCL_ALGO habit): forces auto's pick where supported,
+    never breaks unsupported (op, mesh) combos, loses to explicit algos."""
+    monkeypatch.setenv("RNR_ALGO", "ring")
+    assert t8._resolve("auto", "allreduce") == "ring"
+    assert t8._resolve("fused", "allreduce") == "fused"   # explicit wins
+    assert t2d._resolve("auto", "allreduce") == "hierarchical"  # 2-D: no ring
+    monkeypatch.setenv("RNR_ALGO", "bogus")
+    with pytest.raises(ValueError, match="RNR_ALGO"):
+        t8._resolve("auto", "allreduce")
+    monkeypatch.delenv("RNR_ALGO")
+    assert t8._resolve("auto", "allreduce") == "fused"
+
+
 def test_cross_dtype_dcn_compression(t2d):
     """bf16 on the DCN wire only: correct to bf16 rounding of the
     cross-slice partials, full fp32 on both ICI phases."""
