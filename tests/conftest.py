@@ -38,3 +38,11 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 fake CPU devices, got {devs}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_algo_override(monkeypatch):
+    """A leftover RNR_ALGO (e.g. from a benchmarking session) must not
+    flip every algo='auto' assertion in the suite; tests that WANT the
+    override set it themselves via monkeypatch."""
+    monkeypatch.delenv("RNR_ALGO", raising=False)
