@@ -49,6 +49,10 @@ def make_parser(bench_name: str, collective: str) -> argparse.ArgumentParser:
                    help="root rank (broadcast/reduce/gather/scatter only)")
     p.add_argument("--shift", type=int, default=1,
                    help="ring offset: send to rank+shift mod n (sendrecv only)")
+    p.add_argument("--cross-dtype", default=None, metavar="DTYPE",
+                   help="DCN wire dtype for the hierarchical allreduce on "
+                        "--mesh2d sweeps (e.g. bfloat16); other algos in "
+                        "the sweep run unaffected")
     p.add_argument("--redop", choices=("sum", "prod", "max", "min", "avg"),
                    default="sum",
                    help="reduction operator (allreduce/reducescatter/reduce)")
@@ -270,10 +274,20 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                 # resume fast-path: skip input generation/transfer entirely
                 # when every algo at this sweep point is already recorded
                 # (actual bytes may round down from `size`, so check both).
+                def _xd(algo):
+                    # --cross-dtype applies only where it exists (the
+                    # hierarchical allreduce's DCN wire) and is part of
+                    # the sweep-point identity: a bf16-wire run and a
+                    # plain run are different measurements
+                    return (dict(cross_dtype=args.cross_dtype)
+                            if args.cross_dtype
+                            and collective == "allreduce"
+                            and algo == "hierarchical" else {})
+
                 def _key(algo, nbytes):
                     return M.record_key(bench_name, collective, algo,
                                         pre.n_ranks, nbytes, dtype,
-                                        M.knob_key(knobs))
+                                        M.knob_key({**knobs, **_xd(algo)}))
                 if done and all(_key(a, size) in done or _key(a, _actual_bytes(
                         collective, pre.n_ranks, size, dtype)) in done
                         for a in algos):
@@ -282,6 +296,7 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                                             size, dtype)
                 x = t.shard(x_np)
                 for algo in algos:
+                    xd = _xd(algo)
                     key = _key(algo, actual)
                     if key in done:
                         continue
@@ -298,7 +313,7 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                               f"kernel needs size % (n*128) elems == 0",
                               file=sys.stderr)
                         continue
-                    fn = t.jit_fn(_OP[collective], algo, **knobs)
+                    fn = t.jit_fn(_OP[collective], algo, **knobs, **xd)
                     r1 = None
                     if args.paranoid:
                         # same input, same schedule: any bit difference means
@@ -315,7 +330,9 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                                else np.asarray(fn(x))).astype(np.float32)
                         want = _expected(collective, x_np, pre.mesh2d,
                                          **check_knobs)
-                        rtol, atol = (1e-4, 1e-5) if dtype == "float32" else (5e-2, 5e-2)
+                        rtol, atol = ((5e-2, 5e-2)
+                                      if dtype != "float32" or xd
+                                      else (1e-4, 1e-5))
                         np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
                     tm = time_fn(fn, x, warmup=args.warmup, repeats=args.repeats,
                                  calls_per_repeat=args.iters)
@@ -324,7 +341,7 @@ def run_sweep(bench_name: str, collective: str, args) -> list:
                         tm.mean_s, platform=topo.platform, preset=pre.name,
                         mesh2d=list(pre.mesh2d) if pre.mesh2d else None,
                         min_s=tm.min_s, max_s=tm.max_s, checked=pre.check,
-                        **knobs)
+                        **knobs, **xd)
                     records.append(rec)
                     if out_fp:
                         rec.write(out_fp)

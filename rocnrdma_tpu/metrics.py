@@ -131,7 +131,7 @@ class BenchRecord:
 
 # Collective knobs that change the program (and so the sweep-point identity).
 # Producers record only non-default knobs, so old JSONL rows hash identically.
-_KNOB_KEYS = ("op", "root", "shift")
+_KNOB_KEYS = ("op", "root", "shift", "cross_dtype")
 
 
 def knob_key(extra: dict) -> tuple:

@@ -88,6 +88,34 @@ def test_strict_preset_refuses(tmp_path):
         bench_allreduce.main(["--preset", "tree64", "--strict-preset"])
 
 
+def test_cross_dtype_is_a_distinct_resume_point(tmp_path):
+    """A bf16-wire hierarchical run and a plain one are different sweep
+    points: resuming one over the other's JSONL must re-measure."""
+    out = tmp_path / "r.jsonl"
+    base = ["--mesh2d", "2x2", "--sizes", "16K", "--algos", "hierarchical",
+            "--repeats", "1", "--iters", "1", "--out", str(out), "--resume"]
+    _run(bench_allreduce.main, base)
+    _run(bench_allreduce.main, base + ["--cross-dtype", "bfloat16"])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(rows) == 2
+    assert {r["extra"].get("cross_dtype") for r in rows} == {None, "bfloat16"}
+    # and a rerun of either adds nothing
+    _run(bench_allreduce.main, base + ["--cross-dtype", "bfloat16"])
+    assert len(out.read_text().splitlines()) == 2
+
+
+def test_bench_cross_dtype_applies_to_hierarchical_only(tmp_path):
+    out = tmp_path / "xd.jsonl"
+    _run(bench_allreduce.main,
+         ["--mesh2d", "2x4", "--sizes", "16K",
+          "--algos", "hierarchical,fused", "--cross-dtype", "bfloat16",
+          "--repeats", "2", "--iters", "2", "--out", str(out)])
+    rows = {json.loads(l)["algo"]: json.loads(l)
+            for l in out.read_text().splitlines()}
+    assert rows["hierarchical"]["extra"]["cross_dtype"] == "bfloat16"
+    assert "cross_dtype" not in rows["fused"]["extra"]
+
+
 def test_bench_alltoall_multislice_preset(tmp_path):
     # the multislice preset's hierarchical algo applies to alltoall too (the
     # two-level DCN-light transpose), alongside the fused baseline
