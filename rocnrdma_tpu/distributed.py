@@ -715,6 +715,19 @@ class ProcessGroup:
         running watchdog)."""
         return list(self._dead)
 
+    def async_error(self) -> str | None:
+        """The ``ncclCommGetAsyncError`` habit: poll the group's background
+        health WITHOUT raising — None when healthy, else a description of
+        what the watchdog knows (dead peers, or its own demise). The next
+        verb would raise the same condition; this is for schedulers that
+        want to check between steps."""
+        if self._watchdog_failed:
+            return (f"watchdog thread died ({self._watchdog_failed}); "
+                    f"failure detection is OFF")
+        if self._dead:
+            return f"rank(s) {self._dead} stopped heartbeating"
+        return None
+
     def _check_alive(self) -> None:
         if self._watchdog_failed:
             raise RuntimeError(

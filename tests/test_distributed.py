@@ -738,6 +738,7 @@ def test_watchdog_quiet_when_all_alive(sidecar_store):
         _t.sleep(1.0)  # several beats
         out = pg.all_reduce(np.ones(4, np.float32))  # verbs still work
         assert pg.dead_ranks() == []
+        assert pg.async_error() is None  # the poll-not-raise habit
         pg.stop_watchdog()
         return out
 
@@ -763,6 +764,7 @@ def test_watchdog_flags_never_published_peer(sidecar_store):
         while pg.dead_ranks() != [1]:
             assert _t.monotonic() < deadline, "never-published peer not flagged"
             _t.sleep(0.1)
+        assert "[1]" in pg.async_error()  # poll sees it without raising
         with pytest.raises(RuntimeError, match=r"watchdog.*\[1\]"):
             pg.all_reduce(np.ones(2, np.float32))
         pg.stop_watchdog()
