@@ -259,6 +259,39 @@ class ProcessGroup:
         return self._ring(plugin.ring_scatter_over_net, x, self.rank,
                           self.world_size, root=src)
 
+    # -- object collectives (pickled python values, torch-style) -----------
+    #
+    # For small control-plane payloads (configs, vocab maps, shapes) among
+    # MUTUALLY TRUSTED ranks — pickle is executed on receipt, exactly the
+    # torch.distributed object-collective trust model. Two-phase: fixed
+    # 8-byte size exchange, then the payload ride on the array verbs.
+
+    def broadcast_object(self, obj=None, src: int = 0):
+        """Every rank returns rank ``src``'s ``obj`` (non-src args ignored)."""
+        import pickle
+        payload = (np.frombuffer(pickle.dumps(obj), np.uint8)
+                   if self.rank == src else np.empty(0, np.uint8))
+        size = self.broadcast(np.array([payload.size], np.int64), src=src)
+        buf = payload if self.rank == src else np.empty(int(size[0]), np.uint8)
+        out = self.broadcast(buf, src=src)
+        if self.rank == src:  # keep the original (torch semantics), skip a
+            return obj        # deserialize + deep copy of a large payload
+        return pickle.loads(out.tobytes())
+
+    def all_gather_object(self, obj) -> list:
+        """Every rank contributes any picklable ``obj``; returns the n
+        objects in rank order (sizes may differ — padded on the wire to the
+        max, truncated per-rank on receipt)."""
+        import pickle
+        mine = np.frombuffer(pickle.dumps(obj), np.uint8)
+        sizes = self.all_gather(np.array([mine.size], np.int64))[:, 0]
+        cap = int(sizes.max())
+        padded = np.zeros(cap, np.uint8)
+        padded[:mine.size] = mine
+        rows = self.all_gather(padded)
+        return [pickle.loads(rows[r, :int(sizes[r])].tobytes())
+                for r in range(self.world_size)]
+
     # -- point-to-point ----------------------------------------------------
     #
     # Wiring rule (deadlock-freedom): a rank's FIRST p2p op — before it

@@ -112,6 +112,26 @@ def test_gather_scatter_broadcast_alltoall(sidecar_store):
             a2a, np.stack([mats[src][r] for src in range(n)]))
 
 
+def test_object_collectives(sidecar_store):
+    n = 3
+    store = sidecar_store(n)
+
+    def fn(pg):
+        r = pg.rank
+        cfg = pg.broadcast_object({"lr": 0.1, "layers": [1, 2]}
+                                  if r == 1 else None, src=1)
+        # ragged payloads: rank r contributes an r-dependent-size object
+        gathered = pg.all_gather_object({"rank": r, "pad": "x" * (10 * r)})
+        return cfg, gathered
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    for r in range(n):
+        cfg, gathered = res[r]
+        assert cfg == {"lr": 0.1, "layers": [1, 2]}
+        assert [g["rank"] for g in gathered] == list(range(n))
+        assert gathered[2]["pad"] == "x" * 20
+
+
 def test_rooted_reduce_gather_scatter(sidecar_store):
     n = 4
     store = sidecar_store(n)
