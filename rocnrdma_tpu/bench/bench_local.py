@@ -1,0 +1,148 @@
+"""``bench_local`` — on-chip combine kernels, the HBM-bound half of a step.
+
+Every ring/tree hop ends in an elementwise combine of HBM-resident
+buffers; on one chip that combine IS the measurable half of the collective
+(bench.py's single-chip headline). This CLI times the framework's two
+implementations of it on whatever backend jax sees:
+
+  xla2 / xla3       fused 2-/3-operand combine, XLA lowering (what the
+                    jitted schedules in collectives/ fold with: ring step
+                    = 2-operand, dtree inner-node level fold = 3-operand,
+                    dtree.py:59-69)
+  pallas2 / pallas3 ``ops.pallas_hbm_combine`` — the explicit
+                    double-buffered DMA tier (local-DMA variant of the HBM
+                    ring kernel's mini-hop, ops/local_pallas.py)
+
+On a real TPU the pallas kernels compile through Mosaic and run NATIVELY
+(interpret=None auto-detect) — a completing run of this CLI on hardware is
+the proof that the Pallas data-plane machinery (HBM BlockSpecs, DMA
+semaphores, VMEM slot reuse) lowers for real, not just under the
+interpret-mode oracle. On CPU they run under interpret mode: correct but
+emulated, so the default size drops to keep runtime sane.
+
+Timing: the same two-depth chained-marginal discipline as bench.py
+(``timing.marginal_s_per_op``); GB/s counts (k+1) HBM bytes per element
+(k reads + 1 write).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+
+import jax
+import numpy as np
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu.bench import cli_common
+from rocnrdma_tpu.bench.runner import parse_size
+from rocnrdma_tpu.bench.timing import marginal_s_per_op
+
+KERNELS = ("xla2", "xla3", "pallas2", "pallas3")
+
+
+def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int):
+    """Jitted k-deep chain of one combine kernel; also the chain builder
+    behind bench.py's single-chip headline candidates (one copy of the
+    fori_loop/byte-accounting conventions)."""
+    from jax import lax
+
+    from rocnrdma_tpu.ops import pallas_hbm_combine
+
+    n_ops = int(kernel[-1])
+    if kernel.startswith("xla"):
+        def combine(y, bb, cc):
+            return y + bb + cc if n_ops == 3 else y + bb
+    else:
+        def combine(y, bb, cc):
+            ops = (y, bb, cc)[:n_ops]
+            return pallas_hbm_combine(*ops, tile_rows=tile_rows,
+                                      interpret=interpret)
+
+    @jax.jit
+    def f(x, bb, cc):
+        return lax.fori_loop(
+            0, k, lambda _, y: combine(y, bb, cc), x).ravel()[0]
+    return f
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_local",
+        description="on-chip HBM combine kernels (XLA fused vs Pallas "
+                    "explicit-DMA); the native-execution proof of the "
+                    "Pallas tier on real hardware")
+    p.add_argument("--size", type=str, default=None,
+                   help="per-operand bytes (default: 256M on TPU, 512K on "
+                        "the CPU oracle where pallas runs interpreted)")
+    p.add_argument("--kernels", type=str, default=None,
+                   help=f"comma subset of {','.join(KERNELS)}")
+    p.add_argument("--tile-rows", type=int, default=2048,
+                   help="pallas tile rows (x128 lanes; 2048 = 1 MiB fp32)")
+    p.add_argument("--k1", type=int, default=4)
+    p.add_argument("--k2", type=int, default=None,
+                   help="deep chain depth (default 64 TPU / 16 CPU)")
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    p.add_argument("--fake-devices", type=int, default=None)
+    p.add_argument("--out", type=str, default=None,
+                   help="append JSONL records here")
+    args = p.parse_args(argv)
+
+    cli_common.setup_backend(args.fake_devices, args.platform,
+                             default_ranks=1)
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    native = not on_cpu  # interpret auto-detect in ops/: native iff TPU
+    size = parse_size(args.size) if args.size else (
+        512 * M.KiB if on_cpu else 256 * M.MiB)
+    k2 = args.k2 or (16 if on_cpu else 64)
+    kernels = (args.kernels.split(",") if args.kernels
+               else list(KERNELS))
+    for kname in kernels:
+        if kname not in KERNELS:
+            raise SystemExit(f"unknown kernel {kname!r}; pick from {KERNELS}")
+
+    elems = size // 4
+    rng = np.random.default_rng(0)
+    import jax.numpy as jnp
+    x0 = tuple(jnp.asarray(rng.standard_normal((elems,), dtype=np.float32))
+               for _ in range(3))
+
+    # correctness gate before any timing (the suite's bench convention):
+    # one shallow chain of each kernel vs numpy
+    ref2 = np.asarray(x0[0]) + 2 * np.asarray(x0[1])
+    ref3 = ref2 + 2 * np.asarray(x0[2])
+    rows = []
+    for kname in kernels:
+        n_ops = int(kname[-1])
+        chk = make_combine_chain(kname, args.tile_rows, None if native else True,
+                          k=2)(*x0)
+        want = (ref3 if n_ops == 3 else ref2).ravel()[0]
+        if not np.isclose(float(chk), want, rtol=1e-3, atol=1e-3):
+            raise SystemExit(f"{kname}: self-check failed "
+                             f"({float(chk)} vs {want})")
+        mk = functools.partial(make_combine_chain, kname, args.tile_rows,
+                               None if native else True)
+        sec = marginal_s_per_op(lambda k: mk(k=k), x0, args.k1, k2,
+                                args.repeats, args.trials)
+        gbps = (n_ops + 1) * elems * 4 / sec / 1e9
+        rec = {"bench": "bench_local", "kernel": kname,
+               "size_bytes": size, "GBps": round(gbps, 3),
+               "s_per_op": sec, "native": native,
+               "device_kind": dev.device_kind, "tile_rows": args.tile_rows}
+        rows.append(rec)
+        sz = (f"{size >> 20} MiB" if size >= M.MiB else f"{size >> 10} KiB")
+        print(f"{kname:8s} {sz:>9s}  {gbps:8.1f} GB/s  native={native}")
+    if args.out:
+        with open(args.out, "a") as fp:
+            for rec in rows:
+                fp.write(json.dumps(rec) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""One chip-constants table for the whole framework.
+
+``bench.py``'s roofline reporting and ``transport/tuner.py``'s calibrated
+cost model used to carry separate hand-maintained copies of the same
+device-kind figures; this module is the single source. Values are
+approximate public per-chip numbers; ``MEASURED_HBM_FRAC`` is the one
+measured calibration this repo owns — bench.py's local-combine measurement
+on its real v5e (656-678 GB/s across rounds vs the 819 GB/s public figure,
+i.e. ~0.82 of peak) — applied as the achievable-fraction derate for every
+chip kind until a given chip is measured directly.
+
+Match rule: first key that is a substring of the lowercased
+``device_kind`` wins (e.g. "TPU v5 lite" matches "v5 lite" before "v5").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Chip:
+    hbm_GBps: float   # public peak HBM bandwidth per chip
+    ici_GBps: float   # public aggregate ICI bandwidth per chip
+    ici_links: int    # inter-chip links (per-link rate = ici_GBps / links)
+
+
+# keys match substrings of jax device_kind (e.g. "TPU v5 lite", "TPU v6 lite")
+CHIPS: dict[str, Chip] = {
+    "v5 lite": Chip(819.0, 400.0, 4), "v5e": Chip(819.0, 400.0, 4),
+    "v6 lite": Chip(1638.0, 900.0, 4), "v6e": Chip(1638.0, 900.0, 4),
+    "v5p": Chip(2765.0, 1200.0, 6), "v5": Chip(2765.0, 1200.0, 6),
+    "v4": Chip(1228.0, 1200.0, 6),
+}
+
+# measured/public HBM fraction on this repo's real chip (bench.py headline)
+MEASURED_HBM_FRAC = 670.0 / 819.0
+
+
+def chip_for(device_kind: str) -> Chip | None:
+    kind = (device_kind or "").lower()
+    for key, chip in CHIPS.items():
+        if key in kind:
+            return chip
+    return None

@@ -8,8 +8,12 @@ semaphores — queue pairs become double-buffered communication slots, and
 completion polling becomes semaphore waits.
 """
 
+from rocnrdma_tpu.ops.local_pallas import (  # noqa: F401
+    pallas_hbm_combine,
+)
 from rocnrdma_tpu.ops.ring_pallas import (  # noqa: F401
     pallas_alltoall,
+    pallas_alltoallv,
     pallas_hbm_ring_allreduce,
     pallas_ring_allgather,
     pallas_ring_allreduce,

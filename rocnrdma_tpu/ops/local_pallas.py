@@ -1,0 +1,131 @@
+"""Local-DMA streaming combine: the on-chip half of a ring/tree step.
+
+The remote-DMA ring kernels (``ring_pallas.py``) need >=2 chips before a
+single hop executes, so on the one real chip available they had only ever
+run under interpret mode. This module runs the SAME memory machinery
+natively on one chip: HBM-resident operands streamed tile-by-tile through
+double-buffered VMEM slots by explicit async DMAs, combine on the VPU,
+result DMA'd back to HBM — ``make_async_copy`` standing in for
+``make_async_remote_copy``. It is the local-DMA variant of
+``_hbm_ring_kernel``'s mini-hop (same staging slots, same semaphore
+discipline), so a native (non-interpret) run of this kernel exercises the
+Mosaic lowering of everything the HBM ring tier does except the wire
+itself — tile shapes, DMA semaphore allocation, HBM BlockSpecs, VMEM slot
+reuse — which is exactly where interpret mode and real lowering diverge.
+
+Semantics: ``pallas_hbm_combine(x0, .., xk-1) == x0 + .. + xk-1``.
+k=2 is the per-step combine of the ring schedules (2R+1W per element);
+k=3 is the double-binary-tree inner-node level combine
+(``collectives/dtree.py:59-69``; 3R+1W per element) — the two kernels the
+single-chip headline in ``bench.py`` can honestly report.
+
+Reference hook (BASELINE.json:5): the ``hipMemRegister``-pinned staging
+buffers the reference DMA'd through become these VMEM slots; posting the
+next tile's loads before waiting the current tile's is the same
+overlap-by-queue-depth trick as keeping multiple ``ibv_post_send`` work
+requests outstanding on a QP.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from rocnrdma_tpu.ops.ring_pallas import _interpret_mode
+
+
+def _hbm_combine_kernel(*refs, n_tiles: int, k: int):
+    """refs = (x0..xk-1 HBM, o HBM, in_slots, out_slots, load_sems,
+    store_sems). Double-buffered pipeline, unrolled at trace time like
+    ``_hbm_ring_kernel``: while tile t is combined and stored, tile t+1's
+    k loads are already in flight on the other slot.
+
+    Hazards the slot/semaphore discipline covers (mirroring the credit
+    notes in ``_ring_hops``):
+      - in_slots[s] reuse: loads for tile t+2 are only issued after tile
+        t+1's loads started AND tile t's combine read the slot (program
+        order guarantees the read; the per-slot sems guarantee the load).
+      - out_slots[s] reuse: before writing the combine of tile t (t>=2),
+        wait the store of tile t-2 (same slot) so the DMA source is not
+        overwritten mid-flight.
+    """
+    x_refs, o_ref = refs[:k], refs[k]
+    in_slots, out_slots, load_sems, store_sems = refs[k + 1:]
+
+    loads: dict = {}
+    stores: dict = {}
+
+    def start_loads(t):
+        slot = t % 2
+        for j in range(k):
+            cp = pltpu.make_async_copy(x_refs[j].at[t],
+                                       in_slots.at[slot, j],
+                                       load_sems.at[slot, j])
+            cp.start()
+            loads[(t, j)] = cp
+
+    start_loads(0)
+    for t in range(n_tiles):
+        slot = t % 2
+        if t + 1 < n_tiles:  # prefetch next tile onto the other slot
+            start_loads(t + 1)
+        for j in range(k):
+            loads.pop((t, j)).wait()
+        if t >= 2:  # out slot reused: its previous store must have landed
+            stores.pop(t - 2).wait()
+        acc = in_slots[slot, 0]
+        for j in range(1, k):
+            acc = acc + in_slots[slot, j]
+        out_slots[slot] = acc
+        cp = pltpu.make_async_copy(out_slots.at[slot], o_ref.at[t],
+                                   store_sems.at[slot])
+        cp.start()
+        stores[t] = cp
+    for t in sorted(stores):  # drain the last (<=2) stores
+        stores[t].wait()
+
+
+def pallas_hbm_combine(*xs: jax.Array, tile_rows: int = 2048,
+                       interpret: bool | None = None) -> jax.Array:
+    """Elementwise sum of k same-shaped HBM-resident arrays, streamed
+    (tile_rows, 128) tiles at a time through double-buffered VMEM slots.
+
+    VMEM footprint is 2*(k+1) tiles regardless of buffer size (k input
+    slots + 1 output slot, double-buffered); the default 1 MiB fp32 tile
+    keeps it ~8 MiB at k=3, inside the ~16 MiB/core budget. The tile loop
+    unrolls at trace time — at 256 MiB that is 256 tiles, the same order
+    of program size as the HBM ring kernel's hop unroll.
+    """
+    k = len(xs)
+    if k < 2:
+        raise ValueError("pallas_hbm_combine needs >= 2 operands")
+    shape, dtype = xs[0].shape, xs[0].dtype
+    for x in xs[1:]:
+        if x.shape != shape or x.dtype != dtype:
+            raise ValueError("operands must share shape and dtype")
+    lanes = 128
+    tile = tile_rows * lanes
+    size = xs[0].size
+    padded = -(-size // tile) * tile
+    n_tiles = padded // tile
+    bufs = [jnp.pad(x.reshape(-1), (0, padded - size))
+            .reshape(n_tiles, tile_rows, lanes) for x in xs]
+    kern = functools.partial(_hbm_combine_kernel, n_tiles=n_tiles, k=k)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct(bufs[0].shape, dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * k,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, k, tile_rows, lanes), dtype),  # input slots
+            pltpu.VMEM((2, tile_rows, lanes), dtype),     # output slots
+            pltpu.SemaphoreType.DMA((2, k)),              # per-slot loads
+            pltpu.SemaphoreType.DMA((2,)),                # per-slot stores
+        ],
+        interpret=_interpret_mode(interpret),
+    )(*bufs)
+    return out.reshape(-1)[:size].reshape(shape)

@@ -316,6 +316,42 @@ def pallas_alltoall(x: jax.Array, axis_name: str,
     return out.reshape(n, -1)[:, :per].reshape(x.shape)
 
 
+def pallas_alltoallv(x: jax.Array, counts: jax.Array, axis_name: str,
+                     interpret: bool | None = None
+                     ) -> tuple[jax.Array, jax.Array]:
+    """Ragged alltoall on the device plane — the RCCL ``ncclAllToAllv``
+    analogue of the host plane's ``ring_alltoallv_over_net``
+    (transport/plugin.py), with the TPU's static-shape bargain.
+
+    ``x``: (n, max_count, ...) — chunk d carries ``counts[my, d]`` valid
+    rows destined for rank d (rows beyond the count are don't-care).
+    ``counts``: the full (n, n) element-count matrix, identical on every
+    rank (the MPI alltoallv contract, exactly as the host plane takes it).
+    Returns ``(out, recv_counts)``: ``out[j]`` holds the first
+    ``counts[j, my]`` rows rank j sent here, tail rows zeroed;
+    ``recv_counts = counts[:, my]``.
+
+    Unlike the host plane, the wire always moves ``max_count`` rows per
+    chunk: XLA/Mosaic programs are compiled once for static shapes, so a
+    truly ragged DMA would force a recompile per counts matrix (or
+    per-row DMA loops gated on traced bounds). Shipping the static
+    capacity and masking at the receiver is the same trade the MoE
+    dispatch makes (workloads/routing.py) and costs wire bytes only when
+    counts are far below capacity — the regime where the exchange is
+    latency-bound anyway. See docs/DESIGN.md §5a.
+    """
+    n = lax.axis_size(axis_name)
+    if counts.shape != (n, n):
+        raise ValueError(f"counts must be ({n}, {n}), got {counts.shape}")
+    out = pallas_alltoall(x, axis_name, interpret=interpret)
+    my = lax.axis_index(axis_name)
+    recv_counts = lax.dynamic_index_in_dim(counts.T, my, keepdims=False)
+    row = jnp.arange(x.shape[1])
+    mask = row[None, :] < recv_counts[:, None]          # (n, max_count)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mask, out, jnp.zeros((), x.dtype)), recv_counts
+
+
 # ---------------------------------------------------------------------------
 # HBM-resident tier: stream tiles through VMEM staging around the ring
 

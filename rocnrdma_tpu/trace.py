@@ -265,13 +265,106 @@ def to_chrome_trace(events: list[Event], alpha: float = ALPHA_S,
                           "n_steps": n_steps}}
 
 
+# --------------------------------------------------------------------------
+# Measured lane: real per-op durations out of an XProf capture (the NPKit
+# concept proper — NPKit recorded MEASURED events, the model lane above only
+# predicts them)
+
+# substrings of XLA op/event names that belong to a schedule's data path
+_MEASURED_OP_HINTS = ("ppermute", "collective-permute", "all-reduce",
+                      "all-gather", "all-to-all", "reduce-scatter",
+                      "add", "fusion", "psum", "rendezvous")
+
+
+def measured_lanes(xplane_path: str, hints=_MEASURED_OP_HINTS) -> list:
+    """Parse an ``.xplane.pb`` (as written by ``--profile`` / a
+    ``jax.profiler.trace`` capture) into per-device-lane op events:
+    ``[(lane_label, [(op_name, start_ns, dur_ns), ...]), ...]``, keeping
+    only events whose name matches the schedule-data-path ``hints``
+    (``end:``-marker twins dropped). Works on whatever planes the backend
+    wrote — per-device executor lines on the CPU oracle, per-core TPU
+    planes on hardware."""
+    from jax.profiler import ProfileData
+
+    p = ProfileData.from_file(xplane_path)
+    lanes = []
+    for plane in p.planes:
+        for line in plane.lines:
+            evs = [(e.name, int(e.start_ns), int(e.duration_ns))
+                   for e in line.events
+                   if not e.name.startswith("end:")
+                   and any(h in e.name.lower() for h in hints)]
+            if evs:
+                evs.sort(key=lambda t: t[1])
+                lanes.append((f"{plane.name}/{line.name}", evs))
+    return lanes
+
+
+def measured_to_chrome(lanes: list, pid: int = 1) -> list:
+    """Chrome-trace slices for the measured lane (pid 1 next to the
+    predicted pid 0), timestamps rebased so the earliest matched event is
+    t=0 — which lines the two lanes up for eyeball diffing."""
+    if not lanes:
+        return []
+    t0 = min(ev[1] for _, evs in lanes for ev in evs)
+    out = []
+    for tid, (label, evs) in enumerate(sorted(lanes)):
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": f"measured {label}"}})
+        for name, start, dur in evs:
+            out.append({"name": name, "ph": "X", "pid": pid, "tid": tid,
+                        "ts": round((start - t0) / 1e3, 3),
+                        "dur": round(dur / 1e3, 3)})
+    return out
+
+
+def profile_collective(collective: str, algo: str, ranks: int,
+                       nbytes: int, mesh2d, fake_devices, platform: str,
+                       dtype: str = "float32") -> list:
+    """Run the collective once on the live backend under an XProf capture
+    and return its measured lanes. Shares the bench runner's input builder
+    and the Transport's jit cache so the profiled program is EXACTLY the
+    one the sweeps time."""
+    import glob
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from rocnrdma_tpu.bench.cli_common import build_mesh, setup_backend
+    from rocnrdma_tpu.bench.runner import _build_input
+    from rocnrdma_tpu.transport import Transport
+
+    info = setup_backend(fake_devices, platform, ranks)
+    mesh = build_mesh("x".join(map(str, mesh2d)) if mesh2d else None,
+                      ranks, info.topology)
+    t = Transport(mesh)
+    verb = {"reducescatter": "reduce_scatter", "sendrecv": "sendrecv"}.get(
+        collective, collective)
+    x, _ = _build_input(collective, t.n_ranks,
+                        mesh.devices.shape if t.is_2d else None,
+                        nbytes, dtype)
+    xs = t.shard(x)
+    fn = t.jit_fn(verb, algo)
+    jax.block_until_ready(fn(xs))  # compile + warm outside the capture
+    d = tempfile.mkdtemp(prefix="rnr_xprof_")
+    with jax.profiler.trace(d):
+        np.asarray(fn(xs))  # fetch: the reliable barrier on relay backends
+    paths = sorted(glob.glob(d + "/**/*.xplane.pb", recursive=True))
+    if not paths:
+        raise RuntimeError(f"XProf capture wrote no .xplane.pb under {d}")
+    return measured_lanes(paths[-1])
+
+
 def main(argv=None) -> int:
     from rocnrdma_tpu.bench.runner import parse_size
 
     p = argparse.ArgumentParser(
         prog="rocnrdma_trace",
         description="Emit a Chrome-trace timeline of an explicit schedule "
-                    "(the NPKit analogue; model-predicted durations)")
+                    "(the NPKit analogue; model-predicted durations, plus "
+                    "a measured lane from a live XProf capture with "
+                    "--measured)")
     p.add_argument("--collective", default="allreduce")
     p.add_argument("--algo", default="ring")
     p.add_argument("--ranks", type=int, default=8)
@@ -283,6 +376,17 @@ def main(argv=None) -> int:
     p.add_argument("--beta", type=float, default=BETA_S_PER_B,
                    help="seconds per byte (tuner default)")
     p.add_argument("--out", default=None, help="output path (default stdout)")
+    p.add_argument("--measured", action="store_true",
+                   help="also run the collective on the live backend under "
+                        "an XProf capture and emit a second lane (pid 1) "
+                        "with the REAL per-op durations")
+    p.add_argument("--xplane", default=None, metavar="PB",
+                   help="with --measured: parse this existing .xplane.pb "
+                        "(e.g. from a bench --profile dir) instead of "
+                        "running the collective")
+    p.add_argument("--fake-devices", type=int, default=None,
+                   help="with --measured: CPU-oracle backend size")
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
     args = p.parse_args(argv)
 
     mesh2d = None
@@ -293,13 +397,33 @@ def main(argv=None) -> int:
     events = schedule_events(args.collective, args.algo, args.ranks,
                              parse_size(args.size), mesh2d)
     doc = to_chrome_trace(events, args.alpha, args.beta)
+
+    measured_note = ""
+    if args.measured:
+        lanes = (measured_lanes(args.xplane) if args.xplane else
+                 profile_collective(args.collective, args.algo, args.ranks,
+                                    parse_size(args.size), mesh2d,
+                                    args.fake_devices, args.platform))
+        if not lanes:
+            raise SystemExit(
+                "--measured: no schedule-data-path events matched in the "
+                "capture (try a bigger --size, or check the .xplane.pb)")
+        doc["traceEvents"] += measured_to_chrome(lanes)
+        n_ev = sum(len(evs) for _, evs in lanes)
+        meas_us = max(ev[1] + ev[2] for _, evs in lanes for ev in evs)
+        meas_us = (meas_us - min(ev[1] for _, evs in lanes for ev in evs)) / 1e3
+        doc["otherData"]["measured_us"] = round(meas_us, 3)
+        doc["otherData"]["measured_events"] = n_ev
+        measured_note = (f"; measured lane: {n_ev} events across "
+                         f"{len(lanes)} device lanes, {meas_us:.0f} us")
+
     payload = json.dumps(doc)
     if args.out:
         with open(args.out, "w") as fp:
             fp.write(payload)
         print(f"# {len(events)} events, {doc['otherData']['n_steps']} steps, "
-              f"predicted {doc['otherData']['total_us']:.0f} us -> {args.out}",
-              file=sys.stderr)
+              f"predicted {doc['otherData']['total_us']:.0f} us"
+              f"{measured_note} -> {args.out}", file=sys.stderr)
     else:
         print(payload)
     return 0

@@ -206,12 +206,18 @@ class Transport:
             # kernels run in interpret mode, orders of magnitude off the
             # model's wire-cost assumptions (same exclusion the Autotuner's
             # sweep applies).
-            from rocnrdma_tpu.transport.tuner import model_pick
-            plat = self.mesh.devices.flat[0].platform
+            from rocnrdma_tpu.transport.tuner import constants_for, model_pick
+            dev = self.mesh.devices.flat[0]
+            plat = dev.platform
             cands = [a for a in SCHEDULES[op]
                      if supports(op, a, self.is_2d)
                      and (plat == "tpu" or not a.startswith("pallas"))]
-            picked = (model_pick(op, self.n_ranks, nbytes, candidates=cands)
+            # TPU-calibrated alpha/beta when the chip kind is known
+            # (tuner.constants_for; per-verb — reducing verbs pay the HBM
+            # combine term), generic ratios otherwise
+            alpha, beta = constants_for(getattr(dev, "device_kind", ""), op)
+            picked = (model_pick(op, self.n_ranks, nbytes, candidates=cands,
+                                 alpha=alpha, beta=beta)
                       if nbytes is not None else None)
             algo = picked or "auto"
         if algo not in ALGOS:

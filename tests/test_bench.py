@@ -257,3 +257,75 @@ def test_bf16_sweep_rows(tmp_path):
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert {r["dtype"] for r in rows} == {"float32", "bfloat16"}
     assert {r["algo"] for r in rows} == {"ring", "fused"}
+
+
+def test_bench_local_cli(tmp_path):
+    from rocnrdma_tpu.bench import bench_local
+    out = tmp_path / "l.jsonl"
+    _run(bench_local.main,
+         ["--size", "64K", "--kernels", "xla2,xla3,pallas2,pallas3",
+          "--k2", "8", "--repeats", "2", "--trials", "1",
+          "--tile-rows", "8", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [r["kernel"] for r in rows] == ["xla2", "xla3", "pallas2",
+                                          "pallas3"]
+    # on the CPU oracle the pallas tier runs interpreted, never native
+    assert all(r["native"] is False for r in rows)
+    assert all(r["GBps"] > 0 for r in rows)
+
+
+def test_bench_local_rejects_unknown_kernel():
+    from rocnrdma_tpu.bench import bench_local
+    with pytest.raises(SystemExit):
+        bench_local.main(["--kernels", "cuda9000"])
+
+
+def test_tree64_at_contract_ranks():
+    # VERDICT r1 item 4: the suite must run a collective above n=8. A fresh
+    # interpreter hosts 64 fake devices (conftest pinned this one to 8);
+    # the preset's tree/dtree/fused legs all self-check vs numpy at n=64.
+    import subprocess
+    import sys
+
+    res = subprocess.run(
+        [sys.executable, "-m", "rocnrdma_tpu.bench.bench_allreduce",
+         "--preset", "tree64", "--fake-devices", "64", "--sizes", "64K",
+         "--repeats", "1", "--iters", "2"],
+        capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert " 64 " in res.stdout and "dtree" in res.stdout
+
+
+def test_bench_script_multichip_branch_with_failing_candidate(
+        monkeypatch, capsys):
+    # VERDICT r1 item 10: the code that runs at real-multi-chip first
+    # contact (bench.py's n>=2 best-of, including a candidate that raises)
+    # must have executed at least once. conftest's 8 fake devices take the
+    # n>=2 branch; shrinking MiB keeps the timed chains trivial.
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_script", os.path.join(os.path.dirname(__file__), "..",
+                                     "bench.py"))
+    bench_script = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_script)
+
+    import rocnrdma_tpu.collectives as C
+    from rocnrdma_tpu import metrics as M
+
+    monkeypatch.setattr(M, "MiB", 1024)  # 8 "MiB" -> 8 KiB arrays
+    def boom(*a, **k):
+        raise RuntimeError("injected candidate failure")
+    monkeypatch.setattr(C, "ring_allreduce", boom)
+
+    assert bench_script.main() == 0
+    out = capsys.readouterr()
+    # the failing candidate lost the best-of without aborting the run...
+    assert "ring_bidir failed" in out.err
+    assert "winner: fused" in out.err
+    # ...and the scored JSON line still printed with a finite ratio
+    import json
+    row = json.loads(out.out.strip().splitlines()[-1])
+    assert row["metric"] == "allreduce_busbw_GBps_per_chip"
+    assert row["value"] > 0 and row["vs_baseline"] > 0

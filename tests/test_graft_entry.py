@@ -26,3 +26,41 @@ def test_dryrun_multichip(devices):
     # asserts internally (numpy oracles for dp allreduce, ep alltoall, the
     # full top-k MoE layer, grouped launch and dtree)
     g.dryrun_multichip(8)
+
+
+def _dryrun_in_subprocess(n, timeout=420):
+    # conftest pinned THIS process to 8 fake devices; contract-scale rank
+    # counts need a fresh interpreter where dryrun_multichip can still set
+    # jax_num_cpu_devices itself (PYTHONPATH is exported by conftest)
+    import subprocess
+    import sys
+
+    res = subprocess.run(
+        [sys.executable, "-c",
+         f"import __graft_entry__ as g; g.dryrun_multichip({n})"],
+        capture_output=True, text=True, timeout=timeout)
+    assert res.returncode == 0, res.stderr[-2000:]
+    return res.stdout
+
+
+def test_dryrun_multichip_16(devices):
+    # VERDICT r1 item 4: the oracle must exercise >8 ranks
+    out = _dryrun_in_subprocess(16)
+    assert "(2, 8)" in out and "hierarchical=True" in out
+
+
+def test_dryrun_multichip_nonpow2_3x5(devices):
+    # 15 devices: odd composite -> a 3x5 ('slice','intra') mesh; catches
+    # power-of-two assumptions anywhere in the sharded step
+    out = _dryrun_in_subprocess(15)
+    assert "(3, 5)" in out and "hierarchical=True" in out
+
+
+def test_mesh_factor():
+    import __graft_entry__ as g
+
+    assert g._mesh_factor(16) == (2, 8)
+    assert g._mesh_factor(15) == (3, 5)
+    assert g._mesh_factor(9) == (3, 3)
+    for prime_or_small in (1, 2, 3, 7, 13):
+        assert g._mesh_factor(prime_or_small) is None

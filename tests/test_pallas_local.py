@@ -1,0 +1,63 @@
+"""The local-DMA streaming combine (`ops/local_pallas.py`), run under TPU
+interpret mode on the CPU oracle. The native (non-interpret) execution of
+the same kernel is proven on hardware by `bench/bench_local.py` — whose
+artifact lands in results/ — because this suite pins the CPU backend."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.ops import pallas_hbm_combine
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+def test_combine_matches_numpy(devices, k):
+    rng = np.random.default_rng(k)
+    xs = [jnp.asarray(rng.standard_normal(1000, dtype=np.float32))
+          for _ in range(k)]
+    out = pallas_hbm_combine(*xs, tile_rows=8, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), sum(np.asarray(x) for x in xs), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("size", [1, 128, 1024, 3 * 8 * 128 + 17])
+def test_combine_sizes_and_padding(devices, size):
+    # below one tile, exactly tiled, and unaligned multi-tile (tile_rows=8
+    # -> 1024-elem tiles; the last case spans 4 tiles with a ragged tail)
+    rng = np.random.default_rng(size)
+    a = jnp.asarray(rng.standard_normal(size, dtype=np.float32))
+    b = jnp.asarray(rng.standard_normal(size, dtype=np.float32))
+    out = pallas_hbm_combine(a, b, tile_rows=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a) + np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_combine_2d_shape_preserved(devices):
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((33, 45), dtype=np.float32))
+    b = jnp.asarray(rng.standard_normal((33, 45), dtype=np.float32))
+    out = pallas_hbm_combine(a, b, tile_rows=8, interpret=True)
+    assert out.shape == (33, 45)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a) + np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_combine_bfloat16(devices):
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.standard_normal(512).astype(np.float32)).astype(jnp.bfloat16)
+    b = jnp.asarray(rng.standard_normal(512).astype(np.float32)).astype(jnp.bfloat16)
+    out = pallas_hbm_combine(a, b, tile_rows=8, interpret=True)
+    ref = (a.astype(jnp.float32) + b.astype(jnp.float32)).astype(jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref, dtype=np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_combine_validates_operands(devices):
+    a = jnp.zeros(10, jnp.float32)
+    with pytest.raises(ValueError, match=">= 2 operands"):
+        pallas_hbm_combine(a, interpret=True)
+    with pytest.raises(ValueError, match="share shape"):
+        pallas_hbm_combine(a, jnp.zeros(11, jnp.float32), interpret=True)
+    with pytest.raises(ValueError, match="share shape"):
+        pallas_hbm_combine(a, jnp.zeros(10, jnp.bfloat16), interpret=True)

@@ -113,3 +113,49 @@ def test_cli_writes_trace(tmp_path):
     rc = T.main(["--algo", "hierarchical", "--mesh2d", "2x4",
                  "--size", "64K", "--out", str(out)])
     assert rc == 0
+
+
+def test_measured_lane_from_live_capture(tmp_path):
+    # VERDICT r1 item 8: the NPKit concept records MEASURED events — run
+    # the ring on the oracle under an XProf capture and check the second
+    # Chrome-trace lane carries real, nonzero-duration device events
+    import json
+
+    from rocnrdma_tpu import trace as T
+
+    out = tmp_path / "m.json"
+    rc = T.main(["--collective", "allreduce", "--algo", "ring",
+                 "--ranks", "8", "--size", "64K", "--measured",
+                 "--fake-devices", "8", "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    predicted = [e for e in doc["traceEvents"]
+                 if e.get("pid") == 0 and e.get("ph") == "X"]
+    measured = [e for e in doc["traceEvents"]
+                if e.get("pid") == 1 and e.get("ph") == "X"]
+    assert predicted and measured
+    # the capture saw the schedule's wire op on several device lanes
+    assert any("ppermute" in e["name"] for e in measured)
+    assert len({e["tid"] for e in measured}) >= 8
+    assert doc["otherData"]["measured_us"] > 0
+    assert doc["otherData"]["measured_events"] == len(measured)
+
+
+def test_measured_from_existing_xplane(tmp_path):
+    # the --xplane form consumes a capture some bench --profile run wrote
+    import glob
+
+    import jax
+    import numpy as np
+
+    from rocnrdma_tpu import trace as T
+
+    d = str(tmp_path)
+    x = np.ones((8, 128), np.float32)
+    with jax.profiler.trace(d):
+        np.asarray(jax.jit(lambda v: v + v)(x))
+    pb = sorted(glob.glob(d + "/**/*.xplane.pb", recursive=True))
+    assert pb
+    lanes = T.measured_lanes(pb[-1])
+    assert lanes and any("add" in name.lower()
+                         for _, evs in lanes for name, _, _ in evs)

@@ -184,3 +184,74 @@ def test_autotune_2d_mesh_candidates():
     table = tuner.sweep(["allreduce"], [1024])
     picked = table.lookup("allreduce", 1024, 4, 2, "cpu")
     assert picked in ("fused", "hierarchical")  # the only 2-D-legal algos
+
+
+def test_constants_for_tpu_calibration():
+    from rocnrdma_tpu.transport.tuner import (ALPHA_S, BETA_S_PER_B,
+                                              constants_for)
+    a, b = constants_for("TPU v5 lite", "allreduce")
+    # beta = per-link wire time + measured HBM combine time (3 bytes of
+    # HBM traffic per byte reduced, at the chip's ACHIEVABLE rate: public
+    # peak x the fraction bench.py measured on this repo's v5e)
+    assert a == 1.0e-6
+    assert b == pytest.approx(1 / 100e9 + 3 / 670e9)
+    # pure-movement verbs fold no combine: wire term only
+    _, b_move = constants_for("TPU v5 lite", "alltoall")
+    assert b_move == pytest.approx(1 / 100e9)
+    # other chips scale the combine term by THEIR hbm, same measured frac
+    _, b_v5p = constants_for("TPU v5p", "allreduce")
+    assert b_v5p == pytest.approx(1 / 200e9 + 3 / (2765 * 670 / 819) / 1e9)
+    # unknown chips keep the generic ratio constants
+    assert constants_for("warp drive") == (ALPHA_S, BETA_S_PER_B)
+    assert constants_for("") == (ALPHA_S, BETA_S_PER_B)
+
+
+def test_model_table_generation_and_provenance():
+    from rocnrdma_tpu.transport.tuner import model_table
+    t = model_table("v5 lite", [8, 64], ["allreduce", "alltoall"],
+                    [4096, 2**30])
+    # fused is modeled as the bandwidth-optimal shape at half-alpha hops
+    # (one compiled program), NOT as a log-depth schedule — so the
+    # latency-bound corner goes to the explicit tree and the
+    # bandwidth-bound bulk to fused, the RCCL-table shape
+    assert t.lookup("allreduce", 4096, 8, 1, "tpu") == "tree"
+    assert t.lookup("allreduce", 2**30, 8, 1, "tpu") == "fused"
+    assert t.lookup("allreduce", 2**30, 64, 1, "tpu") == "fused"
+    # alltoall's fused model is the direct fabric exchange: one hop,
+    # wire-optimal — nothing explicit beats it at any size
+    assert t.lookup("alltoall", 4096, 8, 1, "tpu") == "fused"
+    assert "model-derived" in t.meta["provenance"]
+    # meta must never leak into lookup keys
+    assert t.lookup("_meta", 1, 1, 1, "tpu") is None
+
+
+def test_merge_tables_provenance_mixing():
+    from rocnrdma_tpu.transport.tuner import Bucket, merge_tables
+    model = TuningTable(meta={"provenance": "model-derived"})
+    model.set_buckets("allreduce", 8, 1, "tpu", [Bucket(1 << 40, "tree")])
+    sweep = TuningTable(meta={"provenance": "measured sweep"})
+    sweep.set_buckets("allreduce", 8, 1, "tpu", [Bucket(1 << 40, "fused")])
+    merged = merge_tables(model, sweep)
+    # sweep rows win; the label admits the mix instead of claiming either
+    assert merged.lookup("allreduce", 4096, 8, 1, "tpu") == "fused"
+    assert "mixed" in merged.meta["provenance"]
+    assert "measured sweep" in merged.meta["provenance"]
+
+
+def test_tuning_v5e_artifact_loads_and_consults(tmp_path):
+    import os
+    from rocnrdma_tpu.transport.tuner import TuningTable
+    path = os.path.join(os.path.dirname(__file__), "..", "results",
+                        "tuning_v5e.json")
+    t = TuningTable.load(path)
+    assert t.meta["device_kind"] == "v5 lite"
+    # the entries key the tpu platform: on real-TPU first contact a
+    # Transport(tuning=this) resolves auto from these rows...
+    assert t.lookup("allreduce", 256 * 2**20, 8, 1, "tpu") == "fused"
+    # ...and on the CPU oracle the platform key does NOT match, so auto
+    # keeps the static policy instead of trusting tpu-calibrated picks
+    assert t.lookup("allreduce", 256 * 2**20, 8, 1, "cpu") is None
+    # round-trip with meta intact
+    p2 = tmp_path / "t.json"
+    t.save(str(p2))
+    assert TuningTable.load(str(p2)).meta == t.meta
