@@ -83,7 +83,9 @@ def main(argv=None) -> int:
                    help="pallas tile rows (x128 lanes; 2048 = 1 MiB fp32)")
     p.add_argument("--k1", type=int, default=4)
     p.add_argument("--k2", type=int, default=None,
-                   help="deep chain depth (default 64 TPU / 16 CPU)")
+                   help="deep chain depth (default 128 TPU / 16 CPU; "
+                        "shorter chains risk XLA unrolling the loop and "
+                        "fusing adjacent adds — see bench.py's guard note)")
     p.add_argument("--repeats", type=int, default=5)
     p.add_argument("--trials", type=int, default=3)
     p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
@@ -99,7 +101,7 @@ def main(argv=None) -> int:
     native = not on_cpu  # interpret auto-detect in ops/: native iff TPU
     size = parse_size(args.size) if args.size else (
         512 * M.KiB if on_cpu else 256 * M.MiB)
-    k2 = args.k2 or (16 if on_cpu else 64)
+    k2 = args.k2 or (16 if on_cpu else 128)
     kernels = (args.kernels.split(",") if args.kernels
                else list(KERNELS))
     for kname in kernels:

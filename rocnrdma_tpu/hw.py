@@ -20,17 +20,21 @@ import dataclasses
 
 @dataclasses.dataclass(frozen=True)
 class Chip:
-    hbm_GBps: float   # public peak HBM bandwidth per chip
-    ici_GBps: float   # public aggregate ICI bandwidth per chip
-    ici_links: int    # inter-chip links (per-link rate = ici_GBps / links)
+    hbm_GBps: float     # public peak HBM bandwidth per chip
+    ici_GBps: float     # public aggregate ICI bandwidth per chip
+    ici_links: int      # inter-chip links (per-link rate = ici_GBps / links)
+    bf16_tflops: float  # public peak dense bf16 matmul throughput
 
 
 # keys match substrings of jax device_kind (e.g. "TPU v5 lite", "TPU v6 lite")
 CHIPS: dict[str, Chip] = {
-    "v5 lite": Chip(819.0, 400.0, 4), "v5e": Chip(819.0, 400.0, 4),
-    "v6 lite": Chip(1638.0, 900.0, 4), "v6e": Chip(1638.0, 900.0, 4),
-    "v5p": Chip(2765.0, 1200.0, 6), "v5": Chip(2765.0, 1200.0, 6),
-    "v4": Chip(1228.0, 1200.0, 6),
+    "v5 lite": Chip(819.0, 400.0, 4, 197.0),
+    "v5e": Chip(819.0, 400.0, 4, 197.0),
+    "v6 lite": Chip(1638.0, 900.0, 4, 918.0),
+    "v6e": Chip(1638.0, 900.0, 4, 918.0),
+    "v5p": Chip(2765.0, 1200.0, 6, 459.0),
+    "v5": Chip(2765.0, 1200.0, 6, 459.0),
+    "v4": Chip(1228.0, 1200.0, 6, 275.0),
 }
 
 # measured/public HBM fraction on this repo's real chip (bench.py headline)

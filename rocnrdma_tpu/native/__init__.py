@@ -30,6 +30,30 @@ _LIB = os.path.join(_LIB_DIR, "librqp.so")
 _build_lock = threading.Lock()
 _lib = None
 
+
+def _as_cbuf(data):
+    """(ctypes-passable buffer, nbytes) WITHOUT copying when possible.
+
+    bytes pass through; any other C-contiguous buffer (numpy array,
+    memoryview, bytearray) is wrapped via ``from_buffer`` — a borrowed
+    view, valid because both native planes copy synchronously during the
+    ctypes call (shm: memcpy into the shared arena; tcp: frame queued into
+    conn-owned storage). Read-only non-bytes buffers still copy (ctypes
+    cannot borrow them)."""
+    if isinstance(data, bytes):
+        return data, len(data)
+    try:
+        mv = memoryview(data).cast("B")
+    except TypeError:
+        # non-C-contiguous (strided numpy slice etc.): serialize, as the
+        # old bytes(data) path always did for every input
+        b = bytes(data)
+        return b, len(b)
+    if mv.readonly:
+        b = bytes(mv)
+        return b, len(b)
+    return (ctypes.c_char * mv.nbytes).from_buffer(mv), mv.nbytes
+
 OP_SEND = 0
 OP_RECV = 1
 OP_WRITE = 2   # one-sided RDMA write completed (initiator-side CQE)
@@ -301,11 +325,15 @@ class _QpBase(_Closeable):
                           f"failed on {self.name!r} (arena full?)")
         return MemoryRegion(self, rkey, nbytes)
 
-    def post_rdma_write(self, rkey: int, data: bytes, offset: int = 0) -> int:
-        """One-sided write of ``data`` into the MR named by ``rkey`` at
-        ``offset``; wr_id (CQE opcode OP_WRITE), -1 on backpressure, raises
-        on invalid rkey/bounds (shm plane detects locally)."""
-        data = bytes(data)
+    def post_rdma_write(self, rkey: int, data, offset: int = 0) -> int:
+        """One-sided write of ``data`` (bytes or any C-contiguous buffer —
+        numpy arrays/memoryviews pass ZERO-COPY; both native planes copy
+        into their own storage synchronously during the call, so the
+        caller's buffer is free the moment this returns) into the MR named
+        by ``rkey`` at ``offset``; wr_id (CQE opcode OP_WRITE), -1 on
+        backpressure, raises on invalid rkey/bounds (shm plane detects
+        locally)."""
+        data, _n = _as_cbuf(data)
         if len(data) > self.MAX_MSG:
             raise ValueError(
                 f"{self._PREFIX}: {len(data)} B one-sided write exceeds the "
@@ -436,6 +464,24 @@ class MemoryRegion:
             raise ValueError(f"read [{offset}, {offset + nbytes}) outside "
                              f"{self.nbytes} B MR")
         return ctypes.string_at(self._addr() + offset, nbytes)
+
+    def view(self, offset: int = 0, nbytes: int | None = None):
+        """ZERO-COPY uint8 numpy view of the region through the local
+        mapping — the owner reading its own MR without the memcpy
+        ``read`` pays. Ordering caveat: a raw view does not fence; when
+        consuming a peer's one-sided write, establish visibility first by
+        reading the (separately written) doorbell through the fenced path
+        (``rdma_read``/``read``), the way ``_rdma_ring_io.take`` does. The
+        view aliases the mapping: it is invalidated by ``close()`` and its
+        bytes change whenever the peer writes — consume before releasing
+        whatever protocol window (credit slot) protects it."""
+        import numpy as np
+        nbytes = self.nbytes - offset if nbytes is None else nbytes
+        if offset < 0 or nbytes < 0 or offset + nbytes > self.nbytes:
+            raise ValueError(f"view [{offset}, {offset + nbytes}) outside "
+                             f"{self.nbytes} B MR")
+        buf = (ctypes.c_char * nbytes).from_address(self._addr() + offset)
+        return np.frombuffer(buf, np.uint8)
 
     def write(self, data: bytes, offset: int = 0) -> None:
         data = bytes(data)

@@ -71,13 +71,36 @@ class Request:
         ring hops pass their send comm's pump) supply it here."""
         import time
         deadline = time.monotonic() + timeout_s
+        back = _Backoff()
         while not self.test()[0]:
             if progress is not None:
                 progress()
             if time.monotonic() >= deadline:
                 raise TimeoutError("net request timed out")
-            time.sleep(0.0002)
+            back.pause()
         return self.payload
+
+
+class _Backoff:
+    """Yield-first poll backoff for doorbell/completion waits.
+
+    The peers of a host-plane ring are OS processes very often timesharing
+    ONE core (this container: nproc=1), so the fastest "wait" is to give
+    the core away immediately — ``sleep(0)`` (sched_yield) lets the
+    predecessor run NOW instead of after a 0.2 ms timer quantum, which was
+    worth ~10x on the 16 MiB shm allreduce. Only after sustained misses
+    fall back to real sleeps so a genuinely dead peer doesn't burn 100%
+    CPU until the caller's timeout fires."""
+
+    __slots__ = ("misses",)
+
+    def __init__(self):
+        self.misses = 0
+
+    def pause(self):
+        import time
+        self.misses += 1
+        time.sleep(0.0 if self.misses <= 500 else 0.0002)
 
 
 # ---------------------------------------------------------------------------
@@ -241,6 +264,7 @@ class HostQPNet:
         two mutually-sending ranks deadlock) while backpressured."""
         import time
         deadline = time.monotonic() + timeout_s
+        back = _Backoff()
         while True:
             wr = post()
             if wr >= 0:
@@ -250,7 +274,7 @@ class HostQPNet:
                 progress()
             if time.monotonic() >= deadline:
                 raise TimeoutError(f"host net: {what} backpressured, peer stalled")
-            time.sleep(0.0002)
+            back.pause()
 
     def iwrite(self, comm: _HostComm, rkey: int, mr: memoryview,
                offset: int = 0, timeout_s: float = 10.0,
@@ -258,12 +282,13 @@ class HostQPNet:
         """One-sided put of ``mr`` into the peer MR named by ``rkey``: no
         peer receive, no peer CQE — the soft-NIC applies it. Backpressure
         handling mirrors :meth:`isend` (``progress`` keeps other comms
-        draining)."""
-        data = bytes(mr)
+        draining). ``mr`` passes to the native layer ZERO-COPY (writable
+        buffers borrow via from_buffer; the native planes copy
+        synchronously during the post call)."""
+        size = memoryview(mr).nbytes
         wr = self._post_backpressured(
-            comm, lambda: comm.qp.post_rdma_write(rkey, data, offset),
+            comm, lambda: comm.qp.post_rdma_write(rkey, mr, offset),
             "one-sided write", timeout_s, progress)
-        size = len(data)
         return Request(_test=lambda: self._onesided_probe(comm, wr, size, None))
 
     def iread(self, comm: _HostComm, rkey: int, nbytes: int,
@@ -284,6 +309,14 @@ class HostQPNet:
         shm plane: a local fenced copy through the QP (the arena is shared,
         so the acquire fence pairs with the writer's release)."""
         return comm.qp.rdma_read(mr.rkey, nbytes, offset)
+
+    def read_mr_view(self, comm: _HostComm, mr, offset: int, nbytes: int):
+        """ZERO-COPY owner read of an MR window (uint8 numpy view over the
+        shared mapping). No fence of its own: callers must order it after
+        a fenced doorbell read (see ``MemoryRegion.view``'s caveat) and
+        consume before releasing the protocol window that guards the
+        bytes. The bulk-data fast path of the put-based rings."""
+        return mr.view(offset, nbytes)
 
     @staticmethod
     def _onesided_probe(comm: _HostComm, wr: int, size: int, into):
@@ -355,6 +388,12 @@ class TCPNet(HostQPNet):
         table, which is a different region)."""
         comm._pump()
         return mr.read(offset, nbytes)
+
+    def read_mr_view(self, comm: _HostComm, mr, offset: int, nbytes: int):
+        """TCP plane zero-copy owner read: pump (peer writes land in our
+        progress engine), then view the conn-local MR storage directly."""
+        comm._pump()
+        return mr.view(offset, nbytes)
 
     def close(self) -> None:
         super().close()
@@ -667,6 +706,7 @@ def _flush_tx(comm, timeout_s: float, extra_pump=None,
     if tx_pending is None:
         return
     deadline = _time.monotonic() + timeout_s
+    back = _Backoff()
     while tx_pending() > 0:
         comm._pump()
         if extra_pump is not None:
@@ -674,7 +714,7 @@ def _flush_tx(comm, timeout_s: float, extra_pump=None,
         if _time.monotonic() >= deadline:
             raise TimeoutError(f"tx flush: {what}; bytes still queued "
                                f"after {timeout_s}s")
-        _time.sleep(0.0002)
+        back.pause()
 
 
 _RDMA_SETUP_TAG = 0x52444D41  # "RDMA": rkey-exchange tag namespace
@@ -720,14 +760,20 @@ def _rdma_ring_state(net, send_comm, recv_comm, cap: int):
 
 def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
     """The put/take engine shared by every put-based ring collective:
-    returns ``(st, put, take, finish)``. ``put(hop, bytes)`` writes a chunk
-    into the successor's slot ``hop % 2`` and rings the doorbell;
-    ``take(hop, nbytes)`` polls the predecessor's doorbell, consumes, and
-    acks the credit; ``finish(hop)`` persists the hop counter and flushes
-    both comms' queued tx (a fast rank must not exit holding a slow rank's
-    last hop in its user-space queue — observed at 16 MB: rank 0 finishes
-    correct in 0.13 s, rank 1 times out on the doorbell with 3.2 MB
-    stranded in rank 0's send queue). The caller runs the phase loops."""
+    returns ``(st, put, take, ack, finish)``. ``put(hop, buf)`` writes a
+    chunk (zero-copy: numpy slices pass straight to the native post) into
+    the successor's slot ``hop % 2`` and rings the doorbell;
+    ``take(hop, nbytes)`` polls the predecessor's doorbell and returns a
+    ZERO-COPY view of the slot — the caller consumes it (in-place
+    combine / copy-out) and only then calls ``ack(hop)``, which releases
+    the credit letting the predecessor overwrite the slot (acking before
+    consuming would race the view against the next write, which is why
+    the ack is no longer inside take). ``finish(hop)`` persists the hop
+    counter and flushes both comms' queued tx (a fast rank must not exit
+    holding a slow rank's last hop in its user-space queue — observed at
+    16 MB: rank 0 finishes correct in 0.13 s, rank 1 times out on the
+    doorbell with 3.2 MB stranded in rank 0's send queue). The caller
+    runs the phase loops."""
     import time as _time
 
     st = _rdma_ring_state(net, send_comm, recv_comm, cap)
@@ -749,6 +795,7 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
         # every rank waits for credit while pumping only its send comm,
         # no ACK ever flushes and the ring deadlocks globally.
         deadline = _time.monotonic() + timeout_s
+        back = _Backoff()
         while hop > 2:
             consumed = int.from_bytes(
                 net.read_mr_local(send_comm, credit_mr, 0, 8), "little")
@@ -759,7 +806,7 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
             probe_pending()
             if _time.monotonic() >= deadline:
                 raise TimeoutError("rdma ring: successor stopped consuming")
-            _time.sleep(0.0002)
+            back.pause()
         slot = hop % 2
         pending.append(net.iwrite(send_comm, st["peer_data_rkey"],
                                   memoryview(out), offset=slot * cap))
@@ -770,7 +817,11 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
     def take(hop: int, nbytes: int) -> np.ndarray:
         slot = hop % 2
         deadline = _time.monotonic() + timeout_s
+        back = _Backoff()
         while True:
+            # the fenced 8-byte doorbell read also establishes visibility
+            # for the raw slot view below (acquire pairs with the writer's
+            # release; data was written before the flag on one connection)
             flag = int.from_bytes(
                 net.read_mr_local(recv_comm, data_mr, 2 * cap + 8 * slot, 8),
                 "little")
@@ -781,12 +832,14 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
             probe_pending()
             if _time.monotonic() >= deadline:
                 raise TimeoutError("rdma ring: predecessor's doorbell never rang")
-            _time.sleep(0.0002)
-        payload = net.read_mr_local(recv_comm, data_mr, slot * cap, nbytes)
-        # ack: predecessor may now reuse this slot
+            back.pause()
+        return net.read_mr_view(recv_comm, data_mr, slot * cap, nbytes)
+
+    def ack(hop: int) -> None:
+        # credit: predecessor may now reuse (overwrite) the slot — callers
+        # must have fully consumed take()'s view first
         pending.append(net.iwrite(recv_comm, st["peer_credit_rkey"],
                                   hop.to_bytes(8, "little"), offset=0))
-        return np.frombuffer(payload, np.uint8)
 
     def finish(hop: int) -> None:
         st["hop"] = hop
@@ -794,7 +847,7 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
             _flush_tx(comm, timeout_s,
                       what="rdma ring: peer stopped draining at exit")
 
-    return st, put, take, finish
+    return st, put, take, ack, finish
 
 
 def _chunk_layout(x: np.ndarray, n: int):
@@ -808,20 +861,22 @@ def _chunk_layout(x: np.ndarray, n: int):
     return chunk, cap
 
 
-def _rdma_reduce_phase(put, take, chunk, x, rank: int, n: int, hop: int,
+def _rdma_reduce_phase(put, take, ack, chunk, x, rank: int, n: int, hop: int,
                        shift: int = 0, op: str = "sum") -> int:
     """The n-1 doorbell reduce hops in place (the put/take twin of the msg
     plane's ``_ring_reduce_phase``): at step k, put chunk ``rank - k +
     shift``, combine the taken chunk into ``rank - k - 1 + shift``. Returns
     the advanced hop counter. shift=0 is the allreduce layout; shift=-1
-    lands chunk r fully reduced on rank r."""
+    lands chunk r fully reduced on rank r. The combine reads take()'s
+    zero-copy slot view in place; the credit ack only goes out after."""
     combine = _NET_REDUCE_OPS[op]
     for k in range(n - 1):
         hop += 1
         send_i, recv_i = rank - k + shift, rank - k - 1 + shift
-        put(hop, _as_bytes(chunk(send_i)))
+        put(hop, chunk(send_i))
         incoming = take(hop, chunk(recv_i).nbytes)
         combine(chunk(recv_i), incoming.view(x.dtype), out=chunk(recv_i))
+        ack(hop)
     return hop
 
 
@@ -844,15 +899,17 @@ def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return x.reshape(np.shape(local))
     chunk, cap = _chunk_layout(x, n)
-    st, put, take, finish = _rdma_ring_io(net, send_comm, recv_comm, cap,
-                                          timeout_s)
-    hop = _rdma_reduce_phase(put, take, chunk, x, rank, n, st["hop"], op=op)
+    st, put, take, ack, finish = _rdma_ring_io(net, send_comm, recv_comm,
+                                               cap, timeout_s)
+    hop = _rdma_reduce_phase(put, take, ack, chunk, x, rank, n, st["hop"],
+                             op=op)
     for k in range(n - 1):  # allgather phase
         hop += 1
         send_i, recv_i = rank + 1 - k, rank - k
-        put(hop, _as_bytes(chunk(send_i)))
+        put(hop, chunk(send_i))
         incoming = take(hop, chunk(recv_i).nbytes)
         chunk(recv_i)[:] = incoming.view(x.dtype)
+        ack(hop)
     finish(hop)
     return x.reshape(np.shape(local))
 
@@ -868,10 +925,10 @@ def ring_reduce_scatter_rdma(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return x
     chunk, cap = _chunk_layout(x, n)
-    st, put, take, finish = _rdma_ring_io(net, send_comm, recv_comm, cap,
-                                          timeout_s)
+    st, put, take, ack, finish = _rdma_ring_io(net, send_comm, recv_comm,
+                                               cap, timeout_s)
     # shift=-1: chunk r lands fully reduced on rank r
-    hop = _rdma_reduce_phase(put, take, chunk, x, rank, n, st["hop"],
+    hop = _rdma_reduce_phase(put, take, ack, chunk, x, rank, n, st["hop"],
                              shift=-1, op=op)
     finish(hop)
     return np.array(chunk(rank), copy=True)
@@ -889,16 +946,17 @@ def ring_allgather_rdma(net, send_comm, recv_comm, local: np.ndarray,
     out[rank] = block
     if n == 1:
         return out
-    st, put, take, finish = _rdma_ring_io(net, send_comm, recv_comm,
-                                          block.nbytes, timeout_s)
+    st, put, take, ack, finish = _rdma_ring_io(net, send_comm, recv_comm,
+                                               block.nbytes, timeout_s)
     hop = st["hop"]
     for k in range(n - 1):
         hop += 1
         send_i = (rank - k) % n
         recv_i = (rank - k - 1) % n
-        put(hop, _as_bytes(out[send_i]))
+        put(hop, out[send_i])
         incoming = take(hop, block.nbytes)
         out[recv_i] = incoming.view(block.dtype).reshape(block.shape)
+        ack(hop)
     finish(hop)
     return out
 

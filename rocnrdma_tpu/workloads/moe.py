@@ -51,20 +51,39 @@ def moe_step(t: Transport, algo: str, expert_compute: bool):
     return jax.jit(step) if expert_compute else step
 
 
+def ffn_expert(w_in: jnp.ndarray, w_out: jnp.ndarray):
+    """A real per-expert FFN for ``moe_topk_step``'s expert slot: two
+    matmuls + gelu over the dispatched ``(..., E, cap, d)`` slots, weights
+    ``(E, d, ffn)`` / ``(E, ffn, d)``. This is where the flagship step's
+    MXU FLOPs live (the MFU leg of bench.py counts exactly these two
+    einsums: 4 * tokens * d * ffn flops per step)."""
+    def expert(v):
+        h = jnp.einsum("...ecd,edf->...ecf", v, w_in,
+                       preferred_element_type=v.dtype)
+        h = jax.nn.gelu(h)
+        return jnp.einsum("...ecf,efd->...ecd", h, w_out,
+                          preferred_element_type=v.dtype)
+    return expert
+
+
 def moe_topk_step(t: Transport, algo: str, expert_compute: bool,
-                  n_experts: int, cap: int, top_k: int):
+                  n_experts: int, cap: int, top_k: int, expert=None):
     """The REAL MoE layer shape: router logits -> top-k gating with a
     static capacity (tokens past capacity dropped, GShard-style; see
     workloads/routing.py) -> alltoall dispatch -> expert -> alltoall
     combine -> gate-weighted gather. Inputs per mesh position: tokens
     ``(T, d)`` and router logits ``(T, E)``; output ``(T, d)`` plus the
-    keep mask for drop accounting."""
+    keep mask for drop accounting. ``expert``: the per-expert transform
+    applied to the dispatched ``(E, cap, d)`` slots (default: the x2
+    marker, handy for identity-style oracles; pass ``ffn_expert(...)`` for
+    real MXU work)."""
     from rocnrdma_tpu.workloads import routing as R
 
     a2a = t.jit_fn("alltoall", algo)
 
-    def expert(v):
-        return v * 2.0
+    if expert is None:
+        def expert(v):
+            return v * 2.0
 
     def step(tokens, logits):
         # global arrays (mesh lead dims + (T, d)); the routing math is

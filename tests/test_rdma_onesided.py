@@ -224,3 +224,53 @@ def test_tcp_messaging_interleaves_with_onesided(tcp_pair):
     assert a.recv() == b"msg-1"
     assert a.recv() == b"msg-2"
     assert mr.read(0, 7) == b"payload"
+
+
+# ---------------------------------------------------------------------------
+# zero-copy surfaces (round 2: the put/take fast path)
+
+
+def test_write_accepts_numpy_buffer_zero_copy(shm_pair):
+    # post_rdma_write takes any C-contiguous buffer via from_buffer —
+    # no bytes() materialization on the put path
+    import numpy as np
+
+    a, b = shm_pair
+    mr = b.reg_mr(64)
+    src = np.arange(16, dtype=np.float32)
+    a.rdma_write(mr.rkey, src, 0)
+    got = np.frombuffer(mr.read(0, 64), np.float32)
+    np.testing.assert_array_equal(got, src)
+    # a numpy slice (still contiguous) also passes
+    a.rdma_write(mr.rkey, src[4:8], 0)
+    np.testing.assert_array_equal(
+        np.frombuffer(mr.read(0, 16), np.float32), src[4:8])
+
+
+def test_mr_view_is_zero_copy_and_bounds_checked(shm_pair):
+    import numpy as np
+
+    a, b = shm_pair
+    mr = b.reg_mr(64)
+    a.rdma_write(mr.rkey, bytes(range(64)), 0)
+    v = mr.view(8, 8)
+    np.testing.assert_array_equal(v, np.arange(8, 16, dtype=np.uint8))
+    # the view ALIASES the arena: a later peer write shows through
+    a.rdma_write(mr.rkey, bytes([99] * 8), 8)
+    assert v[0] == 99
+    with pytest.raises(ValueError, match="outside"):
+        mr.view(60, 8)
+    with pytest.raises(ValueError, match="outside"):
+        mr.view(-1, 4)
+
+
+def test_tcp_mr_view_after_pump(tcp_pair):
+    import numpy as np
+
+    a, b = tcp_pair
+    mr = b.reg_mr(32)
+    rkey_wire = mr.rkey
+    a.rdma_write(rkey_wire, bytes(range(32)), 0)
+    _pump(b)  # soft-NIC: peer writes apply in the target's progress engine
+    np.testing.assert_array_equal(mr.view(0, 32),
+                                  np.arange(32, dtype=np.uint8))

@@ -104,3 +104,32 @@ def test_replay_speedup_base_is_sequential_only(tmp_path, capsys):
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert all("speedup_vs_sequential" not in r["extra"] for r in rows)
     assert "vs sequential" not in capsys.readouterr().out
+
+
+def test_ffn_expert_in_moe_layer(devices):
+    # a REAL FFN expert (two einsums + gelu) slots into moe_topk_step and
+    # matches a numpy reference on the kept tokens at generous capacity
+    import jax.numpy as jnp
+
+    from rocnrdma_tpu import runtime as rt
+    from rocnrdma_tpu.transport import Transport
+    from rocnrdma_tpu.workloads.moe import ffn_expert, moe_topk_step
+
+    rng = np.random.default_rng(5)
+    T, d, ffn = 16, 8, 32
+    t = Transport(rt.rank_mesh(1))
+    w_in = jnp.asarray(rng.standard_normal((1, d, ffn)), jnp.float32)
+    w_out = jnp.asarray(rng.standard_normal((1, ffn, d)), jnp.float32)
+    step = moe_topk_step(t, "auto", True, 1, T, 1,
+                         expert=ffn_expert(w_in, w_out))
+    tok = rng.standard_normal((1, T, d)).astype(np.float32)
+    logits = rng.standard_normal((1, T, 1)).astype(np.float32)
+    out, keep = step(tok, logits)
+    assert bool(np.all(np.asarray(keep)))
+
+    # reference via jax's own gelu on the plain (no-routing) path:
+    # 1 expert + top-1 + no drops => layer == gate(=1) * ffn(tokens)
+    import jax
+    ref = np.asarray(jax.nn.gelu(tok[0] @ np.asarray(w_in[0]))
+                     @ np.asarray(w_out[0]))
+    np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=2e-4, atol=2e-4)
