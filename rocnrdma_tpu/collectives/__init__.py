@@ -37,6 +37,8 @@ from rocnrdma_tpu.collectives.tree import hd_allreduce  # noqa: F401
 from rocnrdma_tpu.collectives.dtree import dbtree_allreduce  # noqa: F401
 from rocnrdma_tpu.collectives.alltoall import (  # noqa: F401
     bruck_alltoall,
+    fused_alltoallv,
+    ragged_mask,
     rotation_alltoall,
 )
 from rocnrdma_tpu.collectives.hierarchical import (  # noqa: F401

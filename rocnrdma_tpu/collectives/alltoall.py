@@ -75,3 +75,38 @@ def bruck_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
     # src is a permutation, so a plain gather restores order (no scatter).
     src = (r - jnp.arange(n)) % n
     return buf[src]
+
+
+# ---------------------------------------------------------------------------
+# Ragged (variable-count) alltoall: the ncclAllToAllv shape on a static wire
+
+
+def ragged_mask(out: jax.Array, counts: jax.Array, axis_name: str):
+    """Receiver-side masking shared by every device-plane alltoallv wire:
+    zero the rows of ``out[src]`` at positions >= ``counts[src, me]`` and
+    return ``(masked, recv_counts)`` with ``recv_counts = counts[:, me]``.
+    ``counts`` is the replicated (n, n) element-count matrix (the MPI
+    alltoallv contract, identical to the host plane's
+    ``ring_alltoallv_over_net``)."""
+    my = lax.axis_index(axis_name)
+    recv_counts = lax.dynamic_index_in_dim(counts.T, my, keepdims=False)
+    row = jnp.arange(out.shape[1])
+    mask = row[None, :] < recv_counts[:, None]          # (n, max_count)
+    mask = mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+    return jnp.where(mask, out, jnp.zeros((), out.dtype)), recv_counts
+
+
+def fused_alltoallv(x: jax.Array, counts: jax.Array, axis_name: str):
+    """Ragged alltoall on the XLA wire: ``lax.all_to_all`` ships the full
+    static capacity every time (one compiled program for every counts
+    matrix — the TPU static-shape bargain, see DESIGN.md §5a), then the
+    receiver masks to the counts. ``x``: (n, max_count, ...) — chunk d
+    carries ``counts[me, d]`` valid rows for rank d. Returns
+    ``(out, recv_counts)``; ``out[j]``'s rows past ``counts[j, me]`` are
+    zeroed. Twin of ``ops.pallas_alltoallv`` (remote-DMA wire)."""
+    n = lax.axis_size(axis_name)
+    if counts.shape != (n, n):
+        raise ValueError(f"counts must be ({n}, {n}), got {counts.shape}")
+    out = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)
+    return ragged_mask(out, counts, axis_name)

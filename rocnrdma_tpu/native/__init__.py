@@ -133,6 +133,8 @@ def _load():
         rd.restype = ctypes.c_int64
         rd.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
                        ctypes.c_void_p, ctypes.c_uint32]
+    lib.rqp_fence_acquire.restype = None
+    lib.rqp_fence_acquire.argtypes = []
     lib.rqp_close.restype = None
     lib.rqp_close.argtypes = [ctypes.c_void_p]
     lib.rqp_unlink.restype = ctypes.c_int
@@ -172,6 +174,15 @@ def available() -> bool:
         return True
     except (OSError, subprocess.CalledProcessError):
         return False
+
+
+def fence_acquire() -> None:
+    """Acquire fence: place between a doorbell/flag load (observed through
+    any fenced path) and subsequent RAW ``MemoryRegion.view`` loads, so
+    the view cannot observe pre-doorbell slot bytes on weakly-ordered
+    CPUs. Pairs with the release fence ``rqp_rdma_write`` issues after its
+    memcpy."""
+    _load().rqp_fence_acquire()
 
 
 class _Closeable:

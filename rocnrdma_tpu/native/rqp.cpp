@@ -384,6 +384,15 @@ int64_t rqp_rdma_write(void* hv, int64_t rkey, uint64_t off, const void* buf,
   return id;
 }
 
+// Standalone acquire fence: callers that observed a doorbell through a
+// fenced read and then consume payload through a RAW mapping view (the
+// zero-copy take path) place this between the flag load and the view
+// loads — the rdma_read fence alone orders the FLAG load after earlier
+// loads, not the view's loads after the flag.
+void rqp_fence_acquire() {
+  std::atomic_thread_fence(std::memory_order_acquire);
+}
+
 // One-sided read: memcpy out of the MR into a local buffer.
 int64_t rqp_rdma_read(void* hv, int64_t rkey, uint64_t off, void* buf,
                       uint32_t len) {

@@ -340,16 +340,13 @@ def pallas_alltoallv(x: jax.Array, counts: jax.Array, axis_name: str,
     counts are far below capacity — the regime where the exchange is
     latency-bound anyway. See docs/DESIGN.md §5a.
     """
+    from rocnrdma_tpu.collectives.alltoall import ragged_mask
+
     n = lax.axis_size(axis_name)
     if counts.shape != (n, n):
         raise ValueError(f"counts must be ({n}, {n}), got {counts.shape}")
     out = pallas_alltoall(x, axis_name, interpret=interpret)
-    my = lax.axis_index(axis_name)
-    recv_counts = lax.dynamic_index_in_dim(counts.T, my, keepdims=False)
-    row = jnp.arange(x.shape[1])
-    mask = row[None, :] < recv_counts[:, None]          # (n, max_count)
-    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
-    return jnp.where(mask, out, jnp.zeros((), x.dtype)), recv_counts
+    return ragged_mask(out, counts, axis_name)
 
 
 # ---------------------------------------------------------------------------

@@ -352,6 +352,56 @@ class Transport:
         """(ranks..., n, c) -> same shape, global transpose of rank x chunk dims."""
         return self._dispatch("alltoall", x, algo)
 
+    def alltoallv(self, x, counts, algo: str = "auto"):
+        """Ragged alltoall (the RCCL ``ncclAllToAllv`` verb, device plane).
+
+        ``x``: global ``(ranks, n, max_count, ...)`` — rank r's chunk d
+        carries ``counts[r, d]`` valid rows destined for rank d (rows past
+        the count are don't-care). ``counts``: the replicated (n, n)
+        element-count matrix every rank knows (the MPI contract the host
+        plane's ``ring_alltoallv_over_net`` also takes). Returns
+        ``(out, recv_counts)`` with ``out[r, j]`` = the first
+        ``counts[j, r]`` rows rank j sent r (tail zeroed) and
+        ``recv_counts[r] = counts[:, r]``.
+
+        The wire always ships ``max_count`` (static shapes — one compiled
+        program for every counts matrix; DESIGN.md §5a); ``algo``:
+        ``fused`` (XLA ``all_to_all``) or ``pallas_ring`` (one-sided
+        remote-DMA writes). 1-D rank meshes only, like the other explicit
+        ring verbs. ``counts`` is a traced operand — a new matrix does NOT
+        recompile."""
+        if self.is_2d:
+            raise ValueError("alltoallv rings a 1-D rank mesh (use the "
+                             "dense alltoall on 2-D meshes)")
+        if algo in ("auto", "model"):
+            # the RNR_ALGO fleet override applies here exactly as in
+            # _resolve: only where this verb supports the forced algo
+            forced = os.environ.get("RNR_ALGO", "").strip().lower()
+            algo = forced if forced in ("fused", "pallas_ring") else "fused"
+        if algo not in ("fused", "pallas_ring"):
+            raise ValueError(
+                f"alltoallv knows algos fused|pallas_ring, got {algo!r}")
+        key = ("alltoallv", algo)
+        if key not in self._cache:
+            if algo == "fused":
+                from rocnrdma_tpu.collectives.alltoall import fused_alltoallv
+                axis_fn = fused_alltoallv
+            else:
+                from rocnrdma_tpu.ops.ring_pallas import pallas_alltoallv
+                axis_fn = pallas_alltoallv
+
+            def local(s, c):
+                out, rc = axis_fn(s.reshape(s.shape[1:]), c, RANK_AXIS)
+                return out[None], rc[None]
+
+            sh = jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(RANK_AXIS), P()),
+                out_specs=(P(RANK_AXIS), P(RANK_AXIS)), check_vma=False)
+            self._cache[key] = jax.jit(sh)
+        self._count("alltoallv", algo, x)
+        return self._cache[key](x, jnp.asarray(counts))
+
     def broadcast(self, x, algo: str = "auto", root: int = 0):
         """(ranks..., S) -> same shape; every rank row = root's row."""
         return self._dispatch("broadcast", x, algo, root=root)

@@ -776,6 +776,8 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
     runs the phase loops."""
     import time as _time
 
+    from rocnrdma_tpu.native import fence_acquire as _fence_acquire
+
     st = _rdma_ring_state(net, send_comm, recv_comm, cap)
     cap = st["cap"]
     data_mr, credit_mr = st["data_mr"], st["credit_mr"]
@@ -819,9 +821,6 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
         deadline = _time.monotonic() + timeout_s
         back = _Backoff()
         while True:
-            # the fenced 8-byte doorbell read also establishes visibility
-            # for the raw slot view below (acquire pairs with the writer's
-            # release; data was written before the flag on one connection)
             flag = int.from_bytes(
                 net.read_mr_local(recv_comm, data_mr, 2 * cap + 8 * slot, 8),
                 "little")
@@ -833,6 +832,12 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
             if _time.monotonic() >= deadline:
                 raise TimeoutError("rdma ring: predecessor's doorbell never rang")
             back.pause()
+        # acquire AFTER the matching flag load, BEFORE the raw view loads:
+        # the fenced read above orders the flag load itself, not the view
+        # reads that follow it — without this fence a weakly-ordered CPU
+        # could pair flag==hop with pre-doorbell slot bytes (pairs with
+        # the writer's release fence in rqp_rdma_write)
+        _fence_acquire()
         return net.read_mr_view(recv_comm, data_mr, slot * cap, nbytes)
 
     def ack(hop: int) -> None:

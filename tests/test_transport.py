@@ -309,3 +309,40 @@ def test_premul_sum(devices):
                                np.broadcast_to(0.5 * x.sum(0), x.shape),
                                rtol=1e-5)
     assert np.allclose(np.asarray(h2.result())[1], 0.5 * x.sum(0), rtol=1e-5)
+
+
+def test_alltoallv_both_wires(devices):
+    # the device-plane ncclAllToAllv verb: static-capacity wire + receiver
+    # masking, counts as a TRACED operand (new matrix, no recompile)
+    n, cap, d = 4, 5, 3
+    t = Transport(rt.rank_mesh(n))
+    rng = np.random.default_rng(0)
+    counts = rng.integers(0, cap + 1, size=(n, n))
+    x = t.shard(rng.standard_normal((n, n, cap, d)).astype(np.float32))
+    for algo in ("fused", "pallas_ring", "auto"):
+        out, rc = t.alltoallv(x, counts, algo)
+        out, rc = np.asarray(out), np.asarray(rc)
+        for me in range(n):
+            np.testing.assert_array_equal(rc[me], counts[:, me])
+            for src in range(n):
+                k = counts[src, me]
+                np.testing.assert_allclose(
+                    out[me, src, :k], np.asarray(x)[src, me, :k],
+                    rtol=1e-6, atol=1e-7)
+                assert np.all(out[me, src, k:] == 0)
+    # traced counts: a different matrix reuses the compiled program
+    counts2 = rng.integers(0, cap + 1, size=(n, n))
+    out2, rc2 = t.alltoallv(x, counts2, "fused")
+    assert np.asarray(rc2)[0, 1] == counts2[1, 0]
+    # stats counted the dispatches
+    assert any(k.startswith("alltoallv/") for k in t.stats())
+
+
+def test_alltoallv_validates(devices):
+    t = Transport(rt.rank_mesh(4))
+    x = t.shard(np.zeros((4, 4, 2, 2), np.float32))
+    with pytest.raises(ValueError, match="fused|pallas_ring"):
+        t.alltoallv(x, np.zeros((4, 4), int), "bruck")
+    t2 = Transport(rt.slice_mesh(2, 2))
+    with pytest.raises(ValueError, match="1-D"):
+        t2.alltoallv(x, np.zeros((4, 4), int))
