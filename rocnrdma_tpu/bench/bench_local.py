@@ -81,6 +81,10 @@ def main(argv=None) -> int:
                    help=f"comma subset of {','.join(KERNELS)}")
     p.add_argument("--tile-rows", type=int, default=2048,
                    help="pallas tile rows (x128 lanes; 2048 = 1 MiB fp32)")
+    p.add_argument("--dtype", choices=("float32", "bfloat16"),
+                   default="float32",
+                   help="combine dtype (the C11 fp32/bf16 sweep axis; "
+                        "bf16 halves the bytes per element)")
     p.add_argument("--k1", type=int, default=4)
     p.add_argument("--k2", type=int, default=None,
                    help="deep chain depth (default 128 TPU / 16 CPU; "
@@ -92,6 +96,10 @@ def main(argv=None) -> int:
     p.add_argument("--fake-devices", type=int, default=None)
     p.add_argument("--out", type=str, default=None,
                    help="append JSONL records here")
+    p.add_argument("--profile", type=str, default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the timed chains "
+                        "(feed the .xplane.pb to `rocnrdma_tpu.trace "
+                        "--measured --xplane` for the measured lane)")
     args = p.parse_args(argv)
 
     cli_common.setup_backend(args.fake_devices, args.platform,
@@ -108,42 +116,59 @@ def main(argv=None) -> int:
         if kname not in KERNELS:
             raise SystemExit(f"unknown kernel {kname!r}; pick from {KERNELS}")
 
-    elems = size // 4
-    rng = np.random.default_rng(0)
     import jax.numpy as jnp
+    dtype = jnp.dtype(args.dtype)
+    elems = size // dtype.itemsize
+    rng = np.random.default_rng(0)
     x0 = tuple(jnp.asarray(rng.standard_normal((elems,), dtype=np.float32))
-               for _ in range(3))
+               .astype(dtype) for _ in range(3))
 
     # correctness gate before any timing (the suite's bench convention):
-    # one shallow chain of each kernel vs numpy
-    ref2 = np.asarray(x0[0]) + 2 * np.asarray(x0[1])
-    ref3 = ref2 + 2 * np.asarray(x0[2])
+    # one shallow chain of each kernel vs numpy (in fp32 — the bf16 chain
+    # is checked against the fp32 math at bf16 tolerance)
+    f32 = [np.asarray(x, dtype=np.float32) for x in x0]
+    ref2 = f32[0] + 2 * f32[1]
+    ref3 = ref2 + 2 * f32[2]
+    import contextlib
+    prof = (jax.profiler.trace(args.profile) if args.profile
+            else contextlib.nullcontext())
     rows = []
+    with prof:
+        run_kernels(kernels, args, x0, ref2, ref3, rows, native, size, k2,
+                    dev, dtype)
+    if args.out:
+        with open(args.out, "a") as fp:
+            for rec in rows:
+                fp.write(json.dumps(rec) + "\n")
+    return 0
+
+
+def run_kernels(kernels, args, x0, ref2, ref3, rows, native, size, k2, dev,
+                dtype):
+    itemsize = dtype.itemsize
+    elems = size // itemsize
+    tol = 1e-3 if itemsize == 4 else 3e-2  # bf16 chain vs fp32 reference
     for kname in kernels:
         n_ops = int(kname[-1])
-        chk = make_combine_chain(kname, args.tile_rows, None if native else True,
-                          k=2)(*x0)
+        chk = make_combine_chain(kname, args.tile_rows,
+                                 None if native else True, k=2)(*x0)
         want = (ref3 if n_ops == 3 else ref2).ravel()[0]
-        if not np.isclose(float(chk), want, rtol=1e-3, atol=1e-3):
+        if not np.isclose(float(chk), want, rtol=tol, atol=tol):
             raise SystemExit(f"{kname}: self-check failed "
                              f"({float(chk)} vs {want})")
         mk = functools.partial(make_combine_chain, kname, args.tile_rows,
                                None if native else True)
         sec = marginal_s_per_op(lambda k: mk(k=k), x0, args.k1, k2,
                                 args.repeats, args.trials)
-        gbps = (n_ops + 1) * elems * 4 / sec / 1e9
-        rec = {"bench": "bench_local", "kernel": kname,
+        gbps = (n_ops + 1) * elems * itemsize / sec / 1e9
+        rec = {"bench": "bench_local", "kernel": kname, "dtype": dtype.name,
                "size_bytes": size, "GBps": round(gbps, 3),
                "s_per_op": sec, "native": native,
                "device_kind": dev.device_kind, "tile_rows": args.tile_rows}
         rows.append(rec)
         sz = (f"{size >> 20} MiB" if size >= M.MiB else f"{size >> 10} KiB")
-        print(f"{kname:8s} {sz:>9s}  {gbps:8.1f} GB/s  native={native}")
-    if args.out:
-        with open(args.out, "a") as fp:
-            for rec in rows:
-                fp.write(json.dumps(rec) + "\n")
-    return 0
+        print(f"{kname:8s} {dtype.name:9s} {sz:>9s}  {gbps:8.1f} GB/s  "
+              f"native={native}")
 
 
 if __name__ == "__main__":

@@ -290,6 +290,10 @@ def measured_lanes(xplane_path: str, hints=_MEASURED_OP_HINTS) -> list:
     lanes = []
     for plane in p.planes:
         for line in plane.lines:
+            if line.name == "python":
+                # host python-frame sampling, not device ops — frame names
+                # like "$<unknown> add" would false-match the hints
+                continue
             evs = [(e.name, int(e.start_ns), int(e.duration_ns))
                    for e in line.events
                    if not e.name.startswith("end:")

@@ -184,6 +184,12 @@ class Transport:
                 f"runtime.slice_mesh()")
         self.n_ranks = math.prod(self.mesh.devices.shape)
         self.is_2d = len(self.axes) == 2
+        if tuning is None:
+            # RNR_TUNING env (the NCCL_TUNER_PLUGIN habit): point every
+            # Transport in the fleet at a saved table — e.g. the shipped
+            # model-derived results/tuning_v5e.json — without touching
+            # code. An explicit ``tuning=`` argument wins.
+            tuning = os.environ.get("RNR_TUNING", "").strip() or None
         if isinstance(tuning, str):
             from rocnrdma_tpu.transport.tuner import TuningTable
             tuning = TuningTable.load(tuning)
@@ -375,8 +381,12 @@ class Transport:
                              "dense alltoall on 2-D meshes)")
         if algo in ("auto", "model"):
             # the RNR_ALGO fleet override applies here exactly as in
-            # _resolve: only where this verb supports the forced algo
+            # _resolve: unknown names raise, known-but-unsupported names
+            # are ignored (one env var must not break unrelated verbs)
             forced = os.environ.get("RNR_ALGO", "").strip().lower()
+            if forced and forced not in ALGOS:
+                raise ValueError(f"RNR_ALGO={forced!r} is not an algorithm; "
+                                 f"know {ALGOS}")
             algo = forced if forced in ("fused", "pallas_ring") else "fused"
         if algo not in ("fused", "pallas_ring"):
             raise ValueError(

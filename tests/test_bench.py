@@ -329,3 +329,16 @@ def test_bench_script_multichip_branch_with_failing_candidate(
     row = json.loads(out.out.strip().splitlines()[-1])
     assert row["metric"] == "allreduce_busbw_GBps_per_chip"
     assert row["value"] > 0 and row["vs_baseline"] > 0
+
+
+def test_bench_local_bfloat16_leg(tmp_path):
+    # the C11 dtype axis on the combine kernels: bf16 halves bytes/elem
+    from rocnrdma_tpu.bench import bench_local
+    out = tmp_path / "b.jsonl"
+    _run(bench_local.main,
+         ["--size", "64K", "--kernels", "xla2,pallas3", "--dtype",
+          "bfloat16", "--k2", "8", "--repeats", "2", "--trials", "1",
+          "--tile-rows", "8", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert all(r["dtype"] == "bfloat16" for r in rows)
+    assert all(r["GBps"] > 0 for r in rows)

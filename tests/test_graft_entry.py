@@ -64,3 +64,9 @@ def test_mesh_factor():
     assert g._mesh_factor(9) == (3, 3)
     for prime_or_small in (1, 2, 3, 7, 13):
         assert g._mesh_factor(prime_or_small) is None
+
+
+def test_dryrun_multichip_contract_64(devices):
+    # the BASELINE.json:9 rank count, end to end (measured ~13 s cold)
+    out = _dryrun_in_subprocess(64)
+    assert "(2, 32)" in out and "hierarchical=True" in out

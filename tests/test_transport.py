@@ -346,3 +346,23 @@ def test_alltoallv_validates(devices):
     t2 = Transport(rt.slice_mesh(2, 2))
     with pytest.raises(ValueError, match="1-D"):
         t2.alltoallv(x, np.zeros((4, 4), int))
+
+
+def test_alltoallv_rnr_algo_env(monkeypatch, devices):
+    t = Transport(rt.rank_mesh(4))
+    x = t.shard(np.zeros((4, 4, 2, 2), np.float32))
+    counts = np.full((4, 4), 2)
+    # a known-but-unsupported forced algo is ignored (one env var must not
+    # break unrelated verbs)...
+    monkeypatch.setenv("RNR_ALGO", "bruck")
+    out, _ = t.alltoallv(x, counts)
+    assert np.asarray(out).shape == (4, 4, 2, 2)
+    # ...a supported one is honored...
+    monkeypatch.setenv("RNR_ALGO", "pallas_ring")
+    t2 = Transport(rt.rank_mesh(4))
+    t2.alltoallv(t2.shard(np.zeros((4, 4, 2, 2), np.float32)), counts)
+    assert any(k.startswith("alltoallv/pallas_ring") for k in t2.stats())
+    # ...and a typo raises, exactly like _resolve
+    monkeypatch.setenv("RNR_ALGO", "ringg")
+    with pytest.raises(ValueError, match="not an algorithm"):
+        t.alltoallv(x, counts)

@@ -255,3 +255,24 @@ def test_tuning_v5e_artifact_loads_and_consults(tmp_path):
     p2 = tmp_path / "t.json"
     t.save(str(p2))
     assert TuningTable.load(str(p2)).meta == t.meta
+
+
+def test_rnr_tuning_env_loads_table(tmp_path, monkeypatch):
+    # the NCCL_TUNER_PLUGIN habit: RNR_TUNING points every Transport at a
+    # saved table; explicit tuning= still wins
+    path = str(tmp_path / "t.json")
+    table = TuningTable()
+    table.set_buckets("allreduce", 4, 1, "cpu", [Bucket(1 << 40, "ring")])
+    table.save(path)
+    monkeypatch.setenv("RNR_TUNING", path)
+    t = Transport(rt.rank_mesh(4))
+    assert t._resolve("auto", "allreduce", nbytes=1024) == "ring"
+    # explicit argument beats the env
+    other = TuningTable()
+    other.set_buckets("allreduce", 4, 1, "cpu", [Bucket(1 << 40, "tree")])
+    t2 = Transport(rt.rank_mesh(4), tuning=other)
+    assert t2._resolve("auto", "allreduce", nbytes=1024) == "tree"
+    # absent env + absent arg -> static default (no file touched)
+    monkeypatch.delenv("RNR_TUNING")
+    t3 = Transport(rt.rank_mesh(4))
+    assert t3._resolve("auto", "allreduce", nbytes=1024) == "fused"
