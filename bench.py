@@ -160,18 +160,28 @@ def main() -> int:
             algos["pallas_hbm"] = lambda y: O.pallas_hbm_ring_allreduce(
                 y, "rank", tile_rows=512)
 
-        def make_chain(k, ar):
+        def make_chain(k, ar, stabilize=True):
+            # stabilize: allreduce GROWS values n-fold per op, so the chain
+            # rescales by 1/n each iteration; pure-movement verbs
+            # (alltoall) must NOT pay that extra elementwise pass — their
+            # magnitudes are already stable
+            if stabilize:
+                body = lambda _, y: ar(y) * inv_n
+            else:
+                body = lambda _, y: ar(y)
+
             def local(s):
-                out = lax.fori_loop(0, k, lambda _, y: ar(y) * inv_n, s[0])
+                out = lax.fori_loop(0, k, body, s[0])
                 return out.ravel()[:1][None]
             sh = jax.shard_map(local, mesh=mesh, in_specs=(P("rank"),),
                                out_specs=P("rank"), check_vma=False)
             return jax.jit(lambda v: sh(v)[0, 0])
 
         def run_mc_leg(nbytes):
-            """Best-of at one size; {} if every candidate failed (a failing
-            candidate loses the best-of, it must not abort the scored run —
-            first multichip contact happens here)."""
+            """Best-of at one size; ({}, x0) if every candidate failed (a
+            failing candidate loses the best-of, it must not abort the
+            scored run — first multichip contact happens here). The shard
+            is returned so the alltoall leg reuses it (no re-transfer)."""
             elems = nbytes // 4
             x0 = t.shard(np.random.default_rng(0)
                          .standard_normal(size=(n, elems), dtype=np.float32))
@@ -186,17 +196,17 @@ def main() -> int:
                 except Exception as e:
                     print(f"# algo {name} failed: {type(e).__name__}: "
                           f"{str(e)[:200]}", file=sys.stderr)
-            return leg
+            return leg, x0
 
         # contract size first (1 GiB fp32 per rank, BASELINE.json:2); the
         # WHOLE best-of drops to 256 MiB if that size cannot even produce
         # one surviving candidate (shard/compile/OOM failures included) —
         # same ladder as the single-chip branch
-        secs, elems = {}, 0
+        secs, elems, x0 = {}, 0, None
         for nbytes in ([8 * M.MiB] if on_cpu else [M.GiB, 256 * M.MiB]):
             elems = nbytes // 4
             try:
-                secs = run_mc_leg(nbytes)
+                secs, x0 = run_mc_leg(nbytes)
             except Exception as e:  # e.g. the shard itself refused
                 print(f"# {nbytes >> 20} MiB/rank leg failed: "
                       f"{type(e).__name__}: {str(e)[:160]}", file=sys.stderr)
@@ -215,6 +225,24 @@ def main() -> int:
         target = 0.9 * ici_bw
         out = {"metric": "allreduce_busbw_GBps_per_chip", "value": round(value, 3),
                "unit": "GB/s", "vs_baseline": round(value / target, 4)}
+
+        # the contract's SECOND metric (BASELINE.json:2): alltoall algbw —
+        # stderr only (the driver schema takes one JSON line; allreduce
+        # busbw is the scored one). Needs a wire, so multi-chip only.
+        try:
+            def a2a(y):
+                return C.fused_alltoall(y.reshape(n, -1), "rank").reshape(
+                    y.shape)
+            sec = _marginal_s_per_op(
+                functools.partial(make_chain, ar=a2a, stabilize=False),
+                (x0,), k1=2, k2=8 if on_cpu else 32,
+                repeats=3 if on_cpu else 5, trials=1 if on_cpu else 3)
+            print(f"# alltoall algbw: "
+                  f"{M.algbw_GBps(elems * 4, sec):.2f} GB/s/chip "
+                  f"@ {elems * 4 >> 20} MiB/rank (fused)", file=sys.stderr)
+        except Exception as e:
+            print(f"# alltoall leg failed: {type(e).__name__}: "
+                  f"{str(e)[:160]}", file=sys.stderr)
     else:
         # single chip: HBM-bound accumulate — best of the per-step combine
         # kernels the implemented schedules actually fold with:

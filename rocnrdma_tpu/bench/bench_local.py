@@ -132,43 +132,36 @@ def main(argv=None) -> int:
     import contextlib
     prof = (jax.profiler.trace(args.profile) if args.profile
             else contextlib.nullcontext())
+    tol = 1e-3 if dtype.itemsize == 4 else 3e-2  # bf16 vs fp32 reference
     rows = []
     with prof:
-        run_kernels(kernels, args, x0, ref2, ref3, rows, native, size, k2,
-                    dev, dtype)
+        for kname in kernels:
+            n_ops = int(kname[-1])
+            chk = make_combine_chain(kname, args.tile_rows,
+                                     None if native else True, k=2)(*x0)
+            want = (ref3 if n_ops == 3 else ref2).ravel()[0]
+            if not np.isclose(float(chk), want, rtol=tol, atol=tol):
+                raise SystemExit(f"{kname}: self-check failed "
+                                 f"({float(chk)} vs {want})")
+            mk = functools.partial(make_combine_chain, kname, args.tile_rows,
+                                   None if native else True)
+            sec = marginal_s_per_op(lambda k: mk(k=k), x0, args.k1, k2,
+                                    args.repeats, args.trials)
+            gbps = (n_ops + 1) * elems * dtype.itemsize / sec / 1e9
+            rows.append({"bench": "bench_local", "kernel": kname,
+                         "dtype": dtype.name, "size_bytes": size,
+                         "GBps": round(gbps, 3), "s_per_op": sec,
+                         "native": native, "device_kind": dev.device_kind,
+                         "tile_rows": args.tile_rows})
+            sz = (f"{size >> 20} MiB" if size >= M.MiB
+                  else f"{size >> 10} KiB")
+            print(f"{kname:8s} {dtype.name:9s} {sz:>9s}  {gbps:8.1f} GB/s  "
+                  f"native={native}")
     if args.out:
         with open(args.out, "a") as fp:
             for rec in rows:
                 fp.write(json.dumps(rec) + "\n")
     return 0
-
-
-def run_kernels(kernels, args, x0, ref2, ref3, rows, native, size, k2, dev,
-                dtype):
-    itemsize = dtype.itemsize
-    elems = size // itemsize
-    tol = 1e-3 if itemsize == 4 else 3e-2  # bf16 chain vs fp32 reference
-    for kname in kernels:
-        n_ops = int(kname[-1])
-        chk = make_combine_chain(kname, args.tile_rows,
-                                 None if native else True, k=2)(*x0)
-        want = (ref3 if n_ops == 3 else ref2).ravel()[0]
-        if not np.isclose(float(chk), want, rtol=tol, atol=tol):
-            raise SystemExit(f"{kname}: self-check failed "
-                             f"({float(chk)} vs {want})")
-        mk = functools.partial(make_combine_chain, kname, args.tile_rows,
-                               None if native else True)
-        sec = marginal_s_per_op(lambda k: mk(k=k), x0, args.k1, k2,
-                                args.repeats, args.trials)
-        gbps = (n_ops + 1) * elems * itemsize / sec / 1e9
-        rec = {"bench": "bench_local", "kernel": kname, "dtype": dtype.name,
-               "size_bytes": size, "GBps": round(gbps, 3),
-               "s_per_op": sec, "native": native,
-               "device_kind": dev.device_kind, "tile_rows": args.tile_rows}
-        rows.append(rec)
-        sz = (f"{size >> 20} MiB" if size >= M.MiB else f"{size >> 10} KiB")
-        print(f"{kname:8s} {dtype.name:9s} {sz:>9s}  {gbps:8.1f} GB/s  "
-              f"native={native}")
 
 
 if __name__ == "__main__":
