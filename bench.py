@@ -102,10 +102,65 @@ def _mfu_leg(on_cpu: bool, device, marginal) -> str:
     flops = 4 * T * d * ffn  # two matmuls, 2 flops per MAC
     chip = chip_for(getattr(device, "device_kind", ""))
     peak = chip.bf16_tflops * 1e12 if chip else 1e12
-    mfu = flops / sec / peak
-    return (f"# flagship step (moe-ffn fwd, T={T} d={d} ffn={ffn} "
-            f"{jnp.dtype(dtype).name}): {sec * 1e6:.0f} us/step, "
-            f"{flops / sec / 1e12:.1f} TFLOP/s, MFU {mfu:.2f} vs bf16 peak")
+    fwd_line = (f"# flagship step (moe-ffn fwd, T={T} d={d} ffn={ffn} "
+                f"{jnp.dtype(dtype).name}): {sec * 1e6:.0f} us/step, "
+                f"{flops / sec / 1e12:.1f} TFLOP/s, MFU {flops / sec / peak:.2f} "
+                f"vs bf16 peak")
+
+    # TRAIN step: the same layer under jax.grad (loss -> expert-weight
+    # grads -> SGD), the standard fwd+bwd MFU axis. The expert weights are
+    # traced loop carries so the whole chain is one compiled program; see
+    # the FLOP accounting note below (NOT the 3x-forward rule of thumb).
+    def loss_fn(ws, tok, lg):
+        step = moe_topk_step(t, "auto", True, 1, T, 1,
+                             expert=ffn_expert(*ws))
+        out, _ = step(tok, lg)
+        out = out.astype(jnp.float32)
+        return (out * out).sum()
+
+    def make_train_chain(k):
+        @jax.jit
+        def f(wi, wo, tok, lg):
+            def body(_, ws):
+                g = jax.grad(loss_fn)(ws, tok, lg)
+                return tuple((w - 1e-4 * gg).astype(dtype)
+                             for w, gg in zip(ws, g))
+            ws = jax.lax.fori_loop(0, k, body, (wi, wo))
+            return ws[0].ravel()[0]
+        return f
+
+    # FLOPs: fwd 4TDF (two matmuls) + bwd 6TDF — dW for both matmuls and
+    # dx through the SECOND only (tokens are not differentiated, so the
+    # first matmul's dx is never built) = 10 T d ffn, NOT the 3x-forward
+    # rule of thumb. Depth gap: a k2=16 chain (~46 ms of work) sat inside
+    # the relay's jitter band and once measured MFU 1.25 — impossible —
+    # so the train chain runs k2=32 (~100 ms gap) and anything still
+    # beating the chip's peak is re-measured deeper, mirroring the
+    # roofline guard.
+    tflops = 10 * T * d * ffn
+    # exceeds-peak guard only where a REAL peak is known (same rule as the
+    # single-chip roofline guard: the 1e12 fallback would flag every honest
+    # measurement on a chip missing from hw.CHIPS)
+    guard_peak = not on_cpu and chip is not None
+    depths = ((2, 4),) if on_cpu else ((4, 32), (8, 64))
+    tsec, mfu = 0.0, float("inf")
+    for i, (k1, k2) in enumerate(depths):
+        tsec = marginal(make_train_chain, (w_in, w_out, tokens, logits),
+                        k1=k1, k2=k2, repeats=3 if on_cpu else 5,
+                        trials=1 if on_cpu else 3)
+        mfu = tflops / tsec / peak
+        if not guard_peak or mfu <= 1.0:
+            break
+        if i + 1 < len(depths):
+            print(f"# train-step MFU {mfu:.2f} > 1 at k2={k2} (impossible; "
+                  f"jitter swamped the gap) — deepening chain",
+                  file=sys.stderr)
+    return (fwd_line + "\n"
+            f"# flagship TRAIN step (fwd+bwd+sgd, same layer): "
+            f"{tsec * 1e6:.0f} us/step, {tflops / tsec / 1e12:.1f} TFLOP/s, "
+            f"MFU {mfu:.2f} vs bf16 peak"
+            + (" [UNRELIABLE: exceeds peak at max depth]"
+               if guard_peak and mfu > 1.0 else ""))
 
 
 def main() -> int:

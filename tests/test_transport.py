@@ -366,3 +366,19 @@ def test_alltoallv_rnr_algo_env(monkeypatch, devices):
     monkeypatch.setenv("RNR_ALGO", "ringg")
     with pytest.raises(ValueError, match="not an algorithm"):
         t.alltoallv(x, counts)
+
+
+def test_alltoallv_edge_counts(devices):
+    # all-zero counts (pure-padding exchange) and full-capacity counts
+    # (degenerates to the dense alltoall) must both hold the contract
+    n, cap, d = 4, 3, 2
+    t = Transport(rt.rank_mesh(n))
+    rng = np.random.default_rng(9)
+    x = t.shard(rng.standard_normal((n, n, cap, d)).astype(np.float32))
+    out, rc = t.alltoallv(x, np.zeros((n, n), np.int64))
+    assert np.all(np.asarray(out) == 0) and np.all(np.asarray(rc) == 0)
+    out, rc = t.alltoallv(x, np.full((n, n), cap, np.int64))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(x).transpose(1, 0, 2, 3),
+                               rtol=1e-6, atol=1e-7)
+    assert np.all(np.asarray(rc) == cap)
