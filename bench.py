@@ -8,9 +8,10 @@ policy; the winner is printed to stderr. On a single
 chip there is no wire, so the headline degrades to the on-chip half of the
 algorithm — the HBM-bound accumulate, best-of over the per-step combine
 kernels the implemented schedules fold with (the ring step's 2-operand
-combine, 2R+1W; the double binary tree's inner-node level fold, a 3-operand
-combine, 3R+1W — see dtree.py:59-69) — reported against the chip's HBM
-roofline so the number is honest about what it measures. Size is the
+combine; the double binary tree's 3-operand level fold, dtree.py:59-69;
+the arity-4 k-ary tree's 5-operand level fold, ktree.py) — reported
+against the chip's HBM roofline so the number is honest about what it
+measures. Size is the
 contract's 1 GiB fp32 (BASELINE.json:2), falling back to 256 MiB only if
 the relayed backend refuses the larger buffers.
 
@@ -301,13 +302,16 @@ def main() -> int:
     else:
         # single chip: HBM-bound accumulate — best of the per-step combine
         # kernels the implemented schedules actually fold with:
-        #   ring2  = y + b      (2R+1W; every ring/halving-doubling step,
-        #                        collectives/ring.py / tree.py)
-        #   dtree3 = y + b + c  (3R+1W; the double-binary-tree inner-node
-        #                        LEVEL fold — collectives/dtree.py:59-69
-        #                        stashes both child arrivals and combines
-        #                        them in ONE elementwise pass, so the 3-load
-        #                        kernel is what that schedule runs per level)
+        #   ring2  = y + b        (2R+1W; every ring/halving-doubling step,
+        #                          collectives/ring.py / tree.py)
+        #   dtree3 = y + b + c    (3R+1W; the double-binary-tree inner-node
+        #                          LEVEL fold — collectives/dtree.py:59-69
+        #                          stashes both child arrivals and combines
+        #                          them in ONE elementwise pass)
+        #   ktree5 = y + b+c+d+e  (5R+1W; the arity-4 k-ary tree's level
+        #                          fold — collectives/ktree.py, the
+        #                          wide-fold schedule built exactly so the
+        #                          accumulate amortizes its write traffic)
         # Size: the contract fixes 1 GiB fp32 (BASELINE.json:2). The relayed
         # backend may reject multi-GiB transfers/compiles, so fall back to
         # 256 MiB and say so on stderr (BASELINE.md documents both rows).
@@ -330,11 +334,12 @@ def main() -> int:
             elems = nbytes // 4
             # operands enter as arguments: closed-over constants this size
             # would be embedded in the program and can exceed
-            # compile-request limits on relayed backends
+            # compile-request limits on relayed backends. Five operands
+            # serve every candidate (the widest fold reads 5).
             args = tuple(
                 jnp.asarray(rng.standard_normal(size=(elems,),
                                                 dtype=np.float32))
-                for _ in range(3))
+                for _ in range(5))
             # The depth gap must make device work dominate tunnel jitter:
             # the relayed backend adds ~90 ms fixed overhead per call
             # fluctuating by tens of ms, so a 20-op gap measured 271-721
@@ -348,7 +353,8 @@ def main() -> int:
             # deeper if a physically impossible number still appears.
             leg = {}
             for name, kernel, n_ops in (("ring2", "xla2", 2),
-                                        ("dtree3", "xla3", 3)):
+                                        ("dtree3", "xla3", 3),
+                                        ("ktree5", "xla5", 5)):
                 mk = functools.partial(make_combine_chain, kernel, 0, None)
                 for k1, k2 in ((8, 128), (32, 256)):
                     # trials=4: min-over-trials hunts the backend's fast

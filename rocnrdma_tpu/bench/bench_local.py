@@ -40,31 +40,40 @@ from rocnrdma_tpu.bench import cli_common
 from rocnrdma_tpu.bench.runner import parse_size
 from rocnrdma_tpu.bench.timing import marginal_s_per_op
 
-KERNELS = ("xla2", "xla3", "pallas2", "pallas3")
+KERNELS = ("xla2", "xla3", "xla4", "xla5", "pallas2", "pallas3", "pallas4",
+           "pallas5")
 
 
 def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int):
     """Jitted k-deep chain of one combine kernel; also the chain builder
     behind bench.py's single-chip headline candidates (one copy of the
-    fori_loop/byte-accounting conventions)."""
+    fori_loop/byte-accounting conventions). The trailing digit is the
+    operand count: 2 = ring step, 3 = dtree level fold, 5 = the arity-4
+    ktree level fold (collectives/ktree.py). The callable is variadic —
+    pass at least n_ops operand arrays; spares are traced but untouched,
+    so one operand tuple (sized to the widest kernel in play) serves
+    every kernel."""
     from jax import lax
 
     from rocnrdma_tpu.ops import pallas_hbm_combine
 
     n_ops = int(kernel[-1])
     if kernel.startswith("xla"):
-        def combine(y, bb, cc):
-            return y + bb + cc if n_ops == 3 else y + bb
+        def combine(y, *bs):
+            out = y
+            for b in bs[:n_ops - 1]:
+                out = out + b
+            return out
     else:
-        def combine(y, bb, cc):
-            ops = (y, bb, cc)[:n_ops]
-            return pallas_hbm_combine(*ops, tile_rows=tile_rows,
+        def combine(y, *bs):
+            return pallas_hbm_combine(y, *bs[:n_ops - 1],
+                                      tile_rows=tile_rows,
                                       interpret=interpret)
 
     @jax.jit
-    def f(x, bb, cc):
+    def f(x, *bs):
         return lax.fori_loop(
-            0, k, lambda _, y: combine(y, bb, cc), x).ravel()[0]
+            0, k, lambda _, y: combine(y, *bs), x).ravel()[0]
     return f
 
 
@@ -120,15 +129,17 @@ def main(argv=None) -> int:
     dtype = jnp.dtype(args.dtype)
     elems = size // dtype.itemsize
     rng = np.random.default_rng(0)
+    # one operand tuple serves every kernel (spares traced but untouched)
+    need = max(int(k[-1]) for k in kernels)
     x0 = tuple(jnp.asarray(rng.standard_normal((elems,), dtype=np.float32))
-               .astype(dtype) for _ in range(3))
+               .astype(dtype) for _ in range(need))
 
     # correctness gate before any timing (the suite's bench convention):
-    # one shallow chain of each kernel vs numpy (in fp32 — the bf16 chain
-    # is checked against the fp32 math at bf16 tolerance)
+    # one shallow (k=2) chain of each kernel vs numpy (in fp32 — the bf16
+    # chain is checked against the fp32 math at bf16 tolerance). After two
+    # iterations of y += b1..b_{n-1}, the result is x + 2*sum(b).
     f32 = [np.asarray(x, dtype=np.float32) for x in x0]
-    ref2 = f32[0] + 2 * f32[1]
-    ref3 = ref2 + 2 * f32[2]
+    refs = {n: f32[0] + 2 * sum(f32[1:n]) for n in range(2, need + 1)}
     import contextlib
     prof = (jax.profiler.trace(args.profile) if args.profile
             else contextlib.nullcontext())
@@ -139,7 +150,7 @@ def main(argv=None) -> int:
             n_ops = int(kname[-1])
             chk = make_combine_chain(kname, args.tile_rows,
                                      None if native else True, k=2)(*x0)
-            want = (ref3 if n_ops == 3 else ref2).ravel()[0]
+            want = refs[n_ops].ravel()[0]
             if not np.isclose(float(chk), want, rtol=tol, atol=tol):
                 raise SystemExit(f"{kname}: self-check failed "
                                  f"({float(chk)} vs {want})")

@@ -35,6 +35,10 @@ from rocnrdma_tpu.collectives.ring import (  # noqa: F401
 )
 from rocnrdma_tpu.collectives.tree import hd_allreduce  # noqa: F401
 from rocnrdma_tpu.collectives.dtree import dbtree_allreduce  # noqa: F401
+from rocnrdma_tpu.collectives.ktree import (  # noqa: F401
+    kary_tree_allreduce,
+    sim_kary_allreduce,
+)
 from rocnrdma_tpu.collectives.alltoall import (  # noqa: F401
     bruck_alltoall,
     fused_alltoallv,

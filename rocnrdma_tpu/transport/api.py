@@ -49,7 +49,7 @@ def _raise(msg: str):
 # algorithm did auto actually pick?" without a debugger.
 _DEBUG_LOG = os.environ.get("RNR_DEBUG", "") not in ("", "0")
 
-ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "dtree",
+ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "dtree", "ktree",
          "hierarchical", "pallas_ring", "bruck", "binomial")
 
 # THE (op, algo) compatibility table — single source of truth, consumed by
@@ -72,6 +72,10 @@ SCHEDULES = {
             C.hd_allreduce(v, RANK_AXIS, op=op),
         "dtree": lambda v, _, op="sum", root=0:
             C.dbtree_allreduce(v, RANK_AXIS, op=op),
+        # wide-fold k-ary tree (one fused (arity+1)-operand combine per
+        # interior level; arity = ktree.KTREE_ARITY, shared with the tuner)
+        "ktree": lambda v, _, op="sum", root=0:
+            C.kary_tree_allreduce(v, RANK_AXIS, op=op),
         "hierarchical": lambda v, _, op="sum", root=0, cross_dtype=None:
             C.hierarchical_allreduce(v, op=op, cross_dtype=cross_dtype),
         "pallas_ring": lambda v, _, op="sum", root=0:

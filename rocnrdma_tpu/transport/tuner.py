@@ -78,6 +78,11 @@ def _L(n: int) -> int:
     return max(1, math.ceil(math.log2(n)))
 
 
+def _ktree_arity() -> int:
+    from rocnrdma_tpu.collectives.ktree import KTREE_ARITY
+    return KTREE_ARITY
+
+
 # (steps, wire_bytes_factor) per (verb, algo): T = steps*alpha + factor*S*beta.
 # ``factor`` is the serialized bytes-on-the-critical-link per buffer byte —
 # exactly the busbw accounting of metrics.py read backwards. ``ring_bidir``
@@ -91,6 +96,12 @@ _MODEL = {
     # double tree: ~2 substeps/level x 2 phases x 2 trees; each rank wires
     # about S/2 up + S/2 down per tree (leaf in one, interior in the other)
     ("allreduce", "dtree"): lambda n: (8 * _L(n), 2.0),
+    # arity-k tree (k = the registry's ktree.KTREE_ARITY): up to k child
+    # substeps per level, 2 phases, ceil(log_k n) levels; full buffer up +
+    # down on tree edges
+    ("allreduce", "ktree"): lambda n: (
+        2 * _ktree_arity() * max(1, math.ceil(
+            math.log(n, _ktree_arity()))), 2.0),
     ("allreduce", "pallas_ring"): lambda n: (2 * (n - 1), 2 * (n - 1) / n),
     ("reduce_scatter", "ring"): lambda n: (n - 1, (n - 1) / n),
     ("reduce_scatter", "pallas_ring"): lambda n: (n - 1, (n - 1) / n),

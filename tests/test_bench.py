@@ -263,12 +263,12 @@ def test_bench_local_cli(tmp_path):
     from rocnrdma_tpu.bench import bench_local
     out = tmp_path / "l.jsonl"
     _run(bench_local.main,
-         ["--size", "64K", "--kernels", "xla2,xla3,pallas2,pallas3",
+         ["--size", "64K", "--kernels", "xla2,xla3,xla5,pallas2,pallas5",
           "--k2", "8", "--repeats", "2", "--trials", "1",
           "--tile-rows", "8", "--out", str(out)])
     rows = [json.loads(l) for l in out.read_text().splitlines()]
-    assert [r["kernel"] for r in rows] == ["xla2", "xla3", "pallas2",
-                                          "pallas3"]
+    assert [r["kernel"] for r in rows] == ["xla2", "xla3", "xla5",
+                                          "pallas2", "pallas5"]
     # on the CPU oracle the pallas tier runs interpreted, never native
     assert all(r["native"] is False for r in rows)
     assert all(r["GBps"] > 0 for r in rows)

@@ -1,0 +1,76 @@
+"""k-ary tree allreduce: the wide-fold schedule (collectives/ktree.py)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rocnrdma_tpu import runtime as rt
+from rocnrdma_tpu.collectives import kary_tree_allreduce, sim_kary_allreduce
+from rocnrdma_tpu.collectives.ktree import kary_levels
+from rocnrdma_tpu.transport import Transport
+
+RANK = rt.mesh.RANK_AXIS
+
+
+def _run(n, arity, op="sum", size=97):
+    rng = np.random.default_rng(n * 10 + arity)
+    x = rng.standard_normal((n, size)).astype(np.float32)
+    mesh = rt.rank_mesh(n)
+    f = jax.jit(jax.shard_map(
+        lambda s: kary_tree_allreduce(s[0], RANK, arity=arity, op=op)[None],
+        mesh=mesh, in_specs=(P(RANK),), out_specs=P(RANK), check_vma=False))
+    return x, np.asarray(f(x))
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5, 7, 8])
+@pytest.mark.parametrize("arity", [2, 3, 4])
+def test_ktree_matches_numpy(devices, n, arity):
+    x, out = _run(n, arity)
+    np.testing.assert_allclose(out, np.broadcast_to(x.sum(0), out.shape),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("op,npf", [("max", np.max), ("min", np.min),
+                                    ("avg", np.mean)])
+def test_ktree_ops(devices, op, npf):
+    x, out = _run(8, 4, op=op)
+    np.testing.assert_allclose(out, np.broadcast_to(npf(x, axis=0), out.shape),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [2, 5, 8, 13, 64])
+@pytest.mark.parametrize("arity", [2, 3, 4, 5])
+def test_ktree_sim_oracle(n, arity):
+    # the pure-numpy walker over the same substep tables (no devices):
+    # contract-scale rank counts included
+    rng = np.random.default_rng(n + arity)
+    xs = [rng.standard_normal(33).astype(np.float32) for _ in range(n)]
+    out = sim_kary_allreduce(xs, arity=arity)
+    want = np.sum(xs, axis=0)
+    for h in out:
+        np.testing.assert_allclose(h, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ktree_levels_structure():
+    up, down = kary_levels(13, 4)
+    # 13 ranks, arity 4: depth-1 = ranks 1..4, depth-2 = 5..12
+    flat_up = [p for level in up for sub in level for p in sub]
+    assert set(flat_up) == {(c, (c - 1) // 4) for c in range(1, 13)}
+    # down mirrors up with flipped pairs, shallowest level first
+    flat_down = [p for level in down for sub in level for p in sub]
+    assert set(flat_down) == {(p, c) for c, p in flat_up}
+    assert down[0][0][0] == (0, 1)  # root broadcasts first
+    with pytest.raises(ValueError, match="arity"):
+        kary_levels(8, 1)
+
+
+def test_ktree_via_transport_and_group(devices):
+    t = Transport(rt.rank_mesh(8))
+    x = t.shard(np.random.default_rng(3)
+                .standard_normal((8, 64)).astype(np.float32))
+    out = np.asarray(t.allreduce(x, "ktree"))
+    np.testing.assert_allclose(
+        out, np.broadcast_to(np.asarray(x).sum(0), out.shape),
+        rtol=1e-5, atol=1e-5)
+    assert any(k.startswith("allreduce/ktree") for k in t.stats())

@@ -207,3 +207,50 @@ def test_rdma_family_shares_connection_state():
         np.testing.assert_allclose(b, total[bounds[r]:bounds[r + 1]],
                                    rtol=1e-5)
         np.testing.assert_array_equal(c, np.stack(xs))
+
+
+@needs_native
+@pytest.mark.parametrize("net_cls", PLANES)
+def test_rdma_soak_random_mixed_sequence(net_cls):
+    """Soak the put/take engine: a random mixed collective sequence with
+    jumping sizes on ONE connection pair per rank — MR growth and shrink
+    reuse, slot parity, hop-counter continuity across collectives, and
+    the deferred-ack consume window (the zero-copy refactor's riskiest
+    paths) all exercised in one run."""
+    n = 3
+    seq = np.random.default_rng(77)
+    ops = seq.choice(["ar", "rs", "ag"], size=18)
+    sizes = seq.integers(1, 5000, size=18)
+    datas = [np.random.default_rng(100 + i)
+             .standard_normal((n, int(s))).astype(np.float32)
+             for i, s in enumerate(sizes)]
+
+    def fn(net, s, r, rank):
+        out = []
+        for i, op in enumerate(ops):
+            x = datas[i][rank]
+            if op == "ar":
+                out.append(ring_allreduce_rdma(net, s, r, x, rank, n))
+            elif op == "rs":
+                out.append(ring_reduce_scatter_rdma(net, s, r, x, rank, n))
+            else:
+                out.append(ring_allgather_rdma(net, s, r, x, rank, n))
+        return out
+
+    res = _run_ring(net_cls, n, fn)
+    for i, op in enumerate(ops):
+        total = datas[i].sum(axis=0)
+        m = len(total)
+        bounds = [m * j // n for j in range(n + 1)]
+        for r in range(n):
+            got = res[r][i]
+            if op == "ar":
+                np.testing.assert_allclose(got, total, rtol=1e-5,
+                                           atol=1e-5, err_msg=f"op {i}")
+            elif op == "rs":
+                np.testing.assert_allclose(
+                    got, total[bounds[r]:bounds[r + 1]], rtol=1e-5,
+                    atol=1e-5, err_msg=f"op {i}")
+            else:
+                np.testing.assert_array_equal(got, datas[i],
+                                              err_msg=f"op {i}")
