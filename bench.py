@@ -9,7 +9,7 @@ chip there is no wire, so the headline degrades to the on-chip half of the
 algorithm — the HBM-bound accumulate, best-of over the per-step combine
 kernels the implemented schedules fold with (the ring step's 2-operand
 combine; the double binary tree's 3-operand level fold, dtree.py:59-69;
-the arity-4 k-ary tree's 5-operand level fold, ktree.py) — reported
+the k-ary tree's wide level fold, ktree.py; arity 8 folds 9 operands) — reported
 against the chip's HBM roofline so the number is honest about what it
 measures. Size is the
 contract's 1 GiB fp32 (BASELINE.json:2), falling back to 256 MiB only if
@@ -184,6 +184,7 @@ def main() -> int:
     hbm_bw, ici_bw = _roofline(devices[0])
     n = len(devices)
     on_cpu = devices[0].platform == "cpu"
+    extras = []  # stderr legs run AFTER the scored JSON line prints
 
     if n >= 2:
         # multi-chip: allreduce over ICI. Two candidates — the fused XLA
@@ -285,7 +286,9 @@ def main() -> int:
         # the contract's SECOND metric (BASELINE.json:2): alltoall algbw —
         # stderr only (the driver schema takes one JSON line; allreduce
         # busbw is the scored one). Needs a wire, so multi-chip only.
-        try:
+        # Deferred until AFTER the scored line prints (see the flush note
+        # at the bottom of main).
+        def alltoall_extra():
             def a2a(y):
                 return C.fused_alltoall(y.reshape(n, -1), "rank").reshape(
                     y.shape)
@@ -293,12 +296,10 @@ def main() -> int:
                 functools.partial(make_chain, ar=a2a, stabilize=False),
                 (x0,), k1=2, k2=8 if on_cpu else 32,
                 repeats=3 if on_cpu else 5, trials=1 if on_cpu else 3)
-            print(f"# alltoall algbw: "
-                  f"{M.algbw_GBps(elems * 4, sec):.2f} GB/s/chip "
-                  f"@ {elems * 4 >> 20} MiB/rank (fused)", file=sys.stderr)
-        except Exception as e:
-            print(f"# alltoall leg failed: {type(e).__name__}: "
-                  f"{str(e)[:160]}", file=sys.stderr)
+            return (f"# alltoall algbw: "
+                    f"{M.algbw_GBps(elems * 4, sec):.2f} GB/s/chip "
+                    f"@ {elems * 4 >> 20} MiB/rank (fused)")
+        extras.append(alltoall_extra)
     else:
         # single chip: HBM-bound accumulate — best of the per-step combine
         # kernels the implemented schedules actually fold with:
@@ -308,14 +309,15 @@ def main() -> int:
         #                          LEVEL fold — collectives/dtree.py:59-69
         #                          stashes both child arrivals and combines
         #                          them in ONE elementwise pass)
-        #   ktree5 = y + b+c+d+e  (5R+1W; the arity-4 k-ary tree's level
+        #   ktree9 = y + b+..+i   (9R+1W; the arity-8 k-ary tree's level
         #                          fold — collectives/ktree.py, the
         #                          wide-fold schedule built exactly so the
-        #                          accumulate amortizes its write traffic)
+        #                          accumulate amortizes its write traffic;
+        #                          measured 723/733/738 GB/s for
+        #                          5/7/9-operand folds at 1 GiB)
         # Size: the contract fixes 1 GiB fp32 (BASELINE.json:2). The relayed
         # backend may reject multi-GiB transfers/compiles, so fall back to
         # 256 MiB and say so on stderr (BASELINE.md documents both rows).
-        rng = np.random.default_rng(0)
         target = 0.9 * hbm_bw
         # the anti-collapse guard only makes sense against a REAL roofline:
         # on the CPU oracle and on chips missing from hw.CHIPS, hbm_bw is
@@ -334,12 +336,19 @@ def main() -> int:
             elems = nbytes // 4
             # operands enter as arguments: closed-over constants this size
             # would be embedded in the program and can exceed
-            # compile-request limits on relayed backends. Five operands
-            # serve every candidate (the widest fold reads 5).
+            # compile-request limits on relayed backends. Nine operands
+            # serve every candidate (the widest fold reads 9; at 1 GiB
+            # that is 9 GiB of operands + the chain carry — inside the
+            # 16 GiB HBM, and the 256 MiB fallback rung shrinks it 4x).
+            # Generated ON-DEVICE: shipping 9 GiB of host randomness
+            # through the relay cost ~20 minutes per run; the timing
+            # discipline only needs distinct dense buffers, not any
+            # particular values.
+            gen = jax.jit(lambda key: jax.random.normal(
+                key, (elems,), jnp.float32))
             args = tuple(
-                jnp.asarray(rng.standard_normal(size=(elems,),
-                                                dtype=np.float32))
-                for _ in range(5))
+                jax.block_until_ready(gen(k))
+                for k in jax.random.split(jax.random.PRNGKey(0), 9))
             # The depth gap must make device work dominate tunnel jitter:
             # the relayed backend adds ~90 ms fixed overhead per call
             # fluctuating by tens of ms, so a 20-op gap measured 271-721
@@ -354,7 +363,7 @@ def main() -> int:
             leg = {}
             for name, kernel, n_ops in (("ring2", "xla2", 2),
                                         ("dtree3", "xla3", 3),
-                                        ("ktree5", "xla5", 5)):
+                                        ("ktree9", "xla9", 9)):
                 mk = functools.partial(make_combine_chain, kernel, 0, None)
                 for k1, k2 in ((8, 128), (32, 256)):
                     # trials=4: min-over-trials hunts the backend's fast
@@ -401,6 +410,11 @@ def main() -> int:
         out = {"metric": "local_reduce_GBps", "value": round(value, 3),
                "unit": "GB/s", "vs_baseline": round(value / target, 4)}
 
+    # The scored JSON line prints FIRST: the stderr extras below (alltoall
+    # leg, flagship MFU) take minutes of chip time, and a driver-side
+    # timeout mid-extra must not cost the headline that is already known.
+    print(json.dumps(out), flush=True)
+
     # Second axis (stderr only; VERDICT r1 item 5), BOTH branches: the
     # flagship step's compute-bound face. entry()'s MoE program at
     # realistic width with a REAL FFN expert (workloads.moe.ffn_expert),
@@ -408,14 +422,13 @@ def main() -> int:
     # definition), timed with the same marginal discipline; expert-matmul
     # FLOP/s vs the chip's bf16 peak = MFU. A failure here must never
     # cost the headline.
-    try:
-        print(_mfu_leg(on_cpu, devices[0], _marginal_s_per_op),
-              file=sys.stderr)
-    except Exception as e:
-        print(f"# mfu leg failed: {type(e).__name__}: {str(e)[:200]}",
-              file=sys.stderr)
-
-    print(json.dumps(out))
+    extras.append(lambda: _mfu_leg(on_cpu, devices[0], _marginal_s_per_op))
+    for extra in extras:
+        try:
+            print(extra(), file=sys.stderr)
+        except Exception as e:
+            print(f"# extra leg failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
     return 0
 
 

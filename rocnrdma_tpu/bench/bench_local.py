@@ -40,16 +40,16 @@ from rocnrdma_tpu.bench import cli_common
 from rocnrdma_tpu.bench.runner import parse_size
 from rocnrdma_tpu.bench.timing import marginal_s_per_op
 
-KERNELS = ("xla2", "xla3", "xla4", "xla5", "pallas2", "pallas3", "pallas4",
-           "pallas5")
+KERNELS = ("xla2", "xla3", "xla4", "xla5", "xla6", "xla7", "xla8",
+           "xla9", "pallas2", "pallas3", "pallas4", "pallas5")
 
 
 def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int):
     """Jitted k-deep chain of one combine kernel; also the chain builder
     behind bench.py's single-chip headline candidates (one copy of the
     fori_loop/byte-accounting conventions). The trailing digit is the
-    operand count: 2 = ring step, 3 = dtree level fold, 5 = the arity-4
-    ktree level fold (collectives/ktree.py). The callable is variadic —
+    operand count: 2 = ring step, 3 = dtree level fold, k+1 = the
+    arity-k ktree level fold (collectives/ktree.py; 9 = arity 8). The callable is variadic —
     pass at least n_ops operand arrays; spares are traced but untouched,
     so one operand tuple (sized to the widest kernel in play) serves
     every kernel."""

@@ -39,8 +39,14 @@ from rocnrdma_tpu.collectives.dtree import _dst_gate
 from rocnrdma_tpu.collectives.reduce_op import combine_fn, finalize, identity
 
 # the registry arity (transport SCHEDULES' algo="ktree" and the tuner's
-# cost model both consume THIS constant — one copy, they cannot diverge)
-KTREE_ARITY = 4
+# cost model both consume THIS constant — one copy, they cannot diverge).
+# 8: the widest fold the chip still rewards (1 GiB ladder: 5-op 723,
+# 7-op 733, 9-op 738-757 GB/s) — the wide combine IS this schedule's
+# reason to exist, and bench.py's scored ktree9 kernel must be the fold
+# the registered algorithm actually runs. The wire-latency trade (more
+# substeps per level, fewer levels) is modeled honestly by the tuner's
+# log_arity step count.
+KTREE_ARITY = 8
 
 
 @functools.lru_cache(maxsize=None)

@@ -74,3 +74,22 @@ def test_ktree_via_transport_and_group(devices):
         out, np.broadcast_to(np.asarray(x).sum(0), out.shape),
         rtol=1e-5, atol=1e-5)
     assert any(k.startswith("allreduce/ktree") for k in t.stats())
+
+
+@pytest.mark.parametrize("n", [3, 8])
+def test_ktree_arity8(devices, n):
+    # the widest registry fold bench.py's ktree9 candidate cites: at n<=8
+    # the root folds every other rank in ONE level (one fused 9-operand
+    # combine at n=8 wait-free of depth)
+    x, out = _run(n, 8)
+    np.testing.assert_allclose(out, np.broadcast_to(x.sum(0), out.shape),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ktree_arity8_sim_large():
+    rng = np.random.default_rng(88)
+    xs = [rng.standard_normal(17).astype(np.float32) for _ in range(64)]
+    out = sim_kary_allreduce(xs, arity=8)
+    for h in out:
+        np.testing.assert_allclose(h, np.sum(xs, axis=0), rtol=1e-5,
+                                   atol=1e-5)
