@@ -240,8 +240,14 @@ def main() -> int:
             scored run — first multichip contact happens here). The shard
             is returned so the alltoall leg reuses it (no re-transfer)."""
             elems = nbytes // 4
-            x0 = t.shard(np.random.default_rng(0)
-                         .standard_normal(size=(n, elems), dtype=np.float32))
+            # generated on-device, already sharded (host-shipping n GiB
+            # through relayed backends is minutes of dead time; values
+            # are irrelevant to the timing discipline)
+            from jax.sharding import NamedSharding
+            gen = jax.jit(
+                lambda key: jax.random.normal(key, (n, elems), jnp.float32),
+                out_shardings=NamedSharding(mesh, P("rank")))
+            x0 = jax.block_until_ready(gen(jax.random.PRNGKey(0)))
             leg = {}
             for name, ar in algos.items():
                 try:
