@@ -197,10 +197,8 @@ def main() -> int:
 
         from rocnrdma_tpu import collectives as C
         from rocnrdma_tpu import runtime as rt
-        from rocnrdma_tpu.transport import Transport
 
         mesh = rt.rank_mesh(n)
-        t = Transport(mesh)
         inv_n = np.float32(1.0 / n)  # keep magnitudes stable along the chain
 
         algos = {
