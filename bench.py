@@ -1,10 +1,11 @@
 """Driver benchmark: prints ONE JSON line with the headline metric.
 
 Headline (BASELINE.json:2): allreduce bus-bandwidth GB/s/chip. On a
-multi-chip backend this measures the BEST of the framework's two allreduce
-paths over ICI — the fused XLA lowering (the production algo="auto" pick)
-and the explicit bidirectional ring — mirroring the Transport's selection
-policy; the winner is printed to stderr. On a single
+multi-chip backend this measures the BEST of the framework's allreduce
+paths over ICI — the fused XLA lowering (the production algo="auto" pick),
+the explicit bidirectional ring, and (on real TPU) the Pallas remote-DMA
+ring — mirroring the Transport's selection policy; the winner is printed
+to stderr. On a single
 chip there is no wire, so the headline degrades to the on-chip half of the
 algorithm — the HBM-bound accumulate, best-of over the per-step combine
 kernels the implemented schedules fold with (the ring step's 2-operand
