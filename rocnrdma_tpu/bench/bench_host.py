@@ -38,19 +38,40 @@ from rocnrdma_tpu.bench.runner import parse_size
 from rocnrdma_tpu.bench.timing import trimmed_mean
 
 COLLECTIVES = ("allreduce", "reducescatter", "allgather", "broadcast",
-               "alltoall", "sendrecv")
+               "alltoall", "alltoallv", "sendrecv")
 
 
-def _build_input(collective: str, n: int, elems: int, rng) -> np.ndarray:
+def _build_input(collective: str, n: int, elems: int, rng,
+                 rank: int = 0, counts=None):
     if collective == "allgather":
         return rng.standard_normal(max(1, elems // n)).astype(np.float32)
     if collective == "alltoall":
         per = max(1, elems // n)
         return rng.standard_normal((n, per)).astype(np.float32)
+    if collective == "alltoallv":
+        # ragged: segment j from rank r carries counts[r, j] elements
+        # (callers pass the deterministic matrix every rank derives
+        # identically — the MPI contract)
+        return [rng.standard_normal(c).astype(np.float32)
+                for c in counts[rank]]
     return rng.standard_normal(elems).astype(np.float32)
 
 
-def _issue(pg, collective: str, x: np.ndarray, transport: str = "msg"):
+def _alltoallv_counts(n: int, per: int) -> np.ndarray:
+    """Deterministic skewed (n, n) counts: rank r sends rank j between
+    25% and 175% of the balanced chunk. (i + j) % n makes the fractions a
+    LATIN SQUARE — every row and column is a permutation of the full
+    range — so the train is genuinely ragged per segment while every
+    rank's TOTAL sent bytes stays equal (the recorded size_bytes and the
+    (n-1)/n busbw factor then mean the same thing on every rank; an
+    earlier (i + 2j) % n variant degenerated to two sizes and bimodal
+    row totals at even n)."""
+    i, j = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    frac = 0.25 + 1.5 * ((i + j) % n) / max(1, n - 1)
+    return np.maximum(1, (frac * per).astype(np.int64))
+
+
+def _issue(pg, collective: str, x, transport: str = "msg", counts=None):
     if collective == "allreduce":
         return pg.all_reduce(x, transport=transport)
     if collective == "reducescatter":
@@ -61,6 +82,8 @@ def _issue(pg, collective: str, x: np.ndarray, transport: str = "msg"):
         return pg.broadcast(x, src=0)
     if collective == "alltoall":
         return pg.all_to_all(x)
+    if collective == "alltoallv":
+        return pg.all_to_all_v(x, counts)
     if collective == "sendrecv":
         # the neighbour shift exchange over the p2p verbs: send right,
         # receive left, both in flight (the ncclSend/ncclRecv pattern)
@@ -83,18 +106,24 @@ def worker(args) -> int:
     for collective in args.collectives.split(","):
         for size in (parse_size(s) for s in args.sizes.split(",")):
             elems = max(1, size // 4)
-            x = _build_input(collective, pg.world_size, elems, rng)
+            counts = (_alltoallv_counts(pg.world_size,
+                                        max(1, elems // pg.world_size))
+                      if collective == "alltoallv" else None)
+            x = _build_input(collective, pg.world_size, elems, rng,
+                             rank=pg.rank, counts=counts)
             # record the bytes actually moved (per-rank chunks round down),
             # matching the device benches' actual-bytes convention
             actual = (x.nbytes * pg.world_size
-                      if collective == "allgather" else x.nbytes)
-            _issue(pg, collective, x, args.transport)  # warmup
+                      if collective == "allgather"
+                      else sum(seg.nbytes for seg in x)
+                      if collective == "alltoallv" else x.nbytes)
+            _issue(pg, collective, x, args.transport, counts)  # warmup
             spans = []
             for _ in range(args.repeats):
                 pg.barrier()
                 t0 = time.perf_counter()
                 for _ in range(args.iters):
-                    _issue(pg, collective, x, args.transport)
+                    _issue(pg, collective, x, args.transport, counts)
                 spans.append((time.perf_counter() - t0) / args.iters)
             mine = trimmed_mean(spans)
             # a collective is as slow as its slowest rank

@@ -46,6 +46,9 @@ _BUSBW_FACTOR = {
     "allgather": lambda n: (n - 1) / n,
     "reducescatter": lambda n: (n - 1) / n,
     "alltoall": lambda n: (n - 1) / n,
+    # ragged alltoall, reported against size = the rank's actual sent
+    # bytes: the off-rank fraction matches the dense exchange
+    "alltoallv": lambda n: (n - 1) / n,
     "broadcast": lambda n: 1.0,
     "reduce": lambda n: 1.0,          # every byte crosses each link once
     "gather": lambda n: (n - 1) / n,  # root receives (n-1) chunks of S/n

@@ -32,3 +32,32 @@ def test_build_input_shapes():
     assert bench_host._build_input("allreduce", 4, 100, rng).shape == (100,)
     assert bench_host._build_input("allgather", 4, 100, rng).shape == (25,)
     assert bench_host._build_input("alltoall", 4, 100, rng).shape == (4, 25)
+
+
+def test_alltoallv_on_the_native_wire(tmp_path):
+    # the RCCL ncclAllToAllv extension benched on the wire it ships on:
+    # ragged trains (skewed deterministic counts), shm plane
+    out = tmp_path / "v.jsonl"
+    rc = bench_host.main(["--ranks", "3", "--plane", "shm",
+                          "--sizes", "64K", "--collectives", "alltoallv",
+                          "--repeats", "2", "--iters", "2",
+                          "--out", str(out)])
+    assert rc == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows and all(r["collective"] == "alltoallv" for r in rows)
+    # ragged: actual bytes differ from the dense elems*4
+    assert all(r["size_bytes"] != 64 * 1024 for r in rows)
+
+
+def test_alltoallv_counts_deterministic_skewed_balanced():
+    import numpy as np
+    for n in (3, 4, 5, 8):
+        c = bench_host._alltoallv_counts(n, 100)
+        np.testing.assert_array_equal(c, bench_host._alltoallv_counts(n, 100))
+        assert c.shape == (n, n) and c.min() >= 1
+        # Latin square: every row spans the full 25-175% range...
+        for r in range(n):
+            assert len(set(c[r])) == n
+        # ...and every rank's TOTAL sent bytes is equal, so size_bytes
+        # and the busbw factor mean the same thing on every rank
+        assert len(set(c.sum(axis=1))) == 1
