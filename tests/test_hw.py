@@ -1,0 +1,44 @@
+"""The shared chip-constants table (rocnrdma_tpu/hw.py) — the one source
+bench.py's roofline, the tuner's calibration, and the MFU peak all read."""
+
+import pytest
+
+from rocnrdma_tpu import hw
+
+
+def test_match_rule_first_substring_wins():
+    # "TPU v5 lite" must hit "v5 lite" (819 GB/s HBM), NOT the "v5"
+    # entry that describes v5p-class chips — dict order is load-bearing
+    assert hw.chip_for("TPU v5 lite").hbm_GBps == 819.0
+    assert hw.chip_for("TPU v6 lite").hbm_GBps == 1638.0
+    assert hw.chip_for("TPU v5p").hbm_GBps == 2765.0
+    assert hw.chip_for("TPU v5").hbm_GBps == 2765.0
+    assert hw.chip_for("TPU v4").hbm_GBps == 1228.0
+
+
+def test_unknown_and_empty_kinds():
+    assert hw.chip_for("warp drive") is None
+    assert hw.chip_for("") is None
+    assert hw.chip_for(None) is None
+
+
+def test_per_link_rates_and_peaks_sane():
+    for kind, chip in hw.CHIPS.items():
+        assert chip.ici_links > 0
+        per_link = chip.ici_GBps / chip.ici_links
+        # per-link ICI is always well under HBM; peaks are positive
+        assert 0 < per_link < chip.hbm_GBps
+        assert chip.bf16_tflops > 0
+
+
+def test_measured_fraction_is_a_fraction():
+    assert 0.5 < hw.MEASURED_HBM_FRAC < 1.0
+
+
+@pytest.mark.parametrize("kind,expect_guard", [("TPU v5 lite", True),
+                                               ("mystery-chip", False)])
+def test_bench_roofline_consumes_the_table(kind, expect_guard):
+    # bench.py's _roofline and guard logic key off chip_for — the same
+    # dict; a kind missing from CHIPS must fall back, never crash
+    chip = hw.chip_for(kind)
+    assert (chip is not None) == expect_guard
