@@ -35,10 +35,21 @@ def test_measured_fraction_is_a_fraction():
     assert 0.5 < hw.MEASURED_HBM_FRAC < 1.0
 
 
-@pytest.mark.parametrize("kind,expect_guard", [("TPU v5 lite", True),
-                                               ("mystery-chip", False)])
-def test_bench_roofline_consumes_the_table(kind, expect_guard):
-    # bench.py's _roofline and guard logic key off chip_for — the same
-    # dict; a kind missing from CHIPS must fall back, never crash
-    chip = hw.chip_for(kind)
-    assert (chip is not None) == expect_guard
+def test_bench_roofline_consumes_the_table():
+    # bench.py's _roofline must actually read THIS table (a private copy
+    # of the constants would silently desync calibration from scoring)
+    import importlib.util
+    import os
+    import types
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_script_hw", os.path.join(os.path.dirname(__file__), "..",
+                                        "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    dev = types.SimpleNamespace(device_kind="TPU v5 lite")
+    chip = hw.chip_for(dev.device_kind)
+    assert bench._roofline(dev) == (chip.hbm_GBps, chip.ici_GBps)
+    # unknown kind: the CPU fallback, never a crash
+    assert bench._roofline(types.SimpleNamespace(device_kind="mystery")) \
+        == bench._CPU_FALLBACK

@@ -93,3 +93,12 @@ def test_ktree_arity8_sim_large():
     for h in out:
         np.testing.assert_allclose(h, np.sum(xs, axis=0), rtol=1e-5,
                                    atol=1e-5)
+
+
+def test_ktree_rejects_2d_mesh(devices):
+    # every explicit schedule rings a 1-D rank mesh; the 2-D policy error
+    # must be the clean ValueError, not a shape failure mid-trace
+    t = Transport(rt.slice_mesh(2, 4))
+    x = t.shard(np.zeros((2, 4, 8), np.float32))
+    with pytest.raises(ValueError, match="no 'ktree' schedule on a 2-D"):
+        t.allreduce(x, "ktree")
