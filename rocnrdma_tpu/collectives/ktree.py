@@ -109,8 +109,10 @@ def kary_tree_allreduce(x: jax.Array, axis_name: str,
     return finalize(h, op, n)
 
 
-def sim_kary_allreduce(xs: list, arity: int = 4) -> list:
-    """Pure-numpy oracle walking the same substep tables."""
+def sim_kary_allreduce(xs: list, arity: int = KTREE_ARITY) -> list:
+    """Pure-numpy oracle walking the same substep tables. The default arity
+    is the registry's (``KTREE_ARITY``) so the oracle validates the same
+    tree ``algo="ktree"`` runs unless a caller overrides it (ADVICE r2)."""
     n = len(xs)
     if n == 1:
         return [np.asarray(xs[0])]

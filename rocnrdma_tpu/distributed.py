@@ -202,6 +202,38 @@ class ProcessGroup:
                           np.asarray(counts), self.rank, self.world_size,
                           dtype=dtype)
 
+    def all_gather_v(self, x, counts) -> list:
+        """Ragged allgather (gloo/MPI ``allgatherv``): rank r contributes
+        ``counts[r]`` elements; every rank returns the n segments in rank
+        order. ``counts`` is the length-n vector every rank knows (the MPI
+        contract). Completes the ragged family next to
+        :meth:`all_to_all_v`."""
+        x = np.asarray(x)
+        counts = np.asarray(counts)
+        if self.world_size == 1:
+            # still routes validation through the plugin convention: one
+            # segment, counts[0] must match
+            return plugin.ring_allgatherv_over_net(
+                None, None, None, x, counts, 0, 1)
+        return self._ring(plugin.ring_allgatherv_over_net, x, counts,
+                          self.rank, self.world_size)
+
+    def reduce_scatter_v(self, x, counts, op: str = "sum") -> np.ndarray:
+        """Ragged reduce-scatter (MPI ``Reduce_scatter`` with recvcounts):
+        ``x`` is the concatenation of n chunks sized by ``counts`` (same
+        layout everywhere); rank r returns the reduction of every rank's
+        chunk r (op: sum/prod/max/min/avg)."""
+        x = np.asarray(x)
+        counts = np.asarray(counts)
+        wire_op = self._avg_wire_op(x, op, "reduce_scatter_v")
+        if self.world_size == 1:
+            out = plugin.ring_reduce_scatter_v_over_net(
+                None, None, None, x, counts, 0, 1, op=wire_op)
+        else:
+            out = self._ring(plugin.ring_reduce_scatter_v_over_net, x,
+                             counts, self.rank, self.world_size, op=wire_op)
+        return self._avg_finalize(out, x, op)
+
     def _avg_wire_op(self, x, op: str, verb: str) -> str:
         """Shared avg handling: validate the dtype, map avg to a sum on the
         wire (finalized by :meth:`_avg_finalize`), and reject unknown ops —

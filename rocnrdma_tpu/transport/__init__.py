@@ -27,6 +27,8 @@ from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     ring_allreduce_rdma,
     ring_reduce_scatter_rdma,
     ring_alltoallv_over_net,
+    ring_allgatherv_over_net,
+    ring_reduce_scatter_v_over_net,
     ring_gather_over_net,
     ring_reduce_over_net,
     ring_reduce_scatter_over_net,

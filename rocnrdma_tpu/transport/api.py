@@ -49,8 +49,8 @@ def _raise(msg: str):
 # algorithm did auto actually pick?" without a debugger.
 _DEBUG_LOG = os.environ.get("RNR_DEBUG", "") not in ("", "0")
 
-ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "dtree", "ktree",
-         "hierarchical", "pallas_ring", "bruck", "binomial")
+ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "khd", "dtree",
+         "ptree", "ktree", "hierarchical", "pallas_ring", "bruck", "binomial")
 
 # THE (op, algo) compatibility table — single source of truth, consumed by
 # Transport._build below and by the bench runner's algo filter. Each entry
@@ -70,8 +70,17 @@ SCHEDULES = {
             C.ring_allreduce(v, RANK_AXIS, bidir=True, op=op),
         "tree": lambda v, _, op="sum", root=0:
             C.hd_allreduce(v, RANK_AXIS, op=op),
+        # mixed-radix halving-doubling: ring-equal serialized bytes with a
+        # wide (radix)-operand fold per round — the tree-family member the
+        # cost model keeps at bandwidth sizes (collectives/khd.py)
+        "khd": lambda v, _, op="sum", root=0:
+            C.khd_allreduce(v, RANK_AXIS, op=op),
         "dtree": lambda v, _, op="sum", root=0:
             C.dbtree_allreduce(v, RANK_AXIS, op=op),
+        # chunk-pipelined double binary tree: C chunks stream through the
+        # tree, one 3-operand fold per pipeline beat (collectives/ptree.py)
+        "ptree": lambda v, _, op="sum", root=0:
+            C.ptree_allreduce(v, RANK_AXIS, op=op),
         # wide-fold k-ary tree (one fused (arity+1)-operand combine per
         # interior level; arity = ktree.KTREE_ARITY, shared with the tuner)
         "ktree": lambda v, _, op="sum", root=0:

@@ -1168,6 +1168,73 @@ def ring_alltoallv_over_net(net, send_comm, recv_comm, segments: list,
     return out
 
 
+def ring_allgatherv_over_net(net, send_comm, recv_comm, local: np.ndarray,
+                             counts, rank: int, n_ranks: int) -> list:
+    """Ragged allgather (the gloo/MPI ``allgatherv`` verb — VERDICT r2
+    item 8): rank r contributes ``counts[r]`` elements; every rank returns
+    the n segments in rank order. ``counts`` is the length-n per-rank
+    element-count vector, identical everywhere (the MPI contract — so only
+    actual bytes travel, no global-max padding).
+
+    Ring schedule, n-1 hops: at hop s each rank forwards the segment that
+    originated at ``rank - s + 1`` and receives origin ``rank - s`` (the
+    segment just received IS the next hop's send, so each segment travels
+    the ring once). Per-rank wire = sum(counts) - counts[rank] — the
+    allgather optimum, ragged or not."""
+    n = n_ranks
+    counts = np.asarray(counts, np.int64).ravel()
+    if counts.shape != (n,):
+        raise ValueError(f"counts must be length {n}, got {counts.shape}")
+    seg = np.ascontiguousarray(local).ravel()
+    if seg.size != counts[rank]:
+        raise ValueError(f"local has {seg.size} elements, "
+                         f"counts[{rank}] says {counts[rank]}")
+    out: list = [None] * n
+    out[rank] = seg.copy()
+    if n == 1:
+        return out
+    wire = _RingWire(net, send_comm, recv_comm)
+    isz = seg.dtype.itemsize
+    cur = _as_bytes(seg)
+    for s in range(1, n):
+        origin = (rank - s) % n
+        incoming = wire.exchange(cur, int(counts[origin]) * isz)
+        out[origin] = incoming.view(seg.dtype).copy()
+        cur = incoming  # forward the arrival on the next hop
+    return out
+
+
+def ring_reduce_scatter_v_over_net(net, send_comm, recv_comm,
+                                   local: np.ndarray, counts, rank: int,
+                                   n_ranks: int, op: str = "sum"
+                                   ) -> np.ndarray:
+    """Ragged reduce-scatter (MPI ``Reduce_scatter`` with recvcounts —
+    VERDICT r2 item 8): ``local`` is the concatenation of n ragged chunks
+    (chunk j holds ``counts[j]`` elements; same layout on every rank); rank
+    r returns the elementwise reduction of every rank's chunk r.
+
+    The ragged generalization of :func:`ring_reduce_scatter_over_net`:
+    identical n-1 ring steps (via ``_ring_reduce_phase`` with shift=-1, so
+    chunk r lands on rank r), with chunk bounds taken from ``counts``
+    instead of floor-balanced — wire bytes are exactly the non-own chunks,
+    as in the dense case."""
+    n = n_ranks
+    counts = np.asarray(counts, np.int64).ravel()
+    if counts.shape != (n,):
+        raise ValueError(f"counts must be length {n}, got {counts.shape}")
+    x = np.array(local, copy=True).ravel()
+    if x.size != int(counts.sum()):
+        raise ValueError(f"local has {x.size} elements, counts sum to "
+                         f"{int(counts.sum())}")
+    if n == 1:
+        return x
+    bounds = np.concatenate([[0], np.cumsum(counts)])
+    chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
+    wire = _RingWire(net, send_comm, recv_comm)
+    _ring_reduce_phase(wire, x, chunk, rank, n, shift=-1, op=op)
+    return np.array(chunk(rank), copy=True)
+
+
 def ring_alltoall_over_net(net, send_comm, recv_comm, local: np.ndarray,
                            rank: int, n_ranks: int) -> np.ndarray:
     """Shift alltoall over the verbs: ``local`` is ``(n, ...)`` — block d is

@@ -331,6 +331,49 @@ def test_bench_script_multichip_branch_with_failing_candidate(
     assert row["value"] > 0 and row["vs_baseline"] > 0
 
 
+def test_bench_script_multichip_pallas_hbm_interpret_rehearsal(
+        monkeypatch, capsys):
+    # VERDICT r2 item 4: the pallas_hbm candidate only joins bench.py's
+    # best-of on real multi-chip TPU (`not on_cpu`), so before this test it
+    # was the one candidate that had never executed anywhere. Force-include
+    # it on the CPU oracle (RNR_BENCH_PALLAS -> interpret-mode lowering) so
+    # its full operand-gen -> shard -> kernel path has run before
+    # multi-chip first contact. Size/tile: 64 KiB/rank with 8-row tiles —
+    # each ring chunk spans 2 tiles, so multi-tile streaming, the pad
+    # path, and slot recycling all execute inside bench.py's own chain
+    # harness. (The VERDICT's suggested 4 MiB/rank @ tile_rows=512 is not
+    # reachable on this oracle: the interpret emulator's cost scales with
+    # tile size — a single 512-row-tile call ran >9 min on the one-core
+    # container, while tile-size-independent kernel mechanics at 8-row
+    # tiles run in seconds; test_pallas_ring.py covers tile-shape
+    # generality separately.)
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_script_p", os.path.join(os.path.dirname(__file__), "..",
+                                       "bench.py"))
+    bench_script = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_script)
+
+    from rocnrdma_tpu import metrics as M
+
+    monkeypatch.setattr(M, "MiB", 8 * 1024)  # 8 "MiB"/rank -> 64 KiB/rank
+    monkeypatch.setenv("RNR_BENCH_PALLAS", "8")  # 8-row tiles (see above)
+
+    assert bench_script.main() == 0
+    out = capsys.readouterr()
+    # the candidate must have been TIMED (it appears in the winner line's
+    # per-candidate listing), not errored out of the best-of
+    assert "pallas_hbm failed" not in out.err
+    winner_line = next(l for l in out.err.splitlines()
+                       if l.startswith("# allreduce @"))
+    assert "pallas_hbm=" in winner_line
+    import json
+    row = json.loads(out.out.strip().splitlines()[-1])
+    assert row["value"] > 0 and row["vs_baseline"] > 0
+
+
 def test_bench_local_bfloat16_leg(tmp_path):
     # the C11 dtype axis on the combine kernels: bf16 halves bytes/elem
     from rocnrdma_tpu.bench import bench_local

