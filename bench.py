@@ -215,9 +215,11 @@ def main() -> int:
         algos = {
             "fused": lambda y: C.fused_allreduce(y, "rank"),
             "ring_bidir": lambda y: C.ring_allreduce(y, "rank", bidir=True),
-            # ring-equal serialized bytes in fewer steps; the cost model's
-            # explicit-schedule pick at bandwidth sizes (collectives/khd.py)
-            "khd": lambda y: C.khd_allreduce(y, "rank"),
+            # the cost model's explicit-schedule pick at bandwidth sizes
+            # (collectives/khd.py) — bidir=True because that IS the
+            # registered algo="khd" form; timing any other variant would
+            # publish an algo name for a schedule that never ran
+            "khd": lambda y: C.khd_allreduce(y, "rank", bidir=True),
         }
         import os as _os
         _pallas_env = _os.environ.get("RNR_BENCH_PALLAS", "")
@@ -236,7 +238,17 @@ def main() -> int:
             # buffer — the VMEM-resident kernel would fail to compile at
             # these sizes.
             from rocnrdma_tpu import ops as O
-            _tr = 512 if _pallas_env in ("", "1") else int(_pallas_env)
+            # a malformed env value must never abort the scored run on
+            # real hardware (where this block runs unconditionally): fall
+            # back to the production tile and say so
+            try:
+                _tr = int(_pallas_env) if _pallas_env not in ("", "1") else 512
+                if _tr < 1:
+                    raise ValueError(_pallas_env)
+            except ValueError:
+                print(f"# RNR_BENCH_PALLAS={_pallas_env!r} is not a "
+                      f"positive int; using tile_rows=512", file=sys.stderr)
+                _tr = 512
             algos["pallas_hbm"] = lambda y: O.pallas_hbm_ring_allreduce(
                 y, "rank", tile_rows=_tr)
 
@@ -378,7 +390,8 @@ def main() -> int:
                    ("ptree3", "xla3", 3, "ptree pipeline-beat fold "
                                          "(= dtree level fold)"),
                    ("khd8", "xla8", 8, "khd radix-8 round fold "
-                                       "(ring-equal wire bytes)"))
+                                       "(ring_bidir-equal wire; the "
+                                       "model's 1 GiB pick)"))
 
         def run_leg(nbytes):
             elems = nbytes // 4

@@ -141,13 +141,15 @@ def main(argv=None) -> int:
     # correctness gate before any timing (the suite's bench convention):
     # one shallow (k=2) chain of each kernel vs numpy ON A SLICE of the
     # operands — full-array comparison over the slice, so the gate covers
-    # every slice element (tile edges included) WITHOUT materializing
-    # full-size fp32 references on the host (~2 GiB at 256 MiB x 8
-    # operands for what used to be an element-0 check; ADVICE r2). The
-    # slice spans at least one pallas tile's worth of rows. bf16 chains
-    # are checked against the fp32 math at bf16 tolerance. After two
-    # iterations of y += b1..b_{n-1}, the result is x + 2*sum(b).
-    gate_elems = min(elems, 32768)
+    # every slice element WITHOUT materializing full-size fp32 references
+    # on the host (~2 GiB at 256 MiB x 8 operands for what used to be an
+    # element-0 check; ADVICE r2). The slice spans at least TWO pallas
+    # tiles at the configured --tile-rows, so the multi-tile streaming /
+    # slot-recycling path (and the tile-boundary bugs that live there)
+    # executes before any timing. bf16 chains are checked against the
+    # fp32 math at bf16 tolerance. After two iterations of
+    # y += b1..b_{n-1}, the result is x + 2*sum(b).
+    gate_elems = min(elems, max(32768, 2 * args.tile_rows * 128))
     x_gate = tuple(x[:gate_elems] for x in x0)
     f32 = [np.asarray(x, dtype=np.float32) for x in x_gate]
     refs = {n: f32[0] + 2 * sum(f32[1:n]) for n in range(2, need + 1)}

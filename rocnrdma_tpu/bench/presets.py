@@ -75,7 +75,7 @@ PRESETS = {
         name="tree64",
         baseline_config="64-rank tree allreduce + allgather, 1 GiB (single ICI slice)",
         n_ranks=64, mesh2d=None, sizes=(1 * GiB,), dtypes=("float32",),
-        algos=("tree", "dtree", "fused")),
+        algos=("tree", "khd", "dtree", "fused")),
     # BASELINE.json:11 — hierarchical over DCN; 2 x v5p-128 on hardware,
     # simulated as 2 "slices" of fake CPU devices on the oracle.
     "multislice": Preset(

@@ -108,6 +108,82 @@ def dtree_events(n: int, nbytes: int) -> list[Event]:
     return out
 
 
+def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
+               itemsize: int = 4) -> list[Event]:
+    """Mixed-radix halving-doubling (khd.py). One Event STEP per ppermute
+    in the exact order the jit program executes them, so ``align_steps``
+    maps a profiled ``algo="khd"`` run 1:1: the registered form is bidir —
+    for radix > 2 each (round, offset) substep is TWO permutes (first
+    half +o, second half -o); d=2 rounds and 1-element parts stay single.
+    ``itemsize``: the buffer's element width — khd.py's split gate counts
+    ELEMENTS (``part < 2``), so the byte-level gate here must agree or the
+    step counts diverge at 1-element parts.
+    """
+    digits = tuple(S.khd_digits(n)) if digits is None else tuple(digits)
+    out = []
+    step = 0
+    chunk = nbytes // n  # bytes of one 1/n-th chunk
+
+    def substep(t, d, o, frac, direction, tag):
+        nonlocal step
+        perm = S.khd_perm(n, digits, t, o)
+        for r, dst in perm:
+            out.append(Event(f"khd {tag} r{t} o{o}{direction}: "
+                             f"{frac} B -> rank {dst}", r, step, frac))
+        step += 1
+
+    P = 1
+    for t, d in enumerate(digits):          # reduce-scatter rounds
+        P *= d
+        part = (n // P) * chunk
+        split = bidir and d > 2 and part >= 2 * itemsize
+        for o in range(1, d):
+            if split:
+                substep(t, d, o, part // 2, "+", "rs")
+                substep(t, d, d - o, part - part // 2, "-", "rs")
+            else:
+                substep(t, d, o, part, "", "rs")
+    for t in range(len(digits) - 1, -1, -1):  # allgather rounds
+        d = digits[t]
+        part = (n // P) * chunk
+        split = bidir and d > 2 and part >= 2 * itemsize
+        for o in range(1, d):
+            if split:
+                substep(t, d, o, part // 2, "+", "ag")
+                substep(t, d, d - o, part - part // 2, "-", "ag")
+            else:
+                substep(t, d, o, part, "", "ag")
+        P //= d
+    return out
+
+
+def ptree_events(n: int, nbytes: int, chunks: int | None = None) -> list[Event]:
+    """Chunk-pipelined double tree (ptree.py). One Event STEP per ppermute
+    in jit execution order (tick -> tree -> side-substep), so a profiled
+    ``algo="ptree"`` run aligns 1:1; the pipeline structure — different
+    chunk indices in flight at different depths within one tick — is
+    visible in the event names."""
+    if chunks is None:
+        from rocnrdma_tpu.collectives.ptree import PTREE_CHUNKS
+        chunks = PTREE_CHUNKS
+    half = -(-nbytes // 2)
+    csize = -(-half // chunks)
+    trees = [S.ptree_ticks(p, chunks) for p in S.dbtree_parents(n)]
+    out = []
+    step = 0
+    n_ticks = len(trees[0][0])
+    for phase, tag in ((0, "up"), (1, "down")):
+        for t in range(n_ticks):
+            for ti in (0, 1):
+                for sub in trees[ti][phase][t]:
+                    for a, b, i in sub:
+                        out.append(Event(
+                            f"ptree{ti} {tag} tick {t}: chunk {i} "
+                            f"rank {a} -> {b}", a, step, csize))
+                    step += 1
+    return out
+
+
 def rotation_a2a_events(n: int, nbytes: int) -> list[Event]:
     chunk = nbytes // n
     out = []
@@ -208,7 +284,9 @@ _GENERATORS = {
     ("allreduce", "ring"): lambda n, b: ring_events(n, b),
     ("allreduce", "ring_bidir"): lambda n, b: ring_events(n, b, bidir=True),
     ("allreduce", "tree"): hd_events,
+    ("allreduce", "khd"): khd_events,
     ("allreduce", "dtree"): dtree_events,
+    ("allreduce", "ptree"): ptree_events,
     ("alltoall", "ring"): rotation_a2a_events,
     ("alltoall", "bruck"): bruck_a2a_events,
     ("broadcast", "binomial"): lambda n, b: binomial_events(n, b, "broadcast"),
@@ -322,6 +400,72 @@ def measured_to_chrome(lanes: list, pid: int = 1) -> list:
     return out
 
 
+# op-name substrings identifying the wire step proper (one per ppermute)
+_PERMUTE_HINTS = ("ppermute", "collective-permute")
+
+
+def align_steps(events: list[Event], lanes: list,
+                alpha: float = ALPHA_S, beta: float = BETA_S_PER_B) -> tuple:
+    """Map measured XProf ops onto schedule steps — the NPKit diff proper
+    (VERDICT r2 item 6): for every device lane whose permute-op count
+    equals the schedule's step count, the k-th ``ppermute``/
+    ``collective-permute`` event IS schedule step k (the compiled program
+    executes the explicit schedule's permutes in program order, one per
+    step). Returns ``(chrome_events, diff_rows)``:
+
+    - ``chrome_events``: a pid-2 "aligned" lane with one slice per step at
+      the MEASURED start/duration (max across ranks — the schedule's
+      barrier semantics), named with the schedule step's own name;
+    - ``diff_rows``: per step ``{step, name, predicted_us,
+      measured_max_us, measured_mean_us, lanes}`` — the predicted lane's
+      alpha-beta duration next to what the profiler recorded.
+
+    Lanes whose permute count differs from the step count are skipped (a
+    fused rewrite or a capture that caught extra programs would misalign);
+    if NO lane matches, returns ``([], [])`` and the caller reports it.
+    """
+    if not events or not lanes:
+        return [], []
+    n_steps = max(e.step for e in events) + 1
+    step_names = {}
+    for e in sorted(events, key=lambda e: (e.step, e.rank)):
+        step_names.setdefault(e.step, e.name)
+    per_lane = []
+    for label, evs in lanes:
+        pevs = [ev for ev in evs
+                if any(h in ev[0].lower() for h in _PERMUTE_HINTS)]
+        if len(pevs) == n_steps:
+            per_lane.append((label, pevs))
+    if not per_lane:
+        return [], []
+    diff = []
+    chrome = [{"name": "thread_name", "ph": "M", "pid": 2, "tid": 0,
+               "args": {"name": f"aligned steps ({len(per_lane)} lanes)"}}]
+    t0 = min(pevs[0][1] for _, pevs in per_lane)
+    for k in range(n_steps):
+        pred_us = max((_dur_s(e.nbytes, alpha, beta) for e in events
+                       if e.step == k), default=0.0) * 1e6
+        durs = [pevs[k][2] for _, pevs in per_lane]
+        start = min(pevs[k][1] for _, pevs in per_lane)
+        end = max(pevs[k][1] + pevs[k][2] for _, pevs in per_lane)
+        diff.append({
+            "step": k, "name": step_names.get(k, f"step {k}"),
+            "predicted_us": round(pred_us, 3),
+            "measured_max_us": round(max(durs) / 1e3, 3),
+            "measured_mean_us": round(sum(durs) / len(durs) / 1e3, 3),
+            "lanes": len(per_lane),
+        })
+        chrome.append({
+            "name": f"step {k}: {step_names.get(k, '?')}",
+            "ph": "X", "pid": 2, "tid": 0,
+            "ts": round((start - t0) / 1e3, 3),
+            "dur": round((end - start) / 1e3, 3),
+            "args": {"predicted_us": round(pred_us, 3),
+                     "measured_max_us": round(max(durs) / 1e3, 3)},
+        })
+    return chrome, diff
+
+
 def profile_collective(collective: str, algo: str, ranks: int,
                        nbytes: int, mesh2d, fake_devices, platform: str,
                        dtype: str = "float32") -> list:
@@ -388,6 +532,11 @@ def main(argv=None) -> int:
                    help="with --measured: parse this existing .xplane.pb "
                         "(e.g. from a bench --profile dir) instead of "
                         "running the collective")
+    p.add_argument("--align-steps", action="store_true",
+                   help="with --measured: map the capture's permute ops "
+                        "onto schedule steps (k-th permute = step k) and "
+                        "emit a pid-2 aligned lane + per-step "
+                        "predicted-vs-measured diff rows (the NPKit diff)")
     p.add_argument("--fake-devices", type=int, default=None,
                    help="with --measured: CPU-oracle backend size")
     p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
@@ -420,6 +569,23 @@ def main(argv=None) -> int:
         doc["otherData"]["measured_events"] = n_ev
         measured_note = (f"; measured lane: {n_ev} events across "
                          f"{len(lanes)} device lanes, {meas_us:.0f} us")
+        if args.align_steps:
+            aligned, diff = align_steps(events, lanes, args.alpha, args.beta)
+            if not diff:
+                raise SystemExit(
+                    "--align-steps: no device lane's permute count matches "
+                    "the schedule's step count (fused rewrite, or the "
+                    "capture caught extra programs) — cannot align")
+            doc["traceEvents"] += aligned
+            doc["otherData"]["step_diff"] = diff
+            tot_meas = sum(r["measured_max_us"] for r in diff)
+            tot_pred = sum(r["predicted_us"] for r in diff)
+            measured_note += (
+                f"; aligned {len(diff)} steps across {diff[0]['lanes']} "
+                f"lanes: predicted {tot_pred:.0f} us vs measured "
+                f"{tot_meas:.0f} us (x{tot_meas / max(tot_pred, 1e-9):.1f})")
+    elif args.align_steps:
+        raise SystemExit("--align-steps requires --measured")
 
     payload = json.dumps(doc)
     if args.out:

@@ -70,11 +70,13 @@ SCHEDULES = {
             C.ring_allreduce(v, RANK_AXIS, bidir=True, op=op),
         "tree": lambda v, _, op="sum", root=0:
             C.hd_allreduce(v, RANK_AXIS, op=op),
-        # mixed-radix halving-doubling: ring-equal serialized bytes with a
-        # wide (radix)-operand fold per round — the tree-family member the
-        # cost model keeps at bandwidth sizes (collectives/khd.py)
+        # mixed-radix halving-doubling: ring_bidir-equal wire bytes (the
+        # registered form runs bidir — halves ride opposite rotations on
+        # full-duplex links) with a wide (radix)-operand fold per round —
+        # the schedule the cost model keeps at bandwidth sizes
+        # (collectives/khd.py)
         "khd": lambda v, _, op="sum", root=0:
-            C.khd_allreduce(v, RANK_AXIS, op=op),
+            C.khd_allreduce(v, RANK_AXIS, op=op, bidir=True),
         "dtree": lambda v, _, op="sum", root=0:
             C.dbtree_allreduce(v, RANK_AXIS, op=op),
         # chunk-pipelined double binary tree: C chunks stream through the
@@ -231,12 +233,14 @@ class Transport:
             cands = [a for a in SCHEDULES[op]
                      if supports(op, a, self.is_2d)
                      and (plat == "tpu" or not a.startswith("pallas"))]
-            # TPU-calibrated alpha/beta when the chip kind is known
-            # (tuner.constants_for; per-verb — reducing verbs pay the HBM
-            # combine term), generic ratios otherwise
-            alpha, beta = constants_for(getattr(dev, "device_kind", ""), op)
+            # TPU-calibrated alpha/beta/hbm_beta when the chip kind is
+            # known (tuner.constants_for; the reducing verbs' combine
+            # traffic is priced per schedule fold width), generic
+            # ratios otherwise
+            alpha, beta, hbm_beta = constants_for(
+                getattr(dev, "device_kind", ""), op)
             picked = (model_pick(op, self.n_ranks, nbytes, candidates=cands,
-                                 alpha=alpha, beta=beta)
+                                 alpha=alpha, beta=beta, hbm_beta=hbm_beta)
                       if nbytes is not None else None)
             algo = picked or "auto"
         if algo not in ALGOS:

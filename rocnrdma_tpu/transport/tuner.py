@@ -52,26 +52,29 @@ _REDUCING_VERBS = frozenset({"allreduce", "reduce_scatter", "reduce"})
 
 
 def constants_for(device_kind: str, verb: str | None = None
-                  ) -> tuple[float, float]:
-    """(alpha, beta) calibrated for this chip, or the generic defaults.
+                  ) -> tuple[float, float, float]:
+    """(alpha, beta, hbm_beta) calibrated for this chip, or generics.
 
-    beta is the per-buffer-byte cost of one explicit-schedule wire step:
-    serialized per-link ICI time (aggregate/links from ``hw.CHIPS``), plus
-    — only for the reducing verbs, whose steps fold an accumulate — the
-    HBM combine cost: 3 bytes of HBM traffic per byte reduced at the
-    chip's ACHIEVABLE rate (public peak x ``hw.MEASURED_HBM_FRAC``, the
-    fraction bench.py measured on this repo's real v5e). Pure-movement
-    verbs (alltoall/allgather/broadcast/...) pay wire only.
-    """
+    beta is the serialized per-link ICI time per wire byte (aggregate/links
+    from ``hw.CHIPS``). hbm_beta is the HBM seconds per COMBINE byte at the
+    chip's achievable rate (public peak x ``hw.MEASURED_HBM_FRAC``, the
+    fraction bench.py measured on this repo's real v5e) — nonzero only for
+    the reducing verbs; how many combine bytes a schedule moves per buffer
+    byte is the SCHEDULE's property (``_MODEL``'s third element — a wide
+    fold reads k operands per write, so k-ary folds cost (k+2)/k per
+    arrival vs the pairwise 3; the fold-width term is exactly what the
+    single-chip headline measures, 2-op 665 vs 8-op 736 GB/s). Generic
+    (unknown-chip) constants keep hbm_beta = 0 — the ranking then rests on
+    steps and wire alone, as before r3."""
     from rocnrdma_tpu import hw
 
     chip = hw.chip_for(device_kind)
     if chip is None:
-        return ALPHA_S, BETA_S_PER_B
+        return ALPHA_S, BETA_S_PER_B, 0.0
     beta = 1.0 / (chip.ici_GBps / chip.ici_links * 1e9)
-    if verb in _REDUCING_VERBS:
-        beta += 3.0 / (chip.hbm_GBps * hw.MEASURED_HBM_FRAC * 1e9)
-    return hw.ICI_HOP_S + hw.MEASURED_DISPATCH_ALPHA_S, beta
+    hbm_beta = (1.0 / (chip.hbm_GBps * hw.MEASURED_HBM_FRAC * 1e9)
+                if verb in _REDUCING_VERBS else 0.0)
+    return hw.ICI_HOP_S + hw.MEASURED_DISPATCH_ALPHA_S, beta, hbm_beta
 
 
 def measure_alpha(size_bytes: int = 4096, k1: int = 32, k2: int = 512,
@@ -115,17 +118,25 @@ def _ktree_arity() -> int:
     return KTREE_ARITY
 
 
-# (steps, wire_bytes_factor) per (verb, algo): T = steps*alpha + factor*S*beta.
-# ``factor`` is the serialized bytes-on-the-critical-link per buffer byte —
+# (steps, wire_bytes_factor, hbm_bytes_factor) per (verb, algo):
+#   T = steps*alpha + wire*S*beta + hbm*S*hbm_beta.
+# ``wire`` is the serialized bytes-on-the-critical-link per buffer byte —
 # exactly the busbw accounting of metrics.py read backwards, for THE
 # SCHEDULES AS IMPLEMENTED: substeps execute in program order, so a factor
 # may not assume overlap the program does not express (VERDICT r2 item 2 —
 # the unpipelined trees were previously given the pipelined-tree factor of
 # 2.0, which made model_pick recommend them exactly where they are worst).
-# ``ring_bidir`` halves the beta term (two counter-rotating rings share the
-# load; links are full-duplex) at the same step count. Bruck trades (n-1)
-# steps for log2(n) steps moving S/2 each — the small-message alltoall of
-# the MPI literature.
+# The one sanctioned overlap assumption is FULL-DUPLEX links: ring_bidir
+# and bidir-khd split each payload across the two directions of the same
+# path, so their per-direction wire bytes halve at the same step count.
+# ``hbm`` is the serialized HBM traffic the schedule's combine passes cost
+# per buffer byte (reducing verbs only; a d-operand fused fold costs
+# (d+1)/(d-1) HBM bytes per arriving byte vs the pairwise 3 — fold width
+# is a schedule property, so it lives here, and the gated SPMD trees bill
+# EVERY rank for every level's fold because every rank executes the
+# where-gated combine). Bruck trades (n-1) steps for log2(n)
+# steps moving S/2 each — the small-message alltoall of the MPI
+# literature.
 
 
 def _khd_digits(n: int):
@@ -137,75 +148,124 @@ def _khd_steps(n: int) -> int:
     return 2 * sum(d - 1 for d in _khd_digits(n))
 
 
-def _ptree_cost(n: int) -> tuple[int, float]:
+def _khd_wire(n: int) -> float:
+    # per-direction serialized bytes of the REGISTERED (bidir) khd, per
+    # buffer byte: rounds with d > 2 split each part across the two
+    # directions (half per direction); d = 2 rounds CANNOT halve — the one
+    # partner exchange already uses both directions at the full part (the
+    # as-implemented rule: no unexpressed overlap). Factorizations with no
+    # 2-digit reduce exactly to ring_bidir's (n-1)/n; a trailing 2 digit
+    # costs its full part per direction.
+    P, total = 1, 0.0
+    for d in _khd_digits(n):
+        P *= d
+        total += (d - 1) / P * (0.5 if d > 2 else 1.0)
+    return 2 * total
+
+
+def _khd_hbm(n: int) -> float:
+    # RS round t folds the kept part (S/prod(d_0..d_t)) in one
+    # (d_t)-operand pass: d_t reads + 1 write = (d_t+1) HBM bytes per part
+    # byte; no gating waste (full permutations). AG adoption ignored, as
+    # for every schedule (pure copies, identically shaped across schedules).
+    P, total = 1, 0.0
+    for d in _khd_digits(n):
+        P *= d
+        total += (d + 1) / P
+    return total
+
+
+def _ptree_cost(n: int) -> tuple[int, float, float]:
     # C chunks stream through both trees: per phase C+D-1 ticks x up to 4
     # substeps (2 sides x 2 trees) x S/(2C) each, two phases — serialized
     # bytes 4S(C+D-1)/C (ptree.py's own accounting; the async-overlap ideal
-    # of 2S is NOT assumed, matching the as-implemented rule above)
+    # of 2S is NOT assumed, matching the as-implemented rule above). HBM:
+    # every rank executes every tick's gated 3-operand fold over one chunk
+    # (4 HBM bytes/elem x S/(2C) x 2 trees x (C+D-1) ticks).
     from rocnrdma_tpu.collectives.ptree import PTREE_CHUNKS
     c = PTREE_CHUNKS
     ticks = c + _L(n) - 1
-    return 8 * ticks, 4.0 * ticks / c
+    return 8 * ticks, 4.0 * ticks / c, 4.0 * ticks / c
+
+
+def _ktree_terms(n: int) -> tuple[int, float, float]:
+    k = _ktree_arity()
+    levels = max(1, math.ceil(math.log(n, k)))
+    # up to k child substeps/level x 2 phases; each up level ingests k
+    # whole buffers serialized; each level's gated (k+1)-operand fold costs
+    # (k+2) HBM bytes/elem on EVERY rank (where-gated SPMD)
+    return 2 * k * levels, 2.0 * k * levels, (k + 2.0) * levels
 
 
 _MODEL = {
-    ("allreduce", "ring"): lambda n: (2 * (n - 1), 2 * (n - 1) / n),
-    ("allreduce", "ring_bidir"): lambda n: (2 * (n - 1), (n - 1) / n),
-    ("allreduce", "tree"): lambda n: (2 * _L(n), 2 * (n - 1) / n),
-    # mixed-radix halving-doubling: ring-equal serialized bytes (full
-    # permutations whose sizes sum to 2(n-1)/n exactly; khd.py) in
-    # 2*sum(d_t - 1) steps — strictly dominates ring in this model, which
-    # is the point: the wide-fold schedule an honest tuner keeps at
-    # bandwidth sizes
-    ("allreduce", "khd"): lambda n: (_khd_steps(n), 2 * (n - 1) / n),
+    ("allreduce", "ring"): lambda n: (
+        2 * (n - 1), 2 * (n - 1) / n, 3 * (n - 1) / n),
+    # full-duplex: wire halves, combine traffic doesn't (HBM is one
+    # resource regardless of direction)
+    ("allreduce", "ring_bidir"): lambda n: (
+        2 * (n - 1), (n - 1) / n, 3 * (n - 1) / n),
+    ("allreduce", "tree"): lambda n: (
+        2 * _L(n), 2 * (n - 1) / n, 3 * (n - 1) / n),
+    # mixed-radix halving-doubling, registered form = bidir (khd.py):
+    # ring_bidir-equal per-direction wire bytes when every digit exceeds 2
+    # (_khd_wire prices the d=2 rounds that cannot halve), in
+    # 2*sum(d_t - 1) steps, and the cheapest combine traffic of any
+    # schedule here — the wide fused fold reads d operands per write. This
+    # row is WHY the single-chip headline scores the khd8 kernel: at
+    # bandwidth sizes the model's pick among the explicit schedules is
+    # khd, and this fold is what it runs.
+    ("allreduce", "khd"): lambda n: (
+        _khd_steps(n), _khd_wire(n), _khd_hbm(n)),
     # double binary tree AS IMPLEMENTED (level-synchronous, dtree.py): each
     # level's substeps move the whole half-buffer and levels serialize —
     # ~2 substeps/level x D levels x 2 phases x 2 trees x S/2 = 2*D*S
-    # serialized. Latency-only role; model_pick must never keep it at
-    # bandwidth sizes (test_tuner guards this).
-    ("allreduce", "dtree"): lambda n: (8 * _L(n), 2.0 * _L(n)),
-    # k-ary tree AS IMPLEMENTED (ktree.py): an interior level ingests up to
-    # k whole buffers serialized (k substeps x S), x ceil(log_k n) levels
-    # x 2 phases. The wide fold is real; the wire cost is arity-scaled —
-    # which is why khd above exists.
-    ("allreduce", "ktree"): lambda n: (
-        2 * _ktree_arity() * max(1, math.ceil(
-            math.log(n, _ktree_arity()))),
-        2.0 * _ktree_arity() * max(1, math.ceil(
-            math.log(n, _ktree_arity())))),
+    # serialized; every rank executes every level's gated 3-op fold
+    # (4 HBM bytes/elem x S/2 x D x 2 trees). Latency-only role;
+    # model_pick must never keep it at bandwidth sizes (test_tuner guards).
+    ("allreduce", "dtree"): lambda n: (
+        8 * _L(n), 2.0 * _L(n), 4.0 * _L(n)),
+    # k-ary tree AS IMPLEMENTED (ktree.py): arity-scaled serialized
+    # ingress. The wide fold is real; the wire cost is why khd exists.
+    ("allreduce", "ktree"): lambda n: _ktree_terms(n),
     # chunk-pipelined double tree (ptree.py): the serialized bound of its
     # own docstring — 4S(C+D-1)/C total, approaching 4S for C >> D (2S if
     # the backend overlaps a tick's independent permutes; not assumed)
     ("allreduce", "ptree"): lambda n: _ptree_cost(n),
-    ("allreduce", "pallas_ring"): lambda n: (2 * (n - 1), 2 * (n - 1) / n),
-    ("reduce_scatter", "ring"): lambda n: (n - 1, (n - 1) / n),
-    ("reduce_scatter", "pallas_ring"): lambda n: (n - 1, (n - 1) / n),
-    ("allgather", "ring"): lambda n: (n - 1, (n - 1) / n),
-    ("allgather", "pallas_ring"): lambda n: (n - 1, (n - 1) / n),
-    ("alltoall", "ring"): lambda n: (n - 1, (n - 1) / n),   # rotation
-    ("alltoall", "bruck"): lambda n: (_L(n), _L(n) / 2),
+    ("allreduce", "pallas_ring"): lambda n: (
+        2 * (n - 1), 2 * (n - 1) / n, 3 * (n - 1) / n),
+    ("reduce_scatter", "ring"): lambda n: (
+        n - 1, (n - 1) / n, 3 * (n - 1) / n),
+    ("reduce_scatter", "pallas_ring"): lambda n: (
+        n - 1, (n - 1) / n, 3 * (n - 1) / n),
+    ("allgather", "ring"): lambda n: (n - 1, (n - 1) / n, 0.0),
+    ("allgather", "pallas_ring"): lambda n: (n - 1, (n - 1) / n, 0.0),
+    ("alltoall", "ring"): lambda n: (n - 1, (n - 1) / n, 0.0),  # rotation
+    ("alltoall", "bruck"): lambda n: (_L(n), _L(n) / 2, 0.0),
     # direct one-sided writes, all n-1 DMAs concurrent: one latency step,
     # the alltoall bandwidth factor
-    ("alltoall", "pallas_ring"): lambda n: (1, (n - 1) / n),
-    ("broadcast", "binomial"): lambda n: (_L(n), _L(n)),
-    ("reduce", "binomial"): lambda n: (_L(n), _L(n)),
-    ("gather", "binomial"): lambda n: (_L(n), (n - 1) / n),
-    ("scatter", "binomial"): lambda n: (_L(n), (n - 1) / n),
-    ("sendrecv", "fused"): lambda n: (1, 1.0),
+    ("alltoall", "pallas_ring"): lambda n: (1, (n - 1) / n, 0.0),
+    ("broadcast", "binomial"): lambda n: (_L(n), _L(n), 0.0),
+    # every rank executes each level's gated pairwise fold over S
+    ("reduce", "binomial"): lambda n: (_L(n), _L(n), 3.0 * _L(n)),
+    ("gather", "binomial"): lambda n: (_L(n), (n - 1) / n, 0.0),
+    ("scatter", "binomial"): lambda n: (_L(n), (n - 1) / n, 0.0),
+    ("sendrecv", "fused"): lambda n: (1, 1.0, 0.0),
 }
 
 
 def model_time(verb: str, algo: str, n: int, nbytes: int,
-               alpha: float = ALPHA_S, beta: float = BETA_S_PER_B) -> float:
+               alpha: float = ALPHA_S, beta: float = BETA_S_PER_B,
+               hbm_beta: float = 0.0) -> float:
     """Predicted seconds for ``algo`` moving an ``nbytes`` buffer over ``n``
     ranks. Raises KeyError for pairs the model does not cover (fused XLA
     lowerings are measured, not modeled — XLA's internal schedule is opaque)."""
-    steps, factor = _MODEL[(verb, algo)](n)
-    return steps * alpha + factor * nbytes * beta
+    steps, wire, hbm = _MODEL[(verb, algo)](n)
+    return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
 
 
 def model_pick(verb: str, n: int, nbytes: int, candidates=None,
-               alpha: float = ALPHA_S, beta: float = BETA_S_PER_B) -> str | None:
+               alpha: float = ALPHA_S, beta: float = BETA_S_PER_B,
+               hbm_beta: float = 0.0) -> str | None:
     """Cheapest modeled algorithm for this point, or None if none modeled.
 
     Ties break EXPLICITLY toward the non-pallas schedule (several pallas
@@ -216,7 +276,7 @@ def model_pick(verb: str, n: int, nbytes: int, candidates=None,
     for (v, algo), _ in _MODEL.items():
         if v != verb or (candidates is not None and algo not in candidates):
             continue
-        key = (model_time(verb, algo, n, nbytes, alpha, beta),
+        key = (model_time(verb, algo, n, nbytes, alpha, beta, hbm_beta),
                algo.startswith("pallas"))
         if key < best_key:
             best, best_key = algo, key
@@ -395,20 +455,22 @@ def model_table(device_kind: str, rank_counts, verbs, sizes,
     })
     for n in sorted(rank_counts):
         for verb in verbs:
-            alpha, beta = constants_for(device_kind, verb)
-            table.meta[f"alpha_beta[{verb}]"] = [alpha, beta]
+            alpha, beta, hbm_beta = constants_for(device_kind, verb)
+            table.meta[f"alpha_beta[{verb}]"] = [alpha, beta, hbm_beta]
             cands = [a for a in SCHEDULES.get(verb, ())
                      if supports(verb, a, False) and (verb, a) in _MODEL]
             if not cands:
                 continue
             buckets = []
             for size in sorted(sizes):
-                times = {a: model_time(verb, a, n, size, alpha, beta)
+                times = {a: model_time(verb, a, n, size, alpha, beta,
+                                       hbm_beta)
                          for a in cands}
                 shape = _FUSED_SHAPE.get(verb)
                 if shape and "fused" in SCHEDULES[verb]:
-                    steps, wire = _MODEL[(verb, shape)](n)
-                    times["fused"] = steps * alpha / 2 + wire * size * beta
+                    steps, wire, hbm = _MODEL[(verb, shape)](n)
+                    times["fused"] = (steps * alpha / 2 + wire * size * beta
+                                      + hbm * size * hbm_beta)
                 best = min(times, key=lambda a: (times[a], a != "fused"))
                 buckets.append(Bucket(size, best))
             table.set_buckets(verb, n, 1, platform, _coalesce(buckets))

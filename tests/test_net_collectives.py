@@ -171,7 +171,8 @@ def test_reduce_over_net(net_cls, n, root):
     from rocnrdma_tpu.transport.plugin import ring_reduce_over_net
     rng = np.random.default_rng(11)
     # multi-chunk on the shm plane: > MAX_FRAME bytes forces pipelining
-    xs = [rng.standard_normal(50000).astype(np.float32) for _ in range(n)]
+    # (150k floats = 600 KB > the r3 512 KiB frame)
+    xs = [rng.standard_normal(150000).astype(np.float32) for _ in range(n)]
     res = _run_ring(net_cls, n, lambda net, s, r, rank:
                     ring_reduce_over_net(net, s, r, xs[rank], rank, n,
                                          root=root))

@@ -41,10 +41,11 @@ def test_model_unpipelined_trees_never_picked_at_bandwidth():
     # constants model_pick must never keep them above the latency
     # crossover. Sweep sizes from 256 KiB up at contract-ish rank counts.
     from rocnrdma_tpu.transport.tuner import constants_for
-    alpha, beta = constants_for("TPU v5 lite", "allreduce")
+    alpha, beta, hbm_beta = constants_for("TPU v5 lite", "allreduce")
     for n in (8, 16, 64, 256):
         for size in (256 * M.KiB, M.MiB, 16 * M.MiB, 256 * M.MiB, M.GiB):
-            pick = model_pick("allreduce", n, size, alpha=alpha, beta=beta)
+            pick = model_pick("allreduce", n, size, alpha=alpha, beta=beta,
+                              hbm_beta=hbm_beta)
             assert pick not in ("dtree", "ktree"), (n, size, pick)
 
 
@@ -64,18 +65,45 @@ def test_model_unpipelined_tree_factors_are_depth_scaled():
 
 
 def test_model_khd_ring_equal_bytes_fewer_steps():
-    # khd's serialized bytes equal the ring's exactly; its step count is
-    # sum(d_t - 1) per phase — so it dominates ring everywhere in the model
-    # and is the honest bandwidth-size pick among the explicit schedules
+    # the registered khd is bidir: per-direction wire bytes equal
+    # ring_bidir's exactly (the same full-duplex split), in sum(d_t - 1)
+    # steps per phase instead of n-1 — so it dominates the ring family
+    # everywhere in the model and is the honest bandwidth-size pick among
+    # the explicit schedules
+    from rocnrdma_tpu.collectives.schedule import khd_digits
     from rocnrdma_tpu.transport.tuner import _MODEL
     for n in (8, 16, 64, 256):
-        ring_steps, ring_bytes = _MODEL[("allreduce", "ring")](n)
-        khd_steps, khd_bytes = _MODEL[("allreduce", "khd")](n)
-        assert khd_bytes == ring_bytes
-        assert khd_steps <= ring_steps
+        rb_steps, rb_bytes, rb_hbm = _MODEL[("allreduce", "ring_bidir")](n)
+        khd_steps, khd_bytes, khd_hbm = _MODEL[("allreduce", "khd")](n)
+        if all(d > 2 for d in khd_digits(n)):
+            # every round splits across both directions: exactly bidir-ring
+            assert khd_bytes == pytest.approx(rb_bytes)
+        else:
+            # a d=2 round cannot halve (the pair exchange already uses both
+            # directions at full part) — the model must charge it honestly:
+            # n=16 = (8,2) costs 2*(7/16 + 1/16) = 1.0 vs ring_bidir 0.9375
+            assert rb_bytes < khd_bytes <= 2 * (n - 1) / n
+        assert khd_steps <= rb_steps
+        assert khd_hbm < rb_hbm  # the wide fold's combine saving
     assert model_pick("allreduce", 64, M.GiB,
                       candidates=("ring", "khd", "dtree", "ktree",
                                   "ptree")) == "khd"
+
+
+def test_model_khd_is_the_bandwidth_pick_with_chip_constants():
+    # the full circle the r2 verdict demanded: with the fold-width-aware
+    # chip constants, the model's pick among ALL explicit allreduce
+    # schedules at the contract size is khd — so the khd8 kernel bench.py
+    # scores is the fold the model-recommended schedule actually runs
+    from rocnrdma_tpu.transport.tuner import constants_for
+    alpha, beta, hbm_beta = constants_for("TPU v5 lite", "allreduce")
+    for n in (8, 64, 256):
+        pick = model_pick(
+            "allreduce", n, M.GiB,
+            candidates=("ring", "ring_bidir", "tree", "khd", "dtree",
+                        "ktree", "ptree"),
+            alpha=alpha, beta=beta, hbm_beta=hbm_beta)
+        assert pick == "khd", (n, pick)
 
 
 def test_model_trees_win_latency_sizes():
@@ -93,7 +121,7 @@ def test_constants_for_alpha_is_calibrated_sum():
     # chip (hw.py documents the five-run derivation)
     from rocnrdma_tpu import hw
     from rocnrdma_tpu.transport.tuner import constants_for
-    alpha, _ = constants_for("TPU v5 lite", "allreduce")
+    alpha, _, _hb = constants_for("TPU v5 lite", "allreduce")
     assert alpha == hw.ICI_HOP_S + hw.MEASURED_DISPATCH_ALPHA_S
     assert 0 < hw.MEASURED_DISPATCH_ALPHA_S < 2e-7  # ns-scale, not the old guess
 
@@ -260,23 +288,26 @@ def test_autotune_2d_mesh_candidates():
 def test_constants_for_tpu_calibration():
     from rocnrdma_tpu.transport.tuner import (ALPHA_S, BETA_S_PER_B,
                                               constants_for)
-    a, b = constants_for("TPU v5 lite", "allreduce")
-    # beta = per-link wire time + measured HBM combine time (3 bytes of
-    # HBM traffic per byte reduced, at the chip's ACHIEVABLE rate: public
-    # peak x the fraction bench.py measured on this repo's v5e)
-    # alpha = public hop + measured dispatch (r3 calibration; see
-    # test_constants_for_alpha_is_calibrated_sum)
+    a, b, hb = constants_for("TPU v5 lite", "allreduce")
+    # beta = per-link wire time; hbm_beta = measured achievable HBM rate
+    # (public peak x the fraction bench.py measured on this repo's v5e) —
+    # how many combine bytes a schedule costs is the _MODEL row's third
+    # element (fold-width-aware, r3). alpha = public hop + measured
+    # dispatch (see test_constants_for_alpha_is_calibrated_sum).
     assert a == pytest.approx(1.032e-6)
-    assert b == pytest.approx(1 / 100e9 + 3 / 670e9)
+    assert b == pytest.approx(1 / 100e9)
+    assert hb == pytest.approx(1 / 670e9)
     # pure-movement verbs fold no combine: wire term only
-    _, b_move = constants_for("TPU v5 lite", "alltoall")
+    _, b_move, hb_move = constants_for("TPU v5 lite", "alltoall")
     assert b_move == pytest.approx(1 / 100e9)
-    # other chips scale the combine term by THEIR hbm, same measured frac
-    _, b_v5p = constants_for("TPU v5p", "allreduce")
-    assert b_v5p == pytest.approx(1 / 200e9 + 3 / (2765 * 670 / 819) / 1e9)
-    # unknown chips keep the generic ratio constants
-    assert constants_for("warp drive") == (ALPHA_S, BETA_S_PER_B)
-    assert constants_for("") == (ALPHA_S, BETA_S_PER_B)
+    assert hb_move == 0.0
+    # other chips scale the combine rate by THEIR hbm, same measured frac
+    _, b_v5p, hb_v5p = constants_for("TPU v5p", "allreduce")
+    assert b_v5p == pytest.approx(1 / 200e9)
+    assert hb_v5p == pytest.approx(1 / (2765 * 670 / 819) / 1e9)
+    # unknown chips keep the generic ratio constants (hbm term off)
+    assert constants_for("warp drive") == (ALPHA_S, BETA_S_PER_B, 0.0)
+    assert constants_for("") == (ALPHA_S, BETA_S_PER_B, 0.0)
 
 
 def test_model_table_generation_and_provenance():
@@ -284,12 +315,15 @@ def test_model_table_generation_and_provenance():
     t = model_table("v5 lite", [8, 64], ["allreduce", "alltoall"],
                     [4096, 2**30])
     # fused is modeled as the bandwidth-optimal shape at half-alpha hops
-    # (one compiled program), NOT as a log-depth schedule — so the
-    # latency-bound corner goes to the explicit tree and the
-    # bandwidth-bound bulk to fused, the RCCL-table shape
+    # (one compiled program), NOT as a log-depth schedule — the
+    # latency-bound corner goes to the explicit tree. The bandwidth bulk
+    # goes to khd (r3, fold-width-aware combine term): its per-direction
+    # wire bytes match fused's ring_bidir shape while its wide fused fold
+    # costs (d+1)/P_t HBM bytes per round instead of the pairwise 3 per
+    # arrival — cheaper combine at equal wire beats fused's half-alpha.
     assert t.lookup("allreduce", 4096, 8, 1, "tpu") == "tree"
-    assert t.lookup("allreduce", 2**30, 8, 1, "tpu") == "fused"
-    assert t.lookup("allreduce", 2**30, 64, 1, "tpu") == "fused"
+    assert t.lookup("allreduce", 2**30, 8, 1, "tpu") == "khd"
+    assert t.lookup("allreduce", 2**30, 64, 1, "tpu") == "khd"
     # alltoall's fused model is the direct fabric exchange: one hop,
     # wire-optimal — nothing explicit beats it at any size
     assert t.lookup("alltoall", 4096, 8, 1, "tpu") == "fused"
@@ -319,8 +353,9 @@ def test_tuning_v5e_artifact_loads_and_consults(tmp_path):
     t = TuningTable.load(path)
     assert t.meta["device_kind"] == "v5 lite"
     # the entries key the tpu platform: on real-TPU first contact a
-    # Transport(tuning=this) resolves auto from these rows...
-    assert t.lookup("allreduce", 256 * 2**20, 8, 1, "tpu") == "fused"
+    # Transport(tuning=this) resolves auto from these rows... (r3: the
+    # fold-width-aware model hands the bandwidth bucket to khd)
+    assert t.lookup("allreduce", 256 * 2**20, 8, 1, "tpu") == "khd"
     # ...and on the CPU oracle the platform key does NOT match, so auto
     # keeps the static policy instead of trusting tpu-calibrated picks
     assert t.lookup("allreduce", 256 * 2**20, 8, 1, "cpu") is None
