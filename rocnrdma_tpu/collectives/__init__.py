@@ -34,7 +34,11 @@ from rocnrdma_tpu.collectives.ring import (  # noqa: F401
     ring_reduce_scatter,
 )
 from rocnrdma_tpu.collectives.tree import hd_allreduce  # noqa: F401
-from rocnrdma_tpu.collectives.khd import khd_allreduce  # noqa: F401
+from rocnrdma_tpu.collectives.khd import (  # noqa: F401
+    khd_allgather,
+    khd_allreduce,
+    khd_reduce_scatter,
+)
 from rocnrdma_tpu.collectives.dtree import dbtree_allreduce  # noqa: F401
 from rocnrdma_tpu.collectives.ptree import ptree_allreduce  # noqa: F401
 from rocnrdma_tpu.collectives.ktree import (  # noqa: F401

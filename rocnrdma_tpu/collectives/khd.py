@@ -13,8 +13,13 @@ pass. Serialized bytes per phase are sum_t (d_t-1) * S/prod(d_0..d_t) =
 S(1 - 1/n) — equal to the unidirectional ring with no pipelining or
 overlap assumption — in sum(d_t - 1) steps per phase instead of n-1; the
 ``bidir=True`` form (the registered algo) additionally splits each part
-across the two directions of each path, matching ring_bidir's
-per-direction (n-1)/n under the same full-duplex-links assumption. At
+across the two directions of each path where that is REAL — the
+self-inverse offset o = d/2 cannot split (+o and -o are the same
+permutation; see ``_split_offset``) — reaching ring_bidir's per-direction
+(n-1)/n exactly for all-odd-radix factorizations and paying the o = d/2
+full part otherwise (1.125 vs 0.984 at n=64; the tuner prices this,
+``tuner._khd_wire``). khd's winning margin at bandwidth sizes is the HBM
+fold term, not a wire discount. At
 radix 8 the first round's fold is an 8-operand combine costing
 (d+1)/(d-1) HBM bytes per arriving byte vs the pairwise 3 — the wide
 kernel the single-chip headline (bench.py) scores is the fold THIS
@@ -65,66 +70,39 @@ def khd_allreduce(x: jax.Array, axis_name: str, op: str = "sum",
     n = lax.axis_size(axis_name)
     if n == 1:
         return finalize(x, op, 1)
-    if digits is None:
-        digits = khd_digits(n, max_radix)
-    else:
-        digits = tuple(int(d) for d in digits)
-    prod = 1
-    for d in digits:
-        prod *= d
-    if prod != n:
-        raise ValueError(f"digits {digits} multiply to {prod}, axis has {n}")
-    combine = combine_fn(op)
+    shape, size = x.shape, x.size
+    # Reduce-scatter rounds (shared with khd_reduce_scatter): all starts
+    # are in ELEMENTS; slice lengths static per round; the bidir branch
+    # ships each part's halves along opposite rotations (see _khd_rs_phase
+    # for the routing derivation).
+    buf, seg_start, chunk, digits = _khd_rs_phase(
+        x, axis_name, op, digits, max_radix, bidir)
+    buf = _khd_ag_phase(buf, seg_start, chunk, digits, axis_name, bidir)
+    return finalize(buf[:size].reshape(shape), op, n)
+
+
+def _split_offset(bidir: bool, d: int, part: int, o: int) -> bool:
+    """Does substep ``o`` of a radix-``d`` round split across the two
+    rotations? Not when: unidirectional; d = 2 (the pair exchange is
+    symmetric already); a 1-element part; or ``o = d/2`` — the +o and -o
+    rotations are the SAME permutation there (self-inverse), so a "split"
+    would ship both halves one way at two dispatches for nothing. The
+    cost model (tuner._khd_wire/_khd_steps) and the trace generator
+    (trace.khd_events) mirror this predicate exactly."""
+    return bidir and d > 2 and part >= 2 and 2 * o != d
+
+
+def _khd_ag_phase(buf, seg_start, chunk, digits, axis_name: str,
+                  bidir: bool):
+    """The shared allgather rounds (reversed): each rank sends its
+    current reduced part to every group member and stores theirs — used
+    by both khd_allreduce and khd_allgather so the routing can never
+    desynchronize between the two."""
+    n = lax.axis_size(axis_name)
     strides = khd_strides(digits)
     r = lax.axis_index(axis_name)
-
-    shape, size = x.shape, x.size
-    chunk = -(-size // n)  # element count of one 1/n-th chunk
-    buf = jnp.pad(x.reshape(-1), (0, n * chunk - size))
-
-    # traced per-rank digits (static strides/radices, so this is a handful
-    # of integer ops, not a gather)
     dig = [(r // s) % d for s, d in zip(strides, digits)]
-
-    # Reduce-scatter rounds. All starts are in ELEMENTS (chunk units x chunk);
-    # slice lengths are static per round.
-    seg_start = jnp.zeros((), jnp.int32)
-    P = 1
-    for t, d in enumerate(digits):
-        P *= d
-        part = (n // P) * chunk
-        h1 = part // 2  # bidir split point (h2 = part - h1)
-        keep_start = seg_start + dig[t] * part
-        stashes = []
-        for o in range(1, d):
-            if not bidir or d == 2 or part < 2:
-                send_start = seg_start + ((dig[t] + o) % d) * part
-                sent = lax.dynamic_slice_in_dim(buf, send_start, part)
-                stashes.append(lax.ppermute(sent, axis_name,
-                                            perm=khd_perm(n, digits, t, o)))
-            else:
-                # first half of partner(+o)'s kept part rides +o; second
-                # half of partner(-o)'s kept part rides -o. Receiver r gets
-                # its own kept part's first half from -o and second half
-                # from +o — reassembled below into one full-part stash.
-                fwd_start = seg_start + ((dig[t] + o) % d) * part
-                bwd_start = seg_start + ((dig[t] - o) % d) * part
-                first = lax.dynamic_slice_in_dim(buf, fwd_start, h1)
-                second = lax.dynamic_slice_in_dim(buf, bwd_start + h1,
-                                                  part - h1)
-                got_first = lax.ppermute(first, axis_name,
-                                         perm=khd_perm(n, digits, t, o))
-                got_second = lax.ppermute(second, axis_name,
-                                          perm=khd_perm(n, digits, t, d - o))
-                stashes.append(jnp.concatenate([got_first, got_second]))
-        kept = lax.dynamic_slice_in_dim(buf, keep_start, part)
-        for s in stashes:  # fused by XLA into ONE (d)-operand pass
-            kept = combine(kept, s)
-        buf = lax.dynamic_update_slice_in_dim(buf, kept, keep_start, axis=0)
-        seg_start = keep_start
-
-    # Allgather rounds, reversed: send my reduced part to every group
-    # member, store theirs into their slots.
+    P = n
     for t in range(len(digits) - 1, -1, -1):
         d = digits[t]
         part = (n // P) * chunk
@@ -132,7 +110,7 @@ def khd_allreduce(x: jax.Array, axis_name: str, op: str = "sum",
         base = seg_start - dig[t] * part
         mine = lax.dynamic_slice_in_dim(buf, seg_start, part)
         for o in range(1, d):
-            if not bidir or d == 2 or part < 2:
+            if not _split_offset(bidir, d, part, o):
                 recvd = lax.ppermute(mine, axis_name,
                                      perm=khd_perm(n, digits, t, o))
                 recv_start = base + ((dig[t] - o) % d) * part
@@ -155,5 +133,114 @@ def khd_allreduce(x: jax.Array, axis_name: str, op: str = "sum",
                                                       second_start, axis=0)
         seg_start = base
         P //= d
+    return buf
 
-    return finalize(buf[:size].reshape(shape), op, n)
+
+def khd_reduce_scatter(x: jax.Array, axis_name: str, op: str = "sum",
+                       digits=None, max_radix: int = 8,
+                       bidir: bool = True) -> jax.Array:
+    """Mixed-radix reduce-scatter — the RS phase of :func:`khd_allreduce`
+    standalone: sum(d_t - 1) rounds of full-permutation exchanges with a
+    (d_t)-operand fused fold each, after which rank r owns the fully
+    reduced chunk r (the mixed-radix segment start sum(dig_t * stride_t)
+    IS r, so the standard reduce-scatter layout falls out of the digit
+    arithmetic). Input ``(n*c,)`` per rank; returns the ``(c,)`` chunk.
+    Wire bytes: (1 - 1/n) * S, the ring RS optimum, in sum(d_t - 1) steps
+    instead of n-1; ``bidir`` as in the allreduce (the registered form).
+    The ZeRO/FSDP gradient-shard verb (C12's sibling) at tree depth."""
+    n = lax.axis_size(axis_name)
+    if x.size % n:
+        raise ValueError(f"reduce_scatter needs size divisible by {n} ranks, "
+                         f"got {x.size}")
+    if n == 1:
+        return finalize(x.reshape(-1), op, 1)
+    buf, seg_start, chunk, _digits = _khd_rs_phase(
+        x, axis_name, op, digits, max_radix, bidir)
+    out = lax.dynamic_slice_in_dim(buf, seg_start, chunk)
+    return finalize(out, op, n)
+
+
+def khd_allgather(x: jax.Array, axis_name: str, digits=None,
+                  max_radix: int = 8, bidir: bool = True) -> jax.Array:
+    """Mixed-radix allgather — the AG phase of :func:`khd_allreduce`
+    standalone (recursive multiplying): rank r contributes its ``(c,)``
+    chunk; every rank returns the ``(n, c)`` concatenation in rank order.
+    Wire bytes (1 - 1/n) * S in sum(d_t - 1) steps instead of n-1."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x.reshape(1, -1)
+    if digits is None:
+        digits = khd_digits(n, max_radix)
+    else:
+        digits = tuple(int(d) for d in digits)
+    prod = 1
+    for d in digits:
+        prod *= d
+    if prod != n:
+        raise ValueError(f"digits {digits} multiply to {prod}, axis has {n}")
+    strides = khd_strides(digits)
+    r = lax.axis_index(axis_name)
+    dig = [(r // s) % d for s, d in zip(strides, digits)]
+    chunk = x.size
+    buf = jnp.zeros((n * chunk,), x.dtype)
+    # my chunk starts at my own mixed-radix position (= r * chunk elements)
+    seg_start = jnp.int32(0)
+    for t, s in enumerate(strides):
+        seg_start = seg_start + dig[t] * (s * chunk)
+    buf = lax.dynamic_update_slice_in_dim(buf, x.reshape(-1), seg_start,
+                                          axis=0)
+    buf = _khd_ag_phase(buf, seg_start, chunk, digits, axis_name, bidir)
+    return buf.reshape(n, chunk)
+
+
+def _khd_rs_phase(x, axis_name, op, digits, max_radix, bidir):
+    """The shared reduce-scatter rounds: returns (buf, seg_start,
+    chunk_elems, digits) with rank r's fully reduced chunk at seg_start."""
+    n = lax.axis_size(axis_name)
+    if digits is None:
+        digits = khd_digits(n, max_radix)
+    else:
+        digits = tuple(int(d) for d in digits)
+    prod = 1
+    for d in digits:
+        prod *= d
+    if prod != n:
+        raise ValueError(f"digits {digits} multiply to {prod}, axis has {n}")
+    combine = combine_fn(op)
+    strides = khd_strides(digits)
+    r = lax.axis_index(axis_name)
+    size = x.size
+    chunk = -(-size // n)
+    buf = jnp.pad(x.reshape(-1), (0, n * chunk - size))
+    dig = [(r // s) % d for s, d in zip(strides, digits)]
+    seg_start = jnp.zeros((), jnp.int32)
+    P = 1
+    for t, d in enumerate(digits):
+        P *= d
+        part = (n // P) * chunk
+        h1 = part // 2
+        keep_start = seg_start + dig[t] * part
+        stashes = []
+        for o in range(1, d):
+            if not _split_offset(bidir, d, part, o):
+                send_start = seg_start + ((dig[t] + o) % d) * part
+                sent = lax.dynamic_slice_in_dim(buf, send_start, part)
+                stashes.append(lax.ppermute(sent, axis_name,
+                                            perm=khd_perm(n, digits, t, o)))
+            else:
+                fwd_start = seg_start + ((dig[t] + o) % d) * part
+                bwd_start = seg_start + ((dig[t] - o) % d) * part
+                first = lax.dynamic_slice_in_dim(buf, fwd_start, h1)
+                second = lax.dynamic_slice_in_dim(buf, bwd_start + h1,
+                                                  part - h1)
+                got_first = lax.ppermute(first, axis_name,
+                                         perm=khd_perm(n, digits, t, o))
+                got_second = lax.ppermute(second, axis_name,
+                                          perm=khd_perm(n, digits, t, d - o))
+                stashes.append(jnp.concatenate([got_first, got_second]))
+        kept = lax.dynamic_slice_in_dim(buf, keep_start, part)
+        for s in stashes:
+            kept = combine(kept, s)
+        buf = lax.dynamic_update_slice_in_dim(buf, kept, keep_start, axis=0)
+        seg_start = keep_start
+    return buf, seg_start, chunk, digits

@@ -115,14 +115,21 @@ def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
     maps a profiled ``algo="khd"`` run 1:1: the registered form is bidir —
     for radix > 2 each (round, offset) substep is TWO permutes (first
     half +o, second half -o); d=2 rounds and 1-element parts stay single.
-    ``itemsize``: the buffer's element width — khd.py's split gate counts
-    ELEMENTS (``part < 2``), so the byte-level gate here must agree or the
-    step counts diverge at 1-element parts.
+    ``itemsize``: the buffer's element width — khd.py's split/pad logic
+    counts ELEMENTS (ceil-divided chunks; ``part < 2`` gate), so the
+    byte-level accounting here must round and gate the same way or the
+    step counts diverge at tiny/non-divisible sizes. The split predicate
+    mirrors ``khd._split_offset`` exactly (incl. the self-inverse
+    ``o = d/2`` offset, which cannot split: +o and -o are the same
+    permutation there).
     """
+    from rocnrdma_tpu.collectives.khd import _split_offset
+
     digits = tuple(S.khd_digits(n)) if digits is None else tuple(digits)
     out = []
     step = 0
-    chunk = nbytes // n  # bytes of one 1/n-th chunk
+    # one 1/n-th chunk in bytes, ceil-rounded in ELEMENTS like khd.py's pad
+    chunk = -(-nbytes // (n * itemsize)) * itemsize
 
     def substep(t, d, o, frac, direction, tag):
         nonlocal step
@@ -136,9 +143,8 @@ def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
     for t, d in enumerate(digits):          # reduce-scatter rounds
         P *= d
         part = (n // P) * chunk
-        split = bidir and d > 2 and part >= 2 * itemsize
         for o in range(1, d):
-            if split:
+            if _split_offset(bidir, d, part // itemsize, o):
                 substep(t, d, o, part // 2, "+", "rs")
                 substep(t, d, d - o, part - part // 2, "-", "rs")
             else:
@@ -146,9 +152,8 @@ def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
     for t in range(len(digits) - 1, -1, -1):  # allgather rounds
         d = digits[t]
         part = (n // P) * chunk
-        split = bidir and d > 2 and part >= 2 * itemsize
         for o in range(1, d):
-            if split:
+            if _split_offset(bidir, d, part // itemsize, o):
                 substep(t, d, o, part // 2, "+", "ag")
                 substep(t, d, d - o, part - part // 2, "-", "ag")
             else:

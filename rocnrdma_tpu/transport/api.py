@@ -98,6 +98,10 @@ SCHEDULES = {
             C.fused_reduce_scatter(v, fused_axes, op=op),
         "ring": lambda v, _, op="sum", root=0:
             C.ring_reduce_scatter(v, RANK_AXIS, op=op),
+        # the khd RS phase standalone: sum(d_t-1) wide-fold rounds instead
+        # of n-1 ring steps at the same wire bytes (collectives/khd.py)
+        "khd": lambda v, _, op="sum", root=0:
+            C.khd_reduce_scatter(v, RANK_AXIS, op=op),
         "pallas_ring": lambda v, _, op="sum", root=0:
             _pallas().pallas_ring_reduce_scatter(v, RANK_AXIS) if op == "sum"
             else _raise(f"pallas_ring reduce_scatter is sum-only, got op={op!r}"),
@@ -107,6 +111,10 @@ SCHEDULES = {
             C.fused_allgather(v, fused_axes).reshape(-1),
         "ring": lambda v, _, op="sum", root=0:
             C.ring_allgather(v, RANK_AXIS).reshape(-1),
+        # the khd AG phase standalone (recursive multiplying): sum(d_t-1)
+        # rounds instead of n-1 at the same wire bytes
+        "khd": lambda v, _, op="sum", root=0:
+            C.khd_allgather(v, RANK_AXIS).reshape(-1),
         "pallas_ring": lambda v, _, op="sum", root=0:
             _pallas().pallas_ring_allgather(v, RANK_AXIS).reshape(-1),
     },

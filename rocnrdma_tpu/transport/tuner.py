@@ -129,6 +129,16 @@ def _ktree_arity() -> int:
 # The one sanctioned overlap assumption is FULL-DUPLEX links: ring_bidir
 # and bidir-khd split each payload across the two directions of the same
 # path, so their per-direction wire bytes halve at the same step count.
+# TOPOLOGY caveat (scoping, not a bug): factors price each permutation as
+# one link crossing — exact for the ring's neighbor hops, optimistic on a
+# physical torus for long-stride rotations (a +o rotation on an m-ring
+# loads its busiest link min(o, m-o)-fold; khd's natural mesh mapping
+# keeps each round inside one torus dimension — digits (8, 8) on an 8x8
+# torus are row then column exchanges — but intra-row strides still
+# multi-hop). This is the standard switch-abstraction every NCCL-style
+# alpha-beta table uses; on real multi-chip hardware the MEASURED
+# Autotuner sweep supersedes these rows at first contact (model_table's
+# provenance says exactly that), which is where torus effects get priced.
 # ``hbm`` is the serialized HBM traffic the schedule's combine passes cost
 # per buffer byte (reducing verbs only; a d-operand fused fold costs
 # (d+1)/(d-1) HBM bytes per arriving byte vs the pairwise 3 — fold width
@@ -144,22 +154,32 @@ def _khd_digits(n: int):
     return khd_digits(n)
 
 
+def _khd_round_shape(d: int) -> tuple[int, float]:
+    """(ppermute dispatches, per-direction part-fractions) of one radix-d
+    round of the REGISTERED (bidir) khd — mirroring khd._split_offset
+    exactly: offsets with 2o != d split across the two rotations (2
+    dispatches, half a part per direction each); the self-inverse offset
+    o = d/2 CANNOT split (+o and -o are the same permutation) and ships a
+    full part one way in one dispatch; d = 2's single offset is that
+    self-inverse case. The as-implemented rule, priced as implemented."""
+    if d == 2:
+        return 1, 1.0
+    self_inv = 1 if d % 2 == 0 else 0
+    split = d - 1 - self_inv
+    return 2 * split + self_inv, 0.5 * split + 1.0 * self_inv
+
+
 def _khd_steps(n: int) -> int:
-    return 2 * sum(d - 1 for d in _khd_digits(n))
+    # ppermute dispatches across both phases (each pays alpha)
+    return 2 * sum(_khd_round_shape(d)[0] for d in _khd_digits(n))
 
 
 def _khd_wire(n: int) -> float:
-    # per-direction serialized bytes of the REGISTERED (bidir) khd, per
-    # buffer byte: rounds with d > 2 split each part across the two
-    # directions (half per direction); d = 2 rounds CANNOT halve — the one
-    # partner exchange already uses both directions at the full part (the
-    # as-implemented rule: no unexpressed overlap). Factorizations with no
-    # 2-digit reduce exactly to ring_bidir's (n-1)/n; a trailing 2 digit
-    # costs its full part per direction.
+    # per-direction serialized bytes per buffer byte, both phases
     P, total = 1, 0.0
     for d in _khd_digits(n):
         P *= d
-        total += (d - 1) / P * (0.5 if d > 2 else 1.0)
+        total += _khd_round_shape(d)[1] / P
     return 2 * total
 
 
@@ -235,9 +255,14 @@ _MODEL = {
         2 * (n - 1), 2 * (n - 1) / n, 3 * (n - 1) / n),
     ("reduce_scatter", "ring"): lambda n: (
         n - 1, (n - 1) / n, 3 * (n - 1) / n),
+    # one khd phase: half the allreduce's steps/wire/folds
+    ("reduce_scatter", "khd"): lambda n: (
+        _khd_steps(n) // 2, _khd_wire(n) / 2, _khd_hbm(n)),
     ("reduce_scatter", "pallas_ring"): lambda n: (
         n - 1, (n - 1) / n, 3 * (n - 1) / n),
     ("allgather", "ring"): lambda n: (n - 1, (n - 1) / n, 0.0),
+    ("allgather", "khd"): lambda n: (
+        _khd_steps(n) // 2, _khd_wire(n) / 2, 0.0),
     ("allgather", "pallas_ring"): lambda n: (n - 1, (n - 1) / n, 0.0),
     ("alltoall", "ring"): lambda n: (n - 1, (n - 1) / n, 0.0),  # rotation
     ("alltoall", "bruck"): lambda n: (_L(n), _L(n) / 2, 0.0),
@@ -433,7 +458,7 @@ def model_table(device_kind: str, rank_counts, verbs, sizes,
     matching keys; provenance is recorded under ``_meta``).
 
     ``"fused"`` competes alongside the modeled explicit schedules. XLA's
-    lowering runs a bandwidth-optimal schedule SHAPE (``_FUSED_SHAPE``) as
+    lowering runs a bandwidth-optimal schedule SHAPE (``_FUSED_MODEL``) as
     one compiled program: the per-step dispatch half of alpha disappears
     (modeled as alpha/2 per hop — physical hop latency remains), but XLA
     does not switch to log-depth schedules at small sizes — which is
@@ -466,9 +491,9 @@ def model_table(device_kind: str, rank_counts, verbs, sizes,
                 times = {a: model_time(verb, a, n, size, alpha, beta,
                                        hbm_beta)
                          for a in cands}
-                shape = _FUSED_SHAPE.get(verb)
+                shape = _FUSED_MODEL.get(verb)
                 if shape and "fused" in SCHEDULES[verb]:
-                    steps, wire, hbm = _MODEL[(verb, shape)](n)
+                    steps, wire, hbm = shape(n)
                     times["fused"] = (steps * alpha / 2 + wire * size * beta
                                       + hbm * size * hbm_beta)
                 best = min(times, key=lambda a: (times[a], a != "fused"))
@@ -477,14 +502,19 @@ def model_table(device_kind: str, rank_counts, verbs, sizes,
     return table
 
 
-# the schedule shape XLA's fused lowering approximates per verb: the
-# bandwidth-optimal one (ring family; alltoall is a direct fabric exchange,
-# modeled by the direct one-sided row)
-_FUSED_SHAPE = {
-    "allreduce": "ring_bidir",
-    "reduce_scatter": "ring",
-    "allgather": "ring",
-    "alltoall": "pallas_ring",  # direct: 1 step, (n-1)/n wire
+# the (steps, wire, hbm) shape XLA's fused lowering approximates per verb:
+# bandwidth-optimal BIDIRECTIONAL rings (XLA's ICI collectives use both
+# link directions, so fused allgather/reduce_scatter get the same
+# full-duplex credit as ring_bidir — modeling them unidirectional would
+# hand their buckets to the explicit bidir schedules by an artifact),
+# with PAIRWISE accumulation for the reducing verbs (XLA folds one
+# arrival at a time); alltoall is a direct fabric exchange.
+_FUSED_MODEL = {
+    "allreduce": lambda n: _MODEL[("allreduce", "ring_bidir")](n),
+    "reduce_scatter": lambda n: (
+        n - 1, (n - 1) / (2 * n), 3 * (n - 1) / n),
+    "allgather": lambda n: (n - 1, (n - 1) / (2 * n), 0.0),
+    "alltoall": lambda n: _MODEL[("alltoall", "pallas_ring")](n),
 }
 
 

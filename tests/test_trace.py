@@ -53,16 +53,11 @@ def test_khd_events_traffic(n):
     ev = T.khd_events(n, nbytes)
     for r in range(n):
         assert _rank_bytes(ev, r) == 2 * (nbytes - nbytes // n)
-    from rocnrdma_tpu.collectives.schedule import khd_digits
-    digits = khd_digits(n)
-    want_steps = 0
-    P = 1
-    for d in digits:
-        P *= d
-        part = (n // P) * (nbytes // n)
-        split = d > 2 and part >= 2
-        want_steps += (d - 1) * (2 if split else 1)
-    assert max(e.step for e in ev) + 1 == 2 * want_steps
+    # step count = ppermute dispatches of the registered bidir program:
+    # split offsets (2o != d) dispatch two permutes, the self-inverse
+    # o = d/2 offset one — the same shape the tuner's alpha term prices
+    from rocnrdma_tpu.transport.tuner import _khd_steps
+    assert max(e.step for e in ev) + 1 == _khd_steps(n)
 
 
 @pytest.mark.parametrize("n", [2, 5, 8])
