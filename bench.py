@@ -389,9 +389,9 @@ def main() -> int:
         KERNELS = (("ring2", "xla2", 2, "ring/ring_bidir/tree step"),
                    ("ptree3", "xla3", 3, "ptree pipeline-beat fold "
                                          "(= dtree level fold)"),
-                   ("khd8", "xla8", 8, "khd radix-8 round fold "
-                                       "(ring_bidir-equal wire; the "
-                                       "model's 1 GiB pick)"))
+                   ("khd8", "xla8", 8, "khd radix-8 round fold (the "
+                                       "model's 1 GiB pick; wide-fold "
+                                       "HBM margin)"))
 
         def run_leg(nbytes):
             elems = nbytes // 4

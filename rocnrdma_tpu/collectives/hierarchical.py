@@ -29,10 +29,17 @@ from rocnrdma_tpu.collectives.ring import (
 
 def hierarchical_allreduce(x: jax.Array, *, intra_axis: str = "intra",
                            slice_axis: str = "slice",
+                           intra_algo: str = "ring",
                            cross_algo: str = "ring",
                            cross_dtype=None,
                            op: str = "sum") -> jax.Array:
     """Allreduce over both mesh axes, ICI-heavy / DCN-light.
+
+    ``intra_algo``: "ring" (explicit ring RS/AG, the default) or "khd"
+    (mixed-radix RS/AG, ``collectives/khd.py``) for the two ICI phases —
+    same wire bytes, sum(d-1) rounds instead of n-1 with a radix-wide
+    fused fold, the combination the fold-width-aware cost model prefers
+    for the reduce-scatter half at bandwidth sizes.
 
     ``cross_algo``: "ring" (explicit) or "fused" (``lax.psum``) for the
     cross-slice phase — DCN hops are latency-dominated, so the fused
@@ -65,17 +72,32 @@ def hierarchical_allreduce(x: jax.Array, *, intra_axis: str = "intra",
     if m == 1:
         wire = None  # nothing crosses the DCN: casting would only round
 
-    shard = ring_reduce_scatter(flat, intra_axis, op=inner)     # ICI
+    if intra_algo == "khd":
+        from rocnrdma_tpu.collectives.khd import (
+            khd_allgather,
+            khd_reduce_scatter,
+        )
+        rs = lambda v: khd_reduce_scatter(v, intra_axis, op=inner)
+        ag = lambda v: khd_allgather(v, intra_axis)
+    elif intra_algo == "ring":
+        rs = lambda v: ring_reduce_scatter(v, intra_axis, op=inner)
+        ag = lambda v: ring_allgather(v, intra_axis)
+    else:
+        raise ValueError(f"intra_algo must be ring|khd, got {intra_algo!r}")
+
+    shard = rs(flat)                                            # ICI
     orig = shard.dtype
     if wire is not None and wire != orig:
         shard = shard.astype(wire)
     if cross_algo == "fused":
         shard = fused_reduce(shard, slice_axis, op=inner)       # DCN
-    else:
+    elif cross_algo == "ring":
         shard = ring_allreduce(shard, slice_axis, op=inner)     # DCN
+    else:  # same fail-fast as intra_algo: a typo must not silently ring
+        raise ValueError(f"cross_algo must be ring|fused, got {cross_algo!r}")
     if wire is not None and wire != orig:
         shard = shard.astype(orig)
-    full = ring_allgather(shard, intra_axis).reshape(-1)        # ICI
+    full = ag(shard).reshape(-1)                                # ICI
     return finalize(full[:size].reshape(shape), op, n * m)
 
 

@@ -206,6 +206,37 @@ def test_khd_reduce_scatter_divisibility(devices):
         f(np.zeros((8, 9), np.float32))
 
 
+@pytest.mark.parametrize("cross_dtype", [None, "bfloat16"])
+def test_hierarchical_intra_khd(devices, cross_dtype):
+    # the ICI phases of the 2-level allreduce can ride the khd RS/AG pair
+    # (same wire bytes, wide folds); composes with the bf16 DCN wire
+    from rocnrdma_tpu.collectives import hierarchical_allreduce
+    rng = np.random.default_rng(21)
+    x = rng.standard_normal((2, 4, 24)).astype(np.float32)
+    mesh = rt.slice_mesh(2, 4)
+    f = jax.jit(jax.shard_map(
+        lambda s: hierarchical_allreduce(
+            s[0, 0], intra_algo="khd", cross_dtype=cross_dtype)[None, None],
+        mesh=mesh, in_specs=(P("slice", "intra"),),
+        out_specs=P("slice", "intra"), check_vma=False))
+    out = np.asarray(f(x))
+    want = np.broadcast_to(x.reshape(8, 24).sum(0), out.shape)
+    tol = 5e-2 if cross_dtype else 1e-4
+    np.testing.assert_allclose(out, want, rtol=tol, atol=tol)
+    with pytest.raises(ValueError, match="intra_algo must be"):
+        jax.shard_map(
+            lambda s: hierarchical_allreduce(s[0, 0],
+                                             intra_algo="bogus")[None, None],
+            mesh=mesh, in_specs=(P("slice", "intra"),),
+            out_specs=P("slice", "intra"), check_vma=False)(x)
+    with pytest.raises(ValueError, match="cross_algo must be"):
+        jax.shard_map(
+            lambda s: hierarchical_allreduce(s[0, 0],
+                                             cross_algo="fsed")[None, None],
+            mesh=mesh, in_specs=(P("slice", "intra"),),
+            out_specs=P("slice", "intra"), check_vma=False)(x)
+
+
 def test_khd_digits_factorization():
     assert khd_digits(64) == (8, 8)
     assert khd_digits(16) == (8, 2)
