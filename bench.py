@@ -362,13 +362,15 @@ def main() -> int:
         #                          pass; identical to the dtree level fold)
         #   khd8   = y + b+..+h   (8R+1W; the radix-8 mixed-radix
         #                          halving-doubling round-0 fold —
-        #                          collectives/khd.py moves ring-EQUAL
-        #                          serialized bytes, 2(n-1)/n*S with no
-        #                          overlap assumption, so the tuner's model
-        #                          genuinely selects it at bandwidth sizes
-        #                          (test_model_khd_ring_equal_bytes_fewer_
-        #                          steps); its wide fold is the one the
-        #                          bandwidth winner actually runs)
+        #                          collectives/khd.py moves ring-family
+        #                          serialized wire bytes and its wide fold
+        #                          cuts combine HBM traffic to 9/7 bytes
+        #                          per arriving byte vs the pairwise 3, so
+        #                          the fold-width-aware model genuinely
+        #                          selects khd at bandwidth sizes
+        #                          (test_model_khd_is_the_bandwidth_pick_
+        #                          with_chip_constants); its fold is the
+        #                          one the bandwidth winner actually runs)
         # Size: the contract fixes 1 GiB fp32 (BASELINE.json:2). The relayed
         # backend may reject multi-GiB transfers/compiles, so fall back to
         # 256 MiB and say so on stderr (BASELINE.md documents both rows).
@@ -469,6 +471,27 @@ def main() -> int:
                             for a, (v, t) in cands.items())
         print(f"# local combine @ {nbytes >> 20} MiB — winner: {winner} "
               f"({listing})", file=sys.stderr)
+        try:
+            # tie the scored kernel to the tuner visibly: the model's pick
+            # among the explicit schedules at the contract point is the
+            # schedule whose fold the winner-kernel set represents. Only
+            # meaningful with CHIP-calibrated constants — the generic
+            # (unknown-chip/CPU) constants have no HBM term and would
+            # print a pick that contradicts the fold narrative.
+            from rocnrdma_tpu.transport.tuner import constants_for, model_pick
+            if guard_roofline:  # known chip (same gate as the roofline)
+                a_, b_, hb_ = constants_for(
+                    getattr(devices[0], "device_kind", ""), "allreduce")
+                mp = model_pick("allreduce", 64, M.GiB,
+                                candidates=("ring", "ring_bidir", "tree",
+                                            "khd", "dtree", "ktree",
+                                            "ptree"),
+                                alpha=a_, beta=b_, hbm_beta=hb_)
+                print(f"# model pick @ 1 GiB, n=64, chip constants: {mp} "
+                      f"(the schedule the scored fold belongs to)",
+                      file=sys.stderr)
+        except Exception:
+            pass  # purely informational; never risk the headline
         value, trials_gbps = cands[winner]
         # the winner's leg runs a SECOND time (VERDICT r2 item 3) so the
         # reported spread samples more than one tenancy window; the scored

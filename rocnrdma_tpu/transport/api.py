@@ -80,15 +80,24 @@ SCHEDULES = {
         "dtree": lambda v, _, op="sum", root=0:
             C.dbtree_allreduce(v, RANK_AXIS, op=op),
         # chunk-pipelined double binary tree: C chunks stream through the
-        # tree, one 3-operand fold per pipeline beat (collectives/ptree.py)
-        "ptree": lambda v, _, op="sum", root=0:
-            C.ptree_allreduce(v, RANK_AXIS, op=op),
+        # tree, one 3-operand fold per pipeline beat (collectives/ptree.py);
+        # ``chunks`` overrides the pipeline depth
+        "ptree": lambda v, _, op="sum", root=0, chunks=None:
+            C.ptree_allreduce(v, RANK_AXIS, op=op,
+                              **({} if chunks is None else
+                                 {"chunks": chunks})),
         # wide-fold k-ary tree (one fused (arity+1)-operand combine per
         # interior level; arity = ktree.KTREE_ARITY, shared with the tuner)
         "ktree": lambda v, _, op="sum", root=0:
             C.kary_tree_allreduce(v, RANK_AXIS, op=op),
-        "hierarchical": lambda v, _, op="sum", root=0, cross_dtype=None:
-            C.hierarchical_allreduce(v, op=op, cross_dtype=cross_dtype),
+        # ``intra_algo``: ring|khd for the two ICI phases (khd = mixed-radix
+        # RS/AG, the fold-width-aware model's reduce-scatter pick)
+        "hierarchical": lambda v, _, op="sum", root=0, cross_dtype=None,
+                               intra_algo=None:
+            C.hierarchical_allreduce(
+                v, op=op, cross_dtype=cross_dtype,
+                **({} if intra_algo is None else
+                   {"intra_algo": intra_algo})),
         "pallas_ring": lambda v, _, op="sum", root=0:
             _pallas().pallas_ring_allreduce(v, RANK_AXIS) if op == "sum"
             else _raise(f"pallas_ring allreduce is sum-only, got op={op!r}"),
@@ -335,14 +344,19 @@ class Transport:
 
     @staticmethod
     def _force_algo(algo: str, **knobs) -> str:
-        # cross_dtype exists only on the hierarchical allreduce schedule:
-        # when the caller asks for it with a policy algo (auto/model), the
-        # knob IS the algorithm choice — resolving to fused/etc. by table
-        # or model and then rejecting the knob would make the same call
-        # succeed or fail with message size. An explicit algo still
-        # resolves normally and is validated in _build.
-        if knobs.get("cross_dtype") is not None and algo in ("auto", "model"):
-            return "hierarchical"
+        # Schedule-specific knobs force their schedule under the policy
+        # algos (auto/model): the knob IS the algorithm choice — resolving
+        # to fused/etc. by table or model and then rejecting the knob
+        # would make the same call succeed or fail with message size. An
+        # explicit algo still resolves normally and is validated in _build.
+        # cross_dtype/intra_algo exist only on the hierarchical allreduce;
+        # chunks only on the pipelined tree.
+        if algo in ("auto", "model"):
+            if (knobs.get("cross_dtype") is not None
+                    or knobs.get("intra_algo") is not None):
+                return "hierarchical"
+            if knobs.get("chunks") is not None:
+                return "ptree"
         return algo
 
     def _dispatch(self, verb: str, x, algo: str, **knobs):
@@ -353,7 +367,8 @@ class Transport:
         return fn(x)
 
     def allreduce(self, x, algo: str = "auto", op: str = "sum", acc=None,
-                  premul=None, cross_dtype=None):
+                  premul=None, cross_dtype=None, intra_algo=None,
+                  chunks=None):
         """(ranks..., S) -> same shape; every rank row = elementwise reduction
         (``op``: sum/prod/max/min/avg). ``acc``: accumulate in this wider
         dtype and cast back — e.g. ``acc="float32"`` on bf16 buffers, the
@@ -365,9 +380,14 @@ class Transport:
         loss scaling) pre-scale the input array instead. ``cross_dtype``:
         hierarchical (2-D mesh) only — wire dtype for the cross-slice DCN
         phase (e.g. ``"bfloat16"`` on fp32 buffers halves DCN bytes; both
-        ICI phases stay full precision)."""
+        ICI phases stay full precision). ``intra_algo``: hierarchical only
+        — ``"ring"``/``"khd"`` for the two ICI phases (khd = the
+        mixed-radix wide-fold RS/AG pair). ``chunks``: ptree only —
+        pipeline-depth override. Each schedule-specific knob forces its
+        schedule under algo auto/model, like cross_dtype."""
         return self._dispatch("allreduce", x, algo, op=op, acc=acc,
-                              premul=premul, cross_dtype=cross_dtype)
+                              premul=premul, cross_dtype=cross_dtype,
+                              intra_algo=intra_algo, chunks=chunks)
 
     def reduce_scatter(self, x, algo: str = "auto", op: str = "sum", acc=None,
                        premul=None):
@@ -536,11 +556,22 @@ class Transport:
                     f"cross_dtype only composes with op sum/avg (a coarser-"
                     f"dtype {knobs['op']} would change which element wins)")
             knobs["cross_dtype"] = dt.name
+        if knobs.get("intra_algo") is not None:
+            if knobs["intra_algo"] not in ("ring", "khd"):
+                raise ValueError(f"intra_algo must be ring|khd, got "
+                                 f"{knobs['intra_algo']!r}")
+        if knobs.get("chunks") is not None:
+            chunks = int(knobs["chunks"])
+            if chunks < 1:
+                raise ValueError(f"chunks must be >= 1, got {chunks}")
+            knobs["chunks"] = chunks  # one cache entry per depth
         return {k: v for k, v in knobs.items()
                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
                 and not (k == "shift" and v == 1) and not (k == "acc" and v is None)
                 and not (k == "premul" and v is None)
                 and not (k == "cross_dtype" and v is None)
+                and not (k == "intra_algo" and v is None)
+                and not (k == "chunks" and v is None)
                 and not (k == "donate" and not v)}
 
     # verbs whose output shape differs from the input: donating would save
@@ -600,6 +631,15 @@ class Transport:
             raise ValueError(
                 f"cross_dtype is a hierarchical-ALLREDUCE knob (the DCN "
                 f"wire dtype); got ({verb!r}, algo {algo!r})")
+        if "intra_algo" in knobs and (verb, algo) != ("allreduce",
+                                                      "hierarchical"):
+            raise ValueError(
+                f"intra_algo is a hierarchical-ALLREDUCE knob (the ICI "
+                f"phase schedule); got ({verb!r}, algo {algo!r})")
+        if "chunks" in knobs and (verb, algo) != ("allreduce", "ptree"):
+            raise ValueError(
+                f"chunks is a PTREE-allreduce knob (the pipeline depth); "
+                f"got ({verb!r}, algo {algo!r})")
         # ``donate``: hand the input buffer to XLA for in-place reuse — the
         # zero-copy/user-buffer-registration analogue (ncclCommRegister /
         # hipMemRegister): collectives whose output matches the input

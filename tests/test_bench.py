@@ -374,6 +374,27 @@ def test_bench_script_multichip_pallas_hbm_interpret_rehearsal(
     assert row["value"] > 0 and row["vs_baseline"] > 0
 
 
+def test_bench_headline_kernels_match_registry():
+    # cross-artifact consistency: the scored kernel set must describe the
+    # registered schedules — khd8's operand count IS the khd radix at the
+    # contract rank counts, ptree3's is the double tree's per-beat fold
+    # width (2 children + own), ring2's the ring step
+    import os
+
+    from rocnrdma_tpu.collectives.schedule import khd_digits
+
+    src = open(os.path.join(os.path.dirname(__file__), "..",
+                            "bench.py")).read()
+    for name, kern, n_ops in (("ring2", "xla2", 2), ("ptree3", "xla3", 3),
+                              ("khd8", "xla8", 8)):
+        assert f'("{name}", "{kern}", {n_ops},' in src, name
+    # khd's first-round fold width at the contract rank counts is the
+    # radix: 64 and 256 ranks both factor with a leading 8, so the xla8
+    # kernel (8 operands = own + 7 arrivals) is what algo="khd" folds
+    assert khd_digits(64)[0] == 8
+    assert khd_digits(256)[0] == 8
+
+
 def test_bench_local_bfloat16_leg(tmp_path):
     # the C11 dtype axis on the combine kernels: bf16 halves bytes/elem
     from rocnrdma_tpu.bench import bench_local

@@ -237,6 +237,37 @@ def test_hierarchical_intra_khd(devices, cross_dtype):
             out_specs=P("slice", "intra"), check_vma=False)(x)
 
 
+def test_transport_intra_algo_and_chunks_knobs(devices):
+    # the schedule-specific knobs reach the production API: intra_algo
+    # forces hierarchical (like cross_dtype) and routes the ICI phases
+    # through khd; chunks forces/overrides the ptree pipeline depth
+    t2 = Transport(rt.slice_mesh(2, 4))
+    x2 = t2.shard(np.random.default_rng(1)
+                  .standard_normal((2, 4, 24)).astype(np.float32))
+    out = np.asarray(t2.allreduce(x2, "auto", intra_algo="khd"))
+    want = np.broadcast_to(np.asarray(x2).reshape(8, 24).sum(0), out.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    assert any(k.startswith("allreduce/hierarchical") for k in t2.stats())
+
+    t1 = Transport(rt.rank_mesh(8))
+    x1 = t1.shard(np.random.default_rng(2)
+                  .standard_normal((8, 40)).astype(np.float32))
+    out1 = np.asarray(t1.allreduce(x1, "auto", chunks=3))
+    np.testing.assert_allclose(
+        out1, np.broadcast_to(np.asarray(x1).sum(0), out1.shape),
+        rtol=1e-4, atol=1e-5)
+    assert any(k.startswith("allreduce/ptree") for k in t1.stats())
+
+    with pytest.raises(ValueError, match="intra_algo must be"):
+        t2.allreduce(x2, "auto", intra_algo="bogus")
+    with pytest.raises(ValueError, match="chunks must be"):
+        t1.allreduce(x1, "auto", chunks=0)
+    with pytest.raises(ValueError, match="intra_algo is a hierarchical"):
+        t1.allreduce(x1, "ring", intra_algo="khd")  # explicit algo mismatch
+    with pytest.raises(ValueError, match="chunks is a PTREE"):
+        t1.allreduce(x1, "ring", chunks=4)
+
+
 def test_khd_digits_factorization():
     assert khd_digits(64) == (8, 8)
     assert khd_digits(16) == (8, 2)
