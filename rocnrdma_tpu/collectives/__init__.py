@@ -6,9 +6,14 @@ Two families:
   double-binary-tree / rotation / hierarchical algorithms, with reference
   simulators. These are the TPU rebuild of the reference's "its own ring/tree
   allreduce" (the inspectable, educational path).
-- ``ring`` / ``tree`` / ``dtree`` / ``alltoall`` / ``hierarchical``: jit-compiled
-  implementations of those schedules as ``lax.ppermute`` programs under
-  ``jax.shard_map`` — axis-level primitives callable on any mesh axis.
+- ``ring`` / ``tree`` / ``khd`` / ``dtree`` / ``ptree`` / ``ktree`` /
+  ``alltoall`` / ``hierarchical``: jit-compiled implementations of those
+  schedules as ``lax.ppermute`` programs under ``jax.shard_map`` —
+  axis-level primitives callable on any mesh axis. The r3 additions:
+  ``khd`` (mixed-radix halving-doubling — ring-family wire bytes with a
+  radix-wide fused fold per round, plus standalone reduce-scatter/
+  allgather phase verbs) and ``ptree`` (the chunk-pipelined double binary
+  tree — C chunks streaming through both trees).
 - ``fused``: the XLA-lowered fast path (``lax.psum`` / ``lax.all_to_all``),
   the production default.
 - ``program``: the MSCCL analogue — a declarative schedule IR (Program/Step)

@@ -6,8 +6,10 @@ mesh, owns the shard_map/jit plumbing, and exposes the collective verbs with
 an algorithm-selection policy:
 
 - ``"fused"``  — XLA's own lowering (``lax.psum`` etc.): the fast path.
-- ``"ring"`` / ``"ring_bidir"`` / ``"tree"`` — the explicit inspectable
-  schedules (1-D rank mesh).
+- ``"ring"`` / ``"ring_bidir"`` / ``"tree"`` / ``"khd"`` / ``"dtree"`` /
+  ``"ptree"`` / ``"ktree"`` — the explicit inspectable schedules (1-D
+  rank mesh); khd is the wide-fold bandwidth pick of the calibrated cost
+  model, ptree the chunk-pipelined double tree.
 - ``"hierarchical"`` — 2-level ICI/DCN schedule (2-D ``('slice','intra')``
   mesh).
 - ``"auto"`` — the measured tuning table (``transport/tuner.py``) when one
