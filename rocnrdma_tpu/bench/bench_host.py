@@ -38,7 +38,8 @@ from rocnrdma_tpu.bench.runner import parse_size
 from rocnrdma_tpu.bench.timing import trimmed_mean
 
 COLLECTIVES = ("allreduce", "reducescatter", "allgather", "broadcast",
-               "alltoall", "alltoallv", "sendrecv")
+               "alltoall", "alltoallv", "allgatherv", "reducescatterv",
+               "sendrecv")
 
 
 def _build_input(collective: str, n: int, elems: int, rng,
@@ -54,6 +55,10 @@ def _build_input(collective: str, n: int, elems: int, rng,
         # identically — the MPI contract)
         return [rng.standard_normal(c).astype(np.float32)
                 for c in counts[rank]]
+    if collective == "allgatherv":
+        return rng.standard_normal(int(counts[rank])).astype(np.float32)
+    if collective == "reducescatterv":
+        return rng.standard_normal(int(counts.sum())).astype(np.float32)
     return rng.standard_normal(elems).astype(np.float32)
 
 
@@ -71,6 +76,15 @@ def _alltoallv_counts(n: int, per: int) -> np.ndarray:
     return np.maximum(1, (frac * per).astype(np.int64))
 
 
+def _ragged_counts(n: int, per: int) -> np.ndarray:
+    """Deterministic length-n per-rank element counts for the ragged
+    allgatherv/reduce-scatter-v legs: rank r contributes/keeps between 25%
+    and 175% of the balanced chunk, every rank deriving the same vector
+    (the MPI recvcounts contract). Literally row 0 of the alltoallv
+    matrix — ONE skew formula to maintain."""
+    return _alltoallv_counts(n, per)[0]
+
+
 def _issue(pg, collective: str, x, transport: str = "msg", counts=None):
     if collective == "allreduce":
         return pg.all_reduce(x, transport=transport)
@@ -78,6 +92,10 @@ def _issue(pg, collective: str, x, transport: str = "msg", counts=None):
         return pg.reduce_scatter(x, transport=transport)
     if collective == "allgather":
         return pg.all_gather(x, transport=transport)
+    if collective == "allgatherv":
+        return pg.all_gather_v(x, counts)
+    if collective == "reducescatterv":
+        return pg.reduce_scatter_v(x, counts)
     if collective == "broadcast":
         return pg.broadcast(x, src=0)
     if collective == "alltoall":
@@ -106,15 +124,22 @@ def worker(args) -> int:
     for collective in args.collectives.split(","):
         for size in (parse_size(s) for s in args.sizes.split(",")):
             elems = max(1, size // 4)
-            counts = (_alltoallv_counts(pg.world_size,
-                                        max(1, elems // pg.world_size))
-                      if collective == "alltoallv" else None)
+            per = max(1, elems // pg.world_size)
+            counts = (_alltoallv_counts(pg.world_size, per)
+                      if collective == "alltoallv"
+                      else _ragged_counts(pg.world_size, per)
+                      if collective in ("allgatherv", "reducescatterv")
+                      else None)
             x = _build_input(collective, pg.world_size, elems, rng,
                              rank=pg.rank, counts=counts)
             # record the bytes actually moved (per-rank chunks round down),
-            # matching the device benches' actual-bytes convention
+            # matching the device benches' actual-bytes convention; the
+            # gathered verbs record the gathered TOTAL (the sweep size-key
+            # convention)
             actual = (x.nbytes * pg.world_size
                       if collective == "allgather"
+                      else int(counts.sum()) * 4
+                      if collective == "allgatherv"
                       else sum(seg.nbytes for seg in x)
                       if collective == "alltoallv" else x.nbytes)
             _issue(pg, collective, x, args.transport, counts)  # warmup
@@ -156,7 +181,8 @@ def main(argv=None) -> int:
                    help="data path for the reducing/gather rings "
                         "(allreduce, reducescatter, allgather): two-sided "
                         "send/recv or one-sided RDMA writes (put-based "
-                        "ring); broadcast/alltoall always ride send/recv")
+                        "ring); broadcast/alltoall(v) and the ragged "
+                        "allgatherv/reducescatterv always ride send/recv")
     p.add_argument("--sizes", default="64K,1M")
     p.add_argument("--collectives", default=",".join(COLLECTIVES))
     p.add_argument("--repeats", type=int, default=5)

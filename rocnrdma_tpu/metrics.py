@@ -49,6 +49,11 @@ _BUSBW_FACTOR = {
     # ragged alltoall, reported against size = the rank's actual sent
     # bytes: the off-rank fraction matches the dense exchange
     "alltoallv": lambda n: (n - 1) / n,
+    # ragged gather/RS siblings, reported against size = the gathered
+    # total resp. the full ragged buffer: off-rank fraction as the dense
+    # verbs (the own chunk never travels)
+    "allgatherv": lambda n: (n - 1) / n,
+    "reducescatterv": lambda n: (n - 1) / n,
     "broadcast": lambda n: 1.0,
     "reduce": lambda n: 1.0,          # every byte crosses each link once
     "gather": lambda n: (n - 1) / n,  # root receives (n-1) chunks of S/n

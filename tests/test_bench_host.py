@@ -49,6 +49,30 @@ def test_alltoallv_on_the_native_wire(tmp_path):
     assert all(r["size_bytes"] != 64 * 1024 for r in rows)
 
 
+def test_ragged_v_legs_on_the_native_wire(tmp_path):
+    # the allgatherv / reduce-scatter-v bench legs across real OS
+    # processes (VERDICT r2 item 8's bench-surface completion)
+    import json
+    out = tmp_path / "ragged.jsonl"
+    rc = bench_host.main(["--ranks", "2", "--sizes", "64K", "--plane", "shm",
+                          "--collectives", "allgatherv,reducescatterv",
+                          "--repeats", "2", "--iters", "2",
+                          "--out", str(out)])
+    assert rc == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert {r["collective"] for r in rows} == {"allgatherv",
+                                               "reducescatterv"}
+    assert all(r["mean_s"] > 0 and r["busbw_GBps"] > 0 for r in rows)
+
+
+def test_ragged_counts_deterministic():
+    import numpy as np
+    c = bench_host._ragged_counts(4, 100)
+    assert c.shape == (4,) and (c >= 1).all()
+    np.testing.assert_array_equal(c, bench_host._ragged_counts(4, 100))
+    assert len(set(c.tolist())) > 1  # genuinely ragged
+
+
 def test_alltoallv_counts_deterministic_skewed_balanced():
     import numpy as np
     for n in (3, 4, 5, 8):
