@@ -109,7 +109,7 @@ def dtree_events(n: int, nbytes: int) -> list[Event]:
 
 
 def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
-               itemsize: int = 4) -> list[Event]:
+               itemsize: int = 4, phases=("rs", "ag")) -> list[Event]:
     """Mixed-radix halving-doubling (khd.py). One Event STEP per ppermute
     in the exact order the jit program executes them, so ``align_steps``
     maps a profiled ``algo="khd"`` run 1:1: the registered form is bidir —
@@ -121,7 +121,10 @@ def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
     step counts diverge at tiny/non-divisible sizes. The split predicate
     mirrors ``khd._split_offset`` exactly (incl. the self-inverse
     ``o = d/2`` offset, which cannot split: +o and -o are the same
-    permutation there).
+    permutation there). ``phases``: subset of ("rs", "ag") — ("rs",)
+    traces the standalone ``khd_reduce_scatter`` verb, ("ag",) the
+    standalone ``khd_allgather`` (``nbytes`` = the full/gathered buffer
+    in both conventions, matching the sweep size key).
     """
     from rocnrdma_tpu.collectives.khd import _split_offset
 
@@ -143,6 +146,8 @@ def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
     for t, d in enumerate(digits):          # reduce-scatter rounds
         P *= d
         part = (n // P) * chunk
+        if "rs" not in phases:
+            continue
         for o in range(1, d):
             if _split_offset(bidir, d, part // itemsize, o):
                 substep(t, d, o, part // 2, "+", "rs")
@@ -152,12 +157,13 @@ def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
     for t in range(len(digits) - 1, -1, -1):  # allgather rounds
         d = digits[t]
         part = (n // P) * chunk
-        for o in range(1, d):
-            if _split_offset(bidir, d, part // itemsize, o):
-                substep(t, d, o, part // 2, "+", "ag")
-                substep(t, d, d - o, part - part // 2, "-", "ag")
-            else:
-                substep(t, d, o, part, "", "ag")
+        if "ag" in phases:
+            for o in range(1, d):
+                if _split_offset(bidir, d, part // itemsize, o):
+                    substep(t, d, o, part // 2, "+", "ag")
+                    substep(t, d, d - o, part - part // 2, "-", "ag")
+                else:
+                    substep(t, d, o, part, "", "ag")
         P //= d
     return out
 
@@ -292,6 +298,10 @@ _GENERATORS = {
     ("allreduce", "khd"): khd_events,
     ("allreduce", "dtree"): dtree_events,
     ("allreduce", "ptree"): ptree_events,
+    # the standalone khd phase verbs (reducescatter spelling matches the
+    # bench CLI collective names)
+    ("reducescatter", "khd"): lambda n, b: khd_events(n, b, phases=("rs",)),
+    ("allgather", "khd"): lambda n, b: khd_events(n, b, phases=("ag",)),
     ("alltoall", "ring"): rotation_a2a_events,
     ("alltoall", "bruck"): bruck_a2a_events,
     ("broadcast", "binomial"): lambda n, b: binomial_events(n, b, "broadcast"),

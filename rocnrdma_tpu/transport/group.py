@@ -61,15 +61,29 @@ class Group:
     def _queue(self, verb: str, x, algo: str, **knobs) -> GroupHandle:
         if self._results is not None:
             raise GroupError("group already executed; start a new group()")
+        # schedule-specific knobs force their schedule under auto/model,
+        # exactly as on the direct verb methods (Transport._force_algo)
+        algo = self._t._force_algo(algo, **knobs)
         knobs = self._t._normalize_knobs(**knobs)
         resolved = self._t._resolve(algo, verb, self._t._msg_bytes(verb, x))
+        # validate the (verb, algo, knobs) combination NOW — the direct verb
+        # methods raise at call time ("rejected calls don't count"), so a
+        # knob/explicit-algo mismatch must not hide until group exit and
+        # poison the whole batch. _jit only builds the (lazy) jitted
+        # callable; exit-time execution reuses the cache entry.
+        self._t._jit(verb, resolved, **knobs)
         self._calls.append((verb, resolved, tuple(sorted(knobs.items())), x))
         return GroupHandle(self, len(self._calls) - 1)
 
     def allreduce(self, x, algo: str = "auto", op: str = "sum",
-                  acc=None, premul=None) -> GroupHandle:
+                  acc=None, premul=None, cross_dtype=None, intra_algo=None,
+                  chunks=None) -> GroupHandle:
+        """Knobs as on ``Transport.allreduce`` (cross_dtype/intra_algo:
+        hierarchical; chunks: ptree — each forces its schedule under
+        auto/model)."""
         return self._queue("allreduce", x, algo, op=op, acc=acc,
-                           premul=premul)
+                           premul=premul, cross_dtype=cross_dtype,
+                           intra_algo=intra_algo, chunks=chunks)
 
     def reduce_scatter(self, x, algo: str = "auto", op: str = "sum",
                        acc=None, premul=None) -> GroupHandle:

@@ -49,6 +49,27 @@ def test_group_mixed_verbs_and_knobs(t8):
                                rtol=1e-6)
 
 
+def test_group_schedule_knobs_force_like_direct_calls(t8):
+    # the r3 knobs work in grouped launches exactly as on the verb
+    # methods: chunks forces ptree under auto
+    import numpy as np
+    x = t8.shard(np.random.default_rng(4)
+                 .standard_normal((8, 40)).astype(np.float32))
+    with t8.group() as g:
+        h = g.allreduce(x, algo="auto", chunks=3)
+    out = np.asarray(h.result())
+    np.testing.assert_allclose(
+        out, np.broadcast_to(np.asarray(x).sum(0), out.shape),
+        rtol=1e-4, atol=1e-5)
+    assert any(k.startswith("allreduce/ptree") for k in t8.stats())
+    # a knob/explicit-algo mismatch raises AT QUEUE TIME (the direct verb
+    # methods' behavior), not at group exit where it would poison the batch
+    import pytest
+    with t8.group() as g2:
+        with pytest.raises(ValueError, match="chunks is a PTREE"):
+            g2.allreduce(x, algo="ring", chunks=3)
+
+
 def test_group_result_before_exit_raises(t8):
     s = t8.shard(_rand((8, 16), 7))
     with t8.group() as g:

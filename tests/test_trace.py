@@ -60,6 +60,25 @@ def test_khd_events_traffic(n):
     assert max(e.step for e in ev) + 1 == _khd_steps(n)
 
 
+def test_khd_phase_events():
+    # the standalone phase verbs trace as the halves of the allreduce:
+    # same substep shape, half the steps, and the wire bytes of one phase
+    n, nbytes = 8, 8 * 128
+    full = T.khd_events(n, nbytes)
+    rs = T.khd_events(n, nbytes, phases=("rs",))
+    ag = T.khd_events(n, nbytes, phases=("ag",))
+    assert (max(e.step for e in rs) + 1) + (max(e.step for e in ag) + 1) \
+        == max(e.step for e in full) + 1
+    for r in range(n):
+        assert (_rank_bytes(rs, r) + _rank_bytes(ag, r)
+                == _rank_bytes(full, r))
+    assert all(" rs " in e.name for e in rs)
+    assert all(" ag " in e.name for e in ag)
+    # registered under the CLI spellings
+    assert ("reducescatter", "khd") in T._GENERATORS
+    assert ("allgather", "khd") in T._GENERATORS
+
+
 @pytest.mark.parametrize("n", [2, 5, 8])
 def test_ptree_events_structure(n):
     # every tree edge carries every chunk exactly once per phase; steps
