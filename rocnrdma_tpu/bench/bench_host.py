@@ -157,10 +157,16 @@ def worker(args) -> int:
                 algo = ("ring_rdma" if args.transport == "rdma"
                         and collective in ("allreduce", "reducescatter",
                                            "allgather") else "ring")
+                # ragged verbs: the busbw factor comes from the actual
+                # counts vector (the busiest rank's wire), not the
+                # balanced-counts (n-1)/n approximation (ADVICE r3)
+                ragged = (counts.tolist()
+                          if collective in ("allgatherv", "reducescatterv")
+                          else None)
                 records.append(M.BenchRecord.measure(
                     "bench_host", collective, algo, pg.world_size, actual,
                     "float32", sec, platform=f"host-{args.plane}",
-                    iters=args.iters, repeats=args.repeats))
+                    counts=ragged, iters=args.iters, repeats=args.repeats))
     pg.barrier()
     pg.destroy()
     if pg.rank == 0:

@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import re
 import sys
 
 import jax
@@ -42,6 +43,16 @@ from rocnrdma_tpu.bench.timing import marginal_s_per_op
 
 KERNELS = ("xla2", "xla3", "xla4", "xla5", "xla6", "xla7", "xla8",
            "xla9", "pallas2", "pallas3", "pallas4", "pallas5")
+
+
+def kernel_n_ops(kernel: str) -> int:
+    """Operand count of a combine-kernel name — the TRAILING digits, so
+    multi-digit widths (``xla16``, ``xla64`` — the khd radix ladder's
+    folds) parse correctly; ``kernel[-1]`` silently truncated them."""
+    m = re.search(r"(\d+)$", kernel)
+    if not m:
+        raise ValueError(f"kernel name {kernel!r} has no operand count")
+    return int(m.group(1))
 
 
 def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int,
@@ -61,7 +72,7 @@ def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int,
 
     from rocnrdma_tpu.ops import pallas_hbm_combine
 
-    n_ops = int(kernel[-1])
+    n_ops = kernel_n_ops(kernel)
     if kernel.startswith("xla"):
         def combine(y, *bs):
             out = y
@@ -134,7 +145,7 @@ def main(argv=None) -> int:
     elems = size // dtype.itemsize
     rng = np.random.default_rng(0)
     # one operand tuple serves every kernel (spares traced but untouched)
-    need = max(int(k[-1]) for k in kernels)
+    need = max(kernel_n_ops(k) for k in kernels)
     x0 = tuple(jnp.asarray(rng.standard_normal((elems,), dtype=np.float32))
                .astype(dtype) for _ in range(need))
 
@@ -160,7 +171,7 @@ def main(argv=None) -> int:
     rows = []
     with prof:
         for kname in kernels:
-            n_ops = int(kname[-1])
+            n_ops = kernel_n_ops(kname)
             chk = np.asarray(
                 make_combine_chain(kname, args.tile_rows,
                                    None if native else True, k=2,

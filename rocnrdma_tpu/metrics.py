@@ -74,8 +74,17 @@ def algbw_GBps(size_bytes: int, seconds: float) -> float:
     return size_bytes / seconds / 1e9
 
 
-def busbw_GBps(collective: str, n_ranks: int, size_bytes: int, seconds: float) -> float:
-    """Bus bandwidth in GB/s/chip for ``collective`` over ``n_ranks`` ranks."""
+def busbw_GBps(collective: str, n_ranks: int, size_bytes: int,
+               seconds: float, counts=None) -> float:
+    """Bus bandwidth in GB/s/chip for ``collective`` over ``n_ranks`` ranks.
+
+    ``counts``: for the RAGGED verbs (allgatherv/reducescatterv), the
+    per-rank element counts — the dense (n-1)/n factor assumes balanced
+    counts, but a rank's actual wire is sum(counts) - counts[rank]
+    (ADVICE r3), so with counts the factor is the BUSIEST rank's
+    (sum - min(counts)) / sum, matching the measure-the-slowest-rank
+    timing convention. Without counts the dense factor stands (documented
+    balanced-counts approximation)."""
     if collective not in _BUSBW_FACTOR:
         raise ValueError(f"unknown collective {collective!r}; know {sorted(_BUSBW_FACTOR)}")
     if n_ranks < 1:
@@ -84,6 +93,12 @@ def busbw_GBps(collective: str, n_ranks: int, size_bytes: int, seconds: float) -
         # Degenerate single-rank case: no wire traffic; busbw defined as 0 so
         # single-chip smoke runs can't masquerade as line-rate numbers.
         return 0.0
+    if counts is not None and collective in ("allgatherv", "reducescatterv"):
+        total = float(sum(counts))
+        if total <= 0:
+            return 0.0
+        factor = (total - float(min(counts))) / total
+        return algbw_GBps(size_bytes, seconds) * factor
     return algbw_GBps(size_bytes, seconds) * _BUSBW_FACTOR[collective](n_ranks)
 
 
@@ -111,12 +126,13 @@ class BenchRecord:
 
     @classmethod
     def measure(cls, bench, collective, algo, n_ranks, size_bytes, dtype,
-                mean_s, platform="", **extra):
+                mean_s, platform="", counts=None, **extra):
         return cls(
             bench=bench, collective=collective, algo=algo, n_ranks=n_ranks,
             size_bytes=size_bytes, dtype=dtype, mean_s=mean_s,
             algbw_GBps=algbw_GBps(size_bytes, mean_s),
-            busbw_GBps=busbw_GBps(collective, n_ranks, size_bytes, mean_s),
+            busbw_GBps=busbw_GBps(collective, n_ranks, size_bytes, mean_s,
+                                  counts=counts),
             platform=platform, extra=extra,
         )
 

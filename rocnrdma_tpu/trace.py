@@ -146,39 +146,48 @@ def khd_events(n: int, nbytes: int, digits=None, bidir: bool = True,
     for t, d in enumerate(digits):          # reduce-scatter rounds
         P *= d
         part = (n // P) * chunk
+        # the split halves in ELEMENTS exactly like khd.py (h1 =
+        # part_elems // 2), then scale to bytes — a byte-level part // 2
+        # diverges from the jitted slice sizes for odd-element parts
+        # (ADVICE r3: 3-elem fp32 part is 4/8 B, not 6/6)
+        h1 = (part // itemsize // 2) * itemsize
         if "rs" not in phases:
             continue
         for o in range(1, d):
             if _split_offset(bidir, d, part // itemsize, o):
-                substep(t, d, o, part // 2, "+", "rs")
-                substep(t, d, d - o, part - part // 2, "-", "rs")
+                substep(t, d, o, h1, "+", "rs")
+                substep(t, d, d - o, part - h1, "-", "rs")
             else:
                 substep(t, d, o, part, "", "rs")
     for t in range(len(digits) - 1, -1, -1):  # allgather rounds
         d = digits[t]
         part = (n // P) * chunk
+        h1 = (part // itemsize // 2) * itemsize
         if "ag" in phases:
             for o in range(1, d):
                 if _split_offset(bidir, d, part // itemsize, o):
-                    substep(t, d, o, part // 2, "+", "ag")
-                    substep(t, d, d - o, part - part // 2, "-", "ag")
+                    substep(t, d, o, h1, "+", "ag")
+                    substep(t, d, d - o, part - h1, "-", "ag")
                 else:
                     substep(t, d, o, part, "", "ag")
         P //= d
     return out
 
 
-def ptree_events(n: int, nbytes: int, chunks: int | None = None) -> list[Event]:
+def ptree_events(n: int, nbytes: int, chunks: int | None = None,
+                 itemsize: int = 4) -> list[Event]:
     """Chunk-pipelined double tree (ptree.py). One Event STEP per ppermute
     in jit execution order (tick -> tree -> side-substep), so a profiled
     ``algo="ptree"`` run aligns 1:1; the pipeline structure — different
     chunk indices in flight at different depths within one tick — is
-    visible in the event names."""
+    visible in the event names. ``chunks`` defaults to ptree.py's
+    size-scaled pick for this ``nbytes``; half/chunk sizes round in
+    ELEMENTS exactly like ptree.py (ADVICE r3)."""
     if chunks is None:
-        from rocnrdma_tpu.collectives.ptree import PTREE_CHUNKS
-        chunks = PTREE_CHUNKS
-    half = -(-nbytes // 2)
-    csize = -(-half // chunks)
+        from rocnrdma_tpu.collectives.ptree import ptree_auto_chunks
+        chunks = ptree_auto_chunks(nbytes // itemsize)
+    half = -(-(nbytes // itemsize) // 2)
+    csize = -(-half // chunks) * itemsize
     trees = [S.ptree_ticks(p, chunks) for p in S.dbtree_parents(n)]
     out = []
     step = 0

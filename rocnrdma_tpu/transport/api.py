@@ -51,8 +51,9 @@ def _raise(msg: str):
 # algorithm did auto actually pick?" without a debugger.
 _DEBUG_LOG = os.environ.get("RNR_DEBUG", "") not in ("", "0")
 
-ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "khd", "dtree",
-         "ptree", "ktree", "hierarchical", "pallas_ring", "bruck", "binomial")
+ALGOS = ("auto", "fused", "ring", "ring_bidir", "tree", "khd", "khd2d",
+         "dtree", "ptree", "ktree", "hierarchical", "pallas_ring", "bruck",
+         "binomial")
 
 # THE (op, algo) compatibility table — single source of truth, consumed by
 # Transport._build below and by the bench runner's algo filter. Each entry
@@ -76,9 +77,20 @@ SCHEDULES = {
         # registered form runs bidir — halves ride opposite rotations on
         # full-duplex links) with a wide (radix)-operand fold per round —
         # the schedule the cost model keeps at bandwidth sizes
-        # (collectives/khd.py)
-        "khd": lambda v, _, op="sum", root=0:
-            C.khd_allreduce(v, RANK_AXIS, op=op, bidir=True),
+        # (collectives/khd.py). ``digits``: the round radices — resolved
+        # by the radix-ladder model at dispatch when not given
+        # (tuner.khd_model_digits; VERDICT r3 missing #1)
+        "khd": lambda v, _, op="sum", root=0, digits=None:
+            C.khd_allreduce(v, RANK_AXIS, op=op, bidir=True,
+                            **({} if digits is None else
+                               {"digits": digits})),
+        # topology-mapped khd (2-D mesh): digits = the mesh shape, round t
+        # rides ONLY mesh axis t — on a torus every exchange stays inside
+        # one physical ring dimension, and the tuner's khd2d row prices
+        # each rotation's min(o, d-o) torus hops EXACTLY (collectives/
+        # khd.py khd2d_allreduce; VERDICT r3 next #3)
+        "khd2d": lambda v, axes, op="sum", root=0:
+            C.khd2d_allreduce(v, axes, op=op, bidir=True),
         "dtree": lambda v, _, op="sum", root=0:
             C.dbtree_allreduce(v, RANK_AXIS, op=op),
         # chunk-pipelined double binary tree: C chunks stream through the
@@ -111,8 +123,10 @@ SCHEDULES = {
             C.ring_reduce_scatter(v, RANK_AXIS, op=op),
         # the khd RS phase standalone: sum(d_t-1) wide-fold rounds instead
         # of n-1 ring steps at the same wire bytes (collectives/khd.py)
-        "khd": lambda v, _, op="sum", root=0:
-            C.khd_reduce_scatter(v, RANK_AXIS, op=op),
+        "khd": lambda v, _, op="sum", root=0, digits=None:
+            C.khd_reduce_scatter(v, RANK_AXIS, op=op,
+                                 **({} if digits is None else
+                                    {"digits": digits})),
         "pallas_ring": lambda v, _, op="sum", root=0:
             _pallas().pallas_ring_reduce_scatter(v, RANK_AXIS) if op == "sum"
             else _raise(f"pallas_ring reduce_scatter is sum-only, got op={op!r}"),
@@ -124,8 +138,10 @@ SCHEDULES = {
             C.ring_allgather(v, RANK_AXIS).reshape(-1),
         # the khd AG phase standalone (recursive multiplying): sum(d_t-1)
         # rounds instead of n-1 at the same wire bytes
-        "khd": lambda v, _, op="sum", root=0:
-            C.khd_allgather(v, RANK_AXIS).reshape(-1),
+        "khd": lambda v, _, op="sum", root=0, digits=None:
+            C.khd_allgather(v, RANK_AXIS,
+                            **({} if digits is None else
+                               {"digits": digits})).reshape(-1),
         "pallas_ring": lambda v, _, op="sum", root=0:
             _pallas().pallas_ring_allgather(v, RANK_AXIS).reshape(-1),
     },
@@ -190,7 +206,7 @@ def supports(op: str, algo: str, is_2d: bool) -> bool:
         return True
     if algo not in SCHEDULES.get(op, {}):
         return False
-    if algo == "hierarchical":
+    if algo in ("hierarchical", "khd2d"):
         return is_2d
     if op == "sendrecv":
         return not is_2d  # a shift permutation is only defined on one ring
@@ -259,7 +275,9 @@ class Transport:
             alpha, beta, hbm_beta = constants_for(
                 getattr(dev, "device_kind", ""), op)
             picked = (model_pick(op, self.n_ranks, nbytes, candidates=cands,
-                                 alpha=alpha, beta=beta, hbm_beta=hbm_beta)
+                                 alpha=alpha, beta=beta, hbm_beta=hbm_beta,
+                                 mesh_shape=(self.mesh.devices.shape
+                                             if self.is_2d else None))
                       if nbytes is not None else None)
             algo = picked or "auto"
         if algo not in ALGOS:
@@ -359,18 +377,41 @@ class Transport:
                 return "hierarchical"
             if knobs.get("chunks") is not None:
                 return "ptree"
+            if (knobs.get("digits") is not None
+                    or knobs.get("max_radix") is not None):
+                return "khd"
         return algo
+
+    def khd_model_digits(self, verb: str, nbytes: int) -> tuple[int, ...]:
+        """The radix-ladder digits ``algo="khd"`` dispatches for this verb
+        at this message size on this mesh's chip — the same resolution the
+        cost model prices (tuner.khd_model_digits with this device's
+        calibrated constants), exposed so trace/alignment tooling can
+        predict exactly the program a dispatch ran."""
+        from rocnrdma_tpu.transport.tuner import constants_for, khd_model_digits
+        alpha, beta, hbm_beta = constants_for(
+            getattr(self.mesh.devices.flat[0], "device_kind", ""), verb)
+        return khd_model_digits(verb, self.n_ranks, nbytes,
+                                alpha, beta, hbm_beta)
 
     def _dispatch(self, verb: str, x, algo: str, **knobs):
         algo = self._force_algo(algo, **knobs)
-        resolved = self._resolve(algo, verb, self._msg_bytes(verb, x))
+        nbytes = self._msg_bytes(verb, x)
+        resolved = self._resolve(algo, verb, nbytes)
+        if (resolved == "khd" and nbytes is not None
+                and knobs.get("digits") is None
+                and knobs.get("max_radix") is None):
+            # radix is a modeled, size-dependent choice (the r4 radix
+            # ladder): resolve it here with the same function the cost
+            # model uses, so the dispatched program IS the priced one
+            knobs["digits"] = self.khd_model_digits(verb, nbytes)
         fn = self._jit(verb, resolved, **knobs)  # validates knobs first —
         self._count(verb, resolved, x)           # rejected calls don't count
         return fn(x)
 
     def allreduce(self, x, algo: str = "auto", op: str = "sum", acc=None,
                   premul=None, cross_dtype=None, intra_algo=None,
-                  chunks=None):
+                  chunks=None, digits=None, max_radix=None):
         """(ranks..., S) -> same shape; every rank row = elementwise reduction
         (``op``: sum/prod/max/min/avg). ``acc``: accumulate in this wider
         dtype and cast back — e.g. ``acc="float32"`` on bf16 buffers, the
@@ -385,21 +426,32 @@ class Transport:
         ICI phases stay full precision). ``intra_algo``: hierarchical only
         — ``"ring"``/``"khd"`` for the two ICI phases (khd = the
         mixed-radix wide-fold RS/AG pair). ``chunks``: ptree only —
-        pipeline-depth override. Each schedule-specific knob forces its
-        schedule under algo auto/model, like cross_dtype."""
+        pipeline-depth override (default: size-scaled,
+        ``ptree.ptree_auto_chunks``). ``digits``/``max_radix``: khd only —
+        the round radices, explicit tuple or a radix cap (default: the
+        radix-ladder model's pick at this size, ``khd_model_digits``).
+        Each schedule-specific knob forces its schedule under algo
+        auto/model, like cross_dtype."""
         return self._dispatch("allreduce", x, algo, op=op, acc=acc,
                               premul=premul, cross_dtype=cross_dtype,
-                              intra_algo=intra_algo, chunks=chunks)
+                              intra_algo=intra_algo, chunks=chunks,
+                              digits=digits, max_radix=max_radix)
 
     def reduce_scatter(self, x, algo: str = "auto", op: str = "sum", acc=None,
-                       premul=None):
-        """(ranks..., S) -> (ranks..., S/n); rank r keeps the reduced r-th shard."""
+                       premul=None, digits=None, max_radix=None):
+        """(ranks..., S) -> (ranks..., S/n); rank r keeps the reduced r-th
+        shard. ``digits``/``max_radix``: khd round radices (as on
+        allreduce)."""
         return self._dispatch("reduce_scatter", x, algo, op=op, acc=acc,
-                              premul=premul)
+                              premul=premul, digits=digits,
+                              max_radix=max_radix)
 
-    def allgather(self, x, algo: str = "auto"):
-        """(ranks..., c) -> (ranks..., n*c); every rank ends with the concatenation."""
-        return self._dispatch("allgather", x, algo)
+    def allgather(self, x, algo: str = "auto", digits=None, max_radix=None):
+        """(ranks..., c) -> (ranks..., n*c); every rank ends with the
+        concatenation. ``digits``/``max_radix``: khd round radices (as on
+        allreduce)."""
+        return self._dispatch("allgather", x, algo, digits=digits,
+                              max_radix=max_radix)
 
     def alltoall(self, x, algo: str = "auto"):
         """(ranks..., n, c) -> same shape, global transpose of rank x chunk dims."""
@@ -567,6 +619,23 @@ class Transport:
             if chunks < 1:
                 raise ValueError(f"chunks must be >= 1, got {chunks}")
             knobs["chunks"] = chunks  # one cache entry per depth
+        if knobs.get("max_radix") is not None:
+            # canonicalize to digits (ONE cache key form for the khd shape)
+            if knobs.get("digits") is not None:
+                raise ValueError("give digits OR max_radix, not both")
+            mr = int(knobs.pop("max_radix"))
+            if mr < 2:
+                raise ValueError(f"max_radix must be >= 2, got {mr}")
+            from rocnrdma_tpu.collectives.schedule import khd_digits
+            knobs["digits"] = khd_digits(self.n_ranks, mr)
+        if knobs.get("digits") is not None:
+            digits = tuple(int(d) for d in knobs["digits"])
+            prod = math.prod(digits)
+            if any(d < 2 for d in digits) or prod != self.n_ranks:
+                raise ValueError(
+                    f"digits {digits} must each be >= 2 and multiply to "
+                    f"the {self.n_ranks}-rank axis (product {prod})")
+            knobs["digits"] = digits
         return {k: v for k, v in knobs.items()
                 if not (k == "op" and v == "sum") and not (k == "root" and v == 0)
                 and not (k == "shift" and v == 1) and not (k == "acc" and v is None)
@@ -574,6 +643,8 @@ class Transport:
                 and not (k == "cross_dtype" and v is None)
                 and not (k == "intra_algo" and v is None)
                 and not (k == "chunks" and v is None)
+                and not (k == "digits" and v is None)
+                and not (k == "max_radix" and v is None)
                 and not (k == "donate" and not v)}
 
     # verbs whose output shape differs from the input: donating would save
@@ -641,6 +712,10 @@ class Transport:
         if "chunks" in knobs and (verb, algo) != ("allreduce", "ptree"):
             raise ValueError(
                 f"chunks is a PTREE-allreduce knob (the pipeline depth); "
+                f"got ({verb!r}, algo {algo!r})")
+        if "digits" in knobs and algo != "khd":
+            raise ValueError(
+                f"digits/max_radix is a KHD knob (the round radices); "
                 f"got ({verb!r}, algo {algo!r})")
         # ``donate``: hand the input buffer to XLA for in-place reuse — the
         # zero-copy/user-buffer-registration analogue (ncclCommRegister /

@@ -376,23 +376,28 @@ def test_bench_script_multichip_pallas_hbm_interpret_rehearsal(
 
 def test_bench_headline_kernels_match_registry():
     # cross-artifact consistency: the scored kernel set must describe the
-    # registered schedules — khd8's operand count IS the khd radix at the
-    # contract rank counts, ptree3's is the double tree's per-beat fold
-    # width (2 children + own), ring2's the ring step
+    # registered schedules — each khdN's operand count IS a radix the khd
+    # ladder can dispatch at the contract rank counts, ring2's the ring
+    # step. ptree3 is OUT since r4 (bench.py's own rule: the honest tuner
+    # keeps ptree at no size — VERDICT r3 weak #3).
     import os
 
-    from rocnrdma_tpu.collectives.schedule import khd_digits
+    from rocnrdma_tpu.transport.tuner import khd_radix_candidates
 
     src = open(os.path.join(os.path.dirname(__file__), "..",
                             "bench.py")).read()
-    for name, kern, n_ops in (("ring2", "xla2", 2), ("ptree3", "xla3", 3),
-                              ("khd8", "xla8", 8)):
+    for name, kern, n_ops in (("ring2", "xla2", 2), ("khd8", "xla8", 8),
+                              ("khd16", "xla16", 16),
+                              ("khd32", "xla32", 32),
+                              ("khd64", "xla64", 64)):
         assert f'("{name}", "{kern}", {n_ops},' in src, name
-    # khd's first-round fold width at the contract rank counts is the
-    # radix: 64 and 256 ranks both factor with a leading 8, so the xla8
-    # kernel (8 operands = own + 7 arrivals) is what algo="khd" folds
-    assert khd_digits(64)[0] == 8
-    assert khd_digits(256)[0] == 8
+    assert '"ptree3"' not in src
+    # every scored khdN fold width is a leading radix some ladder
+    # candidate dispatches at the contract rank counts
+    lead64 = {d[0] for d in khd_radix_candidates(64)}
+    assert {8, 16, 32, 64} <= lead64
+    lead256 = {d[0] for d in khd_radix_candidates(256)}
+    assert {8, 16, 32, 64} <= lead256
 
 
 def test_bench_local_bfloat16_leg(tmp_path):

@@ -1,7 +1,10 @@
 """The driver hooks (__graft_entry__) — covered in-suite so a refactor
 cannot silently break what only the driver would otherwise notice."""
 
+import os
+
 import numpy as np
+import pytest
 
 
 def test_entry_compiles_and_runs(devices):
@@ -70,3 +73,16 @@ def test_dryrun_multichip_contract_64(devices):
     # the BASELINE.json:9 rank count, end to end (measured ~13 s cold)
     out = _dryrun_in_subprocess(64)
     assert "(2, 32)" in out and "hierarchical=True" in out
+
+
+@pytest.mark.skipif(os.environ.get("RNR_SKIP_SLOW", "") not in ("", "0"),
+                    reason="RNR_SKIP_SLOW set")
+def test_dryrun_multichip_contract_128(devices):
+    # VERDICT r3 next #9: exercise the contract-scale rank-count axis
+    # (BASELINE.json:5, v5p-256) before first contact. 128 fake devices
+    # timeshare the CPU core for ~7 min — the suite's slowest single test
+    # (256 measured >15 min, past any sane CI budget; the sharding logic
+    # it would add beyond 128 is the same code paths at 2x fan-out).
+    # Skippable via RNR_SKIP_SLOW=1 for quick local loops.
+    out = _dryrun_in_subprocess(128, timeout=900)
+    assert "(2, 64)" in out and "hierarchical=True" in out

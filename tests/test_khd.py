@@ -336,3 +336,117 @@ def test_khd_rejects_2d_mesh(devices):
     x = t.shard(np.zeros((2, 4, 8), np.float32))
     with pytest.raises(ValueError, match="no 'khd' schedule on a 2-D"):
         t.allreduce(x, "khd")
+
+
+# -- r4: topology-mapped khd2d -----------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2), (2, 2, 2)])
+@pytest.mark.parametrize("bidir", [False, True])
+def test_khd2d_matches_numpy(devices, shape, bidir):
+    # per-axis rounds compute the same reduction the flat mixed-radix
+    # schedule (digits = mesh shape) simulates
+    from jax.sharding import Mesh
+
+    from rocnrdma_tpu.collectives import khd2d_allreduce
+
+    n = int(np.prod(shape))
+    axes = tuple(f"ax{i}" for i in range(len(shape)))
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(shape), axes)
+    rng = np.random.default_rng(n)
+    x = rng.standard_normal((*shape, 37)).astype(np.float32)
+    nlead = len(shape)
+    f = jax.jit(jax.shard_map(
+        lambda s: khd2d_allreduce(s.reshape(s.shape[nlead:]), axes,
+                                  bidir=bidir)[(None,) * nlead],
+        mesh=mesh, in_specs=(P(*axes),), out_specs=P(*axes),
+        check_vma=False))
+    out = np.asarray(f(x))
+    want = x.reshape(n, -1).sum(0)
+    np.testing.assert_allclose(out.reshape(n, -1),
+                               np.broadcast_to(want, (n, want.size)),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("op,npf", [("max", np.max), ("avg", None)])
+def test_khd2d_ops(devices, op, npf):
+    from jax.sharding import Mesh
+
+    from rocnrdma_tpu.collectives import khd2d_allreduce
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("a", "b"))
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 4, 16)).astype(np.float32)
+    f = jax.jit(jax.shard_map(
+        lambda s: khd2d_allreduce(s[0, 0], ("a", "b"), op=op)[None, None],
+        mesh=mesh, in_specs=(P("a", "b"),), out_specs=P("a", "b"),
+        check_vma=False))
+    out = np.asarray(f(x)).reshape(8, -1)
+    flat = x.reshape(8, -1)
+    want = flat.max(0) if op == "max" else flat.mean(0)
+    np.testing.assert_allclose(out, np.broadcast_to(want, out.shape),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_khd2d_registered_on_2d_mesh(devices):
+    # algo="khd2d" resolves on the standard ('slice','intra') mesh and
+    # matches numpy; on a 1-D mesh it is rejected
+    t2 = Transport(rt.mesh.slice_mesh(2, 4))
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((2, 4, 24)).astype(np.float32)
+    out = np.asarray(t2.allreduce(t2.shard(x), "khd2d")).reshape(8, -1)
+    want = x.reshape(8, -1).sum(0)
+    np.testing.assert_allclose(out, np.broadcast_to(want, out.shape),
+                               rtol=1e-5, atol=1e-5)
+    t1 = Transport(rt.rank_mesh(8))
+    with pytest.raises(ValueError, match="khd2d"):
+        t1.allreduce(t1.shard(np.zeros((8, 8), np.float32)), "khd2d")
+
+
+def test_khd2d_rides_single_axes(devices):
+    # every ppermute in the lowered program permutes along ONE mesh axis
+    # (the topology claim: no flat-rank strides crossing both dimensions).
+    # The jaxpr's ppermute perms are per-axis pairs, so each round's pair
+    # list must be a rotation within an axis-sized group.
+    from jax.sharding import Mesh
+
+    from rocnrdma_tpu.collectives import khd2d_allreduce
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("a", "b"))
+    jaxpr = jax.make_jaxpr(jax.shard_map(
+        lambda s: khd2d_allreduce(s[0, 0], ("a", "b"))[None, None],
+        mesh=mesh, in_specs=(P("a", "b"),), out_specs=P("a", "b"),
+        check_vma=False))(np.zeros((2, 4, 16), np.float32))
+    perms = [(e.params["axis_name"], e.params["perm"])
+             for e in jaxpr.jaxpr.eqns[0].params["jaxpr"].eqns
+             if e.primitive.name == "ppermute"]
+    assert perms, "no ppermutes found"
+    for axis, perm in perms:
+        (ax,) = axis if isinstance(axis, tuple) else (axis,)
+        assert ax in ("a", "b")
+        size = {"a": 2, "b": 4}[ax]
+        assert all(0 <= s < size and 0 <= d < size for s, d in perm)
+
+
+def test_khd2d_model_row_exact_torus():
+    from rocnrdma_tpu.transport.tuner import (
+        _khd2d_round_torus, khd2d_terms, model_pick, model_time)
+
+    # d=8: split offsets 1,2,3,5,6,7 carry min(o,8-o)*part/2 per
+    # direction (sum 6.0), the self-inverse o=4 a full part 4 hops
+    assert _khd2d_round_torus(8) == (13, 10.0)
+    assert _khd2d_round_torus(2) == (1, 1.0)
+    steps, wire, hbm = khd2d_terms((8, 8))
+    assert steps == 2 * 26
+    assert wire == pytest.approx(2 * (10.0 / 8 + 10.0 / 64))
+    # the exact torus price is HIGHER than the flat khd's one-hop
+    # abstraction at the same digits — that asymmetry is the honesty
+    from rocnrdma_tpu.transport.tuner import _khd_wire
+    assert wire > _khd_wire(64, (8, 8))
+    # model_time requires the mesh shape; model_pick skips khd2d without
+    with pytest.raises(KeyError):
+        model_time("allreduce", "khd2d", 64, 2**20)
+    assert model_pick("allreduce", 64, 2**20,
+                      candidates=("khd2d",)) is None
+    t = model_time("allreduce", "khd2d", 64, 2**20, mesh_shape=(8, 8))
+    assert t > 0

@@ -51,3 +51,23 @@ def test_load_completed_tolerates_torn_line(tmp_path):
 def test_format_table_runs():
     r = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096, "float32", 1e-6)
     assert "busbw" in M.format_table([r])
+
+
+def test_ragged_busbw_uses_counts_vector():
+    # ADVICE r3: with skewed counts the dense (n-1)/n factor misstates the
+    # busiest rank's wire; the counts-aware factor is (sum - min)/sum
+    from rocnrdma_tpu import metrics as M
+
+    counts = [100, 300, 100, 100]  # sum 600, min 100
+    sec, size = 1.0, 600 * 4
+    got = M.busbw_GBps("allgatherv", 4, size, sec, counts=counts)
+    assert got == pytest.approx(M.algbw_GBps(size, sec) * (600 - 100) / 600)
+    # balanced counts reduce to the dense factor exactly
+    bal = [150] * 4
+    assert M.busbw_GBps("reducescatterv", 4, size, sec, counts=bal) == \
+        pytest.approx(M.algbw_GBps(size, sec) * 3 / 4)
+    # without counts: unchanged dense behavior
+    assert M.busbw_GBps("allgatherv", 4, size, sec) == \
+        pytest.approx(M.algbw_GBps(size, sec) * 3 / 4)
+    # degenerate all-zero counts cannot divide by zero
+    assert M.busbw_GBps("allgatherv", 4, 0, sec, counts=[0, 0, 0, 0]) == 0.0

@@ -77,9 +77,12 @@ def test_host_tag_matching_out_of_order(host_pair):
 
 @needs_native
 def test_host_frame_limit_enforced(host_pair):
+    # r4: the hard reg_mr cap moved from the frame size to the
+    # large-message arena (isend auto-routes past MAX_FRAME over the put
+    # path); only past the arena must the caller chunk
     net, send_comm, _ = host_pair
-    with pytest.raises(ValueError, match="frame limit"):
-        net.reg_mr(send_comm, bytes(net.MAX_FRAME + 1))
+    with pytest.raises(ValueError, match="large-message limit"):
+        net.reg_mr(send_comm, bytes(net.LG_ARENA + 1))
 
 
 @needs_native
@@ -302,3 +305,82 @@ def test_device_p2p_chain(devices):
         buf = net.isend(send_comm, buf).wait()
     out = np.asarray(buf)
     np.testing.assert_array_equal(out[3], np.arange(8, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# large-message auto-route (r4: isend >= LG_MIN rides the put path)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_lg_route_boundary(host_pair):
+    # below LG_MIN: the frame path, no arena allocated on either side;
+    # at/above: the put rendezvous (receiver grows an arena) — payload
+    # identical either way
+    net, send, recv = host_pair
+    small = np.arange(net.MAX_FRAME // 4, dtype=np.uint32).tobytes()
+    req = net.irecv(recv, len(small), tag=7)
+    net.isend(send, net.reg_mr(send, small), tag=7)
+    req.wait()
+    assert req.payload == small
+    assert recv._lg_mr is None and send._lg_peer is None
+
+    big = np.arange((net.LG_MIN + 3) // 4, dtype=np.uint32).tobytes()
+    req = net.irecv(recv, len(big), tag=8)
+    net.isend(send, net.reg_mr(send, big), tag=8,
+              progress=lambda: req.test())
+    req.wait()
+    assert req.payload == big
+    assert recv._lg_mr is not None     # receiver allocated its arena
+    assert send._lg_peer is not None   # sender learned (rkey, size)
+    assert send._lg_peer[1] == net.LG_ARENA
+
+
+@needs_native
+def test_lg_reg_mr_accepts_past_frame_limit(host_pair):
+    # reg_mr's cap is now the arena, not the frame (isend routes); past
+    # the arena the caller must chunk, as before
+    net, send, _ = host_pair
+    net.reg_mr(send, bytearray(2 * net.MAX_FRAME))
+    with pytest.raises(ValueError, match="large-message limit"):
+        net.reg_mr(send, bytearray(net.LG_ARENA + 1))
+
+
+@needs_native
+def test_lg_credit_cycles_and_resets(host_pair, monkeypatch):
+    # a small arena forces the bump allocator through ACK-credit waits and
+    # head resets across many messages; contents must survive every cycle
+    net, send, recv = host_pair
+    monkeypatch.setattr(HostQPNet, "LG_MIN", 1 << 16)
+    monkeypatch.setattr(HostQPNet, "LG_ARENA", 3 << 16)  # holds 3 messages
+    rng = np.random.default_rng(0)
+    for i in range(10):
+        msg = rng.integers(0, 256, size=net.LG_MIN, dtype=np.uint8).tobytes()
+        req = net.irecv(recv, len(msg), tag=100 + i)
+        net.isend(send, net.reg_mr(send, msg), tag=100 + i,
+                  progress=lambda r=req: r.test())
+        req.wait()
+        assert req.payload == msg, i
+    # every byte is ACKed back (credit drain is lazy — it happens on the
+    # next isend — so pump explicitly here) and the allocator fully resets
+    import time
+    deadline = time.monotonic() + 5
+    while send._lg_outstanding and time.monotonic() < deadline:
+        send._pump()
+        net._lg_drain_acks(send)
+    assert send._lg_outstanding == 0
+
+
+@needs_native
+def test_lg_send_completes_before_irecv_and_delivers_late(host_pair):
+    # arenas are announced at comm setup / first use on EVERY comm (the
+    # symmetric-blocking-send deadlock fix), so a large isend completes
+    # without a posted irecv — frame-path parity — and a LATE irecv still
+    # delivers the buffered payload
+    net, send, recv = host_pair
+    big = np.arange((net.LG_MIN + 3) // 4, dtype=np.uint32).tobytes()
+    net.isend(send, net.reg_mr(send, big), tag=9,
+              progress=recv._pump)  # peer pumps, as any live process does
+    req = net.irecv(recv, len(big), tag=9)
+    req.wait()
+    assert req.payload == big
