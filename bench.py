@@ -271,6 +271,26 @@ def main() -> int:
                                out_specs=P("rank"), check_vma=False)
             return jax.jit(lambda v: sh(v)[0, 0])
 
+        def _balanced_factor(m: int):
+            """(s, p) with s*p = m, s as close to sqrt(m) as divisors allow
+            and both >= 2; None when m is prime or < 4."""
+            import math as _math
+            for s in range(int(_math.isqrt(m)), 1, -1):
+                if m % s == 0:
+                    return s, m // s
+            return None
+
+        def make_khd2d_chain(k, mesh2):
+            axes = mesh2.axis_names
+
+            def local(sx):
+                body = lambda _, y: C.khd2d_allreduce(y, axes) * inv_n
+                out = lax.fori_loop(0, k, body, sx[0, 0])
+                return out.ravel()[:1][None, None]
+            sh = jax.shard_map(local, mesh=mesh2, in_specs=(P(*axes),),
+                               out_specs=P(*axes), check_vma=False)
+            return jax.jit(lambda v: sh(v)[0, 0, 0])
+
         def run_mc_leg(nbytes):
             """Best-of at one size; ({}, x0) if every candidate failed (a
             failing candidate loses the best-of, it must not abort the
@@ -295,6 +315,27 @@ def main() -> int:
                         trials=1 if on_cpu else 3)
                 except Exception as e:
                     print(f"# algo {name} failed: {type(e).__name__}: "
+                          f"{str(e)[:200]}", file=sys.stderr)
+            # the topology-mapped flagship (khd2d) competes over a 2-D
+            # ('slice','intra') mesh of the same chips when n factors —
+            # on a physical torus its rounds stay inside one ring
+            # dimension each, the form whose wire cost the tuner prices
+            # exactly (collectives/khd.py khd2d_allreduce)
+            fac = _balanced_factor(n)
+            if fac is not None:
+                try:
+                    mesh2 = rt.slice_mesh(*fac, devices=list(
+                        mesh.devices.flat))
+                    x2 = jax.device_put(
+                        x0.reshape(fac[0], fac[1], elems),
+                        NamedSharding(mesh2, P(*mesh2.axis_names)))
+                    leg["khd2d"] = _marginal_trials(
+                        functools.partial(make_khd2d_chain, mesh2=mesh2),
+                        (x2,), k1=2, k2=8 if on_cpu else 32,
+                        repeats=3 if on_cpu else 5,
+                        trials=1 if on_cpu else 3)
+                except Exception as e:
+                    print(f"# algo khd2d failed: {type(e).__name__}: "
                           f"{str(e)[:200]}", file=sys.stderr)
             return leg, x0
 
@@ -410,14 +451,19 @@ def main() -> int:
                                           "pick at n=64: digits (64,) — "
                                           "the direct-exchange RS/AG "
                                           "with one 64-operand fold)"))
-        # total addend footprint per kernel (the widest fold reads its
-        # operands as ~S/d parts in the real schedule; the 256 MiB
-        # fallback rung shrinks per-operand sizes, not this cap)
-        ADDEND_BUDGET = 3584 * M.MiB if not on_cpu else 8 * M.MiB
+        # operand sizing is THE fold_ladder protocol (one shared helper —
+        # the headline kernels are calibrated against that ladder, so the
+        # two sizings must never drift); the CPU oracle shrinks the
+        # budget/floor, and the 256 MiB fallback rung shrinks per-operand
+        # caps, not the budget
+        from rocnrdma_tpu.bench.fold_ladder import (
+            ADDEND_BUDGET as _LADDER_BUDGET, ladder_op_elems)
+        ADDEND_BUDGET = _LADDER_BUDGET if not on_cpu else 8 * M.MiB
 
         def op_elems(n_ops: int, nbytes: int) -> int:
-            return (min(nbytes, ADDEND_BUDGET // (n_ops - 1)) // 4
-                    // 1024 * 1024)
+            return ladder_op_elems(
+                n_ops, nbytes, ADDEND_BUDGET,
+                floor=4 * M.MiB if not on_cpu else 64 * M.KiB)
 
         def gen_args(n_ops: int, nbytes: int):
             elems = op_elems(n_ops, nbytes)

@@ -43,6 +43,22 @@ from rocnrdma_tpu.bench.timing import marginal_trials
 # the r2 ktree9 headline) so the new points splice into the known curve.
 DEFAULT_WIDTHS = (2, 3, 4, 8, 9, 12, 16, 24, 32, 48, 64)
 
+# THE operand-sizing protocol, shared with bench.py's headline kernels
+# (one copy: the headline is calibrated against this ladder, so the two
+# must never drift): addend buffers shrink as width grows under a total
+# footprint budget, floored so narrow widths stay HBM-bound, capped at
+# the contract size per operand.
+ADDEND_BUDGET = 3584 * M.MiB   # total addend footprint per width (TPU)
+OP_FLOOR = 4 * M.MiB           # per-operand floor (TPU)
+
+
+def ladder_op_elems(n_ops: int, per_op_cap: int,
+                    budget: int = ADDEND_BUDGET,
+                    floor: int = OP_FLOOR) -> int:
+    """Per-operand fp32 element count for an ``n_ops``-wide fold chain."""
+    per = min(per_op_cap, max(floor, budget // max(1, n_ops - 1)))
+    return (per // 4) // 1024 * 1024
+
 
 def run_ladder(widths, addend_budget: int, per_op_cap: int, k1: int,
                k2: int, repeats: int, trials: int, out_path=None):
@@ -56,11 +72,10 @@ def run_ladder(widths, addend_budget: int, per_op_cap: int, k1: int,
     on_cpu = dev.platform == "cpu"
     rows = []
     for w in widths:
-        n_add = w - 1
-        # per-operand bytes: fill the addend budget, capped at the contract
-        # size per operand, floored at 4 MiB so tiny widths stay HBM-bound
-        per = min(per_op_cap, max(4 * M.MiB, addend_budget // n_add))
-        elems = (per // 4) // 1024 * 1024
+        # the shared sizing protocol (ladder_op_elems); the CPU-oracle
+        # caller shrinks budget/cap so the floor is cap-bound there
+        elems = ladder_op_elems(w, per_op_cap, addend_budget,
+                                floor=min(4 * M.MiB, per_op_cap))
         gen = jax.jit(lambda key, e=elems: jax.random.normal(
             key, (e,), jnp.float32))
         args = tuple(jax.block_until_ready(gen(k))

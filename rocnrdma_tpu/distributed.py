@@ -375,16 +375,13 @@ class ProcessGroup:
         # frames; tx pumps drive queued user-space tx (an irecv wait issued
         # before a send handle's flush must still make the outbound tail
         # progress, or symmetric large batches wedge on full kernel buffers).
-        # Rx comms also get their large-message arena ensured/announced
-        # here (r4): a rank blocked in a LARGE send can only unblock once
-        # its peer's announce arrives, and the peer may itself be blocked
-        # sending — this engine runs inside that blocked send, so the
-        # announce flows even when no irecv has been posted yet.
-        ensure = getattr(self._net, "_lg_ensure", None)
+        # Large-message arena announces also flow through these pumps: a
+        # peer blocked in a big send posts a _LG_REQ frame, and the pump
+        # answers it with an on-demand ensure+announce (plugin._HostComm.
+        # _pump) — on demand, not eagerly, so small-message workloads
+        # never pay k x LG_ARENA of MR capacity.
         for (peer, d), wire in list(self._p2p.items()):
             comm = wire.recv_comm if d == "rx" else wire.send_comm
-            if d == "rx" and ensure is not None:
-                ensure(comm)
             comm._pump()
 
     def _p2p_wire(self, peer: int, direction: str, timeout_s: float = 30.0):

@@ -90,8 +90,9 @@ def main(argv=None) -> int:
                    help="measured-sweep size grid")
     p.add_argument("--verbs",
                    default="allreduce,alltoall,allgather,reduce_scatter")
-    p.add_argument("--align-algo", default="khd",
-                   help="schedule for the step-alignment capture")
+    p.add_argument("--align-algo", default=None,
+                   help="schedule for the step-alignment capture "
+                        "(default: khd on a 1-D mesh, khd2d on --mesh2d)")
     p.add_argument("--align-size", default="4M")
     p.add_argument("--model-table", default=None,
                    help="model-derived table to merge under the measured "
@@ -101,6 +102,10 @@ def main(argv=None) -> int:
                    help="skip step 1 (e.g. when the driver already ran it)")
     args = p.parse_args(argv)
 
+    if args.align_algo is None:
+        # the 1-D explicit schedules don't resolve on a 2-D mesh; align
+        # the topology-mapped flagship there instead
+        args.align_algo = "khd2d" if args.mesh2d else "khd"
     os.makedirs(args.outdir, exist_ok=True)
     from rocnrdma_tpu import metrics as M
     from rocnrdma_tpu.bench import cli_common
@@ -212,6 +217,9 @@ def main(argv=None) -> int:
                  "--ranks", str(t.n_ranks), "--size", args.align_size,
                  "--measured", "--align-steps", "--out", out,
                  "--platform", args.platform]
+        if args.mesh2d:
+            # 2-D-mesh schedules (khd2d/hierarchical) trace per mesh shape
+            argv2 += ["--mesh2d", args.mesh2d]
         if args.fake_devices:
             argv2 += ["--fake-devices", str(args.fake_devices)]
         T.main(argv2)

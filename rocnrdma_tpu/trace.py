@@ -319,8 +319,22 @@ _GENERATORS = {
 
 
 def schedule_events(collective: str, algo: str, n: int, nbytes: int,
-                    mesh2d: tuple[int, int] | None = None) -> list[Event]:
-    """The full event list of one collective call's schedule."""
+                    mesh2d: tuple[int, int] | None = None,
+                    digits=None) -> list[Event]:
+    """The full event list of one collective call's schedule.
+
+    ``digits``: khd only — the round radices of the dispatch being
+    predicted. The production dispatch resolves digits per size via the
+    radix-ladder model (``Transport.khd_model_digits``), so aligning a
+    capture of it requires pinning the same digits here; the default is
+    the radix-8 factorization ``jit_fn(verb, "khd")`` (no knobs) runs."""
+    if digits is not None:
+        phases = {"allreduce": ("rs", "ag"), "reducescatter": ("rs",),
+                  "allgather": ("ag",)}.get(collective)
+        if algo != "khd" or phases is None:
+            raise ValueError("digits pins the khd radices; use with "
+                             "--algo khd and a khd-family collective")
+        return khd_events(n, nbytes, digits=digits, phases=phases)
     if algo == "hierarchical":
         if collective not in ("allreduce", "alltoall") or mesh2d is None:
             raise ValueError("hierarchical tracing needs --collective "
@@ -328,6 +342,16 @@ def schedule_events(collective: str, algo: str, n: int, nbytes: int,
         gen2 = (hierarchical_events if collective == "allreduce"
                 else hierarchical_a2a_events)
         return gen2(*mesh2d, nbytes)
+    if algo == "khd2d":
+        # topology-mapped khd IS mixed-radix khd with digits = the mesh
+        # shape — same rounds, substeps, split predicate, and byte sizes;
+        # only the permutation carrier (per-axis rotation vs flat-rank
+        # digit rotation, the same mapping on flattened ids) differs — so
+        # its predicted lane is khd's with the digits pinned
+        if collective != "allreduce" or mesh2d is None:
+            raise ValueError("khd2d tracing needs --collective allreduce "
+                             "and --mesh2d SLICESxPER")
+        return khd_events(mesh2d[0] * mesh2d[1], nbytes, digits=mesh2d)
     gen = _GENERATORS.get((collective, algo))
     if gen is None:
         raise ValueError(
@@ -492,7 +516,7 @@ def align_steps(events: list[Event], lanes: list,
 
 def profile_collective(collective: str, algo: str, ranks: int,
                        nbytes: int, mesh2d, fake_devices, platform: str,
-                       dtype: str = "float32") -> list:
+                       dtype: str = "float32", digits=None) -> list:
     """Run the collective once on the live backend under an XProf capture
     and return its measured lanes. Shares the bench runner's input builder
     and the Transport's jit cache so the profiled program is EXACTLY the
@@ -517,7 +541,8 @@ def profile_collective(collective: str, algo: str, ranks: int,
                         mesh.devices.shape if t.is_2d else None,
                         nbytes, dtype)
     xs = t.shard(x)
-    fn = t.jit_fn(verb, algo)
+    fn = t.jit_fn(verb, algo, **({"digits": tuple(digits)}
+                                 if digits is not None else {}))
     jax.block_until_ready(fn(xs))  # compile + warm outside the capture
     d = tempfile.mkdtemp(prefix="rnr_xprof_")
     with jax.profiler.trace(d):
@@ -564,6 +589,13 @@ def main(argv=None) -> int:
     p.add_argument("--fake-devices", type=int, default=None,
                    help="with --measured: CPU-oracle backend size")
     p.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    p.add_argument("--digits", default=None, metavar="D0,D1,...",
+                   help="khd only: pin the round radices to the dispatch "
+                        "being predicted (the production policies resolve "
+                        "digits per size via the radix-ladder model — "
+                        "Transport.khd_model_digits names the pick); with "
+                        "--measured the live run dispatches these digits "
+                        "too, so the lanes align")
     args = p.parse_args(argv)
 
     mesh2d = None
@@ -571,8 +603,10 @@ def main(argv=None) -> int:
         s, per = args.mesh2d.lower().split("x")
         mesh2d = (int(s), int(per))
         args.ranks = mesh2d[0] * mesh2d[1]
+    digits = (tuple(int(d) for d in args.digits.split(","))
+              if args.digits else None)
     events = schedule_events(args.collective, args.algo, args.ranks,
-                             parse_size(args.size), mesh2d)
+                             parse_size(args.size), mesh2d, digits=digits)
     doc = to_chrome_trace(events, args.alpha, args.beta)
 
     measured_note = ""
@@ -580,7 +614,8 @@ def main(argv=None) -> int:
         lanes = (measured_lanes(args.xplane) if args.xplane else
                  profile_collective(args.collective, args.algo, args.ranks,
                                     parse_size(args.size), mesh2d,
-                                    args.fake_devices, args.platform))
+                                    args.fake_devices, args.platform,
+                                    digits=digits))
         if not lanes:
             raise SystemExit(
                 "--measured: no schedule-data-path events matched in the "

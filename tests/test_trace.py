@@ -301,3 +301,40 @@ def test_alignment_rederives_on_oracle():
         pytest.skip("no capture lane carried all 20 permutes (thread-pool "
                     "split); the committed artifact covers the claim")
     assert len(diff) == 20
+
+
+def test_khd2d_events_match_dispatch_shape(devices):
+    # the khd2d predicted lane (khd events at digits = mesh shape) has
+    # exactly as many steps as the jitted khd2d program has ppermutes
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from rocnrdma_tpu import trace as T
+    from rocnrdma_tpu.collectives import khd2d_allreduce
+
+    ev = T.schedule_events("allreduce", "khd2d", 8, 4096, (2, 4))
+    n_steps = max(e.step for e in ev) + 1
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("a", "b"))
+    jaxpr = jax.make_jaxpr(jax.shard_map(
+        lambda s: khd2d_allreduce(s[0, 0], ("a", "b"))[None, None],
+        mesh=mesh, in_specs=(P("a", "b"),), out_specs=P("a", "b"),
+        check_vma=False))(np.zeros((2, 4, 1024), np.float32))
+    perms = [e for e in jaxpr.jaxpr.eqns[0].params["jaxpr"].eqns
+             if e.primitive.name == "ppermute"]
+    assert n_steps == len(perms)
+
+
+def test_khd_digits_knob_pins_the_predicted_lane():
+    # the production khd dispatch resolves digits per size (the radix
+    # ladder); schedule_events(digits=...) predicts exactly that program
+    from rocnrdma_tpu import trace as T
+
+    ev84 = T.schedule_events("allreduce", "khd", 8, 4096)            # (8,)
+    ev42 = T.schedule_events("allreduce", "khd", 8, 4096, digits=(4, 2))
+    assert max(e.step for e in ev84) + 1 == 26   # radix-8 default
+    assert max(e.step for e in ev42) + 1 == 12   # (4,2): 2*(5+1)
+    with pytest.raises(ValueError, match="digits pins"):
+        T.schedule_events("allreduce", "ring", 8, 4096, digits=(4, 2))
+    with pytest.raises(ValueError, match="digits pins"):
+        T.schedule_events("alltoall", "khd", 8, 4096, digits=(4, 2))

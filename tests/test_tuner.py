@@ -546,3 +546,16 @@ def test_alpha_sensitivity_documented():
     # model_table embeds the audit on every fresh build
     t = model_table("v5 lite", [8], ["allreduce"], sizes)
     assert "alpha_sensitivity" in t.meta
+
+
+def test_model_policy_resolves_on_2d_mesh_with_khd2d():
+    # algo="model" on a 2-D mesh passes the mesh shape through, so khd2d
+    # competes (and the resolution dispatches cleanly whatever wins)
+    t = Transport(rt.mesh.slice_mesh(2, 4))
+    x = t.shard(np.ones((2, 4, 16), np.float32))
+    picked = t._resolve("model", "allreduce", nbytes=16 * 4)
+    assert picked in ("tree", "khd", "khd2d", "ring", "ring_bidir",
+                      "dtree", "ktree", "ptree", "fused", "auto",
+                      "hierarchical")
+    out = np.asarray(t.allreduce(x, "model"))
+    np.testing.assert_allclose(out, 8.0)
