@@ -45,6 +45,17 @@ import sys
 _CPU_FALLBACK = (50.0, 10.0)  # oracle runs: keep vs_baseline finite
 
 
+def _median(xs):
+    """True median: the mean of the two middle elements on even-length
+    pools. The upper-middle shortcut (sorted[n//2]) systematically lands
+    in the FAST mode when a bimodal backend splits the pool evenly —
+    re-smuggling a sliver of best-of-N into a stat labeled median."""
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
 def _roofline(device) -> tuple:
     # chip figures live in ONE place, rocnrdma_tpu.hw (the tuner's
     # calibrated cost model reads the same table)
@@ -357,8 +368,8 @@ def main() -> int:
                   f"trying the next size", file=sys.stderr)
         if not secs:  # not assert: -O must not turn this into a min() crash
             raise RuntimeError("every allreduce candidate failed")
-        med = lambda s: sorted(s)[len(s) // 2]
-        winner = min(secs, key=lambda a: med(secs[a]))
+        winner = min(secs, key=lambda a: _median(secs[a]))
+        med = _median
         # listing prints the MEDIANS the ranking used (printing mins here
         # would let a losing algo show the smaller number)
         print(f"# allreduce @ {elems * 4 >> 20} MiB/rank — winner: {winner} "
@@ -369,7 +380,7 @@ def main() -> int:
         # scored value = MEDIAN of the winner's trials (VERDICT r3 item 2:
         # the driver's number must not be best-of-N on a bimodal backend);
         # the max stays visible in the spread
-        value = wt[len(wt) // 2]
+        value = _median(wt)
         target = 0.9 * ici_bw
         out = {"metric": "allreduce_busbw_GBps_per_chip", "value": round(value, 3),
                "unit": "GB/s", "vs_baseline": round(value / target, 4),
@@ -509,7 +520,7 @@ def main() -> int:
                         # (median, trials, elems): median ranks and scores;
                         # the spread shows the bimodal window a point
                         # estimate hides (VERDICT r2 item 3)
-                        leg[name] = (span[len(span) // 2], span, elems)
+                        leg[name] = (_median(span), span, elems)
                         break
                     print(f"# {name}@k2={k2}: {span[-1]:.0f} GB/s exceeds "
                           f"the {hbm_bw:.0f} GB/s HBM roofline (loop "
@@ -595,7 +606,7 @@ def main() -> int:
             except Exception as e:
                 print(f"# winner rerun failed (keeping first-run spread): "
                       f"{type(e).__name__}: {str(e)[:120]}", file=sys.stderr)
-        value = trials_gbps[len(trials_gbps) // 2]
+        value = _median(trials_gbps)
         out = {"metric": "local_reduce_GBps", "value": round(value, 3),
                "unit": "GB/s", "vs_baseline": round(value / target, 4),
                # self-describing scored artifact (ADVICE r2): which kernel

@@ -40,7 +40,9 @@ from rocnrdma_tpu.collectives.ring import (  # noqa: F401
 )
 from rocnrdma_tpu.collectives.tree import hd_allreduce  # noqa: F401
 from rocnrdma_tpu.collectives.khd import (  # noqa: F401
+    khd2d_allgather,
     khd2d_allreduce,
+    khd2d_reduce_scatter,
     khd_allgather,
     khd_allreduce,
     khd_reduce_scatter,

@@ -119,6 +119,55 @@ def khd2d_allreduce(x: jax.Array, axis_names, op: str = "sum",
     return finalize(buf[:size].reshape(shape), op, n)
 
 
+def khd2d_reduce_scatter(x: jax.Array, axis_names, op: str = "sum",
+                         bidir: bool = True) -> jax.Array:
+    """The khd2d RS phase standalone (the ZeRO/FSDP gradient-shard verb on
+    a 2-D mesh): per-device ``(n*c,)`` in, reduced ``(c,)`` chunk out,
+    where the kept chunk index IS the flat row-major rank over
+    ``axis_names`` — the mixed-radix segment arithmetic of the flat verb
+    (khd_reduce_scatter) with each round riding one mesh axis."""
+    axis_names = tuple(axis_names)
+    digits = tuple(lax.axis_size(a) for a in axis_names)
+    n = 1
+    for d in digits:
+        n *= d
+    if x.size % n:
+        raise ValueError(f"reduce_scatter needs size divisible by {n} ranks, "
+                         f"got {x.size}")
+    if n == 1:
+        return finalize(x.reshape(-1), op, 1)
+    buf, seg_start, chunk, _digits = _khd_rs_phase(
+        x, None, op, digits, None, bidir, axes=axis_names)
+    out = lax.dynamic_slice_in_dim(buf, seg_start, chunk)
+    return finalize(out, op, n)
+
+
+def khd2d_allgather(x: jax.Array, axis_names,
+                    bidir: bool = True) -> jax.Array:
+    """The khd2d AG phase standalone (recursive multiplying per mesh
+    axis): rank (i0, i1, ...) contributes its ``(c,)`` chunk; every rank
+    returns the ``(n, c)`` concatenation in flat row-major rank order."""
+    axis_names = tuple(axis_names)
+    digits = tuple(lax.axis_size(a) for a in axis_names)
+    n = 1
+    for d in digits:
+        n *= d
+    if n == 1:
+        return x.reshape(1, -1)
+    strides = khd_strides(digits)
+    dig = [lax.axis_index(a) for a in axis_names]
+    chunk = x.size
+    buf = jnp.zeros((n * chunk,), x.dtype)
+    seg_start = jnp.int32(0)
+    for t, s in enumerate(strides):
+        seg_start = seg_start + dig[t] * (s * chunk)
+    buf = lax.dynamic_update_slice_in_dim(buf, x.reshape(-1), seg_start,
+                                          axis=0)
+    buf = _khd_ag_phase(buf, seg_start, chunk, digits, None, bidir,
+                        axes=axis_names)
+    return buf.reshape(n, chunk)
+
+
 def _split_offset(bidir: bool, d: int, part: int, o: int) -> bool:
     """Does substep ``o`` of a radix-``d`` round split across the two
     rotations? Not when: unidirectional; d = 2 (the pair exchange is

@@ -76,7 +76,7 @@ def _step(outdir, name, fn):
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
-        prog="first_contact", description=main.__doc__ or "")
+        prog="first_contact", description=__doc__)
     p.add_argument("--outdir", default="results/first_contact")
     p.add_argument("--ranks", type=int, default=None,
                    help="rank count (default: every device jax sees)")
@@ -217,6 +217,14 @@ def main(argv=None) -> int:
                  "--ranks", str(t.n_ranks), "--size", args.align_size,
                  "--measured", "--align-steps", "--out", out,
                  "--platform", args.platform]
+        if args.align_algo == "khd":
+            # pin the digits production algo="khd" dispatches AT THIS SIZE
+            # (the radix-ladder pick) — aligning the default radix-8
+            # factorization would validate a schedule the production
+            # policies never run here
+            digs = t.khd_model_digits("allreduce",
+                                      parse_size(args.align_size))
+            argv2 += ["--digits", ",".join(str(d) for d in digs)]
         if args.mesh2d:
             # 2-D-mesh schedules (khd2d/hierarchical) trace per mesh shape
             argv2 += ["--mesh2d", args.mesh2d]

@@ -91,7 +91,8 @@ def fold_rate_scale(n_ops: int) -> float:
 #   compiled loop, MEASURED on this repo's real v5e via
 #   ``tuner.measure_alpha()`` (chained marginal of a 4 KiB fused combine,
 #   k1=4096/k2=65536 so the ~92 ms depth gap dominates the relay's jitter):
-#   five runs gave 7-77 ns, median 32 ns. The previous alpha was a 1 us
+#   five r3 runs gave 7-77 ns, median 32 ns; an r4 re-measurement landed
+#   33.0 ns, on the median. The previous alpha was a 1 us
 #   GUESS for the sum; the measurement shows dispatch is ~3% of it — the
 #   hop term dominates, and the calibrated sum below is what
 #   ``tuner.constants_for`` now returns.

@@ -127,6 +127,10 @@ SCHEDULES = {
             C.khd_reduce_scatter(v, RANK_AXIS, op=op,
                                  **({} if digits is None else
                                     {"digits": digits})),
+        # topology-mapped RS phase (2-D mesh; the FSDP gradient-shard verb
+        # whose every round stays inside one torus axis)
+        "khd2d": lambda v, axes, op="sum", root=0:
+            C.khd2d_reduce_scatter(v, axes, op=op),
         "pallas_ring": lambda v, _, op="sum", root=0:
             _pallas().pallas_ring_reduce_scatter(v, RANK_AXIS) if op == "sum"
             else _raise(f"pallas_ring reduce_scatter is sum-only, got op={op!r}"),
@@ -142,6 +146,9 @@ SCHEDULES = {
             C.khd_allgather(v, RANK_AXIS,
                             **({} if digits is None else
                                {"digits": digits})).reshape(-1),
+        # topology-mapped AG phase (2-D mesh; FSDP's param-unshard verb)
+        "khd2d": lambda v, axes, op="sum", root=0:
+            C.khd2d_allgather(v, axes).reshape(-1),
         "pallas_ring": lambda v, _, op="sum", root=0:
             _pallas().pallas_ring_allgather(v, RANK_AXIS).reshape(-1),
     },

@@ -334,7 +334,14 @@ class HostQPNet:
         try:
             comm._lg_mr = self.alloc_mr(comm, self.LG_ARENA)
         except Exception:
-            comm._lg_dead = True  # plane without a usable MR arena
+            # no usable MR arena (capacity exhausted): NACK with size=0 so
+            # the peer's large sends fail FAST with the real diagnosis
+            # instead of spinning to a misleading announce timeout
+            comm._lg_dead = True
+            ann = (0).to_bytes(8, "little") + (0).to_bytes(8, "little")
+            data = self._LG_RKEY_TAG.to_bytes(4, "little") + ann
+            self._post_backpressured(comm, lambda: comm.qp.post_send(data),
+                                     "send ring full", 10.0, None)
             return
         ann = (comm._lg_mr.rkey.to_bytes(8, "little")
                + self.LG_ARENA.to_bytes(8, "little"))
@@ -383,6 +390,12 @@ class HostQPNet:
                     "arena announce (no matching >= LG_MIN irecv posted?)")
             back.pause()
         rkey, arena = comm._lg_peer
+        if arena == 0:
+            # the peer NACKed: its MR capacity could not fit an arena
+            raise OSError(
+                "host net: peer has no large-message arena (MR capacity "
+                "exhausted on its side); chunk at the caller below "
+                f"LG_MIN={self.LG_MIN} B or raise the peer's mr_capacity")
         need = len(mr)
         # 2. bump-allocate a window; reset to 0 when everything prior is
         # ACKed; block on credit otherwise (single writer per direction)

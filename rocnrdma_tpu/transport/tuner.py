@@ -376,11 +376,13 @@ _MODEL = {
     # one khd phase: half the allreduce's steps/wire/folds
     ("reduce_scatter", "khd"): lambda n: (
         _khd_steps(n) // 2, _khd_wire(n) / 2, _khd_hbm(n)),
+    ("reduce_scatter", "khd2d"): None,  # per mesh shape, like allreduce
     ("reduce_scatter", "pallas_ring"): lambda n: (
         n - 1, (n - 1) / n, 3 * (n - 1) / n),
     ("allgather", "ring"): lambda n: (n - 1, (n - 1) / n, 0.0),
     ("allgather", "khd"): lambda n: (
         _khd_steps(n) // 2, _khd_wire(n) / 2, 0.0),
+    ("allgather", "khd2d"): None,  # per mesh shape, like allreduce
     ("allgather", "pallas_ring"): lambda n: (n - 1, (n - 1) / n, 0.0),
     ("alltoall", "ring"): lambda n: (n - 1, (n - 1) / n, 0.0),  # rotation
     ("alltoall", "bruck"): lambda n: (_L(n), _L(n) / 2, 0.0),
@@ -417,6 +419,10 @@ def model_time(verb: str, algo: str, n: int, nbytes: int,
             raise KeyError("khd2d is modeled per mesh shape; pass "
                            "mesh_shape=(d0, d1, ...)")
         steps, wire, hbm = khd2d_terms(mesh_shape)
+        if verb == "reduce_scatter":
+            steps, wire = steps // 2, wire / 2
+        elif verb == "allgather":
+            steps, wire, hbm = steps // 2, wire / 2, 0.0
         return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
     if algo == "khd" and (verb, algo) in _MODEL:
         digits = khd_model_digits(verb, n, nbytes, alpha, beta, hbm_beta)

@@ -411,3 +411,21 @@ def test_bench_local_bfloat16_leg(tmp_path):
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert all(r["dtype"] == "bfloat16" for r in rows)
     assert all(r["GBps"] > 0 for r in rows)
+
+
+def test_bench_median_is_the_true_median():
+    # even-length pools take the MEAN of the two middles — the
+    # upper-middle shortcut lands in the fast mode when a bimodal backend
+    # splits the pool evenly (review r4)
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_script_median", os.path.join(os.path.dirname(__file__),
+                                            "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench._median([1.0, 3.0]) == 2.0
+    assert bench._median([700.0, 701.0, 780.0, 781.0]) == 740.5
+    assert bench._median([5.0]) == 5.0
+    assert bench._median([3.0, 1.0, 2.0]) == 2.0

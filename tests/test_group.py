@@ -142,3 +142,25 @@ def test_group_on_2d_mesh(devices):
     want = np.broadcast_to(x.sum((0, 1)), x.shape)
     np.testing.assert_allclose(np.asarray(h1.result()), want, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(h2.result()), want, rtol=1e-5)
+
+
+def test_group_khd2d_on_2d_mesh(devices):
+    # grouped launches compose with the topology-mapped schedules: one XLA
+    # module carrying a khd2d allreduce + a fused alltoall over the 2-D mesh
+    import numpy as np
+
+    from rocnrdma_tpu import runtime as rt
+    from rocnrdma_tpu.transport import Transport
+
+    t = Transport(rt.mesh.slice_mesh(2, 4))
+    rng = np.random.default_rng(11)
+    g1 = rng.standard_normal((2, 4, 24)).astype(np.float32)
+    g2 = rng.standard_normal((2, 4, 8, 2)).astype(np.float32)
+    with t.group() as g:
+        h1 = g.allreduce(t.shard(g1), algo="khd2d")
+        h2 = g.alltoall(t.shard(g2), algo="fused")
+    out1 = np.asarray(h1.result()).reshape(8, 24)
+    np.testing.assert_allclose(
+        out1, np.broadcast_to(g1.reshape(8, 24).sum(0), (8, 24)),
+        rtol=1e-5, atol=1e-5)
+    assert np.asarray(h2.result()).shape == g2.shape

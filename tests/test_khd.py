@@ -450,3 +450,69 @@ def test_khd2d_model_row_exact_torus():
                       candidates=("khd2d",)) is None
     t = model_time("allreduce", "khd2d", 64, 2**20, mesh_shape=(8, 8))
     assert t > 0
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+@pytest.mark.parametrize("bidir", [False, True])
+def test_khd2d_reduce_scatter(devices, shape, bidir):
+    from jax.sharding import Mesh
+
+    from rocnrdma_tpu.collectives import khd2d_reduce_scatter
+
+    n = int(np.prod(shape))
+    axes = tuple(f"ax{i}" for i in range(len(shape)))
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(shape), axes)
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((*shape, n * 6)).astype(np.float32)
+    nlead = len(shape)
+    f = jax.jit(jax.shard_map(
+        lambda s: khd2d_reduce_scatter(s.reshape(s.shape[nlead:]), axes,
+                                       bidir=bidir)[(None,) * nlead],
+        mesh=mesh, in_specs=(P(*axes),), out_specs=P(*axes),
+        check_vma=False))
+    out = np.asarray(f(x)).reshape(n, 6)
+    want = x.reshape(n, n, 6).sum(0)  # rank r keeps reduced chunk r
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("bidir", [False, True])
+def test_khd2d_allgather(devices, bidir):
+    from jax.sharding import Mesh
+
+    from rocnrdma_tpu.collectives import khd2d_allgather
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("a", "b"))
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((2, 4, 5)).astype(np.float32)
+    f = jax.jit(jax.shard_map(
+        lambda s: khd2d_allgather(s[0, 0], ("a", "b"),
+                                  bidir=bidir)[None, None],
+        mesh=mesh, in_specs=(P("a", "b"),), out_specs=P("a", "b"),
+        check_vma=False))
+    out = np.asarray(f(x)).reshape(8, 8, 5)
+    want = x.reshape(8, 5)  # flat row-major rank order
+    for r in range(8):
+        np.testing.assert_allclose(out[r], want, rtol=1e-6, atol=1e-6)
+
+
+def test_khd2d_phase_verbs_via_transport(devices):
+    # the FSDP pair on a 2-D mesh: allgather(shard) -> reduce_scatter(grads)
+    t = Transport(rt.mesh.slice_mesh(2, 4))
+    rng = np.random.default_rng(7)
+    shard = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    full = np.asarray(t.allgather(t.shard(shard), "khd2d"))
+    np.testing.assert_allclose(
+        full.reshape(8, 24), np.broadcast_to(shard.reshape(-1), (8, 24)),
+        rtol=1e-6, atol=1e-6)
+    grads = rng.standard_normal((2, 4, 16)).astype(np.float32)
+    gs = np.asarray(t.reduce_scatter(t.shard(grads), "khd2d"))
+    np.testing.assert_allclose(gs.reshape(8, 2),
+                               grads.reshape(8, 8, 2).sum(0),
+                               rtol=1e-5, atol=1e-5)
+    # model rows exist per mesh shape for both phase verbs
+    from rocnrdma_tpu.transport.tuner import model_time
+    t_rs = model_time("reduce_scatter", "khd2d", 8, 2**20,
+                      mesh_shape=(2, 4))
+    t_ag = model_time("allgather", "khd2d", 8, 2**20, mesh_shape=(2, 4))
+    t_ar = model_time("allreduce", "khd2d", 8, 2**20, mesh_shape=(2, 4))
+    assert 0 < t_ag < t_ar and 0 < t_rs < t_ar

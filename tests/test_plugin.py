@@ -384,3 +384,20 @@ def test_lg_send_completes_before_irecv_and_delivers_late(host_pair):
     req = net.irecv(recv, len(big), tag=9)
     req.wait()
     assert req.payload == big
+
+
+@needs_native
+def test_lg_arena_alloc_failure_nacks_fast(host_pair, monkeypatch):
+    # a receiver whose MR capacity cannot fit the arena NACKs (size-0
+    # announce), so the sender fails FAST with the real diagnosis instead
+    # of a misleading announce timeout
+    net, send, recv = host_pair
+
+    def broken_alloc(comm, nbytes):
+        raise OSError("mr capacity exhausted")
+
+    monkeypatch.setattr(HostQPNet, "alloc_mr", broken_alloc)
+    big = bytes(net.LG_MIN)
+    with pytest.raises(OSError, match="no large-message arena"):
+        net.isend(send, net.reg_mr(send, big), tag=30,
+                  progress=recv._pump, timeout_s=5.0)
