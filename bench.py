@@ -45,15 +45,11 @@ import sys
 _CPU_FALLBACK = (50.0, 10.0)  # oracle runs: keep vs_baseline finite
 
 
-def _median(xs):
-    """True median: the mean of the two middle elements on even-length
-    pools. The upper-middle shortcut (sorted[n//2]) systematically lands
-    in the FAST mode when a bimodal backend splits the pool evenly —
-    re-smuggling a sliver of best-of-N into a stat labeled median."""
-    s = sorted(xs)
-    n = len(s)
-    mid = n // 2
-    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+# the TRUE median (stdlib: mean of the two middles on even pools). The
+# upper-middle shortcut (sorted[n//2]) systematically lands in the FAST
+# mode when a bimodal backend splits the pool evenly — re-smuggling a
+# sliver of best-of-N into a stat labeled median.
+from statistics import median as _median  # noqa: E402
 
 
 def _roofline(device) -> tuple:

@@ -106,9 +106,7 @@ def khd2d_allreduce(x: jax.Array, axis_names, op: str = "sum",
     schedule; only the physical carrier differs)."""
     axis_names = tuple(axis_names)
     digits = tuple(lax.axis_size(a) for a in axis_names)
-    n = 1
-    for d in digits:
-        n *= d
+    n = _prod(digits)
     if n == 1:
         return finalize(x, op, 1)
     shape, size = x.shape, x.size
@@ -128,9 +126,7 @@ def khd2d_reduce_scatter(x: jax.Array, axis_names, op: str = "sum",
     (khd_reduce_scatter) with each round riding one mesh axis."""
     axis_names = tuple(axis_names)
     digits = tuple(lax.axis_size(a) for a in axis_names)
-    n = 1
-    for d in digits:
-        n *= d
+    n = _prod(digits)
     if x.size % n:
         raise ValueError(f"reduce_scatter needs size divisible by {n} ranks, "
                          f"got {x.size}")
@@ -149,13 +145,29 @@ def khd2d_allgather(x: jax.Array, axis_names,
     returns the ``(n, c)`` concatenation in flat row-major rank order."""
     axis_names = tuple(axis_names)
     digits = tuple(lax.axis_size(a) for a in axis_names)
-    n = 1
-    for d in digits:
-        n *= d
+    n = _prod(digits)
     if n == 1:
         return x.reshape(1, -1)
-    strides = khd_strides(digits)
     dig = [lax.axis_index(a) for a in axis_names]
+    buf, seg_start, chunk = _khd_ag_seed(x, digits, dig)
+    buf = _khd_ag_phase(buf, seg_start, chunk, digits, None, bidir,
+                        axes=axis_names)
+    return buf.reshape(n, chunk)
+
+
+def _prod(digits) -> int:
+    import math
+    return math.prod(int(d) for d in digits)
+
+
+def _khd_ag_seed(x, digits, dig):
+    """Place my chunk at my mixed-radix position (= my flat row-major
+    rank x chunk): the shared allgather seeding of the flat and
+    topology-mapped (khd2d) variants — one copy, so the placement
+    arithmetic cannot desynchronize between them. Returns
+    (buf, seg_start, chunk_elems)."""
+    n = _prod(digits)
+    strides = khd_strides(digits)
     chunk = x.size
     buf = jnp.zeros((n * chunk,), x.dtype)
     seg_start = jnp.int32(0)
@@ -163,9 +175,7 @@ def khd2d_allgather(x: jax.Array, axis_names,
         seg_start = seg_start + dig[t] * (s * chunk)
     buf = lax.dynamic_update_slice_in_dim(buf, x.reshape(-1), seg_start,
                                           axis=0)
-    buf = _khd_ag_phase(buf, seg_start, chunk, digits, None, bidir,
-                        axes=axis_names)
-    return buf.reshape(n, chunk)
+    return buf, seg_start, chunk
 
 
 def _split_offset(bidir: bool, d: int, part: int, o: int) -> bool:
@@ -284,22 +294,13 @@ def khd_allgather(x: jax.Array, axis_name: str, digits=None,
         digits = khd_digits(n, max_radix)
     else:
         digits = tuple(int(d) for d in digits)
-    prod = 1
-    for d in digits:
-        prod *= d
+    prod = _prod(digits)
     if prod != n:
         raise ValueError(f"digits {digits} multiply to {prod}, axis has {n}")
     strides = khd_strides(digits)
     r = lax.axis_index(axis_name)
     dig = [(r // s) % d for s, d in zip(strides, digits)]
-    chunk = x.size
-    buf = jnp.zeros((n * chunk,), x.dtype)
-    # my chunk starts at my own mixed-radix position (= r * chunk elements)
-    seg_start = jnp.int32(0)
-    for t, s in enumerate(strides):
-        seg_start = seg_start + dig[t] * (s * chunk)
-    buf = lax.dynamic_update_slice_in_dim(buf, x.reshape(-1), seg_start,
-                                          axis=0)
+    buf, seg_start, chunk = _khd_ag_seed(x, digits, dig)
     buf = _khd_ag_phase(buf, seg_start, chunk, digits, axis_name, bidir)
     return buf.reshape(n, chunk)
 

@@ -429,3 +429,23 @@ def test_bench_median_is_the_true_median():
     assert bench._median([700.0, 701.0, 780.0, 781.0]) == 740.5
     assert bench._median([5.0]) == 5.0
     assert bench._median([3.0, 1.0, 2.0]) == 2.0
+
+
+def test_fold_ladder_cli_on_oracle(tmp_path):
+    # the radix-calibration CLI end to end (self-check gate + JSONL rows),
+    # both dtypes, on the CPU oracle at its auto-shrunk sizes
+    from rocnrdma_tpu.bench import fold_ladder
+
+    out = tmp_path / "ladder.jsonl"
+    _run(fold_ladder.main, ["--platform", "cpu", "--widths", "2,9",
+                            "--out", str(out)])
+    _run(fold_ladder.main, ["--platform", "cpu", "--widths", "8",
+                            "--dtype", "bfloat16", "--out", str(out)])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [(r["n_ops"], r["dtype"]) for r in rows] == [
+        (2, "float32"), (9, "float32"), (8, "bfloat16")]
+    assert all(r["GBps"] > 0 and r["spread"][0] <= r["GBps"] for r in rows)
+    # the sizing helper IS bench.py's (one protocol; see bench.py op_elems)
+    from rocnrdma_tpu.bench.fold_ladder import ladder_op_elems
+    assert ladder_op_elems(2, 1 << 30) == (1 << 30) // 4
+    assert ladder_op_elems(64, 1 << 30) < (1 << 30) // 4

@@ -305,3 +305,18 @@ def test_ring_allreduce_over_tcp_processes(tmp_path):
         out, err = p.communicate(timeout=120)
         assert p.returncode == 0, f"rank {r} failed:\n{err}"
         assert f"rank {r} OK" in out
+
+
+@needs_native
+def test_tcpnet_large_message_auto_route(tcp_net_pair):
+    # the LG rendezvous inherited from HostQPNet over the TCP plane: the
+    # arena is a conn-local heap buffer and read_mr_view pumps before
+    # viewing — the payload must survive the different MR storage model
+    net, send, recv = tcp_net_pair
+    big = np.arange((net.LG_MIN + 3) // 4, dtype=np.uint32).tobytes()
+    req = net.irecv(recv, len(big), tag=41)
+    net.isend(send, net.reg_mr(send, big), tag=41,
+              progress=lambda: req.test())
+    req.wait(timeout_s=30)
+    assert req.payload == big
+    assert recv._lg_mr is not None and send._lg_peer is not None

@@ -540,8 +540,9 @@ def test_alpha_sensitivity_documented():
     art = os.path.join(os.path.dirname(__file__), "..", "results",
                        "tuning_v5e.json")
     meta = json.load(open(art))["_meta"]
-    assert meta["alpha_sensitivity"]["dispatch_alpha_range_s"] == [7e-9,
-                                                                   7.7e-8]
+    from rocnrdma_tpu import hw
+    assert meta["alpha_sensitivity"]["dispatch_alpha_range_s"] == list(
+        hw.MEASURED_DISPATCH_ALPHA_RANGE_S)
     assert set(meta["alpha_sensitivity"]["unstable_keys"]) == set(sens)
     # model_table embeds the audit on every fresh build
     t = model_table("v5 lite", [8], ["allreduce"], sizes)
