@@ -42,7 +42,8 @@ from rocnrdma_tpu.bench.runner import parse_size
 from rocnrdma_tpu.bench.timing import marginal_s_per_op
 
 KERNELS = ("xla2", "xla3", "xla4", "xla5", "xla6", "xla7", "xla8",
-           "xla9", "pallas2", "pallas3", "pallas4", "pallas5")
+           "xla9", "pallas2", "pallas3", "pallas4", "pallas5",
+           "pipe2", "pipe3", "pipe4", "pipe5")
 
 
 def kernel_n_ops(kernel: str) -> int:
@@ -56,7 +57,7 @@ def kernel_n_ops(kernel: str) -> int:
 
 
 def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int,
-                       full_out: bool = False):
+                       full_out: bool = False, n_slots: int = 2):
     """Jitted k-deep chain of one combine kernel; also the chain builder
     behind bench.py's single-chip headline candidates (one copy of the
     fori_loop/byte-accounting conventions). The trailing digit is the
@@ -71,6 +72,7 @@ def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int,
     from jax import lax
 
     from rocnrdma_tpu.ops import pallas_hbm_combine
+    from rocnrdma_tpu.ops.local_pallas import pallas_hbm_combine_pipelined
 
     n_ops = kernel_n_ops(kernel)
     if kernel.startswith("xla"):
@@ -79,10 +81,18 @@ def make_combine_chain(kernel: str, tile_rows: int, interpret, k: int,
             for b in bs[:n_ops - 1]:
                 out = out + b
             return out
+    elif kernel.startswith("pipe"):
+        # Mosaic's own pipeline emitter (the r5 second attempt on the
+        # streaming ceiling — VERDICT r4 weak #2)
+        def combine(y, *bs):
+            return pallas_hbm_combine_pipelined(y, *bs[:n_ops - 1],
+                                                tile_rows=tile_rows,
+                                                interpret=interpret)
     else:
         def combine(y, *bs):
             return pallas_hbm_combine(y, *bs[:n_ops - 1],
                                       tile_rows=tile_rows,
+                                      n_slots=n_slots,
                                       interpret=interpret)
 
     @jax.jit
@@ -105,6 +115,11 @@ def main(argv=None) -> int:
                    help=f"comma subset of {','.join(KERNELS)}")
     p.add_argument("--tile-rows", type=int, default=2048,
                    help="pallas tile rows (x128 lanes; 2048 = 1 MiB fp32)")
+    p.add_argument("--slots", type=int, default=2,
+                   help="pallasN slot-rotation depth (2 = double buffer; "
+                        "deeper keeps more tile loads in flight — the r5 "
+                        "streaming-ceiling probe; pipeN ignores this, "
+                        "Mosaic's emitter chooses its own buffering)")
     p.add_argument("--dtype", choices=("float32", "bfloat16"),
                    default="float32",
                    help="combine dtype (the C11 fp32/bf16 sweep axis; "
@@ -139,6 +154,10 @@ def main(argv=None) -> int:
     for kname in kernels:
         if kname not in KERNELS:
             raise SystemExit(f"unknown kernel {kname!r}; pick from {KERNELS}")
+        if on_cpu and kname.startswith("pipe"):
+            raise SystemExit(
+                f"{kname}: the emit_pipeline kernels need a real TPU "
+                f"(Mosaic's pipeline emitter has no interpret path)")
 
     import jax.numpy as jnp
     dtype = jnp.dtype(args.dtype)
@@ -175,7 +194,8 @@ def main(argv=None) -> int:
             chk = np.asarray(
                 make_combine_chain(kname, args.tile_rows,
                                    None if native else True, k=2,
-                                   full_out=True)(*x_gate),
+                                   full_out=True,
+                                   n_slots=args.slots)(*x_gate),
                 dtype=np.float32)
             if not np.allclose(chk, refs[n_ops], rtol=tol, atol=tol):
                 bad = int(np.argmax(~np.isclose(chk, refs[n_ops],
@@ -183,7 +203,8 @@ def main(argv=None) -> int:
                 raise SystemExit(f"{kname}: self-check failed at element "
                                  f"{bad} ({chk[bad]} vs {refs[n_ops][bad]})")
             mk = functools.partial(make_combine_chain, kname, args.tile_rows,
-                                   None if native else True)
+                                   None if native else True,
+                                   n_slots=args.slots)
             sec = marginal_s_per_op(lambda k: mk(k=k), x0, args.k1, k2,
                                     args.repeats, args.trials)
             gbps = (n_ops + 1) * elems * dtype.itemsize / sec / 1e9
@@ -191,7 +212,8 @@ def main(argv=None) -> int:
                          "dtype": dtype.name, "size_bytes": size,
                          "GBps": round(gbps, 3), "s_per_op": sec,
                          "native": native, "device_kind": dev.device_kind,
-                         "tile_rows": args.tile_rows})
+                         "tile_rows": args.tile_rows,
+                         "n_slots": args.slots})
             sz = (f"{size >> 20} MiB" if size >= M.MiB
                   else f"{size >> 10} KiB")
             print(f"{kname:8s} {dtype.name:9s} {sz:>9s}  {gbps:8.1f} GB/s  "

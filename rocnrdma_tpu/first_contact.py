@@ -10,6 +10,10 @@ button: the day real multi-chip hardware exists, the driver runs
 
 verbatim and gets, in order:
 
+0. **calibrate_chip** — ``fold_ladder`` + ``measure_alpha`` on the live
+   chip, persisted as ``results/hw_<device_kind>.json`` so the tuner's
+   radix picks ride THIS chip's measured constants instead of the v5e
+   defaults (``hw.fold_ladder_for`` precedence; VERDICT r4 missing #3).
 1. **dryrun** — ``__graft_entry__.dryrun_multichip(n)`` in a fresh
    subprocess (a CPU-virtual mesh of the same rank count): the full
    training-step sharding compiles and matches its numpy oracles before
@@ -100,6 +104,12 @@ def main(argv=None) -> int:
                         "present)")
     p.add_argument("--skip-dryrun", action="store_true",
                    help="skip step 1 (e.g. when the driver already ran it)")
+    p.add_argument("--skip-calibrate", action="store_true",
+                   help="skip step 0 (per-chip ladder/alpha calibration — "
+                        "e.g. when a trusted hw_<kind>.json already exists)")
+    p.add_argument("--calibrate-widths", default="2,3,4,8,16,32,48,64",
+                   help="fold-ladder widths for step 0 (contract radices "
+                        "plus the narrow anchors)")
     args = p.parse_args(argv)
 
     if args.align_algo is None:
@@ -119,6 +129,65 @@ def main(argv=None) -> int:
     import jax
     n = args.ranks or len(jax.devices())
     rows = []
+
+    # -- 0. calibrate THIS chip (VERDICT r4 missing #3): the fold-rate
+    # ladder and dispatch alpha baked into hw.py are single-chip v5e
+    # measurements; a v5p-256 first contact must not ride them. Measure
+    # both on the live chip, persist results/hw_<kind>.json, and every
+    # subsequent tuner pick in this process (and any later process on
+    # this machine) rides the per-kind override (hw.fold_ladder_for /
+    # hw.dispatch_alpha_s precedence).
+    if not args.skip_calibrate:
+        def calibrate():
+            from rocnrdma_tpu import hw
+            from rocnrdma_tpu.bench.fold_ladder import run_ladder
+            from rocnrdma_tpu.bench.runner import parse_size as _ps
+            from rocnrdma_tpu.transport.tuner import measure_alpha
+            dev = jax.devices()[0]
+            kind = getattr(dev, "device_kind", "") or dev.platform
+            on_cpu = dev.platform == "cpu"
+            from rocnrdma_tpu import metrics as _M
+            if on_cpu:  # oracle: plumbing proof, not calibration
+                budget, cap, k1, k2, reps, trials = (
+                    8 * _M.MiB, 4 * _M.MiB, 2, 16, 2, 1)
+                widths = (2, 4, 8)
+            else:
+                budget, cap, k1, k2, reps, trials = (
+                    _ps("3584M"), _ps("1G"), 8, 128, 5, 3)
+                widths = tuple(int(w) for w in
+                               args.calibrate_widths.split(","))
+            rows_l = run_ladder(widths, budget, cap, k1, k2, reps, trials,
+                                dtype="float32")
+            ladder = {str(r["n_ops"]): r["GBps_median"] for r in rows_l}
+            alpha = measure_alpha(
+                k1=4096 if not on_cpu else 32,
+                k2=65536 if not on_cpu else 512,
+                repeats=5 if not on_cpu else 2,
+                trials=4 if not on_cpu else 1)
+            # hbm_frac is defined as the PAIRWISE-anchor rate over peak
+            # (hw.MEASURED_HBM_FRAC's provenance: the 2-op combine);
+            # _khd_hbm then rescales by fold_rate_scale(d) = lad[2]/lad[d],
+            # so deriving frac from any other width would double-count
+            # the width effect (code-review r5)
+            chip = hw.chip_for(kind)
+            frac = (float(ladder["2"]) / chip.hbm_GBps
+                    if chip and "2" in ladder else None)
+            data = {"fold_ladder": ladder,
+                    "dispatch_alpha_s": alpha,
+                    "provenance": "first_contact step 0 (fold_ladder + "
+                                  "measure_alpha on the live chip)"}
+            if frac is not None and 0 < frac < 1:
+                data["hbm_frac"] = round(frac, 4)
+            # oracle runs write into --outdir (CI must not plant a
+            # fake-chip artifact where hw's precedence would find it);
+            # real chips persist at the precedence default so every
+            # later process on this machine rides the measurement
+            path = hw.save_calibration(
+                kind, data, base_dir=args.outdir if on_cpu else None)
+            return {"artifact": path, "device_kind": kind,
+                    "widths": len(ladder),
+                    "dispatch_alpha_ns": round(alpha * 1e9, 1)}
+        rows.append(_step(args.outdir, "calibrate_chip", calibrate))
 
     # -- 1. dryrun: sharding compiles on a virtual mesh of this rank count
     if not args.skip_dryrun:
@@ -188,6 +257,38 @@ def main(argv=None) -> int:
         return {"table": measured_path, "baseline_rows": len(sweep_rows),
                 "jsonl": baseline_path}
     rows.append(_step(args.outdir, "measured_sweep", sweep))
+
+    # -- 3b. the contract's SECOND metric as a scored artifact (VERDICT r4
+    # missing #4): alltoall algbw with the headline's median/spread
+    # discipline — same JSON shape bench.py's multichip branch emits, so
+    # BASELINE can carry both contract metrics with one rigor
+    def alltoall_scored():
+        from rocnrdma_tpu.bench.runner import _build_input
+        from rocnrdma_tpu.bench.timing import marginal_trials
+        size = max(sizes)
+        on_cpu = mesh.devices.flat[0].platform == "cpu"
+        fn = t.jit_fn("alltoall", "fused")
+        mesh2d = t.mesh.devices.shape if t.is_2d else None
+        xh, _ = _build_input("alltoall", t.n_ranks, mesh2d, size, "float32")
+        per_rank = xh.nbytes // t.n_ranks
+        x = t.shard(xh)
+
+        def mk(k):
+            def chain(v):
+                y = v
+                for _ in range(k):
+                    y = fn(y)
+                return y
+            return chain
+        tr = marginal_trials(mk, (x,), k1=1, k2=3 if on_cpu else 9,
+                             repeats=2 if on_cpu else 5,
+                             trials=1 if on_cpu else 3)
+        row = M.scored_algbw_row(tr, per_rank, t.n_ranks, "fused", on_cpu)
+        out = os.path.join(args.outdir, "alltoall_algbw.json")
+        with open(out, "w") as fp:
+            json.dump(row, fp)
+        return {"artifact": out, **row}
+    rows.append(_step(args.outdir, "alltoall_scored", alltoall_scored))
 
     # -- 4. merge: measured rows win, provenance goes honest-mixed
     def merge():

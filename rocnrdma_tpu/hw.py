@@ -16,6 +16,8 @@ Match rule: first key that is a substring of the lowercased
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,29 +49,151 @@ CHIPS: dict[str, Chip] = {
 MEASURED_HBM_FRAC = 670.0 / 819.0
 
 # Measured fused fold-width ladder (bench/fold_ladder.py on this repo's
-# real v5e, round 4, median-of-trials accounted GB/s at (n_ops+1) bytes
-# per element): the achieved HBM byte rate RISES with fold width — wider
-# folds write less per byte read — and saturates. This is the measurement
-# behind khd's radix choice (tuner.khd_model_digits): the flat-rate model
-# (one hbm_beta for every width) would keep widening forever; the ladder
-# says where the chip actually stops paying. Values are the MEAN of two
-# full r4 runs ~90 min apart (both in results/fold_ladder_v5e.jsonl);
-# the runs agree within ~1% at every width, including the repeatable
-# 48 > 64 local maximum (run 1 / run 2 at 48-op: 787.6 / 787.6). Same
-# one-chip provenance caveat as MEASURED_HBM_FRAC.
+# real v5e; median-of-trials accounted GB/s at (n_ops+1) bytes per
+# element, the LADDER SIZING PROTOCOL: per-operand size shrinks as width
+# grows under a fixed total budget — the shape of a real radix-d khd
+# round, which folds d parts of ~S/d). This is the measurement behind
+# khd's radix choice (tuner.khd_model_digits): the flat-rate model (one
+# hbm_beta for every width) would keep widening forever; the ladder says
+# where the chip actually stops paying. Widths 2-24 are the r4 two-run
+# means (~1% agreement, results/fold_ladder_v5e.jsonl); widths 32-64 are
+# the r5 fine grid (results/fold_ladder_fine_r5.jsonl, clean re-runs for
+# the two contaminated rows). Same one-chip provenance caveat as
+# MEASURED_HBM_FRAC; first_contact step 0 supersedes per chip kind.
+#
+# THE r4 "48 > 64 ANOMALY", RESOLVED (VERDICT r4 weak #1): the r5 fine
+# grid (36-64 step 4) plus a CONSTANT-OPERAND-SIZE control run
+# (results/fold_ladder_const_r5.jsonl, 56 MiB per operand at every
+# width) separate two superposed effects: (1) at constant operand size
+# the fold rate DECLINES gently and monotonically with width past ~32
+# (830 -> 799 GB/s from 32-op to 64-op — input-stream pressure), and
+# (2) at fixed width the rate declines with operand SIZE (32-op:
+# 830 @ 56 MiB vs 760 @ 115 MiB). Under the ladder protocol size shrinks
+# as width grows, so the two opposite-signed trends superpose into the
+# observed non-monotone curve with its plateau at 36-44 (~793-799) and
+# the genuine, small 48 > 64 gap (790.0 vs 782.6 clean). Exploiting the
+# plateau at n=64 is arithmetically impossible: no plateau width divides
+# 64, and every SPLIT fold (48+16, 44+20, 2x32, ...) pays an
+# intermediate write+read that costs 3-6% MORE than the one 64-op pass
+# at these measured rates (see BASELINE.md r5 for the arithmetic) — so
+# the contract-point pick stays the single 64-op fold, now as a proven
+# optimum rather than an unexplained choice.
 MEASURED_FOLD_LADDER: dict[int, float] = {
-    2: 661.8, 3: 704.5, 4: 713.5, 8: 735.1, 9: 739.8, 12: 742.0,
-    16: 747.6, 24: 757.2, 32: 753.9, 48: 787.6, 64: 779.4,
+    2: 662.7, 3: 704.5, 4: 713.5, 8: 735.1, 9: 739.8, 12: 742.0,
+    16: 747.6, 24: 757.2, 32: 760.2, 36: 799.3, 40: 792.7, 44: 793.8,
+    48: 790.0, 52: 789.3, 56: 783.5, 60: 784.2, 64: 782.6,
 }
 
 
-def fold_rate_scale(n_ops: int) -> float:
+# -- per-chip calibration overrides (VERDICT r4 missing #3) ---------------
+#
+# Every MEASURED constant above is a single-chip v5e measurement; applying
+# it to a v4/v5p/v6e is an extrapolation. The first-contact runbook
+# (first_contact.py step 0) measures the live chip's own ladder/alpha and
+# persists ``results/hw_<device_kind_slug>.json``; the accessors below
+# consult that artifact BEFORE the v5e defaults. Precedence (documented
+# contract):
+#
+#   1. explicit path in env ``RNR_HW_CAL`` (one file, any device kind)
+#   2. ``<RNR_HW_CAL_DIR or repo results/>hw_<slug>.json`` for this kind
+#   3. the v5e-measured module defaults above
+#
+# Artifact schema (first_contact writes it; save_calibration owns it):
+#   {"device_kind": ..., "fold_ladder": {"2": GBps, ...},
+#    "hbm_frac": float, "dispatch_alpha_s": float, "provenance": ...}
+# Any field may be absent — present fields override, absent fall through.
+
+_CAL_CACHE: dict[str, dict | None] = {}
+
+
+def _cal_slug(device_kind: str) -> str:
+    return "".join(c if c.isalnum() else "_" for c in
+                   (device_kind or "").lower()).strip("_") or "unknown"
+
+
+def calibration_path(device_kind: str, base_dir: str | None = None) -> str:
+    # an EXPLICIT base_dir wins over the env pins: the caller passing one
+    # (the CPU-oracle runbook quarantining a fake-chip artifact in its
+    # outdir) must never clobber an operator's RNR_HW_CAL-pinned file
+    if base_dir:
+        return os.path.join(base_dir, f"hw_{_cal_slug(device_kind)}.json")
+    env = os.environ.get("RNR_HW_CAL", "").strip()
+    if env:
+        return env
+    base = os.environ.get("RNR_HW_CAL_DIR", "").strip() or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "results")
+    return os.path.join(base, f"hw_{_cal_slug(device_kind)}.json")
+
+
+def calibration_for(device_kind: str) -> dict | None:
+    """The persisted per-chip calibration artifact, or None. Cached per
+    path; a malformed file is treated as absent (first contact must not
+    crash the fleet on a torn write)."""
+    path = calibration_path(device_kind)
+    if path not in _CAL_CACHE:
+        try:
+            with open(path) as fp:
+                _CAL_CACHE[path] = json.load(fp)
+        except (OSError, ValueError):
+            _CAL_CACHE[path] = None
+    return _CAL_CACHE[path]
+
+
+def save_calibration(device_kind: str, data: dict,
+                     base_dir: str | None = None) -> str:
+    """Persist a calibration artifact for this kind (and drop the cache so
+    the writing process sees its own measurement immediately).
+    ``base_dir``: write somewhere other than the precedence default — the
+    CPU-oracle runbook proof uses its own outdir so CI never pollutes the
+    repo's results/ with a fake-chip artifact."""
+    path = calibration_path(device_kind, base_dir)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fp:
+        json.dump({"device_kind": device_kind, **data}, fp, indent=1,
+                  sort_keys=True)
+    os.replace(tmp, path)
+    _CAL_CACHE.pop(path, None)
+    return path
+
+
+def hbm_frac(device_kind: str = "") -> float:
+    cal = calibration_for(device_kind)
+    if cal and isinstance(cal.get("hbm_frac"), (int, float)):
+        return float(cal["hbm_frac"])
+    return MEASURED_HBM_FRAC
+
+
+def dispatch_alpha_s(device_kind: str = "") -> float:
+    cal = calibration_for(device_kind)
+    if cal and isinstance(cal.get("dispatch_alpha_s"), (int, float)):
+        return float(cal["dispatch_alpha_s"])
+    return MEASURED_DISPATCH_ALPHA_S
+
+
+def fold_ladder_for(device_kind: str = "") -> dict[int, float]:
+    cal = calibration_for(device_kind)
+    lad = (cal or {}).get("fold_ladder")
+    if isinstance(lad, dict) and lad:
+        try:
+            out = {int(k): float(v) for k, v in lad.items()}
+            if 2 in out:  # the pairwise anchor is load-bearing
+                return out
+        except (TypeError, ValueError):
+            pass
+    return MEASURED_FOLD_LADDER
+
+
+def fold_rate_scale(n_ops: int, device_kind: str = "") -> float:
     """HBM-time multiplier for an ``n_ops``-operand fused fold relative to
     the pairwise anchor: rate(2)/rate(n_ops), linearly interpolated
     between measured widths and CLAMPED at the widest measured point —
     unmeasured widths get no extrapolated credit (the honesty rule the
-    radix picker relies on). 1.0 for the pairwise fold by construction."""
-    lad = MEASURED_FOLD_LADDER
+    radix picker relies on). 1.0 for the pairwise fold by construction.
+    ``device_kind``: consult this chip's own measured ladder when a
+    first-contact calibration artifact exists (precedence note above)."""
+    lad = fold_ladder_for(device_kind)
     base = lad[2]
     if n_ops in lad:
         return base / lad[n_ops]
@@ -98,6 +222,23 @@ def fold_rate_scale(n_ops: int) -> float:
 #   ``tuner.constants_for`` now returns.
 ICI_HOP_S = 1.0e-6
 MEASURED_DISPATCH_ALPHA_S = 3.2e-8
+
+# DCN (data-center network) constants — the cross-slice wire of the
+# ('slice','intra') mesh, the one link class the r4 cost model could not
+# price at all (VERDICT r4 missing #1: "no DCN constant anywhere").
+# PROVENANCE (same discipline as the ICI rows — public order-of-magnitude
+# figures, superseded by measurement at multi-slice first contact):
+# public TPU multislice material quotes ~200 Gbps of per-host DCN NIC
+# bandwidth shared by a 4-chip host → 25 GB/s per host / 4 chips =
+# ~6.25 GB/s per chip of cross-slice egress, i.e. ~16x slower than one
+# v5e ICI link (100 GB/s) and ~30x slower than a v5p link. Latency: DCN
+# crossings are routed through the data-center fabric — tens of
+# microseconds one-way vs ICI's ~1 us. These two numbers are what makes
+# hierarchical schedules exist: shrinking DCN bytes to S/intra is worth
+# two extra ICI phases whenever beta_dcn >> beta_ici, and the model can
+# only reason about that trade if the DCN has a price.
+DCN_GBPS_PER_CHIP = 6.25
+DCN_HOP_S = 10.0e-6
 # the five r3 measurement runs spanned 7-77 ns around that median; four
 # r4 re-measurements added 33.0 / 29.1 / 7.2 / 1.9 ns, widening the floor
 # (the relay's fast windows can make dispatch nearly free). The tuner's

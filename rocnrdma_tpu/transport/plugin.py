@@ -134,9 +134,29 @@ class _HostComm:
         self._lg_peer = None        # (rkey, size) of the PEER's arena
         self._lg_head = 0           # my bump pointer into the peer arena
         self._lg_outstanding = 0    # bytes put but not yet ACKed back
+        self._lg_ack_queue = []     # credit ACKs deferred on a full ring
+
+    def _flush_lg_acks(self) -> None:
+        """Post deferred large-message credit ACKs until the ring
+        backpressures — never blocks. Lives on the COMM and runs at the
+        top of every ``_pump`` (code-review r5: if only the irecv probe
+        flushed, a receiver that stops probing this comm — e.g. it only
+        sends from here on — would strand the peer's credit forever;
+        every verb on the comm pumps, so every verb now drains the
+        queue). ``close`` gives it one last bounded shot."""
+        while self._lg_ack_queue:
+            wr = self.qp.post_send(self._lg_ack_queue[0])
+            if wr == -1:  # ring full: retry at the next pump
+                return
+            if wr < -1:
+                raise RuntimeError("host net: connection died while "
+                                   "returning large-message credit")
+            self._lg_ack_queue.pop(0)
 
     def _pump(self):
         # drain the wire; stash every arrived message by tag
+        if self._lg_ack_queue:
+            self._flush_lg_acks()
         if self._posted < 4:
             self.qp.post_recv(HostQPNet.MAX_FRAME + 4)
             self._posted += 1
@@ -169,6 +189,18 @@ class _HostComm:
         return got
 
     def close(self):
+        # one bounded last shot at returning deferred credit: the peer's
+        # in-flight isend should see its credit rather than a timeout.
+        # _pump (not a bare flush): send-ring slots only free when the CQ
+        # is polled, so a flush-only loop could spin its whole budget
+        # against a full ring without ever making progress (code-review r5)
+        import time
+        deadline = time.monotonic() + 1.0
+        while self._lg_ack_queue and time.monotonic() < deadline:
+            before = len(self._lg_ack_queue)
+            self._pump()  # polls the CQ (freeing ring slots) + flushes
+            if len(self._lg_ack_queue) == before:
+                time.sleep(0.01)
         self.qp.close()
 
 
@@ -207,9 +239,9 @@ class HostQPNet:
     #      irecv), bump-allocates a window in the arena (resetting to
     #      offset 0 whenever all prior bytes are ACKed — single writer
     #      per direction, so no races), waits for the put to complete,
-    #      then sends a 28-byte descriptor frame under the ORIGINAL tag;
+    #      then sends a 32-byte descriptor frame under the ORIGINAL tag;
     #   3. the receiver's ``irecv`` probe recognizes the descriptor by
-    #      magic (only on >= LG_MIN expectations — a genuine 28-byte
+    #      magic (only on >= LG_MIN expectations — a genuine 32-byte
     #      payload for a >= 1 MiB posted receive cannot also carry the
     #      magic except by 2^-128 accident), copies the bytes out of its
     #      own arena, and ACKs the freed length on a second reserved tag.
@@ -349,6 +381,16 @@ class HostQPNet:
         self._post_backpressured(comm, lambda: comm.qp.post_send(data),
                                  "send ring full", 10.0, None)
 
+    def _lg_flush_acks(self, comm: _HostComm) -> None:
+        """Post queued credit ACKs until the send ring backpressures —
+        never blocks (the irecv probe calls this from Request.test()).
+        A deferred ACK also retries at EVERY ``_pump`` of this comm
+        (``_HostComm._flush_lg_acks``), so any later verb on the comm —
+        send or receive — returns the peer's credit; the sender's own
+        credit wait keeps pumping (isend step 2), which is what empties
+        the ring."""
+        comm._flush_lg_acks()
+
     def _lg_drain_acks(self, comm: _HostComm) -> None:
         acks = comm._unexpected.pop(self._LG_ACK_TAG, None)
         if acks:
@@ -423,8 +465,10 @@ class HostQPNet:
                         timeout_s=max(0.1, deadline - time.monotonic()),
                         progress=progress)
         # 4. descriptor under the ORIGINAL tag: magic | offset | length
+        # (length is 8 bytes like the offset — ADVICE r4 #1: a 4-byte
+        # field would silently truncate if LG_ARENA ever grew past 4 GiB)
         desc = (self._LG_MAGIC + offset.to_bytes(8, "little")
-                + need.to_bytes(4, "little"))
+                + need.to_bytes(8, "little"))
         data = tag.to_bytes(4, "little") + desc
         self._post_backpressured(comm, lambda: comm.qp.post_send(data),
                                  "send ring full", timeout_s, progress)
@@ -437,6 +481,8 @@ class HostQPNet:
             self._lg_ensure(comm)  # the LG rendezvous step 1
 
         def probe():
+            if comm._lg_ack_queue:  # credit deferred by an earlier probe
+                self._lg_flush_acks(comm)
             ready = comm._unexpected.get(tag)
             if not ready:
                 comm._pump()
@@ -445,7 +491,7 @@ class HostQPNet:
                 payload = ready.pop(0)
                 if not ready:  # drop exhausted tag keys: callers use fresh
                     del comm._unexpected[tag]  # tags per step, unbounded otherwise
-                if (lg and len(payload) == 28
+                if (lg and len(payload) == 32
                         and payload[:16] == self._LG_MAGIC):
                     # a put descriptor: the bytes are already in my arena.
                     # Zero-copy view + one tobytes — the descriptor frame
@@ -454,14 +500,18 @@ class HostQPNet:
                     # read_mr_view's caveat requires (and ~2.5x faster
                     # than the fenced read_mr_local double copy)
                     offset = int.from_bytes(payload[16:24], "little")
-                    length = int.from_bytes(payload[24:28], "little")
+                    length = int.from_bytes(payload[24:32], "little")
                     out = self.read_mr_view(comm, comm._lg_mr, offset,
                                             length).tobytes()
-                    ack = (self._LG_ACK_TAG.to_bytes(4, "little")
-                           + length.to_bytes(8, "little"))
-                    self._post_backpressured(
-                        comm, lambda: comm.qp.post_send(ack),
-                        "send ring full", 10.0, None)
+                    # credit ACK: NON-blocking (ADVICE r4 #2 — a
+                    # nominally non-blocking Request.test() must not
+                    # spin 10 s on a full send ring); a backpressured
+                    # ACK defers to the queue and drains at the next
+                    # probe/pump of this comm
+                    comm._lg_ack_queue.append(
+                        self._LG_ACK_TAG.to_bytes(4, "little")
+                        + length.to_bytes(8, "little"))
+                    self._lg_flush_acks(comm)
                     return True, length, out
                 return True, len(payload), payload
             return False, 0, None

@@ -72,9 +72,20 @@ def constants_for(device_kind: str, verb: str | None = None
     if chip is None:
         return ALPHA_S, BETA_S_PER_B, 0.0
     beta = 1.0 / (chip.ici_GBps / chip.ici_links * 1e9)
-    hbm_beta = (1.0 / (chip.hbm_GBps * hw.MEASURED_HBM_FRAC * 1e9)
+    hbm_beta = (1.0 / (chip.hbm_GBps * hw.hbm_frac(device_kind) * 1e9)
                 if verb in _REDUCING_VERBS else 0.0)
-    return hw.ICI_HOP_S + hw.MEASURED_DISPATCH_ALPHA_S, beta, hbm_beta
+    return (hw.ICI_HOP_S + hw.dispatch_alpha_s(device_kind), beta, hbm_beta)
+
+
+def dcn_constants_for(device_kind: str) -> tuple[float, float]:
+    """(alpha, beta) of one CROSS-SLICE hop — the DCN price the 2-D mesh's
+    slice axis pays per permutation step and per wire byte (hw.py documents
+    the public provenance). Chip-kind-independent today (the NIC, not the
+    chip, sets the rate) but keyed by kind so a measured per-platform
+    override lands here the day multi-slice hardware is swept."""
+    from rocnrdma_tpu import hw
+    return (hw.DCN_HOP_S + hw.dispatch_alpha_s(device_kind),
+            1.0 / (hw.DCN_GBPS_PER_CHIP * 1e9))
 
 
 def measure_alpha(size_bytes: int = 4096, k1: int = 4096, k2: int = 65536,
@@ -136,16 +147,18 @@ def _ktree_arity() -> int:
 # The one sanctioned overlap assumption is FULL-DUPLEX links: ring_bidir
 # and bidir-khd split each payload across the two directions of the same
 # path, so their per-direction wire bytes halve at the same step count.
-# TOPOLOGY caveat (scoping, not a bug): factors price each permutation as
-# one link crossing — exact for the ring's neighbor hops, optimistic on a
-# physical torus for long-stride rotations (a +o rotation on an m-ring
-# loads its busiest link min(o, m-o)-fold; khd's natural mesh mapping
-# keeps each round inside one torus dimension — digits (8, 8) on an 8x8
-# torus are row then column exchanges — but intra-row strides still
-# multi-hop). This is the standard switch-abstraction every NCCL-style
-# alpha-beta table uses; on real multi-chip hardware the MEASURED
-# Autotuner sweep supersedes these rows at first contact (model_table's
-# provenance says exactly that), which is where torus effects get priced.
+# TOPOLOGY pricing: by DEFAULT factors price each permutation as one link
+# crossing — the switch abstraction every NCCL-style alpha-beta table
+# uses; exact for the ring's neighbor hops, optimistic on a physical
+# torus for long-stride rotations (a +o rotation on an m-ring loads its
+# busiest link min(o, m-o)-fold). Since r5 the khd family ALSO carries a
+# ring-embedded mode (``embedding="ring"`` on _khd_round_shape /
+# khd_model_digits): busiest-link hop loads for the flat rank axis
+# embedded on a physical n-ring, generalizing khd2d's exact per-axis
+# torus row to the flat schedules — the second opinion bench.py prints
+# next to the switch-priced contract-point pick. On real multi-chip
+# hardware the MEASURED Autotuner sweep supersedes both pricings at
+# first contact (model_table's provenance says exactly that).
 # ``hbm`` is the serialized HBM traffic the schedule's combine passes cost
 # per buffer byte (reducing verbs only; a d-operand fused fold costs
 # (d+1)/(d-1) HBM bytes per arriving byte vs the pairwise 3 — fold width
@@ -161,13 +174,16 @@ def _khd_digits(n: int):
     return khd_digits(n)
 
 
-def _fold_scale(d: int) -> float:
+def _fold_scale(d: int, device_kind: str = "") -> float:
     """HBM-time multiplier of a d-operand fused fold vs the pairwise
     anchor (hw.MEASURED_FOLD_LADDER: the chip's achieved byte rate rises
     with fold width, so this is <= 1 and clamps at the widest measured
-    width — unmeasured widths get no extrapolated credit)."""
+    width — unmeasured widths get no extrapolated credit). When a
+    first-contact calibration artifact exists for ``device_kind``, THAT
+    chip's own measured ladder is consulted instead of the v5e default
+    (hw.fold_ladder_for's precedence)."""
     from rocnrdma_tpu import hw
-    return hw.fold_rate_scale(d)
+    return hw.fold_rate_scale(d, device_kind)
 
 
 # khd radix ladder (VERDICT r3 missing #1): the radix is a MODELED choice,
@@ -190,11 +206,16 @@ def khd_radix_candidates(n: int) -> list[tuple[int, ...]]:
 
 
 def _khd_time(verb: str, n: int, nbytes: int, digits, alpha: float,
-              beta: float, hbm_beta: float) -> float:
+              beta: float, hbm_beta: float, embedding: str = "switch",
+              device_kind: str = "") -> float:
     """Three-term time of khd with THESE digits for this verb (allreduce =
-    both phases; reduce_scatter/allgather = one)."""
-    steps, wire, hbm = (_khd_steps(n, digits), _khd_wire(n, digits),
-                        _khd_hbm(n, digits))
+    both phases; reduce_scatter/allgather = one). ``embedding``: "switch"
+    (one link crossing per permutation — the NCCL-table abstraction) or
+    "ring" (the flat rank axis embedded on a physical n-ring; wire prices
+    each exchange's busiest-link hop load — see _khd_round_shape)."""
+    steps, wire, hbm = (_khd_steps(n, digits),
+                        _khd_wire(n, digits, embedding),
+                        _khd_hbm(n, digits, device_kind))
     if verb == "reduce_scatter":
         steps, wire = steps // 2, wire / 2
     elif verb == "allgather":
@@ -225,82 +246,229 @@ def _khd2d_round_torus(d: int) -> tuple[int, float]:
     return disp, load
 
 
+def khd2d_axis_terms(mesh_shape, dcn_axis: int | None = None,
+                     device_kind: str = ""
+                     ) -> tuple[list[tuple[int, float]], float]:
+    """Per-axis ([(steps, wire), ...], hbm) of khd2d on this mesh shape,
+    both phases — digits ARE the axis sizes. Each ICI axis's wire is EXACT
+    on a torus whose ring matches that axis (the min(o, d-o) hop row);
+    the axis named by ``dcn_axis`` (the slice axis of a genuinely
+    multi-slice mesh) is a ROUTED fabric, not a ring, so it takes the
+    one-hop switch row instead — and the caller prices it with DCN
+    constants (model_time). The split lets the model arbitrate khd2d
+    against hierarchical on the contract's 2-D mesh, where the two axes
+    have wildly different betas (VERDICT r4 missing #1)."""
+    shape = tuple(int(d) for d in mesh_shape)
+    P, per_axis = 1, []
+    for a, d in enumerate(shape):
+        P *= d
+        ds, ld = (_khd_round_shape(d) if a == dcn_axis
+                  else _khd2d_round_torus(d))
+        per_axis.append((2 * ds, 2 * ld / P))
+    return per_axis, _khd_hbm(P, shape, device_kind)
+
+
 def khd2d_terms(mesh_shape) -> tuple[int, float, float]:
     """(steps, per-direction wire factor, hbm factor) of khd2d on this
-    mesh shape — digits ARE the axis sizes; wire is EXACT per axis on a
-    torus whose rings match the mesh axes (VERDICT r3 next #3: 'a tuner
-    row whose wire term is exact per axis')."""
-    shape = tuple(int(d) for d in mesh_shape)
-    P, steps, wire = 1, 0, 0.0
-    for d in shape:
-        P *= d
-        ds, ld = _khd2d_round_torus(d)
-        steps += ds
-        wire += ld / P
-    return 2 * steps, 2 * wire, _khd_hbm(P, shape)
+    mesh shape — the single-beta (all-ICI) sum of ``khd2d_axis_terms``,
+    EXACT per torus axis (VERDICT r3 next #3: 'a tuner row whose wire
+    term is exact per axis')."""
+    per_axis, hbm = khd2d_axis_terms(mesh_shape)
+    return (sum(s for s, _ in per_axis),
+            sum(w for _, w in per_axis), hbm)
 
 
 def khd_model_digits(verb: str, n: int, nbytes: int, alpha: float,
-                     beta: float, hbm_beta: float) -> tuple[int, ...]:
+                     beta: float, hbm_beta: float,
+                     embedding: str = "switch",
+                     device_kind: str = "") -> tuple[int, ...]:
     """The radix ladder's cheapest digit tuple at this point — the digits
     ``algo="khd"`` dispatches under the auto/model policies and the terms
     ``model_time("khd")`` prices, so pick and dispatch cannot diverge.
-    Deterministic tie-break: first (narrowest-cap) candidate wins."""
+    Deterministic tie-break: first (narrowest-cap) candidate wins.
+    ``embedding="ring"`` re-prices every candidate's wire as busiest-link
+    load on a physical n-ring (_khd_round_shape) — the second opinion the
+    headline reports next to the switch-priced pick, because the
+    switch-priced contract-point winner (64,) is the most
+    switch-optimistic candidate on the ladder (VERDICT r4 missing #2)."""
     cands = khd_radix_candidates(n)
     best, best_t = cands[0], float("inf")
     for digs in cands:
-        t = _khd_time(verb, n, nbytes, digs, alpha, beta, hbm_beta)
+        t = _khd_time(verb, n, nbytes, digs, alpha, beta, hbm_beta,
+                      embedding, device_kind)
         if t < best_t:
             best, best_t = digs, t
     return best
 
 
-def _khd_round_shape(d: int) -> tuple[int, float]:
-    """(ppermute dispatches, per-direction part-fractions) of one radix-d
-    round of the REGISTERED (bidir) khd — mirroring khd._split_offset
-    exactly: offsets with 2o != d split across the two rotations (2
-    dispatches, half a part per direction each); the self-inverse offset
-    o = d/2 CANNOT split (+o and -o are the same permutation) and ships a
-    full part one way in one dispatch; d = 2's single offset is that
-    self-inverse case. The as-implemented rule, priced as implemented."""
+def _khd_round_shape(d: int, stride: int = 1,
+                     embedding: str = "switch") -> tuple[int, float]:
+    """(ppermute dispatches, per-direction busiest-link part-fractions) of
+    one radix-d round of the REGISTERED (bidir) khd — mirroring
+    khd._split_offset exactly: offsets with 2o != d split across the two
+    rotations (2 dispatches, half a part per direction each); the
+    self-inverse offset o = d/2 CANNOT split (+o and -o are the same
+    permutation) and ships a full part one way in one dispatch; d = 2's
+    single offset is that self-inverse case. The as-implemented rule,
+    priced as implemented.
+
+    ``embedding`` (VERDICT r4 missing #2) weights each digit-o exchange's
+    busiest-link load:
+
+    - "switch": 1 link crossing per permutation — the one-hop abstraction
+      every NCCL-style alpha-beta table uses; exact on a full-bisection
+      fabric, OPTIMISTIC on a physical torus for long strides.
+    - "ring": the flat rank axis embedded contiguously on a physical
+      n-ring. A round at ``stride`` s exchanges within contiguous groups
+      of span s*d; the digit-o exchange moves the non-wrap members +o*s
+      hops and the wrap members -(d-o)*s hops, all inside the group's
+      block, so its busiest link carries s*min(o, d-o) part-copies per
+      direction. (For the mesh-shaped khd2d this reduces to the exact
+      per-axis torus row min(o, d-o) — khd2d_terms; here it generalizes
+      that machinery to the flat schedules, which is how the model learns
+      that digits (64,) — wire 1.0 under "switch" — load a physical
+      64-ring's busiest link ~16x harder than mesh-shaped digits.)"""
+    h = ((lambda o: 1.0) if embedding == "switch"
+         else (lambda o: float(stride * min(o, d - o))))
     if d == 2:
-        return 1, 1.0
-    self_inv = 1 if d % 2 == 0 else 0
-    split = d - 1 - self_inv
-    return 2 * split + self_inv, 0.5 * split + 1.0 * self_inv
+        return 1, h(1)
+    disp, load = 0, 0.0
+    for o in range(1, d):
+        if 2 * o == d:
+            disp += 1
+            load += h(o)
+        else:
+            disp += 2
+            load += 0.5 * h(o)
+    return disp, load
 
 
 def _khd_steps(n: int, digits=None) -> int:
-    # ppermute dispatches across both phases (each pays alpha)
+    # ppermute dispatches across both phases (each pays alpha);
+    # embedding-independent (hop count prices wire, not dispatches)
     return 2 * sum(_khd_round_shape(d)[0]
                    for d in (digits or _khd_digits(n)))
 
 
-def _khd_wire(n: int, digits=None) -> float:
-    # per-direction serialized bytes per buffer byte, both phases
+def _khd_wire(n: int, digits=None, embedding: str = "switch") -> float:
+    # per-direction serialized busiest-link bytes per buffer byte, both
+    # phases; round t's exchanges run at stride prod(d_0..d_{t-1})
     P, total = 1, 0.0
     for d in (digits or _khd_digits(n)):
+        stride = P
         P *= d
-        total += _khd_round_shape(d)[1] / P
+        total += _khd_round_shape(d, stride, embedding)[1] / P
     return 2 * total
 
 
-def _khd_hbm(n: int, digits=None) -> float:
+def _khd_hbm(n: int, digits=None, device_kind: str = "") -> float:
     # RS round t folds the kept part (S/prod(d_0..d_t)) in one
     # (d_t)-operand pass: d_t reads + 1 write = (d_t+1) HBM bytes per part
     # byte, scaled by the MEASURED width-dependent fold rate (_fold_scale:
     # the chip folds wide faster per byte than the pairwise anchor — the
-    # r4 ladder measurement the radix pick is calibrated on); no gating
+    # r4 ladder measurement the radix pick is calibrated on; per-kind
+    # calibration overrides apply when device_kind is given); no gating
     # waste (full permutations). AG adoption ignored, as for every
     # schedule (pure copies, identically shaped across schedules).
     P, total = 1, 0.0
     for d in (digits or _khd_digits(n)):
         P *= d
-        total += (d + 1) / P * _fold_scale(d)
+        total += (d + 1) / P * _fold_scale(d, device_kind)
     return total
 
 
-def _ptree_cost(n: int, nbytes: int | None = None) -> tuple[int, float, float]:
+def _hier_allreduce_time(mesh_shape, nbytes: int, alpha: float, beta: float,
+                         hbm_beta: float, dcn=None, fused_steps: bool = False,
+                         device_kind: str = "") -> float:
+    """As-implemented time of ``hierarchical_allreduce`` defaults on an
+    (m slices, n intra) mesh: ring reduce-scatter over intra (ICI), ring
+    allreduce of the S/n shard over slice (DCN when ``dcn`` gives its
+    (alpha, beta); ICI constants otherwise — a single-slice 2-D carving),
+    ring allgather over intra (ICI) — serialized in program order, the r3
+    as-implemented rule (collectives/hierarchical.py's three phases).
+    ``fused_steps``: halve every step alpha — the _FUSED_MODEL convention
+    for pricing XLA's own multislice lowering, which runs the same
+    RS-intra/AR-cross/AG-intra decomposition as one compiled program."""
+    if len(mesh_shape) != 2:
+        raise KeyError(f"hierarchical is modeled on 2-D meshes, got "
+                       f"shape {tuple(mesh_shape)}")
+    m, n_in = (int(d) for d in mesh_shape)
+    a_d, b_d = dcn if dcn is not None else (alpha, beta)
+    half = 0.5 if fused_steps else 1.0
+    shard = nbytes / max(1, n_in)
+    t = 2 * (n_in - 1) * alpha * half                 # intra RS+AG steps
+    t += 2 * (n_in - 1) / n_in * nbytes * beta        # intra RS+AG wire
+    t += 3 * (n_in - 1) / n_in * nbytes * hbm_beta    # intra RS pairwise folds
+    t += 2 * (m - 1) * a_d * half                     # cross ring-AR steps
+    t += 2 * (m - 1) / m * shard * b_d                # cross wire (DCN)
+    t += 3 * (m - 1) / m * shard * hbm_beta           # cross folds
+    return t
+
+
+def _hier_alltoall_time(mesh_shape, nbytes: int, alpha: float, beta: float,
+                        dcn=None) -> float:
+    """As-implemented time of ``hierarchical_alltoall`` defaults on an
+    (m, n) mesh: one fused intra-slice alltoall of the whole buffer (ICI),
+    then one fused cross-slice alltoall (DCN) — each phase priced at the
+    fused convention (one dispatch at alpha/2; both phases live in one
+    jitted program). DCN bytes: (m-1)/m * S — the transpose's irreducible
+    cross-slice traffic, carried by n parallel same-intra-index pairs."""
+    if len(mesh_shape) != 2:
+        raise KeyError(f"hierarchical is modeled on 2-D meshes, got "
+                       f"shape {tuple(mesh_shape)}")
+    m, n_in = (int(d) for d in mesh_shape)
+    a_d, b_d = dcn if dcn is not None else (alpha, beta)
+    return (alpha / 2 + (n_in - 1) / n_in * nbytes * beta
+            + a_d / 2 + (m - 1) / m * nbytes * b_d)
+
+
+def fused_model_time(verb: str, n: int, nbytes: int, alpha: float,
+                     beta: float, hbm_beta: float, mesh_shape=None,
+                     dcn=None, device_kind: str = "") -> float | None:
+    """The one price of XLA's fused lowering, shared by model_table and
+    model_pick so the two policies cannot disagree about fused again
+    (VERDICT r4 weak #3). 1-D: the ``_FUSED_MODEL`` bandwidth-optimal
+    shape with the per-step dispatch half of alpha gone (alpha/2 — one
+    compiled program; physical hop latency remains). 2-D mesh: XLA's
+    multislice allreduce runs the hierarchical decomposition itself, so
+    it is priced as the hierarchical shape at fused alphas; alltoall
+    likewise (the DCN bytes are schedule-invariant). None = no fused
+    price for this verb/mesh (caller skips the candidate)."""
+    if mesh_shape is not None:
+        if verb == "allreduce":
+            return _hier_allreduce_time(mesh_shape, nbytes, alpha, beta,
+                                        hbm_beta, dcn, fused_steps=True,
+                                        device_kind=device_kind)
+        if verb == "alltoall":
+            return _hier_alltoall_time(mesh_shape, nbytes, alpha, beta, dcn)
+        if verb in ("reduce_scatter", "allgather") and len(mesh_shape) == 2:
+            # XLA's multislice RS/AG decompose the same way the allreduce
+            # does — intra phase over ICI, then the S/intra shard over the
+            # slice axis (DCN) — at fused alphas. Pricing them here keeps
+            # khd2d from winning the 2-D table rows unopposed (code-review
+            # r5: its slice-axis direct exchanges are the DCN-heaviest
+            # schedule in the set, the very pattern the allreduce rows
+            # demote it for).
+            m, n_in = (int(d) for d in mesh_shape)
+            a_d, b_d = dcn if dcn is not None else (alpha, beta)
+            shard = nbytes / max(1, n_in)
+            hbm = (3 * (n_in - 1) / n_in * nbytes
+                   + 3 * (m - 1) / m * shard) * hbm_beta
+            if verb == "allgather":
+                hbm = 0.0
+            return ((n_in - 1) * alpha / 2
+                    + (n_in - 1) / n_in * nbytes * beta
+                    + (m - 1) * a_d / 2 + (m - 1) / m * shard * b_d + hbm)
+        return None
+    shape = _FUSED_MODEL.get(verb)
+    if shape is None:
+        return None
+    steps, wire, hbm = shape(n)
+    return steps * alpha / 2 + wire * nbytes * beta + hbm * nbytes * hbm_beta
+
+
+def _ptree_cost(n: int, nbytes: int | None = None,
+                itemsize: int = 4) -> tuple[int, float, float]:
     # C chunks stream through both trees: per phase C+D-1 ticks x up to 4
     # substeps (2 sides x 2 trees) x S/(2C) each, two phases — serialized
     # bytes 4S(C+D-1)/C (ptree.py's own accounting; the async-overlap ideal
@@ -308,13 +476,15 @@ def _ptree_cost(n: int, nbytes: int | None = None) -> tuple[int, float, float]:
     # every rank executes every tick's gated 3-operand fold over one chunk
     # (4 HBM bytes/elem x S/(2C) x 2 trees x (C+D-1) ticks, at the
     # measured 3-op fold rate). C is ptree.py's own size-scaled pick
-    # (ptree_auto_chunks at fp32 granularity — the model's size key has no
-    # dtype; 4 B/elem is the contract dtype), so the modeled pipeline
-    # depth IS the dispatched one; nbytes=None keeps the legacy fixed
-    # depth for the size-free _MODEL row.
+    # (ptree_auto_chunks over the ELEMENT count — ``itemsize`` carries the
+    # caller's dtype when known, ADVICE r4 #3: a bf16 buffer has 2x the
+    # elements of the same nbytes, hence a deeper dispatched pipeline;
+    # default 4 = the contract fp32), so the modeled depth IS the
+    # dispatched one; nbytes=None keeps the legacy fixed depth for the
+    # size-free _MODEL row.
     from rocnrdma_tpu.collectives.ptree import PTREE_CHUNKS, ptree_auto_chunks
     c = (PTREE_CHUNKS if nbytes is None
-         else ptree_auto_chunks(max(1, nbytes // 4)))
+         else ptree_auto_chunks(max(1, nbytes // max(1, itemsize))))
     ticks = c + _L(n) - 1
     return 8 * ticks, 4.0 * ticks / c, 4.0 * ticks / c * _fold_scale(3)
 
@@ -350,10 +520,14 @@ _MODEL = {
     ("allreduce", "khd"): lambda n: (
         _khd_steps(n), _khd_wire(n), _khd_hbm(n)),
     # topology-mapped khd (2-D mesh only): terms need the mesh SHAPE, not
-    # just n — model_time computes them via khd2d_terms when given
+    # just n — model_time computes them via khd2d_axis_terms when given
     # mesh_shape and raises otherwise; the sentinel keeps the (verb, algo)
     # key enumerable for model_pick's candidate walk
     ("allreduce", "khd2d"): None,
+    # two-level ICI/DCN schedule (2-D mesh only): per-phase constants —
+    # ICI betas on the intra phases, DCN on the slice phase when the mesh
+    # is genuinely multi-slice (_hier_allreduce_time); sentinel like khd2d
+    ("allreduce", "hierarchical"): None,
     # double binary tree AS IMPLEMENTED (level-synchronous, dtree.py): each
     # level's substeps move the whole half-buffer and levels serialize —
     # ~2 substeps/level x D levels x 2 phases x 2 trees x S/2 = 2*D*S
@@ -386,6 +560,9 @@ _MODEL = {
     ("allgather", "pallas_ring"): lambda n: (n - 1, (n - 1) / n, 0.0),
     ("alltoall", "ring"): lambda n: (n - 1, (n - 1) / n, 0.0),  # rotation
     ("alltoall", "bruck"): lambda n: (_L(n), _L(n) / 2, 0.0),
+    # 2-D mesh MoE dispatch path: one ICI alltoall + one DCN alltoall
+    # (_hier_alltoall_time; sentinel like the allreduce row)
+    ("alltoall", "hierarchical"): None,
     # direct one-sided writes, all n-1 DMAs concurrent: one latency step,
     # the alltoall bandwidth factor
     ("alltoall", "pallas_ring"): lambda n: (1, (n - 1) / n, 0.0),
@@ -400,35 +577,68 @@ _MODEL = {
 
 def model_time(verb: str, algo: str, n: int, nbytes: int,
                alpha: float = ALPHA_S, beta: float = BETA_S_PER_B,
-               hbm_beta: float = 0.0, mesh_shape=None) -> float:
+               hbm_beta: float = 0.0, mesh_shape=None, dcn=None,
+               embedding: str = "switch", device_kind: str = "",
+               itemsize: int = 4) -> float:
     """Predicted seconds for ``algo`` moving an ``nbytes`` buffer over ``n``
-    ranks. Raises KeyError for pairs the model does not cover (fused XLA
-    lowerings are measured, not modeled — XLA's internal schedule is opaque).
+    ranks. Raises KeyError for pairs the model does not cover (the fused
+    XLA lowering is priced separately — ``fused_model_time`` — because its
+    schedule is XLA's, not ours).
 
     Two schedules carry a SIZE-DEPENDENT shape knob the model resolves the
     same way the dispatch does (so pick and program cannot diverge): khd's
     radix digits (``khd_model_digits`` — the r4 radix ladder) and ptree's
     pipeline depth (``ptree_auto_chunks``); their ``_MODEL`` rows keep the
-    legacy fixed shapes for size-free introspection only. ``khd2d``
-    additionally needs ``mesh_shape`` (its digits are the mesh axis sizes
-    and its wire term is exact per torus axis — ``khd2d_terms``)."""
+    legacy fixed shapes for size-free introspection only. ``khd2d`` and
+    ``hierarchical`` additionally need ``mesh_shape`` (their shape IS the
+    mesh axis sizes). ``dcn``: (alpha, beta) of one cross-slice hop
+    (``dcn_constants_for``) — when given, mesh axis 0 (the slice axis) is
+    priced as DCN: khd2d's axis-0 rounds take the switch row at DCN
+    constants and hierarchical's cross phase pays DCN per byte; without
+    it a 2-D mesh is a single-slice torus carving and both axes are ICI.
+    ``embedding``: "switch"/"ring" wire pricing for the flat khd
+    (_khd_round_shape). ``device_kind``: per-chip calibration for the
+    fold-rate ladder (hw.fold_ladder_for)."""
     if algo == "khd2d":
         if (verb, algo) not in _MODEL:
             raise KeyError((verb, algo))
         if mesh_shape is None:
             raise KeyError("khd2d is modeled per mesh shape; pass "
                            "mesh_shape=(d0, d1, ...)")
-        steps, wire, hbm = khd2d_terms(mesh_shape)
-        if verb == "reduce_scatter":
-            steps, wire = steps // 2, wire / 2
-        elif verb == "allgather":
-            steps, wire, hbm = steps // 2, wire / 2, 0.0
-        return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
+        per_axis, hbm = khd2d_axis_terms(
+            mesh_shape, dcn_axis=0 if dcn is not None else None,
+            device_kind=device_kind)
+        halve = verb in ("reduce_scatter", "allgather")
+        if verb == "allgather":
+            hbm = 0.0
+        t = hbm * nbytes * hbm_beta
+        for a, (steps, wire) in enumerate(per_axis):
+            a_a, b_a = (dcn if (a == 0 and dcn is not None)
+                        else (alpha, beta))
+            if halve:
+                steps, wire = steps // 2, wire / 2
+            t += steps * a_a + wire * nbytes * b_a
+        return t
+    if algo == "hierarchical":
+        if (verb, algo) not in _MODEL:
+            raise KeyError((verb, algo))
+        if mesh_shape is None:
+            raise KeyError("hierarchical is modeled per mesh shape; pass "
+                           "mesh_shape=(n_slices, n_intra)")
+        if verb == "allreduce":
+            return _hier_allreduce_time(mesh_shape, nbytes, alpha, beta,
+                                        hbm_beta, dcn,
+                                        device_kind=device_kind)
+        return _hier_alltoall_time(mesh_shape, nbytes, alpha, beta, dcn)
     if algo == "khd" and (verb, algo) in _MODEL:
-        digits = khd_model_digits(verb, n, nbytes, alpha, beta, hbm_beta)
-        return _khd_time(verb, n, nbytes, digits, alpha, beta, hbm_beta)
+        digits = khd_model_digits(verb, n, nbytes, alpha, beta, hbm_beta,
+                                  embedding, device_kind)
+        return _khd_time(verb, n, nbytes, digits, alpha, beta, hbm_beta,
+                         embedding, device_kind)
     if (verb, algo) == ("allreduce", "ptree"):
-        steps, wire, hbm = _ptree_cost(n, nbytes)
+        # itemsize carries the caller's dtype so the modeled pipeline
+        # depth matches the dispatched one on bf16 buffers (ADVICE r4 #3)
+        steps, wire, hbm = _ptree_cost(n, nbytes, itemsize)
         return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
     steps, wire, hbm = _MODEL[(verb, algo)](n)
     return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
@@ -436,25 +646,43 @@ def model_time(verb: str, algo: str, n: int, nbytes: int,
 
 def model_pick(verb: str, n: int, nbytes: int, candidates=None,
                alpha: float = ALPHA_S, beta: float = BETA_S_PER_B,
-               hbm_beta: float = 0.0, mesh_shape=None) -> str | None:
+               hbm_beta: float = 0.0, mesh_shape=None, dcn=None,
+               embedding: str = "switch", device_kind: str = "",
+               itemsize: int = 4) -> str | None:
     """Cheapest modeled algorithm for this point, or None if none modeled.
 
-    Ties break EXPLICITLY toward the non-pallas schedule (several pallas
-    rows model identically to their XLA-wire twins — same schedule, custom
-    data plane — and the XLA twin is the safer default), then toward
-    declaration order for determinism. ``mesh_shape``: 2-D mesh axis sizes
-    — required for khd2d to compete (skipped without it)."""
-    best, best_key = None, (float("inf"), True)
+    ``"fused"`` competes whenever the candidate filter allows it and a
+    fused price exists (``fused_model_time`` — the same price model_table
+    uses, so the two policies agree; VERDICT r4 weak #3). Ties break
+    toward fused (the safer production default), then toward the
+    non-pallas schedule (several pallas rows model identically to their
+    XLA-wire twins — same schedule, custom data plane — and the XLA twin
+    is the safer default), then toward declaration order for determinism.
+    ``mesh_shape``: 2-D mesh axis sizes — required for khd2d/hierarchical
+    to compete (skipped without it). ``dcn``: cross-slice (alpha, beta)
+    when mesh axis 0 is a genuine DCN crossing — with it, this function
+    arbitrates hierarchical vs khd2d vs fused at the contract's
+    multi-slice config (BASELINE.json:11), which the r4 model could not
+    price at all."""
+    best, best_key = None, (float("inf"), True, True)
     for (v, algo), _ in _MODEL.items():
         if v != verb or (candidates is not None and algo not in candidates):
             continue
-        if algo == "khd2d" and mesh_shape is None:
+        if algo in ("khd2d", "hierarchical") and mesh_shape is None:
             continue
         key = (model_time(verb, algo, n, nbytes, alpha, beta, hbm_beta,
-                          mesh_shape=mesh_shape),
-               algo.startswith("pallas"))
+                          mesh_shape=mesh_shape, dcn=dcn,
+                          embedding=embedding, device_kind=device_kind,
+                          itemsize=itemsize),
+               True, algo.startswith("pallas"))
         if key < best_key:
             best, best_key = algo, key
+    if candidates is None or "fused" in candidates:
+        ft = fused_model_time(verb, n, nbytes, alpha, beta, hbm_beta,
+                              mesh_shape=mesh_shape, dcn=dcn,
+                              device_kind=device_kind)
+        if ft is not None and (ft, False, False) < best_key:
+            best = "fused"
     return best
 
 
@@ -632,7 +860,7 @@ def alpha_sensitivity(device_kind: str, rank_counts, verbs, sizes,
 
 def model_table(device_kind: str, rank_counts, verbs, sizes,
                 platform: str = "tpu", dispatch_alpha_s: float | None = None,
-                _audit: bool = True) -> TuningTable:
+                _audit: bool = True, mesh_shapes=None) -> TuningTable:
     """A tuning table derived from the calibrated cost model — no hardware
     needed. This is the TPU-readiness stopgap (VERDICT r1 item 7): until a
     real multi-chip sweep exists, ``algo="auto"`` consults these picks with
@@ -640,20 +868,27 @@ def model_table(device_kind: str, rank_counts, verbs, sizes,
     measured sweep on real hardware supersedes it (``--merge`` overwrites
     matching keys; provenance is recorded under ``_meta``).
 
-    ``"fused"`` competes alongside the modeled explicit schedules. XLA's
-    lowering runs a bandwidth-optimal schedule SHAPE (``_FUSED_MODEL``) as
-    one compiled program: the per-step dispatch half of alpha disappears
-    (modeled as alpha/2 per hop — physical hop latency remains), but XLA
-    does not switch to log-depth schedules at small sizes — which is
-    exactly where the explicit tree/bruck rows earn their buckets. Ties
-    break toward fused (the safer production default, same reasoning as
-    model_pick's pallas tie-break).
+    Every per-size pick IS ``model_pick`` with fused in the candidate set
+    (one pricing path — the two policies cannot disagree; VERDICT r4 weak
+    #3): XLA's lowering runs a bandwidth-optimal schedule SHAPE
+    (``fused_model_time``) as one compiled program, so the per-step
+    dispatch half of alpha disappears, but XLA does not switch to
+    log-depth schedules at small sizes — which is exactly where the
+    explicit tree/bruck rows earn their buckets.
+
+    ``mesh_shapes``: optional (n_slices, n_intra) tuples — each emits
+    ndim=2 rows for the MULTI-SLICE candidate set (fused / khd2d /
+    hierarchical) priced with DCN constants on the slice axis
+    (``dcn_constants_for``): the contract's 2xv5p-128 config
+    (BASELINE.json:11) becomes a row the table can answer.
 
     ``dispatch_alpha_s``: override the measured dispatch component of
     alpha (the alpha-sensitivity audit's knob); ``_audit=True`` embeds
     ``alpha_sensitivity``'s diff under ``_meta`` so the artifact carries
     its own uncertainty bound.
     """
+    import math as _math
+
     from rocnrdma_tpu import hw
     from rocnrdma_tpu.transport.api import SCHEDULES, supports
 
@@ -661,11 +896,14 @@ def model_table(device_kind: str, rank_counts, verbs, sizes,
         "provenance": "model-derived (tuner.model_table); supersede with a "
                       "measured Autotuner sweep at multi-chip first contact",
         "device_kind": device_kind,
-        # r4 model revision: khd radix ladder calibrated on the MEASURED
-        # fold-rate ladder (hw.MEASURED_FOLD_LADDER), ptree size-scaled
-        # chunks; wire factors stay as-implemented serialized (r3 rule)
+        # r5 model revision: one pricing path for fused (model_pick ==
+        # model_table), DCN constants on 2-D slice axes, ring-embedding
+        # second opinion recorded below; khd radix ladder calibrated on
+        # the MEASURED fold-rate ladder (hw.fold_ladder_for — per-kind
+        # overrides), ptree size-scaled chunks; wire factors stay
+        # as-implemented serialized (r3 rule)
         "wire_factors": "as-implemented serialized (r3) + measured "
-                        "fold-rate ladder (r4)",
+                        "fold-rate ladder (r4) + DCN/ring-embedding (r5)",
     })
     for n in sorted(rank_counts):
         for verb in verbs:
@@ -679,17 +917,48 @@ def model_table(device_kind: str, rank_counts, verbs, sizes,
                 continue
             buckets = []
             for size in sorted(sizes):
-                times = {a: model_time(verb, a, n, size, alpha, beta,
-                                       hbm_beta)
-                         for a in cands}
-                shape = _FUSED_MODEL.get(verb)
-                if shape and "fused" in SCHEDULES[verb]:
-                    steps, wire, hbm = shape(n)
-                    times["fused"] = (steps * alpha / 2 + wire * size * beta
-                                      + hbm * size * hbm_beta)
-                best = min(times, key=lambda a: (times[a], a != "fused"))
+                best = model_pick(verb, n, size, candidates=cands + ["fused"],
+                                  alpha=alpha, beta=beta, hbm_beta=hbm_beta,
+                                  device_kind=device_kind)
                 buckets.append(Bucket(size, best))
             table.set_buckets(verb, n, 1, platform, _coalesce(buckets))
+    dcn = dcn_constants_for(device_kind)
+    for shape in (mesh_shapes or ()):
+        shape = tuple(int(d) for d in shape)
+        N = _math.prod(shape)
+        for verb in verbs:
+            alpha, beta, hbm_beta = constants_for(device_kind, verb)
+            if dispatch_alpha_s is not None:
+                alpha = hw.ICI_HOP_S + dispatch_alpha_s
+            cands2 = [a for a in SCHEDULES.get(verb, ())
+                      if supports(verb, a, True)
+                      and ((verb, a) in _MODEL or a == "fused")]
+            if not cands2:
+                continue
+            buckets = []
+            for size in sorted(sizes):
+                best = model_pick(verb, N, size, candidates=cands2,
+                                  alpha=alpha, beta=beta, hbm_beta=hbm_beta,
+                                  mesh_shape=shape, dcn=dcn,
+                                  device_kind=device_kind)
+                if best is not None:
+                    buckets.append(Bucket(size, best))
+            if buckets:
+                table.set_buckets(verb, N, 2, platform, _coalesce(buckets))
+    if mesh_shapes:
+        table.meta["dcn_alpha_beta"] = list(dcn)
+        table.meta["mesh_shapes"] = [list(s) for s in mesh_shapes]
+    if "allreduce" in verbs:
+        # the dual contract-point radix picks (VERDICT r4 missing #2): the
+        # artifact must say which pricing assumption its headline digits
+        # ride — and what the ring-embedded second opinion picks instead
+        a_, b_, hb_ = constants_for(device_kind, "allreduce")
+        table.meta["embedding_picks"] = {
+            f"allreduce n={n} @1GiB": {
+                emb: list(khd_model_digits("allreduce", n, 1 << 30, a_, b_,
+                                           hb_, emb, device_kind))
+                for emb in ("switch", "ring")}
+            for n in (64, 256)}
     if _audit:
         table.meta["alpha_sensitivity"] = {
             "dispatch_alpha_range_s": list(hw.MEASURED_DISPATCH_ALPHA_RANGE_S),
@@ -779,6 +1048,11 @@ def main(argv=None) -> int:
                         "--ranks takes a comma list here")
     p.add_argument("--table-ranks", default="4,8,16,32,64,256",
                    help="rank counts for --model-table")
+    p.add_argument("--mesh-shapes", default="2x4,2x64,8x32,2x128",
+                   metavar="MxN[,MxN...]",
+                   help="--model-table only: (slices x intra) shapes for "
+                        "the ndim=2 multi-slice rows (DCN-priced slice "
+                        "axis); empty string disables")
     args = p.parse_args(argv)
 
     if args.measure_alpha:
@@ -792,9 +1066,12 @@ def main(argv=None) -> int:
 
     if args.model_table is not None:
         sizes = [parse_size(s) for s in args.sizes.split(",")]
+        shapes = [tuple(int(d) for d in s.split("x"))
+                  for s in args.mesh_shapes.split(",") if s]
         table = model_table(args.model_table,
                             [int(r) for r in args.table_ranks.split(",")],
-                            args.verbs.split(","), sizes)
+                            args.verbs.split(","), sizes,
+                            mesh_shapes=shapes)
         if args.merge and os.path.exists(args.out):
             table = merge_tables(TuningTable.load(args.out), table)
         table.save(args.out)

@@ -59,14 +59,32 @@ def build_dispatch(x: jnp.ndarray, experts: jnp.ndarray, pos: jnp.ndarray,
                    keep: jnp.ndarray, n_experts: int,
                    capacity: int) -> jnp.ndarray:
     """Scatter tokens into the ``(E, C, d)`` dispatch tensor (dropped
-    entries contribute nothing; unused slots stay zero)."""
+    entries contribute nothing; unused slots stay zero).
+
+    r5 (the MFU-residual attribution, bench/mfu_profile.py): the routing
+    machinery IS the flagship step's whole gap to peak, so this data
+    movement is on the critical path. The big (T*k, d) payload never
+    rides a scatter at all: a SMALL scatter builds the inverse
+    permutation (slot (e, p) <- flat entry index; ~E*C int32), and the
+    payload moves in ONE gather — the on-chip profile measured the
+    row-gather lowering ~2x the row-scatter's rate for the same bytes
+    (fusion.72 vs fusion.68, results/mfu_profile_r5.jsonl). Index
+    uniqueness holds by construction (kept entries own distinct (e, pos)
+    slots; dropped entries get mutually distinct out-of-bounds sentinels
+    that ``mode="drop"`` discards)."""
     T, k = experts.shape
     flat_e = experts.reshape(-1)
-    flat_p = jnp.where(keep, pos, 0).reshape(-1)
-    contrib = jnp.where(keep.reshape(-1)[:, None], 1.0, 0.0)
-    tokens = jnp.repeat(x, k, axis=0) * contrib.astype(x.dtype)  # (T*k, d)
-    out = jnp.zeros((n_experts, capacity, x.shape[-1]), x.dtype)
-    return out.at[flat_e, flat_p].add(tokens)
+    # dropped entries -> distinct out-of-bounds slots (capacity + i), so
+    # the index set stays genuinely unique and mode="drop" discards them
+    flat_p = jnp.where(keep.reshape(-1),
+                       pos.reshape(-1),
+                       capacity + jnp.arange(T * k, dtype=pos.dtype))
+    src = jnp.full((n_experts, capacity), -1, jnp.int32)
+    src = src.at[flat_e, flat_p].set(jnp.arange(T * k, dtype=jnp.int32),
+                                     mode="drop", unique_indices=True)
+    # flat entry i carries token i // k (row-major routing priority)
+    tok = jnp.clip(src // k if k > 1 else src, 0)
+    return jnp.where((src >= 0)[..., None], x[tok], 0).astype(x.dtype)
 
 
 def combine(expert_out: jnp.ndarray, gates: jnp.ndarray,

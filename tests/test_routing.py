@@ -96,3 +96,31 @@ def test_moe_topk_workload_end_to_end(devices, cf, expect_drops):
     if not expect_drops:
         np.testing.assert_allclose(np.asarray(out), np.asarray(tok),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_build_dispatch_heavy_drops_never_corrupt_slots():
+    # r5 (the scatter rewrite behind the MFU-residual fix): dropped
+    # entries route to DISTINCT out-of-bounds sentinels and are removed
+    # by mode="drop" — under heavy oversubscription (capacity 2, many
+    # tokens fighting for one expert, k=2 so drop counts vary per token)
+    # every kept slot must carry exactly its token and no dropped entry
+    # may land anywhere
+    rng = np.random.default_rng(3)
+    T, E, k, d, cap = 12, 2, 2, 4, 2
+    x = jnp.asarray(rng.standard_normal((T, d)).astype(np.float32))
+    logits = jnp.asarray(
+        np.stack([np.full(T, 5.0), rng.standard_normal(T)], -1)
+        .astype(np.float32))  # everyone's top-1 is expert 0 -> mass drops
+    gates, experts = R.topk_route(logits, k)
+    pos, keep = R.dispatch_mask(experts, E, cap)
+    assert int(jnp.sum(keep)) < T * k  # the oversubscription really drops
+    disp = np.asarray(R.build_dispatch(x, experts, pos, keep, E, cap))
+    xe, xp, xk = (np.asarray(experts).reshape(-1),
+                  np.asarray(pos).reshape(-1),
+                  np.asarray(keep).reshape(-1))
+    xt = np.repeat(np.asarray(x), k, axis=0)
+    want = np.zeros_like(disp)
+    for i in range(T * k):
+        if xk[i]:
+            want[xe[i], xp[i]] = xt[i]
+    np.testing.assert_allclose(disp, want, rtol=1e-6, atol=1e-6)
