@@ -156,6 +156,12 @@ def main(argv=None) -> int:
                     _ps("3584M"), _ps("1G"), 8, 128, 5, 3)
                 widths = tuple(int(w) for w in
                                args.calibrate_widths.split(","))
+            if 2 not in widths:
+                # the pairwise anchor is load-bearing: hw.fold_ladder_for
+                # REJECTS an anchorless artifact (falls back to the v5e
+                # defaults) and hbm_frac derives from it — a widths list
+                # without it would report ok while calibrating nothing
+                widths = (2,) + tuple(widths)
             rows_l = run_ladder(widths, budget, cap, k1, k2, reps, trials,
                                 dtype="float32")
             ladder = {str(r["n_ops"]): r["GBps_median"] for r in rows_l}

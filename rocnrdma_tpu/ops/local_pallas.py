@@ -92,6 +92,32 @@ def _hbm_combine_kernel(*refs, n_tiles: int, k: int, n_slots: int = 2):
         stores[t].wait()
 
 
+_LANES = 128
+
+
+def _validate_and_pad(xs, tile_rows: int, flat2d: bool):
+    """Shared operand validation + pad/tile arithmetic of both combine
+    entry points: (k, shape, dtype, size, n_tiles, bufs). ``flat2d``:
+    reshape each buffer (n_tiles*tile_rows, lanes) for the grid-indexed
+    emitter instead of (n_tiles, tile_rows, lanes) for the manual
+    kernel."""
+    k = len(xs)
+    if k < 2:
+        raise ValueError("the streaming combine needs >= 2 operands")
+    shape, dtype = xs[0].shape, xs[0].dtype
+    for x in xs[1:]:
+        if x.shape != shape or x.dtype != dtype:
+            raise ValueError("operands must share shape and dtype")
+    tile = tile_rows * _LANES
+    size = xs[0].size
+    padded = -(-size // tile) * tile
+    n_tiles = padded // tile
+    lead = ((n_tiles * tile_rows,) if flat2d else (n_tiles, tile_rows))
+    bufs = [jnp.pad(x.reshape(-1), (0, padded - size))
+            .reshape(lead + (_LANES,)) for x in xs]
+    return k, shape, dtype, size, n_tiles, bufs
+
+
 def pallas_hbm_combine(*xs: jax.Array, tile_rows: int = 2048,
                        n_slots: int = 2,
                        interpret: bool | None = None) -> jax.Array:
@@ -107,23 +133,12 @@ def pallas_hbm_combine(*xs: jax.Array, tile_rows: int = 2048,
     The tile loop unrolls at trace time — at 256 MiB that is 256 tiles,
     the same order of program size as the HBM ring kernel's hop unroll.
     """
-    k = len(xs)
-    if k < 2:
-        raise ValueError("pallas_hbm_combine needs >= 2 operands")
     if n_slots < 2:
         raise ValueError("n_slots must be >= 2 (single-buffer cannot "
                          "overlap load with combine)")
-    shape, dtype = xs[0].shape, xs[0].dtype
-    for x in xs[1:]:
-        if x.shape != shape or x.dtype != dtype:
-            raise ValueError("operands must share shape and dtype")
-    lanes = 128
-    tile = tile_rows * lanes
-    size = xs[0].size
-    padded = -(-size // tile) * tile
-    n_tiles = padded // tile
-    bufs = [jnp.pad(x.reshape(-1), (0, padded - size))
-            .reshape(n_tiles, tile_rows, lanes) for x in xs]
+    lanes = _LANES
+    k, shape, dtype, size, n_tiles, bufs = _validate_and_pad(
+        xs, tile_rows, flat2d=False)
     kern = functools.partial(_hbm_combine_kernel, n_tiles=n_tiles, k=k,
                              n_slots=n_slots)
     out = pl.pallas_call(
@@ -161,20 +176,8 @@ def pallas_hbm_combine_pipelined(*xs: jax.Array, tile_rows: int = 2048,
             "pallas_hbm_combine_pipelined needs a real TPU: Mosaic's "
             "emit_pipeline has no interpret path (use pallas_hbm_combine "
             "on the CPU oracle)")
-    k = len(xs)
-    if k < 2:
-        raise ValueError("pallas_hbm_combine_pipelined needs >= 2 operands")
-    shape, dtype = xs[0].shape, xs[0].dtype
-    for x in xs[1:]:
-        if x.shape != shape or x.dtype != dtype:
-            raise ValueError("operands must share shape and dtype")
-    lanes = 128
-    tile = tile_rows * lanes
-    size = xs[0].size
-    padded = -(-size // tile) * tile
-    n_tiles = padded // tile
-    bufs = [jnp.pad(x.reshape(-1), (0, padded - size))
-            .reshape(n_tiles * tile_rows, lanes) for x in xs]
+    k, shape, dtype, size, n_tiles, bufs = _validate_and_pad(
+        xs, tile_rows, flat2d=True)
 
     def inner(*refs):
         x_refs, o_ref = refs[:k], refs[k]
@@ -183,12 +186,14 @@ def pallas_hbm_combine_pipelined(*xs: jax.Array, tile_rows: int = 2048,
             acc = acc + x_refs[j][...]
         o_ref[...] = acc
 
-    spec = pl.BlockSpec((tile_rows, lanes), lambda i: (i, 0))
-    pipeline = pltpu.emit_pipeline(
-        inner, grid=(n_tiles,), in_specs=[spec] * k, out_specs=[spec])
+    spec = pl.BlockSpec((tile_rows, _LANES), lambda i: (i, 0))
 
     def kernel(*refs):
-        pipeline(*refs)
+        # the emitter must be instantiated INSIDE the kernel trace —
+        # built outside, its closure captures a traced scalar and
+        # pallas_call rejects the kernel ("captures constants")
+        pltpu.emit_pipeline(inner, grid=(n_tiles,), in_specs=[spec] * k,
+                            out_specs=[spec])(*refs)
 
     out = pl.pallas_call(
         kernel,

@@ -196,11 +196,17 @@ class _HostComm:
         # against a full ring without ever making progress (code-review r5)
         import time
         deadline = time.monotonic() + 1.0
-        while self._lg_ack_queue and time.monotonic() < deadline:
-            before = len(self._lg_ack_queue)
-            self._pump()  # polls the CQ (freeing ring slots) + flushes
-            if len(self._lg_ack_queue) == before:
-                time.sleep(0.01)
+        try:
+            while self._lg_ack_queue and time.monotonic() < deadline:
+                before = len(self._lg_ack_queue)
+                self._pump()  # polls the CQ (freeing ring slots) + flushes
+                if len(self._lg_ack_queue) == before:
+                    time.sleep(0.01)
+        except Exception:
+            # teardown must not leak the QP (or abort a net-level close
+            # loop over sibling comms) because the peer died first — the
+            # credit is moot once either side is gone
+            pass
         self.qp.close()
 
 

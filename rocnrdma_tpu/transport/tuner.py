@@ -467,8 +467,8 @@ def fused_model_time(verb: str, n: int, nbytes: int, alpha: float,
     return steps * alpha / 2 + wire * nbytes * beta + hbm * nbytes * hbm_beta
 
 
-def _ptree_cost(n: int, nbytes: int | None = None,
-                itemsize: int = 4) -> tuple[int, float, float]:
+def _ptree_cost(n: int, nbytes: int | None = None, itemsize: int = 4,
+                device_kind: str = "") -> tuple[int, float, float]:
     # C chunks stream through both trees: per phase C+D-1 ticks x up to 4
     # substeps (2 sides x 2 trees) x S/(2C) each, two phases — serialized
     # bytes 4S(C+D-1)/C (ptree.py's own accounting; the async-overlap ideal
@@ -486,10 +486,11 @@ def _ptree_cost(n: int, nbytes: int | None = None,
     c = (PTREE_CHUNKS if nbytes is None
          else ptree_auto_chunks(max(1, nbytes // max(1, itemsize))))
     ticks = c + _L(n) - 1
-    return 8 * ticks, 4.0 * ticks / c, 4.0 * ticks / c * _fold_scale(3)
+    return (8 * ticks, 4.0 * ticks / c,
+            4.0 * ticks / c * _fold_scale(3, device_kind))
 
 
-def _ktree_terms(n: int) -> tuple[int, float, float]:
+def _ktree_terms(n: int, device_kind: str = "") -> tuple[int, float, float]:
     k = _ktree_arity()
     levels = max(1, math.ceil(math.log(n, k)))
     # up to k child substeps/level x 2 phases; each up level ingests k
@@ -497,7 +498,7 @@ def _ktree_terms(n: int) -> tuple[int, float, float]:
     # (k+2) HBM bytes/elem on EVERY rank (where-gated SPMD), at the
     # measured (k+1)-wide fold rate
     return (2 * k * levels, 2.0 * k * levels,
-            (k + 2.0) * levels * _fold_scale(k + 1))
+            (k + 2.0) * levels * _fold_scale(k + 1, device_kind))
 
 
 _MODEL = {
@@ -638,7 +639,19 @@ def model_time(verb: str, algo: str, n: int, nbytes: int,
     if (verb, algo) == ("allreduce", "ptree"):
         # itemsize carries the caller's dtype so the modeled pipeline
         # depth matches the dispatched one on bf16 buffers (ADVICE r4 #3)
-        steps, wire, hbm = _ptree_cost(n, nbytes, itemsize)
+        steps, wire, hbm = _ptree_cost(n, nbytes, itemsize, device_kind)
+        return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
+    # the remaining fold-bearing trees price their HBM term on the same
+    # per-kind ladder as khd (code-review r5: comparing candidates priced
+    # on two different chips' ladders would misplace every crossover
+    # after a first-contact calibration); the kind-less _MODEL rows stay
+    # for size-free introspection only
+    if (verb, algo) == ("allreduce", "ktree"):
+        steps, wire, hbm = _ktree_terms(n, device_kind)
+        return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
+    if (verb, algo) == ("allreduce", "dtree"):
+        steps, wire = 8 * _L(n), 2.0 * _L(n)
+        hbm = 4.0 * _L(n) * _fold_scale(3, device_kind)
         return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
     steps, wire, hbm = _MODEL[(verb, algo)](n)
     return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta

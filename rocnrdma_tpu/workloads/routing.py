@@ -17,6 +17,8 @@ transpose semantics of every alltoall in this package apply unchanged.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -55,6 +57,7 @@ def dispatch_mask(experts: jnp.ndarray, n_experts: int, capacity: int):
     return pos, keep
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def build_dispatch(x: jnp.ndarray, experts: jnp.ndarray, pos: jnp.ndarray,
                    keep: jnp.ndarray, n_experts: int,
                    capacity: int) -> jnp.ndarray:
@@ -71,7 +74,18 @@ def build_dispatch(x: jnp.ndarray, experts: jnp.ndarray, pos: jnp.ndarray,
     (fusion.72 vs fusion.68, results/mfu_profile_r5.jsonl). Index
     uniqueness holds by construction (kept entries own distinct (e, pos)
     slots; dropped entries get mutually distinct out-of-bounds sentinels
-    that ``mode="drop"`` discards)."""
+    that ``mode="drop"`` discards).
+
+    custom_vjp: autodiff would lower the payload gather's transpose as a
+    big scatter-add — the exact lowering the forward was rewritten to
+    avoid, billed to the TRAIN step instead. The known routing tables
+    make the cotangent a GATHER too (grad_x[t] = sum over t's kept slots
+    of g[e, p] — ``_build_dispatch_bwd``), so both directions stay on
+    the fast path."""
+    return _build_dispatch_impl(x, experts, pos, keep, n_experts, capacity)
+
+
+def _build_dispatch_impl(x, experts, pos, keep, n_experts, capacity):
     T, k = experts.shape
     flat_e = experts.reshape(-1)
     # dropped entries -> distinct out-of-bounds slots (capacity + i), so
@@ -85,6 +99,30 @@ def build_dispatch(x: jnp.ndarray, experts: jnp.ndarray, pos: jnp.ndarray,
     # flat entry i carries token i // k (row-major routing priority)
     tok = jnp.clip(src // k if k > 1 else src, 0)
     return jnp.where((src >= 0)[..., None], x[tok], 0).astype(x.dtype)
+
+
+def _build_dispatch_fwd(x, experts, pos, keep, n_experts, capacity):
+    out = _build_dispatch_impl(x, experts, pos, keep, n_experts, capacity)
+    return out, (experts, pos, keep)
+
+
+def _build_dispatch_bwd(n_experts, capacity, res, g):
+    import numpy as np
+    experts, pos, keep = res
+    T, k = experts.shape
+    # token t's cotangent sums its kept slots' upstream rows — a gather
+    # by the same (expert, pos) tables the forward used (the forward
+    # output carries x's dtype, so g's dtype IS x's)
+    picked = g[experts.reshape(-1),
+               jnp.where(keep, pos, 0).reshape(-1)]        # (T*k, d)
+    picked = jnp.where(keep.reshape(-1)[:, None], picked, 0)
+    gx = picked.reshape(T, k, -1).sum(axis=1).astype(g.dtype)
+    f0 = jax.dtypes.float0
+    return (gx, np.zeros(experts.shape, f0), np.zeros(pos.shape, f0),
+            np.zeros(keep.shape, f0))
+
+
+build_dispatch.defvjp(_build_dispatch_fwd, _build_dispatch_bwd)
 
 
 def combine(expert_out: jnp.ndarray, gates: jnp.ndarray,

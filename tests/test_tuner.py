@@ -733,3 +733,19 @@ def test_model_table_emits_2d_mesh_rows_and_dual_picks():
     picks = tbl.meta["embedding_picks"]["allreduce n=64 @1GiB"]
     assert picks["switch"] == [64]
     assert picks["ring"] != [64]
+
+
+def test_ptree_model_depth_keys_on_element_count():
+    # ADVICE r4 #3: the modeled ptree pipeline depth must match the
+    # DISPATCHED one for non-fp32 dtypes — a bf16 buffer of the same
+    # nbytes has 2x the elements, hence at least as deep a pipeline
+    from rocnrdma_tpu.collectives.ptree import ptree_auto_chunks
+    from rocnrdma_tpu.transport.tuner import _ptree_cost
+    nbytes = 8 * M.MiB
+    s32, w32, _ = _ptree_cost(8, nbytes, itemsize=4)
+    s16, w16, _ = _ptree_cost(8, nbytes, itemsize=2)
+    c32 = ptree_auto_chunks(nbytes // 4)
+    c16 = ptree_auto_chunks(nbytes // 2)
+    assert (s32, s16) == (8 * (c32 + 2), 8 * (c16 + 2))
+    if c16 != c32:  # the depths genuinely diverge at this size
+        assert s16 != s32
