@@ -90,6 +90,24 @@ def marginal_s_per_op(make_chain, x0, k1: int, k2: int, repeats: int,
     return min(marginal_trials(make_chain, x0, k1, k2, repeats, trials))
 
 
+def _barrier(out):
+    """Wait for ``out`` AND fetch one element. On relayed/remote backends
+    ``block_until_ready`` has been observed returning before device
+    completion (bench.py's discipline note); a device-to-host fetch is
+    the reliable barrier there, and costs one scalar everywhere else.
+    The leading leaf's first element suffices — dispatch order means its
+    completion implies the rest of the batch has been consumed."""
+    import numpy as np
+    jax.block_until_ready(out)
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves and hasattr(leaves[0], "ndim") and getattr(
+            leaves[0], "size", 0):
+        # first element by direct indexing — ravel() of a multi-D array
+        # would dispatch a full-buffer device reshape inside the timed
+        # span (code-review r5); a scalar index is a scalar fetch
+        np.asarray(leaves[0][(0,) * leaves[0].ndim])
+
+
 def time_fn(fn, *args, warmup: int = 2, repeats: int = 5,
             calls_per_repeat: int = 10) -> Timing:
     """Time ``fn(*args)`` (a jitted callable) per the rules above."""
@@ -97,14 +115,14 @@ def time_fn(fn, *args, warmup: int = 2, repeats: int = 5,
     # the first timed repeat, even with --warmup 0.
     for _ in range(max(1, warmup)):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _barrier(out)
 
     spans = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(calls_per_repeat):
             out = fn(*args)
-        jax.block_until_ready(out)
+        _barrier(out)
         spans.append((time.perf_counter() - t0) / calls_per_repeat)
     return Timing(mean_s=trimmed_mean(spans), min_s=min(spans), max_s=max(spans),
                   repeats=repeats, calls_per_repeat=calls_per_repeat)

@@ -490,6 +490,16 @@ def _ptree_cost(n: int, nbytes: int | None = None, itemsize: int = 4,
             4.0 * ticks / c * _fold_scale(3, device_kind))
 
 
+def _dtree_terms(n: int, device_kind: str = "") -> tuple[int, float, float]:
+    # double binary tree AS IMPLEMENTED (level-synchronous, dtree.py):
+    # ~2 substeps/level x D levels x 2 phases x 2 trees x S/2 serialized;
+    # every rank executes every level's gated 3-op fold. ONE copy shared
+    # by the _MODEL introspection row and model_time's kind-aware path
+    # (code-review r5: an inlined duplicate would desynchronize them).
+    return (8 * _L(n), 2.0 * _L(n),
+            4.0 * _L(n) * _fold_scale(3, device_kind))
+
+
 def _ktree_terms(n: int, device_kind: str = "") -> tuple[int, float, float]:
     k = _ktree_arity()
     levels = max(1, math.ceil(math.log(n, k)))
@@ -531,12 +541,10 @@ _MODEL = {
     ("allreduce", "hierarchical"): None,
     # double binary tree AS IMPLEMENTED (level-synchronous, dtree.py): each
     # level's substeps move the whole half-buffer and levels serialize —
-    # ~2 substeps/level x D levels x 2 phases x 2 trees x S/2 = 2*D*S
-    # serialized; every rank executes every level's gated 3-op fold
-    # (4 HBM bytes/elem x S/2 x D x 2 trees). Latency-only role;
-    # model_pick must never keep it at bandwidth sizes (test_tuner guards).
-    ("allreduce", "dtree"): lambda n: (
-        8 * _L(n), 2.0 * _L(n), 4.0 * _L(n) * _fold_scale(3)),
+    # 2*D*S serialized (see _dtree_terms, the one copy of the accounting).
+    # Latency-only role; model_pick must never keep it at bandwidth sizes
+    # (test_tuner guards).
+    ("allreduce", "dtree"): lambda n: _dtree_terms(n),
     # k-ary tree AS IMPLEMENTED (ktree.py): arity-scaled serialized
     # ingress. The wide fold is real; the wire cost is why khd exists.
     ("allreduce", "ktree"): lambda n: _ktree_terms(n),
@@ -650,8 +658,7 @@ def model_time(verb: str, algo: str, n: int, nbytes: int,
         steps, wire, hbm = _ktree_terms(n, device_kind)
         return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
     if (verb, algo) == ("allreduce", "dtree"):
-        steps, wire = 8 * _L(n), 2.0 * _L(n)
-        hbm = 4.0 * _L(n) * _fold_scale(3, device_kind)
+        steps, wire, hbm = _dtree_terms(n, device_kind)
         return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta
     steps, wire, hbm = _MODEL[(verb, algo)](n)
     return steps * alpha + wire * nbytes * beta + hbm * nbytes * hbm_beta

@@ -322,7 +322,10 @@ def test_autotune_2d_mesh_candidates():
     tuner = Autotuner(t, warmup=1, repeats=1, calls_per_repeat=1)
     table = tuner.sweep(["allreduce"], [1024])
     picked = table.lookup("allreduce", 1024, 4, 2, "cpu")
-    assert picked in ("fused", "hierarchical")  # the only 2-D-legal algos
+    # the 2-D-legal candidate set (khd2d joined it in r4); which one wins
+    # a 1-repeat oracle timing is window luck, so the assertion is the
+    # SET, not a winner
+    assert picked in ("fused", "hierarchical", "khd2d")
 
 
 def test_constants_for_tpu_calibration():
