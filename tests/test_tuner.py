@@ -752,3 +752,21 @@ def test_ptree_model_depth_keys_on_element_count():
     assert (s32, s16) == (8 * (c32 + 2), 8 * (c16 + 2))
     if c16 != c32:  # the depths genuinely diverge at this size
         assert s16 != s32
+
+
+def test_fused_2d_rs_ag_priced_so_khd2d_never_unopposed():
+    # code-review r5: without a fused 2-D RS/AG price, khd2d won those
+    # table rows unopposed — the DCN-heaviest schedule recommended at the
+    # exact config the allreduce rows demote it for. Now fused's
+    # multislice decomposition competes and wins wherever the slice axis
+    # is genuine DCN.
+    a, b, hb, dcn = _v5p_ar()
+    for verb in ("reduce_scatter", "allgather"):
+        for shape in ((2, 4), (2, 128), (8, 32)):
+            N = shape[0] * shape[1]
+            for size in (4096, M.MiB, M.GiB):
+                pick = model_pick(verb, N, size,
+                                  candidates=("fused", "khd2d"),
+                                  alpha=a, beta=b, hbm_beta=hb,
+                                  mesh_shape=shape, dcn=dcn)
+                assert pick == "fused", (verb, shape, size, pick)
