@@ -191,8 +191,9 @@ def main() -> int:
         devices = jax.devices()
     except Exception:
         # no usable accelerator backend: fall back to the CPU oracle
+        from rocnrdma_tpu.runtime.compat import set_cpu_device_count
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        set_cpu_device_count(8)
         devices = jax.devices()
 
     import jax.numpy as jnp

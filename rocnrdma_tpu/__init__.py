@@ -18,3 +18,9 @@ transport; empty at the surveyed v0 snapshot, so the contract is defined by
 """
 
 __version__ = "0.1.0"
+
+# jax-version compat shims (runtime/compat.py) are installed by the
+# jax-consuming packages at their own import (runtime, collectives, ops,
+# transport.api) — NOT here: the pure-host-plane modules
+# (transport.bootstrap/plugin/faults, the native QPs, the chaos workers)
+# must stay importable in ~0s without pulling jax into the process.

@@ -21,6 +21,11 @@ Two families:
   code.
 """
 
+# install the jax-version compat shims before any schedule code touches
+# jax.shard_map / lax.axis_size (idempotent; see runtime/compat.py)
+from rocnrdma_tpu.runtime.compat import install as _install_jax_compat
+_install_jax_compat()
+
 from rocnrdma_tpu.collectives import schedule  # noqa: F401
 from rocnrdma_tpu.collectives import program  # noqa: F401
 from rocnrdma_tpu.collectives.program import (  # noqa: F401

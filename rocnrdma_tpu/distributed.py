@@ -82,15 +82,21 @@ class ProcessGroup:
     def __init__(self, rank: int, world_size: int, store_handle: str,
                  server: "bootstrap.BootstrapServer | None",
                  timeout_s: float = 30.0, group_name: str = "default",
-                 plane: str = "tcp"):
+                 plane: str = "tcp", fault_schedule=None):
         self.rank = rank
         self.world_size = world_size
         self.group_name = group_name
         self.plane = plane
+        self.timeout_s = timeout_s  # the group's default op deadline
         self._server = server  # only rank 0 (or an external sidecar) owns one
         if plane not in _PLANES:
             raise ValueError(f"unknown plane {plane!r}; know {sorted(_PLANES)}")
         self._net = _PLANES[plane]()
+        if fault_schedule is not None:
+            # chaos harness hook: the same group, over a wire that
+            # misbehaves on schedule (transport/faults.py)
+            from rocnrdma_tpu.transport.faults import FaultNet
+            self._net = FaultNet(self._net, fault_schedule)
         self._net.init()
         try:
             if world_size > 1:
@@ -119,12 +125,16 @@ class ProcessGroup:
 
     # -- collectives (numpy in, numpy out) ---------------------------------
 
-    def _ring(self, fn, *args, **kw):
+    def _ring(self, fn, *args, timeout_s=None, **kw):
         self._check_alive()  # fail fast instead of hanging on the dead
-        return fn(self._net, self._send, self._recv, *args, **kw)
+        # every wire wait under this call is bounded by ONE deadline: the
+        # per-call override, else the group default from init — a stalled
+        # peer surfaces as a named TimeoutError, never a hang
+        t = self.timeout_s if timeout_s is None else timeout_s
+        return fn(self._net, self._send, self._recv, *args, timeout_s=t, **kw)
 
-    def all_reduce(self, x, op: str = "sum",
-                   transport: str = "msg") -> np.ndarray:
+    def all_reduce(self, x, op: str = "sum", transport: str = "msg",
+                   timeout_s: float | None = None) -> np.ndarray:
         """Elementwise reduction across ranks (op: sum/prod/max/min/avg);
         every rank gets the result, shape preserved. ``transport``:
         ``"msg"`` (two-sided send/recv ring) or ``"rdma"`` (one-sided
@@ -137,11 +147,12 @@ class ProcessGroup:
             return x.copy()
         fn = (plugin.ring_allreduce_rdma if transport == "rdma"
               else plugin.ring_allreduce_over_net)
-        out = self._ring(fn, x, self.rank, self.world_size, op=wire_op)
+        out = self._ring(fn, x, self.rank, self.world_size, op=wire_op,
+                         timeout_s=timeout_s)
         return self._avg_finalize(out, x, op)
 
-    def reduce_scatter(self, x, op: str = "sum",
-                       transport: str = "msg") -> np.ndarray:
+    def reduce_scatter(self, x, op: str = "sum", transport: str = "msg",
+                       timeout_s: float | None = None) -> np.ndarray:
         """Reduce across ranks (op: sum/prod/max/min/avg); rank r keeps the
         r-th of n floor-balanced element ranges of the flattened buffer.
         ``transport``: ``"msg"`` (send/recv ring) or ``"rdma"`` (one-sided
@@ -153,10 +164,12 @@ class ProcessGroup:
             return x.ravel().copy()
         fn = (plugin.ring_reduce_scatter_rdma if transport == "rdma"
               else plugin.ring_reduce_scatter_over_net)
-        out = self._ring(fn, x, self.rank, self.world_size, op=wire_op)
+        out = self._ring(fn, x, self.rank, self.world_size, op=wire_op,
+                         timeout_s=timeout_s)
         return self._avg_finalize(out, x, op)
 
-    def all_gather(self, x, transport: str = "msg") -> np.ndarray:
+    def all_gather(self, x, transport: str = "msg",
+                   timeout_s: float | None = None) -> np.ndarray:
         """Every rank contributes ``x`` (same shape everywhere); returns
         ``(world_size, *x.shape)`` in rank order. ``transport`` as in
         :meth:`all_reduce`."""
@@ -166,9 +179,11 @@ class ProcessGroup:
             return x[None].copy()
         fn = (plugin.ring_allgather_rdma if transport == "rdma"
               else plugin.ring_allgather_over_net)
-        return self._ring(fn, x, self.rank, self.world_size)
+        return self._ring(fn, x, self.rank, self.world_size,
+                          timeout_s=timeout_s)
 
-    def broadcast(self, x, src: int = 0) -> np.ndarray:
+    def broadcast(self, x, src: int = 0,
+                  timeout_s: float | None = None) -> np.ndarray:
         """Every rank returns rank ``src``'s buffer (non-src inputs size the
         receive buffer)."""
         x = np.asarray(x)
@@ -176,18 +191,19 @@ class ProcessGroup:
         if self.world_size == 1:
             return x.copy()
         return self._ring(plugin.ring_broadcast_over_net, x, self.rank,
-                          self.world_size, root=src)
+                          self.world_size, root=src, timeout_s=timeout_s)
 
-    def all_to_all(self, x) -> np.ndarray:
+    def all_to_all(self, x, timeout_s: float | None = None) -> np.ndarray:
         """``x`` is ``(world_size, ...)``; row j goes to rank j. Returns the
         rows addressed to this rank, in source-rank order."""
         x = np.asarray(x)
         if self.world_size == 1:
             return x.copy()
         return self._ring(plugin.ring_alltoall_over_net, x, self.rank,
-                          self.world_size)
+                          self.world_size, timeout_s=timeout_s)
 
-    def all_to_all_v(self, segments: list, counts, dtype="float32") -> list:
+    def all_to_all_v(self, segments: list, counts, dtype="float32",
+                     timeout_s: float | None = None) -> list:
         """Variable-count alltoall (the RCCL ``ncclAllToAllv`` extension):
         ``segments[j]`` (``counts[self.rank, j]`` elements) goes to rank j;
         returns the n received segments in source order. ``counts`` is the
@@ -200,9 +216,10 @@ class ProcessGroup:
         # validation behaves identically to multi-rank runs
         return self._ring(plugin.ring_alltoallv_over_net, segments,
                           np.asarray(counts), self.rank, self.world_size,
-                          dtype=dtype)
+                          dtype=dtype, timeout_s=timeout_s)
 
-    def all_gather_v(self, x, counts) -> list:
+    def all_gather_v(self, x, counts,
+                     timeout_s: float | None = None) -> list:
         """Ragged allgather (gloo/MPI ``allgatherv``): rank r contributes
         ``counts[r]`` elements; every rank returns the n segments in rank
         order. ``counts`` is the length-n vector every rank knows (the MPI
@@ -216,9 +233,10 @@ class ProcessGroup:
             return plugin.ring_allgatherv_over_net(
                 None, None, None, x, counts, 0, 1)
         return self._ring(plugin.ring_allgatherv_over_net, x, counts,
-                          self.rank, self.world_size)
+                          self.rank, self.world_size, timeout_s=timeout_s)
 
-    def reduce_scatter_v(self, x, counts, op: str = "sum") -> np.ndarray:
+    def reduce_scatter_v(self, x, counts, op: str = "sum",
+                         timeout_s: float | None = None) -> np.ndarray:
         """Ragged reduce-scatter (MPI ``Reduce_scatter`` with recvcounts):
         ``x`` is the concatenation of n chunks sized by ``counts`` (same
         layout everywhere); rank r returns the reduction of every rank's
@@ -231,7 +249,8 @@ class ProcessGroup:
                 None, None, None, x, counts, 0, 1, op=wire_op)
         else:
             out = self._ring(plugin.ring_reduce_scatter_v_over_net, x,
-                             counts, self.rank, self.world_size, op=wire_op)
+                             counts, self.rank, self.world_size, op=wire_op,
+                             timeout_s=timeout_s)
         return self._avg_finalize(out, x, op)
 
     def _avg_wire_op(self, x, op: str, verb: str) -> str:
@@ -253,7 +272,8 @@ class ProcessGroup:
             out = (out / self.world_size).astype(x.dtype)
         return out
 
-    def reduce(self, x, dst: int = 0, op: str = "sum") -> np.ndarray | None:
+    def reduce(self, x, dst: int = 0, op: str = "sum",
+               timeout_s: float | None = None) -> np.ndarray | None:
         """Rooted reduction: every rank contributes ``x``; only rank ``dst``
         returns the reduced array (others return None, torch semantics).
         Pipelined chain reduce toward the root under the hood."""
@@ -263,10 +283,12 @@ class ProcessGroup:
         if self.world_size == 1:
             return x.copy()
         out = self._ring(plugin.ring_reduce_over_net, x, self.rank,
-                         self.world_size, root=dst, op=wire_op)
+                         self.world_size, root=dst, op=wire_op,
+                         timeout_s=timeout_s)
         return self._avg_finalize(out, x, op)
 
-    def gather(self, x, dst: int = 0) -> np.ndarray | None:
+    def gather(self, x, dst: int = 0,
+               timeout_s: float | None = None) -> np.ndarray | None:
         """Rooted gather: every rank contributes ``x`` (same shape
         everywhere); rank ``dst`` returns ``(world_size, *x.shape)`` in rank
         order, others return None."""
@@ -275,9 +297,10 @@ class ProcessGroup:
         if self.world_size == 1:
             return x[None].copy()
         return self._ring(plugin.ring_gather_over_net, x, self.rank,
-                          self.world_size, root=dst)
+                          self.world_size, root=dst, timeout_s=timeout_s)
 
-    def scatter(self, x, src: int = 0) -> np.ndarray:
+    def scatter(self, x, src: int = 0,
+                timeout_s: float | None = None) -> np.ndarray:
         """Rooted scatter: rank ``src`` passes ``(world_size, ...)`` — row j
         goes to rank j; every OTHER rank passes a template of one row's
         shape/dtype (contents ignored, it sizes the receive). Every rank
@@ -289,7 +312,7 @@ class ProcessGroup:
                 raise ValueError(f"scatter root wants (1, ...), got {x.shape}")
             return x[0].copy()
         return self._ring(plugin.ring_scatter_over_net, x, self.rank,
-                          self.world_size, root=src)
+                          self.world_size, root=src, timeout_s=timeout_s)
 
     # -- object collectives (pickled python values, torch-style) -----------
     #
@@ -592,16 +615,35 @@ class ProcessGroup:
                     f"{key}/{r}",
                     timeout_s=max(0.0, deadline - time.monotonic()))
             except TimeoutError:
-                missing = []
-                for m in range(r, self.world_size):  # one naming sweep
-                    try:
-                        self._client.get(f"{key}/{m}", timeout_s=0.0)
-                    except TimeoutError:
-                        missing.append(m)
+                try:  # one naming sweep (try_get: a transport failure
+                    # must not name a present rank as missing)
+                    missing = [m for m in range(r, self.world_size)
+                               if self._client.try_get(f"{key}/{m}") is None]
+                except TimeoutError:
+                    missing = list(range(r, self.world_size))  # store gone:
+                    # every unconfirmed rank stays suspect, said so below
+                # store-state triage of the missing: one that still talks
+                # to the store is certainly alive (stuck or slow — keep
+                # waiting); one silent for a long window is PROBABLY gone.
+                # The silence window gets a floor well above the barrier
+                # timeout: a rank deep in a long jit compile makes no
+                # store RPCs either, and a 2 s barrier must not brand it
+                # dead. This is evidence for the error message, not a
+                # decision — nothing acts on it unilaterally.
+                silence_s = max(timeout_s, 15.0)
+                try:
+                    silent = set(self._client.dead_ranks(
+                        self.world_size, max_age_s=silence_s))
+                except (OSError, TimeoutError):
+                    silent = set()
+                dead = sorted(set(missing) & silent)
+                slow = sorted(set(missing) - silent)
                 raise TimeoutError(
                     f"monitored_barrier: rank(s) {missing} missing after "
                     f"{timeout_s}s (group {self.group_name!r}, "
-                    f"world_size {self.world_size})") from None
+                    f"world_size {self.world_size}; "
+                    f"store-silent>{silence_s:.0f}s {dead}, "
+                    f"store-live {slow})") from None
 
     def split(self, color: int, timeout_s: float = 30.0) -> "ProcessGroup | None":
         """Partition the group into sub-groups by ``color`` (the
@@ -653,17 +695,38 @@ class ProcessGroup:
             raise RuntimeError("nothing to shrink: single-rank group")
         import json
         import time
+
+        from rocnrdma_tpu.transport.backoff import poll_backoff
         ns = f"pg/{self.group_name}/shrink{self._shrink_no}"
         self._client.set(f"{ns}/alive/{self.rank}", "1")
-        time.sleep(grace_s)
+        # grace window, polled instead of blind-slept: the only EARLY exit
+        # is every rank having posted (no one left to wait for — the
+        # no-death fast path). Store liveness is deliberately NOT used to
+        # cut the window short: it is circumstantial (a rank deep in
+        # compute makes no RPCs), good for NAMING suspects in errors
+        # (monitored_barrier's triage), too weak to justify unilaterally
+        # excluding a rank the full grace would have admitted.
         members_key = f"{ns}/members"
-        alive = []
-        for r in range(self.world_size):
-            try:
-                self._client.get(f"{ns}/alive/{r}", timeout_s=0.0)
-                alive.append(r)
-            except TimeoutError:
-                pass
+        deadline = time.monotonic() + grace_s
+        back = poll_backoff()
+        while True:
+            # try_get, not get(timeout_s=0): an alive-key lookup that fails
+            # at the TRANSPORT must raise (named), never read as "rank is
+            # gone" — a store-connection flake during the leader's final
+            # poll must not get a live rank excluded from the member list
+            alive = [r for r in range(self.world_size)
+                     if self._client.try_get(f"{ns}/alive/{r}") is not None]
+            if len(alive) == self.world_size:
+                break
+            if time.monotonic() >= deadline:
+                break
+            back.pause()
+        if not alive:
+            # we posted our own key and cannot read it back: the store is
+            # unreachable — name it instead of crashing on min([])
+            raise TimeoutError(
+                f"shrink: no alive keys readable after {grace_s}s grace "
+                f"(store unreachable? group {self.group_name!r})")
         if self.rank == min(alive):
             # first-writer-wins: with skewed entry two ranks can each think
             # themselves the minimum survivor; set-if-absent makes exactly
@@ -721,8 +784,11 @@ class ProcessGroup:
         def run():
             client = None
             try:
-                client = bootstrap.BootstrapClient(self._store_handle,
-                                                   self.rank)
+                # same liveness scope as the group's main client, so the
+                # watchdog's RPCs stamp THIS group's table
+                client = bootstrap.BootstrapClient(
+                    self._store_handle, self.rank,
+                    scope=f"pg/{self.group_name}/ring")
                 beat = 0
                 seen: dict[int, tuple] = {}  # target -> (value, stamp)
                 dead: set[int] = set()
@@ -869,7 +935,8 @@ def init_process_group(rank: int | None = None,
                        store_handle: str | None = None,
                        timeout_s: float = 30.0,
                        group_name: str = "default",
-                       plane: str = "tcp") -> ProcessGroup:
+                       plane: str = "tcp",
+                       fault_schedule=None) -> ProcessGroup:
     """Create this process's :class:`ProcessGroup`.
 
     Rendezvous: either pass ``store_handle`` (an already-running
@@ -882,6 +949,10 @@ def init_process_group(rank: int | None = None,
     ``plane``: the wire under the ring — ``"tcp"`` (cross-host; default) or
     ``"shm"`` (shared-memory queue pairs: the intra-node fast path, all
     ranks on one machine; the rendezvous store stays TCP either way).
+
+    ``fault_schedule``: a ``transport.faults.FaultSchedule`` to wrap the
+    net plane in a fault-injecting ``FaultNet`` — the chaos-testing hook
+    (construct it with this rank, so streams stay per-rank).
     """
     rank = int(os.environ["RANK"]) if rank is None else rank
     world_size = (int(os.environ["WORLD_SIZE"]) if world_size is None
@@ -902,7 +973,8 @@ def init_process_group(rank: int | None = None,
             store_handle = f"{master_addr}:{master_port}"
     try:
         return ProcessGroup(rank, world_size, store_handle, server,
-                            timeout_s, group_name, plane)
+                            timeout_s, group_name, plane,
+                            fault_schedule=fault_schedule)
     except BaseException:
         if server is not None:  # failed rendezvous must free the master port
             server.close()

@@ -32,6 +32,7 @@ nccl-tests-style suites, which the reference's ``bench_allreduce`` followed):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import time
@@ -100,6 +101,45 @@ def busbw_GBps(collective: str, n_ranks: int, size_bytes: int,
         factor = (total - float(min(counts))) / total
         return algbw_GBps(size_bytes, seconds) * factor
     return algbw_GBps(size_bytes, seconds) * _BUSBW_FACTOR[collective](n_ranks)
+
+
+@dataclasses.dataclass
+class FaultCounters:
+    """Named fault-event counters — the chaos-plane telemetry row.
+
+    Producers are the fault-injection layer (``transport.faults.FaultNet``
+    counts every fault it injects) and the survival machinery (retry
+    loops count what they absorbed); consumers are the chaos harness and
+    soak tests, which sum counters across ranks from the one-line JSON
+    each worker prints. Keys are free-form kind strings
+    (``connect-refused``, ``test-delayed``, ``comm-dead``, ...); the
+    class owns only the wire format (counting itself rides
+    ``collections.Counter``) so every producer serialises identically
+    (the same single-owner discipline as the busbw table above)."""
+
+    counts: collections.Counter = dataclasses.field(
+        default_factory=collections.Counter)
+
+    def __post_init__(self):
+        if not isinstance(self.counts, collections.Counter):
+            self.counts = collections.Counter(self.counts)
+
+    def count(self, kind: str, n: int = 1) -> None:
+        self.counts[kind] += n
+
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def merge(self, other: "FaultCounters") -> "FaultCounters":
+        self.counts.update(other.counts)
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps(dict(sorted(self.counts.items())))
+
+    @classmethod
+    def from_json(cls, line: str) -> "FaultCounters":
+        return cls(counts=json.loads(line))
 
 
 @dataclasses.dataclass

@@ -87,9 +87,13 @@ def build(force: bool = False) -> str:
         if stale:
             os.makedirs(_LIB_DIR, exist_ok=True)
             tmp = _LIB + f".tmp.{os.getpid()}"
+            # -lrt: shm_open/shm_unlink live in librt on pre-2.34 glibc
+            # (newer glibc ships an empty librt, so the flag is harmless
+            # everywhere — without it the .so builds fine and then fails
+            # at dlopen with "undefined symbol: shm_open")
             subprocess.run(
                 ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
-                 *_SRCS, "-pthread"],
+                 *_SRCS, "-pthread", "-lrt"],
                 check=True, capture_output=True, text=True)
             os.replace(tmp, _LIB)  # atomic: concurrent builders don't clash
     return _LIB
@@ -99,7 +103,13 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    lib = ctypes.CDLL(build())
+    try:
+        lib = ctypes.CDLL(build())
+    except OSError:
+        # a cached .so from another toolchain/glibc (e.g. built without
+        # -lrt where shm_open needed it) can dlopen-fail while looking
+        # fresh by mtime — rebuild once with today's flags before giving up
+        lib = ctypes.CDLL(build(force=True))
     lib.rqp_listen.restype = ctypes.c_void_p
     lib.rqp_listen.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                ctypes.c_uint32]

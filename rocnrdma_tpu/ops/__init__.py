@@ -8,6 +8,11 @@ semaphores — queue pairs become double-buffered communication slots, and
 completion polling becomes semaphore waits.
 """
 
+# install the jax-version compat shims before any schedule code touches
+# jax.shard_map / lax.axis_size (idempotent; see runtime/compat.py)
+from rocnrdma_tpu.runtime.compat import install as _install_jax_compat
+_install_jax_compat()
+
 from rocnrdma_tpu.ops.local_pallas import (  # noqa: F401
     pallas_hbm_combine,
 )

@@ -150,7 +150,16 @@ def _interpret_mode(interpret: bool | None):
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    return pltpu.InterpretParams() if interpret else False
+    if not interpret:
+        return False
+    if not hasattr(pltpu, "InterpretParams"):
+        if hasattr(pltpu, "TPUInterpretParams"):  # pre-rename spelling
+            return pltpu.TPUInterpretParams()
+        raise NotImplementedError(
+            "this jax release has no TPU interpret mode (pltpu."
+            "InterpretParams); the pallas data plane needs a real TPU "
+            "here — gate callers on runtime.compat.tpu_interpret_available()")
+    return pltpu.InterpretParams()
 
 
 def _pad_chunks(x: jax.Array, n: int, lanes: int = 128):

@@ -14,6 +14,11 @@ is exactly what this package owns:
   BASELINE.json:7).
 """
 
+# install the jax-version compat shims before any schedule code touches
+# jax.shard_map / lax.axis_size (idempotent; see runtime/compat.py)
+from rocnrdma_tpu.runtime.compat import install as _install_jax_compat
+_install_jax_compat()
+
 from rocnrdma_tpu.runtime.mesh import (  # noqa: F401
     Topology,
     detect_topology,

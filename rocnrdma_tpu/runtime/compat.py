@@ -1,0 +1,160 @@
+"""jax version compatibility shims (robustness layer L0).
+
+The codebase targets the current jax API surface (``jax.shard_map`` with
+``check_vma``, ``jax_num_cpu_devices``); deployment containers routinely lag
+a few releases behind. Rather than sprinkle try/excepts at every call site,
+this module owns the two known seams:
+
+- :func:`ensure_shard_map` — installs ``jax.shard_map`` on releases that
+  only ship ``jax.experimental.shard_map.shard_map`` (mapping the
+  ``check_vma`` kwarg to its old name ``check_rep``). Idempotent; called
+  once from the package ``__init__`` so every internal and test call site
+  works unchanged.
+- :func:`set_cpu_device_count` — the ``jax_num_cpu_devices`` config knob,
+  falling back to ``XLA_FLAGS=--xla_force_host_platform_device_count`` on
+  releases that predate the knob. Must run before the backend initialises
+  (both mechanisms are init-time-only); raises with a usable diagnosis if
+  it is already too late and the existing layout can't serve.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+_installed = False
+
+
+def install() -> None:
+    """Install every applicable shim. Idempotent and cheap on repeat;
+    called from each jax-consuming package's ``__init__`` (runtime,
+    collectives, ops, transport.api) so both internal modules and the
+    test suite see one consistent jax surface — while the pure-host-plane
+    modules never pay the jax import."""
+    global _installed
+    if _installed:
+        return
+    ensure_shard_map()
+    ensure_axis_size()
+    ensure_pallas_params()
+    _installed = True
+
+
+def ensure_shard_map() -> None:
+    """Make ``jax.shard_map(f, mesh=, in_specs=, out_specs=, check_vma=)``
+    callable on jax releases that predate the top-level export."""
+    import jax
+
+    if getattr(jax, "_rnr_shard_map_shim", False):
+        return
+    try:
+        jax.shard_map  # noqa: B018 — probe the deprecation getattr
+        return  # modern jax: nothing to do
+    except AttributeError:
+        pass
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def _shim(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+    jax.shard_map = _shim
+    jax._rnr_shard_map_shim = True
+
+
+def ensure_axis_size() -> None:
+    """Provide ``jax.lax.axis_size(name)`` on releases that predate it.
+
+    Old jax exposes the (static) size of a bound axis through the axis
+    environment: ``jax._src.core.axis_frame(name)`` returns the plain int
+    the schedules need for loop bounds and chunk math."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return
+    from jax._src import core as _core
+
+    def _axis_size(axis_name):
+        if isinstance(axis_name, (tuple, list)):
+            n = 1
+            for a in axis_name:
+                n *= _core.axis_frame(a)
+            return n
+        return _core.axis_frame(axis_name)
+
+    lax.axis_size = _axis_size
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Configure ``n`` fake CPU devices, whichever way this jax supports."""
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass  # old jax: the knob doesn't exist; fall through to XLA_FLAGS
+    except RuntimeError as e:
+        _verify_layout(n, e)
+        return
+    flag = f"--xla_force_host_platform_device_count={n}"
+    prev = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in prev:
+        os.environ["XLA_FLAGS"] = f"{prev} {flag}".strip()
+    elif flag not in prev:
+        import re
+        os.environ["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, prev)
+    # XLA parses the env at first backend creation; if that already
+    # happened the flag is inert — verify rather than silently run short
+    if jax._src.xla_bridge._backends:  # backend(s) already initialised
+        _verify_layout(n, None)
+
+
+def ensure_pallas_params() -> None:
+    """Alias ``pltpu.CompilerParams`` to its pre-rename ``TPUCompilerParams``
+    on jax releases that predate the rename (same fields, including the
+    ``collective_id`` the ring kernels set)."""
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except Exception:  # no pallas at all: the ops modules guard themselves
+        return
+    if (not hasattr(pltpu, "CompilerParams")
+            and hasattr(pltpu, "TPUCompilerParams")):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+def tpu_interpret_available() -> bool:
+    """Does this jax ship the TPU interpret machinery (``pltpu.
+    InterpretParams``) the remote-DMA data plane needs off-TPU? Old
+    releases have none — callers (and the pallas test files) gate on
+    this instead of tracebacking into a missing attribute."""
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except Exception:
+        return False
+    return (hasattr(pltpu, "InterpretParams")
+            or hasattr(pltpu, "TPUInterpretParams"))
+
+
+def profile_data_available() -> bool:
+    """Does ``jax.profiler`` export ``ProfileData`` (the xplane reader the
+    trace alignment lanes parse)? Old releases don't; trace.measured_lanes
+    raises a clean ImportError there and its tests skip."""
+    try:
+        from jax.profiler import ProfileData  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _verify_layout(n: int, cause) -> None:
+    import jax
+
+    devs = jax.devices()
+    if devs[0].platform != "cpu" or len(devs) < n:
+        raise RuntimeError(
+            f"jax already initialised with {len(devs)} {devs[0].platform} "
+            f"device(s); cannot retro-fit {n} fake CPU devices (set "
+            f"JAX_PLATFORMS=cpu and the device count before startup)"
+        ) from cause

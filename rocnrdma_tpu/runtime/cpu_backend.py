@@ -16,18 +16,14 @@ def force_cpu_devices(n: int) -> None:
     """Configure an ``n``-fake-device CPU backend, or raise if it's too late."""
     import jax
 
+    from rocnrdma_tpu.runtime.compat import _verify_layout, set_cpu_device_count
+
     os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
+        set_cpu_device_count(n)
     except RuntimeError as e:
         # config.update raises once backends are initialised; verify the
         # existing layout is usable rather than silently benchmarking the
-        # wrong device count.
-        devs = jax.devices()
-        if devs[0].platform != "cpu" or len(devs) < n:
-            raise RuntimeError(
-                f"jax already initialised with {len(devs)} {devs[0].platform} "
-                f"device(s); cannot retro-fit {n} fake CPU devices "
-                f"(set JAX_PLATFORMS=cpu and the device count before startup): {e}"
-            ) from e
+        # wrong device count (ONE definition of that check: compat's).
+        _verify_layout(n, e)

@@ -17,12 +17,116 @@ Tasks:
 - ``fault``: ``--fault-rank`` exits(3) BEFORE the init barrier; the others
   must fail their (deadline-bounded) initialize with a clean error — the
   coordinator-timeout surfacing disposition of SURVEY.md §5.
+- ``chaos-allreduce``: the HOST-plane chaos path (no jax): each rank wires
+  a ring over ``FaultNet(HostQPNet)`` with a seeded fault schedule
+  (refused connects/accepts, delayed completions, dropped closes), runs
+  ``--rounds`` int64 ring allreduces, and asserts each BITWISE against the
+  replicated-seed oracle. Exits 0 (all correct), 4 (clean named
+  TimeoutError/OSError abort, printed as ``CLEAN-ABORT``), or 5 (silent
+  corruption — the one outcome chaos may never produce). Every rank
+  prints its fault counters (``FAULTS {json}``) and the schedule's replay
+  fingerprint (``FAULTLOG hex``) for the soak harness.
+- ``die-mid-collective``: chaos-allreduce where ``--fault-rank``
+  ``os._exit``\\ s (no FIN, no teardown) at the half-way round while its
+  peers are already inside the collective; survivors must surface a named
+  clean abort (exit 4), never hang to a harness kill.
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+
+CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective")
+
+
+def _chaos_input(seed: int, rank: int, rnd: int, size: int):
+    """The deterministic per-(rank, round) contribution every rank can
+    reconstruct for any other — int64 so the ring reduction is exact and
+    the correctness assertion is BITWISE, not allclose."""
+    import numpy as np
+    rng = np.random.default_rng((seed, rank, rnd))
+    return rng.integers(-1_000_000, 1_000_000, size=size, dtype=np.int64)
+
+
+def _chaos_main(args) -> int:
+    import os
+
+    import numpy as np
+
+    from rocnrdma_tpu.transport import bootstrap
+    from rocnrdma_tpu.transport.faults import FaultNet, FaultSchedule
+    from rocnrdma_tpu.transport.plugin import (
+        HostQPNet,
+        ring_allreduce_over_net,
+    )
+
+    rank, n = args.process_id, args.num_processes
+    server = None
+    if rank == 0:
+        host, port = args.coordinator.rsplit(":", 1)
+        server = bootstrap.BootstrapServer(n_ranks=n, port=int(port),
+                                           host=host)
+    # the chaos profile: every class of fault the schedule knows, at rates
+    # the hardened stack must absorb (connect/accept refusals retried by
+    # bootstrap_ring, delayed completions absorbed by Request.wait) or
+    # surface cleanly. Deterministic per (seed, rank).
+    sched = FaultSchedule(
+        args.seed, rank,
+        connect_refusals=2, accept_refusals=1,
+        test_delay_p=0.3, test_delay_polls=(1, 6),
+        close_drop_p=0.5)
+    net = FaultNet(HostQPNet(), sched)
+    net.init()
+    die_round = args.rounds // 2
+    status = 0
+    try:
+        send, recv, client = bootstrap.bootstrap_ring(
+            net, args.coordinator, rank, n, timeout_s=60.0,
+            ns=f"chaos{args.seed}")
+        for rnd in range(args.rounds):
+            if (args.task == "die-mid-collective" and rank == args.fault_rank
+                    and rnd == die_round):
+                # peers are already inside round die_round's allreduce;
+                # _exit skips every destructor — no FIN, no credit return,
+                # exactly a SIGKILLed host
+                print(f"FAULT: dying mid-collective round={rnd}", flush=True)
+                os._exit(7)
+            local = _chaos_input(args.seed, rank, rnd, args.size)
+            got = ring_allreduce_over_net(net, send, recv, local, rank, n,
+                                          timeout_s=15.0)
+            want = _chaos_input(args.seed, 0, rnd, args.size)
+            for r in range(1, n):
+                want = want + _chaos_input(args.seed, r, rnd, args.size)
+            if not np.array_equal(got, want):
+                print(f"BAD-RESULT: round {rnd} not bitwise-correct",
+                      flush=True)
+                status = 5
+                break
+        if status == 0:
+            client.barrier(f"chaos{args.seed}/done", n, 30.0)
+            # the vtable close verb, so scheduled close drops get their
+            # shot (a dropped close defers to net.close() below)
+            net.close_comm(send)
+            net.close_comm(recv)
+            client.close()
+            print(f"OK rank={rank}/{n} rounds={args.rounds}", flush=True)
+    except (TimeoutError, OSError) as e:
+        # THE contract under chaos: named, typed, clean — never a hang
+        print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+        status = 4
+    finally:
+        print(f"FAULTS {sched.counters.to_json()}", flush=True)
+        print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        try:
+            net.close()
+        except (OSError, TimeoutError):
+            pass
+        if server is not None:
+            if status == 0:
+                server.wait_idle(timeout_s=5.0)
+            server.close()
+    return status
 
 
 def main(argv=None) -> int:
@@ -31,18 +135,26 @@ def main(argv=None) -> int:
     p.add_argument("--num-processes", type=int, required=True)
     p.add_argument("--process-id", type=int, required=True)
     p.add_argument("--task",
-                   choices=("allreduce", "alltoall", "hierarchical", "fault"),
+                   choices=("allreduce", "alltoall", "hierarchical", "fault")
+                   + CHAOS_TASKS,
                    required=True)
     p.add_argument("--fault-rank", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--rounds", type=int, default=10)
+    p.add_argument("--size", type=int, default=2048)
     args = p.parse_args(argv)
 
+    if args.task in CHAOS_TASKS:
+        return _chaos_main(args)  # host plane only: no jax, no devices
+
     import jax
+
+    from rocnrdma_tpu.runtime.compat import set_cpu_device_count
 
     jax.config.update("jax_platforms", "cpu")
     # hierarchical: each process is one SLICE hosting 2 devices, so the
     # slice axis crosses the process boundary (the DCN analogue)
-    jax.config.update("jax_num_cpu_devices",
-                      2 if args.task == "hierarchical" else 1)
+    set_cpu_device_count(2 if args.task == "hierarchical" else 1)
 
     from rocnrdma_tpu.runtime.init import init_runtime
 

@@ -35,19 +35,35 @@ def free_port() -> int:
 
 
 def run_workers(n: int, task: str, timeout_s: float = 120.0,
-                fault_rank: int | None = None) -> list[WorkerResult]:
-    """Spawn ``n`` worker processes running ``task``; wait for all."""
+                fault_rank: int | None = None, seed: int | None = None,
+                rounds: int | None = None,
+                size: int | None = None) -> list[WorkerResult]:
+    """Spawn ``n`` worker processes running ``task``; wait for all.
+
+    A worker that outlives ``timeout_s`` is killed and reported with
+    returncode -9 — the outcome the chaos soak asserts NEVER happens
+    (the stack must convert every injected fault into success or a named
+    clean abort before the harness loses patience).
+
+    ``seed``/``rounds``/``size`` parameterize the chaos tasks (see
+    ``mp_worker``); ``fault_rank`` picks the victim for ``fault`` and
+    ``die-mid-collective``."""
     coordinator = f"127.0.0.1:{free_port()}"
     procs = []
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)          # workers get exactly 1 CPU device each
     env["JAX_PLATFORMS"] = "cpu"
+    extra = (["--fault-rank", str(fault_rank)] if fault_rank is not None
+             else [])
+    for flag, val in (("--seed", seed), ("--rounds", rounds),
+                      ("--size", size)):
+        if val is not None:
+            extra += [flag, str(val)]
     for i in range(n):
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "rocnrdma_tpu.runtime.mp_worker",
              "--coordinator", coordinator, "--num-processes", str(n),
-             "--process-id", str(i), "--task", task]
-            + (["--fault-rank", str(fault_rank)] if fault_rank is not None else []),
+             "--process-id", str(i), "--task", task] + extra,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env))
     results = []
     for i, p in enumerate(procs):

@@ -6,15 +6,25 @@ for a raw-RDMA backend, the TPU framework exposes ONE interface over global
 ``jax.Array``s and lowers every collective to jit-compiled XLA programs —
 in-slice traffic rides ICI, cross-slice rides DCN, and "memory registration"
 is simply sharded device placement.
+
+Import discipline: the HOST-plane surface (the vtable nets, bootstrap store,
+backoff, FaultNet, the ring collectives over numpy) imports eagerly and
+jax-free — chaos workers and store sidecars start in ~0s. The DEVICE-plane
+surface (``Transport``, ``Group``) loads jax lazily on first attribute
+access (PEP 562), installing the jax-version compat shims as it goes.
 """
 
-from rocnrdma_tpu.transport.api import Transport, ALGOS  # noqa: F401
-from rocnrdma_tpu.transport.group import Group, GroupError, GroupHandle  # noqa: F401
+from rocnrdma_tpu.transport.backoff import (  # noqa: F401
+    Backoff,
+    poll_backoff,
+    retry_with_backoff,
+)
 from rocnrdma_tpu.transport.bootstrap import (  # noqa: F401
     BootstrapClient,
     BootstrapServer,
     bootstrap_ring,
 )
+from rocnrdma_tpu.transport.faults import FaultNet, FaultSchedule  # noqa: F401
 from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     DeviceMeshNet,
     HostQPNet,
@@ -36,3 +46,24 @@ from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     ring_alltoall_over_net,
     ring_broadcast_over_net,
 )
+
+# jax-heavy exports, resolved on first access so `import
+# rocnrdma_tpu.transport` alone never pays the jax import
+_LAZY = {
+    "Transport": "rocnrdma_tpu.transport.api",
+    "ALGOS": "rocnrdma_tpu.transport.api",
+    "Group": "rocnrdma_tpu.transport.group",
+    "GroupError": "rocnrdma_tpu.transport.group",
+    "GroupHandle": "rocnrdma_tpu.transport.group",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

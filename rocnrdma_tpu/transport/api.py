@@ -31,6 +31,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# install the jax-version compat shims before any schedule code touches
+# jax.shard_map / lax.axis_size (idempotent; see runtime/compat.py)
+from rocnrdma_tpu.runtime.compat import install as _install_jax_compat
+_install_jax_compat()
+
 from rocnrdma_tpu import collectives as C
 from rocnrdma_tpu.runtime.mesh import INTRA_AXIS, RANK_AXIS, SLICE_AXIS, rank_mesh
 

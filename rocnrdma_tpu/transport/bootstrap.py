@@ -11,7 +11,16 @@ hosts requires.
 
 Protocol: length-framed JSON requests over a ``TcpQueuePair``, strict
 request→reply lockstep per client. Ops: ``set`` / ``get`` (non-blocking;
-client polls) / ``barrier_arrive`` + ``barrier_done`` / ``bye``.
+client polls) / ``barrier_arrive`` + ``barrier_done`` / ``live`` / ``bye``.
+Every request carries the client's ``rank``; the server keeps a last-seen
+stamp per rank (the passive liveness table ``live`` reads back), and
+barrier arrival is keyed by rank — idempotent, so a client that retries an
+RPC over a dropped connection can never double-count a barrier.
+
+Failure model: the client survives transient connection drops by
+reconnecting with jittered backoff and replaying the request (safe: every
+op is idempotent per rank). A reply that never comes surfaces as a named
+``TimeoutError`` bounded by the caller's deadline — polls never hang.
 
 Usage::
 
@@ -24,26 +33,38 @@ Usage::
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
 
 from rocnrdma_tpu import native
+from rocnrdma_tpu.transport.backoff import (
+    poll_backoff,
+    retry_with_backoff,
+)
 
 
 class BootstrapServer:
     """Rank-0-side store. One daemon thread per client connection (rendezvous
-    fan-in is small and short-lived); state is a dict + barrier counters."""
+    fan-in is small and short-lived); state is a dict + per-rank barrier
+    arrival sets + a last-seen liveness table."""
 
     def __init__(self, n_ranks: int, port: int = 0, host: str | None = None):
         self.n_ranks = n_ranks
         self._listener = native.TcpListener(port=port, host=host)
         self.handle = self._listener.handle
         self._kv: dict[str, str] = {}
-        self._barriers: dict[str, int] = {}
+        self._barriers: dict[str, set] = {}  # key -> set of arrived ranks
+        # (scope, rank) -> monotonic stamp: liveness is namespaced like
+        # every other piece of store state — two groups sharing one store
+        # (a split() child next to its parent) must not read each other's
+        # ranks as their own (the rank numbers collide, the scopes don't)
+        self._last_seen: dict[tuple, float] = {}
         self._lock = threading.Lock()
         self._closed = False
         self._threads: list[threading.Thread] = []
+        self._conn_ids = itertools.count()  # distinguishes rank-less clients
         self._acceptor = threading.Thread(target=self._accept_loop, daemon=True)
         self._acceptor.start()
 
@@ -59,9 +80,15 @@ class BootstrapServer:
                 return  # listener closed under us
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
-            self._threads.append(t)
+            with self._lock:
+                # prune the finished under the same lock that guards the
+                # append: unbounded growth (and the append-vs-snapshot race
+                # with wait_idle) both die here
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
 
     def _serve(self, conn):
+        conn_id = next(self._conn_ids)
         try:
             while not self._closed:
                 try:
@@ -70,15 +97,19 @@ class BootstrapServer:
                     continue
                 except OSError:
                     return  # client went away
-                conn.send(json.dumps(self._handle(req)).encode())
+                conn.send(json.dumps(self._handle(req, conn_id)).encode())
                 if req.get("op") == "bye":
                     return
         finally:
             conn.close()
 
-    def _handle(self, req: dict) -> dict:
+    def _handle(self, req: dict, conn_id: int = -1) -> dict:
         op = req.get("op")
+        rank = req.get("rank")
+        scope = req.get("scope", "")
         with self._lock:
+            if rank is not None:
+                self._last_seen[(scope, int(rank))] = time.monotonic()
             if op == "set":
                 self._kv[req["key"]] = req["value"]
                 return {"ok": True}
@@ -92,10 +123,27 @@ class BootstrapServer:
                     return {"ok": True, "value": self._kv[req["key"]]}
                 return {"ok": False}
             if op == "barrier_arrive":
-                self._barriers[req["key"]] = self._barriers.get(req["key"], 0) + 1
+                # keyed by rank (conn id for rank-less callers): arrival is
+                # IDEMPOTENT, so an RPC replayed over a reconnect cannot
+                # count twice and release a barrier early
+                who = rank if rank is not None else ("conn", conn_id)
+                self._barriers.setdefault(req["key"], set()).add(who)
                 return {"ok": True}
             if op == "barrier_done":
-                return {"ok": self._barriers.get(req["key"], 0) >= req["n"]}
+                return {"ok": len(self._barriers.get(req["key"], ()))
+                              >= req["n"]}
+            if op == "live":
+                # liveness table: seconds since each rank's last RPC (the
+                # store-state evidence monitored_barrier/shrink name the
+                # dead from). Heartbeats are implicit — every RPC stamps —
+                # plus the explicit ``hb`` no-op for idle ranks.
+                now = time.monotonic()
+                return {"ok": True,
+                        "ages": {str(r): now - t
+                                 for (sc, r), t in self._last_seen.items()
+                                 if sc == scope}}
+            if op == "hb":
+                return {"ok": True}  # the stamp above was the point
             if op == "bye":
                 return {"ok": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
@@ -105,7 +153,9 @@ class BootstrapServer:
         or disconnected) — the orderly-shutdown handshake: close the server
         only after this returns, so no client's in-flight RPC is cut."""
         deadline = time.monotonic() + timeout_s
-        for t in list(self._threads):
+        with self._lock:
+            threads = list(self._threads)  # snapshot under the append lock
+        for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def close(self):
@@ -126,18 +176,82 @@ class BootstrapServer:
 
 
 class BootstrapClient:
-    """One rank's connection to the store."""
+    """One rank's connection to the store.
 
-    def __init__(self, handle: str, rank: int, timeout_s: float = 30.0):
+    Connection failures are survivable: the initial dial retries refused
+    connects with backoff (the server may not be listening yet), and a
+    connection dropped mid-conversation is re-dialed and the request
+    replayed — safe because every op is idempotent per rank (see the
+    barrier-arrival keying in the server). A reply that never comes in
+    ``timeout_s`` surfaces as ``TimeoutError``. One thread per client:
+    the wire protocol is strict request→reply lockstep."""
+
+    def __init__(self, handle: str, rank: int, timeout_s: float = 30.0,
+                 scope: str = ""):
         self.rank = rank
-        self._qp = native.TcpQueuePair.connect(handle, timeout_s)
+        self.timeout_s = timeout_s
+        # liveness namespace: clients of one group pass one scope (the
+        # ring's store namespace), so live/dead queries see only peers of
+        # THAT group — rank numbers collide across groups, scopes don't
+        self.scope = scope
+        self._handle = handle
+        self._said_bye = False
+        self._qp = self._dial(timeout_s)
 
-    def _rpc(self, **req) -> dict:
-        self._qp.send(json.dumps(req).encode())
-        return json.loads(self._qp.recv())
+    def _dial(self, timeout_s: float):
+        # refused dials retry with backoff: rank 0 may still be binding the
+        # master port when rank N-1 starts (the races every launcher has)
+        return retry_with_backoff(
+            lambda: native.TcpQueuePair.connect(
+                self._handle, min(5.0, timeout_s)),
+            timeout_s, f"bootstrap dial {self._handle}",
+            retry_on=(OSError,))
 
-    def set(self, key: str, value: str) -> None:
-        resp = self._rpc(op="set", key=key, value=value)
+    def _rpc(self, _budget_s: float | None = None, **req) -> dict:
+        """One request→reply, surviving a dropped/hung connection by
+        re-dialing and replaying (never resending on the same connection —
+        a late reply to the first copy would desync the lockstep).
+
+        ``_budget_s`` bounds the RETRY budget (reconnect + replay) — the
+        deadline-honoring poll loops (get/barrier) pass their remaining
+        time so a 2 s caller deadline cannot inflate into 30 s of
+        re-dialing per RPC against a dead store. The first attempt always
+        runs (a 0 budget means "one try, no retries"); a single healthy
+        round-trip is bounded by ``self.timeout_s`` as before."""
+        req.setdefault("rank", self.rank)
+        req.setdefault("scope", self.scope)
+        payload = json.dumps(req).encode()
+        deadline = time.monotonic() + (self.timeout_s if _budget_s is None
+                                       else max(0.0, _budget_s))
+        back = None  # built on the FIRST failure: the happy path (every
+        last: Exception | None = None  # poll iteration) allocates nothing
+        while True:
+            try:
+                self._qp.send(payload)
+                return json.loads(self._qp.recv(timeout_s=self.timeout_s))
+            except (OSError, TimeoutError) as e:
+                last = e
+                if back is None:
+                    back = poll_backoff()
+                if self._said_bye or time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"bootstrap rpc {req.get('op')!r} failed "
+                        f"(retry budget spent): {last!r}") from last
+                back.pause()
+                try:
+                    self._qp.close()
+                except OSError:
+                    pass
+                self._qp = self._dial(
+                    max(0.1, deadline - time.monotonic()))
+
+    def set(self, key: str, value: str,
+            timeout_s: float | None = None) -> None:
+        """``timeout_s``: optional retry budget for surviving a dropped
+        connection (default: the client-level ``self.timeout_s``) — the
+        deadline-honoring callers (exchange) pass their remaining time."""
+        resp = self._rpc(op="set", key=key, value=value,
+                         _budget_s=timeout_s)
         if not resp.get("ok"):
             raise OSError(f"bootstrap set({key!r}) failed: {resp}")
 
@@ -146,36 +260,79 @@ class BootstrapClient:
         (ours if we won the race, the incumbent's otherwise)."""
         return self._rpc(op="setnx", key=key, value=value)["value"]
 
+    def try_get(self, key: str) -> str | None:
+        """One idempotent lookup: the value if present, ``None`` if the
+        key is ABSENT. A transport failure raises (after the client retry
+        budget) instead of masquerading as absence — callers deciding
+        membership (``ProcessGroup.shrink``) or naming the dead must not
+        read a flaky wire as a missing rank."""
+        resp = self._rpc(op="get", key=key)
+        return resp.get("value") if resp.get("ok") else None
+
     def get(self, key: str, timeout_s: float = 30.0) -> str:
-        """Blocking get: polls until the key appears."""
+        """Blocking get: polls (jittered backoff) until the key appears or
+        the deadline passes."""
         deadline = time.monotonic() + timeout_s
+        back = poll_backoff()
         while True:
-            resp = self._rpc(op="get", key=key)
+            resp = self._rpc(op="get", key=key,
+                             _budget_s=deadline - time.monotonic())
             if resp.get("ok"):
                 return resp["value"]
             if time.monotonic() >= deadline:
                 raise TimeoutError(f"bootstrap key {key!r} never published")
-            time.sleep(0.01)
+            back.pause()
 
     def barrier(self, key: str, n: int, timeout_s: float = 30.0) -> None:
-        self._rpc(op="barrier_arrive", key=key)
         deadline = time.monotonic() + timeout_s
+        self._rpc(op="barrier_arrive", key=key, _budget_s=timeout_s)
+        back = poll_backoff()
         while True:
-            if self._rpc(op="barrier_done", key=key, n=n).get("ok"):
+            if self._rpc(op="barrier_done", key=key, n=n,
+                         _budget_s=deadline - time.monotonic()).get("ok"):
                 return
             if time.monotonic() >= deadline:
                 raise TimeoutError(f"bootstrap barrier {key!r} timed out")
-            time.sleep(0.01)
+            back.pause()
+
+    def heartbeat(self) -> None:
+        """Stamp this rank's liveness without any other side effect (every
+        RPC stamps implicitly; this is for idle ranks that want to stay
+        visibly alive)."""
+        self._rpc(op="hb")
+
+    def live_ages(self) -> dict[int, float]:
+        """Seconds since each rank's last store RPC, from the server's
+        passive liveness table. A rank absent from the dict has never
+        spoken to the store through a rank-tagged client."""
+        ages = self._rpc(op="live").get("ages", {})
+        return {int(r): float(a) for r, a in ages.items()}
+
+    def dead_ranks(self, n_ranks: int, max_age_s: float) -> list[int]:
+        """Ranks the STORE's evidence says are gone: never seen, or silent
+        for more than ``max_age_s``. This is circumstantial (a rank busy in
+        a long compute makes no RPCs) — callers use it to NAME suspects in
+        errors, not to act unilaterally."""
+        ages = self.live_ages()
+        return [r for r in range(n_ranks)
+                if r not in ages or ages[r] > max_age_s]
 
     def exchange(self, prefix: str, my_value: str, n: int,
                  timeout_s: float = 30.0) -> list[str]:
         """Publish ``my_value`` under ``prefix/rank``; return all n values
-        in rank order (the all-gather every bootstrap needs)."""
-        self.set(f"{prefix}/{self.rank}", my_value)
-        return [self.get(f"{prefix}/{r}", timeout_s) for r in range(n)]
+        in rank order (the all-gather every bootstrap needs).
+        ``timeout_s`` is ONE overall deadline for the whole exchange, not
+        a per-key allowance — n keys can no longer stretch one nominal
+        timeout n-fold."""
+        deadline = time.monotonic() + timeout_s
+        self.set(f"{prefix}/{self.rank}", my_value, timeout_s=timeout_s)
+        return [self.get(f"{prefix}/{r}",
+                         max(0.0, deadline - time.monotonic()))
+                for r in range(n)]
 
     def close(self):
         try:
+            self._said_bye = True  # no reconnect-replay past this point
             self._rpc(op="bye")
         except Exception:
             pass
@@ -195,13 +352,34 @@ def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
     predecessor. Returns ``(send_comm, recv_comm, client)`` — close the
     client after the job, the comms via ``net.close()``.
 
+    ``timeout_s`` is ONE overall deadline for the whole wiring (store
+    dial, handle exchange, connect, accept, barrier). Refused connects
+    and accepts retry with backoff inside the deadline — the peer's
+    listener may not be up yet, and fault-injecting planes
+    (``transport.faults.FaultNet``) refuse the first k attempts by
+    design; what never succeeds surfaces as a named ``TimeoutError``.
+
     ``ns`` namespaces this ring's store keys: distinct groups sharing one
     long-lived store MUST use distinct namespaces (keys and barrier
     counters persist for the store's lifetime)."""
-    client = BootstrapClient(store_handle, rank, timeout_s)
-    handle, listener = net.listen()
-    handles = client.exchange(f"{ns}/h", handle, n_ranks, timeout_s)
-    send_comm = net.connect(0, handles[(rank + 1) % n_ranks], timeout_s)
-    recv_comm = net.accept(listener, timeout_s)
-    client.barrier(f"{ns}/wired", n_ranks, timeout_s)
+    deadline = time.monotonic() + timeout_s
+    remaining = lambda: max(0.1, deadline - time.monotonic())
+    client = BootstrapClient(store_handle, rank, timeout_s, scope=ns)
+    try:
+        handle, listener = net.listen()
+        handles = client.exchange(f"{ns}/h", handle, n_ranks, remaining())
+        send_comm = retry_with_backoff(
+            lambda: net.connect(0, handles[(rank + 1) % n_ranks],
+                                min(5.0, remaining())),
+            remaining(), f"ring wiring: connect to rank {(rank + 1) % n_ranks}",
+            retry_on=(ConnectionRefusedError, ConnectionResetError))
+        recv_comm = retry_with_backoff(
+            lambda: net.accept(listener, min(5.0, remaining())),
+            remaining(), f"ring wiring: accept rank {(rank - 1) % n_ranks}",
+            retry_on=(ConnectionRefusedError, ConnectionResetError,
+                      TimeoutError))
+        client.barrier(f"{ns}/wired", n_ranks, remaining())
+    except BaseException:
+        client.close()  # a failed wiring must not leak the store conn
+        raise
     return send_comm, recv_comm, client

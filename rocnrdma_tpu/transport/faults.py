@@ -1,0 +1,262 @@
+"""FaultNet — a deterministic fault-injecting net plane.
+
+The reference's whole reason to exist is a transport that keeps
+collectives correct over an unreliable wire; the production half of that
+claim is proving the stack DEGRADES CLEANLY — named errors, never hangs —
+when connects flake, completions stall, and peers die mid-collective.
+This module is the wire that misbehaves on demand: :class:`FaultNet`
+wraps ANY vtable net (``HostQPNet``, ``TCPNet``, ``DeviceMeshNet``) with
+the same verbs (``listen / connect / accept / reg_mr / isend / irecv /
+test / close``) and injects faults from a **seeded, replayable
+schedule**.
+
+Fault classes (all off by default; see :class:`FaultSchedule`):
+
+- **connect/accept refusals** — the first ``k`` attempts raise
+  ``ConnectionRefusedError`` (a peer whose listener isn't up yet, a
+  flaky SYN); later attempts may flake with probability ``p``. The
+  refusal happens BEFORE the inner verb runs, so a retry can succeed.
+- **delayed test completions** — with probability ``p`` an ``irecv``'s
+  completion is held for a drawn number of extra ``test()`` polls after
+  the wire actually delivered it (a slow CQ, an interrupt coalesce).
+  Progress underneath keeps flowing — only the *report* is late.
+- **comm death after the Nth op** — every data verb past the threshold
+  raises ``OSError`` (the NIC fell off the bus). Poisoning, not
+  retryable, exactly like a real half-written QP.
+- **rank partition** — after ``partition_after_ops`` data ops this
+  net drops traffic silently: sends complete locally but never arrive,
+  receives never complete. The layers above MUST turn that into a named
+  ``TimeoutError``; a hang is a failed test.
+- **close drops** — with probability ``p`` a ``close_comm`` skips the
+  graceful teardown (a peer that died without FIN); the wrapped net's
+  final ``close()`` still reclaims everything.
+
+Determinism: every decision is drawn from per-fault-class
+``random.Random`` streams seeded by ``(seed, rank, class)`` string keys
+(process-stable hashing) and advanced only by this rank's own op
+sequence — never by wall-clock time or cross-rank interleaving. Two runs
+of the same seed against the same local call sequence inject byte-for-
+byte the same faults; ``FaultSchedule.log`` records them and
+``fingerprint()`` hashes the log for cheap replay assertions.
+
+Counters ride :class:`rocnrdma_tpu.metrics.FaultCounters` so the chaos
+harness can sum injected faults across ranks from each worker's one-line
+JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import random
+
+from rocnrdma_tpu.metrics import FaultCounters
+from rocnrdma_tpu.transport.plugin import Request
+
+
+@dataclasses.dataclass
+class FaultSchedule:
+    """The seeded, replayable fault plan for ONE rank's net.
+
+    ``seed``/``rank`` key the random streams; every knob defaults to "no
+    faults", so an empty schedule makes :class:`FaultNet` a transparent
+    wrapper. Construct one per rank (``FaultSchedule(seed, rank)``) —
+    per-rank streams keep determinism independent of thread/process
+    interleaving.
+    """
+
+    seed: int = 0
+    rank: int = 0
+    # connection-plane faults
+    connect_refusals: int = 0       # first k connect() attempts refused
+    accept_refusals: int = 0        # first k accept() attempts refused
+    connect_flake_p: float = 0.0    # later connects refused with prob p
+    # completion-plane faults
+    test_delay_p: float = 0.0       # prob an irecv completion is held
+    test_delay_polls: tuple = (1, 8)  # held for uniform[a, b] extra polls
+    # death-plane faults
+    die_after_ops: int | None = None        # OSError on every op past N
+    partition_after_ops: int | None = None  # silent blackhole past N
+    close_drop_p: float = 0.0       # prob a close_comm skips teardown
+
+    def __post_init__(self):
+        self.counters = FaultCounters()
+        self.log: list = []   # (op_no, kind, detail) in injection order
+        self.ops = 0          # data ops (isend/irecv) seen so far
+        self._connect_attempts = 0
+        self._accept_attempts = 0
+        self._rngs: dict[str, random.Random] = {}
+
+    def _rng(self, stream: str) -> random.Random:
+        # string seeding is sha512-based (process-stable), unlike hash()
+        if stream not in self._rngs:
+            self._rngs[stream] = random.Random(
+                f"{self.seed}:{self.rank}:{stream}")
+        return self._rngs[stream]
+
+    def record(self, kind: str, detail=None) -> None:
+        self.counters.count(kind)
+        self.log.append((self.ops, kind, detail))
+
+    def fingerprint(self) -> str:
+        """Stable digest of the injection log — two runs of one seed over
+        one call sequence must produce equal fingerprints (the replay
+        assertion the soak test makes)."""
+        return hashlib.sha256(
+            json.dumps(self.log, default=str).encode()).hexdigest()
+
+    # -- per-verb decisions (each advances only its own stream) ------------
+
+    def connect_fault(self) -> str | None:
+        self._connect_attempts += 1
+        if self._connect_attempts <= self.connect_refusals:
+            self.record("connect-refused", self._connect_attempts)
+            return f"injected refusal {self._connect_attempts}/" \
+                   f"{self.connect_refusals}"
+        if (self.connect_flake_p
+                and self._rng("connect").random() < self.connect_flake_p):
+            self.record("connect-flaked", self._connect_attempts)
+            return "injected transient connect flake"
+        return None
+
+    def accept_fault(self) -> str | None:
+        self._accept_attempts += 1
+        if self._accept_attempts <= self.accept_refusals:
+            self.record("accept-refused", self._accept_attempts)
+            return f"injected refusal {self._accept_attempts}/" \
+                   f"{self.accept_refusals}"
+        return None
+
+    def op_fault(self, verb: str) -> str | None:
+        """Called once per data op (isend/irecv); returns the death mode
+        in force, if any."""
+        self.ops += 1
+        if self.die_after_ops is not None and self.ops > self.die_after_ops:
+            self.record("comm-dead", verb)
+            return "dead"
+        if (self.partition_after_ops is not None
+                and self.ops > self.partition_after_ops):
+            self.record("partitioned", verb)
+            return "partitioned"
+        return None
+
+    def test_delay(self) -> int:
+        """Extra not-done ``test()`` polls to inject on this irecv
+        (0 = report truthfully)."""
+        rng = self._rng("test")
+        if self.test_delay_p and rng.random() < self.test_delay_p:
+            lo, hi = self.test_delay_polls
+            d = rng.randint(lo, hi)
+            self.record("test-delayed", d)
+            return d
+        return 0
+
+    def close_drop(self) -> bool:
+        if (self.close_drop_p
+                and self._rng("close").random() < self.close_drop_p):
+            self.record("close-dropped")
+            return True
+        return False
+
+
+class FaultNet:
+    """The vtable wrapper that misbehaves on ``schedule``'s command.
+
+    Transparent for every verb the schedule leaves alone: unknown
+    attributes (``alloc_mr``, ``iwrite``, ``LG_CHUNK``, ``MAX_FRAME``,
+    plane-specific helpers) delegate to the inner net, so collectives,
+    ``_RingWire`` chunking, and the one-sided paths ride through
+    unchanged. Comms are the inner net's own objects — progress pumps and
+    per-comm state need no adaptation.
+    """
+
+    def __init__(self, inner, schedule: FaultSchedule | None = None):
+        self.inner = inner
+        self.schedule = schedule if schedule is not None else FaultSchedule()
+        self.counters = self.schedule.counters
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    # -- vtable ------------------------------------------------------------
+
+    def init(self) -> None:
+        self.inner.init()
+
+    def devices(self) -> int:
+        return self.inner.devices()
+
+    def get_properties(self, dev: int = 0):
+        return self.inner.get_properties(dev)
+
+    def listen(self, *args, **kw):
+        return self.inner.listen(*args, **kw)
+
+    def connect(self, *args, **kw):
+        why = self.schedule.connect_fault()
+        if why is not None:
+            raise ConnectionRefusedError(f"faultnet: {why}")
+        return self.inner.connect(*args, **kw)
+
+    def accept(self, *args, **kw):
+        # refusal precedes the inner verb: the peer's dial stays pending
+        # in the listener backlog, so a retried accept can succeed
+        why = self.schedule.accept_fault()
+        if why is not None:
+            raise ConnectionRefusedError(f"faultnet: {why}")
+        return self.inner.accept(*args, **kw)
+
+    def reg_mr(self, comm, buffer):
+        return self.inner.reg_mr(comm, buffer)
+
+    def _dead_mode(self, verb: str) -> str | None:
+        mode = self.schedule.op_fault(verb)
+        if mode == "dead":
+            raise OSError(
+                f"faultnet: comm dead (injected death after "
+                f"{self.schedule.die_after_ops} ops; {verb} refused)")
+        return mode
+
+    def isend(self, comm, mr, tag: int = 0, **kw) -> Request:
+        if self._dead_mode("isend") == "partitioned":
+            # blackhole: complete locally, deliver nowhere — the PEER's
+            # recv (or this rank's next recv) must time out, named
+            size = len(mr)
+            return Request(_test=lambda: (True, size, None))
+        return self.inner.isend(comm, mr, tag=tag, **kw)
+
+    def irecv(self, comm, *args, **kw) -> Request:
+        if self._dead_mode("irecv") == "partitioned":
+            return Request(_test=lambda: (False, 0, None))  # never completes
+        req = self.inner.irecv(comm, *args, **kw)
+        hold = self.schedule.test_delay()
+        if hold == 0:
+            return req
+
+        state = {"left": hold}
+
+        def probe():
+            done, size = req.test()   # progress underneath keeps flowing
+            if not done:
+                return False, 0, None
+            if state["left"] > 0:     # hold the completion REPORT only
+                state["left"] -= 1
+                return False, 0, None
+            return True, size, req.payload
+
+        return Request(_test=probe)
+
+    def test(self, req: Request):
+        return req.test()
+
+    def close_comm(self, comm) -> None:
+        if self.schedule.close_drop():
+            return  # died without FIN; inner.close() still reclaims it
+        if hasattr(self.inner, "close_comm"):
+            self.inner.close_comm(comm)
+        elif hasattr(comm, "close"):
+            comm.close()  # device-plane comms are bare rank pairs: no-op
+
+    def close(self) -> None:
+        self.inner.close()

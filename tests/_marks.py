@@ -1,0 +1,9 @@
+"""Shared capability markers (one definition; imported by test modules)."""
+
+import pytest
+
+from rocnrdma_tpu.runtime.compat import tpu_interpret_available
+
+needs_tpu_interpret = pytest.mark.skipif(
+    not tpu_interpret_available(),
+    reason="this jax has no TPU interpret mode (pallas plane needs real TPU)")

@@ -26,9 +26,14 @@ os.environ["PYTHONPATH"] = (
     if os.environ.get("PYTHONPATH") else _REPO_ROOT)
 
 import jax  # noqa: E402
+from rocnrdma_tpu.runtime.compat import (  # noqa: E402
+    install as _install_jax_compat,
+    set_cpu_device_count,
+)
 
+_install_jax_compat()  # shard_map/axis_size/pallas shims for old jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+set_cpu_device_count(8)
 
 import pytest  # noqa: E402
 

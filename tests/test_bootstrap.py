@@ -125,3 +125,124 @@ def test_bootstrap_multiprocess_single_address():
             out, err = p.communicate(timeout=120)
             assert p.returncode == 0, f"rank {r} failed:\n{err}"
             assert f"rank {r} OK" in out
+
+
+# ---------------------------------------------------------------------------
+# robustness hardening (FaultNet-era): reconnects, deadlines, liveness
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_client_survives_connection_drop_via_reconnect():
+    """Sever the client's QP underneath it (a transient server-side drop):
+    the next RPC re-dials with backoff and replays — the caller never
+    sees the break."""
+    with BootstrapServer(n_ranks=2) as srv:
+        c = BootstrapClient(srv.handle, rank=0)
+        c.set("pre", "kept")
+        c._qp.close()  # the drop: broken pipe on the next send
+        assert c.get("pre", timeout_s=10) == "kept"   # reconnect + replay
+        c.set("post", "alive")
+        assert c.get("post", timeout_s=10) == "alive"
+        c.close()
+
+
+@needs_native
+def test_barrier_arrival_is_idempotent_per_rank():
+    """A replayed barrier_arrive (the reconnect path resends requests)
+    must not double-count: arrival is keyed by rank, so one rank can
+    never release a 2-rank barrier alone."""
+    with BootstrapServer(n_ranks=2) as srv:
+        c = BootstrapClient(srv.handle, rank=0)
+        c._rpc(op="barrier_arrive", key="b")
+        c._rpc(op="barrier_arrive", key="b")  # the replay
+        assert c._rpc(op="barrier_done", key="b", n=2) == {"ok": False}
+        d = BootstrapClient(srv.handle, rank=1)
+        d._rpc(op="barrier_arrive", key="b")
+        assert c._rpc(op="barrier_done", key="b", n=2) == {"ok": True}
+        c.close(); d.close()
+
+
+@needs_native
+def test_exchange_honors_one_overall_deadline():
+    """exchange()'s timeout is a single budget for the whole all-gather,
+    not a per-key allowance: n absent keys cannot stretch one nominal
+    timeout n-fold."""
+    import time
+    with BootstrapServer(n_ranks=8) as srv:
+        c = BootstrapClient(srv.handle, rank=0)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            c.exchange("lonely", "me", n=8, timeout_s=0.6)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 4.0, f"per-key timeouts stacked: {elapsed:.1f}s"
+        c.close()
+
+
+@needs_native
+def test_liveness_table_names_silent_ranks():
+    with BootstrapServer(n_ranks=3) as srv:
+        a = BootstrapClient(srv.handle, rank=0)
+        b = BootstrapClient(srv.handle, rank=1)
+        b.heartbeat()
+        ages = a.live_ages()
+        assert 0 in ages and 1 in ages
+        assert ages[0] < 5.0 and ages[1] < 5.0
+        # rank 2 never spoke: the store's evidence names it dead
+        assert a.dead_ranks(3, max_age_s=60.0) == [2]
+        a.close(); b.close()
+
+
+@needs_native
+def test_server_prunes_finished_client_threads():
+    """_threads must not grow without bound across many short-lived
+    clients (satellite: the unbounded-growth + append race fix)."""
+    with BootstrapServer(n_ranks=1) as srv:
+        for i in range(12):
+            c = BootstrapClient(srv.handle, rank=0)
+            c.set(f"k{i}", "v")
+            c.close()
+        # give the last conn threads a beat to wind down, then one more
+        # client forces a prune pass in the accept loop
+        srv.wait_idle(timeout_s=5.0)
+        c = BootstrapClient(srv.handle, rank=0)
+        c.set("final", "v")
+        with srv._lock:
+            n_threads = len(srv._threads)
+        assert n_threads <= 3, f"{n_threads} serve threads retained"
+        c.close()
+
+
+@needs_native
+def test_liveness_scopes_are_isolated_per_group():
+    """Two groups sharing one store must not read each other's ranks as
+    their own: the liveness table is keyed by (scope, rank) like every
+    other piece of store state."""
+    with BootstrapServer(n_ranks=2) as srv:
+        a = BootstrapClient(srv.handle, rank=0, scope="groupA")
+        b = BootstrapClient(srv.handle, rank=0, scope="groupB")
+        a.heartbeat()
+        b.heartbeat()
+        assert list(a.live_ages()) == [0]   # only groupA's rank 0
+        # groupA's view: its own rank 1 never spoke — even though a rank
+        # numbered 1 could exist (and be alive) in another scope
+        assert a.dead_ranks(2, max_age_s=60.0) == [1]
+        a.close(); b.close()
+
+
+@needs_native
+def test_exchange_deadline_holds_against_dead_store():
+    """The overall exchange deadline bounds the RECONNECT path too: with
+    the store gone, set/get retry budgets come out of the same clock,
+    not out of the client-level 30 s default per RPC."""
+    import time
+    srv = BootstrapServer(n_ranks=2)
+    c = BootstrapClient(srv.handle, rank=0, timeout_s=30.0)
+    c.set("warm", "up")
+    srv.close()  # the store dies under the client
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        c.exchange("gone", "v", n=2, timeout_s=0.8)
+    assert time.monotonic() - t0 < 6.0, "reconnect budget ignored deadline"
+    c._said_bye = True  # skip the bye RPC against the dead store
+    c._qp.close()

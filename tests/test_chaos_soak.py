@@ -1,0 +1,105 @@
+"""Chaos soak — the acceptance run of the FaultNet tentpole.
+
+Real OS processes (the multiprocess harness), 4 ranks over
+``FaultNet(HostQPNet)``, hundreds of injected faults across
+connect/accept/test/close. THE contract asserted here:
+
+- every rank ends in a BITWISE-correct allreduce or a clean NAMED
+  ``TimeoutError``/``OSError`` abort (exit 4, ``CLEAN-ABORT`` printed);
+- zero hangs — no rank ever reaches the harness's kill (returncode -9);
+- the whole run is REPLAYABLE from its seed: a second run injects
+  byte-for-byte the same fault log on every rank.
+
+The full soak is ``slow`` (excluded from tier-1); the die-mid-collective
+run is small enough to ride tier-1 and guards the named-abort path.
+"""
+
+import re
+
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.metrics import FaultCounters
+from rocnrdma_tpu.runtime.multiprocess import run_workers
+
+pytestmark = [
+    pytest.mark.chaos,
+    pytest.mark.skipif(not native.available(),
+                       reason="native rqp library not buildable"),
+]
+
+
+def _faults(result) -> FaultCounters:
+    m = re.search(r"^FAULTS (\{.*\})$", result.stdout, re.M)
+    assert m, f"rank {result.process_id} printed no FAULTS line:\n" \
+              f"{result.stdout}\n{result.stderr}"
+    return FaultCounters.from_json(m.group(1))
+
+
+def _faultlog(result) -> str:
+    m = re.search(r"^FAULTLOG ([0-9a-f]{64})$", result.stdout, re.M)
+    assert m, f"rank {result.process_id} printed no FAULTLOG line"
+    return m.group(1)
+
+
+def _assert_clean(results):
+    """Success or clean named abort — never a harness kill, never silent
+    corruption."""
+    for r in results:
+        assert r.returncode != -9, \
+            f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+        assert r.returncode in (0, 4), \
+            f"rank {r.process_id} exited {r.returncode}:\n" \
+            f"{r.stdout}\n{r.stderr}"
+        if r.returncode == 0:
+            assert "OK rank" in r.stdout
+        else:
+            assert "CLEAN-ABORT" in r.stdout  # named, typed, printed
+
+
+@pytest.mark.slow
+def test_chaos_soak_replayable_from_seed():
+    n, seed, rounds = 4, 1234, 30
+    runs = [run_workers(n, "chaos-allreduce", timeout_s=240.0, seed=seed,
+                        rounds=rounds) for _ in range(2)]
+    for results in runs:
+        _assert_clean(results)
+
+    # fault volume: the acceptance floor — >= 200 injected faults across
+    # connect/accept/test/close in one run
+    total = FaultCounters()
+    for r in runs[0]:
+        total.merge(_faults(r))
+    assert total.total() >= 200, total.counts
+    assert total.counts.get("connect-refused", 0) >= n
+    assert total.counts.get("test-delayed", 0) > 0
+    assert total.counts.get("close-dropped", 0) > 0
+
+    # replayable: every rank injected the identical fault sequence in
+    # both runs (the schedule is a function of (seed, rank) + the rank's
+    # own op sequence, not of timing)
+    first = [_faultlog(r) for r in runs[0]]
+    second = [_faultlog(r) for r in runs[1]]
+    assert first == second
+    # and the faults were not vacuously identical-empty
+    assert all(_faults(r).total() > 0 for r in runs[0])
+
+
+def test_die_mid_collective_survivors_abort_named():
+    """A rank SIGKILL-style dies inside the collective; every survivor
+    surfaces a named TimeoutError/OSError (exit 4) inside its deadline —
+    the 'degrades cleanly, never hangs' half of the contract."""
+    victim = 2
+    results = run_workers(4, "die-mid-collective", timeout_s=120.0, seed=7,
+                          rounds=6, fault_rank=victim)
+    rc = {r.process_id: r.returncode for r in results}
+    assert rc[victim] == 7, results[victim].stderr
+    for r in results:
+        if r.process_id == victim:
+            continue
+        assert r.returncode == 4, \
+            f"survivor {r.process_id} exited {r.returncode}:\n" \
+            f"{r.stdout}\n{r.stderr}"
+        assert re.search(r"CLEAN-ABORT: (TimeoutError|OSError|"
+                         r"ConnectionRefusedError)", r.stdout)
+        assert r.returncode != -9

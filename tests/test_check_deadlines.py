@@ -1,0 +1,43 @@
+"""The no-hangs static lint (tools/check_deadlines.py) runs in tier-1:
+a new unbounded poll loop or deadline-less public blocking API in
+transport/ or distributed.py fails CI before it can hang a job."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_deadlines.py")
+
+
+def test_transport_surface_is_deadline_clean():
+    out = subprocess.run([sys.executable, TOOL], capture_output=True,
+                         text=True, cwd=REPO, timeout=60)
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+    assert "clean" in out.stdout
+
+
+def test_lint_selftest_detects_violations():
+    out = subprocess.run([sys.executable, TOOL, "--selftest"],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=60)
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+
+
+def test_lint_flags_fresh_unbounded_loop(tmp_path):
+    """End to end: a deadline-less while-True (function-level and
+    module-level) must be flagged. The probe lives in tmp_path — never in
+    the real tree, where a crashed test run would leave it failing every
+    later tier-1 lint until hand-deleted (check_file takes absolute
+    paths)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_deadlines as cd
+    finally:
+        sys.path.pop(0)
+    probe = tmp_path / "probe.py"
+    probe.write_text("def poll(x):\n    while True:\n        if x():\n"
+                     "            return\n\nwhile True:\n    pass\n")
+    problems = cd.check_file(str(probe))
+    assert any("no deadline check" in p for p in problems)
+    assert any("module-level" in p for p in problems)

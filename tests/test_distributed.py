@@ -1002,3 +1002,80 @@ def test_real_processes_master_semantics(tmp_path):
         out, _ = p.communicate(timeout=90)
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"rank {r} ok" in out
+
+
+# ---------------------------------------------------------------------------
+# FaultNet-era robustness: liveness triage + chaos through the group API
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_monitored_barrier_triages_alive_but_absent(sidecar_store):
+    """A rank that skips the barrier while still heartbeating the store
+    must be named store-live (stuck/slow: keep waiting), never
+    store-silent — the evidence a restart decision would read."""
+    import time as _t
+    n = 2
+    store = sidecar_store(n)
+    caught = []
+
+    def fn(pg):
+        if pg.rank == 1:
+            # absent from the barrier, visibly alive to the store
+            for _ in range(10):
+                pg._client.heartbeat()
+                _t.sleep(0.25)
+            return "absent"
+        try:
+            pg.monitored_barrier(timeout_s=2.0)
+        except TimeoutError as e:
+            caught.append(str(e))
+            return "timeout"
+        return "passed"
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res == ["timeout", "absent"]
+    assert caught and "rank(s) [1] missing" in caught[0]
+    assert "store-live [1]" in caught[0]
+    assert "store-silent" in caught[0] and "[1]" not in \
+        caught[0].split("store-silent", 1)[1].split("store-live", 1)[0]
+
+
+@pytest.mark.chaos
+def test_group_over_faultnet_survives_flaky_wiring(sidecar_store):
+    """The full ProcessGroup stack over a FaultNet whose connects/accepts
+    refuse first: the hardened ring wiring absorbs the faults and the
+    collective is exact."""
+    from rocnrdma_tpu.transport.faults import FaultSchedule
+
+    n = 2
+    store = sidecar_store(n)
+    results = [None] * n
+    errors = []
+
+    def worker(rank):
+        pg = None
+        try:
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=store.handle,
+                plane="shm",
+                fault_schedule=FaultSchedule(23, rank, connect_refusals=1,
+                                             accept_refusals=1,
+                                             test_delay_p=0.5))
+            results[rank] = pg.all_reduce(
+                np.arange(8, dtype=np.int64) * (rank + 1))
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append((rank, repr(e)))
+        finally:
+            if pg is not None:
+                pg.destroy()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    want = np.arange(8, dtype=np.int64) * 3
+    for r in range(n):
+        np.testing.assert_array_equal(results[r], want)

@@ -10,9 +10,22 @@ import pytest
 from rocnrdma_tpu.runtime.multiprocess import run_workers
 
 
+_CPU_MP_UNSUPPORTED = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _skip_if_backend_cannot(results):
+    """Old jaxlibs have no cross-process CPU collectives at all — a
+    capability gap of the environment, not a regression; skip with the
+    backend's own words (the host-plane chaos tier still runs, it needs
+    no jax backend)."""
+    if any(_CPU_MP_UNSUPPORTED in r.stderr for r in results):
+        pytest.skip(f"this jaxlib: {_CPU_MP_UNSUPPORTED}")
+
+
 @pytest.mark.parametrize("task", ["allreduce", "alltoall"])
 def test_two_process_collective(task):
     results = run_workers(2, task, timeout_s=180)
+    _skip_if_backend_cannot(results)
     for r in results:
         assert r.returncode == 0, f"rank {r.process_id} failed:\n{r.stderr[-2000:]}"
         assert f"OK rank={r.process_id}/2" in r.stdout
@@ -23,6 +36,7 @@ def test_two_process_hierarchical_dcn_path():
     with the slice axis ON the process boundary; the Transport's
     hierarchical allreduce and alltoall run over it."""
     results = run_workers(2, "hierarchical", timeout_s=240)
+    _skip_if_backend_cannot(results)
     for r in results:
         assert r.returncode == 0, f"rank {r.rank}:\n{r.stdout}\n{r.stderr}"
         assert "hierarchical" in r.stdout

@@ -9,6 +9,11 @@ import pytest
 
 from rocnrdma_tpu.ops import pallas_hbm_combine
 
+from _marks import needs_tpu_interpret
+
+pytestmark = needs_tpu_interpret
+
+
 
 @pytest.mark.parametrize("k", [2, 3, 4])
 def test_combine_matches_numpy(devices, k):

@@ -17,6 +17,11 @@ from rocnrdma_tpu.transport import Transport
 
 RANK = rt.mesh.RANK_AXIS
 
+from _marks import needs_tpu_interpret
+
+pytestmark = needs_tpu_interpret
+
+
 
 def _shmap(fn, n):
     mesh = rt.rank_mesh(n)

@@ -6,6 +6,12 @@ import json
 import pytest
 
 from rocnrdma_tpu import trace as T
+from rocnrdma_tpu.runtime.compat import profile_data_available
+
+needs_profile_data = pytest.mark.skipif(
+    not profile_data_available(),
+    reason="jax.profiler.ProfileData unavailable in this jax "
+           "(xplane parsing needs it)")
 
 
 def _rank_bytes(events, rank):
@@ -162,6 +168,7 @@ def test_cli_writes_trace(tmp_path):
     assert rc == 0
 
 
+@needs_profile_data
 def test_measured_lane_from_live_capture(tmp_path):
     # VERDICT r1 item 8: the NPKit concept records MEASURED events — run
     # the ring on the oracle under an XProf capture and check the second
@@ -188,6 +195,7 @@ def test_measured_lane_from_live_capture(tmp_path):
     assert doc["otherData"]["measured_events"] == len(measured)
 
 
+@needs_profile_data
 def test_align_steps_live_capture(tmp_path):
     # VERDICT r2 item 6 — the NPKit diff proper: the capture's k-th
     # permute op IS schedule step k; the aligned lane and per-step diff
@@ -238,6 +246,7 @@ def test_align_steps_unit_and_errors():
                 "--ranks", "4", "--align-steps"])
 
 
+@needs_profile_data
 def test_measured_from_existing_xplane(tmp_path):
     # the --xplane form consumes a capture some bench --profile run wrote
     import glob
@@ -284,6 +293,7 @@ def test_committed_alignment_artifacts_load():
             assert row["measured_max_us"] > 0 and row["predicted_us"] > 0
 
 
+@needs_profile_data
 def test_alignment_rederives_on_oracle():
     # one alignment re-derived live (dtree: 20 level-synchronous steps, the
     # most capture-stable schedule on the thread-pooled CPU profiler)

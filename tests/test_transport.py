@@ -4,6 +4,8 @@ import pytest
 
 from rocnrdma_tpu import runtime as rt
 from rocnrdma_tpu.transport import Transport
+from _marks import needs_tpu_interpret
+
 
 
 def _rand(shape, seed=0):
@@ -311,6 +313,7 @@ def test_premul_sum(devices):
     assert np.allclose(np.asarray(h2.result())[1], 0.5 * x.sum(0), rtol=1e-5)
 
 
+@needs_tpu_interpret
 def test_alltoallv_both_wires(devices):
     # the device-plane ncclAllToAllv verb: static-capacity wire + receiver
     # masking, counts as a TRACED operand (new matrix, no recompile)
@@ -348,6 +351,7 @@ def test_alltoallv_validates(devices):
         t2.alltoallv(x, np.zeros((4, 4), int))
 
 
+@needs_tpu_interpret
 def test_alltoallv_rnr_algo_env(monkeypatch, devices):
     t = Transport(rt.rank_mesh(4))
     x = t.shard(np.zeros((4, 4, 2, 2), np.float32))
