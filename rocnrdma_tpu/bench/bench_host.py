@@ -41,6 +41,15 @@ COLLECTIVES = ("allreduce", "reducescatter", "allgather", "broadcast",
                "alltoall", "alltoallv", "allgatherv", "reducescatterv",
                "sendrecv")
 
+# --smoke perf floor (GB/s, algbw): recorded on the reference container
+# (2 ranks, shm plane, 1 MiB allreduce) where the PRE-pipelining wire
+# measured 0.20 and the streaming wire measures ~0.24-0.30. The gate
+# asserts >= 0.8x this floor, so a regression back to (or below) the
+# copy-bound wire fails tier-1 while normal CI noise does not.
+SMOKE_FLOOR_GBPS = 0.20
+SMOKE_ARGS = ["--ranks", "2", "--plane", "shm", "--sizes", "1M",
+              "--collectives", "allreduce", "--repeats", "3", "--iters", "5"]
+
 
 def _build_input(collective: str, n: int, elems: int, rng,
                  rank: int = 0, counts=None):
@@ -117,6 +126,7 @@ def _issue(pg, collective: str, x, transport: str = "msg", counts=None):
 
 def worker(args) -> int:
     from rocnrdma_tpu import distributed as dist
+    from rocnrdma_tpu.metrics import WIRE
 
     pg = dist.init_process_group(plane=args.plane)
     rng = np.random.default_rng(pg.rank)
@@ -143,6 +153,10 @@ def worker(args) -> int:
                       else sum(seg.nbytes for seg in x)
                       if collective == "alltoallv" else x.nbytes)
             _issue(pg, collective, x, args.transport, counts)  # warmup
+            # wire-counter window: warmup absorbs the one-time setup
+            # (arena announces, pool priming), so the delta below is the
+            # STEADY-state copy/stream/overlap telemetry of the timed loop
+            wire_base = WIRE.snapshot()
             spans = []
             for _ in range(args.repeats):
                 pg.barrier()
@@ -150,6 +164,18 @@ def worker(args) -> int:
                 for _ in range(args.iters):
                     _issue(pg, collective, x, args.transport, counts)
                 spans.append((time.perf_counter() - t0) / args.iters)
+            wire = WIRE.delta(wire_base)
+            streamed = wire["frames_streamed"]
+            wire["overlap_ratio"] = (round(wire["frames_overlapped"]
+                                           / streamed, 4) if streamed else 0.0)
+            if args.smoke and wire["payload_bytes_copied"]:
+                # the zero-copy steady-path contract, enforced on EVERY
+                # rank (each process checks its own counters)
+                raise SystemExit(
+                    f"smoke gate: rank {pg.rank} staged "
+                    f"{wire['payload_bytes_copied']} payload bytes through "
+                    f"copies during the steady {collective} loop "
+                    f"(want 0): {wire}")
             mine = trimmed_mean(spans)
             # a collective is as slow as its slowest rank
             sec = float(pg.all_reduce(np.array([mine]), op="max")[0])
@@ -166,7 +192,8 @@ def worker(args) -> int:
                 records.append(M.BenchRecord.measure(
                     "bench_host", collective, algo, pg.world_size, actual,
                     "float32", sec, platform=f"host-{args.plane}",
-                    counts=ragged, iters=args.iters, repeats=args.repeats))
+                    counts=ragged, iters=args.iters, repeats=args.repeats,
+                    wire=wire))
     pg.barrier()
     pg.destroy()
     if pg.rank == 0:
@@ -178,7 +205,11 @@ def worker(args) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="bench_host",
-        description="Benchmark the native host-plane (TCP QP) ring collectives")
+        description="Benchmark the native host-plane (TCP QP) ring collectives",
+        # no prefix abbreviations: the --smoke clash guard matches literal
+        # flag strings, and an abbreviated `--plan tcp --smoke` slipping
+        # past it would silently gate a config the run never touched
+        allow_abbrev=False)
     p.add_argument("--ranks", type=int, default=4)
     p.add_argument("--plane", choices=("tcp", "shm"), default="tcp",
                    help="wire under the ring: TCP (cross-host) or shared "
@@ -194,8 +225,31 @@ def main(argv=None) -> int:
     p.add_argument("--repeats", type=int, default=5)
     p.add_argument("--iters", type=int, default=5)
     p.add_argument("--out", default=None, help="JSONL output path")
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1 perf gate: 2-rank 1 MiB shm allreduce; "
+                        "asserts ZERO steady-path payload copies on every "
+                        "rank and algbw >= 0.8x the recorded floor "
+                        f"({SMOKE_FLOOR_GBPS} GB/s)")
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
+    if args.smoke and not args.worker:
+        # the gate measures ONE recorded configuration; silently ignoring
+        # an explicit --plane tcp (etc.) would let a user believe they
+        # gated a path the smoke run never touched — refuse the clash
+        # (detected from argv: a default-valued explicit flag must clash
+        # too, or `--plane tcp --smoke` would pass and mislead)
+        given = {a.split("=", 1)[0]
+                 for a in (sys.argv[1:] if argv is None else argv)
+                 if a.startswith("--")}
+        clash = sorted(given & {"--ranks", "--plane", "--transport",
+                                "--sizes", "--collectives", "--repeats",
+                                "--iters"})
+        if clash:
+            p.error(f"--smoke runs the fixed recorded config "
+                    f"({' '.join(SMOKE_ARGS)}); drop {'/'.join(clash)} "
+                    f"or run a plain bench instead")
+        args = p.parse_args(SMOKE_ARGS + ["--smoke"]
+                            + (["--out", args.out] if args.out else []))
 
     if args.worker:
         return worker(args)
@@ -208,16 +262,19 @@ def main(argv=None) -> int:
            "--ranks", str(args.ranks), "--plane", args.plane,
            "--transport", args.transport, "--sizes", args.sizes,
            "--collectives", args.collectives, "--repeats", str(args.repeats),
-           "--iters", str(args.iters)]
+           "--iters", str(args.iters)] + (["--smoke"] if args.smoke else [])
     procs = []
     try:
         for r in range(args.ranks):
             env = dict(os.environ, RANK=str(r), WORLD_SIZE=str(args.ranks),
                        MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port))
+            # --smoke: every rank enforces the copy gate and its SystemExit
+            # diagnostic (which rank, how many bytes) must reach the user,
+            # so smoke runs keep ALL ranks' stderr attached
             procs.append(subprocess.Popen(
                 cmd, env=env, text=True,
                 stdout=subprocess.PIPE if r == 0 else subprocess.DEVNULL,
-                stderr=None if r == 0 else subprocess.DEVNULL))
+                stderr=None if r == 0 or args.smoke else subprocess.DEVNULL))
         out, _ = procs[0].communicate(timeout=600)
         codes = [p.wait(timeout=600) for p in procs]
     finally:
@@ -237,6 +294,20 @@ def main(argv=None) -> int:
             for rec in records:
                 rec.write(fp)
     print(M.format_table(records))
+    if args.smoke:
+        # the copy gate already ran on every rank (worker exits nonzero);
+        # here the throughput half: a slide back to the copy-bound wire
+        # shows up as a >20% drop below the recorded floor
+        rec = records[0]
+        want = 0.8 * SMOKE_FLOOR_GBPS
+        if rec.algbw_GBps < want:
+            raise SystemExit(
+                f"smoke gate: {rec.algbw_GBps:.3f} GB/s is below 0.8x the "
+                f"recorded floor ({SMOKE_FLOOR_GBPS} GB/s); the zero-copy "
+                f"ring wire has regressed (wire={rec.extra.get('wire')})")
+        print(f"smoke gate ok: {rec.algbw_GBps:.3f} GB/s >= {want:.3f}, "
+              f"zero steady-path payload copies on every rank "
+              f"(wire={rec.extra.get('wire')})")
     return 0
 
 

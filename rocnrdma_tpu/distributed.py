@@ -32,9 +32,11 @@ state, metrics, checkpoint shards) and for machines with no TPU at all.
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
+from rocnrdma_tpu.metrics import WIRE as _WIRE
 from rocnrdma_tpu.transport import (
     HostQPNet,
     TCPNet,
@@ -522,16 +524,22 @@ class ProcessGroup:
         self._claim_outstanding(src, "rx", tag)
         self._p2p_seq[src][("rx", tag)] = seq + 1
         nbytes = template.nbytes
-        reqs = wire.post_recvs(nbytes, self._p2p_hop(tag, seq))
+        # the destination is allocated at POST time so recv_into-capable
+        # nets land every frame straight into it (zero staging copies);
+        # legacy planes still hand payloads back through wait()
+        got = np.empty(nbytes, np.uint8)
+        reqs = wire.post_recvs(nbytes, self._p2p_hop(tag, seq), into=got)
 
         def wait():
-            got = np.empty(nbytes, np.uint8)
             for off, nb, r in reqs:
                 # _p2p_progress pumps every wired comm BOTH ways, so queued
                 # isend tx keeps draining while this recv blocks
                 payload = r.wait(timeout_s=timeout_s,
                                  progress=self._p2p_progress)
-                got[off:off + nb] = np.frombuffer(payload, np.uint8)
+                if payload is not None:  # legacy plane: stage the copy
+                    got[off:off + nb] = np.frombuffer(payload, np.uint8)
+                    _WIRE.payload_bytes_copied += nb
+                    _WIRE.frames_copied += 1
             self._release_outstanding(src, "rx", tag)
             return got.view(template.dtype).reshape(template.shape)
 
@@ -602,7 +610,6 @@ class ProcessGroup:
         'something hung' and 'rank 3 is dead'."""
         if self.world_size == 1:
             return
-        import time
         self._barrier_no += 1
         key = f"pg/{self.group_name}/mb{self._barrier_no}"
         self._client.set(f"{key}/{self.rank}", "1")
@@ -694,7 +701,6 @@ class ProcessGroup:
         if self.world_size == 1 or self._client is None:
             raise RuntimeError("nothing to shrink: single-rank group")
         import json
-        import time
 
         from rocnrdma_tpu.transport.backoff import poll_backoff
         ns = f"pg/{self.group_name}/shrink{self._shrink_no}"
@@ -775,7 +781,6 @@ class ProcessGroup:
         if self._watchdog is not None and self._watchdog.is_alive():
             return
         import threading
-        import time
         self._watchdog_stop = threading.Event()
         self._watchdog_failed = None
         self._dead = []
@@ -845,6 +850,18 @@ class ProcessGroup:
 
         self._watchdog = threading.Thread(target=run, daemon=True)
         self._watchdog.start()
+
+    def wire_stats(self) -> dict:
+        """THIS RANK's zero-copy wire counters (``metrics.WIRE`` snapshot:
+        payload_bytes_copied / frames_streamed / frames_copied /
+        frames_overlapped + the derived overlap_ratio). Host-plane ranks
+        are OS processes, so cross-rank aggregation happens at the
+        harness, like fault counters; the steady-state contract of the
+        streaming collectives is a zero ``payload_bytes_copied`` delta
+        across a measurement window (what ``bench_host --smoke`` gates)."""
+        s = _WIRE.snapshot()
+        s["overlap_ratio"] = round(_WIRE.overlap_ratio(), 4)
+        return s
 
     def dead_ranks(self) -> list:
         """Peers the watchdog currently considers dead (empty without a

@@ -104,6 +104,62 @@ def busbw_GBps(collective: str, n_ranks: int, size_bytes: int,
 
 
 @dataclasses.dataclass
+class WireCounters:
+    """Zero-copy telemetry for the pipelined host-plane ring wire.
+
+    Producers are the net-plugin's receive paths (``transport.plugin``):
+    ``irecv_into`` counts every frame it lands or combines in place
+    (``frames_streamed``); the legacy copy paths — staging a payload
+    through an intermediate ``bytes``/``frombuffer`` materialization —
+    count ``frames_copied`` and the bytes so staged
+    (``payload_bytes_copied``). ``frames_overlapped`` counts streamed
+    frames whose wire transfer had ALREADY completed when the consume
+    loop first looked — i.e. the transfer fully overlapped the combine
+    of earlier frames, which is the pipelining win made observable.
+
+    The steady-state contract of the zero-copy ring collectives is
+    ``payload_bytes_copied == 0`` across a timed window (the
+    ``bench_host --smoke`` gate asserts exactly that on a delta of
+    :data:`WIRE`, the process-wide instance every producer increments).
+    Counters are plain ints bumped under the GIL — telemetry precision,
+    not synchronization.
+    """
+
+    payload_bytes_copied: int = 0   # bytes staged through an extra copy
+    frames_streamed: int = 0        # frames landed/combined in place
+    frames_copied: int = 0          # frames that took a staging copy
+    frames_overlapped: int = 0      # streamed frames that beat the consumer
+
+    def snapshot(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def delta(self, since: dict) -> dict:
+        """Counter movement since a ``snapshot()`` (the per-measurement
+        window the bench attaches to its records)."""
+        return {k: v - since.get(k, 0) for k, v in self.snapshot().items()}
+
+    def overlap_ratio(self) -> float:
+        """Fraction of streamed frames whose transfer fully overlapped the
+        consumption of earlier frames (0.0 with nothing streamed)."""
+        if self.frames_streamed == 0:
+            return 0.0
+        return self.frames_overlapped / self.frames_streamed
+
+    def reset(self) -> None:
+        self.payload_bytes_copied = 0
+        self.frames_streamed = 0
+        self.frames_copied = 0
+        self.frames_overlapped = 0
+
+
+# THE process-wide wire-counter instance (one per rank process — host-plane
+# ranks are OS processes, so summing across ranks happens at the harness,
+# like FaultCounters). transport.plugin increments it; benches/tests window
+# it with snapshot()/delta().
+WIRE = WireCounters()
+
+
+@dataclasses.dataclass
 class FaultCounters:
     """Named fault-event counters — the chaos-plane telemetry row.
 

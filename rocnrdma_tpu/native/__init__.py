@@ -20,6 +20,7 @@ import dataclasses
 import os
 import subprocess
 import threading
+import time
 
 _SRCS = [os.path.join(os.path.dirname(__file__), f)
          for f in ("rqp.cpp", "rtcp.cpp")]
@@ -123,6 +124,11 @@ def _load():
     lib.rqp_post_recv.restype = ctypes.c_int64
     lib.rqp_post_recv.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                   ctypes.c_uint32]
+    for pfx in ("rqp", "rtcp"):
+        s2 = getattr(lib, f"{pfx}_post_send2")
+        s2.restype = ctypes.c_int64
+        s2.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                       ctypes.c_char_p, ctypes.c_uint32]
     lib.rqp_poll_cq.restype = ctypes.c_int
     lib.rqp_poll_cq.argtypes = [ctypes.c_void_p, ctypes.POINTER(_CQE),
                                 ctypes.c_int]
@@ -265,7 +271,6 @@ class _QpBase(_Closeable):
 
     def send(self, data: bytes, timeout_s: float = 10.0) -> int:
         """``post_send`` with bounded retry on backpressure."""
-        import time
         deadline = time.monotonic() + timeout_s
         while True:
             wr = self.post_send(data)
@@ -278,17 +283,38 @@ class _QpBase(_Closeable):
                                    f"deadline on {self.name!r}")
             time.sleep(0.0005)
 
-    def post_recv(self, nbytes: int) -> int:
-        """Register a receive buffer of ``nbytes``; returns its wr_id."""
-        buf = bytearray(nbytes)
+    def post_send2(self, hdr: bytes, payload) -> int:
+        """Scatter-gather post: ``[hdr][payload]`` travels as ONE message
+        without a Python-side concatenation — the native layer gathers both
+        parts directly into its ring/tx queue (the zero-copy tag-prefix
+        send path: ``payload`` may be any C-contiguous buffer and is
+        borrowed, not serialized). wr_id, -1 on backpressure (retry), -2
+        when the connection is dead."""
+        data, n = _as_cbuf(payload)
+        if len(hdr) + n > self.MAX_MSG:
+            raise ValueError(
+                f"{self._PREFIX}: {len(hdr) + n} B message exceeds the "
+                f"{self.MAX_MSG} B frame bound; chunk at the caller")
+        return self._fn("post_send2")(self._h, hdr, len(hdr), data, n)
+
+    def post_recv(self, nbytes: int, buf: bytearray | None = None) -> int:
+        """Register a receive buffer of ``nbytes``; returns its wr_id.
+        ``buf``: an optional recycled bytearray (exactly ``nbytes`` long) to
+        post instead of allocating — the comm-level buffer pool hands frames
+        back here so the steady state allocates nothing."""
+        if buf is None or len(buf) != nbytes:
+            buf = bytearray(nbytes)
         cbuf = (ctypes.c_char * nbytes).from_buffer(buf)
         wr = self._fn("post_recv")(self._h, cbuf, nbytes)
         if wr >= 0:
             self._recv_bufs[wr] = buf
         return wr
 
-    def poll_cq(self, max_cqes: int = 16) -> list[tuple[Completion, bytes | None]]:
-        """Drain completions; each recv completion carries its payload.
+    def poll_cq(self, max_cqes: int = 16) -> list[tuple[Completion, object]]:
+        """Drain completions; each recv completion carries its payload as a
+        ZERO-COPY memoryview of the posted buffer (``payload.obj`` is the
+        backing bytearray — recyclable via ``post_recv(buf=...)`` once the
+        consumer is done; ``bytes(payload)`` if it must outlive the pool).
         Completions stashed by a blocking helper are replayed first."""
         out = self._pending_cqes
         self._pending_cqes = []
@@ -303,7 +329,7 @@ class _QpBase(_Closeable):
                            arr[i].len)
             payload = None
             if c.opcode == OP_RECV:
-                payload = bytes(self._recv_bufs.pop(c.wr_id)[:c.length])
+                payload = memoryview(self._recv_bufs.pop(c.wr_id))[:c.length]
             elif c.opcode == OP_READ:
                 self._read_bufs.pop(c.wr_id, None)  # dst now filled; release
             out.append((c, payload))
@@ -316,7 +342,6 @@ class _QpBase(_Closeable):
         outstanding, so a retry after a timeout reuses the posted WR instead
         of leaking one registered buffer per attempt.
         """
-        import time
         if not self._recv_bufs:
             self.post_recv(1 << 16)
         deadline = time.monotonic() + timeout_s
@@ -326,7 +351,9 @@ class _QpBase(_Closeable):
                     if c.status != OK:
                         raise OSError(
                             f"{self._PREFIX}: recv truncated on {self.name!r}")
-                    return payload
+                    # bytes, not the poll_cq memoryview: recv()'s callers
+                    # (bootstrap JSON RPCs) hold the payload past this call
+                    return bytes(payload)
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"{self._PREFIX}: recv timed out on {self.name!r}")
@@ -410,7 +437,6 @@ class _QpBase(_Closeable):
         return bytes(out)
 
     def _await_rdma(self, post, opcode: int, timeout_s: float) -> None:
-        import time
         deadline = time.monotonic() + timeout_s
         while True:
             wr = post()

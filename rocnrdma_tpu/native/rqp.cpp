@@ -235,16 +235,22 @@ int rqp_accept(void* hv, int timeout_ms) {
   return 0;
 }
 
-// Post a send WR: copy [len][payload] into the ring if it fits. The copy IS
-// the transfer (shm in place of the NIC DMA), so the completion is queued
-// immediately and surfaces at the next poll_cq — same contract the verbs
-// layer gives the caller: buffer reusable once the CQE is seen.
-int64_t rqp_post_send(void* hv, const void* buf, uint32_t len) {
-  Handle* h = static_cast<Handle*>(hv);
-  if (!h || (len > 0 && !buf)) return -1;
+// Post a send WR: copy [len][hdr][payload] into the ring if it fits. The
+// copy IS the transfer (shm in place of the NIC DMA), so the completion is
+// queued immediately and surfaces at the next poll_cq — same contract the
+// verbs layer gives the caller: buffer reusable once the CQE is seen. The
+// two-part form (hdr + payload gathered into ONE message) exists so a
+// caller prefixing a small tag/header never has to concatenate in its own
+// language first — the gather happens here, inside the one ring memcpy.
+static int64_t post_send_gather(Handle* h, const void* hdr, uint32_t hdr_len,
+                                const void* buf, uint32_t len) {
+  if (!h || (hdr_len > 0 && !hdr) || (len > 0 && !buf)) return -1;
+  uint64_t total64 = uint64_t(hdr_len) + len;
+  if (total64 > 0xFFFFFFFFull - kAlign) return -1;  // u32 frame bound
+  uint32_t total = uint32_t(total64);
   Ring* r = h->send_ring;
   uint32_t cap = h->hdr->capacity;
-  uint32_t need = 4 + pad8(len);
+  uint32_t need = 4 + pad8(total);
   if (need + 4 > cap) return -1;  // can never fit (+4: wrap marker headroom)
   uint64_t head = r->head.load(std::memory_order_relaxed);
   uint64_t tail = r->tail.load(std::memory_order_acquire);
@@ -261,12 +267,23 @@ int64_t rqp_post_send(void* hv, const void* buf, uint32_t len) {
   } else if (cap - (head - tail) < need) {
     return -1;  // full
   }
-  std::memcpy(h->send_data + off, &len, 4);
-  if (len) std::memcpy(h->send_data + off + 4, buf, len);
+  std::memcpy(h->send_data + off, &total, 4);
+  if (hdr_len) std::memcpy(h->send_data + off + 4, hdr, hdr_len);
+  if (len) std::memcpy(h->send_data + off + 4 + hdr_len, buf, len);
   r->head.store(head + advance + need, std::memory_order_release);
   int64_t id = h->next_wr++;
-  h->send_cq.push_back({id, len, RQP_OP_SEND});
+  h->send_cq.push_back({id, total, RQP_OP_SEND});
   return id;
+}
+
+int64_t rqp_post_send(void* hv, const void* buf, uint32_t len) {
+  return post_send_gather(static_cast<Handle*>(hv), nullptr, 0, buf, len);
+}
+
+// Scatter-gather send: [hdr][payload] as one message, one ring pass.
+int64_t rqp_post_send2(void* hv, const void* hdr, uint32_t hdr_len,
+                       const void* buf, uint32_t len) {
+  return post_send_gather(static_cast<Handle*>(hv), hdr, hdr_len, buf, len);
 }
 
 int64_t rqp_post_recv(void* hv, void* buf, uint32_t cap) {

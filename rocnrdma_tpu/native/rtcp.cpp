@@ -49,6 +49,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -154,14 +155,22 @@ struct Conn {
   uint64_t staged_bytes = 0;           // payload bytes held in `staged`
   // one-sided state
   std::vector<Mr> mrs;                 // rkey low bits index this
-  std::deque<Cqe> rdma_done;           // completed one-sided reads
+  std::deque<Cqe> rx_done;             // completed one-sided reads AND
+                                       // direct-landed recvs awaiting poll
   std::vector<std::pair<int64_t, PendingRead>> pending_reads;  // req -> dst
   int64_t next_req = 1;
-  // rx parse state ([len u32][type u32] read together, then the body)
+  // rx parse state: [len u32][type u32] read together into hdr, then the
+  // BODY lands in `scratch` — one reusable heap buffer, grown
+  // monotonically, never zero-filled — instead of the old per-frame
+  // vector (which cost a 64 KiB bounce buffer + an insert copy + a
+  // staging copy for every byte on the wire)
   char hdr[8];
   uint32_t hdr_have = 0;
-  std::vector<char> cur;               // type + body in flight
-  uint32_t cur_len = 0;                // total frame length (type + body)
+  std::unique_ptr<char[]> scratch;
+  uint32_t scratch_cap = 0;
+  uint32_t cur_type = 0;               // known as soon as hdr completes
+  uint32_t body_len = 0;               // frame body bytes (type excluded)
+  uint32_t body_have = 0;
   bool mid_msg = false;
 };
 
@@ -213,12 +222,21 @@ bool queue_frame(Conn* c, int64_t wr_id, int32_t opcode, uint32_t type,
   TxMsg m;
   m.wr_id = wr_id;
   m.opcode = opcode;
-  m.frame.resize(4 + body_len);
-  std::memcpy(m.frame.data(), &body_len, 4);
-  std::memcpy(m.frame.data() + 4, &type, 4);
-  if (hdr_len) std::memcpy(m.frame.data() + 8, hdr_bytes, hdr_len);
-  if (data_len)
-    std::memcpy(m.frame.data() + 8 + hdr_len, data, data_len);
+  // reserve + range-insert, not resize + memcpy: resize value-initializes,
+  // which at multi-MiB frames is a whole extra pass over the payload
+  m.frame.reserve(4 + body_len);
+  const char* p = reinterpret_cast<const char*>(&body_len);
+  m.frame.insert(m.frame.end(), p, p + 4);
+  p = reinterpret_cast<const char*>(&type);
+  m.frame.insert(m.frame.end(), p, p + 4);
+  if (hdr_len) {
+    p = static_cast<const char*>(hdr_bytes);
+    m.frame.insert(m.frame.end(), p, p + hdr_len);
+  }
+  if (data_len) {
+    p = static_cast<const char*>(data);
+    m.frame.insert(m.frame.end(), p, p + data_len);
+  }
   c->tx_bytes += m.frame.size();
   c->txq.push_back(std::move(m));
   return true;
@@ -237,16 +255,30 @@ char* mr_span(Conn* c, int64_t rkey, uint64_t off, uint64_t len) {
   return mr.buf.data() + off;
 }
 
-// Apply one complete inbound frame (type + body in c->cur). Returns false
-// when the frame is a protocol violation (connection must break).
+// Apply one complete inbound frame (type in c->cur_type, body in scratch).
+// Returns false when the frame is a protocol violation (connection breaks).
 bool dispatch_frame(Conn* c) {
-  if (c->cur.size() < 4) return false;
-  uint32_t type;
-  std::memcpy(&type, c->cur.data(), 4);
-  const char* body = c->cur.data() + 4;
-  size_t blen = c->cur.size() - 4;
+  uint32_t type = c->cur_type;
+  const char* body = c->scratch.get();
+  size_t blen = c->body_len;
   switch (type) {
     case FR_MSG: {
+      // Fast path — a receive is already posted and nothing is queued
+      // ahead of us: land the payload STRAIGHT in the caller's buffer
+      // (one copy total on the rx side, down from three). The staged
+      // queue must be empty or we would reorder past earlier messages.
+      if (!c->recv_q.empty() && c->staged.empty()) {
+        RecvWr wr = c->recv_q.front();
+        c->recv_q.pop_front();
+        uint32_t msg_len = uint32_t(blen);
+        uint32_t copy_len = msg_len <= wr.cap ? msg_len : wr.cap;
+        if (copy_len && wr.buf) std::memcpy(wr.buf, body, copy_len);
+        c->rx_done.push_back({wr.wr_id, OP_RECV,
+                              msg_len <= wr.cap ? int32_t(ST_OK)
+                                                : int32_t(ST_TRUNC),
+                              copy_len, 0});
+        return true;
+      }
       c->staged.push_back({std::vector<char>(body, body + blen)});
       c->staged_bytes += blen;
       return true;
@@ -296,7 +328,7 @@ bool dispatch_frame(Conn* c) {
         uint32_t copy = got < pr.len ? got : pr.len;
         if (status == ST_OK && copy && pr.buf)
           std::memcpy(pr.buf, body + 12, copy);
-        c->rdma_done.push_back(
+        c->rx_done.push_back(
             {pr.wr_id, OP_READ,
              status != ST_OK ? int32_t(ST_RERR)
                              : (got < pr.len ? int32_t(ST_TRUNC)
@@ -315,8 +347,9 @@ bool dispatch_frame(Conn* c) {
 // frame once `staged` is saturated so an unserviced peer backpressures
 // through the kernel socket buffer instead of growing our heap without
 // bound — but only MSG frames: one-sided WRITE/READ frames must flow even
-// when the user posts no receives (that is the one-sided contract), so the
-// gate fires after the frame type is known (first 4 body bytes).
+// when the user posts no receives (that is the one-sided contract). The
+// frame type arrives with the length in the 8-byte header, so the gate
+// fires before any body byte is pulled.
 // Should the in-flight frame wait before we pull/dispatch its body?
 // - FR_MSG waits when staging is hard-bounded and no receive is posted.
 // - FR_READ_REQ waits while our response backlog exceeds the tx cap: the
@@ -326,15 +359,22 @@ bool dispatch_frame(Conn* c) {
 //   reads cannot deadlock — pump_tx keeps draining regardless.
 // - One-sided WRITE frames are never gated (their contract).
 bool rx_gated(Conn* c) {
-  if (!c->mid_msg || c->cur.size() < 4) return false;
-  uint32_t type;
-  std::memcpy(&type, c->cur.data(), 4);
-  if (type == FR_MSG)
+  if (!c->mid_msg) return false;
+  if (c->cur_type == FR_MSG)
     return (int(c->staged.size()) >= kMaxStagedMsgs ||
             c->staged_bytes >= kMaxStagedBytes) &&
            c->recv_q.empty();
-  if (type == FR_READ_REQ) return c->tx_bytes >= kTxCapBytes;
+  if (c->cur_type == FR_READ_REQ) return c->tx_bytes >= kTxCapBytes;
   return false;
+}
+
+void ensure_scratch(Conn* c, uint32_t need) {
+  if (c->scratch_cap < need) {
+    uint32_t cap = c->scratch_cap ? c->scratch_cap : (1u << 16);
+    while (cap < need) cap *= 2;
+    c->scratch.reset(new char[cap]);  // raw heap: no value-init pass
+    c->scratch_cap = cap;
+  }
 }
 
 void pump_rx(Conn* c) {
@@ -355,27 +395,29 @@ void pump_rx(Conn* c) {
           return;
         }
       }
-      std::memcpy(&c->cur_len, c->hdr, 4);
-      if (c->cur_len > kMaxFrameBytes || c->cur_len < 4) {
+      uint32_t frame_len;
+      std::memcpy(&frame_len, c->hdr, 4);
+      if (frame_len > kMaxFrameBytes || frame_len < 4) {
         c->broken = true;  // protocol violation (every frame has a type)
         return;
       }
+      std::memcpy(&c->cur_type, c->hdr + 4, 4);
+      c->body_len = frame_len - 4;
+      c->body_have = 0;
       c->hdr_have = 0;
       c->mid_msg = true;
-      c->cur.clear();
-      c->cur.reserve(c->cur_len);
-      c->cur.insert(c->cur.end(), c->hdr + 4, c->hdr + 8);  // the type word
+      ensure_scratch(c, c->body_len);
     }
-    // gate BEFORE pulling (or dispatching) body bytes, so a saturated MSG
-    // queue backpressures through the kernel socket buffer
+    // gate BEFORE pulling body bytes, so a saturated MSG queue
+    // backpressures through the kernel socket buffer
     if (rx_gated(c)) return;
-    while (c->cur.size() < c->cur_len) {
-      char tmp[1 << 16];
-      size_t want = c->cur_len - c->cur.size();
-      if (want > sizeof(tmp)) want = sizeof(tmp);
-      ssize_t n = recv(c->fd, tmp, want, 0);
+    while (c->body_have < c->body_len) {
+      // straight into the reusable scratch buffer — no 64 KiB bounce
+      // buffer, no per-frame vector growth, no second copy
+      ssize_t n = recv(c->fd, c->scratch.get() + c->body_have,
+                       c->body_len - c->body_have, 0);
       if (n > 0) {
-        c->cur.insert(c->cur.end(), tmp, tmp + n);
+        c->body_have += uint32_t(n);
       } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
         return;
       } else {
@@ -387,7 +429,6 @@ void pump_rx(Conn* c) {
       c->broken = true;
       return;
     }
-    c->cur.clear();
     c->mid_msg = false;
   }
 }
@@ -499,6 +540,25 @@ int64_t rtcp_post_send(void* cv, const void* buf, uint32_t len) {
   return id;
 }
 
+// Scatter-gather send: [hdr][payload] as one MSG frame — queue_frame already
+// gathers a header and a body into one frame, so a tag-prefixing caller
+// never concatenates on its side.
+int64_t rtcp_post_send2(void* cv, const void* hdr, uint32_t hdr_len,
+                        const void* buf, uint32_t len) {
+  Conn* c = static_cast<Conn*>(cv);
+  if (!c || (hdr_len > 0 && !hdr) || (len > 0 && !buf)) return -1;
+  if (c->broken) return -2;
+  pump_tx(c);
+  if (c->broken) return -2;
+  int64_t id = c->next_wr;
+  if (!queue_frame(c, id, OP_SEND, FR_MSG, hdr, hdr_len, buf, len,
+                   /*respect_cap=*/true))
+    return -1;
+  c->next_wr++;
+  pump_tx(c);
+  return id;
+}
+
 // -- one-sided RDMA ---------------------------------------------------------
 
 int64_t rtcp_reg_mr(void* cv, uint32_t len) {
@@ -577,9 +637,9 @@ int rtcp_poll_cq(void* cv, Cqe* cqes, int max_cqes) {
     c->send_done.pop_front();
     cqes[n++] = {d.wr_id, d.opcode, ST_OK, 0, 0};
   }
-  while (n < max_cqes && !c->rdma_done.empty()) {
-    cqes[n++] = c->rdma_done.front();
-    c->rdma_done.pop_front();
+  while (n < max_cqes && !c->rx_done.empty()) {
+    cqes[n++] = c->rx_done.front();
+    c->rx_done.pop_front();
   }
   while (n < max_cqes && !c->staged.empty() && !c->recv_q.empty()) {
     RxMsg m = std::move(c->staged.front());

@@ -7,8 +7,9 @@ when connects flake, completions stall, and peers die mid-collective.
 This module is the wire that misbehaves on demand: :class:`FaultNet`
 wraps ANY vtable net (``HostQPNet``, ``TCPNet``, ``DeviceMeshNet``) with
 the same verbs (``listen / connect / accept / reg_mr / isend / irecv /
-test / close``) and injects faults from a **seeded, replayable
-schedule**.
+irecv_into / test / close``) and injects faults from a **seeded,
+replayable schedule** — the zero-copy receive path included, so the
+pipelined ring collectives see every fault class the legacy path did.
 
 Fault classes (all off by default; see :class:`FaultSchedule`):
 
@@ -230,6 +231,35 @@ class FaultNet:
         if self._dead_mode("irecv") == "partitioned":
             return Request(_test=lambda: (False, 0, None))  # never completes
         req = self.inner.irecv(comm, *args, **kw)
+        hold = self.schedule.test_delay()
+        if hold == 0:
+            return req
+
+        state = {"left": hold}
+
+        def probe():
+            done, size = req.test()   # progress underneath keeps flowing
+            if not done:
+                return False, 0, None
+            if state["left"] > 0:     # hold the completion REPORT only
+                state["left"] -= 1
+                return False, 0, None
+            return True, size, req.payload
+
+        return Request(_test=probe)
+
+    def irecv_into(self, comm, buf, tag: int = 0, **kw) -> Request:
+        """The zero-copy receive, under the SAME fault model as irecv: a
+        partitioned net never completes it, a dead comm refuses it, and a
+        delayed completion holds only the REPORT — the inner probe still
+        lands/folds the bytes at true delivery time, so the data path the
+        streaming collectives reduce over is byte-identical with and
+        without the delay (what keeps chaos runs bitwise-correct AND
+        replay-equal: every decision below draws from the schedule's own
+        op-sequence streams, never from arrival timing)."""
+        if self._dead_mode("irecv_into") == "partitioned":
+            return Request(_test=lambda: (False, 0, None))  # never completes
+        req = self.inner.irecv_into(comm, buf, tag=tag, **kw)
         hold = self.schedule.test_delay()
         if hold == 0:
             return req

@@ -31,12 +31,15 @@ branch on ``ncclNetProperties_t``.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
+import time
 import uuid
 
 import numpy as np
 
+from rocnrdma_tpu.metrics import WIRE as _WIRE
 from rocnrdma_tpu.transport.backoff import Backoff
 
 
@@ -51,6 +54,10 @@ class NetProperties:
     byte_oriented: bool   # host plane moves bytes; device plane moves arrays
     one_sided: bool = False  # alloc_mr/iwrite/iread supported (optional
                              # capability, like ncclNet's ptrSupport flags)
+    recv_into: bool = False  # irecv_into supported: inbound frames land (or
+                             # streaming-reduce) directly in a caller buffer
+                             # — the zero-copy receive capability the
+                             # pipelined ring collectives key off
 
 
 @dataclasses.dataclass
@@ -71,7 +78,6 @@ class Request:
         """Block until done. ``progress``: extra per-cycle progress hook —
         callers whose own outbound must keep flowing while they wait (the
         ring hops pass their send comm's pump) supply it here."""
-        import time
         deadline = time.monotonic() + timeout_s
         back = _Backoff()
         while not self.test()[0]:
@@ -107,8 +113,17 @@ class _HostComm:
     def __init__(self, qp, net=None):
         self.qp = qp
         self._net = net
-        self._unexpected: dict[int, list[bytes]] = {}  # tag -> payloads
+        # tag -> payloads; entries are ZERO-COPY memoryviews of the posted
+        # receive buffers (poll_cq's contract) with the 4-byte tag sliced
+        # off — a consumer that lands/combines them in place (irecv_into)
+        # recycles the backing bytearray via _recycle
+        self._unexpected: dict[int, list] = {}
         self._posted = 0  # receive buffers posted but not yet completed
+        # recycled frame buffers, one size class (MAX_FRAME + 4): the
+        # steady state of the streaming ring collectives posts receives
+        # from here instead of allocating — zero alloc, zero reg churn
+        self._pool: list[bytearray] = []
+        self._POOL_CAP = 8
         # completed iwrite/iread wr_ids awaiting their Request's probe.
         # Insertion-ordered and CAPPED: a fire-and-forget caller that never
         # tests its Requests must not grow this without bound, so beyond the
@@ -145,12 +160,13 @@ class _HostComm:
         if self._lg_ack_queue:
             self._flush_lg_acks()
         if self._posted < 4:
-            self.qp.post_recv(HostQPNet.MAX_FRAME + 4)
+            self.qp.post_recv(HostQPNet.MAX_FRAME + 4,
+                              buf=self._pool.pop() if self._pool else None)
             self._posted += 1
         got = False
         arena_requested = False
+        from rocnrdma_tpu import native
         for c, payload in self.qp.poll_cq():
-            from rocnrdma_tpu import native
             if c.opcode == native.OP_RECV:
                 self._posted -= 1
                 if c.status != native.OK:
@@ -175,13 +191,27 @@ class _HostComm:
             self._net._lg_ensure(self)
         return got
 
+    def _recycle(self, payload) -> None:
+        """Hand a fully-consumed frame payload's backing buffer back to the
+        receive pool (``payload``: the ``_unexpected`` memoryview whose
+        ``.obj`` is the posted bytearray). Only the one frame size class is
+        pooled; anything else just drops to the GC as before."""
+        buf = getattr(payload, "obj", None)
+        if (isinstance(buf, bytearray)
+                and len(buf) == HostQPNet.MAX_FRAME + 4
+                and len(self._pool) < self._POOL_CAP):
+            try:
+                payload.release()  # drop the export; post_recv re-borrows
+            except BufferError:
+                return  # a live export still aliases it: leave it to the GC
+            self._pool.append(buf)
+
     def close(self):
         # one bounded last shot at returning deferred credit: the peer's
         # in-flight isend should see its credit rather than a timeout.
         # _pump (not a bare flush): send-ring slots only free when the CQ
         # is polled, so a flush-only loop could spin its whole budget
         # against a full ring without ever making progress (code-review r5)
-        import time
         deadline = time.monotonic() + 1.0
         try:
             while self._lg_ack_queue and time.monotonic() < deadline:
@@ -277,7 +307,7 @@ class HostQPNet:
     def get_properties(self, dev: int = 0) -> NetProperties:
         return NetProperties(name="shm-qp", plane="host", max_comms=1 << 16,
                              max_inflight=1 << 10, byte_oriented=True,
-                             one_sided=True)
+                             one_sided=True, recv_into=True)
 
     def listen(self, dev: int = 0, capacity: int = 4 << 20,
                mr_capacity: int = 64 << 20):
@@ -338,8 +368,11 @@ class HostQPNet:
         """
         if len(mr) >= self.LG_MIN:
             return self._lg_isend(comm, mr, tag, timeout_s, progress)
-        data = tag.to_bytes(4, "little") + bytes(mr)
-        self._post_backpressured(comm, lambda: comm.qp.post_send(data),
+        # scatter-gather post: the native layer prepends the 4-byte tag
+        # inside its one ring/queue memcpy, so the payload is borrowed
+        # zero-copy instead of being serialized twice (bytes(mr) + concat)
+        hdr = tag.to_bytes(4, "little")
+        self._post_backpressured(comm, lambda: comm.qp.post_send2(hdr, mr),
                                  "send ring full", timeout_s, progress)
         # drain our own CQ so send completions don't pile up in the native
         # deque over a long-lived comm (poll is the only thing that frees them)
@@ -374,6 +407,27 @@ class HostQPNet:
         self._post_backpressured(comm, lambda: comm.qp.post_send(data),
                                  "send ring full", 10.0, None)
 
+    def _lg_descriptor(self, payload, lg: bool):
+        """``(offset, length)`` when ``payload`` is a put descriptor for a
+        >= LG_MIN expectation, else None — the ONE parser of the LG
+        descriptor frame (``magic | offset | length``), shared by the
+        legacy and zero-copy receive paths so the protocol can never
+        desynchronize between them."""
+        if not (lg and len(payload) == 32
+                and payload[:16] == self._LG_MAGIC):
+            return None
+        return (int.from_bytes(payload[16:24], "little"),
+                int.from_bytes(payload[24:32], "little"))
+
+    def _lg_credit(self, comm: _HostComm, length: int) -> None:
+        """Return ``length`` bytes of arena credit to the sender — queued,
+        then flushed best-effort (NON-blocking: a nominally non-blocking
+        Request.test() must not spin on a full send ring; a deferred ACK
+        drains at the next probe/pump of this comm)."""
+        comm._lg_ack_queue.append(self._LG_ACK_TAG.to_bytes(4, "little")
+                                  + length.to_bytes(8, "little"))
+        self._lg_flush_acks(comm)
+
     def _lg_flush_acks(self, comm: _HostComm) -> None:
         """Post queued credit ACKs until the send ring backpressures —
         never blocks (the irecv probe calls this from Request.test()).
@@ -392,7 +446,6 @@ class HostQPNet:
 
     def _lg_isend(self, comm: _HostComm, mr: memoryview, tag: int,
                   timeout_s: float, progress) -> Request:
-        import time
         deadline = time.monotonic() + timeout_s
         back = _Backoff()
         # announce MY arena on this comm before waiting on the peer's: on
@@ -484,30 +537,104 @@ class HostQPNet:
                 payload = ready.pop(0)
                 if not ready:  # drop exhausted tag keys: callers use fresh
                     del comm._unexpected[tag]  # tags per step, unbounded otherwise
-                if (lg and len(payload) == 32
-                        and payload[:16] == self._LG_MAGIC):
+                desc = self._lg_descriptor(payload, lg)
+                if desc is not None:
                     # a put descriptor: the bytes are already in my arena.
                     # Zero-copy view + one tobytes — the descriptor frame
                     # arrived through the fenced message ring AFTER the
                     # sender's put completed, which is the ordering
                     # read_mr_view's caveat requires (and ~2.5x faster
                     # than the fenced read_mr_local double copy)
-                    offset = int.from_bytes(payload[16:24], "little")
-                    length = int.from_bytes(payload[24:32], "little")
+                    offset, length = desc
                     out = self.read_mr_view(comm, comm._lg_mr, offset,
                                             length).tobytes()
-                    # credit ACK: NON-blocking (ADVICE r4 #2 — a
-                    # nominally non-blocking Request.test() must not
-                    # spin 10 s on a full send ring); a backpressured
-                    # ACK defers to the queue and drains at the next
-                    # probe/pump of this comm
-                    comm._lg_ack_queue.append(
-                        self._LG_ACK_TAG.to_bytes(4, "little")
-                        + length.to_bytes(8, "little"))
-                    self._lg_flush_acks(comm)
+                    _WIRE.payload_bytes_copied += length  # arena staged out
+                    _WIRE.frames_copied += 1              # (irecv_into lands
+                    #                                        it in place)
+                    self._lg_credit(comm, length)
                     return True, length, out
                 return True, len(payload), payload
             return False, 0, None
+        return Request(_test=probe)
+
+    def irecv_into(self, comm: _HostComm, buf, tag: int = 0, *,
+                   combine=None, dtype=None) -> Request:
+        """Post a receive landing DIRECTLY in ``buf`` — the zero-copy twin
+        of :meth:`irecv` (the ``recv_into`` capability in
+        :class:`NetProperties`). ``buf`` is a writable C-contiguous byte
+        buffer, typically a slice of the destination ndarray; the completed
+        Request's ``size`` is the byte count delivered and ``payload`` is
+        None (the data is already in ``buf``).
+
+        ``combine``: optional binary numpy ufunc (``np.add`` & friends) —
+        instead of overwriting, the arrived bytes are interpreted as
+        ``dtype`` and folded INTO ``buf`` in place the moment the frame
+        completes. This is the streaming-reduce primitive of the pipelined
+        ring collectives: the fold reads straight out of the wire buffer
+        (frame path) or the large-message arena view (put path), so the
+        steady state stages no intermediate payload copy at all. ``buf``'s
+        length must then be a multiple of ``dtype``'s itemsize, and the
+        sender must frame on element boundaries (``_RingWire`` aligns its
+        frame size for exactly this reason).
+
+        Frame-path buffers are recycled to the comm's receive pool after
+        consumption, so a long-lived comm's steady state allocates nothing.
+        """
+        mv = memoryview(buf)
+        if mv.readonly:
+            raise ValueError("irecv_into needs a writable destination buffer")
+        dest = np.frombuffer(mv.cast("B"), np.uint8)
+        nbytes = dest.nbytes
+        if combine is not None:
+            if dtype is None:
+                raise ValueError("combine needs an explicit dtype")
+            dtype = np.dtype(dtype)
+            if nbytes % dtype.itemsize:
+                raise ValueError(
+                    f"{nbytes} B destination is not a whole number of "
+                    f"{dtype} elements")
+        lg = nbytes >= self.LG_MIN
+        if lg:
+            self._lg_ensure(comm)  # the LG rendezvous step 1
+
+        def consume(src_u8, length: int) -> None:
+            # land or fold `src_u8` (uint8 array view of the arrived bytes)
+            # into the destination — the ONE write of the zero-copy path
+            if combine is None:
+                dest[:length] = src_u8
+            else:
+                d = dest[:length].view(dtype)
+                combine(d, src_u8.view(dtype), out=d)
+            _WIRE.frames_streamed += 1
+
+        def probe():
+            if comm._lg_ack_queue:  # credit deferred by an earlier probe
+                self._lg_flush_acks(comm)
+            ready = comm._unexpected.get(tag)
+            if not ready:
+                comm._pump()
+                ready = comm._unexpected.get(tag)
+            if not ready:
+                return False, 0, None
+            payload = ready.pop(0)
+            if not ready:
+                del comm._unexpected[tag]
+            desc = self._lg_descriptor(payload, lg)
+            if desc is not None:
+                # put descriptor: bytes already sit in my arena — consume
+                # them through the zero-copy view (ordering per
+                # read_mr_view's caveat: the descriptor frame arrived
+                # through the fenced ring AFTER the sender's put), then
+                # return the credit
+                offset, length = desc
+                consume(self.read_mr_view(comm, comm._lg_mr, offset, length),
+                        length)
+                self._lg_credit(comm, length)
+                return True, length, None
+            n = len(payload)
+            consume(np.frombuffer(payload, np.uint8), n)
+            comm._recycle(payload)
+            return True, n, None
         return Request(_test=probe)
 
     # -- one-sided verbs (optional capability; see NetProperties.one_sided) --
@@ -525,7 +652,6 @@ class HostQPNet:
         """Retry ``post()`` until it yields a wr_id, pumping this comm (and
         the caller's ``progress`` hook — other comms must keep draining or
         two mutually-sending ranks deadlock) while backpressured."""
-        import time
         deadline = time.monotonic() + timeout_s
         back = _Backoff()
         while True:
@@ -620,7 +746,7 @@ class TCPNet(HostQPNet):
     def get_properties(self, dev: int = 0) -> NetProperties:
         return NetProperties(name="tcp-qp", plane="host", max_comms=1 << 16,
                              max_inflight=1 << 10, byte_oriented=True,
-                             one_sided=True)
+                             one_sided=True, recv_into=True)
 
     def listen(self, dev: int = 0, capacity: int = 1 << 20):
         """-> (handle "host:port", listener). ``capacity`` is unused (TCP's
@@ -799,23 +925,46 @@ class _RingWire:
         # bulk copy per hop (r4); everything else chunks at the frame
         self.frame = (getattr(net, "LG_CHUNK", None)
                       or getattr(net, "MAX_FRAME", (1 << 16) - 4))
+        # the zero-copy receive verb, gated on the plane's ADVERTISED
+        # recv_into capability (NetProperties) — not a bare getattr, which
+        # a delegating wrapper like FaultNet would satisfy even over an
+        # inner plane that lacks the verb (e.g. the device mesh)
+        try:
+            caps = net.get_properties(0)
+        except Exception:
+            caps = None
+        self._recv_into = (getattr(net, "irecv_into", None)
+                           if getattr(caps, "recv_into", False) else None)
         self._hops = itertools.count(1)
 
-    def _tag(self, hop: int, nbytes: int):
+    def _tag(self, hop: int, nbytes: int, frame: int | None = None):
         """The (hop, frame-index) tag packer — the ONE definition of the
-        wire tag layout, shared by exchange and the non-blocking p2p."""
-        n_frames = -(-nbytes // self.frame)
+        wire tag layout, shared by exchange, stream, and the non-blocking
+        p2p. ``frame`` overrides the wire's default chunking (the
+        streaming mode's dtype-aligned frame)."""
+        frame = self.frame if frame is None else frame
+        n_frames = -(-nbytes // frame)
         if n_frames >= (1 << 16):
             raise ValueError(
                 f"{n_frames} frames in one message overflows the 16-bit "
                 f"frame-index tag field (> ~4 GB); chunk at the caller")
         return lambda fi: (hop << 16) | fi
 
-    def queue_send(self, out: np.ndarray, hop: int, progress=None) -> None:
+    def _aligned_frame(self, itemsize: int) -> int:
+        """The streaming frame size: the wire frame rounded DOWN to a whole
+        number of ``itemsize``-byte elements, so every frame can be folded
+        in the buffer's own dtype the moment it lands. Both ring ends
+        compute it from the same (dtype, wire) pair, so tags agree."""
+        it = max(1, int(itemsize))
+        return max(it, self.frame - self.frame % it)
+
+    def queue_send(self, out: np.ndarray, hop: int, progress=None,
+                   frame: int | None = None) -> None:
         """Queue ``out`` (uint8) as chunked frames on the send comm (may
-        pump under backpressure; does NOT flush — callers flush or drain)."""
-        tag = self._tag(hop, len(out))
-        frame = self.frame
+        pump under backpressure; does NOT flush — callers flush or drain).
+        ``frame`` overrides the chunking (streaming mode)."""
+        tag = self._tag(hop, len(out), frame)
+        frame = self.frame if frame is None else frame
         for fi, off in enumerate(range(0, len(out), frame)):
             seg = np.ascontiguousarray(out[off:off + frame])
             self.net.isend(self.send_comm,
@@ -823,16 +972,24 @@ class _RingWire:
                            tag=tag(fi), timeout_s=self.timeout_s,
                            progress=progress)
 
-    def post_recvs(self, nbytes: int, hop: int) -> list:
+    def post_recvs(self, nbytes: int, hop: int, into=None) -> list:
         """Post the chunked frame receives for an ``nbytes`` inbound
-        message; returns ``[(offset, nbytes, Request), ...]`` to drain."""
+        message; returns ``[(offset, nbytes, Request), ...]`` to drain.
+        ``into``: optional uint8 destination ndarray — on nets with the
+        ``recv_into`` capability every frame lands there directly and the
+        drained Request carries payload None (zero staging copies)."""
         tag = self._tag(hop, nbytes)
         frame = self.frame
+        recv_into = self._recv_into if into is not None else None
         reqs = []
         for fi, off in enumerate(range(0, nbytes, frame)):
             nb = min(frame, nbytes - off)
-            reqs.append((off, nb,
-                         self.net.irecv(self.recv_comm, nb, tag=tag(fi))))
+            if recv_into is not None:
+                req = recv_into(self.recv_comm, into[off:off + nb],
+                                tag=tag(fi))
+            else:
+                req = self.net.irecv(self.recv_comm, nb, tag=tag(fi))
+            reqs.append((off, nb, req))
         return reqs
 
     def exchange(self, out: np.ndarray, in_nbytes: int,
@@ -849,9 +1006,10 @@ class _RingWire:
         if hop is None:
             hop = next(self._hops)
         got = np.empty(in_nbytes, np.uint8)
-        # queue all chunked irecvs, then the isends, then drain — the plugin
+        # queue all chunked irecvs — landing straight in ``got`` on
+        # recv_into-capable nets — then the isends, then drain; the plugin
         # pumps receives while a send backpressures, so no deadlock
-        reqs = self.post_recvs(in_nbytes, hop)
+        reqs = self.post_recvs(in_nbytes, hop, into=got)
         # progress engine: while our send ring is full, keep draining the
         # comm our inbound data arrives on, or two mutually-sending ranks
         # stall each other
@@ -864,11 +1022,13 @@ class _RingWire:
         # feed us until it drains us and vice versa, so a wait that only
         # pumps the recv comm deadlocks symmetrically (observed at 16 MB
         # hops: both ranks time out with MBs stuck in their send queues).
-        import time as _time
         send_pump = getattr(self.send_comm, "_pump", None)
         for off, nb, r in reqs:
             payload = r.wait(timeout_s=self.timeout_s, progress=send_pump)
-            got[off:off + nb] = np.frombuffer(payload, np.uint8)
+            if payload is not None:  # legacy plane: stage the copy out
+                got[off:off + nb] = np.frombuffer(payload, np.uint8)
+                _WIRE.payload_bytes_copied += nb
+                _WIRE.frames_copied += 1
         # Symmetric tail: a rank whose receives all completed early may
         # still hold queued tx that nothing would otherwise flush — the
         # peer would time out on frames we believe are sent. Flushing
@@ -876,6 +1036,126 @@ class _RingWire:
         _flush_tx(self.send_comm, self.timeout_s, extra_pump=pump,
                   what="ring hop: peer stopped draining")
         return got
+
+    def stream(self, first_send: np.ndarray, hops: list, dtype,
+               timeout_s: float | None = None) -> None:
+        """Pipelined multi-hop engine — the zero-copy streaming mode of the
+        ring collectives. ``hops`` is one ``(dest, combine)`` pair per ring
+        hop: ``dest`` is that hop's inbound destination as a uint8 view of
+        the caller's buffer; ``combine`` is None (land the bytes — the
+        allgather-style hops) or a reduce ufunc (fold them into ``dest``
+        in ``dtype`` — the reduce-scatter-style hops). The engine relies on
+        the chain property every ring schedule here satisfies: hop k+1
+        SENDS hop k's completed ``dest`` (hop 0 sends ``first_send``), so
+
+        - hop k+1's receives are posted while hop k's tail frames drain
+          (double buffering across hops),
+        - frame f of hop k+1's send is queued the moment frame f of hop k
+          is consumed (frame-granular pipelining), and
+        - each frame is reduced the instant its transfer completes, via
+          ``irecv_into``'s in-place fold — combine compute overlaps wire
+          transfer, and the steady state stages zero payload copies and
+          allocates nothing (comm receive pool).
+
+        Every blocking point uses ``consume_progress``, which besides
+        pumping CONSUMES ready inbound frames in post order (their probes
+        fold in place and return large-message credit) — a rank blocked
+        queueing its next hop keeps acking its predecessor, so symmetric
+        rings whose hop size approaches the LG arena cannot mutually
+        starve. Nets without the ``recv_into`` capability fall back to
+        sequential per-hop :meth:`exchange` calls (the capability is
+        uniform across a ring, so both ends take the same path and tags
+        agree)."""
+        t = self.timeout_s if timeout_s is None else timeout_s
+        H = len(hops)
+        if H == 0:
+            return
+        if self._recv_into is None:
+            send = first_send
+            for dest, combine in hops:
+                got = self.exchange(send, dest.nbytes)
+                if combine is None:
+                    dest[:] = got
+                else:
+                    d = dest.view(dtype)
+                    combine(d, got.view(dtype), out=d)
+                send = dest
+            return
+        # ONE dtype-aligned frame for the whole stream: splitting hops
+        # finer to deepen the pipeline was tried and LOSES on both planes
+        # (a comm is one FIFO — extra frames buy no parallelism, only
+        # per-frame Python and protocol work; tuner-driven sizing is an
+        # open ROADMAP item)
+        frame = self._aligned_frame(np.dtype(dtype).itemsize)
+        hop_nos = [next(self._hops) for _ in range(H)]
+        pending = collections.deque()  # posted recv Requests, arrival order
+        send_pump = getattr(self.send_comm, "_pump", None)
+        recv_pump = (self.progress if self.progress is not None
+                     else getattr(self.recv_comm, "_pump", None))
+
+        def consume_progress():
+            # keep our outbound flowing AND consume ready inbound frames
+            # in order (an empty-handed head probe pumps the recv comm
+            # itself, so inbound keeps landing either way)
+            if send_pump is not None:
+                send_pump()
+            while pending and pending[0].test()[0]:
+                pending.popleft()
+            if not pending and recv_pump is not None:
+                recv_pump()
+
+        def post_hop(k):
+            dest, combine = hops[k]
+            tagf = self._tag(hop_nos[k], dest.nbytes, frame)
+            reqs = []
+            for fi, off in enumerate(range(0, dest.nbytes, frame)):
+                nb = min(frame, dest.nbytes - off)
+                r = self._recv_into(self.recv_comm, dest[off:off + nb],
+                                    tag=tagf(fi), combine=combine,
+                                    dtype=dtype)
+                reqs.append((off, nb, r))
+                pending.append(r)
+            return reqs
+
+        posted = [None] * H
+        posted[0] = post_hop(0)
+        if H > 1:
+            posted[1] = post_hop(1)  # double buffer: hop 1's receives are
+            #                          live before hop 0 starts draining
+        # hop 0's outbound is known up front: queue the whole burst
+        self.queue_send(first_send, hop_nos[0], consume_progress, frame=frame)
+        blocked = True  # nothing precedes frame 0: its arrival is not overlap
+        for k in range(H):
+            if k + 1 < H and posted[k + 1] is None:
+                posted[k + 1] = post_hop(k + 1)
+            dest = hops[k][0]
+            nxt_tag = (self._tag(hop_nos[k + 1], dest.nbytes, frame)
+                       if k + 1 < H else None)
+            for fi, (off, nb, r) in enumerate(posted[k]):
+                if r.test()[0]:
+                    # complete before we first looked — genuine overlap
+                    # only if we did real work (consume + send queueing)
+                    # since the last blocking wait; frames that merely
+                    # piled up while we were blocked on a predecessor
+                    # would overstate the pipeline
+                    if not blocked:
+                        _WIRE.frames_overlapped += 1
+                    blocked = False
+                else:
+                    r.wait(timeout_s=t, progress=consume_progress)
+                    blocked = True
+                if nxt_tag is not None:
+                    # this frame of dest is final: it IS frame f of the
+                    # next hop's outbound — queue it while our later
+                    # frames are still in flight
+                    seg = dest[off:off + nb]
+                    self.net.isend(self.send_comm,
+                                   self.net.reg_mr(self.send_comm, seg),
+                                   tag=nxt_tag(fi), timeout_s=t,
+                                   progress=consume_progress)
+            posted[k] = None
+        _flush_tx(self.send_comm, t, extra_pump=consume_progress,
+                  what="ring stream: peer stopped draining")
 
 
 def _as_bytes(a: np.ndarray) -> np.ndarray:
@@ -907,18 +1187,19 @@ def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
     n = n_ranks
     if n == 1:
         return x.reshape(np.shape(local))
+    combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
-
-    # reduce-scatter phase: rank r ends owning chunk (r + 1) mod n
-    _ring_reduce_phase(wire, x, chunk, rank, n, op=op)
-    # allgather: circulate the fully-reduced chunks
-    for k in range(n - 1):
-        send_i, recv_i = rank + 1 - k, rank - k
-        incoming = wire.exchange(_as_bytes(chunk(send_i)),
-                                 chunk(recv_i).nbytes)
-        chunk(recv_i)[:] = incoming.view(x.dtype)
+    # ONE pipelined 2(n-1)-hop stream: the n-1 reduce-scatter hops (fold
+    # each frame on arrival) chained straight into the n-1 allgather hops
+    # (land each frame on arrival). Hop k+1 always sends hop k's completed
+    # chunk — including across the phase boundary (the last reduce hop
+    # lands chunk rank+1 fully reduced, which IS the first allgather
+    # send) — so frames flow continuously from first send to last landing.
+    hops = [(_as_bytes(chunk(rank - k - 1)), combine) for k in range(n - 1)]
+    hops += [(_as_bytes(chunk(rank - k)), None) for k in range(n - 1)]
+    wire.stream(_as_bytes(chunk(rank)), hops, x.dtype)
     return x.reshape(np.shape(local))
 
 
@@ -926,18 +1207,15 @@ _NET_REDUCE_OPS = {"sum": np.add, "prod": np.multiply,
                    "max": np.maximum, "min": np.minimum}
 
 
-def _ring_reduce_phase(wire: "_RingWire", x: np.ndarray, chunk, rank: int,
-                       n: int, shift: int = 0, op: str = "sum") -> None:
-    """The n-1 reduce-scatter ring steps in place: at step k, send chunk
-    ``rank - k + shift``, combine into ``rank - k - 1 + shift``. After
-    the phase, rank r owns the fully-reduced chunk ``(r + 1 + shift) mod n``
-    — shift=0 is the allreduce layout, shift=-1 lands chunk r on rank r."""
-    combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
-    for k in range(n - 1):
-        send_i, recv_i = rank - k + shift, rank - k - 1 + shift
-        incoming = wire.exchange(_as_bytes(chunk(send_i)),
-                                 chunk(recv_i).nbytes)
-        combine(chunk(recv_i), incoming.view(x.dtype), out=chunk(recv_i))
+def _stream_reduce_scatter(wire: "_RingWire", chunk, rank: int, n: int,
+                           dtype, combine) -> None:
+    """The -1-shifted streaming reduce chain — the ONE definition of its
+    offset arithmetic, shared by the dense and ragged reduce-scatter verbs
+    (chunk bounds differ, the schedule does not): hop k sends
+    chunk(rank-k-1) and folds the arrival into chunk(rank-k-2); after n-1
+    hops chunk(rank) is fully reduced on this rank."""
+    hops = [(_as_bytes(chunk(rank - k - 2)), combine) for k in range(n - 1)]
+    wire.stream(_as_bytes(chunk(rank - 1)), hops, dtype)
 
 
 def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
@@ -955,10 +1233,11 @@ def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
     n = n_ranks
     if n == 1:
         return x
+    combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
-    _ring_reduce_phase(wire, x, chunk, rank, n, shift=-1, op=op)
+    _stream_reduce_scatter(wire, chunk, rank, n, x.dtype, combine)
     return np.array(chunk(rank), copy=True)
 
 
@@ -969,18 +1248,17 @@ def _flush_tx(comm, timeout_s: float, extra_pump=None,
     in user space — and a caller that stops touching the comm after its own
     receives complete would strand it, starving the peer. No-op on comms
     without a tx queue (shm plane, device plane)."""
-    import time as _time
     tx_pending = (getattr(comm.qp, "tx_pending", None)
                   if hasattr(comm, "qp") else None)
     if tx_pending is None:
         return
-    deadline = _time.monotonic() + timeout_s
+    deadline = time.monotonic() + timeout_s
     back = _Backoff()
     while tx_pending() > 0:
         comm._pump()
         if extra_pump is not None:
             extra_pump()
-        if _time.monotonic() >= deadline:
+        if time.monotonic() >= deadline:
             raise TimeoutError(f"tx flush: {what}; bytes still queued "
                                f"after {timeout_s}s")
         back.pause()
@@ -1043,7 +1321,6 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
     16 MB: rank 0 finishes correct in 0.13 s, rank 1 times out on the
     doorbell with 3.2 MB stranded in rank 0's send queue). The caller
     runs the phase loops."""
-    import time as _time
 
     from rocnrdma_tpu.native import fence_acquire as _fence_acquire
 
@@ -1065,7 +1342,7 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
         # predecessor may still sit in the recv comm's tx queue, and if
         # every rank waits for credit while pumping only its send comm,
         # no ACK ever flushes and the ring deadlocks globally.
-        deadline = _time.monotonic() + timeout_s
+        deadline = time.monotonic() + timeout_s
         back = _Backoff()
         while hop > 2:
             consumed = int.from_bytes(
@@ -1075,7 +1352,7 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
             if recv_pump is not None:
                 recv_pump()
             probe_pending()
-            if _time.monotonic() >= deadline:
+            if time.monotonic() >= deadline:
                 raise TimeoutError("rdma ring: successor stopped consuming")
             back.pause()
         slot = hop % 2
@@ -1087,7 +1364,7 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
 
     def take(hop: int, nbytes: int) -> np.ndarray:
         slot = hop % 2
-        deadline = _time.monotonic() + timeout_s
+        deadline = time.monotonic() + timeout_s
         back = _Backoff()
         while True:
             flag = int.from_bytes(
@@ -1098,7 +1375,7 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
             if send_pump is not None:  # keep our own outbound flowing
                 send_pump()
             probe_pending()
-            if _time.monotonic() >= deadline:
+            if time.monotonic() >= deadline:
                 raise TimeoutError("rdma ring: predecessor's doorbell never rang")
             back.pause()
         # acquire AFTER the matching flag load, BEFORE the raw view loads:
@@ -1138,7 +1415,7 @@ def _chunk_layout(x: np.ndarray, n: int):
 def _rdma_reduce_phase(put, take, ack, chunk, x, rank: int, n: int, hop: int,
                        shift: int = 0, op: str = "sum") -> int:
     """The n-1 doorbell reduce hops in place (the put/take twin of the msg
-    plane's ``_ring_reduce_phase``): at step k, put chunk ``rank - k +
+    plane's streaming reduce chain): at step k, put chunk ``rank - k +
     shift``, combine the taken chunk into ``rank - k - 1 + shift``. Returns
     the advanced hop counter. shift=0 is the allreduce layout; shift=-1
     lands chunk r fully reduced on rank r. The combine reads take()'s
@@ -1248,11 +1525,11 @@ def ring_allgather_over_net(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return out
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
-    for k in range(n - 1):
-        send_i = (rank - k) % n
-        recv_i = (rank - k - 1) % n
-        incoming = wire.exchange(_as_bytes(out[send_i]), block.nbytes)
-        out[recv_i] = incoming.view(block.dtype).reshape(block.shape)
+    # pipelined: hop k lands origin (rank-k-1)'s block STRAIGHT into its
+    # output row, and that row is hop k+1's outbound — frame f forwards
+    # the moment it arrives, no per-hop staging buffer
+    hops = [(_as_bytes(out[(rank - k - 1) % n]), None) for k in range(n - 1)]
+    wire.stream(_as_bytes(out[rank]), hops, block.dtype)
     return out
 
 
@@ -1472,13 +1749,14 @@ def ring_allgatherv_over_net(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return out
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
-    isz = seg.dtype.itemsize
-    cur = _as_bytes(seg)
+    # pipelined ragged train: each hop lands origin (rank-s)'s segment
+    # straight into its (pre-allocated, exactly-sized) output slot, and
+    # that slot is the next hop's outbound — no staging, no .copy()
     for s in range(1, n):
         origin = (rank - s) % n
-        incoming = wire.exchange(cur, int(counts[origin]) * isz)
-        out[origin] = incoming.view(seg.dtype).copy()
-        cur = incoming  # forward the arrival on the next hop
+        out[origin] = np.empty(int(counts[origin]), seg.dtype)
+    hops = [(_as_bytes(out[(rank - s) % n]), None) for s in range(1, n)]
+    wire.stream(_as_bytes(seg), hops, seg.dtype)
     return out
 
 
@@ -1492,7 +1770,7 @@ def ring_reduce_scatter_v_over_net(net, send_comm, recv_comm,
     r returns the elementwise reduction of every rank's chunk r.
 
     The ragged generalization of :func:`ring_reduce_scatter_over_net`:
-    identical n-1 ring steps (via ``_ring_reduce_phase`` with shift=-1, so
+    identical n-1 pipelined ring steps (the -1-shifted stream, so
     chunk r lands on rank r), with chunk bounds taken from ``counts``
     instead of floor-balanced — wire bytes are exactly the non-own chunks,
     as in the dense case."""
@@ -1508,8 +1786,11 @@ def ring_reduce_scatter_v_over_net(net, send_comm, recv_comm,
         return x
     bounds = np.concatenate([[0], np.cumsum(counts)])
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
+    combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
-    _ring_reduce_phase(wire, x, chunk, rank, n, shift=-1, op=op)
+    # same -1-shifted streaming reduce chain as the dense verb, with the
+    # chunk bounds taken from ``counts`` instead of floor-balanced
+    _stream_reduce_scatter(wire, chunk, rank, n, x.dtype, combine)
     return np.array(chunk(rank), copy=True)
 
 
