@@ -32,6 +32,7 @@ state, metrics, checkpoint shards) and for machines with no TPU at all.
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 import numpy as np
@@ -114,6 +115,11 @@ class ProcessGroup:
             raise
         self._barrier_no = 0
         self._watchdog = None
+        # guards the watchdog thread's shared health state (_dead,
+        # _watchdog_failed): the thread writes, every verb's _check_alive
+        # reads — the race-discipline lint (tools/analyze/races.py) holds
+        # every touch of thread-written attributes to this lock
+        self._health_lock = threading.Lock()
         self._watchdog_failed = None
         self._dead: list[int] = []
         self._p2p: dict[tuple, "plugin._RingWire"] = {}  # (peer, dir) -> wire
@@ -538,8 +544,7 @@ class ProcessGroup:
                                  progress=self._p2p_progress)
                 if payload is not None:  # legacy plane: stage the copy
                     got[off:off + nb] = np.frombuffer(payload, np.uint8)
-                    _WIRE.payload_bytes_copied += nb
-                    _WIRE.frames_copied += 1
+                    _WIRE.copied(nb)
             self._release_outstanding(src, "rx", tag)
             return got.view(template.dtype).reshape(template.shape)
 
@@ -780,10 +785,10 @@ class ProcessGroup:
             return
         if self._watchdog is not None and self._watchdog.is_alive():
             return
-        import threading
         self._watchdog_stop = threading.Event()
-        self._watchdog_failed = None
-        self._dead = []
+        with self._health_lock:
+            self._watchdog_failed = None
+            self._dead = []
         ns = f"pg/{self.group_name}/hb"
 
         def run():
@@ -818,7 +823,8 @@ class ProcessGroup:
                                 if p != self.rank and p not in dead \
                                         and get0(f"{ns}/dead/{p}") is not None:
                                     dead.add(p)
-                            self._dead = sorted(dead)
+                            with self._health_lock:
+                                self._dead = sorted(dead)
                         # watch my nearest alive right neighbour
                         target = next(
                             (c for off in range(1, self.world_size)
@@ -835,7 +841,8 @@ class ProcessGroup:
                                 seen[target] = (hv, now)
                             elif now - s[1] > timeout_s:
                                 dead.add(target)
-                                self._dead = sorted(dead)
+                                with self._health_lock:
+                                    self._dead = sorted(dead)
                                 client.set(f"{ns}/dead/{target}", "1")
                                 client.set(f"{ns}/dead_v",
                                            f"{self.rank}:{beat}")
@@ -843,7 +850,8 @@ class ProcessGroup:
                         pass  # one slow store RPC: keep ticking, not die
                     self._watchdog_stop.wait(interval_s)
             except Exception as e:  # noqa: BLE001 — recorded, not swallowed
-                self._watchdog_failed = repr(e)
+                with self._health_lock:
+                    self._watchdog_failed = repr(e)
             finally:
                 if client is not None:
                     client.close()
@@ -866,7 +874,8 @@ class ProcessGroup:
     def dead_ranks(self) -> list:
         """Peers the watchdog currently considers dead (empty without a
         running watchdog)."""
-        return list(self._dead)
+        with self._health_lock:
+            return list(self._dead)
 
     def async_error(self) -> str | None:
         """The ``ncclCommGetAsyncError`` habit: poll the group's background
@@ -874,22 +883,26 @@ class ProcessGroup:
         what the watchdog knows (dead peers, or its own demise). The next
         verb would raise the same condition; this is for schedulers that
         want to check between steps."""
-        if self._watchdog_failed:
-            return (f"watchdog thread died ({self._watchdog_failed}); "
+        with self._health_lock:
+            failed, dead = self._watchdog_failed, list(self._dead)
+        if failed:
+            return (f"watchdog thread died ({failed}); "
                     f"failure detection is OFF")
-        if self._dead:
-            return f"rank(s) {self._dead} stopped heartbeating"
+        if dead:
+            return f"rank(s) {dead} stopped heartbeating"
         return None
 
     def _check_alive(self) -> None:
-        if self._watchdog_failed:
+        with self._health_lock:
+            failed, dead = self._watchdog_failed, list(self._dead)
+        if failed:
             raise RuntimeError(
-                f"watchdog thread died ({self._watchdog_failed}); failure "
+                f"watchdog thread died ({failed}); failure "
                 f"detection is OFF for group {self.group_name!r} — "
                 f"start_watchdog() again or destroy")
-        if self._dead:
+        if dead:
             raise RuntimeError(
-                f"watchdog: rank(s) {self._dead} stopped heartbeating "
+                f"watchdog: rank(s) {dead} stopped heartbeating "
                 f"(group {self.group_name!r}); shrink() or destroy "
                 f"(a collective would hang on the dead)")
 
@@ -898,8 +911,11 @@ class ProcessGroup:
             self._watchdog_stop.set()
             self._watchdog.join(timeout=5.0)
             self._watchdog = None
-            self._watchdog_failed = None
-            self._dead = []
+            # the join is bounded: a wedged thread may still be alive, so
+            # the reset must hold the same lock its writes do
+            with self._health_lock:
+                self._watchdog_failed = None
+                self._dead = []
 
     # -- lifecycle ---------------------------------------------------------
 

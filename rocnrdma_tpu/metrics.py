@@ -35,6 +35,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import json
+import threading
 import time
 from typing import IO
 
@@ -121,8 +122,15 @@ class WireCounters:
     ``payload_bytes_copied == 0`` across a timed window (the
     ``bench_host --smoke`` gate asserts exactly that on a delta of
     :data:`WIRE`, the process-wide instance every producer increments).
-    Counters are plain ints bumped under the GIL — telemetry precision,
-    not synchronization.
+
+    Mutation goes through the ``copied``/``streamed``/``overlapped``
+    methods, which hold the instance's own lock: producers include
+    progress hooks that p2p verbs may drive from a watchdog-adjacent
+    context, and "bumped under the GIL" is an implementation accident,
+    not a contract — the lock makes the increments (and the
+    snapshot/delta windows the smoke gate asserts on) sound wherever
+    they run (the static race pass, ``tools/analyze/races.py``, enforces
+    the same discipline for thread-shared attributes).
     """
 
     payload_bytes_copied: int = 0   # bytes staged through an extra copy
@@ -130,8 +138,30 @@ class WireCounters:
     frames_copied: int = 0          # frames that took a staging copy
     frames_overlapped: int = 0      # streamed frames that beat the consumer
 
+    def __post_init__(self):
+        # not a dataclass field: asdict()/snapshot() must stay pure counters
+        self._lock = threading.Lock()
+
+    def copied(self, nbytes: int, frames: int = 1) -> None:
+        """Record ``nbytes`` staged through an extra payload copy (the
+        legacy path's one frame at a time)."""
+        with self._lock:
+            self.payload_bytes_copied += nbytes
+            self.frames_copied += frames
+
+    def streamed(self, frames: int = 1) -> None:
+        """Record frames landed/combined in place (the zero-copy path)."""
+        with self._lock:
+            self.frames_streamed += frames
+
+    def overlapped(self, frames: int = 1) -> None:
+        """Record streamed frames whose transfer beat the consume loop."""
+        with self._lock:
+            self.frames_overlapped += frames
+
     def snapshot(self) -> dict:
-        return dataclasses.asdict(self)
+        with self._lock:
+            return dataclasses.asdict(self)
 
     def delta(self, since: dict) -> dict:
         """Counter movement since a ``snapshot()`` (the per-measurement
@@ -141,15 +171,17 @@ class WireCounters:
     def overlap_ratio(self) -> float:
         """Fraction of streamed frames whose transfer fully overlapped the
         consumption of earlier frames (0.0 with nothing streamed)."""
-        if self.frames_streamed == 0:
-            return 0.0
-        return self.frames_overlapped / self.frames_streamed
+        with self._lock:
+            if self.frames_streamed == 0:
+                return 0.0
+            return self.frames_overlapped / self.frames_streamed
 
     def reset(self) -> None:
-        self.payload_bytes_copied = 0
-        self.frames_streamed = 0
-        self.frames_copied = 0
-        self.frames_overlapped = 0
+        with self._lock:
+            self.payload_bytes_copied = 0
+            self.frames_streamed = 0
+            self.frames_copied = 0
+            self.frames_overlapped = 0
 
 
 # THE process-wide wire-counter instance (one per rank process — host-plane

@@ -24,12 +24,60 @@ import time
 
 _SRCS = [os.path.join(os.path.dirname(__file__), f)
          for f in ("rqp.cpp", "rtcp.cpp")]
-_LIB_DIR = os.environ.get("RQP_LIB_DIR") or os.path.join(
-    os.path.dirname(__file__), "_build")
+
+# Sanitizer build flavors (ROCNRDMA_SANITIZE=asan|ubsan): the same
+# sources, instrumented, cached in a per-flavor subdir of _build so the
+# plain .so is never clobbered. ASAN-instrumented code additionally needs
+# the asan runtime loaded FIRST in the process — a ctypes host (python)
+# must be launched with LD_PRELOAD pointing at libasan; sanitizer_env()
+# below builds that environment, and tests/test_native_sanitize.py is the
+# slow-marked CI driver that reruns the native test files under each
+# flavor.
+_SANITIZE = os.environ.get("ROCNRDMA_SANITIZE", "").strip().lower()
+_SAN_FLAGS = {
+    "": [],
+    "asan": ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"],
+    "ubsan": ["-fsanitize=undefined", "-fno-sanitize-recover=undefined",
+              "-g"],
+}
+# the flavor nests INSIDE an explicit RQP_LIB_DIR too: a sanitizer run
+# must never pick up (or overwrite) the plain cached .so just because the
+# cache location was overridden
+_LIB_DIR = os.path.join(
+    os.environ.get("RQP_LIB_DIR")
+    or os.path.join(os.path.dirname(__file__), "_build"),
+    _SANITIZE).rstrip("/")
 _LIB = os.path.join(_LIB_DIR, "librqp.so")
 
 _build_lock = threading.Lock()
 _lib = None
+
+
+def sanitizer_env(flavor: str) -> dict:
+    """Environment for a python process that should run the native layer
+    under the ``flavor`` sanitizer build: selects the flavor
+    (``ROCNRDMA_SANITIZE``), preloads the asan runtime where required,
+    and configures the runtimes to fail loudly (abort on error; leak
+    detection ON, with the interpreter's own allocations suppressed —
+    python "leaks" by LSAN's accounting, the native library must not).
+    """
+    if flavor not in _SAN_FLAGS or not flavor:
+        raise ValueError(f"unknown sanitizer flavor {flavor!r}; "
+                         f"know {sorted(k for k in _SAN_FLAGS if k)}")
+    env = {"ROCNRDMA_SANITIZE": flavor}
+    if flavor == "asan":
+        rt = subprocess.run(["g++", "-print-file-name=libasan.so"],
+                            capture_output=True, text=True,
+                            check=True).stdout.strip()
+        env["LD_PRELOAD"] = rt
+        env["ASAN_OPTIONS"] = "abort_on_error=1:detect_leaks=1"
+        env["LSAN_OPTIONS"] = ("suppressions="
+                               + os.path.join(os.path.dirname(__file__),
+                                              "lsan.supp")
+                               + ":print_suppressed=0")
+    elif flavor == "ubsan":
+        env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    return env
 
 
 def _as_cbuf(data):
@@ -81,7 +129,13 @@ class Completion:
 
 
 def build(force: bool = False) -> str:
-    """Compile rqp.cpp + rtcp.cpp → ``librqp.so`` with system g++ (cached)."""
+    """Compile rqp.cpp + rtcp.cpp → ``librqp.so`` with system g++ (cached).
+    ``ROCNRDMA_SANITIZE=asan|ubsan`` selects an instrumented flavor in its
+    own cache dir (``_build/<flavor>``)."""
+    if _SANITIZE not in _SAN_FLAGS:
+        raise ValueError(
+            f"ROCNRDMA_SANITIZE={_SANITIZE!r} is not a build flavor; "
+            f"know {sorted(k for k in _SAN_FLAGS if k)} (or unset)")
     with _build_lock:
         stale = (force or not os.path.exists(_LIB)
                  or os.path.getmtime(_LIB) < max(map(os.path.getmtime, _SRCS)))
@@ -92,10 +146,16 @@ def build(force: bool = False) -> str:
             # (newer glibc ships an empty librt, so the flag is harmless
             # everywhere — without it the .so builds fine and then fails
             # at dlopen with "undefined symbol: shm_open")
+            # the COMPILER is not the subject under test: when this
+            # process itself runs under an LD_PRELOADed sanitizer runtime
+            # (sanitizer_env), g++/cc1plus would inherit it and abort on
+            # their own exit-time "leaks" before producing any .so
+            env = {k: v for k, v in os.environ.items() if k != "LD_PRELOAD"}
             subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 *_SAN_FLAGS[_SANITIZE], "-o", tmp,
                  *_SRCS, "-pthread", "-lrt"],
-                check=True, capture_output=True, text=True)
+                check=True, capture_output=True, text=True, env=env)
             os.replace(tmp, _LIB)  # atomic: concurrent builders don't clash
     return _LIB
 
@@ -175,6 +235,8 @@ def _load():
                                  ctypes.c_int]
     lib.rtcp_tx_pending.restype = ctypes.c_uint64
     lib.rtcp_tx_pending.argtypes = [ctypes.c_void_p]
+    lib.rtcp_rx_pending.restype = ctypes.c_uint64
+    lib.rtcp_rx_pending.argtypes = [ctypes.c_void_p]
     lib.rtcp_close.restype = None
     lib.rtcp_close.argtypes = [ctypes.c_void_p]
     lib.rtcp_close_listener.restype = None
@@ -579,6 +641,16 @@ class QueuePair(_QpBase):
         """Unread bytes in the incoming ring (diagnostics)."""
         return _load().rqp_rx_pending(self._h)
 
+    def tx_pending(self) -> int:
+        """Bytes queued but not yet handed to the wire: always 0 on the
+        shm plane — ``post_send`` memcpys into the shared ring (or
+        backpressures with wr_id -1) synchronously during the call, so
+        nothing ever waits in user space. Present for verb-surface parity
+        with :class:`TcpQueuePair` (the conformance pass holds the two
+        bindings to one surface), and it makes ``_flush_tx`` uniformly
+        correct instead of feature-detected."""
+        return 0
+
     def _post_close(self) -> None:
         if self.is_listener:
             _load().rqp_unlink(self.name.encode())
@@ -640,3 +712,9 @@ class TcpQueuePair(_QpBase):
     def tx_pending(self) -> int:
         """Bytes queued but not yet handed to the kernel (diagnostics)."""
         return _load().rtcp_tx_pending(self._h)
+
+    def rx_pending(self) -> int:
+        """Payload bytes parsed off the socket but not yet claimed by a
+        posted receive (staged messages; diagnostics — the rtcp twin of
+        the shm plane's unread-ring count)."""
+        return _load().rtcp_rx_pending(self._h)

@@ -19,7 +19,8 @@
 //   rtcp_post_recv(c, buf, cap) -> wr_id
 //   rtcp_poll_cq(c, cqes, max)  -> n      (THE progress engine: flushes tx,
 //                                          parses rx frames, fills WRs)
-//   rtcp_tx_pending(c) / rtcp_close(c) / rtcp_close_listener(l)
+//   rtcp_tx_pending(c) / rtcp_rx_pending(c) / rtcp_close(c) /
+//   rtcp_close_listener(l)
 //
 // One-sided RDMA (ibv_wr_rdma_write/read over the socket). An MR here is a
 // heap buffer owned by the connection; WRITE and READ travel as typed frames
@@ -662,6 +663,13 @@ int rtcp_poll_cq(void* cv, Cqe* cqes, int max_cqes) {
 uint64_t rtcp_tx_pending(void* cv) {
   Conn* c = static_cast<Conn*>(cv);
   return c ? c->tx_bytes : 0;
+}
+
+uint64_t rtcp_rx_pending(void* cv) {
+  // payload bytes parsed off the socket but not yet claimed by a posted
+  // receive — the diagnostic twin of rqp_rx_pending's unread-ring count
+  Conn* c = static_cast<Conn*>(cv);
+  return c ? c->staged_bytes : 0;
 }
 
 void rtcp_close(void* cv) {

@@ -345,6 +345,16 @@ class BootstrapClient:
         self.close()
 
 
+def _close_quietly(res) -> None:
+    """Best-effort teardown of a half-made endpoint on a failure path —
+    the original error is the diagnosis; a close() raising over it (peer
+    already gone, segment already unlinked) would mask it."""
+    try:
+        res.close()
+    except Exception:
+        pass
+
+
 def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
                    timeout_s: float = 30.0, ns: str = "ring"):
     """Wire the ring every net collective here expects, from ONE shared
@@ -365,6 +375,7 @@ def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
     deadline = time.monotonic() + timeout_s
     remaining = lambda: max(0.1, deadline - time.monotonic())
     client = BootstrapClient(store_handle, rank, timeout_s, scope=ns)
+    listener = send_comm = recv_comm = None
     try:
         handle, listener = net.listen()
         handles = client.exchange(f"{ns}/h", handle, n_ranks, remaining())
@@ -380,6 +391,19 @@ def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
                       TimeoutError))
         client.barrier(f"{ns}/wired", n_ranks, remaining())
     except BaseException:
-        client.close()  # a failed wiring must not leak the store conn
+        # a failed wiring must not leak what it made: any half-wired comm,
+        # the listener when nothing was ever accepted on it (on the shm
+        # plane the listener IS a queue pair holding a segment; once
+        # accepted it became recv_comm, closed above — TCP listeners are
+        # net-tracked either way), and the store connection. Closes are
+        # idempotent, so the net-level close() of registered comms later
+        # is a harmless second no-op.
+        if send_comm is not None:
+            _close_quietly(send_comm)
+        if recv_comm is not None:
+            _close_quietly(recv_comm)
+        elif listener is not None:
+            _close_quietly(listener)
+        client.close()
         raise
     return send_comm, recv_comm, client

@@ -164,12 +164,17 @@ class FaultSchedule:
 class FaultNet:
     """The vtable wrapper that misbehaves on ``schedule``'s command.
 
-    Transparent for every verb the schedule leaves alone: unknown
-    attributes (``alloc_mr``, ``iwrite``, ``LG_CHUNK``, ``MAX_FRAME``,
-    plane-specific helpers) delegate to the inner net, so collectives,
-    ``_RingWire`` chunking, and the one-sided paths ride through
-    unchanged. Comms are the inner net's own objects — progress pumps and
-    per-comm state need no adaptation.
+    EVERY canonical net verb is defined here explicitly — data verbs
+    (two-sided ``isend``/``irecv``/``irecv_into`` and one-sided
+    ``iwrite``/``iread``) under the fault model, the rest as documented
+    passthroughs — and the vtable-conformance pass
+    (``tools/analyze/vtable.py``) pins it that way: a verb that fell
+    through ``__getattr__`` would run with zero fault coverage. The
+    delegation stays for NON-verb attributes only (``LG_CHUNK``,
+    ``MAX_FRAME``, plane-specific helpers), so ``_RingWire`` chunking
+    and frame constants ride through unchanged. Comms are the inner
+    net's own objects — progress pumps and per-comm state need no
+    adaptation.
     """
 
     def __init__(self, inner, schedule: FaultSchedule | None = None):
@@ -276,6 +281,46 @@ class FaultNet:
             return True, size, req.payload
 
         return Request(_test=probe)
+
+    # -- one-sided verbs (the put-based data path) -------------------------
+    #
+    # Before PR 3 these fell through __getattr__ — the put-based ring
+    # collectives ran with ZERO fault coverage, the exact bug class the
+    # vtable-conformance pass (tools/analyze/vtable.py) now makes
+    # structurally impossible. Same model as the two-sided verbs: iwrite
+    # and iread are data ops (they advance the schedule's op stream and
+    # honor die/partition); alloc_mr is connection-plane setup and
+    # read_mr_local/read_mr_view are reads of this rank's OWN memory —
+    # explicit passthroughs, so the wrap is a documented decision instead
+    # of a silent delegation.
+
+    def alloc_mr(self, comm, nbytes: int):
+        """Passthrough: MR registration is local setup (the connection
+        faults already cover the rendezvous it rides on)."""
+        return self.inner.alloc_mr(comm, nbytes)
+
+    def iwrite(self, comm, rkey, mr, **kw) -> Request:
+        if self._dead_mode("iwrite") == "partitioned":
+            # blackhole: the put "completes" locally but never lands — the
+            # peer's doorbell poll (or credit wait) must time out, named
+            size = memoryview(mr).nbytes
+            return Request(_test=lambda: (True, size, None))
+        return self.inner.iwrite(comm, rkey, mr, **kw)
+
+    def iread(self, comm, rkey, nbytes: int, **kw) -> Request:
+        if self._dead_mode("iread") == "partitioned":
+            return Request(_test=lambda: (False, 0, None))  # never completes
+        return self.inner.iread(comm, rkey, nbytes, **kw)
+
+    def read_mr_local(self, comm, mr, offset: int, nbytes: int):
+        """Passthrough: the owner reading its own MR cannot flake — under
+        a partition the peer's writes simply never arrive, which is the
+        fault (the doorbell value stays stale and the caller times out)."""
+        return self.inner.read_mr_local(comm, mr, offset, nbytes)
+
+    def read_mr_view(self, comm, mr, offset: int, nbytes: int):
+        """Passthrough, as :meth:`read_mr_local`."""
+        return self.inner.read_mr_view(comm, mr, offset, nbytes)
 
     def test(self, req: Request):
         return req.test()

@@ -329,14 +329,19 @@ class HostQPNet:
     def connect(self, dev: int, handle: str, timeout_s: float = 10.0) -> _HostComm:
         from rocnrdma_tpu import native
         assert self._inited, "call init() first"
-        comm = _HostComm(native.QueuePair.connect(handle, timeout_s), net=self)
-        comm.qp.accept(timeout_s)
+        qp = native.QueuePair.connect(handle, timeout_s)
+        try:
+            qp.accept(timeout_s)
+        except BaseException:
+            qp.close()  # a half-attached QP is not in _comms yet: nothing
+            raise       # else would ever release its shm segment
+        comm = _HostComm(qp, net=self)
         self._comms.append(comm)
         return comm
 
-    def accept(self, listen_qp, timeout_s: float = 10.0) -> _HostComm:
-        listen_qp.accept(timeout_s)
-        comm = _HostComm(listen_qp, net=self)
+    def accept(self, listener, timeout_s: float = 10.0) -> _HostComm:
+        listener.accept(timeout_s)
+        comm = _HostComm(listener, net=self)
         self._comms.append(comm)
         return comm
 
@@ -548,9 +553,8 @@ class HostQPNet:
                     offset, length = desc
                     out = self.read_mr_view(comm, comm._lg_mr, offset,
                                             length).tobytes()
-                    _WIRE.payload_bytes_copied += length  # arena staged out
-                    _WIRE.frames_copied += 1              # (irecv_into lands
-                    #                                        it in place)
+                    _WIRE.copied(length)  # arena staged out (irecv_into
+                    #                       lands it in place instead)
                     self._lg_credit(comm, length)
                     return True, length, out
                 return True, len(payload), payload
@@ -605,7 +609,7 @@ class HostQPNet:
             else:
                 d = dest[:length].view(dtype)
                 combine(d, src_u8.view(dtype), out=d)
-            _WIRE.frames_streamed += 1
+            _WIRE.streamed()
 
         def probe():
             if comm._lg_ack_queue:  # credit deferred by an earlier probe
@@ -748,9 +752,13 @@ class TCPNet(HostQPNet):
                              max_inflight=1 << 10, byte_oriented=True,
                              one_sided=True, recv_into=True)
 
-    def listen(self, dev: int = 0, capacity: int = 1 << 20):
-        """-> (handle "host:port", listener). ``capacity`` is unused (TCP's
-        tx bound is the fixed 64 MiB rtcp queue cap, not a ring size)."""
+    def listen(self, dev: int = 0, capacity: int = 1 << 20,
+               mr_capacity: int = 64 << 20):
+        """-> (handle "host:port", listener). ``capacity`` and
+        ``mr_capacity`` are accepted for vtable-signature parity with the
+        shm plane and unused (TCP's tx bound is the fixed 64 MiB rtcp
+        queue cap, not a ring size; TCP MRs are heap buffers sized at
+        ``reg_mr`` time, not carved from a pre-sized arena)."""
         from rocnrdma_tpu import native
         assert self._inited, "call init() first"
         listener = native.TcpListener()
@@ -1027,8 +1035,7 @@ class _RingWire:
             payload = r.wait(timeout_s=self.timeout_s, progress=send_pump)
             if payload is not None:  # legacy plane: stage the copy out
                 got[off:off + nb] = np.frombuffer(payload, np.uint8)
-                _WIRE.payload_bytes_copied += nb
-                _WIRE.frames_copied += 1
+                _WIRE.copied(nb)
         # Symmetric tail: a rank whose receives all completed early may
         # still hold queued tx that nothing would otherwise flush — the
         # peer would time out on frames we believe are sent. Flushing
@@ -1139,7 +1146,7 @@ class _RingWire:
                     # piled up while we were blocked on a predecessor
                     # would overstate the pipeline
                     if not blocked:
-                        _WIRE.frames_overlapped += 1
+                        _WIRE.overlapped()
                     blocked = False
                 else:
                     r.wait(timeout_s=t, progress=consume_progress)

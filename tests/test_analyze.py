@@ -1,0 +1,391 @@
+"""The static-analysis suite (tools/analyze) gates tier-1: every pass runs
+clean on the repo, each detector proves it still detects on purpose-built
+bad-code fixtures (positive AND negative cases), and finding counts are
+RATCHETED against results/analyze_pr3.json — a PR may shrink them, never
+grow them, so "we'll clean it up later" cannot accrete."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import analyze  # noqa: E402
+from tools.analyze import base, deadlines, leaks, races, vtable  # noqa: E402
+
+sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# the whole suite, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_one_exit_code():
+    """`python -m tools.analyze` is the one command CI (and a human) runs:
+    exit 0, every pass clean."""
+    out = subprocess.run([sys.executable, "-m", "tools.analyze"],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=120)
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+    assert "0 problem(s) total" in out.stdout
+
+
+def test_ratchet_counts_never_grow():
+    """The snapshot is a ceiling, not a target: each pass's finding count
+    must stay <= the recorded value (currently all zero — the ALLOW lists
+    are empty and the surface complies)."""
+    with open(os.path.join(REPO, analyze.SNAPSHOT)) as fp:
+        snap = json.load(fp)["counts"]
+    current = analyze.counts()
+    for name, ceiling in snap.items():
+        assert current.get(name, 0) <= ceiling, (
+            f"pass {name!r} grew from {ceiling} to {current.get(name)} "
+            f"finding(s) — fix the code, don't regress the ratchet:\n"
+            + "\n".join(analyze.run_all()[name]))
+    # and every pass is in the snapshot, so a NEW pass can't dodge the gate
+    assert set(current) == set(snap), (set(current), set(snap))
+
+
+def test_every_allow_entry_carries_a_reason():
+    for p in analyze.PASSES:
+        for key, reason in p.ALLOW.items():
+            assert isinstance(reason, str) and reason.strip(), (
+                f"{p.NAME}: ALLOW entry {key!r} has no written reason")
+
+
+# ---------------------------------------------------------------------------
+# pass #0: deadlines (the shim keeps tests/test_check_deadlines.py green;
+# here only the package entry point is exercised)
+# ---------------------------------------------------------------------------
+
+
+def test_deadlines_flags_unbounded_loop(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def poll(x):
+            while True:
+                if x():
+                    return
+    """))
+    problems = deadlines.check_file(str(bad))
+    assert any("no deadline check" in p for p in problems)
+
+
+def test_deadlines_accepts_bounded_loop(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(textwrap.dedent("""
+        def poll(x, deadline):
+            while True:
+                if x():
+                    return
+                if now() >= deadline:
+                    raise TimeoutError
+    """))
+    assert deadlines.check_file(str(good)) == []
+
+
+# ---------------------------------------------------------------------------
+# pass #1: race discipline
+# ---------------------------------------------------------------------------
+
+_RACY = textwrap.dedent("""
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._t = threading.Thread(target=self._serve, daemon=True)
+            self._t.start()
+
+        def _serve(self):
+            self._count += 1                 # thread write, NO lock
+
+        def snapshot(self):
+            return self._count               # main-thread read, NO lock
+""")
+
+_DISCIPLINED = textwrap.dedent("""
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._t = threading.Thread(target=self._serve, daemon=True)
+            self._t.start()
+
+        def _serve(self):
+            with self._lock:
+                self._count += 1
+
+        def snapshot(self):
+            with self._lock:
+                return self._count
+""")
+
+
+def test_races_flags_unlocked_thread_state():
+    problems = races.check_source(_RACY, "racy.py")
+    # both the thread's write and the main-thread read are violations
+    assert len(problems) == 2, problems
+    assert all("_count" in p for p in problems)
+
+
+def test_races_accepts_locked_thread_state():
+    assert races.check_source(_DISCIPLINED, "ok.py") == []
+
+
+def test_races_follows_closure_targets_and_method_chains():
+    src = textwrap.dedent("""
+        import threading
+
+        class PG:
+            def start(self):
+                def run():
+                    self._apply()
+                self._t = threading.Thread(target=run, daemon=True)
+                self._t.start()
+
+            def _apply(self):
+                self._dead = [1]             # write via self-call chain
+
+            def poll(self):
+                return self._dead            # unlocked read
+    """)
+    problems = races.check_source(src, "chain.py")
+    assert any("_dead" in p and "poll" in p for p in problems), problems
+
+
+def test_races_exempts_writes_before_spawn_and_init():
+    src = textwrap.dedent("""
+        import threading
+
+        class PG:
+            def __init__(self):
+                self._state = 0              # construction: exempt
+
+            def start(self):
+                self._state = 1              # precedes the spawn: exempt
+                t = threading.Thread(target=self._tick, daemon=True)
+                t.start()
+
+            def _tick(self):
+                with self._lock:
+                    self._state = 2
+    """)
+    assert races.check_source(src, "pre.py") == []
+
+
+def test_races_flags_two_locks_guarding_one_attr():
+    src = textwrap.dedent("""
+        import threading
+
+        class S:
+            def go(self):
+                t = threading.Thread(target=self._w)
+                t.start()
+
+            def _w(self):
+                with self._a_lock:
+                    self._n = 1
+
+            def read(self):
+                with self._b_lock:
+                    return self._n
+    """)
+    problems = races.check_source(src, "twolocks.py")
+    assert any("different locks" in p for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
+# pass #2: vtable / fault parity
+# ---------------------------------------------------------------------------
+
+import ast  # noqa: E402
+
+_CANON = textwrap.dedent("""
+    class HostNet:
+        def isend(self, comm, mr, tag=0):
+            pass
+        def irecv(self, comm, nbytes, tag=0):
+            pass
+        def irecv_into(self, comm, buf, tag=0):
+            pass
+""")
+
+
+def test_vtable_flags_plane_missing_verb():
+    planes = _CANON + textwrap.dedent("""
+        class TcpNet(HostNet):
+            def irecv_into(self, comm, buf, tag=0):
+                pass
+        class BareNet:
+            def isend(self, comm, mr, tag=0):
+                pass
+    """)
+    classes = {n.name: n for n in ast.walk(ast.parse(planes))
+               if isinstance(n, ast.ClassDef)}
+    # inheritance carries the surface: TcpNet conforms
+    assert vtable.conformance_problems(classes, "HostNet", ["TcpNet"],
+                                       "fix.py") == []
+    problems = vtable.conformance_problems(classes, "HostNet", ["BareNet"],
+                                           "fix.py")
+    assert any("missing canonical verb 'irecv'" in p for p in problems)
+
+
+def test_vtable_flags_signature_drift():
+    planes = _CANON + textwrap.dedent("""
+        class DriftNet(HostNet):
+            def isend(self, comm, buffer, tag=0):
+                pass
+    """)
+    classes = {n.name: n for n in ast.walk(ast.parse(planes))
+               if isinstance(n, ast.ClassDef)}
+    problems = vtable.conformance_problems(classes, "HostNet", ["DriftNet"],
+                                           "fix.py")
+    assert any("isend" in p and "drifts" in p for p in problems), problems
+
+
+def test_vtable_flags_unwrapped_fault_verb():
+    wrapper = textwrap.dedent("""
+        class FaultNet:
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+            def isend(self, comm, mr, tag=0, **kw):
+                pass
+            def irecv(self, comm, *args, **kw):
+                pass
+    """)
+    canon_classes = {n.name: n for n in ast.walk(ast.parse(_CANON))
+                     if isinstance(n, ast.ClassDef)}
+    wrap_classes = {n.name: n for n in ast.walk(ast.parse(wrapper))
+                    if isinstance(n, ast.ClassDef)}
+    problems = vtable.wrapper_problems(canon_classes, "HostNet",
+                                       wrap_classes, "FaultNet", "fix.py")
+    assert any("irecv_into" in p and "BYPASSES fault injection" in p
+               for p in problems), problems
+    # the two wrapped verbs (wrapper *args/**kw idiom) are accepted
+    assert not any("'isend'" in p or "'irecv'" in p for p in problems)
+
+
+def test_vtable_binding_symmetry():
+    src = textwrap.dedent("""
+        class Base:
+            def post_send(self, data):
+                pass
+        class A(Base):
+            def rx_pending(self):
+                pass
+        class B(Base):
+            pass
+    """)
+    classes = {n.name: n for n in ast.walk(ast.parse(src))
+               if isinstance(n, ast.ClassDef)}
+    problems = vtable.binding_problems(classes, "A", "B", "fix.py")
+    assert any("missing 'rx_pending'" in p for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
+# pass #3: resource leaks
+# ---------------------------------------------------------------------------
+
+
+def test_leaks_flags_unreleased_acquisition():
+    src = textwrap.dedent("""
+        def wire(net, store):
+            handle, listener = net.listen()
+            peers = store.exchange(handle)
+            return peers
+    """)
+    problems = leaks.check_source(src, "leaky.py")
+    assert any("never released" in p for p in problems), problems
+
+
+def test_leaks_flags_risky_window_before_ownership():
+    src = textwrap.dedent("""
+        def dial(net, handle):
+            comm = net.connect(0, handle)
+            comm.qp.handshake()
+            net._comms.append(comm)
+            return comm
+    """)
+    # handshake() can raise between connect and the registry append
+    problems = leaks.check_source(src, "window.py")
+    assert any("can leak" in p for p in problems), problems
+
+
+def test_leaks_flags_bare_close_outside_cleanup_scope():
+    src = textwrap.dedent("""
+        def probe(net, handle):
+            conn = net.connect(0, handle)
+            conn.ping()
+            conn.close()
+    """)
+    problems = leaks.check_source(src, "bare.py")
+    assert any("bare conn.close()" in p for p in problems), problems
+
+
+def test_leaks_accepts_guarded_and_escaping_patterns():
+    src = textwrap.dedent("""
+        def a_guarded(net, handle):
+            conn = net.connect(0, handle)
+            try:
+                conn.ping()
+            finally:
+                conn.close()
+
+        class BNet:
+            def b_immediate_escape(self, handle):
+                comm = self.connect(0, handle)
+                self._comms.append(comm)
+                comm.qp.handshake()
+                return comm
+
+        def c_except_close(net, handle):
+            qp = net.connect(0, handle)
+            try:
+                qp.handshake()
+            except BaseException:
+                qp.close()
+                raise
+            net._comms.append(qp)
+
+        def d_with(net, handle):
+            with net.connect(0, handle) as conn:
+                conn.ping()
+
+        def e_transfer(net, handle):
+            comm = Comm(net.connect(0, handle))
+            return comm
+    """)
+    assert leaks.check_source(src, "clean.py") == []
+
+
+# ---------------------------------------------------------------------------
+# shared ALLOW hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_stale_allow_entries_are_findings(monkeypatch):
+    monkeypatch.setitem(races.ALLOW, "nothing.py::Gone.attr",
+                        "covered code was deleted")
+    problems = races.check_source(_DISCIPLINED, "nothing.py")
+    assert any("stale" in p for p in problems), problems
+
+
+def test_reasonless_allow_entries_are_findings():
+    assert base.allow_reason_problems({"x.py::A.b": "  "}, "races")
+
+
+def test_unknown_file_allow_entries_are_findings(monkeypatch):
+    """A typo'd (or deleted-file) ALLOW key matches no lint target and
+    would otherwise suppress nothing, silently, forever."""
+    for p in (races, leaks):
+        monkeypatch.setitem(p.ALLOW, "plugn.py::Typo.attr", "typo'd file")
+        problems = p.run()
+        assert any("unknown file" in x for x in problems), (p.NAME, problems)

@@ -1,0 +1,125 @@
+"""FaultNet verb parity, checked on the LIVE classes (the runtime twin of
+the AST conformance pass in tools/analyze/vtable.py): every public verb of
+the canonical shm-plane vtable must be defined DIRECTLY on FaultNet — a
+verb that falls through FaultNet.__getattr__ runs with zero fault
+injection, which is how the one-sided put path shipped uncovered in PR 2.
+A NEW verb added to HostQPNet fails here loudly until faults cover it."""
+
+import inspect
+
+from rocnrdma_tpu.transport import plugin
+from rocnrdma_tpu.transport.faults import FaultNet, FaultSchedule
+
+
+def _public_verbs(cls) -> dict:
+    """name -> function, public callables across the mro (vtable surface)."""
+    out = {}
+    for klass in reversed(cls.__mro__):
+        for name, val in vars(klass).items():
+            if name.startswith("_"):
+                continue
+            if callable(val) or isinstance(val, staticmethod):
+                out[name] = val
+    return out
+
+
+def _wrapped_verbs() -> set:
+    """What FaultNet defines ITSELF — __getattr__ delegation excluded by
+    construction (vars() sees only the class body)."""
+    return {n for n, v in vars(FaultNet).items()
+            if not n.startswith("_") and callable(v)}
+
+
+def test_faultnet_wraps_the_full_live_vtable():
+    canon = set(_public_verbs(plugin.HostQPNet))
+    missing = canon - _wrapped_verbs()
+    assert not missing, (
+        f"FaultNet does not wrap {sorted(missing)} — these verbs fall "
+        f"through __getattr__ to the inner net and run WITHOUT fault "
+        f"injection; wrap them (even as explicit passthroughs) before "
+        f"shipping")
+
+
+def test_tcp_plane_carries_the_full_live_vtable():
+    canon = _public_verbs(plugin.HostQPNet)
+    tcp = _public_verbs(plugin.TCPNet)
+    missing = set(canon) - set(tcp)
+    assert not missing, f"TCPNet is missing vtable verbs {sorted(missing)}"
+
+
+def test_wrapped_signatures_accept_canonical_calls():
+    """Every FaultNet verb must accept a call shaped like the canon's
+    signature: same required params (wrapper *args/**kw absorb the rest),
+    no canonical-optional promoted to required."""
+    canon = _public_verbs(plugin.HostQPNet)
+    for name in sorted(canon):
+        c = inspect.signature(inspect.unwrap(
+            canon[name].__func__ if isinstance(canon[name], staticmethod)
+            else canon[name]))
+        f = inspect.signature(vars(FaultNet)[name])
+        c_params = [p for p in c.parameters.values() if p.name != "self"]
+        f_params = [p for p in f.parameters.values() if p.name != "self"]
+        f_names = {p.name for p in f_params}
+        f_varargs = any(p.kind is p.VAR_POSITIONAL for p in f_params)
+        f_kwargs = any(p.kind is p.VAR_KEYWORD for p in f_params)
+        c_required = [p.name for p in c_params
+                      if p.default is p.empty
+                      and p.kind in (p.POSITIONAL_ONLY,
+                                     p.POSITIONAL_OR_KEYWORD)]
+        f_required = [p.name for p in f_params
+                      if p.default is p.empty
+                      and p.kind in (p.POSITIONAL_ONLY,
+                                     p.POSITIONAL_OR_KEYWORD)]
+        assert f_required == c_required[:len(f_required)], (
+            f"FaultNet.{name} required params {f_required} are not a "
+            f"prefix of the canonical {c_required}")
+        if len(f_required) < len(c_required):
+            assert f_varargs or f_kwargs, (
+                f"FaultNet.{name} drops canonical required params "
+                f"{c_required[len(f_required):]} without *args/**kw")
+        for p in c_params:
+            if p.default is p.empty or p.name in f_names:
+                continue
+            assert f_kwargs or f_varargs, (
+                f"FaultNet.{name} does not accept canonical optional "
+                f"param {p.name!r} (add it or **kw)")
+
+
+def test_one_sided_verbs_obey_the_fault_model():
+    """The PR 3 wrap is behavioral, not just structural: a partitioned
+    schedule blackholes iwrite (completes locally, lands nowhere) and
+    never completes iread; a dead schedule refuses both, named."""
+    class _StubNet:
+        def iwrite(self, comm, rkey, mr, **kw):
+            raise AssertionError("partitioned iwrite must not reach inner")
+
+        def iread(self, comm, rkey, nbytes, **kw):
+            raise AssertionError("partitioned iread must not reach inner")
+
+    net = FaultNet(_StubNet(), FaultSchedule(seed=7, rank=0,
+                                             partition_after_ops=0))
+    req = net.iwrite("comm", 1, memoryview(b"abcd"))
+    done, size = req.test()
+    assert done and size == 4          # local completion, no delivery
+    req = net.iread("comm", 1, 4)
+    assert req.test() == (False, 0)    # never completes: caller times out
+
+    dead = FaultNet(_StubNet(), FaultSchedule(seed=7, rank=0,
+                                              die_after_ops=0))
+    for verb in (lambda: dead.iwrite("c", 1, memoryview(b"x")),
+                 lambda: dead.iread("c", 1, 1)):
+        try:
+            verb()
+        except OSError as e:
+            assert "comm dead" in str(e)
+        else:
+            raise AssertionError("dead comm must refuse one-sided verbs")
+
+
+def test_one_sided_faults_are_recorded_for_replay():
+    sched = FaultSchedule(seed=3, rank=1, partition_after_ops=0)
+    net = FaultNet(object(), sched)
+    net.iwrite("c", 1, memoryview(b"zz"))
+    kinds = [k for _, k, _ in sched.log]
+    assert "partitioned" in kinds
+    assert sched.counters.counts["partitioned"] == 1

@@ -1,0 +1,130 @@
+"""Native sanitizer flavors (ROCNRDMA_SANITIZE=asan|ubsan): rebuild
+rqp.cpp/rtcp.cpp instrumented and re-run the native qp / rtcp /
+irecv_into test files under them, so the C++ rx/tx paths (the PR 2
+rewrites: scatter-gather tx, direct-land rx, zero-copy poll_cq) get
+memory-error coverage CI can run. Slow-marked: two full rebuilds plus an
+interpreter running under ASAN interception.
+
+ASAN runs with leak detection ON — the interpreter's own allocations are
+suppressed (native/lsan.supp), so a leak report means librqp.so leaked.
+Any sanitizer report fails the subprocess loudly (abort_on_error /
+halt_on_error), and the output is additionally grepped so a report that
+somehow left the exit code clean still fails the test."""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from rocnrdma_tpu import native
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not native.available(),
+                       reason="native rqp library not buildable"),
+]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the native-surface test files the flavors re-run (qp verbs, the rtcp
+# wire, and the zero-copy receive paths that drive both planes hard)
+NATIVE_TESTS = [
+    "tests/test_native_qp.py",
+    "tests/test_tcp_qp.py",
+    "tests/test_irecv_into.py",
+]
+
+_REPORT_MARKERS = (
+    "AddressSanitizer",         # ASAN error reports
+    "LeakSanitizer",            # LSAN leak reports
+    "runtime error:",           # UBSAN findings
+    "SUMMARY: ",                # any sanitizer summary line
+)
+
+
+def _toolchain_has(flavor: str) -> bool:
+    lib = {"asan": "libasan.so", "ubsan": "libubsan.so"}[flavor]
+    try:
+        out = subprocess.run(["g++", f"-print-file-name={lib}"],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    path = out.stdout.strip()
+    return os.path.sep in path and os.path.exists(path)
+
+
+@pytest.mark.parametrize("flavor", ["asan", "ubsan"])
+def test_native_tests_pass_sanitized(flavor):
+    if not _toolchain_has(flavor):
+        pytest.skip(f"g++ has no {flavor} runtime on this machine")
+    env = dict(os.environ)
+    env.pop("RQP_LIB_DIR", None)   # flavor dirs, not an explicit override
+    env.update(native.sanitizer_env(flavor))
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", *NATIVE_TESTS, "-q",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900)
+    text = out.stdout + out.stderr
+    assert out.returncode == 0, f"{flavor} run failed:\n{text[-8000:]}"
+    for marker in _REPORT_MARKERS:
+        assert marker not in text, (
+            f"{flavor} run produced a sanitizer report "
+            f"({marker!r}):\n{text[-8000:]}")
+    # a broken instrumented build makes native.available() False and every
+    # test SKIP — a green exit code proving nothing. Require the suite to
+    # have genuinely run (the three files hold 40+ tests; leave slack for
+    # a few environment-dependent skips, not for wholesale skipping).
+    m = re.search(r"(\d+) passed", text)
+    passed = int(m.group(1)) if m else 0
+    assert passed >= 30, (
+        f"{flavor} run passed only {passed} test(s) — the instrumented "
+        f"build likely failed and the suite skipped itself green:"
+        f"\n{text[-8000:]}")
+
+
+def test_leak_detection_is_not_vacuous(tmp_path):
+    """The ASAN gate's value rests on LSAN still seeing NATIVE leaks under
+    the interpreter suppressions (native/lsan.supp) — suppressions match
+    ANY frame of a leak stack, so if the unwinder ever symbolized a python
+    frame into a native allocation's stack, the gate would pass green on
+    leaking code. Prove the negative: a deliberately leaking .so driven
+    through ctypes MUST still be reported on this machine."""
+    if not _toolchain_has("asan"):
+        pytest.skip("g++ has no asan runtime on this machine")
+    src = tmp_path / "leaker.cpp"
+    src.write_text('#include <cstdlib>\nextern "C" void* probe_leak(int n)'
+                   "{ return malloc(n); }\n")
+    so = tmp_path / "leaker.so"
+    env = {k: v for k, v in os.environ.items() if k != "LD_PRELOAD"}
+    subprocess.run(["g++", "-O1", "-g", "-shared", "-fPIC",
+                    "-fsanitize=address", "-o", str(so), str(src)],
+                   check=True, capture_output=True, env=env, timeout=120)
+    drive = (f"import ctypes; lib = ctypes.CDLL({str(so)!r}); "
+             f"lib.probe_leak.restype = ctypes.c_void_p; lib.probe_leak(4096)")
+    env = dict(os.environ)
+    env.update(native.sanitizer_env("asan"))
+    # abort_on_error would SIGABRT before the leak summary prints; exit
+    # codes are enough here
+    env["ASAN_OPTIONS"] = "detect_leaks=1"
+    out = subprocess.run([sys.executable, "-c", drive], capture_output=True,
+                         text=True, env=env, timeout=120)
+    text = out.stdout + out.stderr
+    assert out.returncode != 0 and "4096 byte(s) leaked" in text, (
+        f"LSAN did not report a deliberate native leak — the suppressions "
+        f"in native/lsan.supp are over-matching on this machine and the "
+        f"leak gate is vacuous:\n{text[-4000:]}")
+
+
+def test_unknown_flavor_is_a_named_error():
+    env = dict(os.environ)
+    env.pop("RQP_LIB_DIR", None)
+    env["ROCNRDMA_SANITIZE"] = "msan"   # not a supported flavor
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from rocnrdma_tpu import native; native.build()"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert out.returncode != 0
+    assert "ROCNRDMA_SANITIZE" in out.stderr

@@ -1,0 +1,2 @@
+# tools — repo-local developer tooling (static analysis, lint shims).
+# A package so `python -m tools.analyze` works from the repo root.

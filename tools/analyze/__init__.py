@@ -1,0 +1,41 @@
+"""tools.analyze — the repo's static-analysis suite, gating tier-1.
+
+Four passes over the transport stack, one shared AST/allowlist core
+(``tools.analyze.base``); each pass enforces one machine-checkable
+invariant of the "named errors, never hangs, no silent corruption"
+contract:
+
+- ``deadlines`` (pass #0, grown from ``tools/check_deadlines.py``):
+  every blocking wait is bounded by a caller-visible deadline.
+- ``races``: attributes written by daemon threads are only touched under
+  their owning lock.
+- ``vtable``: every net plane exposes the canonical verb surface derived
+  from the shm plane, and FaultNet wraps ALL of it — a new verb cannot
+  ship without fault-injection coverage.
+- ``leaks``: acquired sockets/QPs/listeners are released on all paths.
+
+Run all passes with ``python -m tools.analyze`` (exit 0 = clean). Every
+pass carries an ``ALLOW`` dict — empty by policy; an entry needs a
+written reason and dies with the violation it excuses. Finding counts
+are ratcheted against ``results/analyze_pr3.json`` by
+``tests/test_analyze.py``: a PR may shrink them, never grow them.
+"""
+
+from __future__ import annotations
+
+from tools.analyze import deadlines, leaks, races, vtable
+
+PASSES = (deadlines, races, vtable, leaks)
+
+SNAPSHOT = "results/analyze_pr3.json"
+
+
+def run_all() -> dict:
+    """pass name -> list of problem strings."""
+    return {p.NAME: p.run() for p in PASSES}
+
+
+def counts(results: dict | None = None) -> dict:
+    """pass name -> finding count (the ratchet's unit)."""
+    results = run_all() if results is None else results
+    return {name: len(problems) for name, problems in results.items()}

@@ -1,0 +1,58 @@
+"""``python -m tools.analyze`` — run every static-analysis pass, one exit
+code (0 = the whole transport surface complies; 1 = findings, printed
+one per line).
+
+Options:
+  --json                 machine-readable {pass: [problems]} on stdout
+  --write-snapshot [P]   also write the ratchet snapshot (finding counts
+                         per pass) to P (default: results/analyze_pr3.json)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools import analyze
+from tools.analyze import base
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analyze",
+                                 description=__doc__)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--write-snapshot", nargs="?", const=analyze.SNAPSHOT,
+                    default=None, metavar="PATH")
+    args = ap.parse_args(argv)
+
+    results = analyze.run_all()
+    counts = analyze.counts(results)
+    total = sum(counts.values())
+
+    if args.as_json:
+        print(json.dumps({"counts": counts, "problems": results}, indent=2))
+    else:
+        for p in analyze.PASSES:
+            n = counts[p.NAME]
+            state = "clean" if n == 0 else f"{n} problem(s)"
+            print(f"[{p.NAME}] {p.DESCRIPTION}: {state}")
+            for line in results[p.NAME]:
+                print("  " + line)
+        print(f"tools.analyze: {len(analyze.PASSES)} passes, "
+              f"{total} problem(s) total")
+
+    if args.write_snapshot:
+        path = (args.write_snapshot if os.path.isabs(args.write_snapshot)
+                else os.path.join(base.REPO, args.write_snapshot))
+        with open(path, "w") as fp:
+            json.dump({"counts": counts, "total": total}, fp, indent=2)
+            fp.write("\n")
+        print(f"snapshot written to {path}")
+
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

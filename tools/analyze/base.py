@@ -1,0 +1,172 @@
+"""Shared AST / allowlist core of the analyzer suite (``tools.analyze``).
+
+Every pass in this package is the same machine: parse the target files,
+walk the AST for a mechanical invariant, and report each violation as a
+one-line problem string (``path:lineno: what``) unless an ``ALLOW`` entry
+— keyed per pass, always with a written reason — excuses it. This module
+owns the pieces the passes share so they cannot drift apart:
+
+- the repo root and the transport-stack target list (the same files
+  ``check_deadlines`` always linted: ``rocnrdma_tpu/transport/*.py`` plus
+  ``distributed.py``);
+- source loading / parsing (absolute or repo-relative paths — tests feed
+  tmp-dir fixture files through the same entry points);
+- a parent map and lexical helpers (enclosing ``with self._lock`` blocks,
+  function parameter shapes, qualname walking);
+- ALLOW-list hygiene: every entry must name a real target and carry a
+  non-empty reason, and stale entries are themselves findings — an
+  allowlist that outlives its violation is a lie about the codebase.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def transport_targets() -> list[str]:
+    """The transport-stack lint surface, repo-relative (distributed.py +
+    every transport module) — one definition for every file-scoped pass."""
+    return ["rocnrdma_tpu/distributed.py"] + sorted(
+        os.path.join("rocnrdma_tpu/transport", f)
+        for f in os.listdir(os.path.join(REPO, "rocnrdma_tpu/transport"))
+        if f.endswith(".py"))
+
+
+def read_source(path: str) -> str:
+    full = path if os.path.isabs(path) else os.path.join(REPO, path)
+    with open(full) as fp:
+        return fp.read()
+
+
+def parse_file(path: str) -> ast.Module:
+    return ast.parse(read_source(path), filename=path)
+
+
+def parent_map(tree: ast.AST) -> dict:
+    """child node -> parent node, for lexical (enclosing-scope) queries."""
+    parents: dict = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(node: ast.AST, parents: dict):
+    while node in parents:
+        node = parents[node]
+        yield node
+
+
+def call_name(call: ast.Call) -> str | None:
+    """The rightmost identifier of a call's callee (``net.listen`` ->
+    ``listen``; ``Thread`` -> ``Thread``), or None for computed callees."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def is_self_attr(node: ast.AST, attr: str | None = None) -> bool:
+    """True for ``self.X`` (any X, or the named ``attr``)."""
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+def lock_name_of(expr: ast.AST) -> str | None:
+    """The lock identifier if ``expr`` looks like a lock (``self._lock``,
+    ``some_lock`` — any name containing "lock"), else None."""
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        return expr.attr
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return expr.id
+    return None
+
+
+def under_lock(node: ast.AST, parents: dict) -> str | None:
+    """The name of the lock whose ``with`` block lexically encloses
+    ``node`` (``with self._lock: ...``), or None. Stops at the enclosing
+    function boundary — a lock held by a caller is invisible to this
+    lexical check, which is the discipline the race pass enforces."""
+    for anc in ancestors(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                name = lock_name_of(item.context_expr)
+                if name is not None:
+                    return name
+    return None
+
+
+def func_params(fn) -> set:
+    a = fn.args
+    return {p.arg for p in
+            a.posonlyargs + a.args + a.kwonlyargs
+            + ([a.vararg] if a.vararg else [])
+            + ([a.kwarg] if a.kwarg else [])}
+
+
+def signature_shape(fn) -> tuple:
+    """``(required, optional, has_varargs, has_kwargs)`` — required is the
+    ordered no-default positional names (self/cls dropped), optional the
+    defaulted positionals plus keyword-onlys."""
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    if pos and pos[0] in ("self", "cls"):
+        pos = pos[1:]
+    n_def = len(a.defaults)
+    required = pos[:len(pos) - n_def] if n_def else pos
+    optional = (pos[len(pos) - n_def:] if n_def else []) \
+        + [k.arg for k in a.kwonlyargs]
+    return required, optional, a.vararg is not None, a.kwarg is not None
+
+
+def iter_functions(tree: ast.Module):
+    """Yield ``(qualname, node, owner_class)`` for every def in the module.
+    ``owner_class`` is the nearest enclosing ClassDef name (a closure nested
+    in a method belongs to that method's class), or None at module level."""
+    out = []
+
+    def visit(node, qual, owner):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, qual + [child.name], child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((".".join(qual + [child.name]), child, owner))
+                visit(child, qual + [child.name], owner)
+    visit(tree, [], None)
+    return out
+
+
+def allow_reason_problems(allow: dict, pass_name: str) -> list[str]:
+    """Every ALLOW entry must carry a written reason — an empty reason is
+    an unexplained suppression, which defeats the point of the list."""
+    return [f"{pass_name}: ALLOW entry {key!r} has no written reason"
+            for key, reason in allow.items()
+            if not (isinstance(reason, str) and reason.strip())]
+
+
+def allow_unknown_file_problems(allow: dict, targets: list,
+                                pass_name: str) -> list[str]:
+    """ALLOW entries whose ``file.py::`` prefix names no lint target can
+    suppress nothing — a typo'd or deleted-file entry must be a finding,
+    or it outlives the code forever."""
+    names = {os.path.basename(t) for t in targets}
+    return [f"{pass_name}: ALLOW entry {key!r} names an unknown file "
+            f"(know {sorted(names)})"
+            for key in allow if key.partition("::")[0] not in names]
+
+
+def allow_stale_problems(allow: dict, used_keys: set, pass_name: str) -> list[str]:
+    """ALLOW entries that excused nothing this run are stale — the code
+    they covered was fixed (or renamed), so the entry must go."""
+    return [f"{pass_name}: ALLOW entry {key!r} matched no finding "
+            f"(stale — remove it)"
+            for key in allow if key not in used_keys]

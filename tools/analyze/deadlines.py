@@ -1,0 +1,209 @@
+"""Pass #0: the no-hangs static lint (grown from ``tools/check_deadlines``).
+
+The transport stack's failure contract is "named errors, never hangs":
+every blocking wait must be bounded by a caller-visible deadline. This
+pass enforces the mechanical halves of that contract over
+``rocnrdma_tpu/transport/*.py`` and ``rocnrdma_tpu/distributed.py``:
+
+RULE 1 (bounded loops): every ``while True:`` loop must contain a
+deadline check — a reference to an identifier mentioning ``deadline``,
+or a ``raise TimeoutError`` — somewhere in its body. A poll loop with
+neither can spin forever when its condition never comes true.
+
+RULE 2 (deadline-accepting surface): every PUBLIC function or method
+(module-level, or on a public class; name not underscore-prefixed) that
+contains a ``while`` loop must accept a deadline-shaped parameter
+(``timeout_s`` / ``grace_s`` / ``deadline``) so callers can bound it.
+
+RULE 3 (blocking verb surface): the named public blocking APIs — the
+``ring_*_over_net`` / ``ring_*_rdma`` collectives in ``plugin.py`` and
+the ``ProcessGroup`` verbs in ``distributed.py`` — must accept
+``timeout_s`` whether or not the loop is syntactically visible in them
+(most delegate the spin to a helper).
+
+Exceptions live in ``ALLOW`` with a reason; the tier-1 suite runs this
+pass as a test (``tests/test_check_deadlines.py`` via the
+``tools/check_deadlines.py`` shim, and ``tests/test_analyze.py`` with the
+rest of the suite), so a new unbounded poll loop fails CI before it can
+hang a job.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+from tools.analyze import base
+
+NAME = "deadlines"
+DESCRIPTION = "every blocking wait is bounded by a caller-visible deadline"
+
+REPO = base.REPO
+
+TARGETS = base.transport_targets()
+
+DEADLINE_PARAMS = ("timeout_s", "grace_s", "deadline")
+
+# "file.py::qualname" -> reason. Keep this SHORT; every entry is a wait
+# some caller cannot bound. Currently empty — the whole surface complies.
+ALLOW: dict[str, str] = {}
+
+# RULE 3's named surface
+RING_VERB_RE = re.compile(r"^ring_\w+_(over_net|rdma)$")
+PG_BLOCKING = {
+    "all_reduce", "reduce_scatter", "all_gather", "broadcast", "all_to_all",
+    "all_to_all_v", "all_gather_v", "reduce_scatter_v", "reduce", "gather",
+    "scatter", "send", "recv", "isend", "irecv", "batch_isend_irecv",
+    "barrier", "monitored_barrier", "split", "shrink",
+}
+
+
+def _params(fn: ast.FunctionDef) -> set:
+    return base.func_params(fn)
+
+
+def _mentions_deadline(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "deadline" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "deadline" in sub.attr.lower():
+            return True
+        if isinstance(sub, ast.Raise):
+            exc = sub.exc
+            call = exc.func if isinstance(exc, ast.Call) else exc
+            if isinstance(call, ast.Name) and call.id == "TimeoutError":
+                return True
+            if isinstance(call, ast.Attribute) and call.attr == "TimeoutError":
+                return True
+    return False
+
+
+def _is_while_true(node: ast.While) -> bool:
+    return isinstance(node.test, ast.Constant) and node.test.value is True
+
+
+def check_file(path: str) -> list[str]:
+    src = base.read_source(path)
+    tree = ast.parse(src, filename=path)
+    base_name = os.path.basename(path)
+    problems = []
+
+    # every while-True seen inside some def, so the module-level sweep at
+    # the end can flag the ones enclosed in no function at all
+    in_function_loops: set[int] = set()
+
+    # qualname bookkeeping: (class, function) nesting
+    def visit(node, qual, in_public_scope, cls_public):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, qual + [child.name], in_public_scope,
+                      not child.name.startswith("_"))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = ".".join(qual + [child.name])
+                key = f"{base_name}::{qn}"
+                public = (not child.name.startswith("_")
+                          and in_public_scope and cls_public)
+                # RULE 1: while True needs a deadline check, public or not
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.While) and _is_while_true(sub):
+                        in_function_loops.add(id(sub))
+                        if not _mentions_deadline(sub):
+                            problems.append(
+                                f"{path}:{sub.lineno}: while-True loop in "
+                                f"{qn} has no deadline check "
+                                f"(no 'deadline' reference, no raise "
+                                f"TimeoutError)")
+                # RULE 2: public def with a while loop takes a deadline
+                has_while = any(isinstance(sub, ast.While)
+                                for sub in ast.walk(child))
+                if public and has_while and key not in ALLOW \
+                        and not (_params(child) & set(DEADLINE_PARAMS)):
+                    problems.append(
+                        f"{path}:{child.lineno}: public blocking "
+                        f"{qn} accepts none of {DEADLINE_PARAMS} "
+                        f"(add one, or ALLOW it with a reason)")
+                # RULE 3: the named blocking surface always takes timeout_s
+                named = ((base_name == "plugin.py"
+                          and RING_VERB_RE.match(child.name))
+                         or (base_name == "distributed.py"
+                             and qual == ["ProcessGroup"]
+                             and child.name in PG_BLOCKING))
+                if named and key not in ALLOW \
+                        and "timeout_s" not in _params(child):
+                    problems.append(
+                        f"{path}:{child.lineno}: blocking verb {qn} "
+                        f"must accept timeout_s")
+                # nested defs: only RULE 1 applies inside (handled above by
+                # ast.walk over the whole function body), so don't recurse
+            # other statements carry no defs we need beyond ast.walk above
+    visit(tree, [], True, True)
+
+    # module-level while True (rare, but rule 1 is universal): any
+    # while-True the function pass did NOT see lives outside every def
+    for node in ast.walk(tree):
+        if isinstance(node, ast.While) and _is_while_true(node) \
+                and id(node) not in in_function_loops \
+                and not _mentions_deadline(node):
+            problems.append(
+                f"{path}:{node.lineno}: module-level while-True loop has "
+                f"no deadline check")
+    return problems
+
+
+SELFTEST_BAD = """
+def spin_forever(x):
+    while True:
+        if x():
+            return 1
+
+class Thing:
+    def wait(self):
+        while not self.done:
+            pass
+"""
+
+
+def selftest() -> int:
+    tree = ast.parse(SELFTEST_BAD)
+    fn = tree.body[0]
+    bad_loop = fn.body[0]
+    assert isinstance(bad_loop, ast.While) and _is_while_true(bad_loop)
+    assert not _mentions_deadline(bad_loop), "selftest: bad loop not flagged"
+    meth = tree.body[1].body[0]
+    assert not (_params(meth) & set(DEADLINE_PARAMS)), \
+        "selftest: deadline-less method not flagged"
+    print("selftest ok: unbounded loop and deadline-less public method "
+          "are both detectable")
+    return 0
+
+
+def run() -> list[str]:
+    problems = []
+    for path in TARGETS:
+        problems += check_file(path)
+    for key in ALLOW:
+        f, _, qn = key.partition("::")
+        if not any(f == os.path.basename(t) for t in TARGETS):
+            problems.append(f"ALLOW entry {key!r} names an unknown file")
+    problems += base.allow_reason_problems(ALLOW, NAME)
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--selftest":
+        return selftest()
+    problems = run()
+    if problems:
+        print(f"check_deadlines: {len(problems)} problem(s)")
+        for p in problems:
+            print("  " + p)
+        return 1
+    print(f"check_deadlines: {len(TARGETS)} files clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

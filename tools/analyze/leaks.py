@@ -1,0 +1,282 @@
+"""Pass #3: resource-leak lint — every acquired endpoint is released on
+every path.
+
+The transport stack acquires real kernel state: shm queue pairs, TCP
+sockets, listeners, bootstrap store connections. The teardown discipline
+the code review keeps re-deriving is mechanical: a locally-acquired
+resource must either ESCAPE to an owner that manages its lifetime (the
+net's comm registry, an attribute, the caller via return, a wrapping
+object) before anything can raise, or be guarded by a cleanup scope
+(``with``, ``finally``, an ``except`` that closes and re-raises). A bare
+``close()`` in straight-line code is not a release strategy — the
+exception path skips it, and the leaked fd/segment outlives the error.
+
+Mechanics (over ``rocnrdma_tpu/transport/*.py`` + ``distributed.py``):
+
+1. An ACQUISITION is an assignment whose value calls one of the known
+   acquirer verbs/constructors (``listen`` / ``connect`` / ``accept`` /
+   ``TcpListener`` / ``BootstrapServer`` / ``BootstrapClient``) binding a
+   local name. Attribute targets (``self._qp = ...``) are lifecycle-owned
+   by the object's own ``close()`` and out of scope here.
+2. A RELEASE/ESCAPE is the first of: a ``return`` carrying the local, a
+   store into ``self`` state (attribute, subscript, registry mutator), a
+   transfer into a constructor-shaped call (``_HostComm(qp)``,
+   ``Thread(args=(conn,))`` — CapWord callee), or a ``local.close()``.
+3. Between acquisition and that point, any call that can raise (not a
+   known-safe builtin/container op) makes the window leaky — unless the
+   function also closes the local in a ``finally``/``except`` block, or
+   the acquisition sits in a ``with`` item.
+4. No release point at all, and no cleanup-scope close → flagged.
+
+Exceptions live in ``ALLOW`` ("file.py::qualname.local" -> reason) —
+empty by policy: the deliverable of a finding is a ``finally``, not a
+list entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.analyze import base
+
+NAME = "leaks"
+DESCRIPTION = "acquired sockets/QPs/listeners are released on all paths"
+
+TARGETS = base.transport_targets()
+
+ALLOW: dict[str, str] = {}
+
+ACQUIRERS = {
+    "listen", "connect", "accept",
+    "TcpListener", "BootstrapServer", "BootstrapClient",
+}
+
+# container/introspection calls that cannot plausibly raise mid-window
+SAFE_CALLS = {
+    "append", "add", "extend", "update", "setdefault", "insert", "pop",
+    "discard", "clear", "get", "items", "keys", "values", "popleft",
+    "len", "min", "max", "abs", "int", "float", "str", "bytes", "bool",
+    "sorted", "list", "dict", "set", "tuple", "frozenset", "isinstance",
+    "hasattr", "getattr", "repr", "format", "print", "range", "enumerate",
+    "zip", "id", "next", "iter", "partition", "rsplit", "split", "join",
+    "encode", "decode", "startswith", "endswith", "to_bytes", "from_bytes",
+    "monotonic", "time",
+}
+
+
+def _is_capword_call(call: ast.Call) -> bool:
+    name = base.call_name(call)
+    if not name:
+        return False
+    stripped = name.lstrip("_")
+    return bool(stripped) and stripped[0].isupper()
+
+
+def _references(node: ast.AST, local: str) -> bool:
+    return any(isinstance(s, ast.Name) and s.id == local
+               for s in ast.walk(node))
+
+
+def _acquirer_call(value: ast.AST):
+    """The acquirer Call inside an assignment's value expr, or None.
+    Lambdas are descended into deliberately: ``x = retry(lambda:
+    net.connect(...))`` binds the connection to ``x`` just the same."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call) and base.call_name(sub) in ACQUIRERS:
+            return sub
+    return None
+
+
+def _own_body_nodes(fn):
+    """Walk ``fn`` excluding nested function/lambda bodies (separate
+    scopes own their own locals)."""
+    nested = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and sub is not fn:
+            for inner in ast.walk(sub):
+                if inner is not sub:
+                    nested.add(id(inner))
+    for sub in ast.walk(fn):
+        if sub is fn or id(sub) in nested:
+            continue
+        yield sub
+
+
+def _close_calls(fn, local: str):
+    """Every release of ``local`` in ``fn``'s own body: ``local.close()``,
+    or ``local`` passed into a callee whose name mentions close
+    (``net.close_comm(c)``, ``_close_quietly(c)``)."""
+    for sub in _own_body_nodes(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = base.call_name(sub) or ""
+        if name == "close" and isinstance(sub.func, ast.Attribute) \
+                and isinstance(sub.func.value, ast.Name) \
+                and sub.func.value.id == local:
+            yield sub
+        elif "close" in name and any(
+                isinstance(a, ast.Name) and a.id == local for a in sub.args):
+            yield sub
+
+
+def _in_cleanup_scope(node, parents, fn) -> bool:
+    """True when ``node`` sits in a ``finally`` or ``except`` body of a
+    ``try`` within ``fn``."""
+    child = node
+    for anc in base.ancestors(node, parents):
+        if anc is fn:
+            return False
+        if isinstance(anc, ast.ExceptHandler):
+            return True
+        if isinstance(anc, ast.Try) and child in getattr(anc, "finalbody", []):
+            return True
+        # remember the direct child while walking up, so the Try check
+        # above can tell finalbody membership from plain try-body
+        child = anc
+    return False
+
+
+def _escape_node(fn, local: str, after_line: int):
+    """The earliest release/escape of ``local`` at or after
+    ``after_line``: return, self-store, CapWord-ctor transfer, or a
+    ``local.close()``. -> (node, kind) or (None, None)."""
+    best = None
+    kind = None
+
+    def consider(node, k):
+        nonlocal best, kind
+        if node.lineno < after_line:
+            return
+        if best is None or node.lineno < best.lineno:
+            best, kind = node, k
+
+    for sub in _own_body_nodes(fn):
+        if isinstance(sub, ast.Return) and sub.value is not None \
+                and _references(sub.value, local):
+            consider(sub, "return")
+        elif isinstance(sub, ast.Assign) and _references(sub.value, local):
+            for t in sub.targets:
+                if base.is_self_attr(t) or (
+                        isinstance(t, ast.Subscript)
+                        and base.is_self_attr(t.value)):
+                    consider(sub, "self-store")
+        elif isinstance(sub, ast.Call):
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in ("append", "add", "setdefault") \
+                    and base.is_self_attr(sub.func.value) \
+                    and any(_references(a, local) for a in sub.args):
+                consider(sub, "registry")
+            elif _is_capword_call(sub) and (
+                    any(_references(a, local) for a in sub.args)
+                    or any(_references(kw.value, local)
+                           for kw in sub.keywords)):
+                consider(sub, "transfer")
+    for c in _close_calls(fn, local):
+        consider(c, "close")
+    return best, kind
+
+
+def _risky_between(fn, lo: int, hi: int, acquire_node, escape_node):
+    """Calls between lines (lo, hi) exclusive that can raise."""
+    skip = {id(s) for s in ast.walk(acquire_node)}
+    if escape_node is not None:
+        skip |= {id(s) for s in ast.walk(escape_node)}
+    risky = []
+    for sub in _own_body_nodes(fn):
+        if not isinstance(sub, ast.Call) or id(sub) in skip:
+            continue
+        if not (lo < sub.lineno < hi):
+            continue
+        name = base.call_name(sub)
+        if name in SAFE_CALLS:
+            continue
+        risky.append(sub)
+    return risky
+
+
+def check_source(src: str, path: str = "<fixture>") -> list[str]:
+    tree = ast.parse(src, filename=path)
+    parents = base.parent_map(tree)
+    base_name = os.path.basename(path)
+    problems = []
+    used_allow: set = set()
+    for qual, fn, owner in base.iter_functions(tree):
+        for sub in _own_body_nodes(fn):
+            if not isinstance(sub, ast.Assign):
+                continue
+            call = _acquirer_call(sub.value)
+            if call is None:
+                continue
+            # inside a with item? the with owns the lifetime
+            if any(isinstance(a, ast.withitem)
+                   for a in base.ancestors(sub, parents)):
+                continue
+            locals_bound = []
+            for t in sub.targets:
+                for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                    if isinstance(el, ast.Name):
+                        locals_bound.append(el.id)
+            if not locals_bound:
+                continue  # attribute target: object-lifecycle-owned
+            verb = base.call_name(call)
+            # cleanup-scope close of ANY bound local guards the whole
+            # acquisition (tuple targets: we cannot tell which element is
+            # the resource, so any handled element clears the statement)
+            guarded = any(
+                _in_cleanup_scope(c, parents, fn)
+                for local in locals_bound for c in _close_calls(fn, local))
+            escapes = [(local,) + _escape_node(fn, local, sub.lineno)
+                       for local in locals_bound]
+            escapes = [(l, n, k) for l, n, k in escapes if n is not None]
+            key = f"{base_name}::{qual}.{locals_bound[0]}"
+            if not escapes:
+                if guarded:
+                    continue
+                if key in ALLOW:
+                    used_allow.add(key)
+                    continue
+                problems.append(
+                    f"{path}:{sub.lineno}: {verb}() result "
+                    f"{'/'.join(locals_bound)} in {qual} is never "
+                    f"released or handed off — close it in a finally/with "
+                    f"or store it on an owner")
+                continue
+            local, enode, ekind = min(escapes, key=lambda e: e[1].lineno)
+            risky = _risky_between(fn, sub.lineno, enode.lineno, sub, enode)
+            if risky and not guarded:
+                if key in ALLOW:
+                    used_allow.add(key)
+                    continue
+                lines = ", ".join(str(r.lineno) for r in risky[:4])
+                if ekind == "close" \
+                        and not _in_cleanup_scope(enode, parents, fn):
+                    problems.append(
+                        f"{path}:{enode.lineno}: bare {local}.close() in "
+                        f"{qual} outside a cleanup scope — the call(s) at "
+                        f"line {lines} can raise first and leak it; use "
+                        f"finally/with")
+                else:
+                    problems.append(
+                        f"{path}:{sub.lineno}: {verb}() result {local} in "
+                        f"{qual} can leak — call(s) at line {lines} may "
+                        f"raise before it reaches its owner at line "
+                        f"{enode.lineno}; close it in a finally/except")
+    problems += base.allow_stale_problems(
+        {k: v for k, v in ALLOW.items() if k.startswith(base_name + "::")},
+        used_allow, NAME)
+    return problems
+
+
+def check_file(path: str) -> list[str]:
+    return check_source(base.read_source(path), path)
+
+
+def run() -> list[str]:
+    problems = []
+    for path in TARGETS:
+        problems += check_file(path)
+    problems += base.allow_reason_problems(ALLOW, NAME)
+    problems += base.allow_unknown_file_problems(ALLOW, TARGETS, NAME)
+    return problems

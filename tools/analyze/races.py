@@ -1,0 +1,241 @@
+"""Pass #1: race discipline — thread-shared attributes stay under their lock.
+
+The transport stack runs real daemon threads (the bootstrap server's
+acceptor and per-connection serve threads, the process-group watchdog),
+and its contract is "no silent corruption": any instance attribute a
+thread body WRITES is thread-shared state, and every access to it —
+reader or writer, on either side — must hold the owning ``self.*lock*``
+``with``-block. CPython's GIL makes single-bytecode races rare enough to
+survive soak tests and then corrupt state in production; this pass makes
+the discipline lexical, so it is checked on every PR instead of
+re-derived by reviewers.
+
+Mechanics (over ``rocnrdma_tpu/transport/*.py`` + ``distributed.py``):
+
+1. Find every thread entry function: ``threading.Thread(target=X)`` where
+   X is ``self._method`` or a local ``def`` (the watchdog's ``run``
+   closure), plus every ``self._method`` transitively called from one —
+   the acceptor/serve/handle chains.
+2. Collect the attributes those functions WRITE through ``self``:
+   plain/augmented assignment, subscript stores (``self._kv[k] = v``),
+   and mutator calls (``self._threads.append(t)``).
+3. Every access to such an attribute, anywhere in the owning class, must
+   be inside a ``with self.<lock>:`` block — and every access must use
+   the SAME lock (two locks "guarding" one attribute guard nothing).
+
+Lexical exemptions, because construction happens-before thread start:
+``__init__`` bodies, and writes that lexically precede the
+``threading.Thread(...)`` construction in the function that spawns it
+(the spawner resets state, then starts the thread). ``Thread.start()``
+is a synchronizing edge, so neither can race.
+
+Deliberately NOT flagged: attributes threads only READ (stop flags like
+``self._closed`` written by the main thread are one-way latches — the
+reader tolerates staleness by design), synchronization primitives
+themselves (names containing "lock"/"stop"/"event"), and ``next()`` on
+shared iterators (atomic under the GIL by implementation).
+
+Exceptions live in ``ALLOW`` ("file.py::Class.attr" -> reason) — empty
+by policy: the deliverable of a finding is a lock, not a list entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.analyze import base
+
+NAME = "races"
+DESCRIPTION = "thread-shared attributes are only touched under their lock"
+
+TARGETS = base.transport_targets()
+
+ALLOW: dict[str, str] = {}
+
+# attribute-mutating method names counted as writes of the receiver
+MUTATORS = {
+    "append", "add", "extend", "update", "setdefault", "insert",
+    "pop", "popitem", "remove", "discard", "clear",
+    "appendleft", "popleft",
+}
+
+# attributes that ARE synchronization (or one-way control) primitives:
+# flagging the lock itself, or an Event the thread waits on, would be
+# circular — these are the tools the discipline is built from
+_SYNC_HINTS = ("lock", "stop", "event", "cond", "sem")
+
+
+def _is_sync_attr(attr: str) -> bool:
+    low = attr.lower()
+    return any(h in low for h in _SYNC_HINTS)
+
+
+def _thread_target(call: ast.Call):
+    """The ``target=`` expr of a ``threading.Thread(...)`` call, or None."""
+    if base.call_name(call) != "Thread":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+def _owning_function(node, parents):
+    for anc in base.ancestors(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _written_attrs(fn) -> list:
+    """``(attr, node)`` for every ``self.X`` write in ``fn`` (including
+    nested defs — a closure writing through the captured self is the
+    watchdog pattern). Writes: assignment targets, augmented assigns,
+    subscript stores into ``self.X[...]``, and mutator calls."""
+    writes = []
+    for sub in ast.walk(fn):
+        targets = []
+        if isinstance(sub, ast.Assign):
+            targets = sub.targets
+        elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+            targets = [sub.target]
+        for t in targets:
+            for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                if base.is_self_attr(el):
+                    writes.append((el.attr, sub))
+                elif isinstance(el, ast.Subscript) \
+                        and base.is_self_attr(el.value):
+                    writes.append((el.value.attr, sub))
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in MUTATORS \
+                and base.is_self_attr(sub.func.value):
+            writes.append((sub.func.value.attr, sub))
+    return writes
+
+
+def check_source(src: str, path: str = "<fixture>") -> list[str]:
+    tree = ast.parse(src, filename=path)
+    parents = base.parent_map(tree)
+    base_name = os.path.basename(path)
+    functions = base.iter_functions(tree)
+    by_name = {}          # (owner_class, name) -> node
+    for qual, node, owner in functions:
+        by_name[(owner, node.name)] = node
+
+    # -- 1. thread entry functions ---------------------------------------
+    entries: list = []          # (fn_node, owner_class)
+    spawn_sites: dict = {}      # spawning fn node -> spawn lineno
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _thread_target(node)
+        if target is None:
+            continue
+        spawner = _owning_function(node, parents)
+        owner = None
+        for qual, fn, own in functions:
+            if fn is spawner:
+                owner = own
+                break
+        if spawner is not None:
+            line = spawn_sites.get(spawner)
+            spawn_sites[spawner] = min(node.lineno, line) \
+                if line is not None else node.lineno
+        if base.is_self_attr(target):
+            fn = by_name.get((owner, target.attr))
+            if fn is not None:
+                entries.append((fn, owner))
+        elif isinstance(target, ast.Name):
+            fn = by_name.get((owner, target.id))
+            if fn is not None:
+                entries.append((fn, owner))
+
+    # -- transitive closure over self-method calls -----------------------
+    reachable = []
+    seen = set()
+    work = list(entries)
+    while work:
+        fn, owner = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        reachable.append((fn, owner))
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and base.is_self_attr(sub.func):
+                callee = by_name.get((owner, sub.func.attr))
+                if callee is not None:
+                    work.append((callee, owner))
+
+    # -- 2. thread-written attributes per class --------------------------
+    shared: dict = {}   # (owner_class, attr) -> first write node
+    for fn, owner in reachable:
+        for attr, node in _written_attrs(fn):
+            if not _is_sync_attr(attr):
+                shared.setdefault((owner, attr), node)
+
+    # -- 3. every access to a shared attr is under ONE lock --------------
+    problems = []
+    used_allow: set = set()
+    reachable_ids = {id(fn) for fn, _ in reachable}
+    for (owner, attr), first in sorted(shared.items(),
+                                       key=lambda kv: kv[1].lineno):
+        key = f"{base_name}::{owner}.{attr}"
+        accesses = []   # (node, fn, lock_name|None)
+        for qual, fn, own in functions:
+            if own != owner:
+                continue
+            if fn.name == "__init__" and id(fn) not in reachable_ids:
+                continue  # construction happens-before thread start
+            nested = {id(s) for s in ast.walk(fn)
+                      if isinstance(s, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) and s is not fn}
+            for sub in ast.walk(fn):
+                if not base.is_self_attr(sub, attr):
+                    continue
+                anc_fn = _owning_function(sub, parents)
+                if anc_fn is not None and id(anc_fn) in nested \
+                        and anc_fn is not fn:
+                    continue  # reported once, from the nested def itself
+                spawn_line = spawn_sites.get(fn)
+                if spawn_line is not None and id(fn) not in reachable_ids \
+                        and sub.lineno < spawn_line:
+                    continue  # precedes Thread(...): happens-before start
+                accesses.append((sub, fn, base.under_lock(sub, parents)))
+        locks = {l for _, _, l in accesses if l is not None}
+        for sub, fn, lock in accesses:
+            if lock is None:
+                if key in ALLOW:
+                    used_allow.add(key)
+                    continue
+                where = ("the thread body" if id(fn) in reachable_ids
+                         else f"{fn.name}")
+                problems.append(
+                    f"{path}:{sub.lineno}: self.{attr} is written by a "
+                    f"thread (first write {path}:{first.lineno}) but "
+                    f"touched in {where} outside any 'with self.<lock>:' "
+                    f"block")
+        if len(locks) > 1 and key not in ALLOW:
+            problems.append(
+                f"{path}:{first.lineno}: self.{attr} is guarded by "
+                f"{len(locks)} different locks ({', '.join(sorted(locks))}) "
+                f"— pick one")
+    problems += base.allow_stale_problems(
+        {k: v for k, v in ALLOW.items() if k.startswith(base_name + "::")},
+        used_allow, NAME)
+    return problems
+
+
+def check_file(path: str) -> list[str]:
+    return check_source(base.read_source(path), path)
+
+
+def run() -> list[str]:
+    problems = []
+    for path in TARGETS:
+        problems += check_file(path)
+    problems += base.allow_reason_problems(ALLOW, NAME)
+    problems += base.allow_unknown_file_problems(ALLOW, TARGETS, NAME)
+    return problems

@@ -1,0 +1,242 @@
+"""Pass #2: vtable / fault-parity conformance — one verb surface, no bypass.
+
+The net-plugin vtable has one canonical shape, and PR 2 proved how it
+drifts: a new verb (``irecv_into``, ``post_send2``) lands on the shm
+plane, the TCP plane and the native bindings grow it too — but nothing
+forces the FaultNet wrapper to cover it, so the new verb silently
+bypasses fault injection and the chaos suite tests a wire nobody ships.
+This pass derives the canonical surface FROM the shm plane and asserts,
+structurally, that it cannot desynchronize again:
+
+1. **Plane conformance** (``plugin.py``): every public verb of
+   ``HostQPNet`` exists on ``TCPNet`` (through inheritance or override)
+   with a compatible signature — same required parameters (name and
+   order), every canonical optional parameter accepted. The device plane
+   (``DeviceMeshNet``) is deliberately out of scope: it shares the
+   vtable's *shape*, not interchangeability (``byte_oriented=False``),
+   and byte-oriented callers already gate on ``get_properties()``.
+2. **Fault parity** (``faults.py``): every canonical verb must be
+   defined DIRECTLY in ``FaultNet``'s class body. ``FaultNet.__getattr__``
+   delegates unknown names to the inner net — convenient for constants,
+   fatal for verbs: a delegated verb runs with zero fault coverage. An
+   explicit passthrough is fine (it documents the decision); a silent
+   fall-through is the bug class this pass exists to kill.
+3. **Binding parity** (``native/__init__.py``): the shm (``rqp``) and TCP
+   (``rtcp``) queue-pair bindings expose the SAME public instance-verb
+   surface, symmetrically — connected-QP verbs only (classmethod
+   constructors differ by design: the TCP plane splits the listener into
+   its own class).
+
+Signature compatibility: a plane's required params must equal the
+canon's (wrappers taking ``*args``/``**kw`` match any suffix), and every
+canonical optional param must be accepted by name or absorbed by
+``**kw`` — so a caller written against the canon runs on every plane.
+
+Exceptions live in ``ALLOW`` ("Class.verb" -> reason) — empty by policy.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import base
+
+NAME = "vtable"
+DESCRIPTION = "every net plane exposes the canonical verb surface; FaultNet wraps all of it"
+
+PLUGIN = "rocnrdma_tpu/transport/plugin.py"
+FAULTS = "rocnrdma_tpu/transport/faults.py"
+NATIVE = "rocnrdma_tpu/native/__init__.py"
+
+CANON = "HostQPNet"
+PLANES = ("TCPNet",)
+WRAPPER = "FaultNet"
+NATIVE_CANON = "QueuePair"
+NATIVE_PEER = "TcpQueuePair"
+
+ALLOW: dict[str, str] = {}
+
+
+def _classes(tree: ast.Module) -> dict:
+    return {n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)}
+
+
+def _decorated(fn, name: str) -> bool:
+    for d in fn.decorator_list:
+        if isinstance(d, ast.Name) and d.id == name:
+            return True
+        if isinstance(d, ast.Attribute) and d.attr == name:
+            return True
+    return False
+
+
+def resolved_methods(classes: dict, name: str) -> dict:
+    """name -> FunctionDef through same-module bases (derived wins)."""
+    cls = classes.get(name)
+    if cls is None:
+        return {}
+    methods: dict = {}
+    for b in cls.bases:
+        if isinstance(b, ast.Name) and b.id in classes:
+            methods.update(resolved_methods(classes, b.id))
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            methods[node.name] = node
+    return methods
+
+
+def own_methods(classes: dict, name: str) -> dict:
+    cls = classes.get(name)
+    if cls is None:
+        return {}
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def public_verbs(methods: dict, instance_only: bool = False) -> dict:
+    return {n: fn for n, fn in methods.items()
+            if not n.startswith("_")
+            and not (instance_only and (_decorated(fn, "classmethod")
+                                        or _decorated(fn, "staticmethod")))}
+
+
+def _sig_problem(canon_fn, plane_fn) -> str | None:
+    c_req, c_opt, _, _ = base.signature_shape(canon_fn)
+    p_req, p_opt, p_var, p_kw = base.signature_shape(plane_fn)
+    if p_var:
+        if p_req != c_req[:len(p_req)]:
+            return (f"required params {p_req} are not a prefix of the "
+                    f"canonical {c_req}")
+    elif p_req != c_req:
+        return f"required params {p_req} != canonical {c_req}"
+    if not p_kw:
+        missing = [o for o in c_opt if o not in p_opt and o not in p_req]
+        if missing:
+            return (f"canonical optional param(s) {missing} not accepted "
+                    f"(add them or **kw)")
+    promoted = [o for o in c_opt if o in p_req]
+    if promoted:
+        return (f"canonical optional param(s) {promoted} are required "
+                f"here — canon-shaped calls omitting them break")
+    return None
+
+
+def _allowed(key: str, used: set | None) -> bool:
+    if key in ALLOW:
+        if used is not None:
+            used.add(key)
+        return True
+    return False
+
+
+def conformance_problems(classes: dict, canon_name: str, plane_names,
+                         where: str, used: set | None = None) -> list[str]:
+    """Leg 1: each plane carries the canon's full public surface."""
+    problems = []
+    canon = public_verbs(resolved_methods(classes, canon_name))
+    if not canon:
+        return [f"{where}: canonical class {canon_name} not found or empty"]
+    for plane in plane_names:
+        methods = resolved_methods(classes, plane)
+        if not methods:
+            problems.append(f"{where}: plane class {plane} not found")
+            continue
+        for verb, canon_fn in sorted(canon.items()):
+            key = f"{plane}.{verb}"
+            if _allowed(key, used):
+                continue
+            fn = methods.get(verb)
+            if fn is None:
+                problems.append(
+                    f"{where}: plane {plane} is missing canonical verb "
+                    f"{verb!r} (defined by {canon_name}:{canon_fn.lineno})")
+                continue
+            why = _sig_problem(canon_fn, fn)
+            if why is not None:
+                problems.append(
+                    f"{where}:{fn.lineno}: {plane}.{verb} signature "
+                    f"drifts from the canon: {why}")
+    return problems
+
+
+def wrapper_problems(canon_classes: dict, canon_name: str,
+                     wrapper_classes: dict, wrapper_name: str,
+                     where: str, used: set | None = None) -> list[str]:
+    """Leg 2: the fault wrapper explicitly defines every canonical verb —
+    __getattr__ delegation would run it with zero fault coverage."""
+    problems = []
+    canon = public_verbs(resolved_methods(canon_classes, canon_name))
+    if not canon:
+        return [f"{where}: canonical class {canon_name} not found or empty"]
+    wrapped = own_methods(wrapper_classes, wrapper_name)
+    if not wrapped:
+        return [f"{where}: wrapper class {wrapper_name} not found"]
+    for verb, canon_fn in sorted(canon.items()):
+        key = f"{wrapper_name}.{verb}"
+        if _allowed(key, used):
+            continue
+        fn = wrapped.get(verb)
+        if fn is None:
+            problems.append(
+                f"{where}: {wrapper_name} does not wrap canonical verb "
+                f"{verb!r} — it falls through __getattr__ to the inner "
+                f"net and BYPASSES fault injection (wrap it, even as an "
+                f"explicit passthrough, or ALLOW it with a reason)")
+            continue
+        why = _sig_problem(canon_fn, fn)
+        if why is not None:
+            problems.append(
+                f"{where}:{fn.lineno}: {wrapper_name}.{verb} signature "
+                f"drifts from the canon: {why}")
+    return problems
+
+
+def binding_problems(classes: dict, canon_name: str, peer_name: str,
+                     where: str, used: set | None = None) -> list[str]:
+    """Leg 3: the two native QP bindings expose one instance-verb surface,
+    symmetrically (an rqp-only diagnostic is as much drift as a missing
+    data verb — callers feature-detect with getattr and silently no-op)."""
+    problems = []
+    a = public_verbs(resolved_methods(classes, canon_name), instance_only=True)
+    b = public_verbs(resolved_methods(classes, peer_name), instance_only=True)
+    if not a or not b:
+        return [f"{where}: binding class(es) {canon_name}/{peer_name} "
+                f"not found"]
+    for verb in sorted(set(a) | set(b)):
+        in_a, in_b = verb in a, verb in b
+        if in_a and in_b:
+            why = _sig_problem(a[verb], b[verb])
+            if why is not None and not _allowed(f"{peer_name}.{verb}",
+                                                used):
+                problems.append(
+                    f"{where}:{b[verb].lineno}: {peer_name}.{verb} "
+                    f"signature drifts from {canon_name}.{verb}: {why}")
+            continue
+        missing, present = ((peer_name, canon_name) if in_a
+                            else (canon_name, peer_name))
+        if _allowed(f"{missing}.{verb}", used):
+            continue
+        problems.append(
+            f"{where}: {missing} is missing {verb!r} (present on "
+            f"{present}) — the two QP bindings must expose one surface")
+    return problems
+
+
+def check_trees(plugin_tree, faults_tree, native_tree,
+                used: set | None = None) -> list[str]:
+    plug = _classes(plugin_tree)
+    problems = conformance_problems(plug, CANON, PLANES, PLUGIN, used)
+    problems += wrapper_problems(plug, CANON, _classes(faults_tree),
+                                 WRAPPER, FAULTS, used)
+    problems += binding_problems(_classes(native_tree), NATIVE_CANON,
+                                 NATIVE_PEER, NATIVE, used)
+    return problems
+
+
+def run() -> list[str]:
+    used: set = set()
+    problems = check_trees(base.parse_file(PLUGIN), base.parse_file(FAULTS),
+                           base.parse_file(NATIVE), used)
+    problems += base.allow_reason_problems(ALLOW, NAME)
+    problems += base.allow_stale_problems(ALLOW, used, NAME)
+    return problems
