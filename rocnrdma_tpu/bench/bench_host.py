@@ -126,7 +126,7 @@ def _issue(pg, collective: str, x, transport: str = "msg", counts=None):
 
 def worker(args) -> int:
     from rocnrdma_tpu import distributed as dist
-    from rocnrdma_tpu.metrics import WIRE
+    from rocnrdma_tpu.metrics import VERBS, WIRE
 
     pg = dist.init_process_group(plane=args.plane)
     rng = np.random.default_rng(pg.rank)
@@ -157,6 +157,7 @@ def worker(args) -> int:
             # (arena announces, pool priming), so the delta below is the
             # STEADY-state copy/stream/overlap telemetry of the timed loop
             wire_base = WIRE.snapshot()
+            verb_base = VERBS.snapshot()
             spans = []
             for _ in range(args.repeats):
                 pg.barrier()
@@ -165,9 +166,15 @@ def worker(args) -> int:
                     _issue(pg, collective, x, args.transport, counts)
                 spans.append((time.perf_counter() - t0) / args.iters)
             wire = WIRE.delta(wire_base)
-            streamed = wire["frames_streamed"]
-            wire["overlap_ratio"] = (round(wire["frames_overlapped"]
-                                           / streamed, 4) if streamed else 0.0)
+            # windowed, same as every other gated counter: the lifetime
+            # ratio would dilute the steady loop with the warmup's frames
+            wire["overlap_ratio"] = round(WIRE.overlap_ratio(since=wire_base),
+                                          4)
+            # the wire parameters the streaming engine negotiated for this
+            # collective (frame_bytes / pipeline_depth gauges): on the
+            # record so a GB/s regression is attributable to a frame-
+            # choice change, not just observable as a slowdown
+            wire.update(WIRE.negotiation())
             if args.smoke and wire["payload_bytes_copied"]:
                 # the zero-copy steady-path contract, enforced on EVERY
                 # rank (each process checks its own counters)
@@ -193,7 +200,7 @@ def worker(args) -> int:
                     "bench_host", collective, algo, pg.world_size, actual,
                     "float32", sec, platform=f"host-{args.plane}",
                     counts=ragged, iters=args.iters, repeats=args.repeats,
-                    wire=wire))
+                    wire=wire, verb_lat=VERBS.delta(verb_base)))
     pg.barrier()
     pg.destroy()
     if pg.rank == 0:

@@ -37,7 +37,8 @@ import time
 
 import numpy as np
 
-from rocnrdma_tpu.metrics import WIRE as _WIRE
+from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
+from rocnrdma_tpu.obs import postmortem as _postmortem
 from rocnrdma_tpu.transport import (
     HostQPNet,
     TCPNet,
@@ -129,6 +130,7 @@ class ProcessGroup:
         self._split_no = 0
         self._shrink_no = 0
         self._destroyed = False
+        self._postmortemed = False  # one watchdog flight dump per group
         self._store_handle = store_handle
 
     # -- collectives (numpy in, numpy out) ---------------------------------
@@ -400,7 +402,7 @@ class ProcessGroup:
                     continue
                 self._p2p_accepted.add(peer)
                 self._p2p[(peer, "rx")] = plugin._RingWire(
-                    self._net, comm, comm)
+                    self._net, comm, comm, peers=(peer, peer))
                 self._p2p_seq.setdefault(peer, {})
         # pump EVERY wired comm, both directions: rx pumps deliver inbound
         # frames; tx pumps drive queued user-space tx (an irecv wait issued
@@ -432,14 +434,16 @@ class ProcessGroup:
                 # sends pump the whole p2p plane (see _p2p_progress)
                 wire = plugin._RingWire(self._net, comm, comm,
                                         progress=self._p2p_progress,
-                                        timeout_s=timeout_s)
+                                        timeout_s=timeout_s,
+                                        peers=(peer, peer))
             else:
                 comm = self._net.accept(self._p2p_listen[peer], timeout_s)
                 self._p2p_accepted.add(peer)
                 # one comm plays both _RingWire roles: receives probe their
                 # own comm, the flush of an (empty) tx queue is harmless
                 wire = plugin._RingWire(self._net, comm, comm,
-                                        timeout_s=timeout_s)
+                                        timeout_s=timeout_s,
+                                        peers=(peer, peer))
             self._p2p[(peer, direction)] = wire
             self._p2p_seq.setdefault(peer, {})
         wire.timeout_s = timeout_s  # per-call deadline on a cached wire
@@ -650,6 +654,13 @@ class ProcessGroup:
                     silent = set()
                 dead = sorted(set(missing) & silent)
                 slow = sorted(set(missing) - silent)
+                # the hang postmortem: the barrier just triaged a dead-vs-
+                # slow rank, so dump this survivor's last wire events —
+                # the hop/frame/verb the time went to — next to the triage
+                _postmortem(
+                    f"monitored_barrier: rank(s) {missing} missing "
+                    f"(store-silent {dead}, store-live {slow}) on rank "
+                    f"{self.rank} of group {self.group_name!r}")
                 raise TimeoutError(
                     f"monitored_barrier: rank(s) {missing} missing after "
                     f"{timeout_s}s (group {self.group_name!r}, "
@@ -862,13 +873,20 @@ class ProcessGroup:
     def wire_stats(self) -> dict:
         """THIS RANK's zero-copy wire counters (``metrics.WIRE`` snapshot:
         payload_bytes_copied / frames_streamed / frames_copied /
-        frames_overlapped + the derived overlap_ratio). Host-plane ranks
-        are OS processes, so cross-rank aggregation happens at the
-        harness, like fault counters; the steady-state contract of the
-        streaming collectives is a zero ``payload_bytes_copied`` delta
-        across a measurement window (what ``bench_host --smoke`` gates)."""
+        frames_overlapped + the derived overlap_ratio), the wire's
+        last-negotiated parameters (``frame_bytes`` / ``pipeline_depth``
+        — what the streaming engine chose, so regressions are
+        attributable to the frame choice), and the per-verb latency
+        histograms (``verb_latency``: ``metrics.VERBS`` snapshot,
+        log-bucketed). Host-plane ranks are OS processes, so cross-rank
+        aggregation happens at the harness, like fault counters; the
+        steady-state contract of the streaming collectives is a zero
+        ``payload_bytes_copied`` delta across a measurement window (what
+        ``bench_host --smoke`` gates)."""
         s = _WIRE.snapshot()
         s["overlap_ratio"] = round(_WIRE.overlap_ratio(), 4)
+        s.update(_WIRE.negotiation())
+        s["verb_latency"] = _VERB_LAT.snapshot()
         return s
 
     def dead_ranks(self) -> list:
@@ -901,6 +919,18 @@ class ProcessGroup:
                 f"detection is OFF for group {self.group_name!r} — "
                 f"start_watchdog() again or destroy")
         if dead:
+            # the watchdog fired: dump this survivor's flight tail (what
+            # the wire was doing when the peer went silent) before the
+            # verb refuses — the other postmortem trigger point besides
+            # monitored_barrier's triage and the ring wire's own stalls.
+            # Once per group: every subsequent verb re-raises, and a
+            # caller retrying into a dead group must not flood stderr.
+            if not self._postmortemed:
+                self._postmortemed = True
+                _postmortem(
+                    f"watchdog: rank(s) {dead} stopped heartbeating; rank "
+                    f"{self.rank} of group {self.group_name!r} "
+                    f"refusing verbs")
             raise RuntimeError(
                 f"watchdog: rank(s) {dead} stopped heartbeating "
                 f"(group {self.group_name!r}); shrink() or destroy "
@@ -930,6 +960,12 @@ class ProcessGroup:
             return
         self._destroyed = True
         self.stop_watchdog()
+        # serialize this rank's flight buffer on exit when
+        # ROCNRDMA_FLIGHT_DUMP asks for it (best-effort, group-keyed so
+        # re-ranked split/shrink subgroups can't clobber each other; the
+        # on-demand half is obs.chrome.dump_rank itself)
+        from rocnrdma_tpu.obs import chrome
+        chrome.dump_if_env(self.rank, group=self.group_name)
         if self._client is not None:
             if graceful:
                 try:

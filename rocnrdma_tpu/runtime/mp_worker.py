@@ -118,6 +118,11 @@ def _chaos_main(args) -> int:
     finally:
         print(f"FAULTS {sched.counters.to_json()}", flush=True)
         print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        # chaos timeline dump (injections + absorptions + stalls) when
+        # ROCNRDMA_FLIGHT_DUMP asks, mergeable by obs.chrome like any
+        # other rank fleet's
+        from rocnrdma_tpu.obs import chrome
+        chrome.dump_if_env(rank)
         try:
             net.close()
         except (OSError, TimeoutError):

@@ -29,6 +29,8 @@ from __future__ import annotations
 import random
 import time
 
+from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
+
 
 class Backoff:
     """Yield-first poll backoff for doorbell/completion waits.
@@ -103,6 +105,12 @@ def retry_with_backoff(fn, timeout_s: float, what: str,
         try:
             return fn()
         except retry_on as e:
+            # failure-path only (the happy path records nothing): every
+            # absorbed refusal shows on the flight timeline next to the
+            # fault that caused it, so a chaos trace reads injection ->
+            # absorption instead of silence
+            _FLIGHT.record("retry", what=what, attempt=attempt,
+                           error=type(e).__name__)
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"{what}: still failing after {timeout_s}s "

@@ -39,6 +39,7 @@ import threading
 import time
 
 from rocnrdma_tpu import native
+from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
 from rocnrdma_tpu.transport.backoff import (
     poll_backoff,
     retry_with_backoff,
@@ -233,6 +234,11 @@ class BootstrapClient:
                 last = e
                 if back is None:
                     back = poll_backoff()
+                # a dropped/hung store connection entering the reconnect-
+                # replay path: on the flight timeline (failure path only —
+                # the lockstep happy path records nothing per RPC)
+                _FLIGHT.record("rpc-retry", op=req.get("op"),
+                               error=type(e).__name__)
                 if self._said_bye or time.monotonic() >= deadline:
                     raise TimeoutError(
                         f"bootstrap rpc {req.get('op')!r} failed "
@@ -390,6 +396,11 @@ def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
             retry_on=(ConnectionRefusedError, ConnectionResetError,
                       TimeoutError))
         client.barrier(f"{ns}/wired", n_ranks, remaining())
+        # the cross-rank clock-sync mark: every rank exits the wired
+        # barrier within one store poll interval, so the flight-trace
+        # merger (obs.chrome) aligns rank timelines on this event — the
+        # bootstrap handshake doubling as the clock handshake
+        _FLIGHT.mark_sync(ns=ns, rank=rank)
     except BaseException:
         # a failed wiring must not leak what it made: any half-wired comm,
         # the listener when nothing was ever accepted on it (on the shm

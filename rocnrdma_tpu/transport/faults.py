@@ -53,6 +53,7 @@ import json
 import random
 
 from rocnrdma_tpu.metrics import FaultCounters
+from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
 from rocnrdma_tpu.transport.plugin import Request
 
 
@@ -99,6 +100,14 @@ class FaultSchedule:
     def record(self, kind: str, detail=None) -> None:
         self.counters.count(kind)
         self.log.append((self.ops, kind, detail))
+        # every injection also lands on the flight-recorder timeline, so
+        # a chaos trace shows the fault NEXT TO its absorption (the retry/
+        # stall events the layers above record). The event args come from
+        # the schedule's own deterministic state (op counter + detail),
+        # never from timing — two replays of one seed record the same
+        # fault event sequence (what the replay-equality test asserts).
+        _FLIGHT.record("fault-" + kind, op=self.ops, rank=self.rank,
+                       detail=detail)
 
     def fingerprint(self) -> str:
         """Stable digest of the injection log — two runs of one seed over

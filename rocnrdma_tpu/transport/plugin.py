@@ -39,7 +39,8 @@ import uuid
 
 import numpy as np
 
-from rocnrdma_tpu.metrics import WIRE as _WIRE
+from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
+from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
 from rocnrdma_tpu.transport.backoff import Backoff
 
 
@@ -94,6 +95,45 @@ class Request:
 # for ~500 misses, then constant 0.2 ms; kept under the old name for the
 # many wait loops here (and any out-of-tree user of the private class)
 _Backoff = Backoff
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder verb instrumentation (rocnrdma_tpu.obs). Every public
+# blocking verb on the host-plane vtable records an entry event and a
+# completion event + latency observation — the coverage invariant the
+# tools/analyze 'obs' pass pins: a new blocking verb cannot ship
+# unobservable. The helpers keep the hot path to one record() call and
+# one perf_counter read per edge.
+# ---------------------------------------------------------------------------
+
+
+def _verb_entry(verb: str, **ctx) -> float:
+    """Record a blocking verb's entry (``<verb>-post``); returns the
+    entry timestamp the completion side measures latency from."""
+    _FLIGHT.record(verb + "-post", **ctx)
+    return time.perf_counter()
+
+
+def _verb_done(verb: str, t0: float, **ctx) -> None:
+    """Record a blocking verb's completion (``<verb>-done``, with the
+    post->done span as ``dur`` so trace viewers render a slice) and feed
+    the per-verb latency histogram."""
+    dt = time.perf_counter() - t0
+    _VERB_LAT.observe(verb, dt)
+    _FLIGHT.record(verb + "-done", dur=dt, **ctx)
+
+
+def _traced_request(verb: str, t0: float, req: Request, **ctx) -> Request:
+    """Wrap an async verb's Request so its FIRST completed probe records
+    the completion event/latency (the native planes' completion polls run
+    underneath ``req.test()`` — no extra polling is added)."""
+    def probe():
+        done, size = req.test()
+        if not done:
+            return False, 0, None
+        _verb_done(verb, t0, size=size, **ctx)
+        return True, size, req.payload
+    return Request(_test=probe)
 
 
 # ---------------------------------------------------------------------------
@@ -329,6 +369,7 @@ class HostQPNet:
     def connect(self, dev: int, handle: str, timeout_s: float = 10.0) -> _HostComm:
         from rocnrdma_tpu import native
         assert self._inited, "call init() first"
+        t0 = _verb_entry("connect", plane="shm")
         qp = native.QueuePair.connect(handle, timeout_s)
         try:
             qp.accept(timeout_s)
@@ -337,12 +378,15 @@ class HostQPNet:
             raise       # else would ever release its shm segment
         comm = _HostComm(qp, net=self)
         self._comms.append(comm)
+        _verb_done("connect", t0, plane="shm")
         return comm
 
     def accept(self, listener, timeout_s: float = 10.0) -> _HostComm:
+        t0 = _verb_entry("accept", plane="shm")
         listener.accept(timeout_s)
         comm = _HostComm(listener, net=self)
         self._comms.append(comm)
+        _verb_done("accept", t0, plane="shm")
         return comm
 
     def reg_mr(self, comm: _HostComm, buffer) -> memoryview:
@@ -371,8 +415,12 @@ class HostQPNet:
         ``irecv``, the same liveness requirement the frame path already
         has under backpressure.
         """
-        if len(mr) >= self.LG_MIN:
-            return self._lg_isend(comm, mr, tag, timeout_s, progress)
+        size = len(mr)
+        t0 = _verb_entry("isend", tag=tag, nbytes=size)
+        if size >= self.LG_MIN:
+            req = self._lg_isend(comm, mr, tag, timeout_s, progress)
+            _verb_done("isend", t0, tag=tag, nbytes=size)
+            return req
         # scatter-gather post: the native layer prepends the 4-byte tag
         # inside its one ring/queue memcpy, so the payload is borrowed
         # zero-copy instead of being serialized twice (bytes(mr) + concat)
@@ -382,7 +430,7 @@ class HostQPNet:
         # drain our own CQ so send completions don't pile up in the native
         # deque over a long-lived comm (poll is the only thing that frees them)
         comm._pump()
-        size = len(mr)
+        _verb_done("isend", t0, tag=tag, nbytes=size)
         return Request(_test=lambda: (True, size, None))
 
     def _lg_ensure(self, comm: _HostComm) -> None:
@@ -429,6 +477,7 @@ class HostQPNet:
         then flushed best-effort (NON-blocking: a nominally non-blocking
         Request.test() must not spin on a full send ring; a deferred ACK
         drains at the next probe/pump of this comm)."""
+        _FLIGHT.record("lg-credit-acked", nbytes=length)
         comm._lg_ack_queue.append(self._LG_ACK_TAG.to_bytes(4, "little")
                                   + length.to_bytes(8, "little"))
         self._lg_flush_acks(comm)
@@ -492,12 +541,17 @@ class HostQPNet:
         need = len(mr)
         # 2. bump-allocate a window; reset to 0 when everything prior is
         # ACKed; block on credit otherwise (single writer per direction)
+        stall_logged = False  # one event per stall episode, not per poll
         while True:
             self._lg_drain_acks(comm)
             if comm._lg_outstanding == 0:
                 comm._lg_head = 0
             if comm._lg_head + need <= arena:
                 break
+            if not stall_logged:
+                stall_logged = True
+                _FLIGHT.record("credit-stalled", tag=tag, need=need,
+                               outstanding=comm._lg_outstanding)
             comm._pump()
             if progress is not None:
                 progress()
@@ -530,6 +584,7 @@ class HostQPNet:
         lg = nbytes >= self.LG_MIN
         if lg:
             self._lg_ensure(comm)  # the LG rendezvous step 1
+        t0 = _verb_entry("irecv", tag=tag, nbytes=nbytes)
 
         def probe():
             if comm._lg_ack_queue:  # credit deferred by an earlier probe
@@ -556,7 +611,9 @@ class HostQPNet:
                     _WIRE.copied(length)  # arena staged out (irecv_into
                     #                       lands it in place instead)
                     self._lg_credit(comm, length)
+                    _verb_done("irecv", t0, tag=tag, nbytes=length)
                     return True, length, out
+                _verb_done("irecv", t0, tag=tag, nbytes=len(payload))
                 return True, len(payload), payload
             return False, 0, None
         return Request(_test=probe)
@@ -600,6 +657,8 @@ class HostQPNet:
         lg = nbytes >= self.LG_MIN
         if lg:
             self._lg_ensure(comm)  # the LG rendezvous step 1
+        t0 = _verb_entry("irecv_into", tag=tag, nbytes=nbytes)
+        frame_kind = "frame-landed" if combine is None else "frame-combined"
 
         def consume(src_u8, length: int) -> None:
             # land or fold `src_u8` (uint8 array view of the arrived bytes)
@@ -610,6 +669,12 @@ class HostQPNet:
                 d = dest[:length].view(dtype)
                 combine(d, src_u8.view(dtype), out=d)
             _WIRE.streamed()
+            # one irecv_into request is one wire frame, so this event IS
+            # the frame's landing slice (post->consume as dur): the trace
+            # lane the acceptance check counts against frames_streamed
+            _verb_done("irecv_into", t0, tag=tag, nbytes=length)
+            _FLIGHT.record(frame_kind, tag=tag, nbytes=length,
+                           dur=time.perf_counter() - t0)
 
         def probe():
             if comm._lg_ack_queue:  # credit deferred by an earlier probe
@@ -679,10 +744,13 @@ class HostQPNet:
         buffers borrow via from_buffer; the native planes copy
         synchronously during the post call)."""
         size = memoryview(mr).nbytes
+        t0 = _verb_entry("iwrite", nbytes=size, offset=offset)
         wr = self._post_backpressured(
             comm, lambda: comm.qp.post_rdma_write(rkey, mr, offset),
             "one-sided write", timeout_s, progress)
-        return Request(_test=lambda: self._onesided_probe(comm, wr, size, None))
+        return _traced_request(
+            "iwrite", t0,
+            Request(_test=lambda: self._onesided_probe(comm, wr, size, None)))
 
     def iread(self, comm: _HostComm, rkey: int, nbytes: int,
               offset: int = 0, timeout_s: float = 10.0,
@@ -690,11 +758,13 @@ class HostQPNet:
         """One-sided get from the peer MR; the completed Request's payload
         carries the fetched bytes."""
         into = bytearray(nbytes)
+        t0 = _verb_entry("iread", nbytes=nbytes, offset=offset)
         wr = self._post_backpressured(
             comm, lambda: comm.qp.post_rdma_read(rkey, into, offset),
             "one-sided read", timeout_s, progress)
-        return Request(
-            _test=lambda: self._onesided_probe(comm, wr, nbytes, into))
+        return _traced_request(
+            "iread", t0,
+            Request(_test=lambda: self._onesided_probe(comm, wr, nbytes, into)))
 
     def read_mr_local(self, comm: _HostComm, mr, offset: int,
                       nbytes: int) -> bytes:
@@ -768,13 +838,17 @@ class TCPNet(HostQPNet):
     def connect(self, dev: int, handle: str, timeout_s: float = 10.0) -> _HostComm:
         from rocnrdma_tpu import native
         assert self._inited, "call init() first"
+        t0 = _verb_entry("connect", plane="tcp")
         comm = _HostComm(native.TcpQueuePair.connect(handle, timeout_s), net=self)
         self._comms.append(comm)
+        _verb_done("connect", t0, plane="tcp")
         return comm
 
     def accept(self, listener, timeout_s: float = 10.0) -> _HostComm:
+        t0 = _verb_entry("accept", plane="tcp")
         comm = _HostComm(listener.accept(timeout_s), net=self)
         self._comms.append(comm)
+        _verb_done("accept", t0, plane="tcp")
         return comm
 
     def read_mr_local(self, comm: _HostComm, mr, offset: int,
@@ -922,12 +996,17 @@ class _RingWire:
     """
 
     def __init__(self, net, send_comm, recv_comm, progress=None,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, peers: tuple | None = None):
         self.net = net
         self.send_comm = send_comm
         self.recv_comm = recv_comm
         self.progress = progress
         self.timeout_s = timeout_s
+        # (send_peer_rank, recv_peer_rank) when the caller knows them (the
+        # ring collectives do; p2p wires name the one peer twice): what a
+        # stalled hop's postmortem NAMES, turning "net request timed out"
+        # into "recv hop 3 frame 2 peer rank 1"
+        self.peers = peers
         # LG-capable planes (the host QP nets) take ring hops in LG_CHUNK
         # units — isend auto-routes those over the put path, one native
         # bulk copy per hop (r4); everything else chunks at the frame
@@ -957,6 +1036,25 @@ class _RingWire:
                 f"{n_frames} frames in one message overflows the 16-bit "
                 f"frame-index tag field (> ~4 GB); chunk at the caller")
         return lambda fi: (hop << 16) | fi
+
+    def _stall(self, direction: str, hop: int, frame, exc) -> TimeoutError:
+        """A wire wait timed out: record the stall, dump the flight
+        postmortem, and return the enriched TimeoutError for the caller
+        to raise — the hang-triage half of the observability story. The
+        enriched message (and the postmortem header) name the hop, frame
+        index, and peer rank the time went to; the last-N event dump
+        shows what the wire was doing on the way in."""
+        peer = None
+        if self.peers is not None:
+            peer = self.peers[0 if direction in ("send", "flush") else 1]
+        peer_s = "?" if peer is None else peer
+        _FLIGHT.record("stall", dir=direction, hop=hop,
+                       frame="?" if frame is None else frame, peer=peer_s)
+        reason = (f"ring wire stalled: {direction} hop {hop} "
+                  f"frame {'?' if frame is None else frame} "
+                  f"peer rank {peer_s}")
+        _postmortem(reason)
+        return TimeoutError(f"{reason} ({exc})")
 
     def _aligned_frame(self, itemsize: int) -> int:
         """The streaming frame size: the wire frame rounded DOWN to a whole
@@ -1013,6 +1111,10 @@ class _RingWire:
         an explicit hop so tags agree per ring edge."""
         if hop is None:
             hop = next(self._hops)
+        # the non-streaming path frames at the wire default, depth 1 (no
+        # cross-hop pipeline): recorded so wire_stats()/bench records name
+        # the frame choice on this path too (gauge: last exchange wins)
+        _WIRE.negotiated(self.frame, 1)
         got = np.empty(in_nbytes, np.uint8)
         # queue all chunked irecvs — landing straight in ``got`` on
         # recv_into-capable nets — then the isends, then drain; the plugin
@@ -1023,7 +1125,10 @@ class _RingWire:
         # stall each other
         pump = (self.progress if self.progress is not None
                 else getattr(self.recv_comm, "_pump", None))
-        self.queue_send(out, hop, pump)
+        try:
+            self.queue_send(out, hop, pump)
+        except TimeoutError as e:
+            raise self._stall("send", hop, 0, e) from e
         # Wait for the inbound frames WHILE keeping our own outbound
         # flowing. A hop larger than the kernel socket buffers leaves the
         # tail of our frames in the user-space tx queue; the peer cannot
@@ -1031,8 +1136,12 @@ class _RingWire:
         # pumps the recv comm deadlocks symmetrically (observed at 16 MB
         # hops: both ranks time out with MBs stuck in their send queues).
         send_pump = getattr(self.send_comm, "_pump", None)
-        for off, nb, r in reqs:
-            payload = r.wait(timeout_s=self.timeout_s, progress=send_pump)
+        for fi, (off, nb, r) in enumerate(reqs):
+            try:
+                payload = r.wait(timeout_s=self.timeout_s,
+                                 progress=send_pump)
+            except TimeoutError as e:
+                raise self._stall("recv", hop, fi, e) from e
             if payload is not None:  # legacy plane: stage the copy out
                 got[off:off + nb] = np.frombuffer(payload, np.uint8)
                 _WIRE.copied(nb)
@@ -1040,8 +1149,11 @@ class _RingWire:
         # still hold queued tx that nothing would otherwise flush — the
         # peer would time out on frames we believe are sent. Flushing
         # cannot deadlock: the peer always drains its inbound socket.
-        _flush_tx(self.send_comm, self.timeout_s, extra_pump=pump,
-                  what="ring hop: peer stopped draining")
+        try:
+            _flush_tx(self.send_comm, self.timeout_s, extra_pump=pump,
+                      what="ring hop: peer stopped draining")
+        except TimeoutError as e:
+            raise self._stall("flush", hop, None, e) from e
         return got
 
     def stream(self, first_send: np.ndarray, hops: list, dtype,
@@ -1094,6 +1206,14 @@ class _RingWire:
         # per-frame Python and protocol work; tuner-driven sizing is an
         # open ROADMAP item)
         frame = self._aligned_frame(np.dtype(dtype).itemsize)
+        # the negotiated wire parameters, recorded where they are chosen
+        # (gauges on WIRE -> wire_stats()/bench records) so a throughput
+        # regression is attributable to the frame choice; depth 2 is the
+        # engine's cross-hop double buffer (hop k+1's receives live while
+        # hop k drains), 1 when there is only one hop to pipeline
+        depth = 2 if H > 1 else 1
+        _WIRE.negotiated(frame, depth)
+        _FLIGHT.record("stream-start", hops=H, frame=frame, depth=depth)
         hop_nos = [next(self._hops) for _ in range(H)]
         pending = collections.deque()  # posted recv Requests, arrival order
         send_pump = getattr(self.send_comm, "_pump", None)
@@ -1120,6 +1240,8 @@ class _RingWire:
                 r = self._recv_into(self.recv_comm, dest[off:off + nb],
                                     tag=tagf(fi), combine=combine,
                                     dtype=dtype)
+                _FLIGHT.record("frame-posted", hop=hop_nos[k], frame=fi,
+                               nbytes=nb)
                 reqs.append((off, nb, r))
                 pending.append(r)
             return reqs
@@ -1130,7 +1252,11 @@ class _RingWire:
             posted[1] = post_hop(1)  # double buffer: hop 1's receives are
             #                          live before hop 0 starts draining
         # hop 0's outbound is known up front: queue the whole burst
-        self.queue_send(first_send, hop_nos[0], consume_progress, frame=frame)
+        try:
+            self.queue_send(first_send, hop_nos[0], consume_progress,
+                            frame=frame)
+        except TimeoutError as e:
+            raise self._stall("send", hop_nos[0], 0, e) from e
         blocked = True  # nothing precedes frame 0: its arrival is not overlap
         for k in range(H):
             if k + 1 < H and posted[k + 1] is None:
@@ -1149,20 +1275,30 @@ class _RingWire:
                         _WIRE.overlapped()
                     blocked = False
                 else:
-                    r.wait(timeout_s=t, progress=consume_progress)
+                    try:
+                        r.wait(timeout_s=t, progress=consume_progress)
+                    except TimeoutError as e:
+                        raise self._stall("recv", hop_nos[k], fi, e) from e
                     blocked = True
                 if nxt_tag is not None:
                     # this frame of dest is final: it IS frame f of the
                     # next hop's outbound — queue it while our later
                     # frames are still in flight
                     seg = dest[off:off + nb]
-                    self.net.isend(self.send_comm,
-                                   self.net.reg_mr(self.send_comm, seg),
-                                   tag=nxt_tag(fi), timeout_s=t,
-                                   progress=consume_progress)
+                    try:
+                        self.net.isend(self.send_comm,
+                                       self.net.reg_mr(self.send_comm, seg),
+                                       tag=nxt_tag(fi), timeout_s=t,
+                                       progress=consume_progress)
+                    except TimeoutError as e:
+                        raise self._stall("send", hop_nos[k + 1], fi,
+                                          e) from e
             posted[k] = None
-        _flush_tx(self.send_comm, t, extra_pump=consume_progress,
-                  what="ring stream: peer stopped draining")
+        try:
+            _flush_tx(self.send_comm, t, extra_pump=consume_progress,
+                      what="ring stream: peer stopped draining")
+        except TimeoutError as e:
+            raise self._stall("flush", hop_nos[-1], None, e) from e
 
 
 def _as_bytes(a: np.ndarray) -> np.ndarray:
@@ -1195,7 +1331,8 @@ def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return x.reshape(np.shape(local))
     combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
     # ONE pipelined 2(n-1)-hop stream: the n-1 reduce-scatter hops (fold
@@ -1241,7 +1378,8 @@ def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
     if n == 1:
         return x
     combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
     _stream_reduce_scatter(wire, chunk, rank, n, x.dtype, combine)
@@ -1531,7 +1669,8 @@ def ring_allgather_over_net(net, send_comm, recv_comm, local: np.ndarray,
     out[rank] = block
     if n == 1:
         return out
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     # pipelined: hop k lands origin (rank-k-1)'s block STRAIGHT into its
     # output row, and that row is hop k+1's outbound — frame f forwards
     # the moment it arrives, no per-hop staging buffer
@@ -1550,7 +1689,8 @@ def ring_broadcast_over_net(net, send_comm, recv_comm, local: np.ndarray,
     _check_root(root, n)
     if n == 1:
         return np.array(local, copy=True)
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     # non-root contents are irrelevant: only shape/dtype matter, so skip the
     # payload-sized copy and zero-fill there; root sends from a byte view
     flat = (_as_bytes(local) if rank == root
@@ -1604,7 +1744,8 @@ def ring_reduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
         return np.array(local, copy=True)
     combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     acc = np.array(local, copy=True).ravel()
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     d = (root - rank) % n  # my hop distance to the root (0 = root)
     n_chunks = _pipeline_chunks(acc.nbytes, wire.frame, n)
     bounds = [acc.size * i // n_chunks for i in range(n_chunks + 1)]
@@ -1714,7 +1855,8 @@ def ring_alltoallv_over_net(net, send_comm, recv_comm, segments: list,
     out[rank] = segs[rank].copy()
     if n == 1:
         return out
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     isz = dtype.itemsize
     train = np.concatenate(
         [_as_bytes(segs[(rank + off) % n]) for off in range(1, n)])
@@ -1755,7 +1897,8 @@ def ring_allgatherv_over_net(net, send_comm, recv_comm, local: np.ndarray,
     out[rank] = seg.copy()
     if n == 1:
         return out
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     # pipelined ragged train: each hop lands origin (rank-s)'s segment
     # straight into its (pre-allocated, exactly-sized) output slot, and
     # that slot is the next hop's outbound — no staging, no .copy()
@@ -1794,7 +1937,8 @@ def ring_reduce_scatter_v_over_net(net, send_comm, recv_comm,
     bounds = np.concatenate([[0], np.cumsum(counts)])
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
     combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     # same -1-shifted streaming reduce chain as the dense verb, with the
     # chunk bounds taken from ``counts`` instead of floor-balanced
     _stream_reduce_scatter(wire, chunk, rank, n, x.dtype, combine)
@@ -1815,7 +1959,8 @@ def ring_alltoall_over_net(net, send_comm, recv_comm, local: np.ndarray,
     out[rank] = blocks[rank]
     if n == 1:
         return out
-    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s)
+    wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
+                     peers=((rank + 1) % n, (rank - 1) % n))
     bnb = blocks[0].nbytes
     # my outbound train: blocks for rank+1, rank+2, ... rank+n-1 (travel order)
     train = np.concatenate(

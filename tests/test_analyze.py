@@ -14,7 +14,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tools import analyze  # noqa: E402
-from tools.analyze import base, deadlines, leaks, races, vtable  # noqa: E402
+from tools.analyze import base, deadlines, leaks, obs, races, vtable  # noqa: E402
 
 sys.path.pop(0)
 
@@ -288,6 +288,107 @@ def test_vtable_binding_symmetry():
                if isinstance(n, ast.ClassDef)}
     problems = vtable.binding_problems(classes, "A", "B", "fix.py")
     assert any("missing 'rx_pending'" in p for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
+# pass #4: observability coverage (blocking verbs record flight events)
+# ---------------------------------------------------------------------------
+
+_OBS_INSTRUMENTED = textwrap.dedent("""
+    class HostQPNet:
+        def isend(self, comm, mr, tag=0, timeout_s=10.0, progress=None):
+            t0 = _verb_entry("isend", tag=tag)
+            _verb_done("isend", t0)
+            return Request(_test=lambda: (True, 0, None))
+
+        def irecv(self, comm, nbytes, tag=0):
+            t0 = _verb_entry("irecv", tag=tag)
+
+            def probe():
+                _verb_done("irecv", t0)   # completion lives in the probe
+                return True, nbytes, None
+            return Request(_test=probe)
+
+        def iwrite(self, comm, rkey, mr, timeout_s=10.0):
+            t0 = _verb_entry("iwrite")
+            return _traced_request("iwrite", t0, post())
+
+        def reg_mr(self, comm, buffer):
+            return memoryview(buffer)     # non-blocking: out of scope
+
+        def listen(self, dev=0):
+            return "h", object()          # non-blocking: out of scope
+
+    class TCPNet(HostQPNet):
+        def connect(self, dev, handle, timeout_s=10.0):
+            t0 = _verb_entry("connect")
+            _verb_done("connect", t0)
+""")
+
+
+def test_obs_flags_uninstrumented_blocking_verb():
+    src = _OBS_INSTRUMENTED + textwrap.dedent("""
+        class BareNet:
+            pass
+    """)
+    # sabotage: strip isend's instrumentation
+    src = src.replace('t0 = _verb_entry("isend", tag=tag)\n'
+                      '        _verb_done("isend", t0)\n        ', "")
+    problems = obs.check_source(src, "fix.py")
+    assert any("HostQPNet.isend" in p and "no entry event" in p
+               for p in problems), problems
+    assert any("HostQPNet.isend" in p and "no completion event" in p
+               for p in problems), problems
+    # the still-instrumented verbs are not flagged
+    assert not any("irecv" in p or "iwrite" in p for p in problems)
+
+
+def test_obs_accepts_instrumented_surface():
+    assert obs.check_source(_OBS_INSTRUMENTED, "fix.py") == []
+
+
+def test_obs_nonblocking_verbs_out_of_scope():
+    # reg_mr carries no markers and stays clean ONLY because it is
+    # non-blocking: the moment it grows a timeout_s (= becomes blocking)
+    # the missing instrumentation is a finding
+    src = _OBS_INSTRUMENTED.replace("def reg_mr(self, comm, buffer):",
+                                    "def reg_mr(self, comm, buffer, "
+                                    "timeout_s=1.0):")
+    assert src != _OBS_INSTRUMENTED
+    problems = obs.check_source(src, "fix.py")
+    assert any("HostQPNet.reg_mr" in p for p in problems), problems
+
+
+def test_obs_override_must_reinstrument():
+    src = _OBS_INSTRUMENTED + textwrap.dedent("""
+        class DriftNet(HostQPNet):
+            pass
+    """)
+    # a TCPNet override that DROPS the markers is a finding even though
+    # the canon's verb is instrumented
+    assert 't0 = _verb_entry("connect")' in src
+    src = src.replace('t0 = _verb_entry("connect")\n'
+                      '        _verb_done("connect", t0)', "pass")
+    problems = obs.check_source(src, "fix.py")
+    assert any("TCPNet.connect" in p for p in problems), problems
+
+
+def test_obs_blocking_detection_is_mechanical():
+    import ast as _ast
+    tree = _ast.parse(_OBS_INSTRUMENTED)
+    fns = {n.name: n for n in _ast.walk(tree)
+           if isinstance(n, _ast.FunctionDef)}
+    assert obs.is_blocking(fns["isend"])     # timeout_s
+    assert obs.is_blocking(fns["irecv"])     # returns Request(...)
+    assert obs.is_blocking(fns["iwrite"])    # returns _traced_request(...)
+    assert not obs.is_blocking(fns["reg_mr"])
+    assert not obs.is_blocking(fns["listen"])
+    # a probe's nested returns do not make the verb "return a Request"
+    assert not obs.is_blocking(fns["probe"])
+
+
+def test_obs_runs_clean_on_the_repo_plugin():
+    assert obs.run() == []
 
 
 # ---------------------------------------------------------------------------

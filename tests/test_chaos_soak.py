@@ -88,7 +88,10 @@ def test_chaos_soak_replayable_from_seed():
 def test_die_mid_collective_survivors_abort_named():
     """A rank SIGKILL-style dies inside the collective; every survivor
     surfaces a named TimeoutError/OSError (exit 4) inside its deadline —
-    the 'degrades cleanly, never hangs' half of the contract."""
+    the 'degrades cleanly, never hangs' half of the contract — AND dumps
+    a flight-recorder postmortem naming the stalled hop, frame index,
+    and peer rank (the observability half: 'rank 3 is dead' plus WHERE
+    the wire was waiting on it)."""
     victim = 2
     results = run_workers(4, "die-mid-collective", timeout_s=120.0, seed=7,
                           rounds=6, fault_rank=victim)
@@ -103,3 +106,12 @@ def test_die_mid_collective_survivors_abort_named():
         assert re.search(r"CLEAN-ABORT: (TimeoutError|OSError|"
                          r"ConnectionRefusedError)", r.stdout)
         assert r.returncode != -9
+        # the postmortem: last-N wire events on stderr, and a stall line
+        # naming hop/frame/peer both there and in the abort message
+        assert "FLIGHT POSTMORTEM" in r.stderr, \
+            f"survivor {r.process_id} dumped no postmortem:\n{r.stderr}"
+        m = re.search(r"ring wire stalled: (recv|send|flush) hop (\d+) "
+                      r"frame (\S+) peer rank (\d+)", r.stdout)
+        assert m, f"survivor {r.process_id} named no stalled hop:\n" \
+                  f"{r.stdout}"
+        assert int(m.group(4)) in {0, 1, 2, 3} - {r.process_id}

@@ -53,6 +53,74 @@ def test_format_table_runs():
     assert "busbw" in M.format_table([r])
 
 
+def test_format_table_shows_tier_column():
+    """An oracle row must be visually distinguishable from a performance
+    row — the tier is ON the printed table, not only in the JSON."""
+    perf = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096,
+                                 "float32", 1e-6, platform="host-shm")
+    oracle = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096,
+                                   "float32", 1e-6, platform="cpu")
+    table = M.format_table([perf, oracle])
+    assert "tier" in table.splitlines()[0]
+    assert "performance" in table and "correctness-oracle" in table
+
+
+def test_overlap_ratio_windowed_since_snapshot():
+    w = M.WireCounters()
+    w.streamed(8)
+    w.overlapped(8)            # warmup: a perfect-looking prefix
+    base = w.snapshot()
+    w.streamed(10)
+    w.overlapped(2)            # the steady window: 2/10
+    assert w.overlap_ratio() == pytest.approx(10 / 18)  # lifetime dilutes
+    assert w.overlap_ratio(since=base) == pytest.approx(0.2)
+    # an empty window is 0.0, not a ZeroDivisionError
+    assert w.overlap_ratio(since=w.snapshot()) == 0.0
+
+
+def test_negotiation_gauges_record_and_reset():
+    w = M.WireCounters()
+    assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0}
+    w.negotiated(524288, 2)
+    assert w.negotiation() == {"frame_bytes": 524288, "pipeline_depth": 2}
+    # gauges, not counters: they never appear in the delta window
+    assert "frame_bytes" not in w.delta(w.snapshot())
+    w.reset()
+    assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0}
+
+
+def test_verb_latency_log_buckets():
+    v = M.VerbLatencies()
+    v.observe("isend", 0.5e-6)    # <= 1us floor bucket
+    v.observe("isend", 2.5e-6)    # -> <=4us (2us bucket would under-read)
+    v.observe("isend", 4e-6)      # boundary lands IN <=4us
+    v.observe("irecv", 3.0)       # seconds-scale
+    snap = v.snapshot()
+    assert snap["isend"]["count"] == 3
+    assert snap["isend"]["buckets"] == {"<=1us": 1, "<=4us": 2}
+    assert snap["isend"]["mean_us"] == pytest.approx(7 / 3, rel=1e-6)
+    assert snap["irecv"]["buckets"] == {"<=4194304us": 1}
+    # absurd latencies collapse into the ceiling bucket, never a KeyError
+    v.observe("irecv", 1e6)
+    assert f"<={1 << M.VerbLatencies._TOP}us" in \
+        v.snapshot()["irecv"]["buckets"]
+
+
+def test_verb_latency_delta_windows_per_verb():
+    v = M.VerbLatencies()
+    v.observe("isend", 1e-6)
+    base = v.snapshot()
+    v.observe("isend", 1e-6)
+    v.observe("iwrite", 2e-6)
+    d = v.delta(base)
+    assert d["isend"]["count"] == 1
+    assert d["iwrite"]["count"] == 1
+    assert set(d) == {"isend", "iwrite"}  # unmoved verbs are dropped
+    assert v.delta(v.snapshot()) == {}
+    v.reset()
+    assert v.snapshot() == {}
+
+
 def test_ragged_busbw_uses_counts_vector():
     # ADVICE r3: with skewed counts the dense (n-1)/n factor misstates the
     # busiest rank's wire; the counts-aware factor is (sum - min)/sum

@@ -1,0 +1,298 @@
+"""The host-plane flight recorder (rocnrdma_tpu.obs): ring-buffer
+semantics, thread-safety under concurrent producers, deterministic chaos
+timelines, postmortem rendering, and the multi-rank Chrome-trace merge
+over real OS processes."""
+
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.obs import FLIGHT, FlightRecorder, postmortem
+from rocnrdma_tpu.obs import chrome
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable")
+
+
+# ---------------------------------------------------------------------------
+# ring-buffer semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_wraparound_keeps_last_capacity_events():
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("tick", i=i)
+    ev = rec.events()
+    assert len(ev) == 8
+    assert [args["i"] for _, _, args in ev] == list(range(12, 20))
+    assert rec.recorded() == 20  # lifetime count survives the wrap
+    # timestamps are monotone within the single-producer buffer
+    ts = [t for t, _, _ in ev]
+    assert ts == sorted(ts)
+
+
+def test_tail_returns_last_n_oldest_first():
+    rec = FlightRecorder(capacity=16)
+    for i in range(5):
+        rec.record("e", i=i)
+    assert [a["i"] for _, _, a in rec.tail(3)] == [2, 3, 4]
+    assert [a["i"] for _, _, a in rec.tail(99)] == [0, 1, 2, 3, 4]
+    assert rec.tail(0) == []  # not the whole buffer (ev[-0:] trap)
+
+
+def test_malformed_capacity_env_degrades_to_default(monkeypatch):
+    from rocnrdma_tpu.obs import recorder as R
+    monkeypatch.setenv("ROCNRDMA_FLIGHT_EVENTS", "4k")
+    rec = R._from_env()  # must not raise: this runs at import time
+    assert rec.capacity == 4096 and rec.enabled
+
+
+def test_disabled_recorder_records_nothing():
+    rec = FlightRecorder(capacity=8, enabled=False)
+    rec.record("tick")
+    assert rec.events() == [] and rec.recorded() == 0
+
+
+def test_reset_clears_buffer_and_sync():
+    rec = FlightRecorder(capacity=8)
+    rec.record("tick")
+    rec.mark_sync()
+    assert rec.sync_ts is not None
+    rec.reset()
+    assert rec.events() == [] and rec.sync_ts is None
+
+
+def test_mark_sync_shows_on_timeline():
+    rec = FlightRecorder(capacity=8)
+    t = rec.mark_sync(ns="ring")
+    kinds = [k for _, k, _ in rec.events()]
+    assert kinds == ["clock-sync"]
+    assert rec.sync_ts == t
+
+
+def test_concurrent_producers_lose_nothing_and_corrupt_nothing():
+    """The lock discipline under fire: N threads hammering record()
+    concurrently — the lifetime count is exact and every buffered slot
+    is a well-formed event (a torn ring index would break both)."""
+    rec = FlightRecorder(capacity=64)
+    n_threads, per = 8, 500
+
+    def produce(t):
+        for i in range(per):
+            rec.record("p", t=t, i=i)
+
+    threads = [threading.Thread(target=produce, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert rec.recorded() == n_threads * per
+    ev = rec.events()
+    assert len(ev) == 64
+    for t, kind, args in ev:
+        assert kind == "p" and 0 <= args["t"] < n_threads \
+            and 0 <= args["i"] < per
+
+
+# ---------------------------------------------------------------------------
+# postmortem rendering
+# ---------------------------------------------------------------------------
+
+
+def test_postmortem_renders_reason_and_tail():
+    rec = FlightRecorder(capacity=8)
+    rec.record("frame-posted", hop=3, frame=2)
+    rec.record("stall", dir="recv", hop=3, frame=2, peer=1)
+    out = io.StringIO()
+    text = postmortem("recv hop 3 frame 2 peer rank 1", out=out,
+                      recorder=rec)
+    assert "FLIGHT POSTMORTEM" in text
+    assert "recv hop 3 frame 2 peer rank 1" in text
+    assert "frame-posted hop=3 frame=2" in text
+    assert "stall dir=recv" in text
+    assert out.getvalue() == text + "\n"
+
+
+# ---------------------------------------------------------------------------
+# deterministic chaos timelines: same seed -> same injected-fault events
+# ---------------------------------------------------------------------------
+
+
+class _StubComm:
+    pass
+
+
+class _StubNet:
+    """Minimal always-succeeding vtable for driving FaultNet decisions."""
+
+    def init(self):
+        pass
+
+    def connect(self, dev, handle, timeout_s=1.0):
+        return _StubComm()
+
+    def accept(self, listener, timeout_s=1.0):
+        return _StubComm()
+
+    def isend(self, comm, mr, tag=0, **kw):
+        from rocnrdma_tpu.transport.plugin import Request
+        size = len(mr)
+        return Request(_test=lambda: (True, size, None))
+
+    def irecv(self, comm, nbytes, tag=0):
+        from rocnrdma_tpu.transport.plugin import Request
+        return Request(_test=lambda: (True, nbytes, b"\0" * nbytes))
+
+    def close_comm(self, comm):
+        pass
+
+    def close(self):
+        pass
+
+
+def _drive_chaos(seed: int) -> list:
+    """One deterministic op sequence over FaultNet; returns the flight
+    recorder's fault events with timestamps stripped."""
+    from rocnrdma_tpu.transport.faults import FaultNet, FaultSchedule
+
+    FLIGHT.reset()
+    net = FaultNet(_StubNet(), FaultSchedule(
+        seed, rank=0, connect_refusals=2, connect_flake_p=0.3,
+        test_delay_p=0.5, test_delay_polls=(1, 3), close_drop_p=0.5))
+    net.init()
+    comm = None
+    for _ in range(6):  # refused twice, then flaky
+        try:
+            comm = net.connect(0, "h")
+            break
+        except ConnectionRefusedError:
+            continue
+    assert comm is not None
+    for i in range(20):
+        net.isend(comm, b"x" * 8, tag=i)
+        req = net.irecv(comm, 8, tag=i)
+        while not req.test()[0]:  # delayed completions drain here
+            pass
+        net.close_comm(comm)
+    net.close()
+    return [(kind, args) for _, kind, args in FLIGHT.events()
+            if kind.startswith("fault-")]
+
+
+def test_chaos_timeline_replay_equal_for_one_seed():
+    first = _drive_chaos(seed=42)
+    second = _drive_chaos(seed=42)
+    assert first, "chaos profile injected nothing — vacuous test"
+    assert first == second  # kinds AND args, in order; timestamps excluded
+    assert any(k == "fault-connect-refused" for k, _ in first)
+    assert any(k == "fault-test-delayed" for k, _ in first)
+    # and a different seed draws a different timeline (not a constant)
+    assert _drive_chaos(seed=43) != first
+
+
+# ---------------------------------------------------------------------------
+# the multi-rank Chrome trace (acceptance: 2-rank shm allreduce merges
+# into one clock-aligned Perfetto-loadable timeline whose frame-level
+# slices match frames_streamed)
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_merge_wrapped_ring_keeps_ts_positive(tmp_path):
+    """After a ring wrap the oldest retained event can be a dur-carrying
+    completion whose -post was evicted; its slice START (ts - dur) must
+    still bias the merged timeline, or Perfetto gets negative ts."""
+    import time as _t
+    rec = FlightRecorder(capacity=3)
+    for i in range(6):
+        rec.record("isend-post", tag=i)
+        rec.record("isend-done", tag=i, dur=0.002)
+        _t.sleep(0.001)
+    p = tmp_path / "wrapped.json"
+    chrome.dump_rank(str(p), 0, recorder=rec)
+    merged = chrome.merge([str(p)])
+    ts = [e["ts"] for e in merged["traceEvents"] if "ts" in e]
+    assert ts and min(ts) >= 0
+
+
+@needs_native
+def test_chrome_merge_two_rank_shm_allreduce(tmp_path, monkeypatch):
+    from rocnrdma_tpu.bench import bench_host
+
+    monkeypatch.setenv("ROCNRDMA_FLIGHT_DUMP", str(tmp_path))
+    rc = bench_host.main(["--ranks", "2", "--plane", "shm", "--sizes",
+                          "64K", "--collectives", "allreduce",
+                          "--repeats", "2", "--iters", "2"])
+    assert rc == 0
+    dumps = [tmp_path / f"flight_rank{r}.json" for r in (0, 1)]
+    assert all(p.exists() for p in dumps), list(tmp_path.iterdir())
+
+    merged_path = tmp_path / "merged.trace.json"
+    merged = chrome.merge([str(p) for p in dumps], str(merged_path))
+    # the written artifact parses and matches what merge() returned
+    assert json.loads(merged_path.read_text())["otherData"]["ranks"] == [0, 1]
+
+    events = merged["traceEvents"]
+    # both ranks' lanes are present and named
+    assert {e["pid"] for e in events} == {0, 1}
+    names = {(e["pid"], e.get("args", {}).get("name"))
+             for e in events if e.get("ph") == "M"}
+    assert (0, "rank 0 (host plane)") in names
+    assert (1, "frames") in names
+    # Perfetto-loadable basics: every event stamped, no negative ts
+    assert all(e["ts"] >= 0 for e in events if "ts" in e)
+    # frame-level slices match each rank's streamed-frame count exactly
+    for r, p in enumerate(dumps):
+        d = json.loads(p.read_text())
+        assert d["sync_ts"] is not None  # bootstrap clock handshake ran
+        streamed = d["wire"]["frames_streamed"]
+        assert streamed > 0
+        assert len(chrome.frame_slices(merged, r)) == streamed
+        # per-verb latency histograms rode along in the dump
+        assert d["verb_latency"]["irecv_into"]["count"] >= streamed
+
+
+@needs_native
+def test_wire_stats_exports_negotiation_and_verb_latency():
+    """wire_stats() carries the negotiated frame/pipeline-depth gauges
+    and the per-verb latency histograms next to the zero-copy counters."""
+    from rocnrdma_tpu import distributed as dist
+    from rocnrdma_tpu.transport import bootstrap
+
+    n = 2
+    store = bootstrap.BootstrapServer(n_ranks=n)
+    stats, errors = [None] * n, []
+
+    def worker(rank):
+        pg = None
+        try:
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=store.handle,
+                plane="shm", group_name="obs-stats")
+            pg.all_reduce(np.arange(1024, dtype=np.float32))
+            stats[rank] = pg.wire_stats()
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append((rank, repr(e)))
+        finally:
+            if pg is not None:
+                pg.destroy()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    store.close()
+    assert not errors, errors
+    for s in stats:
+        assert s["frame_bytes"] > 0
+        assert s["pipeline_depth"] >= 1
+        lat = s["verb_latency"]
+        assert lat["irecv_into"]["count"] > 0
+        assert lat["isend"]["count"] > 0
+        assert all(v >= 1 for v in lat["isend"]["buckets"].values())

@@ -1,6 +1,6 @@
 """tools.analyze — the repo's static-analysis suite, gating tier-1.
 
-Four passes over the transport stack, one shared AST/allowlist core
+Five passes over the transport stack, one shared AST/allowlist core
 (``tools.analyze.base``); each pass enforces one machine-checkable
 invariant of the "named errors, never hangs, no silent corruption"
 contract:
@@ -13,6 +13,9 @@ contract:
   from the shm plane, and FaultNet wraps ALL of it — a new verb cannot
   ship without fault-injection coverage.
 - ``leaks``: acquired sockets/QPs/listeners are released on all paths.
+- ``obs``: every public blocking verb on the net vtable records
+  flight-recorder entry/completion events — a new verb cannot ship
+  unobservable (blind spots are where hang postmortems go to die).
 
 Run all passes with ``python -m tools.analyze`` (exit 0 = clean). Every
 pass carries an ``ALLOW`` dict — empty by policy; an entry needs a
@@ -23,9 +26,9 @@ are ratcheted against ``results/analyze_pr3.json`` by
 
 from __future__ import annotations
 
-from tools.analyze import deadlines, leaks, races, vtable
+from tools.analyze import deadlines, leaks, obs, races, vtable
 
-PASSES = (deadlines, races, vtable, leaks)
+PASSES = (deadlines, races, vtable, leaks, obs)
 
 SNAPSHOT = "results/analyze_pr3.json"
 

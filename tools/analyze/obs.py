@@ -1,0 +1,144 @@
+"""Pass #4: observability coverage — blocking verbs record flight events.
+
+PR 4 instrumented the host-plane net vtable with the flight recorder
+(``rocnrdma_tpu.obs``): every public blocking verb records an entry
+event (``_verb_entry``) and a completion event + latency observation
+(``_verb_done`` directly, or ``_traced_request`` wrapping an async
+Request). That coverage is the whole value of the recorder — a hang
+postmortem that is blind to one verb tells a partial story precisely
+where it matters — and nothing structural kept it from rotting: a new
+blocking verb (the PR-2 lesson: ``irecv_into`` landed on three planes
+before FaultNet wrapped it) would ship unobservable. This pass pins the
+invariant the way the vtable pass pins fault parity:
+
+**Every public BLOCKING verb on the host-plane net classes
+(``HostQPNet``, and ``TCPNet``'s own overrides) must contain both an
+entry marker (a ``_verb_entry(...)`` call) and a completion marker (a
+``_verb_done(...)`` or ``_traced_request(...)`` call), anywhere in its
+body including nested probe/consume functions.**
+
+"Blocking" is detected mechanically, so a new verb cannot dodge by
+omission: a verb is blocking if its signature accepts ``timeout_s``
+(the deadline-discipline marker pass #0 already enforces on blocking
+surfaces) or if the verb's own body returns a ``Request`` /
+``_traced_request`` construction (the async-completion shape — its
+caller blocks in ``Request.wait``). Non-blocking surface (``listen``,
+``reg_mr``, ``get_properties``, the owner-side MR reads, teardown) is
+deliberately out of scope.
+
+``FaultNet`` inherits coverage through delegation — its verbs call the
+inner plane's instrumented ones, and the vtable pass already pins that
+it wraps the full surface — so it is not re-checked here; the
+fault-injection *events* themselves are recorded by ``FaultSchedule``.
+
+Exceptions live in ``ALLOW`` ("Class.verb" -> reason) — empty by policy.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import base
+from tools.analyze.vtable import own_methods, public_verbs, resolved_methods
+
+NAME = "obs"
+DESCRIPTION = ("every public blocking net verb records flight-recorder "
+               "entry/completion events")
+
+PLUGIN = "rocnrdma_tpu/transport/plugin.py"
+
+CANON = "HostQPNet"      # full resolved surface checked
+OVERRIDES = ("TCPNet",)  # only own re-definitions (inherited = canon's)
+
+ENTRY_MARKERS = {"_verb_entry"}
+DONE_MARKERS = {"_verb_done", "_traced_request"}
+REQUEST_NAMES = {"Request", "_traced_request"}
+
+ALLOW: dict[str, str] = {}
+
+
+def _called_names(fn: ast.AST) -> set:
+    """Every simple callee name invoked anywhere in ``fn`` (nested defs
+    included — the completion marker legitimately lives in the verb's
+    probe/consume closure)."""
+    return {base.call_name(sub) for sub in ast.walk(fn)
+            if isinstance(sub, ast.Call)} - {None}
+
+
+def _own_returns(fn: ast.FunctionDef):
+    """Return statements at the verb's OWN level (nested defs excluded —
+    a probe's ``return False, 0, None`` is not the verb returning)."""
+    nested = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and sub is not fn:
+            nested.update(id(x) for x in ast.walk(sub))
+    return [sub for sub in ast.walk(fn)
+            if isinstance(sub, ast.Return) and id(sub) not in nested]
+
+
+def is_blocking(fn: ast.FunctionDef) -> bool:
+    """The mechanical blocking-verb test: takes ``timeout_s``, or returns
+    a Request construction from its own body."""
+    if "timeout_s" in base.func_params(fn):
+        return True
+    for ret in _own_returns(fn):
+        if isinstance(ret.value, ast.Call) \
+                and base.call_name(ret.value) in REQUEST_NAMES:
+            return True
+    return False
+
+
+def verb_problems(cls_name: str, verbs: dict, where: str,
+                  used: set | None = None) -> list[str]:
+    problems = []
+    for verb, fn in sorted(verbs.items()):
+        if not is_blocking(fn):
+            continue
+        key = f"{cls_name}.{verb}"
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        called = _called_names(fn)
+        if not (called & ENTRY_MARKERS):
+            problems.append(
+                f"{where}:{fn.lineno}: blocking verb {cls_name}.{verb} "
+                f"records no entry event (call _verb_entry at post time, "
+                f"or ALLOW it with a reason)")
+        if not (called & DONE_MARKERS):
+            problems.append(
+                f"{where}:{fn.lineno}: blocking verb {cls_name}.{verb} "
+                f"records no completion event (call _verb_done, or wrap "
+                f"the returned Request with _traced_request)")
+    return problems
+
+
+def check_tree(tree: ast.Module, where: str = PLUGIN,
+               used: set | None = None) -> list[str]:
+    classes = {n.name: n for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef)}
+    canon = public_verbs(resolved_methods(classes, CANON))
+    if not canon:
+        return [f"{where}: canonical class {CANON} not found or empty"]
+    problems = verb_problems(CANON, canon, where, used)
+    for plane in OVERRIDES:
+        if plane not in classes:
+            problems.append(f"{where}: plane class {plane} not found")
+            continue
+        problems += verb_problems(plane,
+                                  public_verbs(own_methods(classes, plane)),
+                                  where, used)
+    return problems
+
+
+def check_source(src: str, path: str = "<fixture>") -> list[str]:
+    return check_tree(ast.parse(src, filename=path), path)
+
+
+def run() -> list[str]:
+    used: set = set()
+    problems = check_tree(base.parse_file(PLUGIN), PLUGIN, used)
+    problems += base.allow_reason_problems(ALLOW, NAME)
+    problems += base.allow_stale_problems(ALLOW, used, NAME)
+    return problems
