@@ -38,7 +38,7 @@ import time
 import numpy as np
 
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
-from rocnrdma_tpu.obs import postmortem as _postmortem
+from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
 from rocnrdma_tpu.transport import (
     HostQPNet,
     TCPNet,
@@ -86,12 +86,29 @@ class ProcessGroup:
     def __init__(self, rank: int, world_size: int, store_handle: str,
                  server: "bootstrap.BootstrapServer | None",
                  timeout_s: float = 30.0, group_name: str = "default",
-                 plane: str = "tcp", fault_schedule=None):
+                 plane: str = "tcp", fault_schedule=None,
+                 self_heal: bool = False):
         self.rank = rank
         self.world_size = world_size
         self.group_name = group_name
         self.plane = plane
         self.timeout_s = timeout_s  # the group's default op deadline
+        # elastic-recovery state: the group generation (bumped by every
+        # heal; stamped on every wire frame and asserted at the vtable
+        # boundary), the current-rank -> ORIGINAL-rank map (identity is
+        # the construction-time rank forever — heals re-rank, the oracle
+        # keys by who a survivor originally was), and the opt-in flag
+        # that lets _ring heal-and-retry instead of raising on a
+        # confirmed-dead peer
+        self.epoch = 0
+        self.last_op_epoch = 0      # epoch the last collective COMMITTED on
+        self._op_seq = 0            # collectives COMMITTED (heal divergence
+        #                             check: every survivor must agree on
+        #                             which op the retry re-executes)
+        self._ranks = list(range(world_size))
+        self._self_heal = bool(self_heal)
+        self._heals = 0
+        self._watchdog_params = None  # (interval_s, timeout_s) when running
         self._server = server  # only rank 0 (or an external sidecar) owns one
         if plane not in _PLANES:
             raise ValueError(f"unknown plane {plane!r}; know {sorted(_PLANES)}")
@@ -109,9 +126,12 @@ class ProcessGroup:
                     ns=f"pg/{group_name}/ring")
             else:
                 self._send = self._recv = self._client = None
-        except BaseException:
+        except BaseException as e:
             # a failed rendezvous must not leak the net plane (or, via
-            # init_process_group, rank 0's master-port listener)
+            # init_process_group, rank 0's master-port listener); the
+            # abort leaves a flight event (analyzer abort-path rule)
+            _FLIGHT.record("group-abort", group=group_name, rank=rank,
+                           error=type(e).__name__)
             self._net.close()
             raise
         self._barrier_no = 0
@@ -135,13 +155,112 @@ class ProcessGroup:
 
     # -- collectives (numpy in, numpy out) ---------------------------------
 
-    def _ring(self, fn, *args, timeout_s=None, **kw):
-        self._check_alive()  # fail fast instead of hanging on the dead
+    def _ring(self, fn, *args, timeout_s=None, _retry_ok=True, **kw):
         # every wire wait under this call is bounded by ONE deadline: the
         # per-call override, else the group default from init — a stalled
-        # peer surfaces as a named TimeoutError, never a hang
+        # peer surfaces as a named TimeoutError, never a hang. Rank and
+        # world size are injected HERE (not at the verb call sites) so a
+        # heal-and-retry re-executes on the post-heal numbering;
+        # ``_retry_ok=False`` marks verbs whose INPUTS are shaped by the
+        # current world size (alltoall rows, ragged counts, scatter's
+        # root block) — those refuse transparent retry with a named
+        # error instead of feeding old-world shapes to a shrunk group.
+        #
+        # Exactly-once under retry: every ring_* collective copies its
+        # input at entry (np.array(local, copy=True)), so an aborted
+        # attempt can only have corrupted ITS OWN working copy — the
+        # caller's buffer is preserved until commit, the retry re-reads
+        # it, and the epoch fence guarantees no frame of the aborted
+        # attempt (whose hop/frame tags the retry REUSES) can leak into
+        # the re-execution. The epoch the result committed on is
+        # recorded in last_op_epoch.
         t = self.timeout_s if timeout_s is None else timeout_s
-        return fn(self._net, self._send, self._recv, *args, timeout_s=t, **kw)
+        attempts = self.world_size  # each genuine heal removes >= 1 rank
+        for _ in range(max(1, attempts)):
+            try:
+                self._check_alive()  # fail fast instead of hanging on the dead
+                out = fn(self._net, self._send, self._recv, *args,
+                         self.rank, self.world_size, timeout_s=t, **kw)
+            except (TimeoutError, OSError, RuntimeError) as e:
+                # CLEAN-ABORT: the collective died with a named error —
+                # on the flight timeline either way; with self-healing
+                # on, a CONFIRMED-dead peer triggers heal + transparent
+                # retry, anything else (slow peer, watchdog suicide,
+                # exhausted retries) re-raises to the caller
+                _FLIGHT.record("collective-abort", epoch=self.epoch,
+                               error=type(e).__name__)
+                if not self._self_heal:
+                    raise
+                if not _retry_ok:
+                    # inputs shaped by the CURRENT world size (alltoall
+                    # rows, v-counts, scatter's root block) would be
+                    # malformed on a shrunk group — refuse BEFORE healing
+                    # (the group is left un-mutated; the caller heals and
+                    # re-issues with new-world shapes), named, never a
+                    # shape assertion from deep inside a retry
+                    raise RuntimeError(
+                        f"{getattr(fn, '__name__', 'collective')} aborted "
+                        f"on a peer failure, and its inputs are shaped by "
+                        f"the current world size — a transparent shrunk-"
+                        f"group retry would be malformed. Call heal(), "
+                        f"then re-issue with shapes for the new world "
+                        f"size.") from e
+                prev = list(self._ranks)
+                self._heal_for(e, t)
+                root_kw = next((k for k in ("root",) if k in kw), None)
+                if root_kw is not None:
+                    # rooted verbs name a rank: follow the ROOT's identity
+                    # through the re-ranking (a retried broadcast must
+                    # still source the same original rank), and refuse
+                    # named if the root itself is the one that died
+                    gid = prev[kw[root_kw]]
+                    if gid not in self._ranks:
+                        raise RuntimeError(
+                            f"{getattr(fn, '__name__', 'collective')}: "
+                            f"the root (original rank {gid}) died; a "
+                            f"rooted collective cannot retry without its "
+                            f"root — re-issue with a surviving root"
+                        ) from e
+                    kw[root_kw] = self._ranks.index(gid)
+                continue
+            self.last_op_epoch = self.epoch
+            self._op_seq += 1
+            return out
+        raise RuntimeError(
+            f"self-heal retry budget exhausted for group "
+            f"{self.group_name!r} (epoch {self.epoch})")
+
+    def _heal_for(self, exc, timeout_s: float) -> None:
+        """A collective just aborted: wait (briefly) for the failure
+        detector's verdict, then heal if a peer is confirmed dead, else
+        re-raise ``exc`` — slow is not dead, and healing away a live
+        rank on a timeout alone would be the split-brain this protocol
+        exists to prevent."""
+        wd = self._watchdog_params
+        verdict_wait = (wd[0] + wd[1] + 1.0) if wd is not None else 2.0
+        silence_s = wd[1] + wd[0] if wd is not None else max(timeout_s, 15.0)
+        deadline = time.monotonic() + verdict_wait
+        from rocnrdma_tpu.transport.backoff import poll_backoff
+        back = poll_backoff()
+        while True:
+            suspects = set(self.dead_ranks())
+            if not suspects:
+                try:
+                    # with a watchdog running every rank heartbeats the
+                    # store each tick, so store silence past one watchdog
+                    # timeout IS the dead-vs-slow verdict; without one,
+                    # the long floor keeps a jit-compiling rank alive
+                    suspects = set(self._client.dead_ranks(
+                        self.world_size, max_age_s=silence_s))
+                except (OSError, TimeoutError):
+                    suspects = set()
+            suspects &= set(range(self.world_size))
+            if suspects:
+                break
+            if time.monotonic() >= deadline:
+                raise exc
+            back.pause()
+        self.heal(timeout_s=timeout_s, _suspects=suspects)
 
     def all_reduce(self, x, op: str = "sum", transport: str = "msg",
                    timeout_s: float | None = None) -> np.ndarray:
@@ -157,8 +276,7 @@ class ProcessGroup:
             return x.copy()
         fn = (plugin.ring_allreduce_rdma if transport == "rdma"
               else plugin.ring_allreduce_over_net)
-        out = self._ring(fn, x, self.rank, self.world_size, op=wire_op,
-                         timeout_s=timeout_s)
+        out = self._ring(fn, x, op=wire_op, timeout_s=timeout_s)
         return self._avg_finalize(out, x, op)
 
     def reduce_scatter(self, x, op: str = "sum", transport: str = "msg",
@@ -174,8 +292,7 @@ class ProcessGroup:
             return x.ravel().copy()
         fn = (plugin.ring_reduce_scatter_rdma if transport == "rdma"
               else plugin.ring_reduce_scatter_over_net)
-        out = self._ring(fn, x, self.rank, self.world_size, op=wire_op,
-                         timeout_s=timeout_s)
+        out = self._ring(fn, x, op=wire_op, timeout_s=timeout_s)
         return self._avg_finalize(out, x, op)
 
     def all_gather(self, x, transport: str = "msg",
@@ -189,8 +306,7 @@ class ProcessGroup:
             return x[None].copy()
         fn = (plugin.ring_allgather_rdma if transport == "rdma"
               else plugin.ring_allgather_over_net)
-        return self._ring(fn, x, self.rank, self.world_size,
-                          timeout_s=timeout_s)
+        return self._ring(fn, x, timeout_s=timeout_s)
 
     def broadcast(self, x, src: int = 0,
                   timeout_s: float | None = None) -> np.ndarray:
@@ -200,8 +316,8 @@ class ProcessGroup:
         plugin._check_root(src, self.world_size)
         if self.world_size == 1:
             return x.copy()
-        return self._ring(plugin.ring_broadcast_over_net, x, self.rank,
-                          self.world_size, root=src, timeout_s=timeout_s)
+        return self._ring(plugin.ring_broadcast_over_net, x, root=src,
+                          timeout_s=timeout_s)
 
     def all_to_all(self, x, timeout_s: float | None = None) -> np.ndarray:
         """``x`` is ``(world_size, ...)``; row j goes to rank j. Returns the
@@ -209,8 +325,8 @@ class ProcessGroup:
         x = np.asarray(x)
         if self.world_size == 1:
             return x.copy()
-        return self._ring(plugin.ring_alltoall_over_net, x, self.rank,
-                          self.world_size, timeout_s=timeout_s)
+        return self._ring(plugin.ring_alltoall_over_net, x,
+                          timeout_s=timeout_s, _retry_ok=False)
 
     def all_to_all_v(self, segments: list, counts, dtype="float32",
                      timeout_s: float | None = None) -> list:
@@ -225,8 +341,8 @@ class ProcessGroup:
         # world_size == 1 still routes through the plugin so counts/segment
         # validation behaves identically to multi-rank runs
         return self._ring(plugin.ring_alltoallv_over_net, segments,
-                          np.asarray(counts), self.rank, self.world_size,
-                          dtype=dtype, timeout_s=timeout_s)
+                          np.asarray(counts), dtype=dtype,
+                          timeout_s=timeout_s, _retry_ok=False)
 
     def all_gather_v(self, x, counts,
                      timeout_s: float | None = None) -> list:
@@ -243,7 +359,7 @@ class ProcessGroup:
             return plugin.ring_allgatherv_over_net(
                 None, None, None, x, counts, 0, 1)
         return self._ring(plugin.ring_allgatherv_over_net, x, counts,
-                          self.rank, self.world_size, timeout_s=timeout_s)
+                          timeout_s=timeout_s, _retry_ok=False)
 
     def reduce_scatter_v(self, x, counts, op: str = "sum",
                          timeout_s: float | None = None) -> np.ndarray:
@@ -259,8 +375,8 @@ class ProcessGroup:
                 None, None, None, x, counts, 0, 1, op=wire_op)
         else:
             out = self._ring(plugin.ring_reduce_scatter_v_over_net, x,
-                             counts, self.rank, self.world_size, op=wire_op,
-                             timeout_s=timeout_s)
+                             counts, op=wire_op, timeout_s=timeout_s,
+                             _retry_ok=False)
         return self._avg_finalize(out, x, op)
 
     def _avg_wire_op(self, x, op: str, verb: str) -> str:
@@ -292,9 +408,8 @@ class ProcessGroup:
         plugin._check_root(dst, self.world_size)
         if self.world_size == 1:
             return x.copy()
-        out = self._ring(plugin.ring_reduce_over_net, x, self.rank,
-                         self.world_size, root=dst, op=wire_op,
-                         timeout_s=timeout_s)
+        out = self._ring(plugin.ring_reduce_over_net, x, root=dst,
+                         op=wire_op, timeout_s=timeout_s)
         return self._avg_finalize(out, x, op)
 
     def gather(self, x, dst: int = 0,
@@ -306,8 +421,8 @@ class ProcessGroup:
         plugin._check_root(dst, self.world_size)
         if self.world_size == 1:
             return x[None].copy()
-        return self._ring(plugin.ring_gather_over_net, x, self.rank,
-                          self.world_size, root=dst, timeout_s=timeout_s)
+        return self._ring(plugin.ring_gather_over_net, x, root=dst,
+                          timeout_s=timeout_s)
 
     def scatter(self, x, src: int = 0,
                 timeout_s: float | None = None) -> np.ndarray:
@@ -321,8 +436,8 @@ class ProcessGroup:
             if x.shape[0] != 1:
                 raise ValueError(f"scatter root wants (1, ...), got {x.shape}")
             return x[0].copy()
-        return self._ring(plugin.ring_scatter_over_net, x, self.rank,
-                          self.world_size, root=src, timeout_s=timeout_s)
+        return self._ring(plugin.ring_scatter_over_net, x, root=src,
+                          timeout_s=timeout_s, _retry_ok=False)
 
     # -- object collectives (pickled python values, torch-style) -----------
     #
@@ -370,8 +485,13 @@ class ProcessGroup:
     # blocking-receive semantics.
 
     def _p2p_ns(self, peer: int) -> str:
+        # epoch-qualified: a heal tears the p2p plane down and renumbers
+        # peers, so post-heal wiring must rendezvous on FRESH keys — a
+        # dial that read a dead generation's listener handle would race
+        # the republish (and desynchronize the deterministic chaos
+        # replay with spurious failed connects)
         lo, hi = min(self.rank, peer), max(self.rank, peer)
-        return f"pg/{self.group_name}/p2p/{lo}-{hi}"
+        return f"pg/{self.group_name}/e{self.epoch}/p2p/{lo}-{hi}"
 
     def _p2p_publish(self) -> None:
         """First p2p op on this rank: listen + publish for EVERY peer."""
@@ -426,18 +546,35 @@ class ProcessGroup:
         self._check_alive()
         wire = self._p2p.get((peer, direction))
         if wire is None:
+            from rocnrdma_tpu.transport.backoff import retry_with_backoff
             self._p2p_publish()
             if direction == "tx":
                 handle = self._client.get(f"{self._p2p_ns(peer)}/h/{peer}",
                                           timeout_s)
-                comm = self._net.connect(0, handle, timeout_s)
+                # refused/flaky dials retry under the shared backoff —
+                # same discipline as the ring wiring (a FaultNet flake,
+                # or a peer re-binding across a heal, is transient);
+                # per-attempt timeouts also retry, so a peer that is
+                # merely SLOW to accept still gets the caller's full
+                # timeout_s, as before the retry wrapper
+                comm = retry_with_backoff(
+                    lambda: self._net.connect(0, handle,
+                                              min(5.0, timeout_s)),
+                    timeout_s, f"p2p dial to rank {peer}",
+                    retry_on=(ConnectionRefusedError, ConnectionResetError,
+                              TimeoutError))
                 # sends pump the whole p2p plane (see _p2p_progress)
                 wire = plugin._RingWire(self._net, comm, comm,
                                         progress=self._p2p_progress,
                                         timeout_s=timeout_s,
                                         peers=(peer, peer))
             else:
-                comm = self._net.accept(self._p2p_listen[peer], timeout_s)
+                comm = retry_with_backoff(
+                    lambda: self._net.accept(self._p2p_listen[peer],
+                                             min(5.0, timeout_s)),
+                    timeout_s, f"p2p accept from rank {peer}",
+                    retry_on=(ConnectionRefusedError, ConnectionResetError,
+                              TimeoutError))
                 self._p2p_accepted.add(peer)
                 # one comm plays both _RingWire roles: receives probe their
                 # own comm, the flush of an (empty) tx queue is harmless
@@ -602,13 +739,22 @@ class ProcessGroup:
                 handles[i] = self.isend(arr, peer, tag, timeout_s)
         return [handles[i] for i in range(len(parsed))]
 
+    def _barrier_key(self, kind: str) -> str:
+        """Epoch-qualified barrier key. Survivors abort a collective at
+        DIFFERENT points (one mid-allreduce, one mid-barrier), so their
+        ``_barrier_no`` counters desynchronize across a heal; the heal
+        resets the counter and the epoch in the key keeps every
+        generation's arrival sets disjoint — a dead rank's pre-heal
+        arrival can never release a post-heal barrier early."""
+        return f"pg/{self.group_name}/e{self.epoch}/{kind}{self._barrier_no}"
+
     def barrier(self, timeout_s: float = 30.0) -> None:
         """Block until every rank arrives."""
         if self.world_size == 1:
             return
         self._check_alive()
         self._barrier_no += 1
-        self._client.barrier(f"pg/{self.group_name}/b{self._barrier_no}",
+        self._client.barrier(self._barrier_key("b"),
                              self.world_size, timeout_s)
 
     def monitored_barrier(self, timeout_s: float = 30.0) -> None:
@@ -620,7 +766,7 @@ class ProcessGroup:
         if self.world_size == 1:
             return
         self._barrier_no += 1
-        key = f"pg/{self.group_name}/mb{self._barrier_no}"
+        key = self._barrier_key("mb")
         self._client.set(f"{key}/{self.rank}", "1")
         deadline = time.monotonic() + timeout_s
         # one blocking get at a time (get() itself polls at 10 ms), so the
@@ -703,7 +849,9 @@ class ProcessGroup:
         liveness, waits the grace window, the lowest surviving rank
         proposes the member list, and a fresh re-ranked group is wired over
         the same store. Raises for a rank that arrives after the window
-        closed (it must exit — the group has moved on).
+        closed (it must exit — the group has moved on). For repair IN
+        PLACE — same group object, epoch-fenced wiring, transparent
+        collective retry — use :meth:`heal` instead.
 
         The rendezvous store must still be reachable: run it as a sidecar
         (or on a rank you trust to live) if you need elasticity — losing
@@ -769,6 +917,290 @@ class ProcessGroup:
             server, timeout_s, f"{self.group_name}/shrunk{self._shrink_no}",
             plane=self.plane)
 
+    # -- self-healing (epoch-fenced in-place ring repair) -------------------
+
+    @property
+    def global_ranks(self) -> list:
+        """Current members' ORIGINAL ranks in current-rank order — the
+        stable identities a shrunk group's oracle (and its operator) key
+        by. ``global_ranks[self.rank]`` is who this process originally
+        was; before any heal it is ``list(range(world_size))``."""
+        return list(self._ranks)
+
+    @property
+    def heals(self) -> int:
+        """How many times this group has healed (== ``self.epoch``
+        unless a future epoch consumer bumps differently)."""
+        return self._heals
+
+    def heal(self, grace_s: float = 5.0, timeout_s: float | None = None,
+             _suspects=None) -> list:
+        """Elastic recovery IN PLACE — the self-healing half of the
+        failure story (``shrink()`` is the build-a-new-group sibling;
+        this one repairs the group object the training loop already
+        holds, so the interrupted collective can transparently retry).
+        Every survivor calls ``heal`` (the self-healing ``_ring`` path
+        does it automatically on a confirmed death); the protocol:
+
+        1. **Abort + fence.** The failed collective already raised a
+           named error (CLEAN-ABORT). Survivors agree on the member list
+           through the store (idempotent rank-keyed alive publication,
+           grace window, first-writer-wins proposal by the lowest
+           surviving original rank — the same split-brain-free shape as
+           ``shrink``), then bump the group generation: every comm —
+           kept wiring included — stamps the new epoch on outbound
+           frames and FENCES inbound frames of any other generation at
+           the vtable boundary, so the aborted attempt's in-flight
+           frames (whose hop/frame tags the retry will reuse) can never
+           corrupt a post-heal reduction.
+        2. **Re-wire.** The surviving ring is repaired AROUND the dead:
+           edges whose both endpoints stay ring-adjacent are KEPT (their
+           stale traffic is epoch-fenced on arrival); only the gaps over
+           dead ranks are re-dialed, through per-epoch store keys, with
+           refused/flaky connects retried under the shared backoff
+           (FaultNet-visible). P2P wiring is torn down (streams to a
+           renumbered peer are meaningless); the store's liveness table
+           is pruned of orphaned rank ids so the compacted numbering
+           re-registers cleanly; barrier counters reset under the new
+           epoch's namespace.
+        3. **Re-arm.** The wired barrier doubles as the new epoch's
+           clock-sync mark; the watchdog (if it was running) restarts on
+           the new membership.
+
+        Returns the new member list (original ranks). Raises for a rank
+        that misses the window (it must exit — the group moved on), and
+        keeps the same store-must-survive requirement as ``shrink``.
+        ``_suspects`` (internal): current-rank ids the caller's triage
+        already confirmed dead — lets the grace window close early."""
+        if self._destroyed:
+            raise RuntimeError("cannot heal a destroyed group")
+        if self.world_size == 1 or self._client is None:
+            raise RuntimeError("nothing to heal: single-rank group")
+        import json
+
+        from rocnrdma_tpu.transport.backoff import poll_backoff
+        t = self.timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + t + grace_s
+        remaining = lambda: max(0.1, deadline - time.monotonic())
+        epoch = self.epoch + 1
+        g = self._ranks[self.rank]
+        ns = f"pg/{self.group_name}/heal/e{epoch}"
+        _FLIGHT.record("heal-start", epoch=epoch, rank=g)
+        with self._health_lock:
+            wd_dead = list(self._dead)
+        suspects = {self._ranks[r] for r in wd_dead
+                    if 0 <= r < len(self._ranks)}
+        suspects |= {self._ranks[r] for r in (_suspects or ())
+                     if 0 <= r < len(self._ranks)}
+        was_watching = self._watchdog_params
+        self.stop_watchdog()
+        try:
+            return self._heal_protocol(grace_s, epoch, g, ns, suspects,
+                                       remaining, was_watching)
+        except BaseException as e:
+            # a FAILED heal (store flake, missed window, divergence) must
+            # not leave failure detection silently off: the watchdog the
+            # protocol stopped is re-armed before the error propagates,
+            # so a later heal attempt — or async_error() — still sees
+            # the world
+            _FLIGHT.record("heal-abort", epoch=epoch,
+                           error=type(e).__name__)
+            if was_watching is not None:
+                self.start_watchdog(*was_watching)
+            raise
+
+    def _heal_protocol(self, grace_s, epoch, g, ns, suspects,
+                       remaining, was_watching) -> list:
+        """The body of :meth:`heal` steps 1-3, run with the watchdog
+        stopped — split out so heal's failure path can re-arm the
+        detector around ANY exit (see the wrapper's except)."""
+        import json
+
+        from rocnrdma_tpu.transport.backoff import poll_backoff
+        # 1. idempotent rank-keyed alive publication + grace window. The
+        # early exits: everyone posted (spurious heal), or every member
+        # is accounted for — posted alive or triage-confirmed dead. A
+        # merely-slow rank that posts inside the grace is admitted; one
+        # that misses the window raises below and must exit (the same
+        # contract shrink documents). The alive VALUE is this rank's
+        # committed-collective count: the divergence check below needs
+        # every survivor to agree on which op a retry re-executes.
+        self._client.set(f"{ns}/alive/{g}", str(self._op_seq))
+        grace_deadline = time.monotonic() + grace_s
+        back = poll_backoff()
+        while True:
+            alive = [m for m in self._ranks
+                     if self._client.try_get(f"{ns}/alive/{m}") is not None]
+            if len(alive) == len(self._ranks):
+                break
+            if alive and not (set(self._ranks) - set(alive) - suspects):
+                break
+            if time.monotonic() >= grace_deadline:
+                break
+            back.pause()
+        if not alive:
+            raise TimeoutError(
+                f"heal: no alive keys readable after {grace_s}s grace "
+                f"(store unreachable? group {self.group_name!r})")
+        if g == min(alive):
+            self._client.set_if_absent(f"{ns}/members", json.dumps(alive))
+        members = json.loads(self._client.get(f"{ns}/members", remaining()))
+        if g not in members:
+            raise RuntimeError(
+                f"rank {g} missed the heal window; group re-formed as "
+                f"{members} without it — exit")
+        dead = sorted(set(self._ranks) - set(members))
+        old_ranks, old_world = self._ranks, self.world_size
+        new_rank, new_world = members.index(g), len(members)
+        _FLIGHT.record("heal-members", epoch=epoch,
+                       members=json.dumps(members), dead=json.dumps(dead))
+        # divergence check: a death can straddle a commit boundary — a
+        # survivor whose last inbound frames did not depend on the victim
+        # COMMITS the interrupted collective while downstream survivors
+        # abort it. Those two populations would retry DIFFERENT ops (with
+        # reused tags, and with full- vs shrunk-group semantics for the
+        # same round), which no fence can reconcile — so it must be a
+        # NAMED failure, never a silent mix. Every survivor published its
+        # committed count in its alive key; disagreement aborts the heal
+        # on every rank (restart from the last application checkpoint).
+        seqs = {m: self._client.try_get(f"{ns}/alive/{m}") for m in members}
+        if len({v for v in seqs.values() if v is not None}) > 1:
+            _FLIGHT.record("heal-diverged", epoch=epoch,
+                           seqs=json.dumps(seqs, sort_keys=True))
+            raise RuntimeError(
+                f"heal: survivors diverged across the failed collective "
+                f"(committed-op counts {seqs}); some ranks committed the "
+                f"op others must retry — transparent retry is impossible, "
+                f"restart the job from its last checkpoint")
+        # 2. the fence goes up BEFORE any rewiring: every comm (kept or
+        # new) now stamps the new generation; stale stashed frames are
+        # fenced+counted; LG credit and put-ring state reset
+        self._net.set_epoch(epoch)
+        self._teardown_p2p()
+        self._rewire(members, new_rank, new_world, old_ranks, ns, remaining)
+        self.rank, self.world_size, self._ranks = new_rank, new_world, members
+        self.epoch = epoch
+        self._barrier_no = 0
+        self._postmortemed = False
+        # the store identity follows the new numbering (liveness stamps,
+        # barrier arrivals); the ORIGINAL identity lives on in _ranks
+        self._client.rank = new_rank
+        self._client.barrier(f"{ns}/wired", new_world, remaining())
+        # every survivor has re-stamped under its new id at the barrier;
+        # the leader prunes the ids the compaction orphaned so nothing
+        # stale can brand a live rank dead (satellite: bootstrap prune)
+        if g == min(members) and new_world < old_world:
+            try:
+                self._client.prune(range(new_world, old_world),
+                                   prefix=f"pg/{self.group_name}/")
+            except (OSError, TimeoutError):
+                pass  # hygiene, not correctness: stale ids age out of use
+        # the wired barrier doubles as the new epoch's clock handshake
+        # (obs.chrome aligns rank timelines on the LAST sync mark)
+        _FLIGHT.mark_sync(ns=ns, rank=new_rank)
+        self._heals += 1
+        _FLIGHT.record("heal-done", epoch=epoch, world=new_world)
+        if was_watching is not None:
+            self.start_watchdog(*was_watching)
+        return members
+
+    def _rewire(self, members, new_rank, new_world, old_ranks, ns,
+                remaining) -> None:
+        """Repair the ring around the dead: keep edges whose endpoints
+        stay ring-adjacent (stale frames on them are epoch-fenced), dial
+        fresh connections across the gaps. Publish-before-dial ordering
+        makes any pattern of gaps deadlock-free, exactly as in
+        ``bootstrap_ring``."""
+        from rocnrdma_tpu.transport.backoff import retry_with_backoff
+
+        def succ_of(gid, ring):
+            return ring[(ring.index(gid) + 1) % len(ring)]
+
+        g = old_ranks[self.rank]
+        if new_world == 1:
+            # the ring degenerates: this survivor is alone
+            for comm in (self._send, self._recv):
+                if comm is not None:
+                    self._close_comm_quietly(comm)
+            self._send = self._recv = None
+            _FLIGHT.record("heal-rewire", kept_send=False, kept_recv=False)
+            return
+        succ_g = members[(new_rank + 1) % new_world]
+        pred_g = members[(new_rank - 1) % new_world]
+        keep_send = succ_of(g, old_ranks) == succ_g
+        keep_recv = succ_of(pred_g, old_ranks) == g
+        listener = send_comm = recv_comm = None
+        try:
+            if not keep_recv:
+                handle, listener = self._net.listen()
+                self._client.set(f"{ns}/h/{g}", handle)
+            if not keep_send:
+                if self._send is not None:
+                    self._close_comm_quietly(self._send)
+                    self._send = None
+                peer_handle = self._client.get(f"{ns}/h/{succ_g}",
+                                               remaining())
+                send_comm = retry_with_backoff(
+                    lambda: self._net.connect(0, peer_handle,
+                                              min(5.0, remaining())),
+                    remaining(),
+                    f"heal rewire: connect to original rank {succ_g}",
+                    retry_on=(ConnectionRefusedError, ConnectionResetError))
+                self._send = send_comm
+            if not keep_recv:
+                if self._recv is not None:
+                    self._close_comm_quietly(self._recv)
+                    self._recv = None
+                recv_comm = retry_with_backoff(
+                    lambda: self._net.accept(listener,
+                                             min(5.0, remaining())),
+                    remaining(),
+                    f"heal rewire: accept original rank {pred_g}",
+                    retry_on=(ConnectionRefusedError, ConnectionResetError,
+                              TimeoutError))
+                self._recv = recv_comm
+        except BaseException as e:
+            # a failed repair must not leak the half-made endpoints (the
+            # bootstrap_ring teardown discipline) and must leave a
+            # flight event for the postmortem
+            _FLIGHT.record("heal-abort", epoch=self.epoch + 1,
+                           error=type(e).__name__)
+            if send_comm is not None:
+                self._close_comm_quietly(send_comm)
+            if recv_comm is None and listener is not None:
+                bootstrap._close_quietly(listener)
+            raise
+        _FLIGHT.record("heal-rewire", kept_send=keep_send,
+                       kept_recv=keep_recv)
+
+    def _close_comm_quietly(self, comm) -> None:
+        """Best-effort comm teardown on the heal path — the peer may be
+        the dead rank itself; its half of the wire cannot make this
+        worse than closed."""
+        try:
+            self._net.close_comm(comm)
+        except Exception:
+            pass
+
+    def _teardown_p2p(self) -> None:
+        """Drop all p2p wiring at a heal: peers renumber, so cached
+        wires, sequence counters, and published listeners are meaningless
+        in the new epoch (p2p streams do not survive a heal — the same
+        'failed send leaves the stream undefined' contract as before)."""
+        for (peer, d), wire in list(self._p2p.items()):
+            self._close_comm_quietly(wire.recv_comm if d == "rx"
+                                     else wire.send_comm)
+        self._p2p.clear()
+        if self._p2p_listen and self.plane == "shm":
+            # as in destroy(): never-accepted shm listeners hold segments
+            # the net does not track
+            for peer, listener in self._p2p_listen.items():
+                if peer not in self._p2p_accepted:
+                    bootstrap._close_quietly(listener)
+        self._p2p_listen = None
+        self._p2p_accepted = set()
+        self._p2p_seq.clear()
+
     # -- watchdog (the ProcessGroupNCCL watchdog / RCCL heartbeat analogue) --
 
     def start_watchdog(self, interval_s: float = 1.0,
@@ -800,7 +1232,12 @@ class ProcessGroup:
         with self._health_lock:
             self._watchdog_failed = None
             self._dead = []
-        ns = f"pg/{self.group_name}/hb"
+        # remembered so heal() can re-arm the detector on the healed
+        # membership with the same cadence; the hb namespace is epoch-
+        # qualified — re-ranked ids must not read a dead generation's
+        # beats (or death flags) as their own
+        self._watchdog_params = (interval_s, timeout_s)
+        ns = f"pg/{self.group_name}/hb/e{self.epoch}"
 
         def run():
             client = None
@@ -887,6 +1324,11 @@ class ProcessGroup:
         s["overlap_ratio"] = round(_WIRE.overlap_ratio(), 4)
         s.update(_WIRE.negotiation())
         s["verb_latency"] = _VERB_LAT.snapshot()
+        # the recovery gauges: which group generation this rank runs on
+        # (frames_fenced in the snapshot above counts the stale frames
+        # the epoch fence dropped), and how many heals got it here
+        s["epoch"] = self.epoch
+        s["heals"] = self._heals
         return s
 
     def dead_ranks(self) -> list:
@@ -937,6 +1379,7 @@ class ProcessGroup:
                 f"(a collective would hang on the dead)")
 
     def stop_watchdog(self) -> None:
+        self._watchdog_params = None
         if self._watchdog is not None:
             self._watchdog_stop.set()
             self._watchdog.join(timeout=5.0)
@@ -1005,7 +1448,8 @@ def init_process_group(rank: int | None = None,
                        timeout_s: float = 30.0,
                        group_name: str = "default",
                        plane: str = "tcp",
-                       fault_schedule=None) -> ProcessGroup:
+                       fault_schedule=None,
+                       self_heal: bool = False) -> ProcessGroup:
     """Create this process's :class:`ProcessGroup`.
 
     Rendezvous: either pass ``store_handle`` (an already-running
@@ -1022,6 +1466,14 @@ def init_process_group(rank: int | None = None,
     ``fault_schedule``: a ``transport.faults.FaultSchedule`` to wrap the
     net plane in a fault-injecting ``FaultNet`` — the chaos-testing hook
     (construct it with this rank, so streams stay per-rank).
+
+    ``self_heal``: opt into elastic recovery — when a collective aborts
+    on a CONFIRMED-dead peer (watchdog flag, or store silence past the
+    watchdog window), the group heals in place (:meth:`ProcessGroup.heal`:
+    epoch bump + ring repair around the dead) and transparently retries
+    the collective on the survivors. Off by default: a shrunk-group
+    result is a different answer than the full-group one, and the caller
+    must have opted into that semantic.
     """
     rank = int(os.environ["RANK"]) if rank is None else rank
     world_size = (int(os.environ["WORLD_SIZE"]) if world_size is None
@@ -1043,8 +1495,11 @@ def init_process_group(rank: int | None = None,
     try:
         return ProcessGroup(rank, world_size, store_handle, server,
                             timeout_s, group_name, plane,
-                            fault_schedule=fault_schedule)
-    except BaseException:
+                            fault_schedule=fault_schedule,
+                            self_heal=self_heal)
+    except BaseException as e:
+        _FLIGHT.record("group-abort", group=group_name, rank=rank,
+                       error=type(e).__name__)
         if server is not None:  # failed rendezvous must free the master port
             server.close()
         raise

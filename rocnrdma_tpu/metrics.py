@@ -138,6 +138,7 @@ class WireCounters:
     frames_streamed: int = 0        # frames landed/combined in place
     frames_copied: int = 0          # frames that took a staging copy
     frames_overlapped: int = 0      # streamed frames that beat the consumer
+    frames_fenced: int = 0          # stale-epoch frames dropped at the vtable
 
     def __post_init__(self):
         # not a dataclass field: asdict()/snapshot() must stay pure counters
@@ -166,6 +167,15 @@ class WireCounters:
         """Record streamed frames whose transfer beat the consume loop."""
         with self._lock:
             self.frames_overlapped += frames
+
+    def fenced(self, frames: int = 1) -> None:
+        """Record stale-epoch frames dropped at the vtable boundary (the
+        epoch fence of the self-healing process group: a frame stamped
+        with a pre-heal group generation can never reach a post-heal
+        reduction — it is counted here and on the flight timeline as an
+        ``epoch-fenced`` event instead of being delivered)."""
+        with self._lock:
+            self.frames_fenced += frames
 
     def negotiated(self, frame_bytes: int, pipeline_depth: int) -> None:
         """Record the frame size / pipeline depth the ring wire chose for
@@ -215,6 +225,7 @@ class WireCounters:
             self.frames_streamed = 0
             self.frames_copied = 0
             self.frames_overlapped = 0
+            self.frames_fenced = 0
             self._frame_bytes = 0
             self._pipeline_depth = 0
 

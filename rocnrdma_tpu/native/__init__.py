@@ -317,11 +317,21 @@ class _QpBase(_Closeable):
     def _fn(self, op: str):
         return getattr(_load(), f"{self._PREFIX}_{op}")
 
+    def _guard(self) -> None:
+        # a verb on a CLOSED queue pair would hand freed native state to
+        # C — observed as a segfault when a stale request handle pumped
+        # its comm after an elastic heal's p2p teardown. Refuse here, in
+        # Python, with the named error the failure contract promises.
+        if self._closed:
+            raise OSError(f"{self._PREFIX}: queue pair {self.name!r} "
+                          f"is closed")
+
     # -- work requests -----------------------------------------------------
 
     def post_send(self, data: bytes) -> int:
         """Queue ``data`` for the peer; wr_id, or -1 on backpressure (retry),
         or -2 when the connection is dead."""
+        self._guard()
         data = bytes(data)
         if len(data) > self.MAX_MSG:
             # ctypes would silently wrap the u32 length — a >4 GiB payload
@@ -352,6 +362,7 @@ class _QpBase(_Closeable):
         send path: ``payload`` may be any C-contiguous buffer and is
         borrowed, not serialized). wr_id, -1 on backpressure (retry), -2
         when the connection is dead."""
+        self._guard()
         data, n = _as_cbuf(payload)
         if len(hdr) + n > self.MAX_MSG:
             raise ValueError(
@@ -364,6 +375,7 @@ class _QpBase(_Closeable):
         ``buf``: an optional recycled bytearray (exactly ``nbytes`` long) to
         post instead of allocating — the comm-level buffer pool hands frames
         back here so the steady state allocates nothing."""
+        self._guard()
         if buf is None or len(buf) != nbytes:
             buf = bytearray(nbytes)
         cbuf = (ctypes.c_char * nbytes).from_buffer(buf)
@@ -378,6 +390,7 @@ class _QpBase(_Closeable):
         backing bytearray — recyclable via ``post_recv(buf=...)`` once the
         consumer is done; ``bytes(payload)`` if it must outlive the pool).
         Completions stashed by a blocking helper are replayed first."""
+        self._guard()
         out = self._pending_cqes
         self._pending_cqes = []
         arr = (_CQE * max_cqes)()
@@ -429,6 +442,7 @@ class _QpBase(_Closeable):
         band (e.g. over ``send``); the peer then moves bytes with
         ``rdma_write`` / ``rdma_read`` while this side's CPU stays out of
         the path."""
+        self._guard()
         rkey = self._fn("reg_mr")(self._h, nbytes)
         if rkey < 0:
             raise OSError(f"{self._PREFIX}: MR registration of {nbytes} B "
@@ -443,6 +457,7 @@ class _QpBase(_Closeable):
         by ``rkey`` at ``offset``; wr_id (CQE opcode OP_WRITE), -1 on
         backpressure, raises on invalid rkey/bounds (shm plane detects
         locally)."""
+        self._guard()
         data, _n = _as_cbuf(data)
         if len(data) > self.MAX_MSG:
             raise ValueError(
@@ -471,6 +486,7 @@ class _QpBase(_Closeable):
         status ERR_REMOTE if the target denied the access). The buffer must
         stay alive until the completion is polled — it IS the registered
         local MR, verbs-style."""
+        self._guard()
         n = len(into)
         if n > self.MAX_MSG:
             raise ValueError(

@@ -30,6 +30,21 @@ Tasks:
   ``os._exit``\\ s (no FIN, no teardown) at the half-way round while its
   peers are already inside the collective; survivors must surface a named
   clean abort (exit 4), never hang to a harness kill.
+- ``kill-and-heal``: the SELF-HEALING path — a ``ProcessGroup`` (shm
+  plane, watchdog on, ``self_heal=True``) over a FaultNet whose
+  ``--kill-ranks``/``--kill-ops`` pairs hard-kill victims at
+  deterministic points of their own op sequences (``os._exit`` mid-
+  collective, the SIGKILLed-host analogue). Survivors must heal
+  automatically (epoch bump, ring repair around the dead) and finish
+  EVERY round with the int64 bitwise oracle of the then-current member
+  set — exit 0, with the heal/epoch/fence telemetry printed for the
+  soak harness (``EPOCH``/``MEMBERS``/``FENCED``/``HEALLOG`` lines next
+  to the usual ``FAULTS``/``FAULTLOG``). Exit 4 = clean named abort
+  (allowed only for ranks that miss a heal window), 5 = silent
+  corruption (never acceptable). Two runs of one seed must print
+  identical FAULTLOG and HEALLOG lines on every survivor: kills are
+  keyed in op space and heal events carry only membership/epoch data,
+  so the fault+heal timeline is a pure function of the seed.
 """
 
 from __future__ import annotations
@@ -37,7 +52,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective")
+CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective", "kill-and-heal")
 
 
 def _chaos_input(seed: int, rank: int, rnd: int, size: int):
@@ -134,6 +149,143 @@ def _chaos_main(args) -> int:
     return status
 
 
+def _heal_log() -> str:
+    """Stable digest of this rank's heal timeline: the ``heal-*`` flight
+    events with timestamps stripped. Their args carry only membership,
+    epoch, and edge-keep data — deterministic per seed (kills land in op
+    space, membership is a function of who died), so two runs of one
+    seed must digest identically on every survivor."""
+    import hashlib
+    import json
+
+    from rocnrdma_tpu.obs import FLIGHT
+    events = [(kind, args) for _, kind, args in FLIGHT.events()
+              if kind.startswith("heal-")]
+    return hashlib.sha256(
+        json.dumps(events, default=str, sort_keys=True).encode()).hexdigest()
+
+
+def _heal_chaos_main(args) -> int:
+    import numpy as np
+
+    from rocnrdma_tpu import distributed as dist
+    from rocnrdma_tpu.metrics import WIRE
+    from rocnrdma_tpu.transport import bootstrap
+    from rocnrdma_tpu.transport.faults import FaultSchedule
+
+    rank, n = args.process_id, args.num_processes
+    kill = dict(zip(
+        (int(r) for r in (args.kill_ranks or "").split(",") if r),
+        (int(o) for o in (args.kill_ops or "").split(",") if o)))
+    server = None
+    if rank == 0:
+        host, port = args.coordinator.rsplit(":", 1)
+        server = bootstrap.BootstrapServer(n_ranks=n, port=int(port),
+                                           host=host)
+    # the heal chaos profile: refused + flaky connects (the heal-time
+    # re-dials must retry them under the shared backoff), delayed
+    # completions (stale frames pile up unreported at the abort, so the
+    # epoch fence provably fires), and the op-keyed hard kill on the
+    # victims. Every class replays deterministically: decisions key off
+    # the rank's own op/attempt sequence, and the abort points are data-
+    # flow-determined (the victim's last op bounds what could ever be
+    # delivered), not wall-clock-determined.
+    sched = FaultSchedule(
+        args.seed, rank,
+        connect_refusals=1, connect_flake_p=0.2,
+        test_delay_p=0.3, test_delay_polls=(1, 4),
+        kill_after_ops=kill.get(rank))
+    status = 0
+    pg = None
+    try:
+        pg = dist.init_process_group(
+            rank=rank, world_size=n, store_handle=args.coordinator,
+            timeout_s=20.0, group_name=f"heal{args.seed}", plane="shm",
+            fault_schedule=sched, self_heal=True)
+        pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
+        for rnd in range(args.rounds):
+            # a neighbour ping IN FLIGHT across every round's collective:
+            # posted before the allreduce, drained after it. The p2p
+            # plane is pumped only by p2p verbs, so at a kill-round abort
+            # the predecessor's ping provably sits undelivered — the
+            # frames the heal's epoch bump must fence (what the
+            # `FENCED > 0` acceptance asserts), with deterministic count
+            ping = None
+            if pg.world_size > 1:
+                succ = (pg.rank + 1) % pg.world_size
+                pred = (pg.rank - 1) % pg.world_size
+                pred_gid = pg.global_ranks[pred]
+                ping = pg.batch_isend_irecv([
+                    ("recv", np.empty(64, np.int64), pred, rnd % 60),
+                    ("send", _chaos_input(args.seed, rank, rnd, 64),
+                     succ, rnd % 60),
+                ], timeout_s=5.0)
+            local = _chaos_input(args.seed, rank, rnd, args.size)
+            got = pg.all_reduce(local, timeout_s=5.0)
+            # the oracle of the CURRENT membership: contributions are
+            # keyed by ORIGINAL rank (pg.global_ranks survives re-
+            # ranking), so a post-heal round sums exactly the survivors
+            members = pg.global_ranks
+            want = _chaos_input(args.seed, members[0], rnd, args.size)
+            for m in members[1:]:
+                want = want + _chaos_input(args.seed, m, rnd, args.size)
+            if not np.array_equal(got, want):
+                print(f"BAD-RESULT: round {rnd} not bitwise-correct on "
+                      f"epoch {pg.last_op_epoch} members {members}",
+                      flush=True)
+                status = 5
+                break
+            if ping is not None:
+                try:
+                    heard = ping[0].wait()
+                    ping[1].wait()
+                except (TimeoutError, OSError, RuntimeError):
+                    # the collective healed mid-round: the ping's wiring
+                    # died with the old epoch (its stale frames were
+                    # fenced, which is the point) — the stream restarts
+                    # fresh next round
+                    pass
+                else:
+                    if not np.array_equal(
+                            heard, _chaos_input(args.seed, pred_gid,
+                                                rnd, 64)):
+                        print(f"BAD-RESULT: round {rnd} ping from "
+                              f"original rank {pred_gid} corrupted",
+                              flush=True)
+                        status = 5
+                        break
+        if status == 0:
+            print(f"OK rank={rank}/{n} rounds={args.rounds} "
+                  f"now-rank={pg.rank}/{pg.world_size}", flush=True)
+            print(f"EPOCH {pg.epoch}", flush=True)
+            print(f"MEMBERS {pg.global_ranks}", flush=True)
+            pg.stop_watchdog()
+            pg.destroy(graceful=True)
+            pg = None
+    except (TimeoutError, OSError, RuntimeError) as e:
+        # allowed only for a rank that missed a heal window (it must
+        # exit); the soak asserts no survivor actually takes this path
+        print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+        status = 4
+    finally:
+        print(f"FENCED {WIRE.snapshot()['frames_fenced']}", flush=True)
+        print(f"FAULTS {sched.counters.to_json()}", flush=True)
+        print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        print(f"HEALLOG {_heal_log()}", flush=True)
+        from rocnrdma_tpu.obs import chrome
+        chrome.dump_if_env(rank)
+        if pg is not None:
+            try:
+                pg.destroy(graceful=False)
+            except (OSError, TimeoutError):
+                pass
+        if server is not None:
+            if status == 0:
+                server.wait_idle(timeout_s=5.0)
+            server.close()
+    return status
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="mp_worker")
     p.add_argument("--coordinator", required=True)
@@ -147,8 +299,15 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--rounds", type=int, default=10)
     p.add_argument("--size", type=int, default=2048)
+    p.add_argument("--kill-ranks", default=None,
+                   help="kill-and-heal: comma list of victim ranks")
+    p.add_argument("--kill-ops", default=None,
+                   help="kill-and-heal: per-victim op counts at which "
+                        "the hard kill lands (paired with --kill-ranks)")
     args = p.parse_args(argv)
 
+    if args.task == "kill-and-heal":
+        return _heal_chaos_main(args)  # host plane only: no jax
     if args.task in CHAOS_TASKS:
         return _chaos_main(args)  # host plane only: no jax, no devices
 

@@ -37,7 +37,9 @@ def free_port() -> int:
 def run_workers(n: int, task: str, timeout_s: float = 120.0,
                 fault_rank: int | None = None, seed: int | None = None,
                 rounds: int | None = None,
-                size: int | None = None) -> list[WorkerResult]:
+                size: int | None = None,
+                kill_ranks: str | None = None,
+                kill_ops: str | None = None) -> list[WorkerResult]:
     """Spawn ``n`` worker processes running ``task``; wait for all.
 
     A worker that outlives ``timeout_s`` is killed and reported with
@@ -47,7 +49,8 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
 
     ``seed``/``rounds``/``size`` parameterize the chaos tasks (see
     ``mp_worker``); ``fault_rank`` picks the victim for ``fault`` and
-    ``die-mid-collective``."""
+    ``die-mid-collective``; ``kill_ranks``/``kill_ops`` (comma lists)
+    place the ``kill-and-heal`` task's deterministic op-space kills."""
     coordinator = f"127.0.0.1:{free_port()}"
     procs = []
     env = dict(os.environ)
@@ -56,7 +59,8 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
     extra = (["--fault-rank", str(fault_rank)] if fault_rank is not None
              else [])
     for flag, val in (("--seed", seed), ("--rounds", rounds),
-                      ("--size", size)):
+                      ("--size", size), ("--kill-ranks", kill_ranks),
+                      ("--kill-ops", kill_ops)):
         if val is not None:
             extra += [flag, str(val)]
     for i in range(n):

@@ -145,6 +145,24 @@ class BootstrapServer:
                                  if sc == scope}}
             if op == "hb":
                 return {"ok": True}  # the stamp above was the point
+            if op == "prune":
+                # epoch-bump hygiene (ProcessGroup.heal): drop the named
+                # rank ids' liveness stamps for this scope and their
+                # arrivals from every barrier under ``prefix``, so a
+                # rank id orphaned (or freed by a death) in one group
+                # generation can re-register in the next without a stale
+                # stamp branding it dead or a stale arrival tripping the
+                # duplicate-arrival guard. Idempotent per rank set, like
+                # every other op — safe to replay over a reconnect.
+                ranks = {int(r) for r in req.get("ranks", ())}
+                for r in ranks:
+                    self._last_seen.pop((scope, r), None)
+                prefix = req.get("prefix")
+                if prefix:
+                    for key, arrived in self._barriers.items():
+                        if key.startswith(prefix):
+                            arrived -= ranks
+                return {"ok": True}
             if op == "bye":
                 return {"ok": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
@@ -301,6 +319,15 @@ class BootstrapClient:
                 raise TimeoutError(f"bootstrap barrier {key!r} timed out")
             back.pause()
 
+    def prune(self, ranks, prefix: str | None = None) -> None:
+        """Remove ``ranks``' liveness-table entries for this client's
+        scope (and, with ``prefix``, their arrivals from every barrier
+        key under it) — the epoch-bump cleanup ``ProcessGroup.heal``'s
+        leader runs so re-ranked survivors can re-register the freed
+        rank ids cleanly."""
+        self._rpc(op="prune", ranks=sorted(int(r) for r in ranks),
+                  prefix=prefix)
+
     def heartbeat(self) -> None:
         """Stamp this rank's liveness without any other side effect (every
         RPC stamps implicitly; this is for idle ranks that want to stay
@@ -401,14 +428,18 @@ def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
         # merger (obs.chrome) aligns rank timelines on this event — the
         # bootstrap handshake doubling as the clock handshake
         _FLIGHT.mark_sync(ns=ns, rank=rank)
-    except BaseException:
+    except BaseException as e:
         # a failed wiring must not leak what it made: any half-wired comm,
         # the listener when nothing was ever accepted on it (on the shm
         # plane the listener IS a queue pair holding a segment; once
         # accepted it became recv_comm, closed above — TCP listeners are
         # net-tracked either way), and the store connection. Closes are
         # idempotent, so the net-level close() of registered comms later
-        # is a harmless second no-op.
+        # is a harmless second no-op. The abort leaves a flight event
+        # (the analyzer's abort-path rule): which wiring step died is
+        # exactly what the next postmortem needs.
+        _FLIGHT.record("bootstrap-abort", ns=ns, rank=rank,
+                       error=type(e).__name__)
         if send_comm is not None:
             _close_quietly(send_comm)
         if recv_comm is not None:

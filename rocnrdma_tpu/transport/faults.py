@@ -80,6 +80,11 @@ class FaultSchedule:
     # death-plane faults
     die_after_ops: int | None = None        # OSError on every op past N
     partition_after_ops: int | None = None  # silent blackhole past N
+    kill_after_ops: int | None = None       # os._exit(7) AT op N: the
+    #   SIGKILLed-host analogue (no FIN, no teardown, no destructors),
+    #   keyed on the rank's own op sequence instead of wall clock so a
+    #   kill-and-heal chaos run replays deterministically — every peer
+    #   sees byte-for-byte the same pre-death traffic on every run
     close_drop_p: float = 0.0       # prob a close_comm skips teardown
 
     def __post_init__(self):
@@ -142,6 +147,14 @@ class FaultSchedule:
         """Called once per data op (isend/irecv); returns the death mode
         in force, if any."""
         self.ops += 1
+        if self.kill_after_ops is not None and self.ops >= self.kill_after_ops:
+            # the hard kill: mid-collective, mid-frame-stream, skipping
+            # every destructor — exactly a SIGKILLed host, but landed at
+            # a deterministic point of this rank's own op sequence
+            import os
+            self.record("killed", verb)
+            print(f"FAULT: killed at op {self.ops} ({verb})", flush=True)
+            os._exit(7)
         if self.die_after_ops is not None and self.ops > self.die_after_ops:
             self.record("comm-dead", verb)
             return "dead"
@@ -224,6 +237,15 @@ class FaultNet:
 
     def reg_mr(self, comm, buffer):
         return self.inner.reg_mr(comm, buffer)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Passthrough: the epoch fence lives at the inner plane's comm
+        boundary (``_HostComm._pump``), BELOW fault injection — injected
+        faults and the generation fence compose (a delayed completion
+        whose frame went stale is fenced at true delivery, deterministic
+        under replay because the fence keys off frame contents, not
+        timing)."""
+        self.inner.set_epoch(epoch)
 
     def _dead_mode(self, verb: str) -> str | None:
         mode = self.schedule.op_fault(verb)

@@ -153,13 +153,20 @@ class _HostComm:
     def __init__(self, qp, net=None):
         self.qp = qp
         self._net = net
+        # the group-generation (epoch) this comm stamps on every outbound
+        # frame and requires on every inbound one: inherited from the
+        # owning net at creation, advanced by the net's set_epoch verb.
+        # A frame carrying any OTHER epoch is dropped at the vtable
+        # boundary (_pump) — the fence that keeps late packets from
+        # pre-heal wiring out of post-heal reductions.
+        self.epoch = getattr(net, "_epoch", 0) if net is not None else 0
         # tag -> payloads; entries are ZERO-COPY memoryviews of the posted
-        # receive buffers (poll_cq's contract) with the 4-byte tag sliced
-        # off — a consumer that lands/combines them in place (irecv_into)
-        # recycles the backing bytearray via _recycle
+        # receive buffers (poll_cq's contract) with the 8-byte tag+epoch
+        # header sliced off — a consumer that lands/combines them in place
+        # (irecv_into) recycles the backing bytearray via _recycle
         self._unexpected: dict[int, list] = {}
         self._posted = 0  # receive buffers posted but not yet completed
-        # recycled frame buffers, one size class (MAX_FRAME + 4): the
+        # recycled frame buffers, one size class (MAX_FRAME + 8): the
         # steady state of the streaming ring collectives posts receives
         # from here instead of allocating — zero alloc, zero reg churn
         self._pool: list[bytearray] = []
@@ -195,12 +202,20 @@ class _HostComm:
                                    "returning large-message credit")
             self._lg_ack_queue.pop(0)
 
+    def _hdr(self, tag: int) -> bytes:
+        """The 8-byte wire header every framed message carries:
+        ``tag(4) | epoch(4)``, both little-endian. One builder so the
+        send paths (isend, LG announce/credit/REQ/descriptor) can never
+        disagree with the parser in ``_pump``."""
+        return (tag.to_bytes(4, "little")
+                + self.epoch.to_bytes(4, "little"))
+
     def _pump(self):
         # drain the wire; stash every arrived message by tag
         if self._lg_ack_queue:
             self._flush_lg_acks()
         if self._posted < 4:
-            self.qp.post_recv(HostQPNet.MAX_FRAME + 4,
+            self.qp.post_recv(HostQPNet.MAX_FRAME + 8,
                               buf=self._pool.pop() if self._pool else None)
             self._posted += 1
         got = False
@@ -212,15 +227,28 @@ class _HostComm:
                 if c.status != native.OK:
                     raise OSError(
                         f"host net: truncated message "
-                        f"(> {HostQPNet.MAX_FRAME + 4} B frame)")
+                        f"(> {HostQPNet.MAX_FRAME + 8} B frame)")
                 tag = int.from_bytes(payload[:4], "little")
+                epoch = int.from_bytes(payload[4:8], "little")
+                if epoch != self.epoch:
+                    # THE epoch fence: a frame from another group
+                    # generation (pre-heal wiring, or an aborted
+                    # collective's retry-colliding tags) is dropped at
+                    # the vtable boundary — counted, on the flight
+                    # timeline, never delivered
+                    _WIRE.fenced()
+                    _FLIGHT.record("epoch-fenced", tag=tag,
+                                   frame_epoch=epoch, epoch=self.epoch,
+                                   nbytes=len(payload) - 8)
+                    self._recycle(payload[8:])
+                    continue
                 if tag == HostQPNet._LG_REQ_TAG:
                     # peer blocked in a large send wants my arena announce;
                     # handled AFTER the poll loop (ensure posts a send and
                     # pumps — no mutation under the live CQ iteration)
                     arena_requested = True
                     continue
-                self._unexpected.setdefault(tag, []).append(payload[4:])
+                self._unexpected.setdefault(tag, []).append(payload[8:])
                 got = True
             elif c.opcode in (native.OP_WRITE, native.OP_READ):
                 self._onesided_done[c.wr_id] = (
@@ -238,7 +266,7 @@ class _HostComm:
         pooled; anything else just drops to the GC as before."""
         buf = getattr(payload, "obj", None)
         if (isinstance(buf, bytearray)
-                and len(buf) == HostQPNet.MAX_FRAME + 4
+                and len(buf) == HostQPNet.MAX_FRAME + 8
                 and len(self._pool) < self._POOL_CAP):
             try:
                 payload.release()  # drop the export; post_recv re-borrows
@@ -276,15 +304,17 @@ class HostQPNet:
     reference does during plugin bootstrap.
     """
 
-    # One message per posted recv buffer, minus the 4-byte tag. 512 KiB
-    # (r3, VERDICT r2 item 9 — was 64 KiB): at MiB message sizes the msg
-    # plane's cost is per-FRAME Python work (tag pack, post, poll), so 8x
-    # fewer frames is 8x less of it; the shm ring's default capacity below
-    # holds several frames (pages are lazily allocated — an unused ring
-    # costs nothing), and _pump's 4 posted buffers stay a modest 2 MiB per
-    # comm. Messages past LG_MIN below no longer chunk at all — see the
-    # large-message rendezvous.
-    MAX_FRAME = (1 << 19) - 4
+    # One message per posted recv buffer, minus the 8-byte header
+    # (``tag(4) | epoch(4)`` — the epoch half is the group-generation
+    # fence of the self-healing process group). 512 KiB (r3, VERDICT r2
+    # item 9 — was 64 KiB): at MiB message sizes the msg plane's cost is
+    # per-FRAME Python work (tag pack, post, poll), so 8x fewer frames is
+    # 8x less of it; the shm ring's default capacity below holds several
+    # frames (pages are lazily allocated — an unused ring costs nothing),
+    # and _pump's 4 posted buffers stay a modest 2 MiB per comm. Messages
+    # past LG_MIN below no longer chunk at all — see the large-message
+    # rendezvous.
+    MAX_FRAME = (1 << 19) - 8
 
     # Large-message rendezvous (r4, VERDICT r3 next #8): a message of
     # >= LG_MIN bytes on a one-sided-capable plane is routed INSIDE
@@ -332,6 +362,7 @@ class HostQPNet:
     def __init__(self):
         self._inited = False
         self._comms: list[_HostComm] = []
+        self._epoch = 0  # the group generation new comms inherit
 
     # -- vtable ------------------------------------------------------------
 
@@ -340,6 +371,58 @@ class HostQPNet:
         if not native.available():
             raise OSError("native rqp library unavailable (no g++?)")
         self._inited = True
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the group generation (the elastic-recovery fence,
+        called by ``ProcessGroup.heal`` after a membership change): every
+        comm — kept survivors' wiring included — stamps ``epoch`` on all
+        future frames and DROPS inbound frames carrying any other epoch
+        at the vtable boundary (counted in ``metrics.WIRE`` and recorded
+        as ``epoch-fenced`` flight events). Stale frames already stashed
+        unconsumed are fenced immediately, and per-comm protocol state
+        that an aborted collective may have left dangling resets
+        symmetrically on both ends (large-message arena credit, the
+        put-ring doorbell cache) — the heal's wired barrier orders these
+        resets before any new-epoch traffic."""
+        self._epoch = int(epoch)
+        for comm in self._comms:
+            self._fence_comm(comm)
+
+    def _fence_comm(self, comm: _HostComm) -> None:
+        # pump once before fencing: frames already DELIVERED to this
+        # comm's ring but not yet polled (a p2p plane nothing pumped
+        # during the aborted collective, a burst the consumer abandoned)
+        # must be fenced NOW and counted — not discovered mid-retry. The
+        # comm may be wired to the dead rank itself: a failing pump
+        # cannot make it worse than dead, and the rewire replaces it.
+        try:
+            comm._pump()
+        except Exception:
+            pass
+        stale = sum(len(v) for v in comm._unexpected.values())
+        if stale:
+            _WIRE.fenced(stale)
+            _FLIGHT.record("epoch-fenced", stashed=stale,
+                           epoch=self._epoch)
+            for payloads in comm._unexpected.values():
+                for payload in payloads:
+                    comm._recycle(payload)
+        comm._unexpected.clear()
+        comm.epoch = self._epoch
+        # LG sender-side credit restarts at offset 0 — safe because the
+        # receiver's unconsumed stale puts are dead bytes (single writer
+        # per direction + QP FIFO: any post-heal put overwrites them
+        # before its own descriptor frame can be consumed), and queued
+        # credit ACKs for stale consumption are dropped with the epoch
+        comm._lg_head = 0
+        comm._lg_outstanding = 0
+        comm._lg_ack_queue.clear()
+        # the put-ring doorbell state (hop counters, slot MRs) is
+        # generation-bound: drop the cache so the next rdma collective
+        # re-registers fresh MRs (bump-allocated; stale doorbell writes
+        # land in the abandoned regions, harmlessly)
+        if getattr(comm, "_rdma_ring", None) is not None:
+            comm._rdma_ring = None
 
     def devices(self) -> int:
         return 1
@@ -373,7 +456,12 @@ class HostQPNet:
         qp = native.QueuePair.connect(handle, timeout_s)
         try:
             qp.accept(timeout_s)
-        except BaseException:
+        except BaseException as e:
+            # the abort-path observability rule (tools/analyze/obs.py):
+            # a teardown-and-reraise must leave a flight event, or the
+            # postmortem is blind to exactly the failed wiring step
+            _FLIGHT.record("connect-abort", plane="shm",
+                           error=type(e).__name__)
             qp.close()  # a half-attached QP is not in _comms yet: nothing
             raise       # else would ever release its shm segment
         comm = _HostComm(qp, net=self)
@@ -421,10 +509,10 @@ class HostQPNet:
             req = self._lg_isend(comm, mr, tag, timeout_s, progress)
             _verb_done("isend", t0, tag=tag, nbytes=size)
             return req
-        # scatter-gather post: the native layer prepends the 4-byte tag
-        # inside its one ring/queue memcpy, so the payload is borrowed
-        # zero-copy instead of being serialized twice (bytes(mr) + concat)
-        hdr = tag.to_bytes(4, "little")
+        # scatter-gather post: the native layer prepends the 8-byte
+        # tag+epoch header inside its one ring/queue memcpy, so the
+        # payload is borrowed zero-copy instead of being serialized twice
+        hdr = comm._hdr(tag)
         self._post_backpressured(comm, lambda: comm.qp.post_send2(hdr, mr),
                                  "send ring full", timeout_s, progress)
         # drain our own CQ so send completions don't pile up in the native
@@ -450,13 +538,13 @@ class HostQPNet:
             # instead of spinning to a misleading announce timeout
             comm._lg_dead = True
             ann = (0).to_bytes(8, "little") + (0).to_bytes(8, "little")
-            data = self._LG_RKEY_TAG.to_bytes(4, "little") + ann
+            data = comm._hdr(self._LG_RKEY_TAG) + ann
             self._post_backpressured(comm, lambda: comm.qp.post_send(data),
                                      "send ring full", 10.0, None)
             return
         ann = (comm._lg_mr.rkey.to_bytes(8, "little")
                + self.LG_ARENA.to_bytes(8, "little"))
-        data = self._LG_RKEY_TAG.to_bytes(4, "little") + ann
+        data = comm._hdr(self._LG_RKEY_TAG) + ann
         self._post_backpressured(comm, lambda: comm.qp.post_send(data),
                                  "send ring full", 10.0, None)
 
@@ -478,7 +566,7 @@ class HostQPNet:
         Request.test() must not spin on a full send ring; a deferred ACK
         drains at the next probe/pump of this comm)."""
         _FLIGHT.record("lg-credit-acked", nbytes=length)
-        comm._lg_ack_queue.append(self._LG_ACK_TAG.to_bytes(4, "little")
+        comm._lg_ack_queue.append(comm._hdr(self._LG_ACK_TAG)
                                   + length.to_bytes(8, "little"))
         self._lg_flush_acks(comm)
 
@@ -513,7 +601,7 @@ class HostQPNet:
         # topologies additionally ensure rx comms in their progress engine.
         self._lg_ensure(comm)
         if comm._lg_peer is None:
-            req = self._LG_REQ_TAG.to_bytes(4, "little")
+            req = comm._hdr(self._LG_REQ_TAG)
             self._post_backpressured(comm, lambda: comm.qp.post_send(req),
                                      "send ring full", timeout_s, progress)
         # 1. the peer's arena announce (sent at its comm setup / irecv)
@@ -574,7 +662,7 @@ class HostQPNet:
         # field would silently truncate if LG_ARENA ever grew past 4 GiB)
         desc = (self._LG_MAGIC + offset.to_bytes(8, "little")
                 + need.to_bytes(8, "little"))
-        data = tag.to_bytes(4, "little") + desc
+        data = comm._hdr(tag) + desc
         self._post_backpressured(comm, lambda: comm.qp.post_send(data),
                                  "send ring full", timeout_s, progress)
         comm._pump()
@@ -797,6 +885,13 @@ class HostQPNet:
 
     def close_comm(self, comm: _HostComm) -> None:
         comm.close()
+        # deregister: an elastic group closes comms mid-life (heal's ring
+        # repair, p2p teardown) — left in the registry they would pile up
+        # across heals and every later set_epoch would pump dead handles
+        try:
+            self._comms.remove(comm)
+        except ValueError:
+            pass  # already deregistered (double close is legal)
 
     def close(self) -> None:
         for c in self._comms:

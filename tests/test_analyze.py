@@ -392,6 +392,71 @@ def test_obs_runs_clean_on_the_repo_plugin():
 
 
 # ---------------------------------------------------------------------------
+# pass #4b: abort-path coverage (except-and-reraise must record a flight
+# event — a silent teardown is a postmortem blind spot)
+# ---------------------------------------------------------------------------
+
+
+def test_obs_flags_silent_abort_path():
+    src = textwrap.dedent("""
+        def wire(net, store):
+            qp = net.connect(0, "h")
+            try:
+                qp.handshake()
+            except BaseException:
+                qp.close()
+                raise
+    """)
+    problems = obs.check_abort_source(src, "fix.py")
+    assert any("re-raises without recording a flight event" in p
+               for p in problems), problems
+
+
+def test_obs_accepts_recorded_abort_path():
+    src = textwrap.dedent("""
+        def wire(net, store):
+            qp = net.connect(0, "h")
+            try:
+                qp.handshake()
+            except BaseException as e:
+                _FLIGHT.record("wire-abort", error=type(e).__name__)
+                qp.close()
+                raise
+    """)
+    assert obs.check_abort_source(src, "fix.py") == []
+
+
+def test_obs_abort_rule_ignores_absorbing_handlers():
+    # absorb-and-continue (no raise) is the retry layer's business; only
+    # the re-raising teardown paths must record
+    src = textwrap.dedent("""
+        def poll(qp):
+            try:
+                return qp.recv()
+            except TimeoutError:
+                return None
+
+        def stall(wire, hop, e):
+            try:
+                wire.flush()
+            except TimeoutError as exc:
+                raise wire._stall("flush", hop, None, exc) from exc
+    """)
+    assert obs.check_abort_source(src, "fix.py") == []
+
+
+def test_obs_abort_rule_covers_repo_targets():
+    # the repo surface itself: every except-and-reraise in the transport
+    # abort targets records (run() returning [] pins it); sanity-check
+    # the targets are the intended three files
+    assert set(obs.ABORT_TARGETS) == {
+        "rocnrdma_tpu/transport/plugin.py",
+        "rocnrdma_tpu/distributed.py",
+        "rocnrdma_tpu/transport/bootstrap.py",
+    }
+
+
+# ---------------------------------------------------------------------------
 # pass #3: resource leaks
 # ---------------------------------------------------------------------------
 

@@ -246,3 +246,32 @@ def test_exchange_deadline_holds_against_dead_store():
     assert time.monotonic() - t0 < 6.0, "reconnect budget ignored deadline"
     c._said_bye = True  # skip the bye RPC against the dead store
     c._qp.close()
+
+
+@needs_native
+def test_prune_clears_liveness_and_barrier_arrivals():
+    """The epoch-bump hygiene op (ProcessGroup.heal's leader runs it): a
+    pruned rank id loses its liveness stamp for the scope AND its
+    arrivals at every barrier under the prefix — so a rank id freed by a
+    heal's re-ranking can re-register without a stale stamp branding it
+    dead or a stale arrival tripping the duplicate-arrival guard."""
+    srv = BootstrapServer(n_ranks=2)
+    a = BootstrapClient(srv.handle, rank=0, scope="g")
+    b = BootstrapClient(srv.handle, rank=1, scope="g")
+    try:
+        a.heartbeat()
+        b.heartbeat()
+        assert set(a.live_ages()) == {0, 1}
+        a.barrier("pg/x/w", 1, timeout_s=5.0)  # rank 0's arrival recorded
+        b.prune([0], prefix="pg/x/")
+        assert set(b.live_ages()) == {1}  # the liveness entry is gone
+        # ...and so is the barrier arrival: the key no longer reads done
+        assert not b._rpc(op="barrier_done", key="pg/x/w", n=1)["ok"]
+        # re-registration is clean: the freed id heartbeats and re-arrives
+        a.heartbeat()
+        a.barrier("pg/x/w", 1, timeout_s=5.0)
+        assert set(b.live_ages()) == {0, 1}
+    finally:
+        a.close()
+        b.close()
+        srv.close()

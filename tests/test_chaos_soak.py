@@ -85,6 +85,111 @@ def test_chaos_soak_replayable_from_seed():
     assert all(_faults(r).total() > 0 for r in runs[0])
 
 
+def _line(result, key):
+    m = re.search(rf"^{key} (.+)$", result.stdout, re.M)
+    assert m, f"rank {result.process_id} printed no {key} line:\n" \
+              f"{result.stdout}\n{result.stderr}"
+    return m.group(1)
+
+
+def test_kill_and_heal_retries_on_shrunk_group_replay_equal():
+    """The self-healing acceptance run: 4 ranks, a rank hard-killed
+    (os._exit, no FIN) mid-allreduce at a deterministic op. Survivors
+    must heal AUTOMATICALLY (watchdog triage -> epoch bump -> ring
+    repair around the dead) and finish EVERY round — the kill round
+    included, transparently retried — with the int64 bitwise oracle of
+    the shrunk group (exit 0, never 4/5, never a -9 hang). The epoch
+    fence must have dropped stale pre-heal frames (FENCED > 0 on every
+    survivor: the in-flight neighbour ping is provably undelivered at
+    the abort), and TWO runs of the seed must produce identical fault
+    AND heal timelines on every rank — kills land in op space and heal
+    events carry only membership/epoch data, so the whole failure story
+    replays."""
+    n, seed, rounds, victim = 4, 11, 6, 2
+    runs = [run_workers(n, "kill-and-heal", timeout_s=150.0, seed=seed,
+                        rounds=rounds, kill_ranks=str(victim),
+                        kill_ops="49") for _ in range(2)]
+    for results in runs:
+        rc = {r.process_id: r.returncode for r in results}
+        assert rc[victim] == 7, results[victim].stdout
+        assert "FAULT: killed at op 49" in results[victim].stdout
+        for r in results:
+            assert r.returncode != -9, \
+                f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+            if r.process_id == victim:
+                continue
+            assert r.returncode == 0, \
+                f"survivor {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert _line(r, "EPOCH") == "1"
+            assert _line(r, "MEMBERS") == "[0, 1, 3]"
+            # the epoch fence fired: stale pre-heal frames were counted
+            # out at the vtable boundary, not delivered into the retry
+            assert int(_line(r, "FENCED")) > 0
+    for a, b in zip(*runs):
+        if a.process_id == victim:
+            continue
+        assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
+        assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
+        assert _line(a, "FENCED") == _line(b, "FENCED"), a.process_id
+
+
+def test_kill_straddling_commit_boundary_aborts_named_not_mixed():
+    """A death LATE in a round can straddle the commit boundary: the
+    survivors whose last frames did not depend on the victim COMMIT the
+    round while downstream survivors abort it. The two populations would
+    retry DIFFERENT collectives (reused tags; full- vs shrunk-group
+    semantics for the same round) — no fence can reconcile that, so the
+    heal must detect the divergent committed-op counts at its rendezvous
+    and fail NAMED on every survivor (exit 4), never silently mix (exit
+    5), never hang (-9)."""
+    results = run_workers(4, "kill-and-heal", timeout_s=150.0, seed=11,
+                          rounds=6, kill_ranks="2", kill_ops="55")
+    rc = {r.process_id: r.returncode for r in results}
+    assert rc[2] == 7
+    for r in results:
+        assert r.returncode != -9, \
+            f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+        if r.process_id == 2:
+            continue
+        assert r.returncode == 4, \
+            f"survivor {r.process_id} exited {r.returncode} " \
+            f"(5 = silent corruption):\n{r.stdout}\n{r.stderr}"
+        assert "diverged" in r.stdout, r.stdout
+
+
+@pytest.mark.slow
+def test_heal_soak_two_sequential_kills():
+    """The heal phase of the chaos soak: TWO rank kills mid-soak
+    (sequential — the second victim dies on the already-healed epoch-1
+    group), zero -9, every surviving round bitwise-correct on the
+    then-current membership, and the whole two-heal timeline
+    replay-equal from the seed."""
+    n, seed, rounds = 4, 23, 8
+    runs = [run_workers(n, "kill-and-heal", timeout_s=180.0, seed=seed,
+                        rounds=rounds, kill_ranks="1,3",
+                        kill_ops="33,85") for _ in range(2)]
+    for results in runs:
+        rc = {r.process_id: r.returncode for r in results}
+        assert rc[1] == 7 and rc[3] == 7, rc
+        for r in results:
+            assert r.returncode != -9, \
+                f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+            if r.process_id in (1, 3):
+                continue
+            assert r.returncode == 0, \
+                f"survivor {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert _line(r, "EPOCH") == "2"     # two heals
+            assert _line(r, "MEMBERS") == "[0, 2]"
+            assert int(_line(r, "FENCED")) > 0
+    for a, b in zip(*runs):
+        if a.process_id in (1, 3):
+            continue
+        assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
+        assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
+
+
 def test_die_mid_collective_survivors_abort_named():
     """A rank SIGKILL-style dies inside the collective; every survivor
     surfaces a named TimeoutError/OSError (exit 4) inside its deadline —

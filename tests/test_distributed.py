@@ -1079,3 +1079,203 @@ def test_group_over_faultnet_survives_flaky_wiring(sidecar_store):
     want = np.arange(8, dtype=np.int64) * 3
     for r in range(n):
         np.testing.assert_array_equal(results[r], want)
+
+
+# ---------------------------------------------------------------------------
+# self-healing: epoch-fenced in-place ring repair + exactly-once retry
+# ---------------------------------------------------------------------------
+
+
+def test_heal_repairs_ring_in_place(sidecar_store):
+    """Explicit heal: rank 1 vanishes after round 0; survivors heal the
+    SAME group object — epoch bumps, the ring re-wires around the dead,
+    ranks renumber — and the next collective is bitwise-correct on the
+    shrunk membership."""
+    n = 3
+    store = sidecar_store(n)
+    xs = [np.arange(4, dtype=np.int64) * (r + 1) for r in range(n)]
+
+    def fn(pg):
+        out0 = pg.all_reduce(xs[pg.rank])
+        np.testing.assert_array_equal(out0, xs[0] + xs[1] + xs[2])
+        if pg.rank == 1:
+            return "dead"  # never participates again (destroyed by harness)
+        try:
+            pg.all_reduce(xs[pg.rank], timeout_s=2.0)
+        except (TimeoutError, OSError, RuntimeError):
+            pass  # the CLEAN-ABORT the heal follows
+        members = pg.heal(grace_s=1.5)
+        assert members == [0, 2]
+        assert pg.epoch == 1 and pg.world_size == 2
+        assert pg.global_ranks == [0, 2]
+        out1 = pg.all_reduce(xs[pg.global_ranks[pg.rank]])
+        assert pg.last_op_epoch == 1
+        pg.barrier()  # post-heal barriers run under the e1 namespace
+        return out1
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res[1] == "dead"
+    np.testing.assert_array_equal(res[0], xs[0] + xs[2])
+    np.testing.assert_array_equal(res[2], xs[0] + xs[2])
+
+
+def test_self_heal_auto_retries_collective(sidecar_store):
+    """The automatic path (self_heal=True): the watchdog confirms the
+    death, the aborted collective heals the group and transparently
+    re-executes — the caller just gets the shrunk-group result, with the
+    epoch it committed on recorded."""
+    n = 3
+    store = sidecar_store(n)
+    xs = [np.arange(6, dtype=np.int64) * (r + 1) for r in range(n)]
+
+    def fn(pg):
+        pg.start_watchdog(interval_s=0.2, timeout_s=1.0)
+        out0 = pg.all_reduce(xs[pg.rank])
+        np.testing.assert_array_equal(out0, xs[0] + xs[1] + xs[2])
+        if pg.rank == 1:
+            pg.stop_watchdog()  # heartbeat stops: reads as dead
+            return "dead"
+        out1 = pg.all_reduce(xs[pg.rank], timeout_s=2.5)  # heals inside
+        assert pg.epoch == 1 and pg.last_op_epoch == 1
+        assert pg.global_ranks == [0, 2]
+        pg.stop_watchdog()
+        pg.barrier()
+        return out1
+
+    res = _run_group(n, fn, store_handle=store.handle, self_heal=True)
+    assert res[1] == "dead"
+    np.testing.assert_array_equal(res[0], xs[0] + xs[2])
+    np.testing.assert_array_equal(res[2], xs[0] + xs[2])
+
+
+def test_heal_single_rank_raises():
+    pg = dist.init_process_group(rank=0, world_size=1)
+    try:
+        with pytest.raises(RuntimeError, match="single-rank"):
+            pg.heal()
+    finally:
+        pg.destroy()
+
+
+def test_heal_preserves_input_buffer_exactly_once(sidecar_store):
+    """The exactly-once contract's observable half: the caller's input
+    buffer is untouched by an aborted attempt, so the healed retry
+    re-reads pristine data (a partially-reduced input would double-count
+    contributions)."""
+    n = 3
+    store = sidecar_store(n)
+    xs = [np.full(8, 10 ** r, np.int64) for r in range(n)]
+
+    def fn(pg):
+        orig = pg.rank  # heal re-ranks; the identity check must not move
+        mine = xs[orig].copy()
+        if orig == 1:
+            return "dead"
+        try:
+            pg.all_reduce(mine, timeout_s=2.0)
+        except (TimeoutError, OSError, RuntimeError):
+            pass
+        np.testing.assert_array_equal(mine, xs[orig])  # preserved
+        pg.heal(grace_s=1.5)
+        out = pg.all_reduce(mine)
+        np.testing.assert_array_equal(mine, xs[orig])  # still preserved
+        pg.barrier()
+        return out
+
+    res = _run_group(n, fn, store_handle=store.handle)
+    assert res[1] == "dead"
+    np.testing.assert_array_equal(res[0], xs[0] + xs[2])
+
+
+def test_self_heal_remaps_rooted_collective_root(sidecar_store):
+    """A retried ROOTED collective must follow the root's IDENTITY
+    through the re-ranking: broadcast(src=2) healed from [0,1,2] to
+    [1,2] retries with the new index of ORIGINAL rank 2 — the caller
+    still gets rank 2's buffer, not whoever inherited index 2's slot."""
+    n = 3
+    store = sidecar_store(n)
+    # one >= LG_MIN chunk: the root's large-message send to the dead rank
+    # stalls on the arena announce, so the root ABORTS round 1 like
+    # everyone else (uniform abort -> heal -> retry) instead of
+    # committing it. Kept at 2 MiB — and the watchdog cadence generous —
+    # because these ranks are GIL-sharing THREADS on a loaded CI box: a
+    # tight heartbeat timeout reads scheduler starvation as death and
+    # split-brains the heal (observed at 8 MiB payloads with a 1 s
+    # watchdog under the full suite).
+    nbytes = 2 << 20
+    payload = np.arange(nbytes // 8, dtype=np.int64)
+
+    def fn(pg):
+        pg.start_watchdog(interval_s=0.3, timeout_s=3.0)
+        pg.broadcast(np.zeros(4, np.int64), src=2)  # small epoch-0 round
+        if pg.rank == 0:
+            pg.stop_watchdog()
+            return "dead"
+        x = payload if pg.rank == 2 else np.empty_like(payload)
+        out = pg.broadcast(x, src=2, timeout_s=5.0)  # heals + remaps inside
+        assert pg.epoch == 1 and pg.global_ranks == [1, 2]
+        pg.stop_watchdog()
+        pg.barrier()
+        return out
+
+    res = _run_group(n, fn, store_handle=store.handle, plane="shm",
+                     self_heal=True)
+    assert res[0] == "dead"
+    np.testing.assert_array_equal(res[1], payload)
+    np.testing.assert_array_equal(res[2], payload)
+
+
+def test_self_heal_refuses_retry_when_root_died(sidecar_store):
+    """If the ROOT is the rank that died, the rooted collective cannot
+    retry — the heal still repairs the group, but the verb raises a
+    named error instead of silently sourcing from a surviving rank."""
+    n = 3
+    store = sidecar_store(n)
+
+    def fn(pg):
+        pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
+        pg.barrier()
+        if pg.rank == 1:
+            pg.stop_watchdog()
+            return "dead"
+        try:
+            pg.broadcast(np.zeros(8, np.int64), src=1, timeout_s=2.5)
+        except RuntimeError as e:
+            assert "root" in str(e) and "died" in str(e), e
+            assert pg.epoch == 1  # the heal itself still went through
+            pg.stop_watchdog()
+            return "named"
+        return "silently retried"
+
+    res = _run_group(n, fn, store_handle=store.handle, self_heal=True)
+    assert res[0] == "named" and res[2] == "named"
+    assert res[1] == "dead"
+
+
+def test_self_heal_refuses_world_shaped_retry(sidecar_store):
+    """Verbs whose inputs are shaped by the CURRENT world size (alltoall
+    rows here) must refuse transparent retry with a named error BEFORE
+    mutating the group — never feed old-world shapes into a shrunk ring
+    and surface a bare shape assertion."""
+    n = 3
+    store = sidecar_store(n)
+
+    def fn(pg):
+        pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
+        pg.barrier()
+        if pg.rank == 1:
+            pg.stop_watchdog()
+            return "dead"
+        x = np.arange(n * 4, dtype=np.int64).reshape(n, 4)
+        try:
+            pg.all_to_all(x, timeout_s=2.5)
+        except RuntimeError as e:
+            assert "world size" in str(e), e
+            assert pg.epoch == 0  # refused BEFORE healing: group untouched
+            pg.stop_watchdog()
+            return "named"
+        return "silently retried"
+
+    res = _run_group(n, fn, store_handle=store.handle, self_heal=True)
+    assert res[0] == "named" and res[2] == "named"
+    assert res[1] == "dead"

@@ -200,6 +200,90 @@ def test_partition_surfaces_as_timeout_not_hang():
         assert isinstance(e, (TimeoutError, OSError)), (rank, e)
 
 
+# ---------------------------------------------------------------------------
+# epoch fencing: stale group-generation frames die at the vtable boundary
+# ---------------------------------------------------------------------------
+
+
+def _fault_pair(sched: FaultSchedule):
+    """One in-process connected FaultNet(HostQPNet) pair."""
+    net = FaultNet(HostQPNet(), sched)
+    net.init()
+    handle, listener = net.listen()
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault("send", net.connect(0, handle)))
+    t.start()
+    recv = net.accept(listener)
+    t.join(timeout=10)
+    return net, out["send"], recv
+
+
+@needs_native
+def test_stale_epoch_frame_fenced_not_delivered():
+    """A frame sent under epoch N and still in flight (delivered to the
+    wire, unconsumed — the delayed-completion shape FaultNet produces)
+    when the group heals to epoch N+1 must be DROPPED at the vtable
+    boundary, counted in ``metrics.WIRE``, and recorded as an
+    ``epoch-fenced`` flight event — and the SAME tag must then carry
+    epoch-N+1 traffic cleanly (a healed collective's retry reuses the
+    aborted attempt's hop/frame tags; the fence is what makes that
+    sound)."""
+    from rocnrdma_tpu.metrics import WIRE
+    from rocnrdma_tpu.obs import FLIGHT
+
+    FLIGHT.reset()
+    net, send, recv = _fault_pair(FaultSchedule(
+        5, 0, test_delay_p=1.0, test_delay_polls=(1, 3)))
+    try:
+        base = WIRE.snapshot()
+        # epoch-0 frame: delivered to the recv comm's ring, never consumed
+        # (exactly an aborted collective's in-flight tail)
+        net.isend(send, net.reg_mr(send, b"stale epoch-0 payload"), tag=7)
+        net.set_epoch(1)  # the heal's generation bump fences it
+        assert WIRE.delta(base)["frames_fenced"] >= 1
+        fenced = [args for _, kind, args in FLIGHT.events()
+                  if kind == "epoch-fenced"]
+        assert fenced, "no epoch-fenced event on the flight timeline"
+        # the stale frame must NOT satisfy a same-tag epoch-1 receive...
+        req = net.irecv(recv, 21, tag=7)
+        for _ in range(50):
+            assert not req.test()[0], "stale frame leaked into the new epoch"
+        # ...but fresh epoch-1 traffic on the SAME tag flows normally
+        net.isend(send, net.reg_mr(send, b"fresh epoch-1 payload"), tag=7)
+        payload = req.wait(timeout_s=10.0)
+        assert bytes(payload) == b"fresh epoch-1 payload"
+    finally:
+        net.close()
+
+
+@needs_native
+def test_set_epoch_resets_comm_epochs_and_lg_credit():
+    """set_epoch stamps every registered comm (kept survivor wiring
+    included) and resets the LG sender-side credit state the aborted
+    collective may have left dangling."""
+    net, send, recv = _fault_pair(FaultSchedule())
+    try:
+        assert send.epoch == 0 and recv.epoch == 0
+        send._lg_head, send._lg_outstanding = 999, 777
+        send._lg_ack_queue.append(b"junk")
+        net.set_epoch(3)
+        assert send.epoch == 3 and recv.epoch == 3
+        assert send._lg_head == 0 and send._lg_outstanding == 0
+        assert send._lg_ack_queue == []
+        # new comms inherit the net's current epoch at creation
+        handle2, listener2 = net.listen()
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.setdefault("c", net.connect(0, handle2)))
+        t.start()
+        r2 = net.accept(listener2)
+        t.join(timeout=10)
+        assert out["c"].epoch == 3 and r2.epoch == 3
+    finally:
+        net.close()
+
+
 @needs_native
 def test_faultnet_delegates_vtable_surface():
     """Unknown attributes (frame caps, one-sided verbs) reach the inner

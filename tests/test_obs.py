@@ -258,6 +258,44 @@ def test_chrome_merge_two_rank_shm_allreduce(tmp_path, monkeypatch):
 
 
 @needs_native
+def test_epoch_fenced_frames_visible_in_perfetto_dump(tmp_path):
+    """The epoch fence's observability half: a delayed frame from epoch
+    N arriving during epoch N+1 is dropped AND shows up in the flight
+    dump / merged Perfetto trace as an ``epoch-fenced`` instant on the
+    control lane, next to the heal events it belongs with."""
+    from rocnrdma_tpu.transport.faults import FaultNet, FaultSchedule
+    from rocnrdma_tpu.transport.plugin import HostQPNet
+
+    FLIGHT.reset()
+    net = FaultNet(HostQPNet(), FaultSchedule(
+        9, 0, test_delay_p=1.0, test_delay_polls=(1, 2)))
+    net.init()
+    handle, listener = net.listen()
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault("send", net.connect(0, handle)))
+    t.start()
+    recv = net.accept(listener)
+    t.join(timeout=10)
+    try:
+        net.isend(out["send"], net.reg_mr(out["send"], b"late frame"),
+                  tag=3)
+        net.set_epoch(1)  # the frame is now a previous-generation straggler
+    finally:
+        net.close()
+    p = tmp_path / "fenced.json"
+    d = chrome.dump_rank(str(p), 0)
+    assert any(kind == "epoch-fenced" for _, kind, _ in
+               [(e[0], e[1], e[2]) for e in d["events"]])
+    merged = chrome.merge([str(p)])
+    fenced = [e for e in merged["traceEvents"]
+              if e.get("name") == "epoch-fenced"]
+    assert fenced, "epoch-fenced event missing from the merged trace"
+    # an instant on the control lane (no dur), timestamped like the rest
+    assert all(e["ph"] == "i" and e["ts"] >= 0 for e in fenced)
+
+
+@needs_native
 def test_wire_stats_exports_negotiation_and_verb_latency():
     """wire_stats() carries the negotiated frame/pipeline-depth gauges
     and the per-verb latency histograms next to the zero-copy counters."""

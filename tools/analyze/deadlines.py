@@ -56,7 +56,7 @@ PG_BLOCKING = {
     "all_reduce", "reduce_scatter", "all_gather", "broadcast", "all_to_all",
     "all_to_all_v", "all_gather_v", "reduce_scatter_v", "reduce", "gather",
     "scatter", "send", "recv", "isend", "irecv", "batch_isend_irecv",
-    "barrier", "monitored_barrier", "split", "shrink",
+    "barrier", "monitored_barrier", "split", "shrink", "heal",
 }
 
 

@@ -31,12 +31,26 @@ inner plane's instrumented ones, and the vtable pass already pins that
 it wraps the full surface — so it is not re-checked here; the
 fault-injection *events* themselves are recorded by ``FaultSchedule``.
 
-Exceptions live in ``ALLOW`` ("Class.verb" -> reason) — empty by policy.
+**Abort-path coverage (PR 5).** The self-healing work made abort paths
+load-bearing: a collective that dies feeds the heal's triage and the
+postmortem, and an ``except`` that tears down and re-raises SILENTLY is
+a blind spot precisely where the flight recorder earns its keep. Second
+invariant, over the transport abort surface (``plugin.py``,
+``distributed.py``, ``bootstrap.py``): **every ``except`` handler that
+re-raises (any ``raise`` in its body) must emit a flight-recorder event
+first** — a ``record(...)`` call (``_FLIGHT.record``, a schedule's
+``record``), a ``_stall(...)`` (which records and postmortems), or a
+``postmortem(...)``. Handlers that absorb-and-continue are out of
+scope: the retry/backoff layer already records absorptions.
+
+Exceptions live in ``ALLOW`` ("Class.verb" / "file.py::qualname" ->
+reason) — empty by policy.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 
 from tools.analyze import base
 from tools.analyze.vtable import own_methods, public_verbs, resolved_methods
@@ -53,6 +67,13 @@ OVERRIDES = ("TCPNet",)  # only own re-definitions (inherited = canon's)
 ENTRY_MARKERS = {"_verb_entry"}
 DONE_MARKERS = {"_verb_done", "_traced_request"}
 REQUEST_NAMES = {"Request", "_traced_request"}
+
+# the abort surface: every except-and-reraise in these files must leave
+# a flight event (see the module docstring's second invariant)
+ABORT_TARGETS = ("rocnrdma_tpu/transport/plugin.py",
+                 "rocnrdma_tpu/distributed.py",
+                 "rocnrdma_tpu/transport/bootstrap.py")
+ABORT_MARKERS = {"record", "_stall", "postmortem", "_postmortem"}
 
 ALLOW: dict[str, str] = {}
 
@@ -132,13 +153,53 @@ def check_tree(tree: ast.Module, where: str = PLUGIN,
     return problems
 
 
+def abort_problems(tree: ast.Module, where: str,
+                   used: set | None = None) -> list[str]:
+    """The abort-path invariant: an ``except`` handler containing any
+    ``raise`` must also contain a recording call (``record`` / ``_stall``
+    / ``postmortem``) — a silent teardown-and-reraise is a postmortem
+    blind spot exactly where a heal's triage needs the story."""
+    problems = []
+    for qual, fn, _owner in base.iter_functions(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not any(isinstance(s, ast.Raise) for s in ast.walk(node)):
+                continue
+            called = {base.call_name(sub) for sub in ast.walk(node)
+                      if isinstance(sub, ast.Call)}
+            if called & ABORT_MARKERS:
+                continue
+            key = f"{os.path.basename(where)}::{qual}"
+            if key in ALLOW:
+                if used is not None:
+                    used.add(key)
+                continue
+            problems.append(
+                f"{where}:{node.lineno}: except path in {qual} re-raises "
+                f"without recording a flight event (call _FLIGHT.record/"
+                f"_stall/postmortem before the raise, or ALLOW it with a "
+                f"reason) — an unrecorded abort is invisible to the heal "
+                f"triage and the postmortem")
+    return problems
+
+
 def check_source(src: str, path: str = "<fixture>") -> list[str]:
-    return check_tree(ast.parse(src, filename=path), path)
+    tree = ast.parse(src, filename=path)
+    return check_tree(tree, path) + abort_problems(tree, path)
+
+
+def check_abort_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the abort-path invariant alone (sources
+    with no net classes would otherwise fail the canon lookup)."""
+    return abort_problems(ast.parse(src, filename=path), path)
 
 
 def run() -> list[str]:
     used: set = set()
     problems = check_tree(base.parse_file(PLUGIN), PLUGIN, used)
+    for target in ABORT_TARGETS:
+        problems += abort_problems(base.parse_file(target), target, used)
     problems += base.allow_reason_problems(ALLOW, NAME)
     problems += base.allow_stale_problems(ALLOW, used, NAME)
     return problems
