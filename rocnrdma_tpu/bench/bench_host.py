@@ -42,19 +42,29 @@ COLLECTIVES = ("allreduce", "reducescatter", "allgather", "broadcast",
                "sendrecv")
 
 # --smoke perf floors (GB/s, algbw), recorded on the reference container
-# (2 ranks, 1 MiB allreduce) PER PLANE — the ROADMAP "smoke-gate floors
-# per plane" item. shm: the pre-pipelining wire measured 0.20, the
-# streaming wire ~0.24-0.30. tcp: the streaming wire measures ~0.28-0.37
-# on this container; 0.22 keeps the gate above the pre-pipelining
-# 2-rank wire (~0.15-0.20) while absorbing CI scheduler noise. Each gate
-# asserts >= 0.8x its floor AND zero steady-path payload copies on every
-# rank (the copy-counter half runs in the workers for BOTH planes).
-SMOKE_FLOORS = {"shm": 0.20, "tcp": 0.22}
+# (2 ranks, 1 MiB allreduce) PER PATH — the ROADMAP "smoke-gate floors
+# per plane" item, now covering all three data paths. shm: the
+# pre-pipelining wire measured 0.20, the streaming wire ~0.24-0.30.
+# tcp: the streaming wire measures ~0.28-0.37 on this container; 0.22
+# keeps the gate above the pre-pipelining 2-rank wire (~0.15-0.20)
+# while absorbing CI scheduler noise. rdma (the one-sided put-based
+# ring over the shm plane's MRs — the last ungated path): measured
+# 0.54-0.94 on this container; 0.45 absorbs the spread while staying
+# far above a doorbell/credit regression. Each gate asserts >= 0.8x
+# its floor AND zero steady-path payload copies on every rank (the
+# copy-counter half runs in the workers for every fleet).
+SMOKE_FLOORS = {"shm": 0.20, "tcp": 0.22, "rdma": 0.45}
+
+# smoke fleet configurations: gate key -> (plane, transport)
+SMOKE_PATHS = {"shm": ("shm", "msg"), "tcp": ("tcp", "msg"),
+               "rdma": ("shm", "rdma")}
 
 
-def _smoke_args(plane: str) -> list:
-    return ["--ranks", "2", "--plane", plane, "--sizes", "1M",
-            "--collectives", "allreduce", "--repeats", "3", "--iters", "5"]
+def _smoke_args(path: str) -> list:
+    plane, transport = SMOKE_PATHS[path]
+    return ["--ranks", "2", "--plane", plane, "--transport", transport,
+            "--sizes", "1M", "--collectives", "allreduce",
+            "--repeats", "3", "--iters", "5"]
 
 
 SMOKE_ARGS = _smoke_args("shm")
@@ -243,10 +253,10 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None, help="JSONL output path")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1 perf gate: 2-rank 1 MiB allreduce on the "
-                        "shm AND tcp planes; asserts ZERO steady-path "
-                        "payload copies on every rank of both fleets and "
-                        "algbw >= 0.8x each plane's recorded floor "
-                        f"({SMOKE_FLOORS})")
+                        "shm, tcp, AND rdma (put-based ring) paths; "
+                        "asserts ZERO steady-path payload copies on "
+                        "every rank of every fleet and algbw >= 0.8x "
+                        f"each path's recorded floor ({SMOKE_FLOORS})")
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -267,29 +277,30 @@ def main(argv=None) -> int:
                                 "--iters"})
         if clash:
             p.error(f"--smoke runs the fixed recorded configs "
-                    f"({' '.join(SMOKE_ARGS)}, then the tcp twin); drop "
-                    f"{'/'.join(clash)} or run a plain bench instead")
+                    f"({' '.join(SMOKE_ARGS)}, then the tcp and rdma "
+                    f"twins); drop {'/'.join(clash)} or run a plain "
+                    f"bench instead")
         records, failures = [], []
-        for plane in ("shm", "tcp"):
-            # each plane is its own fleet: per-rank copy gates run inside
-            # the workers, the throughput gate against the plane's floor
-            # runs here. BOTH planes measure (and their records persist)
+        for path in ("shm", "tcp", "rdma"):
+            # each path is its own fleet: per-rank copy gates run inside
+            # the workers, the throughput gate against the path's floor
+            # runs here. ALL paths measure (and their records persist)
             # before any floor failure raises, so a regression report
             # carries the full wire counters and says whether the slide
-            # is per-plane or global.
-            rec = _run_fleet(p.parse_args(_smoke_args(plane)
+            # is per-path or global.
+            rec = _run_fleet(p.parse_args(_smoke_args(path)
                                           + ["--smoke"]))[0]
             records.append(rec)
-            floor = SMOKE_FLOORS[plane]
+            floor = SMOKE_FLOORS[path]
             want = 0.8 * floor
             if rec.algbw_GBps < want:
                 failures.append(
-                    f"smoke gate [{plane}]: {rec.algbw_GBps:.3f} GB/s is "
+                    f"smoke gate [{path}]: {rec.algbw_GBps:.3f} GB/s is "
                     f"below 0.8x the recorded floor ({floor} GB/s); the "
                     f"zero-copy ring wire has regressed "
                     f"(wire={rec.extra.get('wire')})")
             else:
-                print(f"smoke gate ok [{plane}]: {rec.algbw_GBps:.3f} "
+                print(f"smoke gate ok [{path}]: {rec.algbw_GBps:.3f} "
                       f"GB/s >= {want:.3f}, zero steady-path payload "
                       f"copies on every rank "
                       f"(wire={rec.extra.get('wire')})")

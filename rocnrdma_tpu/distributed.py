@@ -48,11 +48,89 @@ from rocnrdma_tpu.transport import (
 
 _PLANES = {"tcp": TCPNet, "shm": HostQPNet}
 
+# p2p stream-resume control frame (reserved wire tag, next to the host
+# nets' LG tags — see the reservation note at HostQPNet._LG_REQ_TAG):
+# ``tag(4) | seq(4) | acked_frames(4)``, sent by the RECEIVER of an
+# interrupted stream over the re-established connection to name the
+# fence-acknowledged cursor the sender must resume from.
+_P2P_RESUME_TAG = 0xFFFFFF04
+
 
 def _check_transport(transport: str) -> None:
     if transport not in ("msg", "rdma"):
         raise ValueError(f"unknown transport {transport!r}; "
                          f"know ('msg', 'rdma')")
+
+
+# ---------------------------------------------------------------------------
+# The reshard policy (retry widening for world-size-shaped verbs).
+#
+# A verb whose INPUTS are shaped by the current world size (alltoall rows,
+# the ragged v-counts, scatter's root block) cannot transparently retry on
+# a changed membership — but it CAN retry once the membership delta is
+# applied to its inputs. The policy, documented in DESIGN.md §5f:
+#
+# - the delta must be a pure SHRINK (every current member was a member of
+#   the aborted attempt — heal only removes ranks or promotes a spare
+#   into a dead slot, never invents one); anything else refuses, named;
+# - rows/segments/counts addressed to (or contributed by) dead ranks are
+#   DROPPED — the surviving selector is the prev-rank index of each
+#   current member, in current-rank order, so the retried exchange is
+#   exactly the collective the surviving membership would have issued;
+# - a promotion-only heal (world size unchanged, a spare adopted the dead
+#   slot's identity) is a no-op delta: the retry re-runs unresharded;
+# - ONE resharded retry per call: a second abort re-raises (the caller
+#   re-issues with shapes for the then-current world), and the heal-level
+#   commit-divergence rule carries over unchanged — diverged survivors
+#   refuse before any retry, resharded or not.
+# ---------------------------------------------------------------------------
+
+
+def _survivor_rows(pg: "ProcessGroup", prev: list) -> list:
+    """Prev-current-rank index of every CURRENT member, in current rank
+    order — the row/column/segment selector every reshard policy applies
+    to the aborted attempt's world-shaped inputs."""
+    return [prev.index(g) for g in pg._ranks]
+
+
+def _reshard_alltoall(pg, args, kw, prev):
+    (x,) = args
+    keep = _survivor_rows(pg, prev)
+    return (np.ascontiguousarray(np.asarray(x)[keep]),), kw
+
+
+def _reshard_alltoallv(pg, args, kw, prev):
+    segments, counts = args
+    keep = _survivor_rows(pg, prev)
+    segs = [segments[i] for i in keep]
+    return (segs, np.asarray(counts)[np.ix_(keep, keep)]), kw
+
+
+def _reshard_allgatherv(pg, args, kw, prev):
+    x, counts = args
+    keep = _survivor_rows(pg, prev)
+    return (x, np.asarray(counts).ravel()[keep]), kw
+
+
+def _reshard_reduce_scatter_v(pg, args, kw, prev):
+    x, counts = args
+    counts = np.asarray(counts).ravel()
+    bounds = np.concatenate([[0], np.cumsum(counts)])
+    keep = _survivor_rows(pg, prev)
+    flat = np.asarray(x).ravel()
+    parts = [flat[bounds[i]:bounds[i + 1]] for i in keep]
+    return (np.concatenate(parts), counts[keep]), kw
+
+
+def _reshard_scatter(pg, args, kw, prev):
+    # only the root's input is world-shaped (an (n, ...) block matrix);
+    # non-root templates are one row and pass through. Runs AFTER the
+    # rooted remap, so kw["root"] is the root's CURRENT index.
+    (x,) = args
+    x = np.asarray(x)
+    if pg.rank == kw.get("root"):
+        x = np.ascontiguousarray(x[_survivor_rows(pg, prev)])
+    return (x,), kw
 
 
 class P2PHandle:
@@ -87,7 +165,7 @@ class ProcessGroup:
                  server: "bootstrap.BootstrapServer | None",
                  timeout_s: float = 30.0, group_name: str = "default",
                  plane: str = "tcp", fault_schedule=None,
-                 self_heal: bool = False):
+                 self_heal: bool = False, standby: str | None = None):
         self.rank = rank
         self.world_size = world_size
         self.group_name = group_name
@@ -108,7 +186,22 @@ class ProcessGroup:
         self._ranks = list(range(world_size))
         self._self_heal = bool(self_heal)
         self._heals = 0
+        self._grow_no = 0           # grows issued (namespaces each grow's keys)
+        # elasticity bookkeeping: the highest ORIGINAL rank id ever handed
+        # out (grow assigns joiners past it — a dead rank's id is never
+        # reused, so oracles keyed by original rank stay unambiguous), and
+        # the per-slot incarnation counter (bumped when a spare/joiner
+        # takes a slot over: p2p stream state from the previous process
+        # behind that identity must not resume into the new one)
+        self._orig_hwm = world_size
+        self._incarnation: dict[int, int] = {}
         self._watchdog_params = None  # (interval_s, timeout_s) when running
+        # standby mode: "spare" (bootstrap + pre-listen + heartbeat, sits
+        # out of collectives until a heal promotes it) or "joiner"
+        # (registers for the next grow()); None = ordinary member
+        self._standby = standby
+        self._sid = None            # standby slot id in the store registry
+        self._standby_listener = None
         self._server = server  # only rank 0 (or an external sidecar) owns one
         if plane not in _PLANES:
             raise ValueError(f"unknown plane {plane!r}; know {sorted(_PLANES)}")
@@ -120,7 +213,13 @@ class ProcessGroup:
             self._net = FaultNet(self._net, fault_schedule)
         self._net.init()
         try:
-            if world_size > 1:
+            if standby is not None:
+                self._client = bootstrap.BootstrapClient(
+                    store_handle, None, timeout_s,
+                    scope=f"pg/{group_name}/ring")
+                self._send = self._recv = None
+                self._register_standby(timeout_s)
+            elif world_size > 1:
                 self._send, self._recv, self._client = bootstrap.bootstrap_ring(
                     self._net, store_handle, rank, world_size, timeout_s,
                     ns=f"pg/{group_name}/ring")
@@ -128,10 +227,14 @@ class ProcessGroup:
                 self._send = self._recv = self._client = None
         except BaseException as e:
             # a failed rendezvous must not leak the net plane (or, via
-            # init_process_group, rank 0's master-port listener); the
-            # abort leaves a flight event (analyzer abort-path rule)
+            # init_process_group, rank 0's master-port listener), nor a
+            # standby's pre-published listener (shm: a qp the net does
+            # not track); the abort leaves a flight event (analyzer
+            # abort-path rule)
             _FLIGHT.record("group-abort", group=group_name, rank=rank,
                            error=type(e).__name__)
+            if self._standby_listener is not None:
+                bootstrap._close_quietly(self._standby_listener)
             self._net.close()
             raise
         self._barrier_no = 0
@@ -144,7 +247,19 @@ class ProcessGroup:
         self._watchdog_failed = None
         self._dead: list[int] = []
         self._p2p: dict[tuple, "plugin._RingWire"] = {}  # (peer, dir) -> wire
-        self._p2p_seq: dict[int, dict] = {}     # peer -> (dir, tag) -> seq
+        # sequence counters are keyed by the peer's ORIGINAL rank (via
+        # _pstate): a heal/grow renumbers peers but an unbroken pair's
+        # streams continue — the same identity discipline as the oracle
+        self._p2p_seq: dict[int, dict] = {}     # orig -> (dir, tag) -> seq
+        # in-flight p2p message registrations, (orig, dir, tag) -> state:
+        # the stream-resume protocol's bookkeeping (tx keeps the payload
+        # for re-queueing; rx keeps the destination + the landed-frame
+        # cursor). One registration per stream: a second outstanding op
+        # on one (peer, dir, tag) stream is not resume-covered (its
+        # failure raises, as before the resume protocol existed).
+        self._p2p_inflight: dict[tuple, dict] = {}
+        self._p2p_resume_pending = False  # interrupted tx streams awaiting
+        #                                   the receiver's RESUME cursor
         self._p2p_listen: dict | None = None    # peer -> listener, once used
         self._p2p_accepted: set[int] = set()
         self._split_no = 0
@@ -155,16 +270,18 @@ class ProcessGroup:
 
     # -- collectives (numpy in, numpy out) ---------------------------------
 
-    def _ring(self, fn, *args, timeout_s=None, _retry_ok=True, **kw):
+    def _ring(self, fn, *args, timeout_s=None, _reshard=None, **kw):
         # every wire wait under this call is bounded by ONE deadline: the
         # per-call override, else the group default from init — a stalled
         # peer surfaces as a named TimeoutError, never a hang. Rank and
         # world size are injected HERE (not at the verb call sites) so a
         # heal-and-retry re-executes on the post-heal numbering;
-        # ``_retry_ok=False`` marks verbs whose INPUTS are shaped by the
-        # current world size (alltoall rows, ragged counts, scatter's
-        # root block) — those refuse transparent retry with a named
-        # error instead of feeding old-world shapes to a shrunk group.
+        # ``_reshard`` marks verbs whose INPUTS are shaped by the current
+        # world size (alltoall rows, ragged counts, scatter's root block):
+        # after a membership-changing heal their inputs are re-sharded
+        # ONCE through the named policy (see the module-level reshard
+        # block) and the retry runs on the new-world shapes — a second
+        # abort, or a delta the policy cannot express, refuses named.
         #
         # Exactly-once under retry: every ring_* collective copies its
         # input at entry (np.array(local, copy=True)), so an aborted
@@ -175,10 +292,23 @@ class ProcessGroup:
         # the re-execution. The epoch the result committed on is
         # recorded in last_op_epoch.
         t = self.timeout_s if timeout_s is None else timeout_s
-        attempts = self.world_size  # each genuine heal removes >= 1 rank
+        # each attempt either heals (removing >= 1 rank or burning >= 1
+        # spare on a promotion) or raises; world size bounds the shrinks,
+        # the +2 absorbs a promotion round and one failed-heal re-triage
+        attempts = 2 * self.world_size + 2
+        reshard_left = 1
+        heal_retry_left = 1
         for _ in range(max(1, attempts)):
             try:
                 self._check_alive()  # fail fast instead of hanging on the dead
+                if self.world_size > 1 and (self._send is None
+                                            or self._recv is None):
+                    # a FAILED heal can leave the ring half-rewired (a
+                    # dial toward a dead promotion target never came up):
+                    # route straight back into the heal instead of
+                    # handing a dead edge to the collective
+                    raise OSError("ring wiring torn by a failed repair; "
+                                  "re-healing")
                 out = fn(self._net, self._send, self._recv, *args,
                          self.rank, self.world_size, timeout_s=t, **kw)
             except (TimeoutError, OSError, RuntimeError) as e:
@@ -191,28 +321,33 @@ class ProcessGroup:
                                error=type(e).__name__)
                 if not self._self_heal:
                     raise
-                if not _retry_ok:
-                    # inputs shaped by the CURRENT world size (alltoall
-                    # rows, v-counts, scatter's root block) would be
-                    # malformed on a shrunk group — refuse BEFORE healing
-                    # (the group is left un-mutated; the caller heals and
-                    # re-issues with new-world shapes), named, never a
-                    # shape assertion from deep inside a retry
-                    raise RuntimeError(
-                        f"{getattr(fn, '__name__', 'collective')} aborted "
-                        f"on a peer failure, and its inputs are shaped by "
-                        f"the current world size — a transparent shrunk-"
-                        f"group retry would be malformed. Call heal(), "
-                        f"then re-issue with shapes for the new world "
-                        f"size.") from e
                 prev = list(self._ranks)
-                self._heal_for(e, t)
+                try:
+                    self._heal_for(e, t)
+                except (TimeoutError, OSError) as he:
+                    # a FAILED heal — e.g. the promoted spare died before
+                    # wiring, stranding the wired barrier. The heal's
+                    # failure path re-armed the watchdog, so one
+                    # re-triage is sound: the next attempt fails fast on
+                    # _check_alive and heals again (the dead spare is
+                    # burned — its admit record exists — so the re-heal
+                    # shrinks instead). One retry only; "slow, not dead"
+                    # verdicts (heal re-raising the ORIGINAL error) and a
+                    # second heal failure propagate.
+                    if he is e or heal_retry_left == 0:
+                        raise
+                    heal_retry_left -= 1
+                    _FLIGHT.record("heal-retry", epoch=self.epoch,
+                                   error=type(he).__name__)
+                    continue
                 root_kw = next((k for k in ("root",) if k in kw), None)
                 if root_kw is not None:
                     # rooted verbs name a rank: follow the ROOT's identity
                     # through the re-ranking (a retried broadcast must
-                    # still source the same original rank), and refuse
-                    # named if the root itself is the one that died
+                    # still source the same original rank) — a spare
+                    # promoted into the dead root's identity satisfies
+                    # this (the slot is still a member); only a root that
+                    # died with NO spare to take its place refuses
                     gid = prev[kw[root_kw]]
                     if gid not in self._ranks:
                         raise RuntimeError(
@@ -222,6 +357,23 @@ class ProcessGroup:
                             f"root — re-issue with a surviving root"
                         ) from e
                     kw[root_kw] = self._ranks.index(gid)
+                if _reshard is not None and list(self._ranks) != prev:
+                    # world-size-shaped inputs meet a changed membership:
+                    # apply the reshard policy once; refuse (named) a
+                    # second delta or one that is not a pure shrink
+                    if reshard_left == 0 or not set(self._ranks) <= set(prev):
+                        raise RuntimeError(
+                            f"{getattr(fn, '__name__', 'collective')}: "
+                            f"membership changed again after the one "
+                            f"resharded retry (or grew mid-retry) — "
+                            f"re-issue with shapes for the current world "
+                            f"size") from e
+                    reshard_left -= 1
+                    args, kw = _reshard(self, args, kw, prev)
+                    _FLIGHT.record(
+                        "reshard-retry", epoch=self.epoch,
+                        verb=getattr(fn, "__name__", "collective"),
+                        dropped=len(prev) - self.world_size)
                 continue
             self.last_op_epoch = self.epoch
             self._op_seq += 1
@@ -326,7 +478,7 @@ class ProcessGroup:
         if self.world_size == 1:
             return x.copy()
         return self._ring(plugin.ring_alltoall_over_net, x,
-                          timeout_s=timeout_s, _retry_ok=False)
+                          timeout_s=timeout_s, _reshard=_reshard_alltoall)
 
     def all_to_all_v(self, segments: list, counts, dtype="float32",
                      timeout_s: float | None = None) -> list:
@@ -342,7 +494,7 @@ class ProcessGroup:
         # validation behaves identically to multi-rank runs
         return self._ring(plugin.ring_alltoallv_over_net, segments,
                           np.asarray(counts), dtype=dtype,
-                          timeout_s=timeout_s, _retry_ok=False)
+                          timeout_s=timeout_s, _reshard=_reshard_alltoallv)
 
     def all_gather_v(self, x, counts,
                      timeout_s: float | None = None) -> list:
@@ -359,7 +511,7 @@ class ProcessGroup:
             return plugin.ring_allgatherv_over_net(
                 None, None, None, x, counts, 0, 1)
         return self._ring(plugin.ring_allgatherv_over_net, x, counts,
-                          timeout_s=timeout_s, _retry_ok=False)
+                          timeout_s=timeout_s, _reshard=_reshard_allgatherv)
 
     def reduce_scatter_v(self, x, counts, op: str = "sum",
                          timeout_s: float | None = None) -> np.ndarray:
@@ -376,7 +528,7 @@ class ProcessGroup:
         else:
             out = self._ring(plugin.ring_reduce_scatter_v_over_net, x,
                              counts, op=wire_op, timeout_s=timeout_s,
-                             _retry_ok=False)
+                             _reshard=_reshard_reduce_scatter_v)
         return self._avg_finalize(out, x, op)
 
     def _avg_wire_op(self, x, op: str, verb: str) -> str:
@@ -437,7 +589,7 @@ class ProcessGroup:
                 raise ValueError(f"scatter root wants (1, ...), got {x.shape}")
             return x[0].copy()
         return self._ring(plugin.ring_scatter_over_net, x, root=src,
-                          timeout_s=timeout_s, _retry_ok=False)
+                          timeout_s=timeout_s, _reshard=_reshard_scatter)
 
     # -- object collectives (pickled python values, torch-style) -----------
     #
@@ -505,6 +657,20 @@ class ProcessGroup:
             self._p2p_listen[peer] = listener
             self._client.set(f"{self._p2p_ns(peer)}/h/{self.rank}", handle)
 
+    def _pstate(self, peer: int) -> dict:
+        """The (dir, tag) -> seq counter dict for ``peer`` (a CURRENT
+        rank), keyed internally by the peer's ORIGINAL rank so an
+        unbroken pair's streams keep their numbering across heals/grows
+        (the renumbering is a property of the group, not the stream)."""
+        return self._p2p_seq.setdefault(self._ranks[peer], {})
+
+    def _inc(self, orig: int) -> int:
+        """The incarnation of original-rank slot ``orig``: bumped when a
+        spare or joiner takes the slot over — stream state from the
+        previous process behind that identity must not resume into the
+        new one (its data died with the process)."""
+        return self._incarnation.get(orig, 0)
+
     def _p2p_progress(self) -> None:
         """The p2p progress engine, hooked into every send's backpressure
         and flush loops: poll-accept pending inbound dials and pump every
@@ -523,7 +689,7 @@ class ProcessGroup:
                 self._p2p_accepted.add(peer)
                 self._p2p[(peer, "rx")] = plugin._RingWire(
                     self._net, comm, comm, peers=(peer, peer))
-                self._p2p_seq.setdefault(peer, {})
+                self._pstate(peer)
         # pump EVERY wired comm, both directions: rx pumps deliver inbound
         # frames; tx pumps drive queued user-space tx (an irecv wait issued
         # before a send handle's flush must still make the outbound tail
@@ -536,6 +702,241 @@ class ProcessGroup:
         for (peer, d), wire in list(self._p2p.items()):
             comm = wire.recv_comm if d == "rx" else wire.send_comm
             comm._pump()
+        if self.epoch > 0 and self._p2p_inflight:
+            self._p2p_resume_service()
+
+    def _p2p_resume_service(self) -> int:
+        """Sender-side half of the stream-resume protocol, driven from the
+        progress engine: while this rank blocks in some OTHER p2p wait
+        (typically resuming its own inbound), its interrupted outbound
+        streams must still make progress — a ring of ranks each waiting
+        on its inbound first would otherwise deadlock, every receiver
+        waiting for a sender that has not reached its own send wait yet.
+        For each interrupted outbound stream: dial the peer once it has
+        re-published its pair listener (publish-before-dial, so the only
+        refusals are injected ones — attempt counts stay schedule-driven
+        and chaos replay-equal), consume the receiver's RESUME frame, and
+        re-queue the tail from the fence-acknowledged cursor. Returns the
+        number of interrupted outbound streams still unserved (the
+        _check_alive hook keeps calling until it hits zero)."""
+        pending = 0
+        for key, info in list(self._p2p_inflight.items()):
+            orig, d, tag = key
+            if d != "tx" or info.get("state") == "resumed":
+                continue
+            if info["epoch"] >= self.epoch:
+                continue  # not interrupted by a membership change
+            if orig not in self._ranks or self._inc(orig) != info["inc"]:
+                continue  # peer process gone: its wait will raise, named
+            pending += 1
+            cur = self._ranks.index(orig)
+            wire = self._p2p.get((cur, "tx"))
+            if wire is None:
+                try:
+                    handle = self._client.try_get(
+                        f"{self._p2p_ns(cur)}/h/{cur}")
+                except (OSError, TimeoutError):
+                    continue
+                if handle is None:
+                    continue  # peer has not re-published yet
+                try:
+                    comm = self._net.connect(0, handle, min(5.0,
+                                                            self.timeout_s))
+                except (ConnectionRefusedError, ConnectionResetError,
+                        TimeoutError, OSError):
+                    continue  # injected refusal/flake: next service call
+                wire = plugin._RingWire(self._net, comm, comm,
+                                        timeout_s=self.timeout_s,
+                                        peers=(cur, cur))
+                self._p2p[(cur, "tx")] = wire
+            acked = self._take_resume_ack(wire.send_comm, tag, info["seq"])
+            if acked is None:
+                continue
+            _FLIGHT.record("p2p-resume", dir="tx", tag=tag,
+                           seq=info["seq"], acked=acked)
+            wire.queue_send(info["data"], info["hop"], first_frame=acked)
+            info["state"] = "resumed"
+            pending -= 1
+        return pending
+
+    def _take_resume_ack(self, comm, tag: int, seq: int) -> int | None:
+        """Pop the RESUME control frame for stream (tag, seq) from
+        ``comm``'s stash, if it has arrived; returns the receiver's
+        fence-acknowledged frame cursor. Frames for OTHER streams stay
+        stashed for their own senders' waits."""
+        frames = comm._unexpected.get(_P2P_RESUME_TAG)
+        if not frames:
+            comm._pump()
+            frames = comm._unexpected.get(_P2P_RESUME_TAG)
+        for i, p in enumerate(frames or ()):
+            if (int.from_bytes(p[:4], "little") == tag
+                    and int.from_bytes(p[4:8], "little") == seq):
+                frames.pop(i)
+                if not frames:
+                    del comm._unexpected[_P2P_RESUME_TAG]
+                return int.from_bytes(p[8:12], "little")
+        return None
+
+    def _p2p_resume_accept(self, cur: int, timeout_s: float):
+        """Accept the re-dial of an interrupted INBOUND stream's sender,
+        interleaved with the tx resume SERVICE — a ring of ranks all
+        resuming their inbound first would otherwise deadlock, each
+        blocked in a plain accept while the dial it waits for can only
+        come from a peer's service that never gets to run. Publishes this
+        rank's pair listeners first (the sender's service dials only a
+        published handle, so connect attempts stay schedule-driven)."""
+        self._check_alive()
+        wire = self._p2p.get((cur, "rx"))
+        if wire is not None:
+            wire.timeout_s = timeout_s
+            return wire
+        self._p2p_publish()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self._p2p_resume_service()  # keep OUR outbound resumes moving
+            try:
+                comm = self._net.accept(self._p2p_listen[cur],
+                                        timeout_s=0.25)
+                break
+            except (ConnectionRefusedError, ConnectionResetError,
+                    TimeoutError, OSError):
+                if time.monotonic() >= deadline:
+                    _FLIGHT.record("p2p-resume-abort", dir="rx", peer=cur,
+                                   error="TimeoutError")
+                    raise TimeoutError(
+                        f"p2p resume: peer rank {cur} never re-dialed "
+                        f"within {timeout_s}s") from None
+        try:
+            wire = plugin._RingWire(self._net, comm, comm,
+                                    timeout_s=timeout_s, peers=(cur, cur))
+        except BaseException as e:
+            _FLIGHT.record("p2p-resume-abort", dir="rx", peer=cur,
+                           error=type(e).__name__)
+            self._net.close_comm(comm)
+            raise
+        self._p2p_accepted.add(cur)
+        self._p2p[(cur, "rx")] = wire
+        return wire
+
+    def _raise_if_interrupted(self, key: tuple | None,
+                              epoch0: int) -> None:
+        """A tx flush that 'succeeded' on a dead comm proves nothing: shm
+        comms have no user-space tx queue, so ``_flush_tx`` no-ops even
+        though the queued frames went out under the OLD epoch and were
+        fenced on arrival. An interrupted, not-yet-resumed stream must
+        take the resume path regardless — raised here, into the caller's
+        resume handler. ``epoch0`` is the epoch captured at op entry: an
+        UNCOVERED op (second outstanding on its stream, ``key`` None)
+        has no registration to compare against, but a silent success
+        after a fence is still data loss — it raises too, just without
+        resume coverage."""
+        info = self._p2p_inflight.get(key) if key is not None else None
+        if info is not None:
+            if (info.get("state") != "resumed"
+                    and self.epoch > info["epoch"]):
+                raise OSError("p2p stream interrupted by a membership "
+                              "change (frames fenced); resuming")
+        elif self.epoch > epoch0:
+            raise OSError("p2p stream interrupted by a membership change "
+                          "(frames fenced); op was not resume-covered "
+                          "(another op owns the stream's resume slot) — "
+                          "the stream is undefined")
+
+    def _p2p_resumable(self, info: dict | None, orig: int) -> bool:
+        """A stream continuation is legal iff the group healed/grew SINCE
+        the op posted (the wire's frames were epoch-fenced, not lost),
+        the peer slot is still a member, and the PROCESS behind it is the
+        same incarnation (a promoted spare or joiner under the same
+        identity never saw the stream)."""
+        return (self._self_heal and info is not None
+                and orig in self._ranks
+                and self._inc(orig) == info["inc"]
+                and self.epoch > info["epoch"])
+
+    def _p2p_resume_tx(self, key: tuple, exc, timeout_s: float) -> None:
+        """Resume an interrupted OUTBOUND stream from the receiver's
+        fence-acknowledged cursor (or re-raise ``exc`` when the stream is
+        not resumable). The receiver drives: its RESUME frame names the
+        cursor; this side re-queues the tail and flushes."""
+        info = self._p2p_inflight.get(key)
+        orig, _, tag = key
+        if not self._p2p_resumable(info, orig):
+            raise exc
+        cur = self._ranks.index(orig)
+        deadline = time.monotonic() + timeout_s
+        wire = self._p2p_wire(cur, "tx", timeout_s)
+        if info.get("state") != "resumed":
+            from rocnrdma_tpu.transport.backoff import poll_backoff
+            back = poll_backoff()
+            # the progress-engine SERVICE may take the RESUME frame and
+            # re-queue the tail while this loop polls (it runs inside
+            # _p2p_progress below) — re-check the stream state every
+            # iteration or the frame this loop waits for is already gone
+            while info.get("state") != "resumed":
+                acked = self._take_resume_ack(wire.send_comm, tag,
+                                              info["seq"])
+                if acked is not None:
+                    _FLIGHT.record("p2p-resume", dir="tx", tag=tag,
+                                   seq=info["seq"], acked=acked)
+                    wire.queue_send(info["data"], info["hop"],
+                                    progress=self._p2p_progress,
+                                    first_frame=acked)
+                    info["state"] = "resumed"
+                    break
+                self._p2p_progress()
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"p2p resume: no RESUME cursor from rank {cur} "
+                        f"(original {orig}, tag {tag}) within "
+                        f"{timeout_s}s — peer never resumed its "
+                        f"receive") from exc
+                back.pause()
+        plugin._flush_tx(wire.send_comm,
+                         max(0.1, deadline - time.monotonic()),
+                         extra_pump=self._p2p_progress,
+                         what="p2p resume: peer stopped draining")
+
+    def _p2p_resume_rx(self, key: tuple, exc, timeout_s: float) -> None:
+        """Resume an interrupted INBOUND stream: re-wire, tell the sender
+        the fence-acknowledged cursor (frames already landed in the
+        destination before the epoch fence), and re-post only the
+        missing tail — same frame indices, so wire tags line up with the
+        sender's resumed ``queue_send``."""
+        info = self._p2p_inflight.get(key)
+        orig, _, tag = key
+        if not self._p2p_resumable(info, orig):
+            raise exc
+        cur = self._ranks.index(orig)
+        _FLIGHT.record("p2p-resume", dir="rx", tag=tag, seq=info["seq"],
+                       acked=info["acked"])
+        wire = self._p2p_resume_accept(cur, timeout_s)
+        ack = (tag.to_bytes(4, "little") + info["seq"].to_bytes(4, "little")
+               + info["acked"].to_bytes(4, "little"))
+        self._net.isend(wire.recv_comm,
+                        self._net.reg_mr(wire.recv_comm, ack),
+                        tag=_P2P_RESUME_TAG, timeout_s=timeout_s,
+                        progress=self._p2p_progress)
+        reqs = wire.post_recvs(info["nbytes"], info["hop"],
+                               into=info["got"],
+                               first_frame=info["acked"])
+        self._drain_p2p_recvs(wire, reqs, info, timeout_s, resumed=True)
+
+    def _drain_p2p_recvs(self, wire, reqs, info: dict, timeout_s: float,
+                         resumed: bool = False) -> None:
+        """Drain posted p2p frame receives in order, advancing the
+        stream's fence-acknowledged cursor per completed frame (the
+        in-order count IS the resume cursor: a later frame stuck in the
+        stash when the epoch fence falls is dropped with it, so anything
+        beyond the first incomplete frame cannot be acknowledged)."""
+        for off, nb, r in reqs:
+            payload = r.wait(timeout_s=timeout_s,
+                             progress=self._p2p_progress)
+            if payload is not None:  # legacy plane: stage the copy
+                info["got"][off:off + nb] = np.frombuffer(payload, np.uint8)
+                _WIRE.copied(nb)
+            info["acked"] += 1
+            if resumed:
+                _WIRE.resumed()
 
     def _p2p_wire(self, peer: int, direction: str, timeout_s: float = 30.0):
         """The cached one-way wire to/from ``peer`` (``direction``: "tx" dials
@@ -569,10 +970,21 @@ class ProcessGroup:
                                         timeout_s=timeout_s,
                                         peers=(peer, peer))
             else:
+                def _accept_once():
+                    # interleave the resume SERVICE with the blocking
+                    # accept: a first-contact accept after a heal can
+                    # otherwise starve a peer blocked in its own resume
+                    # handshake waiting for THIS rank's service to dial
+                    # — the same cycle _p2p_resume_accept breaks. Short
+                    # attempts keep the service cadence; refused/timed
+                    # out attempts retry under the caller's full budget.
+                    if self.epoch > 0 and self._p2p_inflight:
+                        self._p2p_resume_service()
+                    return self._net.accept(self._p2p_listen[peer],
+                                            min(0.5, timeout_s))
                 comm = retry_with_backoff(
-                    lambda: self._net.accept(self._p2p_listen[peer],
-                                             min(5.0, timeout_s)),
-                    timeout_s, f"p2p accept from rank {peer}",
+                    _accept_once, timeout_s,
+                    f"p2p accept from rank {peer}",
                     retry_on=(ConnectionRefusedError, ConnectionResetError,
                               TimeoutError))
                 self._p2p_accepted.add(peer)
@@ -582,7 +994,7 @@ class ProcessGroup:
                                         timeout_s=timeout_s,
                                         peers=(peer, peer))
             self._p2p[(peer, direction)] = wire
-            self._p2p_seq.setdefault(peer, {})
+            self._pstate(peer)
         wire.timeout_s = timeout_s  # per-call deadline on a cached wire
         return wire
 
@@ -596,6 +1008,26 @@ class ProcessGroup:
             raise ValueError(f"p2p tag must be in [0, 64), got {tag}")
         return (tag << 10) | (seq % 1024)
 
+    def _register_inflight(self, orig: int, d: str, tag: int,
+                           state: dict) -> tuple | None:
+        """Register an in-flight p2p message for the stream-resume
+        protocol (one registration per (peer, dir, tag) stream — a second
+        outstanding op on the same stream is not resume-covered: its
+        failure raises, exactly the pre-resume contract)."""
+        key = (orig, d, tag)
+        if self._p2p_inflight.get(key) is not None:
+            # the stream's resume slot is owned by an outstanding op —
+            # including one a heal interrupted whose wait() has not run
+            # yet. A second op must NOT steal it: overwriting would let
+            # the interrupted op's wait() read the new registration's
+            # current epoch and report success while its fenced frames
+            # were never re-sent. The new op runs uncovered instead.
+            return None
+        state.setdefault("inc", self._inc(orig))
+        state.setdefault("epoch", self.epoch)
+        self._p2p_inflight[key] = state
+        return key
+
     def send(self, x, dst: int, tag: int = 0,
              timeout_s: float = 60.0) -> None:
         """Blocking point-to-point send of ``x`` to rank ``dst``. Messages
@@ -603,34 +1035,96 @@ class ProcessGroup:
         disambiguates concurrent streams, torch-style. ``timeout_s`` bounds
         every wait (first-contact rendezvous, backpressure, flush) — raise
         it for slow-consumer peers; blocking semantics are only as patient
-        as this deadline. A send that RAISES may have left partial frames
-        on the wire; the (peer, tag) stream is then undefined (standard
-        failed-blocking-send semantics) — tear down the group rather than
-        retry. A timed-out recv, by contrast, is cleanly retryable."""
+        as this deadline.
+
+        Failure semantics: under ``self_heal``, a send interrupted by a
+        membership change (the wire died, the group healed/grew, the peer
+        PROCESS survived) RESUMES — the receiver names its last
+        fence-acknowledged frame and only the tail is re-sent, so the
+        stream continues instead of tearing down. Any other raising send
+        leaves the (peer, tag) stream undefined (standard
+        failed-blocking-send semantics) — tear down the group rather
+        than retry. A timed-out recv, by contrast, is cleanly
+        retryable."""
         x = np.asarray(x)
-        wire = self._p2p_wire(dst, "tx", timeout_s)
+        data = plugin._as_bytes(x)
+        orig = self._ranks[dst]
+        st = self._pstate(dst)
         # counters are per-(direction, tag): tag streams are independently
         # ordered, so a receiver may drain tag 7 before tag 0 (the verbs
         # layer tag-matches out of order; see _HostComm._unexpected)
-        seq = self._p2p_seq[dst].get(("tx", tag), 0)
-        self._p2p_seq[dst][("tx", tag)] = seq + 1
-        wire.exchange(plugin._as_bytes(x), 0, hop=self._p2p_hop(tag, seq))
+        seq = st.get(("tx", tag), 0)
+        st[("tx", tag)] = seq + 1
+        hop = self._p2p_hop(tag, seq)
+        key = self._register_inflight(orig, "tx", tag,
+                                      {"seq": seq, "data": data,
+                                       "hop": hop})
+        epoch0 = self.epoch
+        try:
+            wire = self._p2p_wire(dst, "tx", timeout_s)
+            wire.queue_send(data, hop, progress=self._p2p_progress)
+            plugin._flush_tx(wire.send_comm, timeout_s,
+                             extra_pump=self._p2p_progress,
+                             what="p2p send: peer stopped draining")
+            self._raise_if_interrupted(key, epoch0)
+        except (TimeoutError, OSError, RuntimeError) as e:
+            if key is None:
+                raise
+            _FLIGHT.record("p2p-abort", dir="tx", tag=tag,
+                           error=type(e).__name__)
+            self._p2p_resume_tx(key, e, timeout_s)
+        finally:
+            if key is not None:
+                self._p2p_inflight.pop(key, None)
 
     def recv(self, x_like, src: int, tag: int = 0,
              timeout_s: float = 60.0) -> np.ndarray:
         """Blocking point-to-point receive from rank ``src``; ``x_like``
         supplies the expected shape/dtype (the recvbuff role). Returns the
         received array. ``timeout_s`` bounds the wait for the matching send
-        — raise it for slow producers."""
+        — raise it for slow producers. Interrupted-by-heal receives
+        resume like :meth:`send` (the landed head frames are kept, only
+        the fenced tail is re-requested)."""
         template = np.asarray(x_like)
-        wire = self._p2p_wire(src, "rx", timeout_s)
-        seq = self._p2p_seq[src].get(("rx", tag), 0)
-        got = wire.exchange(np.empty(0, np.uint8), template.nbytes,
-                            hop=self._p2p_hop(tag, seq))
+        orig = self._ranks[src]
+        st = self._pstate(src)
+        seq = st.get(("rx", tag), 0)
+        hop = self._p2p_hop(tag, seq)
+        got = np.empty(template.nbytes, np.uint8)
+        key = self._register_inflight(orig, "rx", tag,
+                                      {"seq": seq, "got": got, "hop": hop,
+                                       "nbytes": template.nbytes,
+                                       "acked": 0})
+        info = self._p2p_inflight.get(key) if key is not None else None
+        try:
+            wire = self._p2p_wire(src, "rx", timeout_s)
+            reqs = wire.post_recvs(template.nbytes, hop, into=got)
+            if info is not None:
+                self._drain_p2p_recvs(wire, reqs, info, timeout_s)
+            else:  # second outstanding op on the stream: plain drain
+                self._drain_p2p_recvs(wire, reqs,
+                                      {"got": got, "acked": 0}, timeout_s)
+        except (TimeoutError, OSError, RuntimeError) as e:
+            if key is None:
+                raise
+            _FLIGHT.record("p2p-abort", dir="rx", tag=tag,
+                           error=type(e).__name__)
+            try:
+                self._p2p_resume_rx(key, e, timeout_s)
+            except BaseException as e2:
+                # an unresumable timeout stays cleanly retryable at the
+                # SAME sequence number (the pre-resume contract): drop
+                # the registration so the retry re-registers fresh
+                _FLIGHT.record("p2p-resume-abort", dir="rx", tag=tag,
+                               error=type(e2).__name__)
+                self._p2p_inflight.pop(key, None)
+                raise
         # advance only on success: a timed-out recv put nothing on the wire,
         # so a retry (with a longer timeout) must re-post the SAME sequence
         # number or the stream is permanently off by one
-        self._p2p_seq[src][("rx", tag)] = seq + 1
+        if key is not None:
+            self._p2p_inflight.pop(key, None)
+        st[("rx", tag)] = seq + 1
         return got.view(template.dtype).reshape(template.shape)
 
     def isend(self, x, dst: int, tag: int = 0,
@@ -638,20 +1132,54 @@ class ProcessGroup:
         """Non-blocking send: frames are queued on the wire immediately
         (pumping the p2p plane under backpressure); ``wait()`` flushes the
         tx queue. Shares the (peer, tag) sequence space with :meth:`send`,
-        so blocking and non-blocking calls interleave coherently."""
+        so blocking and non-blocking calls interleave coherently. A
+        ``wait()`` interrupted by a heal/grow resumes the stream like
+        :meth:`send` (the handle keeps the payload for the tail
+        re-send)."""
         x = np.asarray(x)
+        data = plugin._as_bytes(x)
+        orig = self._ranks[dst]
         wire = self._p2p_wire(dst, "tx", timeout_s)
-        seq = self._p2p_seq[dst].get(("tx", tag), 0)
-        self._claim_outstanding(dst, "tx", tag)
-        self._p2p_seq[dst][("tx", tag)] = seq + 1
-        wire.queue_send(plugin._as_bytes(x), self._p2p_hop(tag, seq),
-                        progress=self._p2p_progress)
+        st = self._pstate(dst)
+        seq = st.get(("tx", tag), 0)
+        hop = self._p2p_hop(tag, seq)  # validates tag before any claim
+        self._claim_outstanding(orig, "tx", tag)
+        st[("tx", tag)] = seq + 1
+        key = self._register_inflight(orig, "tx", tag,
+                                      {"seq": seq, "data": data,
+                                       "hop": hop})
+        epoch0 = self.epoch
+        try:
+            wire.queue_send(data, hop, progress=self._p2p_progress)
+        except BaseException as e:
+            # a queue-time failure produced no handle whose wait() owns
+            # the cleanup: drop the registration and the outstanding
+            # claim, or every later op on the stream runs uncovered and
+            # a later heal resume-resends a payload whose isend the
+            # caller watched FAIL
+            _FLIGHT.record("p2p-abort", dir="tx", tag=tag,
+                           error=type(e).__name__)
+            if key is not None:
+                self._p2p_inflight.pop(key, None)
+            self._release_outstanding(orig, "tx", tag)
+            raise
 
         def wait():
-            plugin._flush_tx(wire.send_comm, timeout_s,
-                             extra_pump=self._p2p_progress,
-                             what="isend: peer stopped draining")
-            self._release_outstanding(dst, "tx", tag)
+            try:
+                plugin._flush_tx(wire.send_comm, timeout_s,
+                                 extra_pump=self._p2p_progress,
+                                 what="isend: peer stopped draining")
+                self._raise_if_interrupted(key, epoch0)
+            except (TimeoutError, OSError, RuntimeError) as e:
+                if key is None:
+                    raise
+                _FLIGHT.record("p2p-abort", dir="tx", tag=tag,
+                               error=type(e).__name__)
+                self._p2p_resume_tx(key, e, timeout_s)
+            finally:
+                if key is not None:
+                    self._p2p_inflight.pop(key, None)
+            self._release_outstanding(orig, "tx", tag)
 
         return P2PHandle(wait)
 
@@ -664,49 +1192,78 @@ class ProcessGroup:
         peer blocks wiring the receive connection until that peer dials
         (i.e. first sends) — for symmetric first-contact exchanges, issue
         through :meth:`batch_isend_irecv`, which orders the wiring so
-        cycles resolve."""
+        cycles resolve. A ``wait()`` interrupted by a heal/grow resumes
+        from the last fence-acknowledged frame like :meth:`recv`."""
         template = np.asarray(x_like)
+        orig = self._ranks[src]
         wire = self._p2p_wire(src, "rx", timeout_s)
-        seq = self._p2p_seq[src].get(("rx", tag), 0)
-        self._claim_outstanding(src, "rx", tag)
-        self._p2p_seq[src][("rx", tag)] = seq + 1
+        st = self._pstate(src)
+        seq = st.get(("rx", tag), 0)
+        hop = self._p2p_hop(tag, seq)  # validates tag before any claim
+        self._claim_outstanding(orig, "rx", tag)
+        st[("rx", tag)] = seq + 1
         nbytes = template.nbytes
         # the destination is allocated at POST time so recv_into-capable
         # nets land every frame straight into it (zero staging copies);
         # legacy planes still hand payloads back through wait()
         got = np.empty(nbytes, np.uint8)
-        reqs = wire.post_recvs(nbytes, self._p2p_hop(tag, seq), into=got)
+        key = self._register_inflight(orig, "rx", tag,
+                                      {"seq": seq, "got": got, "hop": hop,
+                                       "nbytes": nbytes, "acked": 0})
+        try:
+            reqs = wire.post_recvs(nbytes, hop, into=got)
+        except BaseException as e:
+            # no handle exists yet to own the cleanup: the registration
+            # and outstanding claim must not outlive the failed post
+            _FLIGHT.record("p2p-abort", dir="rx", tag=tag,
+                           error=type(e).__name__)
+            if key is not None:
+                self._p2p_inflight.pop(key, None)
+            self._release_outstanding(orig, "rx", tag)
+            raise
 
         def wait():
-            for off, nb, r in reqs:
+            info = (self._p2p_inflight.get(key) if key is not None
+                    else None) or {"got": got, "acked": 0}
+            try:
                 # _p2p_progress pumps every wired comm BOTH ways, so queued
                 # isend tx keeps draining while this recv blocks
-                payload = r.wait(timeout_s=timeout_s,
-                                 progress=self._p2p_progress)
-                if payload is not None:  # legacy plane: stage the copy
-                    got[off:off + nb] = np.frombuffer(payload, np.uint8)
-                    _WIRE.copied(nb)
-            self._release_outstanding(src, "rx", tag)
+                self._drain_p2p_recvs(wire, reqs, info, timeout_s)
+            except (TimeoutError, OSError, RuntimeError) as e:
+                if key is None:
+                    raise
+                _FLIGHT.record("p2p-abort", dir="rx", tag=tag,
+                               error=type(e).__name__)
+                self._p2p_resume_rx(key, e, timeout_s)
+            finally:
+                if key is not None:
+                    self._p2p_inflight.pop(key, None)
+            self._release_outstanding(orig, "rx", tag)
             return got.view(template.dtype).reshape(template.shape)
 
         return P2PHandle(wait)
 
-    def _claim_outstanding(self, peer: int, d: str, tag: int) -> None:
+    def _claim_outstanding(self, orig: int, d: str, tag: int) -> None:
         # the 10-bit seq wrap in _p2p_hop is only safe while fewer than
         # 1024 ops are outstanding per (peer, direction, tag) stream: op
         # k+1024 would reuse op k's wire tags while its frames are still
-        # in flight — a silent mismatch, so it is refused here
+        # in flight — a silent mismatch, so it is refused here. Keyed by
+        # ORIGINAL rank: a handle's wait (and so its release) may run
+        # after a heal renumbered the peer.
         key = ("out", d, tag)
-        n = self._p2p_seq[peer].get(key, 0)
+        st = self._p2p_seq.setdefault(orig, {})
+        n = st.get(key, 0)
         if n >= 1023:
             raise RuntimeError(
-                f"too many outstanding p2p ops on (peer {peer}, {d}, "
-                f"tag {tag}): wait() some handles first (seq wrap window)")
-        self._p2p_seq[peer][key] = n + 1
+                f"too many outstanding p2p ops on (original rank {orig}, "
+                f"{d}, tag {tag}): wait() some handles first (seq wrap "
+                f"window)")
+        st[key] = n + 1
 
-    def _release_outstanding(self, peer: int, d: str, tag: int) -> None:
+    def _release_outstanding(self, orig: int, d: str, tag: int) -> None:
         key = ("out", d, tag)
-        self._p2p_seq[peer][key] = max(0, self._p2p_seq[peer].get(key, 1) - 1)
+        st = self._p2p_seq.setdefault(orig, {})
+        st[key] = max(0, st.get(key, 1) - 1)
 
     def batch_isend_irecv(self, ops, timeout_s: float = 60.0) -> list:
         """Issue a batch of p2p ops together (the torch
@@ -933,6 +1490,25 @@ class ProcessGroup:
         unless a future epoch consumer bumps differently)."""
         return self._heals
 
+    def _seed_admissions(self, ns: str, epoch: int, members: list,
+                         prop: dict, registry: str, slots: dict) -> None:
+        """Leader-side: seed each admitted slot's PRE-published listener
+        handle under the agreement ns and cut the admit record its
+        claimant is polling. One schema for both admission shapes (spare
+        promotion and grow join) — ``_complete_admission`` reads every
+        field, so the two paths must never desync."""
+        import json
+        for slot, sid in slots.items():
+            self._client.set_if_absent(f"{ns}/h/{slot}",
+                                       prop["handles"][str(slot)])
+            self._client.set(
+                f"pg/{self.group_name}/{registry}/admit/{sid}",
+                json.dumps({"epoch": epoch, "members": members,
+                            "slot": slot, "ops": int(prop["ops"]),
+                            "hwm": int(prop["hwm"]), "ns": ns,
+                            "grow_no": self._grow_no,
+                            "watchdog": prop.get("watchdog")}))
+
     def heal(self, grace_s: float = 5.0, timeout_s: float | None = None,
              _suspects=None) -> list:
         """Elastic recovery IN PLACE — the self-healing half of the
@@ -967,6 +1543,17 @@ class ProcessGroup:
            clock-sync mark; the watchdog (if it was running) restarts on
            the new membership.
 
+        **Warm spares.** When the group has registered spares
+        (``init_process_group(spare=True)`` + ``wait_promotion``), a
+        confirmed-dead slot is PROMOTED instead of shrunk: the lowest-sid
+        live, unburned spare adopts the dead rank's original identity —
+        the member list (and so world size, reshard shapes, and rooted
+        roots) is preserved, and the only wire work on the critical path
+        is dialing the spare's PRE-published listener and the spare's one
+        dial to its successor. A spare is promotable at most once (its
+        admit record burns it), so a spare that dies mid-promotion is
+        deterministically skipped by the retried heal, which shrinks.
+
         Returns the new member list (original ranks). Raises for a rank
         that misses the window (it must exit — the group moved on), and
         keeps the same store-must-survive requirement as ``shrink``.
@@ -974,6 +1561,9 @@ class ProcessGroup:
         already confirmed dead — lets the grace window close early."""
         if self._destroyed:
             raise RuntimeError("cannot heal a destroyed group")
+        if self._standby is not None:
+            raise RuntimeError("a spare/joiner cannot heal the group it "
+                               "is waiting to enter (wait_promotion)")
         if self.world_size == 1 or self._client is None:
             raise RuntimeError("nothing to heal: single-rank group")
         import json
@@ -1043,8 +1633,28 @@ class ProcessGroup:
                 f"heal: no alive keys readable after {grace_s}s grace "
                 f"(store unreachable? group {self.group_name!r})")
         if g == min(alive):
-            self._client.set_if_absent(f"{ns}/members", json.dumps(alive))
-        members = json.loads(self._client.get(f"{ns}/members", remaining()))
+            # spare promotion (the "heal without shrinking" half): every
+            # confirmed-dead slot with a live, unburned warm spare keeps
+            # its seat — the spare adopts the slot's ORIGINAL identity
+            # (re-rank + epoch bump only; its listener was pre-published
+            # at registration, so no cold listen/publish lands on this
+            # critical path). Dead slots beyond the spare pool shrink as
+            # before.
+            dead_now = [m for m in self._ranks if m not in alive]
+            promoted = self._assign_spares(dead_now, remaining)
+            prop = {"members": [m for m in self._ranks
+                                if m in alive or m in promoted],
+                    "promoted": {str(s): sid
+                                 for s, (sid, _) in promoted.items()},
+                    "handles": {str(s): h
+                                for s, (_, h) in promoted.items()},
+                    "ops": self._op_seq, "hwm": self._orig_hwm,
+                    "watchdog": was_watching}
+            self._client.set_if_absent(f"{ns}/members", json.dumps(prop))
+        prop = json.loads(self._client.get(f"{ns}/members", remaining()))
+        members = list(prop["members"])
+        promoted_slots = {int(k): v
+                          for k, v in prop.get("promoted", {}).items()}
         if g not in members:
             raise RuntimeError(
                 f"rank {g} missed the heal window; group re-formed as "
@@ -1053,7 +1663,8 @@ class ProcessGroup:
         old_ranks, old_world = self._ranks, self.world_size
         new_rank, new_world = members.index(g), len(members)
         _FLIGHT.record("heal-members", epoch=epoch,
-                       members=json.dumps(members), dead=json.dumps(dead))
+                       members=json.dumps(members), dead=json.dumps(dead),
+                       promoted=json.dumps(promoted_slots, sort_keys=True))
         # divergence check: a death can straddle a commit boundary — a
         # survivor whose last inbound frames did not depend on the victim
         # COMMITS the interrupted collective while downstream survivors
@@ -1072,14 +1683,36 @@ class ProcessGroup:
                 f"(committed-op counts {seqs}); some ranks committed the "
                 f"op others must retry — transparent retry is impossible, "
                 f"restart the job from its last checkpoint")
+        # promotion bookkeeping BEFORE the rewire: incarnations bump (the
+        # process behind a promoted identity changed — p2p stream state
+        # under it must not resume), and the leader seeds the promoted
+        # slots' PRE-PUBLISHED listener handles under the heal ns plus
+        # the admit records the spares are polling. Admits are written
+        # only after the divergence check above: a diverged heal must
+        # not burn (or wake) a spare.
+        fresh = set(promoted_slots)
+        for slot in sorted(fresh):
+            self._incarnation[slot] = self._incarnation.get(slot, 0) + 1
+            _FLIGHT.record("heal-promoted", epoch=epoch, slot=slot,
+                           sid=promoted_slots[slot])
+        if g == min(alive) and promoted_slots:
+            self._seed_admissions(ns, epoch, members, prop, "spares",
+                                  promoted_slots)
         # 2. the fence goes up BEFORE any rewiring: every comm (kept or
         # new) now stamps the new generation; stale stashed frames are
-        # fenced+counted; LG credit and put-ring state reset
+        # fenced+counted; LG credit and put-ring state reset. P2P wiring
+        # drops but STREAM state survives for continuous peers (resume).
+        # self.epoch advances WITH the fence, not after the rewire: a
+        # heal that fails mid-rewire on one survivor but post-rewire on
+        # another must leave every survivor proposing the SAME next
+        # epoch (e+2), or the retried heals rendezvous in different
+        # namespaces and split-brain into disjoint groups.
         self._net.set_epoch(epoch)
-        self._teardown_p2p()
-        self._rewire(members, new_rank, new_world, old_ranks, ns, remaining)
-        self.rank, self.world_size, self._ranks = new_rank, new_world, members
         self.epoch = epoch
+        self._suspend_p2p(members, fresh)
+        self._rewire(members, new_rank, new_world, old_ranks, ns, remaining,
+                     fresh=fresh)
+        self.rank, self.world_size, self._ranks = new_rank, new_world, members
         self._barrier_no = 0
         self._postmortemed = False
         # the store identity follows the new numbering (liveness stamps,
@@ -1087,30 +1720,40 @@ class ProcessGroup:
         self._client.rank = new_rank
         self._client.barrier(f"{ns}/wired", new_world, remaining())
         # every survivor has re-stamped under its new id at the barrier;
-        # the leader prunes the ids the compaction orphaned so nothing
-        # stale can brand a live rank dead (satellite: bootstrap prune)
-        if g == min(members) and new_world < old_world:
+        # the leader prunes the ids the compaction orphaned — and the
+        # promoted spares' prefixed store footprint — so nothing stale
+        # can brand a live rank dead or collide with a later claimant
+        # (satellite: bootstrap prune)
+        if g == min(alive) and (new_world < old_world or promoted_slots):
             try:
                 self._client.prune(range(new_world, old_world),
-                                   prefix=f"pg/{self.group_name}/")
+                                   prefix=f"pg/{self.group_name}/",
+                                   spares=promoted_slots.values())
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness: stale ids age out of use
         # the wired barrier doubles as the new epoch's clock handshake
         # (obs.chrome aligns rank timelines on the LAST sync mark)
         _FLIGHT.mark_sync(ns=ns, rank=new_rank)
         self._heals += 1
-        _FLIGHT.record("heal-done", epoch=epoch, world=new_world)
+        if promoted_slots:
+            _WIRE.promoted(len(promoted_slots))
+        _FLIGHT.record("heal-done", epoch=epoch, world=new_world,
+                       promoted=len(promoted_slots))
         if was_watching is not None:
             self.start_watchdog(*was_watching)
         return members
 
     def _rewire(self, members, new_rank, new_world, old_ranks, ns,
-                remaining) -> None:
+                remaining, fresh=frozenset()) -> None:
         """Repair the ring around the dead: keep edges whose endpoints
         stay ring-adjacent (stale frames on them are epoch-fenced), dial
         fresh connections across the gaps. Publish-before-dial ordering
         makes any pattern of gaps deadlock-free, exactly as in
-        ``bootstrap_ring``."""
+        ``bootstrap_ring``. ``fresh``: original ranks whose PROCESS is
+        new this epoch (promoted spares, grow joiners) — an edge touching
+        one is never "kept" even when the identity adjacency matches,
+        because the old connection went to a different process (the dead
+        rank, or nowhere)."""
         from rocnrdma_tpu.transport.backoff import retry_with_backoff
 
         def succ_of(gid, ring):
@@ -1127,8 +1770,10 @@ class ProcessGroup:
             return
         succ_g = members[(new_rank + 1) % new_world]
         pred_g = members[(new_rank - 1) % new_world]
-        keep_send = succ_of(g, old_ranks) == succ_g
-        keep_recv = succ_of(pred_g, old_ranks) == g
+        keep_send = (succ_g not in fresh and succ_g in old_ranks
+                     and succ_of(g, old_ranks) == succ_g)
+        keep_recv = (pred_g not in fresh and pred_g in old_ranks
+                     and succ_of(pred_g, old_ranks) == g)
         listener = send_comm = recv_comm = None
         try:
             if not keep_recv:
@@ -1162,11 +1807,17 @@ class ProcessGroup:
         except BaseException as e:
             # a failed repair must not leak the half-made endpoints (the
             # bootstrap_ring teardown discipline) and must leave a
-            # flight event for the postmortem
-            _FLIGHT.record("heal-abort", epoch=self.epoch + 1,
+            # flight event for the postmortem (self.epoch already
+            # advanced with the fence)
+            _FLIGHT.record("heal-abort", epoch=self.epoch,
                            error=type(e).__name__)
             if send_comm is not None:
                 self._close_comm_quietly(send_comm)
+                if self._send is send_comm:
+                    # the retry's _ring fast-fail checks _send/_recv for
+                    # None — a pointer at the just-closed comm would hand
+                    # it to the next collective instead
+                    self._send = None
             if recv_comm is None and listener is not None:
                 bootstrap._close_quietly(listener)
             raise
@@ -1182,11 +1833,17 @@ class ProcessGroup:
         except Exception:
             pass
 
-    def _teardown_p2p(self) -> None:
-        """Drop all p2p wiring at a heal: peers renumber, so cached
-        wires, sequence counters, and published listeners are meaningless
-        in the new epoch (p2p streams do not survive a heal — the same
-        'failed send leaves the stream undefined' contract as before)."""
+    def _suspend_p2p(self, members, fresh=frozenset()) -> None:
+        """Drop all p2p WIRING at a heal/grow — peers renumber, so cached
+        connections and published listeners are meaningless in the new
+        epoch — but keep the STREAM state (sequence counters and
+        in-flight registrations, keyed by original rank) for peers whose
+        process continues into the new membership: those streams RESUME
+        from the last fence-acknowledged frame (``_p2p_resume_rx``/
+        ``_p2p_resume_tx``) instead of tearing down. State for dead
+        slots — and for fresh incarnations (promoted spares, joiners)
+        under a surviving identity — is dropped: the stream's data died
+        with the process behind it."""
         for (peer, d), wire in list(self._p2p.items()):
             self._close_comm_quietly(wire.recv_comm if d == "rx"
                                      else wire.send_comm)
@@ -1199,7 +1856,447 @@ class ProcessGroup:
                     bootstrap._close_quietly(listener)
         self._p2p_listen = None
         self._p2p_accepted = set()
-        self._p2p_seq.clear()
+        keep = set(members) - set(fresh)
+        for orig in list(self._p2p_seq):
+            if orig not in keep:
+                del self._p2p_seq[orig]
+        for key in list(self._p2p_inflight):
+            if key[0] not in keep:
+                del self._p2p_inflight[key]
+            else:
+                # re-arm: a tail re-queued by an EARLIER resume (state
+                # "resumed") was just fenced again with this epoch bump —
+                # clear the flag so the wait/service re-run the resume
+                # protocol against the receiver's CURRENT cursor instead
+                # of reporting a flush of fenced frames as success
+                self._p2p_inflight[key].pop("state", None)
+        # surviving outbound streams now await their receivers' RESUME
+        # cursors; the service runs from the progress engine AND from
+        # _check_alive (a sender that moved on to collectives must still
+        # answer — see _p2p_resume_service)
+        self._p2p_resume_pending = any(k[1] == "tx"
+                                       for k in self._p2p_inflight)
+
+    def _scan_standby_registry(self, sub: str, base: int, what: str,
+                               remaining) -> list:
+        """Walk the standby registry ``pg/<group>/<sub>`` for live,
+        unburned registrations, ascending slot id — ``[(sid, handle),
+        ...]``. Slot ids are claimed densely from 0 and consumed
+        monotonically — ``prune`` keeps the ``slot``/``admit`` keys of
+        promoted/burned slots precisely so this scan's
+        first-missing-slot stop rule cannot hide a live standby at a
+        higher sid. A registration is a candidate only when it is
+        unburned (no admit record — an admit, even from a heal/grow
+        that later failed, burns the slot; the decision is a function
+        of store state, never of wall-clock races), has published its
+        listener handle, and heartbeats within the liveness window."""
+        try:
+            ages = self._client.live_ages()
+        except (OSError, TimeoutError):
+            ages = {}
+        # liveness window: a standby polls its admit key continuously, so
+        # any healthy one's age is near zero; the generous floor only
+        # guards against a scheduler stall branding a live standby dead
+        window = 10.0
+        reg = f"pg/{self.group_name}/{sub}"
+        out = []
+        sid = 0
+        while True:
+            # both callers floor remaining() at 0.1 — compare against
+            # that floor or an expired deadline never stops the scan
+            if remaining() <= 0.1:
+                raise TimeoutError(
+                    f"{what}: standby registry scan ran out of deadline")
+            if self._client.try_get(f"{reg}/slot/{sid}") is None:
+                break
+            if self._client.try_get(f"{reg}/admit/{sid}") is None:
+                handle = self._client.try_get(f"{reg}/h/{sid}")
+                age = ages.get(base + sid)
+                if handle is not None and age is not None and age <= window:
+                    out.append((sid, handle))
+            sid += 1
+        return out
+
+    def _assign_spares(self, dead_slots, remaining) -> dict:
+        """Heal-leader side of promotion: map confirmed-dead slots
+        (ascending) to live, unburned spares (ascending slot id) from
+        the store registry — a spare that died mid-promotion is
+        deterministically skipped by the retried heal (see
+        ``_scan_standby_registry``'s burn rule). Returns
+        ``{slot: (sid, handle)}``."""
+        if not dead_slots:
+            return {}
+        candidates = self._scan_standby_registry(
+            "spares", bootstrap.SPARE_RANK_BASE, "heal", remaining)
+        return dict(zip(sorted(dead_slots), candidates))
+
+    # -- elastic grow (rank admission: the exact dual of heal) --------------
+
+    def grow(self, grace_s: float = 5.0,
+             timeout_s: float | None = None) -> list:
+        """Elastic grow IN PLACE — the exact dual of :meth:`heal`:
+        re-admit capacity instead of shrinking around its loss.
+
+        Collective: every current member calls ``grow()`` at the same
+        committed-op boundary (between collectives); joiners must already
+        be registered through :func:`join_process_group`. The protocol
+        mirrors heal step for step:
+
+        1. **Agreement.** Members publish their committed-op counts under
+           a per-grow namespace and verify they agree (the joiners adopt
+           the agreed count, so a later heal's divergence rule keeps
+           working on the widened group); the lowest original rank
+           proposes the widened member list (first-writer-wins), with
+           every live pending joiner assigned a fresh original id past
+           the high-water mark — dead ids are never reused, so oracles
+           keyed by original rank stay unambiguous.
+        2. **Fence + splice.** ``set_epoch`` fences the old generation
+           exactly as in heal; the ring is re-wired with the admitted
+           ranks spliced in at the tail — surviving edges are KEPT
+           (their stale tails fence on arrival), only the wrap edge and
+           the joiner edges dial, through the grow namespace's
+           publish-before-dial keys under the shared backoff. Joiners
+           pre-published their listener handles at registration, so no
+           cold listen/publish lands on this path.
+        3. **Re-arm.** The wired barrier doubles as the new epoch's
+           clock-sync mark; the watchdog restarts on the widened
+           membership; p2p streams between continuing members resume
+           (same contract as heal).
+
+        Admitting zero joiners is a no-op (no epoch burn). Returns the
+        new member list (original ranks)."""
+        if self._destroyed:
+            raise RuntimeError("cannot grow a destroyed group")
+        if self._standby is not None:
+            raise RuntimeError("a spare/joiner cannot grow the group it "
+                               "is waiting to enter")
+        if self._client is None:
+            raise RuntimeError(
+                "nothing to grow from: this group has no store client "
+                "(single-rank groups must be created with a store_handle "
+                "to be growable)")
+        t = self.timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + t + grace_s
+        remaining = lambda: max(0.1, deadline - time.monotonic())
+        epoch = self.epoch + 1
+        self._grow_no += 1
+        g = self._ranks[self.rank]
+        ns = f"pg/{self.group_name}/grow/g{self._grow_no}"
+        _FLIGHT.record("grow-start", epoch=epoch, rank=g)
+        was_watching = self._watchdog_params
+        self.stop_watchdog()
+        try:
+            return self._grow_protocol(epoch, g, ns, remaining,
+                                       was_watching)
+        except BaseException as e:
+            # a failed grow must not leave failure detection silently
+            # off (the heal discipline): re-arm before propagating
+            _FLIGHT.record("grow-abort", epoch=epoch,
+                           error=type(e).__name__)
+            if was_watching is not None:
+                self.start_watchdog(*was_watching)
+            raise
+
+    def _grow_protocol(self, epoch, g, ns, remaining,
+                       was_watching) -> list:
+        import json
+
+        from rocnrdma_tpu.transport.backoff import poll_backoff
+        # 1. member agreement: unlike heal there is no dead-exclusion —
+        # grow is a deliberate op on a healthy group, so EVERY member
+        # must arrive (a dead one is heal's problem, named here by the
+        # deadline), and all must agree on the committed-op boundary
+        self._client.set(f"{ns}/alive/{g}", str(self._op_seq))
+        back = poll_backoff()
+        while True:
+            alive = [m for m in self._ranks
+                     if self._client.try_get(f"{ns}/alive/{m}") is not None]
+            if len(alive) == len(self._ranks):
+                break
+            if remaining() <= 0.1:
+                raise TimeoutError(
+                    f"grow: member(s) "
+                    f"{sorted(set(self._ranks) - set(alive))} never "
+                    f"arrived at the grow rendezvous (heal() first if "
+                    f"one is dead)")
+            back.pause()
+        seqs = {m: self._client.try_get(f"{ns}/alive/{m}")
+                for m in self._ranks}
+        if len({v for v in seqs.values() if v is not None}) > 1:
+            _FLIGHT.record("grow-diverged", epoch=epoch,
+                           seqs=json.dumps(seqs, sort_keys=True))
+            raise RuntimeError(
+                f"grow: members disagree on the committed-op boundary "
+                f"({seqs}); issue grow() between collectives, on every "
+                f"rank")
+        # 2. leader proposal: every live pending joiner is admitted,
+        # assigned an original id past the high-water mark
+        if g == min(self._ranks):
+            joiners = self._pending_joiners(remaining)
+            new_slots = {self._orig_hwm + i: sh
+                         for i, sh in enumerate(joiners)}
+            prop = {"members": list(self._ranks) + sorted(new_slots),
+                    "joined": {str(s): sid
+                               for s, (sid, _) in new_slots.items()},
+                    "handles": {str(s): h
+                                for s, (_, h) in new_slots.items()},
+                    "ops": self._op_seq,
+                    "hwm": self._orig_hwm + len(new_slots),
+                    "watchdog": was_watching}
+            self._client.set_if_absent(f"{ns}/members", json.dumps(prop))
+        prop = json.loads(self._client.get(f"{ns}/members", remaining()))
+        members = list(prop["members"])
+        joined = {int(k): v for k, v in prop.get("joined", {}).items()}
+        old_ranks, old_world = self._ranks, self.world_size
+        _FLIGHT.record("grow-members", epoch=epoch,
+                       members=json.dumps(members),
+                       joined=json.dumps(sorted(joined)))
+        if not joined:
+            # nothing to admit: the group is untouched (no epoch burn)
+            _FLIGHT.record("grow-done", epoch=self.epoch,
+                           world=self.world_size, joined=0)
+            if was_watching is not None:
+                self.start_watchdog(*was_watching)
+            return list(self._ranks)
+        new_rank, new_world = members.index(g), len(members)
+        fresh = set(joined)
+        for slot in sorted(fresh):
+            self._incarnation[slot] = self._incarnation.get(slot, 0) + 1
+        if g == min(old_ranks):
+            self._seed_admissions(ns, epoch, members, prop, "join", joined)
+        # 3. fence + splice: kept survivor edges fence their stale tails
+        # on arrival exactly as in heal; only the wrap and joiner edges
+        # dial (publish-before-dial through the grow ns). self.epoch
+        # advances WITH the fence, not after the rewire — same invariant
+        # as heal: a grow that fails mid-rewire on one member but
+        # post-rewire on another must leave every member proposing the
+        # SAME next epoch, or the retried repairs rendezvous in
+        # different namespaces and split-brain.
+        self._net.set_epoch(epoch)
+        self.epoch = epoch
+        self._suspend_p2p(members, fresh)
+        self._rewire(members, new_rank, new_world, old_ranks, ns, remaining,
+                     fresh=fresh)
+        self.rank, self.world_size, self._ranks = new_rank, new_world, members
+        self._orig_hwm = int(prop["hwm"])
+        self._barrier_no = 0
+        self._postmortemed = False
+        self._client.rank = new_rank
+        self._client.barrier(f"{ns}/wired", new_world, remaining())
+        if g == min(old_ranks):
+            try:
+                # the admitted joiners' prefixed store footprint (slot/
+                # handle/admit keys, prefixed liveness, barrier arrivals)
+                # is cleared so their slot ids are cleanly re-claimable
+                self._client.prune((), prefix=f"pg/{self.group_name}/",
+                                   joiners=joined.values())
+            except (OSError, TimeoutError):
+                pass  # hygiene, not correctness
+        _FLIGHT.mark_sync(ns=ns, rank=new_rank)
+        _WIRE.grew()
+        _FLIGHT.record("grow-done", epoch=epoch, world=new_world,
+                       joined=len(fresh))
+        if was_watching is not None:
+            self.start_watchdog(*was_watching)
+        return members
+
+    def _pending_joiners(self, remaining) -> list:
+        """Grow-leader side: the live, unadmitted joiner registrations,
+        ascending slot id — ``[(sid, handle), ...]`` (same scan and
+        burn rule as spare promotion: ``_scan_standby_registry``)."""
+        return self._scan_standby_registry(
+            "join", bootstrap.JOINER_RANK_BASE, "grow", remaining)
+
+    # -- standby ranks (warm spares / grow joiners) -------------------------
+
+    def _register_standby(self, timeout_s: float) -> None:
+        """Register this process in the store's standby registry: claim
+        the lowest free slot id (set-if-absent — first writer wins),
+        adopt the prefixed liveness identity, and PRE-publish a listener
+        handle so promotion-time dials hit an already-listening endpoint
+        (the no-cold-dial half of the warm-spare contract — the spare's
+        would-be neighbours read this handle instead of waiting for a
+        fresh listen+publish on the heal's critical path). Injected
+        admission refusals (``FaultSchedule.join_refusals``) retry under
+        the shared backoff like refused connects."""
+        import uuid as _uuid
+
+        from rocnrdma_tpu.transport.backoff import retry_with_backoff
+        sub = "spares" if self._standby == "spare" else "join"
+        reg = f"pg/{self.group_name}/{sub}"
+        token = _uuid.uuid4().hex
+        sched = getattr(self._net, "schedule", None)
+
+        def claim() -> int:
+            why = sched.join_fault() if sched is not None else None
+            if why is not None:
+                raise ConnectionRefusedError(f"faultnet: {why}")
+            deadline = time.monotonic() + timeout_s
+            sid = 0
+            while True:
+                if self._client.set_if_absent(f"{reg}/slot/{sid}",
+                                              token) == token:
+                    return sid
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"standby registration: no free {sub} slot "
+                        f"within {timeout_s}s")
+                sid += 1
+
+        self._sid = retry_with_backoff(
+            claim, timeout_s, f"{sub} admission",
+            retry_on=(ConnectionRefusedError,))
+        base = (bootstrap.SPARE_RANK_BASE if sub == "spares"
+                else bootstrap.JOINER_RANK_BASE)
+        self._client.rank = base + self._sid
+        handle, listener = self._net.listen()
+        self._standby_listener = listener
+        self._client.set(f"{reg}/h/{self._sid}", handle)
+        self._client.heartbeat()  # first stamp under the prefixed id
+        _FLIGHT.record("standby-registered", role=self._standby,
+                       sid=self._sid)
+
+    def wait_promotion(self, timeout_s: float = 600.0) -> list:
+        """Block until this standby rank is admitted, then wire in and
+        become a full member; returns the member list (original ranks).
+
+        For a SPARE: a heal with a confirmed-dead slot promotes the
+        lowest-sid live spare into the dead rank's ORIGINAL identity —
+        re-rank + epoch bump, world size unchanged; the interrupted
+        collective's retry then runs on the full-width group with this
+        process contributing in the dead rank's place. For a JOINER:
+        the survivors' next :meth:`grow` admits it under a fresh
+        original id (``join_process_group`` calls this internally).
+
+        While waiting, every admit-key poll stamps the prefixed liveness
+        id — the heartbeat the heal/grow leader's candidate scan reads.
+        Collectives on a standby rank raise until this returns."""
+        if self._standby is None:
+            raise RuntimeError("wait_promotion: this rank is not a "
+                               "spare/joiner (already a member?)")
+        import json
+
+        from rocnrdma_tpu.transport.backoff import poll_backoff
+        sub = "spares" if self._standby == "spare" else "join"
+        admit_key = f"pg/{self.group_name}/{sub}/admit/{self._sid}"
+        deadline = time.monotonic() + timeout_s
+        back = poll_backoff()
+        kind = self._standby
+        try:
+            while True:
+                val = self._client.try_get(admit_key)
+                if val is not None:
+                    break
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"wait_promotion: no admission within {timeout_s}s "
+                        f"({self._standby} {self._sid} of group "
+                        f"{self.group_name!r})")
+                back.pause()
+            info = json.loads(val)
+            sched = getattr(self._net, "schedule", None)
+            if sched is not None:
+                sched.promotion_fault()  # chaos: spare death mid-promotion
+            _FLIGHT.record("promote-admit", epoch=info["epoch"],
+                           slot=info["slot"], sid=self._sid, role=kind)
+            self._complete_admission(info)
+        except BaseException as e:
+            # an aborted admission (missed window, store flake, the
+            # admitting group dying mid-splice) must leave its story in
+            # the flight ring — the postmortem for "the spare never
+            # joined" starts here
+            _FLIGHT.record("promote-abort", role=kind, sid=self._sid,
+                           error=type(e).__name__)
+            raise
+        if kind == "spare":
+            _WIRE.promoted()
+        else:
+            _WIRE.grew()
+        _FLIGHT.record("promote-done", epoch=self.epoch, rank=self.rank,
+                       world=self.world_size, role=kind)
+        return list(self._ranks)
+
+    def _complete_admission(self, info: dict) -> None:
+        """Shared spare/joiner admission: adopt the assigned identity,
+        epoch, and committed-op count; wire into the ring (accept the
+        predecessor on the PRE-created listener whose handle the leader
+        seeded, dial the successor's per-epoch handle); join the wired
+        barrier that doubles as the new epoch's clock-sync mark."""
+        from rocnrdma_tpu.transport.backoff import retry_with_backoff
+        ns = info["ns"]
+        epoch = int(info["epoch"])
+        members = list(info["members"])
+        slot = int(info["slot"])
+        deadline = time.monotonic() + self.timeout_s
+        remaining = lambda: max(0.1, deadline - time.monotonic())
+        self._net.set_epoch(epoch)
+        self._ranks = members
+        self.rank = members.index(slot)
+        self.world_size = len(members)
+        self.epoch = epoch
+        self.last_op_epoch = epoch
+        self._op_seq = int(info.get("ops", 0))
+        self._orig_hwm = int(info.get("hwm", max(members) + 1))
+        # adopt the group's grow counter: a later grow()'s rendezvous
+        # namespace (grow/g<N>) is keyed by it, and a member admitted at
+        # counter k that kept its own 0 would rendezvous in a split
+        # namespace and deadlock the whole group
+        self._grow_no = int(info.get("grow_no", 0))
+        self._barrier_no = 0
+        self._client.rank = self.rank
+        listener = self._standby_listener
+        send_comm = None
+        try:
+            if self.world_size > 1:
+                succ_g = members[(self.rank + 1) % self.world_size]
+                peer_handle = self._client.get(f"{ns}/h/{succ_g}",
+                                               remaining())
+                send_comm = retry_with_backoff(
+                    lambda: self._net.connect(0, peer_handle,
+                                              min(5.0, remaining())),
+                    remaining(),
+                    f"admission wiring: connect to original rank {succ_g}",
+                    retry_on=(ConnectionRefusedError, ConnectionResetError))
+                self._send = send_comm
+                self._recv = retry_with_backoff(
+                    lambda: self._net.accept(listener,
+                                             min(5.0, remaining())),
+                    remaining(),
+                    "admission wiring: accept the predecessor",
+                    retry_on=(ConnectionRefusedError, ConnectionResetError,
+                              TimeoutError))
+                # on the shm plane the listener IS the accepted comm's QP
+                # (owned by the net from here); TCP listeners stay in the
+                # net's listener registry until close — either way it is
+                # no longer this rank's to tear down
+                self._standby_listener = None
+            self._client.barrier(f"{ns}/wired", self.world_size,
+                                 remaining())
+        except BaseException as e:
+            _FLIGHT.record("promote-abort", epoch=epoch, slot=slot,
+                           error=type(e).__name__)
+            if send_comm is not None:
+                self._close_comm_quietly(send_comm)
+            raise
+        _FLIGHT.mark_sync(ns=ns, rank=self.rank)
+        self._standby = None
+        wd = info.get("watchdog")
+        if wd:
+            self.start_watchdog(*wd)
+
+    @property
+    def committed_ops(self) -> int:
+        """Collectives COMMITTED on this group (the exactly-once retry
+        ledger). A promoted spare/joiner adopts the group's agreed count
+        at admission, so a harness can resume its op loop at the right
+        index."""
+        return self._op_seq
+
+    @property
+    def is_standby(self) -> bool:
+        """True while this rank is a spare/joiner sitting out of
+        collectives (admission clears it)."""
+        return self._standby is not None
 
     # -- watchdog (the ProcessGroupNCCL watchdog / RCCL heartbeat analogue) --
 
@@ -1224,8 +2321,8 @@ class ProcessGroup:
         itself dies (store unreachable), that is recorded and surfaced by
         the next verb — a broken detector must not masquerade as a quiet
         one."""
-        if self.world_size == 1:
-            return
+        if self.world_size == 1 or self._standby is not None:
+            return  # standby ranks heartbeat via their admit-key polls
         if self._watchdog is not None and self._watchdog.is_alive():
             return
         self._watchdog_stop = threading.Event()
@@ -1353,6 +2450,19 @@ class ProcessGroup:
         return None
 
     def _check_alive(self) -> None:
+        if self._p2p_resume_pending:
+            # a sender that moved on to collectives must still answer its
+            # receivers' RESUME cursors, or a resumed recv on the other
+            # end starves to its (named) deadline — every verb entry
+            # gives the service a turn until nothing is left unserved
+            self._p2p_resume_pending = self._p2p_resume_service() > 0
+        if self._standby is not None:
+            # spares/joiners SIT OUT: no collective or p2p verb may run
+            # until admission re-ranks this process into the group
+            raise RuntimeError(
+                f"this rank is a standby {self._standby} for group "
+                f"{self.group_name!r}: it sits out of collectives until "
+                f"promoted/admitted (wait_promotion)")
         with self._health_lock:
             failed, dead = self._watchdog_failed, list(self._dead)
         if failed:
@@ -1410,13 +2520,20 @@ class ProcessGroup:
         from rocnrdma_tpu.obs import chrome
         chrome.dump_if_env(self.rank, group=self.group_name)
         if self._client is not None:
-            if graceful:
+            if graceful and self._standby is None:
+                # a standby rank never joins the members' destroy
+                # barrier: it is not one of the world_size arrivals
                 try:
                     self._client.barrier(f"pg/{self.group_name}/destroy",
                                          self.world_size, timeout_s=10.0)
                 except (OSError, TimeoutError):
                     pass  # peers may have crashed; teardown must complete
             self._client.close()
+        if self._standby_listener is not None:
+            # a never-promoted standby still holds its pre-published
+            # listener (on shm that is a queue pair owning a segment)
+            bootstrap._close_quietly(self._standby_listener)
+            self._standby_listener = None
         if self._p2p_listen and self.plane == "shm":
             # shm listeners ARE queue pairs: accepted ones became net comms
             # (closed by net.close()); never-accepted ones are invisible to
@@ -1449,7 +2566,8 @@ def init_process_group(rank: int | None = None,
                        group_name: str = "default",
                        plane: str = "tcp",
                        fault_schedule=None,
-                       self_heal: bool = False) -> ProcessGroup:
+                       self_heal: bool = False,
+                       spare: bool = False) -> ProcessGroup:
     """Create this process's :class:`ProcessGroup`.
 
     Rendezvous: either pass ``store_handle`` (an already-running
@@ -1474,7 +2592,33 @@ def init_process_group(rank: int | None = None,
     the collective on the survivors. Off by default: a shrunk-group
     result is a different answer than the full-group one, and the caller
     must have opted into that semantic.
+
+    ``spare``: start this process as a WARM SPARE instead of a member —
+    it bootstraps (store registration under a spare-prefixed liveness
+    id, pre-published listener), sits out of collectives, and blocks in
+    :meth:`ProcessGroup.wait_promotion` until a heal promotes it into a
+    confirmed-dead rank's original identity (epoch bump + re-rank, world
+    size preserved). Spares dial nothing cold on the promotion critical
+    path; ``rank`` is ignored (identity is assigned at promotion). The
+    group's store must already be running (pass ``store_handle``, or the
+    master env/args of the group whose rank 0 serves it).
     """
+    if spare:
+        if store_handle is None:
+            master_addr = master_addr or os.environ.get("MASTER_ADDR",
+                                                        "127.0.0.1")
+            master_port = (master_port if master_port is not None
+                           else int(os.environ.get("MASTER_PORT", "29500")))
+            store_handle = f"{master_addr}:{master_port}"
+        try:
+            return ProcessGroup(0, 0, store_handle, None, timeout_s,
+                                group_name, plane,
+                                fault_schedule=fault_schedule,
+                                self_heal=self_heal, standby="spare")
+        except BaseException as e:
+            _FLIGHT.record("group-abort", group=group_name, rank=-1,
+                           error=type(e).__name__)
+            raise
     rank = int(os.environ["RANK"]) if rank is None else rank
     world_size = (int(os.environ["WORLD_SIZE"]) if world_size is None
                   else world_size)
@@ -1503,3 +2647,41 @@ def init_process_group(rank: int | None = None,
         if server is not None:  # failed rendezvous must free the master port
             server.close()
         raise
+
+
+def join_process_group(store_handle: str | None = None,
+                       master_addr: str | None = None,
+                       master_port: int | None = None,
+                       group_name: str = "default",
+                       plane: str = "tcp",
+                       timeout_s: float = 300.0,
+                       fault_schedule=None,
+                       self_heal: bool = False) -> ProcessGroup:
+    """Join a RUNNING group as a fresh rank — the joiner side of elastic
+    grow. Registers in the store's join registry (joiner-prefixed
+    liveness id, pre-published listener handle, injected admission
+    refusals retried under the shared backoff) and blocks until the
+    members' next :meth:`ProcessGroup.grow` admits this process under a
+    fresh original rank id; returns the fully-wired member group.
+
+    ``timeout_s`` bounds the WHOLE admission wait — size it to how long
+    the members may reasonably take to decide to grow. The rendezvous
+    arguments mirror :func:`init_process_group` (``store_handle``, or
+    the master addr/port whose rank 0 serves the store)."""
+    if store_handle is None:
+        master_addr = master_addr or os.environ.get("MASTER_ADDR",
+                                                    "127.0.0.1")
+        master_port = (master_port if master_port is not None
+                       else int(os.environ.get("MASTER_PORT", "29500")))
+        store_handle = f"{master_addr}:{master_port}"
+    pg = ProcessGroup(0, 0, store_handle, None, timeout_s, group_name,
+                      plane, fault_schedule=fault_schedule,
+                      self_heal=self_heal, standby="joiner")
+    try:
+        pg.wait_promotion(timeout_s)
+    except BaseException as e:
+        _FLIGHT.record("group-abort", group=group_name, rank=-1,
+                       error=type(e).__name__)
+        pg.destroy()
+        raise
+    return pg

@@ -139,6 +139,10 @@ class WireCounters:
     frames_copied: int = 0          # frames that took a staging copy
     frames_overlapped: int = 0      # streamed frames that beat the consumer
     frames_fenced: int = 0          # stale-epoch frames dropped at the vtable
+    frames_resumed: int = 0         # p2p frames re-delivered on a resumed
+    #                                 stream continuation across a heal/grow
+    grows: int = 0                  # grow() admissions this rank completed
+    promotions: int = 0             # spare promotions this rank took part in
 
     def __post_init__(self):
         # not a dataclass field: asdict()/snapshot() must stay pure counters
@@ -176,6 +180,27 @@ class WireCounters:
         ``epoch-fenced`` event instead of being delivered)."""
         with self._lock:
             self.frames_fenced += frames
+
+    def resumed(self, frames: int = 1) -> None:
+        """Record p2p frames re-delivered by the stream-resume protocol
+        (the retry-widening half of the elastic group: an interrupted
+        send/recv stream continues from its last fence-acknowledged
+        frame across a heal/grow instead of tearing down)."""
+        with self._lock:
+            self.frames_resumed += frames
+
+    def grew(self, n: int = 1) -> None:
+        """Record completed ``grow()`` admissions (counted on every
+        member of the widened group, joiners included)."""
+        with self._lock:
+            self.grows += n
+
+    def promoted(self, n: int = 1) -> None:
+        """Record spare promotions (counted on every member of the healed
+        group: survivors when their heal admits a spare, the spare when
+        its ``wait_promotion`` completes)."""
+        with self._lock:
+            self.promotions += n
 
     def negotiated(self, frame_bytes: int, pipeline_depth: int) -> None:
         """Record the frame size / pipeline depth the ring wire chose for
@@ -226,6 +251,9 @@ class WireCounters:
             self.frames_copied = 0
             self.frames_overlapped = 0
             self.frames_fenced = 0
+            self.frames_resumed = 0
+            self.grows = 0
+            self.promotions = 0
             self._frame_bytes = 0
             self._pipeline_depth = 0
 

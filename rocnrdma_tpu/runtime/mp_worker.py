@@ -45,11 +45,28 @@ Tasks:
   identical FAULTLOG and HEALLOG lines on every survivor: kills are
   keyed in op space and heal events carry only membership/epoch data,
   so the fault+heal timeline is a pure function of the seed.
+
+  Elastic fleet (ISSUE 6): ``--spares K`` starts the K trailing process
+  ids as WARM SPARES (active world = num-processes - spares - join) —
+  a mid-run kill then promotes a spare instead of shrinking, and the
+  interrupted collective retries exactly-once on the UNCHANGED world
+  size with the spare contributing under the dead rank's original
+  identity. ``--join J`` + ``--grow-round R`` register J joiners that
+  every member admits with ``grow()`` at round R's op boundary (the
+  widened oracle sums their fresh original ids). In-flight neighbour
+  pings between CONTINUOUS survivors RESUME across the heal (printed
+  as ``RESUMED``, asserted > 0); pings whose peer process died fail
+  named. ``GROWLOG`` digests the grow/promotion flight events next to
+  ``HEALLOG`` — both replay-equal per seed. ``--die-at-promotion P``
+  hard-kills spare process P the instant its admit record lands: the
+  survivors' first heal strands at the wired barrier, and the retried
+  heal must BURN the spare (admit records are one-shot) and shrink.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective", "kill-and-heal")
@@ -149,113 +166,182 @@ def _chaos_main(args) -> int:
     return status
 
 
-def _heal_log() -> str:
-    """Stable digest of this rank's heal timeline: the ``heal-*`` flight
-    events with timestamps stripped. Their args carry only membership,
-    epoch, and edge-keep data — deterministic per seed (kills land in op
-    space, membership is a function of who died), so two runs of one
-    seed must digest identically on every survivor."""
+def _event_log(prefixes: tuple) -> str:
+    """Stable digest of this rank's flight events under ``prefixes``,
+    timestamps stripped. The selected kinds carry only membership,
+    epoch, slot, and cursor data — deterministic per seed (kills land in
+    op space, membership is a function of who died, resume cursors are
+    data-flow-determined), so two runs of one seed must digest
+    identically on every survivor."""
     import hashlib
     import json
 
     from rocnrdma_tpu.obs import FLIGHT
     events = [(kind, args) for _, kind, args in FLIGHT.events()
-              if kind.startswith("heal-")]
+              if kind.startswith(prefixes)]
     return hashlib.sha256(
         json.dumps(events, default=str, sort_keys=True).encode()).hexdigest()
 
 
-def _heal_chaos_main(args) -> int:
-    import numpy as np
+def _heal_log() -> str:
+    """The heal timeline digest (see :func:`_event_log`)."""
+    return _event_log(("heal-",))
 
+
+def _grow_log() -> str:
+    """The grow/promotion timeline digest: grow-* events (start, members,
+    done, aborts), promote-* (the standby side of admission), and
+    standby-registered — the elastic-grow half of the replay-equality
+    contract next to HEALLOG."""
+    return _event_log(("grow-", "promote-", "standby-"))
+
+
+def _chaos_rounds(args, pg, start: int, can_grow: bool,
+                  skip_first_ping: bool = False) -> int:
+    """The shared round loop of the kill-and-heal task: an in-flight
+    neighbour ping across every round's allreduce, the int64 bitwise
+    oracle of the then-current membership (keyed by ORIGINAL rank, so
+    promoted spares and grow joiners contribute under their adopted
+    identities), and — with ``--grow-round`` — a ``grow()`` issued by
+    every member at that round's committed-op boundary."""
+    import numpy as np
+    for rnd in range(start, args.rounds):
+        if can_grow and args.grow_round is not None \
+                and rnd == args.grow_round:
+            # every member (promoted spares included) grows at the same
+            # op boundary; the registered joiners are admitted here
+            pg.grow(grace_s=3.0, timeout_s=30.0)
+        my_orig = pg.global_ranks[pg.rank]
+        # a neighbour ping IN FLIGHT across every round's collective:
+        # posted before the allreduce, drained after it. The p2p
+        # plane is pumped only by p2p verbs, so at a kill-round abort
+        # the predecessor's ping provably sits undelivered — the
+        # frames the heal's epoch bump must fence (what the
+        # `FENCED > 0` acceptance asserts) and the resume protocol
+        # must then re-deliver between CONTINUOUS survivors (RESUMED)
+        ping = None
+        if pg.world_size > 1 and not (skip_first_ping and rnd == start):
+            # a promoted spare resumes INTO an interrupted round: its
+            # peers are already blocked in the retried collective and
+            # cannot serve p2p wiring until it completes, so the spare
+            # must not dial a fresh ping stream ahead of the retry (its
+            # peers' kill-round pings toward the dead incarnation fail
+            # named either way)
+            succ = (pg.rank + 1) % pg.world_size
+            pred = (pg.rank - 1) % pg.world_size
+            pred_gid = pg.global_ranks[pred]
+            ping = pg.batch_isend_irecv([
+                ("recv", np.empty(64, np.int64), pred, rnd % 60),
+                ("send", _chaos_input(args.seed, my_orig, rnd, 64),
+                 succ, rnd % 60),
+            ], timeout_s=5.0)
+        local = _chaos_input(args.seed, my_orig, rnd, args.size)
+        got = pg.all_reduce(local, timeout_s=5.0)
+        # the oracle of the CURRENT membership: contributions are
+        # keyed by ORIGINAL rank (pg.global_ranks survives re-
+        # ranking), so a post-heal round sums exactly the members —
+        # a promotion keeps the full width, a shrink drops the dead
+        members = pg.global_ranks
+        want = _chaos_input(args.seed, members[0], rnd, args.size)
+        for m in members[1:]:
+            want = want + _chaos_input(args.seed, m, rnd, args.size)
+        if not np.array_equal(got, want):
+            print(f"BAD-RESULT: round {rnd} not bitwise-correct on "
+                  f"epoch {pg.last_op_epoch} members {members}",
+                  flush=True)
+            return 5
+        if ping is not None:
+            try:
+                heard = ping[0].wait()
+                ping[1].wait()
+            except (TimeoutError, OSError, RuntimeError):
+                # the collective healed mid-round and this ping's peer
+                # PROCESS did not continue (dead, or its slot was
+                # re-incarnated by a promotion): the stream's data died
+                # with it — named, and the stream restarts next round.
+                # Streams between continuous survivors RESUME instead
+                # (the else branch still asserts their payloads).
+                pass
+            else:
+                if not np.array_equal(
+                        heard, _chaos_input(args.seed, pred_gid,
+                                            rnd, 64)):
+                    print(f"BAD-RESULT: round {rnd} ping from "
+                          f"original rank {pred_gid} corrupted",
+                          flush=True)
+                    return 5
+    return 0
+
+
+def _heal_chaos_main(args) -> int:
     from rocnrdma_tpu import distributed as dist
     from rocnrdma_tpu.metrics import WIRE
     from rocnrdma_tpu.transport import bootstrap
     from rocnrdma_tpu.transport.faults import FaultSchedule
 
-    rank, n = args.process_id, args.num_processes
+    rank, total = args.process_id, args.num_processes
+    # fleet layout: members first, then warm spares, then grow joiners
+    n = total - args.spares - args.join
+    role = ("member" if rank < n
+            else "spare" if rank < n + args.spares else "joiner")
     kill = dict(zip(
         (int(r) for r in (args.kill_ranks or "").split(",") if r),
         (int(o) for o in (args.kill_ops or "").split(",") if o)))
     server = None
     if rank == 0:
         host, port = args.coordinator.rsplit(":", 1)
-        server = bootstrap.BootstrapServer(n_ranks=n, port=int(port),
+        server = bootstrap.BootstrapServer(n_ranks=total, port=int(port),
                                            host=host)
     # the heal chaos profile: refused + flaky connects (the heal-time
     # re-dials must retry them under the shared backoff), delayed
     # completions (stale frames pile up unreported at the abort, so the
-    # epoch fence provably fires), and the op-keyed hard kill on the
-    # victims. Every class replays deterministically: decisions key off
-    # the rank's own op/attempt sequence, and the abort points are data-
+    # epoch fence provably fires), the op-keyed hard kill on the
+    # victims, plus the admission-plane faults (refused registrations
+    # retried under backoff; a spare death landed AT its promotion).
+    # Every class replays deterministically: decisions key off the
+    # rank's own op/attempt sequence, and the abort points are data-
     # flow-determined (the victim's last op bounds what could ever be
     # delivered), not wall-clock-determined.
     sched = FaultSchedule(
         args.seed, rank,
         connect_refusals=1, connect_flake_p=0.2,
         test_delay_p=0.3, test_delay_polls=(1, 4),
-        kill_after_ops=kill.get(rank))
+        kill_after_ops=kill.get(rank),
+        join_refusals=1 if role != "member" else 0,
+        die_at_promotion=(rank == args.die_at_promotion))
     status = 0
     pg = None
+    group = f"heal{args.seed}"
     try:
-        pg = dist.init_process_group(
-            rank=rank, world_size=n, store_handle=args.coordinator,
-            timeout_s=20.0, group_name=f"heal{args.seed}", plane="shm",
-            fault_schedule=sched, self_heal=True)
-        pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
-        for rnd in range(args.rounds):
-            # a neighbour ping IN FLIGHT across every round's collective:
-            # posted before the allreduce, drained after it. The p2p
-            # plane is pumped only by p2p verbs, so at a kill-round abort
-            # the predecessor's ping provably sits undelivered — the
-            # frames the heal's epoch bump must fence (what the
-            # `FENCED > 0` acceptance asserts), with deterministic count
-            ping = None
-            if pg.world_size > 1:
-                succ = (pg.rank + 1) % pg.world_size
-                pred = (pg.rank - 1) % pg.world_size
-                pred_gid = pg.global_ranks[pred]
-                ping = pg.batch_isend_irecv([
-                    ("recv", np.empty(64, np.int64), pred, rnd % 60),
-                    ("send", _chaos_input(args.seed, rank, rnd, 64),
-                     succ, rnd % 60),
-                ], timeout_s=5.0)
-            local = _chaos_input(args.seed, rank, rnd, args.size)
-            got = pg.all_reduce(local, timeout_s=5.0)
-            # the oracle of the CURRENT membership: contributions are
-            # keyed by ORIGINAL rank (pg.global_ranks survives re-
-            # ranking), so a post-heal round sums exactly the survivors
-            members = pg.global_ranks
-            want = _chaos_input(args.seed, members[0], rnd, args.size)
-            for m in members[1:]:
-                want = want + _chaos_input(args.seed, m, rnd, args.size)
-            if not np.array_equal(got, want):
-                print(f"BAD-RESULT: round {rnd} not bitwise-correct on "
-                      f"epoch {pg.last_op_epoch} members {members}",
-                      flush=True)
-                status = 5
-                break
-            if ping is not None:
-                try:
-                    heard = ping[0].wait()
-                    ping[1].wait()
-                except (TimeoutError, OSError, RuntimeError):
-                    # the collective healed mid-round: the ping's wiring
-                    # died with the old epoch (its stale frames were
-                    # fenced, which is the point) — the stream restarts
-                    # fresh next round
-                    pass
-                else:
-                    if not np.array_equal(
-                            heard, _chaos_input(args.seed, pred_gid,
-                                                rnd, 64)):
-                        print(f"BAD-RESULT: round {rnd} ping from "
-                              f"original rank {pred_gid} corrupted",
-                              flush=True)
-                        status = 5
-                        break
+        if role == "member":
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=args.coordinator,
+                timeout_s=20.0, group_name=group, plane="shm",
+                fault_schedule=sched, self_heal=True)
+            pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
+            start = 0
+        elif role == "spare":
+            pg = dist.init_process_group(
+                world_size=n, store_handle=args.coordinator,
+                timeout_s=20.0, group_name=group, plane="shm",
+                fault_schedule=sched, self_heal=True, spare=True)
+            pg.wait_promotion(timeout_s=120.0)
+            # resume the round loop AT the interrupted collective: the
+            # adopted committed-op count IS the round index (one
+            # allreduce per round), so this process participates in the
+            # survivors' transparent retry under the dead rank's identity
+            start = pg.committed_ops
+        else:  # joiner
+            pg = dist.join_process_group(
+                store_handle=args.coordinator, group_name=group,
+                plane="shm", timeout_s=150.0, fault_schedule=sched,
+                self_heal=True)
+            start = pg.committed_ops
+        status = _chaos_rounds(args, pg, start,
+                               can_grow=role in ("member", "spare"),
+                               skip_first_ping=(role == "spare"))
         if status == 0:
-            print(f"OK rank={rank}/{n} rounds={args.rounds} "
+            print(f"OK rank={rank}/{total} rounds={args.rounds} "
                   f"now-rank={pg.rank}/{pg.world_size}", flush=True)
             print(f"EPOCH {pg.epoch}", flush=True)
             print(f"MEMBERS {pg.global_ranks}", flush=True)
@@ -268,10 +354,19 @@ def _heal_chaos_main(args) -> int:
         print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
         status = 4
     finally:
-        print(f"FENCED {WIRE.snapshot()['frames_fenced']}", flush=True)
+        snap = WIRE.snapshot()
+        print(f"FENCED {snap['frames_fenced']}", flush=True)
+        print(f"RESUMED {snap['frames_resumed']}", flush=True)
         print(f"FAULTS {sched.counters.to_json()}", flush=True)
         print(f"FAULTLOG {sched.fingerprint()}", flush=True)
         print(f"HEALLOG {_heal_log()}", flush=True)
+        print(f"GROWLOG {_grow_log()}", flush=True)
+        if os.environ.get("ROCNRDMA_CHAOS_DUMP"):
+            # replay-divergence triage: the RAW injection log behind
+            # FAULTLOG, one line so the harness can diff two runs
+            import json as _json
+            print(f"FAULTDUMP {_json.dumps(sched.log, default=str)}",
+                  flush=True)
         from rocnrdma_tpu.obs import chrome
         chrome.dump_if_env(rank)
         if pg is not None:
@@ -304,6 +399,21 @@ def main(argv=None) -> int:
     p.add_argument("--kill-ops", default=None,
                    help="kill-and-heal: per-victim op counts at which "
                         "the hard kill lands (paired with --kill-ranks)")
+    p.add_argument("--spares", type=int, default=0,
+                   help="kill-and-heal: trailing process ids that start "
+                        "as WARM SPARES (world = num-processes - spares "
+                        "- join); a heal promotes them instead of "
+                        "shrinking")
+    p.add_argument("--join", type=int, default=0,
+                   help="kill-and-heal: trailing process ids (after the "
+                        "spares) that register as grow() JOINERS")
+    p.add_argument("--grow-round", type=int, default=None,
+                   help="kill-and-heal: round at which every member "
+                        "issues grow(), admitting the registered joiners")
+    p.add_argument("--die-at-promotion", type=int, default=None,
+                   help="kill-and-heal: process id of a spare that "
+                        "hard-dies the moment its admit record lands "
+                        "(the mid-promotion death case)")
     args = p.parse_args(argv)
 
     if args.task == "kill-and-heal":

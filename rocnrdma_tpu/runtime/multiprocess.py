@@ -39,7 +39,11 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                 rounds: int | None = None,
                 size: int | None = None,
                 kill_ranks: str | None = None,
-                kill_ops: str | None = None) -> list[WorkerResult]:
+                kill_ops: str | None = None,
+                spares: int | None = None,
+                join: int | None = None,
+                grow_round: int | None = None,
+                die_at_promotion: int | None = None) -> list[WorkerResult]:
     """Spawn ``n`` worker processes running ``task``; wait for all.
 
     A worker that outlives ``timeout_s`` is killed and reported with
@@ -50,7 +54,10 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
     ``seed``/``rounds``/``size`` parameterize the chaos tasks (see
     ``mp_worker``); ``fault_rank`` picks the victim for ``fault`` and
     ``die-mid-collective``; ``kill_ranks``/``kill_ops`` (comma lists)
-    place the ``kill-and-heal`` task's deterministic op-space kills."""
+    place the ``kill-and-heal`` task's deterministic op-space kills;
+    ``spares``/``join``/``grow_round``/``die_at_promotion`` shape its
+    elastic fleet (trailing process ids become warm spares, then grow
+    joiners admitted at ``grow_round``)."""
     coordinator = f"127.0.0.1:{free_port()}"
     procs = []
     env = dict(os.environ)
@@ -60,7 +67,9 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
              else [])
     for flag, val in (("--seed", seed), ("--rounds", rounds),
                       ("--size", size), ("--kill-ranks", kill_ranks),
-                      ("--kill-ops", kill_ops)):
+                      ("--kill-ops", kill_ops), ("--spares", spares),
+                      ("--join", join), ("--grow-round", grow_round),
+                      ("--die-at-promotion", die_at_promotion)):
         if val is not None:
             extra += [flag, str(val)]
     for i in range(n):

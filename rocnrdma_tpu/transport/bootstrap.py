@@ -45,6 +45,16 @@ from rocnrdma_tpu.transport.backoff import (
     retry_with_backoff,
 )
 
+# Store-identity bases for ranks that are NOT (yet) members of the group:
+# warm spares and grow() joiners heartbeat the liveness table under
+# prefixed ids so ``dead_ranks(world_size)`` — which scans only
+# ``range(world_size)`` — can never confuse a waiting spare with a member,
+# and a member's death can never be masked by a spare's heartbeat. The
+# bases are far above any plausible world size; prune's ``spares`` op
+# clears the prefixed footprint when an id is promoted (or burned).
+SPARE_RANK_BASE = 1 << 20
+JOINER_RANK_BASE = 1 << 21
+
 
 class BootstrapServer:
     """Rank-0-side store. One daemon thread per client connection (rendezvous
@@ -155,9 +165,31 @@ class BootstrapServer:
                 # duplicate-arrival guard. Idempotent per rank set, like
                 # every other op — safe to replay over a reconnect.
                 ranks = {int(r) for r in req.get("ranks", ())}
+                prefix = req.get("prefix")
+                # spare-prefixed footprint (the elastic-grow fix): a
+                # promoted — or burned — spare/joiner leaves liveness
+                # stamps under its PREFIXED id plus a stale listener
+                # handle; left behind, the stale heartbeat reads as
+                # alive and the handle points at a gone endpoint. The
+                # ``slot`` and ``admit`` keys are deliberately KEPT:
+                # the registry scan walks slot ids densely from 0
+                # (``_scan_standby_registry``), so popping a slot would
+                # hide every live standby at a higher sid, and the
+                # admit record is the slot's permanent burn mark — slot
+                # ids are consumed monotonically, never reused.
+                # ``spares``/``joiners`` name the slot ids to clear;
+                # both liveness and barrier arrivals are swept through
+                # the same rank set below.
+                for base, key_name, sub in (
+                        (SPARE_RANK_BASE, "spares", "spares"),
+                        (JOINER_RANK_BASE, "joiners", "join")):
+                    for sid in req.get(key_name, ()):
+                        ranks.add(base + int(sid))
+                        if prefix:
+                            self._kv.pop(
+                                f"{prefix}{sub}/h/{int(sid)}", None)
                 for r in ranks:
                     self._last_seen.pop((scope, r), None)
-                prefix = req.get("prefix")
                 if prefix:
                     for key, arrived in self._barriers.items():
                         if key.startswith(prefix):
@@ -319,14 +351,22 @@ class BootstrapClient:
                 raise TimeoutError(f"bootstrap barrier {key!r} timed out")
             back.pause()
 
-    def prune(self, ranks, prefix: str | None = None) -> None:
+    def prune(self, ranks, prefix: str | None = None,
+              spares=(), joiners=()) -> None:
         """Remove ``ranks``' liveness-table entries for this client's
         scope (and, with ``prefix``, their arrivals from every barrier
         key under it) — the epoch-bump cleanup ``ProcessGroup.heal``'s
         leader runs so re-ranked survivors can re-register the freed
-        rank ids cleanly."""
+        rank ids cleanly. ``spares``/``joiners``: slot ids whose
+        SPARE/JOINER-prefixed liveness stamps, barrier arrivals, and
+        stale listener handles (``{prefix}spares|join/h/{sid}``) are
+        cleared too — a promoted-then-dead spare's orphaned ids must
+        not read as a live candidate. The ``slot``/``admit`` keys
+        stay: slots are consumed monotonically (the dense registry
+        scan depends on it) and the admit record is the burn mark."""
         self._rpc(op="prune", ranks=sorted(int(r) for r in ranks),
-                  prefix=prefix)
+                  prefix=prefix, spares=sorted(int(s) for s in spares),
+                  joiners=sorted(int(j) for j in joiners))
 
     def heartbeat(self) -> None:
         """Stamp this rank's liveness without any other side effect (every

@@ -74,6 +74,15 @@ class FaultSchedule:
     connect_refusals: int = 0       # first k connect() attempts refused
     accept_refusals: int = 0        # first k accept() attempts refused
     connect_flake_p: float = 0.0    # later connects refused with prob p
+    # admission-plane faults (the elastic-grow surface): the store-side
+    # join/spare registration, not a vtable verb — consulted directly by
+    # ProcessGroup's join/spare entry points, which retry refusals under
+    # the same shared backoff as refused connects
+    join_refusals: int = 0          # first k admission attempts refused
+    die_at_promotion: bool = False  # spare os._exit(7)s the moment its
+    #   admit record lands — the spare-death-mid-promotion chaos case:
+    #   survivors' first heal times out at the wired barrier and the
+    #   retried heal must burn the spare and shrink instead
     # completion-plane faults
     test_delay_p: float = 0.0       # prob an irecv completion is held
     test_delay_polls: tuple = (1, 8)  # held for uniform[a, b] extra polls
@@ -93,6 +102,9 @@ class FaultSchedule:
         self.ops = 0          # data ops (isend/irecv) seen so far
         self._connect_attempts = 0
         self._accept_attempts = 0
+        self._join_attempts = 0
+        self._test_draws = 0
+        self._close_draws = 0
         self._rngs: dict[str, random.Random] = {}
 
     def _rng(self, stream: str) -> random.Random:
@@ -102,43 +114,87 @@ class FaultSchedule:
                 f"{self.seed}:{self.rank}:{stream}")
         return self._rngs[stream]
 
-    def record(self, kind: str, detail=None) -> None:
+    def record(self, kind: str, detail=None, coord=None) -> None:
+        """Append an injection to the log at ``coord`` — the deciding
+        stream's OWN coordinate (attempt/draw counter; defaults to the
+        global data-op index, right for op-placed faults like the
+        kills). Coordinates are stream-local by design: once an
+        opportunistic engine runs verbs at wall-clock-determined points
+        (the PR-6 p2p resume service fires tail sends whenever the
+        peer's cursor lands), the global op index of an independent
+        stream's injection is no longer replay-stable — each stream's
+        own sequence still is."""
+        coord = self.ops if coord is None else coord
         self.counters.count(kind)
-        self.log.append((self.ops, kind, detail))
+        self.log.append((coord, kind, detail))
         # every injection also lands on the flight-recorder timeline, so
         # a chaos trace shows the fault NEXT TO its absorption (the retry/
         # stall events the layers above record). The event args come from
-        # the schedule's own deterministic state (op counter + detail),
-        # never from timing — two replays of one seed record the same
-        # fault event sequence (what the replay-equality test asserts).
-        _FLIGHT.record("fault-" + kind, op=self.ops, rank=self.rank,
+        # the schedule's own deterministic state (stream coordinate +
+        # detail), never from timing — two replays of one seed record the
+        # same fault event sequence (what the replay-equality test
+        # asserts).
+        _FLIGHT.record("fault-" + kind, op=coord, rank=self.rank,
                        detail=detail)
 
     def fingerprint(self) -> str:
         """Stable digest of the injection log — two runs of one seed over
         one call sequence must produce equal fingerprints (the replay
-        assertion the soak test makes)."""
+        assertion the soak test makes). Digested in CANONICAL order: the
+        multiset of (coord, kind, detail) entries is a pure function of
+        the seed, but the list's interleaving across independent streams
+        is not (see :meth:`record` on the resume service), so the log is
+        sorted before hashing."""
         return hashlib.sha256(
-            json.dumps(self.log, default=str).encode()).hexdigest()
+            json.dumps(sorted(self.log, key=repr),
+                       default=str).encode()).hexdigest()
 
     # -- per-verb decisions (each advances only its own stream) ------------
 
     def connect_fault(self) -> str | None:
         self._connect_attempts += 1
         if self._connect_attempts <= self.connect_refusals:
-            self.record("connect-refused", self._connect_attempts)
+            self.record("connect-refused", self._connect_attempts,
+                        coord=self._connect_attempts)
             return f"injected refusal {self._connect_attempts}/" \
                    f"{self.connect_refusals}"
         if (self.connect_flake_p
                 and self._rng("connect").random() < self.connect_flake_p):
-            self.record("connect-flaked", self._connect_attempts)
+            self.record("connect-flaked", self._connect_attempts,
+                        coord=self._connect_attempts)
             return "injected transient connect flake"
         return None
+
+    def join_fault(self) -> str | None:
+        """One admission attempt (a joiner's/spare's store registration):
+        the first ``join_refusals`` attempts are refused — the caller
+        retries under the shared backoff, like refused connects.
+        Deterministic: keyed on this rank's own attempt counter."""
+        self._join_attempts += 1
+        if self._join_attempts <= self.join_refusals:
+            self.record("join-refused", self._join_attempts,
+                        coord=self._join_attempts)
+            return f"injected admission refusal {self._join_attempts}/" \
+                   f"{self.join_refusals}"
+        return None
+
+    def promotion_fault(self) -> None:
+        """Called by a spare the moment it reads its admit record: with
+        ``die_at_promotion`` the spare hard-dies HERE — after the heal
+        leader assigned it a slot, before it wires — the worst-placed
+        spare death (survivors are already waiting at the wired
+        barrier)."""
+        if self.die_at_promotion:
+            import os
+            self.record("killed-at-promotion")
+            print("FAULT: spare killed at promotion", flush=True)
+            os._exit(7)
 
     def accept_fault(self) -> str | None:
         self._accept_attempts += 1
         if self._accept_attempts <= self.accept_refusals:
-            self.record("accept-refused", self._accept_attempts)
+            self.record("accept-refused", self._accept_attempts,
+                        coord=self._accept_attempts)
             return f"injected refusal {self._accept_attempts}/" \
                    f"{self.accept_refusals}"
         return None
@@ -168,17 +224,19 @@ class FaultSchedule:
         """Extra not-done ``test()`` polls to inject on this irecv
         (0 = report truthfully)."""
         rng = self._rng("test")
+        self._test_draws += 1
         if self.test_delay_p and rng.random() < self.test_delay_p:
             lo, hi = self.test_delay_polls
             d = rng.randint(lo, hi)
-            self.record("test-delayed", d)
+            self.record("test-delayed", d, coord=self._test_draws)
             return d
         return 0
 
     def close_drop(self) -> bool:
+        self._close_draws += 1
         if (self.close_drop_p
                 and self._rng("close").random() < self.close_drop_p):
-            self.record("close-dropped")
+            self.record("close-dropped", coord=self._close_draws)
             return True
         return False
 

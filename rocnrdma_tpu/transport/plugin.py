@@ -352,6 +352,10 @@ class HostQPNet:
     _LG_RKEY_TAG = 0xFFFFFF01   # arena announce (rkey, size)
     _LG_ACK_TAG = 0xFFFFFF02    # consumed-bytes credit return
     _LG_REQ_TAG = 0xFFFFFF03    # "announce your arena" (peer mid-isend)
+    # 0xFFFFFF04 is reserved by the p2p stream-resume protocol
+    # (distributed._P2P_RESUME_TAG): same collision exposure class as the
+    # LG tags (hop 0xFFFF with a > 0xFF00 frame index), carried by the
+    # ordinary isend/irecv verbs — no pump special-casing here
     # ring-collective hop chunk on LG-capable planes (_RingWire reads
     # this): 4 MiB >= LG_MIN, so every ring hop is ONE put + descriptor
     # instead of 8 frame posts; FOUR windows fit the 16 MiB arena, enough
@@ -1160,30 +1164,45 @@ class _RingWire:
         return max(it, self.frame - self.frame % it)
 
     def queue_send(self, out: np.ndarray, hop: int, progress=None,
-                   frame: int | None = None) -> None:
+                   frame: int | None = None, first_frame: int = 0) -> None:
         """Queue ``out`` (uint8) as chunked frames on the send comm (may
         pump under backpressure; does NOT flush — callers flush or drain).
-        ``frame`` overrides the chunking (streaming mode)."""
+        ``frame`` overrides the chunking (streaming mode). ``first_frame``
+        is the stream-resume cursor: frames below it were already
+        fence-acknowledged by the receiver in an earlier epoch, so a
+        resumed p2p send re-queues only the tail — frame INDICES (and so
+        wire tags) are preserved, which is what lets the receiver's
+        re-posted tail receives match."""
         tag = self._tag(hop, len(out), frame)
         frame = self.frame if frame is None else frame
         for fi, off in enumerate(range(0, len(out), frame)):
+            if fi < first_frame:
+                continue
             seg = np.ascontiguousarray(out[off:off + frame])
             self.net.isend(self.send_comm,
                            self.net.reg_mr(self.send_comm, seg),
                            tag=tag(fi), timeout_s=self.timeout_s,
                            progress=progress)
 
-    def post_recvs(self, nbytes: int, hop: int, into=None) -> list:
+    def post_recvs(self, nbytes: int, hop: int, into=None,
+                   first_frame: int = 0) -> list:
         """Post the chunked frame receives for an ``nbytes`` inbound
         message; returns ``[(offset, nbytes, Request), ...]`` to drain.
         ``into``: optional uint8 destination ndarray — on nets with the
         ``recv_into`` capability every frame lands there directly and the
-        drained Request carries payload None (zero staging copies)."""
+        drained Request carries payload None (zero staging copies).
+        ``first_frame``: the stream-resume cursor — frames below it
+        already landed in ``into`` before the stream's epoch was fenced,
+        so a resumed receive posts only the missing tail (same frame
+        indices, hence same wire tags as the sender's resumed
+        ``queue_send``)."""
         tag = self._tag(hop, nbytes)
         frame = self.frame
         recv_into = self._recv_into if into is not None else None
         reqs = []
         for fi, off in enumerate(range(0, nbytes, frame)):
+            if fi < first_frame:
+                continue
             nb = min(frame, nbytes - off)
             if recv_into is not None:
                 req = recv_into(self.recv_comm, into[off:off + nb],

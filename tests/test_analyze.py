@@ -457,6 +457,114 @@ def test_obs_abort_rule_covers_repo_targets():
 
 
 # ---------------------------------------------------------------------------
+# pass #4c: elastic-surface coverage (grow/heal/wait_promotion must
+# GUARANTEE an abort flight event — the conditional abort rule alone lets
+# a handler-free membership verb abort silently)
+# ---------------------------------------------------------------------------
+
+
+def test_obs_flags_uninstrumented_elastic_verb():
+    # heal records on abort, grow has NO handler at all: the abort rule
+    # (#4b) sees nothing to flag in grow — the elastic rule must
+    src = textwrap.dedent("""
+        class ProcessGroup:
+            def heal(self, timeout_s=None):
+                try:
+                    return self._heal_protocol()
+                except BaseException as e:
+                    _FLIGHT.record("heal-abort", error=type(e).__name__)
+                    raise
+
+            def grow(self, timeout_s=None):
+                return self._grow_protocol()
+
+            def wait_promotion(self, timeout_s=600.0):
+                try:
+                    return self._admit()
+                except BaseException as e:
+                    _FLIGHT.record("promote-abort", error=type(e).__name__)
+                    raise
+    """)
+    problems = obs.check_elastic_source(src, "fix.py")
+    assert len(problems) == 1, problems
+    assert "ProcessGroup.grow guarantees no abort flight event" \
+        in problems[0], problems
+
+
+def test_obs_elastic_rule_rejects_record_free_handler():
+    # a handler that re-raises WITHOUT recording does not count as
+    # instrumentation (it is also flagged by #4b on the repo surface)
+    src = textwrap.dedent("""
+        class ProcessGroup:
+            def heal(self, timeout_s=None):
+                try:
+                    return self._heal_protocol()
+                except BaseException:
+                    self._rearm()
+                    raise
+
+            def grow(self, timeout_s=None):
+                try:
+                    return self._grow_protocol()
+                except BaseException as e:
+                    _FLIGHT.record("grow-abort", error=type(e).__name__)
+                    raise
+
+            def wait_promotion(self, timeout_s=600.0):
+                try:
+                    return self._admit()
+                except BaseException as e:
+                    _FLIGHT.record("promote-abort", error=type(e).__name__)
+                    raise
+    """)
+    problems = obs.check_elastic_source(src, "fix.py")
+    assert len(problems) == 1, problems
+    assert "ProcessGroup.heal" in problems[0], problems
+
+
+def test_obs_elastic_rule_flags_stale_surface_list():
+    # a renamed/removed verb must surface as a finding, not silently
+    # shrink the checked surface
+    src = textwrap.dedent("""
+        class ProcessGroup:
+            def heal(self, timeout_s=None):
+                try:
+                    return self._heal_protocol()
+                except BaseException as e:
+                    _FLIGHT.record("heal-abort", error=type(e).__name__)
+                    raise
+    """)
+    problems = obs.check_elastic_source(src, "fix.py")
+    assert any("ProcessGroup.grow not found" in p for p in problems), \
+        problems
+    assert any("ProcessGroup.wait_promotion not found" in p
+               for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
+# pass #0 extension (PR 6): the elastic PG surface is on the named
+# blocking list — grow/wait_promotion must accept timeout_s
+# ---------------------------------------------------------------------------
+
+
+def test_deadlines_flags_elastic_verb_without_timeout(tmp_path):
+    assert {"grow", "wait_promotion"} <= deadlines.PG_BLOCKING
+    bad = tmp_path / "distributed.py"
+    bad.write_text(textwrap.dedent("""
+        class ProcessGroup:
+            def grow(self, grace_s=5.0):
+                return self._grow_protocol()
+
+            def wait_promotion(self, timeout_s=600.0):
+                return self._admit()
+    """))
+    problems = deadlines.check_file(str(bad))
+    assert any("grow must accept timeout_s" in p for p in problems), \
+        problems
+    assert not any("wait_promotion" in p for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
 # pass #3: resource leaks
 # ---------------------------------------------------------------------------
 

@@ -88,24 +88,32 @@ def test_alltoallv_counts_deterministic_skewed_balanced():
 
 
 def test_smoke_perf_gate(tmp_path, capsys):
-    """The tier-1 zero-copy perf gate, now per plane (ROADMAP "smoke-gate
-    floors per plane"): 2 ranks, 1 MiB allreduce on shm AND tcp must each
-    stage ZERO payload bytes through copies on the steady path (every
-    worker rank enforces its own counters) and hold >= 0.8x that plane's
+    """The tier-1 zero-copy perf gate, now per data path (ROADMAP
+    "smoke-gate floors per plane", closed in PR 6): 2 ranks, 1 MiB
+    allreduce on shm, tcp, AND the put-based rdma ring must each stage
+    ZERO payload bytes through copies on the steady path (every worker
+    rank enforces its own counters) and hold >= 0.8x that path's
     recorded GB/s floor. A regression back to the copy-bound wire — on
-    either plane — fails here before it can ship."""
+    any path — fails here before it can ship."""
     out = tmp_path / "smoke.jsonl"
     rc = bench_host.main(["--smoke", "--out", str(out)])
     assert rc == 0
     printed = capsys.readouterr().out
     assert "smoke gate ok [shm]" in printed
     assert "smoke gate ok [tcp]" in printed
+    assert "smoke gate ok [rdma]" in printed
     rows = [json.loads(l) for l in out.read_text().splitlines()]
-    assert [r["platform"] for r in rows] == ["host-shm", "host-tcp"]
+    assert [r["platform"] for r in rows] == ["host-shm", "host-tcp",
+                                             "host-shm"]
+    assert [r["algo"] for r in rows] == ["ring", "ring", "ring_rdma"]
     for row in rows:
         wire = row["extra"]["wire"]
-        assert wire["payload_bytes_copied"] == 0, row["platform"]
-        assert wire["frames_streamed"] > 0
+        assert wire["payload_bytes_copied"] == 0, row["algo"]
+        # the one-sided put ring moves whole hops by RDMA write — no
+        # streamed frames by design; the message-passing paths must
+        # stream
+        if row["algo"] == "ring":
+            assert wire["frames_streamed"] > 0
         # overlap is timing-dependent (a loaded CI box can legitimately
         # see a peer that never runs ahead), so it is RECORDED, not gated
         # — only the deterministic zero-copy contract above fails the

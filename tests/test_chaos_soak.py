@@ -158,6 +158,92 @@ def test_kill_straddling_commit_boundary_aborts_named_not_mixed():
         assert "diverged" in r.stdout, r.stdout
 
 
+def test_kill_promote_then_grow_replay_equal():
+    """The elastic-grow acceptance run (ISSUE 6): rank 1 of 3 is
+    hard-killed mid-allreduce on a group with ONE warm spare, then a
+    ``grow()`` at a later round admits a registered joiner.
+
+    Asserted: the kill round completes exactly-once on an UNCHANGED
+    world size (the spare is promoted into original rank 1's identity —
+    epoch 1, members [0, 1, 2]); the grow widens to [0, 1, 2, 3] with a
+    bitwise-correct allreduce including the joiner's fresh original id
+    (epoch 2); the epoch fence dropped stranded ping frames
+    (FENCED > 0 on the continuous survivors) and the survivor<->survivor
+    ping stream RESUMED across the heal rather than tearing down
+    (RESUMED > 0 somewhere); no survivor exits nonzero, nothing hangs
+    to a -9; and TWO runs of the seed replay byte-identical fault, heal,
+    AND grow timelines on every continuing rank."""
+    n_members, seed, rounds = 3, 11, 6
+    total = n_members + 2  # + 1 spare (id 3) + 1 joiner (id 4)
+    victim = 1
+    runs = [run_workers(total, "kill-and-heal", timeout_s=150.0, seed=seed,
+                        rounds=rounds, kill_ranks=str(victim),
+                        kill_ops="25", spares=1, join=1, grow_round=4)
+            for _ in range(2)]
+    for results in runs:
+        rc = {r.process_id: r.returncode for r in results}
+        assert rc[victim] == 7, results[victim].stdout
+        resumed_total = 0
+        for r in results:
+            assert r.returncode != -9, \
+                f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+            if r.process_id == victim:
+                continue
+            assert r.returncode == 0, \
+                f"rank {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            # epoch 1 = the promotion heal, epoch 2 = the grow; the
+            # final membership carries every ORIGINAL id — the spare
+            # under the victim's identity, the joiner under the fresh
+            # high-water id
+            assert _line(r, "EPOCH") == "2"
+            assert _line(r, "MEMBERS") == "[0, 1, 2, 3]"
+            resumed_total += int(_line(r, "RESUMED"))
+            if r.process_id in (0, 2):
+                # the continuous survivors provably fenced the kill
+                # round's stranded ping frames
+                assert int(_line(r, "FENCED")) > 0
+        assert resumed_total > 0, \
+            "no survivor<->survivor ping stream resumed across the heal"
+    for a, b in zip(*runs):
+        if a.process_id == victim:
+            continue
+        assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
+        assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
+        assert _line(a, "GROWLOG") == _line(b, "GROWLOG"), a.process_id
+        assert _line(a, "FENCED") == _line(b, "FENCED"), a.process_id
+        assert _line(a, "RESUMED") == _line(b, "RESUMED"), a.process_id
+
+
+def test_spare_death_mid_promotion_burns_spare_and_shrinks():
+    """The worst-placed spare death: the victim dies mid-collective, the
+    heal assigns the spare, and the spare hard-dies the INSTANT its
+    admit record lands — survivors are already waiting at the wired
+    barrier. The first heal strands (bounded, named); the retried heal
+    must BURN the spare (admit records are one-shot, a pure function of
+    store state — no wall-clock race) and shrink around the dead slot:
+    survivors finish every round bitwise-correct on [0, 1] at epoch 2,
+    exit 0, never -9."""
+    results = run_workers(4, "kill-and-heal", timeout_s=200.0, seed=13,
+                          rounds=6, kill_ranks="2", kill_ops="25",
+                          spares=1, die_at_promotion=3)
+    rc = {r.process_id: r.returncode for r in results}
+    assert rc[2] == 7, results[2].stdout
+    assert rc[3] == 7, results[3].stdout
+    assert "FAULT: spare killed at promotion" in results[3].stdout
+    for r in results:
+        assert r.returncode != -9, \
+            f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+        if r.process_id in (2, 3):
+            continue
+        assert r.returncode == 0, \
+            f"survivor {r.process_id} exited {r.returncode}:\n" \
+            f"{r.stdout}\n{r.stderr}"
+        assert _line(r, "EPOCH") == "2"      # failed promotion + shrink
+        assert _line(r, "MEMBERS") == "[0, 1]"
+        assert int(_line(r, "FENCED")) > 0
+
+
 @pytest.mark.slow
 def test_heal_soak_two_sequential_kills():
     """The heal phase of the chaos soak: TWO rank kills mid-soak

@@ -1252,13 +1252,20 @@ def test_self_heal_refuses_retry_when_root_died(sidecar_store):
     assert res[1] == "dead"
 
 
-def test_self_heal_refuses_world_shaped_retry(sidecar_store):
+def test_self_heal_reshards_world_shaped_retry(sidecar_store):
     """Verbs whose inputs are shaped by the CURRENT world size (alltoall
-    rows here) must refuse transparent retry with a named error BEFORE
-    mutating the group — never feed old-world shapes into a shrunk ring
-    and surface a bare shape assertion."""
+    rows here) heal and retry ONCE with their inputs re-sharded through
+    the membership delta: rows addressed to the dead rank are dropped,
+    surviving rows reindex to the shrunk numbering, and the caller gets
+    the result the surviving membership would have produced — never a
+    bare shape assertion from feeding old-world shapes to a shrunk
+    ring (PR 5 named-refused this; the reshard policy widens it)."""
     n = 3
     store = sidecar_store(n)
+    # row (j) of rank r's input is [100*r + j] * 4: after rank 1 dies,
+    # survivor r must end with rows [100*s + r_old] from each survivor s
+    xs = [np.stack([np.full(4, 100 * r + j, np.int64) for j in range(n)])
+          for r in range(n)]
 
     def fn(pg):
         pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
@@ -1266,16 +1273,16 @@ def test_self_heal_refuses_world_shaped_retry(sidecar_store):
         if pg.rank == 1:
             pg.stop_watchdog()
             return "dead"
-        x = np.arange(n * 4, dtype=np.int64).reshape(n, 4)
-        try:
-            pg.all_to_all(x, timeout_s=2.5)
-        except RuntimeError as e:
-            assert "world size" in str(e), e
-            assert pg.epoch == 0  # refused BEFORE healing: group untouched
-            pg.stop_watchdog()
-            return "named"
-        return "silently retried"
+        orig = pg.rank
+        out = pg.all_to_all(xs[orig], timeout_s=2.5)  # heals + reshards
+        assert pg.epoch == 1 and pg.global_ranks == [0, 2]
+        assert out.shape == (2, 4)  # new-world rows, survivors only
+        pg.stop_watchdog()
+        pg.barrier()
+        return out
 
     res = _run_group(n, fn, store_handle=store.handle, self_heal=True)
-    assert res[0] == "named" and res[2] == "named"
     assert res[1] == "dead"
+    # survivor 0 hears rows addressed to original rank 0 from [0, 2]
+    np.testing.assert_array_equal(res[0], np.stack([xs[0][0], xs[2][0]]))
+    np.testing.assert_array_equal(res[2], np.stack([xs[0][2], xs[2][2]]))

@@ -57,6 +57,10 @@ PG_BLOCKING = {
     "all_to_all_v", "all_gather_v", "reduce_scatter_v", "reduce", "gather",
     "scatter", "send", "recv", "isend", "irecv", "batch_isend_irecv",
     "barrier", "monitored_barrier", "split", "shrink", "heal",
+    # the elastic lifecycle surface (PR 6): grow blocks on the member
+    # rendezvous + joiner splice, wait_promotion on the admit key — both
+    # wait on OTHER processes, the exact shape rule 3 exists for
+    "grow", "wait_promotion",
 }
 
 

@@ -43,6 +43,17 @@ first** — a ``record(...)`` call (``_FLIGHT.record``, a schedule's
 ``postmortem(...)``. Handlers that absorb-and-continue are out of
 scope: the retry/backoff layer already records absorptions.
 
+**Elastic-surface coverage (PR 6).** The abort-path rule above only
+fires where an ``except``-and-reraise already exists — a membership
+verb with NO handler at all would abort silently and still pass. The
+elastic lifecycle surface (``ProcessGroup.grow`` / ``heal`` /
+``wait_promotion`` in ``distributed.py``) is exactly where that gap
+bites: a grow/promote that dies between ``set_epoch`` and the wired
+barrier is the hardest hang to triage after the fact. Third invariant:
+**each elastic verb must CONTAIN at least one handler that both
+re-raises and records a flight event** — guaranteed abort
+instrumentation, not merely conditional on a handler existing.
+
 Exceptions live in ``ALLOW`` ("Class.verb" / "file.py::qualname" ->
 reason) — empty by policy.
 """
@@ -74,6 +85,12 @@ ABORT_TARGETS = ("rocnrdma_tpu/transport/plugin.py",
                  "rocnrdma_tpu/distributed.py",
                  "rocnrdma_tpu/transport/bootstrap.py")
 ABORT_MARKERS = {"record", "_stall", "postmortem", "_postmortem"}
+
+# the elastic lifecycle surface: these ProcessGroup verbs must each
+# GUARANTEE an abort flight event (contain a record-and-reraise handler)
+ELASTIC_FILE = "rocnrdma_tpu/distributed.py"
+ELASTIC_CLASS = "ProcessGroup"
+ELASTIC_SURFACE = ("grow", "heal", "wait_promotion")
 
 ALLOW: dict[str, str] = {}
 
@@ -184,6 +201,48 @@ def abort_problems(tree: ast.Module, where: str,
     return problems
 
 
+def elastic_problems(tree: ast.Module, where: str,
+                     used: set | None = None) -> list[str]:
+    """The elastic-surface invariant: every verb in ``ELASTIC_SURFACE``
+    must contain at least one ``except`` handler that both re-raises and
+    records — a membership change with no abort instrumentation at all
+    would pass the (conditional) abort rule while aborting silently."""
+    problems = []
+    classes = {n.name: n for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef)}
+    cls = classes.get(ELASTIC_CLASS)
+    if cls is None:
+        return [f"{where}: elastic class {ELASTIC_CLASS} not found"]
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name in ELASTIC_SURFACE:
+        key = f"{ELASTIC_CLASS}.{name}"
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        fn = methods.get(name)
+        if fn is None:
+            problems.append(
+                f"{where}: elastic verb {key} not found — the surface "
+                f"list in tools/analyze/obs.py is stale")
+            continue
+        instrumented = any(
+            isinstance(node, ast.ExceptHandler)
+            and any(isinstance(s, ast.Raise) for s in ast.walk(node))
+            and ({base.call_name(sub) for sub in ast.walk(node)
+                  if isinstance(sub, ast.Call)} & ABORT_MARKERS)
+            for node in ast.walk(fn))
+        if not instrumented:
+            problems.append(
+                f"{where}:{fn.lineno}: elastic verb {key} guarantees no "
+                f"abort flight event (wrap the protocol in an except "
+                f"that records — _FLIGHT.record/_stall/postmortem — and "
+                f"re-raises, or ALLOW it with a reason); a silent "
+                f"grow/promote abort is untriageable after the fact")
+    return problems
+
+
 def check_source(src: str, path: str = "<fixture>") -> list[str]:
     tree = ast.parse(src, filename=path)
     return check_tree(tree, path) + abort_problems(tree, path)
@@ -195,11 +254,18 @@ def check_abort_source(src: str, path: str = "<fixture>") -> list[str]:
     return abort_problems(ast.parse(src, filename=path), path)
 
 
+def check_elastic_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the elastic-surface invariant alone."""
+    return elastic_problems(ast.parse(src, filename=path), path)
+
+
 def run() -> list[str]:
     used: set = set()
     problems = check_tree(base.parse_file(PLUGIN), PLUGIN, used)
     for target in ABORT_TARGETS:
         problems += abort_problems(base.parse_file(target), target, used)
+    problems += elastic_problems(base.parse_file(ELASTIC_FILE),
+                                 ELASTIC_FILE, used)
     problems += base.allow_reason_problems(ALLOW, NAME)
     problems += base.allow_stale_problems(ALLOW, used, NAME)
     return problems
