@@ -16,7 +16,7 @@ def setup_backend(fake_devices: int | None, platform: str,
         rt.force_cpu_devices(fake_devices)
     elif platform == "cpu":
         rt.force_cpu_devices(max(default_ranks or 8, 2))
-    return rt.init_runtime()
+    return rt.init_runtime(timeout_s=60)
 
 
 def parse_mesh2d(spec: str) -> tuple[int, int]:

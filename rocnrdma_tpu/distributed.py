@@ -264,6 +264,12 @@ class ProcessGroup:
         self._p2p_accepted: set[int] = set()
         self._split_no = 0
         self._shrink_no = 0
+        # the cross-plane heal hook (DESIGN.md §5g): called with
+        # (members, epoch) after every SUCCESSFUL membership change so
+        # the device plane (jax coordination service, meshes, Transport
+        # consumers) can restart on the agreed world — see
+        # set_device_heal / _run_device_heal
+        self._device_heal_hook = None
         self._destroyed = False
         self._postmortemed = False  # one watchdog flight dump per group
         self._store_handle = store_handle
@@ -1474,6 +1480,64 @@ class ProcessGroup:
             server, timeout_s, f"{self.group_name}/shrunk{self._shrink_no}",
             plane=self.plane)
 
+    # -- cross-plane heal hook (the device-plane restart, DESIGN.md §5g) ----
+
+    def set_device_heal(self, hook) -> None:
+        """Register the device-plane heal hook: ``hook(members, epoch)``
+        runs on this rank after every SUCCESSFUL membership change —
+        heal, grow, or this rank's own promotion/admission — with the
+        agreed member list (original ranks, current-rank order) and the
+        new epoch. The intended hook drives
+        :func:`rocnrdma_tpu.runtime.init.reinit_runtime` (coordinated
+        jax coordination-service restart + mesh/Transport rebuild); the
+        group itself stays jax-free either way.
+
+        Failure contract: a raising hook surfaces as a named
+        ``RuntimeError`` ("device-plane heal failed ...") to whoever
+        triggered the membership change — the HOST plane is already
+        healed and keeps serving collectives (watchdog re-armed, ring
+        wired, epoch advanced); only the device plane is down. The
+        error is recorded as a ``deviceheal-abort`` flight event and is
+        never swallowed into another host-plane heal attempt."""
+        self._device_heal_hook = hook
+
+    def agree(self, key: str, value: str | None = None,
+              timeout_s: float = 30.0) -> str:
+        """First-writer-wins agreement under this group's store
+        namespace — the proposal primitive ``heal()``/``grow()`` use for
+        their member lists, exposed for cross-plane consumers (the
+        device-plane heal elects its coordinator through it). With
+        ``value``, propose set-if-absent and return the winning value
+        (ours, or the incumbent's); with ``value=None``, block up to
+        ``timeout_s`` for someone's proposal."""
+        if self._client is None:
+            raise RuntimeError("agree: this group has no store client "
+                               "(single-rank group without a store)")
+        full = f"pg/{self.group_name}/{key}"
+        if value is not None:
+            return self._client.set_if_absent(full, value)
+        return self._client.get(full, timeout_s)
+
+    def _run_device_heal(self, members: list) -> None:
+        """Invoke the registered device-heal hook for a just-completed
+        membership change. Runs AFTER the host-plane protocol is fully
+        committed (epoch advanced, ring wired, watchdog re-armed), so a
+        device-plane failure leaves a healthy host plane behind it."""
+        hook = self._device_heal_hook
+        if hook is None:
+            return
+        try:
+            hook(list(members), self.epoch)
+        except BaseException as e:
+            _FLIGHT.record("deviceheal-abort", epoch=self.epoch,
+                           error=type(e).__name__)
+            if not isinstance(e, Exception):
+                raise  # KeyboardInterrupt/SystemExit are not heal failures
+            raise RuntimeError(
+                f"device-plane heal failed on epoch {self.epoch} of "
+                f"group {self.group_name!r} (host plane healthy; members "
+                f"{members}): {e}") from e
+
     # -- self-healing (epoch-fenced in-place ring repair) -------------------
 
     @property
@@ -1585,8 +1649,8 @@ class ProcessGroup:
         was_watching = self._watchdog_params
         self.stop_watchdog()
         try:
-            return self._heal_protocol(grace_s, epoch, g, ns, suspects,
-                                       remaining, was_watching)
+            members = self._heal_protocol(grace_s, epoch, g, ns, suspects,
+                                          remaining, was_watching)
         except BaseException as e:
             # a FAILED heal (store flake, missed window, divergence) must
             # not leave failure detection silently off: the watchdog the
@@ -1598,6 +1662,14 @@ class ProcessGroup:
             if was_watching is not None:
                 self.start_watchdog(*was_watching)
             raise
+        # the host plane is healed (epoch advanced, ring wired, watchdog
+        # re-armed by the protocol); now follow it with the device plane.
+        # A hook failure raises NAMED (RuntimeError — deliberately not in
+        # _ring's heal-and-retry set, so it propagates to the caller
+        # instead of burning another host heal) with the host plane
+        # still serving.
+        self._run_device_heal(members)
+        return members
 
     def _heal_protocol(self, grace_s, epoch, g, ns, suspects,
                        remaining, was_watching) -> list:
@@ -1726,9 +1798,20 @@ class ProcessGroup:
         # (satellite: bootstrap prune)
         if g == min(alive) and (new_world < old_world or promoted_slots):
             try:
+                # the kv sweep drops the DEAD generations' device-plane
+                # coordinator elections — per-epoch prefixes, strictly
+                # below the epoch just minted: a promoted spare with the
+                # minimum original id is the NEW epoch's election leader
+                # and may write deviceheal/e<N>/coord the instant it
+                # clears the wired barrier, racing this sweep (a whole-
+                # namespace sweep here deleted its proposal and wedged
+                # every other member's blocking agree)
                 self._client.prune(range(new_world, old_world),
                                    prefix=f"pg/{self.group_name}/",
-                                   spares=promoted_slots.values())
+                                   spares=promoted_slots.values(),
+                                   kv=tuple(
+                                       f"pg/{self.group_name}/deviceheal/e{k}/"
+                                       for k in range(epoch)))
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness: stale ids age out of use
         # the wired barrier doubles as the new epoch's clock handshake
@@ -1986,8 +2069,8 @@ class ProcessGroup:
         was_watching = self._watchdog_params
         self.stop_watchdog()
         try:
-            return self._grow_protocol(epoch, g, ns, remaining,
-                                       was_watching)
+            members = self._grow_protocol(epoch, g, ns, remaining,
+                                          was_watching)
         except BaseException as e:
             # a failed grow must not leave failure detection silently
             # off (the heal discipline): re-arm before propagating
@@ -1996,6 +2079,13 @@ class ProcessGroup:
             if was_watching is not None:
                 self.start_watchdog(*was_watching)
             raise
+        if self.epoch == epoch:
+            # joiners were admitted (a zero-joiner grow burns no epoch
+            # and changes nothing the device plane would care about):
+            # the widened membership restarts the device plane too —
+            # same failure contract as heal's hook
+            self._run_device_heal(members)
+        return members
 
     def _grow_protocol(self, epoch, g, ns, remaining,
                        was_watching) -> list:
@@ -2087,9 +2177,17 @@ class ProcessGroup:
             try:
                 # the admitted joiners' prefixed store footprint (slot/
                 # handle/admit keys, prefixed liveness, barrier arrivals)
-                # is cleared so their slot ids are cleanly re-claimable
+                # is cleared so their slot ids are cleanly re-claimable;
+                # the kv sweep retires the old generations' device-plane
+                # coordinator elections exactly as in heal (per-epoch
+                # prefixes below the minted epoch — the election leader
+                # here is always this same rank, but the heal-side race
+                # discipline is kept symmetric)
                 self._client.prune((), prefix=f"pg/{self.group_name}/",
-                                   joiners=joined.values())
+                                   joiners=joined.values(),
+                                   kv=tuple(
+                                       f"pg/{self.group_name}/deviceheal/e{k}/"
+                                       for k in range(epoch)))
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness
         _FLIGHT.mark_sync(ns=ns, rank=new_rank)
@@ -2214,6 +2312,11 @@ class ProcessGroup:
             _WIRE.grew()
         _FLIGHT.record("promote-done", epoch=self.epoch, rank=self.rank,
                        world=self.world_size, role=kind)
+        # this rank just became a member of the new epoch: its device
+        # plane joins the membership's coordinated restart (the members'
+        # own hooks run at the end of their heal/grow). Raises named on
+        # failure with the host-plane admission already complete.
+        self._run_device_heal(self._ranks)
         return list(self._ranks)
 
     def _complete_admission(self, info: dict) -> None:

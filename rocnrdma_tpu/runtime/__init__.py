@@ -22,8 +22,17 @@ _install_jax_compat()
 from rocnrdma_tpu.runtime.mesh import (  # noqa: F401
     Topology,
     detect_topology,
+    local_mesh,
     rank_mesh,
+    reprobe_topology,
     slice_mesh,
 )
-from rocnrdma_tpu.runtime.init import init_runtime, RuntimeInfo  # noqa: F401
+from rocnrdma_tpu.runtime.init import (  # noqa: F401
+    RuntimeInfo,
+    device_fence,
+    elect_coordinator,
+    init_runtime,
+    reinit_runtime,
+    shutdown_runtime,
+)
 from rocnrdma_tpu.runtime.cpu_backend import force_cpu_devices  # noqa: F401

@@ -124,6 +124,46 @@ def ensure_pallas_params() -> None:
         pltpu.CompilerParams = pltpu.TPUCompilerParams
 
 
+def runtime_restart_available() -> bool:
+    """Can this jax restart its distributed runtime in-process? The
+    device-plane heal (``runtime.init.reinit_runtime``) needs two seams:
+    a backend-clearing entry point (so ``jax.distributed.initialize``'s
+    backends-not-yet-initialized precondition can be re-established) and
+    the distributed global state to reset. Callers gate on this instead
+    of tracebacking into a missing attribute mid-heal."""
+    return _clear_backends_fn() is not None
+
+
+def _clear_backends_fn():
+    """The backend-clearing callable for this jax, or None. Newer
+    releases export it as ``jax.extend.backend.clear_backends`` (the
+    top-level ``jax.clear_backends`` was removed in 0.4.36); older ones
+    still carry the top-level name."""
+    import jax
+    try:
+        from jax.extend.backend import clear_backends
+        return clear_backends
+    except ImportError:
+        pass
+    fn = getattr(jax, "clear_backends", None)
+    return fn if callable(fn) else None
+
+
+def clear_jax_backends() -> None:
+    """Tear down every live backend client (and the jit/pjit caches that
+    hold them) so the next ``jax.distributed.initialize`` sees a fresh
+    process — the restart seam of the device-plane heal. Raises a named
+    RuntimeError on releases with no clearing entry point rather than
+    leaving the caller to hang on a stale device view."""
+    fn = _clear_backends_fn()
+    if fn is None:
+        raise RuntimeError(
+            "this jax release exposes no backend-clearing entry point "
+            "(jax.extend.backend.clear_backends / jax.clear_backends): "
+            "device-plane runtime restart is unavailable")
+    fn()
+
+
 def tpu_interpret_available() -> bool:
     """Does this jax ship the TPU interpret machinery (``pltpu.
     InterpretParams``) the remote-DMA data plane needs off-TPU? Old

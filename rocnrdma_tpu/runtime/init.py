@@ -7,6 +7,16 @@
    rebuild of the reference's rank bootstrap/out-of-band exchange),
 2. probes the topology (rank/slice counts, platform),
 3. selects the oracle path when on the CPU backend (BASELINE.json:7).
+
+``reinit_runtime`` is the restartable half (the device-plane heal of
+DESIGN.md §5g): when the host plane's ``ProcessGroup.heal()`` agrees on
+a shrunk/promoted membership, every survivor drives a coordinated jax
+runtime restart here — bounded shutdown of the dead generation's
+coordination client, backend teardown, coordinator re-election by the
+lowest surviving original rank (through the same first-writer-wins
+store proposal ``heal()`` uses), and a re-``initialize`` against the
+winner — so the pod's device plane follows the host plane out of a host
+death instead of staying wedged on a dead coordination service.
 """
 
 from __future__ import annotations
@@ -14,10 +24,13 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import threading
+import time
 
 import jax
 
-from rocnrdma_tpu.runtime.mesh import Topology, detect_topology
+from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
+from rocnrdma_tpu.runtime.mesh import Topology, detect_topology, reprobe_topology
 
 log = logging.getLogger("rocnrdma_tpu")
 
@@ -26,6 +39,8 @@ log = logging.getLogger("rocnrdma_tpu")
 class RuntimeInfo:
     topology: Topology
     distributed: bool   # did we run jax.distributed.initialize?
+    epoch: int = 0      # host-plane generation this runtime serves
+    reinit_s: float = 0.0  # wall time of the restart (0.0 on first init)
 
 
 def _should_init_distributed(coordinator, num_processes) -> bool:
@@ -39,30 +54,58 @@ def _should_init_distributed(coordinator, num_processes) -> bool:
 def init_runtime(coordinator: str | None = None,
                  num_processes: int | None = None,
                  process_id: int | None = None,
-                 timeout_s: int = 60) -> RuntimeInfo:
+                 timeout_s: int = 60,
+                 resilient: bool = False) -> RuntimeInfo:
     """Initialise the distributed runtime and probe the topology.
 
     Surfacing coordinator timeouts (rather than hanging) is the minimal
     failure-detection disposition of SURVEY.md §5: initialization failures
     raise with the coordinator address in the message.
+
+    ``resilient``: connect through the restartable-runtime path
+    (:func:`_connect_distributed`) — a later coordination-service death
+    is RECORDED instead of terminating the process (the stock jax
+    client LOG(FATAL)s), which is the prerequisite for surviving a host
+    death long enough to heal. Requires explicit coordinator/
+    num_processes/process_id (no launcher auto-detection).
     """
     distributed = False
     if _should_init_distributed(coordinator, num_processes):
         coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS") \
             or os.environ.get("COORDINATOR_ADDRESS")
-        kwargs = {}
-        if coordinator:
-            kwargs["coordinator_address"] = coordinator
-        if num_processes is not None:
-            kwargs["num_processes"] = num_processes
-        if process_id is not None:
-            kwargs["process_id"] = process_id
-        kwargs["initialization_timeout"] = timeout_s
         try:
-            jax.distributed.initialize(**kwargs)
+            if resilient:
+                if None in (coordinator, num_processes, process_id):
+                    raise ValueError(
+                        "resilient init needs explicit coordinator, "
+                        "num_processes, and process_id")
+                _connect_distributed(coordinator, num_processes,
+                                     process_id, timeout_s)
+            else:
+                kwargs = {}
+                if coordinator:
+                    kwargs["coordinator_address"] = coordinator
+                if num_processes is not None:
+                    kwargs["num_processes"] = num_processes
+                if process_id is not None:
+                    kwargs["process_id"] = process_id
+                # preflight and initialize SHARE timeout_s (one declared
+                # bound, not two stacked ones): the preflight's elapsed
+                # time is deducted from the C++ init deadline
+                deadline = time.monotonic() + timeout_s
+                if coordinator and process_id not in (None, 0):
+                    # "coordinator never answers" must raise, not
+                    # SIGABRT from the C++ client (the host rank skips
+                    # this: it binds the service itself)
+                    _coordinator_preflight(coordinator, timeout_s)
+                jax.distributed.initialize(
+                    initialization_timeout=max(
+                        1, int(deadline - time.monotonic())),
+                    **kwargs)
         except Exception as e:  # re-raise with the address for diagnosability
+            _FLIGHT.record("device-init-abort", error=type(e).__name__)
             raise RuntimeError(
-                f"jax.distributed.initialize failed (coordinator={coordinator!r}, "
+                f"jax distributed initialize failed (coordinator={coordinator!r}, "
                 f"num_processes={num_processes}, process_id={process_id}): {e}"
             ) from e
         distributed = True
@@ -72,3 +115,375 @@ def init_runtime(coordinator: str | None = None,
              topo.platform, topo.n_devices, topo.n_processes, topo.n_slices,
              " [CPU oracle path]" if topo.is_oracle else "")
     return RuntimeInfo(topology=topo, distributed=distributed)
+
+
+# ---------------------------------------------------------------------------
+# The device-plane heal (DESIGN.md §5g): restartable runtime.
+# ---------------------------------------------------------------------------
+
+
+# Dead-generation coordination services are LEAKED (referenced here)
+# instead of shut down mid-heal: a surviving peer whose client has not
+# finished winding down yet would see the closed socket from its
+# error-polling thread and die in C++ (this jaxlib's client terminates
+# on a polled service error; its Python missed_heartbeat_callback
+# binding is broken — std::bad_cast — so the death cannot be
+# intercepted). The services hold a port each and die with the process,
+# AFTER every local client has wound down. Same disposition as the
+# bootstrap store: the coordination service must outlive its clients.
+_RETIRED_SERVICES: list = []
+
+# client shutdown must be SNAPPY: with a dead peer the shutdown barrier
+# can never complete, and the coordination agent only stops its
+# heartbeat/error-polling threads once Shutdown() returns (it proceeds
+# past a barrier timeout) — a short bound turns "wait for the dead" into
+# a few seconds of orderly teardown instead of minutes
+_CLIENT_SHUTDOWN_TIMEOUT_S = 3
+
+# the HTTP/2 client connection preface + an empty SETTINGS frame: any
+# live gRPC server (the coordination service included) answers it with
+# its own SETTINGS frame; a silent squatter on the port answers nothing
+_H2_PREFACE = (b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+               b"\x00\x00\x00\x04\x00\x00\x00\x00\x00")
+
+
+def _coordinator_preflight(coordinator: str, timeout_s: float) -> None:
+    """Bounded proof that something gRPC-shaped ANSWERS at
+    ``coordinator`` before the C++ coordination client is allowed to
+    dial it. On this jaxlib a client whose RegisterTask RPC expires
+    terminates the whole process from C++ (``LOG(QFATAL)`` in
+    client.h — the Python error-callback binding is broken, so the
+    death cannot be intercepted), which turns "coordinator never
+    answers" into a SIGABRT instead of the named error the failure
+    disposition demands. So the reachability half of initialization is
+    proven HERE, in Python, where it can raise: dial, send the HTTP/2
+    preface, and require the server's SETTINGS frame back. Refused
+    connects and silent listeners retry under the shared backoff until
+    ``timeout_s``, then raise ``TimeoutError`` carrying the address.
+    The service host itself never calls this (it dials in-process).
+
+    Residual risk, documented in DESIGN.md §5g: a service that answers
+    the preflight and THEN dies mid-registration still hits the C++
+    fatal path — the preflight bounds the "never answers" case, which
+    is the one a host death actually produces."""
+    import socket
+
+    from rocnrdma_tpu.transport.backoff import poll_backoff
+    host, port = coordinator.rsplit(":", 1)
+    deadline = time.monotonic() + timeout_s
+    back = poll_backoff()
+    last = "no answer"
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0.0:
+            raise TimeoutError(
+                f"coordination service at {coordinator!r} did not answer "
+                f"within {timeout_s:.1f}s ({last}) — refusing to hand a "
+                f"dead coordinator to the C++ client (it would abort the "
+                f"process instead of raising)")
+        try:
+            with socket.create_connection(
+                    (host, int(port)), timeout=min(2.0, remaining)) as s:
+                s.settimeout(min(2.0, remaining))
+                s.sendall(_H2_PREFACE)
+                if s.recv(1):
+                    return  # a live HTTP/2 server answered
+                last = "connection closed without a handshake"
+        except OSError as e:
+            last = f"{type(e).__name__}: {e}"
+        back.pause()
+
+
+def _connect_distributed(coordinator: str, num_processes: int,
+                         process_id: int, timeout_s: float) -> None:
+    """Start (for process 0) and connect the jax distributed runtime
+    with a RESTARTABLE client: identical to ``jax.distributed.initialize``
+    except the client's shutdown barrier is tightly bounded (see
+    ``_CLIENT_SHUTDOWN_TIMEOUT_S`` — a dead peer must not turn teardown
+    into minutes) and the client never runs a shutdown barrier from a
+    destructor (an abandoned dead-generation client must not block
+    teardown). This is the connect path of the restartable runtime;
+    plain ``init_runtime`` keeps the stock jax behavior unless asked
+    for resilience."""
+    from jax._src import distributed as _jdist
+    from jax._src.lib import xla_extension
+
+    from jax._src import xla_bridge as _xb
+    if _xb.backends_are_initialized():
+        raise RuntimeError(
+            "distributed connect must run before any JAX computation "
+            "(clear backends first — reinit_runtime does)")
+    state = _jdist.global_state
+    if state.client is not None or state.service is not None:
+        raise RuntimeError("distributed runtime already initialized "
+                           "(shutdown_runtime first)")
+    deadline = time.monotonic() + max(1.0, timeout_s)
+    if process_id == 0:
+        bind = "[::]:" + coordinator.rsplit(":", 1)[1]
+        state.service = xla_extension.get_distributed_runtime_service(
+            bind, num_processes)
+    try:
+        # EVERY rank — the service host included — proves the
+        # coordinator address ANSWERS before the C++ client may dial it
+        # (a dead one aborts the process from C++, see
+        # _coordinator_preflight). The host is not exempt: a squatter
+        # on 127.0.0.1:<port> wins the dispatch race against the
+        # service's own [::] bind, so even a freshly bound service is
+        # only trusted once the preflight lands on it. Shares this
+        # connect's deadline budget.
+        _coordinator_preflight(
+            coordinator, max(0.5, deadline - time.monotonic()))
+        state.num_processes = num_processes
+        state.process_id = process_id
+        state.coordinator_address = coordinator
+        client = xla_extension.get_distributed_runtime_client(
+            coordinator, process_id,
+            init_timeout=max(1, int(deadline - time.monotonic())),
+            shutdown_timeout=_CLIENT_SHUTDOWN_TIMEOUT_S,
+            shutdown_on_destruction=False)
+        client.connect()
+    except BaseException:
+        # a failed preflight/connect must leave a cleanly
+        # re-initializable state (the retry loop in reinit_runtime
+        # tears down + tries again). A service this process just bound
+        # is RETIRED, never shut down: a peer whose preflight landed on
+        # it may already be registered, and closing the socket under
+        # that peer's client kills the peer from C++ (the QFATAL
+        # landmine — see _RETIRED_SERVICES). The retired service keeps
+        # listening until process exit; the gRPC server binds with
+        # SO_REUSEPORT, so a retry CAN re-bind the port — in the corner
+        # where peers had already registered on the retired instance the
+        # two services then split registrations and every rank times out
+        # NAMED at its deadline (degraded, never a hang or abort; the
+        # next heal re-elects a fresh port under a fresh epoch).
+        if state.service is not None:
+            _RETIRED_SERVICES.append(state.service)
+            state.service = None
+        raise
+    state.client = client
+
+
+def shutdown_runtime(timeout_s: float = 5.0,
+                     retire_service: bool = True) -> bool:
+    """Best-effort, BOUNDED shutdown of the jax distributed runtime.
+
+    ``jax.distributed.shutdown`` runs a shutdown barrier across every
+    process of the old generation — with a dead peer (the reason the
+    device plane is healing at all) that barrier can only resolve by
+    timing out, far past any heal deadline with stock options. So: the
+    global distributed state is detached FIRST (a re-``initialize``
+    never races the old client), the orderly client shutdown runs on a
+    daemon thread, and the caller waits at most ``timeout_s``. Returns
+    True when the client wound down cleanly inside the bound, False
+    when it was abandoned to the background (its thread keeps draining;
+    the dead generation's client cannot touch the new one).
+
+    ``retire_service``: a coordination service this process hosts is
+    NOT closed — it is parked in ``_RETIRED_SERVICES`` and dies with
+    the process. Closing it here would race surviving peers whose
+    clients are still winding down: their error-polling threads see the
+    closed socket and this jaxlib's client terminates the process from
+    C++ (unconditionally — the Python callback binding is broken).
+    Pass ``retire_service=False`` only when every client of the service
+    is known to be gone. The outcome is recorded as a
+    ``device-plane-shutdown`` flight event — deliberately OUTSIDE the
+    ``deviceheal-`` replay digest, because clean-vs-abandoned is
+    wall-clock-determined."""
+    from jax._src import distributed as _jdist
+    state = _jdist.global_state
+    client, service = state.client, state.service
+    state.client = None
+    state.service = None
+    state.preemption_sync_manager = None
+    if service is not None:
+        if retire_service:
+            _RETIRED_SERVICES.append(service)
+            service = None
+    if client is None and service is None:
+        _FLIGHT.record("device-plane-shutdown", clean=True)
+        return True
+
+    def _wind_down():
+        try:
+            if client is not None:
+                client.shutdown()
+        except Exception:
+            pass
+        try:
+            if service is not None:
+                service.shutdown()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=_wind_down, daemon=True)
+    t.start()
+    t.join(timeout=max(0.0, timeout_s))
+    clean = not t.is_alive()
+    _FLIGHT.record("device-plane-shutdown", clean=clean)
+    return clean
+
+
+def elect_coordinator(agree, members: list, my_orig: int, epoch: int,
+                      timeout_s: float = 30.0,
+                      host: str = "127.0.0.1") -> str:
+    """Re-elect the device-plane coordinator for ``epoch``: the lowest
+    surviving ORIGINAL rank reserves a fresh port on its host and
+    proposes ``host:port`` under the group's store, first-writer-wins —
+    the same split-brain-free proposal shape ``heal()`` uses for the
+    member list. Everyone (proposer included) adopts the winning value.
+
+    ``agree`` is the group's agreement primitive
+    (:meth:`ProcessGroup.agree`): ``agree(key, value)`` proposes
+    set-if-absent and returns the winner; ``agree(key, None, timeout_s)``
+    blocks for it. The key is epoch-qualified so a later heal's election
+    can never read a dead generation's coordinator; ``heal()``'s leader
+    prune sweeps the stale epochs' keys from long-lived stores."""
+    from rocnrdma_tpu.runtime.multiprocess import reserve_port
+    key = f"deviceheal/e{epoch}/coord"
+    if my_orig == min(members):
+        port, res = reserve_port(host)
+        res.close()  # the coordination service binds it next
+        winner = agree(key, f"{host}:{port}")
+    else:
+        winner = agree(key, None, timeout_s)
+    # the election is on the replay-equal DEVICEHEAL timeline by leader
+    # identity, never by port (ports vary run to run)
+    _FLIGHT.record("deviceheal-elected", epoch=epoch,
+                   leader=min(members))
+    return winner
+
+
+def reinit_runtime(members: list, epoch: int, my_orig: int,
+                   agree=None, coordinator: str | None = None,
+                   host: str = "127.0.0.1",
+                   timeout_s: float = 60.0) -> RuntimeInfo:
+    """Coordinated device-plane restart on the agreed membership — the
+    device half of a heal (or grow/promotion): every member calls this
+    with the SAME ``members`` (original ranks, current-rank order) and
+    ``epoch`` the host plane just agreed on.
+
+    The sequence, under ONE overall deadline (``timeout_s``):
+
+    1. bounded :func:`shutdown_runtime` of the dead generation (never a
+       hang on the dead peer's shutdown barrier);
+    2. backend teardown (``compat.clear_jax_backends``) so
+       ``jax.distributed.initialize``'s fresh-process precondition holds;
+    3. coordinator re-election (:func:`elect_coordinator`) unless the
+       caller already knows the address;
+    4. ``jax.distributed.initialize`` against the winner with
+       ``process_id = members.index(my_orig)`` — connect failures retry
+       under the shared backoff inside the deadline;
+    5. topology re-probe validated against the agreed membership
+       (:func:`~rocnrdma_tpu.runtime.mesh.reprobe_topology`), so a
+       coordination service that silently admitted the wrong world
+       count raises named here instead of desyncing ``shard_map``.
+
+    A failure at any step records a ``deviceheal-abort`` flight event
+    and raises a named ``RuntimeError`` carrying the coordinator address
+    and membership — never a hang (the host plane stays healthy; the
+    caller decides whether to retry, degrade, or exit)."""
+    from rocnrdma_tpu.runtime import compat
+    from rocnrdma_tpu.transport.backoff import poll_backoff
+
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    remaining = lambda: max(0.1, deadline - time.monotonic())
+    if my_orig not in members:
+        raise ValueError(f"reinit_runtime: rank {my_orig} is not in the "
+                         f"agreed membership {members}")
+    _FLIGHT.record("deviceheal-start", epoch=epoch, rank=my_orig,
+                   members=",".join(str(m) for m in members))
+    try:
+        if not compat.runtime_restart_available():
+            raise RuntimeError(
+                "device-plane restart unavailable: this jax release "
+                "exposes no backend-clearing entry point")
+        shutdown_runtime(timeout_s=min(5.0, timeout_s / 4.0))
+        compat.clear_jax_backends()
+        if coordinator is None:
+            if agree is None:
+                raise ValueError(
+                    "reinit_runtime needs either an explicit coordinator "
+                    "or an agree primitive to elect one")
+            coordinator = elect_coordinator(agree, members, my_orig, epoch,
+                                            timeout_s=remaining(),
+                                            host=host)
+        process_id = members.index(my_orig)
+        back = poll_backoff()
+        while True:
+            try:
+                _connect_distributed(coordinator, len(members),
+                                     process_id,
+                                     timeout_s=remaining())
+                break
+            except Exception as e:
+                # a transient connect race (the re-elected coordinator's
+                # service is still binding) retries under the shared
+                # backoff; what never succeeds surfaces named below. The
+                # half-made state of a failed initialize must be torn
+                # down first or the retry trips the only-once guards.
+                # Recorded OUTSIDE the deviceheal- digest prefix: retry
+                # counts are wall-clock-determined, and the DEVICEHEAL
+                # replay log must stay a pure function of the seed.
+                _FLIGHT.record("device-reinit-retry", epoch=epoch,
+                               error=type(e).__name__)
+                shutdown_runtime(timeout_s=1.0)
+                compat.clear_jax_backends()
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"device re-init against {coordinator!r} still "
+                        f"failing at the deadline: {e}") from e
+                back.pause()
+        topo = reprobe_topology(expected_processes=len(members))
+    except BaseException as e:
+        _FLIGHT.record("deviceheal-abort", epoch=epoch, rank=my_orig,
+                       error=type(e).__name__)
+        if not isinstance(e, Exception):
+            raise  # KeyboardInterrupt/SystemExit are not re-init failures
+        raise RuntimeError(
+            f"device-plane re-init failed on epoch {epoch} "
+            f"(coordinator={coordinator!r}, members={members}, "
+            f"rank {my_orig}): {e}") from e
+    _FLIGHT.record("deviceheal-done", epoch=epoch, rank=my_orig,
+                   procs=topo.n_processes, devices=topo.n_devices)
+    log.info("device heal: epoch=%d members=%s coordinator=%s "
+             "procs=%d devices=%d", epoch, members, coordinator,
+             topo.n_processes, topo.n_devices)
+    return RuntimeInfo(topology=topo, distributed=True, epoch=epoch,
+                       reinit_s=time.monotonic() - t0)
+
+
+def device_fence(members: list, my_orig: int, epoch: int,
+                 timeout_s: float = 30.0) -> dict:
+    """Cross-process handshake THROUGH the restarted coordination
+    service: every member publishes a deterministic token under its
+    original rank and blocks (bounded) for every peer's — the proof
+    that the re-elected service actually serves the whole agreed
+    membership, independent of whether this backend can run
+    cross-process computations. Returns ``{orig: token}``; a member the
+    service never admitted surfaces as a named TimeoutError."""
+    from jax._src import distributed as _jdist
+    client = _jdist.global_state.client
+    if client is None:
+        raise RuntimeError("device_fence: no distributed runtime "
+                           "(initialize/reinit first)")
+    ns = f"rocnrdma/deviceheal/e{epoch}"
+    token = f"m{my_orig}e{epoch}"
+    client.key_value_set(f"{ns}/{my_orig}", token)
+    out = {}
+    deadline = time.monotonic() + timeout_s
+    for m in members:
+        try:
+            out[m] = client.blocking_key_value_get(
+                f"{ns}/{m}",
+                max(100, int((deadline - time.monotonic()) * 1000)))
+        except Exception as e:
+            raise TimeoutError(
+                f"device_fence: member (original rank {m}) never "
+                f"published through the epoch-{epoch} coordination "
+                f"service: {e}") from e
+        if out[m] != f"m{m}e{epoch}":
+            raise RuntimeError(
+                f"device_fence: member {m} published {out[m]!r} on "
+                f"epoch {epoch} (wrong generation answered)")
+    return out

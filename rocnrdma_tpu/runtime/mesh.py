@@ -72,6 +72,41 @@ def detect_topology(devices=None) -> Topology:
     )
 
 
+def reprobe_topology(expected_processes: int | None = None,
+                     expected_devices: int | None = None) -> Topology:
+    """Re-probe the topology after a device-plane restart
+    (``runtime.init.reinit_runtime``) and VALIDATE it against the
+    membership the host plane agreed on. ``detect_topology`` is
+    stateless — the probe itself is just a fresh call — but a restart
+    that silently came up on the wrong world (a stale backend view, a
+    coordination service that admitted a straggler of the dead
+    generation) would desync every ``shard_map`` layout downstream, so
+    the shrunk/promoted expectations are checked HERE, named, before
+    any mesh consumer is rebuilt."""
+    topo = detect_topology()
+    if (expected_processes is not None
+            and topo.n_processes != expected_processes):
+        raise RuntimeError(
+            f"device plane re-probed {topo.n_processes} process(es) but "
+            f"the healed membership has {expected_processes} — the "
+            f"coordination service and the host plane disagree on the "
+            f"world")
+    if expected_devices is not None and topo.n_devices != expected_devices:
+        raise RuntimeError(
+            f"device plane re-probed {topo.n_devices} device(s), "
+            f"expected {expected_devices} on the healed membership")
+    return topo
+
+
+def local_mesh(axis: str = RANK_AXIS) -> Mesh:
+    """1-D mesh over THIS process's addressable devices — the
+    device-plane consumer every process can rebuild (and run) after a
+    heal even on backends without cross-process computation support:
+    ``shard_map`` collectives over it execute entirely in-process while
+    still exercising the freshly re-initialized backend."""
+    return Mesh(np.array(jax.local_devices()), (axis,))
+
+
 def rank_mesh(n: int | None = None, devices=None) -> Mesh:
     """1-D mesh over the ``rank`` axis — the ring the explicit schedules walk.
 

@@ -68,8 +68,14 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective", "kill-and-heal")
+# tasks that drive BOTH planes: the host-plane chaos stack AND a real
+# jax coordination service (run_workers reserves a second port for it)
+DEVICE_TASKS = ("kill-a-host",)
+# debug/harness tasks (no jax, no chaos stack)
+AUX_TASKS = ("hang",)
 
 
 def _chaos_input(seed: int, rank: int, rnd: int, size: int):
@@ -273,6 +279,265 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
     return 0
 
 
+def _device_log() -> str:
+    """The device-plane heal timeline digest: deviceheal-* events carry
+    only epoch/membership/leader/world-count data (never ports or wall
+    times — those live in non-digested ``device-*`` events), so two runs
+    of one seed digest identically on every survivor."""
+    return _event_log(("deviceheal-",))
+
+
+def _verify_device_plane(args, members: list, my_orig: int,
+                         epoch: int) -> None:
+    """Prove the device plane is ALIVE end-to-end on the agreed
+    membership: (1) every member answers through the (re)started jax
+    coordination service; (2) the re-probed topology matches the agreed
+    world; (3) a rebuilt mesh consumer (``Transport`` over this
+    process's devices) completes a ``shard_map`` allreduce with an
+    int64 bitwise oracle; (4) the cross-process ``shard_map`` collective
+    runs too when the backend supports multiprocess computations (old
+    CPU jaxlibs cannot — the capability is probed and named, exactly
+    like the existing multiprocess tests). Raises on any mismatch; the
+    caller (the device-heal hook) converts that into the named
+    device-heal failure."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rocnrdma_tpu import runtime as rt
+    from rocnrdma_tpu.runtime.init import device_fence
+    from rocnrdma_tpu.transport import Transport
+
+    device_fence(members, my_orig, epoch, timeout_s=20.0)
+    topo = rt.reprobe_topology(expected_processes=len(members))
+    # the local device collective: Transport + shard_map over THIS
+    # process's devices runs on every backend; int64 keeps it bitwise
+    mesh = rt.local_mesh()
+    t = Transport(mesh)
+    k = int(mesh.devices.size)
+    rows = np.stack([_chaos_input(args.seed, 7_000 + my_orig * 131 + d,
+                                  epoch, 64) for d in range(k)])
+    garr = jax.device_put(jnp.asarray(rows),
+                          NamedSharding(mesh, P("rank")))
+    got = np.asarray(t.allreduce(garr))
+    want = np.broadcast_to(rows.sum(axis=0), rows.shape)
+    if not np.array_equal(got, want):
+        raise RuntimeError(
+            f"device plane: local shard_map allreduce not bitwise-"
+            f"correct on epoch {epoch} (members {members})")
+    print(f"DEVICE-LOCAL ok epoch={epoch}", flush=True)
+    # the cross-process collective, capability-gated: each process
+    # contributes its local rows of a deterministic global matrix
+    try:
+        nr = topo.n_devices
+        pi = topo.process_index
+        per = nr // len(members)
+        full = np.stack([_chaos_input(args.seed, 9_000 + i, epoch, 64)
+                         for i in range(nr)])
+        gmesh = rt.rank_mesh(nr)
+        sharding = NamedSharding(gmesh, P("rank"))
+        garr = jax.make_array_from_process_local_data(
+            sharding, full[pi * per:(pi + 1) * per], full.shape)
+        out = jax.jit(
+            lambda a: jnp.broadcast_to(a.sum(axis=0, keepdims=True),
+                                       a.shape),
+            in_shardings=sharding, out_shardings=sharding)(garr)
+        for shard in out.addressable_shards:
+            if not np.array_equal(np.asarray(shard.data),
+                                  full.sum(axis=0)[None]):
+                raise RuntimeError(
+                    f"device plane: global shard_map allreduce not "
+                    f"bitwise-correct on epoch {epoch}")
+        print(f"DEVICE-GLOBAL ok epoch={epoch}", flush=True)
+    except Exception as e:
+        if "Multiprocess computations aren't implemented" not in str(e):
+            raise
+        # this jaxlib's CPU backend has no cross-process execution at
+        # all (a capability gap of the environment, not of the heal —
+        # the coordination fence above already proved every member is
+        # attached); named, like the existing multiprocess tests
+        print(f"DEVICE-GLOBAL unsupported-backend epoch={epoch}",
+              flush=True)
+
+
+def _device_chaos_main(args) -> int:
+    """The ``kill-a-host`` task: the end-to-end "pod survives a host
+    death" run (ISSUE 7). Every member drives BOTH planes — the
+    self-healing host-plane ProcessGroup of ``kill-and-heal`` AND a
+    real jax coordination service (the device plane). The victim host
+    is hard-killed mid-collective; survivors must heal the host plane,
+    then the registered device-heal hook restarts the coordination
+    service on the agreed membership (coordinator re-elected by lowest
+    surviving original rank through the store), re-probes the topology,
+    rebuilds the mesh consumers, and proves the device plane with the
+    bitwise oracle — all bounded, never a hang.
+
+    The jax coordination service rides host rank 0 next to the
+    bootstrap store — the SAME sidecar disposition the store documents
+    (losing the store host loses the group): on this jaxlib a client
+    whose service socket closes under it terminates the process from
+    C++ (the Python error-callback binding is broken), so the service
+    must outlive its clients; what a host death kills is the victim's
+    CLIENT membership, and the heal still re-elects a fresh coordinator
+    + service for the shrunk world (``runtime.init.elect_coordinator``
+    — the old generation's service is retired, never reused).
+    ``--device-heal-fail`` makes the re-init deterministically fail
+    (the elected address is a bound-but-silent port): every survivor
+    must surface the named device-heal failure within one deadline
+    window and then prove the HOST plane still serves collectives
+    (degraded mode)."""
+    import numpy as np
+
+    from rocnrdma_tpu import distributed as dist
+    from rocnrdma_tpu.metrics import WIRE
+    from rocnrdma_tpu.transport import bootstrap
+    from rocnrdma_tpu.transport.faults import FaultSchedule
+
+    rank, total = args.process_id, args.num_processes
+    n = total - args.spares
+    role = "member" if rank < n else "spare"
+    kill = dict(zip(
+        (int(r) for r in (args.kill_ranks or "").split(",") if r),
+        (int(o) for o in (args.kill_ops or "").split(",") if o)))
+    server = None
+    if rank == 0:
+        host, port = args.coordinator.rsplit(":", 1)
+        server = bootstrap.BootstrapServer(n_ranks=total, port=int(port),
+                                           host=host)
+    sched = FaultSchedule(
+        args.seed, rank,
+        connect_refusals=1, connect_flake_p=0.2,
+        test_delay_p=0.3, test_delay_polls=(1, 4),
+        kill_after_ops=kill.get(rank))
+    # the device plane: 2 fake CPU devices per "host", configured before
+    # the first backend touch (compat knob); spares defer their first
+    # jax init to the promotion hook
+    import jax
+
+    from rocnrdma_tpu.runtime.compat import set_cpu_device_count
+    from rocnrdma_tpu.runtime.init import init_runtime, reinit_runtime
+    jax.config.update("jax_platforms", "cpu")
+    set_cpu_device_count(2)
+    status = 0
+    pg = None
+    reinit_ms: list = []
+    fail_sock = [None]
+    group = f"dh{args.seed}"
+    try:
+        if role == "member":
+            init_runtime(coordinator=args.jax_coordinator,
+                         num_processes=n, process_id=rank,
+                         timeout_s=30, resilient=True)
+            _verify_device_plane(args, list(range(n)), rank, 0)
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=args.coordinator,
+                timeout_s=20.0, group_name=group, plane="shm",
+                fault_schedule=sched, self_heal=True)
+        else:
+            pg = dist.init_process_group(
+                world_size=n, store_handle=args.coordinator,
+                timeout_s=20.0, group_name=group, plane="shm",
+                fault_schedule=sched, self_heal=True, spare=True)
+
+        def device_heal(members, epoch):
+            my_orig = pg.global_ranks[pg.rank]
+            if args.device_heal_fail:
+                # deterministic failure injection: the leader squats a
+                # port with a listener that never speaks gRPC and
+                # proposes it through the SAME first-writer-wins key
+                # the election would use; every rank's re-init then
+                # times out named inside its deadline
+                import socket as _socket
+                key = f"deviceheal/e{epoch}/coord"
+                if my_orig == min(members):
+                    s = _socket.socket()
+                    s.setsockopt(_socket.SOL_SOCKET,
+                                 _socket.SO_REUSEADDR, 1)
+                    s.bind(("127.0.0.1", 0))
+                    s.listen(1)
+                    fail_sock[0] = s
+                    coord = pg.agree(
+                        key, f"127.0.0.1:{s.getsockname()[1]}")
+                else:
+                    coord = pg.agree(key, None, 20.0)
+                reinit_runtime(members, epoch, my_orig,
+                               coordinator=coord, timeout_s=6.0)
+            else:
+                info = reinit_runtime(members, epoch, my_orig,
+                                      agree=pg.agree, timeout_s=30.0)
+                reinit_ms.append(round(info.reinit_s * 1000.0, 3))
+                _verify_device_plane(args, members, my_orig, epoch)
+
+        pg.set_device_heal(device_heal)
+        if role == "member":
+            pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
+            start = 0
+        else:
+            pg.wait_promotion(timeout_s=120.0)
+            start = pg.committed_ops
+        status = _chaos_rounds(args, pg, start, can_grow=False,
+                               skip_first_ping=(role == "spare"))
+        if status == 0:
+            print(f"OK rank={rank}/{total} rounds={args.rounds} "
+                  f"now-rank={pg.rank}/{pg.world_size}", flush=True)
+            print(f"EPOCH {pg.epoch}", flush=True)
+            print(f"MEMBERS {pg.global_ranks}", flush=True)
+            pg.stop_watchdog()
+            pg.destroy(graceful=True)
+            pg = None
+    except RuntimeError as e:
+        if "device-plane heal failed" in str(e):
+            # degraded mode: the device plane is down, NAMED, inside
+            # its deadline — and the host plane must still serve. One
+            # more host collective with the bitwise oracle proves it.
+            print(f"DEVICEHEAL-FAILED {type(e).__name__}: {e}",
+                  flush=True)
+            pg.set_device_heal(None)
+            my_orig = pg.global_ranks[pg.rank]
+            local = _chaos_input(args.seed, my_orig, 999, args.size)
+            got = pg.all_reduce(local, timeout_s=10.0)
+            want = _chaos_input(args.seed, pg.global_ranks[0], 999,
+                                args.size)
+            for m in pg.global_ranks[1:]:
+                want = want + _chaos_input(args.seed, m, 999, args.size)
+            if np.array_equal(got, want):
+                print("HOST-PLANE-OK", flush=True)
+            else:
+                print("HOST-PLANE-BAD", flush=True)
+            print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+            status = 4
+        else:
+            print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+            status = 4
+    except (TimeoutError, OSError) as e:
+        print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+        status = 4
+    finally:
+        snap = WIRE.snapshot()
+        print(f"FENCED {snap['frames_fenced']}", flush=True)
+        print(f"RESUMED {snap['frames_resumed']}", flush=True)
+        print(f"FAULTS {sched.counters.to_json()}", flush=True)
+        print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        print(f"HEALLOG {_heal_log()}", flush=True)
+        print(f"DEVICEHEAL {_device_log()}", flush=True)
+        print(f"DEVICEHEAL_MS {reinit_ms}", flush=True)
+        if fail_sock[0] is not None:
+            fail_sock[0].close()
+        from rocnrdma_tpu.obs import chrome
+        chrome.dump_if_env(rank)
+        if pg is not None:
+            try:
+                pg.destroy(graceful=False)
+            except (OSError, TimeoutError):
+                pass
+        if server is not None:
+            if status == 0:
+                server.wait_idle(timeout_s=5.0)
+            server.close()
+    return status
+
+
 def _heal_chaos_main(args) -> int:
     from rocnrdma_tpu import distributed as dist
     from rocnrdma_tpu.metrics import WIRE
@@ -388,8 +653,17 @@ def main(argv=None) -> int:
     p.add_argument("--process-id", type=int, required=True)
     p.add_argument("--task",
                    choices=("allreduce", "alltoall", "hierarchical", "fault")
-                   + CHAOS_TASKS,
+                   + CHAOS_TASKS + DEVICE_TASKS + AUX_TASKS,
                    required=True)
+    p.add_argument("--jax-coordinator", default=None,
+                   help="kill-a-host: the DEVICE plane's initial jax "
+                        "coordination-service address (the host-plane "
+                        "store rides --coordinator)")
+    p.add_argument("--device-heal-fail", action="store_true",
+                   help="kill-a-host: make the post-heal device re-init "
+                        "deterministically fail (degraded-mode chaos: "
+                        "survivors must raise named with the host plane "
+                        "still serving)")
     p.add_argument("--fault-rank", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--rounds", type=int, default=10)
@@ -416,6 +690,18 @@ def main(argv=None) -> int:
                         "(the mid-promotion death case)")
     args = p.parse_args(argv)
 
+    if args.task == "hang":
+        # harness-test task: fork a grandchild and block far past any
+        # test deadline — run_workers' timeout path must reap the WHOLE
+        # process group (the grandchild included), never leave zombies
+        import subprocess
+        child = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(600)"])
+        print(f"CHILD {child.pid}", flush=True)
+        time.sleep(600)
+        return 0
+    if args.task == "kill-a-host":
+        return _device_chaos_main(args)  # both planes
     if args.task == "kill-and-heal":
         return _heal_chaos_main(args)  # host plane only: no jax
     if args.task in CHAOS_TASKS:

@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 @dataclasses.dataclass
@@ -28,10 +30,60 @@ class WorkerResult:
     stderr: str
 
 
+def reserve_port(host: str = "127.0.0.1") -> tuple[int, socket.socket]:
+    """Reserve a free port and KEEP it held until the returned socket is
+    closed. The old ``free_port()`` released the port at function exit,
+    so under parallel chaos runs two harnesses could draw the same
+    number before either coordinator bound it (a TOCTOU race). The
+    reservation is ``SO_REUSEADDR``-bound AND listening: a bound-but-
+    not-listening socket does not stop another ``SO_REUSEADDR`` binder
+    (a stale worker from a reaped fleet re-binding its old port) from
+    stealing the number — ``listen`` makes the hold real against both
+    explicit binders and the kernel's ephemeral allocator. Holding until
+    just before the spawn shrinks the window to the close→child-bind
+    gap; ``run_workers`` retries once on the residual bind collision."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    s.listen(1)
+    return s.getsockname()[1], s
+
+
 def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    """A free port number, released immediately — last-resort helper for
+    callers that cannot hold a reservation (prefer :func:`reserve_port`:
+    the returned number can be re-drawn by anyone between this close and
+    your bind)."""
+    port, sock = reserve_port()
+    sock.close()
+    return port
+
+
+def _bind_collision(results: list) -> bool:
+    """Did this run die on the reserved-port race? Rank 0 binds both
+    coordinator ports first thing (the bootstrap store, and for device
+    tasks the jax service); a loss of the reservation race surfaces
+    there as EADDRINUSE before any real work ran — as a traceback on
+    stderr (store port) or wrapped into a named CLEAN-ABORT on stdout
+    (jax port: init_runtime wraps the bind failure and the worker
+    prints it)."""
+    r0 = next((r for r in results if r.process_id == 0), None)
+    if r0 is None or r0.returncode in (0, None):
+        return False
+    return "Address already in use" in (r0.stderr or "") + (r0.stdout or "")
+
+
+def _reap(proc: subprocess.Popen) -> tuple[str, str]:
+    """Kill ``proc``'s WHOLE process group (workers are spawned as
+    session leaders, so children they forked die with them instead of
+    lingering as zombies that poison later chaos tests) and collect
+    whatever stdout/stderr it managed to write."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        proc.kill()  # already gone, or pgid unavailable: kill the leader
+    out, err = proc.communicate()
+    return out, err
 
 
 def run_workers(n: int, task: str, timeout_s: float = 120.0,
@@ -43,22 +95,40 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                 spares: int | None = None,
                 join: int | None = None,
                 grow_round: int | None = None,
-                die_at_promotion: int | None = None) -> list[WorkerResult]:
+                die_at_promotion: int | None = None,
+                device_heal_fail: bool = False,
+                _retry_left: int = 1) -> list[WorkerResult]:
     """Spawn ``n`` worker processes running ``task``; wait for all.
 
-    A worker that outlives ``timeout_s`` is killed and reported with
-    returncode -9 — the outcome the chaos soak asserts NEVER happens
+    ``timeout_s`` is ONE overall deadline for the whole fleet. A worker
+    that outlives it has its entire process group killed (children
+    included) and is reported with returncode -9 and its partial
+    stdout/stderr — the outcome the chaos soak asserts NEVER happens
     (the stack must convert every injected fault into success or a named
     clean abort before the harness loses patience).
 
     ``seed``/``rounds``/``size`` parameterize the chaos tasks (see
     ``mp_worker``); ``fault_rank`` picks the victim for ``fault`` and
     ``die-mid-collective``; ``kill_ranks``/``kill_ops`` (comma lists)
-    place the ``kill-and-heal`` task's deterministic op-space kills;
-    ``spares``/``join``/``grow_round``/``die_at_promotion`` shape its
-    elastic fleet (trailing process ids become warm spares, then grow
-    joiners admitted at ``grow_round``)."""
-    coordinator = f"127.0.0.1:{free_port()}"
+    place the ``kill-and-heal``/``kill-a-host`` tasks' deterministic
+    op-space kills; ``spares``/``join``/``grow_round``/
+    ``die_at_promotion`` shape the elastic fleet (trailing process ids
+    become warm spares, then grow joiners admitted at ``grow_round``);
+    ``device_heal_fail`` makes the ``kill-a-host`` task's device re-init
+    deterministically fail (the degraded-mode chaos case). Coordinator
+    ports are held reserved (:func:`reserve_port`) until the instant
+    before the spawn, and a run that still loses the bind race is
+    retried once with fresh ports."""
+    from rocnrdma_tpu.runtime.mp_worker import DEVICE_TASKS
+
+    port, res = reserve_port()
+    coordinator = f"127.0.0.1:{port}"
+    jax_port = jax_res = None
+    if task in DEVICE_TASKS:
+        # the device tasks run TWO coordination planes: the bootstrap
+        # store (host plane) and the jax coordination service (device
+        # plane) need separate ports
+        jax_port, jax_res = reserve_port()
     procs = []
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)          # workers get exactly 1 CPU device each
@@ -72,19 +142,36 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                       ("--die-at-promotion", die_at_promotion)):
         if val is not None:
             extra += [flag, str(val)]
+    if jax_port is not None:
+        extra += ["--jax-coordinator", f"127.0.0.1:{jax_port}"]
+    if device_heal_fail:
+        extra += ["--device-heal-fail"]
+    # release the reservations at the last instant: the spawned rank 0
+    # (and the re-elected device coordinator) bind these ports next
+    res.close()
+    if jax_res is not None:
+        jax_res.close()
+    deadline = time.monotonic() + timeout_s
     for i in range(n):
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "rocnrdma_tpu.runtime.mp_worker",
              "--coordinator", coordinator, "--num-processes", str(n),
              "--process-id", str(i), "--task", task] + extra,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env))
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, start_new_session=True))
     results = []
     for i, p in enumerate(procs):
         try:
-            out, err = p.communicate(timeout=timeout_s)
+            out, err = p.communicate(
+                timeout=max(0.1, deadline - time.monotonic()))
             results.append(WorkerResult(i, p.returncode, out, err))
         except subprocess.TimeoutExpired:
-            p.kill()
-            out, err = p.communicate()
-            results.append(WorkerResult(i, -9, out, err + "\n[HARNESS] timeout"))
+            out, err = _reap(p)
+            results.append(WorkerResult(i, -9, out or "",
+                                        (err or "") + "\n[HARNESS] timeout"))
+    if _retry_left > 0 and _bind_collision(results):
+        return run_workers(n, task, timeout_s, fault_rank, seed, rounds,
+                           size, kill_ranks, kill_ops, spares, join,
+                           grow_round, die_at_promotion, device_heal_fail,
+                           _retry_left=_retry_left - 1)
     return results

@@ -188,6 +188,24 @@ class BootstrapServer:
                         if prefix:
                             self._kv.pop(
                                 f"{prefix}{sub}/h/{int(sid)}", None)
+                # kv sweep: whole key prefixes a membership change
+                # obsoleted — the device-plane coordinator-election keys
+                # (pg/<group>/deviceheal/e<N>/coord) are epoch-qualified,
+                # so the heal that mints epoch N+1 sweeps every older
+                # election before ITS hook writes the new one; a
+                # long-lived sidecar store cannot accrete one dead
+                # coordinator handle per heal. Guarded to the caller's
+                # prefix: a prune may only sweep its own group's keys,
+                # and a prune that declares NO prefix may sweep none at
+                # all (an unprefixed request bypassing the guard would
+                # let any client of a shared store delete another
+                # group's live election).
+                for sub_prefix in req.get("kv", ()):
+                    if not (prefix and sub_prefix.startswith(prefix)):
+                        continue
+                    for k in [k for k in self._kv
+                              if k.startswith(sub_prefix)]:
+                        del self._kv[k]
                 for r in ranks:
                     self._last_seen.pop((scope, r), None)
                 if prefix:
@@ -352,7 +370,7 @@ class BootstrapClient:
             back.pause()
 
     def prune(self, ranks, prefix: str | None = None,
-              spares=(), joiners=()) -> None:
+              spares=(), joiners=(), kv=()) -> None:
         """Remove ``ranks``' liveness-table entries for this client's
         scope (and, with ``prefix``, their arrivals from every barrier
         key under it) — the epoch-bump cleanup ``ProcessGroup.heal``'s
@@ -363,10 +381,15 @@ class BootstrapClient:
         cleared too — a promoted-then-dead spare's orphaned ids must
         not read as a live candidate. The ``slot``/``admit`` keys
         stay: slots are consumed monotonically (the dense registry
-        scan depends on it) and the admit record is the burn mark."""
+        scan depends on it) and the admit record is the burn mark.
+        ``kv``: whole kv-key prefixes to sweep (each must start with
+        ``prefix`` — a group prunes only its own keys); the heal leader
+        passes the dead generations' device-plane coordinator-election
+        namespace (``{prefix}deviceheal/``) through this."""
         self._rpc(op="prune", ranks=sorted(int(r) for r in ranks),
                   prefix=prefix, spares=sorted(int(s) for s in spares),
-                  joiners=sorted(int(j) for j in joiners))
+                  joiners=sorted(int(j) for j in joiners),
+                  kv=sorted(kv))
 
     def heartbeat(self) -> None:
         """Stamp this rank's liveness without any other side effect (every

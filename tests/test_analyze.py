@@ -565,6 +565,63 @@ def test_deadlines_flags_elastic_verb_without_timeout(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# pass #0 extension (PR 7): the initialization surface — every
+# jax.distributed.initialize / init_runtime / reinit_runtime call site
+# states its deadline explicitly
+# ---------------------------------------------------------------------------
+
+
+def test_deadlines_flags_unbounded_init_call_sites(tmp_path):
+    bad = tmp_path / "boot.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        from rocnrdma_tpu.runtime.init import init_runtime, reinit_runtime
+
+        def start(addr):
+            jax.distributed.initialize(coordinator_address=addr)
+            init_runtime(coordinator=addr)
+
+        def heal(members, epoch, rank, agree):
+            reinit_runtime(members, epoch, rank, agree=agree)
+    """))
+    problems = deadlines.check_init_sites(str(bad))
+    assert len(problems) == 3, problems
+    assert any("jax.distributed.initialize" in p
+               and "initialization_timeout" in p for p in problems)
+    assert any("init_runtime call site" in p for p in problems)
+    assert any("reinit_runtime call site" in p for p in problems)
+
+
+def test_deadlines_accepts_bounded_init_call_sites(tmp_path):
+    good = tmp_path / "boot.py"
+    good.write_text(textwrap.dedent("""
+        import jax
+        from rocnrdma_tpu.runtime.init import init_runtime, reinit_runtime
+
+        def start(addr, timeout_s):
+            jax.distributed.initialize(coordinator_address=addr,
+                                       initialization_timeout=timeout_s)
+            init_runtime(coordinator=addr, timeout_s=timeout_s)
+
+        def heal(members, epoch, rank, agree, timeout_s):
+            reinit_runtime(members, epoch, rank, agree=agree,
+                           timeout_s=timeout_s)
+
+        def unrelated(thing):
+            thing.initialize()          # not jax.distributed: no finding
+    """))
+    assert deadlines.check_init_sites(str(good)) == []
+
+
+def test_deadlines_init_surface_is_package_wide():
+    """The rule scans the whole package: the runtime and bench modules
+    (where the bootstrap call sites actually live), not just the
+    transport stack."""
+    files = {os.path.basename(t) for t in deadlines.INIT_TARGETS}
+    assert {"init.py", "mp_worker.py", "cli_common.py"} <= files
+
+
+# ---------------------------------------------------------------------------
 # pass #3: resource leaks
 # ---------------------------------------------------------------------------
 

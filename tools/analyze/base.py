@@ -35,6 +35,18 @@ def transport_targets() -> list[str]:
         if f.endswith(".py"))
 
 
+def package_targets() -> list[str]:
+    """Every module of the ``rocnrdma_tpu`` package, repo-relative — the
+    wider surface for call-site rules that are not transport-stack-scoped
+    (the deadline pass's initialization-surface rule scans these)."""
+    out = []
+    for root, _dirs, files in os.walk(os.path.join(REPO, "rocnrdma_tpu")):
+        for f in files:
+            if f.endswith(".py"):
+                out.append(os.path.relpath(os.path.join(root, f), REPO))
+    return sorted(out)
+
+
 def read_source(path: str) -> str:
     full = path if os.path.isabs(path) else os.path.join(REPO, path)
     with open(full) as fp:

@@ -21,6 +21,16 @@ the ``ProcessGroup`` verbs in ``distributed.py`` — must accept
 ``timeout_s`` whether or not the loop is syntactically visible in them
 (most delegate the spin to a helper).
 
+RULE 4 (initialization surface, package-wide): every call site of
+``jax.distributed.initialize`` must carry ``initialization_timeout=``
+and every ``init_runtime``/``reinit_runtime`` call site must carry
+``timeout_s=``. These are the device-plane bootstrap waits — a call
+site silently inheriting a default deadline it never chose (300 s for
+stock jax) is exactly the unaudited wait that turns a dead coordinator
+into a wedged heal; the bound must be visible where the wait is
+incurred. This rule scans the whole ``rocnrdma_tpu`` package, not just
+the transport stack.
+
 Exceptions live in ``ALLOW`` with a reason; the tier-1 suite runs this
 pass as a test (``tests/test_check_deadlines.py`` via the
 ``tools/check_deadlines.py`` shim, and ``tests/test_analyze.py`` with the
@@ -62,6 +72,11 @@ PG_BLOCKING = {
     # wait on OTHER processes, the exact shape rule 3 exists for
     "grow", "wait_promotion",
 }
+
+
+# RULE 4's surface: the whole package (call sites of the device-plane
+# bootstrap live outside the transport stack — runtime/, bench/)
+INIT_TARGETS = base.package_targets()
 
 
 def _params(fn: ast.FunctionDef) -> set:
@@ -156,6 +171,35 @@ def check_file(path: str) -> list[str]:
     return problems
 
 
+def check_init_sites(path: str) -> list[str]:
+    """RULE 4: every ``jax.distributed.initialize`` call site carries
+    ``initialization_timeout=`` and every ``init_runtime``/
+    ``reinit_runtime`` call site carries ``timeout_s=`` — explicitly,
+    at the call, so the audit never has to chase a default through two
+    layers of signature."""
+    tree = base.parse_file(path)
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        kws = {k.arg for k in node.keywords}
+        if (isinstance(f, ast.Attribute) and f.attr == "initialize"
+                and isinstance(f.value, ast.Attribute)
+                and f.value.attr == "distributed"):
+            if "initialization_timeout" not in kws:
+                problems.append(
+                    f"{path}:{node.lineno}: jax.distributed.initialize "
+                    f"call site carries no initialization_timeout= "
+                    f"(the stock 300 s default is an unaudited wait)")
+        elif base.call_name(node) in ("init_runtime", "reinit_runtime"):
+            if "timeout_s" not in kws:
+                problems.append(
+                    f"{path}:{node.lineno}: {base.call_name(node)} call "
+                    f"site carries no explicit timeout_s=")
+    return problems
+
+
 SELFTEST_BAD = """
 def spin_forever(x):
     while True:
@@ -187,6 +231,8 @@ def run() -> list[str]:
     problems = []
     for path in TARGETS:
         problems += check_file(path)
+    for path in INIT_TARGETS:
+        problems += check_init_sites(path)
     for key in ALLOW:
         f, _, qn = key.partition("::")
         if not any(f == os.path.basename(t) for t in TARGETS):

@@ -1,0 +1,109 @@
+"""Record the kill-a-host chaos outcome as a benchable results/ artifact.
+
+Runs the two acceptance scenarios of the device-plane heal (DESIGN.md
+§5g) — the shrink run (victim killed, survivors heal both planes on the
+smaller world) and the warm-spare run (promotion keeps the world size)
+— and persists what the robustness trajectory is judged on: epochs
+reached, per-survivor device re-init latency, FENCED/RESUMED counters,
+and the replay digests (FAULTLOG/HEALLOG/DEVICEHEAL), so later PRs can
+be diffed against this PR's recovery behavior the same way BENCH_r*
+records pin throughput.
+
+    python -m tools.record_deviceheal [--out results/deviceheal_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rocnrdma_tpu.runtime.multiprocess import run_workers  # noqa: E402
+
+OUT = "results/deviceheal_r01.json"
+
+SCENARIOS = {
+    # the replay-equality acceptance seedings (tests/test_device_heal.py)
+    "shrink": dict(n=3, seed=11, rounds=4, kill_ranks="1", kill_ops="25",
+                   size=2048, spares=0),
+    "spare": dict(n=4, seed=13, rounds=4, kill_ranks="2", kill_ops="25",
+                  size=2048, spares=1),
+}
+
+
+def _line(result, key):
+    m = re.search(rf"^{key} (.+)$", result.stdout, re.M)
+    if not m:
+        raise SystemExit(
+            f"rank {result.process_id} (rc={result.returncode}) printed "
+            f"no {key} line:\n{result.stdout}\n{result.stderr}")
+    return m.group(1)
+
+
+def run_scenario(name: str, params: dict) -> dict:
+    n = params["n"]
+    victims = {int(r) for r in params["kill_ranks"].split(",")}
+    t0 = time.monotonic()
+    results = run_workers(n, "kill-a-host", timeout_s=240.0,
+                          seed=params["seed"], rounds=params["rounds"],
+                          kill_ranks=params["kill_ranks"],
+                          kill_ops=params["kill_ops"],
+                          size=params["size"],
+                          spares=params["spares"] or None)
+    wall_s = time.monotonic() - t0
+    out = {"params": params, "wall_s": round(wall_s, 2), "survivors": {}}
+    epochs, members = set(), set()
+    for r in results:
+        if r.process_id in victims:
+            if r.returncode != 7:
+                raise SystemExit(f"victim {r.process_id} exited "
+                                 f"{r.returncode}, not the kill's 7")
+            continue
+        if r.returncode != 0:
+            raise SystemExit(
+                f"{name}: rank {r.process_id} exited {r.returncode} — "
+                f"refusing to record a failed run:\n{r.stdout}\n{r.stderr}")
+        epochs.add(int(_line(r, "EPOCH")))
+        members.add(_line(r, "MEMBERS"))
+        out["survivors"][str(r.process_id)] = {
+            "reinit_ms": json.loads(_line(r, "DEVICEHEAL_MS")),
+            "fenced": int(_line(r, "FENCED")),
+            "resumed": int(_line(r, "RESUMED")),
+            "faultlog": _line(r, "FAULTLOG"),
+            "heallog": _line(r, "HEALLOG"),
+            "deviceheal": _line(r, "DEVICEHEAL"),
+        }
+    if len(epochs) != 1 or len(members) != 1:
+        raise SystemExit(f"{name}: survivors disagree "
+                         f"(epochs={epochs}, members={members})")
+    out["epoch"] = epochs.pop()
+    out["members"] = json.loads(members.pop())
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args(argv)
+    record = {"record": "deviceheal_r01", "task": "kill-a-host",
+              "scenarios": {}}
+    for name, params in SCENARIOS.items():
+        print(f"running {name} ...", flush=True)
+        record["scenarios"][name] = run_scenario(name, params)
+    path = args.out if os.path.isabs(args.out) else os.path.join(REPO,
+                                                                 args.out)
+    with open(path, "w") as fp:
+        json.dump(record, fp, indent=2)
+        fp.write("\n")
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
