@@ -148,6 +148,12 @@ def worker(args) -> int:
     from rocnrdma_tpu.metrics import VERBS, WIRE
 
     pg = dist.init_process_group(plane=args.plane)
+    # the fleet telemetry agent rides the watchdog heartbeat — ON for
+    # every bench fleet, the smoke runs included: the per-rank zero-copy
+    # gate below then doubles as proof that the agent adds nothing to
+    # the collective hot path (publishes are bounded store writes from
+    # the watchdog thread)
+    pg.start_watchdog()
     rng = np.random.default_rng(pg.rank)
     records = []
     for collective in args.collectives.split(","):
@@ -205,7 +211,19 @@ def worker(args) -> int:
             mine = trimmed_mean(spans)
             # a collective is as slow as its slowest rank
             sec = float(pg.all_reduce(np.array([mine]), op="max")[0])
+            # fleet snapshot, OFF the timed window: every rank flushes a
+            # final telemetry publish, the barrier orders them before
+            # the leader aggregates — the record then carries per-rank
+            # health and the bucket-exact merged verb histograms next to
+            # the windowed wire counters
+            pg.publish_telemetry()
+            pg.barrier()
             if pg.rank == 0:
+                fl = pg.fleet_stats()
+                fleet = {k: fl[k] for k in
+                         ("epoch", "health", "missing", "stale_dropped",
+                          "worst_p99_us", "verb_p50_us", "verb_p99_us",
+                          "verb_latency", "wire_totals")}
                 algo = ("ring_rdma" if args.transport == "rdma"
                         and collective in ("allreduce", "reducescatter",
                                            "allgather") else "ring")
@@ -219,7 +237,8 @@ def worker(args) -> int:
                     "bench_host", collective, algo, pg.world_size, actual,
                     "float32", sec, platform=f"host-{args.plane}",
                     counts=ragged, iters=args.iters, repeats=args.repeats,
-                    wire=wire, verb_lat=VERBS.delta(verb_base)))
+                    wire=wire, verb_lat=VERBS.delta(verb_base),
+                    fleet=fleet))
     pg.barrier()
     pg.destroy()
     if pg.rank == 0:

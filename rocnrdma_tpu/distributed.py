@@ -39,6 +39,7 @@ import numpy as np
 
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
+from rocnrdma_tpu.obs import fleet as _fleet
 from rocnrdma_tpu.transport import (
     HostQPNet,
     TCPNet,
@@ -246,6 +247,20 @@ class ProcessGroup:
         self._health_lock = threading.Lock()
         self._watchdog_failed = None
         self._dead: list[int] = []
+        # the fleet plane's coarse health state (obs.fleet.HEALTH_STATES)
+        # + the bounded transition log the telemetry snapshots carry.
+        # Writes happen at PROTOCOL points on the verb-calling thread
+        # (confirmed death, heal/grow entry/commit, admission), never on
+        # a timer — so the transition sequence is a pure function of the
+        # failure story and replays equal from a chaos seed (the FLEET
+        # digest contract). The watchdog thread only READS (to publish),
+        # under the same health lock.
+        self._health = "resuming" if standby is not None else "ok"
+        self._health_log: list = []
+        # the per-rank telemetry publisher: the watchdog thread calls
+        # publish() on its tick (piggybacking the liveness heartbeat);
+        # publish_telemetry()/fleet_stats() are the explicit entries
+        self._fleet_agent = _fleet.FleetAgent(self)
         self._p2p: dict[tuple, "plugin._RingWire"] = {}  # (peer, dir) -> wire
         # sequence counters are keyed by the peer's ORIGINAL rank (via
         # _pstate): a heal/grow renumbers peers but an unbroken pair's
@@ -418,6 +433,11 @@ class ProcessGroup:
             if time.monotonic() >= deadline:
                 raise exc
             back.pause()
+        # the verdict is in: a confirmed death moves health to degraded
+        # BEFORE the heal flips it to healing — the same transition (and
+        # the same cause string) whether _check_alive or this triage saw
+        # it first, so the fleet transition sequence replays equal
+        self._set_health("degraded", cause="peer-dead")
         self.heal(timeout_s=timeout_s, _suspects=suspects)
 
     def all_reduce(self, x, op: str = "sum", transport: str = "msg",
@@ -1533,6 +1553,10 @@ class ProcessGroup:
                            error=type(e).__name__)
             if not isinstance(e, Exception):
                 raise  # KeyboardInterrupt/SystemExit are not heal failures
+            # the host plane is healthy but the device plane is down:
+            # the fleet view must say so until the next successful
+            # membership change (or hook run) flips it back
+            self._set_health("degraded", cause="device-heal-failed")
             raise RuntimeError(
                 f"device-plane heal failed on epoch {self.epoch} of "
                 f"group {self.group_name!r} (host plane healthy; members "
@@ -1639,6 +1663,8 @@ class ProcessGroup:
         epoch = self.epoch + 1
         g = self._ranks[self.rank]
         ns = f"pg/{self.group_name}/heal/e{epoch}"
+        t_span = time.perf_counter()
+        self._set_health("healing")
         _FLIGHT.record("heal-start", epoch=epoch, rank=g)
         with self._health_lock:
             wd_dead = list(self._dead)
@@ -1659,6 +1685,7 @@ class ProcessGroup:
             # the world
             _FLIGHT.record("heal-abort", epoch=epoch,
                            error=type(e).__name__)
+            self._set_health("degraded", cause="heal-failed")
             if was_watching is not None:
                 self.start_watchdog(*was_watching)
             raise
@@ -1669,6 +1696,14 @@ class ProcessGroup:
         # instead of burning another host heal) with the host plane
         # still serving.
         self._run_device_heal(members)
+        # the membership-track span (obs.chrome renders member-* kinds
+        # with dur as slices): heal entry -> committed membership, with
+        # the epoch bump in the args. Deliberately OUTSIDE the heal-
+        # digest prefix — dur is wall time and must never enter a
+        # replay-equality contract.
+        _FLIGHT.record("member-heal", epoch=epoch, world=len(members),
+                       dur=time.perf_counter() - t_span)
+        self._set_health("ok")
         return members
 
     def _heal_protocol(self, grace_s, epoch, g, ns, suspects,
@@ -1806,11 +1841,20 @@ class ProcessGroup:
                 # clears the wired barrier, racing this sweep (a whole-
                 # namespace sweep here deleted its proposal and wedged
                 # every other member's blocking agree)
+                # the kv sweep also drops the dead generations' fleet
+                # telemetry snapshots (pg/<g>/fleet/e<k>/ — same
+                # strictly-below-the-minted-epoch rule: the new epoch's
+                # publishes must survive the sweep), so healed-away
+                # generations don't leak snapshot keys on a long-lived
+                # sidecar store
                 self._client.prune(range(new_world, old_world),
                                    prefix=f"pg/{self.group_name}/",
                                    spares=promoted_slots.values(),
                                    kv=tuple(
                                        f"pg/{self.group_name}/deviceheal/e{k}/"
+                                       for k in range(epoch))
+                                   + tuple(
+                                       f"pg/{self.group_name}/fleet/e{k}/"
                                        for k in range(epoch)))
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness: stale ids age out of use
@@ -2065,6 +2109,8 @@ class ProcessGroup:
         self._grow_no += 1
         g = self._ranks[self.rank]
         ns = f"pg/{self.group_name}/grow/g{self._grow_no}"
+        t_span = time.perf_counter()
+        self._set_health("healing")
         _FLIGHT.record("grow-start", epoch=epoch, rank=g)
         was_watching = self._watchdog_params
         self.stop_watchdog()
@@ -2076,6 +2122,7 @@ class ProcessGroup:
             # off (the heal discipline): re-arm before propagating
             _FLIGHT.record("grow-abort", epoch=epoch,
                            error=type(e).__name__)
+            self._set_health("degraded", cause="grow-failed")
             if was_watching is not None:
                 self.start_watchdog(*was_watching)
             raise
@@ -2085,6 +2132,12 @@ class ProcessGroup:
             # the widened membership restarts the device plane too —
             # same failure contract as heal's hook
             self._run_device_heal(members)
+        # the membership-track span (see heal's member-heal twin): grow
+        # entry -> widened membership, outside every digest prefix
+        _FLIGHT.record("member-grow", epoch=self.epoch,
+                       world=len(members),
+                       dur=time.perf_counter() - t_span)
+        self._set_health("ok")
         return members
 
     def _grow_protocol(self, epoch, g, ns, remaining,
@@ -2187,6 +2240,9 @@ class ProcessGroup:
                                    joiners=joined.values(),
                                    kv=tuple(
                                        f"pg/{self.group_name}/deviceheal/e{k}/"
+                                       for k in range(epoch))
+                                   + tuple(
+                                       f"pg/{self.group_name}/fleet/e{k}/"
                                        for k in range(epoch)))
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness
@@ -2280,6 +2336,10 @@ class ProcessGroup:
         deadline = time.monotonic() + timeout_s
         back = poll_backoff()
         kind = self._standby
+        t_span = time.perf_counter()
+        self._set_health("resuming")  # no-op for a fresh standby; a
+        #                               re-entered wait after an aborted
+        #                               admission transitions back
         try:
             while True:
                 val = self._client.try_get(admit_key)
@@ -2305,6 +2365,7 @@ class ProcessGroup:
             # joined" starts here
             _FLIGHT.record("promote-abort", role=kind, sid=self._sid,
                            error=type(e).__name__)
+            self._set_health("degraded", cause="promotion-failed")
             raise
         if kind == "spare":
             _WIRE.promoted()
@@ -2317,6 +2378,12 @@ class ProcessGroup:
         # own hooks run at the end of their heal/grow). Raises named on
         # failure with the host-plane admission already complete.
         self._run_device_heal(self._ranks)
+        # the membership-track span: admission wait -> full membership
+        # (outside the promote- digest prefix — dur is wall time)
+        _FLIGHT.record("member-promotion", epoch=self.epoch, role=kind,
+                       world=self.world_size,
+                       dur=time.perf_counter() - t_span)
+        self._set_health("ok")
         return list(self._ranks)
 
     def _complete_admission(self, info: dict) -> None:
@@ -2401,6 +2468,95 @@ class ProcessGroup:
         collectives (admission clears it)."""
         return self._standby is not None
 
+    # -- fleet telemetry (the cross-rank counter plane, obs.fleet) ----------
+
+    def _set_health(self, state: str, **why) -> None:
+        """Move the fleet-plane health state (``ok|degraded|healing|
+        resuming``); a no-op when unchanged, else the transition is
+        appended to the bounded log the telemetry snapshots carry and
+        recorded as a ``fleet-health`` flight event (with the epoch —
+        the args are membership/epoch data only, so the event sequence
+        is digestable for replay equality)."""
+        with self._health_lock:
+            prev = self._health
+            if prev == state:
+                return
+            self._health = state
+            self._health_log.append([prev, state, self.epoch])
+            if len(self._health_log) > 16:
+                del self._health_log[0]
+        _FLIGHT.record("fleet-health", prev=prev, state=state,
+                       epoch=self.epoch, **why)
+
+    def health(self) -> str:
+        """This rank's coarse fleet-plane health state."""
+        with self._health_lock:
+            return self._health
+
+    def health_transitions(self) -> list:
+        """The recent health transitions, oldest first, as
+        ``[prev, state, epoch]`` triples (bounded — the last 16)."""
+        with self._health_lock:
+            return [list(t) for t in self._health_log]
+
+    def publish_telemetry(self, timeout_s: float = 2.0) -> bool:
+        """ONE explicit, bounded, best-effort publish of this rank's
+        telemetry snapshot to the store (the watchdog tick does this
+        automatically while running; harnesses and benches call this to
+        flush a final snapshot before the leader aggregates). Returns
+        False — never raises — when the store write failed or this rank
+        has nothing to publish from (standby, no store)."""
+        if self._client is None or self._standby is not None \
+                or self._destroyed:
+            return False
+        return self._fleet_agent.publish(self._client, timeout_s=timeout_s)
+
+    def fleet_stats(self, timeout_s: float = 5.0) -> dict:
+        """The LIVE fleet snapshot: this rank's fresh local telemetry
+        merged with every other member's latest published snapshot from
+        the store (``obs.fleet.aggregate`` — wire counters summed
+        field-wise, verb latency histograms added bucket-wise so the
+        merged P50/P99 are bucket-exact, per-rank health and windowed
+        throughput alongside). Any member may call it; the natural
+        caller is the leader (or an operator via the
+        ``python -m rocnrdma_tpu.obs.fleet`` CLI, which reads the same
+        keys without being a member).
+
+        Epoch fencing: only this generation's keys are read, and a
+        payload stamped with another epoch is dropped and counted
+        (``stale_dropped``) — stale-generation telemetry can no more
+        reach a fleet view than a stale frame can reach a reduction.
+        Reads are bounded by ``timeout_s`` overall — each fetch gets
+        the REMAINING budget (reply wait included, via ``try_get``'s
+        whole-call bound), so a rank whose snapshot cannot be fetched
+        in time is reported ``missing``, not waited for; nothing here
+        touches the collective hot path."""
+        if self._standby is not None:
+            raise RuntimeError(
+                "fleet_stats: this rank is a standby (promotion pending); "
+                "it has no membership to aggregate over")
+        snaps: list = [self._fleet_agent.local_snapshot()]
+        if self._client is not None:
+            deadline = time.monotonic() + timeout_s
+            me = self._ranks[self.rank] if self._ranks else -1
+            for g in self._ranks:
+                if g == me or time.monotonic() >= deadline:
+                    continue
+                try:
+                    raw = self._client.try_get(
+                        _fleet.snapshot_key(self.group_name, self.epoch, g),
+                        timeout_s=deadline - time.monotonic())
+                except (OSError, TimeoutError):
+                    raw = None  # reported as missing, never waited for
+                if raw is not None:
+                    import json
+                    try:
+                        snaps.append(json.loads(raw))
+                    except ValueError:
+                        pass  # a torn write reads as missing
+        return _fleet.aggregate(snaps, epoch=self.epoch,
+                                members=list(self._ranks))
+
     # -- watchdog (the ProcessGroupNCCL watchdog / RCCL heartbeat analogue) --
 
     def start_watchdog(self, interval_s: float = 1.0,
@@ -2443,9 +2599,16 @@ class ProcessGroup:
             client = None
             try:
                 # same liveness scope as the group's main client, so the
-                # watchdog's RPCs stamp THIS group's table
+                # watchdog's RPCs stamp THIS group's table. The client's
+                # OWN timeout bounds every round-trip (recv included) to
+                # about one detection window: a merely-SLOW store must
+                # cost this thread a bounded tick — heartbeat and
+                # telemetry publish alike — never a default 30 s stall
+                # that lands our beat after the neighbour's death grace
+                # (the loop absorbs the TimeoutError and keeps ticking)
                 client = bootstrap.BootstrapClient(
                     self._store_handle, self.rank,
+                    timeout_s=interval_s + timeout_s,
                     scope=f"pg/{self.group_name}/ring")
                 beat = 0
                 seen: dict[int, tuple] = {}  # target -> (value, stamp)
@@ -2458,6 +2621,13 @@ class ProcessGroup:
                     except TimeoutError:
                         return None
 
+                publish_budget = min(1.0, max(0.1, float(interval_s)))
+                # telemetry cadence: at most one publish per second (or
+                # per tick when the interval is slower) — fast-ticking
+                # chaos watchdogs (0.3 s) must not double the store
+                # traffic of every tick for a feed nobody reads at 3 Hz
+                publish_every = max(float(interval_s), 1.0)
+                last_publish = 0.0
                 while not self._watchdog_stop.is_set():
                     beat += 1
                     try:
@@ -2494,6 +2664,17 @@ class ProcessGroup:
                                 client.set(f"{ns}/dead/{target}", "1")
                                 client.set(f"{ns}/dead_v",
                                            f"{self.rank}:{beat}")
+                        # the fleet telemetry snapshot piggybacks the
+                        # heartbeat — AFTER the beat and the death scan
+                        # (telemetry is best-effort; the beat is the
+                        # failure detector's signal and must land
+                        # first), bounded, rate-limited, absorbed-on-
+                        # failure inside publish()
+                        t_pub = time.monotonic()
+                        if t_pub - last_publish >= publish_every:
+                            last_publish = t_pub
+                            self._fleet_agent.publish(
+                                client, timeout_s=publish_budget)
                     except TimeoutError:
                         pass  # one slow store RPC: keep ticking, not die
                     self._watchdog_stop.wait(interval_s)
@@ -2529,6 +2710,7 @@ class ProcessGroup:
         # the epoch fence dropped), and how many heals got it here
         s["epoch"] = self.epoch
         s["heals"] = self._heals
+        s["health"] = self.health()  # the fleet plane's coarse state
         return s
 
     def dead_ranks(self) -> list:
@@ -2569,11 +2751,13 @@ class ProcessGroup:
         with self._health_lock:
             failed, dead = self._watchdog_failed, list(self._dead)
         if failed:
+            self._set_health("degraded", cause="watchdog-died")
             raise RuntimeError(
                 f"watchdog thread died ({failed}); failure "
                 f"detection is OFF for group {self.group_name!r} — "
                 f"start_watchdog() again or destroy")
         if dead:
+            self._set_health("degraded", cause="peer-dead")
             # the watchdog fired: dump this survivor's flight tail (what
             # the wire was doing when the peer went silent) before the
             # verb refuses — the other postmortem trigger point besides

@@ -135,6 +135,9 @@ class WireCounters:
     """
 
     payload_bytes_copied: int = 0   # bytes staged through an extra copy
+    payload_bytes_streamed: int = 0 # bytes landed/combined with NO staging
+    #                                 copy (the numerator of the fleet
+    #                                 plane's aggregate-throughput gauge)
     frames_streamed: int = 0        # frames landed/combined in place
     frames_copied: int = 0          # frames that took a staging copy
     frames_overlapped: int = 0      # streamed frames that beat the consumer
@@ -162,10 +165,14 @@ class WireCounters:
             self.payload_bytes_copied += nbytes
             self.frames_copied += frames
 
-    def streamed(self, frames: int = 1) -> None:
-        """Record frames landed/combined in place (the zero-copy path)."""
+    def streamed(self, frames: int = 1, nbytes: int = 0) -> None:
+        """Record frames landed/combined in place (the zero-copy path);
+        ``nbytes`` is the payload so delivered — the fleet telemetry
+        plane's throughput gauge divides its window delta by the window
+        seconds to estimate live per-rank wire bandwidth."""
         with self._lock:
             self.frames_streamed += frames
+            self.payload_bytes_streamed += nbytes
 
     def overlapped(self, frames: int = 1) -> None:
         """Record streamed frames whose transfer beat the consume loop."""
@@ -225,6 +232,23 @@ class WireCounters:
         window the bench attaches to its records)."""
         return {k: v - since.get(k, 0) for k, v in self.snapshot().items()}
 
+    @staticmethod
+    def merge(snapshots) -> dict:
+        """Cross-rank merge of ``snapshot()``/``delta()`` dicts: exact
+        field-wise integer addition (every field is a count of disjoint
+        per-rank events, so the fleet total IS the sum — no averaging,
+        no loss). The fleet aggregator (``obs.fleet``) merges the live
+        ranks' published snapshots through this; it is equally usable
+        standalone on bench-record ``wire`` dicts in post-processing.
+        Unknown keys are summed too, so a snapshot from a newer rank
+        with an extra counter merges rather than raises."""
+        out: dict = {}
+        for s in snapshots:
+            for k, v in s.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[k] = out.get(k, 0) + v
+        return out
+
     def overlap_ratio(self, since: dict | None = None) -> float:
         """Fraction of streamed frames whose transfer fully overlapped the
         consumption of earlier frames (0.0 with nothing streamed).
@@ -247,6 +271,7 @@ class WireCounters:
     def reset(self) -> None:
         with self._lock:
             self.payload_bytes_copied = 0
+            self.payload_bytes_streamed = 0
             self.frames_streamed = 0
             self.frames_copied = 0
             self.frames_overlapped = 0
@@ -347,9 +372,61 @@ class VerbLatencies:
                          "buckets": buckets}
         return out
 
+    @staticmethod
+    def merge(snapshots) -> dict:
+        """Cross-rank merge of ``snapshot()``/``delta()`` dicts:
+        bucket-wise histogram ADDITION, which is exact — log2 buckets
+        are identical on every rank (same exponent grid, same labels),
+        so summing the per-rank counts of a bucket yields precisely the
+        histogram a single recorder observing all ranks' verbs would
+        hold. Counts and total_s sum; mean_us is recomputed from the
+        merged totals. This is what makes fleet-level P50/P99 honest:
+        percentiles are read off the MERGED buckets
+        (:func:`bucket_percentile_us`), never averaged across ranks."""
+        out: dict = {}
+        for s in snapshots:
+            for verb, v in s.items():
+                m = out.setdefault(verb, {"count": 0, "total_s": 0.0,
+                                          "buckets": {}})
+                m["count"] += v.get("count", 0)
+                m["total_s"] += v.get("total_s", 0.0)
+                for lbl, n in v.get("buckets", {}).items():
+                    m["buckets"][lbl] = m["buckets"].get(lbl, 0) + n
+        for m in out.values():
+            m["mean_us"] = (m["total_s"] / m["count"] * 1e6
+                            if m["count"] else 0.0)
+            m["buckets"] = dict(sorted(
+                m["buckets"].items(), key=lambda kv: _bucket_us(kv[0])))
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self._verbs = {}
+
+
+def _bucket_us(label: str) -> int:
+    """The microsecond upper bound a ``"<=Nus"`` histogram label names."""
+    return int(label[2:-2])
+
+
+def bucket_percentile_us(buckets: dict, q: float) -> int:
+    """The ``q``-quantile (0 < q <= 1) of a log2 latency histogram, as
+    the microsecond UPPER BOUND of the bucket the quantile falls in —
+    the resolution the histogram actually has (claiming finer would be
+    invented precision). Works on per-rank and merged buckets alike;
+    0 for an empty histogram."""
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"quantile must be in (0, 1], got {q}")
+    total = sum(buckets.values())
+    if total <= 0:
+        return 0
+    want = q * total
+    seen = 0
+    for lbl, n in sorted(buckets.items(), key=lambda kv: _bucket_us(kv[0])):
+        seen += n
+        if seen >= want:
+            return _bucket_us(lbl)
+    raise AssertionError("unreachable: seen reaches total >= q*total")
 
 
 # THE process-wide per-verb latency histograms (same one-per-rank-process
@@ -509,16 +586,22 @@ def format_table(records: list) -> str:
     correctness-oracle row (CPU fake devices timesharing one core) prints
     indistinguishable from a performance row, and a reader quotes an
     oracle's "bandwidth" as a measurement (the row-level tier field
-    exists for exactly this — VERDICT r4 weak #7)."""
+    exists for exactly this — VERDICT r4 weak #7). ``wp99(us)`` is the
+    WORST-RANK verb-latency P99 from the record's attached fleet
+    snapshot (``extra["fleet"]["worst_p99_us"]``): a mean-looking row
+    can hide one rank's tail, and the slowest rank is what a collective
+    actually waits on; ``-`` for records with no fleet telemetry."""
     hdr = (f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} "
            f"{'dtype':>9} {'tier':>18} {'time(us)':>12} "
-           f"{'algbw GB/s':>11} {'busbw GB/s':>11}")
+           f"{'algbw GB/s':>11} {'busbw GB/s':>11} {'wp99(us)':>9}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
+        wp99 = r.extra.get("fleet", {}).get("worst_p99_us")
         lines.append(
             f"{r.collective:>13} {r.algo:>12} {r.n_ranks:>5} {r.size_bytes:>14} "
             f"{r.dtype:>9} {r.tier:>18} {r.mean_s * 1e6:>12.1f} "
-            f"{r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f}"
+            f"{r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f} "
+            f"{wp99 if wp99 is not None else '-':>9}"
         )
     return "\n".join(lines)
 

@@ -15,7 +15,15 @@ fault ``FaultNet`` injects), plus:
   naming the stalled hop/frame/peer instead of a bare timeout;
 - :mod:`rocnrdma_tpu.obs.chrome` — per-rank serialization and a
   multi-rank merger emitting one clock-aligned Chrome-trace JSON
-  (Perfetto-loadable), the host twin of ``trace.py``'s device lanes.
+  (Perfetto-loadable), the host twin of ``trace.py``'s device lanes —
+  including the ``membership`` track (epoch bumps, heal/grow/promotion
+  spans, device-heal restart phases, fleet-health transitions);
+- :mod:`rocnrdma_tpu.obs.fleet` — the FLEET telemetry plane: a per-rank
+  agent piggybacking windowed counter snapshots onto the liveness
+  heartbeat via epoch-qualified store keys, a leader-side aggregator
+  merging them (bucket-exact cross-rank verb P50/P99, per-rank health),
+  exposed as ``ProcessGroup.fleet_stats()`` and the
+  ``python -m rocnrdma_tpu.obs.fleet`` CLI (``--watch`` for live).
 
 ``FLIGHT`` is THE process-wide recorder instance (one per rank process,
 like ``metrics.WIRE``); producers import it, consumers snapshot it.

@@ -15,12 +15,16 @@ residual skew is bounded by that poll period, ~1-20 ms, documented in
 DESIGN.md's observability section). The merger shifts each rank's
 timeline so its sync mark sits at a common origin.
 
-Lane layout: one Perfetto *process* per rank (``pid = rank``), three
+Lane layout: one Perfetto *process* per rank (``pid = rank``), four
 threads inside it — ``verbs`` (net-vtable entry/completion spans),
 ``frames`` (ring-wire frame lifecycle slices, one per streamed frame),
-``control`` (bootstrap retries, faults, stalls, sync marks). Events
-whose args carry ``dur`` (seconds) render as complete slices (``ph:X``)
-spanning post→completion; everything else is an instant.
+``control`` (bootstrap retries, faults, stalls, sync marks), and
+``membership`` (the unified host+device recovery timeline: epoch bumps
+and heal/grow/promotion protocol events, ``member-*`` spans for the
+heal/grow/promotion wall time and the device-plane ``reinit_runtime``
+phases, ``fleet-health`` transitions). Events whose args carry ``dur``
+(seconds) render as complete slices (``ph:X``) spanning
+post→completion; everything else is an instant.
 
 CLI::
 
@@ -40,8 +44,16 @@ from rocnrdma_tpu.obs.recorder import FLIGHT, FlightRecorder
 # kind prefixes -> lane (tid). Unlisted kinds land in "control".
 _FRAME_KINDS = ("frame-", "stream-", "credit-", "lg-credit")
 _VERB_PREFIXES = ("isend", "irecv", "iwrite", "iread", "connect", "accept")
+# the membership track: epoch bumps and group-shape changes — heal/grow
+# protocol events, spare/joiner admission, device-plane restarts, and
+# the fleet plane's health transitions. The member-* kinds carry ``dur``
+# (heal/grow/promotion wall spans, reinit_runtime's shutdown → election
+# → reinit → reprobe phases) and render as slices ALIGNED against the
+# frame lane — the one unified host+device timeline.
+_MEMBER_PREFIXES = ("member-", "heal-", "grow-", "promote-", "standby-",
+                    "deviceheal-", "fleet-health")
 
-_LANES = {"verbs": 0, "frames": 1, "control": 2}
+_LANES = {"verbs": 0, "frames": 1, "control": 2, "membership": 3}
 
 
 def _lane(kind: str) -> int:
@@ -49,6 +61,8 @@ def _lane(kind: str) -> int:
         return _LANES["frames"]
     if kind.startswith(_VERB_PREFIXES):
         return _LANES["verbs"]
+    if kind.startswith(_MEMBER_PREFIXES):
+        return _LANES["membership"]
     return _LANES["control"]
 
 
@@ -163,6 +177,17 @@ def frame_slices(merged: dict, rank: int) -> list:
     return [e for e in merged["traceEvents"]
             if e.get("pid") == rank and e.get("ph") == "X"
             and e.get("name") in ("frame-landed", "frame-combined")]
+
+
+def membership_events(merged: dict, rank: int) -> list:
+    """One rank's membership-track events (heal/grow/promotion protocol
+    instants, ``member-*`` spans, ``fleet-health`` transitions) — the
+    lane the kill-and-heal acceptance reads the recovery story from,
+    aligned against the same rank's frame slices."""
+    tid = _LANES["membership"]
+    return [e for e in merged["traceEvents"]
+            if e.get("pid") == rank and e.get("tid") == tid
+            and e.get("ph") in ("X", "i")]
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,390 @@
+"""Fleet telemetry plane — live cross-rank aggregation over the store.
+
+PR 4's flight recorder and the heal/grow machinery left rich PER-RANK
+observability (``metrics.WIRE``/``VERBS``, the event ring, the liveness
+table) with no fleet-level view: the self-tuning wire needs a live
+measure feed from EVERY rank, multi-tenant lanes need per-channel fleet
+metrics, and an operator watching a healing job needs one screen, not N
+stderr streams. This module is that layer:
+
+- :class:`FleetAgent` — the per-rank publisher. It piggybacks a windowed
+  telemetry snapshot (wire counters + delta, verb-latency histograms,
+  flight-ring high-water mark, coarse health state, recent health
+  transitions) onto the existing liveness heartbeat: the watchdog thread
+  calls :meth:`FleetAgent.publish` each tick, writing ONE epoch-qualified
+  store key (``pg/<group>/fleet/e<epoch>/<orig>``) plus a tiny ``meta``
+  pointer. Publishes are strictly best-effort and bounded — an explicit
+  ``timeout_s`` on every store write, NO retry loop, failures recorded
+  as ``telemetry-abort`` flight events and absorbed (a telemetry stall
+  must never stall a heartbeat, let alone a collective; the analyzer's
+  telemetry rule in ``tools/analyze/obs.py`` pins exactly this shape).
+
+- :func:`aggregate` — the leader-side merger. Snapshots are epoch-tagged
+  and FENCED like wire frames: a payload stamped with another generation
+  is dropped and counted (``stale_dropped``, plus a ``telemetry-fenced``
+  flight event), never merged into a post-heal view. Live snapshots
+  merge exactly: wire counters by field-wise addition
+  (``WireCounters.merge``), verb latencies by bucket-wise histogram
+  addition (``VerbLatencies.merge`` — log2 buckets share one exponent
+  grid, so the merged P50/P99 read off ``bucket_percentile_us`` equal
+  what one recorder observing every rank would report), throughput by
+  summing each rank's own windowed streamed-bytes rate.
+
+- the CLI — ``python -m rocnrdma_tpu.obs.fleet --store host:port`` reads
+  the group's telemetry namespace once and prints the fleet table;
+  ``--watch SECS`` refreshes it live. The CLI is a pure observer: a
+  rank-less store client, reads only.
+
+Staleness/overhead model (DESIGN.md §6c): one publish is one bounded
+store ``set`` of a few KB from the watchdog thread; the freshest view
+lags by at most one watchdog interval per rank; a heal's leader prune
+sweeps dead generations' ``fleet/e<k>/`` keys so long-lived stores never
+accrete snapshot keys (``transport.bootstrap``'s generic prefixed kv
+sweep).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+from rocnrdma_tpu.metrics import (
+    VERBS as _VERBS,
+    WIRE as _WIRE,
+    VerbLatencies,
+    WireCounters,
+    bucket_percentile_us,
+)
+from rocnrdma_tpu.obs.recorder import FLIGHT as _FLIGHT
+
+# the coarse per-rank health states the fleet plane reports. Transitions
+# are recorded by ProcessGroup._set_health at protocol points (confirmed
+# death -> degraded, heal/grow entry -> healing, standby admission wait
+# -> resuming, committed membership -> ok), never sampled from timers —
+# so the transition SEQUENCE is a pure function of the failure story and
+# replays equal from a chaos seed (the FLEET digest contract).
+HEALTH_STATES = ("ok", "degraded", "healing", "resuming")
+
+# counters whose fleet totals are deterministic per chaos seed (what the
+# FLEET digest may hash): fence/resume counts are data-flow-determined,
+# grows/promotions are membership events. Stream/copy/overlap counts are
+# wall-clock-shaped (how many frames landed before an abort's timeout
+# fired) and stay OUT of any replay-equality contract.
+DETERMINISTIC_COUNTERS = ("frames_fenced", "frames_resumed", "grows",
+                          "promotions")
+
+
+def _ns(group: str) -> str:
+    return f"pg/{group}/fleet"
+
+
+def snapshot_key(group: str, epoch: int, orig: int) -> str:
+    """The one store key rank ``orig`` publishes under in ``epoch`` —
+    epoch-qualified exactly like the heartbeat/heal namespaces, so a
+    healed-away generation's telemetry is unreachable by construction
+    (and sweepable by prefix: ``pg/<group>/fleet/e<k>/``)."""
+    return f"{_ns(group)}/e{epoch}/{orig}"
+
+
+def meta_key(group: str) -> str:
+    """The discovery pointer the CLI reads first: current epoch +
+    member list, re-written by every publish (last writer wins; every
+    member of one generation writes the same value)."""
+    return f"{_ns(group)}/meta"
+
+
+class FleetAgent:
+    """Per-rank telemetry publisher riding the liveness heartbeat.
+
+    Owns the window state (last-published counter snapshots + stamp) so
+    each publish carries both CUMULATIVE counters (exact cross-rank
+    merging) and the DELTA over its own window (live rates). All state
+    is behind one lock: the watchdog thread publishes on its tick while
+    the main thread may publish explicitly (``publish_telemetry``) or
+    read a fresh local snapshot for ``fleet_stats``.
+    """
+
+    def __init__(self, pg):
+        self._pg = pg
+        self._lock = threading.Lock()
+        self._last_wire: dict | None = None
+        self._last_t: float | None = None
+        self._seq = 0
+
+    def local_snapshot(self) -> dict:
+        """This rank's telemetry payload, as the aggregator consumes it
+        (plain JSON-serializable data). Cheap: two counter snapshots and
+        a ring high-water read — no store traffic, no event scan."""
+        pg = self._pg
+        now = time.monotonic()
+        wire = _WIRE.snapshot()
+        with self._lock:
+            seq = self._seq
+            window_s = (now - self._last_t
+                        if self._last_t is not None else 0.0)
+            delta = ({k: v - self._last_wire.get(k, 0)
+                      for k, v in wire.items()}
+                     if self._last_wire is not None else dict(wire))
+        orig = pg.global_ranks[pg.rank] if pg.global_ranks else -1
+        return {
+            "v": 1,
+            "rank": pg.rank,
+            "orig": orig,
+            "epoch": pg.epoch,
+            "seq": seq,
+            "plane": pg.plane,
+            "health": pg.health(),
+            "transitions": pg.health_transitions(),
+            "heals": pg.heals,
+            "window_s": round(window_s, 6),
+            "wire": wire,
+            "wire_delta": delta,
+            "verb_latency": _VERBS.snapshot(),
+            "flight": {"recorded": _FLIGHT.recorded(),
+                       "capacity": _FLIGHT.capacity},
+        }
+
+    def publish(self, client, timeout_s: float = 1.0) -> bool:
+        """ONE bounded, best-effort publish of this rank's snapshot.
+
+        The contract the analyzer's telemetry rule enforces on this
+        file: every store write carries an explicit ``timeout_s`` (the
+        retry budget — one healthy round-trip, no reconnect loop past
+        the bound) and a failure leaves a ``telemetry-abort`` flight
+        event and returns False. Callers (the watchdog tick, the
+        explicit ``publish_telemetry``) absorb that False: telemetry is
+        an observer, never a participant."""
+        snap = self.local_snapshot()
+        pg = self._pg
+        meta = json.dumps({"epoch": pg.epoch, "members": pg.global_ranks,
+                           "world": pg.world_size, "group": pg.group_name})
+        try:
+            client.set(snapshot_key(pg.group_name, snap["epoch"],
+                                    snap["orig"]),
+                       json.dumps(snap), timeout_s=timeout_s)
+            client.set(meta_key(pg.group_name), meta, timeout_s=timeout_s)
+        except (OSError, TimeoutError) as e:
+            _FLIGHT.record("telemetry-abort", epoch=snap["epoch"],
+                           error=type(e).__name__)
+            return False
+        now = time.monotonic()
+        with self._lock:
+            self._seq += 1
+            self._last_wire = snap["wire"]
+            self._last_t = now
+        return True
+
+
+def aggregate(snapshots, epoch: int, members: list) -> dict:
+    """Merge per-rank telemetry payloads into ONE fleet snapshot.
+
+    ``snapshots``: parsed payload dicts (``None`` entries skipped —
+    missing ranks are reported, not invented). ``epoch``/``members``:
+    the generation the caller believes current; any payload stamped
+    with a DIFFERENT epoch is fenced — dropped, counted in
+    ``stale_dropped``, and left on the flight timeline as a
+    ``telemetry-fenced`` event — exactly the frame fence's contract
+    applied to telemetry (a pre-heal rank's counters must never blend
+    into a post-heal fleet view).
+
+    The merged verb P50/P99 are bucket-exact: log2 histograms add
+    bucket-wise (`VerbLatencies.merge`), and the percentile is read off
+    the merged buckets, so it equals the percentile a single observer
+    of all ranks' verbs would report (at bucket resolution)."""
+    live: dict[int, dict] = {}
+    stale = 0
+    for s in snapshots:
+        if s is None:
+            continue
+        if s.get("epoch") != epoch or s.get("orig") not in members:
+            stale += 1
+            _FLIGHT.record("telemetry-fenced", epoch=epoch,
+                           got=s.get("epoch"), orig=s.get("orig"))
+            continue
+        cur = live.get(s["orig"])
+        if cur is None or s.get("seq", 0) >= cur.get("seq", 0):
+            live[s["orig"]] = s
+    wire_totals = WireCounters.merge([s["wire"] for s in live.values()])
+    verb_merged = VerbLatencies.merge(
+        [s["verb_latency"] for s in live.values()])
+    p50 = {v: bucket_percentile_us(m["buckets"], 0.50)
+           for v, m in verb_merged.items()}
+    p99 = {v: bucket_percentile_us(m["buckets"], 0.99)
+           for v, m in verb_merged.items()}
+    plane_GBps: dict[str, float] = {}
+    ranks: dict[str, dict] = {}
+    worst_p99 = 0
+    for orig in sorted(live):
+        s = live[orig]
+        win = s.get("window_s") or 0.0
+        rate = (s.get("wire_delta", {}).get("payload_bytes_streamed", 0)
+                / win / 1e9 if win > 0 else 0.0)
+        if win > 0:
+            plane_GBps[s.get("plane", "?")] = round(
+                plane_GBps.get(s.get("plane", "?"), 0.0) + rate, 6)
+        rank_p99 = max(
+            (bucket_percentile_us(m["buckets"], 0.99)
+             for m in s.get("verb_latency", {}).values()), default=0)
+        worst_p99 = max(worst_p99, rank_p99)
+        ranks[str(orig)] = {
+            "rank": s.get("rank"),
+            "health": s.get("health"),
+            "seq": s.get("seq"),
+            "window_s": win,
+            "GBps": round(rate, 6),
+            "p99_us": rank_p99,
+            "flight_recorded": s.get("flight", {}).get("recorded", 0),
+            "flight_capacity": s.get("flight", {}).get("capacity", 0),
+            "transitions": s.get("transitions", []),
+        }
+    return {
+        "epoch": epoch,
+        "world_size": len(members),
+        "members": list(members),
+        "missing": [m for m in members if m not in live],
+        "stale_dropped": stale,
+        "health": {str(orig): live[orig].get("health")
+                   for orig in sorted(live)},
+        "heals": max((s.get("heals", 0) for s in live.values()), default=0),
+        "wire_totals": wire_totals,
+        "plane_GBps": plane_GBps,
+        "verb_latency": verb_merged,
+        "verb_p50_us": p50,
+        "verb_p99_us": p99,
+        "worst_p99_us": worst_p99,
+        "ranks": ranks,
+    }
+
+
+def format_fleet(snap: dict) -> str:
+    """Human-readable fleet table (the CLI's output; also handy in test
+    failure messages). One header block (epoch, membership, health
+    rollup, fleet counters), one row per live rank, one line per merged
+    verb histogram."""
+    w = snap["wire_totals"]
+    lines = [
+        f"fleet: epoch {snap['epoch']}  world {snap['world_size']}  "
+        f"members {snap['members']}  heals {snap['heals']}",
+        "  health: " + (" ".join(
+            f"{o}={h}" for o, h in sorted(snap["health"].items(),
+                                          key=lambda kv: int(kv[0])))
+            or "(no live telemetry)"),
+        f"  missing: {snap['missing']}  stale_dropped: "
+        f"{snap['stale_dropped']}",
+        f"  fenced {w.get('frames_fenced', 0)}  "
+        f"resumed {w.get('frames_resumed', 0)}  "
+        f"grows {w.get('grows', 0)}  promotions {w.get('promotions', 0)}  "
+        f"streamed {w.get('frames_streamed', 0)} frames / "
+        f"{w.get('payload_bytes_streamed', 0)} B",
+        "  throughput: " + (" ".join(
+            f"{p}={gb:.3f} GB/s" for p, gb in sorted(
+                snap["plane_GBps"].items())) or "(no window yet)"),
+    ]
+    hdr = (f"  {'orig':>5} {'rank':>5} {'health':>9} {'GB/s':>8} "
+           f"{'p99(us)':>8} {'flight':>12}")
+    lines += [hdr, "  " + "-" * (len(hdr) - 2)]
+    for o in sorted(snap["ranks"], key=int):
+        r = snap["ranks"][o]
+        lines.append(
+            f"  {o:>5} {r['rank']:>5} {r['health']:>9} {r['GBps']:>8.3f} "
+            f"{r['p99_us']:>8} "
+            f"{r['flight_recorded']}/{r['flight_capacity']}")
+    for verb in sorted(snap["verb_latency"]):
+        m = snap["verb_latency"][verb]
+        lines.append(
+            f"  verb {verb:>12}: n={m['count']} "
+            f"mean={m['mean_us']:.1f}us "
+            f"p50<={snap['verb_p50_us'][verb]}us "
+            f"p99<={snap['verb_p99_us'][verb]}us")
+    return "\n".join(lines)
+
+
+def read_fleet(store_handle: str, group: str = "default",
+               timeout_s: float = 5.0) -> dict:
+    """One observer read of a group's published telemetry: meta pointer
+    first (current epoch + members), then every member's snapshot key,
+    then :func:`aggregate`. Raises ``LookupError`` when the group has
+    published nothing (no meta key) — distinct from an empty fleet."""
+    from rocnrdma_tpu.transport import bootstrap
+    client = bootstrap.BootstrapClient(store_handle, None, timeout_s,
+                                       scope=f"pg/{group}/ring")
+    # ONE deadline for the whole refresh (meta + every member key): each
+    # read gets the remaining budget, so an overloaded store costs one
+    # bounded refresh, not (members + 1) stacked timeouts — the same
+    # remaining-budget shape as ProcessGroup.fleet_stats
+    deadline = time.monotonic() + timeout_s
+    remaining = lambda: max(0.1, deadline - time.monotonic())
+    try:
+        meta_raw = client.try_get(meta_key(group), timeout_s=remaining())
+        if meta_raw is None:
+            raise LookupError(
+                f"no fleet telemetry published for group {group!r} "
+                f"(is a member's watchdog running?)")
+        try:
+            meta = json.loads(meta_raw)
+            epoch, members = int(meta["epoch"]), list(meta["members"])
+        except (ValueError, KeyError, TypeError) as e:
+            # a torn/garbage meta write: the observer names it instead
+            # of dying with a decode traceback mid --watch
+            raise LookupError(
+                f"fleet meta for group {group!r} is unreadable "
+                f"({type(e).__name__}) — a publish may be in flight; "
+                f"retry") from e
+        snaps = []
+        for orig in members:
+            try:
+                raw = client.try_get(snapshot_key(group, epoch, orig),
+                                     timeout_s=remaining())
+            except (OSError, TimeoutError):
+                raw = None  # out of budget: reported missing, not waited
+            try:
+                snaps.append(json.loads(raw) if raw is not None else None)
+            except ValueError:
+                snaps.append(None)  # torn payload reads as missing
+        return aggregate(snaps, epoch=epoch, members=members)
+    finally:
+        client.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m rocnrdma_tpu.obs.fleet",
+        description="Read a running group's fleet telemetry from its "
+                    "bootstrap store (one-shot, or --watch for a live "
+                    "refresh)")
+    p.add_argument("--store", required=True,
+                   help="the group's bootstrap store handle (host:port)")
+    p.add_argument("--group", default="default")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="store read deadline per refresh (seconds)")
+    p.add_argument("--watch", type=float, default=None, metavar="SECS",
+                   help="refresh every SECS seconds until interrupted")
+    p.add_argument("--iterations", type=int, default=0,
+                   help=argparse.SUPPRESS)  # test hook: bound --watch
+    p.add_argument("--json", action="store_true",
+                   help="print the raw fleet snapshot as JSON")
+    args = p.parse_args(argv)
+    shown = 0
+    while True:
+        try:
+            snap = read_fleet(args.store, args.group, args.timeout)
+        except (LookupError, OSError, TimeoutError) as e:
+            print(f"fleet: {type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(snap) if args.json else format_fleet(snap),
+              flush=True)
+        shown += 1
+        if args.watch is None or (args.iterations and
+                                  shown >= args.iterations):
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -393,13 +393,27 @@ def reinit_runtime(members: list, epoch: int, my_orig: int,
                          f"agreed membership {members}")
     _FLIGHT.record("deviceheal-start", epoch=epoch, rank=my_orig,
                    members=",".join(str(m) for m in members))
+    # each restart phase leaves a member-device-* span (perf_counter
+    # dur) on the flight timeline: the membership track of the merged
+    # Perfetto trace renders shutdown → election → reinit → reprobe as
+    # adjacent slices next to the host plane's heal span. Deliberately
+    # OUTSIDE the deviceheal- digest prefix — phase durations are wall
+    # time, and the DEVICEHEAL replay log must stay a pure function of
+    # the seed.
+    def _phase(name: str, t_from: float) -> float:
+        now = time.perf_counter()
+        _FLIGHT.record(f"member-device-{name}", epoch=epoch,
+                       dur=now - t_from)
+        return now
     try:
         if not compat.runtime_restart_available():
             raise RuntimeError(
                 "device-plane restart unavailable: this jax release "
                 "exposes no backend-clearing entry point")
+        tp = time.perf_counter()
         shutdown_runtime(timeout_s=min(5.0, timeout_s / 4.0))
         compat.clear_jax_backends()
+        tp = _phase("shutdown", tp)
         if coordinator is None:
             if agree is None:
                 raise ValueError(
@@ -408,6 +422,7 @@ def reinit_runtime(members: list, epoch: int, my_orig: int,
             coordinator = elect_coordinator(agree, members, my_orig, epoch,
                                             timeout_s=remaining(),
                                             host=host)
+        tp = _phase("election", tp)
         process_id = members.index(my_orig)
         back = poll_backoff()
         while True:
@@ -434,7 +449,9 @@ def reinit_runtime(members: list, epoch: int, my_orig: int,
                         f"device re-init against {coordinator!r} still "
                         f"failing at the deadline: {e}") from e
                 back.pause()
+        tp = _phase("reinit", tp)
         topo = reprobe_topology(expected_processes=len(members))
+        _phase("reprobe", tp)
     except BaseException as e:
         _FLIGHT.record("deviceheal-abort", epoch=epoch, rank=my_orig,
                        error=type(e).__name__)

@@ -61,6 +61,15 @@ Tasks:
   hard-kills spare process P the instant its admit record lands: the
   survivors' first heal strands at the wired barrier, and the retried
   heal must BURN the spare (admit records are one-shot) and shrink.
+
+  Fleet telemetry (ISSUE 8): every chaos rank also prints ``HEALTH``
+  (the fleet-health transition triples — ``ok → degraded → healing →
+  ok`` around a kill) and ``FLEET`` (the digest over the transition
+  sequence + the deterministic counter totals; wall-clock fields
+  excluded — replay-equal per seed), and the surviving LEADER of a
+  clean run prints ``FLEETSNAP``: the merged fleet snapshot (per-rank
+  health, bucket-exact merged verb P50/P99, fence/resume totals) after
+  every member published a final snapshot.
 """
 
 from __future__ import annotations
@@ -287,6 +296,73 @@ def _device_log() -> str:
     return _event_log(("deviceheal-",))
 
 
+def _health_transitions(pg) -> list:
+    """This rank's fleet-health transition triples ``[prev, state,
+    epoch]``, oldest first. Transitions are recorded at protocol points
+    (confirmed death, heal/grow entry and commit, admission) —
+    membership/epoch data only, so the sequence is a pure function of
+    the seed's failure story. Read from the GROUP's durable transition
+    log (destroy leaves it intact), not the flight ring: the ring is
+    always-on wire tracing and a long-enough soak wraps it, evicting
+    the earliest transitions timing-dependently — which would break
+    the replay-equality contract the FLEET digest pins. The flight
+    events remain the Perfetto-track copy; a pg that never constructed
+    falls back to them (near-empty either way)."""
+    if pg is not None:
+        return pg.health_transitions()
+    from rocnrdma_tpu.obs import FLIGHT
+    return [[a["prev"], a["state"], a["epoch"]]
+            for _, kind, a in FLIGHT.events() if kind == "fleet-health"]
+
+
+def _fleet_log(transitions: list) -> str:
+    """The FLEET telemetry digest: the health-transition sequence plus
+    the DETERMINISTIC wire-counter totals (fence/resume counts and
+    membership events — ``obs.fleet.DETERMINISTIC_COUNTERS``). Wall-
+    clock-shaped counters (frames streamed/overlapped before an abort's
+    timeout fired) and every wall-time field are excluded, so two runs
+    of one seed must digest identically on every survivor."""
+    import hashlib
+    import json
+
+    from rocnrdma_tpu.metrics import WIRE
+    from rocnrdma_tpu.obs.fleet import DETERMINISTIC_COUNTERS
+    snap = WIRE.snapshot()
+    totals = {k: snap[k] for k in DETERMINISTIC_COUNTERS}
+    return hashlib.sha256(json.dumps(
+        [transitions, totals],
+        sort_keys=True).encode()).hexdigest()
+
+
+def _print_fleet(pg) -> None:
+    """The fleet-plane telemetry lines every chaos rank prints for the
+    soak harness: the health-transition sequence (human-checkable) and
+    the replay digest — both pure functions of the seed."""
+    import json
+    trans = _health_transitions(pg)
+    print(f"HEALTH {json.dumps(trans)}", flush=True)
+    print(f"FLEET {_fleet_log(trans)}", flush=True)
+
+
+def _print_fleetsnap(pg) -> None:
+    """From the surviving LEADER of a clean run: the merged fleet
+    snapshot as one artifact (per-rank health, merged histograms,
+    fence/resume totals, epoch). Every rank publishes a final snapshot
+    and arrives at a barrier first, so the leader's aggregate reads
+    every member's post-heal telemetry. Telemetry is an OBSERVER: a
+    store flake here must cost the FLEETSNAP line (the harness's
+    assertion then names exactly what is missing), never convert a
+    bitwise-clean chaos run into a CLEAN-ABORT."""
+    import json
+    try:
+        pg.publish_telemetry()
+        pg.barrier()
+        if pg.global_ranks[pg.rank] == min(pg.global_ranks):
+            print(f"FLEETSNAP {json.dumps(pg.fleet_stats())}", flush=True)
+    except (OSError, TimeoutError, RuntimeError) as e:
+        print(f"FLEETSNAP-FAILED {type(e).__name__}: {e}", flush=True)
+
+
 def _verify_device_plane(args, members: list, my_orig: int,
                          epoch: int) -> None:
     """Prove the device plane is ALIVE end-to-end on the agreed
@@ -483,9 +559,13 @@ def _device_chaos_main(args) -> int:
                   f"now-rank={pg.rank}/{pg.world_size}", flush=True)
             print(f"EPOCH {pg.epoch}", flush=True)
             print(f"MEMBERS {pg.global_ranks}", flush=True)
+            _print_fleetsnap(pg)
             pg.stop_watchdog()
+            # pg is deliberately KEPT after the graceful destroy:
+            # destroy is idempotent (the finally's ungraceful call
+            # no-ops) and the finally's HEALTH/FLEET lines read the
+            # group's durable health-transition log
             pg.destroy(graceful=True)
-            pg = None
     except RuntimeError as e:
         if "device-plane heal failed" in str(e):
             # degraded mode: the device plane is down, NAMED, inside
@@ -522,6 +602,7 @@ def _device_chaos_main(args) -> int:
         print(f"HEALLOG {_heal_log()}", flush=True)
         print(f"DEVICEHEAL {_device_log()}", flush=True)
         print(f"DEVICEHEAL_MS {reinit_ms}", flush=True)
+        _print_fleet(pg)
         if fail_sock[0] is not None:
             fail_sock[0].close()
         from rocnrdma_tpu.obs import chrome
@@ -610,9 +691,11 @@ def _heal_chaos_main(args) -> int:
                   f"now-rank={pg.rank}/{pg.world_size}", flush=True)
             print(f"EPOCH {pg.epoch}", flush=True)
             print(f"MEMBERS {pg.global_ranks}", flush=True)
+            _print_fleetsnap(pg)
             pg.stop_watchdog()
+            # pg deliberately KEPT (destroy is idempotent): the finally
+            # reads its durable health-transition log for HEALTH/FLEET
             pg.destroy(graceful=True)
-            pg = None
     except (TimeoutError, OSError, RuntimeError) as e:
         # allowed only for a rank that missed a heal window (it must
         # exit); the soak asserts no survivor actually takes this path
@@ -626,6 +709,7 @@ def _heal_chaos_main(args) -> int:
         print(f"FAULTLOG {sched.fingerprint()}", flush=True)
         print(f"HEALLOG {_heal_log()}", flush=True)
         print(f"GROWLOG {_grow_log()}", flush=True)
+        _print_fleet(pg)
         if os.environ.get("ROCNRDMA_CHAOS_DUMP"):
             # replay-divergence triage: the RAW injection log behind
             # FAULTLOG, one line so the harness can diff two runs

@@ -190,11 +190,14 @@ class BootstrapServer:
                                 f"{prefix}{sub}/h/{int(sid)}", None)
                 # kv sweep: whole key prefixes a membership change
                 # obsoleted — the device-plane coordinator-election keys
-                # (pg/<group>/deviceheal/e<N>/coord) are epoch-qualified,
-                # so the heal that mints epoch N+1 sweeps every older
-                # election before ITS hook writes the new one; a
-                # long-lived sidecar store cannot accrete one dead
-                # coordinator handle per heal. Guarded to the caller's
+                # (pg/<group>/deviceheal/e<N>/coord) and the fleet
+                # telemetry snapshots (pg/<group>/fleet/e<N>/<orig>,
+                # one per rank per generation, re-written every
+                # heartbeat tick) are epoch-qualified, so the heal that
+                # mints epoch N+1 sweeps every older generation's keys
+                # before its own start publishing; a long-lived sidecar
+                # store can accrete neither dead coordinator handles
+                # nor orphaned snapshot keys per heal. Guarded to the caller's
                 # prefix: a prune may only sweep its own group's keys,
                 # and a prune that declares NO prefix may sweep none at
                 # all (an unprefixed request bypassing the guard would
@@ -281,11 +284,19 @@ class BootstrapClient:
         re-dialing and replaying (never resending on the same connection —
         a late reply to the first copy would desync the lockstep).
 
-        ``_budget_s`` bounds the RETRY budget (reconnect + replay) — the
-        deadline-honoring poll loops (get/barrier) pass their remaining
-        time so a 2 s caller deadline cannot inflate into 30 s of
-        re-dialing per RPC against a dead store. The first attempt always
-        runs (a 0 budget means "one try, no retries"); a single healthy
+        ``_budget_s`` bounds the WHOLE call — the reply wait of each
+        attempt AND the reconnect/replay retries — so the deadline-
+        honoring callers (get/barrier polls, ``fleet_stats``) passing
+        their remaining time can neither inflate a 2 s deadline into
+        30 s of re-dialing against a dead store NOR block a full
+        ``self.timeout_s`` in one recv against a merely-slow one (the
+        module contract: polls never hang past the caller's deadline).
+        The first attempt always runs, and every attempt's reply wait
+        is floored at min(1 s, ``self.timeout_s``): a 0 budget means
+        "one bounded try, no retries", NOT "give the server 100 ms" —
+        the watchdog's beat probes ride exactly that shape, and a
+        sub-second reply SLA on a busy store reads healthy peers as
+        silent (a spurious-death source, measured). Without a budget a
         round-trip is bounded by ``self.timeout_s`` as before."""
         req.setdefault("rank", self.rank)
         req.setdefault("scope", self.scope)
@@ -296,8 +307,12 @@ class BootstrapClient:
         last: Exception | None = None  # poll iteration) allocates nothing
         while True:
             try:
+                recv_s = (self.timeout_s if _budget_s is None
+                          else max(min(1.0, self.timeout_s),
+                                   min(self.timeout_s,
+                                       deadline - time.monotonic())))
                 self._qp.send(payload)
-                return json.loads(self._qp.recv(timeout_s=self.timeout_s))
+                return json.loads(self._qp.recv(timeout_s=recv_s))
             except (OSError, TimeoutError) as e:
                 last = e
                 if back is None:
@@ -334,13 +349,17 @@ class BootstrapClient:
         (ours if we won the race, the incumbent's otherwise)."""
         return self._rpc(op="setnx", key=key, value=value)["value"]
 
-    def try_get(self, key: str) -> str | None:
+    def try_get(self, key: str,
+                timeout_s: float | None = None) -> str | None:
         """One idempotent lookup: the value if present, ``None`` if the
         key is ABSENT. A transport failure raises (after the client retry
         budget) instead of masquerading as absence — callers deciding
         membership (``ProcessGroup.shrink``) or naming the dead must not
-        read a flaky wire as a missing rank."""
-        resp = self._rpc(op="get", key=key)
+        read a flaky wire as a missing rank. ``timeout_s``: optional
+        whole-call bound (reply wait + retries — see ``_rpc``) for
+        callers holding their own deadline (``fleet_stats``); default is
+        the client-level ``self.timeout_s``."""
+        resp = self._rpc(op="get", key=key, _budget_s=timeout_s)
         return resp.get("value") if resp.get("ok") else None
 
     def get(self, key: str, timeout_s: float = 30.0) -> str:
@@ -385,7 +404,12 @@ class BootstrapClient:
         ``kv``: whole kv-key prefixes to sweep (each must start with
         ``prefix`` — a group prunes only its own keys); the heal leader
         passes the dead generations' device-plane coordinator-election
-        namespace (``{prefix}deviceheal/``) through this."""
+        namespace (``{prefix}deviceheal/e<k>/``) AND the fleet
+        telemetry namespace (``{prefix}fleet/e<k>/`` — the per-rank
+        snapshot keys ``obs.fleet``'s agent publishes each heartbeat
+        tick) through this, both strictly below the minted epoch, so a
+        long-lived sidecar store accretes neither dead coordinator
+        handles nor healed-away generations' snapshot keys."""
         self._rpc(op="prune", ranks=sorted(int(r) for r in ranks),
                   prefix=prefix, spares=sorted(int(s) for s in spares),
                   joiners=sorted(int(j) for j in joiners),

@@ -760,7 +760,7 @@ class HostQPNet:
             else:
                 d = dest[:length].view(dtype)
                 combine(d, src_u8.view(dtype), out=d)
-            _WIRE.streamed()
+            _WIRE.streamed(nbytes=length)
             # one irecv_into request is one wire frame, so this event IS
             # the frame's landing slice (post->consume as dur): the trace
             # lane the acceptance check counts against frames_streamed

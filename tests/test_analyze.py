@@ -542,6 +542,91 @@ def test_obs_elastic_rule_flags_stale_surface_list():
 
 
 # ---------------------------------------------------------------------------
+# pass #4d: telemetry-publish discipline (PR 8) — every store write in
+# the fleet module is non-blocking-bounded (explicit timeout, no retry
+# loop) and flight-evented on abort
+# ---------------------------------------------------------------------------
+
+_TELEMETRY_GOOD = textwrap.dedent("""
+    def publish(self, client, timeout_s=1.0):
+        payload = self.local_snapshot()
+        try:
+            client.set("pg/g/fleet/e0/0", payload, timeout_s=timeout_s)
+        except (OSError, TimeoutError) as e:
+            _FLIGHT.record("telemetry-abort", error=type(e).__name__)
+            return False
+        return True
+""")
+
+
+def test_obs_accepts_bounded_recorded_publish():
+    assert obs.check_telemetry_source(_TELEMETRY_GOOD, "fleet.py") == []
+
+
+def test_obs_flags_unbounded_telemetry_write():
+    src = textwrap.dedent("""
+        def publish(self, client):
+            try:
+                client.set("pg/g/fleet/e0/0", "{}")
+            except (OSError, TimeoutError) as e:
+                _FLIGHT.record("telemetry-abort", error=type(e).__name__)
+                return False
+    """)
+    problems = obs.check_telemetry_source(src, "fleet.py")
+    assert len(problems) == 1, problems
+    assert "no explicit timeout_s" in problems[0], problems
+
+
+def test_obs_flags_telemetry_retry_loop():
+    # a publish retried in a loop turns a flaky store into a stalled
+    # heartbeat — exactly what the rule exists to prevent
+    src = textwrap.dedent("""
+        def publish(self, client, timeout_s=1.0):
+            try:
+                while True:
+                    client.set("k", "{}", timeout_s=timeout_s)
+            except (OSError, TimeoutError) as e:
+                _FLIGHT.record("telemetry-abort", error=type(e).__name__)
+    """)
+    problems = obs.check_telemetry_source(src, "fleet.py")
+    assert len(problems) == 1, problems
+    assert "inside a loop" in problems[0], problems
+
+
+def test_obs_flags_silently_dropped_publish():
+    # absorbing a failed publish WITHOUT recording is a blind spot in
+    # the observability plane itself: the absorb-is-fine exemption of
+    # the abort rule deliberately does not apply to telemetry writes
+    src = textwrap.dedent("""
+        def publish(self, client, timeout_s=1.0):
+            try:
+                client.set("k", "{}", timeout_s=timeout_s)
+            except (OSError, TimeoutError):
+                return False
+    """)
+    problems = obs.check_telemetry_source(src, "fleet.py")
+    assert len(problems) == 1, problems
+    assert "not flight-evented on abort" in problems[0], problems
+
+
+def test_obs_telemetry_rule_ignores_reads_and_other_calls():
+    src = textwrap.dedent("""
+        def read_fleet(client, timeout_s=5.0):
+            raw = client.try_get("pg/g/fleet/meta")
+            vals = [client.get(f"k{i}", timeout_s) for i in range(3)]
+            return raw, vals
+    """)
+    assert obs.check_telemetry_source(src, "fleet.py") == []
+
+
+def test_obs_telemetry_rule_covers_the_repo_fleet_module():
+    # the repo surface itself complies (run() == [] pins it); sanity-
+    # check the target is the fleet module and the write set is sane
+    assert obs.TELEMETRY_FILE == "rocnrdma_tpu/obs/fleet.py"
+    assert "set" in obs.STORE_WRITES
+
+
+# ---------------------------------------------------------------------------
 # pass #0 extension (PR 6): the elastic PG surface is on the named
 # blocking list — grow/wait_promotion must accept timeout_s
 # ---------------------------------------------------------------------------

@@ -22,8 +22,17 @@ def test_cli_end_to_end(tmp_path, capsys):
     assert {r["collective"] for r in rows} == {"allreduce", "allgather"}
     assert all(r["platform"] == "host-tcp" and r["n_ranks"] == 2
                and r["mean_s"] > 0 for r in rows)
+    # the leader's fleet snapshot rides every record: per-rank health,
+    # bucket-exact merged histograms, the worst-rank P99 the table shows
+    for r in rows:
+        fl = r["extra"]["fleet"]
+        assert fl["health"] == {"0": "ok", "1": "ok"}, fl
+        assert fl["missing"] == [] and fl["epoch"] == 0
+        assert fl["worst_p99_us"] > 0
+        assert fl["verb_latency"]  # merged histograms attached
     table = capsys.readouterr().out
     assert "allreduce" in table and "ring" in table
+    assert "wp99(us)" in table.splitlines()[0]
 
 
 def test_build_input_shapes():

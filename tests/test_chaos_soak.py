@@ -14,6 +14,7 @@ The full soak is ``slow`` (excluded from tier-1); the die-mid-collective
 run is small enough to ride tier-1 and guards the named-abort path.
 """
 
+import json
 import re
 
 import pytest
@@ -92,7 +93,8 @@ def _line(result, key):
     return m.group(1)
 
 
-def test_kill_and_heal_retries_on_shrunk_group_replay_equal():
+def test_kill_and_heal_retries_on_shrunk_group_replay_equal(
+        tmp_path, monkeypatch):
     """The self-healing acceptance run: 4 ranks, a rank hard-killed
     (os._exit, no FIN) mid-allreduce at a deterministic op. Survivors
     must heal AUTOMATICALLY (watchdog triage -> epoch bump -> ring
@@ -101,10 +103,19 @@ def test_kill_and_heal_retries_on_shrunk_group_replay_equal():
     the shrunk group (exit 0, never 4/5, never a -9 hang). The epoch
     fence must have dropped stale pre-heal frames (FENCED > 0 on every
     survivor: the in-flight neighbour ping is provably undelivered at
-    the abort), and TWO runs of the seed must produce identical fault
-    AND heal timelines on every rank — kills land in op space and heal
-    events carry only membership/epoch data, so the whole failure story
-    replays."""
+    the abort), and TWO runs of the seed must produce identical fault,
+    heal, AND fleet-telemetry timelines on every rank — kills land in
+    op space, heal events carry only membership/epoch data, and the
+    FLEET digest hashes only health transitions + deterministic counter
+    totals, so the whole failure story replays.
+
+    Fleet acceptance (ISSUE 8): the whole story lands in one artifact —
+    every survivor's health walks ok -> degraded -> healing -> ok with
+    the epoch bump, the leader's merged fleet snapshot shows every
+    member healthy on epoch 1 with the fence totals, and the merged
+    Perfetto trace renders the membership track (heal span + health
+    transitions) aligned against the frame slices."""
+    monkeypatch.setenv("ROCNRDMA_FLIGHT_DUMP", str(tmp_path))
     n, seed, rounds, victim = 4, 11, 6, 2
     runs = [run_workers(n, "kill-and-heal", timeout_s=150.0, seed=seed,
                         rounds=rounds, kill_ranks=str(victim),
@@ -126,12 +137,53 @@ def test_kill_and_heal_retries_on_shrunk_group_replay_equal():
             # the epoch fence fired: stale pre-heal frames were counted
             # out at the vtable boundary, not delivered into the retry
             assert int(_line(r, "FENCED")) > 0
+            # the fleet-health story: confirmed death -> heal -> healthy
+            # on the bumped epoch, on every survivor
+            health = json.loads(_line(r, "HEALTH"))
+            assert health == [["ok", "degraded", 0],
+                              ["degraded", "healing", 0],
+                              ["healing", "ok", 1]], health
+        # the leader's one-artifact fleet snapshot: every member of the
+        # healed generation reports ok, the merged totals carry the
+        # fence/resume counts, nothing is missing or stale
+        leader = next(r for r in results if r.process_id == 0)
+        snap = json.loads(_line(leader, "FLEETSNAP"))
+        assert snap["epoch"] == 1 and snap["members"] == [0, 1, 3]
+        assert snap["health"] == {"0": "ok", "1": "ok", "3": "ok"}
+        assert snap["missing"] == [] and snap["stale_dropped"] == 0
+        assert snap["wire_totals"]["frames_fenced"] >= 3
+        assert snap["worst_p99_us"] > 0
+        for rk in snap["ranks"].values():
+            assert rk["transitions"][-1] == ["healing", "ok", 1]
     for a, b in zip(*runs):
         if a.process_id == victim:
             continue
         assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
         assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
         assert _line(a, "FENCED") == _line(b, "FENCED"), a.process_id
+        # the FLEET digest (health transitions + deterministic counter
+        # totals, wall-clock fields excluded) replays from the seed
+        assert _line(a, "FLEET") == _line(b, "FLEET"), a.process_id
+    # the unified timeline: merge the survivors' flight dumps and read
+    # the recovery story off the membership track, aligned against the
+    # frame lane in the same trace
+    from rocnrdma_tpu.obs import chrome
+    dumps = [tmp_path / f"flight_rank{r}.json" for r in range(n)
+             if r != victim]
+    assert all(p.exists() for p in dumps), list(tmp_path.iterdir())
+    merged = chrome.merge([str(p) for p in dumps])
+    for r in range(n):
+        if r == victim:
+            continue
+        mem = {e["name"] for e in chrome.membership_events(merged, r)}
+        assert "member-heal" in mem, (r, sorted(mem))
+        assert "fleet-health" in mem
+        assert {"heal-start", "heal-done"} <= mem
+        heal_spans = [e for e in chrome.membership_events(merged, r)
+                      if e["name"] == "member-heal"]
+        assert heal_spans and all(e["ph"] == "X" and e["dur"] > 0
+                                  for e in heal_spans)
+        assert chrome.frame_slices(merged, r)
 
 
 def test_kill_straddling_commit_boundary_aborts_named_not_mixed():

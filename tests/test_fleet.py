@@ -1,0 +1,499 @@
+"""Fleet telemetry plane (rocnrdma_tpu.obs.fleet): mergeable counter
+snapshots, bucket-exact cross-rank histogram merging, epoch-fenced
+aggregation, the per-rank agent's bounded best-effort publishes,
+ProcessGroup.fleet_stats / health transitions, the one-shot + --watch
+CLI, the telemetry-namespace prune, and the membership track in the
+Perfetto merge."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import metrics as M
+from rocnrdma_tpu import native
+from rocnrdma_tpu.obs import FLIGHT, chrome, fleet
+from rocnrdma_tpu.transport import bootstrap
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable")
+
+
+# ---------------------------------------------------------------------------
+# mergeable snapshots (the metrics satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_counters_merge_is_exact_fieldwise_addition():
+    a, b = M.WireCounters(), M.WireCounters()
+    a.streamed(3, nbytes=300)
+    a.fenced(2)
+    a.copied(64)
+    b.streamed(5, nbytes=500)
+    b.resumed(1)
+    b.grew(2)
+    m = M.WireCounters.merge([a.snapshot(), b.snapshot()])
+    assert m["frames_streamed"] == 8
+    assert m["payload_bytes_streamed"] == 800
+    assert m["frames_fenced"] == 2
+    assert m["frames_resumed"] == 1
+    assert m["grows"] == 2
+    assert m["payload_bytes_copied"] == 64 and m["frames_copied"] == 1
+
+
+def test_wire_counters_merge_tolerates_foreign_keys():
+    # a newer rank publishing an extra counter merges instead of raising
+    m = M.WireCounters.merge([{"frames_streamed": 1, "novel": 2},
+                              {"frames_streamed": 2, "novel": 3}])
+    assert m["frames_streamed"] == 3 and m["novel"] == 5
+
+
+def test_verb_latencies_merge_equals_single_observer():
+    """THE merge contract: log2 buckets share one exponent grid, so
+    bucket-wise addition of two ranks' histograms is byte-identical to
+    one recorder having observed every verb — and the percentiles read
+    off the merged buckets equal the single-observer truth."""
+    a, b, one = M.VerbLatencies(), M.VerbLatencies(), M.VerbLatencies()
+    lat_a = [3e-6, 3e-6, 9e-6, 700e-6, 0.02]
+    lat_b = [1e-6, 5e-6, 9e-6, 1.5e-3]
+    for s in lat_a:
+        a.observe("isend", s)
+        one.observe("isend", s)
+    for s in lat_b:
+        b.observe("isend", s)
+        one.observe("isend", s)
+    b.observe("accept", 2e-3)
+    one.observe("accept", 2e-3)
+    merged = M.VerbLatencies.merge([a.snapshot(), b.snapshot()])
+    truth = one.snapshot()
+    for verb in truth:
+        assert merged[verb]["buckets"] == truth[verb]["buckets"], verb
+        assert merged[verb]["count"] == truth[verb]["count"]
+        assert merged[verb]["total_s"] == pytest.approx(
+            truth[verb]["total_s"])
+        assert merged[verb]["mean_us"] == pytest.approx(
+            truth[verb]["mean_us"])
+        for q in (0.5, 0.9, 0.99):
+            assert (M.bucket_percentile_us(merged[verb]["buckets"], q)
+                    == M.bucket_percentile_us(truth[verb]["buckets"], q))
+
+
+def test_bucket_percentile_reads_bucket_upper_bounds():
+    buckets = {"<=2us": 50, "<=8us": 49, "<=4096us": 1}
+    assert M.bucket_percentile_us(buckets, 0.5) == 2
+    assert M.bucket_percentile_us(buckets, 0.99) == 8
+    assert M.bucket_percentile_us(buckets, 1.0) == 4096
+    assert M.bucket_percentile_us({}, 0.99) == 0
+
+
+# ---------------------------------------------------------------------------
+# the aggregator: exact merging, epoch fencing, missing ranks
+# ---------------------------------------------------------------------------
+
+
+def _snap(orig, epoch=0, health="ok", plane="shm", streamed=0,
+          delta_bytes=0, window=1.0, seq=1, heals=0, p99_bucket=None):
+    verbs = {}
+    if p99_bucket is not None:
+        verbs["isend"] = {"count": 100, "total_s": 0.1, "mean_us": 1000.0,
+                          "buckets": {"<=64us": 98, p99_bucket: 2}}
+    wire = {"payload_bytes_copied": 0,
+            "payload_bytes_streamed": streamed,
+            "frames_streamed": max(1, streamed // 64), "frames_copied": 0,
+            "frames_overlapped": 0, "frames_fenced": 1, "frames_resumed": 0,
+            "grows": 0, "promotions": 0}
+    return {"v": 1, "rank": orig, "orig": orig, "epoch": epoch, "seq": seq,
+            "plane": plane, "health": health, "transitions": [],
+            "heals": heals, "window_s": window, "wire": wire,
+            "wire_delta": {"payload_bytes_streamed": delta_bytes},
+            "verb_latency": verbs,
+            "flight": {"recorded": 10, "capacity": 4096}}
+
+
+def test_aggregate_merges_counters_health_and_throughput():
+    snap = fleet.aggregate(
+        [_snap(0, streamed=1000, delta_bytes=2e9, window=2.0,
+               p99_bucket="<=512us"),
+         _snap(1, streamed=500, delta_bytes=1e9, window=1.0,
+               health="degraded", p99_bucket="<=8192us")],
+        epoch=0, members=[0, 1])
+    assert snap["missing"] == [] and snap["stale_dropped"] == 0
+    assert snap["health"] == {"0": "ok", "1": "degraded"}
+    assert snap["wire_totals"]["payload_bytes_streamed"] == 1500
+    assert snap["wire_totals"]["frames_fenced"] == 2
+    # per-plane throughput: each rank's OWN windowed rate, summed
+    assert snap["plane_GBps"]["shm"] == pytest.approx(2.0)
+    # merged P99 reads the MERGED buckets (nearest-rank over all 200
+    # observations: the fast rank's samples dilute the slow rank's tail
+    # to <=512us) while worst-rank P99 keeps the slowest rank's own
+    # tail — which is why the format_table column reports the latter
+    assert snap["verb_p99_us"]["isend"] == 512
+    assert snap["worst_p99_us"] == 8192
+    assert snap["ranks"]["0"]["p99_us"] == 512
+    assert snap["ranks"]["1"]["p99_us"] == 8192
+
+
+def test_aggregate_fences_stale_epoch_telemetry():
+    """The telemetry fence: a payload stamped with another generation —
+    or an orig the membership no longer carries — is dropped, counted,
+    and flight-evented; its counters never blend into the fleet view."""
+    FLIGHT.reset()
+    snap = fleet.aggregate(
+        [_snap(0, epoch=1, streamed=100),
+         _snap(1, epoch=0, streamed=700),     # pre-heal straggler
+         _snap(9, epoch=1, streamed=900)],    # healed-away identity
+        epoch=1, members=[0, 1])
+    assert snap["stale_dropped"] == 2
+    assert snap["wire_totals"]["payload_bytes_streamed"] == 100
+    assert snap["missing"] == [1]  # fenced != present
+    fenced = [a for _, k, a in FLIGHT.events() if k == "telemetry-fenced"]
+    assert len(fenced) == 2
+    assert {e.get("got") for e in fenced} == {0, 1}
+
+
+def test_aggregate_reports_missing_ranks():
+    snap = fleet.aggregate([_snap(0), None], epoch=0, members=[0, 1, 2])
+    assert snap["missing"] == [1, 2]
+    assert snap["world_size"] == 3
+    assert list(snap["ranks"]) == ["0"]
+
+
+def test_format_fleet_renders():
+    snap = fleet.aggregate(
+        [_snap(0, epoch=3, delta_bytes=1e9, window=1.0,
+               p99_bucket="<=512us")],
+        epoch=3, members=[0, 1])
+    text = fleet.format_fleet(snap)
+    assert "epoch 3" in text
+    assert "0=ok" in text
+    assert "missing: [1]" in text
+    assert "isend" in text and "p99<=512us" in text
+
+
+# ---------------------------------------------------------------------------
+# the per-rank agent: bounded best-effort publishes
+# ---------------------------------------------------------------------------
+
+
+class _FakePG:
+    rank = 0
+    global_ranks = [0]
+    epoch = 0
+    plane = "shm"
+    group_name = "tfleet"
+    world_size = 1
+    heals = 0
+
+    def health(self):
+        return "ok"
+
+    def health_transitions(self):
+        return []
+
+
+def test_agent_publishes_snapshot_and_meta():
+    server = bootstrap.BootstrapServer(n_ranks=1)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=5.0)
+    try:
+        agent = fleet.FleetAgent(_FakePG())
+        assert agent.publish(client, timeout_s=2.0)
+        raw = client.try_get(fleet.snapshot_key("tfleet", 0, 0))
+        assert raw is not None
+        snap = json.loads(raw)
+        assert snap["epoch"] == 0 and snap["health"] == "ok"
+        assert "wire" in snap and "verb_latency" in snap
+        meta = json.loads(client.try_get(fleet.meta_key("tfleet")))
+        assert meta == {"epoch": 0, "members": [0], "world": 1,
+                        "group": "tfleet"}
+        # the second publish carries a window (seq advanced, delta keyed)
+        assert agent.publish(client, timeout_s=2.0)
+        snap2 = json.loads(client.try_get(fleet.snapshot_key("tfleet",
+                                                             0, 0)))
+        assert snap2["seq"] == 1 and snap2["window_s"] >= 0.0
+    finally:
+        client.close()
+        server.close()
+
+
+def test_agent_publish_absorbs_store_failure_and_records_abort():
+    """A dead store must cost one bounded attempt, a telemetry-abort
+    flight event, and a False — never a raise, never a retry loop (the
+    analyzer's telemetry rule pins the same shape statically)."""
+    server = bootstrap.BootstrapServer(n_ranks=1)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=0.5)
+    server.close()  # the store goes away under the agent
+    FLIGHT.reset()
+    try:
+        agent = fleet.FleetAgent(_FakePG())
+        assert agent.publish(client, timeout_s=0.3) is False
+        aborts = [a for _, k, a in FLIGHT.events()
+                  if k == "telemetry-abort"]
+        assert aborts and aborts[0]["error"] in ("TimeoutError", "OSError")
+    finally:
+        client._said_bye = True  # skip the bye RPC against the dead store
+        client._qp.close()
+
+
+# ---------------------------------------------------------------------------
+# the store plumbing: epoch-qualified keys prune with the generation
+# ---------------------------------------------------------------------------
+
+
+def test_prune_sweeps_fleet_namespace_below_minted_epoch():
+    """The leak fix: a heal's leader prune passes the dead generations'
+    ``fleet/e<k>/`` prefixes through the same guarded kv sweep as the
+    deviceheal elections — swept keys vanish, the new epoch's survive,
+    and an unprefixed request cannot touch them."""
+    server = bootstrap.BootstrapServer(n_ranks=2)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=5.0,
+                                       scope="pg/g/ring")
+    try:
+        for key in ("pg/g/fleet/e0/0", "pg/g/fleet/e0/1",
+                    "pg/g/fleet/e1/0", "pg/g/fleet/meta"):
+            client.set(key, "{}")
+        client.prune([1], prefix="pg/g/", kv=("pg/g/fleet/e0/",))
+        assert client.try_get("pg/g/fleet/e0/0") is None
+        assert client.try_get("pg/g/fleet/e0/1") is None
+        assert client.try_get("pg/g/fleet/e1/0") == "{}"
+        assert client.try_get("pg/g/fleet/meta") == "{}"
+        # the prefix guard: a prune declaring no prefix sweeps nothing
+        client.prune([], prefix=None, kv=("pg/g/fleet/e1/",))
+        assert client.try_get("pg/g/fleet/e1/0") == "{}"
+    finally:
+        client.close()
+        server.close()
+
+
+@needs_native
+def test_heal_prunes_dead_generation_fleet_keys():
+    """End-to-end: after a heal, the e0 telemetry snapshots are gone
+    from the store (the leader's prune swept ``fleet/e0/``) while the
+    healed generation's keys publish cleanly under ``e1``."""
+    from rocnrdma_tpu import distributed as dist
+
+    n = 3
+    store = bootstrap.BootstrapServer(n_ranks=n)
+    probe = bootstrap.BootstrapClient(store.handle, None, timeout_s=5.0,
+                                      scope="pg/fl/ring")
+    results, errors = [None] * n, []
+
+    def worker(rank):
+        pg = None
+        try:
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=store.handle,
+                group_name="fl", plane="shm")
+            assert pg.publish_telemetry()  # an e0 snapshot exists
+            pg.all_reduce(np.arange(8, dtype=np.int64))
+            if pg.rank == 1:
+                results[1] = "dead"
+                return
+            try:
+                pg.all_reduce(np.arange(8, dtype=np.int64), timeout_s=2.0)
+            except (TimeoutError, OSError, RuntimeError):
+                pass
+            members = pg.heal(grace_s=1.5)
+            assert members == [0, 2]
+            assert pg.publish_telemetry()
+            pg.barrier()
+            results[rank] = pg.fleet_stats()
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append((rank, repr(e)))
+        finally:
+            if pg is not None:
+                pg.destroy(graceful=False)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    try:
+        assert not errors, errors
+        assert results[1] == "dead"
+        # the dead generation's snapshots were swept by the heal...
+        for orig in range(n):
+            assert probe.try_get(fleet.snapshot_key("fl", 0, orig)) is None
+        # ...and the healed generation's telemetry is live and merged
+        for r in (0, 2):
+            snap = results[r]
+            assert snap["epoch"] == 1
+            assert snap["members"] == [0, 2]
+            assert set(snap["health"]) == {"0", "2"}
+            assert all(h == "ok" for h in snap["health"].values())
+    finally:
+        probe.close()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet_stats: the live merged view over a real (threaded) group
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_fleet_stats_merges_live_ranks():
+    from rocnrdma_tpu import distributed as dist
+
+    n = 2
+    store = bootstrap.BootstrapServer(n_ranks=n)
+    out, errors = [None] * n, []
+
+    def worker(rank):
+        pg = None
+        try:
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=store.handle,
+                group_name="fs", plane="shm")
+            for _ in range(2):
+                pg.all_reduce(np.arange(512, dtype=np.int64))
+            assert pg.publish_telemetry()
+            pg.barrier()
+            out[rank] = pg.fleet_stats()
+            pg.barrier()
+        except Exception as e:  # pragma: no cover
+            errors.append((rank, repr(e)))
+        finally:
+            if pg is not None:
+                pg.destroy()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    store.close()
+    assert not errors, errors
+    snap = out[0]
+    assert snap["missing"] == [] and snap["stale_dropped"] == 0
+    assert snap["health"] == {"0": "ok", "1": "ok"}
+    assert snap["wire_totals"]["frames_streamed"] > 0
+    assert snap["verb_p99_us"].get("irecv_into", 0) > 0
+    assert snap["worst_p99_us"] > 0
+    # ANY member may aggregate (the CLI reads the same keys rank-lessly)
+    assert out[1]["health"] == {"0": "ok", "1": "ok"}
+
+
+# ---------------------------------------------------------------------------
+# the CLI: one-shot and --watch
+# ---------------------------------------------------------------------------
+
+
+def _seed_store(server, group="g", epoch=0, members=(0, 1)):
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=5.0)
+    client.set(fleet.meta_key(group),
+               json.dumps({"epoch": epoch, "members": list(members),
+                           "world": len(members), "group": group}))
+    for m in members:
+        client.set(fleet.snapshot_key(group, epoch, m),
+                   json.dumps(_snap(m, epoch=epoch,
+                                    p99_bucket="<=1024us")))
+    client.close()
+
+
+def test_cli_one_shot_prints_fleet_table(capsys):
+    server = bootstrap.BootstrapServer(n_ranks=2)
+    try:
+        _seed_store(server)
+        rc = fleet.main(["--store", server.handle, "--group", "g"])
+    finally:
+        server.close()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "fleet: epoch 0" in out
+    assert "0=ok 1=ok" in out
+    assert "isend" in out
+
+
+def test_cli_json_mode_emits_the_snapshot(capsys):
+    server = bootstrap.BootstrapServer(n_ranks=2)
+    try:
+        _seed_store(server, epoch=2)
+        rc = fleet.main(["--store", server.handle, "--group", "g",
+                         "--json"])
+    finally:
+        server.close()
+    assert rc == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["epoch"] == 2 and snap["missing"] == []
+
+
+def test_cli_watch_refreshes(capsys):
+    server = bootstrap.BootstrapServer(n_ranks=2)
+    try:
+        _seed_store(server)
+        rc = fleet.main(["--store", server.handle, "--group", "g",
+                         "--watch", "0.01", "--iterations", "2"])
+    finally:
+        server.close()
+    assert rc == 0
+    assert capsys.readouterr().out.count("fleet: epoch 0") == 2
+
+
+def test_read_fleet_fences_stale_payload_under_current_key():
+    """Defense in depth behind the epoch-qualified keys: even a payload
+    sitting under the CURRENT generation's key is fenced when its own
+    epoch stamp disagrees (a torn write, or a rank that raced the heal)
+    — dropped and counted, never merged."""
+    server = bootstrap.BootstrapServer(n_ranks=2)
+    try:
+        _seed_store(server, epoch=1, members=(0, 1))
+        client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=5.0)
+        # rank 1's e1 key holds a pre-heal (epoch 0) payload
+        client.set(fleet.snapshot_key("g", 1, 1),
+                   json.dumps(_snap(1, epoch=0)))
+        client.close()
+        snap = fleet.read_fleet(server.handle, "g")
+    finally:
+        server.close()
+    assert snap["epoch"] == 1
+    assert snap["stale_dropped"] == 1
+    assert snap["missing"] == [1]
+    assert list(snap["ranks"]) == ["0"]
+
+
+def test_cli_names_missing_telemetry(capsys):
+    server = bootstrap.BootstrapServer(n_ranks=1)
+    try:
+        rc = fleet.main(["--store", server.handle, "--group", "nothere"])
+    finally:
+        server.close()
+    assert rc == 1
+    assert "no fleet telemetry" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the membership track in the Perfetto merge
+# ---------------------------------------------------------------------------
+
+
+def test_membership_track_renders_spans_and_transitions(tmp_path):
+    """member-* kinds (dur) render as slices and heal/fleet-health
+    events as instants, all on the membership lane — the unified
+    host+device recovery timeline next to the frame lane."""
+    from rocnrdma_tpu.obs.recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=64)
+    rec.mark_sync(ns="t")
+    rec.record("heal-start", epoch=1, rank=0)
+    rec.record("fleet-health", prev="ok", state="healing", epoch=0)
+    rec.record("member-device-reinit", epoch=1, dur=0.004)
+    rec.record("member-heal", epoch=1, world=2, dur=0.02)
+    rec.record("frame-landed", tag=1, nbytes=64, dur=0.001)
+    p = tmp_path / "flight_rank0.json"
+    chrome.dump_rank(str(p), 0, recorder=rec)
+    merged = chrome.merge([str(p)])
+    lanes = {(e["pid"], e.get("args", {}).get("name"))
+             for e in merged["traceEvents"] if e.get("ph") == "M"}
+    assert (0, "membership") in lanes
+    mem = chrome.membership_events(merged, 0)
+    by_name = {e["name"]: e for e in mem}
+    assert by_name["member-heal"]["ph"] == "X"
+    assert by_name["member-heal"]["dur"] == pytest.approx(0.02 * 1e6)
+    assert by_name["member-device-reinit"]["ph"] == "X"
+    assert by_name["heal-start"]["ph"] == "i"
+    assert by_name["fleet-health"]["ph"] == "i"
+    # frame slices stay on their own lane, aligned in the same trace
+    assert chrome.frame_slices(merged, 0)
+    assert all(e["tid"] == chrome._LANES["membership"] for e in mem)

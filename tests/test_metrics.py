@@ -53,6 +53,59 @@ def test_format_table_runs():
     assert "busbw" in M.format_table([r])
 
 
+def test_wire_counters_merge_standalone():
+    """The cross-rank merge helper is usable on plain bench-record wire
+    dicts in post-processing — exact integer addition, no instance
+    needed."""
+    a = M.WireCounters()
+    a.streamed(2, nbytes=128)
+    b = M.WireCounters()
+    b.streamed(3, nbytes=192)
+    b.fenced()
+    m = M.WireCounters.merge([a.snapshot(), b.snapshot()])
+    assert m["frames_streamed"] == 5
+    assert m["payload_bytes_streamed"] == 320
+    assert m["frames_fenced"] == 1
+
+
+def test_verb_latencies_merge_bucketwise():
+    a, b = M.VerbLatencies(), M.VerbLatencies()
+    a.observe("isend", 3e-6)      # <=4us
+    a.observe("isend", 100e-6)    # <=128us
+    b.observe("isend", 3.5e-6)    # <=4us
+    merged = M.VerbLatencies.merge([a.snapshot(), b.snapshot()])
+    assert merged["isend"]["count"] == 3
+    assert merged["isend"]["buckets"] == {"<=4us": 2, "<=128us": 1}
+    assert M.bucket_percentile_us(merged["isend"]["buckets"], 0.5) == 4
+    assert M.bucket_percentile_us(merged["isend"]["buckets"], 0.99) == 128
+
+
+def test_streamed_counts_payload_bytes():
+    w = M.WireCounters()
+    w.streamed(1, nbytes=4096)
+    w.streamed(2)  # byte-less legacy call still counts frames
+    s = w.snapshot()
+    assert s["frames_streamed"] == 3
+    assert s["payload_bytes_streamed"] == 4096
+    w.reset()
+    assert w.snapshot()["payload_bytes_streamed"] == 0
+
+
+def test_format_table_shows_worst_rank_p99_column():
+    """The fleet satellite: a record carrying a fleet snapshot prints
+    its worst-rank verb P99; records without telemetry print '-'."""
+    with_fleet = M.BenchRecord.measure(
+        "b", "allreduce", "ring", 2, 4096, "float32", 1e-6,
+        platform="host-shm", fleet={"worst_p99_us": 2048})
+    without = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096,
+                                    "float32", 1e-6, platform="host-shm")
+    table = M.format_table([with_fleet, without])
+    assert "wp99(us)" in table.splitlines()[0]
+    rows = table.splitlines()[2:]
+    assert rows[0].rstrip().endswith("2048")
+    assert rows[1].rstrip().endswith("-")
+
+
 def test_format_table_shows_tier_column():
     """An oracle row must be visually distinguishable from a performance
     row — the tier is ON the printed table, not only in the JSON."""

@@ -71,6 +71,10 @@ PG_BLOCKING = {
     # rendezvous + joiner splice, wait_promotion on the admit key — both
     # wait on OTHER processes, the exact shape rule 3 exists for
     "grow", "wait_promotion",
+    # the fleet telemetry surface (PR 8): fleet_stats reads every
+    # member's snapshot key, publish_telemetry writes one — both store
+    # round-trips a caller must be able to bound
+    "fleet_stats", "publish_telemetry",
 }
 
 

@@ -54,6 +54,22 @@ barrier is the hardest hang to triage after the fact. Third invariant:
 re-raises and records a flight event** — guaranteed abort
 instrumentation, not merely conditional on a handler existing.
 
+**Telemetry-publish discipline (PR 8).** The fleet telemetry plane
+(``rocnrdma_tpu/obs/fleet.py``) publishes per-rank snapshots onto the
+bootstrap store from the watchdog/heartbeat thread. Its one hard rule:
+telemetry is an OBSERVER — a publish that blocks unboundedly (or
+retries in a loop) turns a flaky store into a stalled heartbeat, and a
+publish that fails silently is a blind spot in the very plane built to
+see. Fourth invariant, over every store WRITE in the telemetry module
+(a ``set`` / ``set_if_absent`` / ``exchange`` call): **the call must
+carry an explicit ``timeout_s`` keyword (non-blocking-bounded), must
+not sit inside a ``while``/``for`` loop (no retry loop — one bounded
+attempt per tick), and its enclosing function must contain an
+``except`` handler that records a flight event** (the abort is
+flight-evented even though it is absorbed, not re-raised — the
+absorb-is-fine exemption of the second invariant deliberately does NOT
+apply here).
+
 Exceptions live in ``ALLOW`` ("Class.verb" / "file.py::qualname" ->
 reason) — empty by policy.
 """
@@ -91,6 +107,13 @@ ABORT_MARKERS = {"record", "_stall", "postmortem", "_postmortem"}
 ELASTIC_FILE = "rocnrdma_tpu/distributed.py"
 ELASTIC_CLASS = "ProcessGroup"
 ELASTIC_SURFACE = ("grow", "heal", "wait_promotion")
+
+# the telemetry-publish surface: every store write in the fleet module
+# must be non-blocking-bounded (explicit timeout_s, no enclosing retry
+# loop) and flight-evented on abort (see the module docstring's fourth
+# invariant)
+TELEMETRY_FILE = "rocnrdma_tpu/obs/fleet.py"
+STORE_WRITES = {"set", "set_if_absent", "exchange"}
 
 ALLOW: dict[str, str] = {}
 
@@ -243,6 +266,55 @@ def elastic_problems(tree: ast.Module, where: str,
     return problems
 
 
+def telemetry_problems(tree: ast.Module, where: str,
+                       used: set | None = None) -> list[str]:
+    """The telemetry-publish invariant over the fleet module's store
+    writes: explicit ``timeout_s`` (bounded), no enclosing while/for
+    (no retry loop), and a recording ``except`` in the enclosing
+    function (flight-evented on abort, even when absorbed)."""
+    problems = []
+    for qual, fn, _owner in base.iter_functions(tree):
+        looped = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                looped.update(id(x) for x in ast.walk(node))
+        has_recording_handler = any(
+            isinstance(node, ast.ExceptHandler)
+            and ({base.call_name(sub) for sub in ast.walk(node)
+                  if isinstance(sub, ast.Call)} & ABORT_MARKERS)
+            for node in ast.walk(fn))
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call)
+                    and base.call_name(call) in STORE_WRITES):
+                continue
+            key = f"{os.path.basename(where)}::{qual}"
+            if key in ALLOW:
+                if used is not None:
+                    used.add(key)
+                continue
+            if not any(kw.arg == "timeout_s" for kw in call.keywords):
+                problems.append(
+                    f"{where}:{call.lineno}: telemetry store write in "
+                    f"{qual} has no explicit timeout_s — an unbounded "
+                    f"publish turns a flaky store into a stalled "
+                    f"heartbeat (pass timeout_s=, or ALLOW with a "
+                    f"reason)")
+            if id(call) in looped:
+                problems.append(
+                    f"{where}:{call.lineno}: telemetry store write in "
+                    f"{qual} sits inside a loop — publishes are one "
+                    f"bounded best-effort attempt per tick, never a "
+                    f"retry loop (hoist it, or ALLOW with a reason)")
+            if not has_recording_handler:
+                problems.append(
+                    f"{where}:{call.lineno}: telemetry store write in "
+                    f"{qual} is not flight-evented on abort (wrap it in "
+                    f"an except that records — _FLIGHT.record — before "
+                    f"absorbing; a silently dropped publish is a blind "
+                    f"spot in the observability plane itself)")
+    return problems
+
+
 def check_source(src: str, path: str = "<fixture>") -> list[str]:
     tree = ast.parse(src, filename=path)
     return check_tree(tree, path) + abort_problems(tree, path)
@@ -259,6 +331,11 @@ def check_elastic_source(src: str, path: str = "<fixture>") -> list[str]:
     return elastic_problems(ast.parse(src, filename=path), path)
 
 
+def check_telemetry_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the telemetry-publish invariant alone."""
+    return telemetry_problems(ast.parse(src, filename=path), path)
+
+
 def run() -> list[str]:
     used: set = set()
     problems = check_tree(base.parse_file(PLUGIN), PLUGIN, used)
@@ -266,6 +343,8 @@ def run() -> list[str]:
         problems += abort_problems(base.parse_file(target), target, used)
     problems += elastic_problems(base.parse_file(ELASTIC_FILE),
                                  ELASTIC_FILE, used)
+    problems += telemetry_problems(base.parse_file(TELEMETRY_FILE),
+                                   TELEMETRY_FILE, used)
     problems += base.allow_reason_problems(ALLOW, NAME)
     problems += base.allow_stale_problems(ALLOW, used, NAME)
     return problems
