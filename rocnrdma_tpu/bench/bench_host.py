@@ -57,10 +57,36 @@ SMOKE_FLOORS = {"shm": 0.20, "tcp": 0.22, "rdma": 0.45}
 
 # smoke fleet configurations: gate key -> (plane, transport)
 SMOKE_PATHS = {"shm": ("shm", "msg"), "tcp": ("tcp", "msg"),
-               "rdma": ("shm", "rdma")}
+               "rdma": ("shm", "rdma"), "lanes": ("shm", "msg")}
+
+# lanes scenario smoke gate (ISSUE 9): the P99 ceiling (microseconds)
+# for a 64 KiB allreduce on the HIGH-PRIORITY latency lane while a
+# paced bulk allgather saturates the same 2-rank shm ring. Recorded in
+# results/lanes_r01.json: with the scheduler ON (bulk paced at 1 MiB
+# credit, busy-aware yields) the measured P99 is 6.3-8.2 ms on this
+# container, vs 11.3-12.7 ms with the bulk lane unpaced at equal
+# priority (and the p50 drops 3.2-3.8 -> 2.2-2.3 ms). The 20 ms
+# ceiling carries ~2.5x headroom over the worst scheduled run so CI
+# scheduler noise cannot flake the gate, while a starvation-class
+# regression (a latency frame queued behind the bulk backlog FIFO:
+# P99 at the tens-of-ms bulk-op scale) still trips it.
+SMOKE_LANES_P99_US = 20_000.0
+# ...and the other direction: the bulk lane must still make progress
+# under the latency lane's priority (starvation is not allowed either
+# way) — windowed bulk-lane throughput floor during the latency loop
+SMOKE_LANES_BULK_GBPS = 0.05
 
 
 def _smoke_args(path: str) -> list:
+    if path == "lanes":
+        # 2-rank shm ring, 64 KiB latency-lane allreduces timed while a
+        # bulk lane loops 8 MiB-block allgathers (16 MiB wire traffic
+        # per op) — the bulk round count outlasts the latency loop so
+        # every sample is measured UNDER load (overlap_ok pins it)
+        return ["--ranks", "2", "--plane", "shm", "--transport", "msg",
+                "--sizes", "64K", "--collectives", "lanes",
+                "--repeats", "1", "--iters", "1", "--lat-iters", "200",
+                "--bulk-size", "8M", "--bulk-rounds", "120"]
     plane, transport = SMOKE_PATHS[path]
     return ["--ranks", "2", "--plane", plane, "--transport", transport,
             "--sizes", "1M", "--collectives", "allreduce",
@@ -143,6 +169,127 @@ def _issue(pg, collective: str, x, transport: str = "msg", counts=None):
     raise ValueError(f"unknown collective {collective!r}")
 
 
+def _lanes_worker(pg, args) -> list:
+    """The multi-tenant lanes scenario (ISSUE 9): P99 latency of a small
+    HIGH-PRIORITY allreduce while a paced bulk allgather saturates the
+    same ring — both lanes' collectives concurrently in flight over ONE
+    comm pair (the bulk stream runs on its own thread; frames interleave
+    at the lane scheduler). The record's headline is the latency lane's
+    P99 (worst rank), next to the bulk lane's windowed throughput — the
+    two numbers QoS is judged by: neither tenant may starve the other.
+
+    Inputs are deterministic per (rank, lane), so both lanes' results
+    are verified against their oracles (``lanes_ok``) — concurrency
+    that corrupts either stream fails the bench, not just slows it."""
+    import threading
+
+    from rocnrdma_tpu.metrics import VERBS, WIRE
+
+    n = pg.world_size
+    latency = pg.channel("latency", priority=8)
+    bulk = pg.channel("bulk", priority=0, credit_bytes=1 << 20)
+    small_elems = max(1, parse_size(args.sizes.split(",")[0]) // 4)
+    bulk_elems = max(1, parse_size(args.bulk_size) // 4)
+
+    def contrib(rank: int, lane: int, elems: int):
+        return (np.random.default_rng((rank, lane))
+                .standard_normal(elems).astype(np.float32))
+
+    small = contrib(pg.rank, 0, small_elems)
+    want_small = contrib(0, 0, small_elems)
+    for r in range(1, n):
+        want_small = want_small + contrib(r, 0, small_elems)
+    big = contrib(pg.rank, 1, bulk_elems)
+    # warmup both lanes; prove the bulk lane bitwise-correct once (the
+    # timed loop re-checks the latency lane's last result)
+    rows = bulk.all_gather(big, timeout_s=120.0)
+    ok = all(np.array_equal(rows[r], contrib(r, 1, bulk_elems))
+             for r in range(n))
+    got = None
+    for _ in range(3):
+        got = latency.all_reduce(small, timeout_s=30.0)
+    ok = ok and np.allclose(got, want_small, rtol=1e-4, atol=1e-4)
+    pg.barrier()
+    wire_base = WIRE.snapshot()
+    verb_base = VERBS.snapshot()
+    bulk_done = [None]
+    bulk_err = [None]
+
+    def bulk_run():
+        # a bulk-lane failure must surface as ITSELF, not masquerade as
+        # "bulk finished early" in the overlap gate: capture and re-raise
+        # after the join
+        try:
+            for _ in range(args.bulk_rounds):
+                bulk.all_gather(big, timeout_s=120.0)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            bulk_err[0] = e
+            return
+        bulk_done[0] = time.perf_counter()
+
+    t = threading.Thread(target=bulk_run, daemon=True)
+    t.start()
+    samples = []
+    t0_win = time.perf_counter()
+    for _ in range(args.lat_iters):
+        t0 = time.perf_counter()
+        got = latency.all_reduce(small, timeout_s=30.0)
+        samples.append(time.perf_counter() - t0)
+    lat_end = time.perf_counter()
+    window_s = lat_end - t0_win
+    # the bulk lane's bytes streamed DURING the latency window (the
+    # windowed per-lane counter — measured, not inferred from rounds)
+    mid = WIRE.delta(wire_base)
+    ok = ok and np.allclose(got, want_small, rtol=1e-4, atol=1e-4)
+    # a valid sample set is measured UNDER load: the bulk thread must
+    # still be running when the last latency sample lands
+    overlap_ok = t.is_alive() or (bulk_done[0] is not None
+                                  and bulk_done[0] >= lat_end)
+    t.join(timeout=600.0)
+    if bulk_err[0] is not None:
+        raise SystemExit(
+            f"lanes scenario: the bulk lane FAILED on rank {pg.rank} "
+            f"({type(bulk_err[0]).__name__}: {bulk_err[0]})")
+    wire = WIRE.delta(wire_base)
+    wire["overlap_ratio"] = round(WIRE.overlap_ratio(since=wire_base), 4)
+    wire.update(WIRE.negotiation())
+    if args.smoke and wire["payload_bytes_copied"]:
+        raise SystemExit(
+            f"smoke gate: rank {pg.rank} staged "
+            f"{wire['payload_bytes_copied']} payload bytes through copies "
+            f"during the lanes scenario (want 0): {wire}")
+    bulk_bytes = mid.get("channel_bytes_streamed", {}).get("bulk", 0)
+    bulk_GBps = bulk_bytes / window_s / 1e9 if window_s > 0 else 0.0
+    arr = np.sort(np.array(samples))
+    p50 = float(arr[int(0.50 * (len(arr) - 1))]) * 1e6
+    p99 = float(arr[int(0.99 * (len(arr) - 1))]) * 1e6
+    # fleet reductions: the collective is as slow as its slowest rank,
+    # QoS is as good as its worst rank, validity needs every rank
+    stats = pg.all_reduce(np.array([p50, p99, float(np.mean(arr)) * 1e6,
+                                    bulk_GBps]), op="max")
+    valid = pg.all_reduce(np.array([1.0 if ok else 0.0,
+                                    1.0 if overlap_ok else 0.0]), op="min")
+    pg.publish_telemetry()
+    pg.barrier()
+    if pg.rank != 0:
+        return []
+    fl = pg.fleet_stats()
+    fleet = {k: fl[k] for k in
+             ("epoch", "health", "missing", "stale_dropped",
+              "worst_p99_us", "verb_p50_us", "verb_p99_us",
+              "verb_latency", "wire_totals", "channel_GBps")}
+    return [M.BenchRecord.measure(
+        "bench_host", "allreduce", "lanes", n, small.nbytes, "float32",
+        float(stats[2]) / 1e6, platform=f"host-{args.plane}",
+        iters=args.lat_iters, repeats=1, lane="latency",
+        p50_us=round(float(stats[0]), 1), p99_us=round(float(stats[1]), 1),
+        bulk_GBps=round(float(stats[3]), 4),
+        bulk_lane_bytes=int(bulk_bytes), bulk_size=int(big.nbytes),
+        bulk_rounds=args.bulk_rounds, window_s=round(window_s, 4),
+        lanes_ok=bool(valid[0] > 0), overlap_ok=bool(valid[1] > 0),
+        wire=wire, verb_lat=VERBS.delta(verb_base), fleet=fleet)]
+
+
 def worker(args) -> int:
     from rocnrdma_tpu import distributed as dist
     from rocnrdma_tpu.metrics import VERBS, WIRE
@@ -155,6 +302,14 @@ def worker(args) -> int:
     # the watchdog thread)
     pg.start_watchdog()
     rng = np.random.default_rng(pg.rank)
+    if args.collectives == "lanes":
+        # the multi-tenant scenario has its own two-lane loop shape
+        records = _lanes_worker(pg, args)
+        pg.barrier()
+        pg.destroy()
+        for rec in records:  # only rank 0 holds any
+            print(rec.to_json())
+        return 0
     records = []
     for collective in args.collectives.split(","):
         for size in (parse_size(s) for s in args.sizes.split(",")):
@@ -266,16 +421,32 @@ def main(argv=None) -> int:
                         "ring); broadcast/alltoall(v) and the ragged "
                         "allgatherv/reducescatterv always ride send/recv")
     p.add_argument("--sizes", default="64K,1M")
-    p.add_argument("--collectives", default=",".join(COLLECTIVES))
+    p.add_argument("--collectives", default=",".join(COLLECTIVES),
+                   help="comma list, or the special value 'lanes': the "
+                        "multi-tenant QoS scenario (P99 of a small "
+                        "high-priority allreduce under a saturating "
+                        "bulk allgather on a second lane)")
     p.add_argument("--repeats", type=int, default=5)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--lat-iters", type=int, default=200,
+                   help="lanes scenario: latency-lane allreduce samples "
+                        "the P99 is computed over")
+    p.add_argument("--bulk-size", default="32M",
+                   help="lanes scenario: per-rank bulk allgather block")
+    p.add_argument("--bulk-rounds", type=int, default=40,
+                   help="lanes scenario: bulk allgather ops (same on "
+                        "every rank — the bulk lane is a collective "
+                        "too); size it to outlast the latency loop")
     p.add_argument("--out", default=None, help="JSONL output path")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1 perf gate: 2-rank 1 MiB allreduce on the "
-                        "shm, tcp, AND rdma (put-based ring) paths; "
-                        "asserts ZERO steady-path payload copies on "
-                        "every rank of every fleet and algbw >= 0.8x "
-                        f"each path's recorded floor ({SMOKE_FLOORS})")
+                        "shm, tcp, AND rdma (put-based ring) paths plus "
+                        "the lanes QoS scenario; asserts ZERO steady-"
+                        "path payload copies on every rank of every "
+                        "fleet, algbw >= 0.8x each path's recorded "
+                        f"floor ({SMOKE_FLOORS}), and the latency "
+                        f"lane's P99 <= {SMOKE_LANES_P99_US:.0f} us "
+                        "under concurrent bulk load")
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -293,14 +464,15 @@ def main(argv=None) -> int:
                  if a.startswith("--")}
         clash = sorted(given & {"--ranks", "--plane", "--transport",
                                 "--sizes", "--collectives", "--repeats",
-                                "--iters"})
+                                "--iters", "--lat-iters", "--bulk-size",
+                                "--bulk-rounds"})
         if clash:
             p.error(f"--smoke runs the fixed recorded configs "
-                    f"({' '.join(SMOKE_ARGS)}, then the tcp and rdma "
-                    f"twins); drop {'/'.join(clash)} or run a plain "
-                    f"bench instead")
+                    f"({' '.join(SMOKE_ARGS)}, then the tcp, rdma, and "
+                    f"lanes twins); drop {'/'.join(clash)} or run a "
+                    f"plain bench instead")
         records, failures = [], []
-        for path in ("shm", "tcp", "rdma"):
+        for path in ("shm", "tcp", "rdma", "lanes"):
             # each path is its own fleet: per-rank copy gates run inside
             # the workers, the throughput gate against the path's floor
             # runs here. ALL paths measure (and their records persist)
@@ -310,6 +482,44 @@ def main(argv=None) -> int:
             rec = _run_fleet(p.parse_args(_smoke_args(path)
                                           + ["--smoke"]))[0]
             records.append(rec)
+            if path == "lanes":
+                # the QoS gate: both tenants correct, the measurement
+                # genuinely under load, the latency lane's P99 inside
+                # the recorded ceiling, and the bulk lane not starved
+                ex = rec.extra
+                if not ex.get("lanes_ok"):
+                    failures.append(
+                        "smoke gate [lanes]: a lane's collective was "
+                        "NOT bitwise/allclose-correct under concurrency "
+                        f"(extra={ex})")
+                elif not ex.get("overlap_ok"):
+                    failures.append(
+                        "smoke gate [lanes]: the bulk lane finished "
+                        "before the latency loop — the P99 was not "
+                        "measured under load; raise --bulk-rounds "
+                        f"(extra={ex})")
+                elif ex["p99_us"] > SMOKE_LANES_P99_US:
+                    failures.append(
+                        f"smoke gate [lanes]: latency-lane P99 "
+                        f"{ex['p99_us']:.0f} us exceeds the recorded "
+                        f"ceiling {SMOKE_LANES_P99_US:.0f} us under "
+                        f"concurrent bulk load — the lane scheduler "
+                        f"has regressed (extra={ex})")
+                elif ex["bulk_GBps"] < SMOKE_LANES_BULK_GBPS:
+                    failures.append(
+                        f"smoke gate [lanes]: bulk lane moved only "
+                        f"{ex['bulk_GBps']:.3f} GB/s during the latency "
+                        f"window (< {SMOKE_LANES_BULK_GBPS}) — the "
+                        f"priority lane is starving the bulk tenant "
+                        f"(extra={ex})")
+                else:
+                    print(f"smoke gate ok [lanes]: latency P99 "
+                          f"{ex['p99_us']:.0f} us <= "
+                          f"{SMOKE_LANES_P99_US:.0f} us with the bulk "
+                          f"lane at {ex['bulk_GBps']:.3f} GB/s "
+                          f"({ex['bulk_lane_bytes']} B in window), both "
+                          f"lanes correct, zero steady-path copies")
+                continue
             floor = SMOKE_FLOORS[path]
             want = 0.8 * floor
             if rec.algbw_GBps < want:
@@ -353,7 +563,10 @@ def _run_fleet(args) -> list:
            "--ranks", str(args.ranks), "--plane", args.plane,
            "--transport", args.transport, "--sizes", args.sizes,
            "--collectives", args.collectives, "--repeats", str(args.repeats),
-           "--iters", str(args.iters)] + (["--smoke"] if args.smoke else [])
+           "--iters", str(args.iters), "--lat-iters", str(args.lat_iters),
+           "--bulk-size", args.bulk_size,
+           "--bulk-rounds", str(args.bulk_rounds)] \
+        + (["--smoke"] if args.smoke else [])
     procs = []
     try:
         for r in range(args.ranks):

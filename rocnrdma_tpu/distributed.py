@@ -46,14 +46,19 @@ from rocnrdma_tpu.transport import (
     bootstrap,
     plugin,
 )
+from rocnrdma_tpu.transport import lanes as _lanes
 
 _PLANES = {"tcp": TCPNet, "shm": HostQPNet}
 
 # p2p stream-resume control frame (reserved wire tag, next to the host
 # nets' LG tags — see the reservation note at HostQPNet._LG_REQ_TAG):
-# ``tag(4) | seq(4) | acked_frames(4)``, sent by the RECEIVER of an
-# interrupted stream over the re-established connection to name the
-# fence-acknowledged cursor the sender must resume from.
+# ``tag(4) | seq(4) | acked_frames(4) | chan(4)``, sent by the RECEIVER
+# of an interrupted stream over the re-established connection to name
+# the fence-acknowledged cursor the sender must resume from. The frame
+# itself always rides CHANNEL 0 (control, like the LG protocol); the
+# trailing chan field names the LANE of the stream being resumed — two
+# tenants' streams may share a user tag, and the cursor must reach the
+# right one.
 _P2P_RESUME_TAG = 0xFFFFFF04
 
 
@@ -154,6 +159,114 @@ class P2PHandle:
         return self._result
 
 
+class ChannelHandle:
+    """One QoS lane's verb surface over an existing :class:`ProcessGroup`
+    (returned by :meth:`ProcessGroup.channel`; see there for the lane
+    model). Every verb enters the lane's thread-local context, so every
+    framed message under the call — ring frames, LG descriptors, p2p
+    frames — carries this lane's channel id and lands in its stash on
+    the peer.
+
+    Concurrency contract: DIFFERENT handles' collectives may run
+    concurrently from separate threads over one group (that is the
+    point); ONE handle serializes its own collectives under a per-lane
+    mutex — a lane is one ordered stream of collectives, like a CUDA
+    stream. Each verb's wall latency is observed into the per-verb
+    histograms as ``lane:<name>:<verb>``, so ``fleet_stats()`` reports
+    per-lane P50/P99 merged bucket-exact across ranks."""
+
+    def __init__(self, pg: "ProcessGroup", lane):
+        self._pg = pg
+        self._lane = lane
+        self._mutex = threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return self._lane.name
+
+    @property
+    def channel_id(self) -> int:
+        return self._lane.id
+
+    @property
+    def priority(self) -> int:
+        return self._lane.priority
+
+    @property
+    def credit_bytes(self) -> int | None:
+        return self._lane.credit_bytes
+
+    def _run(self, verb: str, call):
+        t0 = time.perf_counter()
+        # the busy bracket is the priority signal lower lanes throttle
+        # on while this lane is mid-collective (LaneGate.busy_enter)
+        gate = getattr(self._pg._net, "_lane_gate", None)
+        if gate is not None:
+            gate.busy_enter(self._lane.id)
+        try:
+            with self._mutex, _lanes.lane_context(self._lane.id):
+                out = call()
+        finally:
+            if gate is not None:
+                gate.busy_exit(self._lane.id)
+        _VERB_LAT.observe(f"lane:{self._lane.name}:{verb}",
+                          time.perf_counter() - t0)
+        return out
+
+    def all_reduce(self, x, op: str = "sum", transport: str = "msg",
+                   timeout_s: float | None = None) -> np.ndarray:
+        return self._run("all_reduce", lambda: self._pg.all_reduce(
+            x, op=op, transport=transport, timeout_s=timeout_s))
+
+    def reduce_scatter(self, x, op: str = "sum", transport: str = "msg",
+                       timeout_s: float | None = None) -> np.ndarray:
+        return self._run("reduce_scatter", lambda: self._pg.reduce_scatter(
+            x, op=op, transport=transport, timeout_s=timeout_s))
+
+    def all_gather(self, x, transport: str = "msg",
+                   timeout_s: float | None = None) -> np.ndarray:
+        return self._run("all_gather", lambda: self._pg.all_gather(
+            x, transport=transport, timeout_s=timeout_s))
+
+    def broadcast(self, x, src: int = 0,
+                  timeout_s: float | None = None) -> np.ndarray:
+        return self._run("broadcast", lambda: self._pg.broadcast(
+            x, src=src, timeout_s=timeout_s))
+
+    def all_to_all(self, x, timeout_s: float | None = None) -> np.ndarray:
+        return self._run("all_to_all",
+                         lambda: self._pg.all_to_all(x, timeout_s=timeout_s))
+
+    # p2p on the lane: the POST side runs under the lane context (frames
+    # stamp this channel; the in-flight registration captures it, so a
+    # heal-time resume re-sends/re-posts under the same lane); returned
+    # handles' wait() needs no context — their receives were posted
+    # here, and the resume protocol reads the registered channel
+    def send(self, x, dst: int, tag: int = 0,
+             timeout_s: float = 60.0) -> None:
+        with _lanes.lane_context(self._lane.id):
+            return self._pg.send(x, dst, tag=tag, timeout_s=timeout_s)
+
+    def recv(self, x_like, src: int, tag: int = 0,
+             timeout_s: float = 60.0) -> np.ndarray:
+        with _lanes.lane_context(self._lane.id):
+            return self._pg.recv(x_like, src, tag=tag, timeout_s=timeout_s)
+
+    def isend(self, x, dst: int, tag: int = 0,
+              timeout_s: float = 60.0) -> P2PHandle:
+        with _lanes.lane_context(self._lane.id):
+            return self._pg.isend(x, dst, tag=tag, timeout_s=timeout_s)
+
+    def irecv(self, x_like, src: int, tag: int = 0,
+              timeout_s: float = 60.0) -> P2PHandle:
+        with _lanes.lane_context(self._lane.id):
+            return self._pg.irecv(x_like, src, tag=tag, timeout_s=timeout_s)
+
+    def batch_isend_irecv(self, ops, timeout_s: float = 60.0) -> list:
+        with _lanes.lane_context(self._lane.id):
+            return self._pg.batch_isend_irecv(ops, timeout_s=timeout_s)
+
+
 class ProcessGroup:
     """N ranks wired in a TCP ring with a shared rendezvous store.
 
@@ -184,6 +297,28 @@ class ProcessGroup:
         self._op_seq = 0            # collectives COMMITTED (heal divergence
         #                             check: every survivor must agree on
         #                             which op the retry re-executes)
+        # multi-tenant lanes: commit bookkeeping moves under a lock
+        # (concurrent ChannelHandle verbs commit from their own
+        # threads), and at most ONE lane may drive the recovery
+        # machinery at a time — a second lane whose collective aborted
+        # into the same failure waits here, re-checks the epoch, and
+        # retries on the already-healed group instead of double-healing
+        self._op_lock = threading.Lock()
+        self._recovery_lock = threading.RLock()
+        # lane handles are cached ONE per name under their own lock: two
+        # threads opening the same lane concurrently must get the SAME
+        # handle (the per-lane mutex IS the one-collective-per-lane
+        # contract — two handles would be two mutexes, and same-lane
+        # collectives would tag-collide on the wire)
+        self._channels_lock = threading.Lock()
+        self._channels: dict[str, "ChannelHandle"] = {}
+        # collectives committed per lane (channel id -> count), next to
+        # the _op_seq total: the heal/grow divergence check must compare
+        # the PER-LANE split — with concurrent lanes, two survivors can
+        # agree on the total while disagreeing on which lane's op
+        # committed, which is exactly the mixed-retry case the check
+        # exists to refuse, named
+        self._lane_ops: dict[int, int] = {}
         self._ranks = list(range(world_size))
         self._self_heal = bool(self_heal)
         self._heals = 0
@@ -213,6 +348,16 @@ class ProcessGroup:
             from rocnrdma_tpu.transport.faults import FaultNet
             self._net = FaultNet(self._net, fault_schedule)
         self._net.init()
+        # the group-level progress hook every _RingWire on this net runs
+        # inside its blocking loops: a rank blocked in a COLLECTIVE must
+        # still serve its interrupted p2p streams' resume protocol, or a
+        # post-heal round can deadlock — peer A drains a resumed receive
+        # (bounded) while peer B, whose service alone can re-send the
+        # tail, sits in the next collective waiting for A (observed: the
+        # lane chaos run lost a ring frame to exactly this cycle when
+        # B's last verb-entry service turn missed A's RESUME ack by
+        # 0.2 ms). One bool check when nothing is pending.
+        self._net._progress_hook = self._resume_progress
         try:
             if standby is not None:
                 self._client = bootstrap.BootstrapClient(
@@ -275,6 +420,13 @@ class ProcessGroup:
         self._p2p_inflight: dict[tuple, dict] = {}
         self._p2p_resume_pending = False  # interrupted tx streams awaiting
         #                                   the receiver's RESUME cursor
+        # serializes the resume SERVICE: the net-level progress hook
+        # makes it reachable from every lane thread concurrently, and
+        # two threads both dialing a peer's re-published listener would
+        # clobber the (peer, "tx") wire — one re-dial per peer is the
+        # protocol (the receiver accepts exactly one). Non-blocking
+        # acquire: a progress hook must never block on a sibling's turn.
+        self._p2p_service_lock = threading.Lock()
         self._p2p_listen: dict | None = None    # peer -> listener, once used
         self._p2p_accepted: set[int] = set()
         self._split_no = 0
@@ -320,6 +472,13 @@ class ProcessGroup:
         reshard_left = 1
         heal_retry_left = 1
         for _ in range(max(1, attempts)):
+            # the attempt's generation and membership, captured BEFORE
+            # the collective runs: with concurrent lanes another lane's
+            # heal may land mid-attempt, and the retry decisions below
+            # (skip-the-second-heal, root remap, reshard) must compare
+            # against the world THIS attempt's inputs were shaped for
+            epoch0 = self.epoch
+            prev = list(self._ranks)
             try:
                 self._check_alive()  # fail fast instead of hanging on the dead
                 if self.world_size > 1 and (self._send is None
@@ -342,9 +501,16 @@ class ProcessGroup:
                                error=type(e).__name__)
                 if not self._self_heal:
                     raise
-                prev = list(self._ranks)
                 try:
-                    self._heal_for(e, t)
+                    # one lane at a time drives recovery: a concurrent
+                    # lane whose collective aborted into the SAME
+                    # failure blocks here, sees the advanced epoch, and
+                    # goes straight to its retry on the healed group —
+                    # two lanes can never heal (or propose epochs)
+                    # concurrently on one rank
+                    with self._recovery_lock:
+                        if self.epoch == epoch0:
+                            self._heal_for(e, t)
                 except (TimeoutError, OSError) as he:
                     # a FAILED heal — e.g. the promoted spare died before
                     # wiring, stranding the wired barrier. The heal's
@@ -396,8 +562,11 @@ class ProcessGroup:
                         verb=getattr(fn, "__name__", "collective"),
                         dropped=len(prev) - self.world_size)
                 continue
-            self.last_op_epoch = self.epoch
-            self._op_seq += 1
+            with self._op_lock:
+                self.last_op_epoch = self.epoch
+                self._op_seq += 1
+                chan = _lanes.current_channel()
+                self._lane_ops[chan] = self._lane_ops.get(chan, 0) + 1
             return out
         raise RuntimeError(
             f"self-heal retry budget exhausted for group "
@@ -617,6 +786,56 @@ class ProcessGroup:
         return self._ring(plugin.ring_scatter_over_net, x, root=src,
                           timeout_s=timeout_s, _reshard=_reshard_scatter)
 
+    # -- multi-tenant lanes (PR 9: concurrent QoS-scheduled collectives) ----
+
+    def channel(self, name: str, priority: int | None = None,
+                credit_bytes: int | None = None) -> "ChannelHandle":
+        """Open (or fetch) the named QoS lane on this group and return a
+        :class:`ChannelHandle` whose collective verbs run on it — MANY
+        handles' collectives may be in flight CONCURRENTLY over the one
+        comm (each from its own thread), because every framed message
+        carries the lane's channel id next to ``tag|epoch`` and the
+        receive stash matches per ``(chan, tag)``.
+
+        ``priority`` (higher = more urgent) and ``credit_bytes`` (pacing
+        budget; None = unpaced) feed the send-admission gate
+        (``transport.lanes.LaneGate``): a bulk lane with a credit posts
+        in credit-capped quanta, yields the wire every credit of posted
+        bytes (a genuine GIL-releasing sleep while a higher-priority
+        lane is mid-collective), keeps the tcp tx backlog under its
+        credit, and defers outright behind any higher-priority post
+        waiting at the gate — the QoS that keeps a 1 GiB checkpoint
+        stream from starving a 64 KiB inference allreduce on the same
+        ring (and is a throttle, not a hard block, in the other
+        direction: the bulk tenant slows but always progresses). The
+        channel id is a stable hash of
+        ``name``, so every rank derives the same wire identity with no
+        rendezvous — open the same lane names (same settings) on every
+        rank. ``channel("default")`` is lane 0: exactly the group's own
+        verbs.
+
+        Lanes compose with the recovery machinery: the epoch fence drops
+        a stale frame whatever lane it rides (counted per lane in
+        ``wire_stats()['channel_frames_fenced']``), one lane at a time
+        drives heal-and-retry (the others retry on the healed epoch),
+        and FaultNet's per-channel knobs inject against lane names.
+
+        Fetch semantics: ``channel(name)`` with NO QoS arguments returns
+        the already-open handle as-is (a consumer module need not — and
+        must not have to — restate the opener's settings); restating
+        arguments re-runs the conflict check, so a mismatched re-open
+        still raises."""
+        with self._channels_lock:
+            ch = self._channels.get(name)
+            if ch is not None and priority is None and credit_bytes is None:
+                return ch
+            lane = self._net.open_lane(
+                name, priority=0 if priority is None else priority,
+                credit_bytes=credit_bytes)
+            if ch is None:
+                ch = self._channels[name] = ChannelHandle(self, lane)
+            return ch
+
     # -- object collectives (pickled python values, torch-style) -----------
     #
     # For small control-plane payloads (configs, vocab maps, shapes) among
@@ -744,10 +963,21 @@ class ProcessGroup:
         and chaos replay-equal), consume the receiver's RESUME frame, and
         re-queue the tail from the fence-acknowledged cursor. Returns the
         number of interrupted outbound streams still unserved (the
-        _check_alive hook keeps calling until it hits zero)."""
+        _check_alive hook — and the ring wires' net-level progress hook —
+        keep calling until it hits zero). One thread serves at a time:
+        a concurrent caller returns immediately, reporting "still
+        pending" so its own polling continues."""
+        if not self._p2p_service_lock.acquire(blocking=False):
+            return 1  # a sibling lane thread is serving right now
+        try:
+            return self._p2p_resume_service_locked()
+        finally:
+            self._p2p_service_lock.release()
+
+    def _p2p_resume_service_locked(self) -> int:
         pending = 0
         for key, info in list(self._p2p_inflight.items()):
-            orig, d, tag = key
+            orig, d, chan, tag = key
             if d != "tx" or info.get("state") == "resumed":
                 continue
             if info["epoch"] >= self.epoch:
@@ -775,32 +1005,43 @@ class ProcessGroup:
                                         timeout_s=self.timeout_s,
                                         peers=(cur, cur))
                 self._p2p[(cur, "tx")] = wire
-            acked = self._take_resume_ack(wire.send_comm, tag, info["seq"])
+            acked = self._take_resume_ack(wire.send_comm, chan, tag,
+                                          info["seq"])
             if acked is None:
                 continue
-            _FLIGHT.record("p2p-resume", dir="tx", tag=tag,
+            _FLIGHT.record("p2p-resume", dir="tx", tag=tag, chan=chan,
                            seq=info["seq"], acked=acked)
-            wire.queue_send(info["data"], info["hop"], first_frame=acked)
+            # the tail re-queues under the STREAM's lane, whatever lane
+            # context this service call happens to run in — the
+            # receiver's re-posted tail receives match on (chan, tag)
+            with _lanes.lane_context(chan):
+                wire.queue_send(info["data"], info["hop"],
+                                first_frame=acked)
             info["state"] = "resumed"
             pending -= 1
         return pending
 
-    def _take_resume_ack(self, comm, tag: int, seq: int) -> int | None:
-        """Pop the RESUME control frame for stream (tag, seq) from
+    def _take_resume_ack(self, comm, chan: int, tag: int,
+                         seq: int) -> int | None:
+        """Pop the RESUME control frame for stream (chan, tag, seq) from
         ``comm``'s stash, if it has arrived; returns the receiver's
         fence-acknowledged frame cursor. Frames for OTHER streams stay
-        stashed for their own senders' waits."""
-        frames = comm._unexpected.get(_P2P_RESUME_TAG)
-        if not frames:
-            comm._pump()
-            frames = comm._unexpected.get(_P2P_RESUME_TAG)
-        for i, p in enumerate(frames or ()):
-            if (int.from_bytes(p[:4], "little") == tag
-                    and int.from_bytes(p[4:8], "little") == seq):
-                frames.pop(i)
-                if not frames:
-                    del comm._unexpected[_P2P_RESUME_TAG]
-                return int.from_bytes(p[8:12], "little")
+        stashed for their own senders' waits. RESUME frames ride wire
+        channel 0 (control); the stream's lane is in the payload."""
+        key = (0, _P2P_RESUME_TAG)
+        with comm._lock:
+            frames = comm._unexpected.get(key)
+            if not frames:
+                comm._pump()
+                frames = comm._unexpected.get(key)
+            for i, p in enumerate(frames or ()):
+                if (int.from_bytes(p[:4], "little") == tag
+                        and int.from_bytes(p[4:8], "little") == seq
+                        and int.from_bytes(p[12:16], "little") == chan):
+                    frames.pop(i)
+                    if not frames:
+                        del comm._unexpected[key]
+                    return int.from_bytes(p[8:12], "little")
         return None
 
     def _p2p_resume_accept(self, cur: int, timeout_s: float):
@@ -885,7 +1126,7 @@ class ProcessGroup:
         not resumable). The receiver drives: its RESUME frame names the
         cursor; this side re-queues the tail and flushes."""
         info = self._p2p_inflight.get(key)
-        orig, _, tag = key
+        orig, _, chan, tag = key
         if not self._p2p_resumable(info, orig):
             raise exc
         cur = self._ranks.index(orig)
@@ -899,14 +1140,15 @@ class ProcessGroup:
             # _p2p_progress below) — re-check the stream state every
             # iteration or the frame this loop waits for is already gone
             while info.get("state") != "resumed":
-                acked = self._take_resume_ack(wire.send_comm, tag,
+                acked = self._take_resume_ack(wire.send_comm, chan, tag,
                                               info["seq"])
                 if acked is not None:
                     _FLIGHT.record("p2p-resume", dir="tx", tag=tag,
-                                   seq=info["seq"], acked=acked)
-                    wire.queue_send(info["data"], info["hop"],
-                                    progress=self._p2p_progress,
-                                    first_frame=acked)
+                                   chan=chan, seq=info["seq"], acked=acked)
+                    with _lanes.lane_context(chan):
+                        wire.queue_send(info["data"], info["hop"],
+                                        progress=self._p2p_progress,
+                                        first_frame=acked)
                     info["state"] = "resumed"
                     break
                 self._p2p_progress()
@@ -929,22 +1171,28 @@ class ProcessGroup:
         missing tail — same frame indices, so wire tags line up with the
         sender's resumed ``queue_send``."""
         info = self._p2p_inflight.get(key)
-        orig, _, tag = key
+        orig, _, chan, tag = key
         if not self._p2p_resumable(info, orig):
             raise exc
         cur = self._ranks.index(orig)
-        _FLIGHT.record("p2p-resume", dir="rx", tag=tag, seq=info["seq"],
-                       acked=info["acked"])
+        _FLIGHT.record("p2p-resume", dir="rx", tag=tag, chan=chan,
+                       seq=info["seq"], acked=info["acked"])
         wire = self._p2p_resume_accept(cur, timeout_s)
         ack = (tag.to_bytes(4, "little") + info["seq"].to_bytes(4, "little")
-               + info["acked"].to_bytes(4, "little"))
+               + info["acked"].to_bytes(4, "little")
+               + chan.to_bytes(4, "little"))
+        # the RESUME frame itself is control: wire channel 0, whatever
+        # lane the interrupted stream rode (the payload names the lane)
         self._net.isend(wire.recv_comm,
                         self._net.reg_mr(wire.recv_comm, ack),
                         tag=_P2P_RESUME_TAG, timeout_s=timeout_s,
-                        progress=self._p2p_progress)
-        reqs = wire.post_recvs(info["nbytes"], info["hop"],
-                               into=info["got"],
-                               first_frame=info["acked"])
+                        progress=self._p2p_progress, channel=0)
+        # the re-posted tail receives match the sender's re-queued tail
+        # on (chan, tag): post them under the STREAM's lane
+        with _lanes.lane_context(chan):
+            reqs = wire.post_recvs(info["nbytes"], info["hop"],
+                                   into=info["got"],
+                                   first_frame=info["acked"])
         self._drain_p2p_recvs(wire, reqs, info, timeout_s, resumed=True)
 
     def _drain_p2p_recvs(self, wire, reqs, info: dict, timeout_s: float,
@@ -1034,13 +1282,15 @@ class ProcessGroup:
             raise ValueError(f"p2p tag must be in [0, 64), got {tag}")
         return (tag << 10) | (seq % 1024)
 
-    def _register_inflight(self, orig: int, d: str, tag: int,
+    def _register_inflight(self, orig: int, d: str, chan: int, tag: int,
                            state: dict) -> tuple | None:
         """Register an in-flight p2p message for the stream-resume
-        protocol (one registration per (peer, dir, tag) stream — a second
-        outstanding op on the same stream is not resume-covered: its
-        failure raises, exactly the pre-resume contract)."""
-        key = (orig, d, tag)
+        protocol (one registration per (peer, dir, chan, tag) stream — a
+        second outstanding op on the same stream is not resume-covered:
+        its failure raises, exactly the pre-resume contract). ``chan``
+        is the lane the stream rides — part of the stream identity, and
+        what the resume paths re-send/re-post under."""
+        key = (orig, d, chan, tag)
         if self._p2p_inflight.get(key) is not None:
             # the stream's resume slot is owned by an outstanding op —
             # including one a heal interrupted whose wait() has not run
@@ -1051,6 +1301,7 @@ class ProcessGroup:
             return None
         state.setdefault("inc", self._inc(orig))
         state.setdefault("epoch", self.epoch)
+        state.setdefault("chan", chan)
         self._p2p_inflight[key] = state
         return key
 
@@ -1075,14 +1326,17 @@ class ProcessGroup:
         x = np.asarray(x)
         data = plugin._as_bytes(x)
         orig = self._ranks[dst]
+        chan = _lanes.current_channel()
         st = self._pstate(dst)
-        # counters are per-(direction, tag): tag streams are independently
-        # ordered, so a receiver may drain tag 7 before tag 0 (the verbs
-        # layer tag-matches out of order; see _HostComm._unexpected)
-        seq = st.get(("tx", tag), 0)
-        st[("tx", tag)] = seq + 1
+        # counters are per-(direction, lane, tag): tag streams are
+        # independently ordered, so a receiver may drain tag 7 before
+        # tag 0 (the verbs layer tag-matches out of order; see
+        # _HostComm._unexpected), and two lanes sharing a user tag are
+        # still independent streams (frames match on (chan, tag))
+        seq = st.get(("tx", chan, tag), 0)
+        st[("tx", chan, tag)] = seq + 1
         hop = self._p2p_hop(tag, seq)
-        key = self._register_inflight(orig, "tx", tag,
+        key = self._register_inflight(orig, "tx", chan, tag,
                                       {"seq": seq, "data": data,
                                        "hop": hop})
         epoch0 = self.epoch
@@ -1113,11 +1367,12 @@ class ProcessGroup:
         the fenced tail is re-requested)."""
         template = np.asarray(x_like)
         orig = self._ranks[src]
+        chan = _lanes.current_channel()
         st = self._pstate(src)
-        seq = st.get(("rx", tag), 0)
+        seq = st.get(("rx", chan, tag), 0)
         hop = self._p2p_hop(tag, seq)
         got = np.empty(template.nbytes, np.uint8)
-        key = self._register_inflight(orig, "rx", tag,
+        key = self._register_inflight(orig, "rx", chan, tag,
                                       {"seq": seq, "got": got, "hop": hop,
                                        "nbytes": template.nbytes,
                                        "acked": 0})
@@ -1150,7 +1405,7 @@ class ProcessGroup:
         # number or the stream is permanently off by one
         if key is not None:
             self._p2p_inflight.pop(key, None)
-        st[("rx", tag)] = seq + 1
+        st[("rx", chan, tag)] = seq + 1
         return got.view(template.dtype).reshape(template.shape)
 
     def isend(self, x, dst: int, tag: int = 0,
@@ -1165,13 +1420,14 @@ class ProcessGroup:
         x = np.asarray(x)
         data = plugin._as_bytes(x)
         orig = self._ranks[dst]
+        chan = _lanes.current_channel()
         wire = self._p2p_wire(dst, "tx", timeout_s)
         st = self._pstate(dst)
-        seq = st.get(("tx", tag), 0)
+        seq = st.get(("tx", chan, tag), 0)
         hop = self._p2p_hop(tag, seq)  # validates tag before any claim
-        self._claim_outstanding(orig, "tx", tag)
-        st[("tx", tag)] = seq + 1
-        key = self._register_inflight(orig, "tx", tag,
+        self._claim_outstanding(orig, "tx", chan, tag)
+        st[("tx", chan, tag)] = seq + 1
+        key = self._register_inflight(orig, "tx", chan, tag,
                                       {"seq": seq, "data": data,
                                        "hop": hop})
         epoch0 = self.epoch
@@ -1187,7 +1443,7 @@ class ProcessGroup:
                            error=type(e).__name__)
             if key is not None:
                 self._p2p_inflight.pop(key, None)
-            self._release_outstanding(orig, "tx", tag)
+            self._release_outstanding(orig, "tx", chan, tag)
             raise
 
         def wait():
@@ -1205,7 +1461,7 @@ class ProcessGroup:
             finally:
                 if key is not None:
                     self._p2p_inflight.pop(key, None)
-            self._release_outstanding(orig, "tx", tag)
+            self._release_outstanding(orig, "tx", chan, tag)
 
         return P2PHandle(wait)
 
@@ -1222,18 +1478,19 @@ class ProcessGroup:
         from the last fence-acknowledged frame like :meth:`recv`."""
         template = np.asarray(x_like)
         orig = self._ranks[src]
+        chan = _lanes.current_channel()
         wire = self._p2p_wire(src, "rx", timeout_s)
         st = self._pstate(src)
-        seq = st.get(("rx", tag), 0)
+        seq = st.get(("rx", chan, tag), 0)
         hop = self._p2p_hop(tag, seq)  # validates tag before any claim
-        self._claim_outstanding(orig, "rx", tag)
-        st[("rx", tag)] = seq + 1
+        self._claim_outstanding(orig, "rx", chan, tag)
+        st[("rx", chan, tag)] = seq + 1
         nbytes = template.nbytes
         # the destination is allocated at POST time so recv_into-capable
         # nets land every frame straight into it (zero staging copies);
         # legacy planes still hand payloads back through wait()
         got = np.empty(nbytes, np.uint8)
-        key = self._register_inflight(orig, "rx", tag,
+        key = self._register_inflight(orig, "rx", chan, tag,
                                       {"seq": seq, "got": got, "hop": hop,
                                        "nbytes": nbytes, "acked": 0})
         try:
@@ -1245,7 +1502,7 @@ class ProcessGroup:
                            error=type(e).__name__)
             if key is not None:
                 self._p2p_inflight.pop(key, None)
-            self._release_outstanding(orig, "rx", tag)
+            self._release_outstanding(orig, "rx", chan, tag)
             raise
 
         def wait():
@@ -1264,30 +1521,32 @@ class ProcessGroup:
             finally:
                 if key is not None:
                     self._p2p_inflight.pop(key, None)
-            self._release_outstanding(orig, "rx", tag)
+            self._release_outstanding(orig, "rx", chan, tag)
             return got.view(template.dtype).reshape(template.shape)
 
         return P2PHandle(wait)
 
-    def _claim_outstanding(self, orig: int, d: str, tag: int) -> None:
+    def _claim_outstanding(self, orig: int, d: str, chan: int,
+                           tag: int) -> None:
         # the 10-bit seq wrap in _p2p_hop is only safe while fewer than
-        # 1024 ops are outstanding per (peer, direction, tag) stream: op
-        # k+1024 would reuse op k's wire tags while its frames are still
-        # in flight — a silent mismatch, so it is refused here. Keyed by
-        # ORIGINAL rank: a handle's wait (and so its release) may run
-        # after a heal renumbered the peer.
-        key = ("out", d, tag)
+        # 1024 ops are outstanding per (peer, direction, lane, tag)
+        # stream: op k+1024 would reuse op k's wire tags while its
+        # frames are still in flight — a silent mismatch, so it is
+        # refused here. Keyed by ORIGINAL rank: a handle's wait (and so
+        # its release) may run after a heal renumbered the peer.
+        key = ("out", d, chan, tag)
         st = self._p2p_seq.setdefault(orig, {})
         n = st.get(key, 0)
         if n >= 1023:
             raise RuntimeError(
                 f"too many outstanding p2p ops on (original rank {orig}, "
-                f"{d}, tag {tag}): wait() some handles first (seq wrap "
-                f"window)")
+                f"{d}, lane {chan}, tag {tag}): wait() some handles first "
+                f"(seq wrap window)")
         st[key] = n + 1
 
-    def _release_outstanding(self, orig: int, d: str, tag: int) -> None:
-        key = ("out", d, tag)
+    def _release_outstanding(self, orig: int, d: str, chan: int,
+                             tag: int) -> None:
+        key = ("out", d, chan, tag)
         st = self._p2p_seq.setdefault(orig, {})
         st[key] = max(0, st.get(key, 1) - 1)
 
@@ -1593,6 +1852,7 @@ class ProcessGroup:
                 f"pg/{self.group_name}/{registry}/admit/{sid}",
                 json.dumps({"epoch": epoch, "members": members,
                             "slot": slot, "ops": int(prop["ops"]),
+                            "lane_ops": prop.get("lane_ops", {}),
                             "hwm": int(prop["hwm"]), "ns": ns,
                             "grow_no": self._grow_no,
                             "watchdog": prop.get("watchdog")}))
@@ -1720,9 +1980,10 @@ class ProcessGroup:
         # merely-slow rank that posts inside the grace is admitted; one
         # that misses the window raises below and must exit (the same
         # contract shrink documents). The alive VALUE is this rank's
-        # committed-collective count: the divergence check below needs
-        # every survivor to agree on which op a retry re-executes.
-        self._client.set(f"{ns}/alive/{g}", str(self._op_seq))
+        # committed-collective stamp (total + per-lane split): the
+        # divergence check below needs every survivor to agree on which
+        # op — on WHICH LANE — a retry re-executes.
+        self._client.set(f"{ns}/alive/{g}", self._commit_stamp())
         grace_deadline = time.monotonic() + grace_s
         back = poll_backoff()
         while True:
@@ -1749,13 +2010,16 @@ class ProcessGroup:
             # before.
             dead_now = [m for m in self._ranks if m not in alive]
             promoted = self._assign_spares(dead_now, remaining)
+            ops_total, lane_split = self._commit_counts()
             prop = {"members": [m for m in self._ranks
                                 if m in alive or m in promoted],
                     "promoted": {str(s): sid
                                  for s, (sid, _) in promoted.items()},
                     "handles": {str(s): h
                                 for s, (_, h) in promoted.items()},
-                    "ops": self._op_seq, "hwm": self._orig_hwm,
+                    "ops": ops_total,
+                    "lane_ops": lane_split,
+                    "hwm": self._orig_hwm,
                     "watchdog": was_watching}
             self._client.set_if_absent(f"{ns}/members", json.dumps(prop))
         prop = json.loads(self._client.get(f"{ns}/members", remaining()))
@@ -2149,7 +2413,8 @@ class ProcessGroup:
         # grow is a deliberate op on a healthy group, so EVERY member
         # must arrive (a dead one is heal's problem, named here by the
         # deadline), and all must agree on the committed-op boundary
-        self._client.set(f"{ns}/alive/{g}", str(self._op_seq))
+        # (total AND per-lane split — see _commit_stamp)
+        self._client.set(f"{ns}/alive/{g}", self._commit_stamp())
         back = poll_backoff()
         while True:
             alive = [m for m in self._ranks
@@ -2178,12 +2443,14 @@ class ProcessGroup:
             joiners = self._pending_joiners(remaining)
             new_slots = {self._orig_hwm + i: sh
                          for i, sh in enumerate(joiners)}
+            ops_total, lane_split = self._commit_counts()
             prop = {"members": list(self._ranks) + sorted(new_slots),
                     "joined": {str(s): sid
                                for s, (sid, _) in new_slots.items()},
                     "handles": {str(s): h
                                 for s, (_, h) in new_slots.items()},
-                    "ops": self._op_seq,
+                    "ops": ops_total,
+                    "lane_ops": lane_split,
                     "hwm": self._orig_hwm + len(new_slots),
                     "watchdog": was_watching}
             self._client.set_if_absent(f"{ns}/members", json.dumps(prop))
@@ -2406,6 +2673,11 @@ class ProcessGroup:
         self.epoch = epoch
         self.last_op_epoch = epoch
         self._op_seq = int(info.get("ops", 0))
+        # the per-lane split comes with the total: a later heal's
+        # divergence stamp (_commit_stamp) must match the survivors',
+        # or an adopted-total-only spare would spuriously "diverge"
+        self._lane_ops = {int(k): int(v)
+                          for k, v in (info.get("lane_ops") or {}).items()}
         self._orig_hwm = int(info.get("hwm", max(members) + 1))
         # adopt the group's grow counter: a later grow()'s rendezvous
         # namespace (grow/g<N>) is keyed by it, and a member admitted at
@@ -2453,6 +2725,31 @@ class ProcessGroup:
         wd = info.get("watchdog")
         if wd:
             self.start_watchdog(*wd)
+
+    def _commit_counts(self) -> tuple:
+        """``(total, {str(chan): count})`` read atomically under the
+        commit lock — a concurrent lane committing mid-read would
+        otherwise resize the dict under an iterating heal leader (a
+        crash, not a heal) or pair a pre-commit total with a
+        post-commit split (a spurious divergence at the NEXT heal for
+        whoever adopts the proposal)."""
+        with self._op_lock:
+            return self._op_seq, {str(k): v
+                                  for k, v in self._lane_ops.items()}
+
+    def _commit_stamp(self) -> str:
+        """The committed-op identity a heal/grow rendezvous publishes in
+        its alive key: the total AND the per-lane split, as one
+        deterministic string (sorted JSON). String equality across
+        survivors is then exactly "same total and same per-lane
+        counts" — with concurrent lanes, two survivors can agree on the
+        total while one committed the latency lane's op and the other
+        the bulk lane's; those two would retry DIFFERENT collectives,
+        the mixed-retry case the divergence rule exists to refuse."""
+        import json
+        total, lanes_split = self._commit_counts()
+        return json.dumps({"ops": total, "lanes": lanes_split},
+                          sort_keys=True)
 
     @property
     def committed_ops(self) -> int:
@@ -2733,6 +3030,18 @@ class ProcessGroup:
         if dead:
             return f"rank(s) {dead} stopped heartbeating"
         return None
+
+    def _resume_progress(self) -> None:
+        """The net-level progress hook (``_RingWire`` runs it in every
+        blocking loop): give the p2p stream-resume service a turn while
+        this rank blocks inside a collective. Without it, a sender whose
+        interrupted stream awaits its receiver's RESUME cursor can only
+        serve at verb ENTRY — and a receiver still draining its resumed
+        tail (bounded) while the sender is already blocked in the next
+        collective is a cycle nothing breaks. Cheap when idle: one bool
+        read."""
+        if self._p2p_resume_pending:
+            self._p2p_resume_pending = self._p2p_resume_service() > 0
 
     def _check_alive(self) -> None:
         if self._p2p_resume_pending:

@@ -146,6 +146,19 @@ class WireCounters:
     #                                 stream continuation across a heal/grow
     grows: int = 0                  # grow() admissions this rank completed
     promotions: int = 0             # spare promotions this rank took part in
+    # multi-tenant lane telemetry (PR 9). The scalar pair counts the
+    # LaneGate's scheduling decisions (a pacing yield a credit lane
+    # paid; an admit deferred behind higher-priority intent/backlog);
+    # the dicts are PER-LANE counters keyed by lane NAME ("default",
+    # "bulk", ...; unregistered wire channels print as hex) so the
+    # fleet plane can attribute throughput and epoch fencing to a
+    # tenant, not just to the wire. Dict counters merge/window exactly
+    # like the scalars (nested key-wise in merge()/delta()).
+    lane_yields: int = 0            # credit-pacing yields paid by laned sends
+    lane_waits: int = 0             # admits deferred (priority or backlog)
+    channel_frames_streamed: dict = dataclasses.field(default_factory=dict)
+    channel_bytes_streamed: dict = dataclasses.field(default_factory=dict)
+    channel_frames_fenced: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         # not a dataclass field: asdict()/snapshot() must stay pure counters
@@ -165,28 +178,55 @@ class WireCounters:
             self.payload_bytes_copied += nbytes
             self.frames_copied += frames
 
-    def streamed(self, frames: int = 1, nbytes: int = 0) -> None:
+    def streamed(self, frames: int = 1, nbytes: int = 0,
+                 channel: str | None = None) -> None:
         """Record frames landed/combined in place (the zero-copy path);
         ``nbytes`` is the payload so delivered — the fleet telemetry
         plane's throughput gauge divides its window delta by the window
-        seconds to estimate live per-rank wire bandwidth."""
+        seconds to estimate live per-rank wire bandwidth. ``channel``
+        (a lane NAME) additionally attributes the delivery to its lane
+        in the per-channel counters."""
         with self._lock:
             self.frames_streamed += frames
             self.payload_bytes_streamed += nbytes
+            if channel is not None:
+                self.channel_frames_streamed[channel] = \
+                    self.channel_frames_streamed.get(channel, 0) + frames
+                self.channel_bytes_streamed[channel] = \
+                    self.channel_bytes_streamed.get(channel, 0) + nbytes
 
     def overlapped(self, frames: int = 1) -> None:
         """Record streamed frames whose transfer beat the consume loop."""
         with self._lock:
             self.frames_overlapped += frames
 
-    def fenced(self, frames: int = 1) -> None:
+    def fenced(self, frames: int = 1, channel: str | None = None) -> None:
         """Record stale-epoch frames dropped at the vtable boundary (the
         epoch fence of the self-healing process group: a frame stamped
         with a pre-heal group generation can never reach a post-heal
         reduction — it is counted here and on the flight timeline as an
-        ``epoch-fenced`` event instead of being delivered)."""
+        ``epoch-fenced`` event instead of being delivered). ``channel``
+        (a lane NAME) attributes the drop to its lane — a heal fences
+        every lane's stale frames, and the per-lane count is what lets
+        a postmortem say WHICH tenant's stream died with the epoch."""
         with self._lock:
             self.frames_fenced += frames
+            if channel is not None:
+                self.channel_frames_fenced[channel] = \
+                    self.channel_frames_fenced.get(channel, 0) + frames
+
+    def lane_yield(self, n: int = 1) -> None:
+        """Record credit-pacing yields a laned send paid (the bulk lane
+        giving the wire back every ``credit_bytes`` — see
+        ``transport.lanes.LaneGate``)."""
+        with self._lock:
+            self.lane_yields += n
+
+    def lane_wait(self, n: int = 1) -> None:
+        """Record lane admits deferred behind higher-priority intent or
+        tx backlog (the QoS scheduler actually scheduling)."""
+        with self._lock:
+            self.lane_waits += n
 
     def resumed(self, frames: int = 1) -> None:
         """Record p2p frames re-delivered by the stream-resume protocol
@@ -229,23 +269,53 @@ class WireCounters:
 
     def delta(self, since: dict) -> dict:
         """Counter movement since a ``snapshot()`` (the per-measurement
-        window the bench attaches to its records)."""
-        return {k: v - since.get(k, 0) for k, v in self.snapshot().items()}
+        window the bench attaches to its records). Per-channel dict
+        counters window key-wise — a lane absent from the base snapshot
+        deltas from zero."""
+        return self.delta_of(self.snapshot(), since)
+
+    @staticmethod
+    def delta_of(cur: dict, since: dict | None) -> dict:
+        """Window one plain snapshot dict against an earlier one —
+        scalars field-wise, per-channel dict counters key-wise. The ONE
+        definition of the windowing; :meth:`delta` and the fleet
+        publisher (which already holds a snapshot and must not re-read
+        the live counters) both ride it."""
+        if since is None:
+            return {k: (dict(v) if isinstance(v, dict) else v)
+                    for k, v in cur.items()}
+        out: dict = {}
+        for k, v in cur.items():
+            base = since.get(k)
+            if isinstance(v, dict):
+                base = base if isinstance(base, dict) else {}
+                out[k] = {lane: n - base.get(lane, 0)
+                          for lane, n in v.items()}
+            else:
+                out[k] = v - (base if isinstance(base, (int, float)) else 0)
+        return out
 
     @staticmethod
     def merge(snapshots) -> dict:
         """Cross-rank merge of ``snapshot()``/``delta()`` dicts: exact
         field-wise integer addition (every field is a count of disjoint
         per-rank events, so the fleet total IS the sum — no averaging,
-        no loss). The fleet aggregator (``obs.fleet``) merges the live
-        ranks' published snapshots through this; it is equally usable
+        no loss); per-channel dict counters add key-wise, equally exact.
+        The fleet aggregator (``obs.fleet``) merges the live ranks'
+        published snapshots through this; it is equally usable
         standalone on bench-record ``wire`` dicts in post-processing.
         Unknown keys are summed too, so a snapshot from a newer rank
         with an extra counter merges rather than raises."""
         out: dict = {}
         for s in snapshots:
             for k, v in s.items():
-                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                if isinstance(v, dict):
+                    m = out.setdefault(k, {})
+                    for lane, n in v.items():
+                        if isinstance(n, (int, float)) \
+                                and not isinstance(n, bool):
+                            m[lane] = m.get(lane, 0) + n
+                elif isinstance(v, (int, float)) and not isinstance(v, bool):
                     out[k] = out.get(k, 0) + v
         return out
 
@@ -279,6 +349,11 @@ class WireCounters:
             self.frames_resumed = 0
             self.grows = 0
             self.promotions = 0
+            self.lane_yields = 0
+            self.lane_waits = 0
+            self.channel_frames_streamed = {}
+            self.channel_bytes_streamed = {}
+            self.channel_frames_fenced = {}
             self._frame_bytes = 0
             self._pipeline_depth = 0
 
@@ -590,16 +665,20 @@ def format_table(records: list) -> str:
     WORST-RANK verb-latency P99 from the record's attached fleet
     snapshot (``extra["fleet"]["worst_p99_us"]``): a mean-looking row
     can hide one rank's tail, and the slowest rank is what a collective
-    actually waits on; ``-`` for records with no fleet telemetry."""
+    actually waits on; ``-`` for records with no fleet telemetry.
+    ``lane`` names the QoS channel a multi-tenant measurement ran on
+    (the bench_host lanes scenario tags its latency-lane rows); ``-``
+    for ordinary single-tenant rows."""
     hdr = (f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} "
-           f"{'dtype':>9} {'tier':>18} {'time(us)':>12} "
+           f"{'dtype':>9} {'tier':>18} {'lane':>9} {'time(us)':>12} "
            f"{'algbw GB/s':>11} {'busbw GB/s':>11} {'wp99(us)':>9}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
         wp99 = r.extra.get("fleet", {}).get("worst_p99_us")
         lines.append(
             f"{r.collective:>13} {r.algo:>12} {r.n_ranks:>5} {r.size_bytes:>14} "
-            f"{r.dtype:>9} {r.tier:>18} {r.mean_s * 1e6:>12.1f} "
+            f"{r.dtype:>9} {r.tier:>18} {r.extra.get('lane', '-'):>9} "
+            f"{r.mean_s * 1e6:>12.1f} "
             f"{r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f} "
             f"{wp99 if wp99 is not None else '-':>9}"
         )

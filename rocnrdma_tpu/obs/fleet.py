@@ -70,11 +70,13 @@ HEALTH_STATES = ("ok", "degraded", "healing", "resuming")
 
 # counters whose fleet totals are deterministic per chaos seed (what the
 # FLEET digest may hash): fence/resume counts are data-flow-determined,
-# grows/promotions are membership events. Stream/copy/overlap counts are
+# grows/promotions are membership events, and the per-LANE fence split
+# (channel_frames_fenced, a lane-name -> count dict) is the same
+# data-flow fact attributed per tenant. Stream/copy/overlap counts are
 # wall-clock-shaped (how many frames landed before an abort's timeout
 # fired) and stay OUT of any replay-equality contract.
 DETERMINISTIC_COUNTERS = ("frames_fenced", "frames_resumed", "grows",
-                          "promotions")
+                          "promotions", "channel_frames_fenced")
 
 
 def _ns(group: str) -> str:
@@ -125,9 +127,9 @@ class FleetAgent:
             seq = self._seq
             window_s = (now - self._last_t
                         if self._last_t is not None else 0.0)
-            delta = ({k: v - self._last_wire.get(k, 0)
-                      for k, v in wire.items()}
-                     if self._last_wire is not None else dict(wire))
+            # the one windowing definition (scalars field-wise, per-lane
+            # dicts key-wise), applied to the snapshot already in hand
+            delta = WireCounters.delta_of(wire, self._last_wire)
         orig = pg.global_ranks[pg.rank] if pg.global_ranks else -1
         return {
             "v": 1,
@@ -215,6 +217,7 @@ def aggregate(snapshots, epoch: int, members: list) -> dict:
     p99 = {v: bucket_percentile_us(m["buckets"], 0.99)
            for v, m in verb_merged.items()}
     plane_GBps: dict[str, float] = {}
+    channel_GBps: dict[str, float] = {}
     ranks: dict[str, dict] = {}
     worst_p99 = 0
     for orig in sorted(live):
@@ -225,6 +228,16 @@ def aggregate(snapshots, epoch: int, members: list) -> dict:
         if win > 0:
             plane_GBps[s.get("plane", "?")] = round(
                 plane_GBps.get(s.get("plane", "?"), 0.0) + rate, 6)
+            # the multi-tenant split of the same gauge: each rank's
+            # windowed per-LANE streamed bytes (keyed by lane name),
+            # summed across ranks — the per-channel fleet throughput
+            # the QoS scheduler is judged by
+            per_chan = s.get("wire_delta", {}).get(
+                "channel_bytes_streamed", {})
+            if isinstance(per_chan, dict):
+                for lane, nb in per_chan.items():
+                    channel_GBps[lane] = round(
+                        channel_GBps.get(lane, 0.0) + nb / win / 1e9, 6)
         rank_p99 = max(
             (bucket_percentile_us(m["buckets"], 0.99)
              for m in s.get("verb_latency", {}).values()), default=0)
@@ -251,6 +264,7 @@ def aggregate(snapshots, epoch: int, members: list) -> dict:
         "heals": max((s.get("heals", 0) for s in live.values()), default=0),
         "wire_totals": wire_totals,
         "plane_GBps": plane_GBps,
+        "channel_GBps": channel_GBps,
         "verb_latency": verb_merged,
         "verb_p50_us": p50,
         "verb_p99_us": p99,
@@ -282,6 +296,10 @@ def format_fleet(snap: dict) -> str:
         "  throughput: " + (" ".join(
             f"{p}={gb:.3f} GB/s" for p, gb in sorted(
                 snap["plane_GBps"].items())) or "(no window yet)"),
+        "  lanes: " + (" ".join(
+            f"{lane}={gb:.3f} GB/s" for lane, gb in sorted(
+                snap.get("channel_GBps", {}).items()))
+            or "(no laned traffic in window)"),
     ]
     hdr = (f"  {'orig':>5} {'rank':>5} {'health':>9} {'GB/s':>8} "
            f"{'p99(us)':>8} {'flight':>12}")

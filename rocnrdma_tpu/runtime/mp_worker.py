@@ -218,8 +218,20 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
     oracle of the then-current membership (keyed by ORIGINAL rank, so
     promoted spares and grow joiners contribute under their adopted
     identities), and — with ``--grow-round`` — a ``grow()`` issued by
-    every member at that round's committed-op boundary."""
+    every member at that round's committed-op boundary.
+
+    ``--lanes`` moves the round loop onto the multi-tenant lane
+    surface: the allreduces run on a HIGH-PRIORITY "latency" channel
+    and TWO neighbour pings ride per round — one on a paced "bulk"
+    channel, one on the latency channel — so a kill provably strands
+    in-flight frames in BOTH lanes (the per-lane fence counts the
+    LANEFENCED acceptance line asserts), while the latency lane's
+    collective still heals and retries exactly-once."""
     import numpy as np
+    lat = bulkch = None
+    if getattr(args, "lanes", False):
+        lat = pg.channel("latency", priority=8)
+        bulkch = pg.channel("bulk", priority=0, credit_bytes=1 << 20)
     for rnd in range(start, args.rounds):
         if can_grow and args.grow_round is not None \
                 and rnd == args.grow_round:
@@ -234,7 +246,8 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
         # frames the heal's epoch bump must fence (what the
         # `FENCED > 0` acceptance asserts) and the resume protocol
         # must then re-deliver between CONTINUOUS survivors (RESUMED)
-        ping = None
+        pings = []
+        pred_gid = None
         if pg.world_size > 1 and not (skip_first_ping and rnd == start):
             # a promoted spare resumes INTO an interrupted round: its
             # peers are already blocked in the retried collective and
@@ -245,13 +258,37 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
             succ = (pg.rank + 1) % pg.world_size
             pred = (pg.rank - 1) % pg.world_size
             pred_gid = pg.global_ranks[pred]
-            ping = pg.batch_isend_irecv([
-                ("recv", np.empty(64, np.int64), pred, rnd % 60),
-                ("send", _chaos_input(args.seed, my_orig, rnd, 64),
-                 succ, rnd % 60),
-            ], timeout_s=5.0)
+
+            def post_ping(surface, tag):
+                # the ping's timeout also budgets its heal-time stream
+                # RESUME: the lanes variant resumes TWO streams per
+                # survivor pair, so (like the collective above) it gets
+                # double the headroom — a load-stalled resume that falls
+                # back to a stream restart would flip the RESUMED
+                # totals the FLEET digest replays
+                t = 10.0 if lat is not None else 5.0
+                return surface.batch_isend_irecv([
+                    ("recv", np.empty(64, np.int64), pred, tag),
+                    ("send", _chaos_input(args.seed, my_orig, rnd, 64),
+                     succ, tag),
+                ], timeout_s=t)
+
+            if lat is None:
+                pings.append(post_ping(pg, rnd % 60))
+            else:
+                # two tenants' streams in flight across the collective:
+                # the kill round strands frames in BOTH lanes
+                pings.append(post_ping(bulkch, rnd % 30))
+                pings.append(post_ping(lat, 30 + rnd % 30))
         local = _chaos_input(args.seed, my_orig, rnd, args.size)
-        got = pg.all_reduce(local, timeout_s=5.0)
+        # the collective's timeout also budgets a heal it triggers
+        # (heal deadline = timeout + grace): the lanes variant does
+        # strictly more work inside the heal window (TWO p2p streams
+        # resume per survivor pair), so it gets double the headroom —
+        # fault decisions are op-keyed, never time-keyed, so the wider
+        # deadline cannot perturb the replay digests
+        got = (lat.all_reduce(local, timeout_s=10.0) if lat is not None
+               else pg.all_reduce(local, timeout_s=5.0))
         # the oracle of the CURRENT membership: contributions are
         # keyed by ORIGINAL rank (pg.global_ranks survives re-
         # ranking), so a post-heal round sums exactly the members —
@@ -265,7 +302,7 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
                   f"epoch {pg.last_op_epoch} members {members}",
                   flush=True)
             return 5
-        if ping is not None:
+        for ping in pings:
             try:
                 heard = ping[0].wait()
                 ping[1].wait()
@@ -702,9 +739,17 @@ def _heal_chaos_main(args) -> int:
         print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
         status = 4
     finally:
+        import json as _json
         snap = WIRE.snapshot()
         print(f"FENCED {snap['frames_fenced']}", flush=True)
         print(f"RESUMED {snap['frames_resumed']}", flush=True)
+        # the per-LANE fence split (lane name -> frames fenced): the
+        # lane x epoch acceptance line — a kill under --lanes must
+        # strand (and fence) frames in BOTH tenants' lanes, and the
+        # split is data-flow-determined, so it replays per seed
+        print(f"LANEFENCED "
+              f"{_json.dumps(snap['channel_frames_fenced'], sort_keys=True)}",
+              flush=True)
         print(f"FAULTS {sched.counters.to_json()}", flush=True)
         print(f"FAULTLOG {sched.fingerprint()}", flush=True)
         print(f"HEALLOG {_heal_log()}", flush=True)
@@ -772,6 +817,12 @@ def main(argv=None) -> int:
                    help="kill-and-heal: process id of a spare that "
                         "hard-dies the moment its admit record lands "
                         "(the mid-promotion death case)")
+    p.add_argument("--lanes", action="store_true",
+                   help="kill-and-heal: run the round loop on the "
+                        "multi-tenant lane surface — allreduces on a "
+                        "high-priority 'latency' channel, a second ping "
+                        "stream on a paced 'bulk' channel (the lane x "
+                        "epoch chaos case; prints LANEFENCED)")
     args = p.parse_args(argv)
 
     if args.task == "hang":

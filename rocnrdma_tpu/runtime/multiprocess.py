@@ -97,6 +97,7 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                 grow_round: int | None = None,
                 die_at_promotion: int | None = None,
                 device_heal_fail: bool = False,
+                lanes: bool = False,
                 _retry_left: int = 1) -> list[WorkerResult]:
     """Spawn ``n`` worker processes running ``task``; wait for all.
 
@@ -146,6 +147,11 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
         extra += ["--jax-coordinator", f"127.0.0.1:{jax_port}"]
     if device_heal_fail:
         extra += ["--device-heal-fail"]
+    if lanes:
+        # kill-and-heal: the latency allreduces ride a high-priority
+        # channel and a second ping stream rides a paced bulk channel
+        # (the lane x epoch chaos surface)
+        extra += ["--lanes"]
     # release the reservations at the last instant: the spawned rank 0
     # (and the re-elected device coordinator) bind these ports next
     res.close()
@@ -173,5 +179,5 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
         return run_workers(n, task, timeout_s, fault_rank, seed, rounds,
                            size, kill_ranks, kill_ops, spares, join,
                            grow_round, die_at_promotion, device_heal_fail,
-                           _retry_left=_retry_left - 1)
+                           lanes, _retry_left=_retry_left - 1)
     return results

@@ -25,6 +25,12 @@ from rocnrdma_tpu.transport.bootstrap import (  # noqa: F401
     bootstrap_ring,
 )
 from rocnrdma_tpu.transport.faults import FaultNet, FaultSchedule  # noqa: F401
+from rocnrdma_tpu.transport.lanes import (  # noqa: F401
+    Lane,
+    LaneRegistry,
+    lane_context,
+    lane_id,
+)
 from rocnrdma_tpu.transport.plugin import (  # noqa: F401
     DeviceMeshNet,
     HostQPNet,

@@ -54,6 +54,7 @@ import random
 
 from rocnrdma_tpu.metrics import FaultCounters
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
+from rocnrdma_tpu.transport import lanes as _lanes
 from rocnrdma_tpu.transport.plugin import Request
 
 
@@ -95,6 +96,19 @@ class FaultSchedule:
     #   kill-and-heal chaos run replays deterministically — every peer
     #   sees byte-for-byte the same pre-death traffic on every run
     close_drop_p: float = 0.0       # prob a close_comm skips teardown
+    # per-CHANNEL faults (the multi-tenant lane surface): knobs keyed by
+    # lane NAME so one tenant's wire can misbehave while its neighbours'
+    # stays clean. Each lane's decisions advance that lane's OWN op/draw
+    # streams (seeded ``(seed, rank, "chan:<lane>:<class>")``), so the
+    # injection log stays replay-equal per seed however the lanes'
+    # verbs interleave — the same stream-local-coordinate discipline as
+    # :meth:`record` documents for the resume service.
+    chan_partition_after_ops: dict | None = None  # lane -> blackhole past N
+    #   of THAT LANE's data ops (the global partition knob's per-tenant
+    #   twin: sends complete locally, never arrive; recvs never complete)
+    chan_test_delay_p: dict | None = None   # lane -> completion-delay prob
+    #   (overrides the global test_delay_p for that lane's receives;
+    #   draws come from the lane's own rng stream)
 
     def __post_init__(self):
         self.counters = FaultCounters()
@@ -106,6 +120,24 @@ class FaultSchedule:
         self._test_draws = 0
         self._close_draws = 0
         self._rngs: dict[str, random.Random] = {}
+        # per-lane streams (see the chan_* knobs): each lane's own data-op
+        # and completion-draw counters — the coordinates its injections
+        # are logged at, replay-stable under any cross-lane interleaving
+        self.chan_partition_after_ops = dict(self.chan_partition_after_ops
+                                             or {})
+        self.chan_test_delay_p = dict(self.chan_test_delay_p or {})
+        self._chan_ops: dict[str, int] = {}
+        self._chan_test_draws: dict[str, int] = {}
+        # multi-tenant lanes run data verbs from CONCURRENT threads over
+        # one FaultNet: the decision state (op counters, draw counters,
+        # rng streams, the log) mutates under this lock, or a lost
+        # `ops += 1` silently shifts every op-keyed kill/partition and
+        # corrupts the replay contract. (The lock makes the decisions
+        # race-free; op-keyed determinism across THREADS additionally
+        # needs each thread's traffic on its own lane — the per-lane
+        # streams — which is the documented chaos discipline.)
+        import threading
+        self._lock = threading.RLock()
 
     def _rng(self, stream: str) -> random.Random:
         # string seeding is sha512-based (process-stable), unlike hash()
@@ -199,9 +231,16 @@ class FaultSchedule:
                    f"{self.accept_refusals}"
         return None
 
-    def op_fault(self, verb: str) -> str | None:
+    def op_fault(self, verb: str, lane: str | None = None) -> str | None:
         """Called once per data op (isend/irecv); returns the death mode
-        in force, if any."""
+        in force, if any. ``lane`` (a lane NAME) additionally consults
+        the per-channel knobs — a lane's partition is decided on that
+        lane's OWN op counter, so the decision is independent of how
+        other lanes' traffic interleaves (replay-equal per seed)."""
+        with self._lock:
+            return self._op_fault_locked(verb, lane)
+
+    def _op_fault_locked(self, verb: str, lane: str | None) -> str | None:
         self.ops += 1
         if self.kill_after_ops is not None and self.ops >= self.kill_after_ops:
             # the hard kill: mid-collective, mid-frame-stream, skipping
@@ -218,11 +257,37 @@ class FaultSchedule:
                 and self.ops > self.partition_after_ops):
             self.record("partitioned", verb)
             return "partitioned"
+        if lane is not None and self.chan_partition_after_ops:
+            lim = self.chan_partition_after_ops.get(lane)
+            if lim is not None:
+                n = self._chan_ops.get(lane, 0) + 1
+                self._chan_ops[lane] = n
+                if n > lim:
+                    self.record("chan-partitioned", (lane, verb), coord=n)
+                    return "partitioned"
         return None
 
-    def test_delay(self) -> int:
+    def test_delay(self, lane: str | None = None) -> int:
         """Extra not-done ``test()`` polls to inject on this irecv
-        (0 = report truthfully)."""
+        (0 = report truthfully). A lane named in ``chan_test_delay_p``
+        draws from its OWN rng stream and draw counter — the global
+        stream never advances for it, so default-lane replay logs are
+        byte-identical with and without laned traffic alongside."""
+        with self._lock:
+            return self._test_delay_locked(lane)
+
+    def _test_delay_locked(self, lane: str | None) -> int:
+        if lane is not None and lane in self.chan_test_delay_p:
+            p = self.chan_test_delay_p[lane]
+            rng = self._rng(f"chan:{lane}:test")
+            n = self._chan_test_draws.get(lane, 0) + 1
+            self._chan_test_draws[lane] = n
+            if p and rng.random() < p:
+                lo, hi = self.test_delay_polls
+                d = rng.randint(lo, hi)
+                self.record("chan-test-delayed", (lane, d), coord=n)
+                return d
+            return 0
         rng = self._rng("test")
         self._test_draws += 1
         if self.test_delay_p and rng.random() < self.test_delay_p:
@@ -296,6 +361,28 @@ class FaultNet:
     def reg_mr(self, comm, buffer):
         return self.inner.reg_mr(comm, buffer)
 
+    def open_lane(self, name: str, priority: int = 0,
+                  credit_bytes: int | None = None):
+        """Passthrough: lane registration is local configuration (the
+        per-channel fault knobs key by lane NAME and are consulted by
+        the data verbs below — registering a lane injects nothing)."""
+        return self.inner.open_lane(name, priority=priority,
+                                    credit_bytes=credit_bytes)
+
+    def _lane(self, kw: dict) -> str:
+        """The lane NAME of a data verb call: the explicit ``channel``
+        kwarg if the caller passed one, else the calling thread's lane
+        context — resolved to a name through the inner net's registry
+        (the per-channel knobs key by name so chaos configs read
+        "bulk", not a hash)."""
+        chan = kw.get("channel")
+        if chan is None:
+            chan = _lanes.current_channel()
+        reg = getattr(self.inner, "lanes", None)
+        if reg is not None:
+            return reg.label(chan)
+        return _lanes.fallback_label(chan)
+
     def set_epoch(self, epoch: int) -> None:
         """Passthrough: the epoch fence lives at the inner plane's comm
         boundary (``_HostComm._pump``), BELOW fault injection — injected
@@ -305,8 +392,8 @@ class FaultNet:
         timing)."""
         self.inner.set_epoch(epoch)
 
-    def _dead_mode(self, verb: str) -> str | None:
-        mode = self.schedule.op_fault(verb)
+    def _dead_mode(self, verb: str, lane: str | None = None) -> str | None:
+        mode = self.schedule.op_fault(verb, lane=lane)
         if mode == "dead":
             raise OSError(
                 f"faultnet: comm dead (injected death after "
@@ -314,7 +401,7 @@ class FaultNet:
         return mode
 
     def isend(self, comm, mr, tag: int = 0, **kw) -> Request:
-        if self._dead_mode("isend") == "partitioned":
+        if self._dead_mode("isend", self._lane(kw)) == "partitioned":
             # blackhole: complete locally, deliver nowhere — the PEER's
             # recv (or this rank's next recv) must time out, named
             size = len(mr)
@@ -322,10 +409,11 @@ class FaultNet:
         return self.inner.isend(comm, mr, tag=tag, **kw)
 
     def irecv(self, comm, *args, **kw) -> Request:
-        if self._dead_mode("irecv") == "partitioned":
+        lane = self._lane(kw)
+        if self._dead_mode("irecv", lane) == "partitioned":
             return Request(_test=lambda: (False, 0, None))  # never completes
         req = self.inner.irecv(comm, *args, **kw)
-        hold = self.schedule.test_delay()
+        hold = self.schedule.test_delay(lane=lane)
         if hold == 0:
             return req
 
@@ -350,11 +438,15 @@ class FaultNet:
         streaming collectives reduce over is byte-identical with and
         without the delay (what keeps chaos runs bitwise-correct AND
         replay-equal: every decision below draws from the schedule's own
-        op-sequence streams, never from arrival timing)."""
-        if self._dead_mode("irecv_into") == "partitioned":
+        op-sequence streams, never from arrival timing). Per-channel
+        knobs see the message's lane (explicit ``channel`` kwarg or the
+        thread's lane context), so one tenant's receives can stall or
+        blackhole while its neighbours' flow clean."""
+        lane = self._lane(kw)
+        if self._dead_mode("irecv_into", lane) == "partitioned":
             return Request(_test=lambda: (False, 0, None))  # never completes
         req = self.inner.irecv_into(comm, buf, tag=tag, **kw)
-        hold = self.schedule.test_delay()
+        hold = self.schedule.test_delay(lane=lane)
         if hold == 0:
             return req
 
@@ -389,7 +481,7 @@ class FaultNet:
         return self.inner.alloc_mr(comm, nbytes)
 
     def iwrite(self, comm, rkey, mr, **kw) -> Request:
-        if self._dead_mode("iwrite") == "partitioned":
+        if self._dead_mode("iwrite", self._lane(kw)) == "partitioned":
             # blackhole: the put "completes" locally but never lands — the
             # peer's doorbell poll (or credit wait) must time out, named
             size = memoryview(mr).nbytes
@@ -397,7 +489,7 @@ class FaultNet:
         return self.inner.iwrite(comm, rkey, mr, **kw)
 
     def iread(self, comm, rkey, nbytes: int, **kw) -> Request:
-        if self._dead_mode("iread") == "partitioned":
+        if self._dead_mode("iread", self._lane(kw)) == "partitioned":
             return Request(_test=lambda: (False, 0, None))  # never completes
         return self.inner.iread(comm, rkey, nbytes, **kw)
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import threading
 import time
 import uuid
 
@@ -41,6 +42,7 @@ import numpy as np
 
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
+from rocnrdma_tpu.transport import lanes as _lanes
 from rocnrdma_tpu.transport.backoff import Backoff
 
 
@@ -160,11 +162,24 @@ class _HostComm:
         # boundary (_pump) — the fence that keeps late packets from
         # pre-heal wiring out of post-heal reductions.
         self.epoch = getattr(net, "_epoch", 0) if net is not None else 0
-        # tag -> payloads; entries are ZERO-COPY memoryviews of the posted
-        # receive buffers (poll_cq's contract) with the 8-byte tag+epoch
-        # header sliced off — a consumer that lands/combines them in place
-        # (irecv_into) recycles the backing bytearray via _recycle
-        self._unexpected: dict[int, list] = {}
+        # the comm's thread discipline: multi-tenant lanes run CONCURRENT
+        # collectives over one comm from separate threads, so every slice
+        # of work that touches comm/QP state — a pump, a post attempt, a
+        # probe's stash pop — holds this lock. Re-entrant: a locked pump
+        # may call back into _lg_ensure, which posts (and pumps) on the
+        # same comm. Blocking waits NEVER hold it (each loop iteration
+        # locks, releases, then pauses), and progress hooks are called
+        # unlocked — two comms' locks are never held at once, so lane
+        # threads pumping each other's comms cannot deadlock.
+        self._lock = threading.RLock()
+        # (chan, tag) -> payloads; entries are ZERO-COPY memoryviews of
+        # the posted receive buffers (poll_cq's contract) with the
+        # 12-byte tag+epoch+chan header sliced off — a consumer that
+        # lands/combines them in place (irecv_into) recycles the backing
+        # bytearray via _recycle. The channel half of the key is the
+        # lane fence: two collectives in flight on one comm match only
+        # their own lane's frames.
+        self._unexpected: dict[tuple, list] = {}
         self._posted = 0  # receive buffers posted but not yet completed
         # recycled frame buffers, one size class (MAX_FRAME + 8): the
         # steady state of the streaming ring collectives posts receives
@@ -180,6 +195,8 @@ class _HostComm:
         # large-message rendezvous state (HostQPNet's LG protocol):
         self._lg_mr = None          # MY arena (I am the receiver side)
         self._lg_dead = False       # arena alloc failed; LG unavailable
+        self._lg_announced = False  # announce queued this epoch (reset by
+        #                             the fence; a peer's REQ re-queues)
         self._lg_peer = None        # (rkey, size) of the PEER's arena
         self._lg_head = 0           # my bump pointer into the peer arena
         self._lg_outstanding = 0    # bytes put but not yet ACKed back
@@ -193,29 +210,50 @@ class _HostComm:
         sends from here on — would strand the peer's credit forever;
         every verb on the comm pumps, so every verb now drains the
         queue). ``close`` gives it one last bounded shot."""
-        while self._lg_ack_queue:
-            wr = self.qp.post_send(self._lg_ack_queue[0])
-            if wr == -1:  # ring full: retry at the next pump
-                return
-            if wr < -1:
-                raise RuntimeError("host net: connection died while "
-                                   "returning large-message credit")
-            self._lg_ack_queue.pop(0)
+        with self._lock:
+            while self._lg_ack_queue:
+                wr = self.qp.post_send(self._lg_ack_queue[0])
+                if wr == -1:  # ring full: retry at the next pump
+                    return
+                if wr < -1:
+                    raise RuntimeError("host net: connection died while "
+                                       "returning large-message credit")
+                self._lg_ack_queue.pop(0)
 
-    def _hdr(self, tag: int) -> bytes:
-        """The 8-byte wire header every framed message carries:
-        ``tag(4) | epoch(4)``, both little-endian. One builder so the
-        send paths (isend, LG announce/credit/REQ/descriptor) can never
-        disagree with the parser in ``_pump``."""
+    def _hdr(self, tag: int, channel: int = 0) -> bytes:
+        """The 12-byte wire header every framed message carries:
+        ``tag(4) | epoch(4) | chan(4)``, all little-endian. One builder
+        so the send paths (isend, LG announce/credit/REQ/descriptor) can
+        never disagree with the parser in ``_pump``. ``channel`` is the
+        message's lane id (``transport.lanes``); LG protocol control
+        rides channel 0 — the arena is comm-global state, not a
+        tenant's."""
         return (tag.to_bytes(4, "little")
-                + self.epoch.to_bytes(4, "little"))
+                + self.epoch.to_bytes(4, "little")
+                + channel.to_bytes(4, "little"))
+
+    def _label(self, channel: int) -> str:
+        """The lane name behind a wire channel id (per-lane counters and
+        fence events key by name, so telemetry reads "bulk", not a
+        hash) — resolved through the owning net's registry when there
+        is one, else the one shared fallback spelling."""
+        reg = getattr(self._net, "lanes", None)
+        if reg is not None:
+            return reg.label(channel)
+        return _lanes.fallback_label(channel)
 
     def _pump(self):
-        # drain the wire; stash every arrived message by tag
+        # drain the wire; stash every arrived message by (chan, tag).
+        # The whole drain holds the comm lock (lane threads pump
+        # concurrently); _lg_ensure re-enters it safely.
+        with self._lock:
+            return self._pump_locked()
+
+    def _pump_locked(self):
         if self._lg_ack_queue:
             self._flush_lg_acks()
         if self._posted < 4:
-            self.qp.post_recv(HostQPNet.MAX_FRAME + 8,
+            self.qp.post_recv(HostQPNet.MAX_FRAME + HostQPNet.HDR,
                               buf=self._pool.pop() if self._pool else None)
             self._posted += 1
         got = False
@@ -227,20 +265,24 @@ class _HostComm:
                 if c.status != native.OK:
                     raise OSError(
                         f"host net: truncated message "
-                        f"(> {HostQPNet.MAX_FRAME + 8} B frame)")
+                        f"(> {HostQPNet.MAX_FRAME + HostQPNet.HDR} B frame)")
                 tag = int.from_bytes(payload[:4], "little")
                 epoch = int.from_bytes(payload[4:8], "little")
+                chan = int.from_bytes(payload[8:12], "little")
                 if epoch != self.epoch:
                     # THE epoch fence: a frame from another group
                     # generation (pre-heal wiring, or an aborted
                     # collective's retry-colliding tags) is dropped at
-                    # the vtable boundary — counted, on the flight
-                    # timeline, never delivered
-                    _WIRE.fenced()
-                    _FLIGHT.record("epoch-fenced", tag=tag,
+                    # the vtable boundary — counted (per lane, so a
+                    # postmortem can say WHOSE frames died with the
+                    # generation), on the flight timeline, never
+                    # delivered. The fence is lane-agnostic: every
+                    # lane's stale frames drop the same way.
+                    _WIRE.fenced(channel=self._label(chan))
+                    _FLIGHT.record("epoch-fenced", tag=tag, chan=chan,
                                    frame_epoch=epoch, epoch=self.epoch,
-                                   nbytes=len(payload) - 8)
-                    self._recycle(payload[8:])
+                                   nbytes=len(payload) - HostQPNet.HDR)
+                    self._recycle(payload[HostQPNet.HDR:])
                     continue
                 if tag == HostQPNet._LG_REQ_TAG:
                     # peer blocked in a large send wants my arena announce;
@@ -248,7 +290,8 @@ class _HostComm:
                     # pumps — no mutation under the live CQ iteration)
                     arena_requested = True
                     continue
-                self._unexpected.setdefault(tag, []).append(payload[8:])
+                self._unexpected.setdefault((chan, tag), []).append(
+                    payload[HostQPNet.HDR:])
                 got = True
             elif c.opcode in (native.OP_WRITE, native.OP_READ):
                 self._onesided_done[c.wr_id] = (
@@ -256,7 +299,11 @@ class _HostComm:
                 while len(self._onesided_done) > self._ONESIDED_CAP:
                     self._onesided_done.pop(next(iter(self._onesided_done)))
         if arena_requested and self._net is not None:
-            self._net._lg_ensure(self)
+            # the peer explicitly asked: (re-)queue the announce — an
+            # earlier one may have been dropped by the epoch fence on
+            # either end. Non-blocking (deferred control queue), so
+            # running it under the pump's lock is fine.
+            self._net._lg_ensure(self, announce=True)
         return got
 
     def _recycle(self, payload) -> None:
@@ -266,13 +313,15 @@ class _HostComm:
         pooled; anything else just drops to the GC as before."""
         buf = getattr(payload, "obj", None)
         if (isinstance(buf, bytearray)
-                and len(buf) == HostQPNet.MAX_FRAME + 8
-                and len(self._pool) < self._POOL_CAP):
-            try:
-                payload.release()  # drop the export; post_recv re-borrows
-            except BufferError:
-                return  # a live export still aliases it: leave it to the GC
-            self._pool.append(buf)
+                and len(buf) == HostQPNet.MAX_FRAME + HostQPNet.HDR):
+            with self._lock:
+                if len(self._pool) >= self._POOL_CAP:
+                    return
+                try:
+                    payload.release()  # drop the export; post_recv re-borrows
+                except BufferError:
+                    return  # a live export still aliases it: GC's problem
+                self._pool.append(buf)
 
     def close(self):
         # one bounded last shot at returning deferred credit: the peer's
@@ -304,17 +353,22 @@ class HostQPNet:
     reference does during plugin bootstrap.
     """
 
-    # One message per posted recv buffer, minus the 8-byte header
-    # (``tag(4) | epoch(4)`` — the epoch half is the group-generation
-    # fence of the self-healing process group). 512 KiB (r3, VERDICT r2
-    # item 9 — was 64 KiB): at MiB message sizes the msg plane's cost is
-    # per-FRAME Python work (tag pack, post, poll), so 8x fewer frames is
-    # 8x less of it; the shm ring's default capacity below holds several
-    # frames (pages are lazily allocated — an unused ring costs nothing),
-    # and _pump's 4 posted buffers stay a modest 2 MiB per comm. Messages
-    # past LG_MIN below no longer chunk at all — see the large-message
-    # rendezvous.
-    MAX_FRAME = (1 << 19) - 8
+    # The wire header every framed message carries: ``tag(4) | epoch(4)
+    # | chan(4)`` — tag identity, the group-generation fence of the
+    # self-healing process group, and the multi-tenant LANE the frame
+    # rides (``transport.lanes``; 0 = the default lane every un-laned
+    # verb stamps).
+    HDR = 12
+
+    # One message per posted recv buffer, minus the header. 512 KiB (r3,
+    # VERDICT r2 item 9 — was 64 KiB): at MiB message sizes the msg
+    # plane's cost is per-FRAME Python work (tag pack, post, poll), so
+    # 8x fewer frames is 8x less of it; the shm ring's default capacity
+    # below holds several frames (pages are lazily allocated — an unused
+    # ring costs nothing), and _pump's 4 posted buffers stay a modest
+    # 2 MiB per comm. Messages past LG_MIN below no longer chunk at all
+    # — see the large-message rendezvous.
+    MAX_FRAME = (1 << 19) - 12
 
     # Large-message rendezvous (r4, VERDICT r3 next #8): a message of
     # >= LG_MIN bytes on a one-sided-capable plane is routed INSIDE
@@ -367,6 +421,11 @@ class HostQPNet:
         self._inited = False
         self._comms: list[_HostComm] = []
         self._epoch = 0  # the group generation new comms inherit
+        # the multi-tenant lane table + admission gate (transport.lanes):
+        # a net with only the default lane open pays one length check per
+        # send — the single-tenant wire is untouched
+        self.lanes = _lanes.LaneRegistry()
+        self._lane_gate = _lanes.LaneGate(self.lanes)
 
     # -- vtable ------------------------------------------------------------
 
@@ -375,6 +434,21 @@ class HostQPNet:
         if not native.available():
             raise OSError("native rqp library unavailable (no g++?)")
         self._inited = True
+
+    def open_lane(self, name: str, priority: int = 0,
+                  credit_bytes: int | None = None) -> "_lanes.Lane":
+        """Open (or idempotently re-open) a named QoS lane on this net —
+        the vtable half of ``ProcessGroup.channel``. The returned
+        :class:`~rocnrdma_tpu.transport.lanes.Lane` carries the wire
+        channel id (a stable hash of the name — every rank derives the
+        same id with no rendezvous), the scheduling ``priority``
+        (higher preempts lower at the send-admission gate), and the
+        pacing ``credit_bytes`` (bytes the lane may post between
+        yields; None = unpaced). A conflicting re-open raises — two
+        tenants silently disagreeing on a lane's priority is a
+        scheduling bug, not a merge."""
+        return self.lanes.open(name, priority=priority,
+                               credit_bytes=credit_bytes)
 
     def set_epoch(self, epoch: int) -> None:
         """Advance the group generation (the elastic-recovery fence,
@@ -403,30 +477,42 @@ class HostQPNet:
             comm._pump()
         except Exception:
             pass
-        stale = sum(len(v) for v in comm._unexpected.values())
-        if stale:
-            _WIRE.fenced(stale)
-            _FLIGHT.record("epoch-fenced", stashed=stale,
-                           epoch=self._epoch)
-            for payloads in comm._unexpected.values():
-                for payload in payloads:
-                    comm._recycle(payload)
-        comm._unexpected.clear()
-        comm.epoch = self._epoch
-        # LG sender-side credit restarts at offset 0 — safe because the
-        # receiver's unconsumed stale puts are dead bytes (single writer
-        # per direction + QP FIFO: any post-heal put overwrites them
-        # before its own descriptor frame can be consumed), and queued
-        # credit ACKs for stale consumption are dropped with the epoch
-        comm._lg_head = 0
-        comm._lg_outstanding = 0
-        comm._lg_ack_queue.clear()
-        # the put-ring doorbell state (hop counters, slot MRs) is
-        # generation-bound: drop the cache so the next rdma collective
-        # re-registers fresh MRs (bump-allocated; stale doorbell writes
-        # land in the abandoned regions, harmlessly)
-        if getattr(comm, "_rdma_ring", None) is not None:
-            comm._rdma_ring = None
+        with comm._lock:
+            stale = sum(len(v) for v in comm._unexpected.values())
+            if stale:
+                # count the fence PER LANE: every lane's stale frames
+                # drop with the generation, and the per-channel counter
+                # is what lets a heal's postmortem name the tenant
+                per_chan: dict[int, int] = {}
+                for (chan, _tag), payloads in comm._unexpected.items():
+                    per_chan[chan] = per_chan.get(chan, 0) + len(payloads)
+                for chan, n in sorted(per_chan.items()):
+                    _WIRE.fenced(n, channel=comm._label(chan))
+                _FLIGHT.record("epoch-fenced", stashed=stale,
+                               chans=len(per_chan), epoch=self._epoch)
+                for payloads in comm._unexpected.values():
+                    for payload in payloads:
+                        comm._recycle(payload)
+            comm._unexpected.clear()
+            comm.epoch = self._epoch
+            # LG sender-side credit restarts at offset 0 — safe because
+            # the receiver's unconsumed stale puts are dead bytes (single
+            # writer per direction + QP FIFO: any post-heal put
+            # overwrites them before its own descriptor frame can be
+            # consumed), and queued credit ACKs for stale consumption are
+            # dropped with the epoch
+            comm._lg_head = 0
+            comm._lg_outstanding = 0
+            comm._lg_ack_queue.clear()
+            # a queued-but-unsent announce died with the queue: let the
+            # next ensure (or a peer's REQ) re-queue it
+            comm._lg_announced = False
+            # the put-ring doorbell state (hop counters, slot MRs) is
+            # generation-bound: drop the cache so the next rdma collective
+            # re-registers fresh MRs (bump-allocated; stale doorbell
+            # writes land in the abandoned regions, harmlessly)
+            if getattr(comm, "_rdma_ring", None) is not None:
+                comm._rdma_ring = None
 
     def devices(self) -> int:
         return 1
@@ -494,12 +580,23 @@ class HostQPNet:
         return view
 
     def isend(self, comm: _HostComm, mr: memoryview, tag: int = 0,
-              timeout_s: float = 10.0, progress=None) -> Request:
+              timeout_s: float = 10.0, progress=None,
+              channel: int | None = None) -> Request:
         """Queue ``mr`` on ``comm``. ``progress`` is the verbs progress-engine
         hook: while the send ring backpressures, the caller's other comms
         must keep draining (data inbound to THIS rank arrives on a different
         QP than the one we are stuffing), or two mutually-sending ranks
         deadlock. Collectives pass the recv comm's pump here.
+
+        ``channel`` is the message's QoS lane (``transport.lanes``); None
+        reads the calling thread's lane context — 0 (the default lane)
+        outside any ``ChannelHandle`` verb. The lane gate runs BEFORE
+        the post: a paced lane yields per credit of posted bytes (a
+        real sleep while a higher-priority lane is mid-collective) and
+        keeps the shared tx backlog under its credit, and contending
+        admits defer by priority — the admission control that keeps a
+        bulk stream from starving a latency-bound lane on the shared
+        ring/FIFO (see ``lanes.LaneGate.admit`` for the exact bounds).
 
         Messages of >= LG_MIN bytes route over the one-sided put path (the
         LG rendezvous — see the class docstring block at LG_MIN): the peer
@@ -507,16 +604,19 @@ class HostQPNet:
         ``irecv``, the same liveness requirement the frame path already
         has under backpressure.
         """
+        chan = _lanes.current_channel() if channel is None else int(channel)
         size = len(mr)
-        t0 = _verb_entry("isend", tag=tag, nbytes=size)
+        t0 = _verb_entry("isend", tag=tag, nbytes=size, chan=chan)
+        self._lane_gate.admit(comm, chan, size, timeout_s=timeout_s,
+                              progress=progress)
         if size >= self.LG_MIN:
-            req = self._lg_isend(comm, mr, tag, timeout_s, progress)
+            req = self._lg_isend(comm, mr, tag, timeout_s, progress, chan)
             _verb_done("isend", t0, tag=tag, nbytes=size)
             return req
-        # scatter-gather post: the native layer prepends the 8-byte
-        # tag+epoch header inside its one ring/queue memcpy, so the
+        # scatter-gather post: the native layer prepends the 12-byte
+        # tag+epoch+chan header inside its one ring/queue memcpy, so the
         # payload is borrowed zero-copy instead of being serialized twice
-        hdr = comm._hdr(tag)
+        hdr = comm._hdr(tag, chan)
         self._post_backpressured(comm, lambda: comm.qp.post_send2(hdr, mr),
                                  "send ring full", timeout_s, progress)
         # drain our own CQ so send completions don't pile up in the native
@@ -525,32 +625,43 @@ class HostQPNet:
         _verb_done("isend", t0, tag=tag, nbytes=size)
         return Request(_test=lambda: (True, size, None))
 
-    def _lg_ensure(self, comm: _HostComm) -> None:
-        """Allocate + announce this comm's receive arena once. Called from
-        irecv (the natural rendezvous point) AND from a waiting _lg_isend
-        for EVERY open comm: a rank blocked in a large send must still
-        announce the arenas its peers' sends need, or two ranks in
-        blocking symmetric sends over separate tx comms deadlock waiting
-        for announces neither can reach its irecv to produce."""
-        if comm._lg_mr is not None or comm._lg_dead:
-            return
-        try:
-            comm._lg_mr = self.alloc_mr(comm, self.LG_ARENA)
-        except Exception:
-            # no usable MR arena (capacity exhausted): NACK with size=0 so
-            # the peer's large sends fail FAST with the real diagnosis
-            # instead of spinning to a misleading announce timeout
-            comm._lg_dead = True
-            ann = (0).to_bytes(8, "little") + (0).to_bytes(8, "little")
-            data = comm._hdr(self._LG_RKEY_TAG) + ann
-            self._post_backpressured(comm, lambda: comm.qp.post_send(data),
-                                     "send ring full", 10.0, None)
-            return
-        ann = (comm._lg_mr.rkey.to_bytes(8, "little")
-               + self.LG_ARENA.to_bytes(8, "little"))
-        data = comm._hdr(self._LG_RKEY_TAG) + ann
-        self._post_backpressured(comm, lambda: comm.qp.post_send(data),
-                                 "send ring full", 10.0, None)
+    def _lg_ensure(self, comm: _HostComm, announce: bool = False) -> None:
+        """Allocate this comm's receive arena once and queue its
+        announce. Called from irecv (the natural rendezvous point), from
+        a waiting _lg_isend for EVERY open comm (a rank blocked in a
+        large send must still announce the arenas its peers' sends
+        need, or two ranks in blocking symmetric sends over separate tx
+        comms deadlock), and — with ``announce=True`` — from the REQ
+        path in ``_pump`` (the peer explicitly asked: re-queue even if
+        an earlier announce went out, e.g. one the epoch fence
+        dropped).
+
+        NEVER blocks: the announce (or the capacity-exhausted NACK —
+        rkey 0, size 0, so the peer's large sends fail FAST with the
+        real diagnosis) rides the same deferred control queue as the
+        credit ACKs, flushed non-blockingly at every pump/probe of this
+        comm. A blocking post here would hold the comm lock across a
+        full-ring wait — exactly the cross-lane head-of-line blocking
+        the lane subsystem promises cannot happen (the REQ path calls
+        this from inside the locked pump)."""
+        with comm._lock:
+            if comm._lg_mr is None and not comm._lg_dead:
+                try:
+                    comm._lg_mr = self.alloc_mr(comm, self.LG_ARENA)
+                except Exception:
+                    comm._lg_dead = True
+            if comm._lg_announced and not announce:
+                return
+            if comm._lg_dead:
+                ann = (0).to_bytes(8, "little") + (0).to_bytes(8, "little")
+            else:
+                ann = (comm._lg_mr.rkey.to_bytes(8, "little")
+                       + self.LG_ARENA.to_bytes(8, "little"))
+            # LG protocol control rides channel 0 (comm-global state: the
+            # arena serves every lane; any lane's drain sees the announce)
+            comm._lg_ack_queue.append(comm._hdr(self._LG_RKEY_TAG) + ann)
+            comm._lg_announced = True
+            comm._flush_lg_acks()
 
     def _lg_descriptor(self, payload, lg: bool):
         """``(offset, length)`` when ``payload`` is a put descriptor for a
@@ -585,13 +696,31 @@ class HostQPNet:
         comm._flush_lg_acks()
 
     def _lg_drain_acks(self, comm: _HostComm) -> None:
-        acks = comm._unexpected.pop(self._LG_ACK_TAG, None)
-        if acks:
-            for payload in acks:
-                comm._lg_outstanding -= int.from_bytes(payload, "little")
+        # credit ACKs are comm-global (the arena serves every lane), so
+        # the drain scans EVERY lane's stash for the ACK tag — a credit
+        # returned under one lane's context must unblock any lane's
+        # sender, or an idle lane could strand another's credit forever
+        with comm._lock:
+            for key in [k for k in comm._unexpected
+                        if k[1] == self._LG_ACK_TAG]:
+                for payload in comm._unexpected.pop(key):
+                    comm._lg_outstanding -= int.from_bytes(payload, "little")
+
+    def _lg_take_announce(self, comm: _HostComm) -> bool:
+        """Pop the peer's arena announce from any lane's stash into
+        ``comm._lg_peer``; True when present (comm-global, like the
+        ACKs — see ``_lg_drain_acks``)."""
+        with comm._lock:
+            for key in [k for k in comm._unexpected
+                        if k[1] == self._LG_RKEY_TAG]:
+                ann = comm._unexpected.pop(key)
+                comm._lg_peer = (int.from_bytes(ann[0][:8], "little"),
+                                 int.from_bytes(ann[0][8:16], "little"))
+                return True
+        return False
 
     def _lg_isend(self, comm: _HostComm, mr: memoryview, tag: int,
-                  timeout_s: float, progress) -> Request:
+                  timeout_s: float, progress, chan: int = 0) -> Request:
         deadline = time.monotonic() + timeout_s
         back = _Backoff()
         # announce MY arena on this comm before waiting on the peer's: on
@@ -610,10 +739,7 @@ class HostQPNet:
                                      "send ring full", timeout_s, progress)
         # 1. the peer's arena announce (sent at its comm setup / irecv)
         while comm._lg_peer is None:
-            ann = comm._unexpected.pop(self._LG_RKEY_TAG, None)
-            if ann:
-                comm._lg_peer = (int.from_bytes(ann[0][:8], "little"),
-                                 int.from_bytes(ann[0][8:16], "little"))
+            if self._lg_take_announce(comm):
                 break
             comm._pump()
             if progress is not None:
@@ -632,14 +758,22 @@ class HostQPNet:
                 f"LG_MIN={self.LG_MIN} B or raise the peer's mr_capacity")
         need = len(mr)
         # 2. bump-allocate a window; reset to 0 when everything prior is
-        # ACKed; block on credit otherwise (single writer per direction)
+        # ACKed; block on credit otherwise. Allocation holds the comm
+        # lock: concurrent lanes' large sends interleave their windows
+        # safely (the single-writer-per-direction invariant becomes
+        # single-ALLOCATOR-per-direction under the lock).
         stall_logged = False  # one event per stall episode, not per poll
+        offset = None
         while True:
             self._lg_drain_acks(comm)
-            if comm._lg_outstanding == 0:
-                comm._lg_head = 0
-            if comm._lg_head + need <= arena:
-                break
+            with comm._lock:
+                if comm._lg_outstanding == 0:
+                    comm._lg_head = 0
+                if comm._lg_head + need <= arena:
+                    offset = comm._lg_head
+                    comm._lg_head += need
+                    comm._lg_outstanding += need
+                    break
             if not stall_logged:
                 stall_logged = True
                 _FLIGHT.record("credit-stalled", tag=tag, need=need,
@@ -652,43 +786,46 @@ class HostQPNet:
                     "host net: large-message arena credit starved "
                     "(peer not consuming?)")
             back.pause()
-        offset = comm._lg_head
-        comm._lg_head += need
-        comm._lg_outstanding += need
         # 3. the put, completed BEFORE the descriptor leaves (the soft-NIC
         # applies posts in order, but completion is the portable guarantee)
         self.iwrite(comm, rkey, mr, offset, timeout_s=timeout_s,
                     progress=progress).wait(
                         timeout_s=max(0.1, deadline - time.monotonic()),
                         progress=progress)
-        # 4. descriptor under the ORIGINAL tag: magic | offset | length
-        # (length is 8 bytes like the offset — ADVICE r4 #1: a 4-byte
-        # field would silently truncate if LG_ARENA ever grew past 4 GiB)
+        # 4. descriptor under the ORIGINAL tag AND the message's lane:
+        # magic | offset | length (length is 8 bytes like the offset —
+        # ADVICE r4 #1: a 4-byte field would silently truncate if
+        # LG_ARENA ever grew past 4 GiB)
         desc = (self._LG_MAGIC + offset.to_bytes(8, "little")
                 + need.to_bytes(8, "little"))
-        data = comm._hdr(tag) + desc
+        data = comm._hdr(tag, chan) + desc
         self._post_backpressured(comm, lambda: comm.qp.post_send(data),
                                  "send ring full", timeout_s, progress)
         comm._pump()
         return Request(_test=lambda: (True, need, None))
 
-    def irecv(self, comm: _HostComm, nbytes: int, tag: int = 0) -> Request:
+    def irecv(self, comm: _HostComm, nbytes: int, tag: int = 0,
+              channel: int | None = None) -> Request:
+        chan = _lanes.current_channel() if channel is None else int(channel)
+        key = (chan, tag)
         lg = nbytes >= self.LG_MIN
         if lg:
             self._lg_ensure(comm)  # the LG rendezvous step 1
-        t0 = _verb_entry("irecv", tag=tag, nbytes=nbytes)
+        t0 = _verb_entry("irecv", tag=tag, nbytes=nbytes, chan=chan)
 
         def probe():
-            if comm._lg_ack_queue:  # credit deferred by an earlier probe
-                self._lg_flush_acks(comm)
-            ready = comm._unexpected.get(tag)
-            if not ready:
-                comm._pump()
-                ready = comm._unexpected.get(tag)
-            if ready:
+            with comm._lock:
+                if comm._lg_ack_queue:  # credit deferred by an earlier probe
+                    self._lg_flush_acks(comm)
+                ready = comm._unexpected.get(key)
+                if not ready:
+                    comm._pump()
+                    ready = comm._unexpected.get(key)
+                if not ready:
+                    return False, 0, None
                 payload = ready.pop(0)
-                if not ready:  # drop exhausted tag keys: callers use fresh
-                    del comm._unexpected[tag]  # tags per step, unbounded otherwise
+                if not ready:  # drop exhausted keys: callers use fresh
+                    del comm._unexpected[key]  # tags per step
                 desc = self._lg_descriptor(payload, lg)
                 if desc is not None:
                     # a put descriptor: the bytes are already in my arena.
@@ -707,11 +844,11 @@ class HostQPNet:
                     return True, length, out
                 _verb_done("irecv", t0, tag=tag, nbytes=len(payload))
                 return True, len(payload), payload
-            return False, 0, None
         return Request(_test=probe)
 
     def irecv_into(self, comm: _HostComm, buf, tag: int = 0, *,
-                   combine=None, dtype=None) -> Request:
+                   combine=None, dtype=None,
+                   channel: int | None = None) -> Request:
         """Post a receive landing DIRECTLY in ``buf`` — the zero-copy twin
         of :meth:`irecv` (the ``recv_into`` capability in
         :class:`NetProperties`). ``buf`` is a writable C-contiguous byte
@@ -746,21 +883,27 @@ class HostQPNet:
                 raise ValueError(
                     f"{nbytes} B destination is not a whole number of "
                     f"{dtype} elements")
+        chan = _lanes.current_channel() if channel is None else int(channel)
+        key = (chan, tag)
         lg = nbytes >= self.LG_MIN
         if lg:
             self._lg_ensure(comm)  # the LG rendezvous step 1
-        t0 = _verb_entry("irecv_into", tag=tag, nbytes=nbytes)
+        t0 = _verb_entry("irecv_into", tag=tag, nbytes=nbytes, chan=chan)
         frame_kind = "frame-landed" if combine is None else "frame-combined"
+        label = None  # resolved lazily at first consume (registry lookup)
 
         def consume(src_u8, length: int) -> None:
             # land or fold `src_u8` (uint8 array view of the arrived bytes)
             # into the destination — the ONE write of the zero-copy path
+            nonlocal label
             if combine is None:
                 dest[:length] = src_u8
             else:
                 d = dest[:length].view(dtype)
                 combine(d, src_u8.view(dtype), out=d)
-            _WIRE.streamed(nbytes=length)
+            if label is None:
+                label = comm._label(chan)
+            _WIRE.streamed(nbytes=length, channel=label)
             # one irecv_into request is one wire frame, so this event IS
             # the frame's landing slice (post->consume as dur): the trace
             # lane the acceptance check counts against frames_streamed
@@ -769,33 +912,34 @@ class HostQPNet:
                            dur=time.perf_counter() - t0)
 
         def probe():
-            if comm._lg_ack_queue:  # credit deferred by an earlier probe
-                self._lg_flush_acks(comm)
-            ready = comm._unexpected.get(tag)
-            if not ready:
-                comm._pump()
-                ready = comm._unexpected.get(tag)
-            if not ready:
-                return False, 0, None
-            payload = ready.pop(0)
-            if not ready:
-                del comm._unexpected[tag]
-            desc = self._lg_descriptor(payload, lg)
-            if desc is not None:
-                # put descriptor: bytes already sit in my arena — consume
-                # them through the zero-copy view (ordering per
-                # read_mr_view's caveat: the descriptor frame arrived
-                # through the fenced ring AFTER the sender's put), then
-                # return the credit
-                offset, length = desc
-                consume(self.read_mr_view(comm, comm._lg_mr, offset, length),
-                        length)
-                self._lg_credit(comm, length)
-                return True, length, None
-            n = len(payload)
-            consume(np.frombuffer(payload, np.uint8), n)
-            comm._recycle(payload)
-            return True, n, None
+            with comm._lock:
+                if comm._lg_ack_queue:  # credit deferred by earlier probe
+                    self._lg_flush_acks(comm)
+                ready = comm._unexpected.get(key)
+                if not ready:
+                    comm._pump()
+                    ready = comm._unexpected.get(key)
+                if not ready:
+                    return False, 0, None
+                payload = ready.pop(0)
+                if not ready:
+                    del comm._unexpected[key]
+                desc = self._lg_descriptor(payload, lg)
+                if desc is not None:
+                    # put descriptor: bytes already sit in my arena —
+                    # consume them through the zero-copy view (ordering
+                    # per read_mr_view's caveat: the descriptor frame
+                    # arrived through the fenced ring AFTER the sender's
+                    # put), then return the credit
+                    offset, length = desc
+                    consume(self.read_mr_view(comm, comm._lg_mr, offset,
+                                              length), length)
+                    self._lg_credit(comm, length)
+                    return True, length, None
+                n = len(payload)
+                consume(np.frombuffer(payload, np.uint8), n)
+                comm._recycle(payload)
+                return True, n, None
         return Request(_test=probe)
 
     # -- one-sided verbs (optional capability; see NetProperties.one_sided) --
@@ -816,10 +960,15 @@ class HostQPNet:
         deadline = time.monotonic() + timeout_s
         back = _Backoff()
         while True:
-            wr = post()
-            if wr >= 0:
-                return wr
-            comm._pump()
+            # the post attempt and its slot-freeing pump hold the comm
+            # lock (concurrent lane threads post on one QP); the pause
+            # and the caller's progress hook run UNLOCKED so other lanes
+            # — and other comms' pumps — keep moving while we wait
+            with comm._lock:
+                wr = post()
+                if wr >= 0:
+                    return wr
+                comm._pump()
             if progress is not None:
                 progress()
             if time.monotonic() >= deadline:
@@ -875,16 +1024,19 @@ class HostQPNet:
 
     @staticmethod
     def _onesided_probe(comm: _HostComm, wr: int, size: int, into):
-        if wr not in comm._onesided_done:
-            comm._pump()
-        if wr not in comm._onesided_done:
-            return False, 0, None
-        status = comm._onesided_done[wr]
-        if status is not None:
-            # terminal: leave the record so a retried test()/wait() re-raises
-            # the real error instead of spinning to a misleading timeout
-            raise OSError(f"host net: one-sided op denied (status {status})")
-        del comm._onesided_done[wr]
+        with comm._lock:
+            if wr not in comm._onesided_done:
+                comm._pump()
+            if wr not in comm._onesided_done:
+                return False, 0, None
+            status = comm._onesided_done[wr]
+            if status is not None:
+                # terminal: leave the record so a retried test()/wait()
+                # re-raises the real error instead of spinning to a
+                # misleading timeout
+                raise OSError(
+                    f"host net: one-sided op denied (status {status})")
+            del comm._onesided_done[wr]
         return True, size, bytes(into) if into is not None else None
 
     def close_comm(self, comm: _HostComm) -> None:
@@ -1109,8 +1261,8 @@ class _RingWire:
         # LG-capable planes (the host QP nets) take ring hops in LG_CHUNK
         # units — isend auto-routes those over the put path, one native
         # bulk copy per hop (r4); everything else chunks at the frame
-        self.frame = (getattr(net, "LG_CHUNK", None)
-                      or getattr(net, "MAX_FRAME", (1 << 16) - 4))
+        self._base_frame = (getattr(net, "LG_CHUNK", None)
+                            or getattr(net, "MAX_FRAME", (1 << 16) - 4))
         # the zero-copy receive verb, gated on the plane's ADVERTISED
         # recv_into capability (NetProperties) — not a bare getattr, which
         # a delegating wrapper like FaultNet would satisfy even over an
@@ -1122,6 +1274,30 @@ class _RingWire:
         self._recv_into = (getattr(net, "irecv_into", None)
                            if getattr(caps, "recv_into", False) else None)
         self._hops = itertools.count(1)
+
+    @property
+    def frame(self) -> int:
+        """The wire chunk, resolved at USE time: the plane's base frame
+        capped at the CURRENT lane context's ``credit_bytes`` — a paced
+        lane's wire quantum is its credit, bounding how long any single
+        post (and the comm lock / native copy under it) can hold the
+        wire from a higher-priority lane. Resolved per call rather than
+        frozen at construction because p2p wires are CACHED per (peer,
+        direction) and may be created under one lane's context then
+        carry another lane's stream (first-contact wiring, heal-time
+        resume rebuilds): both ends of a stream run its posts under the
+        stream's OWN lane context (the verbs and the resume paths
+        guarantee it), so call-time resolution is what keeps the two
+        ends' frame sizes — and hence frame indices and wire tags — in
+        agreement. The default lane has no credit and keeps the full
+        quantum."""
+        f = self._base_frame
+        reg = getattr(self.net, "lanes", None)
+        lane = (reg.get(_lanes.current_channel())
+                if reg is not None else None)
+        if lane is not None and lane.credit_bytes:
+            f = max(1, min(f, lane.credit_bytes))
+        return f
 
     def _tag(self, hop: int, nbytes: int, frame: int | None = None):
         """The (hop, frame-index) tag packer — the ONE definition of the
@@ -1236,9 +1412,14 @@ class _RingWire:
         reqs = self.post_recvs(in_nbytes, hop, into=got)
         # progress engine: while our send ring is full, keep draining the
         # comm our inbound data arrives on, or two mutually-sending ranks
-        # stall each other
-        pump = (self.progress if self.progress is not None
-                else getattr(self.recv_comm, "_pump", None))
+        # stall each other. The net's group-level hook (the p2p resume
+        # service — ProcessGroup sets net._progress_hook) rides every
+        # blocking loop too: a rank blocked in a collective must still
+        # answer its interrupted p2p streams' resume protocol.
+        hook = getattr(self.net, "_progress_hook", None)
+        pump = _with_hook(self.progress if self.progress is not None
+                          else getattr(self.recv_comm, "_pump", None),
+                          hook)
         try:
             self.queue_send(out, hop, pump)
         except TimeoutError as e:
@@ -1249,7 +1430,7 @@ class _RingWire:
         # feed us until it drains us and vice versa, so a wait that only
         # pumps the recv comm deadlocks symmetrically (observed at 16 MB
         # hops: both ranks time out with MBs stuck in their send queues).
-        send_pump = getattr(self.send_comm, "_pump", None)
+        send_pump = _with_hook(getattr(self.send_comm, "_pump", None), hook)
         for fi, (off, nb, r) in enumerate(reqs):
             try:
                 payload = r.wait(timeout_s=self.timeout_s,
@@ -1333,17 +1514,23 @@ class _RingWire:
         send_pump = getattr(self.send_comm, "_pump", None)
         recv_pump = (self.progress if self.progress is not None
                      else getattr(self.recv_comm, "_pump", None))
+        hook = getattr(self.net, "_progress_hook", None)
 
         def consume_progress():
             # keep our outbound flowing AND consume ready inbound frames
             # in order (an empty-handed head probe pumps the recv comm
-            # itself, so inbound keeps landing either way)
+            # itself, so inbound keeps landing either way); the net's
+            # group-level hook (p2p resume service) gets its turn too —
+            # a rank blocked streaming a collective must still answer
+            # its interrupted p2p streams
             if send_pump is not None:
                 send_pump()
             while pending and pending[0].test()[0]:
                 pending.popleft()
             if not pending and recv_pump is not None:
                 recv_pump()
+            if hook is not None:
+                hook()
 
         def post_hop(k):
             dest, combine = hops[k]
@@ -1413,6 +1600,23 @@ class _RingWire:
                       what="ring stream: peer stopped draining")
         except TimeoutError as e:
             raise self._stall("flush", hop_nos[-1], None, e) from e
+
+
+def _with_hook(base, hook):
+    """Compose a comm pump with the net's group-level progress hook
+    (either may be None) into one progress callable — the ONE
+    definition of the composition the ring wire's blocking loops use
+    (the hook is how a rank blocked in a collective keeps serving its
+    interrupted p2p streams' resume protocol)."""
+    if hook is None:
+        return base
+    if base is None:
+        return hook
+
+    def pump():
+        base()
+        hook()
+    return pump
 
 
 def _as_bytes(a: np.ndarray) -> np.ndarray:

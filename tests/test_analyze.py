@@ -805,3 +805,103 @@ def test_unknown_file_allow_entries_are_findings(monkeypatch):
         monkeypatch.setitem(p.ALLOW, "plugn.py::Typo.attr", "typo'd file")
         problems = p.run()
         assert any("unknown file" in x for x in problems), (p.NAME, problems)
+
+
+# ---------------------------------------------------------------------------
+# pass #4e: lane-scheduling discipline (PR 9) — every blocking point of
+# the multi-tenant lane scheduler records entry + completion events
+# ---------------------------------------------------------------------------
+
+_LANE_GOOD = textwrap.dedent("""
+    class LaneGate:
+        def admit(self, comm, channel, nbytes, timeout_s=10.0):
+            t0 = _lane_entry("lane-admit", chan=channel)
+            deadline = time.monotonic() + timeout_s
+            while True:
+                if self._clear(comm, channel, nbytes):
+                    _lane_done("lane-admit", t0, chan=channel)
+                    return
+                if time.monotonic() >= deadline:
+                    raise TimeoutError("lane starved")
+""")
+
+
+def test_obs_accepts_instrumented_lane_point():
+    assert obs.check_lane_source(_LANE_GOOD, "lanes.py") == []
+
+
+def test_obs_flags_uninstrumented_lane_point():
+    # a lane deferral with no timeline entry is a QoS stall the
+    # postmortem cannot see — both markers are required
+    src = textwrap.dedent("""
+        class LaneGate:
+            def admit(self, comm, channel, nbytes, timeout_s=10.0):
+                deadline = time.monotonic() + timeout_s
+                while True:
+                    if self._clear(comm, channel, nbytes):
+                        return
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError("lane starved")
+    """)
+    problems = obs.check_lane_source(src, "lanes.py")
+    assert len(problems) == 2, problems
+    assert any("no entry event" in p for p in problems), problems
+    assert any("no completion event" in p for p in problems), problems
+
+
+def test_obs_lane_rule_ignores_nonblocking_functions():
+    # registry/context plumbing takes no timeout_s: out of scope
+    src = textwrap.dedent("""
+        def lane_id(name):
+            return 0 if name == "default" else crc32(name)
+
+        class LaneRegistry:
+            def open(self, name, priority=0, credit_bytes=None):
+                return self._by_name.get(name)
+    """)
+    assert obs.check_lane_source(src, "lanes.py") == []
+
+
+def test_obs_lane_rule_covers_the_repo_lanes_module():
+    assert obs.LANE_FILE == "rocnrdma_tpu/transport/lanes.py"
+    # the repo surface complies (run() == [] pins it); the gate's admit
+    # is the blocking point the rule exists for
+    assert obs.check_lane_source(
+        open(os.path.join(os.path.dirname(__file__), "..",
+                          "rocnrdma_tpu", "transport", "lanes.py")).read(),
+        "lanes.py") == []
+
+
+# ---------------------------------------------------------------------------
+# pass #0 extension (PR 9): the lane blocking surface — ChannelHandle
+# verbs and the LaneGate's admission wait accept timeout_s
+# ---------------------------------------------------------------------------
+
+
+def test_deadlines_flags_lane_surface_without_timeout(tmp_path):
+    assert {"all_reduce", "send", "batch_isend_irecv"} \
+        <= deadlines.CHANNEL_BLOCKING
+    assert "admit" in deadlines.LANE_BLOCKING
+    bad = tmp_path / "distributed.py"
+    bad.write_text(textwrap.dedent("""
+        class ChannelHandle:
+            def all_reduce(self, x, op="sum"):
+                return self._run("all_reduce", lambda: None)
+
+            def all_gather(self, x, timeout_s=None):
+                return self._run("all_gather", lambda: None)
+    """))
+    problems = deadlines.check_file(str(bad))
+    assert any("all_reduce must accept timeout_s" in p
+               for p in problems), problems
+    assert not any("all_gather" in p for p in problems), problems
+    bad_gate = tmp_path / "lanes.py"
+    bad_gate.write_text(textwrap.dedent("""
+        class LaneGate:
+            def admit(self, comm, channel, nbytes):
+                while not self._clear(comm, channel, nbytes):
+                    raise TimeoutError("x")
+    """))
+    problems = deadlines.check_file(str(bad_gate))
+    assert any("admit" in p and "timeout_s" in p for p in problems), \
+        problems

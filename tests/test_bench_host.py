@@ -103,7 +103,14 @@ def test_smoke_perf_gate(tmp_path, capsys):
     ZERO payload bytes through copies on the steady path (every worker
     rank enforces its own counters) and hold >= 0.8x that path's
     recorded GB/s floor. A regression back to the copy-bound wire — on
-    any path — fails here before it can ship."""
+    any path — fails here before it can ship.
+
+    PR 9 adds the LANES path: the multi-tenant QoS scenario (a 64 KiB
+    allreduce on a high-priority lane timed under a saturating bulk
+    allgather on a paced lane, concurrently in flight on one comm) —
+    gated on both lanes' correctness, the measurement being genuinely
+    under load, the latency lane's P99 inside the recorded ceiling,
+    and the bulk lane not being starved either."""
     out = tmp_path / "smoke.jsonl"
     rc = bench_host.main(["--smoke", "--out", str(out)])
     assert rc == 0
@@ -111,10 +118,12 @@ def test_smoke_perf_gate(tmp_path, capsys):
     assert "smoke gate ok [shm]" in printed
     assert "smoke gate ok [tcp]" in printed
     assert "smoke gate ok [rdma]" in printed
+    assert "smoke gate ok [lanes]" in printed
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert [r["platform"] for r in rows] == ["host-shm", "host-tcp",
-                                             "host-shm"]
-    assert [r["algo"] for r in rows] == ["ring", "ring", "ring_rdma"]
+                                             "host-shm", "host-shm"]
+    assert [r["algo"] for r in rows] == ["ring", "ring", "ring_rdma",
+                                         "lanes"]
     for row in rows:
         wire = row["extra"]["wire"]
         assert wire["payload_bytes_copied"] == 0, row["algo"]
@@ -128,3 +137,16 @@ def test_smoke_perf_gate(tmp_path, capsys):
         # — only the deterministic zero-copy contract above fails the
         # build
         assert 0.0 <= wire["overlap_ratio"] <= 1.0
+    lanes_row = rows[-1]
+    ex = lanes_row["extra"]
+    assert ex["lane"] == "latency" and ex["lanes_ok"] and ex["overlap_ok"]
+    assert 0 < ex["p99_us"] <= bench_host.SMOKE_LANES_P99_US
+    assert ex["bulk_GBps"] >= bench_host.SMOKE_LANES_BULK_GBPS
+    # both tenants' frames moved on their OWN lanes (the per-channel
+    # wire counters attribute them by lane name)
+    per_lane = ex["wire"]["channel_bytes_streamed"]
+    assert per_lane.get("bulk", 0) > 0 and per_lane.get("latency", 0) > 0
+    # the lane column made it to the table, tagging the latency row
+    hdr = next(l for l in printed.splitlines() if "wp99(us)" in l)
+    assert "lane" in hdr
+    assert any("latency" in l for l in printed.splitlines())

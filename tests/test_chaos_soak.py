@@ -358,3 +358,64 @@ def test_die_mid_collective_survivors_abort_named():
         assert m, f"survivor {r.process_id} named no stalled hop:\n" \
                   f"{r.stdout}"
         assert int(m.group(4)) in {0, 1, 2, 3} - {r.process_id}
+
+
+def test_kill_and_heal_lanes_fence_both_tenants_replay_equal(monkeypatch):
+    """The lane x epoch acceptance run (ISSUE 9): the kill-and-heal
+    chaos on the multi-tenant lane surface — every round's allreduce
+    rides a HIGH-PRIORITY "latency" channel while TWO neighbour ping
+    streams are in flight, one on a paced "bulk" channel and one on the
+    latency channel. Rank 2 of 4 is hard-killed mid-collective at a
+    deterministic op.
+
+    Asserted: the heal fences the dead generation's frames in BOTH
+    lanes (the survivors' summed per-lane fence split counts bulk AND
+    latency drops — the fence is lane-agnostic by construction), the
+    latency lane's collective still completes EVERY round bitwise
+    (exactly-once retry, unaffected by the concurrent bulk stream),
+    survivor<->survivor streams resume, nothing hangs, and TWO runs of
+    the seed replay byte-identical fault/heal/fleet timelines AND the
+    identical per-lane fence split on every survivor (the split is
+    data-flow-determined: what was in flight at the kill)."""
+    # the HEALLOG/GROWLOG digests read the flight ring, and the lanes
+    # variant records strictly more events per round (two ping streams,
+    # lane verb entries, lane-admit waits): size the ring to hold the
+    # WHOLE run on both runs, or wrap-eviction of the heal events is
+    # timing-dependent and breaks the replay-equality contract the test
+    # exists to pin (the same hazard that moved the HEALTH/FLEET
+    # digests onto the durable health log in PR 8)
+    monkeypatch.setenv("ROCNRDMA_FLIGHT_EVENTS", "32768")
+    n, seed, rounds, victim = 4, 11, 6, 2
+    runs = [run_workers(n, "kill-and-heal", timeout_s=150.0, seed=seed,
+                        rounds=rounds, kill_ranks=str(victim),
+                        kill_ops="49", lanes=True) for _ in range(2)]
+    for results in runs:
+        rc = {r.process_id: r.returncode for r in results}
+        assert rc[victim] == 7, results[victim].stdout
+        fenced = {}
+        for r in results:
+            assert r.returncode != -9, \
+                f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+            if r.process_id == victim:
+                continue
+            assert r.returncode == 0, \
+                f"survivor {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert _line(r, "EPOCH") == "1"
+            assert _line(r, "MEMBERS") == "[0, 1, 3]"
+            for lane, k in json.loads(_line(r, "LANEFENCED")).items():
+                fenced[lane] = fenced.get(lane, 0) + k
+        # the kill provably stranded frames in BOTH tenants' lanes, and
+        # the per-lane split sums to the total fence count
+        assert fenced.get("bulk", 0) > 0, fenced
+        assert fenced.get("latency", 0) > 0, fenced
+        assert sum(fenced.values()) == sum(
+            int(_line(r, "FENCED")) for r in results
+            if r.process_id != victim), fenced
+    for a, b in zip(*runs):
+        if a.process_id == victim:
+            continue
+        assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
+        assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
+        assert _line(a, "LANEFENCED") == _line(b, "LANEFENCED"), a.process_id
+        assert _line(a, "FLEET") == _line(b, "FLEET"), a.process_id

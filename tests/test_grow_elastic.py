@@ -601,7 +601,8 @@ def test_isend_queue_failure_leaves_no_stale_registration(sidecar_store):
                     pg.isend(np.arange(8, dtype=np.int64), 1, tag=7)
                 wire.queue_send = orig_qs
                 assert pg._p2p_inflight == {}  # no leaked resume slot
-                assert pg._p2p_seq[1][("out", "tx", 7)] == 0  # claim undone
+                # claim undone (stream keys carry the lane: chan 0 here)
+                assert pg._p2p_seq[1][("out", "tx", 0, 7)] == 0
                 pg.barrier()
                 return "ok"
             finally:

@@ -1,0 +1,494 @@
+"""Multi-tenant collective lanes (ISSUE 9): per-channel wire identity,
+priority/credit scheduling, ProcessGroup.channel handles, lane x epoch
+and lane x fault composition.
+
+The headline here is the CONCURRENCY PROOF: one ProcessGroup per rank,
+a bulk allgather and four small allreduces in flight SIMULTANEOUSLY
+over the same comm pair (five threads per rank, released together by a
+barrier), every lane's result bitwise-correct — the serialization the
+group layer used to impose is gone, and the (chan, tag) wire identity
+is what keeps the interleaved frames apart.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import distributed as dist
+from rocnrdma_tpu import native
+from rocnrdma_tpu.metrics import WIRE
+from rocnrdma_tpu.obs import fleet
+from rocnrdma_tpu.transport import bootstrap, lanes
+from rocnrdma_tpu.transport.faults import FaultNet, FaultSchedule
+from rocnrdma_tpu.transport.plugin import HostQPNet
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native rqp library not buildable")
+
+
+# ---------------------------------------------------------------------------
+# lane identity: ids, registry, context
+# ---------------------------------------------------------------------------
+
+
+def test_lane_id_stable_and_default_zero():
+    assert lanes.lane_id("default") == 0
+    a, b = lanes.lane_id("bulk"), lanes.lane_id("bulk")
+    assert a == b != 0  # pure function of the name: cross-rank, no store
+    assert lanes.lane_id("latency") not in (0, a)
+
+
+def test_registry_open_idempotent_conflict_refused():
+    reg = lanes.LaneRegistry()
+    assert len(reg) == 1  # the default lane exists from construction
+    lane = reg.open("bulk", priority=1, credit_bytes=1 << 20)
+    assert reg.open("bulk", priority=1, credit_bytes=1 << 20) is lane
+    with pytest.raises(ValueError, match="conflicting re-open"):
+        reg.open("bulk", priority=3, credit_bytes=1 << 20)
+    assert reg.get(lane.id) is lane
+    assert reg.label(lane.id) == "bulk"
+    assert reg.label(0) == "default"
+    # an unregistered wire channel still labels (frames can arrive on a
+    # lane the local process never opened)
+    assert reg.label(0xDEADBEEF).startswith("c")
+
+
+def test_lane_context_nests_and_restores():
+    assert lanes.current_channel() == 0
+    with lanes.lane_context(7):
+        assert lanes.current_channel() == 7
+        with lanes.lane_context(9):
+            assert lanes.current_channel() == 9
+        assert lanes.current_channel() == 7
+    assert lanes.current_channel() == 0
+
+
+def test_lane_context_is_thread_local():
+    seen = []
+
+    def other():
+        seen.append(lanes.current_channel())
+
+    with lanes.lane_context(5):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join(timeout=10)
+    assert seen == [0]
+
+
+# ---------------------------------------------------------------------------
+# the wire: frames land in their lane's stash, fences count per lane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def host_pair():
+    net = HostQPNet()
+    net.init()
+    handle, listen_qp = net.listen()
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault("send", net.connect(0, handle)))
+    t.start()
+    recv_comm = net.accept(listen_qp)
+    t.join(timeout=10)
+    yield net, out["send"], recv_comm
+    net.close()
+
+
+@needs_native
+def test_frames_match_only_their_own_lane(host_pair):
+    net, send_comm, recv_comm = host_pair
+    ch = net.open_lane("a", priority=1).id
+    net.isend(send_comm, net.reg_mr(send_comm, b"laned!"), tag=4,
+              channel=ch)
+    # the default lane's receive must NOT see the laned frame
+    r0 = net.irecv(recv_comm, 6, tag=4, channel=0)
+    deadline = time.monotonic() + 0.5
+    while time.monotonic() < deadline:
+        assert r0.test() == (False, 0)
+    # the laned receive does
+    r1 = net.irecv(recv_comm, 6, tag=4, channel=ch)
+    assert r1.wait() == b"laned!"
+
+
+@needs_native
+def test_lane_context_is_the_default_channel(host_pair):
+    net, send_comm, recv_comm = host_pair
+    ch = net.open_lane("ctx", priority=2).id
+    with lanes.lane_context(ch):
+        net.isend(send_comm, net.reg_mr(send_comm, b"via-ctx!"), tag=9)
+    got = net.irecv(recv_comm, 8, tag=9, channel=ch).wait()
+    assert got == b"via-ctx!"
+
+
+@needs_native
+def test_epoch_fence_counts_per_lane(host_pair):
+    net, send_comm, recv_comm = host_pair
+    a = net.open_lane("tenant-a").id
+    b = net.open_lane("tenant-b", priority=3).id
+    base = WIRE.snapshot()
+    for chan, tag in ((a, 1), (a, 2), (b, 1), (0, 5)):
+        net.isend(send_comm, net.reg_mr(send_comm, b"x" * 16), tag=tag,
+                  channel=chan)
+    # deliver into the stash (unconsumed), then fence the generation
+    deadline = time.monotonic() + 5.0
+    while sum(len(v) for v in recv_comm._unexpected.values()) < 4:
+        recv_comm._pump()
+        assert time.monotonic() < deadline, recv_comm._unexpected
+    net.set_epoch(1)
+    d = WIRE.delta(base)
+    assert d["frames_fenced"] >= 4
+    per = d["channel_frames_fenced"]
+    assert per.get("tenant-a", 0) >= 2
+    assert per.get("tenant-b", 0) >= 1
+    assert per.get("default", 0) >= 1
+    assert not recv_comm._unexpected  # every lane's stale frames dropped
+
+
+# ---------------------------------------------------------------------------
+# the gate: credit pacing, strict priority, named starvation timeout
+# ---------------------------------------------------------------------------
+
+
+class _FakeComm:
+    def __init__(self):
+        self.pumps = 0
+
+    def _pump(self):
+        self.pumps += 1
+
+
+def test_gate_single_lane_is_free_and_credit_paces():
+    reg = lanes.LaneRegistry()
+    gate = lanes.LaneGate(reg)
+    comm = _FakeComm()
+    gate.admit(comm, 0, 1 << 30, timeout_s=0.1)  # single lane: no gate
+    bulk = reg.open("bulk", credit_bytes=64)
+    base = WIRE.snapshot()
+    gate.admit(comm, bulk.id, 40, timeout_s=5.0)   # within credit
+    gate.admit(comm, bulk.id, 40, timeout_s=5.0)   # over: one yield, then ok
+    d = WIRE.delta(base)
+    assert d["lane_yields"] >= 1
+    assert comm.pumps >= 1  # the yield pumped the comm
+
+
+def test_gate_defers_behind_higher_priority_intent_then_admits():
+    reg = lanes.LaneRegistry()
+    gate = lanes.LaneGate(reg)
+    comm = _FakeComm()
+    bulk = reg.open("bulk", priority=0, credit_bytes=1 << 20)
+    lat = reg.open("lat", priority=9)
+    # a declared higher-priority intent defers the bulk admit...
+    st = gate._state(comm)
+    st["intents"][lat.priority] = 1
+    done = []
+
+    def admit_bulk():
+        gate.admit(comm, bulk.id, 100, timeout_s=10.0)
+        done.append(time.monotonic())
+
+    t = threading.Thread(target=admit_bulk)
+    t.start()
+    time.sleep(0.15)
+    assert not done  # still deferred
+    with gate._lock:
+        st["intents"].pop(lat.priority)
+    t.join(timeout=10)
+    assert done  # ...and admits the moment the intent clears
+
+
+def test_gate_starved_lane_raises_named():
+    reg = lanes.LaneRegistry()
+    gate = lanes.LaneGate(reg)
+    comm = _FakeComm()
+    bulk = reg.open("bulk2", priority=0)
+    lat = reg.open("lat2", priority=9)
+    st = gate._state(comm)
+    st["intents"][lat.priority] = 1  # never clears
+    with pytest.raises(TimeoutError, match="bulk2.*starved"):
+        gate.admit(comm, bulk.id, 100, timeout_s=0.2)
+
+
+# ---------------------------------------------------------------------------
+# per-channel fault injection (lane x FaultNet)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_per_channel_partition_blackholes_one_tenant():
+    def build():
+        sched = FaultSchedule(31, 0, chan_partition_after_ops={"bulk": 2})
+        net = FaultNet(HostQPNet(), sched)
+        net.init()
+        handle, listen_qp = net.listen()
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.setdefault("send", net.connect(0, handle)))
+        t.start()
+        recv_comm = net.accept(listen_qp)
+        t.join(timeout=10)
+        return sched, net, out["send"], recv_comm
+
+    sched, net, send_comm, recv_comm = build()
+    try:
+        bulk = net.open_lane("bulk").id
+        # bulk ops 1-2 (send+recv) deliver; bulk ops 3+ blackhole;
+        # the default lane flows freely throughout
+        net.isend(send_comm, net.reg_mr(send_comm, b"one"), tag=1,
+                  channel=bulk)
+        assert net.irecv(recv_comm, 3, tag=1, channel=bulk).wait() == b"one"
+        net.isend(send_comm, net.reg_mr(send_comm, b"two"), tag=2,
+                  channel=bulk)
+        r = net.irecv(recv_comm, 3, tag=2, channel=bulk)
+        net.isend(send_comm, net.reg_mr(send_comm, b"ok!"), tag=3)
+        assert net.irecv(recv_comm, 3, tag=3).wait() == b"ok!"
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            assert not r.test()[0]  # the partitioned tenant never completes
+        assert sched.counters.counts.get("chan-partitioned", 0) >= 1
+    finally:
+        net.close()
+    # replay: same seed, same call sequence -> identical injection log
+    first = sched.fingerprint()
+    sched2, net2, send2, recv2 = build()
+    try:
+        bulk = net2.open_lane("bulk").id
+        net2.isend(send2, net2.reg_mr(send2, b"one"), tag=1, channel=bulk)
+        assert net2.irecv(recv2, 3, tag=1, channel=bulk).wait() == b"one"
+        net2.isend(send2, net2.reg_mr(send2, b"two"), tag=2, channel=bulk)
+        r = net2.irecv(recv2, 3, tag=2, channel=bulk)
+        net2.isend(send2, net2.reg_mr(send2, b"ok!"), tag=3)
+        assert net2.irecv(recv2, 3, tag=3).wait() == b"ok!"
+        r.test()
+    finally:
+        net2.close()
+    assert sched2.fingerprint() == first
+
+
+def test_chan_test_delay_uses_its_own_stream():
+    # a laned delay draws from the lane's OWN rng/draw counter: the
+    # global stream never advances for it, so default-lane logs are
+    # byte-identical with and without laned traffic interleaved
+    plain = FaultSchedule(5, 0, test_delay_p=1.0, test_delay_polls=(2, 2))
+    mixed = FaultSchedule(5, 0, test_delay_p=1.0, test_delay_polls=(2, 2),
+                          chan_test_delay_p={"bulk": 1.0})
+    seq = []
+    for s in (plain, mixed):
+        seq.append([s.test_delay() for _ in range(3)])
+    assert seq[0] == seq[1]
+    mixed2 = FaultSchedule(5, 0, test_delay_p=1.0, test_delay_polls=(2, 2),
+                           chan_test_delay_p={"bulk": 1.0})
+    got = [mixed2.test_delay(), mixed2.test_delay(lane="bulk"),
+           mixed2.test_delay(), mixed2.test_delay()]
+    assert got[0] == seq[0][0] and got[2] == seq[0][1] and got[3] == seq[0][2]
+    assert got[1] == 2  # the lane's own draw
+    assert any(k == "chan-test-delayed" for _, k, _ in mixed2.log)
+
+
+# ---------------------------------------------------------------------------
+# ProcessGroup.channel: the concurrency proof + default-lane identity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sidecar_store():
+    servers = []
+
+    def factory(n):
+        s = bootstrap.BootstrapServer(n_ranks=n)
+        servers.append(s)
+        return s
+
+    yield factory
+    for s in servers:
+        s.close()
+
+
+def _lane_input(rank: int, lane: str, i: int, elems: int) -> np.ndarray:
+    rng = np.random.default_rng((rank, hash(lane) % (1 << 32), i))
+    return rng.integers(-1_000_000, 1_000_000, elems).astype(np.int64)
+
+
+@needs_native
+def test_concurrent_bulk_and_four_latency_lanes_bitwise(sidecar_store):
+    """THE concurrency proof (ISSUE 9 acceptance): one comm pair per
+    rank carries a bulk allgather AND four small allreduces in flight
+    simultaneously — five lane threads per rank released by one
+    barrier — and every lane's every result is bitwise-correct. The
+    bulk block rides the LG put path (>= LG_MIN), the small lanes ride
+    the frame ring: both data paths interleave on one wire."""
+    n = 2
+    store = sidecar_store(n)
+    lat_names = [f"lat{i}" for i in range(4)]
+    bulk_elems = (4 << 20) // 8   # 4 MiB int64 -> LG path
+    small_elems = (16 << 10) // 8
+    iters = 4
+
+    def rank_main(rank):
+        pg = dist.init_process_group(rank=rank, world_size=n,
+                                     store_handle=store.handle,
+                                     group_name="lanes-conc", plane="shm")
+        try:
+            bulk = pg.channel("bulk", priority=0, credit_bytes=1 << 20)
+            lats = [pg.channel(nm, priority=5) for nm in lat_names]
+            start = threading.Barrier(1 + len(lats))
+            errors = []
+
+            def bulk_main():
+                try:
+                    start.wait(timeout=30)
+                    for i in range(iters):
+                        mine = _lane_input(rank, "bulk", i, bulk_elems)
+                        rows = bulk.all_gather(mine, timeout_s=120.0)
+                        for r in range(n):
+                            want = _lane_input(r, "bulk", i, bulk_elems)
+                            assert np.array_equal(rows[r], want), \
+                                ("bulk", i, r)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(("bulk", repr(e)))
+
+            def lat_main(ch):
+                try:
+                    start.wait(timeout=30)
+                    for i in range(iters):
+                        mine = _lane_input(rank, ch.name, i, small_elems)
+                        got = ch.all_reduce(mine, timeout_s=60.0)
+                        want = _lane_input(0, ch.name, i, small_elems)
+                        for r in range(1, n):
+                            want = want + _lane_input(r, ch.name, i,
+                                                      small_elems)
+                        assert np.array_equal(got, want), (ch.name, i)
+                except Exception as e:  # noqa: BLE001
+                    errors.append((ch.name, repr(e)))
+
+            threads = [threading.Thread(target=bulk_main)]
+            threads += [threading.Thread(target=lat_main, args=(ch,))
+                        for ch in lats]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert not errors, errors
+            assert not any(t.is_alive() for t in threads), "lane thread hung"
+            return True
+        finally:
+            pg.destroy()
+
+    base = WIRE.snapshot()
+    results = [None] * n
+    rank_errors = []
+
+    def runner(r):
+        try:
+            results[r] = rank_main(r)
+        except Exception as e:  # noqa: BLE001
+            rank_errors.append((r, repr(e)))
+
+    ts = [threading.Thread(target=runner, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=240)
+    assert not rank_errors, rank_errors
+    assert results == [True] * n
+    # every lane genuinely moved frames on its OWN channel
+    per = WIRE.delta(base)["channel_bytes_streamed"]
+    assert per.get("bulk", 0) > 0, per
+    for nm in lat_names:
+        assert per.get(nm, 0) > 0, per
+
+
+@needs_native
+def test_default_channel_is_lane_zero_and_counts_as_default(sidecar_store):
+    n = 2
+    store = sidecar_store(n)
+    base = WIRE.snapshot()
+
+    def fn(rank):
+        pg = dist.init_process_group(rank=rank, world_size=n,
+                                     store_handle=store.handle,
+                                     group_name="lanes-default",
+                                     plane="shm")
+        try:
+            ch = pg.channel("default")
+            assert ch.channel_id == 0 and ch.priority == 0
+            x = np.full(1024, rank + 1.0, np.float32)
+            got = pg.all_reduce(x)        # plain verb: lane 0
+            got2 = ch.all_reduce(x)       # default handle: same lane
+            np.testing.assert_allclose(got, np.full(1024, 3.0, np.float32))
+            np.testing.assert_allclose(got2, got)
+            return True
+        finally:
+            pg.destroy()
+
+    results = [None] * n
+    errs = []
+
+    def runner(r):
+        try:
+            results[r] = fn(r)
+        except Exception as e:  # noqa: BLE001
+            errs.append((r, repr(e)))
+
+    ts = [threading.Thread(target=runner, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    assert not errs, errs
+    assert results == [True] * n
+    per = WIRE.delta(base)["channel_frames_streamed"]
+    assert per.get("default", 0) > 0, per  # un-laned traffic IS lane 0
+
+
+def test_channel_conflicting_reopen_refused(sidecar_store):
+    store = sidecar_store(1)
+    pg = dist.init_process_group(rank=0, world_size=1,
+                                 store_handle=store.handle,
+                                 group_name="lanes-conflict", plane="shm")
+    try:
+        ch = pg.channel("bulk", priority=2, credit_bytes=1 << 20)
+        assert pg.channel("bulk", priority=2, credit_bytes=1 << 20) is ch
+        with pytest.raises(ValueError, match="conflicting re-open"):
+            pg.channel("bulk", priority=7)
+    finally:
+        pg.destroy()
+
+
+# ---------------------------------------------------------------------------
+# fleet: per-channel throughput aggregates cross-rank
+# ---------------------------------------------------------------------------
+
+
+def _snap(orig, epoch, window, chan_bytes):
+    return {
+        "v": 1, "rank": orig, "orig": orig, "epoch": epoch, "seq": 1,
+        "plane": "shm", "health": "ok", "transitions": [], "heals": 0,
+        "window_s": window,
+        "wire": {"payload_bytes_streamed": sum(chan_bytes.values()),
+                 "channel_bytes_streamed": dict(chan_bytes)},
+        "wire_delta": {"payload_bytes_streamed": sum(chan_bytes.values()),
+                       "channel_bytes_streamed": dict(chan_bytes)},
+        "verb_latency": {}, "flight": {"recorded": 0, "capacity": 64},
+    }
+
+
+def test_fleet_aggregates_per_channel_throughput():
+    snaps = [_snap(0, 0, 2.0, {"bulk": 4_000_000_000, "latency": 2_000_000}),
+             _snap(1, 0, 2.0, {"bulk": 4_000_000_000})]
+    out = fleet.aggregate(snaps, epoch=0, members=[0, 1])
+    assert out["channel_GBps"]["bulk"] == pytest.approx(4.0)
+    assert out["channel_GBps"]["latency"] == pytest.approx(0.001)
+    # the per-lane split also survives the exact wire-counter merge
+    assert out["wire_totals"]["channel_bytes_streamed"]["bulk"] \
+        == 8_000_000_000
+    text = fleet.format_fleet(out)
+    assert "lanes:" in text and "bulk=" in text
+
+
+def test_fleet_format_without_lanes_says_so():
+    out = fleet.aggregate([_snap(0, 0, 0.0, {})], epoch=0, members=[0])
+    assert "no laned traffic" in fleet.format_fleet(out)

@@ -192,3 +192,36 @@ def test_ragged_busbw_uses_counts_vector():
         pytest.approx(M.algbw_GBps(size, sec) * 3 / 4)
     # degenerate all-zero counts cannot divide by zero
     assert M.busbw_GBps("allgatherv", 4, 0, sec, counts=[0, 0, 0, 0]) == 0.0
+
+
+def test_wire_counters_per_channel_delta_and_merge():
+    """PR 9: the per-lane dict counters window key-wise and merge
+    key-wise-exact next to the scalars — a lane absent from the base
+    snapshot deltas from zero, and the cross-rank total of a lane is
+    the sum of the ranks' counts."""
+    w = M.WireCounters()
+    w.streamed(nbytes=100, channel="bulk")
+    base = w.snapshot()
+    w.streamed(nbytes=50, channel="bulk")
+    w.streamed(frames=2, nbytes=8, channel="latency")
+    w.fenced(3, channel="bulk")
+    w.lane_yield()
+    w.lane_wait(2)
+    d = w.delta(base)
+    assert d["channel_bytes_streamed"] == {"bulk": 50, "latency": 8}
+    assert d["channel_frames_streamed"] == {"bulk": 1, "latency": 2}
+    assert d["channel_frames_fenced"] == {"bulk": 3}
+    assert d["frames_fenced"] == 3 and d["lane_yields"] == 1
+    assert d["lane_waits"] == 2
+    merged = M.WireCounters.merge([
+        {"frames_streamed": 1, "channel_bytes_streamed": {"bulk": 10}},
+        {"frames_streamed": 2, "channel_bytes_streamed": {"bulk": 5,
+                                                          "latency": 7}},
+    ])
+    assert merged["frames_streamed"] == 3
+    assert merged["channel_bytes_streamed"] == {"bulk": 15, "latency": 7}
+    # everything json-serializable (the fleet publish path)
+    json.dumps(w.snapshot())
+    w.reset()
+    snap = w.snapshot()
+    assert snap["channel_bytes_streamed"] == {} and snap["lane_yields"] == 0

@@ -77,6 +77,17 @@ PG_BLOCKING = {
     "fleet_stats", "publish_telemetry",
 }
 
+# RULE 3 (continued) — the multi-tenant lane surface (PR 9): a
+# ChannelHandle verb blocks exactly like the ProcessGroup verb it wraps
+# (plus the lane gate's admission wait), and LaneGate.admit is the lane
+# scheduler's own blocking point — a starved lane must surface a NAMED
+# timeout the caller chose, never an unbounded deferral
+CHANNEL_BLOCKING = {
+    "all_reduce", "reduce_scatter", "all_gather", "broadcast",
+    "all_to_all", "send", "recv", "isend", "irecv", "batch_isend_irecv",
+}
+LANE_BLOCKING = {"admit"}
+
 
 # RULE 4's surface: the whole package (call sites of the device-plane
 # bootstrap live outside the transport stack — runtime/, bench/)
@@ -152,7 +163,13 @@ def check_file(path: str) -> list[str]:
                           and RING_VERB_RE.match(child.name))
                          or (base_name == "distributed.py"
                              and qual == ["ProcessGroup"]
-                             and child.name in PG_BLOCKING))
+                             and child.name in PG_BLOCKING)
+                         or (base_name == "distributed.py"
+                             and qual == ["ChannelHandle"]
+                             and child.name in CHANNEL_BLOCKING)
+                         or (base_name == "lanes.py"
+                             and qual == ["LaneGate"]
+                             and child.name in LANE_BLOCKING))
                 if named and key not in ALLOW \
                         and "timeout_s" not in _params(child):
                     problems.append(

@@ -115,6 +115,18 @@ ELASTIC_SURFACE = ("grow", "heal", "wait_promotion")
 TELEMETRY_FILE = "rocnrdma_tpu/obs/fleet.py"
 STORE_WRITES = {"set", "set_if_absent", "exchange"}
 
+# the lane-scheduling surface (PR 9): every BLOCKING point of the
+# multi-tenant lane scheduler (``transport/lanes.py`` — mechanically, a
+# function there accepting ``timeout_s``, the same deadline-discipline
+# marker the verb rule keys off) must record an entry event
+# (``_lane_entry``) and a completion event (``_lane_done``). A lane
+# deferral is exactly the wait a QoS postmortem needs on the timeline:
+# "the latency lane's P99 spiked" is untriageable if the gate's stalls
+# are invisible next to the frames they delayed.
+LANE_FILE = "rocnrdma_tpu/transport/lanes.py"
+LANE_ENTRY_MARKERS = {"_lane_entry"}
+LANE_DONE_MARKERS = {"_lane_done"}
+
 ALLOW: dict[str, str] = {}
 
 
@@ -315,6 +327,35 @@ def telemetry_problems(tree: ast.Module, where: str,
     return problems
 
 
+def lane_problems(tree: ast.Module, where: str,
+                  used: set | None = None) -> list[str]:
+    """The lane-scheduling invariant: every blocking function of the
+    lane scheduler (accepts ``timeout_s``) must call ``_lane_entry``
+    AND ``_lane_done`` — a lane deferral with no timeline entry is a
+    QoS stall the postmortem cannot see."""
+    problems = []
+    for qual, fn, _owner in base.iter_functions(tree):
+        if "timeout_s" not in base.func_params(fn):
+            continue
+        key = f"{os.path.basename(where)}::{qual}"
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        called = _called_names(fn)
+        if not (called & LANE_ENTRY_MARKERS):
+            problems.append(
+                f"{where}:{fn.lineno}: blocking lane scheduling point "
+                f"{qual} records no entry event (call _lane_entry when "
+                f"the wait begins, or ALLOW it with a reason)")
+        if not (called & LANE_DONE_MARKERS):
+            problems.append(
+                f"{where}:{fn.lineno}: blocking lane scheduling point "
+                f"{qual} records no completion event (call _lane_done "
+                f"when the wait resolves, or ALLOW it with a reason)")
+    return problems
+
+
 def check_source(src: str, path: str = "<fixture>") -> list[str]:
     tree = ast.parse(src, filename=path)
     return check_tree(tree, path) + abort_problems(tree, path)
@@ -336,6 +377,11 @@ def check_telemetry_source(src: str, path: str = "<fixture>") -> list[str]:
     return telemetry_problems(ast.parse(src, filename=path), path)
 
 
+def check_lane_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the lane-scheduling invariant alone."""
+    return lane_problems(ast.parse(src, filename=path), path)
+
+
 def run() -> list[str]:
     used: set = set()
     problems = check_tree(base.parse_file(PLUGIN), PLUGIN, used)
@@ -345,6 +391,7 @@ def run() -> list[str]:
                                  ELASTIC_FILE, used)
     problems += telemetry_problems(base.parse_file(TELEMETRY_FILE),
                                    TELEMETRY_FILE, used)
+    problems += lane_problems(base.parse_file(LANE_FILE), LANE_FILE, used)
     problems += base.allow_reason_problems(ALLOW, NAME)
     problems += base.allow_stale_problems(ALLOW, used, NAME)
     return problems
