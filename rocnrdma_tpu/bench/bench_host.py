@@ -290,6 +290,52 @@ def _lanes_worker(pg, args) -> list:
         wire=wire, verb_lat=VERBS.delta(verb_base), fleet=fleet)]
 
 
+def _trace_summary(pg, collective: str) -> dict:
+    """The causal tracer's condensed verdict for one bench row: the
+    SLOWEST assembled sampled op matching this collective — its wall
+    span, critical-path total, the straggler rank (``cp_rank``, the
+    ``format_table`` column), the worst hop, and that rank's
+    five-bucket attribution. Sampling is the tracer's default
+    (``ROCNRDMA_TRACE_SAMPLE``) — the bench proves the smoke floors
+    hold with tracing ON, and the attached attribution is why a slow
+    row was slow, not just that it was."""
+    tr = pg.trace_stats()
+
+    def norm(verb: str) -> str:
+        # fn __name__ -> bench collective name: "ring_reduce_scatter_v
+        # _over_net" -> "reducescatterv". EXACT equality after the
+        # strip — a substring match would cross-credit the v-variants
+        # ("alltoall" inside "alltoallv"), and the buffer retains
+        # earlier collectives' ops across a multi-collective sweep
+        for affix in ("ring_", "_over_net", "_rdma"):
+            verb = verb.replace(affix, "")
+        return verb.replace("_", "")
+
+    # NEVER fall back to other collectives' ops: a mismatched verdict
+    # on the row is worse than none
+    ops = [t for t in tr["ops"] if norm(t["verb"]) == collective]
+    out = {"sample": tr["sample"], "ops_assembled": len(tr["ops"]),
+           "cp_rank": None}
+    if not ops:
+        return out
+    slow = max(ops, key=lambda t: t["wall_s"])
+    out.update(
+        op=slow["op"], verb=slow["verb"], epoch=slow["epoch"],
+        wall_us=round(slow["wall_s"] * 1e6, 1),
+        cp_us=round(slow["cp_total_s"] * 1e6, 1),
+        cp_rank=slow["cp_rank"],
+        cp_share={r: round(s * 1e6, 1)
+                  for r, s in slow["cp_share"].items()},
+        worst_hop=slow["worst_hop"])
+    if slow["cp_rank"] is not None:
+        info = slow["ranks"].get(str(slow["cp_rank"]))
+        if info is not None:
+            out["attribution_us"] = {
+                b: round(s * 1e6, 1)
+                for b, s in info["attribution"].items()}
+    return out
+
+
 def worker(args) -> int:
     from rocnrdma_tpu import distributed as dist
     from rocnrdma_tpu.metrics import VERBS, WIRE
@@ -393,7 +439,7 @@ def worker(args) -> int:
                     "float32", sec, platform=f"host-{args.plane}",
                     counts=ragged, iters=args.iters, repeats=args.repeats,
                     wire=wire, verb_lat=VERBS.delta(verb_base),
-                    fleet=fleet))
+                    fleet=fleet, trace=_trace_summary(pg, collective)))
     pg.barrier()
     pg.destroy()
     if pg.rank == 0:

@@ -40,6 +40,7 @@ import numpy as np
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
 from rocnrdma_tpu.obs import fleet as _fleet
+from rocnrdma_tpu.obs import trace as _trace
 from rocnrdma_tpu.transport import (
     HostQPNet,
     TCPNet,
@@ -479,6 +480,16 @@ class ProcessGroup:
             # against the world THIS attempt's inputs were shaped for
             epoch0 = self.epoch
             prev = list(self._ranks)
+            # the attempt's causal-trace identity: the op number this
+            # collective will COMMIT as on its lane (one collective per
+            # lane at a time — the per-lane mutex — so the pre-commit
+            # count IS the op being executed), plus the attempt's epoch
+            # and lane chan. A sampled op's span collects the wire's
+            # frame/wait events into one per-rank op record (obs.trace);
+            # a retried attempt re-opens the span under the new epoch.
+            chan = _lanes.current_channel()
+            with self._op_lock:
+                op_no = self._lane_ops.get(chan, 0)
             try:
                 self._check_alive()  # fail fast instead of hanging on the dead
                 if self.world_size > 1 and (self._send is None
@@ -489,8 +500,11 @@ class ProcessGroup:
                     # handing a dead edge to the collective
                     raise OSError("ring wiring torn by a failed repair; "
                                   "re-healing")
-                out = fn(self._net, self._send, self._recv, *args,
-                         self.rank, self.world_size, timeout_s=t, **kw)
+                with _trace.op_span(epoch0, chan, op_no,
+                                    getattr(fn, "__name__", "collective"),
+                                    self.rank):
+                    out = fn(self._net, self._send, self._recv, *args,
+                             self.rank, self.world_size, timeout_s=t, **kw)
             except (TimeoutError, OSError, RuntimeError) as e:
                 # CLEAN-ABORT: the collective died with a named error —
                 # on the flight timeline either way; with self-healing
@@ -565,7 +579,6 @@ class ProcessGroup:
             with self._op_lock:
                 self.last_op_epoch = self.epoch
                 self._op_seq += 1
-                chan = _lanes.current_channel()
                 self._lane_ops[chan] = self._lane_ops.get(chan, 0) + 1
             return out
         raise RuntimeError(
@@ -2833,26 +2846,65 @@ class ProcessGroup:
                 "fleet_stats: this rank is a standby (promotion pending); "
                 "it has no membership to aggregate over")
         snaps: list = [self._fleet_agent.local_snapshot()]
-        if self._client is not None:
-            deadline = time.monotonic() + timeout_s
-            me = self._ranks[self.rank] if self._ranks else -1
-            for g in self._ranks:
-                if g == me or time.monotonic() >= deadline:
-                    continue
-                try:
-                    raw = self._client.try_get(
-                        _fleet.snapshot_key(self.group_name, self.epoch, g),
-                        timeout_s=deadline - time.monotonic())
-                except (OSError, TimeoutError):
-                    raw = None  # reported as missing, never waited for
-                if raw is not None:
-                    import json
-                    try:
-                        snaps.append(json.loads(raw))
-                    except ValueError:
-                        pass  # a torn write reads as missing
+        snaps += self._fetch_member_snapshots(timeout_s)
         return _fleet.aggregate(snaps, epoch=self.epoch,
                                 members=list(self._ranks))
+
+    def _fetch_member_snapshots(self, timeout_s: float) -> list:
+        """Every OTHER member's latest published telemetry payload,
+        parsed — the shared fetch of ``fleet_stats``/``trace_stats``.
+        One overall deadline; a rank whose key cannot be read (or
+        parsed) in time is simply absent, never waited for."""
+        out: list = []
+        if self._client is None:
+            return out
+        deadline = time.monotonic() + timeout_s
+        me = self._ranks[self.rank] if self._ranks else -1
+        for g in self._ranks:
+            if g == me or time.monotonic() >= deadline:
+                continue
+            try:
+                raw = self._client.try_get(
+                    _fleet.snapshot_key(self.group_name, self.epoch, g),
+                    timeout_s=deadline - time.monotonic())
+            except (OSError, TimeoutError):
+                raw = None  # reported as missing, never waited for
+            if raw is not None:
+                import json
+                try:
+                    out.append(json.loads(raw))
+                except ValueError:
+                    pass  # a torn write reads as missing
+        return out
+
+    def trace_stats(self, timeout_s: float = 5.0) -> dict:
+        """The assembled causal traces of recent SAMPLED collectives:
+        this rank's op records (``obs.trace.TRACE``) merged with every
+        other member's latest published records (they ride the fleet
+        telemetry snapshots — same store channel, same bounded
+        best-effort rules) into per-op cross-rank span trees with their
+        critical paths, plus the windowed straggler scoreboard. Only
+        ops for which EVERY current member's record is present are
+        assembled — a partial tree's critical path would blame whoever
+        happened to publish. Reads are bounded by ``timeout_s``
+        overall; nothing here touches the collective hot path."""
+        if self._standby is not None:
+            raise RuntimeError(
+                "trace_stats: this rank is a standby (promotion "
+                "pending); it has no membership to aggregate over")
+        # fenced like every fleet read: only THIS generation's records
+        # assemble (local and remote alike) — a pre-heal op's tree
+        # would pair ranks that no longer neighbour each other
+        records = [r for r in _trace.TRACE.snapshot()
+                   if r.get("epoch") == self.epoch]
+        for s in self._fetch_member_snapshots(timeout_s):
+            if s.get("epoch") == self.epoch:
+                records.extend(r for r in s.get("trace", [])
+                               if r.get("epoch") == self.epoch)
+        assembled = _trace.assemble(records, world=self.world_size)
+        return {"epoch": self.epoch, "sample": _trace.sample_every(),
+                "ops": assembled,
+                "scoreboard": _trace.scoreboard(assembled)}
 
     # -- watchdog (the ProcessGroupNCCL watchdog / RCCL heartbeat analogue) --
 
@@ -3039,9 +3091,12 @@ class ProcessGroup:
         serve at verb ENTRY — and a receiver still draining its resumed
         tail (bounded) while the sender is already blocked in the next
         collective is a cycle nothing breaks. Cheap when idle: one bool
-        read."""
+        read. The service runs OUTSIDE any active op span: its waits
+        belong to the resumed stream, not to the sampled collective
+        whose blocking loop gave it this turn."""
         if self._p2p_resume_pending:
-            self._p2p_resume_pending = self._p2p_resume_service() > 0
+            with _trace.suspended():
+                self._p2p_resume_pending = self._p2p_resume_service() > 0
 
     def _check_alive(self) -> None:
         if self._p2p_resume_pending:

@@ -668,19 +668,26 @@ def format_table(records: list) -> str:
     actually waits on; ``-`` for records with no fleet telemetry.
     ``lane`` names the QoS channel a multi-tenant measurement ran on
     (the bench_host lanes scenario tags its latency-lane rows); ``-``
-    for ordinary single-tenant rows."""
+    for ordinary single-tenant rows. ``cp-rank`` is the rank holding
+    the largest share of the SLOWEST sampled op's critical path (the
+    causal tracer's attribution, ``extra["trace"]["cp_rank"]``) — the
+    straggler a mean-looking row is actually waiting on; ``-`` for
+    records with no assembled trace."""
     hdr = (f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} "
            f"{'dtype':>9} {'tier':>18} {'lane':>9} {'time(us)':>12} "
-           f"{'algbw GB/s':>11} {'busbw GB/s':>11} {'wp99(us)':>9}")
+           f"{'algbw GB/s':>11} {'busbw GB/s':>11} {'wp99(us)':>9} "
+           f"{'cp-rank':>8}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
         wp99 = r.extra.get("fleet", {}).get("worst_p99_us")
+        cp = r.extra.get("trace", {}).get("cp_rank")
         lines.append(
             f"{r.collective:>13} {r.algo:>12} {r.n_ranks:>5} {r.size_bytes:>14} "
             f"{r.dtype:>9} {r.tier:>18} {r.extra.get('lane', '-'):>9} "
             f"{r.mean_s * 1e6:>12.1f} "
             f"{r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f} "
-            f"{wp99 if wp99 is not None else '-':>9}"
+            f"{wp99 if wp99 is not None else '-':>9} "
+            f"{cp if cp is not None else '-':>8}"
         )
     return "\n".join(lines)
 

@@ -23,7 +23,14 @@ fault ``FaultNet`` injects), plus:
   heartbeat via epoch-qualified store keys, a leader-side aggregator
   merging them (bucket-exact cross-rank verb P50/P99, per-rank health),
   exposed as ``ProcessGroup.fleet_stats()`` and the
-  ``python -m rocnrdma_tpu.obs.fleet`` CLI (``--watch`` for live).
+  ``python -m rocnrdma_tpu.obs.fleet`` CLI (``--watch`` for live);
+- :mod:`rocnrdma_tpu.obs.trace` — causal collective tracing: sampled
+  per-op spans over the wire's frame events, assembled cross-rank into
+  critical paths with per-rank wall-time attribution ({compute-fold,
+  wire, credit-stall, lane-admit, recv-wait}) and a straggler
+  scoreboard — ``ProcessGroup.trace_stats()``, the
+  ``python -m rocnrdma_tpu.obs.trace`` CLI, and the Perfetto merge's
+  ``critical-path`` lane.
 
 ``FLIGHT`` is THE process-wide recorder instance (one per rank process,
 like ``metrics.WIRE``); producers import it, consumers snapshot it.

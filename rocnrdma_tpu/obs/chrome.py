@@ -15,16 +15,19 @@ residual skew is bounded by that poll period, ~1-20 ms, documented in
 DESIGN.md's observability section). The merger shifts each rank's
 timeline so its sync mark sits at a common origin.
 
-Lane layout: one Perfetto *process* per rank (``pid = rank``), four
+Lane layout: one Perfetto *process* per rank (``pid = rank``), five
 threads inside it — ``verbs`` (net-vtable entry/completion spans),
 ``frames`` (ring-wire frame lifecycle slices, one per streamed frame),
-``control`` (bootstrap retries, faults, stalls, sync marks), and
+``control`` (bootstrap retries, faults, stalls, sync marks),
 ``membership`` (the unified host+device recovery timeline: epoch bumps
 and heal/grow/promotion protocol events, ``member-*`` spans for the
 heal/grow/promotion wall time and the device-plane ``reinit_runtime``
-phases, ``fleet-health`` transitions). Events whose args carry ``dur``
-(seconds) render as complete slices (``ph:X``) spanning
-post→completion; everything else is an instant.
+phases, ``fleet-health`` transitions), and ``critical-path`` (the
+causal tracer's per-op spans plus the synthesized ``cp-hop`` slices —
+each sampled op's critical path, segment by segment, on the rank that
+held it, aligned 1:1 against the frame slices it is derived from).
+Events whose args carry ``dur`` (seconds) render as complete slices
+(``ph:X``) spanning post→completion; everything else is an instant.
 
 CLI::
 
@@ -52,11 +55,20 @@ _VERB_PREFIXES = ("isend", "irecv", "iwrite", "iread", "connect", "accept")
 # frame lane — the one unified host+device timeline.
 _MEMBER_PREFIXES = ("member-", "heal-", "grow-", "promote-", "standby-",
                     "deviceheal-", "fleet-health")
+# the causal-trace track: per-op span markers (``trace-op-*``) plus the
+# SYNTHESIZED ``cp-hop`` slices — the merger re-runs the obs.trace
+# assembler over the dumps' op-stamped frame events and renders each
+# critical-path segment on the rank it belongs to, aligned 1:1 against
+# that rank's frame slices (both lanes are built from the same events)
+_TRACE_PREFIXES = ("trace-", "cp-")
 
-_LANES = {"verbs": 0, "frames": 1, "control": 2, "membership": 3}
+_LANES = {"verbs": 0, "frames": 1, "control": 2, "membership": 3,
+          "critical-path": 4}
 
 
 def _lane(kind: str) -> int:
+    if kind.startswith(_TRACE_PREFIXES):
+        return _LANES["critical-path"]
     if kind.startswith(_FRAME_KINDS):
         return _LANES["frames"]
     if kind.startswith(_VERB_PREFIXES):
@@ -160,6 +172,7 @@ def merge(dump_paths: list, out_path: str | None = None) -> dict:
             else:
                 ev.update(ph="i", ts=round(ts_us, 3), s="t")
             trace.append(ev)
+    trace += _critical_path_events(dumps, origin, earliest)
     merged = {"traceEvents": trace, "displayTimeUnit": "ms",
               "otherData": {"ranks": sorted(d["rank"] for d in dumps),
                             "source": "rocnrdma_tpu.obs flight recorder"}}
@@ -168,6 +181,44 @@ def merge(dump_paths: list, out_path: str | None = None) -> dict:
             json.dump(merged, fp)
             fp.write("\n")
     return merged
+
+
+def _critical_path_events(dumps: list, origin, earliest: float) -> list:
+    """The synthesized critical-path slices: rebuild each rank's op
+    records from its dump's op-stamped events (``obs.trace
+    .records_from_events`` — the SAME events the frame lane renders,
+    so the two lanes align exactly), assemble the cross-rank trees,
+    and emit one ``cp-hop`` slice per critical-path segment on the
+    rank whose landing ends it. Ops missing any rank's record are
+    skipped (a partial path would blame whoever happened to dump)."""
+    from rocnrdma_tpu.obs import trace as trace_mod
+    records = []
+    for d in dumps:
+        records += trace_mod.records_from_events(
+            [(e[0], e[1], e[2]) for e in d["events"]],
+            rank=d["rank"], sync_ts=origin(d))
+    out = []
+    for tree in trace_mod.assemble(records, world=len(dumps)):
+        for node in tree["critical_path"]:
+            out.append({
+                "pid": node["rank"], "tid": _LANES["critical-path"],
+                "name": "cp-hop", "cat": "host", "ph": "X",
+                "ts": round((node["t_end"] - node["dur"] - earliest)
+                            * 1e6, 3),
+                "dur": round(node["dur"] * 1e6, 3),
+                "args": {"epoch": tree["epoch"], "chan": tree["chan"],
+                         "op": tree["op"], "hop": node["hop"],
+                         "src": node["src"]}})
+    return out
+
+
+def critical_path_slices(merged: dict, rank: int) -> list:
+    """One rank's synthesized ``cp-hop`` slices (its segments of the
+    sampled ops' critical paths) — what the acceptance check aligns
+    against the same rank's frame slices."""
+    return [e for e in merged["traceEvents"]
+            if e.get("pid") == rank and e.get("ph") == "X"
+            and e.get("name") == "cp-hop"]
 
 
 def frame_slices(merged: dict, rank: int) -> list:

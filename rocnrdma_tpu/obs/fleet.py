@@ -59,6 +59,7 @@ from rocnrdma_tpu.metrics import (
     bucket_percentile_us,
 )
 from rocnrdma_tpu.obs.recorder import FLIGHT as _FLIGHT
+from rocnrdma_tpu.obs import trace as _trace
 
 # the coarse per-rank health states the fleet plane reports. Transitions
 # are recorded by ProcessGroup._set_health at protocol points (confirmed
@@ -146,7 +147,12 @@ class FleetAgent:
             "wire_delta": delta,
             "verb_latency": _VERBS.snapshot(),
             "flight": {"recorded": _FLIGHT.recorded(),
-                       "capacity": _FLIGHT.capacity},
+                       "capacity": _FLIGHT.capacity,
+                       "saturated": _FLIGHT.saturated},
+            # this rank's recent sampled op records (obs.trace): the
+            # causal tracer's cross-rank assembly rides THIS channel —
+            # no extra store writes, same bounded best-effort publish
+            "trace": _trace.TRACE.snapshot(),
         }
 
     def publish(self, client, timeout_s: float = 1.0) -> bool:
@@ -300,6 +306,15 @@ def format_fleet(snap: dict) -> str:
             f"{lane}={gb:.3f} GB/s" for lane, gb in sorted(
                 snap.get("channel_GBps", {}).items()))
             or "(no laned traffic in window)"),
+        # the per-tenant fence split next to the per-tenant throughput:
+        # which lane's frames died with a fenced generation (published
+        # since the lanes PR; rendered here so the --watch view carries
+        # the whole per-lane story on one screen)
+        "  lane-fenced: " + (" ".join(
+            f"{lane}={n}" for lane, n in sorted(
+                snap["wire_totals"].get("channel_frames_fenced",
+                                        {}).items()))
+            or "(none)"),
     ]
     hdr = (f"  {'orig':>5} {'rank':>5} {'health':>9} {'GB/s':>8} "
            f"{'p99(us)':>8} {'flight':>12}")
@@ -320,12 +335,16 @@ def format_fleet(snap: dict) -> str:
     return "\n".join(lines)
 
 
-def read_fleet(store_handle: str, group: str = "default",
-               timeout_s: float = 5.0) -> dict:
-    """One observer read of a group's published telemetry: meta pointer
-    first (current epoch + members), then every member's snapshot key,
-    then :func:`aggregate`. Raises ``LookupError`` when the group has
-    published nothing (no meta key) — distinct from an empty fleet."""
+def read_snapshots(store_handle: str, group: str = "default",
+                   timeout_s: float = 5.0) -> tuple:
+    """One observer read of a group's published telemetry payloads:
+    ``(epoch, members, snapshots)`` — the meta pointer names the
+    generation, then every member's snapshot key is fetched under ONE
+    remaining-budget deadline (an unreadable/torn payload reads as
+    None, never waited for). The shared fetch of :func:`read_fleet`
+    and the trace CLI (``obs.trace.read_trace``). Raises
+    ``LookupError`` when the group has published nothing (no meta key)
+    — distinct from an empty fleet."""
     from rocnrdma_tpu.transport import bootstrap
     client = bootstrap.BootstrapClient(store_handle, None, timeout_s,
                                        scope=f"pg/{group}/ring")
@@ -362,9 +381,19 @@ def read_fleet(store_handle: str, group: str = "default",
                 snaps.append(json.loads(raw) if raw is not None else None)
             except ValueError:
                 snaps.append(None)  # torn payload reads as missing
-        return aggregate(snaps, epoch=epoch, members=members)
+        return epoch, members, snaps
     finally:
         client.close()
+
+
+def read_fleet(store_handle: str, group: str = "default",
+               timeout_s: float = 5.0) -> dict:
+    """One observer read of a group's published telemetry: meta pointer
+    first (current epoch + members), then every member's snapshot key,
+    then :func:`aggregate`. Raises ``LookupError`` when the group has
+    published nothing (no meta key) — distinct from an empty fleet."""
+    epoch, members, snaps = read_snapshots(store_handle, group, timeout_s)
+    return aggregate(snaps, epoch=epoch, members=members)
 
 
 def main(argv=None) -> int:

@@ -51,6 +51,7 @@ class FlightRecorder:
         self._buf: list = [None] * capacity
         self._head = 0        # next write slot
         self._recorded = 0    # lifetime event count (wraps never reset it)
+        self._saturated = False  # the ring wrapped: oldest events evicted
         self._sync_ts: float | None = None
 
     # -- hot path ----------------------------------------------------------
@@ -65,6 +66,17 @@ class FlightRecorder:
             self._buf[self._head] = (t, kind, args)
             self._head = (self._head + 1) % self.capacity
             self._recorded += 1
+            if not self._saturated and self._recorded > self.capacity:
+                # first eviction: the ring is now dropping its oldest
+                # events — marked ONCE so a digest-bearing chaos run
+                # can warn (RINGFULL) instead of silently losing
+                # replay-relevant history, and durable in `saturated`
+                # (the marker event itself can later be evicted; it is
+                # meta, so it does not count toward the lifetime total)
+                self._saturated = True
+                self._buf[self._head] = (t, "flight-ring-saturated",
+                                         {"capacity": self.capacity})
+                self._head = (self._head + 1) % self.capacity
 
     # -- sync / introspection ---------------------------------------------
 
@@ -85,6 +97,14 @@ class FlightRecorder:
     def sync_ts(self) -> float | None:
         with self._lock:
             return self._sync_ts
+
+    @property
+    def saturated(self) -> bool:
+        """True once the ring has wrapped (oldest events evicted) —
+        the capacity guard a digest-bearing chaos run checks before
+        trusting the buffered history."""
+        with self._lock:
+            return self._saturated
 
     def recorded(self) -> int:
         """Lifetime events recorded (NOT capped by capacity)."""
@@ -112,6 +132,7 @@ class FlightRecorder:
             self._buf = [None] * self.capacity
             self._head = 0
             self._recorded = 0
+            self._saturated = False
             self._sync_ts = None
 
 

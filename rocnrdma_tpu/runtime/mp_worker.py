@@ -70,6 +70,24 @@ Tasks:
   clean run prints ``FLEETSNAP``: the merged fleet snapshot (per-rank
   health, bucket-exact merged verb P50/P99, fence/resume totals) after
   every member published a final snapshot.
+
+- ``trace-delay``: the causal-tracing acceptance run (ISSUE 10): a
+  ``ProcessGroup`` fleet (shm plane) where ONLY ``--fault-rank``'s
+  receive completions are held by FaultNet ``test_delay`` — the
+  one-slow-rank-serializes-the-ring scenario. Every rank runs
+  ``--rounds`` bitwise-checked int64 allreduces with full tracing
+  (the harness sets ``ROCNRDMA_TRACE_SAMPLE=1``) and prints its op
+  records (``TRACE {json}``) plus the structural replay digest
+  (``TRACELOG hex``); the harness assembles the records cross-rank
+  and asserts the critical path names the delayed rank, per-rank
+  attribution buckets sum to each op's wall span, and two same-seed
+  runs digest identically.
+
+Every chaos task also prints a ``RINGFULL`` warning when the flight
+ring wrapped during the run (``flight-ring-saturated`` on the
+timeline): a wrapped ring may have evicted digest-relevant events, so
+the harness raises ``ROCNRDMA_FLIGHT_EVENTS`` instead of chasing a
+phantom replay divergence.
 """
 
 from __future__ import annotations
@@ -79,7 +97,8 @@ import os
 import sys
 import time
 
-CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective", "kill-and-heal")
+CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective", "kill-and-heal",
+               "trace-delay")
 # tasks that drive BOTH planes: the host-plane chaos stack AND a real
 # jax coordination service (run_workers reserves a second port for it)
 DEVICE_TASKS = ("kill-a-host",)
@@ -165,6 +184,7 @@ def _chaos_main(args) -> int:
     finally:
         print(f"FAULTS {sched.counters.to_json()}", flush=True)
         print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        _print_ringfull()
         # chaos timeline dump (injections + absorptions + stalls) when
         # ROCNRDMA_FLIGHT_DUMP asks, mergeable by obs.chrome like any
         # other rank fleet's
@@ -379,6 +399,96 @@ def _print_fleet(pg) -> None:
     trans = _health_transitions(pg)
     print(f"HEALTH {json.dumps(trans)}", flush=True)
     print(f"FLEET {_fleet_log(trans)}", flush=True)
+
+
+def _print_ringfull() -> None:
+    """The flight-ring capacity guard's chaos-harness half: when the
+    ring wrapped during a digest-bearing run, say so LOUDLY — evicted
+    events would otherwise read as a timing-dependent replay
+    divergence (or a silently shortened HEALLOG) with no cause on
+    screen."""
+    from rocnrdma_tpu.obs import FLIGHT
+    if FLIGHT.saturated:
+        print(f"RINGFULL flight ring wrapped ({FLIGHT.recorded()} events"
+              f" > capacity {FLIGHT.capacity}): digest-relevant events "
+              f"may have been evicted — raise ROCNRDMA_FLIGHT_EVENTS",
+              flush=True)
+
+
+def _trace_chaos_main(args) -> int:
+    """The causal-tracing acceptance task (module docstring:
+    ``trace-delay``)."""
+    import json
+
+    import numpy as np
+
+    from rocnrdma_tpu import distributed as dist
+    from rocnrdma_tpu.obs import trace as obs_trace
+    from rocnrdma_tpu.transport import bootstrap
+    from rocnrdma_tpu.transport.faults import FaultSchedule
+
+    rank, n = args.process_id, args.num_processes
+    server = None
+    if rank == 0:
+        host, port = args.coordinator.rsplit(":", 1)
+        server = bootstrap.BootstrapServer(n_ranks=n, port=int(port),
+                                           host=host)
+    # ONLY the victim's receive completions are held — long enough
+    # (hundreds of polls: the wait loop's backoff turns them into tens
+    # of ms) to dominate the cross-rank clock-alignment skew, so the
+    # critical path's verdict is unambiguous. Decisions key off the
+    # rank's own op sequence: replay-equal per seed by construction.
+    sched = FaultSchedule(
+        args.seed, rank,
+        test_delay_p=(1.0 if rank == args.fault_rank else 0.0),
+        test_delay_polls=(600, 900))
+    status = 0
+    pg = None
+    try:
+        pg = dist.init_process_group(
+            rank=rank, world_size=n, store_handle=args.coordinator,
+            timeout_s=60.0, group_name=f"trace{args.seed}", plane="shm",
+            fault_schedule=sched)
+        for rnd in range(args.rounds):
+            local = _chaos_input(args.seed, rank, rnd, args.size)
+            got = pg.all_reduce(local, timeout_s=60.0)
+            want = _chaos_input(args.seed, 0, rnd, args.size)
+            for r in range(1, n):
+                want = want + _chaos_input(args.seed, r, rnd, args.size)
+            if not np.array_equal(got, want):
+                print(f"BAD-RESULT: round {rnd} not bitwise-correct",
+                      flush=True)
+                status = 5
+                break
+        if status == 0:
+            # flush this rank's records onto the fleet channel (so a
+            # leader-side trace_stats/CLI could assemble them too),
+            # then print them for the harness
+            pg.publish_telemetry()
+            pg.barrier()
+            print(f"OK rank={rank}/{n} rounds={args.rounds}", flush=True)
+    except (TimeoutError, OSError, RuntimeError) as e:
+        print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+        status = 4
+    finally:
+        recs = obs_trace.TRACE.snapshot()
+        print(f"TRACE {json.dumps(recs)}", flush=True)
+        print(f"TRACELOG {obs_trace.digest(recs)}", flush=True)
+        print(f"FAULTS {sched.counters.to_json()}", flush=True)
+        print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        _print_ringfull()
+        from rocnrdma_tpu.obs import chrome
+        chrome.dump_if_env(rank)
+        if pg is not None:
+            try:
+                pg.destroy(graceful=status == 0)
+            except (OSError, TimeoutError):
+                pass
+        if server is not None:
+            if status == 0:
+                server.wait_idle(timeout_s=5.0)
+            server.close()
+    return status
 
 
 def _print_fleetsnap(pg) -> None:
@@ -640,6 +750,7 @@ def _device_chaos_main(args) -> int:
         print(f"DEVICEHEAL {_device_log()}", flush=True)
         print(f"DEVICEHEAL_MS {reinit_ms}", flush=True)
         _print_fleet(pg)
+        _print_ringfull()
         if fail_sock[0] is not None:
             fail_sock[0].close()
         from rocnrdma_tpu.obs import chrome
@@ -755,6 +866,7 @@ def _heal_chaos_main(args) -> int:
         print(f"HEALLOG {_heal_log()}", flush=True)
         print(f"GROWLOG {_grow_log()}", flush=True)
         _print_fleet(pg)
+        _print_ringfull()
         if os.environ.get("ROCNRDMA_CHAOS_DUMP"):
             # replay-divergence triage: the RAW injection log behind
             # FAULTLOG, one line so the harness can diff two runs
@@ -839,6 +951,8 @@ def main(argv=None) -> int:
         return _device_chaos_main(args)  # both planes
     if args.task == "kill-and-heal":
         return _heal_chaos_main(args)  # host plane only: no jax
+    if args.task == "trace-delay":
+        return _trace_chaos_main(args)  # host plane only: no jax
     if args.task in CHAOS_TASKS:
         return _chaos_main(args)  # host plane only: no jax, no devices
 

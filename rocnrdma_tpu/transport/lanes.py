@@ -67,6 +67,7 @@ import zlib
 
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
+from rocnrdma_tpu.obs import trace as _trace
 from rocnrdma_tpu.transport.backoff import Backoff
 
 DEFAULT_LANE = "default"
@@ -206,8 +207,10 @@ def lane_context(channel: int):
 
 def _lane_entry(point: str, **ctx) -> float:
     """Record a lane scheduling point's entry (``<point>-wait``);
-    returns the timestamp the completion side measures from."""
-    _FLIGHT.record(point + "-wait", **ctx)
+    returns the timestamp the completion side measures from. Recorded
+    through the causal tracer's stamper so a wait inside a sampled op
+    span lands in that op's lane-admit attribution bucket."""
+    _trace.record(point + "-wait", **ctx)
     return time.perf_counter()
 
 
@@ -217,7 +220,7 @@ def _lane_done(point: str, t0: float, **ctx) -> None:
     starving shows up as this point's tail, next to the verb it held."""
     dt = time.perf_counter() - t0
     _VERB_LAT.observe(point, dt)
-    _FLIGHT.record(point + "-done", dur=dt, **ctx)
+    _trace.record(point + "-done", dur=dt, **ctx)
 
 
 class LaneGate:

@@ -42,6 +42,7 @@ import numpy as np
 
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
+from rocnrdma_tpu.obs import trace as _trace
 from rocnrdma_tpu.transport import lanes as _lanes
 from rocnrdma_tpu.transport.backoff import Backoff
 
@@ -680,7 +681,7 @@ class HostQPNet:
         then flushed best-effort (NON-blocking: a nominally non-blocking
         Request.test() must not spin on a full send ring; a deferred ACK
         drains at the next probe/pump of this comm)."""
-        _FLIGHT.record("lg-credit-acked", nbytes=length)
+        _trace.record("lg-credit-acked", nbytes=length)
         comm._lg_ack_queue.append(comm._hdr(self._LG_ACK_TAG)
                                   + length.to_bytes(8, "little"))
         self._lg_flush_acks(comm)
@@ -762,7 +763,7 @@ class HostQPNet:
         # lock: concurrent lanes' large sends interleave their windows
         # safely (the single-writer-per-direction invariant becomes
         # single-ALLOCATOR-per-direction under the lock).
-        stall_logged = False  # one event per stall episode, not per poll
+        stall_t0 = None  # one event per stall episode, not per poll
         offset = None
         while True:
             self._lg_drain_acks(comm)
@@ -774,10 +775,10 @@ class HostQPNet:
                     comm._lg_head += need
                     comm._lg_outstanding += need
                     break
-            if not stall_logged:
-                stall_logged = True
-                _FLIGHT.record("credit-stalled", tag=tag, need=need,
-                               outstanding=comm._lg_outstanding)
+            if stall_t0 is None:
+                stall_t0 = time.perf_counter()
+                _trace.record("credit-stalled", tag=tag, need=need,
+                              outstanding=comm._lg_outstanding)
             comm._pump()
             if progress is not None:
                 progress()
@@ -786,6 +787,11 @@ class HostQPNet:
                     "host net: large-message arena credit starved "
                     "(peer not consuming?)")
             back.pause()
+        if stall_t0 is not None:
+            # the stall's resolution (with the wait as dur): what the
+            # causal tracer attributes to the op's credit-stall bucket
+            _trace.record("credit-resumed", tag=tag,
+                          dur=time.perf_counter() - stall_t0)
         # 3. the put, completed BEFORE the descriptor leaves (the soft-NIC
         # applies posts in order, but completion is the portable guarantee)
         self.iwrite(comm, rkey, mr, offset, timeout_s=timeout_s,
@@ -898,18 +904,34 @@ class HostQPNet:
             nonlocal label
             if combine is None:
                 dest[:length] = src_u8
+                fold = 0.0
+            elif _trace.tracing():
+                # sampled op: the fold's own cost feeds the causal
+                # tracer's compute-fold bucket (two perf_counter reads
+                # per frame, paid only under a sampled span)
+                f0 = time.perf_counter()
+                d = dest[:length].view(dtype)
+                combine(d, src_u8.view(dtype), out=d)
+                fold = time.perf_counter() - f0
             else:
                 d = dest[:length].view(dtype)
                 combine(d, src_u8.view(dtype), out=d)
+                fold = 0.0
             if label is None:
                 label = comm._label(chan)
             _WIRE.streamed(nbytes=length, channel=label)
             # one irecv_into request is one wire frame, so this event IS
             # the frame's landing slice (post->consume as dur): the trace
-            # lane the acceptance check counts against frames_streamed
+            # lane the acceptance check counts against frames_streamed;
+            # under a sampled op span it is additionally stamped
+            # (epoch, chan, op) — the causal tracer's hop landings
             _verb_done("irecv_into", t0, tag=tag, nbytes=length)
-            _FLIGHT.record(frame_kind, tag=tag, nbytes=length,
-                           dur=time.perf_counter() - t0)
+            if fold > 0.0:
+                _trace.record(frame_kind, tag=tag, nbytes=length,
+                              dur=time.perf_counter() - t0, fold=fold)
+            else:
+                _trace.record(frame_kind, tag=tag, nbytes=length,
+                              dur=time.perf_counter() - t0)
 
         def probe():
             with comm._lock:
@@ -1508,7 +1530,14 @@ class _RingWire:
         # hop k drains), 1 when there is only one hop to pipeline
         depth = 2 if H > 1 else 1
         _WIRE.negotiated(frame, depth)
-        _FLIGHT.record("stream-start", hops=H, frame=frame, depth=depth)
+        # the ring neighbours ride the event (up = who our inbound
+        # frames come from, down = who we forward to): the cross-rank
+        # edges of the causal trace need no wire-format change — frames
+        # already name their peer here
+        up = self.peers[1] if self.peers is not None else None
+        down = self.peers[0] if self.peers is not None else None
+        _trace.record("stream-start", hops=H, frame=frame, depth=depth,
+                      up=up, down=down)
         hop_nos = [next(self._hops) for _ in range(H)]
         pending = collections.deque()  # posted recv Requests, arrival order
         send_pump = getattr(self.send_comm, "_pump", None)
@@ -1541,8 +1570,8 @@ class _RingWire:
                 r = self._recv_into(self.recv_comm, dest[off:off + nb],
                                     tag=tagf(fi), combine=combine,
                                     dtype=dtype)
-                _FLIGHT.record("frame-posted", hop=hop_nos[k], frame=fi,
-                               nbytes=nb)
+                _trace.record("frame-posted", hop=hop_nos[k], frame=fi,
+                              nbytes=nb)
                 reqs.append((off, nb, r))
                 pending.append(r)
             return reqs
@@ -1558,6 +1587,11 @@ class _RingWire:
                             frame=frame)
         except TimeoutError as e:
             raise self._stall("send", hop_nos[0], 0, e) from e
+        if _trace.tracing():
+            # sampled op: when each hop's frames were handed to the
+            # wire (the causal tracer splits a critical-path segment
+            # at this point — sender-side hold vs wire+receiver)
+            _trace.record("frame-sent", hop=hop_nos[0], frame=0)
         blocked = True  # nothing precedes frame 0: its arrival is not overlap
         for k in range(H):
             if k + 1 < H and posted[k + 1] is None:
@@ -1576,10 +1610,20 @@ class _RingWire:
                         _WIRE.overlapped()
                     blocked = False
                 else:
+                    # sampled op: the BLOCKED portion of this wait is
+                    # the recv-wait bucket of the causal attribution
+                    # (the frame's own dur spans post->consume, which
+                    # includes time we spent productively elsewhere)
+                    t_w = (time.perf_counter() if _trace.tracing()
+                           else None)
                     try:
                         r.wait(timeout_s=t, progress=consume_progress)
                     except TimeoutError as e:
                         raise self._stall("recv", hop_nos[k], fi, e) from e
+                    if t_w is not None:
+                        _trace.record("recv-wait", hop=hop_nos[k],
+                                      frame=fi,
+                                      dur=time.perf_counter() - t_w)
                     blocked = True
                 if nxt_tag is not None:
                     # this frame of dest is final: it IS frame f of the
@@ -1594,6 +1638,9 @@ class _RingWire:
                     except TimeoutError as e:
                         raise self._stall("send", hop_nos[k + 1], fi,
                                           e) from e
+                    if _trace.tracing():
+                        _trace.record("frame-sent", hop=hop_nos[k + 1],
+                                      frame=fi)
             posted[k] = None
         try:
             _flush_tx(self.send_comm, t, extra_pump=consume_progress,

@@ -10,6 +10,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -20,32 +22,50 @@ sys.path.pop(0)
 
 
 # ---------------------------------------------------------------------------
-# the whole suite, end to end
+# the whole suite, end to end — driven through the CLI's --json output
+# (structured per-pass counts: the ratchet diffs DATA, not stdout prose)
 # ---------------------------------------------------------------------------
 
 
-def test_repo_is_clean_one_exit_code():
-    """`python -m tools.analyze` is the one command CI (and a human) runs:
-    exit 0, every pass clean."""
-    out = subprocess.run([sys.executable, "-m", "tools.analyze"],
+@pytest.fixture(scope="module")
+def cli_json():
+    """ONE `python -m tools.analyze --json` run shared by the
+    end-to-end tests (the suite walks the whole transport surface; the
+    clean check and the ratchet must see the same run)."""
+    out = subprocess.run([sys.executable, "-m", "tools.analyze",
+                          "--json"],
                          capture_output=True, text=True, cwd=REPO,
                          timeout=120)
+    payload = json.loads(out.stdout) if out.stdout.strip() else {}
+    return out, payload
+
+
+def test_repo_is_clean_one_exit_code(cli_json):
+    """`python -m tools.analyze` is the one command CI (and a human)
+    runs: exit 0, every pass clean — asserted on the structured
+    counts, not by grepping the table."""
+    out, payload = cli_json
     assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
-    assert "0 problem(s) total" in out.stdout
+    assert set(payload) == {"counts", "problems"}
+    assert all(n == 0 for n in payload["counts"].values()), payload
+    assert all(p == [] for p in payload["problems"].values()), payload
 
 
-def test_ratchet_counts_never_grow():
-    """The snapshot is a ceiling, not a target: each pass's finding count
-    must stay <= the recorded value (currently all zero — the ALLOW lists
-    are empty and the surface complies)."""
+def test_ratchet_counts_never_grow(cli_json):
+    """The snapshot is a ceiling, not a target: each pass's finding
+    count must stay <= the recorded value (currently all zero — the
+    ALLOW lists are empty and the surface complies). The diff is
+    structured: the CLI's --json counts against the snapshot's counts,
+    key by key."""
+    _out, payload = cli_json
     with open(os.path.join(REPO, analyze.SNAPSHOT)) as fp:
         snap = json.load(fp)["counts"]
-    current = analyze.counts()
+    current = payload["counts"]
     for name, ceiling in snap.items():
         assert current.get(name, 0) <= ceiling, (
             f"pass {name!r} grew from {ceiling} to {current.get(name)} "
             f"finding(s) — fix the code, don't regress the ratchet:\n"
-            + "\n".join(analyze.run_all()[name]))
+            + "\n".join(payload["problems"].get(name, [])))
     # and every pass is in the snapshot, so a NEW pass can't dodge the gate
     assert set(current) == set(snap), (set(current), set(snap))
 
@@ -876,6 +896,101 @@ def test_obs_lane_rule_covers_the_repo_lanes_module():
 # pass #0 extension (PR 9): the lane blocking surface — ChannelHandle
 # verbs and the LaneGate's admission wait accept timeout_s
 # ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# pass #4f: span-pairing discipline (PR 10) — every span-open in the
+# causal tracer has a guaranteed span-close on all exits
+# ---------------------------------------------------------------------------
+
+_SPAN_GOOD = textwrap.dedent("""
+    @contextlib.contextmanager
+    def op_span(epoch, chan, op, verb, rank):
+        t0 = _span_open("trace-op", op=op)
+        try:
+            yield
+        except BaseException as e:
+            _span_abort("trace-op", t0, error=type(e).__name__)
+            raise
+        else:
+            _span_close("trace-op", t0, op=op)
+
+    def finally_shaped(op):
+        t0 = _span_open("trace-op", op=op)
+        try:
+            return work()
+        finally:
+            _span_close("trace-op", t0)
+""")
+
+
+def test_obs_span_rule_accepts_guaranteed_closes():
+    assert obs.check_span_source(_SPAN_GOOD, "trace.py") == []
+
+
+def test_obs_span_rule_flags_success_only_close():
+    # the close is skipped the moment work() raises: a dangling span
+    src = textwrap.dedent("""
+        def leaky(op):
+            t0 = _span_open("trace-op", op=op)
+            work()
+            _span_close("trace-op", t0)
+    """)
+    problems = obs.check_span_source(src, "trace.py")
+    assert len(problems) == 1, problems
+    assert "no guaranteed close" in problems[0]
+
+
+def test_obs_span_rule_flags_handler_that_does_not_reraise():
+    # an absorbing handler is not a close guarantee: the span ends but
+    # the op's failure never reaches the caller's record-and-reraise
+    src = textwrap.dedent("""
+        def swallows(op):
+            t0 = _span_open("trace-op", op=op)
+            try:
+                work()
+            except Exception as e:
+                _span_abort("trace-op", t0, error=type(e).__name__)
+            _span_close("trace-op", t0)
+    """)
+    problems = obs.check_span_source(src, "trace.py")
+    assert len(problems) == 1, problems
+
+
+def test_obs_span_rule_flags_span_with_no_close_at_all():
+    src = textwrap.dedent("""
+        def fire_and_forget(op):
+            _span_open("trace-op", op=op)
+            return work()
+    """)
+    problems = obs.check_span_source(src, "trace.py")
+    assert len(problems) == 1, problems
+
+
+def test_obs_span_rule_attributes_nested_opens_to_the_nested_def():
+    # the outer function contains a nested def that opens (and closes)
+    # its own span: only the nested def owns it — no double flag, no
+    # spurious outer finding
+    src = textwrap.dedent("""
+        def outer(ops):
+            def one(op):
+                t0 = _span_open("trace-op", op=op)
+                try:
+                    return work()
+                finally:
+                    _span_close("trace-op", t0)
+            return [one(op) for op in ops]
+    """)
+    assert obs.check_span_source(src, "trace.py") == []
+
+
+def test_obs_span_rule_covers_the_repo_trace_module():
+    assert obs.SPAN_FILE == "rocnrdma_tpu/obs/trace.py"
+    src = open(os.path.join(os.path.dirname(__file__), "..",
+                            "rocnrdma_tpu", "obs", "trace.py")).read()
+    # the repo surface complies, and not vacuously: op_span DOES open
+    assert "_span_open" in src
+    assert obs.check_span_source(src, "trace.py") == []
 
 
 def test_deadlines_flags_lane_surface_without_timeout(tmp_path):

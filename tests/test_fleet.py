@@ -87,6 +87,33 @@ def test_bucket_percentile_reads_bucket_upper_bounds():
     assert M.bucket_percentile_us({}, 0.99) == 0
 
 
+def test_bucket_percentile_edge_cases():
+    """The boundaries the fleet merge leans on (previously untested):
+    empty/zero-mass histograms read 0, a single bucket answers every
+    quantile with its own bound, all-mass-in-the-ceiling-bucket reads
+    the 2**26 us cap, and out-of-range quantiles raise."""
+    # single bucket: every quantile falls in it
+    single = {"<=16us": 7}
+    for q in (0.001, 0.5, 0.99, 1.0):
+        assert M.bucket_percentile_us(single, q) == 16
+    # empty and zero-mass histograms: 0, never a KeyError/div-by-zero
+    assert M.bucket_percentile_us({}, 0.5) == 0
+    assert M.bucket_percentile_us({"<=4us": 0, "<=8us": 0}, 0.5) == 0
+    # every observation collapsed into the top (ceiling) bucket — the
+    # "all verbs were hangs" shape
+    top = f"<={1 << 26}us"
+    assert M.bucket_percentile_us({top: 3}, 0.01) == 1 << 26
+    assert M.bucket_percentile_us({top: 3}, 1.0) == 1 << 26
+    # a quantile outside (0, 1] is a caller bug, named
+    for q in (0.0, -0.5, 1.01):
+        with pytest.raises(ValueError):
+            M.bucket_percentile_us(single, q)
+    # unsorted insertion order never changes the verdict (labels sort
+    # numerically, not lexically: "<=16us" < "<=4us" as strings)
+    buckets = {"<=16us": 1, "<=4us": 99}
+    assert M.bucket_percentile_us(buckets, 0.5) == 4
+
+
 # ---------------------------------------------------------------------------
 # the aggregator: exact merging, epoch fencing, missing ranks
 # ---------------------------------------------------------------------------
@@ -102,7 +129,9 @@ def _snap(orig, epoch=0, health="ok", plane="shm", streamed=0,
             "payload_bytes_streamed": streamed,
             "frames_streamed": max(1, streamed // 64), "frames_copied": 0,
             "frames_overlapped": 0, "frames_fenced": 1, "frames_resumed": 0,
-            "grows": 0, "promotions": 0}
+            "grows": 0, "promotions": 0,
+            "channel_frames_streamed": {}, "channel_bytes_streamed": {},
+            "channel_frames_fenced": {}}
     return {"v": 1, "rank": orig, "orig": orig, "epoch": epoch, "seq": seq,
             "plane": plane, "health": health, "transitions": [],
             "heals": heals, "window_s": window, "wire": wire,
@@ -169,6 +198,22 @@ def test_format_fleet_renders():
     assert "0=ok" in text
     assert "missing: [1]" in text
     assert "isend" in text and "p99<=512us" in text
+
+
+def test_format_fleet_renders_per_lane_fenced():
+    """The --watch satellite: the per-lane fence split (published since
+    the lanes PR but previously unrendered) prints next to the
+    per-lane throughput, so one screen carries the whole per-tenant
+    story."""
+    s = _snap(0)
+    s["wire"]["channel_frames_fenced"] = {"bulk": 3, "latency": 1}
+    snap = fleet.aggregate([s], epoch=0, members=[0])
+    text = fleet.format_fleet(snap)
+    assert "lane-fenced: bulk=3 latency=1" in text
+    # no laned traffic: an explicit placeholder, not a missing line
+    bare = fleet.format_fleet(fleet.aggregate([_snap(0)], epoch=0,
+                                              members=[0]))
+    assert "lane-fenced: (none)" in bare
 
 
 # ---------------------------------------------------------------------------
@@ -406,7 +451,7 @@ def test_cli_one_shot_prints_fleet_table(capsys):
     assert "isend" in out
 
 
-def test_cli_json_mode_emits_the_snapshot(capsys):
+def test_cli_json_mode_emits_the_full_snapshot(capsys):
     server = bootstrap.BootstrapServer(n_ranks=2)
     try:
         _seed_store(server, epoch=2)
@@ -417,6 +462,16 @@ def test_cli_json_mode_emits_the_snapshot(capsys):
     assert rc == 0
     snap = json.loads(capsys.readouterr().out)
     assert snap["epoch"] == 2 and snap["missing"] == []
+    # --json emits the FULL aggregate snapshot (the satellite): wire
+    # totals with the per-lane counters, per-lane throughput, merged
+    # verb histograms, and the per-rank rows with their transitions
+    assert "channel_frames_fenced" in snap["wire_totals"]
+    assert "channel_GBps" in snap and "plane_GBps" in snap
+    assert set(snap["ranks"]) == {"0", "1"}
+    for row in snap["ranks"].values():
+        assert "transitions" in row and "health" in row
+    assert "isend" in snap["verb_latency"]
+    assert "verb_p50_us" in snap and "worst_p99_us" in snap
 
 
 def test_cli_watch_refreshes(capsys):

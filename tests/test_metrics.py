@@ -102,8 +102,24 @@ def test_format_table_shows_worst_rank_p99_column():
     table = M.format_table([with_fleet, without])
     assert "wp99(us)" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    assert rows[0].rstrip().endswith("2048")
-    assert rows[1].rstrip().endswith("-")
+    # wp99 is the second-to-last column (cp-rank trails it, PR 10)
+    assert rows[0].split()[-2] == "2048"
+    assert rows[1].split()[-2] == "-"
+
+
+def test_format_table_shows_cp_rank_column():
+    """The causal-trace satellite: a record carrying an assembled trace
+    prints the critical-path rank; records without one print '-'."""
+    with_trace = M.BenchRecord.measure(
+        "b", "allreduce", "ring", 4, 4096, "float32", 1e-6,
+        platform="host-shm", trace={"cp_rank": 3, "sample": 8})
+    without = M.BenchRecord.measure("b", "allreduce", "ring", 4, 4096,
+                                    "float32", 1e-6, platform="host-shm")
+    table = M.format_table([with_trace, without])
+    assert "cp-rank" in table.splitlines()[0]
+    rows = table.splitlines()[2:]
+    assert rows[0].split()[-1] == "3"
+    assert rows[1].split()[-1] == "-"
 
 
 def test_format_table_shows_tier_column():

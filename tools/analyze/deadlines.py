@@ -75,6 +75,9 @@ PG_BLOCKING = {
     # member's snapshot key, publish_telemetry writes one — both store
     # round-trips a caller must be able to bound
     "fleet_stats", "publish_telemetry",
+    # the causal-trace surface (PR 10): trace_stats reads every
+    # member's published op records — the same bounded-store-read shape
+    "trace_stats",
 }
 
 # RULE 3 (continued) — the multi-tenant lane surface (PR 9): a

@@ -70,6 +70,16 @@ flight-evented even though it is absorbed, not re-raised — the
 absorb-is-fine exemption of the second invariant deliberately does NOT
 apply here).
 
+**Span-pairing discipline (PR 10).** The causal tracer
+(``rocnrdma_tpu/obs/trace.py``) opens per-op spans (``_span_open``)
+whose open/close events the cross-rank assembler keys on. Fifth
+invariant: **every function there that opens a span must guarantee a
+close on all exits** — a ``_span_close``/``_span_abort`` inside a
+``finally``, or a fall-through close paired with an except handler
+that records the abort marker and re-raises (the record-and-reraise
+shape of the abort-path invariant). A dangling span reads as a
+still-running collective to every consumer of the trace.
+
 Exceptions live in ``ALLOW`` ("Class.verb" / "file.py::qualname" ->
 reason) — empty by policy.
 """
@@ -114,6 +124,19 @@ ELASTIC_SURFACE = ("grow", "heal", "wait_promotion")
 # invariant)
 TELEMETRY_FILE = "rocnrdma_tpu/obs/fleet.py"
 STORE_WRITES = {"set", "set_if_absent", "exchange"}
+
+# the span-pairing surface (PR 10): the causal tracer
+# (``rocnrdma_tpu/obs/trace.py``) opens per-op spans with
+# ``_span_open``; a span left open on ANY exit path is a dangling
+# ``trace-op-start`` the assembler would read as a still-running (or
+# silently vanished) collective. Every function there that opens a
+# span must GUARANTEE a close: a ``_span_close``/``_span_abort`` call
+# in a ``finally``, or BOTH a fall-through close AND an except handler
+# that records the abort marker and re-raises (the same
+# record-and-reraise shape as the abort-path invariant).
+SPAN_FILE = "rocnrdma_tpu/obs/trace.py"
+SPAN_OPEN_MARKERS = {"_span_open"}
+SPAN_CLOSE_MARKERS = {"_span_close", "_span_abort"}
 
 # the lane-scheduling surface (PR 9): every BLOCKING point of the
 # multi-tenant lane scheduler (``transport/lanes.py`` — mechanically, a
@@ -356,6 +379,70 @@ def lane_problems(tree: ast.Module, where: str,
     return problems
 
 
+def _own_level_nodes(fn: ast.AST):
+    """Walk ``fn`` excluding nested function bodies — a nested def's
+    span belongs to the nested def, not its parent (``iter_functions``
+    yields both; attributing a nested open to the parent would flag it
+    twice, once spuriously)."""
+    nested: set = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and sub is not fn:
+            nested.update(id(x) for x in ast.walk(sub))
+    return [sub for sub in ast.walk(fn) if id(sub) not in nested]
+
+
+def span_problems(tree: ast.Module, where: str,
+                  used: set | None = None) -> list[str]:
+    """The span-pairing invariant over the causal tracer: every
+    function calling a span-open marker must guarantee a span-close on
+    all exits — a close marker inside a ``finally``, or a fall-through
+    close paired with an except handler that records the abort marker
+    and re-raises."""
+    problems = []
+    for qual, fn, _owner in base.iter_functions(tree):
+        own = _own_level_nodes(fn)
+        calls = [n for n in own if isinstance(n, ast.Call)]
+        if not any(base.call_name(c) in SPAN_OPEN_MARKERS for c in calls):
+            continue
+        key = f"{os.path.basename(where)}::{qual}"
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        # close markers guaranteed by a finally
+        in_finally: set = set()
+        for node in own:
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    in_finally.update(id(x) for x in ast.walk(stmt))
+        finally_close = any(base.call_name(c) in SPAN_CLOSE_MARKERS
+                            and id(c) in in_finally for c in calls)
+        # ... or a fall-through close plus a record-and-reraise handler
+        in_handlers: set = set()
+        handler_ok = False
+        for node in own:
+            if isinstance(node, ast.ExceptHandler):
+                in_handlers.update(id(x) for x in ast.walk(node))
+                if any(isinstance(s, ast.Raise) for s in ast.walk(node)) \
+                        and any(isinstance(s, ast.Call)
+                                and base.call_name(s) in SPAN_CLOSE_MARKERS
+                                for s in ast.walk(node)):
+                    handler_ok = True
+        fallthrough_close = any(
+            base.call_name(c) in SPAN_CLOSE_MARKERS
+            and id(c) not in in_handlers for c in calls)
+        if not (finally_close or (fallthrough_close and handler_ok)):
+            problems.append(
+                f"{where}:{fn.lineno}: {qual} opens a trace span with no "
+                f"guaranteed close on all exits (put _span_close/"
+                f"_span_abort in a finally, or pair a fall-through "
+                f"_span_close with an except that records _span_abort "
+                f"and re-raises, or ALLOW with a reason) — a dangling "
+                f"span reads as a still-running collective")
+    return problems
+
+
 def check_source(src: str, path: str = "<fixture>") -> list[str]:
     tree = ast.parse(src, filename=path)
     return check_tree(tree, path) + abort_problems(tree, path)
@@ -382,6 +469,11 @@ def check_lane_source(src: str, path: str = "<fixture>") -> list[str]:
     return lane_problems(ast.parse(src, filename=path), path)
 
 
+def check_span_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the span-pairing invariant alone."""
+    return span_problems(ast.parse(src, filename=path), path)
+
+
 def run() -> list[str]:
     used: set = set()
     problems = check_tree(base.parse_file(PLUGIN), PLUGIN, used)
@@ -392,6 +484,7 @@ def run() -> list[str]:
     problems += telemetry_problems(base.parse_file(TELEMETRY_FILE),
                                    TELEMETRY_FILE, used)
     problems += lane_problems(base.parse_file(LANE_FILE), LANE_FILE, used)
+    problems += span_problems(base.parse_file(SPAN_FILE), SPAN_FILE, used)
     problems += base.allow_reason_problems(ALLOW, NAME)
     problems += base.allow_stale_problems(ALLOW, used, NAME)
     return problems
