@@ -59,6 +59,16 @@ SMOKE_FLOORS = {"shm": 0.20, "tcp": 0.22, "rdma": 0.45}
 SMOKE_PATHS = {"shm": ("shm", "msg"), "tcp": ("tcp", "msg"),
                "rdma": ("shm", "rdma"), "lanes": ("shm", "msg")}
 
+# coalesce scenario smoke gate (ISSUE 11): the many-small-ops win the
+# async coalescer must deliver — 2-rank shm, 64 KiB allreduces fused
+# into bucketed streams must move >= this multiple of the unbatched
+# algbw. Measured on this container: the fused path runs ~5-20x the
+# per-op floor at 64 KiB (one stream header + one credit negotiation
+# per bucket instead of per op); 2.0 is the acceptance floor with wide
+# headroom below the measured range, so only a genuine coalescing
+# regression (buckets degenerating to one-op flushes) trips it.
+SMOKE_COALESCE_SPEEDUP = 2.0
+
 # lanes scenario smoke gate (ISSUE 9): the P99 ceiling (microseconds)
 # for a 64 KiB allreduce on the HIGH-PRIORITY latency lane while a
 # paced bulk allgather saturates the same 2-rank shm ring. Recorded in
@@ -78,6 +88,15 @@ SMOKE_LANES_BULK_GBPS = 0.05
 
 
 def _smoke_args(path: str) -> list:
+    if path == "coalesce":
+        # 2-rank shm ring, 128 x 64 KiB allreduces: unbatched loop vs
+        # the async coalescer's bucketed fused streams (4 MiB buckets
+        # -> 64 member ops per fused collective); the gate is the
+        # speedup ratio, so scheduler noise hits both arms alike
+        return ["--ranks", "2", "--plane", "shm", "--transport", "msg",
+                "--sizes", "64K", "--collectives", "coalesce",
+                "--repeats", "3", "--iters", "1",
+                "--small-ops", "128", "--bucket-size", "4M"]
     if path == "lanes":
         # 2-rank shm ring, 64 KiB latency-lane allreduces timed while a
         # bulk lane loops 8 MiB-block allgathers (16 MiB wire traffic
@@ -290,6 +309,113 @@ def _lanes_worker(pg, args) -> list:
         wire=wire, verb_lat=VERBS.delta(verb_base), fleet=fleet)]
 
 
+def _coalesce_worker(pg, args) -> list:
+    """The many-small-ops scenario (ISSUE 11): ``--small-ops`` allreduces
+    of the first ``--sizes`` entry each, timed back to back UNBATCHED
+    (one collective per op — the latency-floor regime the PR-2 record
+    pins) and then COALESCED (the async verb surface packs them into
+    ``--bucket-size`` fused frame streams; one header, one fold pass,
+    one credit negotiation per bucket). The headline is the speedup —
+    the ratio is the bucketing win, and both arms run on the same fleet
+    seconds apart so scheduler noise largely cancels. The coalesced
+    results are checked BITWISE against the unbatched ones (same ring,
+    same fold order — fused must be a pure repacking), and the smoke
+    gate additionally pins zero steady-path copies on every rank."""
+    from rocnrdma_tpu.metrics import VERBS, WIRE
+
+    n = pg.world_size
+    small_bytes = parse_size(args.sizes.split(",")[0])
+    elems = max(1, small_bytes // 4)
+    ops = args.small_ops
+    bucket_bytes = parse_size(args.bucket_size)
+    ch = pg.channel("grads", bucket_bytes=bucket_bytes)
+
+    def contrib(rank: int, j: int):
+        return (np.random.default_rng((rank, j))
+                .standard_normal(elems).astype(np.float32))
+
+    xs = [contrib(pg.rank, j) for j in range(ops)]
+    # warmup both arms (arena announces, pool priming, lane open)
+    pg.all_reduce(xs[0])
+    ch.allreduce_async(xs[0], timeout_s=60.0)
+    ch.flush(timeout_s=60.0)
+
+    def run_unbatched():
+        return [pg.all_reduce(x, timeout_s=60.0) for x in xs]
+
+    def run_coalesced():
+        futs = [ch.allreduce_async(x, timeout_s=60.0) for x in xs]
+        ch.flush(timeout_s=120.0)
+        return [f.wait(timeout_s=60.0) for f in futs]
+
+    spans = {"unbatched": [], "coalesced": []}
+    outs = {}
+    wire_base = WIRE.snapshot()
+    verb_base = VERBS.snapshot()
+    for _ in range(args.repeats):
+        for mode, run in (("unbatched", run_unbatched),
+                          ("coalesced", run_coalesced)):
+            pg.barrier()
+            t0 = time.perf_counter()
+            outs[mode] = run()
+            spans[mode].append((time.perf_counter() - t0) / ops)
+    wire = WIRE.delta(wire_base)
+    wire["overlap_ratio"] = round(WIRE.overlap_ratio(since=wire_base), 4)
+    wire.update(WIRE.negotiation())
+    if args.smoke and wire["payload_bytes_copied"]:
+        raise SystemExit(
+            f"smoke gate: rank {pg.rank} staged "
+            f"{wire['payload_bytes_copied']} payload bytes through copies "
+            f"during the coalesce scenario (want 0): {wire}")
+    # the bitwise oracle: the fused repacking must reproduce the
+    # unbatched ring results exactly (same schedule, same fold order)
+    ok = all(np.array_equal(a, b)
+             for a, b in zip(outs["unbatched"], outs["coalesced"]))
+    per_op = {m: trimmed_mean(s) for m, s in spans.items()}
+    # a collective is as slow as its slowest rank; validity needs all
+    stats = pg.all_reduce(np.array([per_op["unbatched"],
+                                    per_op["coalesced"]]), op="max")
+    valid = pg.all_reduce(np.array([1.0 if ok else 0.0]), op="min")
+    # mean bucket fill over the window (the format_table bfill column),
+    # estimated from the decile histogram's UPPER edges — a deliberate
+    # over-read bounded by one decile (the histogram's resolution;
+    # claiming finer would be invented precision)
+    fills = wire.get("bucket_fill", {})
+    flushed = sum(fills.values())
+    fill_pct = (round(sum(int(lbl[2:-1]) * k for lbl, k in fills.items())
+                      / flushed) if flushed else 0)
+    pg.publish_telemetry()
+    pg.barrier()
+    if pg.rank != 0:
+        return []
+    fl = pg.fleet_stats()
+    fleet = {k: fl[k] for k in
+             ("epoch", "health", "missing", "stale_dropped",
+              "worst_p99_us", "verb_p50_us", "verb_p99_us",
+              "verb_latency", "wire_totals")}
+    t_unb, t_co = float(stats[0]), float(stats[1])
+    speedup = t_unb / t_co if t_co > 0 else 0.0
+    common = dict(iters=ops, repeats=args.repeats,
+                  small_bytes=small_bytes, verb_lat=VERBS.delta(verb_base),
+                  fleet=fleet, trace=_trace_summary(pg, "allreduce"))
+    return [
+        M.BenchRecord.measure(
+            "bench_host", "allreduce", "unbatched", n, small_bytes,
+            "float32", t_unb, platform=f"host-{args.plane}", **common),
+        M.BenchRecord.measure(
+            "bench_host", "allreduce", "coalesced", n, small_bytes,
+            "float32", t_co, platform=f"host-{args.plane}", wire=wire,
+            coalesce={"members_per_bucket": bucket_bytes // small_bytes,
+                      "bucket_bytes": bucket_bytes, "ops": ops,
+                      "fill_pct": fill_pct,
+                      "speedup": round(speedup, 2),
+                      "bitwise_ok": bool(valid[0] > 0),
+                      "unbatched_algbw_GBps": round(
+                          M.algbw_GBps(small_bytes, t_unb), 4)},
+            **common),
+    ]
+
+
 def _trace_summary(pg, collective: str) -> dict:
     """The causal tracer's condensed verdict for one bench row: the
     SLOWEST assembled sampled op matching this collective — its wall
@@ -348,9 +474,11 @@ def worker(args) -> int:
     # the watchdog thread)
     pg.start_watchdog()
     rng = np.random.default_rng(pg.rank)
-    if args.collectives == "lanes":
-        # the multi-tenant scenario has its own two-lane loop shape
-        records = _lanes_worker(pg, args)
+    if args.collectives in ("lanes", "coalesce"):
+        # the multi-tenant and many-small-ops scenarios have their own
+        # loop shapes
+        records = (_lanes_worker(pg, args) if args.collectives == "lanes"
+                   else _coalesce_worker(pg, args))
         pg.barrier()
         pg.destroy()
         for rec in records:  # only rank 0 holds any
@@ -483,16 +611,25 @@ def main(argv=None) -> int:
                    help="lanes scenario: bulk allgather ops (same on "
                         "every rank — the bulk lane is a collective "
                         "too); size it to outlast the latency loop")
+    p.add_argument("--small-ops", type=int, default=256,
+                   help="coalesce scenario: small allreduces per timed "
+                        "pass (each of the first --sizes entry)")
+    p.add_argument("--bucket-size", default="4M",
+                   help="coalesce scenario: the lane's bucket_bytes "
+                        "flush knob (the tuner-pickable coalescer size)")
     p.add_argument("--out", default=None, help="JSONL output path")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1 perf gate: 2-rank 1 MiB allreduce on the "
                         "shm, tcp, AND rdma (put-based ring) paths plus "
-                        "the lanes QoS scenario; asserts ZERO steady-"
+                        "the lanes QoS scenario and the coalesce "
+                        "many-small-ops scenario; asserts ZERO steady-"
                         "path payload copies on every rank of every "
                         "fleet, algbw >= 0.8x each path's recorded "
-                        f"floor ({SMOKE_FLOORS}), and the latency "
+                        f"floor ({SMOKE_FLOORS}), the latency "
                         f"lane's P99 <= {SMOKE_LANES_P99_US:.0f} us "
-                        "under concurrent bulk load")
+                        "under concurrent bulk load, and coalesced "
+                        f">= {SMOKE_COALESCE_SPEEDUP}x unbatched on "
+                        "the small-op floor")
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -511,23 +648,49 @@ def main(argv=None) -> int:
         clash = sorted(given & {"--ranks", "--plane", "--transport",
                                 "--sizes", "--collectives", "--repeats",
                                 "--iters", "--lat-iters", "--bulk-size",
-                                "--bulk-rounds"})
+                                "--bulk-rounds", "--small-ops",
+                                "--bucket-size"})
         if clash:
             p.error(f"--smoke runs the fixed recorded configs "
                     f"({' '.join(SMOKE_ARGS)}, then the tcp, rdma, and "
                     f"lanes twins); drop {'/'.join(clash)} or run a "
                     f"plain bench instead")
         records, failures = [], []
-        for path in ("shm", "tcp", "rdma", "lanes"):
+        for path in ("shm", "tcp", "rdma", "lanes", "coalesce"):
             # each path is its own fleet: per-rank copy gates run inside
             # the workers, the throughput gate against the path's floor
             # runs here. ALL paths measure (and their records persist)
             # before any floor failure raises, so a regression report
             # carries the full wire counters and says whether the slide
             # is per-path or global.
-            rec = _run_fleet(p.parse_args(_smoke_args(path)
-                                          + ["--smoke"]))[0]
-            records.append(rec)
+            recs = _run_fleet(p.parse_args(_smoke_args(path)
+                                           + ["--smoke"]))
+            records.extend(recs)
+            rec = recs[-1]  # coalesce: [unbatched, coalesced] — gate the
+            #                 coalesced row (it carries the speedup)
+            if path == "coalesce":
+                # the many-small-ops gate: fused buckets must beat the
+                # unbatched per-op floor by the recorded multiple, and
+                # the repacking must be bitwise-invisible
+                ex = rec.extra.get("coalesce", {})
+                if not ex.get("bitwise_ok"):
+                    failures.append(
+                        "smoke gate [coalesce]: fused bucket results "
+                        "were NOT bitwise-equal to the unbatched ring "
+                        f"(extra={ex})")
+                elif ex.get("speedup", 0.0) < SMOKE_COALESCE_SPEEDUP:
+                    failures.append(
+                        f"smoke gate [coalesce]: coalesced algbw is "
+                        f"only {ex.get('speedup')}x the unbatched "
+                        f"small-op floor (< {SMOKE_COALESCE_SPEEDUP}x) "
+                        f"— the coalescer has regressed (extra={ex})")
+                else:
+                    print(f"smoke gate ok [coalesce]: "
+                          f"{ex['speedup']}x over unbatched at "
+                          f"{rec.size_bytes} B x {ex['ops']} ops "
+                          f"(fill {ex['fill_pct']}%), bitwise oracle "
+                          f"preserved, zero steady-path copies")
+                continue
             if path == "lanes":
                 # the QoS gate: both tenants correct, the measurement
                 # genuinely under load, the latency lane's P99 inside
@@ -611,7 +774,9 @@ def _run_fleet(args) -> list:
            "--collectives", args.collectives, "--repeats", str(args.repeats),
            "--iters", str(args.iters), "--lat-iters", str(args.lat_iters),
            "--bulk-size", args.bulk_size,
-           "--bulk-rounds", str(args.bulk_rounds)] \
+           "--bulk-rounds", str(args.bulk_rounds),
+           "--small-ops", str(args.small_ops),
+           "--bucket-size", args.bucket_size] \
         + (["--smoke"] if args.smoke else [])
     procs = []
     try:

@@ -174,12 +174,25 @@ class ChannelHandle:
     mutex — a lane is one ordered stream of collectives, like a CUDA
     stream. Each verb's wall latency is observed into the per-verb
     histograms as ``lane:<name>:<verb>``, so ``fleet_stats()`` reports
-    per-lane P50/P99 merged bucket-exact across ranks."""
+    per-lane P50/P99 merged bucket-exact across ranks.
 
-    def __init__(self, pg: "ProcessGroup", lane):
+    The ASYNC half (``*_async`` verbs returning
+    :class:`transport.coalesce.Future`) rides the lane's coalescer:
+    same-(verb, dtype, op) submissions pack into one fused frame
+    stream flushed by size/time/barrier triggers (DESIGN.md §5i) —
+    the bucket commits as ONE collective on this lane, so heal/retry,
+    credit accounting, and op tracing all see a single op."""
+
+    def __init__(self, pg: "ProcessGroup", lane,
+                 bucket_bytes: int | None = None,
+                 bucket_timeout_s: float | None = None):
         self._pg = pg
         self._lane = lane
         self._mutex = threading.Lock()
+        self._bucket_bytes = bucket_bytes
+        self._bucket_timeout_s = bucket_timeout_s
+        self._coalescer = None
+        self._coalescer_lock = threading.Lock()
 
     @property
     def name(self) -> str:
@@ -266,6 +279,91 @@ class ChannelHandle:
     def batch_isend_irecv(self, ops, timeout_s: float = 60.0) -> list:
         with _lanes.lane_context(self._lane.id):
             return self._pg.batch_isend_irecv(ops, timeout_s=timeout_s)
+
+    # -- async verbs (the coalescer surface, transport/coalesce.py) ---------
+
+    def _set_bucket_knobs(self, bucket_bytes: int | None,
+                          bucket_timeout_s: float | None) -> None:
+        """Adopt a later ``channel()`` call's coalescer knobs: an unset
+        knob takes the first stated value; restating the same value is
+        a no-op; a CONFLICTING restatement — or any change once the
+        coalescer is live (its bucket_bytes is baked in) — refuses,
+        the same contract as the lane QoS knobs."""
+        with self._coalescer_lock:
+            changes = [
+                ("bucket_bytes", "_bucket_bytes", bucket_bytes),
+                ("bucket_timeout_s", "_bucket_timeout_s", bucket_timeout_s),
+            ]
+            # validate EVERY knob before adopting ANY: a refusal on the
+            # second knob must not leave the first half-applied (a
+            # later restatement would then conflict against a value no
+            # call ever successfully stated)
+            for label, attr, val in changes:
+                cur = getattr(self, attr)
+                if val is None or val == cur:
+                    continue
+                if cur is not None or self._coalescer is not None:
+                    raise ValueError(
+                        f"lane {self._lane.name!r} already open with "
+                        f"bucket_bytes={self._bucket_bytes} "
+                        f"bucket_timeout_s={self._bucket_timeout_s}"
+                        + (" (coalescer active)"
+                           if self._coalescer is not None else "")
+                        + f"; conflicting re-open of {label} refused")
+            for _label, attr, val in changes:
+                if val is not None:
+                    setattr(self, attr, val)
+
+    @property
+    def coalescer(self):
+        """This lane's coalescer, created on first use with the
+        channel's flush knobs (``bucket_bytes`` defaults to the tuner's
+        model pick for this world size)."""
+        with self._coalescer_lock:
+            if self._coalescer is None:
+                from rocnrdma_tpu.transport import coalesce as _coalesce
+                from rocnrdma_tpu.transport import tuner as _tuner
+                nbytes = self._bucket_bytes
+                if nbytes is None:
+                    nbytes = _tuner.pick_bucket_bytes(self._pg.world_size)
+                self._coalescer = _coalesce.Coalescer(
+                    self, nbytes, self._bucket_timeout_s)
+            return self._coalescer
+
+    def allreduce_async(self, x, op: str = "sum",
+                        timeout_s: float | None = None):
+        """Queue an allreduce onto this lane's coalescer; returns a
+        :class:`transport.coalesce.Future` resolving to the same value
+        ``all_reduce`` would return (a zero-copy view of the fused
+        landing buffer). May flush inline when the submit fires the
+        size/age trigger — ``timeout_s`` bounds that fused collective."""
+        return self.coalescer.submit("allreduce", x, op=op,
+                                     timeout_s=timeout_s)
+
+    def allgather_async(self, x, timeout_s: float | None = None):
+        """Queue an allgather onto the coalescer (see
+        :meth:`allreduce_async`); the future resolves to the
+        ``(world_size, *x.shape)`` rows."""
+        return self.coalescer.submit("allgather", x, timeout_s=timeout_s)
+
+    def reduce_scatter_async(self, x, op: str = "sum",
+                             timeout_s: float | None = None):
+        """Queue a reduce-scatter onto the coalescer (see
+        :meth:`allreduce_async`); the future resolves to this rank's
+        flat floor-balanced shard, exactly ``reduce_scatter``'s value."""
+        return self.coalescer.submit("reduce_scatter", x, op=op,
+                                     timeout_s=timeout_s)
+
+    def flush(self, timeout_s: float | None = None) -> int:
+        """Force-flush the lane's pending buckets (the barrier
+        trigger); returns the bucket count flushed — 0 when nothing is
+        pending (the empty no-op: no collective runs, nothing
+        commits)."""
+        with self._coalescer_lock:
+            c = self._coalescer
+        if c is None:
+            return 0
+        return c.flush(timeout_s=timeout_s)
 
 
 class ProcessGroup:
@@ -802,7 +900,9 @@ class ProcessGroup:
     # -- multi-tenant lanes (PR 9: concurrent QoS-scheduled collectives) ----
 
     def channel(self, name: str, priority: int | None = None,
-                credit_bytes: int | None = None) -> "ChannelHandle":
+                credit_bytes: int | None = None,
+                bucket_bytes: int | None = None,
+                bucket_timeout_s: float | None = None) -> "ChannelHandle":
         """Open (or fetch) the named QoS lane on this group and return a
         :class:`ChannelHandle` whose collective verbs run on it — MANY
         handles' collectives may be in flight CONCURRENTLY over the one
@@ -833,6 +933,17 @@ class ProcessGroup:
         drives heal-and-retry (the others retry on the healed epoch),
         and FaultNet's per-channel knobs inject against lane names.
 
+        ``bucket_bytes`` / ``bucket_timeout_s`` are the lane's COALESCER
+        flush knobs (the ``*_async`` verb surface, DESIGN.md §5i): a
+        bucket flushes when its pending payload reaches ``bucket_bytes``
+        (default: the tuner's model pick,
+        ``transport.tuner.pick_bucket_bytes``) or — opt-in — when a
+        submit finds it older than ``bucket_timeout_s`` (wall-clock
+        triggers are off by default so chaos replays stay seed-pure);
+        an explicit :meth:`ChannelHandle.flush` or ``Future.wait``
+        forces the rest. Like the QoS knobs, a conflicting restatement
+        on an already-open handle refuses.
+
         Fetch semantics: ``channel(name)`` with NO QoS arguments returns
         the already-open handle as-is (a consumer module need not — and
         must not have to — restate the opener's settings); restating
@@ -840,13 +951,24 @@ class ProcessGroup:
         still raises."""
         with self._channels_lock:
             ch = self._channels.get(name)
-            if ch is not None and priority is None and credit_bytes is None:
-                return ch
-            lane = self._net.open_lane(
-                name, priority=0 if priority is None else priority,
-                credit_bytes=credit_bytes)
             if ch is None:
-                ch = self._channels[name] = ChannelHandle(self, lane)
+                lane = self._net.open_lane(
+                    name, priority=0 if priority is None else priority,
+                    credit_bytes=credit_bytes)
+                ch = self._channels[name] = ChannelHandle(
+                    self, lane, bucket_bytes=bucket_bytes,
+                    bucket_timeout_s=bucket_timeout_s)
+                return ch
+            if priority is not None or credit_bytes is not None:
+                # restating QoS re-runs the registry's conflict check;
+                # bucket-only restatements must NOT reach open_lane (a
+                # default-priority re-open against a prioritized lane
+                # would raise a QoS conflict the caller never stated)
+                self._net.open_lane(
+                    name, priority=0 if priority is None else priority,
+                    credit_bytes=credit_bytes)
+            if bucket_bytes is not None or bucket_timeout_s is not None:
+                ch._set_bucket_knobs(bucket_bytes, bucket_timeout_s)
             return ch
 
     # -- object collectives (pickled python values, torch-style) -----------

@@ -159,6 +159,18 @@ class WireCounters:
     channel_frames_streamed: dict = dataclasses.field(default_factory=dict)
     channel_bytes_streamed: dict = dataclasses.field(default_factory=dict)
     channel_frames_fenced: dict = dataclasses.field(default_factory=dict)
+    # collective-coalescing telemetry (the async verb surface,
+    # transport/coalesce.py): member ops absorbed into fused buckets,
+    # buckets committed, a decile histogram of bucket fill at flush
+    # (how full buckets run — the tuner's bucket_bytes feedback), and
+    # the per-trigger split (size/time/barrier — a workload flushing
+    # mostly by barrier is under-filling its buckets). Counted at
+    # bucket COMMIT only, so retried buckets count once and the totals
+    # are deterministic per seed.
+    ops_coalesced: int = 0          # member ops that rode a fused bucket
+    buckets_flushed: int = 0        # fused buckets committed
+    bucket_fill: dict = dataclasses.field(default_factory=dict)
+    bucket_triggers: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         # not a dataclass field: asdict()/snapshot() must stay pure counters
@@ -227,6 +239,21 @@ class WireCounters:
         tx backlog (the QoS scheduler actually scheduling)."""
         with self._lock:
             self.lane_waits += n
+
+    def coalesced(self, members: int, fill: float, trigger: str) -> None:
+        """Record one fused bucket COMMIT: ``members`` member ops rode
+        the bucket, ``fill`` is its payload over the lane's
+        ``bucket_bytes`` (clamped into the decile histogram — a
+        size-triggered bucket may slightly overshoot 100%), ``trigger``
+        names what flushed it (``size``/``time``/``barrier``)."""
+        decile = min(10, max(1, math.ceil(min(1.0, fill) * 10)))
+        label = f"<={decile * 10}%"
+        with self._lock:
+            self.ops_coalesced += members
+            self.buckets_flushed += 1
+            self.bucket_fill[label] = self.bucket_fill.get(label, 0) + 1
+            self.bucket_triggers[trigger] = \
+                self.bucket_triggers.get(trigger, 0) + 1
 
     def resumed(self, frames: int = 1) -> None:
         """Record p2p frames re-delivered by the stream-resume protocol
@@ -354,6 +381,10 @@ class WireCounters:
             self.channel_frames_streamed = {}
             self.channel_bytes_streamed = {}
             self.channel_frames_fenced = {}
+            self.ops_coalesced = 0
+            self.buckets_flushed = 0
+            self.bucket_fill = {}
+            self.bucket_triggers = {}
             self._frame_bytes = 0
             self._pipeline_depth = 0
 
@@ -672,22 +703,28 @@ def format_table(records: list) -> str:
     the largest share of the SLOWEST sampled op's critical path (the
     causal tracer's attribution, ``extra["trace"]["cp_rank"]``) — the
     straggler a mean-looking row is actually waiting on; ``-`` for
-    records with no assembled trace."""
+    records with no assembled trace.
+    ``bfill%`` is the mean coalescer bucket fill of a fused-stream
+    measurement (``extra["coalesce"]["fill_pct"]``): a coalesced row
+    running near-empty buckets pays the fused header for none of the
+    amortization; ``-`` for rows that coalesced nothing."""
     hdr = (f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} "
            f"{'dtype':>9} {'tier':>18} {'lane':>9} {'time(us)':>12} "
            f"{'algbw GB/s':>11} {'busbw GB/s':>11} {'wp99(us)':>9} "
-           f"{'cp-rank':>8}")
+           f"{'cp-rank':>8} {'bfill%':>7}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
         wp99 = r.extra.get("fleet", {}).get("worst_p99_us")
         cp = r.extra.get("trace", {}).get("cp_rank")
+        fill = r.extra.get("coalesce", {}).get("fill_pct")
         lines.append(
             f"{r.collective:>13} {r.algo:>12} {r.n_ranks:>5} {r.size_bytes:>14} "
             f"{r.dtype:>9} {r.tier:>18} {r.extra.get('lane', '-'):>9} "
             f"{r.mean_s * 1e6:>12.1f} "
             f"{r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f} "
             f"{wp99 if wp99 is not None else '-':>9} "
-            f"{cp if cp is not None else '-':>8}"
+            f"{cp if cp is not None else '-':>8} "
+            f"{fill if fill is not None else '-':>7}"
         )
     return "\n".join(lines)
 

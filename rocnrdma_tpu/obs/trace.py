@@ -104,16 +104,35 @@ _TLS = threading.local()
 
 
 class _OpCtx:
-    __slots__ = ("epoch", "chan", "op", "verb", "rank", "t0", "events")
+    __slots__ = ("epoch", "chan", "op", "verb", "rank", "members", "t0",
+                 "events")
 
-    def __init__(self, epoch, chan, op, verb, rank):
+    def __init__(self, epoch, chan, op, verb, rank, members=1):
         self.epoch = epoch
         self.chan = chan
         self.op = op
         self.verb = verb
         self.rank = rank
+        self.members = members
         self.t0 = 0.0
         self.events: list = []
+
+
+@contextlib.contextmanager
+def bucket_members(n: int):
+    """Mark the next op span opened on this thread as a COALESCED
+    bucket of ``n`` member ops (the async verb surface's fused
+    streams, DESIGN.md §5i): the span — and the op record the
+    assembler and the replay digest consume — carries the member
+    count, so a trace reader sees "one op, 64 collectives inside"
+    instead of a mysteriously large small-op. Thread-local, nests and
+    restores like the lane context."""
+    prev = getattr(_TLS, "members", 1)
+    _TLS.members = max(1, int(n))
+    try:
+        yield
+    finally:
+        _TLS.members = prev
 
 
 def tracing() -> bool:
@@ -198,9 +217,10 @@ def op_span(epoch: int, chan: int, op: int, verb: str, rank: int):
     if n <= 0 or op % n or getattr(_TLS, "op", None) is not None:
         yield None
         return
-    ctx = _OpCtx(epoch, chan, op, verb, rank)
+    members = getattr(_TLS, "members", 1)
+    ctx = _OpCtx(epoch, chan, op, verb, rank, members=members)
     ctx.t0 = _span_open("trace-op", epoch=epoch, chan=chan, op=op,
-                        verb=verb, rank=rank)
+                        verb=verb, rank=rank, members=members)
     _TLS.op = ctx
     try:
         yield ctx
@@ -232,7 +252,7 @@ def _hop_of(args: dict):
 
 
 def _events_to_record(events, *, epoch, chan, op, verb, rank,
-                      t_start, wall_s, sync) -> dict:
+                      t_start, wall_s, sync, members=1) -> dict:
     """The ONE op-record builder: fold a sampled op's span-site events
     into the condensed per-rank record. ``sync`` is the rank's
     clock-sync mark — every stored time is relative to it, which is
@@ -281,6 +301,10 @@ def _events_to_record(events, *, epoch, chan, op, verb, rank,
         "v": 1,
         "epoch": epoch, "chan": chan, "op": op, "verb": verb,
         "rank": rank, "up": up, "down": down,
+        # coalesced-bucket spans: how many member collectives the one
+        # op carries (1 for ordinary collectives) — structural, so the
+        # replay digest covers it
+        "members": members,
         "t_start": rel(t_start),
         "wall_s": round(wall_s, 9),
         "n_frames": n_frames,
@@ -298,7 +322,7 @@ def _op_record(ctx: _OpCtx, wall_s: float) -> dict:
     return _events_to_record(
         ctx.events, epoch=ctx.epoch, chan=ctx.chan, op=ctx.op,
         verb=ctx.verb, rank=ctx.rank, t_start=ctx.t0, wall_s=wall_s,
-        sync=sync)
+        sync=sync, members=ctx.members)
 
 
 def records_from_events(events, rank: int, sync_ts) -> list:
@@ -317,6 +341,7 @@ def records_from_events(events, rank: int, sync_ts) -> list:
             continue
         if kind == "trace-op-start":
             spans[key] = {"t0": t, "verb": args.get("verb", "?"),
+                          "members": args.get("members", 1),
                           "events": [], "wall": None}
         elif kind == "trace-op-end" and key in spans:
             spans[key]["wall"] = args.get("dur", 0.0)
@@ -330,7 +355,8 @@ def records_from_events(events, rank: int, sync_ts) -> list:
             continue
         out.append(_events_to_record(
             s["events"], epoch=epoch, chan=chan, op=op, verb=s["verb"],
-            rank=rank, t_start=s["t0"], wall_s=s["wall"], sync=sync))
+            rank=rank, t_start=s["t0"], wall_s=s["wall"], sync=sync,
+            members=s.get("members", 1)))
     return out
 
 
@@ -443,6 +469,11 @@ def assemble(records, world: int | None = None) -> list:
         tree = {
             "epoch": epoch, "chan": chan, "op": op,
             "verb": next(iter(per_rank.values()))["verb"],
+            # a coalesced bucket's member-op count (1 otherwise):
+            # every rank committed the same bucket, so any record's
+            # count is the op's
+            "members": max(rec.get("members", 1)
+                           for rec in per_rank.values()),
             "ranks": {str(r): {
                 "wall_s": rec["wall_s"],
                 "t_start": rec["t_start"],
@@ -600,6 +631,7 @@ def digest(records) -> str:
     structural = sorted(
         [r["epoch"], r["chan"], r["op"], r["verb"], r["rank"],
          r.get("up"), r.get("down"), r.get("n_frames", 0),
+         r.get("members", 1),
          [[entry[0], entry[1]] for entry in r.get("hops", [])]]
         for r in records)
     return hashlib.sha256(

@@ -248,10 +248,24 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
     LANEFENCED acceptance line asserts), while the latency lane's
     collective still heals and retries exactly-once."""
     import numpy as np
-    lat = bulkch = None
+    lat = bulkch = co = None
     if getattr(args, "lanes", False):
         lat = pg.channel("latency", priority=8)
         bulkch = pg.channel("bulk", priority=0, credit_bytes=1 << 20)
+    # --coalesce: each round's reduction is K small ASYNC allreduces
+    # flushed as ONE fused bucket (the coalesce x heal surface): a kill
+    # round strands the bucket mid-stream, the heal fences its frames,
+    # and the retry re-runs the WHOLE bucket as one op — every member's
+    # future must still resolve bitwise on the healed membership. The
+    # bucket size trigger is set far above K*size — EXPLICITLY, on the
+    # lanes variant too — so the flush is always the explicit barrier
+    # (wall-clock triggers would break the replay digests; a size
+    # trigger firing mid-round at a large --size would change bucket
+    # membership and with it the TRACELOG/COALESCED digests).
+    K = 3
+    if getattr(args, "coalesce", False):
+        co = pg.channel("latency" if lat is not None else "default",
+                        bucket_bytes=1 << 30)
     for rnd in range(start, args.rounds):
         if can_grow and args.grow_round is not None \
                 and rnd == args.grow_round:
@@ -300,24 +314,48 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
                 # the kill round strands frames in BOTH lanes
                 pings.append(post_ping(bulkch, rnd % 30))
                 pings.append(post_ping(lat, 30 + rnd % 30))
-        local = _chaos_input(args.seed, my_orig, rnd, args.size)
         # the collective's timeout also budgets a heal it triggers
         # (heal deadline = timeout + grace): the lanes variant does
         # strictly more work inside the heal window (TWO p2p streams
         # resume per survivor pair), so it gets double the headroom —
         # fault decisions are op-keyed, never time-keyed, so the wider
         # deadline cannot perturb the replay digests
-        got = (lat.all_reduce(local, timeout_s=10.0) if lat is not None
-               else pg.all_reduce(local, timeout_s=5.0))
+        t_op = 10.0 if (lat is not None or co is not None) else 5.0
+        if co is not None:
+            # K member inputs per round, each reconstructable per
+            # (original rank, member index) — the bucket is ONE op,
+            # the oracle is per MEMBER
+            locs = [_chaos_input(args.seed, my_orig, rnd * K + j,
+                                 args.size) for j in range(K)]
+            futs = [co.allreduce_async(x, timeout_s=t_op) for x in locs]
+            co.flush(timeout_s=t_op)
+            gots = [f.wait(timeout_s=t_op) for f in futs]
+        else:
+            local = _chaos_input(args.seed, my_orig, rnd, args.size)
+            got = (lat.all_reduce(local, timeout_s=t_op)
+                   if lat is not None
+                   else pg.all_reduce(local, timeout_s=t_op))
         # the oracle of the CURRENT membership: contributions are
         # keyed by ORIGINAL rank (pg.global_ranks survives re-
         # ranking), so a post-heal round sums exactly the members —
         # a promotion keeps the full width, a shrink drops the dead
         members = pg.global_ranks
-        want = _chaos_input(args.seed, members[0], rnd, args.size)
-        for m in members[1:]:
-            want = want + _chaos_input(args.seed, m, rnd, args.size)
-        if not np.array_equal(got, want):
+
+        def want_for(idx: int):
+            w = _chaos_input(args.seed, members[0], idx, args.size)
+            for m in members[1:]:
+                w = w + _chaos_input(args.seed, m, idx, args.size)
+            return w
+
+        if co is not None:
+            bad = [j for j in range(K)
+                   if not np.array_equal(gots[j], want_for(rnd * K + j))]
+            if bad:
+                print(f"BAD-RESULT: round {rnd} bucket members {bad} "
+                      f"not bitwise-correct on epoch {pg.last_op_epoch} "
+                      f"members {members}", flush=True)
+                return 5
+        elif not np.array_equal(got, want_for(rnd)):
             print(f"BAD-RESULT: round {rnd} not bitwise-correct on "
                   f"epoch {pg.last_op_epoch} members {members}",
                   flush=True)
@@ -865,6 +903,16 @@ def _heal_chaos_main(args) -> int:
         print(f"FAULTLOG {sched.fingerprint()}", flush=True)
         print(f"HEALLOG {_heal_log()}", flush=True)
         print(f"GROWLOG {_grow_log()}", flush=True)
+        # the coalesce x heal acceptance lines: member ops and buckets
+        # committed (counted at commit only, so a retried bucket counts
+        # once — deterministic per seed), plus the sampled-op structural
+        # digest (bucket spans carry member counts, so a replay that
+        # split or merged a bucket differently cannot digest equal)
+        print(f"COALESCED {snap['ops_coalesced']} "
+              f"{snap['buckets_flushed']}", flush=True)
+        from rocnrdma_tpu.obs import trace as _obs_trace
+        print(f"TRACELOG {_obs_trace.digest(_obs_trace.TRACE.snapshot())}",
+              flush=True)
         _print_fleet(pg)
         _print_ringfull()
         if os.environ.get("ROCNRDMA_CHAOS_DUMP"):
@@ -935,6 +983,12 @@ def main(argv=None) -> int:
                         "high-priority 'latency' channel, a second ping "
                         "stream on a paced 'bulk' channel (the lane x "
                         "epoch chaos case; prints LANEFENCED)")
+    p.add_argument("--coalesce", action="store_true",
+                   help="kill-and-heal: issue each round's allreduces "
+                        "ASYNC and flush them as one fused bucket (the "
+                        "coalesce x heal case: a kill lands mid-bucket "
+                        "and the whole bucket retries exactly-once, "
+                        "bitwise; prints COALESCED + TRACELOG)")
     args = p.parse_args(argv)
 
     if args.task == "hang":

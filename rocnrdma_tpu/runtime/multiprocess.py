@@ -98,6 +98,7 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                 die_at_promotion: int | None = None,
                 device_heal_fail: bool = False,
                 lanes: bool = False,
+                coalesce: bool = False,
                 _retry_left: int = 1) -> list[WorkerResult]:
     """Spawn ``n`` worker processes running ``task``; wait for all.
 
@@ -152,6 +153,12 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
         # channel and a second ping stream rides a paced bulk channel
         # (the lane x epoch chaos surface)
         extra += ["--lanes"]
+    if coalesce:
+        # kill-and-heal: each round's allreduces are issued ASYNC and
+        # flushed as one fused bucket (the coalesce x heal chaos
+        # surface — a kill lands mid-bucket and the whole bucket must
+        # retry exactly-once, bitwise)
+        extra += ["--coalesce"]
     # release the reservations at the last instant: the spawned rank 0
     # (and the re-elected device coordinator) bind these ports next
     res.close()
@@ -179,5 +186,5 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
         return run_workers(n, task, timeout_s, fault_rank, seed, rounds,
                            size, kill_ranks, kill_ops, spares, join,
                            grow_round, die_at_promotion, device_heal_fail,
-                           lanes, _retry_left=_retry_left - 1)
+                           lanes, coalesce, _retry_left=_retry_left - 1)
     return results

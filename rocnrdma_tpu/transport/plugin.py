@@ -1871,9 +1871,15 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
         pending.append(net.iwrite(send_comm, st["peer_data_rkey"],
                                   hop.to_bytes(8, "little"),
                                   offset=2 * cap + 8 * slot))
+        if _trace.tracing():
+            # sampled op: when this hop's chunk was handed to the wire
+            # (the causal tracer's hold/xfer split point, the put-ring
+            # twin of the streaming engine's frame-sent)
+            _trace.record("frame-sent", hop=hop, frame=0)
 
     def take(hop: int, nbytes: int) -> np.ndarray:
         slot = hop % 2
+        t0 = time.perf_counter()
         deadline = time.monotonic() + timeout_s
         back = _Backoff()
         while True:
@@ -1894,6 +1900,12 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
         # could pair flag==hop with pre-doorbell slot bytes (pairs with
         # the writer's release fence in rqp_rdma_write)
         _fence_acquire()
+        # the put-ring's landing event (ROADMAP: PR-10 critical paths
+        # skipped the put rings because they record no irecv_into frame
+        # events): one doorbell hop is one frame, and under a sampled op
+        # span this is the hop landing the cross-rank assembler chains
+        _trace.record("frame-landed", hop=hop, nbytes=nbytes,
+                      dur=time.perf_counter() - t0)
         return net.read_mr_view(recv_comm, data_mr, slot * cap, nbytes)
 
     def ack(hop: int) -> None:
@@ -1901,6 +1913,10 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
         # must have fully consumed take()'s view first
         pending.append(net.iwrite(recv_comm, st["peer_credit_rkey"],
                                   hop.to_bytes(8, "little"), offset=0))
+        # the consume side of the landing above: the slot's view has
+        # been folded/copied out and the credit released — the flight
+        # timeline's proof of WHEN the predecessor was unblocked
+        _trace.record("frame-consumed", hop=hop)
 
     def finish(hop: int) -> None:
         st["hop"] = hop
@@ -1909,6 +1925,16 @@ def _rdma_ring_io(net, send_comm, recv_comm, cap: int, timeout_s: float):
                       what="rdma ring: peer stopped draining at exit")
 
     return st, put, take, ack, finish
+
+
+def _rdma_stream_start(rank: int, n: int, hops: int, cap: int) -> None:
+    """The put-ring's stream-start span site: one record per rdma
+    collective naming the ring neighbours (up = the predecessor whose
+    doorbell we poll, down = the successor whose MR we put into) — the
+    cross-rank edges the causal tracer chains put-ring hop landings
+    along, exactly like the streaming engine's stream-start."""
+    _trace.record("stream-start", hops=hops, frame=cap, depth=2,
+                  up=(rank - 1) % n, down=(rank + 1) % n)
 
 
 def _chunk_layout(x: np.ndarray, n: int):
@@ -1962,6 +1988,7 @@ def ring_allreduce_rdma(net, send_comm, recv_comm, local: np.ndarray,
     chunk, cap = _chunk_layout(x, n)
     st, put, take, ack, finish = _rdma_ring_io(net, send_comm, recv_comm,
                                                cap, timeout_s)
+    _rdma_stream_start(rank, n, 2 * (n - 1), cap)
     hop = _rdma_reduce_phase(put, take, ack, chunk, x, rank, n, st["hop"],
                              op=op)
     for k in range(n - 1):  # allgather phase
@@ -1988,6 +2015,7 @@ def ring_reduce_scatter_rdma(net, send_comm, recv_comm, local: np.ndarray,
     chunk, cap = _chunk_layout(x, n)
     st, put, take, ack, finish = _rdma_ring_io(net, send_comm, recv_comm,
                                                cap, timeout_s)
+    _rdma_stream_start(rank, n, n - 1, cap)
     # shift=-1: chunk r lands fully reduced on rank r
     hop = _rdma_reduce_phase(put, take, ack, chunk, x, rank, n, st["hop"],
                              shift=-1, op=op)
@@ -2009,6 +2037,7 @@ def ring_allgather_rdma(net, send_comm, recv_comm, local: np.ndarray,
         return out
     st, put, take, ack, finish = _rdma_ring_io(net, send_comm, recv_comm,
                                                block.nbytes, timeout_s)
+    _rdma_stream_start(rank, n, n - 1, block.nbytes)
     hop = st["hop"]
     for k in range(n - 1):
         hop += 1

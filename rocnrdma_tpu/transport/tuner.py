@@ -126,6 +126,62 @@ def measure_alpha(size_bytes: int = 4096, k1: int = 4096, k2: int = 65536,
     return marginal_s_per_op(mk, (x, b), k1, k2, repeats, trials)
 
 
+# ---------------------------------------------------------------------------
+# Host-plane coalescing knob (ISSUE 11). The async verb surface packs
+# small collectives into fused buckets (transport/coalesce.py); the
+# bucket size is the classic latency-amortization knob, and this is its
+# model pick — the same alpha-beta discipline as the device-plane algo
+# choice, with HOST-plane constants: the per-hop latency floor and the
+# steady wire rate measured by the bench_host records (PR-2: 4-rank tcp
+# allreduce 0.20 GB/s at 1 MiB vs 0.40 at 16 MiB is exactly an
+# alpha ~ 3e-4 s / beta ~ 0.4 GB/s ring).
+# ---------------------------------------------------------------------------
+
+HOST_ALPHA_S = 3.0e-4       # per-hop host-wire latency floor (seconds)
+HOST_BETA_GBPS = 0.4        # steady large-message host wire rate (GB/s)
+BUCKET_CANDIDATES = tuple(1 << p for p in range(17, 25))  # 128 KiB..16 MiB
+
+
+def coalesce_per_op_time(n_ranks: int, bucket_bytes: int,
+                         small_bytes: int = 64 << 10,
+                         alpha: float = HOST_ALPHA_S,
+                         beta_GBps: float = HOST_BETA_GBPS) -> float:
+    """Modeled per-member seconds when ops of ``small_bytes`` ride fused
+    allreduce buckets of ``bucket_bytes``: one ring stream of
+    ``2(n-1)`` hops pays the per-hop alpha ONCE for the whole bucket,
+    so the per-op share falls as the bucket fills."""
+    if n_ranks <= 1:
+        return 0.0
+    ops = max(1, bucket_bytes // max(1, small_bytes))
+    hops = 2 * (n_ranks - 1)
+    t_fused = hops * alpha + hops * (bucket_bytes / n_ranks) \
+        / (beta_GBps * 1e9)
+    return t_fused / ops
+
+
+def pick_bucket_bytes(n_ranks: int, small_bytes: int = 64 << 10,
+                      alpha: float = HOST_ALPHA_S,
+                      beta_GBps: float = HOST_BETA_GBPS,
+                      candidates=None) -> int:
+    """The tuner's bucket-size pick for a lane's coalescer: the
+    SMALLEST candidate within 10% of the best modeled per-op time.
+    Smallest-within-tolerance, not argmin — past the latency crossover
+    the curve is nearly flat, and a smaller bucket fills (and so
+    flushes) sooner, which is latency the model does not see. Pure
+    function of its inputs: every rank of a job derives the same pick
+    with no rendezvous (the same reason lane ids are hashes)."""
+    cands = tuple(candidates) if candidates is not None \
+        else BUCKET_CANDIDATES
+    if not cands:
+        raise ValueError("pick_bucket_bytes: empty candidate list")
+    if n_ranks <= 1:
+        return min(cands)
+    times = {b: coalesce_per_op_time(n_ranks, b, small_bytes,
+                                     alpha, beta_GBps) for b in cands}
+    best = min(times.values())
+    return min(b for b in cands if times[b] <= 1.1 * best)
+
+
 def _L(n: int) -> int:
     """ceil(log2 n) — step count of the log-depth schedules."""
     return max(1, math.ceil(math.log2(n)))

@@ -1020,3 +1020,118 @@ def test_deadlines_flags_lane_surface_without_timeout(tmp_path):
     problems = deadlines.check_file(str(bad_gate))
     assert any("admit" in p and "timeout_s" in p for p in problems), \
         problems
+
+
+# ---------------------------------------------------------------------------
+# pass #4g: coalescer flush discipline (ISSUE 11) — every public
+# blocking function of transport/coalesce.py records a flush entry
+# event and guarantees an abort flight event (record-and-reraise)
+# ---------------------------------------------------------------------------
+
+_COALESCE_GOOD = textwrap.dedent("""
+    class Coalescer:
+        def flush(self, timeout_s=None):
+            t0 = _coalesce_entry("coalesce-flush", trigger="barrier")
+            try:
+                self._execute(timeout_s)
+            except BaseException as e:
+                _coalesce_abort("coalesce-flush", t0,
+                                error=type(e).__name__)
+                raise
+            return 1
+
+        def _execute(self, timeout_s):
+            pass  # internal machinery: callers record
+""")
+
+
+def test_obs_coalesce_accepts_recorded_flush():
+    assert obs.check_coalesce_source(_COALESCE_GOOD, "coalesce.py") == []
+
+
+def test_obs_coalesce_flags_unrecorded_flush_entry():
+    src = textwrap.dedent("""
+        class Coalescer:
+            def flush(self, timeout_s=None):
+                try:
+                    self._execute(timeout_s)
+                except BaseException as e:
+                    _coalesce_abort("coalesce-flush", 0.0,
+                                    error=type(e).__name__)
+                    raise
+    """)
+    problems = obs.check_coalesce_source(src, "coalesce.py")
+    assert len(problems) == 1, problems
+    assert "no flush entry event" in problems[0], problems
+
+
+def test_obs_coalesce_flags_silent_bucket_death():
+    # a flush with no record-and-reraise handler: the bucket (many
+    # member ops at once) can vanish with nothing on the timeline
+    src = textwrap.dedent("""
+        class Coalescer:
+            def flush(self, timeout_s=None):
+                t0 = _coalesce_entry("coalesce-flush", trigger="barrier")
+                return self._execute(timeout_s)
+    """)
+    problems = obs.check_coalesce_source(src, "coalesce.py")
+    assert len(problems) == 1, problems
+    assert "guarantees no abort flight event" in problems[0], problems
+
+
+def test_obs_coalesce_rule_skips_internal_and_unbounded_helpers():
+    # underscore-prefixed machinery and timeout-free accessors are out
+    # of scope: the rule pins the PUBLIC blocking surface only
+    src = textwrap.dedent("""
+        class Coalescer:
+            def pending(self):
+                return 0
+
+            def _execute(self, bucket, trigger, timeout_s):
+                return bucket
+    """)
+    assert obs.check_coalesce_source(src, "coalesce.py") == []
+
+
+def test_obs_coalesce_rule_covers_the_repo_module():
+    assert obs.COALESCE_FILE == "rocnrdma_tpu/transport/coalesce.py"
+    problems = obs.coalesce_problems(
+        base.parse_file(obs.COALESCE_FILE), obs.COALESCE_FILE)
+    assert problems == [], problems
+
+
+def test_deadlines_coalesce_surface_requires_timeout(tmp_path):
+    assert ("Future", "wait") in deadlines.COALESCE_BLOCKING
+    assert ("Coalescer", "flush") in deadlines.COALESCE_BLOCKING
+    assert {"allreduce_async", "allgather_async", "reduce_scatter_async",
+            "flush"} <= deadlines.CHANNEL_BLOCKING
+    bad = tmp_path / "coalesce.py"
+    bad.write_text(textwrap.dedent("""
+        class Future:
+            def wait(self):
+                return self._result
+
+        class Coalescer:
+            def flush(self, timeout_s=None):
+                raise TimeoutError("x")
+
+            def submit(self, verb, x, op=""):
+                return None
+    """))
+    problems = deadlines.check_file(str(bad))
+    assert any("Future.wait" in p and "timeout_s" in p
+               for p in problems), problems
+    assert any("Coalescer.submit" in p and "timeout_s" in p
+               for p in problems), problems
+    assert not any("Coalescer.flush" in p for p in problems), problems
+
+
+def test_deadlines_future_wait_timeout_is_mandatory():
+    # the repo surface itself: Future.wait(timeout_s) has NO default —
+    # every call site must choose its bound explicitly
+    import inspect
+
+    from rocnrdma_tpu.transport.coalesce import Future
+    sig = inspect.signature(Future.wait)
+    p = sig.parameters["timeout_s"]
+    assert p.default is inspect.Parameter.empty

@@ -110,7 +110,13 @@ def test_smoke_perf_gate(tmp_path, capsys):
     allgather on a paced lane, concurrently in flight on one comm) —
     gated on both lanes' correctness, the measurement being genuinely
     under load, the latency lane's P99 inside the recorded ceiling,
-    and the bulk lane not being starved either."""
+    and the bulk lane not being starved either.
+
+    PR 11 adds the COALESCE path: many small allreduces unbatched vs
+    fused through the async coalescer — gated on the fused stream
+    beating the per-op floor by the recorded multiple with the
+    bitwise oracle preserved (and the zero-copy contract holding with
+    the coalescer ACTIVE, not just importable)."""
     out = tmp_path / "smoke.jsonl"
     rc = bench_host.main(["--smoke", "--out", str(out)])
     assert rc == 0
@@ -119,12 +125,18 @@ def test_smoke_perf_gate(tmp_path, capsys):
     assert "smoke gate ok [tcp]" in printed
     assert "smoke gate ok [rdma]" in printed
     assert "smoke gate ok [lanes]" in printed
+    assert "smoke gate ok [coalesce]" in printed
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert [r["platform"] for r in rows] == ["host-shm", "host-tcp",
+                                             "host-shm", "host-shm",
                                              "host-shm", "host-shm"]
     assert [r["algo"] for r in rows] == ["ring", "ring", "ring_rdma",
-                                         "lanes"]
+                                         "lanes", "unbatched", "coalesced"]
     for row in rows:
+        # the coalesce pair shares one measurement window: its wire
+        # delta rides the coalesced row only
+        if row["algo"] == "unbatched":
+            continue
         wire = row["extra"]["wire"]
         assert wire["payload_bytes_copied"] == 0, row["algo"]
         # the one-sided put ring moves whole hops by RDMA write — no
@@ -137,7 +149,11 @@ def test_smoke_perf_gate(tmp_path, capsys):
         # — only the deterministic zero-copy contract above fails the
         # build
         assert 0.0 <= wire["overlap_ratio"] <= 1.0
-    lanes_row = rows[-1]
+    co_row = rows[-1]
+    co = co_row["extra"]["coalesce"]
+    assert co["bitwise_ok"] and co["speedup"] >= bench_host.SMOKE_COALESCE_SPEEDUP
+    assert co_row["extra"]["wire"]["ops_coalesced"] >= co["ops"]
+    lanes_row = rows[3]
     ex = lanes_row["extra"]
     assert ex["lane"] == "latency" and ex["lanes_ok"] and ex["overlap_ok"]
     assert 0 < ex["p99_us"] <= bench_host.SMOKE_LANES_P99_US

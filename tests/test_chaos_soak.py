@@ -419,3 +419,54 @@ def test_kill_and_heal_lanes_fence_both_tenants_replay_equal(monkeypatch):
         assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
         assert _line(a, "LANEFENCED") == _line(b, "LANEFENCED"), a.process_id
         assert _line(a, "FLEET") == _line(b, "FLEET"), a.process_id
+
+
+def test_kill_and_heal_mid_bucket_retries_whole_bucket_replay_equal(
+        monkeypatch):
+    """The coalesce x heal acceptance run (ISSUE 11): the kill-and-heal
+    chaos with every round's allreduces issued ASYNC and flushed as
+    ONE fused bucket (three member ops per round). Rank 2 of 4 is
+    hard-killed at a deterministic op, landing mid-bucket.
+
+    Asserted: the heal fences the stranded bucket frames (FENCED > 0
+    — the fused stream was provably in flight at the kill), every
+    member future of every round still resolves BITWISE on the healed
+    membership (the bucket retried exactly-once AS ONE OP — a partial
+    re-execution would break at least one member's oracle), the
+    committed bucket/member totals agree on every survivor, and two
+    same-seed runs replay byte-identical FAULTLOG/HEALLOG/TRACELOG/
+    FLEET digests — the TRACELOG digest covers the sampled bucket
+    spans' member counts, so a replay that bucketed differently
+    cannot hash equal."""
+    monkeypatch.setenv("ROCNRDMA_FLIGHT_EVENTS", "32768")
+    n, seed, rounds, victim = 4, 11, 6, 2
+    runs = [run_workers(n, "kill-and-heal", timeout_s=150.0, seed=seed,
+                        rounds=rounds, kill_ranks=str(victim),
+                        kill_ops="49", coalesce=True) for _ in range(2)]
+    for results in runs:
+        rc = {r.process_id: r.returncode for r in results}
+        assert rc[victim] == 7, results[victim].stdout
+        for r in results:
+            assert r.returncode != -9, \
+                f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+            if r.process_id == victim:
+                continue
+            assert r.returncode == 0, \
+                f"survivor {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert _line(r, "EPOCH") == "1"
+            assert _line(r, "MEMBERS") == "[0, 1, 3]"
+            # every round committed: 3 member ops per round rode one
+            # bucket each round, retried-not-doubled at the kill round
+            assert _line(r, "COALESCED") == f"{3 * rounds} {rounds}"
+        # the kill provably stranded fused-stream frames somewhere
+        assert sum(int(_line(r, "FENCED")) for r in results
+                   if r.process_id != victim) > 0
+    for a, b in zip(*runs):
+        if a.process_id == victim:
+            continue
+        assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
+        assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
+        assert _line(a, "TRACELOG") == _line(b, "TRACELOG"), a.process_id
+        assert _line(a, "FLEET") == _line(b, "FLEET"), a.process_id
+        assert _line(a, "COALESCED") == _line(b, "COALESCED"), a.process_id

@@ -102,9 +102,9 @@ def test_format_table_shows_worst_rank_p99_column():
     table = M.format_table([with_fleet, without])
     assert "wp99(us)" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    # wp99 is the second-to-last column (cp-rank trails it, PR 10)
-    assert rows[0].split()[-2] == "2048"
-    assert rows[1].split()[-2] == "-"
+    # wp99 is third-from-last (cp-rank and bfill% trail it, PR 10/11)
+    assert rows[0].split()[-3] == "2048"
+    assert rows[1].split()[-3] == "-"
 
 
 def test_format_table_shows_cp_rank_column():
@@ -118,7 +118,23 @@ def test_format_table_shows_cp_rank_column():
     table = M.format_table([with_trace, without])
     assert "cp-rank" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    assert rows[0].split()[-1] == "3"
+    # cp-rank is second-to-last (bfill% trails it, PR 11)
+    assert rows[0].split()[-2] == "3"
+    assert rows[1].split()[-2] == "-"
+
+
+def test_format_table_shows_bucket_fill_column():
+    """The coalescing satellite: a fused-stream row prints its mean
+    bucket fill; ordinary rows print '-'."""
+    fused = M.BenchRecord.measure(
+        "b", "allreduce", "coalesced", 2, 65536, "float32", 1e-6,
+        platform="host-shm", coalesce={"fill_pct": 87, "speedup": 5.0})
+    plain = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096,
+                                  "float32", 1e-6, platform="host-shm")
+    table = M.format_table([fused, plain])
+    assert "bfill%" in table.splitlines()[0]
+    rows = table.splitlines()[2:]
+    assert rows[0].split()[-1] == "87"
     assert rows[1].split()[-1] == "-"
 
 
@@ -241,3 +257,24 @@ def test_wire_counters_per_channel_delta_and_merge():
     w.reset()
     snap = w.snapshot()
     assert snap["channel_bytes_streamed"] == {} and snap["lane_yields"] == 0
+
+
+def test_wire_coalesced_deciles_and_merge():
+    """The coalescing counters: fill lands in its decile (clamped both
+    ends — a size-triggered bucket may overshoot 100%), triggers split
+    by name, and the dict counters merge key-wise-exact cross-rank like
+    every other per-lane dict."""
+    a, b = M.WireCounters(), M.WireCounters()
+    a.coalesced(members=4, fill=0.05, trigger="barrier")
+    a.coalesced(members=64, fill=1.0, trigger="size")
+    a.coalesced(members=8, fill=1.25, trigger="size")   # overshoot clamps
+    b.coalesced(members=2, fill=0.95, trigger="time")
+    assert a.bucket_fill == {"<=10%": 1, "<=100%": 2}
+    assert a.bucket_triggers == {"barrier": 1, "size": 2}
+    merged = M.WireCounters.merge([a.snapshot(), b.snapshot()])
+    assert merged["ops_coalesced"] == 78
+    assert merged["buckets_flushed"] == 4
+    assert merged["bucket_fill"] == {"<=10%": 1, "<=100%": 3}
+    assert merged["bucket_triggers"] == {"barrier": 1, "size": 2, "time": 1}
+    a.reset()
+    assert a.bucket_fill == {} and a.ops_coalesced == 0

@@ -1,0 +1,155 @@
+"""The perf regression sentinel (ISSUE 11, lite): record-vs-record
+diffing, the trace-attribution self-diagnosis, the coalesce speedup
+ratchet, and the committed results/ artifacts diffing clean against
+themselves (the all-zero ratchet property, like tools/analyze's)."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools import sentinel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _row(algo="coalesced", platform="host-shm", algbw=0.5, trace=None,
+         coalesce=None):
+    extra = {}
+    if trace is not None:
+        extra["trace"] = trace
+    if coalesce is not None:
+        extra["coalesce"] = coalesce
+    return {"bench": "bench_host", "collective": "allreduce",
+            "algo": algo, "n_ranks": 2, "size_bytes": 65536,
+            "dtype": "float32", "mean_s": 1e-4, "algbw_GBps": algbw,
+            "busbw_GBps": algbw, "platform": platform, "extra": extra}
+
+
+def test_committed_records_self_diff_is_clean():
+    """The ratchet's fixed point: the committed records can never be a
+    regression against themselves."""
+    committed = sentinel.committed_records()
+    assert committed, "results/coalesce_r01.json should carry records"
+    assert sentinel.check_current(committed) == []
+
+
+def test_committed_coalesce_record_schema():
+    with open(os.path.join(REPO, "results", "coalesce_r01.json")) as fp:
+        doc = json.load(fp)
+    assert doc["schema"] == "coalesce_r01"
+    assert doc["scenario"]["ops"] == 256
+    assert doc["scenario"]["small_bytes"] == 65536
+    assert doc["floors"]["speedup_min"] == 2.0
+    # the acceptance multiple held on BOTH planes when recorded
+    assert doc["floors"]["shm_speedup"] >= 2.0
+    assert doc["floors"]["tcp_speedup"] >= 2.0
+    planes = {r["platform"] for r in doc["records"]}
+    assert planes == {"host-shm", "host-tcp"}
+    for r in doc["records"]:
+        if r["algo"] != "coalesced":
+            continue
+        assert r["extra"]["coalesce"]["bitwise_ok"] is True
+        assert r["extra"]["coalesce"]["speedup"] >= 2.0
+
+
+def test_compare_flags_regressed_row_with_attribution_diff():
+    base = [_row(algbw=1.0, trace={"cp_rank": 0, "attribution_us":
+                                   {"wire": 100.0, "recv-wait": 50.0}})]
+    cur = [_row(algbw=0.5, trace={"cp_rank": 1, "attribution_us":
+                                  {"wire": 400.0, "recv-wait": 60.0}})]
+    findings = sentinel.compare(cur, base)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["committed_GBps"] == 1.0 and f["algbw_GBps"] == 0.5
+    # the self-diagnosis: WHICH bucket grew
+    assert f["trace_diff"]["grew"] == "wire"
+    assert f["trace_diff"]["grew_us"] == pytest.approx(300.0)
+    text = sentinel.format_findings(findings)
+    assert "wire grew" in text and "regression" in text
+
+
+def test_compare_within_noise_allowance_is_clean():
+    base = [_row(algbw=1.0)]
+    assert sentinel.compare([_row(algbw=0.85)], base) == []
+    assert sentinel.compare([_row(algbw=0.79)], base) != []
+
+
+def test_compare_ignores_rows_with_no_committed_twin():
+    cur = [_row(algo="brand-new-scenario", algbw=0.001)]
+    assert sentinel.compare(cur, [_row(algbw=1.0)]) == []
+
+
+def test_attribution_diff_with_no_grown_bucket_says_so():
+    # the row regressed but the sampled op was FASTER everywhere: the
+    # diff must not blame a bucket that shrank
+    findings = sentinel.compare(
+        [_row(algbw=0.1, trace={"attribution_us": {"wire": 10.0,
+                                                   "recv-wait": 5.0}})],
+        [_row(algbw=1.0, trace={"attribution_us": {"wire": 100.0,
+                                                   "recv-wait": 50.0}})])
+    td = findings[0]["trace_diff"]
+    assert td["grew"] is None
+    assert "no bucket grew" in sentinel.format_findings(findings)
+
+
+def test_attribution_diff_refuses_to_invent_blame():
+    # either side missing its sampled trace -> no diff, never a guess
+    assert sentinel.attribution_diff(None, {"attribution_us": {}}) is None
+    assert sentinel.attribution_diff({"attribution_us": {"wire": 1.0}},
+                                     {}) is None
+    findings = sentinel.compare(
+        [_row(algbw=0.1)], [_row(algbw=1.0)])
+    assert findings[0]["trace_diff"] is None
+    assert "no sampled trace" in sentinel.format_findings(findings)
+
+
+def test_speedup_floor_ratchet():
+    good = [_row(coalesce={"speedup": 5.0, "bitwise_ok": True})]
+    bad = [_row(coalesce={"speedup": 1.5, "bitwise_ok": True})]
+    assert sentinel.check_speedup_floor(good) == []
+    findings = sentinel.check_speedup_floor(bad)
+    assert len(findings) == 1 and findings[0]["floor"] == 2.0
+    assert "fell below" in sentinel.format_findings(findings)
+
+
+def test_missing_results_dir_is_not_a_regression(tmp_path):
+    # a fresh clone mid-history (records not yet committed) must not
+    # fail the ratchet for artifacts that do not exist
+    assert sentinel.committed_records(str(tmp_path)) == []
+    assert sentinel.check_current([_row()], results_dir=str(tmp_path)) == []
+
+
+def test_cli_end_to_end(tmp_path):
+    current = tmp_path / "cur.jsonl"
+    committed = sentinel.committed_records()
+    with open(current, "w") as fp:
+        for rec in committed:
+            fp.write(json.dumps(rec) + "\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.sentinel", "--records", str(current)],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "no perf regressions" in out.stdout
+    # degrade one row: exit 1 + the named finding
+    rows = [copy.deepcopy(r) for r in committed]
+    rows[0]["algbw_GBps"] *= 0.3
+    with open(current, "w") as fp:
+        for rec in rows:
+            fp.write(json.dumps(rec) + "\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.sentinel", "--records", str(current)],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "regression" in out.stdout
+
+
+def test_cli_refuses_ambiguous_inputs():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.sentinel"],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert out.returncode == 2
+    assert "exactly one of" in out.stderr

@@ -84,12 +84,25 @@ PG_BLOCKING = {
 # ChannelHandle verb blocks exactly like the ProcessGroup verb it wraps
 # (plus the lane gate's admission wait), and LaneGate.admit is the lane
 # scheduler's own blocking point — a starved lane must surface a NAMED
-# timeout the caller chose, never an unbounded deferral
+# timeout the caller chose, never an unbounded deferral. The async
+# coalescing surface (ISSUE 11) joins it: an *_async submit may flush
+# INLINE (size/age trigger) and ChannelHandle.flush always may — both
+# run a fused collective the caller must be able to bound.
 CHANNEL_BLOCKING = {
     "all_reduce", "reduce_scatter", "all_gather", "broadcast",
     "all_to_all", "send", "recv", "isend", "irecv", "batch_isend_irecv",
+    "allreduce_async", "allgather_async", "reduce_scatter_async", "flush",
 }
 LANE_BLOCKING = {"admit"}
+
+# RULE 3 (continued) — the coalescer's own blocking surface (ISSUE 11):
+# Future.wait is THE blocking point of the async verb family (timeout_s
+# mandatory — it has no default, so every call site names its bound),
+# Coalescer.flush/submit run the fused collective inline. A bucket that
+# never resolves must raise named, never hang a training step.
+COALESCE_BLOCKING = {
+    ("Future", "wait"), ("Coalescer", "flush"), ("Coalescer", "submit"),
+}
 
 
 # RULE 4's surface: the whole package (call sites of the device-plane
@@ -172,7 +185,10 @@ def check_file(path: str) -> list[str]:
                              and child.name in CHANNEL_BLOCKING)
                          or (base_name == "lanes.py"
                              and qual == ["LaneGate"]
-                             and child.name in LANE_BLOCKING))
+                             and child.name in LANE_BLOCKING)
+                         or (base_name == "coalesce.py"
+                             and len(qual) == 1
+                             and (qual[0], child.name) in COALESCE_BLOCKING))
                 if named and key not in ALLOW \
                         and "timeout_s" not in _params(child):
                     problems.append(

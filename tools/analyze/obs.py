@@ -150,6 +150,20 @@ LANE_FILE = "rocnrdma_tpu/transport/lanes.py"
 LANE_ENTRY_MARKERS = {"_lane_entry"}
 LANE_DONE_MARKERS = {"_lane_done"}
 
+# the coalescer flush surface (ISSUE 11): every PUBLIC blocking
+# function of ``transport/coalesce.py`` (accepts ``timeout_s`` — the
+# async surface's deadline-discipline marker) runs or waits on a FUSED
+# collective carrying many member ops, and a wedged or aborted bucket
+# is many silently-lost collectives at once. Each must record a flush
+# entry event (``_coalesce_entry``) AND contain an except handler that
+# records the abort marker (``_coalesce_abort``) and re-raises — the
+# same guaranteed-abort shape as the elastic rule, because "the bucket
+# vanished" is exactly the postmortem a training step cannot triage
+# from the frame lane alone.
+COALESCE_FILE = "rocnrdma_tpu/transport/coalesce.py"
+COALESCE_ENTRY_MARKERS = {"_coalesce_entry"}
+COALESCE_ABORT_MARKERS = {"_coalesce_abort"}
+
 ALLOW: dict[str, str] = {}
 
 
@@ -379,6 +393,47 @@ def lane_problems(tree: ast.Module, where: str,
     return problems
 
 
+def coalesce_problems(tree: ast.Module, where: str,
+                      used: set | None = None) -> list[str]:
+    """The coalescer-flush invariant: every PUBLIC ``timeout_s``-
+    accepting function of the coalescer must call ``_coalesce_entry``
+    (the flush path's timeline entry) and contain an except handler
+    that records ``_coalesce_abort`` and re-raises (guaranteed abort
+    instrumentation — a bucket is many member ops, and its silent
+    death is many untriageable losses at once)."""
+    problems = []
+    for qual, fn, _owner in base.iter_functions(tree):
+        name = qual.rsplit(".", 1)[-1]
+        if name.startswith("_") or "timeout_s" not in base.func_params(fn):
+            continue
+        key = f"{os.path.basename(where)}::{qual}"
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        called = _called_names(fn)
+        if not (called & COALESCE_ENTRY_MARKERS):
+            problems.append(
+                f"{where}:{fn.lineno}: coalescer blocking function "
+                f"{qual} records no flush entry event (call "
+                f"_coalesce_entry on the flush path, or ALLOW it with "
+                f"a reason)")
+        handler_ok = any(
+            isinstance(node, ast.ExceptHandler)
+            and any(isinstance(s, ast.Raise) for s in ast.walk(node))
+            and ({base.call_name(sub) for sub in ast.walk(node)
+                  if isinstance(sub, ast.Call)} & COALESCE_ABORT_MARKERS)
+            for node in ast.walk(fn))
+        if not handler_ok:
+            problems.append(
+                f"{where}:{fn.lineno}: coalescer blocking function "
+                f"{qual} guarantees no abort flight event (wrap the "
+                f"flush in an except that records _coalesce_abort and "
+                f"re-raises, or ALLOW it with a reason) — a silently "
+                f"vanished bucket is many lost collectives at once")
+    return problems
+
+
 def _own_level_nodes(fn: ast.AST):
     """Walk ``fn`` excluding nested function bodies — a nested def's
     span belongs to the nested def, not its parent (``iter_functions``
@@ -474,6 +529,11 @@ def check_span_source(src: str, path: str = "<fixture>") -> list[str]:
     return span_problems(ast.parse(src, filename=path), path)
 
 
+def check_coalesce_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the coalescer-flush invariant alone."""
+    return coalesce_problems(ast.parse(src, filename=path), path)
+
+
 def run() -> list[str]:
     used: set = set()
     problems = check_tree(base.parse_file(PLUGIN), PLUGIN, used)
@@ -485,6 +545,8 @@ def run() -> list[str]:
                                    TELEMETRY_FILE, used)
     problems += lane_problems(base.parse_file(LANE_FILE), LANE_FILE, used)
     problems += span_problems(base.parse_file(SPAN_FILE), SPAN_FILE, used)
+    problems += coalesce_problems(base.parse_file(COALESCE_FILE),
+                                  COALESCE_FILE, used)
     problems += base.allow_reason_problems(ALLOW, NAME)
     problems += base.allow_stale_problems(ALLOW, used, NAME)
     return problems

@@ -1,0 +1,207 @@
+"""Perf regression sentinel (lite) — the bench plane defending itself.
+
+The repo's perf story is a set of COMMITTED artifacts: the smoke
+floors in ``bench_host`` (per-path GB/s + the lanes P99 ceiling + the
+coalesce speedup multiple) and the recorded ``results/*.json`` bench
+records (which carry, next to each row's algbw, the causal tracer's
+verdict — ``extra["trace"]["attribution_us"]``, the five-bucket split
+of where the slowest sampled op's wall went). Regressions BETWEEN
+hand-recorded floors were invisible; this module is the ratchet that
+closes the gap, the way ``tools/analyze``'s all-zero ratchets hold the
+static-analysis line:
+
+- :func:`compare` diffs a current record list against a committed one
+  row-by-row (matched on the sweep identity: collective, algo, ranks,
+  size, platform) and flags any row whose algbw fell below
+  ``ratio`` x its committed twin;
+- every flagged row carries the TRACE-ATTRIBUTION DIFF when both
+  records hold one — WHICH bucket grew (credit-stall? compute-fold?
+  wire?), so the offending change self-diagnoses instead of printing a
+  bare "slower";
+- :func:`check_current` is the one-call entry: run (or load) a
+  ``bench_host --smoke`` record set and diff it against the committed
+  coalesce/lanes records plus the smoke-floor constants.
+
+"Lite" scope (ISSUE 11): the statistical-noise modeling the ROADMAP
+sentinel item sketches (spread-aware resolution) stays open; the 0.8x
+ratio here matches the smoke gates' own noise allowance, so the
+sentinel can never be stricter than the gate that recorded the floor.
+
+CLI::
+
+    python -m tools.sentinel --records current.jsonl     # diff a run
+    python -m tools.sentinel --run-smoke                 # measure + diff
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+RESULTS = os.path.join(REPO, "results")
+
+# committed record files whose rows are floor material; each entry
+# names the JSON path and how to pull BenchRecord-shaped rows out
+COMMITTED_FILES = ("coalesce_r01.json", "lanes_r01.json")
+
+# the identity a current row is matched to its committed twin on —
+# the sweep-point convention of metrics.record_key, minus the knob
+# tuple (records here are scenario rows, not sweep grids)
+_KEY_FIELDS = ("bench", "collective", "algo", "n_ranks", "size_bytes",
+               "dtype", "platform")
+
+
+def record_key(rec: dict) -> tuple:
+    return tuple(rec.get(k) for k in _KEY_FIELDS)
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Records from a ``bench_host --out`` JSONL (torn tail tolerated,
+    same as ``metrics.load_completed``)."""
+    out = []
+    with open(path) as fp:
+        for line in fp:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def committed_records(results_dir: str = RESULTS) -> list[dict]:
+    """Every BenchRecord-shaped row in the committed results files —
+    the sentinel's baseline. Missing files are skipped (a fresh clone
+    mid-history must not fail the ratchet for records not yet
+    recorded); malformed committed JSON raises — a corrupt ratchet is
+    a finding, not a skip."""
+    rows: list[dict] = []
+    for name in COMMITTED_FILES:
+        path = os.path.join(results_dir, name)
+        if not os.path.exists(path):
+            continue
+        with open(path) as fp:
+            doc = json.load(fp)
+        rows.extend(doc.get("records", []))
+    return rows
+
+
+def attribution_diff(cur: dict | None, base: dict | None) -> dict | None:
+    """WHICH bucket grew: the per-bucket microsecond deltas between a
+    current row's trace attribution and its committed twin's, plus the
+    single largest grower — the self-diagnosis line a bare "slower"
+    verdict lacks. None when either side carries no attribution (trace
+    sampling is best-effort; the sentinel must not invent blame)."""
+    cur = (cur or {}).get("attribution_us")
+    base = (base or {}).get("attribution_us")
+    if not cur or not base:
+        return None
+    deltas = {b: round(cur.get(b, 0.0) - base.get(b, 0.0), 1)
+              for b in set(cur) | set(base)}
+    grew = max(deltas, key=deltas.get)
+    if deltas[grew] <= 0:
+        # the sampled op happened to be FASTER than the committed one
+        # even though the row's mean regressed: no bucket grew, and
+        # naming a shrunken bucket would be a self-contradictory blame
+        return {"grew": None, "grew_us": 0.0, "deltas": deltas}
+    return {"grew": grew, "grew_us": deltas[grew], "deltas": deltas}
+
+
+def compare(current: list[dict], committed: list[dict],
+            ratio: float = 0.8) -> list[dict]:
+    """Diff current records against committed ones; returns one finding
+    per matched row whose algbw fell below ``ratio`` x the committed
+    value. Rows with no committed twin are ignored (new scenarios are
+    not regressions); each finding carries the trace-attribution diff
+    when both rows hold one."""
+    base_by_key: dict[tuple, dict] = {}
+    for rec in committed:
+        base_by_key[record_key(rec)] = rec
+    findings = []
+    for rec in current:
+        base = base_by_key.get(record_key(rec))
+        if base is None:
+            continue
+        cur_bw = rec.get("algbw_GBps", 0.0)
+        base_bw = base.get("algbw_GBps", 0.0)
+        if base_bw <= 0 or cur_bw >= ratio * base_bw:
+            continue
+        findings.append({
+            "key": record_key(rec),
+            "algbw_GBps": round(cur_bw, 4),
+            "committed_GBps": round(base_bw, 4),
+            "floor_GBps": round(ratio * base_bw, 4),
+            "trace_diff": attribution_diff(
+                rec.get("extra", {}).get("trace"),
+                base.get("extra", {}).get("trace")),
+        })
+    return findings
+
+
+def check_speedup_floor(current: list[dict],
+                        results_dir: str = RESULTS) -> list[dict]:
+    """The coalesce scenario's OWN ratchet: a current coalesced row's
+    recorded speedup must stay >= the committed ``speedup_min`` floor
+    (the acceptance multiple, not the measured headroom — headroom is
+    noise's to spend)."""
+    path = os.path.join(results_dir, "coalesce_r01.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as fp:
+        floor = json.load(fp)["floors"]["speedup_min"]
+    findings = []
+    for rec in current:
+        co = rec.get("extra", {}).get("coalesce")
+        if co is None:
+            continue
+        if co.get("speedup", 0.0) < floor:
+            findings.append({
+                "key": record_key(rec),
+                "speedup": co.get("speedup"),
+                "floor": floor,
+                "trace_diff": None,
+            })
+    return findings
+
+
+def check_current(current: list[dict],
+                  results_dir: str = RESULTS,
+                  ratio: float = 0.8) -> list[dict]:
+    """The one-call sentinel pass: row-wise algbw ratchet against the
+    committed records plus the coalesce speedup floor."""
+    return (compare(current, committed_records(results_dir), ratio)
+            + check_speedup_floor(current, results_dir))
+
+
+def format_findings(findings: list[dict]) -> str:
+    """Human-readable report: one line per regression, with the trace
+    attribution diff (which bucket grew) when available."""
+    if not findings:
+        return "sentinel: no perf regressions against the committed records"
+    lines = [f"sentinel: {len(findings)} perf regression(s)"]
+    for f in findings:
+        key = " ".join(str(k) for k in f["key"] if k is not None)
+        if "speedup" in f:
+            lines.append(f"  {key}: coalesce speedup {f['speedup']}x "
+                         f"fell below the committed {f['floor']}x floor")
+        else:
+            lines.append(f"  {key}: {f['algbw_GBps']} GB/s < floor "
+                         f"{f['floor_GBps']} (committed "
+                         f"{f['committed_GBps']})")
+        td = f.get("trace_diff")
+        if td is not None and td["grew"] is None:
+            lines.append(f"    attribution: no bucket grew on the "
+                         f"sampled op — the regression lives between "
+                         f"samples ({td['deltas']})")
+        elif td is not None:
+            lines.append(f"    attribution: {td['grew']} grew "
+                         f"{td['grew_us']}us ({td['deltas']})")
+        else:
+            lines.append("    attribution: no sampled trace on both "
+                         "sides — rerun with ROCNRDMA_TRACE_SAMPLE=1 "
+                         "for the bucket diff")
+    return "\n".join(lines)

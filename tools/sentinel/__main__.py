@@ -1,0 +1,69 @@
+"""CLI: diff a current bench record set against the committed floors.
+
+``--records current.jsonl`` diffs an existing ``bench_host --out``
+record file; ``--run-smoke`` measures first (``bench_host --smoke``,
+all five paths — the smoke gates themselves still apply) and diffs
+what it recorded. Exit 1 on any regression, with the trace
+attribution diff naming which bucket grew.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+
+from tools import sentinel
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.sentinel",
+        description="Perf regression sentinel: diff current bench "
+                    "records against the committed results/ floors")
+    p.add_argument("--records", default=None,
+                   help="bench_host --out JSONL to diff")
+    p.add_argument("--run-smoke", action="store_true",
+                   help="run bench_host --smoke first and diff its "
+                        "records (the gates still apply)")
+    p.add_argument("--results-dir", default=sentinel.RESULTS,
+                   help=argparse.SUPPRESS)  # test hook
+    p.add_argument("--ratio", type=float, default=0.8,
+                   help="regression threshold as a fraction of the "
+                        "committed algbw (default 0.8, the smoke "
+                        "gates' own noise allowance)")
+    args = p.parse_args(argv)
+    if (args.records is None) == (not args.run_smoke):
+        p.error("pass exactly one of --records / --run-smoke")
+    path = args.records
+    tmp = None
+    try:
+        if args.run_smoke:
+            fd, path = tempfile.mkstemp(suffix=".jsonl")
+            os.close(fd)
+            tmp = path
+            rc = subprocess.call(
+                [sys.executable, "-m", "rocnrdma_tpu.bench.bench_host",
+                 "--smoke", "--out", path])
+            if rc != 0:
+                print("sentinel: bench_host --smoke itself FAILED "
+                      "(its gate output above is the finding)",
+                      file=sys.stderr)
+                return rc
+        findings = sentinel.check_current(sentinel.load_jsonl(path),
+                                          results_dir=args.results_dir,
+                                          ratio=args.ratio)
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    print(sentinel.format_findings(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
