@@ -540,6 +540,13 @@ def worker(args) -> int:
             mine = trimmed_mean(spans)
             # a collective is as slow as its slowest rank
             sec = float(pg.all_reduce(np.array([mine]), op="max")[0])
+            # per-repeat fleet spans (max across ranks per repeat): the
+            # SPREAD field every BENCH_r03+ artifact carries, here on
+            # every bench_host row — what lets the sentinel resolve
+            # regression vs trial noise instead of a fixed allowance
+            fleet_spans = pg.all_reduce(np.asarray(spans), op="max")
+            spread_gb = sorted(M.algbw_GBps(actual, float(s))
+                               for s in fleet_spans)
             # fleet snapshot, OFF the timed window: every rank flushes a
             # final telemetry publish, the barrier orders them before
             # the leader aggregates — the record then carries per-rank
@@ -566,6 +573,7 @@ def worker(args) -> int:
                     "bench_host", collective, algo, pg.world_size, actual,
                     "float32", sec, platform=f"host-{args.plane}",
                     counts=ragged, iters=args.iters, repeats=args.repeats,
+                    spread=[round(spread_gb[0], 4), round(spread_gb[-1], 4)],
                     wire=wire, verb_lat=VERBS.delta(verb_base),
                     fleet=fleet, trace=_trace_summary(pg, collective)))
     pg.barrier()
@@ -618,6 +626,21 @@ def main(argv=None) -> int:
                    help="coalesce scenario: the lane's bucket_bytes "
                         "flush knob (the tuner-pickable coalescer size)")
     p.add_argument("--out", default=None, help="JSONL output path")
+    p.add_argument("--sweep", action="store_true",
+                   help="emit the wire-model fit corpus for --plane "
+                        "(ISSUE 12): a --sizes ladder of allreduce rows "
+                        "per pinned frame candidate (spread recorded), "
+                        "then fit the per-plane alpha/beta model "
+                        "(tuner.fit_host_rows), then measure model "
+                        "picks vs the hand-tuned defaults row-wise; "
+                        "corpus JSONL to --out, summary to --tune-out")
+    p.add_argument("--sweep-frames", default="131072,524276,1048576,4194304",
+                   help="--sweep only: comma list of pinned frame_bytes "
+                        "(raw ints; 524276 is the exact MAX_FRAME "
+                        "payload — the largest frame-path post)")
+    p.add_argument("--tune-out", default=None,
+                   help="--sweep only: write the tune summary (fit "
+                        "params + default-vs-picked rows) to this path")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1 perf gate: 2-rank 1 MiB allreduce on the "
                         "shm, tcp, AND rdma (put-based ring) paths plus "
@@ -635,6 +658,13 @@ def main(argv=None) -> int:
 
     if args.worker:
         return worker(args)
+
+    if args.sweep:
+        if args.smoke:
+            p.error("--sweep and --smoke are different modes: the sweep "
+                    "measures the tuning corpus, the smoke gates the "
+                    "recorded floors — run them separately")
+        return _run_sweep(args)
 
     if args.smoke:
         # the gate measures the recorded configurations; silently ignoring
@@ -731,6 +761,19 @@ def main(argv=None) -> int:
                 continue
             floor = SMOKE_FLOORS[path]
             want = 0.8 * floor
+            # the auto-tuning half of the gate (ISSUE 12): the msg-path
+            # floors must hold with the wire tuner ACTIVE — a streamed
+            # record whose negotiation gauge carries no model version
+            # means the picks were bypassed and the gate proved nothing
+            # about the self-tuning wire
+            if (path in ("shm", "tcp")
+                    and rec.extra.get("wire", {}).get("tuner_version")
+                    is None):
+                failures.append(
+                    f"smoke gate [{path}]: auto-tuning was not active "
+                    f"(no tuner_version on the negotiation gauge) — the "
+                    f"floor was not measured with model picks "
+                    f"(wire={rec.extra.get('wire')})")
             if rec.algbw_GBps < want:
                 failures.append(
                     f"smoke gate [{path}]: {rec.algbw_GBps:.3f} GB/s is "
@@ -760,10 +803,121 @@ def main(argv=None) -> int:
     return 0
 
 
-def _run_fleet(args) -> list:
+def _run_sweep(args) -> int:
+    """The measure half of the measure→model→pick loop (ISSUE 12):
+
+    1. CORPUS — for every (size, pinned frame) point on this plane, one
+       allreduce fleet; each row carries its frame knob, mean, and the
+       per-repeat fleet spread (the statistical field the sentinel and
+       the fit both consume). Appended to ``--out`` as JSONL.
+    2. FIT — ``tuner.fit_host_rows`` least-squares the plane's
+       alpha/beta coefficients from the corpus (fallback ladder named
+       via ``fit_note``); the fitted model is saved next to the
+       summary so ``ROCNRDMA_HOST_TUNING`` can load it.
+    3. PICK vs DEFAULT — per ladder size, one fleet with tuning
+       disabled (the hand-tuned static wire) and one with the fitted
+       model loaded; the summary's rows carry both arms' algbw+spread
+       and the ratio, which is exactly what ``results/tune_r01.json``
+       commits.
+    """
+    from rocnrdma_tpu.transport import tuner as _tuner
+
+    sizes = [parse_size(s) for s in args.sizes.split(",")]
+    frames = [int(f) for f in args.sweep_frames.split(",")]
+    one = argparse.Namespace(**vars(args))
+    one.collectives = "allreduce"
+    corpus: list = []
+    for size in sizes:
+        for frame in frames:
+            one.sizes = str(size)
+            recs = _run_fleet(one, extra_env={
+                "ROCNRDMA_WIRE_FRAME": str(frame)})
+            for rec in recs:
+                print(f"# corpus {args.plane} size={size} frame={frame}: "
+                      f"{rec.algbw_GBps:.3f} GB/s "
+                      f"spread={rec.extra.get('spread')}", flush=True)
+            corpus.extend(recs)
+    if args.out:
+        with open(args.out, "a") as fp:
+            for rec in corpus:
+                rec.write(fp)
+    rows = [{"plane": args.plane, "size_bytes": r.size_bytes,
+             "n_ranks": r.n_ranks, "mean_s": r.mean_s,
+             "algbw_GBps": r.algbw_GBps,
+             "spread": r.extra.get("spread"),
+             "frame_bytes": r.extra.get("wire", {}).get("frame_bytes")}
+            for r in corpus]
+    planes = _tuner.fit_host_rows(rows)
+    # the MEASURED winners supersede the analytic fit inside the swept
+    # range (robust scoring: a bucket goes to the frame whose WORST
+    # trial was fastest — the spread field doing statistics, not decor)
+    tables = _tuner.measured_winners(rows)
+    note = _tuner.fit_note(len(rows))
+    model_path = (args.tune_out or "tune_sweep.json") + ".model"
+    _tuner.save_host_model(model_path, planes, tables=tables, meta={
+        "provenance": f"bench_host --sweep --plane {args.plane}",
+        "fit": {args.plane: note}})
+    print(f"# fitted {args.plane}: {note}, measured table "
+          f"{tables.get(args.plane)} -> {model_path}", flush=True)
+    compare = []
+    picked_records = []
+    for size in sizes:
+        one.sizes = str(size)
+        arms = {}
+        for arm, env in (("default", {"ROCNRDMA_WIRE_TUNER": "0"}),
+                         ("picked", {"ROCNRDMA_HOST_TUNING": model_path})):
+            rec = _run_fleet(one, extra_env=env)[-1]
+            if arm == "picked":
+                # the full record rides the summary: its spread/fleet/
+                # trace extras are what the sentinel's statistical
+                # ratchet (and the wp99/cp-share drift checks) consume
+                import dataclasses as _dc
+                picked_records.append(_dc.asdict(rec))
+            wire = rec.extra.get("wire", {})
+            arms[arm] = {
+                "algbw_GBps": round(rec.algbw_GBps, 4),
+                "spread": rec.extra.get("spread"),
+                "frame_bytes": wire.get("frame_bytes"),
+                "pipeline_depth": wire.get("pipeline_depth"),
+                "tuner_version": wire.get("tuner_version"),
+                "mean_s": rec.mean_s,
+            }
+        ratio = (arms["picked"]["algbw_GBps"]
+                 / max(1e-12, arms["default"]["algbw_GBps"]))
+        compare.append({"size_bytes": size, "ratio": round(ratio, 3),
+                        **{k: v for k, v in arms.items()}})
+        print(f"# compare {args.plane} size={size}: default "
+              f"{arms['default']['algbw_GBps']} "
+              f"({arms['default']['frame_bytes']}B) vs picked "
+              f"{arms['picked']['algbw_GBps']} "
+              f"({arms['picked']['frame_bytes']}B) -> x{ratio:.2f}",
+              flush=True)
+    doc = {"schema": "tune_sweep_r1", "plane": args.plane,
+           "n_ranks": args.ranks,
+           "fit": {"note": note,
+                   "params": {k: v.to_dict() for k, v in planes.items()},
+                   "tables": {k: [[mx, f] for mx, f in v]
+                              for k, v in tables.items()}},
+           "rows": compare,
+           "records": picked_records}
+    payload = json.dumps(doc, indent=1, sort_keys=True)
+    if args.tune_out:
+        tmp = f"{args.tune_out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fp:
+            fp.write(payload)
+        os.replace(tmp, args.tune_out)
+        print(f"# wrote {args.tune_out}")
+    else:
+        print(payload)
+    return 0
+
+
+def _run_fleet(args, extra_env: dict | None = None) -> list:
     """Spawn the rank fleet for one bench configuration; returns the
     parsed BenchRecords from rank 0 (raises SystemExit on any nonzero
-    worker — including a rank's copy-gate failure under --smoke)."""
+    worker — including a rank's copy-gate failure under --smoke).
+    ``extra_env``: extra worker environment (the sweep's wire-model
+    knobs: frame pins, tuner disable, fitted-artifact load)."""
     import socket
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -782,7 +936,8 @@ def _run_fleet(args) -> list:
     try:
         for r in range(args.ranks):
             env = dict(os.environ, RANK=str(r), WORLD_SIZE=str(args.ranks),
-                       MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port))
+                       MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
+                       **(extra_env or {}))
             # --smoke: every rank enforces the copy gate and its SystemExit
             # diagnostic (which rank, how many bytes) must reach the user,
             # so smoke runs keep ALL ranks' stderr attached

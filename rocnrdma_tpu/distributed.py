@@ -325,7 +325,12 @@ class ChannelHandle:
                 from rocnrdma_tpu.transport import tuner as _tuner
                 nbytes = self._bucket_bytes
                 if nbytes is None:
-                    nbytes = _tuner.pick_bucket_bytes(self._pg.world_size)
+                    # the pick reads THIS plane's committed wire model
+                    # (ISSUE 12's consolidation: the coalescer and the
+                    # frame picks share one fitted alpha/beta source)
+                    nbytes = _tuner.pick_bucket_bytes(
+                        self._pg.world_size,
+                        model=getattr(self._pg._net, "wire_model", None))
                 self._coalescer = _coalesce.Coalescer(
                     self, nbytes, self._bucket_timeout_s)
             return self._coalescer
@@ -989,6 +994,79 @@ class ProcessGroup:
         if self.rank == src:  # keep the original (torch semantics), skip a
             return obj        # deserialize + deep copy of a large payload
         return pickle.loads(out.tobytes())
+
+    def tune_wire(self, timeout_s: float | None = None) -> dict:
+        """Close the host wire's measure→model→pick loop at a PROTOCOL
+        point (ISSUE 12): rank 0 reads the windowed five-bucket stall
+        attribution from :meth:`trace_stats` (the PR-10 causal tracer's
+        {compute-fold, wire, credit-stall, lane-admit, recv-wait}),
+        derives a refit of this plane's committed wire model
+        (``tuner.HostWireModel.refit_attribution`` — credit-stall-
+        dominant windows bias picks toward deeper pipelines and
+        frame-path frames, recv-wait-dominant windows toward smaller
+        frames), and BROADCASTS the proposal so every rank commits the
+        same parameters against the same base version in lockstep.
+        Every later pick is then a pure function of (inputs, the new
+        committed version) on every rank — frame tags cannot diverge,
+        which is why the refit must ride a collective rather than each
+        rank fitting its own window.
+
+        Like heal/grow, tune_wire is a PROTOCOL POINT: callers must
+        quiesce concurrent lane collectives around it (the per-lane
+        mutex serializes each lane, but a lane collective STRADDLING
+        the commit could see the old version on one rank and the new on
+        another — the exact skew the lockstep commit exists to prevent;
+        the post-commit barrier below fences everything issued after).
+
+        Returns the committed ``tuner`` block (``committed=False`` when
+        the proposal went stale against a concurrent epoch fence — the
+        named drop, not an error). A no-op dict on nets without a wire
+        model (the device plane)."""
+        t = self.timeout_s if timeout_s is None else timeout_s
+        model = getattr(self._net, "wire_model", None)
+        if model is None:
+            return {"committed": False, "reason": "no wire model"}
+        from rocnrdma_tpu.transport import tuner as _tuner
+        proposal = None
+        if self.rank == 0:
+            shares = self._stall_shares(t)
+            params = model.refit_attribution(shares)
+            # stage against the current version: an epoch fence landing
+            # between here and the commit drops the pending proposal
+            # AND invalidates the base token on every rank
+            base = model.propose(params, "tune_wire")
+            proposal = (params.to_dict(), base, shares)
+        if self.world_size > 1:
+            proposal = self.broadcast_object(proposal, src=0)
+        params_d, base, shares = proposal
+        new = model.commit(
+            _tuner.PlaneParams.from_dict(params_d), base,
+            note="tune_wire: " + ",".join(
+                f"{k}={v:.2f}" for k, v in sorted(shares.items())))
+        if self.world_size > 1:
+            # no rank leaves the protocol point until every rank has
+            # committed: collectives issued AFTER tune_wire returns pick
+            # on the new version everywhere
+            self.barrier(timeout_s=t)
+        out = model.block()
+        out["committed"] = new is not None
+        return out
+
+    def _stall_shares(self, timeout_s: float) -> dict:
+        """The attribution window a refit reads: every assembled sampled
+        op's five buckets summed across ranks, as fractions of the total
+        attributed wall (empty window → all-zero shares, a refit that
+        only clears stale biases)."""
+        from rocnrdma_tpu.obs.trace import BUCKETS
+        totals = {b: 0.0 for b in BUCKETS}
+        for op in self.trace_stats(timeout_s=timeout_s)["ops"]:
+            for info in op.get("ranks", {}).values():
+                for b, s in info.get("attribution", {}).items():
+                    totals[b] = totals.get(b, 0.0) + s
+        wall = sum(totals.values())
+        if wall <= 0:
+            return {b: 0.0 for b in totals}
+        return {b: s / wall for b, s in totals.items()}
 
     def all_gather_object(self, obj) -> list:
         """Every rank contributes any picklable ``obj``; returns the n
@@ -3182,6 +3260,13 @@ class ProcessGroup:
         s["epoch"] = self.epoch
         s["heals"] = self._heals
         s["health"] = self.health()  # the fleet plane's coarse state
+        # the self-tuning wire's committed state (ISSUE 12): version,
+        # per-plane coefficients, pins — next to the frame/depth gauges
+        # above, so a pick change and the model that made it land on
+        # the same record
+        model = getattr(self._net, "wire_model", None)
+        if model is not None:
+            s["tuner"] = model.block()
         return s
 
     def dead_ranks(self) -> list:

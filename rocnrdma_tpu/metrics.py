@@ -176,12 +176,13 @@ class WireCounters:
         # not a dataclass field: asdict()/snapshot() must stay pure counters
         self._lock = threading.Lock()
         # negotiation GAUGES (not counters — windowing them with delta()
-        # would be nonsense): the frame size and pipeline depth the ring
-        # wire last chose, so a perf regression is attributable to the
-        # frame choice (the ROADMAP "attributable frame choice" item,
-        # recorded ahead of the tuner work that will vary it per call)
+        # would be nonsense): the frame size, pipeline depth, and wire-
+        # model version the ring wire last picked, so a perf regression
+        # is attributable to the frame choice — and to the committed
+        # tuner version that chose it (ISSUE 12: picks vary per call)
         self._frame_bytes = 0
         self._pipeline_depth = 0
+        self._tuner_version = None
 
     def copied(self, nbytes: int, frames: int = 1) -> None:
         """Record ``nbytes`` staged through an extra payload copy (the
@@ -276,19 +277,26 @@ class WireCounters:
         with self._lock:
             self.promotions += n
 
-    def negotiated(self, frame_bytes: int, pipeline_depth: int) -> None:
-        """Record the frame size / pipeline depth the ring wire chose for
-        a stream (gauge semantics: last negotiation wins)."""
+    def negotiated(self, frame_bytes: int, pipeline_depth: int,
+                   tuner_version: int | None = None) -> None:
+        """Record the frame size / pipeline depth the ring wire chose
+        for a stream, plus the wire-model version that chose them (None
+        = a legacy static pick; gauge semantics: last negotiation
+        wins)."""
         with self._lock:
             self._frame_bytes = int(frame_bytes)
             self._pipeline_depth = int(pipeline_depth)
+            self._tuner_version = (int(tuner_version)
+                                   if tuner_version is not None else None)
 
     def negotiation(self) -> dict:
         """The last-negotiated wire parameters (``frame_bytes`` /
-        ``pipeline_depth``), for wire_stats() and bench records."""
+        ``pipeline_depth`` / ``tuner_version``), for wire_stats() and
+        bench records."""
         with self._lock:
             return {"frame_bytes": self._frame_bytes,
-                    "pipeline_depth": self._pipeline_depth}
+                    "pipeline_depth": self._pipeline_depth,
+                    "tuner_version": self._tuner_version}
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -387,6 +395,7 @@ class WireCounters:
             self.bucket_triggers = {}
             self._frame_bytes = 0
             self._pipeline_depth = 0
+            self._tuner_version = None
 
 
 # THE process-wide wire-counter instance (one per rank process — host-plane
@@ -707,16 +716,27 @@ def format_table(records: list) -> str:
     ``bfill%`` is the mean coalescer bucket fill of a fused-stream
     measurement (``extra["coalesce"]["fill_pct"]``): a coalesced row
     running near-empty buckets pays the fused header for none of the
-    amortization; ``-`` for rows that coalesced nothing."""
+    amortization; ``-`` for rows that coalesced nothing.
+    ``picks`` is the wire tuner's per-row choice — the frame size and
+    pipeline depth the streaming engine last negotiated for the
+    measurement (``extra["wire"]["frame_bytes"]/["pipeline_depth"]``,
+    printed ``<frame KiB>K/d<depth>``): a GB/s movement between two
+    rows of the same sweep point is attributable to the pick that
+    changed, not just observable; ``-`` for rows with no wire gauge."""
     hdr = (f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} "
            f"{'dtype':>9} {'tier':>18} {'lane':>9} {'time(us)':>12} "
            f"{'algbw GB/s':>11} {'busbw GB/s':>11} {'wp99(us)':>9} "
-           f"{'cp-rank':>8} {'bfill%':>7}")
+           f"{'cp-rank':>8} {'bfill%':>7} {'picks':>10}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
         wp99 = r.extra.get("fleet", {}).get("worst_p99_us")
         cp = r.extra.get("trace", {}).get("cp_rank")
         fill = r.extra.get("coalesce", {}).get("fill_pct")
+        wire = r.extra.get("wire", {})
+        picks = "-"
+        if wire.get("frame_bytes"):
+            picks = (f"{wire['frame_bytes'] // 1024}K"
+                     f"/d{wire.get('pipeline_depth', 0)}")
         lines.append(
             f"{r.collective:>13} {r.algo:>12} {r.n_ranks:>5} {r.size_bytes:>14} "
             f"{r.dtype:>9} {r.tier:>18} {r.extra.get('lane', '-'):>9} "
@@ -724,7 +744,8 @@ def format_table(records: list) -> str:
             f"{r.algbw_GBps:>11.2f} {r.busbw_GBps:>11.2f} "
             f"{wp99 if wp99 is not None else '-':>9} "
             f"{cp if cp is not None else '-':>8} "
-            f"{fill if fill is not None else '-':>7}"
+            f"{fill if fill is not None else '-':>7} "
+            f"{picks:>10}"
         )
     return "\n".join(lines)
 

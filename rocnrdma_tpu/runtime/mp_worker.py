@@ -429,6 +429,23 @@ def _fleet_log(transitions: list) -> str:
         sort_keys=True).encode()).hexdigest()
 
 
+def _tuner_log() -> str:
+    """The self-tuning wire's flight-event sequence, STRUCTURAL fields
+    only (kind, plane, epoch, version, dropped-pending): the model's
+    version stream moves only at protocol points (epoch fences, broadcast
+    commits), so with auto-tuning ON the sequence is a pure function of
+    the seed's failure story and two same-seed chaos runs must print it
+    identically — the ISSUE 12 replay line next to HEALLOG."""
+    import json
+
+    from rocnrdma_tpu.obs import FLIGHT
+    evs = [[kind, a.get("plane"), a.get("epoch"), a.get("version"),
+            a.get("dropped_pending")]
+           for _, kind, a in FLIGHT.events()
+           if kind.startswith("tuner-")]
+    return json.dumps(evs, sort_keys=True)
+
+
 def _print_fleet(pg) -> None:
     """The fleet-plane telemetry lines every chaos rank prints for the
     soak harness: the health-transition sequence (human-checkable) and
@@ -913,6 +930,7 @@ def _heal_chaos_main(args) -> int:
         from rocnrdma_tpu.obs import trace as _obs_trace
         print(f"TRACELOG {_obs_trace.digest(_obs_trace.TRACE.snapshot())}",
               flush=True)
+        print(f"TUNERLOG {_tuner_log()}", flush=True)
         _print_fleet(pg)
         _print_ringfull()
         if os.environ.get("ROCNRDMA_CHAOS_DUMP"):

@@ -418,6 +418,11 @@ class HostQPNet:
     # need a full drain, so deeper pipelining would want a bigger arena)
     LG_CHUNK = 4 << 20
 
+    # the plane key the self-tuning wire model is committed under
+    # (tuner.host_wire_model): shm and tcp fit/pick independently —
+    # their alphas and betas differ by an order of magnitude
+    PLANE = "shm"
+
     def __init__(self):
         self._inited = False
         self._comms: list[_HostComm] = []
@@ -427,6 +432,14 @@ class HostQPNet:
         # send — the single-tenant wire is untouched
         self.lanes = _lanes.LaneRegistry()
         self._lane_gate = _lanes.LaneGate(self.lanes)
+        # the committed host wire model this plane's ring wires pick
+        # frame_bytes/pipeline_depth from (ISSUE 12; process-wide per
+        # plane, so every comm's picks and every tune_wire commit see
+        # one version stream). Env knobs — disable, fitted-artifact
+        # load, sweep pins — are resolved inside host_wire_model at
+        # construction, never at pick time (the purity rule).
+        from rocnrdma_tpu.transport import tuner as _tuner
+        self.wire_model = _tuner.host_wire_model(self.PLANE)
 
     # -- vtable ------------------------------------------------------------
 
@@ -464,6 +477,12 @@ class HostQPNet:
         put-ring doorbell cache) — the heal's wired barrier orders these
         resets before any new-epoch traffic."""
         self._epoch = int(epoch)
+        # the tuner's epoch fence rides the same protocol point: a
+        # pending (uncommitted) model refit computed under the old
+        # generation mixes pre-heal wiring into its window — dropped,
+        # named on the flight timeline (the committed model survives;
+        # it was agreed at a protocol point)
+        self.wire_model.fence_epoch(self._epoch)
         for comm in self._comms:
             self._fence_comm(comm)
 
@@ -1086,6 +1105,8 @@ class TCPNet(HostQPNet):
     both loopback and RDMA NICs through one vtable.
     """
 
+    PLANE = "tcp"  # own wire-model key: tcp's alpha/beta are its own
+
     def __init__(self):
         super().__init__()
         self._listeners = []
@@ -1269,7 +1290,8 @@ class _RingWire:
     """
 
     def __init__(self, net, send_comm, recv_comm, progress=None,
-                 timeout_s: float = 30.0, peers: tuple | None = None):
+                 timeout_s: float = 30.0, peers: tuple | None = None,
+                 world: int | None = None):
         self.net = net
         self.send_comm = send_comm
         self.recv_comm = recv_comm
@@ -1280,6 +1302,15 @@ class _RingWire:
         # stalled hop's postmortem NAMES, turning "net request timed out"
         # into "recv hop 3 frame 2 peer rank 1"
         self.peers = peers
+        # ring size when the caller knows it (the ring collectives pass
+        # n_ranks; p2p wires leave it None): a wire-model pick input —
+        # depth is bounded by the hops a ring of this size can pipeline
+        self.world = world
+        # the committed host wire model (ISSUE 12): per-call picks of
+        # frame_bytes / pipeline_depth / LG-vs-frame cutover replace the
+        # static negotiated constants below. None on planes without one
+        # (the device mesh) — those keep the legacy static frame.
+        self._model = getattr(net, "wire_model", None)
         # LG-capable planes (the host QP nets) take ring hops in LG_CHUNK
         # units — isend auto-routes those over the put path, one native
         # bulk copy per hop (r4); everything else chunks at the frame
@@ -1314,12 +1345,32 @@ class _RingWire:
         agreement. The default lane has no credit and keeps the full
         quantum."""
         f = self._base_frame
+        credit = self._lane_credit()
+        if credit:
+            f = max(1, min(f, credit))
+        return f
+
+    def _lane_credit(self) -> int | None:
+        """The CURRENT lane context's pacing credit (None unpaced) —
+        the lane half of every pick's input (both ring ends run a
+        stream's posts under the stream's own lane context, so the two
+        ends resolve the same credit)."""
         reg = getattr(self.net, "lanes", None)
         lane = (reg.get(_lanes.current_channel())
                 if reg is not None else None)
-        if lane is not None and lane.credit_bytes:
-            f = max(1, min(f, lane.credit_bytes))
-        return f
+        return lane.credit_bytes if lane is not None else None
+
+    def _pick(self, nbytes: int):
+        """The wire model's per-call pick for a message/hop of
+        ``nbytes`` on this plane — pure function of (nbytes, world,
+        lane credit, committed model version), so both ends of an edge
+        derive the same frame from the same message size and their
+        frame tags agree. None on model-less planes (legacy static
+        framing)."""
+        if self._model is None:
+            return None
+        return self._model.pick(nbytes, world=self.world or 2,
+                                credit_bytes=self._lane_credit())
 
     def _tag(self, hop: int, nbytes: int, frame: int | None = None):
         """The (hop, frame-index) tag packer — the ONE definition of the
@@ -1383,7 +1434,7 @@ class _RingWire:
                            progress=progress)
 
     def post_recvs(self, nbytes: int, hop: int, into=None,
-                   first_frame: int = 0) -> list:
+                   first_frame: int = 0, frame: int | None = None) -> list:
         """Post the chunked frame receives for an ``nbytes`` inbound
         message; returns ``[(offset, nbytes, Request), ...]`` to drain.
         ``into``: optional uint8 destination ndarray — on nets with the
@@ -1393,9 +1444,11 @@ class _RingWire:
         already landed in ``into`` before the stream's epoch was fenced,
         so a resumed receive posts only the missing tail (same frame
         indices, hence same wire tags as the sender's resumed
-        ``queue_send``)."""
-        tag = self._tag(hop, nbytes)
-        frame = self.frame
+        ``queue_send``). ``frame`` overrides the chunking (the tuner's
+        per-message pick; the sender derives the same value from the
+        same message size, so tags agree)."""
+        tag = self._tag(hop, nbytes, frame)
+        frame = self.frame if frame is None else frame
         recv_into = self._recv_into if into is not None else None
         reqs = []
         for fi, off in enumerate(range(0, nbytes, frame)):
@@ -1423,15 +1476,54 @@ class _RingWire:
         an explicit hop so tags agree per ring edge."""
         if hop is None:
             hop = next(self._hops)
-        # the non-streaming path frames at the wire default, depth 1 (no
-        # cross-hop pipeline): recorded so wire_stats()/bench records name
-        # the frame choice on this path too (gauge: last exchange wins)
-        _WIRE.negotiated(self.frame, 1)
+        # the non-streaming path frames PER MESSAGE from the wire model
+        # (depth 1 — no cross-hop pipeline): each direction's frame is a
+        # pure function of that message's byte count, which both ends
+        # know exactly (sender: len(out); receiver: in_nbytes), so the
+        # two ends' chunking — and hence frame tags — agree with no
+        # negotiation. One constraint the stream path does not have:
+        # exchange carries the ROOTED verbs' one-directional sends, and
+        # a >= LG_MIN message's put-path rendezvous (arena announce +
+        # credit) is what couples the sender's completion to the
+        # receiver's liveness — the uniform-abort property the rooted
+        # self-heal retry depends on (a frame-path send would queue and
+        # commit against a dead peer). So the pick tunes the frame size
+        # WITHIN the message's path and never moves a >= LG_MIN message
+        # off the put path; the path rule is message-size-intrinsic, so
+        # both ends still agree. Recorded so wire_stats()/bench records
+        # name the pick on this path too (gauge: last exchange wins).
+        out_pick = self._pick(len(out)) if len(out) else None
+        in_pick = self._pick(in_nbytes) if in_nbytes else None
+        credit = self._lane_credit()
+
+        def keep_path(pick, nbytes):
+            if pick is None:
+                return None
+            f = pick.frame_bytes
+            if self._model is not None and nbytes >= self._model.lg_min \
+                    and (not credit or credit >= self._model.lg_min):
+                # the lane's pacing credit outranks path preservation:
+                # a paced lane's wire quantum is its credit (the QoS
+                # bound), and a credit below LG_MIN already rode the
+                # frame path pre-tuner — same cap, same semantics
+                f = max(f, self._model.lg_min)
+            return f
+        out_frame = keep_path(out_pick, len(out))
+        in_frame = keep_path(in_pick, in_nbytes)
+        # the gauge records the frame the wire ACTUALLY posts (the
+        # keep_path-adjusted value — the fit corpus and the picks
+        # column read this, so a pick that was path-bumped must not
+        # masquerade as the raw model output)
+        shown_frame = in_frame if in_frame is not None else out_frame
+        shown = in_pick or out_pick
+        _WIRE.negotiated(
+            shown_frame if shown_frame is not None else self.frame, 1,
+            shown.version if shown is not None else None)
         got = np.empty(in_nbytes, np.uint8)
         # queue all chunked irecvs — landing straight in ``got`` on
         # recv_into-capable nets — then the isends, then drain; the plugin
         # pumps receives while a send backpressures, so no deadlock
-        reqs = self.post_recvs(in_nbytes, hop, into=got)
+        reqs = self.post_recvs(in_nbytes, hop, into=got, frame=in_frame)
         # progress engine: while our send ring is full, keep draining the
         # comm our inbound data arrives on, or two mutually-sending ranks
         # stall each other. The net's group-level hook (the p2p resume
@@ -1443,7 +1535,7 @@ class _RingWire:
                           else getattr(self.recv_comm, "_pump", None),
                           hook)
         try:
-            self.queue_send(out, hop, pump)
+            self.queue_send(out, hop, pump, frame=out_frame)
         except TimeoutError as e:
             raise self._stall("send", hop, 0, e) from e
         # Wait for the inbound frames WHILE keeping our own outbound
@@ -1474,7 +1566,8 @@ class _RingWire:
         return got
 
     def stream(self, first_send: np.ndarray, hops: list, dtype,
-               timeout_s: float | None = None) -> None:
+               timeout_s: float | None = None,
+               size_key: int | None = None) -> None:
         """Pipelined multi-hop engine — the zero-copy streaming mode of the
         ring collectives. ``hops`` is one ``(dest, combine)`` pair per ring
         hop: ``dest`` is that hop's inbound destination as a uint8 view of
@@ -1501,7 +1594,18 @@ class _RingWire:
         starve. Nets without the ``recv_into`` capability fall back to
         sequential per-hop :meth:`exchange` calls (the capability is
         uniform across a ring, so both ends take the same path and tags
-        agree)."""
+        agree).
+
+        ``size_key``: the tuner's pick key — the stream's LARGEST hop
+        payload, as a value every rank of the ring derives identically
+        (max chunk size from (buffer bytes, n) for the balanced verbs,
+        max(counts) for the ragged ones — the collectives own the
+        arithmetic). The committed wire model resolves frame_bytes and
+        the posting-window depth from it per call; None (p2p wires,
+        model-less planes) keeps the legacy static frame. Cross-rank
+        frame agreement is the load-bearing property: ONE frame serves
+        the whole stream, every rank derives it from the same
+        (size_key, lane, model version), so every edge's tags match."""
         t = self.timeout_s if timeout_s is None else timeout_s
         H = len(hops)
         if H == 0:
@@ -1517,19 +1621,29 @@ class _RingWire:
                     combine(d, got.view(dtype), out=d)
                 send = dest
             return
-        # ONE dtype-aligned frame for the whole stream: splitting hops
-        # finer to deepen the pipeline was tried and LOSES on both planes
-        # (a comm is one FIFO — extra frames buy no parallelism, only
-        # per-frame Python and protocol work; tuner-driven sizing is an
-        # open ROADMAP item)
-        frame = self._aligned_frame(np.dtype(dtype).itemsize)
+        # ONE frame for the whole stream (a comm is one FIFO — per-hop
+        # re-framing buys no parallelism, only tag disagreement), sized
+        # by the committed wire model when the caller gave a pick key,
+        # else the legacy plane default; always rounded DOWN to a whole
+        # number of dtype elements so every frame folds in place
+        it = np.dtype(dtype).itemsize
+        pick = self._pick(size_key) if size_key is not None else None
+        if pick is not None:
+            frame = max(it, pick.frame_bytes - pick.frame_bytes % it)
+            # the posting window: how many hops ahead receives are
+            # posted. 2 is the engine's structural double buffer (the
+            # legacy depth); the model only ever deepens it, and a ring
+            # of H hops cannot pipeline deeper than H.
+            depth = max(1, min(pick.pipeline_depth, H))
+        else:
+            frame = self._aligned_frame(it)
+            depth = 2 if H > 1 else 1
         # the negotiated wire parameters, recorded where they are chosen
         # (gauges on WIRE -> wire_stats()/bench records) so a throughput
-        # regression is attributable to the frame choice; depth 2 is the
-        # engine's cross-hop double buffer (hop k+1's receives live while
-        # hop k drains), 1 when there is only one hop to pipeline
-        depth = 2 if H > 1 else 1
-        _WIRE.negotiated(frame, depth)
+        # regression is attributable to the frame choice — and to the
+        # model version that chose it
+        _WIRE.negotiated(frame, depth,
+                         pick.version if pick is not None else None)
         # the ring neighbours ride the event (up = who our inbound
         # frames come from, down = who we forward to): the cross-rank
         # edges of the causal trace need no wire-format change — frames
@@ -1577,10 +1691,11 @@ class _RingWire:
             return reqs
 
         posted = [None] * H
-        posted[0] = post_hop(0)
-        if H > 1:
-            posted[1] = post_hop(1)  # double buffer: hop 1's receives are
-            #                          live before hop 0 starts draining
+        for j in range(min(depth, H)):
+            posted[j] = post_hop(j)  # the posting window: hops 1..depth-1's
+            #                          receives are live before hop 0
+            #                          starts draining (depth 2 = the
+            #                          classic cross-hop double buffer)
         # hop 0's outbound is known up front: queue the whole burst
         try:
             self.queue_send(first_send, hop_nos[0], consume_progress,
@@ -1594,8 +1709,11 @@ class _RingWire:
             _trace.record("frame-sent", hop=hop_nos[0], frame=0)
         blocked = True  # nothing precedes frame 0: its arrival is not overlap
         for k in range(H):
-            if k + 1 < H and posted[k + 1] is None:
-                posted[k + 1] = post_hop(k + 1)
+            # keep the posting window full: hops k..k+depth-1 posted
+            # before hop k drains (depth 1 degenerates to post-on-entry)
+            for j in range(k, min(k + depth, H)):
+                if posted[j] is None:
+                    posted[j] = post_hop(j)
             dest = hops[k][0]
             nxt_tag = (self._tag(hop_nos[k + 1], dest.nbytes, frame)
                        if k + 1 < H else None)
@@ -1697,7 +1815,7 @@ def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
         return x.reshape(np.shape(local))
     combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
     # ONE pipelined 2(n-1)-hop stream: the n-1 reduce-scatter hops (fold
@@ -1708,7 +1826,10 @@ def ring_allreduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
     # send) — so frames flow continuously from first send to last landing.
     hops = [(_as_bytes(chunk(rank - k - 1)), combine) for k in range(n - 1)]
     hops += [(_as_bytes(chunk(rank - k)), None) for k in range(n - 1)]
-    wire.stream(_as_bytes(chunk(rank)), hops, x.dtype)
+    # tuner pick key: the largest chunk — a pure function of (len(x), n),
+    # so every rank derives the same frame and the ring's tags agree
+    wire.stream(_as_bytes(chunk(rank)), hops, x.dtype,
+                size_key=max(chunk(i).nbytes for i in range(n)))
     return x.reshape(np.shape(local))
 
 
@@ -1724,7 +1845,10 @@ def _stream_reduce_scatter(wire: "_RingWire", chunk, rank: int, n: int,
     chunk(rank-k-1) and folds the arrival into chunk(rank-k-2); after n-1
     hops chunk(rank) is fully reduced on this rank."""
     hops = [(_as_bytes(chunk(rank - k - 2)), combine) for k in range(n - 1)]
-    wire.stream(_as_bytes(chunk(rank - 1)), hops, dtype)
+    # pick key: the largest chunk — identical on every rank (the chunk
+    # layout is shared, floor-balanced or counts-derived alike)
+    wire.stream(_as_bytes(chunk(rank - 1)), hops, dtype,
+                size_key=max(chunk(i).nbytes for i in range(n)))
 
 
 def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
@@ -1744,7 +1868,7 @@ def ring_reduce_scatter_over_net(net, send_comm, recv_comm,
         return x
     combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     bounds = [len(x) * i // n for i in range(n + 1)]
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
     _stream_reduce_scatter(wire, chunk, rank, n, x.dtype, combine)
@@ -2064,12 +2188,14 @@ def ring_allgather_over_net(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return out
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     # pipelined: hop k lands origin (rank-k-1)'s block STRAIGHT into its
     # output row, and that row is hop k+1's outbound — frame f forwards
     # the moment it arrives, no per-hop staging buffer
     hops = [(_as_bytes(out[(rank - k - 1) % n]), None) for k in range(n - 1)]
-    wire.stream(_as_bytes(out[rank]), hops, block.dtype)
+    # pick key: one block — every hop moves exactly one (same-shape) block
+    wire.stream(_as_bytes(out[rank]), hops, block.dtype,
+                size_key=block.nbytes)
     return out
 
 
@@ -2084,7 +2210,7 @@ def ring_broadcast_over_net(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return np.array(local, copy=True)
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     # non-root contents are irrelevant: only shape/dtype matter, so skip the
     # payload-sized copy and zero-fill there; root sends from a byte view
     flat = (_as_bytes(local) if rank == root
@@ -2139,7 +2265,7 @@ def ring_reduce_over_net(net, send_comm, recv_comm, local: np.ndarray,
     combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     acc = np.array(local, copy=True).ravel()
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     d = (root - rank) % n  # my hop distance to the root (0 = root)
     n_chunks = _pipeline_chunks(acc.nbytes, wire.frame, n)
     bounds = [acc.size * i // n_chunks for i in range(n_chunks + 1)]
@@ -2250,7 +2376,7 @@ def ring_alltoallv_over_net(net, send_comm, recv_comm, segments: list,
     if n == 1:
         return out
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     isz = dtype.itemsize
     train = np.concatenate(
         [_as_bytes(segs[(rank + off) % n]) for off in range(1, n)])
@@ -2292,7 +2418,7 @@ def ring_allgatherv_over_net(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return out
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     # pipelined ragged train: each hop lands origin (rank-s)'s segment
     # straight into its (pre-allocated, exactly-sized) output slot, and
     # that slot is the next hop's outbound — no staging, no .copy()
@@ -2300,7 +2426,10 @@ def ring_allgatherv_over_net(net, send_comm, recv_comm, local: np.ndarray,
         origin = (rank - s) % n
         out[origin] = np.empty(int(counts[origin]), seg.dtype)
     hops = [(_as_bytes(out[(rank - s) % n]), None) for s in range(1, n)]
-    wire.stream(_as_bytes(seg), hops, seg.dtype)
+    # pick key: the largest contribution — counts is the shared MPI
+    # vector, so every rank derives the same frame
+    wire.stream(_as_bytes(seg), hops, seg.dtype,
+                size_key=int(counts.max()) * seg.dtype.itemsize)
     return out
 
 
@@ -2332,7 +2461,7 @@ def ring_reduce_scatter_v_over_net(net, send_comm, recv_comm,
     chunk = lambda i: x[bounds[i % n]:bounds[i % n + 1]]
     combine = _NET_REDUCE_OPS[op]  # KeyError = unknown op, caller's bug
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     # same -1-shifted streaming reduce chain as the dense verb, with the
     # chunk bounds taken from ``counts`` instead of floor-balanced
     _stream_reduce_scatter(wire, chunk, rank, n, x.dtype, combine)
@@ -2354,7 +2483,7 @@ def ring_alltoall_over_net(net, send_comm, recv_comm, local: np.ndarray,
     if n == 1:
         return out
     wire = _RingWire(net, send_comm, recv_comm, timeout_s=timeout_s,
-                     peers=((rank + 1) % n, (rank - 1) % n))
+                     peers=((rank + 1) % n, (rank - 1) % n), world=n)
     bnb = blocks[0].nbytes
     # my outbound train: blocks for rank+1, rank+2, ... rank+n-1 (travel order)
     train = np.concatenate(

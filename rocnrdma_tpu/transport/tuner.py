@@ -33,6 +33,7 @@ import dataclasses
 import json
 import math
 import os
+import threading
 
 # Default model constants (seconds, seconds/byte). These are order-of-
 # magnitude ICI figures (~1.5us dispatch+hop latency; ~1/(100 GB/s) per
@@ -127,49 +128,709 @@ def measure_alpha(size_bytes: int = 4096, k1: int = 4096, k2: int = 65536,
 
 
 # ---------------------------------------------------------------------------
-# Host-plane coalescing knob (ISSUE 11). The async verb surface packs
-# small collectives into fused buckets (transport/coalesce.py); the
-# bucket size is the classic latency-amortization knob, and this is its
-# model pick — the same alpha-beta discipline as the device-plane algo
-# choice, with HOST-plane constants: the per-hop latency floor and the
-# steady wire rate measured by the bench_host records (PR-2: 4-rank tcp
-# allreduce 0.20 GB/s at 1 MiB vs 0.40 at 16 MiB is exactly an
-# alpha ~ 3e-4 s / beta ~ 0.4 GB/s ring).
+# Host-plane wire model (ISSUE 12) — the measure→model→pick loop closed
+# on the host plane, the way the radix-ladder model above closes it on
+# the device plane. ONE fitted alpha-beta-per-plane model now owns every
+# host tuning constant: the streaming wire's frame_bytes / pipeline_depth
+# (replacing the static negotiated MAX_FRAME/LG_CHUNK constants in
+# ``_RingWire``), the LG-vs-frame-path cutover (a frame past LG_MIN IS
+# the put path), and the coalescer's bucket_bytes pick (whose PR-11
+# hand-set alpha/beta are absorbed as this model's SEED constants).
+#
+# The per-hop cost of streaming S bytes at frame F, posting window D:
+#
+#   t_hop(S, F, D) = alpha_hop                        (hop latency floor)
+#                  + nf * alpha_frame                 (per-frame CPU work:
+#                                                      pack/post/poll)
+#                  + nf * alpha_lg · [lg]             (the put path's EXTRA
+#                                                      per-frame round:
+#                                                      iwrite + descriptor
+#                                                      frame + credit ACK —
+#                                                      the term that prices
+#                                                      the LG-vs-frame-path
+#                                                      CUTOVER; the first
+#                                                      sweep on this
+#                                                      container measured
+#                                                      frame-path 512 KiB
+#                                                      hops ~1.9x faster
+#                                                      than single puts)
+#                  + S * beta * (1 + stall_x·[lg])    (serialized wire; the
+#                                                      credit-stall penalty
+#                                                      inflates put-path
+#                                                      candidates only — the
+#                                                      arena credit is where
+#                                                      stalls live)
+#                  + (S/nf) * consume * (1+recv_x)/D  (the consume/fold
+#                                                      remainder no earlier
+#                                                      frame can hide; a
+#                                                      deeper posting window
+#                                                      overlaps it across
+#                                                      hops)
+#
+#   with nf = ceil(S/F), [lg] = 1 iff F >= LG_MIN.  Larger frames shrink
+#   the nf·alpha_frame term, smaller frames shrink the remainder, and
+#   the alpha_lg surcharge decides where the put path earns its bulk
+#   copy — the interior optimum one static frame cannot hit at all
+#   sizes on both planes.
+#
+# Fitting: ``fit_host_rows`` least-squares the four coefficients per
+# plane from bench_host --sweep rows (size × frame ladder, spread
+# recorded); ``HostWireModel.refit_attribution`` is the ONLINE half —
+# the PR-10 causal stall shares {credit-stall, recv-wait} become the
+# quantized stall_x / recv_x biases (credit-stall-dominant → the put
+# path's candidates price worse, so picks move to deeper pipelines and
+# frame-path frames; recv-wait-dominant → the consume remainder prices
+# worse, so picks move to smaller frames).
+#
+# Determinism: every pick is a PURE function of (inputs, committed model
+# version) — no clock, no RNG, no environ at pick time (the analyzer's
+# purity pass pins this). Versions bump only at epoch-style commit
+# points (``ProcessGroup.tune_wire``'s broadcast commit; ``set_epoch``
+# fences stale pending proposals), each recorded as a flight event, so
+# same-seed chaos runs replay equal with auto-tuning ON.
 # ---------------------------------------------------------------------------
 
-HOST_ALPHA_S = 3.0e-4       # per-hop host-wire latency floor (seconds)
-HOST_BETA_GBPS = 0.4        # steady large-message host wire rate (GB/s)
+# SEED constants (version-0 model): the PR-2 bench_host record's hand
+# readings — 4-rank tcp allreduce 0.20 GB/s at 1 MiB vs 0.40 at 16 MiB
+# is exactly an alpha ~ 3e-4 s / beta ~ 0.4 GB/s ring. These live HERE
+# and nowhere else: pick_bucket_bytes and the wire's frame defaults both
+# read whatever model is committed, seed or fitted (the PR-11 second
+# hand-set copy is gone).
+HOST_ALPHA_S = 3.0e-4       # seed per-hop host-wire latency floor (seconds)
+HOST_BETA_GBPS = 0.4        # seed steady large-message host wire rate (GB/s)
+HOST_FRAME_ALPHA_S = 1.5e-4  # seed per-frame CPU work (one pack+post+poll
+#                              round — the documented dominant msg-plane
+#                              cost, the reason MAX_FRAME grew to 512 KiB
+#                              in r3 and ring hops to 4 MiB puts in r4;
+#                              the seed keeps the pick at those shapes
+#                              until a sweep fit says otherwise)
+HOST_CONSUME_S_PER_B = 1.0e-10  # seed per-byte land/fold remainder (~10 GB/s
+#                                 memcpy+fold — the numpy in-place add rate)
+HOST_LG_ALPHA_S = 2.5e-4    # seed EXTRA per-frame cost of a put-path frame
+#                             (iwrite + descriptor + credit round) — sized
+#                             so the seed cutover sits where the first
+#                             sweep measured it: frame path wins 512 KiB
+#                             hops, single puts win multi-MiB hops
 BUCKET_CANDIDATES = tuple(1 << p for p in range(17, 25))  # 128 KiB..16 MiB
+
+
+@dataclasses.dataclass(frozen=True)
+class PlaneParams:
+    """One plane's fitted wire coefficients (immutable: a committed
+    model version is a value, never mutated in place)."""
+
+    alpha_hop_s: float = HOST_ALPHA_S
+    alpha_frame_s: float = HOST_FRAME_ALPHA_S
+    alpha_lg_s: float = HOST_LG_ALPHA_S
+    beta_s_per_b: float = 1.0 / (HOST_BETA_GBPS * 1e9)
+    consume_s_per_b: float = HOST_CONSUME_S_PER_B
+    stall_x: float = 0.0    # credit-stall bias on LG-path candidates
+    recv_x: float = 0.0     # recv-wait bias on the consume remainder
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlaneParams":
+        return cls(**{f.name: float(d[f.name])
+                      for f in dataclasses.fields(cls) if f.name in d})
+
+
+@dataclasses.dataclass(frozen=True)
+class WirePick:
+    """One per-call wire decision: the frame size, the posting-window
+    depth, whether the frame rides the put (LG) path, and the model
+    version it was derived from (on the record, so a regression is
+    attributable to a model change, not just observable)."""
+
+    frame_bytes: int
+    pipeline_depth: int
+    lg: bool
+    version: int
+
+
+class HostWireModel:
+    """The host plane's committed wire model: per-plane coefficients +
+    a version counter that bumps only at commit points.
+
+    Thread discipline: picks read one immutable ``(version, params)``
+    snapshot (a single attribute load — the hot path pays no lock);
+    commits/fences swap the snapshot under the model lock and record a
+    flight event. Proposals carry the version they were fitted AGAINST
+    and commit only if that version is still current — a stale proposal
+    (e.g. computed before a heal's epoch fence) is dropped, named.
+    """
+
+    # the frame ladder picks choose from: the frame path's sizes up to
+    # MAX_FRAME, then the put-path (LG) chunks; capped at 8 MiB so two
+    # credit windows always fit the 16 MiB LG arena. The exact
+    # MAX_FRAME payload (plugin.HostQPNet.MAX_FRAME) is represented by
+    # its 512 KiB-minus-header value — the largest single-frame post.
+    FRAME_LADDER = (64 << 10, 128 << 10, 256 << 10, (1 << 19) - 12,
+                    1 << 20, 2 << 20, 4 << 20, 8 << 20)
+    DEPTH_LADDER = (2, 3, 4)   # the cross-hop posting window; 2 is the
+    #                            engine's structural double buffer, the
+    #                            pick only ever deepens it
+    PICK_TOL = 1.05            # smallest-within-5%-of-best (flat optima
+    #                            resolve to the cheaper-memory choice,
+    #                            deterministically)
+
+    def __init__(self, plane: str, params: PlaneParams | None = None,
+                 lg_min: int | None = None, lg_arena: int | None = None,
+                 enabled: bool = True, pin_frame: int | None = None,
+                 pin_depth: int | None = None, table=None):
+        self.plane = plane
+        # plugin constants, importable without a cycle: default to the
+        # HostQPNet values ((1<<19)-12 frame cap → LG_MIN just past it)
+        self.lg_min = (1 << 19) - 11 if lg_min is None else int(lg_min)
+        self.lg_arena = 16 << 20 if lg_arena is None else int(lg_arena)
+        self.enabled = enabled
+        # operator pins (bench sweep corpus knobs): a pinned frame/depth
+        # short-circuits the pick — resolved at CONSTRUCTION (env reads
+        # happen in host_wire_model, never at pick time)
+        self.pin_frame = pin_frame
+        self.pin_depth = pin_depth
+        # MEASURED pick table: sorted [(max_hop_bytes, frame_bytes)]
+        # buckets of sweep winners (``measured_winners``). Within its
+        # range the table supersedes the analytic model — the same
+        # precedence the device plane gives the Autotuner sweep over
+        # model_table; beyond it the fitted model extrapolates. Part
+        # of the committed artifact (save/load_host_model), fixed at
+        # construction like the pins.
+        self.table = sorted((int(mx), int(f)) for mx, f in (table or ()))
+        self._lock = threading.Lock()
+        # THE committed snapshot picks read: (version, params, epoch)
+        self._state = (0, params or PlaneParams(), 0)
+        self._pending: tuple | None = None  # (base_version, params, note)
+
+    # -- read side (pure; the pick surface) --------------------------------
+
+    @property
+    def version(self) -> int:
+        return self._state[0]
+
+    @property
+    def params(self) -> PlaneParams:
+        return self._state[1]
+
+    def _is_lg(self, frame_bytes: int, nbytes: int) -> bool:
+        """Whether posts at this (frame, message) ride the put path —
+        decided by the ACTUAL post size min(frame, message)."""
+        return min(max(1, int(frame_bytes)),
+                   max(1, int(nbytes))) >= self.lg_min
+
+    def hop_time(self, nbytes: int, frame_bytes: int, depth: int,
+                 params: PlaneParams | None = None) -> float:
+        """Modeled seconds for one ring hop of ``nbytes`` at this frame
+        and posting window — the formula in the section comment. Pure
+        function of its arguments and the committed params."""
+        p = self.params if params is None else params
+        S = max(1, int(nbytes))
+        F = max(1, int(frame_bytes))
+        nf = -(-S // F)
+        # the path is decided by the ACTUAL post size (a frame cap past
+        # the message still posts message-sized frames): min(F, S)
+        lg = min(F, S) >= self.lg_min
+        per_frame = p.alpha_frame_s + (p.alpha_lg_s if lg else 0.0)
+        wire = S * p.beta_s_per_b * (1.0 + (p.stall_x if lg else 0.0))
+        remainder = (S / nf) * p.consume_s_per_b * (1.0 + p.recv_x) \
+            / max(1, depth)
+        return p.alpha_hop_s + nf * per_frame + wire + remainder
+
+    def pick(self, nbytes: int, world: int = 2,
+             credit_bytes: int | None = None) -> WirePick:
+        """The per-call wire pick for a message/hop of ``nbytes`` on
+        this plane: cheapest modeled (frame, depth) over the ladders,
+        ties broken smallest-first (frame, then depth) within PICK_TOL
+        — so a flat optimum resolves deterministically to the choice
+        holding the least memory. ``credit_bytes`` (the lane's pacing
+        quantum) caps the frame exactly as the lane gate caps the wire
+        quantum; ``world`` bounds the depth (a ring of H hops cannot
+        post deeper than H — the engine clamps again at stream time).
+
+        PURE function of (inputs, committed model version): same inputs
+        and version give the same pick on every rank, which is what
+        keeps both ends' frame tags in agreement (the analyzer's purity
+        pass pins that no clock/RNG/environ sneaks in here)."""
+        state = self._state  # one atomic snapshot: version+params agree
+        version, p = state[0], state[1]
+        if not self.enabled:
+            # tuning OFF: the legacy static pick (LG_CHUNK on put-capable
+            # planes), depth 2 — the pre-ISSUE-12 wire, named
+            f = 4 << 20 if self.lg_arena else (1 << 19) - 12
+            if credit_bytes:
+                f = max(1, min(f, credit_bytes))
+            return WirePick(f, 2, self._is_lg(f, nbytes), version)
+        if self.pin_frame is not None:
+            f = int(self.pin_frame)
+            d = int(self.pin_depth) if self.pin_depth is not None else 2
+            if credit_bytes:
+                f = max(1, min(f, credit_bytes))
+            return WirePick(f, d, self._is_lg(f, nbytes), version)
+        # the measured table first (sweep winners supersede the model
+        # inside the swept range — the Autotuner-over-model_table
+        # precedence, host edition); the analytic ladder handles sizes
+        # past the largest swept bucket
+        for mx, f in self.table:
+            if nbytes <= mx:
+                if credit_bytes:
+                    f = max(1, min(f, credit_bytes))
+                d = int(self.pin_depth) if self.pin_depth is not None \
+                    else 2
+                return WirePick(f, d, self._is_lg(f, nbytes), version)
+        cands = [f for f in self.FRAME_LADDER if f <= self.lg_arena // 2]
+        if credit_bytes:
+            cands = [min(f, credit_bytes) for f in cands]
+        max_depth = max(2, min(max(self.DEPTH_LADDER),
+                               2 * (max(2, world) - 1)))
+        best = None
+        best_t = float("inf")
+        for f in sorted(set(cands)):
+            for d in (d for d in self.DEPTH_LADDER if d <= max_depth):
+                t = self.hop_time(nbytes, f, d, p)
+                if t < best_t:
+                    best, best_t = (f, d), t
+        # smallest-within-tolerance: walk the ladder in (frame, depth)
+        # order and take the first candidate within PICK_TOL of best
+        for f in sorted(set(cands)):
+            for d in (d for d in self.DEPTH_LADDER if d <= max_depth):
+                if self.hop_time(nbytes, f, d, p) <= self.PICK_TOL * best_t:
+                    if self.pin_depth is not None:
+                        d = int(self.pin_depth)
+                    return WirePick(f, d, self._is_lg(f, nbytes), version)
+        f, d = best  # unreachable in practice (best is within its own tol)
+        return WirePick(f, d, self._is_lg(f, nbytes), version)
+
+    # -- write side (commit points only) -----------------------------------
+
+    def propose(self, params: PlaneParams, note: str = "") -> int:
+        """Stage a refit computed against the CURRENT version; returns
+        that base version (the commit token). A later ``commit`` with
+        this token applies it; an epoch fence in between drops it."""
+        with self._lock:
+            base = self._state[0]
+            self._pending = (base, params, note)
+            return base
+
+    def commit(self, params: PlaneParams, base_version: int,
+               note: str = "") -> int | None:
+        """Commit ``params`` fitted against ``base_version``: bumps the
+        model version and records the ``tuner-commit`` flight event.
+        Returns the NEW version, or None when the base is stale (an
+        epoch fence or another commit landed in between) — the stale
+        proposal is dropped, named on the flight timeline."""
+        from rocnrdma_tpu.obs import FLIGHT
+        with self._lock:
+            cur, _p, epoch = self._state
+            if base_version != cur:
+                FLIGHT.record("tuner-stale", plane=self.plane,
+                              base=base_version, version=cur)
+                return None
+            new = cur + 1
+            self._state = (new, params, epoch)
+            self._pending = None
+        FLIGHT.record("tuner-commit", plane=self.plane, version=new,
+                      note=note)
+        return new
+
+    def commit_pending(self) -> int | None:
+        """Commit the staged proposal, if it survived (same semantics
+        as :meth:`commit`); None when nothing is pending or it went
+        stale."""
+        with self._lock:
+            pending = self._pending
+        if pending is None:
+            return None
+        return self.commit(pending[1], pending[0], pending[2])
+
+    def fence_epoch(self, epoch: int) -> None:
+        """The epoch-change fence (wired into the net's ``set_epoch``,
+        so every heal/grow crosses it): a pending proposal computed
+        under the old generation is dropped — its attribution window
+        mixes pre-heal wiring — and the fence lands on the flight
+        timeline. The COMMITTED model survives (it was agreed at a
+        protocol point; membership change does not un-fit it)."""
+        from rocnrdma_tpu.obs import FLIGHT
+        with self._lock:
+            version, params, old = self._state
+            if old == int(epoch):
+                return
+            self._state = (version, params, int(epoch))
+            dropped = self._pending is not None
+            self._pending = None
+        FLIGHT.record("tuner-fence", plane=self.plane, epoch=int(epoch),
+                      version=version, dropped_pending=dropped)
+
+    # -- the online refit (pure; tune_wire broadcasts + commits it) --------
+
+    REFIT_QUANTUM = 0.05  # stall shares quantize to 5% steps: two ranks
+    #                       reading marginally different windows still
+    #                       derive the same biases
+
+    def refit_attribution(self, shares: dict,
+                          params: PlaneParams | None = None) -> PlaneParams:
+        """New params from a trace-attribution window (the PR-10
+        five-bucket shares, fractions of op wall): the credit-stall
+        share becomes the put-path bias ``stall_x`` (stall-dominant →
+        LG candidates price worse → picks move toward deeper pipelines
+        and frame-path frames), the recv-wait share becomes the consume
+        bias ``recv_x`` (recv-wait-dominant → the remainder prices
+        worse → picks move toward smaller frames). Shares quantize to
+        ``REFIT_QUANTUM`` so the refit is stable against window noise.
+        Pure: returns the params, commits nothing."""
+        p = self.params if params is None else params
+        q = self.REFIT_QUANTUM
+
+        def quant(x):
+            return round(min(1.0, max(0.0, float(x))) / q) * q
+
+        stall = quant(shares.get("credit-stall", 0.0))
+        recv = quant(shares.get("recv-wait", 0.0))
+        # the bias scale: a bucket owning the whole wall doubles its
+        # term's price — strong enough to move a pick across one ladder
+        # step, bounded enough never to leave the ladder
+        return dataclasses.replace(p, stall_x=round(2.0 * stall, 6),
+                                   recv_x=round(2.0 * recv, 6))
+
+    # -- introspection / persistence ---------------------------------------
+
+    def block(self) -> dict:
+        """The ``tuner`` block for wire_stats()/bench records: the
+        committed version, the plane's coefficients, and the knobs."""
+        version, p, epoch = self._state
+        return {"plane": self.plane, "version": version, "epoch": epoch,
+                "enabled": self.enabled,
+                "pinned": {"frame_bytes": self.pin_frame,
+                           "depth": self.pin_depth},
+                "table": [[mx, f] for mx, f in self.table],
+                "params": {k: float(v) for k, v in p.to_dict().items()}}
+
+
+def fit_host_rows(rows, seed: PlaneParams | None = None
+                  ) -> dict[str, PlaneParams]:
+    """Least-squares fit of the per-plane wire coefficients from a
+    bench sweep corpus — the offline half of the loop. ``rows`` are
+    bench_host-shaped dicts; each must carry ``plane`` ("shm"/"tcp"),
+    ``size_bytes`` (the collective's buffer), ``n_ranks``, ``mean_s``,
+    and the ``frame_bytes`` the row ran at (the sweep's pinned knob).
+    Rows are converted to per-hop observations via the ring shape
+    (2(n-1) hops of S/n bytes) and regressed on the model's features
+    ``[1, nf, nf·[lg], S_hop, S_hop/nf]`` — the lg column is what lets
+    the fit place the put-path cutover where the corpus measured it.
+
+    Fallback ladder, each step NAMED in the returned params' fit note
+    (see ``fit_note``):
+
+    - >= 5 rows on a plane → the full least-squares fit (coefficients
+      clamped non-negative; a clamped fit refits the surviving terms);
+    - 1..4 rows → proportional calibration: the seed shape scaled by
+      the median measured/predicted ratio (a single point cannot
+      separate five coefficients — it should not pretend to);
+    - 0 rows → the seed constants unchanged (empty corpus falls back
+      to the current defaults, named).
+
+    Pure function of its inputs; plane keys never bleed into each
+    other (conflicting planes fit independently)."""
+    import numpy as np
+
+    seed = seed or PlaneParams()
+    lg_min = HostWireModel("_fit").lg_min
+    by_plane: dict[str, list] = {}
+    for r in rows:
+        plane = r.get("plane")
+        if plane is None:
+            raise ValueError(f"fit_host_rows: row without a plane: {r}")
+        by_plane.setdefault(plane, []).append(r)
+    out: dict[str, PlaneParams] = {}
+    for plane, rs in sorted(by_plane.items()):
+        feats, ts = [], []
+        for r in rs:
+            n = max(2, int(r["n_ranks"]))
+            hops = 2 * (n - 1)
+            s_hop = max(1, int(r["size_bytes"]) // n)
+            f = max(1, int(r.get("frame_bytes") or 4 << 20))
+            nf = -(-s_hop // f)
+            lg = 1.0 if min(f, s_hop) >= lg_min else 0.0
+            # the consume column carries the SAME /depth divisor
+            # hop_time applies (corpus rows run at the engine's default
+            # posting depth 2), so the fitted coefficient means what
+            # hop_time(…, depth) later assumes — without it the
+            # remainder would be double-divided at pick time
+            feats.append([1.0, float(nf), nf * lg, float(s_hop),
+                         float(s_hop) / nf / 2.0])
+            ts.append(float(r["mean_s"]) / hops)
+        if len(rs) >= 5:
+            A = np.asarray(feats)
+            b = np.asarray(ts)
+            coef, *_ = np.linalg.lstsq(A, b, rcond=None)
+            # non-negativity: a negative coefficient is the regression
+            # borrowing one term against another — zero it and refit
+            # the surviving columns so the model stays physical
+            keep = [i for i, c in enumerate(coef) if c > 0]
+            if len(keep) < len(coef) and keep:
+                sub, *_ = np.linalg.lstsq(A[:, keep], b, rcond=None)
+                coef = np.zeros(A.shape[1])
+                for i, c in zip(keep, np.maximum(sub, 0.0)):
+                    coef[i] = c
+            coef = np.maximum(coef, 0.0)
+            floor = 1e-12  # a zero beta would divide a later bucket pick
+            out[plane] = PlaneParams(
+                alpha_hop_s=max(floor, float(coef[0])),
+                alpha_frame_s=max(floor, float(coef[1])),
+                alpha_lg_s=float(coef[2]),
+                beta_s_per_b=max(floor, float(coef[3])),
+                consume_s_per_b=max(floor, float(coef[4])),
+                stall_x=seed.stall_x, recv_x=seed.recv_x)
+        else:
+            # proportional calibration off the seed shape
+            model = HostWireModel(plane, params=seed)
+            ratios = sorted(
+                t / model.hop_time(
+                    max(1, int(r["size_bytes"]) // max(2, int(r["n_ranks"]))),
+                    int(r.get("frame_bytes") or 4 << 20), 2)
+                for r, t in zip(rs, ts))
+            scale = ratios[len(ratios) // 2]
+            out[plane] = PlaneParams(
+                alpha_hop_s=seed.alpha_hop_s * scale,
+                alpha_frame_s=seed.alpha_frame_s * scale,
+                alpha_lg_s=seed.alpha_lg_s * scale,
+                beta_s_per_b=seed.beta_s_per_b * scale,
+                consume_s_per_b=seed.consume_s_per_b * scale,
+                stall_x=seed.stall_x, recv_x=seed.recv_x)
+    return out
+
+
+def measured_winners(rows) -> dict[str, list]:
+    """The sweep's MEASURED pick table per plane: for every swept hop
+    size, the frame whose trials were robustly fastest — scored by the
+    spread's LOWER bound when the row carries one (maximize the worst
+    trial: a noisy arm's lucky best cannot win a bucket), by the mean
+    algbw otherwise; ties break to the smaller frame. Returns
+    ``{plane: [(max_hop_bytes, frame_bytes), ...]}`` sorted by bucket
+    edge, adjacent same-frame buckets collapsed — the ``table`` the
+    committed :class:`HostWireModel` consults before the analytic
+    ladder. Pure function of its rows."""
+    by_point: dict[tuple, list] = {}
+    for r in rows:
+        plane = r.get("plane")
+        if plane is None:
+            raise ValueError(f"measured_winners: row without a plane: {r}")
+        frame = r.get("frame_bytes")
+        if not frame:
+            continue
+        n = max(2, int(r["n_ranks"]))
+        hop = max(1, int(r["size_bytes"]) // n)
+        sp = r.get("spread")
+        if isinstance(sp, (list, tuple)) and len(sp) == 2:
+            score = float(min(sp))
+        elif r.get("algbw_GBps"):
+            score = float(r["algbw_GBps"])
+        else:
+            score = (int(r["size_bytes"]) / float(r["mean_s"]) / 1e9
+                     if r.get("mean_s") else 0.0)
+        by_point.setdefault((plane, hop), []).append((score, int(frame)))
+    out: dict[str, list] = {}
+    for (plane, hop), cands in sorted(by_point.items()):
+        best = max(cands, key=lambda sf: (sf[0], -sf[1]))[1]
+        buckets = out.setdefault(plane, [])
+        if buckets and buckets[-1][1] == best:
+            buckets[-1] = (hop, best)  # adjacent same-frame: widen
+        else:
+            buckets.append((hop, best))
+    return out
+
+
+def fit_note(n_rows: int) -> str:
+    """The fallback-ladder step a fit of ``n_rows`` took, NAMED (the
+    provenance string tune artifacts and commits carry)."""
+    if n_rows == 0:
+        return "seed-defaults (empty corpus)"
+    if n_rows < 5:
+        return f"proportional-calibration ({n_rows} row(s))"
+    return f"least-squares ({n_rows} rows)"
+
+
+def save_host_model(path: str, planes: dict[str, PlaneParams],
+                    meta: dict | None = None,
+                    tables: dict[str, list] | None = None) -> None:
+    """Persist the committed host wire model (the sweep/``--fit-host``
+    artifact; ``ROCNRDMA_HOST_TUNING`` loads it at net construction):
+    fitted per-plane params plus the measured pick tables
+    (``measured_winners``)."""
+    doc = {"schema": "host_wire_model_r2",
+           "planes": {k: v.to_dict() for k, v in planes.items()},
+           "tables": {k: [[int(mx), int(f)] for mx, f in v]
+                      for k, v in (tables or {}).items()},
+           "meta": meta or {}}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fp:
+        json.dump(doc, fp, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def load_host_model(path: str) -> dict[str, PlaneParams]:
+    with open(path) as fp:
+        doc = json.load(fp)
+    return {k: PlaneParams.from_dict(v)
+            for k, v in doc.get("planes", {}).items()}
+
+
+def load_host_tables(path: str) -> dict[str, list]:
+    """The measured pick tables of a saved host model artifact
+    (``{plane: [(max_hop_bytes, frame_bytes), ...]}``; empty for r1
+    artifacts, which carried only fitted params)."""
+    with open(path) as fp:
+        doc = json.load(fp)
+    return {k: [(int(mx), int(f)) for mx, f in v]
+            for k, v in doc.get("tables", {}).items()}
+
+
+# The COMMITTED defaults (results/tune_r01.json): the reference
+# container's fitted coefficients and measured winner tables from the
+# bench_host --sweep ladders (2-rank, 256 KiB..32 MiB x 5 frames,
+# spread-scored). These are what every rank runs until a newer artifact
+# supersedes them via ROCNRDMA_HOST_TUNING — the same "a measured sweep
+# supersedes the seed" ladder as the device plane's tuning tables, and
+# the reason the shm 1 MiB allreduce runs ~2.9x the old static
+# LG_CHUNK default out of the box (the tune_r01 headline row).
+COMMITTED_HOST_PLANES: dict[str, dict] = {
+    "shm": {
+        "params": {"alpha_hop_s": 1e-12,
+                   "alpha_frame_s": 1.6916e-4, "alpha_lg_s": 0.0,
+                   "beta_s_per_b": 1.4984e-9,
+                   "consume_s_per_b": 1e-12},
+        # hop-size buckets -> measured winner frame: frame path
+        # (MAX_FRAME) through 1 MiB hops — the tune_r01 headline row
+        # (2.9x at 512 KiB hops) — then LG puts. The 2 MiB-hop bucket
+        # was re-measured to a wash for plain allreduce and a ~20%
+        # put-path win under the coalescer's fused ops (the submitter
+        # thread is busy with bucket packing, so one native put per
+        # hop beats four frame posts), so it keeps the put path.
+        "table": [[131072, 2097152], [1048576, 524276],
+                  [2097152, 2097152], [16777216, 8388608]],
+    },
+    "tcp": {
+        "params": {"alpha_hop_s": 1e-12,
+                   "alpha_frame_s": 5.8029e-4, "alpha_lg_s": 0.0,
+                   "beta_s_per_b": 2.1284e-9,
+                   "consume_s_per_b": 1e-12},
+        "table": [[131072, 8388608], [524288, 524276],
+                  [2097152, 2097152], [8388608, 4194304],
+                  [16777216, 8388608]],
+    },
+}
+
+
+# the process-wide committed models, one per host plane — created on
+# first touch by the net planes (plugin.HostQPNet/TCPNet construction).
+# Env knobs are read HERE, once, at construction time (the purity rule:
+# pick() itself may never read os.environ):
+#   ROCNRDMA_WIRE_TUNER=0      → picks disabled (legacy static wire)
+#   ROCNRDMA_HOST_TUNING=path  → load fitted params for the planes
+#   ROCNRDMA_WIRE_FRAME=bytes  → pin every pick's frame (sweep corpus knob)
+#   ROCNRDMA_WIRE_DEPTH=n      → pin every pick's posting depth
+_HOST_MODELS: dict[str, HostWireModel] = {}
+_HOST_MODELS_LOCK = threading.Lock()
+
+
+def host_wire_model(plane: str) -> HostWireModel:
+    """THE committed wire model for ``plane`` ("shm" / "tcp"), one per
+    process (like metrics.WIRE) so every comm's picks and every
+    tune_wire commit see the same version stream."""
+    with _HOST_MODELS_LOCK:
+        m = _HOST_MODELS.get(plane)
+        if m is None:
+            enabled = os.environ.get("ROCNRDMA_WIRE_TUNER", "1") != "0"
+            # fallback ladder: operator artifact > committed tune_r01
+            # defaults > seed constants (each step a strict supersede,
+            # like the device plane's tuning-table precedence)
+            committed = COMMITTED_HOST_PLANES.get(plane, {})
+            params = (PlaneParams.from_dict(committed["params"])
+                      if "params" in committed else None)
+            table = committed.get("table")
+            path = os.environ.get("ROCNRDMA_HOST_TUNING")
+            if path:
+                try:
+                    loaded = load_host_model(path).get(plane)
+                    if loaded is not None:
+                        params = loaded
+                        table = load_host_tables(path).get(plane)
+                except (OSError, ValueError, KeyError):
+                    pass  # a bad artifact falls back, committed/seed named
+
+            def _int_env(name):
+                raw = os.environ.get(name)
+                try:
+                    return int(raw) if raw else None
+                except ValueError:
+                    return None
+            m = _HOST_MODELS[plane] = HostWireModel(
+                plane, params=params, enabled=enabled,
+                pin_frame=_int_env("ROCNRDMA_WIRE_FRAME"),
+                pin_depth=_int_env("ROCNRDMA_WIRE_DEPTH"),
+                table=table)
+        return m
+
+
+def _reset_host_models() -> None:
+    """Test hook: drop the process-wide models so a test can re-read
+    the env knobs (mirrors metrics counters' reset discipline)."""
+    with _HOST_MODELS_LOCK:
+        _HOST_MODELS.clear()
 
 
 def coalesce_per_op_time(n_ranks: int, bucket_bytes: int,
                          small_bytes: int = 64 << 10,
-                         alpha: float = HOST_ALPHA_S,
-                         beta_GBps: float = HOST_BETA_GBPS) -> float:
+                         alpha: float | None = None,
+                         beta_GBps: float | None = None,
+                         model: HostWireModel | None = None) -> float:
     """Modeled per-member seconds when ops of ``small_bytes`` ride fused
     allreduce buckets of ``bucket_bytes``: one ring stream of
     ``2(n-1)`` hops pays the per-hop alpha ONCE for the whole bucket,
-    so the per-op share falls as the bucket fills."""
+    so the per-op share falls as the bucket fills. With no explicit
+    ``alpha``/``beta_GBps`` (the what-if/test override path), the price
+    is the committed host wire model's OWN ``hop_time`` at the model's
+    own frame pick — the full per-hop cost including the per-frame
+    alphas, not the hop-latency floor alone (the committed fits carry
+    most fixed cost in ``alpha_frame_s``, so pricing on ``alpha_hop_s``
+    would collapse the bucket pick to the smallest candidate and defeat
+    the amortization the coalescer exists for). One model, one price."""
     if n_ranks <= 1:
         return 0.0
     ops = max(1, bucket_bytes // max(1, small_bytes))
     hops = 2 * (n_ranks - 1)
+    if alpha is None and beta_GBps is None:
+        m = model or host_wire_model("shm")
+        hop_bytes = max(1, bucket_bytes // n_ranks)
+        pk = m.pick(hop_bytes, world=n_ranks)
+        return hops * m.hop_time(hop_bytes, pk.frame_bytes,
+                                 pk.pipeline_depth) / ops
+    if alpha is None or beta_GBps is None:
+        p = (model or host_wire_model("shm")).params
+        alpha = p.alpha_hop_s if alpha is None else alpha
+        if beta_GBps is None:
+            beta_GBps = 1.0 / (p.beta_s_per_b * 1e9)
     t_fused = hops * alpha + hops * (bucket_bytes / n_ranks) \
         / (beta_GBps * 1e9)
     return t_fused / ops
 
 
 def pick_bucket_bytes(n_ranks: int, small_bytes: int = 64 << 10,
-                      alpha: float = HOST_ALPHA_S,
-                      beta_GBps: float = HOST_BETA_GBPS,
-                      candidates=None) -> int:
+                      alpha: float | None = None,
+                      beta_GBps: float | None = None,
+                      candidates=None,
+                      model: HostWireModel | None = None) -> int:
     """The tuner's bucket-size pick for a lane's coalescer: the
     SMALLEST candidate within 10% of the best modeled per-op time.
     Smallest-within-tolerance, not argmin — past the latency crossover
     the curve is nearly flat, and a smaller bucket fills (and so
     flushes) sooner, which is latency the model does not see. Pure
-    function of its inputs: every rank of a job derives the same pick
-    with no rendezvous (the same reason lane ids are hashes)."""
+    function of its inputs and the committed model version: every rank
+    of a job derives the same pick with no rendezvous (the same reason
+    lane ids are hashes). Constants resolve through the one fitted
+    host wire model (ISSUE 12's consolidation — the PR-11 hand-set
+    alpha/beta pair here is gone; the seed constants live only in
+    :class:`PlaneParams`)."""
     cands = tuple(candidates) if candidates is not None \
         else BUCKET_CANDIDATES
     if not cands:
@@ -177,7 +838,8 @@ def pick_bucket_bytes(n_ranks: int, small_bytes: int = 64 << 10,
     if n_ranks <= 1:
         return min(cands)
     times = {b: coalesce_per_op_time(n_ranks, b, small_bytes,
-                                     alpha, beta_GBps) for b in cands}
+                                     alpha, beta_GBps, model=model)
+             for b in cands}
     best = min(times.values())
     return min(b for b in cands if times[b] <= 1.1 * best)
 
@@ -1118,6 +1780,11 @@ def main(argv=None) -> int:
                         "backend (tiny-combine chained marginal; see "
                         "measure_alpha) and exit — the number hw.py's "
                         "MEASURED_DISPATCH_ALPHA_S was derived from")
+    p.add_argument("--fit-host", default=None, metavar="CORPUS_JSONL",
+                   help="no sweep: least-squares the HOST wire model "
+                        "(per-plane frame/depth coefficients) from a "
+                        "bench_host --sweep corpus and write it to --out "
+                        "(load via ROCNRDMA_HOST_TUNING)")
     p.add_argument("--model-table", default=None, metavar="DEVICE_KIND",
                    help="no sweep: derive the table from the calibrated "
                         "cost model for this chip kind (e.g. 'v5 lite'); "
@@ -1138,6 +1805,41 @@ def main(argv=None) -> int:
         print(f"dispatch alpha on {jax.devices()[0].device_kind or 'cpu'}: "
               f"{a * 1e9:.1f} ns/op (hw.MEASURED_DISPATCH_ALPHA_S; run "
               f"several times — take the median)")
+        return 0
+
+    if args.fit_host is not None:
+        rows = []
+        with open(args.fit_host) as fp:
+            for line in fp:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line from an interrupted sweep
+                plane = d.get("platform", "").removeprefix("host-")
+                ex = d.get("extra", {})
+                frame = (ex.get("wire", {}).get("frame_bytes")
+                         or ex.get("frame_bytes"))
+                if plane and frame:
+                    rows.append({"plane": plane,
+                                 "size_bytes": d["size_bytes"],
+                                 "n_ranks": d["n_ranks"],
+                                 "mean_s": d["mean_s"],
+                                 "algbw_GBps": d.get("algbw_GBps"),
+                                 "spread": ex.get("spread"),
+                                 "frame_bytes": frame})
+        planes = fit_host_rows(rows)
+        counts = {p: sum(1 for r in rows if r["plane"] == p)
+                  for p in planes}
+        save_host_model(args.out, planes, tables=measured_winners(rows),
+                        meta={
+            "provenance": f"fit_host_rows over {args.fit_host}",
+            "fit": {p: fit_note(n) for p, n in counts.items()}})
+        print(f"wrote {args.out}: "
+              + ", ".join(f"{p}={fit_note(n)}"
+                          for p, n in sorted(counts.items())))
         return 0
 
     if args.model_table is not None:

@@ -1135,3 +1135,69 @@ def test_deadlines_future_wait_timeout_is_mandatory():
     sig = inspect.signature(Future.wait)
     p = sig.parameters["timeout_s"]
     assert p.default is inspect.Parameter.empty
+
+
+# ---------------------------------------------------------------------------
+# pass #5: pick purity (ISSUE 12) — the self-tuning wire's determinism
+# contract: fixture positives (clock / RNG / environ inside a pick) and
+# negatives (a pure pick; impurity OUTSIDE the pick surface)
+# ---------------------------------------------------------------------------
+
+from tools.analyze import purity  # noqa: E402
+
+
+def test_purity_flags_clock_rng_environ_in_picks(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import os, random, time
+
+        def pick_frame(nbytes):
+            return int(time.time()) % nbytes
+
+        class Model:
+            def pick(self, nbytes):
+                if os.environ.get("KNOB"):
+                    return 1
+                return random.randint(1, nbytes)
+    """))
+    problems = purity.check_file(str(bad))
+    assert any("time()" in p for p in problems)
+    assert any("os.environ" in p for p in problems)
+    assert any("randint" in p for p in problems)
+
+
+def test_purity_ignores_impurity_outside_the_pick_surface(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(textwrap.dedent("""
+        import os, time
+
+        def pick_frame(nbytes, params):
+            return min(nbytes, params.frame)
+
+        def observe_window():
+            # measurement code may read clocks freely — only PICKS may not
+            return time.perf_counter(), os.environ.get("KNOB")
+    """))
+    assert purity.check_file(str(good)) == []
+
+
+def test_purity_covers_the_named_pure_surface(tmp_path):
+    # hop_time & friends are the model the picks are built from:
+    # impurity there laundered through a pick is the same bug
+    bad = tmp_path / "bad2.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+
+        def hop_time(nbytes, frame):
+            return nbytes * time.monotonic()
+    """))
+    problems = purity.check_file(str(bad))
+    assert any("hop_time" in p for p in problems)
+
+
+def test_purity_selftest_runs():
+    assert purity.selftest() == 0
+
+
+def test_purity_repo_surface_is_clean():
+    assert purity.run() == []

@@ -164,6 +164,13 @@ def test_kill_and_heal_retries_on_shrunk_group_replay_equal(
         # the FLEET digest (health transitions + deterministic counter
         # totals, wall-clock fields excluded) replays from the seed
         assert _line(a, "FLEET") == _line(b, "FLEET"), a.process_id
+        # the self-tuning wire's version stream (ISSUE 12): auto-tuning
+        # is ON for the whole chaos run, the heal's epoch fence crossed
+        # the model (at least one tuner-fence event), and the structural
+        # event sequence replays equal — picks are pure functions of
+        # (inputs, version), so retunes can never diverge a retry
+        assert _line(a, "TUNERLOG") == _line(b, "TUNERLOG"), a.process_id
+        assert "tuner-fence" in _line(a, "TUNERLOG"), a.process_id
     # the unified timeline: merge the survivors' flight dumps and read
     # the recovery story off the membership track, aligned against the
     # frame lane in the same trace

@@ -102,9 +102,10 @@ def test_format_table_shows_worst_rank_p99_column():
     table = M.format_table([with_fleet, without])
     assert "wp99(us)" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    # wp99 is third-from-last (cp-rank and bfill% trail it, PR 10/11)
-    assert rows[0].split()[-3] == "2048"
-    assert rows[1].split()[-3] == "-"
+    # wp99 is fourth-from-last (cp-rank, bfill%, picks trail it,
+    # PR 10/11/12)
+    assert rows[0].split()[-4] == "2048"
+    assert rows[1].split()[-4] == "-"
 
 
 def test_format_table_shows_cp_rank_column():
@@ -118,9 +119,9 @@ def test_format_table_shows_cp_rank_column():
     table = M.format_table([with_trace, without])
     assert "cp-rank" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    # cp-rank is second-to-last (bfill% trails it, PR 11)
-    assert rows[0].split()[-2] == "3"
-    assert rows[1].split()[-2] == "-"
+    # cp-rank is third-from-last (bfill% and picks trail it, PR 11/12)
+    assert rows[0].split()[-3] == "3"
+    assert rows[1].split()[-3] == "-"
 
 
 def test_format_table_shows_bucket_fill_column():
@@ -134,8 +135,9 @@ def test_format_table_shows_bucket_fill_column():
     table = M.format_table([fused, plain])
     assert "bfill%" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    assert rows[0].split()[-1] == "87"
-    assert rows[1].split()[-1] == "-"
+    # bfill% is second-to-last (the picks column trails it, PR 12)
+    assert rows[0].split()[-2] == "87"
+    assert rows[1].split()[-2] == "-"
 
 
 def test_format_table_shows_tier_column():
@@ -163,15 +165,40 @@ def test_overlap_ratio_windowed_since_snapshot():
     assert w.overlap_ratio(since=w.snapshot()) == 0.0
 
 
+def test_format_table_shows_picks_column():
+    """The self-tuning-wire satellite (PR 12): a record whose wire
+    gauge carries the negotiated frame/depth prints the pick as
+    <KiB>K/d<depth>; rows without a wire gauge print '-'."""
+    tuned = M.BenchRecord.measure(
+        "b", "allreduce", "ring", 2, 1 << 20, "float32", 1e-6,
+        platform="host-shm",
+        wire={"frame_bytes": 524276, "pipeline_depth": 2,
+              "tuner_version": 0})
+    plain = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096,
+                                  "float32", 1e-6, platform="host-shm")
+    table = M.format_table([tuned, plain])
+    assert "picks" in table.splitlines()[0]
+    rows = table.splitlines()[2:]
+    assert rows[0].split()[-1] == "511K/d2"
+    assert rows[1].split()[-1] == "-"
+
+
 def test_negotiation_gauges_record_and_reset():
     w = M.WireCounters()
-    assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0}
+    assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0,
+                               "tuner_version": None}
     w.negotiated(524288, 2)
-    assert w.negotiation() == {"frame_bytes": 524288, "pipeline_depth": 2}
+    assert w.negotiation() == {"frame_bytes": 524288, "pipeline_depth": 2,
+                               "tuner_version": None}
+    # the tuner's pick records the model version that chose it (PR 12)
+    w.negotiated(524276, 3, tuner_version=4)
+    assert w.negotiation() == {"frame_bytes": 524276,
+                               "pipeline_depth": 3, "tuner_version": 4}
     # gauges, not counters: they never appear in the delta window
     assert "frame_bytes" not in w.delta(w.snapshot())
     w.reset()
-    assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0}
+    assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0,
+                               "tuner_version": None}
 
 
 def test_verb_latency_log_buckets():

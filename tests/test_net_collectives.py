@@ -294,3 +294,116 @@ def test_alltoall_int_dtype_preserved():
         assert res[r].dtype == np.int64
         want = np.stack([mats[src][r] for src in range(n)])
         np.testing.assert_array_equal(res[r], want)
+
+
+# ------------------------------------------------------------- ISSUE 12
+# The self-tuning wire on the live ring: per-call model picks drive the
+# streaming engine's frame/depth, the posting window generalizes past
+# the fixed double buffer, and the negotiation gauge names the model
+# version that chose — while results stay bitwise-correct.
+
+from rocnrdma_tpu.metrics import WIRE
+from rocnrdma_tpu.transport.tuner import HostWireModel, PlaneParams
+
+
+def _allreduce_with_model(net_cls, n, elems, model_fn):
+    rng = np.random.default_rng(7)
+    xs = [rng.standard_normal(elems).astype(np.float32) for _ in range(n)]
+    want = np.sum(xs, axis=0)
+
+    def fn(net, s, r, rank):
+        return ring_allreduce_over_net(net, s, r, xs[rank], rank, n)
+
+    net = net_cls()
+    net.wire_model = model_fn()  # per-test model: no process-wide state
+    net.init()
+    handles, listens = [], []
+    for _ in range(n):
+        h, l = net.listen()
+        handles.append(h)
+        listens.append(l)
+    results: list = [None] * n
+    errors: list = []
+
+    def worker(rank):
+        try:
+            send_comm = net.connect(0, handles[(rank + 1) % n])
+            recv_comm = net.accept(listens[rank])
+            results[rank] = fn(net, send_comm, recv_comm, rank)
+        except Exception as e:
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert not errors, errors
+    net.close()
+    for r in range(n):
+        np.testing.assert_allclose(results[r], want, rtol=1e-3,
+                                   atol=1e-5)
+    return WIRE.negotiation()
+
+
+@needs_native
+def test_stream_runs_model_picked_frame_and_deep_window():
+    # a pinned tiny frame + depth 4 posting window over a 4-rank ring
+    # (6 hops): many frames per hop, receives posted 4 hops ahead —
+    # the generalized window must deliver the exact allreduce
+    neg = _allreduce_with_model(
+        HostQPNet, 4, 16384,
+        lambda: HostWireModel("shm", pin_frame=4096, pin_depth=4))
+    assert neg["frame_bytes"] == 4096
+    assert neg["pipeline_depth"] == 4
+    assert neg["tuner_version"] == 0
+
+
+@needs_native
+def test_stream_depth_one_posting_window_still_correct():
+    neg = _allreduce_with_model(
+        HostQPNet, 3, 4096,
+        lambda: HostWireModel("shm", pin_frame=2048, pin_depth=1))
+    assert neg["pipeline_depth"] == 1
+
+
+@needs_native
+def test_stream_negotiation_carries_committed_version():
+    def mk():
+        m = HostWireModel("shm", pin_frame=8192, pin_depth=2)
+        assert m.commit(PlaneParams(), 0, "test") == 1
+        return m
+    neg = _allreduce_with_model(HostQPNet, 2, 8192, mk)
+    assert neg["tuner_version"] == 1
+
+
+@needs_native
+def test_disabled_model_keeps_the_legacy_static_wire():
+    neg = _allreduce_with_model(
+        HostQPNet, 2, 1 << 20,
+        lambda: HostWireModel("shm", enabled=False))
+    # the legacy pick: LG_CHUNK frames, double-buffered window
+    assert neg["frame_bytes"] == HostQPNet.LG_CHUNK
+    assert neg["pipeline_depth"] == 2
+
+
+@needs_native
+@pytest.mark.parametrize("net_cls", PLANES)
+def test_model_picks_agree_across_ranks_on_ragged_verbs(net_cls):
+    # the cross-rank frame-agreement property on the RAGGED verb whose
+    # per-rank hop lists differ most: the pick key is max(counts), the
+    # same value everywhere, so tags agree and the gather is exact
+    n = 4
+    counts = [1021, 7, 2048, 257]
+    rng = np.random.default_rng(3)
+    segs = [rng.standard_normal(c).astype(np.float32) for c in counts]
+
+    def fn(net, s, r, rank):
+        return ring_allgatherv_over_net(net, s, r, segs[rank], counts,
+                                        rank, n)
+
+    res = _run_ring(net_cls, n, fn)
+    for r in range(n):
+        for j in range(n):
+            np.testing.assert_array_equal(res[r][j], segs[j])

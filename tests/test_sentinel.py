@@ -153,3 +153,92 @@ def test_cli_refuses_ambiguous_inputs():
         capture_output=True, text=True, cwd=REPO, timeout=60)
     assert out.returncode == 2
     assert "exactly one of" in out.stderr
+
+
+# ------------------------------------------- statistical half (ISSUE 12)
+# Spread-resolved regression (non-overlapping trial intervals), the
+# wp99-creep and cp-share-drift decay checks, and the committed tune
+# artifact diffing clean against itself.
+
+
+def _spread_row(algbw=0.5, spread=None, fleet=None, trace=None,
+                algo="ring", platform="host-shm"):
+    r = _row(algo=algo, platform=platform, algbw=algbw, trace=trace)
+    if spread is not None:
+        r["extra"]["spread"] = spread
+    if fleet is not None:
+        r["extra"]["fleet"] = fleet
+    return r
+
+
+def test_compare_overlapping_spread_is_noise_not_regression():
+    # a 25% slide whose trial intervals still overlap: trial noise —
+    # the fixed 0.8x ratio would have flagged it, the statistics don't
+    base = _spread_row(algbw=1.0, spread=[0.7, 1.3])
+    cur = _spread_row(algbw=0.75, spread=[0.72, 0.8])
+    assert sentinel.compare([cur], [base]) == []
+
+
+def test_compare_non_overlapping_spread_flags_inside_ratio():
+    # a tight 12% slide the 0.8x ratio would PASS, but the intervals
+    # do not overlap: statistically resolved regression
+    base = _spread_row(algbw=1.0, spread=[0.98, 1.02])
+    cur = _spread_row(algbw=0.88, spread=[0.86, 0.9])
+    [f] = sentinel.compare([cur], [base])
+    assert f["stat"] == "non-overlapping-spread"
+    assert f["floor_GBps"] == 0.98
+    assert "non-overlapping" in sentinel.format_findings([f])
+
+
+def test_compare_without_spread_keeps_ratio_floor():
+    base = _spread_row(algbw=1.0)
+    cur = _spread_row(algbw=0.75)
+    [f] = sentinel.compare([cur], [base])
+    assert f["stat"].startswith("ratio-")
+    assert sentinel.compare([_spread_row(algbw=0.85)], [base]) == []
+
+
+def test_wp99_creep_flags_tail_decay_headline_green():
+    base = _spread_row(algbw=1.0, fleet={"worst_p99_us": 4096})
+    ok = _spread_row(algbw=1.0,
+                     fleet={"worst_p99_us": 8192})     # 2x: inside
+    bad = _spread_row(algbw=1.0,
+                      fleet={"worst_p99_us": 32768})   # 8x: creep
+    assert sentinel.check_wp99_creep([ok], [base]) == []
+    [f] = sentinel.check_wp99_creep([bad], [base])
+    assert f["factor"] == 8.0
+    assert "crept" in sentinel.format_findings([f])
+    # missing fleet telemetry on either side: skipped, never invented
+    assert sentinel.check_wp99_creep([_spread_row(algbw=1.0)],
+                                     [base]) == []
+
+
+def test_cp_share_drift_flags_forming_straggler():
+    base = _spread_row(algbw=1.0, trace={
+        "cp_share": {"0": 50.0, "1": 50.0}})
+    ok = _spread_row(algbw=1.0, trace={
+        "cp_share": {"0": 60.0, "1": 40.0}})   # 0.6 vs 0.5: inside
+    bad = _spread_row(algbw=1.0, trace={
+        "cp_share": {"0": 90.0, "1": 10.0}})   # 0.9: drifted 0.4
+    assert sentinel.check_cp_share_drift([ok], [base]) == []
+    [f] = sentinel.check_cp_share_drift([bad], [base])
+    assert f["cp_max_share"] == 0.9
+    assert "straggler" in sentinel.format_findings([f])
+    assert sentinel.check_cp_share_drift(
+        [_spread_row(algbw=1.0)], [base]) == []
+
+
+def test_committed_tune_artifact_self_diff_is_clean():
+    # the tune_r01 rows are committed floor material like the others:
+    # their own records must diff clean against themselves (including
+    # through the creep/drift checks — the all-zero ratchet property)
+    path = os.path.join(REPO, "results", "tune_r01.json")
+    if not os.path.exists(path):
+        pytest.skip("tune_r01.json not recorded yet")
+    with open(path) as fp:
+        rows = json.load(fp).get("records", [])
+    assert rows, "tune_r01.json carries no records"
+    for r in rows:
+        assert sentinel._spread(r) is not None, \
+            "tune rows must carry the statistical spread field"
+    assert sentinel.check_current(rows) == []

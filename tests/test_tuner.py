@@ -1,5 +1,7 @@
 """Algorithm-selection tuner: cost model, table persistence, auto policy."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -770,3 +772,299 @@ def test_fused_2d_rs_ag_priced_so_khd2d_never_unopposed():
                                   alpha=a, beta=b, hbm_beta=hb,
                                   mesh_shape=shape, dcn=dcn)
                 assert pick == "fused", (verb, shape, size, pick)
+
+
+# ------------------------------------------------- host wire model (ISSUE 12)
+# The measure→model→pick loop on the HOST plane: fit edge cases (empty
+# corpus named fallback, single-point proportional calibration,
+# conflicting planes independent), pick purity (same inputs + version →
+# same pick; no wall-clock reads), stale-version fencing on epoch
+# change, and the consolidation of the PR-11 bucket constants into the
+# one model.
+
+from rocnrdma_tpu.transport.tuner import (  # noqa: E402
+    HostWireModel, PlaneParams, fit_host_rows, fit_note,
+    host_wire_model, load_host_model, pick_bucket_bytes,
+    save_host_model, _reset_host_models)
+
+
+def _corpus_row(plane="shm", size=4 << 20, frame=1 << 20, mean_s=0.01,
+                n=2):
+    return {"plane": plane, "size_bytes": size, "n_ranks": n,
+            "mean_s": mean_s, "frame_bytes": frame}
+
+
+def test_host_fit_empty_corpus_falls_back_named():
+    # empty corpus -> no fitted planes; the fallback is the CURRENT
+    # defaults (seed PlaneParams), and the ladder step is NAMED
+    assert fit_host_rows([]) == {}
+    assert fit_note(0) == "seed-defaults (empty corpus)"
+    m = HostWireModel("shm")
+    assert m.params == PlaneParams()
+    assert m.version == 0
+
+
+def test_host_fit_single_point_is_proportional():
+    # one row cannot separate five coefficients: the seed SHAPE is kept
+    # and scaled so the model passes through the measured point
+    seed = PlaneParams()
+    [row] = [_corpus_row(mean_s=0.004)]
+    params = fit_host_rows([row])["shm"]
+    assert "proportional" in fit_note(1)
+    scale = params.alpha_hop_s / seed.alpha_hop_s
+    assert scale > 0
+    for a, b in ((params.alpha_frame_s, seed.alpha_frame_s),
+                 (params.beta_s_per_b, seed.beta_s_per_b),
+                 (params.consume_s_per_b, seed.consume_s_per_b)):
+        assert a / b == pytest.approx(scale, rel=1e-9)
+    # and the scaled model reproduces the measured per-hop time
+    m = HostWireModel("shm", params=params)
+    hops = 2 * (row["n_ranks"] - 1)
+    assert m.hop_time(row["size_bytes"] // row["n_ranks"],
+                      row["frame_bytes"], 2) \
+        == pytest.approx(row["mean_s"] / hops, rel=1e-6)
+
+
+def test_host_fit_conflicting_planes_stay_independent():
+    # same sizes, wildly different wire rates: each plane's fit sees
+    # only its own rows (no bleed), and a row without a plane refuses
+    rows = ([_corpus_row("shm", size=s, frame=f, mean_s=s / 2e9)
+             for s in (1 << 20, 4 << 20, 16 << 20, 2 << 20)
+             for f in (1 << 17, 1 << 20)]
+            + [_corpus_row("tcp", size=s, frame=f, mean_s=s / 1e8)
+               for s in (1 << 20, 4 << 20, 16 << 20, 2 << 20)
+               for f in (1 << 17, 1 << 20)])
+    fitted = fit_host_rows(rows)
+    assert set(fitted) == {"shm", "tcp"}
+    shm = HostWireModel("shm", params=fitted["shm"])
+    tcp = HostWireModel("tcp", params=fitted["tcp"])
+    s = 8 << 20
+    assert shm.hop_time(s, 1 << 20, 2) < tcp.hop_time(s, 1 << 20, 2)
+    with pytest.raises(ValueError):
+        fit_host_rows([{"size_bytes": 1, "n_ranks": 2, "mean_s": 1.0}])
+
+
+def test_host_pick_is_pure_and_deterministic(monkeypatch):
+    # same (inputs, committed version) -> same pick, across calls AND
+    # across instances; and no wall clock is read at pick time (every
+    # clock in the time module is boobytrapped for the duration)
+    import time as _time
+
+    def boom(*a, **kw):
+        raise AssertionError("pick read the wall clock")
+    for fn in ("time", "monotonic", "perf_counter", "time_ns",
+               "monotonic_ns", "perf_counter_ns", "process_time"):
+        monkeypatch.setattr(_time, fn, boom)
+    a = HostWireModel("shm")
+    b = HostWireModel("shm")
+    for nbytes in (4096, 1 << 19, 1 << 22, 1 << 24):
+        for world in (2, 4, 8):
+            p1 = a.pick(nbytes, world=world)
+            p2 = a.pick(nbytes, world=world)
+            p3 = b.pick(nbytes, world=world)
+            assert p1 == p2 == p3
+    # bucket pick too (the other consolidated pick surface)
+    assert pick_bucket_bytes(4, model=a) == pick_bucket_bytes(4, model=b)
+
+
+def test_host_pick_respects_lane_credit():
+    m = HostWireModel("shm")
+    pk = m.pick(8 << 20, world=2, credit_bytes=128 << 10)
+    assert pk.frame_bytes <= 128 << 10
+
+
+def test_host_stale_version_fenced_on_epoch_change():
+    m = HostWireModel("shm")
+    base = m.propose(dataclasses.replace(m.params, stall_x=0.5), "w1")
+    assert base == 0
+    m.fence_epoch(1)                    # heal: pending proposal dies
+    assert m.commit_pending() is None   # dropped, not committed
+    assert m.version == 0               # committed model survives
+    # a commit against a stale base is refused even without a fence
+    v1 = m.commit(dataclasses.replace(m.params, recv_x=0.2), 0, "ok")
+    assert v1 == 1
+    assert m.commit(m.params, 0, "stale") is None
+    assert m.version == 1
+    # re-fencing the same epoch is a no-op
+    m.fence_epoch(1)
+    assert m.version == 1
+
+
+def test_host_refit_attribution_moves_picks_both_ways():
+    m = HostWireModel("shm")
+    nbytes = 4 << 20  # seed regime: the put path wins this hop size
+    base_pick = m.pick(nbytes, world=2)
+    assert base_pick.lg
+    # credit-stall-dominant window: the put path prices worse — the
+    # pick leaves LG (or at minimum never grows)
+    stalled = HostWireModel("shm", params=m.refit_attribution(
+        {"credit-stall": 0.9}))
+    pk = stalled.pick(nbytes, world=2)
+    assert not pk.lg
+    # recv-wait-dominant window: the consume remainder prices worse —
+    # frames shrink (or hold), never grow
+    recv = HostWireModel("shm", params=m.refit_attribution(
+        {"recv-wait": 0.9}))
+    assert recv.pick(nbytes, world=2).frame_bytes \
+        <= base_pick.frame_bytes
+    # quantization: two marginally different windows, one bias
+    p1 = m.refit_attribution({"credit-stall": 0.501})
+    p2 = m.refit_attribution({"credit-stall": 0.512})
+    assert p1 == p2
+
+
+def test_host_model_save_load_roundtrip(tmp_path):
+    path = str(tmp_path / "host_model.json")
+    fitted = {"shm": PlaneParams(alpha_hop_s=1e-4, stall_x=0.1),
+              "tcp": PlaneParams(beta_s_per_b=5e-9)}
+    save_host_model(path, fitted, meta={"provenance": "test"})
+    loaded = load_host_model(path)
+    assert loaded == fitted
+
+
+def test_host_model_env_knobs(tmp_path, monkeypatch):
+    # construction-time env resolution (the purity rule's sanctioned
+    # side): disable, artifact load, and sweep pins — via the process-
+    # wide registry, reset around the test
+    path = str(tmp_path / "m.json")
+    save_host_model(path, {"shm": PlaneParams(alpha_hop_s=9e-4)})
+    _reset_host_models()
+    try:
+        monkeypatch.setenv("ROCNRDMA_WIRE_TUNER", "0")
+        assert host_wire_model("shm").enabled is False
+        # disabled picks are the legacy static wire, named by shape
+        pk = host_wire_model("shm").pick(1 << 20, world=2)
+        assert pk.frame_bytes == 4 << 20 and pk.pipeline_depth == 2
+        _reset_host_models()
+        monkeypatch.delenv("ROCNRDMA_WIRE_TUNER")
+        monkeypatch.setenv("ROCNRDMA_HOST_TUNING", path)
+        assert host_wire_model("shm").params.alpha_hop_s == 9e-4
+        # tcp is absent from the artifact: the COMMITTED tune_r01
+        # defaults stand (the fallback ladder's middle rung)
+        from rocnrdma_tpu.transport.tuner import COMMITTED_HOST_PLANES
+        assert host_wire_model("tcp").params == PlaneParams.from_dict(
+            COMMITTED_HOST_PLANES["tcp"]["params"])
+        _reset_host_models()
+        monkeypatch.setenv("ROCNRDMA_WIRE_FRAME", str(1 << 16))
+        monkeypatch.setenv("ROCNRDMA_WIRE_DEPTH", "3")
+        pk = host_wire_model("shm").pick(8 << 20, world=2)
+        assert pk.frame_bytes == 1 << 16 and pk.pipeline_depth == 3
+    finally:
+        _reset_host_models()
+
+
+def test_bucket_pick_reads_the_one_model():
+    # the PR-11 consolidation: pick_bucket_bytes' constants come from
+    # the committed model — on a FAST wire the per-hop alpha dominates
+    # and bigger buckets amortize it, while on a slow wire the per-byte
+    # term flattens the curve and the smallest-within-tolerance rule
+    # stops early; explicit alpha/beta overrides still work (what-if)
+    slow = HostWireModel("tcp", params=PlaneParams(beta_s_per_b=2.5e-8))
+    fast = HostWireModel("shm", params=PlaneParams(beta_s_per_b=2.5e-10))
+    assert pick_bucket_bytes(4, model=fast) >= pick_bucket_bytes(
+        4, model=slow)
+    explicit = pick_bucket_bytes(4, alpha=3e-4, beta_GBps=0.4)
+    assert explicit == pick_bucket_bytes(4, alpha=3e-4, beta_GBps=0.4)
+
+
+def test_host_pick_lg_cutover_is_per_call():
+    # the LG-vs-frame-path cutover is resolved per call: small hops
+    # ride the frame path, multi-MiB hops the put path (seed regime)
+    m = HostWireModel("shm")
+    assert not m.pick(128 << 10, world=2).lg
+    assert m.pick(8 << 20, world=2).lg
+    # and a frame cap past the message does NOT make a small message LG
+    assert m._is_lg(4 << 20, 128 << 10) is False
+
+
+def test_measured_winners_robust_scoring_and_collapse():
+    from rocnrdma_tpu.transport.tuner import measured_winners
+
+    def row(size, frame, algbw, spread=None):
+        return {"plane": "shm", "size_bytes": size, "n_ranks": 2,
+                "frame_bytes": frame, "algbw_GBps": algbw,
+                "spread": spread}
+    rows = [
+        # 1 MiB size (512K hops): the noisy arm's lucky mean must NOT
+        # beat the tight arm's worst trial (lo-bound scoring)
+        row(1 << 20, 4 << 20, 0.9, spread=[0.2, 1.4]),
+        row(1 << 20, 1 << 19, 0.6, spread=[0.55, 0.65]),
+        # 4 MiB size: same winner frame -> the bucket widens (collapse)
+        row(4 << 20, 4 << 20, 0.3, spread=[0.1, 0.5]),
+        row(4 << 20, 1 << 19, 0.6, spread=[0.5, 0.7]),
+        # 16 MiB size: mean scoring when no spread; tie -> smaller frame
+        row(16 << 20, 4 << 20, 0.8),
+        row(16 << 20, 8 << 20, 0.8),
+    ]
+    table = measured_winners(rows)["shm"]
+    assert table == [(2 << 20, 1 << 19), (8 << 20, 4 << 20)]
+    with pytest.raises(ValueError):
+        measured_winners([{"size_bytes": 1, "n_ranks": 2,
+                           "frame_bytes": 4096, "algbw_GBps": 1.0}])
+
+
+def test_pick_consults_measured_table_then_model():
+    m = HostWireModel("shm", table=[(1 << 20, 1 << 19),
+                                    (8 << 20, 4 << 20)])
+    # inside the swept range: the measured winner, verbatim
+    assert m.pick(512 << 10, world=2).frame_bytes == 1 << 19
+    assert m.pick(4 << 20, world=2).frame_bytes == 4 << 20
+    # the lane credit still caps a table pick
+    assert m.pick(4 << 20, world=2,
+                  credit_bytes=64 << 10).frame_bytes == 64 << 10
+    # beyond the largest bucket: the analytic ladder extrapolates
+    beyond = m.pick(32 << 20, world=2)
+    assert beyond.frame_bytes in HostWireModel.FRAME_LADDER
+
+
+def test_host_model_table_save_load_roundtrip(tmp_path):
+    from rocnrdma_tpu.transport.tuner import load_host_tables
+    path = str(tmp_path / "m.json")
+    tables = {"shm": [(1 << 20, 1 << 19)]}
+    save_host_model(path, {"shm": PlaneParams()}, tables=tables)
+    assert load_host_tables(path) == tables
+    _reset_host_models()
+    try:
+        import os as _os
+        _os.environ["ROCNRDMA_HOST_TUNING"] = path
+        try:
+            assert host_wire_model("shm").table == [(1 << 20, 1 << 19)]
+        finally:
+            del _os.environ["ROCNRDMA_HOST_TUNING"]
+    finally:
+        _reset_host_models()
+
+
+def test_default_model_bucket_pick_amortizes():
+    # the committed defaults must keep the coalescer's amortization: a
+    # default bucket that collapsed to the smallest candidate would
+    # silently forfeit the PR-11 win (code-review finding — the price
+    # must include the per-frame alphas, not the hop floor alone)
+    for plane in ("shm", "tcp"):
+        m = HostWireModel(
+            plane,
+            params=PlaneParams.from_dict(
+                __import__("rocnrdma_tpu.transport.tuner",
+                           fromlist=["COMMITTED_HOST_PLANES"])
+                .COMMITTED_HOST_PLANES[plane]["params"]))
+        assert pick_bucket_bytes(2, model=m) >= 1 << 20, plane
+
+
+def test_fit_consume_feature_matches_hop_time_depth():
+    # the fit's consume column carries the /depth divisor hop_time
+    # applies (corpus depth 2): a synthetic corpus generated FROM
+    # hop_time must round-trip through the fit
+    p = PlaneParams(alpha_hop_s=1e-4, alpha_frame_s=5e-5, alpha_lg_s=0.0,
+                    beta_s_per_b=1e-9, consume_s_per_b=4e-10)
+    m = HostWireModel("shm", params=p)
+    rows = []
+    for size in (1 << 20, 4 << 20, 16 << 20, 2 << 20, 8 << 20):
+        for f in (1 << 17, 1 << 18, (1 << 19) - 12):
+            hop = size // 2
+            rows.append({"plane": "shm", "size_bytes": size,
+                         "n_ranks": 2, "frame_bytes": f,
+                         "mean_s": 2 * m.hop_time(hop, f, 2)})
+    fit = fit_host_rows(rows)["shm"]
+    assert fit.consume_s_per_b == pytest.approx(p.consume_s_per_b,
+                                                rel=1e-3)
+    assert fit.beta_s_per_b == pytest.approx(p.beta_s_per_b, rel=1e-3)

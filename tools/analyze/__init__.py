@@ -1,6 +1,6 @@
 """tools.analyze — the repo's static-analysis suite, gating tier-1.
 
-Five passes over the transport stack, one shared AST/allowlist core
+Six passes over the transport stack, one shared AST/allowlist core
 (``tools.analyze.base``); each pass enforces one machine-checkable
 invariant of the "named errors, never hangs, no silent corruption"
 contract:
@@ -16,6 +16,10 @@ contract:
 - ``obs``: every public blocking verb on the net vtable records
   flight-recorder entry/completion events — a new verb cannot ship
   unobservable (blind spots are where hang postmortems go to die).
+- ``purity``: the self-tuning wire's pick surface (``transport/tuner``)
+  reads no clock, RNG, or environ at pick time — picks must be pure
+  functions of (inputs, committed model version) or the two ends of a
+  ring edge derive different frame tags and deadlock.
 
 Run all passes with ``python -m tools.analyze`` (exit 0 = clean). Every
 pass carries an ``ALLOW`` dict — empty by policy; an entry needs a
@@ -26,9 +30,9 @@ are ratcheted against ``results/analyze_pr3.json`` by
 
 from __future__ import annotations
 
-from tools.analyze import deadlines, leaks, obs, races, vtable
+from tools.analyze import deadlines, leaks, obs, purity, races, vtable
 
-PASSES = (deadlines, races, vtable, leaks, obs)
+PASSES = (deadlines, races, vtable, leaks, obs, purity)
 
 SNAPSHOT = "results/analyze_pr3.json"
 

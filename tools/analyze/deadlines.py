@@ -78,6 +78,10 @@ PG_BLOCKING = {
     # the causal-trace surface (PR 10): trace_stats reads every
     # member's published op records — the same bounded-store-read shape
     "trace_stats",
+    # the self-tuning wire's protocol point (ISSUE 12): tune_wire reads
+    # the trace window from the store AND runs a broadcast commit —
+    # both waits a caller must be able to bound
+    "tune_wire",
 }
 
 # RULE 3 (continued) — the multi-tenant lane surface (PR 9): a

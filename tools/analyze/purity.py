@@ -1,0 +1,154 @@
+"""Pass #5: pick purity — the self-tuning wire's determinism contract.
+
+The host wire's per-call picks (``transport/tuner.py``: frame_bytes /
+pipeline_depth / bucket_bytes, and the device model's algorithm picks)
+must be PURE functions of (inputs, committed model version). This is
+not a style preference: both ends of a ring edge derive one message's
+frame chunking independently, and the only thing that keeps their wire
+tags in agreement is that the pick is the same deterministic function
+on every rank. A wall-clock read, an RNG draw, or an ``os.environ``
+lookup inside a pick turns a model refit into a cross-rank tag
+mismatch — a deadlock, not a slowdown — and breaks the same-seed chaos
+replay contract (tuner-version flight events must replay equal).
+
+RULE: any function in the target files whose name (or enclosing
+qualname) contains ``pick``, plus the named pure-model surface
+(``hop_time``, ``refit_attribution``, ``coalesce_per_op_time``,
+``model_time``, ``fit_host_rows``), may not
+
+- call ``time.*`` / ``datetime.*`` clock functions,
+- call ``random.*`` / ``np.random.*`` / ``default_rng``,
+- call ``os.getenv`` / ``os.urandom``, or touch ``os.environ``.
+
+Environment knobs are resolved at CONSTRUCTION (``host_wire_model``
+reads them once, outside any pick), which is the sanctioned pattern.
+Exceptions live in ``ALLOW`` with a written reason; the fixture tests
+in ``tests/test_analyze.py`` prove the detector on positive and
+negative cases, and the ratchet holds the count at zero.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+from tools.analyze import base
+
+NAME = "purity"
+DESCRIPTION = ("pick functions are pure: no clock, no RNG, no environ "
+               "at pick time")
+
+REPO = base.REPO
+
+TARGETS = ["rocnrdma_tpu/transport/tuner.py"]
+
+# the named pure surface beyond name-matching (the model's cost and
+# fit functions the picks are built from — impurity there laundered
+# through a pick would be the same bug one call deeper)
+PURE_SURFACE = {"hop_time", "refit_attribution", "coalesce_per_op_time",
+                "model_time", "fit_host_rows", "measured_winners"}
+
+# rightmost callee identifiers that read a clock or entropy source
+FORBIDDEN_CALLS = {
+    "time", "monotonic", "perf_counter", "process_time", "thread_time",
+    "time_ns", "monotonic_ns", "perf_counter_ns",
+    "now", "today", "utcnow",
+    "random", "randint", "randrange", "randbytes", "choice", "choices",
+    "shuffle", "sample", "uniform", "gauss", "default_rng",
+    "getenv", "urandom",
+}
+
+# "file.py::qualname" -> reason. Empty by policy.
+ALLOW: dict[str, str] = {}
+
+
+def _is_pick_surface(qualname: str, name: str) -> bool:
+    return "pick" in qualname.lower() or name in PURE_SURFACE
+
+
+def _forbidden_in(fn: ast.AST) -> list[tuple[int, str]]:
+    """(lineno, what) for every impure construct inside ``fn``."""
+    out = []
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            callee = base.call_name(sub)
+            if callee in FORBIDDEN_CALLS:
+                out.append((sub.lineno, f"call to {callee}()"))
+        elif isinstance(sub, ast.Attribute) and sub.attr == "environ":
+            out.append((sub.lineno, "os.environ read"))
+    return out
+
+
+def check_file(path: str) -> list[str]:
+    tree = base.parse_file(path)
+    base_name = os.path.basename(path)
+    problems = []
+    for qualname, fn, _owner in base.iter_functions(tree):
+        if not _is_pick_surface(qualname, fn.name):
+            continue
+        key = f"{base_name}::{qualname}"
+        if key in ALLOW:
+            continue
+        for lineno, what in _forbidden_in(fn):
+            problems.append(
+                f"{path}:{lineno}: pick-surface function {qualname} is "
+                f"impure ({what}) — picks must be pure functions of "
+                f"(inputs, committed model version); resolve env/clock "
+                f"state at construction instead")
+    return problems
+
+
+SELFTEST_BAD = """
+import os, time
+
+def pick_frame(nbytes):
+    if os.environ.get("KNOB"):
+        return 1
+    return int(time.time()) % 2
+"""
+
+
+def selftest() -> int:
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as fp:
+        fp.write(SELFTEST_BAD)
+        path = fp.name
+    try:
+        found = check_file(path)
+    finally:
+        os.unlink(path)
+    assert any("os.environ" in p for p in found), "environ not flagged"
+    assert any("time()" in p for p in found), "clock not flagged"
+    print("selftest ok: impure pick (environ + clock) is detectable")
+    return 0
+
+
+def run() -> list[str]:
+    problems = []
+    used: set = set()
+    for path in TARGETS:
+        problems += check_file(path)
+    problems += base.allow_reason_problems(ALLOW, NAME)
+    problems += base.allow_unknown_file_problems(ALLOW, TARGETS, NAME)
+    problems += base.allow_stale_problems(ALLOW, used, NAME)
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--selftest":
+        return selftest()
+    problems = run()
+    if problems:
+        print(f"purity: {len(problems)} problem(s)")
+        for p in problems:
+            print("  " + p)
+        return 1
+    print(f"purity: {len(TARGETS)} files clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,10 +22,19 @@ static-analysis line:
   ``bench_host --smoke`` record set and diff it against the committed
   coalesce/lanes records plus the smoke-floor constants.
 
-"Lite" scope (ISSUE 11): the statistical-noise modeling the ROADMAP
-sentinel item sketches (spread-aware resolution) stays open; the 0.8x
-ratio here matches the smoke gates' own noise allowance, so the
-sentinel can never be stricter than the gate that recorded the floor.
+Statistical half (ISSUE 12, closing the ROADMAP sentinel item): rows
+that carry the BENCH_r03+ ``spread`` field ([lo, hi] algbw over the
+per-repeat fleet trials) are resolved STATISTICALLY instead of by the
+fixed 0.8x allowance — a regression is flagged only when the two
+trial intervals do not overlap (the current run's BEST trial is worse
+than the committed run's WORST trial). That is simultaneously sharper
+than the ratio (a tight-spread 5% slide flags) and calmer (a noisy
+scenario's 30% swing doesn't). Rows without spread on both sides keep
+the ratio floor — the sentinel never invents precision. Two decay
+checks catch rot the headline GB/s hides: ``check_wp99_creep`` (the
+worst-rank verb P99 creeping past a multiple of its committed twin)
+and ``check_cp_share_drift`` (one rank's critical-path share drifting
+toward straggler-hood between floors).
 
 CLI::
 
@@ -44,7 +53,17 @@ RESULTS = os.path.join(REPO, "results")
 
 # committed record files whose rows are floor material; each entry
 # names the JSON path and how to pull BenchRecord-shaped rows out
-COMMITTED_FILES = ("coalesce_r01.json", "lanes_r01.json")
+COMMITTED_FILES = ("coalesce_r01.json", "lanes_r01.json", "tune_r01.json")
+
+# decay thresholds for the between-floors checks: the worst-rank verb
+# P99 may grow to this multiple of its committed twin before it is a
+# finding (log2-bucketed histograms quantize to powers of two, so 2.0
+# is one full bucket of genuine creep)...
+WP99_CREEP_FACTOR = 4.0
+# ...and one rank's critical-path share may drift this much (absolute
+# fraction of cp time) past its committed value before the scoreboard
+# calls it a forming straggler
+CP_SHARE_DRIFT = 0.30
 
 # the identity a current row is matched to its committed twin on —
 # the sweep-point convention of metrics.record_key, minus the knob
@@ -111,13 +130,29 @@ def attribution_diff(cur: dict | None, base: dict | None) -> dict | None:
     return {"grew": grew, "grew_us": deltas[grew], "deltas": deltas}
 
 
+def _spread(rec: dict):
+    """A row's ``[lo, hi]`` algbw trial interval, or None — the
+    statistical field bench_host rows carry since ISSUE 12 (and the
+    BENCH_r03+ artifacts always did)."""
+    sp = rec.get("extra", {}).get("spread")
+    if (isinstance(sp, (list, tuple)) and len(sp) == 2
+            and all(isinstance(v, (int, float)) for v in sp)):
+        return [min(sp), max(sp)]
+    return None
+
+
 def compare(current: list[dict], committed: list[dict],
             ratio: float = 0.8) -> list[dict]:
-    """Diff current records against committed ones; returns one finding
-    per matched row whose algbw fell below ``ratio`` x the committed
-    value. Rows with no committed twin are ignored (new scenarios are
-    not regressions); each finding carries the trace-attribution diff
-    when both rows hold one."""
+    """Diff current records against committed ones; one finding per
+    matched row that regressed. Resolution is STATISTICAL when both
+    rows carry a trial ``spread``: the row is flagged only when the
+    intervals do not overlap — the current run's best trial is worse
+    than the committed run's worst trial, which trial noise cannot
+    produce (the finding says so via ``stat``). Rows without spread on
+    both sides keep the fixed ``ratio`` floor (the lite behavior — no
+    invented precision). Rows with no committed twin are ignored (new
+    scenarios are not regressions); each finding carries the
+    trace-attribution diff when both rows hold one."""
     base_by_key: dict[tuple, dict] = {}
     for rec in committed:
         base_by_key[record_key(rec)] = rec
@@ -128,17 +163,105 @@ def compare(current: list[dict], committed: list[dict],
             continue
         cur_bw = rec.get("algbw_GBps", 0.0)
         base_bw = base.get("algbw_GBps", 0.0)
-        if base_bw <= 0 or cur_bw >= ratio * base_bw:
+        if base_bw <= 0:
             continue
+        cur_sp, base_sp = _spread(rec), _spread(base)
+        if cur_sp is not None and base_sp is not None:
+            # statistically resolved: non-overlapping trial intervals
+            if cur_sp[1] >= base_sp[0]:
+                continue
+            stat = "non-overlapping-spread"
+            floor = base_sp[0]
+        else:
+            if cur_bw >= ratio * base_bw:
+                continue
+            stat = f"ratio-{ratio}"
+            floor = ratio * base_bw
         findings.append({
             "key": record_key(rec),
             "algbw_GBps": round(cur_bw, 4),
             "committed_GBps": round(base_bw, 4),
-            "floor_GBps": round(ratio * base_bw, 4),
+            "floor_GBps": round(floor, 4),
+            "stat": stat,
+            "spread": cur_sp,
+            "committed_spread": base_sp,
             "trace_diff": attribution_diff(
                 rec.get("extra", {}).get("trace"),
                 base.get("extra", {}).get("trace")),
         })
+    return findings
+
+
+def check_wp99_creep(current: list[dict], committed: list[dict],
+                     factor: float = WP99_CREEP_FACTOR) -> list[dict]:
+    """Decay between floors, tail edition: a matched row whose
+    worst-rank verb P99 (``extra["fleet"]["worst_p99_us"]``) grew past
+    ``factor`` x its committed twin is a finding even when the
+    headline GB/s holds — the tail is where the next regression is
+    forming. Rows missing the fleet field on either side are skipped
+    (the sentinel does not invent blame)."""
+    base_by_key = {record_key(r): r for r in committed}
+    findings = []
+    for rec in current:
+        base = base_by_key.get(record_key(rec))
+        if base is None:
+            continue
+        cur = rec.get("extra", {}).get("fleet", {}).get("worst_p99_us")
+        old = base.get("extra", {}).get("fleet", {}).get("worst_p99_us")
+        if not cur or not old:
+            continue
+        if cur > factor * old:
+            findings.append({
+                "key": record_key(rec),
+                "wp99_us": cur, "committed_wp99_us": old,
+                "factor": round(cur / old, 2), "ceiling": factor,
+                "trace_diff": attribution_diff(
+                    rec.get("extra", {}).get("trace"),
+                    base.get("extra", {}).get("trace")),
+            })
+    return findings
+
+
+def _cp_max_share(trace: dict | None):
+    """The largest single-rank fraction of a row's critical-path time
+    (from ``extra["trace"]["cp_share"]``, the per-rank microseconds),
+    or None when the row carries no assembled trace."""
+    shares = (trace or {}).get("cp_share")
+    if not shares:
+        return None
+    total = sum(shares.values())
+    if total <= 0:
+        return None
+    return max(shares.values()) / total
+
+
+def check_cp_share_drift(current: list[dict], committed: list[dict],
+                         drift: float = CP_SHARE_DRIFT) -> list[dict]:
+    """Decay between floors, straggler edition: a matched row where one
+    rank's share of the critical path grew by more than ``drift``
+    (absolute fraction) over the committed row's — a straggler forming
+    while the mean still looks fine. Skipped when either side has no
+    assembled trace."""
+    base_by_key = {record_key(r): r for r in committed}
+    findings = []
+    for rec in current:
+        base = base_by_key.get(record_key(rec))
+        if base is None:
+            continue
+        cur = _cp_max_share(rec.get("extra", {}).get("trace"))
+        old = _cp_max_share(base.get("extra", {}).get("trace"))
+        if cur is None or old is None:
+            continue
+        if cur - old > drift:
+            findings.append({
+                "key": record_key(rec),
+                "cp_max_share": round(cur, 4),
+                "committed_cp_max_share": round(old, 4),
+                "drift": round(cur - old, 4), "ceiling": drift,
+                "trace_diff": attribution_diff(
+                    rec.get("extra", {}).get("trace"),
+                    base.get("extra", {}).get("trace")),
+            })
     return findings
 
 
@@ -171,10 +294,15 @@ def check_speedup_floor(current: list[dict],
 def check_current(current: list[dict],
                   results_dir: str = RESULTS,
                   ratio: float = 0.8) -> list[dict]:
-    """The one-call sentinel pass: row-wise algbw ratchet against the
-    committed records plus the coalesce speedup floor."""
-    return (compare(current, committed_records(results_dir), ratio)
-            + check_speedup_floor(current, results_dir))
+    """The one-call sentinel pass: the (spread-resolved) row-wise algbw
+    ratchet against the committed records, the coalesce speedup floor,
+    and the two between-floors decay checks (wp99 creep, cp-share
+    drift)."""
+    committed = committed_records(results_dir)
+    return (compare(current, committed, ratio)
+            + check_speedup_floor(current, results_dir)
+            + check_wp99_creep(current, committed)
+            + check_cp_share_drift(current, committed))
 
 
 def format_findings(findings: list[dict]) -> str:
@@ -188,10 +316,26 @@ def format_findings(findings: list[dict]) -> str:
         if "speedup" in f:
             lines.append(f"  {key}: coalesce speedup {f['speedup']}x "
                          f"fell below the committed {f['floor']}x floor")
+        elif "wp99_us" in f:
+            lines.append(f"  {key}: worst-rank verb P99 crept to "
+                         f"{f['wp99_us']}us — {f['factor']}x the "
+                         f"committed {f['committed_wp99_us']}us "
+                         f"(ceiling {f['ceiling']}x)")
+        elif "cp_max_share" in f:
+            lines.append(f"  {key}: critical-path share drifted to "
+                         f"{f['cp_max_share']:.0%} on one rank "
+                         f"(committed {f['committed_cp_max_share']:.0%}, "
+                         f"allowed drift {f['ceiling']:.0%}) — a "
+                         f"straggler is forming")
         else:
+            stat = f.get("stat", "")
             lines.append(f"  {key}: {f['algbw_GBps']} GB/s < floor "
                          f"{f['floor_GBps']} (committed "
-                         f"{f['committed_GBps']})")
+                         f"{f['committed_GBps']}"
+                         + (f"; {stat}, spread {f['spread']} vs "
+                            f"{f['committed_spread']}"
+                            if stat == "non-overlapping-spread" else "")
+                         + ")")
         td = f.get("trace_diff")
         if td is not None and td["grew"] is None:
             lines.append(f"    attribution: no bucket grew on the "
