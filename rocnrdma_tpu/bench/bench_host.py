@@ -69,6 +69,17 @@ SMOKE_PATHS = {"shm": ("shm", "msg"), "tcp": ("tcp", "msg"),
 # regression (buckets degenerating to one-op flushes) trips it.
 SMOKE_COALESCE_SPEEDUP = 2.0
 
+# codec scenario smoke gate (ISSUE 13): the quantized-wire win the
+# streaming codec must deliver on the slow leg — a 2-rank tcp 1 MiB
+# allreduce with the int8 wire codec ON (error feedback active) must
+# move >= this multiple of the COMMITTED fp32 tcp floor. The codec
+# cuts the serialized payload 4x (plus per-frame headers) exactly
+# where the tcp floor is bandwidth-bound; measured on this container
+# the int8 arm runs well above 1.5x the 0.22 GB/s floor, so only a
+# genuine codec regression (encode cost swamping the wire saving, or
+# the lane knob silently not engaging) trips the gate.
+SMOKE_CODEC_X = 1.5
+
 # lanes scenario smoke gate (ISSUE 9): the P99 ceiling (microseconds)
 # for a 64 KiB allreduce on the HIGH-PRIORITY latency lane while a
 # paced bulk allgather saturates the same 2-rank shm ring. Recorded in
@@ -88,6 +99,15 @@ SMOKE_LANES_BULK_GBPS = 0.05
 
 
 def _smoke_args(path: str) -> list:
+    if path == "codec":
+        # 2-rank tcp ring, 1 MiB allreduces: the fp32 wire vs the int8
+        # and fp8 codec lanes (error feedback ON) — the gate is the
+        # int8 arm's algbw against the committed fp32 tcp floor, so
+        # the quantized wire is held to an absolute bar, not merely a
+        # same-run ratio
+        return ["--ranks", "2", "--plane", "tcp", "--transport", "msg",
+                "--sizes", "1M", "--collectives", "codec",
+                "--repeats", "5", "--iters", "8"]
     if path == "coalesce":
         # 2-rank shm ring, 128 x 64 KiB allreduces: unbatched loop vs
         # the async coalescer's bucketed fused streams (4 MiB buckets
@@ -416,6 +436,109 @@ def _coalesce_worker(pg, args) -> list:
     ]
 
 
+def _codec_worker(pg, args) -> list:
+    """The quantized-wire scenario (ISSUE 13): the first ``--sizes``
+    entry allreduced over the fp32 wire, then over int8 and fp8 codec
+    lanes (per-frame-scale quantization on every streaming frame,
+    error feedback ON for the sum) — same fleet, arms seconds apart so
+    scheduler noise largely cancels. Each codec row records its
+    speedup over the fp32 arm, the max-abs error of the quantized
+    result against the fp32 result (what the compression actually
+    costs in value space), the payload bytes the codec kept off the
+    wire, and ``floor_x`` — the arm's algbw as a multiple of the
+    committed fp32 floor for this plane (the smoke gate's bar: the
+    quantized wire must BEAT the fp32 floor, not merely its own run).
+    """
+    from rocnrdma_tpu.metrics import VERBS, WIRE
+
+    n = pg.world_size
+    size = parse_size(args.sizes.split(",")[0])
+    elems = max(1, size // 4)
+
+    def contrib(rank: int):
+        return (np.random.default_rng((rank, 77))
+                .standard_normal(elems).astype(np.float32))
+
+    x = contrib(pg.rank)
+    want = contrib(0)
+    for r in range(1, n):
+        want = want + contrib(r)
+    arms = [("fp32", pg),
+            ("int8", pg.channel("q-int8", codec="int8")),
+            ("fp8", pg.channel("q-fp8", codec="fp8"))]
+    floor = SMOKE_FLOORS.get(args.plane, SMOKE_FLOORS["tcp"])
+    rows = []
+    fp32_t = None
+    for name, surf in arms:
+        surf.all_reduce(x, timeout_s=60.0)  # warmup: arenas, lane open
+        wire_base = WIRE.snapshot()
+        verb_base = VERBS.snapshot()
+        spans = []
+        out = None
+        for _ in range(args.repeats):
+            pg.barrier()
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = surf.all_reduce(x, timeout_s=60.0)
+            spans.append((time.perf_counter() - t0) / args.iters)
+        wire = WIRE.delta(wire_base)
+        wire["overlap_ratio"] = round(WIRE.overlap_ratio(since=wire_base), 4)
+        wire.update(WIRE.negotiation())
+        if args.smoke and wire["payload_bytes_copied"]:
+            raise SystemExit(
+                f"smoke gate: rank {pg.rank} staged "
+                f"{wire['payload_bytes_copied']} payload bytes through "
+                f"copies during the codec scenario's {name} arm "
+                f"(want 0): {wire}")
+        mine = trimmed_mean(spans)
+        sec = float(pg.all_reduce(np.array([mine]), op="max")[0])
+        fleet_spans = pg.all_reduce(np.asarray(spans), op="max")
+        spread_gb = sorted(M.algbw_GBps(size, float(s))
+                           for s in fleet_spans)
+        # value-space cost of the compression, fleet-wide worst rank
+        err = float(np.abs(out - want).max())
+        err = float(pg.all_reduce(np.array([err]), op="max")[0])
+        pg.publish_telemetry()
+        pg.barrier()
+        if pg.rank != 0:
+            continue
+        fl = pg.fleet_stats()
+        fleet = {k: fl[k] for k in
+                 ("epoch", "health", "missing", "stale_dropped",
+                  "worst_p99_us", "verb_p50_us", "verb_p99_us",
+                  "verb_latency", "wire_totals")}
+        algbw = M.algbw_GBps(size, sec)
+        extra = dict(iters=args.iters, repeats=args.repeats,
+                     spread=[round(spread_gb[0], 4),
+                             round(spread_gb[-1], 4)],
+                     wire=wire, verb_lat=VERBS.delta(verb_base),
+                     fleet=fleet, trace=_trace_summary(pg, "allreduce"))
+        if name == "fp32":
+            fp32_t = sec
+            algo = "ring"
+        else:
+            algo = f"codec-{name}"
+            extra["codec"] = {
+                "name": name,
+                "speedup": round(fp32_t / sec, 3) if fp32_t else None,
+                "max_abs_err": round(err, 6),
+                "bytes_saved": int(wire.get("payload_bytes_saved", 0)),
+                "frames_encoded": int(wire.get("frames_encoded", 0)),
+                "floor_x": round(algbw / floor, 3),
+                # the spread-BEST trial's multiple: the capability bar
+                # the smoke gate holds to 1.5x (trial noise eats means;
+                # the repo's sentinel resolves regressions by spread
+                # intervals for the same reason), with the mean held
+                # to the standard 0.8x allowance of the same bar
+                "floor_x_best": round(spread_gb[-1] / floor, 3),
+                "floor_GBps": floor,
+            }
+        rows.append(M.BenchRecord.measure(
+            "bench_host", "allreduce", algo, n, size, "float32", sec,
+            platform=f"host-{args.plane}", **extra))
+    return rows
+
+
 def _trace_summary(pg, collective: str) -> dict:
     """The causal tracer's condensed verdict for one bench row: the
     SLOWEST assembled sampled op matching this collective — its wall
@@ -474,11 +597,13 @@ def worker(args) -> int:
     # the watchdog thread)
     pg.start_watchdog()
     rng = np.random.default_rng(pg.rank)
-    if args.collectives in ("lanes", "coalesce"):
-        # the multi-tenant and many-small-ops scenarios have their own
-        # loop shapes
+    if args.collectives in ("lanes", "coalesce", "codec"):
+        # the multi-tenant, many-small-ops, and quantized-wire
+        # scenarios have their own loop shapes
         records = (_lanes_worker(pg, args) if args.collectives == "lanes"
-                   else _coalesce_worker(pg, args))
+                   else _coalesce_worker(pg, args)
+                   if args.collectives == "coalesce"
+                   else _codec_worker(pg, args))
         pg.barrier()
         pg.destroy()
         for rec in records:  # only rank 0 holds any
@@ -638,21 +763,31 @@ def main(argv=None) -> int:
                    help="--sweep only: comma list of pinned frame_bytes "
                         "(raw ints; 524276 is the exact MAX_FRAME "
                         "payload — the largest frame-path post)")
+    p.add_argument("--sweep-depths", default="2",
+                   help="--sweep only: comma list of pinned posting-"
+                        "window depths (the ISSUE-13 depth axis — "
+                        "varying it is what identifies the fitted "
+                        "consume/depth coefficient separately from the "
+                        "per-frame alpha; the default keeps the legacy "
+                        "frames-only corpus shape)")
     p.add_argument("--tune-out", default=None,
                    help="--sweep only: write the tune summary (fit "
                         "params + default-vs-picked rows) to this path")
     p.add_argument("--smoke", action="store_true",
                    help="tier-1 perf gate: 2-rank 1 MiB allreduce on the "
                         "shm, tcp, AND rdma (put-based ring) paths plus "
-                        "the lanes QoS scenario and the coalesce "
-                        "many-small-ops scenario; asserts ZERO steady-"
+                        "the lanes QoS scenario, the coalesce "
+                        "many-small-ops scenario, and the codec "
+                        "quantized-wire scenario; asserts ZERO steady-"
                         "path payload copies on every rank of every "
                         "fleet, algbw >= 0.8x each path's recorded "
                         f"floor ({SMOKE_FLOORS}), the latency "
                         f"lane's P99 <= {SMOKE_LANES_P99_US:.0f} us "
-                        "under concurrent bulk load, and coalesced "
+                        "under concurrent bulk load, coalesced "
                         f">= {SMOKE_COALESCE_SPEEDUP}x unbatched on "
-                        "the small-op floor")
+                        "the small-op floor, and the int8-wire tcp "
+                        f"allreduce >= {SMOKE_CODEC_X}x the fp32 tcp "
+                        "floor with error feedback ON")
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
@@ -686,7 +821,7 @@ def main(argv=None) -> int:
                     f"lanes twins); drop {'/'.join(clash)} or run a "
                     f"plain bench instead")
         records, failures = [], []
-        for path in ("shm", "tcp", "rdma", "lanes", "coalesce"):
+        for path in ("shm", "tcp", "rdma", "lanes", "coalesce", "codec"):
             # each path is its own fleet: per-rank copy gates run inside
             # the workers, the throughput gate against the path's floor
             # runs here. ALL paths measure (and their records persist)
@@ -698,6 +833,47 @@ def main(argv=None) -> int:
             records.extend(recs)
             rec = recs[-1]  # coalesce: [unbatched, coalesced] — gate the
             #                 coalesced row (it carries the speedup)
+            if path == "codec":
+                # the quantized-wire gate: the int8 arm (row 2 of
+                # [fp32, int8, fp8]) must beat the committed fp32 tcp
+                # floor by the recorded multiple with the codec
+                # genuinely engaged (the negotiation gauge says what
+                # the wire actually did)
+                rec = recs[1]
+                ex = rec.extra.get("codec", {})
+                wire = rec.extra.get("wire", {})
+                want_mean = 0.8 * SMOKE_CODEC_X  # the standard noise
+                #             allowance every floor gate carries,
+                #             applied to the codec bar's mean
+                if wire.get("codec") != "int8" \
+                        or not wire.get("frames_encoded"):
+                    failures.append(
+                        f"smoke gate [codec]: the int8 lane did not "
+                        f"engage the wire codec (negotiated "
+                        f"codec={wire.get('codec')}, frames_encoded="
+                        f"{wire.get('frames_encoded')}) — the gate "
+                        f"proved nothing about the quantized wire")
+                elif ex.get("floor_x_best", 0.0) < SMOKE_CODEC_X \
+                        or ex.get("floor_x", 0.0) < want_mean:
+                    failures.append(
+                        f"smoke gate [codec]: int8-wire allreduce at "
+                        f"{rec.algbw_GBps:.3f} GB/s is only "
+                        f"{ex.get('floor_x')}x the committed fp32 tcp "
+                        f"floor mean / {ex.get('floor_x_best')}x best "
+                        f"trial ({ex.get('floor_GBps')} GB/s; want "
+                        f"best >= {SMOKE_CODEC_X}x and mean >= "
+                        f"{want_mean}x) — the quantized wire has "
+                        f"regressed (extra={ex})")
+                else:
+                    print(f"smoke gate ok [codec]: int8 wire "
+                          f"{rec.algbw_GBps:.3f} GB/s = "
+                          f"{ex['floor_x']}x the fp32 tcp floor "
+                          f"(best trial {ex['floor_x_best']}x >= "
+                          f"{SMOKE_CODEC_X}x; speedup {ex['speedup']}x "
+                          f"same-run, max-abs-err {ex['max_abs_err']}, "
+                          f"{ex['bytes_saved']} B saved), zero "
+                          f"steady-path copies")
+                continue
             if path == "coalesce":
                 # the many-small-ops gate: fused buckets must beat the
                 # unbatched per-op floor by the recorded multiple, and
@@ -826,17 +1002,31 @@ def _run_sweep(args) -> int:
     frames = [int(f) for f in args.sweep_frames.split(",")]
     one = argparse.Namespace(**vars(args))
     one.collectives = "allreduce"
+    depths = [int(d) for d in args.sweep_depths.split(",")]
     corpus: list = []
     for size in sizes:
         for frame in frames:
-            one.sizes = str(size)
-            recs = _run_fleet(one, extra_env={
-                "ROCNRDMA_WIRE_FRAME": str(frame)})
-            for rec in recs:
-                print(f"# corpus {args.plane} size={size} frame={frame}: "
-                      f"{rec.algbw_GBps:.3f} GB/s "
-                      f"spread={rec.extra.get('spread')}", flush=True)
-            corpus.extend(recs)
+            for depth in depths:
+                one.sizes = str(size)
+                # the depth axis (ISSUE 13): pinning the posting window
+                # alongside the frame is what separates the fitted
+                # consume/depth coefficient from the per-frame alpha —
+                # a frames-only corpus identifies their SUM, not the
+                # split (the ROADMAP carry-over this sweep closes)
+                recs = _run_fleet(one, extra_env={
+                    "ROCNRDMA_WIRE_FRAME": str(frame),
+                    "ROCNRDMA_WIRE_DEPTH": str(depth),
+                    # the fit converts rows via the GENERIC ring shape
+                    # (2(n-1) hops of S/n): pin the 2-rank
+                    # exchange-and-fold schedule OFF so the corpus
+                    # measures what the regression models
+                    "ROCNRDMA_WIRE_XFOLD": "0"})
+                for rec in recs:
+                    print(f"# corpus {args.plane} size={size} "
+                          f"frame={frame} depth={depth}: "
+                          f"{rec.algbw_GBps:.3f} GB/s "
+                          f"spread={rec.extra.get('spread')}", flush=True)
+                corpus.extend(recs)
     if args.out:
         with open(args.out, "a") as fp:
             for rec in corpus:
@@ -845,7 +1035,9 @@ def _run_sweep(args) -> int:
              "n_ranks": r.n_ranks, "mean_s": r.mean_s,
              "algbw_GBps": r.algbw_GBps,
              "spread": r.extra.get("spread"),
-             "frame_bytes": r.extra.get("wire", {}).get("frame_bytes")}
+             "frame_bytes": r.extra.get("wire", {}).get("frame_bytes"),
+             "pipeline_depth": r.extra.get("wire", {}).get(
+                 "pipeline_depth")}
             for r in corpus]
     planes = _tuner.fit_host_rows(rows)
     # the MEASURED winners supersede the analytic fit inside the swept

@@ -416,6 +416,12 @@ class ProcessGroup:
         # collectives would tag-collide on the wire)
         self._channels_lock = threading.Lock()
         self._channels: dict[str, "ChannelHandle"] = {}
+        # quantized-wire error feedback (ISSUE 13): per-(lane, verb,
+        # shape, dtype) residuals carried across rounds by the codec
+        # lanes' sum reductions; epoch-scoped (a heal's generation bump
+        # deterministically resets a key on first post-heal use)
+        from rocnrdma_tpu.transport import codec as _codec_mod
+        self._codec_residuals = _codec_mod.ResidualStore()
         # collectives committed per lane (channel id -> count), next to
         # the _op_seq total: the heal/grow divergence check must compare
         # the PER-LANE split — with concurrent lanes, two survivors can
@@ -731,7 +737,15 @@ class ProcessGroup:
         every rank gets the result, shape preserved. ``transport``:
         ``"msg"`` (two-sided send/recv ring) or ``"rdma"`` (one-sided
         put-based ring — data written straight into peer MRs with doorbell
-        flags, no posted receives on the data path)."""
+        flags, no posted receives on the data path).
+
+        On a lane opened with a wire ``codec`` (``channel(name,
+        codec=...)``) the msg-path frames ride the wire quantized and a
+        sum reduction additionally runs under ERROR FEEDBACK: the
+        carried residual folds into this round's input, the
+        quantization-committed value rides the wire, and the new
+        residual commits only when the collective does (DESIGN.md
+        §5k)."""
         x = np.asarray(x)
         _check_transport(transport)  # validate even at world size 1
         wire_op = self._avg_wire_op(x, op, "all_reduce")
@@ -739,7 +753,11 @@ class ProcessGroup:
             return x.copy()
         fn = (plugin.ring_allreduce_rdma if transport == "rdma"
               else plugin.ring_allreduce_over_net)
-        out = self._ring(fn, x, op=wire_op, timeout_s=timeout_s)
+        x_wire, commit_residual = self._codec_feedback(
+            "all_reduce", x, wire_op, transport)
+        out = self._ring(fn, x_wire, op=wire_op, timeout_s=timeout_s)
+        if commit_residual is not None:
+            commit_residual()
         return self._avg_finalize(out, x, op)
 
     def reduce_scatter(self, x, op: str = "sum", transport: str = "msg",
@@ -747,7 +765,8 @@ class ProcessGroup:
         """Reduce across ranks (op: sum/prod/max/min/avg); rank r keeps the
         r-th of n floor-balanced element ranges of the flattened buffer.
         ``transport``: ``"msg"`` (send/recv ring) or ``"rdma"`` (one-sided
-        put-based ring, as in :meth:`all_reduce`)."""
+        put-based ring, as in :meth:`all_reduce`). Quantized-lane sum
+        reductions run under error feedback like :meth:`all_reduce`."""
         x = np.asarray(x)
         _check_transport(transport)
         wire_op = self._avg_wire_op(x, op, "reduce_scatter")
@@ -755,8 +774,89 @@ class ProcessGroup:
             return x.ravel().copy()
         fn = (plugin.ring_reduce_scatter_rdma if transport == "rdma"
               else plugin.ring_reduce_scatter_over_net)
-        out = self._ring(fn, x, op=wire_op, timeout_s=timeout_s)
+        x_wire, commit_residual = self._codec_feedback(
+            "reduce_scatter", x, wire_op, transport)
+        out = self._ring(fn, x_wire, op=wire_op, timeout_s=timeout_s)
+        if commit_residual is not None:
+            commit_residual()
         return self._avg_finalize(out, x, op)
+
+    def _codec_feedback(self, verb: str, x: np.ndarray, wire_op: str,
+                        transport: str):
+        """The error-feedback entry of the quantized reducing verbs:
+        ``(x_wire, commit)`` — the value to put on the wire and the
+        residual-commit callback to run AFTER the collective commits
+        (None when the call does not quantize: no lane codec, a
+        non-msg transport, a non-sum reduction — max/min/prod have no
+        accumulating bias to feed back — or a non-floating dtype,
+        which passes through the wire uncompressed anyway).
+
+        ``x_wire = x + residual`` quantization-committed through the
+        codec's roundtrip; the residual is EXACTLY what quantization
+        dropped this round (the codec's power-of-two scales make the
+        committed value ride hop 0 losslessly). Keys are (lane, verb,
+        shape, dtype); epoch discipline — a healed rank's residual
+        resets deterministically — lives in the store
+        (``transport.codec.ResidualStore``). An aborted attempt never
+        commits, so heal-and-retry is exactly-once for the residual
+        too (the retry re-reads the same ``x_wire``)."""
+        if transport != "msg" or wire_op != "sum":
+            return x, None
+        reg = getattr(self._net, "lanes", None)
+        chan = _lanes.current_channel()
+        lane = reg.get(chan) if reg is not None else None
+        name = lane.codec if lane is not None else None
+        if name is None:
+            return x, None
+        from rocnrdma_tpu.transport import codec as _codec
+        if not _codec.WireCodec.supports(x.dtype):
+            return x, None
+        if name == "auto":
+            # THE pure pick the wire's stream negotiation will run —
+            # the size_key comes from the ONE shared definition
+            # (plugin.allreduce_size_key), so the EF verdict and the
+            # wire's frame-level verdict can never disagree
+            model = getattr(self._net, "wire_model", None)
+            if model is None:
+                return x, None
+            n = self.world_size
+            if verb == "all_reduce":
+                size_key = plugin.allreduce_size_key(
+                    model, x.size, x.dtype.itemsize, n,
+                    credit_bytes=lane.credit_bytes)
+            else:  # reduce_scatter: the generic schedule's max chunk
+                size_key = max(x.size * (i + 1) // n - x.size * i // n
+                               for i in range(n)) * x.dtype.itemsize
+            name = model.pick_codec(size_key, x.dtype.itemsize, world=n)
+            if name is None:
+                return x, None
+        codec = _codec.get(name)
+        key = (chan, verb, tuple(np.shape(x)), str(x.dtype))
+        epoch0 = self.epoch
+        if verb == "all_reduce":
+            q, res, payload = self._codec_residuals.feedback(
+                key, x, epoch0, codec, want_payload=True)
+        else:
+            # reduce_scatter's hop-0 send is a chunk, never the whole
+            # buffer — don't pay the EF pass's fused payload emit for
+            # a stash nothing could consume
+            q, res = self._codec_residuals.feedback(key, x, epoch0, codec)
+            payload = None
+        # the wire may skip the exchange-and-fold image commit: q is
+        # already on the quantization grid (consumed at stream entry);
+        # when the EF pass emitted the exact wire payload, a matching
+        # single-frame hop-0 send also skips its re-encode (only the
+        # allreduce exchange-and-fold sends the WHOLE buffer as hop 0
+        # — any other shape mismatches and drops the stash harmlessly)
+        _codec.mark_input_committed()
+        if payload is not None and verb == "all_reduce":
+            _codec.stash_payload(x.nbytes, x.dtype, payload)
+
+        def commit():
+            # q's buffer becomes the key's reusable scratch (the ring
+            # copied it at entry; nothing references it past commit)
+            self._codec_residuals.commit(key, epoch0, res, q=q)
+        return q, commit
 
     def all_gather(self, x, transport: str = "msg",
                    timeout_s: float | None = None) -> np.ndarray:
@@ -907,7 +1007,8 @@ class ProcessGroup:
     def channel(self, name: str, priority: int | None = None,
                 credit_bytes: int | None = None,
                 bucket_bytes: int | None = None,
-                bucket_timeout_s: float | None = None) -> "ChannelHandle":
+                bucket_timeout_s: float | None = None,
+                codec: str | None = None) -> "ChannelHandle":
         """Open (or fetch) the named QoS lane on this group and return a
         :class:`ChannelHandle` whose collective verbs run on it — MANY
         handles' collectives may be in flight CONCURRENTLY over the one
@@ -949,29 +1050,56 @@ class ProcessGroup:
         forces the rest. Like the QoS knobs, a conflicting restatement
         on an already-open handle refuses.
 
+        ``codec`` is the lane's WIRE COMPRESSION knob (ISSUE 13,
+        DESIGN.md §5k): ``"int8"`` / ``"fp8"`` quantize the lane's
+        streaming-collective frames to one byte per element under a
+        per-frame scale header (decoded-and-folded straight out of the
+        wire buffer on the other end), ``"auto"`` lets the committed
+        wire model pick per (plane, size) — off where beta is cheap
+        (shm), on for the slow tcp leg — and None (default) keeps the
+        fp32 wire. Sum reductions on a codec lane additionally run
+        under per-rank error feedback, so training convergence is
+        preserved. Every rank must open the lane with the same codec
+        (the same no-rendezvous contract as the channel id); unknown
+        or unavailable codec names refuse HERE, not mid-collective.
+
         Fetch semantics: ``channel(name)`` with NO QoS arguments returns
         the already-open handle as-is (a consumer module need not — and
         must not have to — restate the opener's settings); restating
         arguments re-runs the conflict check, so a mismatched re-open
         still raises."""
+        from rocnrdma_tpu.transport import codec as _codec_mod
+        codec = _codec_mod.validate_name(codec)
         with self._channels_lock:
             ch = self._channels.get(name)
             if ch is None:
                 lane = self._net.open_lane(
                     name, priority=0 if priority is None else priority,
-                    credit_bytes=credit_bytes)
+                    credit_bytes=credit_bytes, codec=codec)
                 ch = self._channels[name] = ChannelHandle(
                     self, lane, bucket_bytes=bucket_bytes,
                     bucket_timeout_s=bucket_timeout_s)
                 return ch
-            if priority is not None or credit_bytes is not None:
-                # restating QoS re-runs the registry's conflict check;
-                # bucket-only restatements must NOT reach open_lane (a
-                # default-priority re-open against a prioritized lane
-                # would raise a QoS conflict the caller never stated)
+            if priority is not None or credit_bytes is not None \
+                    or codec is not None:
+                # restating SOME lane knobs re-runs the registry's
+                # conflict check with the UNSTATED ones adopted from
+                # the open lane — a partial restatement must conflict
+                # only on what the caller actually said (a
+                # default-priority re-open against a prioritized lane,
+                # or a codec-less restatement against a codec lane,
+                # would otherwise refuse on values the caller never
+                # stated — the same adopt-while-unset contract as the
+                # bucket knobs). Bucket-only restatements still never
+                # reach open_lane.
+                cur = ch._lane
                 self._net.open_lane(
-                    name, priority=0 if priority is None else priority,
-                    credit_bytes=credit_bytes)
+                    name,
+                    priority=cur.priority if priority is None
+                    else priority,
+                    credit_bytes=cur.credit_bytes if credit_bytes is None
+                    else credit_bytes,
+                    codec=cur.codec if codec is None else codec)
             if bucket_bytes is not None or bucket_timeout_s is not None:
                 ch._set_bucket_knobs(bucket_bytes, bucket_timeout_s)
             return ch
@@ -3267,6 +3395,11 @@ class ProcessGroup:
         model = getattr(self._net, "wire_model", None)
         if model is not None:
             s["tuner"] = model.block()
+        # the quantized wire's error-feedback state, as a stable digest
+        # (keys, epochs, exact residual bytes): what the chaos harness
+        # pins replay-equal — including the deterministic post-heal
+        # resets — without shipping the arrays themselves
+        s["codec_residual_digest"] = self._codec_residuals.digest()
         return s
 
     def dead_ranks(self) -> list:

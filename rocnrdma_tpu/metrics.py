@@ -171,6 +171,13 @@ class WireCounters:
     buckets_flushed: int = 0        # fused buckets committed
     bucket_fill: dict = dataclasses.field(default_factory=dict)
     bucket_triggers: dict = dataclasses.field(default_factory=dict)
+    # quantized-wire telemetry (transport/codec.py): frames the sender
+    # encoded to fp8/int8 and the payload bytes the compression kept
+    # off the wire (decoded minus encoded size, headers included) —
+    # deterministic counts of the op sequence, so the chaos FLEET
+    # digest can cover codec activity
+    frames_encoded: int = 0         # outgoing frames quantized at the wire
+    payload_bytes_saved: int = 0    # decoded-minus-wire bytes the codec cut
 
     def __post_init__(self):
         # not a dataclass field: asdict()/snapshot() must stay pure counters
@@ -183,6 +190,7 @@ class WireCounters:
         self._frame_bytes = 0
         self._pipeline_depth = 0
         self._tuner_version = None
+        self._codec = None
 
     def copied(self, nbytes: int, frames: int = 1) -> None:
         """Record ``nbytes`` staged through an extra payload copy (the
@@ -256,6 +264,14 @@ class WireCounters:
             self.bucket_triggers[trigger] = \
                 self.bucket_triggers.get(trigger, 0) + 1
 
+    def encoded(self, saved: int, frames: int = 1) -> None:
+        """Record ``frames`` outgoing wire frames quantized by the
+        streaming codec and the ``saved`` payload bytes (decoded size
+        minus wire size) the compression kept off the wire."""
+        with self._lock:
+            self.frames_encoded += frames
+            self.payload_bytes_saved += saved
+
     def resumed(self, frames: int = 1) -> None:
         """Record p2p frames re-delivered by the stream-resume protocol
         (the retry-widening half of the elastic group: an interrupted
@@ -278,25 +294,28 @@ class WireCounters:
             self.promotions += n
 
     def negotiated(self, frame_bytes: int, pipeline_depth: int,
-                   tuner_version: int | None = None) -> None:
+                   tuner_version: int | None = None,
+                   codec: str | None = None) -> None:
         """Record the frame size / pipeline depth the ring wire chose
         for a stream, plus the wire-model version that chose them (None
-        = a legacy static pick; gauge semantics: last negotiation
-        wins)."""
+        = a legacy static pick) and the wire codec in force (None =
+        uncompressed; gauge semantics: last negotiation wins)."""
         with self._lock:
             self._frame_bytes = int(frame_bytes)
             self._pipeline_depth = int(pipeline_depth)
             self._tuner_version = (int(tuner_version)
                                    if tuner_version is not None else None)
+            self._codec = codec
 
     def negotiation(self) -> dict:
         """The last-negotiated wire parameters (``frame_bytes`` /
-        ``pipeline_depth`` / ``tuner_version``), for wire_stats() and
-        bench records."""
+        ``pipeline_depth`` / ``tuner_version`` / ``codec``), for
+        wire_stats() and bench records."""
         with self._lock:
             return {"frame_bytes": self._frame_bytes,
                     "pipeline_depth": self._pipeline_depth,
-                    "tuner_version": self._tuner_version}
+                    "tuner_version": self._tuner_version,
+                    "codec": self._codec}
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -393,9 +412,12 @@ class WireCounters:
             self.buckets_flushed = 0
             self.bucket_fill = {}
             self.bucket_triggers = {}
+            self.frames_encoded = 0
+            self.payload_bytes_saved = 0
             self._frame_bytes = 0
             self._pipeline_depth = 0
             self._tuner_version = None
+            self._codec = None
 
 
 # THE process-wide wire-counter instance (one per rank process — host-plane
@@ -722,11 +744,15 @@ def format_table(records: list) -> str:
     measurement (``extra["wire"]["frame_bytes"]/["pipeline_depth"]``,
     printed ``<frame KiB>K/d<depth>``): a GB/s movement between two
     rows of the same sweep point is attributable to the pick that
-    changed, not just observable; ``-`` for rows with no wire gauge."""
+    changed, not just observable; ``-`` for rows with no wire gauge.
+    ``codec`` names the wire compression the row's streams ran under
+    (``extra["wire"]["codec"]`` — the negotiated gauge, so it reports
+    what the wire ACTUALLY did, including an ``auto`` knob the tuner
+    resolved to off); ``-`` for uncompressed rows."""
     hdr = (f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} "
            f"{'dtype':>9} {'tier':>18} {'lane':>9} {'time(us)':>12} "
            f"{'algbw GB/s':>11} {'busbw GB/s':>11} {'wp99(us)':>9} "
-           f"{'cp-rank':>8} {'bfill%':>7} {'picks':>10}")
+           f"{'cp-rank':>8} {'bfill%':>7} {'picks':>10} {'codec':>6}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
         wp99 = r.extra.get("fleet", {}).get("worst_p99_us")
@@ -745,7 +771,8 @@ def format_table(records: list) -> str:
             f"{wp99 if wp99 is not None else '-':>9} "
             f"{cp if cp is not None else '-':>8} "
             f"{fill if fill is not None else '-':>7} "
-            f"{picks:>10}"
+            f"{picks:>10} "
+            f"{wire.get('codec') or '-':>6}"
         )
     return "\n".join(lines)
 

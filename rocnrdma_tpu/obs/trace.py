@@ -68,15 +68,21 @@ from rocnrdma_tpu.obs.recorder import FLIGHT
 DEFAULT_SAMPLE = 8
 
 # the attribution buckets (seconds, per rank, summing to the op's wall
-# span): the four MEASURED waits + the wire residual
-WAIT_BUCKETS = ("lane-admit", "credit-stall", "recv-wait", "compute-fold")
+# span): the five MEASURED waits + the wire residual. ``encode`` is the
+# streaming codec's quantize cost (ISSUE 13) — pure calling-thread
+# compute outside every recorded wait, so it counts in full like the
+# scheduling waits; the DECODE half runs inside the consume callbacks
+# and is measured as that frame's fold, landing in compute-fold.
+WAIT_BUCKETS = ("lane-admit", "credit-stall", "recv-wait", "encode",
+                "compute-fold")
 BUCKETS = WAIT_BUCKETS + ("wire",)
 
 # event kinds the op collector folds into the record (everything else
 # recorded under a span rides the flight ring only)
 _WAIT_EVENTS = {"lane-admit-done": "lane-admit",
                 "credit-resumed": "credit-stall",
-                "recv-wait": "recv-wait"}
+                "recv-wait": "recv-wait",
+                "frame-encode-done": "encode"}
 _LAND_KINDS = ("frame-landed", "frame-combined")
 
 

@@ -266,6 +266,21 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
     if getattr(args, "coalesce", False):
         co = pg.channel("latency" if lat is not None else "default",
                         bucket_bytes=1 << 30)
+    # --codec: the round allreduces ride a quantized lane (ISSUE 13) on
+    # FLOAT payloads (the int64 bitwise oracle passes through any codec
+    # uncompressed, which would prove nothing): correctness becomes an
+    # analytic tolerance against the exact fp32 sum (inputs stay below
+    # 2^24 so the fp32 oracle itself is exact), and BITWISENESS becomes
+    # the cross-run contract — the CODECLOG line digests every
+    # committed result plus the error-feedback residual state
+    # (post-heal resets included), and two same-seed runs must print it
+    # identically
+    qch = None
+    codec_hash = None
+    if getattr(args, "codec", None):
+        import hashlib
+        qch = pg.channel("quant", codec=args.codec)
+        codec_hash = hashlib.sha256()
     for rnd in range(start, args.rounds):
         if can_grow and args.grow_round is not None \
                 and rnd == args.grow_round:
@@ -330,6 +345,10 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
             futs = [co.allreduce_async(x, timeout_s=t_op) for x in locs]
             co.flush(timeout_s=t_op)
             gots = [f.wait(timeout_s=t_op) for f in futs]
+        elif qch is not None:
+            local = _chaos_input(args.seed, my_orig, rnd,
+                                 args.size).astype(np.float32)
+            got = qch.all_reduce(local, timeout_s=t_op)
         else:
             local = _chaos_input(args.seed, my_orig, rnd, args.size)
             got = (lat.all_reduce(local, timeout_s=t_op)
@@ -355,6 +374,15 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
                       f"not bitwise-correct on epoch {pg.last_op_epoch} "
                       f"members {members}", flush=True)
                 return 5
+        elif qch is not None:
+            wantf = want_for(rnd).astype(np.float32)
+            tol = 0.08 * max(1.0, float(np.abs(wantf).max()))
+            if float(np.abs(got - wantf).max()) > tol:
+                print(f"BAD-RESULT: round {rnd} quantized result "
+                      f"outside the codec tolerance on epoch "
+                      f"{pg.last_op_epoch} members {members}", flush=True)
+                return 5
+            codec_hash.update(got.tobytes())
         elif not np.array_equal(got, want_for(rnd)):
             print(f"BAD-RESULT: round {rnd} not bitwise-correct on "
                   f"epoch {pg.last_op_epoch} members {members}",
@@ -380,6 +408,12 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
                           f"original rank {pred_gid} corrupted",
                           flush=True)
                     return 5
+    if codec_hash is not None:
+        # result digest + EF residual digest: both pure functions of
+        # the seed's failure story (the residual's post-heal reset is
+        # epoch-keyed, never wall-clock-keyed)
+        print(f"CODECLOG {codec_hash.hexdigest()} "
+              f"{pg.wire_stats()['codec_residual_digest']}", flush=True)
     return 0
 
 
@@ -1001,6 +1035,12 @@ def main(argv=None) -> int:
                         "high-priority 'latency' channel, a second ping "
                         "stream on a paced 'bulk' channel (the lane x "
                         "epoch chaos case; prints LANEFENCED)")
+    p.add_argument("--codec", default=None,
+                   help="kill-and-heal: run the round allreduces on a "
+                        "'quant' lane with this wire codec (int8/fp8) "
+                        "and float payloads — prints CODECLOG (result "
+                        "+ error-feedback-residual digests, replay-"
+                        "equal per seed)")
     p.add_argument("--coalesce", action="store_true",
                    help="kill-and-heal: issue each round's allreduces "
                         "ASYNC and flush them as one fused bucket (the "

@@ -99,6 +99,7 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                 device_heal_fail: bool = False,
                 lanes: bool = False,
                 coalesce: bool = False,
+                codec: str | None = None,
                 _retry_left: int = 1) -> list[WorkerResult]:
     """Spawn ``n`` worker processes running ``task``; wait for all.
 
@@ -159,6 +160,11 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
         # surface — a kill lands mid-bucket and the whole bucket must
         # retry exactly-once, bitwise)
         extra += ["--coalesce"]
+    if codec is not None:
+        # kill-and-heal: the round allreduces ride a quantized lane
+        # with error feedback on float payloads (the codec x heal
+        # chaos surface — prints CODECLOG, replay-equal per seed)
+        extra += ["--codec", codec]
     # release the reservations at the last instant: the spawned rank 0
     # (and the re-elected device coordinator) bind these ports next
     res.close()
@@ -186,5 +192,6 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
         return run_workers(n, task, timeout_s, fault_rank, seed, rounds,
                            size, kill_ranks, kill_ops, spares, join,
                            grow_round, die_at_promotion, device_heal_fail,
-                           lanes, coalesce, _retry_left=_retry_left - 1)
+                           lanes, coalesce, codec,
+                           _retry_left=_retry_left - 1)
     return results

@@ -362,12 +362,15 @@ class FaultNet:
         return self.inner.reg_mr(comm, buffer)
 
     def open_lane(self, name: str, priority: int = 0,
-                  credit_bytes: int | None = None):
+                  credit_bytes: int | None = None,
+                  codec: str | None = None):
         """Passthrough: lane registration is local configuration (the
         per-channel fault knobs key by lane NAME and are consulted by
-        the data verbs below — registering a lane injects nothing)."""
+        the data verbs below — registering a lane injects nothing; the
+        lane's wire ``codec`` knob rides through so a quantized lane's
+        faults land on genuinely encoded frames)."""
         return self.inner.open_lane(name, priority=priority,
-                                    credit_bytes=credit_bytes)
+                                    credit_bytes=credit_bytes, codec=codec)
 
     def _lane(self, kw: dict) -> str:
         """The lane NAME of a data verb call: the explicit ``channel``
@@ -430,7 +433,8 @@ class FaultNet:
 
         return Request(_test=probe)
 
-    def irecv_into(self, comm, buf, tag: int = 0, **kw) -> Request:
+    def irecv_into(self, comm, buf, tag: int = 0, codec=None,
+                   **kw) -> Request:
         """The zero-copy receive, under the SAME fault model as irecv: a
         partitioned net never completes it, a dead comm refuses it, and a
         delayed completion holds only the REPORT — the inner probe still
@@ -441,11 +445,18 @@ class FaultNet:
         op-sequence streams, never from arrival timing). Per-channel
         knobs see the message's lane (explicit ``channel`` kwarg or the
         thread's lane context), so one tenant's receives can stall or
-        blackhole while its neighbours' flow clean."""
+        blackhole while its neighbours' flow clean.
+
+        ``codec`` is wrapped EXPLICITLY (not a ``__getattr__``
+        fall-through — the vtable pass pins that no data-verb surface
+        can bypass fault injection): a quantized lane's decode-and-fold
+        path sees every fault class the plain path does, and a delayed
+        encoded frame still decodes at true delivery time, so quantized
+        chaos runs stay bitwise replay-equal per seed."""
         lane = self._lane(kw)
         if self._dead_mode("irecv_into", lane) == "partitioned":
             return Request(_test=lambda: (False, 0, None))  # never completes
-        req = self.inner.irecv_into(comm, buf, tag=tag, **kw)
+        req = self.inner.irecv_into(comm, buf, tag=tag, codec=codec, **kw)
         hold = self.schedule.test_delay(lane=lane)
         if hold == 0:
             return req

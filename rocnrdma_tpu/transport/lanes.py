@@ -97,14 +97,20 @@ def lane_id(name: str) -> int:
 class Lane:
     """One registered lane: the wire channel id, the human name, the
     scheduling priority (higher = more urgent; the default lane is 0),
-    and the pacing credit (bytes this lane may post between yields;
+    the pacing credit (bytes this lane may post between yields;
     None = unpaced — the default lane's setting, so single-lane
-    workloads pay nothing)."""
+    workloads pay nothing), and the wire codec this lane's streaming
+    collectives compress under (``transport.codec``: "int8" / "fp8",
+    "auto" = the tuner's per-(plane, size) pick, None = uncompressed
+    — the default). Every rank opens the same lane name with the same
+    knobs, so both ends of every hop derive the same codec with no
+    rendezvous — the same no-negotiation contract as the channel id."""
 
     id: int
     name: str
     priority: int = 0
     credit_bytes: int | None = None
+    codec: str | None = None
 
 
 class LaneRegistry:
@@ -128,16 +134,17 @@ class LaneRegistry:
         self.multi = False
 
     def open(self, name: str, priority: int = 0,
-             credit_bytes: int | None = None) -> Lane:
+             credit_bytes: int | None = None,
+             codec: str | None = None) -> Lane:
         with self._lock:
             cur = self._by_name.get(name)
             if cur is not None:
-                if (cur.priority, cur.credit_bytes) != (int(priority),
-                                                        credit_bytes):
+                if (cur.priority, cur.credit_bytes, cur.codec) != \
+                        (int(priority), credit_bytes, codec):
                     raise ValueError(
                         f"lane {name!r} already open with priority="
-                        f"{cur.priority} credit_bytes={cur.credit_bytes}; "
-                        f"conflicting re-open refused")
+                        f"{cur.priority} credit_bytes={cur.credit_bytes} "
+                        f"codec={cur.codec}; conflicting re-open refused")
                 return cur
             lid = lane_id(name)
             clash = self._by_id.get(lid)
@@ -145,7 +152,7 @@ class LaneRegistry:
                 raise ValueError(
                     f"lane id collision: {name!r} hashes to the id of "
                     f"{clash.name!r} — pick a different lane name")
-            lane = Lane(lid, name, int(priority), credit_bytes)
+            lane = Lane(lid, name, int(priority), credit_bytes, codec)
             self._by_name[name] = lane
             self._by_id[lid] = lane
             self.multi = True

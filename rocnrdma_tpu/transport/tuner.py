@@ -211,6 +211,21 @@ HOST_LG_ALPHA_S = 2.5e-4    # seed EXTRA per-frame cost of a put-path frame
 #                             so the seed cutover sits where the first
 #                             sweep measured it: frame path wins 512 KiB
 #                             hops, single puts win multi-MiB hops
+HOST_CODEC_S_PER_B = 1.3e-9  # seed encode+decode CPU cost per DECODED byte
+#                              of the reference (int8) wire codec — the
+#                              compressed-beta term pick_codec weighs the
+#                              wire saving against. Measured on this
+#                              container: ~2.7 GB/s encode + ~9.8 GB/s
+#                              decode pure-numpy, plus the scale pass and
+#                              per-frame Python — ~1.3 ns/B loaded. Sized
+#                              so the seed pick matches the measurement:
+#                              compression loses on shm (committed beta
+#                              1.5e-9: saving 1.1 ns/B < 1.3 cost) and
+#                              wins on tcp (beta 2.1e-9: saving 1.6 > 1.3)
+#                              — off where beta is cheap, on for the slow
+#                              leg. Other codecs scale this by their
+#                              measured codec.COST_FACTOR (fp8 ~7x: the
+#                              ml_dtypes software conversion).
 BUCKET_CANDIDATES = tuple(1 << p for p in range(17, 25))  # 128 KiB..16 MiB
 
 
@@ -226,6 +241,10 @@ class PlaneParams:
     consume_s_per_b: float = HOST_CONSUME_S_PER_B
     stall_x: float = 0.0    # credit-stall bias on LG-path candidates
     recv_x: float = 0.0     # recv-wait bias on the consume remainder
+    codec_s_per_b: float = HOST_CODEC_S_PER_B  # compressed-beta term:
+    #                         encode+decode cost per decoded byte of the
+    #                         reference wire codec (pick_codec weighs it
+    #                         against the wire-byte saving per plane)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -290,6 +309,12 @@ class HostWireModel:
         # happen in host_wire_model, never at pick time)
         self.pin_frame = pin_frame
         self.pin_depth = pin_depth
+        # whether the 2-rank exchange-and-fold schedule may be picked
+        # (plugin._prefer_exchange_fold consults this): resolved at
+        # construction like every env knob (ROCNRDMA_WIRE_XFOLD=0 —
+        # the sweep corpus pins it off so fitted rows measure the
+        # generic ring shape the fit's hop conversion assumes)
+        self.exchange_fold = True
         # MEASURED pick table: sorted [(max_hop_bytes, frame_bytes)]
         # buckets of sweep winners (``measured_winners``). Within its
         # range the table supersedes the analytic model — the same
@@ -320,22 +345,46 @@ class HostWireModel:
                    max(1, int(nbytes))) >= self.lg_min
 
     def hop_time(self, nbytes: int, frame_bytes: int, depth: int,
-                 params: PlaneParams | None = None) -> float:
+                 params: PlaneParams | None = None,
+                 codec: tuple | None = None) -> float:
         """Modeled seconds for one ring hop of ``nbytes`` at this frame
         and posting window — the formula in the section comment. Pure
-        function of its arguments and the committed params."""
+        function of its arguments and the committed params.
+
+        ``codec``: None (uncompressed), or ``(itemsize, cost_factor,
+        hdr_bytes)`` — the compressed arm: the serialized wire bytes
+        shrink to one per element (plus the per-frame scale header),
+        and every decoded byte additionally pays the compressed-beta
+        term ``codec_s_per_b * cost_factor`` (the encode+decode CPU
+        work). The LG-vs-frame cutover is decided on the WIRE sizes —
+        what actually posts."""
         p = self.params if params is None else params
         S = max(1, int(nbytes))
         F = max(1, int(frame_bytes))
         nf = -(-S // F)
+        # the per-frame work scales with what a frame CARRIES: a
+        # sub-frame tail (the 12-byte remainder a header-adjusted
+        # frame leaves on a power-of-two hop) costs its byte share of
+        # the pack/post/poll round, not a full one — integral pricing
+        # made the model prefer schedules that merely avoid tails
+        nf_alpha = max(1.0, S / F)
+        codec_s = 0.0
+        if codec is not None:
+            itemsize, cost_x, hdr = codec
+            S_wire = S // max(1, int(itemsize)) + nf * int(hdr)
+            F_wire = F // max(1, int(itemsize)) + int(hdr)
+            codec_s = S * p.codec_s_per_b * float(cost_x)
+        else:
+            S_wire, F_wire = S, F
         # the path is decided by the ACTUAL post size (a frame cap past
         # the message still posts message-sized frames): min(F, S)
-        lg = min(F, S) >= self.lg_min
+        lg = min(F_wire, S_wire) >= self.lg_min
         per_frame = p.alpha_frame_s + (p.alpha_lg_s if lg else 0.0)
-        wire = S * p.beta_s_per_b * (1.0 + (p.stall_x if lg else 0.0))
+        wire = S_wire * p.beta_s_per_b * (1.0 + (p.stall_x if lg else 0.0))
         remainder = (S / nf) * p.consume_s_per_b * (1.0 + p.recv_x) \
             / max(1, depth)
-        return p.alpha_hop_s + nf * per_frame + wire + remainder
+        return (p.alpha_hop_s + nf_alpha * per_frame + wire + remainder
+                + codec_s)
 
     def pick(self, nbytes: int, world: int = 2,
              credit_bytes: int | None = None) -> WirePick:
@@ -400,6 +449,45 @@ class HostWireModel:
                     return WirePick(f, d, self._is_lg(f, nbytes), version)
         f, d = best  # unreachable in practice (best is within its own tol)
         return WirePick(f, d, self._is_lg(f, nbytes), version)
+
+    def pick_codec(self, nbytes: int, itemsize: int,
+                   world: int = 2) -> str | None:
+        """The per-call COMPRESSION pick for a hop of ``nbytes`` of
+        ``itemsize``-byte elements on this plane: the cheapest wire
+        codec (``transport.codec.WIRE_CODECS``, in that deterministic
+        order) whose best modeled hop time — encoded wire bytes under
+        this plane's beta plus the compressed-beta encode/decode term
+        — beats the best UNCOMPRESSED hop time; None when compression
+        does not pay (the committed seeds place that exactly where the
+        container measured it: off on shm where beta is cheap, on for
+        the slow tcp leg).
+
+        PURE function of (inputs, committed model version), like every
+        pick: a lane's ``codec="auto"`` knob resolves through this on
+        every rank from the same (size_key, dtype, world, version), so
+        both ends of every hop chunk AND decode identically — the
+        purity pass pins it and the broadcast-commit version rules
+        govern when the answer may change."""
+        if not self.enabled or int(itemsize) <= 0:
+            return None
+        from rocnrdma_tpu.transport import codec as _codec
+        p = self._state[1]
+        cands = sorted({f for f in self.FRAME_LADDER
+                        if f <= self.lg_arena // 2})
+        max_depth = max(2, min(max(self.DEPTH_LADDER),
+                               2 * (max(2, world) - 1)))
+        depths = [d for d in self.DEPTH_LADDER if d <= max_depth]
+
+        def best(codec_tuple):
+            return min(self.hop_time(nbytes, f, d, p, codec=codec_tuple)
+                       for f in cands for d in depths)
+
+        name, t = None, best(None)
+        for cand in _codec.WIRE_CODECS:
+            tc = best((int(itemsize), _codec.COST_FACTOR[cand], _codec.HDR))
+            if tc < t:
+                name, t = cand, tc
+        return name
 
     # -- write side (commit points only) -----------------------------------
 
@@ -512,11 +600,17 @@ def fit_host_rows(rows, seed: PlaneParams | None = None
     bench sweep corpus — the offline half of the loop. ``rows`` are
     bench_host-shaped dicts; each must carry ``plane`` ("shm"/"tcp"),
     ``size_bytes`` (the collective's buffer), ``n_ranks``, ``mean_s``,
-    and the ``frame_bytes`` the row ran at (the sweep's pinned knob).
-    Rows are converted to per-hop observations via the ring shape
-    (2(n-1) hops of S/n bytes) and regressed on the model's features
-    ``[1, nf, nf·[lg], S_hop, S_hop/nf]`` — the lg column is what lets
-    the fit place the put-path cutover where the corpus measured it.
+    and the ``frame_bytes`` the row ran at (the sweep's pinned knob);
+    ``pipeline_depth`` when the sweep varied the posting window (the
+    ISSUE-13 depth axis — without depth-varied rows the consume/depth
+    coefficient is only identified through the frame ladder's nf
+    variation, which is exactly the weak identification the ROADMAP
+    carried; absent rows fit at the engine default 2). Rows are
+    converted to per-hop observations via the ring shape (2(n-1) hops
+    of S/n bytes) and regressed on the model's features
+    ``[1, nf, nf·[lg], S_hop, S_hop/nf/depth]`` — the lg column is what
+    lets the fit place the put-path cutover where the corpus measured
+    it.
 
     Fallback ladder, each step NAMED in the returned params' fit note
     (see ``fit_note``):
@@ -552,12 +646,17 @@ def fit_host_rows(rows, seed: PlaneParams | None = None
             nf = -(-s_hop // f)
             lg = 1.0 if min(f, s_hop) >= lg_min else 0.0
             # the consume column carries the SAME /depth divisor
-            # hop_time applies (corpus rows run at the engine's default
-            # posting depth 2), so the fitted coefficient means what
-            # hop_time(…, depth) later assumes — without it the
-            # remainder would be double-divided at pick time
-            feats.append([1.0, float(nf), nf * lg, float(s_hop),
-                         float(s_hop) / nf / 2.0])
+            # hop_time applies — the row's OWN pinned posting depth
+            # when the sweep varied it (the depth axis is what
+            # separates the consume coefficient from the per-frame
+            # alpha), the engine default 2 otherwise — so the fitted
+            # coefficient means what hop_time(…, depth) later assumes
+            depth = max(1, int(r.get("pipeline_depth") or 2))
+            # fractional per-frame column, matching hop_time's pricing
+            # (a tail frame costs its byte share)
+            nf_alpha = max(1.0, s_hop / f)
+            feats.append([1.0, nf_alpha, nf_alpha * lg, float(s_hop),
+                         float(s_hop) / nf / depth])
             ts.append(float(r["mean_s"]) / hops)
         if len(rs) >= 5:
             A = np.asarray(feats)
@@ -580,14 +679,16 @@ def fit_host_rows(rows, seed: PlaneParams | None = None
                 alpha_lg_s=float(coef[2]),
                 beta_s_per_b=max(floor, float(coef[3])),
                 consume_s_per_b=max(floor, float(coef[4])),
-                stall_x=seed.stall_x, recv_x=seed.recv_x)
+                stall_x=seed.stall_x, recv_x=seed.recv_x,
+                codec_s_per_b=seed.codec_s_per_b)
         else:
             # proportional calibration off the seed shape
             model = HostWireModel(plane, params=seed)
             ratios = sorted(
                 t / model.hop_time(
                     max(1, int(r["size_bytes"]) // max(2, int(r["n_ranks"]))),
-                    int(r.get("frame_bytes") or 4 << 20), 2)
+                    int(r.get("frame_bytes") or 4 << 20),
+                    max(1, int(r.get("pipeline_depth") or 2)))
                 for r, t in zip(rs, ts))
             scale = ratios[len(ratios) // 2]
             out[plane] = PlaneParams(
@@ -596,7 +697,8 @@ def fit_host_rows(rows, seed: PlaneParams | None = None
                 alpha_lg_s=seed.alpha_lg_s * scale,
                 beta_s_per_b=seed.beta_s_per_b * scale,
                 consume_s_per_b=seed.consume_s_per_b * scale,
-                stall_x=seed.stall_x, recv_x=seed.recv_x)
+                stall_x=seed.stall_x, recv_x=seed.recv_x,
+                codec_s_per_b=seed.codec_s_per_b)
     return out
 
 
@@ -769,6 +871,8 @@ def host_wire_model(plane: str) -> HostWireModel:
                 pin_frame=_int_env("ROCNRDMA_WIRE_FRAME"),
                 pin_depth=_int_env("ROCNRDMA_WIRE_DEPTH"),
                 table=table)
+            m.exchange_fold = \
+                os.environ.get("ROCNRDMA_WIRE_XFOLD", "1") != "0"
         return m
 
 

@@ -1100,6 +1100,77 @@ def test_obs_coalesce_rule_covers_the_repo_module():
     assert problems == [], problems
 
 
+# ---------------------------------------------------------------------------
+# pass #4h: codec entry-point discipline (ISSUE 13) — every wire-facing
+# codec entry point records an entry flight event and refuses through
+# the record-and-raise helper
+# ---------------------------------------------------------------------------
+
+_CODEC_GOOD = textwrap.dedent("""
+    class WireCodec:
+        def encode(self, arr, commit=None):
+            t0 = _codec_entry("frame-encode", codec=self.name)
+            if not finite(arr):
+                raise _codec_abort("frame-encode", "non-finite input")
+            return b""
+
+        def _quantize(self, scaled):
+            return scaled  # internal machinery: entry points record
+""")
+
+
+def test_obs_codec_accepts_recorded_entry_and_abort():
+    assert obs.check_codec_source(_CODEC_GOOD, "codec.py") == []
+
+
+def test_obs_codec_flags_missing_entry_event():
+    src = textwrap.dedent("""
+        class WireCodec:
+            def encode(self, arr):
+                if not finite(arr):
+                    raise _codec_abort("frame-encode", "non-finite")
+                return b""
+    """)
+    problems = obs.check_codec_source(src, "codec.py")
+    assert len(problems) == 1, problems
+    assert "no entry flight event" in problems[0], problems
+
+
+def test_obs_codec_flags_unrecorded_refusal():
+    # a bare raise on the codec surface: the refusal that killed a
+    # quantized reduction leaves nothing on the timeline
+    src = textwrap.dedent("""
+        class WireCodec:
+            def decode_fold(self, src, dest, dtype, combine=None):
+                t0 = _codec_entry("frame-decode", codec=self.name)
+                if len(src) < 8:
+                    raise ValueError("short frame")
+                return len(dest)
+    """)
+    problems = obs.check_codec_source(src, "codec.py")
+    assert len(problems) == 1, problems
+    assert "raises without recording the abort" in problems[0], problems
+
+
+def test_obs_codec_rule_skips_internal_helpers():
+    src = textwrap.dedent("""
+        class WireCodec:
+            def _quantize(self, scaled):
+                raise ValueError("internal machinery is out of scope")
+
+            def supports(self, dtype):
+                return True
+    """)
+    assert obs.check_codec_source(src, "codec.py") == []
+
+
+def test_obs_codec_rule_covers_the_repo_module():
+    assert obs.CODEC_FILE == "rocnrdma_tpu/transport/codec.py"
+    problems = obs.codec_problems(
+        base.parse_file(obs.CODEC_FILE), obs.CODEC_FILE)
+    assert problems == [], problems
+
+
 def test_deadlines_coalesce_surface_requires_timeout(tmp_path):
     assert ("Future", "wait") in deadlines.COALESCE_BLOCKING
     assert ("Coalescer", "flush") in deadlines.COALESCE_BLOCKING

@@ -116,7 +116,14 @@ def test_smoke_perf_gate(tmp_path, capsys):
     fused through the async coalescer — gated on the fused stream
     beating the per-op floor by the recorded multiple with the
     bitwise oracle preserved (and the zero-copy contract holding with
-    the coalescer ACTIVE, not just importable)."""
+    the coalescer ACTIVE, not just importable).
+
+    PR 13 adds the CODEC path: the tcp 1 MiB allreduce over the int8
+    quantized wire (per-frame-scale compression, error feedback ON) —
+    gated on the int8 arm's best trial beating the committed fp32 tcp
+    floor by the recorded multiple (mean held to the standard 0.8x
+    allowance of the same bar) with the codec provably engaged and
+    zero steady-path copies."""
     out = tmp_path / "smoke.jsonl"
     rc = bench_host.main(["--smoke", "--out", str(out)])
     assert rc == 0
@@ -126,12 +133,16 @@ def test_smoke_perf_gate(tmp_path, capsys):
     assert "smoke gate ok [rdma]" in printed
     assert "smoke gate ok [lanes]" in printed
     assert "smoke gate ok [coalesce]" in printed
+    assert "smoke gate ok [codec]" in printed
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert [r["platform"] for r in rows] == ["host-shm", "host-tcp",
                                              "host-shm", "host-shm",
-                                             "host-shm", "host-shm"]
+                                             "host-shm", "host-shm",
+                                             "host-tcp", "host-tcp",
+                                             "host-tcp"]
     assert [r["algo"] for r in rows] == ["ring", "ring", "ring_rdma",
-                                         "lanes", "unbatched", "coalesced"]
+                                         "lanes", "unbatched", "coalesced",
+                                         "ring", "codec-int8", "codec-fp8"]
     for row in rows:
         # the coalesce pair shares one measurement window: its wire
         # delta rides the coalesced row only
@@ -149,7 +160,18 @@ def test_smoke_perf_gate(tmp_path, capsys):
         # — only the deterministic zero-copy contract above fails the
         # build
         assert 0.0 <= wire["overlap_ratio"] <= 1.0
-    co_row = rows[-1]
+    # the quantized-wire rows (ISSUE 13): the int8 arm beat the fp32
+    # floor bar on its best trial with the codec genuinely engaged
+    int8_row = rows[7]
+    cx = int8_row["extra"]["codec"]
+    assert int8_row["extra"]["wire"]["codec"] == "int8"
+    assert int8_row["extra"]["wire"]["frames_encoded"] > 0
+    assert int8_row["extra"]["wire"]["payload_bytes_saved"] > 0
+    assert cx["floor_x_best"] >= bench_host.SMOKE_CODEC_X
+    assert cx["floor_x"] >= 0.8 * bench_host.SMOKE_CODEC_X
+    assert cx["max_abs_err"] > 0  # genuinely lossy, genuinely measured
+    assert rows[8]["extra"]["wire"]["codec"] == "fp8"
+    co_row = rows[5]
     co = co_row["extra"]["coalesce"]
     assert co["bitwise_ok"] and co["speedup"] >= bench_host.SMOKE_COALESCE_SPEEDUP
     assert co_row["extra"]["wire"]["ops_coalesced"] >= co["ops"]

@@ -104,8 +104,8 @@ def test_format_table_shows_worst_rank_p99_column():
     rows = table.splitlines()[2:]
     # wp99 is fourth-from-last (cp-rank, bfill%, picks trail it,
     # PR 10/11/12)
-    assert rows[0].split()[-4] == "2048"
-    assert rows[1].split()[-4] == "-"
+    assert rows[0].split()[-5] == "2048"
+    assert rows[1].split()[-5] == "-"
 
 
 def test_format_table_shows_cp_rank_column():
@@ -120,8 +120,8 @@ def test_format_table_shows_cp_rank_column():
     assert "cp-rank" in table.splitlines()[0]
     rows = table.splitlines()[2:]
     # cp-rank is third-from-last (bfill% and picks trail it, PR 11/12)
-    assert rows[0].split()[-3] == "3"
-    assert rows[1].split()[-3] == "-"
+    assert rows[0].split()[-4] == "3"
+    assert rows[1].split()[-4] == "-"
 
 
 def test_format_table_shows_bucket_fill_column():
@@ -136,8 +136,8 @@ def test_format_table_shows_bucket_fill_column():
     assert "bfill%" in table.splitlines()[0]
     rows = table.splitlines()[2:]
     # bfill% is second-to-last (the picks column trails it, PR 12)
-    assert rows[0].split()[-2] == "87"
-    assert rows[1].split()[-2] == "-"
+    assert rows[0].split()[-3] == "87"
+    assert rows[1].split()[-3] == "-"
 
 
 def test_format_table_shows_tier_column():
@@ -179,26 +179,46 @@ def test_format_table_shows_picks_column():
     table = M.format_table([tuned, plain])
     assert "picks" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    assert rows[0].split()[-1] == "511K/d2"
+    assert rows[0].split()[-2] == "511K/d2"
+    assert rows[1].split()[-2] == "-"
+
+
+def test_format_table_shows_codec_column():
+    """The quantized-wire satellite (ISSUE 13): a record whose wire
+    gauge names the negotiated codec prints it in the trailing codec
+    column; uncompressed rows print '-'."""
+    quant = M.BenchRecord.measure(
+        "b", "allreduce", "codec-int8", 2, 1 << 20, "float32", 1e-6,
+        platform="host-tcp",
+        wire={"frame_bytes": 2097152, "pipeline_depth": 1,
+              "codec": "int8"})
+    plain = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096,
+                                  "float32", 1e-6, platform="host-tcp")
+    table = M.format_table([quant, plain])
+    assert "codec" in table.splitlines()[0]
+    rows = table.splitlines()[2:]
+    assert rows[0].split()[-1] == "int8"
     assert rows[1].split()[-1] == "-"
 
 
 def test_negotiation_gauges_record_and_reset():
     w = M.WireCounters()
     assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0,
-                               "tuner_version": None}
+                               "tuner_version": None, "codec": None}
     w.negotiated(524288, 2)
     assert w.negotiation() == {"frame_bytes": 524288, "pipeline_depth": 2,
-                               "tuner_version": None}
-    # the tuner's pick records the model version that chose it (PR 12)
-    w.negotiated(524276, 3, tuner_version=4)
+                               "tuner_version": None, "codec": None}
+    # the tuner's pick records the model version that chose it (PR 12),
+    # and the wire codec in force rides the same gauge (ISSUE 13)
+    w.negotiated(524276, 3, tuner_version=4, codec="int8")
     assert w.negotiation() == {"frame_bytes": 524276,
-                               "pipeline_depth": 3, "tuner_version": 4}
+                               "pipeline_depth": 3, "tuner_version": 4,
+                               "codec": "int8"}
     # gauges, not counters: they never appear in the delta window
     assert "frame_bytes" not in w.delta(w.snapshot())
     w.reset()
     assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0,
-                               "tuner_version": None}
+                               "tuner_version": None, "codec": None}
 
 
 def test_verb_latency_log_buckets():

@@ -164,6 +164,20 @@ COALESCE_FILE = "rocnrdma_tpu/transport/coalesce.py"
 COALESCE_ENTRY_MARKERS = {"_coalesce_entry"}
 COALESCE_ABORT_MARKERS = {"_coalesce_abort"}
 
+# the codec entry surface (ISSUE 13): every wire-facing entry point of
+# ``transport/codec.py`` — the functions collective data actually flows
+# through (encode / decode-and-fold / the EF roundtrips) — must record
+# an ENTRY flight event (``_codec_entry``) and must refuse through the
+# record-and-raise helper (``raise _codec_abort(...)``): a frame that
+# refused to encode (non-finite input) or a header that refused to
+# parse kills a collective, and an unrecorded refusal is invisible to
+# the postmortem exactly where a quantized reduction silently lost a
+# rank's contribution.
+CODEC_FILE = "rocnrdma_tpu/transport/codec.py"
+CODEC_ENTRY_MARKERS = {"_codec_entry"}
+CODEC_ABORT_MARKERS = {"_codec_abort"}
+CODEC_SURFACE = ("encode", "decode_fold", "roundtrip", "ef_update")
+
 ALLOW: dict[str, str] = {}
 
 
@@ -434,6 +448,46 @@ def coalesce_problems(tree: ast.Module, where: str,
     return problems
 
 
+def codec_problems(tree: ast.Module, where: str,
+                   used: set | None = None) -> list[str]:
+    """The codec entry-point invariant: every function named in
+    ``CODEC_SURFACE`` must call ``_codec_entry`` (the timeline entry the
+    encode attribution bucket and the postmortem both key on) and every
+    refusal it raises at its own level must flow through
+    ``raise _codec_abort(...)`` — the record-and-raise shape, so a
+    refused frame lands on the timeline next to the collective it
+    killed."""
+    problems = []
+    for qual, fn, _owner in base.iter_functions(tree):
+        name = qual.rsplit(".", 1)[-1]
+        if name not in CODEC_SURFACE:
+            continue
+        key = f"{os.path.basename(where)}::{qual}"
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        called = _called_names(fn)
+        if not (called & CODEC_ENTRY_MARKERS):
+            problems.append(
+                f"{where}:{fn.lineno}: codec entry point {qual} records "
+                f"no entry flight event (call _codec_entry at entry, or "
+                f"ALLOW it with a reason)")
+        for node in _own_level_nodes(fn):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            if isinstance(node.exc, ast.Call) \
+                    and base.call_name(node.exc) in CODEC_ABORT_MARKERS:
+                continue
+            problems.append(
+                f"{where}:{node.lineno}: codec entry point {qual} "
+                f"raises without recording the abort (refuse via "
+                f"`raise _codec_abort(...)`, or ALLOW with a reason) — "
+                f"an unrecorded codec refusal is invisible exactly "
+                f"where a quantized reduction lost a contribution")
+    return problems
+
+
 def _own_level_nodes(fn: ast.AST):
     """Walk ``fn`` excluding nested function bodies — a nested def's
     span belongs to the nested def, not its parent (``iter_functions``
@@ -534,6 +588,11 @@ def check_coalesce_source(src: str, path: str = "<fixture>") -> list[str]:
     return coalesce_problems(ast.parse(src, filename=path), path)
 
 
+def check_codec_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the codec entry-point invariant alone."""
+    return codec_problems(ast.parse(src, filename=path), path)
+
+
 def run() -> list[str]:
     used: set = set()
     problems = check_tree(base.parse_file(PLUGIN), PLUGIN, used)
@@ -547,6 +606,8 @@ def run() -> list[str]:
     problems += span_problems(base.parse_file(SPAN_FILE), SPAN_FILE, used)
     problems += coalesce_problems(base.parse_file(COALESCE_FILE),
                                   COALESCE_FILE, used)
+    problems += codec_problems(base.parse_file(CODEC_FILE), CODEC_FILE,
+                               used)
     problems += base.allow_reason_problems(ALLOW, NAME)
     problems += base.allow_stale_problems(ALLOW, used, NAME)
     return problems

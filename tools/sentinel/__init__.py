@@ -53,7 +53,8 @@ RESULTS = os.path.join(REPO, "results")
 
 # committed record files whose rows are floor material; each entry
 # names the JSON path and how to pull BenchRecord-shaped rows out
-COMMITTED_FILES = ("coalesce_r01.json", "lanes_r01.json", "tune_r01.json")
+COMMITTED_FILES = ("coalesce_r01.json", "lanes_r01.json", "tune_r01.json",
+                   "tune_r02.json", "codec_r01.json")
 
 # decay thresholds for the between-floors checks: the worst-rank verb
 # P99 may grow to this multiple of its committed twin before it is a
@@ -291,16 +292,59 @@ def check_speedup_floor(current: list[dict],
     return findings
 
 
+def check_codec_floor(current: list[dict],
+                      results_dir: str = RESULTS) -> list[dict]:
+    """The quantized-wire scenario's OWN ratchet (ISSUE 13): a current
+    codec row's best-trial multiple of the committed fp32 floor must
+    stay >= the committed ``codec_min_x`` bar (the acceptance multiple
+    — 1.5x the fp32 tcp floor — not the measured headroom), and its
+    value-space cost must stay inside the committed
+    ``max_abs_err_ceil`` (a codec that got 'faster' by quantizing
+    coarser is a regression wearing a speedup)."""
+    path = os.path.join(results_dir, "codec_r01.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as fp:
+        floors = json.load(fp)["floors"]
+    findings = []
+    gated = floors.get("gated_codec", "int8")
+    for rec in current:
+        co = rec.get("extra", {}).get("codec")
+        if co is None:
+            continue
+        best = co.get("floor_x_best", co.get("floor_x", 0.0))
+        err_ceil = floors.get("max_abs_err_ceil", {}).get(co.get("name"))
+        # the GB/s bar gates the committed wire codec (int8 — the
+        # smoke-gated arm); the fp8 arm is recorded for its error
+        # profile, not its software-conversion speed
+        if co.get("name") == gated and best < floors["codec_min_x"]:
+            findings.append({
+                "key": record_key(rec),
+                "codec_floor_x": best,
+                "floor": floors["codec_min_x"],
+                "trace_diff": None,
+            })
+        if err_ceil is not None and co.get("max_abs_err", 0.0) > err_ceil:
+            findings.append({
+                "key": record_key(rec),
+                "codec_err": co.get("max_abs_err"),
+                "err_ceil": err_ceil,
+                "trace_diff": None,
+            })
+    return findings
+
+
 def check_current(current: list[dict],
                   results_dir: str = RESULTS,
                   ratio: float = 0.8) -> list[dict]:
     """The one-call sentinel pass: the (spread-resolved) row-wise algbw
     ratchet against the committed records, the coalesce speedup floor,
-    and the two between-floors decay checks (wp99 creep, cp-share
-    drift)."""
+    the codec quantized-wire floor, and the two between-floors decay
+    checks (wp99 creep, cp-share drift)."""
     committed = committed_records(results_dir)
     return (compare(current, committed, ratio)
             + check_speedup_floor(current, results_dir)
+            + check_codec_floor(current, results_dir)
             + check_wp99_creep(current, committed)
             + check_cp_share_drift(current, committed))
 
@@ -316,6 +360,15 @@ def format_findings(findings: list[dict]) -> str:
         if "speedup" in f:
             lines.append(f"  {key}: coalesce speedup {f['speedup']}x "
                          f"fell below the committed {f['floor']}x floor")
+        elif "codec_floor_x" in f:
+            lines.append(f"  {key}: quantized-wire best trial at "
+                         f"{f['codec_floor_x']}x the committed fp32 "
+                         f"floor fell below the {f['floor']}x bar")
+        elif "codec_err" in f:
+            lines.append(f"  {key}: codec max-abs-err {f['codec_err']} "
+                         f"exceeds the committed {f['err_ceil']} ceiling "
+                         f"— a speedup bought by coarser quantization "
+                         f"is a regression")
         elif "wp99_us" in f:
             lines.append(f"  {key}: worst-rank verb P99 crept to "
                          f"{f['wp99_us']}us — {f['factor']}x the "
