@@ -80,6 +80,26 @@ SMOKE_COALESCE_SPEEDUP = 2.0
 # the lane knob silently not engaging) trips the gate.
 SMOKE_CODEC_X = 1.5
 
+# hier scenario smoke gates (ISSUE 14): the node-aware two-level
+# schedule on the simulated 2-node x 2-rank mixed topology (4 ranks,
+# group plane tcp as the slow inter-node fabric, shm sub-rings as the
+# intra-node one). SMOKE_HIER_X is the acceptance multiple over the
+# flat tcp ring at 1 MiB — the hierarchy crosses the slow fabric once
+# per shard in parallel instead of 2(n-1) sequential hops, and the
+# COMMITTED record (results/hier_r01.json: 1.48x measured; the
+# sentinel's check_hier_floor ratchets future records at >= 1.3x)
+# carries that capability. The per-run --smoke gate holds the ABSOLUTE
+# recorded hier floor (SMOKE_FLOORS_HIER, standard 0.8x allowance —
+# the same absolute-bar design as the codec gate: on a loaded CI box
+# the two arms' SAME-RUN ratio swings +-30% while the absolute floors
+# hold) plus a schedule-collapse guard at SMOKE_HIER_MIN_X: a hier arm
+# measurably SLOWER than the same-run flat ring means the legs
+# serialized or degraded to the flat path, which no load noise
+# produces.
+SMOKE_HIER_X = 1.3
+SMOKE_HIER_MIN_X = 0.9
+SMOKE_FLOORS_HIER = 0.22
+
 # lanes scenario smoke gate (ISSUE 9): the P99 ceiling (microseconds)
 # for a 64 KiB allreduce on the HIGH-PRIORITY latency lane while a
 # paced bulk allgather saturates the same 2-rank shm ring. Recorded in
@@ -99,6 +119,15 @@ SMOKE_LANES_BULK_GBPS = 0.05
 
 
 def _smoke_args(path: str) -> list:
+    if path == "hier":
+        # the simulated 2-node x 2-rank mixed topology: 4 ranks whose
+        # group plane is tcp (the slow inter-node leg) with shm
+        # sub-rings inside each "node" — flat tcp ring vs the
+        # hierarchical schedule vs hierarchical + per-leg codec, 1 MiB
+        # allreduces, arms seconds apart on one fleet
+        return ["--ranks", "4", "--plane", "tcp", "--transport", "msg",
+                "--sizes", "1M", "--collectives", "hier",
+                "--node-map", "0,0,1,1", "--repeats", "3", "--iters", "4"]
     if path == "codec":
         # 2-rank tcp ring, 1 MiB allreduces: the fp32 wire vs the int8
         # and fp8 codec lanes (error feedback ON) — the gate is the
@@ -539,6 +568,141 @@ def _codec_worker(pg, args) -> list:
     return rows
 
 
+def _hier_worker(pg, args) -> list:
+    """The node-aware hierarchical scenario (ISSUE 14): the first
+    ``--sizes`` entry allreduced over the flat ring of the group's
+    plane, then over the hierarchical schedule (node map from
+    ``--node-map``), then hierarchical with a ``codec="auto"`` lane —
+    per-leg arbitration: the committed models compress ONLY the slow
+    cross-node leg. Same fleet, arms seconds apart so scheduler noise
+    largely cancels. Each hier row records its speedup over the flat
+    arm (mean and best-trial), the bitwise/value-space check against
+    the flat result (inputs are integer-valued floats, so fp32 sums
+    are exact and fold order cannot matter), the auto
+    ``pick_algorithm`` verdict + the model's flat-vs-hier crossover
+    size, and ``floor_x`` against the recorded hier floor."""
+    from rocnrdma_tpu.metrics import VERBS, WIRE
+    from rocnrdma_tpu.transport import tuner as _tuner
+
+    n = pg.world_size
+    size = parse_size(args.sizes.split(",")[0])
+    elems = max(1, size // 4)
+
+    def contrib(rank: int):
+        # integer-valued: the fp32 sum of 4 such arrays is exact, so
+        # the flat and hierarchical results must be BITWISE equal
+        return (np.random.default_rng((rank, 14))
+                .integers(-4096, 4096, elems).astype(np.float32))
+
+    x = contrib(pg.rank)
+    want = contrib(0)
+    for r in range(1, n):
+        want = want + contrib(r)
+    hinfo = pg.hierarchy(timeout_s=60.0)  # build off the timed window
+    intra = _tuner.host_wire_model(pg._intra_plane)
+    inter = getattr(pg._net, "wire_model", None)
+    sizes_scan = [1 << p for p in range(12, 25)]
+    verdicts = {s: _tuner.pick_algorithm(s, pg._hier_node_sizes(),
+                                         flat=inter, intra=intra)
+                for s in sizes_scan}
+    hier_sizes = [s for s, v in verdicts.items() if v == "hier"]
+    crossover = min(hier_sizes) if hier_sizes else None
+    arms = [("ring", pg, "ring"),
+            ("hier", pg, "hier"),
+            ("hier-codec", pg.channel("q-hier", codec="auto"), "hier")]
+    rows = []
+    flat_t = None
+    flat_spread = None
+    for name, surf, algo in arms:
+        surf.all_reduce(x, timeout_s=60.0, algorithm=algo)  # warmup
+        wire_base = WIRE.snapshot()
+        verb_base = VERBS.snapshot()
+        spans = []
+        out = None
+        for _ in range(args.repeats):
+            pg.barrier()
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = surf.all_reduce(x, timeout_s=60.0, algorithm=algo)
+            spans.append((time.perf_counter() - t0) / args.iters)
+        wire = WIRE.delta(wire_base)
+        wire["overlap_ratio"] = round(WIRE.overlap_ratio(since=wire_base), 4)
+        wire.update(WIRE.negotiation())
+        if args.smoke and wire["payload_bytes_copied"]:
+            raise SystemExit(
+                f"smoke gate: rank {pg.rank} staged "
+                f"{wire['payload_bytes_copied']} payload bytes through "
+                f"copies during the hier scenario's {name} arm "
+                f"(want 0): {wire}")
+        mine = trimmed_mean(spans)
+        sec = float(pg.all_reduce(np.array([mine]), op="max")[0])
+        fleet_spans = pg.all_reduce(np.asarray(spans), op="max")
+        spread_gb = sorted(M.algbw_GBps(size, float(s))
+                           for s in fleet_spans)
+        err = float(np.abs(out - want).max())
+        err = float(pg.all_reduce(np.array([err]), op="max")[0])
+        bitwise = bool(np.array_equal(out, want))
+        bitwise = bool(pg.all_reduce(
+            np.array([int(bitwise)]), op="min")[0])
+        pg.publish_telemetry()
+        pg.barrier()
+        if pg.rank != 0:
+            continue
+        fl = pg.fleet_stats()
+        fleet = {k: fl[k] for k in
+                 ("epoch", "health", "missing", "stale_dropped",
+                  "worst_p99_us", "verb_p50_us", "verb_p99_us",
+                  "verb_latency", "wire_totals")}
+        algbw = M.algbw_GBps(size, sec)
+        extra = dict(iters=args.iters, repeats=args.repeats,
+                     spread=[round(spread_gb[0], 4),
+                             round(spread_gb[-1], 4)],
+                     wire=wire, verb_lat=VERBS.delta(verb_base),
+                     fleet=fleet,
+                     trace=_trace_summary(pg, "allreduce"
+                                          if name == "ring"
+                                          else "hierallreduce"))
+        if name == "ring":
+            flat_t = sec
+            flat_spread = spread_gb
+        else:
+            extra["hier"] = {
+                "speedup": round(flat_t / sec, 3) if flat_t else None,
+                # best-trial speedup: the hier arm's best trial over
+                # the flat arm's best (same-percentile comparison —
+                # the smoke bar, so one noisy flat trial cannot gift
+                # the gate a pass)
+                "speedup_best": round(spread_gb[-1] / flat_spread[-1], 3)
+                if flat_spread and flat_spread[-1] else None,
+                "bitwise_ok": bitwise if name == "hier" else None,
+                "max_abs_err": round(err, 6),
+                "hier_ops": int(wire.get("hier_ops", 0)),
+                "verdict": verdicts.get(size,
+                                        _tuner.pick_algorithm(
+                                            size, pg._hier_node_sizes(),
+                                            flat=inter, intra=intra)),
+                "crossover_bytes": crossover,
+                "floor_GBps": SMOKE_FLOORS_HIER,
+                "floor_x": round(algbw / SMOKE_FLOORS_HIER, 3),
+                "floor_x_best": round(spread_gb[-1] / SMOKE_FLOORS_HIER,
+                                      3),
+                "topology": {"nodes": hinfo["nodes"],
+                             "leaders": hinfo["leaders"],
+                             "uniform": hinfo["uniform"],
+                             "intra_plane": hinfo["intra_plane"],
+                             "inter_plane": hinfo["inter_plane"]},
+            }
+            if name == "hier-codec":
+                extra["hier"]["frames_encoded"] = \
+                    int(wire.get("frames_encoded", 0))
+                extra["hier"]["bytes_saved"] = \
+                    int(wire.get("payload_bytes_saved", 0))
+        rows.append(M.BenchRecord.measure(
+            "bench_host", "allreduce", name, n, size, "float32", sec,
+            platform=f"host-{args.plane}", **extra))
+    return rows
+
+
 def _trace_summary(pg, collective: str) -> dict:
     """The causal tracer's condensed verdict for one bench row: the
     SLOWEST assembled sampled op matching this collective — its wall
@@ -589,7 +753,9 @@ def worker(args) -> int:
     from rocnrdma_tpu import distributed as dist
     from rocnrdma_tpu.metrics import VERBS, WIRE
 
-    pg = dist.init_process_group(plane=args.plane)
+    node_of = ([int(v) for v in args.node_map.split(",")]
+               if args.node_map else None)
+    pg = dist.init_process_group(plane=args.plane, node_of=node_of)
     # the fleet telemetry agent rides the watchdog heartbeat — ON for
     # every bench fleet, the smoke runs included: the per-rank zero-copy
     # gate below then doubles as proof that the agent adds nothing to
@@ -597,13 +763,15 @@ def worker(args) -> int:
     # the watchdog thread)
     pg.start_watchdog()
     rng = np.random.default_rng(pg.rank)
-    if args.collectives in ("lanes", "coalesce", "codec"):
-        # the multi-tenant, many-small-ops, and quantized-wire
-        # scenarios have their own loop shapes
+    if args.collectives in ("lanes", "coalesce", "codec", "hier"):
+        # the multi-tenant, many-small-ops, quantized-wire, and
+        # hierarchical scenarios have their own loop shapes
         records = (_lanes_worker(pg, args) if args.collectives == "lanes"
                    else _coalesce_worker(pg, args)
                    if args.collectives == "coalesce"
-                   else _codec_worker(pg, args))
+                   else _codec_worker(pg, args)
+                   if args.collectives == "codec"
+                   else _hier_worker(pg, args))
         pg.barrier()
         pg.destroy()
         for rec in records:  # only rank 0 holds any
@@ -750,6 +918,12 @@ def main(argv=None) -> int:
     p.add_argument("--bucket-size", default="4M",
                    help="coalesce scenario: the lane's bucket_bytes "
                         "flush knob (the tuner-pickable coalescer size)")
+    p.add_argument("--node-map", default=None,
+                   help="hier scenario / any run: comma list mapping "
+                        "rank r to its NODE id (init_process_group's "
+                        "node_of) — e.g. 0,0,1,1 simulates a 2-node x "
+                        "2-rank split whose intra-node legs ride shm "
+                        "and whose cross-node legs ride --plane")
     p.add_argument("--out", default=None, help="JSONL output path")
     p.add_argument("--sweep", action="store_true",
                    help="emit the wire-model fit corpus for --plane "
@@ -777,8 +951,10 @@ def main(argv=None) -> int:
                    help="tier-1 perf gate: 2-rank 1 MiB allreduce on the "
                         "shm, tcp, AND rdma (put-based ring) paths plus "
                         "the lanes QoS scenario, the coalesce "
-                        "many-small-ops scenario, and the codec "
-                        "quantized-wire scenario; asserts ZERO steady-"
+                        "many-small-ops scenario, the codec "
+                        "quantized-wire scenario, and the hier "
+                        "node-aware scenario (simulated 2-node x "
+                        "2-rank mixed shm/tcp fleet); asserts ZERO steady-"
                         "path payload copies on every rank of every "
                         "fleet, algbw >= 0.8x each path's recorded "
                         f"floor ({SMOKE_FLOORS}), the latency "
@@ -790,6 +966,11 @@ def main(argv=None) -> int:
                         "floor with error feedback ON")
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
+
+    if args.collectives == "hier" and not args.node_map:
+        p.error("--collectives hier needs --node-map (e.g. 0,0,1,1: "
+                "the simulated node split whose intra-node legs ride "
+                "shm and whose cross-node legs ride --plane)")
 
     if args.worker:
         return worker(args)
@@ -814,14 +995,15 @@ def main(argv=None) -> int:
                                 "--sizes", "--collectives", "--repeats",
                                 "--iters", "--lat-iters", "--bulk-size",
                                 "--bulk-rounds", "--small-ops",
-                                "--bucket-size"})
+                                "--bucket-size", "--node-map"})
         if clash:
             p.error(f"--smoke runs the fixed recorded configs "
                     f"({' '.join(SMOKE_ARGS)}, then the tcp, rdma, and "
                     f"lanes twins); drop {'/'.join(clash)} or run a "
                     f"plain bench instead")
         records, failures = [], []
-        for path in ("shm", "tcp", "rdma", "lanes", "coalesce", "codec"):
+        for path in ("shm", "tcp", "rdma", "lanes", "coalesce", "codec",
+                     "hier"):
             # each path is its own fleet: per-rank copy gates run inside
             # the workers, the throughput gate against the path's floor
             # runs here. ALL paths measure (and their records persist)
@@ -833,6 +1015,69 @@ def main(argv=None) -> int:
             records.extend(recs)
             rec = recs[-1]  # coalesce: [unbatched, coalesced] — gate the
             #                 coalesced row (it carries the speedup)
+            if path == "hier":
+                # the node-aware gate (ISSUE 14): rows are [flat ring,
+                # hier, hier + per-leg codec] on ONE mixed 2x2 fleet.
+                # The hier arm must (a) have genuinely run the
+                # two-level schedule with the verdict pinned on the
+                # negotiation gauge and tuning ON, (b) beat the
+                # same-run flat tcp ring by the recorded multiple,
+                # (c) hold the absolute recorded floor, bitwise; the
+                # codec arm must prove the CROSS leg compressed.
+                rec = recs[1]
+                ex = rec.extra.get("hier", {})
+                wire = rec.extra.get("wire", {})
+                cod = recs[2].extra.get("hier", {})
+                if wire.get("algorithm") != "hier" \
+                        or not wire.get("hier_ops"):
+                    failures.append(
+                        f"smoke gate [hier]: the hierarchical schedule "
+                        f"did not engage (algorithm="
+                        f"{wire.get('algorithm')}, hier_ops="
+                        f"{wire.get('hier_ops')}) — the gate proved "
+                        f"nothing about the node-aware path")
+                elif wire.get("tuner_version") is None:
+                    failures.append(
+                        f"smoke gate [hier]: auto-tuning was not active "
+                        f"on the hier arm (no tuner_version) — the "
+                        f"floor was not measured with model picks "
+                        f"(wire={wire})")
+                elif not ex.get("bitwise_ok"):
+                    failures.append(
+                        f"smoke gate [hier]: the hierarchical result "
+                        f"was NOT bitwise-equal to the exact oracle "
+                        f"(extra={ex})")
+                elif ex.get("speedup_best", 0.0) < SMOKE_HIER_MIN_X:
+                    failures.append(
+                        f"smoke gate [hier]: hierarchical allreduce is "
+                        f"only {ex.get('speedup')}x the same-run flat "
+                        f"ring ({ex.get('speedup_best')}x best trial "
+                        f"< {SMOKE_HIER_MIN_X}x) — hier measurably "
+                        f"SLOWER than flat means the legs serialized "
+                        f"or degraded to the flat path (extra={ex})")
+                elif rec.algbw_GBps < 0.8 * SMOKE_FLOORS_HIER:
+                    failures.append(
+                        f"smoke gate [hier]: {rec.algbw_GBps:.3f} GB/s "
+                        f"is below 0.8x the recorded hier floor "
+                        f"({SMOKE_FLOORS_HIER} GB/s) (extra={ex})")
+                elif not cod.get("frames_encoded"):
+                    failures.append(
+                        f"smoke gate [hier]: the codec arm encoded no "
+                        f"frames — the per-leg arbitration did not "
+                        f"compress the cross-node leg (extra={cod})")
+                else:
+                    print(f"smoke gate ok [hier]: hierarchical "
+                          f"{rec.algbw_GBps:.3f} GB/s >= "
+                          f"{0.8 * SMOKE_FLOORS_HIER:.3f} "
+                          f"({ex['speedup']}x same-run flat; the "
+                          f"committed record holds the "
+                          f">= {SMOKE_HIER_X}x capability bar; "
+                          f"verdict {ex['verdict']}, crossover "
+                          f"{ex['crossover_bytes']} B), bitwise oracle "
+                          f"held, per-leg codec saved "
+                          f"{cod.get('bytes_saved')} B on the cross "
+                          f"leg, zero steady-path copies")
+                continue
             if path == "codec":
                 # the quantized-wire gate: the int8 arm (row 2 of
                 # [fp32, int8, fp8]) must beat the committed fp32 tcp
@@ -1123,6 +1368,7 @@ def _run_fleet(args, extra_env: dict | None = None) -> list:
            "--bulk-rounds", str(args.bulk_rounds),
            "--small-ops", str(args.small_ops),
            "--bucket-size", args.bucket_size] \
+        + (["--node-map", args.node_map] if args.node_map else []) \
         + (["--smoke"] if args.smoke else [])
     procs = []
     try:

@@ -140,6 +140,321 @@ def _reshard_scatter(pg, args, kw, prev):
     return (x,), kw
 
 
+# ---------------------------------------------------------------------------
+# The node-aware hierarchical host plane (ISSUE 14, DESIGN.md §5l).
+#
+# A node map (explicit ``node_of`` at init_process_group, store-published
+# and agreed) splits the group into per-node sub-rings over the fast
+# intra-node plane (shm by default) plus cross-node rings over the slow
+# plane the group was built on. The allreduce schedule is the classic
+# two-level decomposition: node-local reduce-scatter -> cross-node
+# allreduce -> node-local allgather. When every node has the SAME size
+# the cross-node phase is SHARD-PARALLEL — local rank j of every node
+# forms one inter-node ring carrying only shard j, so the slow legs run
+# concurrently in separate processes and each moves 1/ln of the buffer.
+# When heal leaves the nodes unequal (a shrunk node), the schedule
+# degrades to the leader relay: chain-reduce the whole buffer onto each
+# node's leader (the lowest surviving ORIGINAL rank — re-election is
+# exactly "rebuild from the healed member list"), leaders ring the full
+# buffer, chain-broadcast back out. Every leg is an existing ring
+# collective riding the ``_RingWire.stream`` frame engine, so lanes,
+# QoS credits, wire codecs, tracing spans, and the epoch fence apply
+# unchanged per leg — and because each leg resolves its codec from ITS
+# net's committed wire model, a lane opened with ``codec="auto"``
+# compresses ONLY the slow cross-node hop (the PR-13 per-leg
+# arbitration) while the shm legs stay fp32.
+# ---------------------------------------------------------------------------
+
+# joiners admitted past the agreed node map get SINGLETON nodes keyed
+# safely above any user node id (original ranks are bounded by the
+# orig high-water mark, far below this)
+_JOINER_NODE_BASE = 1 << 40
+
+
+class _Hier:
+    """One built generation of the hierarchy: the per-leg nets/wires of
+    this rank for (epoch, membership). Torn down and rebuilt from the
+    CURRENT member list whenever the epoch moves (heal/grow/promotion)
+    — which is the whole repair story: a dead node leader re-elects by
+    lowest surviving original rank simply because leaders are a pure
+    function of the healed membership."""
+
+    __slots__ = ("epoch", "gen", "nodes", "node_idx", "n_nodes",
+                 "local_rank", "local_n", "uniform", "is_leader",
+                 "local_net", "local_send", "local_recv", "local_client",
+                 "inter_net", "inter_send", "inter_recv", "inter_client")
+
+    def __init__(self, epoch, nodes, node_idx, local_rank, uniform):
+        self.epoch = epoch
+        self.gen = 0                    # rendezvous generation (see _hier_build)
+        self.nodes = nodes              # [(node_id, [orig ranks asc])...]
+        self.node_idx = node_idx
+        self.n_nodes = len(nodes)
+        self.local_rank = local_rank
+        self.local_n = len(nodes[node_idx][1])
+        self.uniform = uniform
+        self.is_leader = local_rank == 0
+        self.local_net = self.local_send = self.local_recv = None
+        self.local_client = None
+        self.inter_net = self.inter_send = self.inter_recv = None
+        self.inter_client = None
+
+    @property
+    def cross_wired(self) -> bool:
+        """Whether this rank participates in a cross-node ring (every
+        rank on the uniform fast path; leaders only on the relay
+        path)."""
+        return self.inter_send is not None
+
+    def mirror_lane(self, lane) -> None:
+        """Open ``lane`` on every sub-net (idempotent): each net
+        resolves lanes from its own registry, and a lane's QoS knobs
+        must mean the same thing on every leg. The CODEC knob is the
+        per-leg exception — it binds to the CROSS leg only (the slow
+        fabric it exists for, ``codec="auto"``'s arbitrated verdict
+        made structural): an intra leg honoring an explicit codec
+        would quantize the node-local RS partial sums with NO error
+        feedback anywhere (the flat path's input-stage EF is the
+        group wire's, and the HIER_XLEG residual covers only the
+        cross shard), silently degrading convergence. Every rank
+        mirrors identically, so both ends of each leg still agree."""
+        for net, codec in ((self.local_net, None),
+                           (self.inter_net, lane.codec)):
+            if net is not None and lane.id != 0:
+                net.open_lane(lane.name, priority=lane.priority,
+                              credit_bytes=lane.credit_bytes,
+                              codec=codec)
+
+    def close(self) -> None:
+        """Best-effort teardown (heal-path discipline: a peer may be
+        the dead rank; closing cannot make it worse than closed)."""
+        for client in (self.local_client, self.inter_client):
+            if client is not None:
+                try:
+                    client.close()
+                except (OSError, TimeoutError):
+                    pass
+        for net in (self.local_net, self.inter_net):
+            if net is not None:
+                try:
+                    net.close()
+                except (OSError, TimeoutError):
+                    pass
+
+
+def _hier_bounds(size: int, parts: int) -> list:
+    """The ONE shard layout of the hierarchical schedule: floor-balanced
+    element bounds over ``parts`` — identical on every rank of every
+    node (the same formula as the flat ring chunks), which is what lets
+    local rank j's cross-node ring carry exactly the j-th shard of
+    every node's partial sum."""
+    return [size * i // parts for i in range(parts + 1)]
+
+
+def hier_allreduce(pg, h: _Hier, x: np.ndarray, op: str = "sum",
+                   timeout_s: float = 30.0) -> np.ndarray:
+    """The node-aware allreduce schedule over a built :class:`_Hier`
+    (see the section comment): local reduce-scatter (leg 1) ->
+    cross-node allreduce (leg 2, shard-parallel when uniform, leaders'
+    full buffer otherwise) -> local allgather (leg 3). Sum reductions
+    on a codec-bearing lane feed the cross leg's re-encode error into
+    the group's ResidualStore (the RS-phase partial-sum error feedback
+    — ``transport.codec.HIER_XLEG_VERB``), committed only when the
+    whole schedule commits. Raises named on any leg failure with a
+    ``hier-abort`` flight event, tearing the hierarchy down so the
+    healed retry rebuilds it from the new membership."""
+    from rocnrdma_tpu.transport import codec as _codec_mod
+    x = np.asarray(x)
+    shape = np.shape(x)
+    flat = x.ravel()
+    try:
+        # leg 1: node-local reduce-scatter over the intra-node plane
+        if h.local_n > 1:
+            with _trace.leg(1):
+                if h.uniform:
+                    shard = plugin.ring_reduce_scatter_over_net(
+                        h.local_net, h.local_send, h.local_recv, flat,
+                        h.local_rank, h.local_n, op=op,
+                        timeout_s=timeout_s)
+                else:
+                    shard = plugin.ring_chain_reduce_over_net(
+                        h.local_net, h.local_send, h.local_recv, flat,
+                        h.local_rank, h.local_n, op=op,
+                        timeout_s=timeout_s)
+        else:
+            shard = np.array(flat, copy=True)
+        # leg 2: cross-node allreduce of this rank's shard (uniform:
+        # every local index's ring runs concurrently; relay: leaders
+        # carry the whole node sum). The RS-phase partial sum meets
+        # the wire codec HERE — its re-encode error is fed back.
+        commit_residual = None
+        if h.cross_wired and h.n_nodes > 1 and shard.size:
+            shard_wire = shard
+            if op == "sum":
+                shard_wire, commit_residual = pg._codec_feedback(
+                    _codec_mod.HIER_XLEG_VERB, shard, op, "msg",
+                    net=h.inter_net, world=h.n_nodes)
+            with _trace.leg(2):
+                shard = plugin.ring_allreduce_over_net(
+                    h.inter_net, h.inter_send, h.inter_recv, shard_wire,
+                    h.node_idx, h.n_nodes, op=op, timeout_s=timeout_s)
+        # leg 3: node-local allgather of the globally-reduced shards
+        if h.local_n > 1:
+            with _trace.leg(3):
+                if h.uniform:
+                    bounds = _hier_bounds(flat.size, h.local_n)
+                    counts = [bounds[i + 1] - bounds[i]
+                              for i in range(h.local_n)]
+                    segs = plugin.ring_allgatherv_over_net(
+                        h.local_net, h.local_send, h.local_recv,
+                        shard.ravel(), counts, h.local_rank, h.local_n,
+                        timeout_s=timeout_s)
+                    out = np.concatenate([np.asarray(s).ravel()
+                                          for s in segs])
+                else:
+                    out = plugin.ring_chain_bcast_over_net(
+                        h.local_net, h.local_send, h.local_recv,
+                        shard.ravel() if h.is_leader else flat,
+                        h.local_rank, h.local_n, timeout_s=timeout_s)
+        else:
+            out = shard.ravel()
+        if commit_residual is not None:
+            commit_residual()
+        _WIRE.hier()
+        return out.reshape(shape)
+    except (TimeoutError, OSError, RuntimeError) as e:
+        # record-and-reraise (the analyzer's hier abort rule): the
+        # failed leg's story must reach the postmortem, and the
+        # hierarchy tears down so the healed retry rebuilds it from
+        # the post-heal membership (a dead leader re-elects here)
+        _FLIGHT.record("hier-abort", epoch=pg.epoch, verb="allreduce",
+                       error=type(e).__name__)
+        pg._hier_burn(h)
+        pg._hier_invalidate()
+        raise
+
+
+def hier_reduce_scatter(pg, h: _Hier, x: np.ndarray, rank: int, n: int,
+                        op: str = "sum",
+                        timeout_s: float = 30.0) -> np.ndarray:
+    """Node-aware reduce-scatter: the hierarchical allreduce schedule
+    followed by the flat verb's floor-balanced slice for ``rank`` (the
+    shm allgather leg re-distributes the full buffer, which on the
+    fast intra-node plane costs less than the cross-node bytes the
+    hierarchy saves; a slice-early variant is a follow-on). Abort
+    semantics as :func:`hier_allreduce`; the handler here names THIS
+    verb on the timeline next to the inner leg's record."""
+    try:
+        total = hier_allreduce(pg, h, x, op=op, timeout_s=timeout_s)
+    except (TimeoutError, OSError, RuntimeError) as e:
+        _FLIGHT.record("hier-abort", epoch=pg.epoch,
+                       verb="reduce_scatter", error=type(e).__name__)
+        raise
+    flat = total.ravel()
+    bounds = _hier_bounds(flat.size, n)
+    return np.array(flat[bounds[rank]:bounds[rank + 1]], copy=True)
+
+
+def hier_allgather(pg, h: _Hier, x: np.ndarray,
+                   timeout_s: float = 30.0) -> np.ndarray:
+    """Node-aware allgather: node-local allgather over shm (leg 1),
+    cross-node exchange of the node blocks (leg 2), then a pure-index
+    reorder into GLOBAL current-rank row order (node blocks
+    concatenate in node order, which interleaved node maps do not
+    share with rank order). On the uniform fast path each per-index
+    cross ring carries only ITS floor-balanced SHARD of the node block
+    (the rings run concurrently, so the slow fabric moves each node's
+    block exactly once in total — every ring carrying the whole block
+    would duplicate the cross-node bytes local_n times) and a second
+    local allgather (leg 3) reassembles the shards; the unequal-node
+    path runs the leaders' ragged allgatherv + chain broadcast."""
+    x = np.asarray(x)
+    row = np.shape(x)
+    try:
+        n = sum(len(mem) for _, mem in h.nodes)
+        # leg 1: the node block (local_n rows, local-rank order)
+        if h.local_n > 1:
+            with _trace.leg(1):
+                block = plugin.ring_allgather_over_net(
+                    h.local_net, h.local_send, h.local_recv, x,
+                    h.local_rank, h.local_n, timeout_s=timeout_s)
+        else:
+            block = np.asarray(x)[None]
+        # leg 2: node blocks cross nodes
+        if h.n_nodes > 1:
+            if h.uniform:
+                bf = np.ascontiguousarray(block).ravel()
+                b = _hier_bounds(bf.size, h.local_n)
+                shard = np.ascontiguousarray(
+                    bf[b[h.local_rank]:b[h.local_rank + 1]])
+                with _trace.leg(2):
+                    # (n_nodes, shard) in node order — shard sizes are
+                    # identical across a ring (same local index, equal
+                    # blocks), so the dense verb carries it
+                    pieces = plugin.ring_allgather_over_net(
+                        h.inter_net, h.inter_send, h.inter_recv, shard,
+                        h.node_idx, h.n_nodes, timeout_s=timeout_s)
+                if h.local_n > 1:
+                    counts = [h.n_nodes * (b[i + 1] - b[i])
+                              for i in range(h.local_n)]
+                    with _trace.leg(3):
+                        segs = plugin.ring_allgatherv_over_net(
+                            h.local_net, h.local_send, h.local_recv,
+                            np.ascontiguousarray(pieces).ravel(),
+                            counts, h.local_rank, h.local_n,
+                            timeout_s=timeout_s)
+                    # segs[i] is node-major (n_nodes, shard_i):
+                    # reassemble each node's block from its shards
+                    rows_flat = np.empty(h.n_nodes * bf.size, bf.dtype)
+                    for i in range(h.local_n):
+                        piece = np.asarray(segs[i]).reshape(
+                            h.n_nodes, -1)
+                        for k in range(h.n_nodes):
+                            rows_flat[k * bf.size + b[i]:
+                                      k * bf.size + b[i + 1]] = piece[k]
+                    rows = rows_flat.reshape((n,) + tuple(row))
+                else:
+                    rows = np.asarray(pieces).reshape((n,) + tuple(row))
+            else:
+                counts = [len(mem) * int(np.prod(row, dtype=np.int64))
+                          for _, mem in h.nodes]
+                if h.cross_wired:
+                    with _trace.leg(2):
+                        segs = plugin.ring_allgatherv_over_net(
+                            h.inter_net, h.inter_send, h.inter_recv,
+                            block.ravel(), counts, h.node_idx,
+                            h.n_nodes, timeout_s=timeout_s)
+                    rows = np.concatenate(
+                        [np.asarray(s).ravel() for s in segs])
+                else:
+                    rows = np.empty(n * int(np.prod(row, dtype=np.int64)),
+                                    dtype=np.asarray(x).dtype)
+                # leg 3 (relay only): leaders broadcast the assembled
+                # node-order rows to their node
+                if h.local_n > 1:
+                    with _trace.leg(3):
+                        rows = plugin.ring_chain_bcast_over_net(
+                            h.local_net, h.local_send, h.local_recv,
+                            np.asarray(rows).ravel(), h.local_rank,
+                            h.local_n, timeout_s=timeout_s)
+                rows = np.asarray(rows).reshape((n,) + tuple(row))
+        else:
+            rows = block
+        # node-order -> global current-rank order (pure index math)
+        members = [g for _, mem in h.nodes for g in mem]
+        out = np.empty_like(rows)
+        for i, g in enumerate(members):
+            out[pg._ranks.index(g)] = rows[i]
+        _WIRE.hier()
+        return out
+    except (TimeoutError, OSError, RuntimeError) as e:
+        _FLIGHT.record("hier-abort", epoch=pg.epoch, verb="allgather",
+                       error=type(e).__name__)
+        pg._hier_burn(h)
+        pg._hier_invalidate()
+        raise
+
+
 class P2PHandle:
     """An in-flight :meth:`ProcessGroup.isend`/:meth:`~ProcessGroup.irecv`
     (the torch ``Work``/request handle). ``wait()`` blocks to completion
@@ -228,19 +543,25 @@ class ChannelHandle:
         return out
 
     def all_reduce(self, x, op: str = "sum", transport: str = "msg",
-                   timeout_s: float | None = None) -> np.ndarray:
+                   timeout_s: float | None = None,
+                   algorithm: str | None = None) -> np.ndarray:
         return self._run("all_reduce", lambda: self._pg.all_reduce(
-            x, op=op, transport=transport, timeout_s=timeout_s))
+            x, op=op, transport=transport, timeout_s=timeout_s,
+            algorithm=algorithm))
 
     def reduce_scatter(self, x, op: str = "sum", transport: str = "msg",
-                       timeout_s: float | None = None) -> np.ndarray:
+                       timeout_s: float | None = None,
+                       algorithm: str | None = None) -> np.ndarray:
         return self._run("reduce_scatter", lambda: self._pg.reduce_scatter(
-            x, op=op, transport=transport, timeout_s=timeout_s))
+            x, op=op, transport=transport, timeout_s=timeout_s,
+            algorithm=algorithm))
 
     def all_gather(self, x, transport: str = "msg",
-                   timeout_s: float | None = None) -> np.ndarray:
+                   timeout_s: float | None = None,
+                   algorithm: str | None = None) -> np.ndarray:
         return self._run("all_gather", lambda: self._pg.all_gather(
-            x, transport=transport, timeout_s=timeout_s))
+            x, transport=transport, timeout_s=timeout_s,
+            algorithm=algorithm))
 
     def broadcast(self, x, src: int = 0,
                   timeout_s: float | None = None) -> np.ndarray:
@@ -383,7 +704,8 @@ class ProcessGroup:
                  server: "bootstrap.BootstrapServer | None",
                  timeout_s: float = 30.0, group_name: str = "default",
                  plane: str = "tcp", fault_schedule=None,
-                 self_heal: bool = False, standby: str | None = None):
+                 self_heal: bool = False, standby: str | None = None,
+                 node_of=None, intra_plane: str = "shm"):
         self.rank = rank
         self.world_size = world_size
         self.group_name = group_name
@@ -449,6 +771,20 @@ class ProcessGroup:
         self._sid = None            # standby slot id in the store registry
         self._standby_listener = None
         self._server = server  # only rank 0 (or an external sidecar) owns one
+        # the node-aware hierarchy (ISSUE 14, DESIGN.md §5l): the agreed
+        # ORIGINAL-rank -> node-id map (None = flat-only group), the
+        # intra-node plane its local sub-rings ride, and the lazily
+        # built per-epoch _Hier (one build lock — concurrent lanes'
+        # first hierarchical collectives must share one rendezvous)
+        self._node_of = None
+        if intra_plane not in _PLANES:
+            raise ValueError(f"unknown intra_plane {intra_plane!r}; "
+                             f"know {sorted(_PLANES)}")
+        self._intra_plane = intra_plane
+        self._hier: "_Hier | None" = None
+        self._hier_lock = threading.Lock()
+        self._hier_stale = False       # deferred-invalidate marker
+        self._hier_sizes = None        # (epoch, node-sizes tuple) cache
         if plane not in _PLANES:
             raise ValueError(f"unknown plane {plane!r}; know {sorted(_PLANES)}")
         self._net = _PLANES[plane]()
@@ -481,6 +817,38 @@ class ProcessGroup:
                     ns=f"pg/{group_name}/ring")
             else:
                 self._send = self._recv = self._client = None
+            if node_of is not None and standby is None:
+                # node-map agreement: every member publishes its
+                # topology set-if-absent (first writer wins) and
+                # VERIFIES the winner matches its own — a rank holding
+                # a different topology than the group agreed on would
+                # wire sub-rings nobody else joins, so the mismatch
+                # refuses HERE, named, not as a rendezvous timeout
+                # later. The intra plane is PART of the agreed
+                # topology: the algorithm pick prices intra legs on
+                # its model, and a rank pricing them on a different
+                # plane could resolve a split flat-vs-hier verdict for
+                # the same collective (the exact hazard this check
+                # exists to refuse). Standbys pass no map; they read
+                # the published one at promotion (_node_map).
+                import json as _json
+                nm = [int(v) for v in node_of]
+                if len(nm) != world_size:
+                    raise ValueError(
+                        f"node_of must map every rank: got {len(nm)} "
+                        f"entries for world_size {world_size}")
+                mine = {"node_of": nm, "intra_plane": intra_plane}
+                if self._client is not None:
+                    winner = _json.loads(self._client.set_if_absent(
+                        f"pg/{group_name}/nodemap",
+                        _json.dumps(mine, sort_keys=True)))
+                    if winner != mine:
+                        raise ValueError(
+                            f"node map disagreement: rank {rank} passed "
+                            f"{mine} but the group agreed on {winner} — "
+                            f"every rank must pass the same node_of and "
+                            f"intra_plane")
+                self._node_of = nm
         except BaseException as e:
             # a failed rendezvous must not leak the net plane (or, via
             # init_process_group, rank 0's master-port listener), nor a
@@ -732,7 +1100,8 @@ class ProcessGroup:
         self.heal(timeout_s=timeout_s, _suspects=suspects)
 
     def all_reduce(self, x, op: str = "sum", transport: str = "msg",
-                   timeout_s: float | None = None) -> np.ndarray:
+                   timeout_s: float | None = None,
+                   algorithm: str | None = None) -> np.ndarray:
         """Elementwise reduction across ranks (op: sum/prod/max/min/avg);
         every rank gets the result, shape preserved. ``transport``:
         ``"msg"`` (two-sided send/recv ring) or ``"rdma"`` (one-sided
@@ -745,12 +1114,28 @@ class ProcessGroup:
         carried residual folds into this round's input, the
         quantization-committed value rides the wire, and the new
         residual commits only when the collective does (DESIGN.md
-        §5k)."""
+        §5k).
+
+        ``algorithm`` (ISSUE 14): ``"ring"`` — the flat ring over the
+        group's plane — or ``"hier"`` — the node-aware two-level
+        schedule (local reduce-scatter over the intra-node plane,
+        cross-node allreduce, local allgather; needs a ``node_of`` map
+        at init). None (default) lets the committed wire models pick
+        (``tuner.pick_algorithm``) on node-mapped groups and keeps the
+        flat ring otherwise; the verdict lands on the negotiation
+        gauge either way."""
         x = np.asarray(x)
         _check_transport(transport)  # validate even at world size 1
         wire_op = self._avg_wire_op(x, op, "all_reduce")
         if self.world_size == 1:
             return x.copy()
+        if self._pick_wire_algorithm(x, transport, algorithm) == "hier":
+            # the hierarchical schedule runs its OWN error feedback on
+            # the cross-node leg (the partial sum is what quantizes) —
+            # the flat input-stage EF deliberately does not run
+            out = self._ring(self._hier_fn("allreduce"), x, op=wire_op,
+                             timeout_s=timeout_s)
+            return self._avg_finalize(out, x, op)
         fn = (plugin.ring_allreduce_rdma if transport == "rdma"
               else plugin.ring_allreduce_over_net)
         x_wire, commit_residual = self._codec_feedback(
@@ -761,17 +1146,25 @@ class ProcessGroup:
         return self._avg_finalize(out, x, op)
 
     def reduce_scatter(self, x, op: str = "sum", transport: str = "msg",
-                       timeout_s: float | None = None) -> np.ndarray:
+                       timeout_s: float | None = None,
+                       algorithm: str | None = None) -> np.ndarray:
         """Reduce across ranks (op: sum/prod/max/min/avg); rank r keeps the
         r-th of n floor-balanced element ranges of the flattened buffer.
         ``transport``: ``"msg"`` (send/recv ring) or ``"rdma"`` (one-sided
         put-based ring, as in :meth:`all_reduce`). Quantized-lane sum
-        reductions run under error feedback like :meth:`all_reduce`."""
+        reductions run under error feedback like :meth:`all_reduce`;
+        ``algorithm`` picks flat-vs-hierarchical like
+        :meth:`all_reduce` too."""
         x = np.asarray(x)
         _check_transport(transport)
         wire_op = self._avg_wire_op(x, op, "reduce_scatter")
         if self.world_size == 1:
             return x.ravel().copy()
+        if self._pick_wire_algorithm(x, transport, algorithm,
+                                     verb="reduce_scatter") == "hier":
+            out = self._ring(self._hier_fn("reducescatter"), x,
+                             op=wire_op, timeout_s=timeout_s)
+            return self._avg_finalize(out, x, op)
         fn = (plugin.ring_reduce_scatter_rdma if transport == "rdma"
               else plugin.ring_reduce_scatter_over_net)
         x_wire, commit_residual = self._codec_feedback(
@@ -782,7 +1175,8 @@ class ProcessGroup:
         return self._avg_finalize(out, x, op)
 
     def _codec_feedback(self, verb: str, x: np.ndarray, wire_op: str,
-                        transport: str):
+                        transport: str, net=None,
+                        world: int | None = None):
         """The error-feedback entry of the quantized reducing verbs:
         ``(x_wire, commit)`` — the value to put on the wire and the
         residual-commit callback to run AFTER the collective commits
@@ -799,28 +1193,38 @@ class ProcessGroup:
         resets deterministically — lives in the store
         (``transport.codec.ResidualStore``). An aborted attempt never
         commits, so heal-and-retry is exactly-once for the residual
-        too (the retry re-reads the same ``x_wire``)."""
+        too (the retry re-reads the same ``x_wire``).
+
+        ``net``/``world`` (ISSUE 14): the hierarchical schedule's
+        cross-node leg runs the SAME feedback against the inter-node
+        sub-net's committed model and ring size (verb
+        ``codec.HIER_XLEG_VERB`` — the RS-phase partial sum is what
+        quantizes there); default is the group's own net and world."""
+        from rocnrdma_tpu.transport import codec as _codec
+        net = self._net if net is None else net
+        n = self.world_size if world is None else int(world)
+        allreduce_shaped = verb in ("all_reduce", _codec.HIER_XLEG_VERB)
         if transport != "msg" or wire_op != "sum":
             return x, None
-        reg = getattr(self._net, "lanes", None)
+        reg = getattr(net, "lanes", None)
         chan = _lanes.current_channel()
         lane = reg.get(chan) if reg is not None else None
         name = lane.codec if lane is not None else None
         if name is None:
             return x, None
-        from rocnrdma_tpu.transport import codec as _codec
         if not _codec.WireCodec.supports(x.dtype):
             return x, None
         if name == "auto":
             # THE pure pick the wire's stream negotiation will run —
             # the size_key comes from the ONE shared definition
             # (plugin.allreduce_size_key), so the EF verdict and the
-            # wire's frame-level verdict can never disagree
-            model = getattr(self._net, "wire_model", None)
+            # wire's frame-level verdict can never disagree (per LEG:
+            # the hierarchical cross leg resolves against the inter
+            # plane's model, exactly as its own stream will)
+            model = getattr(net, "wire_model", None)
             if model is None:
                 return x, None
-            n = self.world_size
-            if verb == "all_reduce":
+            if allreduce_shaped:
                 size_key = plugin.allreduce_size_key(
                     model, x.size, x.dtype.itemsize, n,
                     credit_bytes=lane.credit_bytes)
@@ -833,7 +1237,7 @@ class ProcessGroup:
         codec = _codec.get(name)
         key = (chan, verb, tuple(np.shape(x)), str(x.dtype))
         epoch0 = self.epoch
-        if verb == "all_reduce":
+        if allreduce_shaped:
             q, res, payload = self._codec_residuals.feedback(
                 key, x, epoch0, codec, want_payload=True)
         else:
@@ -849,7 +1253,7 @@ class ProcessGroup:
         # allreduce exchange-and-fold sends the WHOLE buffer as hop 0
         # — any other shape mismatches and drops the stash harmlessly)
         _codec.mark_input_committed()
-        if payload is not None and verb == "all_reduce":
+        if payload is not None and allreduce_shaped:
             _codec.stash_payload(x.nbytes, x.dtype, payload)
 
         def commit():
@@ -859,14 +1263,21 @@ class ProcessGroup:
         return q, commit
 
     def all_gather(self, x, transport: str = "msg",
-                   timeout_s: float | None = None) -> np.ndarray:
+                   timeout_s: float | None = None,
+                   algorithm: str | None = None) -> np.ndarray:
         """Every rank contributes ``x`` (same shape everywhere); returns
         ``(world_size, *x.shape)`` in rank order. ``transport`` as in
-        :meth:`all_reduce`."""
+        :meth:`all_reduce`; ``algorithm`` picks flat-vs-hierarchical
+        like :meth:`all_reduce` (node blocks gather locally, cross
+        nodes once, and reorder into rank order)."""
         x = np.asarray(x)
         _check_transport(transport)
         if self.world_size == 1:
             return x[None].copy()
+        if self._pick_wire_algorithm(x, transport, algorithm,
+                                     verb="allgather") == "hier":
+            return self._ring(self._hier_fn("allgather"), x,
+                              timeout_s=timeout_s)
         fn = (plugin.ring_allgather_rdma if transport == "rdma"
               else plugin.ring_allgather_over_net)
         return self._ring(fn, x, timeout_s=timeout_s)
@@ -1002,6 +1413,385 @@ class ProcessGroup:
         return self._ring(plugin.ring_scatter_over_net, x, root=src,
                           timeout_s=timeout_s, _reshard=_reshard_scatter)
 
+    # -- the node-aware hierarchy (ISSUE 14, DESIGN.md §5l) -----------------
+
+    def _node_map(self, timeout_s: float) -> list:
+        """The agreed ORIGINAL-rank -> node-id map. Members carry it
+        from construction; a promoted spare/joiner reads the published
+        copy (its adopted identity indexes the same map, and the
+        published intra plane is adopted with it — part of the agreed
+        topology)."""
+        if self._node_of is None:
+            import json
+            if self._client is None:
+                raise RuntimeError(
+                    "hierarchical collective without a node map: pass "
+                    "node_of= at init_process_group")
+            raw = self._client.try_get(f"pg/{self.group_name}/nodemap",
+                                       timeout_s=timeout_s)
+            if raw is None:
+                raise RuntimeError(
+                    "hierarchical collective without a node map: the "
+                    "group published none (pass node_of= at "
+                    "init_process_group on every member)")
+            agreed = json.loads(raw)
+            self._intra_plane = str(agreed["intra_plane"])
+            self._node_of = [int(v) for v in agreed["node_of"]]
+        return self._node_of
+
+    def _hier_nodes(self, node_of: list) -> list:
+        """The CURRENT membership split into nodes: ``[(node_id,
+        [original ranks ascending]), ...]`` ordered by each node's
+        lowest original rank — a pure function of (members, map), so
+        every rank (and every post-heal rebuild) derives the same
+        topology, leaders included (leader = the node's first entry =
+        the lowest SURVIVING original rank: re-election is free)."""
+        by_node: dict = {}
+        for g in self._ranks:
+            nid = node_of[g] if g < len(node_of) else _JOINER_NODE_BASE + g
+            by_node.setdefault(nid, []).append(g)
+        nodes = [(nid, sorted(mem)) for nid, mem in by_node.items()]
+        nodes.sort(key=lambda kv: kv[1][0])
+        return nodes
+
+    def _hier_node_sizes(self) -> tuple:
+        """Per-node member counts of the current membership (node-order
+        tuple) — ``tuner.pick_algorithm``'s topology input. Cached per
+        epoch: the auto pick runs this on EVERY node-mapped collective,
+        and the split is a pure function of (epoch, membership) —
+        membership only ever changes with an epoch bump (heal/grow/
+        promotion), so the epoch key alone invalidates it."""
+        cached = self._hier_sizes
+        if cached is not None and cached[0] == self.epoch:
+            return cached[1]
+        node_of = self._node_map(self.timeout_s)
+        sizes = tuple(len(mem) for _, mem in self._hier_nodes(node_of))
+        self._hier_sizes = (self.epoch, sizes)
+        return sizes
+
+    def _pick_wire_algorithm(self, x: np.ndarray, transport: str,
+                             algorithm: str | None,
+                             verb: str = "allreduce") -> str:
+        """Resolve the flat-vs-hierarchical verdict for one reducing/
+        gathering collective: the caller's explicit override, else —
+        on a node-mapped msg-path group — the committed models'
+        ``tuner.pick_algorithm`` (pure, so every rank resolves the
+        same schedule; the gauge pins the verdict on the record).
+        ``verb`` prices the schedule actually being run — the three
+        verbs' flat wire patterns differ (see the pick's docstring)."""
+        if algorithm is not None and algorithm not in ("ring", "hier"):
+            raise ValueError(f"unknown algorithm {algorithm!r}; "
+                             f"know ('ring', 'hier')")
+        if algorithm == "hier" and transport != "msg":
+            raise ValueError(
+                "algorithm='hier' rides the msg wire; the rdma "
+                "put-path keeps the flat ring")
+        if algorithm is None:
+            if (self._node_of is None or transport != "msg"
+                    or self.world_size < 2):
+                return "ring"
+            from rocnrdma_tpu.transport import tuner as _tuner
+            model = getattr(self._net, "wire_model", None)
+            if model is None:
+                return "ring"
+            reg = getattr(self._net, "lanes", None)
+            lane = (reg.get(_lanes.current_channel())
+                    if reg is not None else None)
+            algorithm = _tuner.pick_algorithm(
+                x.nbytes, self._hier_node_sizes(), flat=model,
+                intra=_tuner.host_wire_model(self._intra_plane),
+                credit_bytes=lane.credit_bytes
+                if lane is not None else None, verb=verb)
+        if self._node_of is not None or algorithm == "hier":
+            _WIRE.algorithm_picked(algorithm)
+        return algorithm
+
+    def _hier_fn(self, verb: str):
+        """The ``_ring``-shaped wrapper of the hierarchical schedule:
+        resolves the hierarchy PER ATTEMPT (a healed retry rebuilds it
+        from the post-heal membership — the repair path) and runs the
+        module-level ``hier_*`` schedule on it."""
+        pg = self
+
+        def run(net, send, recv, x, rank, n, timeout_s=30.0, op="sum"):
+            h = pg._hier_ensure(timeout_s)
+            if verb == "allreduce":
+                return hier_allreduce(pg, h, x, op=op,
+                                      timeout_s=timeout_s)
+            if verb == "reducescatter":
+                return hier_reduce_scatter(pg, h, x, rank, n, op=op,
+                                           timeout_s=timeout_s)
+            return hier_allgather(pg, h, x, timeout_s=timeout_s)
+
+        run.__name__ = f"hier_{verb}"
+        return run
+
+    def hierarchy(self, timeout_s: float | None = None) -> dict:
+        """Build (or fetch) this epoch's hierarchy and describe it:
+        the node split of the CURRENT membership (original ranks), the
+        per-node leaders, this rank's place, and whether the
+        shard-parallel fast path applies (uniform node sizes). Blocks
+        on the group-wide sub-ring rendezvous when a build is needed —
+        every member must call a hierarchical verb (or this) for the
+        build to complete."""
+        t = self.timeout_s if timeout_s is None else timeout_s
+        h = self._hier_ensure(t)
+        return {"epoch": h.epoch,
+                "nodes": {str(nid): list(mem) for nid, mem in h.nodes},
+                "leaders": [mem[0] for _, mem in h.nodes],
+                "node_idx": h.node_idx,
+                "local_rank": h.local_rank,
+                "local_n": h.local_n,
+                "uniform": h.uniform,
+                "is_leader": h.is_leader,
+                "cross_wired": h.cross_wired,
+                "intra_plane": self._intra_plane,
+                "inter_plane": self.plane}
+
+    def _hier_ensure(self, timeout_s: float) -> "_Hier":
+        """The current epoch's hierarchy, building it when the epoch
+        moved (or nothing was built yet). One build at a time per rank
+        (concurrent lanes share the rendezvous); the namespace is
+        epoch-qualified, so post-heal rebuilds can never pair with a
+        dead generation's listeners."""
+        deadline = time.monotonic() + timeout_s
+        with self._hier_lock:
+            h = self._hier
+            if (h is not None and h.epoch == self.epoch
+                    and not self._hier_stale):
+                return h
+            if h is not None:
+                self._hier = None
+                if h.epoch == self.epoch:
+                    # a same-epoch discard (deferred invalidate after an
+                    # abort): its rendezvous generation was consumed —
+                    # mark it so the rebuild probes past it (old-epoch
+                    # namespaces are never revisited, no burn needed)
+                    self._hier_burn(h)
+                h.close()
+            while True:
+                self._hier_stale = False
+                h = self._hier_build(max(0.1,
+                                         deadline - time.monotonic()))
+                # a heal/grow that landed MID-build may have bumped the
+                # epoch and rewired the membership after the build
+                # snapshotted them (its _hier_invalidate defers against
+                # our held lock, setting only the stale flag) — a torn
+                # result (new epoch over old members, or vice versa)
+                # must never be accepted as current
+                if (not self._hier_stale and h.epoch == self.epoch
+                        and set(g for _, mem in h.nodes for g in mem)
+                        == set(self._ranks)):
+                    self._hier = h
+                    return h
+                if h.epoch == self.epoch:
+                    # same-epoch discard: its generation's rendezvous
+                    # keys point at the listeners the close below
+                    # retires — burn it or the retry redials them
+                    self._hier_burn(h)
+                h.close()
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        "hier build: membership kept changing under "
+                        "the build until the deadline")
+
+    def _hier_invalidate(self, wait_s: float = 0.2) -> None:
+        """Tear the hierarchy down (heal/grow/promotion, an aborted
+        hierarchical collective, destroy): sub-ring state is a pure
+        function of (epoch, membership) and is rebuilt from scratch by
+        the next hierarchical collective — which is exactly how a dead
+        node leader re-elects (the rebuild's node split of the healed
+        member list puts the lowest surviving original rank first).
+
+        Bounded acquire: a concurrent lane's IN-FLIGHT build holds the
+        lock for a group-wide rendezvous that may itself be hanging on
+        the dead member this invalidation's heal is removing — a heal
+        fence parked behind it would burn its own deadline funding the
+        doomed build. When the lock is busy, teardown is DEFERRED to
+        the next ``_hier_ensure`` via the ``_hier_stale`` marker: the
+        heal-path case closes there on the epoch check, and a SAME-
+        epoch abort (self_heal off / unconfirmed failure) closes on
+        the marker — without it the retry would reuse sub-ring comms
+        still holding the aborted leg's mid-stream frames.
+
+        A deferral is self-cleaning even when no later collective
+        runs (destroy): the lock holder is mid-``_hier_ensure``, whose
+        loop closes any result the stale marker condemns and whose
+        build is itself deadline-bounded — ``wait_s`` only trades how
+        long THIS caller waits before handing off (destroy passes a
+        longer bound so the common case tears down inline; heal keeps
+        the short one so a fence never funds a doomed build)."""
+        self._hier_stale = True
+        if not self._hier_lock.acquire(timeout=wait_s):
+            _FLIGHT.record("hier-invalidate-deferred", epoch=self.epoch)
+            return
+        try:
+            h, self._hier = self._hier, None
+        finally:
+            self._hier_lock.release()
+        if h is not None:
+            h.close()
+
+    def _hier_burn(self, h: "_Hier") -> None:
+        """Mark ``h``'s rendezvous generation CONSUMED on the store
+        (best-effort, bounded): ``_hier_build``'s exchange keys are
+        set-then-get with no generation fence of their own, so a retry
+        at an UNCHANGED epoch rebuilding under the same namespace would
+        fetch the aborted build's (closed) listener handles and redial
+        them until deadline. Every rank burns the generation it used
+        before rebuilding, so the rebuild's probe lands past it in
+        lockstep. A failed burn is absorbed: the peers' (idempotent)
+        burns cover it, and a store broken enough to drop ALL of them
+        fails the rebuild named anyway."""
+        if self._client is None:
+            return
+        try:
+            self._client.set(
+                f"pg/{self.group_name}/hier/e{h.epoch}/g{h.gen}/burned",
+                "1", timeout_s=2.0)
+        except (OSError, TimeoutError):
+            _FLIGHT.record("hier-burn-abort", epoch=h.epoch, gen=h.gen)
+
+    def _hier_mirror_lane(self, lane) -> None:
+        """Mirror a newly opened lane onto the live hierarchy's
+        sub-nets (under the build lock, so a lane opened while a build
+        is in flight is either in the registry snapshot the build
+        mirrors, or mirrored here after the build publishes)."""
+        with self._hier_lock:
+            if self._hier is not None:
+                self._hier.mirror_lane(lane)
+
+    def _hier_build(self, timeout_s: float) -> "_Hier":
+        """Wire this epoch's hierarchy: per-node sub-rings over the
+        intra plane plus the cross-node ring(s) over the group's own
+        plane, rendezvoused through epoch-qualified store namespaces
+        (``pg/<g>/hier/e<N>/...``) with the same publish-before-dial
+        and backoff discipline as every ring here
+        (``bootstrap.bootstrap_ring``). Chaos-transparent: sub-nets
+        wrap in the SAME FaultNet schedule as the group net, so
+        injected faults (and the op-keyed kill) land on hierarchical
+        legs deterministically. ``timeout_s`` is ONE deadline shared
+        by every stage (node-map read, generation probe, each
+        sub-ring's wiring, the ready barrier) — the `_ring` contract;
+        granting each sequential stage a fresh budget would let a
+        dead peer stretch the caller's bound severalfold."""
+        deadline = time.monotonic() + timeout_s
+        rem = lambda: max(0.1, deadline - time.monotonic())
+        node_of = self._node_map(rem())
+        nodes = self._hier_nodes(node_of)
+        g = self._ranks[self.rank]
+        node_idx = next(i for i, (_nid, mem) in enumerate(nodes)
+                        if g in mem)
+        members = nodes[node_idx][1]
+        lrank = members.index(g)
+        sizes = [len(mem) for _, mem in nodes]
+        uniform = len(set(sizes)) == 1
+        # ONE epoch snapshot for the whole build: the _Hier stamp, the
+        # rendezvous namespace, and every sub-net's fence must agree —
+        # re-reading self.epoch at each site would let a concurrent
+        # heal/grow tear them (the ensure loop then discards any result
+        # whose stamp or membership went stale mid-build)
+        epoch = self.epoch
+        h = _Hier(epoch, nodes, node_idx, lrank, uniform)
+        sched = getattr(self._net, "schedule", None)
+
+        def mk_net(plane):
+            net = _PLANES[plane]()
+            if sched is not None:
+                from rocnrdma_tpu.transport.faults import FaultNet
+                net = FaultNet(net, sched)
+            net.init()
+            net.set_epoch(epoch)
+            # a rank blocked in a hierarchical leg must still serve
+            # its interrupted p2p streams' resume protocol (the PR-9
+            # progress-hook lesson) — every leg's blocking loops run
+            # the group hook like the main ring's do
+            net._progress_hook = self._resume_progress
+            return net
+
+        # Rendezvous namespace: epoch-qualified AND generation-qualified.
+        # The epoch covers heal/grow rebuilds; the generation covers a
+        # retry at an UNCHANGED epoch (an aborted collective with
+        # self_heal off): the first build's exchange keys and barrier
+        # arrivals are already populated, so reusing them would hand the
+        # rebuild the dead generation's closed listener handles. Probe
+        # for the first generation no rank has burned (every rank burns
+        # the generation it used before rebuilding — _hier_burn — so the
+        # probe converges in lockstep; almost always g0, one store read).
+        ns_epoch = f"pg/{self.group_name}/hier/e{epoch}"
+        gen = 0
+        if self._client is not None:
+            while self._client.try_get(
+                    f"{ns_epoch}/g{gen}/burned",
+                    timeout_s=rem()) is not None:
+                gen += 1
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        "hier build: rendezvous-generation probe "
+                        f"exhausted its deadline at g{gen}")
+        h.gen = gen
+        ns = f"{ns_epoch}/g{gen}"
+        try:
+            if h.local_n > 1:
+                h.local_net = mk_net(self._intra_plane)
+                (h.local_send, h.local_recv,
+                 h.local_client) = bootstrap.bootstrap_ring(
+                    h.local_net, self._store_handle, lrank, h.local_n,
+                    rem(), ns=f"{ns}/n{node_idx}")
+            if h.n_nodes > 1 and (uniform or lrank == 0):
+                # uniform: local index j's ring carries shard j across
+                # nodes (members: each node's j-th rank, node order);
+                # relay: one leaders' ring
+                h.inter_net = mk_net(self.plane)
+                (h.inter_send, h.inter_recv,
+                 h.inter_client) = bootstrap.bootstrap_ring(
+                    h.inter_net, self._store_handle, node_idx,
+                    h.n_nodes, rem(),
+                    ns=f"{ns}/x{lrank if uniform else 0}")
+            # lanes opened before (or during) the build: mirror the
+            # registry snapshot so every leg resolves the same QoS
+            # credit and codec knob (later channel() calls mirror
+            # through _hier_mirror_lane under the same lock)
+            for lane in self._net.lanes.snapshot():
+                h.mirror_lane(lane)
+            # one group-wide barrier re-marks the clock sync for EVERY
+            # member (the sub-ring wired barriers marked only their
+            # own subsets, which would skew the trace alignment
+            # between leaders and non-leaders)
+            if self._client is not None and self.world_size > 1:
+                self._client.barrier(f"{ns}/ready", self.world_size,
+                                     rem())
+                _FLIGHT.mark_sync(ns=ns, rank=self.rank)
+            # the sub-rings' bootstrap clients served only the wiring:
+            # close them NOW. Each open store connection is a server-
+            # side thread polling its recv at sub-ms cadence, and the
+            # hierarchy would otherwise park 2 per rank on the store
+            # host for its lifetime — measured as a ~2x slowdown of
+            # every collective the store-hosting rank (and whoever
+            # pairs with it) runs. Heal-time rebuilds dial fresh ones.
+            for attr in ("local_client", "inter_client"):
+                c = getattr(h, attr)
+                if c is not None:
+                    setattr(h, attr, None)
+                    try:
+                        c.close()
+                    except (OSError, TimeoutError):
+                        pass
+        except BaseException as e:
+            # a half-built hierarchy must not leak its nets/clients
+            # (bootstrap_ring already tore down its own half-wired
+            # endpoints); the abort leaves a flight event for the
+            # postmortem before propagating
+            _FLIGHT.record("hier-abort", epoch=epoch,
+                           verb="build", error=type(e).__name__)
+            self._hier_burn(h)  # half-populated keys: never reused
+            h.close()
+            raise
+        _FLIGHT.record("hier-built", epoch=epoch,
+                       nodes=h.n_nodes, local=h.local_n,
+                       uniform=uniform, leader=h.is_leader)
+        return h
+
     # -- multi-tenant lanes (PR 9: concurrent QoS-scheduled collectives) ----
 
     def channel(self, name: str, priority: int | None = None,
@@ -1079,6 +1869,11 @@ class ProcessGroup:
                 ch = self._channels[name] = ChannelHandle(
                     self, lane, bucket_bytes=bucket_bytes,
                     bucket_timeout_s=bucket_timeout_s)
+                # a live hierarchy's sub-nets resolve lanes from their
+                # own registries: mirror the fresh lane per leg (ISSUE
+                # 14 — QoS credit and codec must mean the same thing on
+                # every leg a laned collective rides)
+                self._hier_mirror_lane(lane)
                 return ch
             if priority is not None or credit_bytes is not None \
                     or codec is not None:
@@ -2421,6 +3216,12 @@ class ProcessGroup:
         # namespaces and split-brain into disjoint groups.
         self._net.set_epoch(epoch)
         self.epoch = epoch
+        # the hierarchy is generation-bound state: tear it down with the
+        # fence — the next hierarchical collective rebuilds it from the
+        # HEALED member list (which is how a dead node leader re-elects
+        # by lowest surviving original rank; sub-net frames of the old
+        # generation die with their closed comms)
+        self._hier_invalidate()
         self._suspend_p2p(members, fresh)
         self._rewire(members, new_rank, new_world, old_ranks, ns, remaining,
                      fresh=fresh)
@@ -2460,6 +3261,9 @@ class ProcessGroup:
                                        for k in range(epoch))
                                    + tuple(
                                        f"pg/{self.group_name}/fleet/e{k}/"
+                                       for k in range(epoch))
+                                   + tuple(
+                                       f"pg/{self.group_name}/hier/e{k}/"
                                        for k in range(epoch)))
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness: stale ids age out of use
@@ -2825,6 +3629,9 @@ class ProcessGroup:
         # different namespaces and split-brain.
         self._net.set_epoch(epoch)
         self.epoch = epoch
+        self._hier_invalidate()  # rebuilt from the widened membership
+        #                          (admitted joiners past the agreed map
+        #                          run as singleton nodes)
         self._suspend_p2p(members, fresh)
         self._rewire(members, new_rank, new_world, old_ranks, ns, remaining,
                      fresh=fresh)
@@ -2851,6 +3658,9 @@ class ProcessGroup:
                                        for k in range(epoch))
                                    + tuple(
                                        f"pg/{self.group_name}/fleet/e{k}/"
+                                       for k in range(epoch))
+                                   + tuple(
+                                       f"pg/{self.group_name}/hier/e{k}/"
                                        for k in range(epoch)))
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness
@@ -3008,6 +3818,29 @@ class ProcessGroup:
         deadline = time.monotonic() + self.timeout_s
         remaining = lambda: max(0.1, deadline - time.monotonic())
         self._net.set_epoch(epoch)
+        self._hier_invalidate()  # a standby never built one; belt and
+        #                          braces against re-admission paths
+        # adopt the group's node map NOW (bounded read; None on
+        # flat-only groups): the auto algorithm pick keys off
+        # _node_of and never re-reads the store, so a promoted rank
+        # left map-less would pick "ring" while the survivors pick
+        # "hier" — a split verdict that strands the whole group in a
+        # sub-ring rendezvous. An ABSENT key is a clean flat-only
+        # verdict; a store FAILURE must fail the admission named
+        # (the burn/shrink path then runs deterministically) — the
+        # very next step dials the store anyway, so a broken store
+        # was never a survivable admission.
+        if self._node_of is None:
+            raw = retry_with_backoff(
+                lambda: self._client.try_get(
+                    f"pg/{self.group_name}/nodemap", timeout_s=5.0),
+                timeout_s=min(remaining(), 15.0),
+                what=f"node-map adoption for {self.group_name!r}")
+            if raw is not None:
+                import json as _json
+                agreed = _json.loads(raw)
+                self._intra_plane = str(agreed["intra_plane"])
+                self._node_of = [int(v) for v in agreed["node_of"]]
         self._ranks = members
         self.rank = members.index(slot)
         self.world_size = len(members)
@@ -3536,6 +4369,7 @@ class ProcessGroup:
                         listener.close()
                     except OSError:
                         pass
+        self._hier_invalidate(wait_s=2.0)
         self._net.close()
         if self._server is not None:
             self._server.wait_idle()  # all clients gone -> safe to close
@@ -3558,7 +4392,9 @@ def init_process_group(rank: int | None = None,
                        plane: str = "tcp",
                        fault_schedule=None,
                        self_heal: bool = False,
-                       spare: bool = False) -> ProcessGroup:
+                       spare: bool = False,
+                       node_of=None,
+                       intra_plane: str = "shm") -> ProcessGroup:
     """Create this process's :class:`ProcessGroup`.
 
     Rendezvous: either pass ``store_handle`` (an already-running
@@ -3593,6 +4429,17 @@ def init_process_group(rank: int | None = None,
     path; ``rank`` is ignored (identity is assigned at promotion). The
     group's store must already be running (pass ``store_handle``, or the
     master env/args of the group whose rank 0 serves it).
+
+    ``node_of`` (ISSUE 14): the hierarchical topology map — entry r is
+    the NODE id of rank r (original ranks; every member must pass the
+    same list, store-published and agreed first-writer-wins). A
+    node-mapped group's reducing/gathering collectives may run the
+    node-aware two-level schedule: node-local legs over ``intra_plane``
+    (default ``"shm"`` — the fast fabric), cross-node legs over
+    ``plane`` (the slow one), picked per call by the committed wire
+    models (or forced via the verbs' ``algorithm=``). Spares need no
+    map (they read the published one at promotion); grow joiners run
+    as singleton nodes.
     """
     if spare:
         if store_handle is None:
@@ -3631,7 +4478,8 @@ def init_process_group(rank: int | None = None,
         return ProcessGroup(rank, world_size, store_handle, server,
                             timeout_s, group_name, plane,
                             fault_schedule=fault_schedule,
-                            self_heal=self_heal)
+                            self_heal=self_heal, node_of=node_of,
+                            intra_plane=intra_plane)
     except BaseException as e:
         _FLIGHT.record("group-abort", group=group_name, rank=rank,
                        error=type(e).__name__)

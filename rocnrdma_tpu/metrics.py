@@ -178,6 +178,12 @@ class WireCounters:
     # digest can cover codec activity
     frames_encoded: int = 0         # outgoing frames quantized at the wire
     payload_bytes_saved: int = 0    # decoded-minus-wire bytes the codec cut
+    # node-aware hierarchical collectives (ISSUE 14): collectives that
+    # ran the two-level schedule (local reduce-scatter -> cross-node
+    # allreduce -> local allgather) instead of the flat ring — counted
+    # per completed schedule, so the bench can prove the hier path was
+    # genuinely exercised, not just picked
+    hier_ops: int = 0
 
     def __post_init__(self):
         # not a dataclass field: asdict()/snapshot() must stay pure counters
@@ -191,6 +197,12 @@ class WireCounters:
         self._pipeline_depth = 0
         self._tuner_version = None
         self._codec = None
+        # the node-aware ALGORITHM gauge (ISSUE 14): the flat-vs-
+        # hierarchical verdict the last node-mapped collective resolved
+        # ("ring"/"hier" — tuner.pick_algorithm, or the caller's
+        # explicit override), so a record can PIN which schedule its
+        # floor was measured on
+        self._algorithm = None
 
     def copied(self, nbytes: int, frames: int = 1) -> None:
         """Record ``nbytes`` staged through an extra payload copy (the
@@ -293,6 +305,20 @@ class WireCounters:
         with self._lock:
             self.promotions += n
 
+    def hier(self, n: int = 1) -> None:
+        """Record completed hierarchical (node-aware two-level)
+        collectives — the ISSUE-14 schedule actually running, not
+        merely picked."""
+        with self._lock:
+            self.hier_ops += n
+
+    def algorithm_picked(self, algo: str) -> None:
+        """Record the node-aware flat-vs-hierarchical verdict the last
+        node-mapped collective resolved (gauge semantics: last pick
+        wins; see ``tuner.pick_algorithm``)."""
+        with self._lock:
+            self._algorithm = algo
+
     def negotiated(self, frame_bytes: int, pipeline_depth: int,
                    tuner_version: int | None = None,
                    codec: str | None = None) -> None:
@@ -315,7 +341,8 @@ class WireCounters:
             return {"frame_bytes": self._frame_bytes,
                     "pipeline_depth": self._pipeline_depth,
                     "tuner_version": self._tuner_version,
-                    "codec": self._codec}
+                    "codec": self._codec,
+                    "algorithm": self._algorithm}
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -414,10 +441,12 @@ class WireCounters:
             self.bucket_triggers = {}
             self.frames_encoded = 0
             self.payload_bytes_saved = 0
+            self.hier_ops = 0
             self._frame_bytes = 0
             self._pipeline_depth = 0
             self._tuner_version = None
             self._codec = None
+            self._algorithm = None
 
 
 # THE process-wide wire-counter instance (one per rank process — host-plane

@@ -235,6 +235,8 @@ def _load():
                                  ctypes.c_int]
     lib.rtcp_tx_pending.restype = ctypes.c_uint64
     lib.rtcp_tx_pending.argtypes = [ctypes.c_void_p]
+    lib.rtcp_wait_readable.restype = ctypes.c_int
+    lib.rtcp_wait_readable.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.rtcp_rx_pending.restype = ctypes.c_uint64
     lib.rtcp_rx_pending.argtypes = [ctypes.c_void_p]
     lib.rtcp_close.restype = None
@@ -313,6 +315,12 @@ class _QpBase(_Closeable):
         # wr; replayed (in order) by the next poll_cq so nothing is lost
         self._pending_cqes: list[tuple] = []
         self._closed = False
+        # serializes the kernel-parked idle wait (rtcp_wait_readable, up
+        # to 50 ms holding the raw native pointer inside C) against a
+        # concurrent close(): _guard's closed-check alone is a TOCTOU —
+        # close() freeing the Conn under a parked poll() is a
+        # use-after-free the pre-park sleep-beat never risked
+        self._wait_lock = threading.Lock()
 
     def _fn(self, op: str):
         return getattr(_load(), f"{self._PREFIX}_{op}")
@@ -421,7 +429,17 @@ class _QpBase(_Closeable):
             self.post_recv(1 << 16)
         deadline = time.monotonic() + timeout_s
         while True:
-            for c, payload in self.poll_cq():
+            # the wait lock covers this round's guard AND its native
+            # poll_cq — close() holds the same lock around the native
+            # free, so a concurrent close either lands between rounds
+            # (the guard refuses named) or waits the round out; without
+            # it the guard-then-poll gap hands C a freed handle. recv
+            # is the blocking STORE-protocol receive, not the framed
+            # data path, so the uncontended acquire per round is cheap.
+            with self._wait_lock:
+                self._guard()
+                cqes = self.poll_cq()
+            for c, payload in cqes:
                 if c.opcode == OP_RECV:
                     if c.status != OK:
                         raise OSError(
@@ -432,7 +450,15 @@ class _QpBase(_Closeable):
             if time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"{self._PREFIX}: recv timed out on {self.name!r}")
-            time.sleep(0.0005)
+            self._recv_idle(deadline)
+
+    def _recv_idle(self, deadline: float) -> None:
+        """One idle beat of the blocking ``recv`` wait. The shm plane
+        spins with a short sleep (its ring has no waitable fd); the TCP
+        plane overrides this with a kernel-level poll() on the socket —
+        zero GIL churn from idle store-serving threads, instant wake on
+        data (see ``rtcp_wait_readable``)."""
+        time.sleep(0.0005)
 
     # -- one-sided RDMA ----------------------------------------------------
 
@@ -553,11 +579,17 @@ class _QpBase(_Closeable):
     # -- teardown ----------------------------------------------------------
 
     def _do_close(self) -> None:
-        # drop ctypes views into posted bytearrays before freeing them
-        self._recv_bufs.clear()
-        self._read_bufs.clear()
-        self._pending_cqes.clear()
-        self._fn("close")(self._h)
+        # _closed is already True (close() flips it before dispatching
+        # here); the wait lock lets a parked _recv_idle — or recv()'s
+        # in-flight guard+poll_cq round, which drains these very
+        # buffers — finish before they are cleared and the native
+        # state is freed under it
+        with self._wait_lock:
+            # drop ctypes views into posted bytearrays before freeing
+            self._recv_bufs.clear()
+            self._read_bufs.clear()
+            self._pending_cqes.clear()
+            self._fn("close")(self._h)
         self._post_close()
 
     def _post_close(self) -> None:
@@ -734,3 +766,17 @@ class TcpQueuePair(_QpBase):
         posted receive (staged messages; diagnostics — the rtcp twin of
         the shm plane's unread-ring count)."""
         return _load().rtcp_rx_pending(self._h)
+
+    def _recv_idle(self, deadline: float) -> None:
+        # park in the kernel until the socket is readable (or there is
+        # other progress to make — staged frames, queued tx, a dead
+        # peer): the idle beat of blocking store-protocol receives.
+        # Capped at 50 ms so a concurrent close() surfaces promptly.
+        # The wait lock (held for at most one beat) keeps close() from
+        # deleting the Conn while the poll reads it; the closed
+        # re-check under the lock closes the check-then-park window.
+        ms = max(1, min(50, int((deadline - time.monotonic()) * 1000)))
+        with self._wait_lock:
+            if self._closed:
+                return
+            _load().rtcp_wait_readable(self._h, ms)

@@ -174,12 +174,42 @@ def record(kind: str, **args) -> None:
     is open, a plain ``FLIGHT.record`` otherwise. The wire's span sites
     (frame lifecycle, credit stalls, lane admission) call THIS instead
     of ``FLIGHT.record`` — one extra thread-local read per event is the
-    whole unsampled-path cost."""
+    whole unsampled-path cost.
+
+    Inside a hierarchical LEG context (:func:`leg`) every frame
+    event's hop id is lifted into that leg's hop namespace
+    (``hop + leg << 16``): one hierarchical collective streams several
+    ``_RingWire``s under ONE op span, and each wire's hop counter
+    starts at 1 — without the offset the legs' per-hop entries would
+    collide in the op record (frame counts merged across legs, landing
+    times maxed across sub-rings)."""
     ctx = getattr(_TLS, "op", None)
+    leg_no = getattr(_TLS, "leg", 0)
+    if leg_no:
+        h = _hop_of(args)
+        if h is not None:
+            args = dict(args, hop=h + (leg_no << 16))
     if ctx is not None:
         args = dict(args, op=ctx.op, chan=ctx.chan, epoch=ctx.epoch)
         ctx.events.append((time.perf_counter(), kind, args))
     FLIGHT.record(kind, **args)
+
+
+@contextlib.contextmanager
+def leg(leg_no: int):
+    """Run one LEG of a hierarchical collective (ISSUE 14 — the local
+    reduce-scatter, the cross-node ring, the local allgather) under a
+    distinct hop namespace, with a structural ``hier-leg`` marker on
+    the op's event list (the record builder counts the legs; the
+    replay digest covers the count). Thread-local, nests and restores
+    like the lane context."""
+    prev = getattr(_TLS, "leg", 0)
+    _TLS.leg = int(leg_no)
+    record("hier-leg", leg=int(leg_no))
+    try:
+        yield
+    finally:
+        _TLS.leg = prev
 
 
 # -- the span markers (the analyzer's span-pairing rule, pass #4f, pins
@@ -272,8 +302,15 @@ def _events_to_record(events, *, epoch, chan, op, verb, rank,
     waits = {b: 0.0 for b in WAIT_BUCKETS}
     up = down = None
     n_frames = 0
+    hier_legs = 0
     for t, kind, args in events:
-        if kind == "stream-start":
+        if kind == "hier-leg":
+            # a hierarchical collective's leg marker (ISSUE 14):
+            # structural — the digest covers the leg count, and the
+            # assembler knows this op's hop entries span several
+            # sub-rings (no single-ring critical path exists)
+            hier_legs = max(hier_legs, int(args.get("leg", 0)))
+        elif kind == "stream-start":
             up = args.get("up", up)
             down = args.get("down", down)
         elif kind == "frame-posted":
@@ -311,6 +348,11 @@ def _events_to_record(events, *, epoch, chan, op, verb, rank,
         # op carries (1 for ordinary collectives) — structural, so the
         # replay digest covers it
         "members": members,
+        # hierarchical spans (ISSUE 14): the highest leg index this
+        # op's streams ran under (0 for flat collectives) — structural,
+        # and the assembler's signal that the hop entries span several
+        # sub-rings (so no single-ring critical path is extracted)
+        "hier_legs": hier_legs,
         "t_start": rel(t_start),
         "wall_s": round(wall_s, 9),
         "n_frames": n_frames,
@@ -469,8 +511,16 @@ def assemble(records, world: int | None = None) -> list:
             continue
         with_hops = {r: rec for r, rec in per_rank.items()
                      if rec.get("hops")}
-        if not all(rec.get("up") in with_hops
-                   for rec in with_hops.values()):
+        if any(rec.get("hier_legs") for rec in per_rank.values()):
+            # hierarchical op (ISSUE 14): the hop entries span several
+            # sub-rings whose `up` neighbours are SUB-ring indices —
+            # the single-ring upstream chain does not exist, and a
+            # cross-leg walk would blame whoever's local index
+            # collided. Walls and the five-bucket attribution stay
+            # exact; the critical path is deliberately not extracted.
+            with_hops = {}
+        elif not all(rec.get("up") in with_hops
+                     for rec in with_hops.values()):
             with_hops = {}  # open ring: no trustworthy causal chain
         tree = {
             "epoch": epoch, "chan": chan, "op": op,
@@ -637,7 +687,7 @@ def digest(records) -> str:
     structural = sorted(
         [r["epoch"], r["chan"], r["op"], r["verb"], r["rank"],
          r.get("up"), r.get("down"), r.get("n_frames", 0),
-         r.get("members", 1),
+         r.get("members", 1), r.get("hier_legs", 0),
          [[entry[0], entry[1]] for entry in r.get("hops", [])]]
         for r in records)
     return hashlib.sha256(

@@ -281,6 +281,12 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
         import hashlib
         qch = pg.channel("quant", codec=args.codec)
         codec_hash = hashlib.sha256()
+    # --hier: the round allreduces run the node-aware two-level
+    # schedule (ISSUE 14) — the group was built with a node map, and
+    # the kill victim is a NODE LEADER, so the healed retry must
+    # re-elect (rebuild the hierarchy around the lowest surviving
+    # original rank of the shrunk node) and still commit exactly-once
+    algo = "hier" if getattr(args, "hier", False) else None
     for rnd in range(start, args.rounds):
         if can_grow and args.grow_round is not None \
                 and rnd == args.grow_round:
@@ -335,7 +341,8 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
         # resume per survivor pair), so it gets double the headroom —
         # fault decisions are op-keyed, never time-keyed, so the wider
         # deadline cannot perturb the replay digests
-        t_op = 10.0 if (lat is not None or co is not None) else 5.0
+        t_op = 10.0 if (lat is not None or co is not None
+                        or algo is not None) else 5.0
         if co is not None:
             # K member inputs per round, each reconstructable per
             # (original rank, member index) — the bucket is ONE op,
@@ -348,12 +355,13 @@ def _chaos_rounds(args, pg, start: int, can_grow: bool,
         elif qch is not None:
             local = _chaos_input(args.seed, my_orig, rnd,
                                  args.size).astype(np.float32)
-            got = qch.all_reduce(local, timeout_s=t_op)
+            got = qch.all_reduce(local, timeout_s=t_op, algorithm=algo)
         else:
             local = _chaos_input(args.seed, my_orig, rnd, args.size)
-            got = (lat.all_reduce(local, timeout_s=t_op)
+            got = (lat.all_reduce(local, timeout_s=t_op, algorithm=algo)
                    if lat is not None
-                   else pg.all_reduce(local, timeout_s=t_op))
+                   else pg.all_reduce(local, timeout_s=t_op,
+                                      algorithm=algo))
         # the oracle of the CURRENT membership: contributions are
         # keyed by ORIGINAL rank (pg.global_ranks survives re-
         # ranking), so a post-heal round sums exactly the members —
@@ -897,10 +905,17 @@ def _heal_chaos_main(args) -> int:
     group = f"heal{args.seed}"
     try:
         if role == "member":
+            # --hier: first half of the ranks are node 0, second half
+            # node 1 (n=4 -> [0, 0, 1, 1]); the intra plane is shm like
+            # the group plane — the chaos surface under test is the
+            # hierarchy's REPAIR (kill a node leader), not the mixed-
+            # plane speedup the bench scenario measures
+            node_map = ([r * 2 // n for r in range(n)]
+                        if getattr(args, "hier", False) else None)
             pg = dist.init_process_group(
                 rank=rank, world_size=n, store_handle=args.coordinator,
                 timeout_s=20.0, group_name=group, plane="shm",
-                fault_schedule=sched, self_heal=True)
+                fault_schedule=sched, self_heal=True, node_of=node_map)
             pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
             start = 0
         elif role == "spare":
@@ -1041,6 +1056,12 @@ def main(argv=None) -> int:
                         "and float payloads — prints CODECLOG (result "
                         "+ error-feedback-residual digests, replay-"
                         "equal per seed)")
+    p.add_argument("--hier", action="store_true",
+                   help="kill-and-heal: run the round allreduces on the "
+                        "node-aware HIERARCHICAL schedule (node map = "
+                        "first half node 0, second half node 1); kill a "
+                        "node leader and the healed retry must re-elect "
+                        "by lowest surviving original rank in the node")
     p.add_argument("--coalesce", action="store_true",
                    help="kill-and-heal: issue each round's allreduces "
                         "ASYNC and flush them as one fused bucket (the "

@@ -100,6 +100,7 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                 lanes: bool = False,
                 coalesce: bool = False,
                 codec: str | None = None,
+                hier: bool = False,
                 _retry_left: int = 1) -> list[WorkerResult]:
     """Spawn ``n`` worker processes running ``task``; wait for all.
 
@@ -165,6 +166,12 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
         # with error feedback on float payloads (the codec x heal
         # chaos surface — prints CODECLOG, replay-equal per seed)
         extra += ["--codec", codec]
+    if hier:
+        # kill-and-heal: the round allreduces run the node-aware
+        # hierarchical schedule and the kill lands on a node leader
+        # (the hierarchy x heal chaos surface — the healed retry must
+        # re-elect and rebuild the sub-rings)
+        extra += ["--hier"]
     # release the reservations at the last instant: the spawned rank 0
     # (and the re-elected device coordinator) bind these ports next
     res.close()
@@ -192,6 +199,6 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
         return run_workers(n, task, timeout_s, fault_rank, seed, rounds,
                            size, kill_ranks, kill_ops, spares, join,
                            grow_round, die_at_promotion, device_heal_fail,
-                           lanes, coalesce, codec,
+                           lanes, coalesce, codec, hier,
                            _retry_left=_retry_left - 1)
     return results

@@ -105,6 +105,16 @@ COST_FACTOR = {"int8": 1.0, "fp8": 7.0}
 # pick_codec walks these; order is part of the pick's purity contract)
 WIRE_CODECS = ("int8", "fp8")
 
+# the residual-store VERB key of the hierarchical schedule's cross-node
+# leg (ISSUE 14): the node-local reduce-scatter's PARTIAL SUM is
+# re-encoded for the slow inter-node hop, and that re-encode error is
+# fed back through its own (lane, HIER_XLEG_VERB, shape, dtype)
+# residual — keyed apart from the flat verbs' input-stage residuals, so
+# a group mixing flat and hierarchical rounds never cross-feeds error
+# between schedules. Epoch discipline is unchanged: the key resets
+# deterministically on first post-heal use like every residual.
+HIER_XLEG_VERB = "hier-xleg"
+
 
 # ---------------------------------------------------------------------------
 # Flight instrumentation (the analyzer's codec rule, pass #4h: every

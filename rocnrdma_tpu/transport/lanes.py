@@ -166,6 +166,16 @@ class LaneRegistry:
         with self._lock:
             return self._by_name.get(name)
 
+    def snapshot(self) -> list:
+        """Every registered lane, name-sorted (immutable Lane values).
+        The hierarchical host plane (ISSUE 14) MIRRORS a group's open
+        lanes onto its per-leg sub-nets through this — a lane's QoS
+        credit and wire codec must mean the same thing on every leg a
+        laned collective rides, and each net resolves lanes from its
+        own registry."""
+        with self._lock:
+            return [self._by_name[k] for k in sorted(self._by_name)]
+
     def label(self, channel: int) -> str:
         """The lane NAME behind a wire channel id (per-channel counters
         and flight events key by this, so telemetry reads "bulk", not a

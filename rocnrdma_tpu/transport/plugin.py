@@ -2726,6 +2726,49 @@ def ring_reduce_scatter_v_over_net(net, send_comm, recv_comm,
     return np.array(chunk(rank), copy=True)
 
 
+def ring_chain_reduce_over_net(net, send_comm, recv_comm,
+                               local: np.ndarray, rank: int,
+                               n_ranks: int, op: str = "sum",
+                               timeout_s: float = 30.0) -> np.ndarray:
+    """Frame-pipelined chain reduce onto RING RANK 0 — the node-local
+    "reduce-scatter-shaped" leg of the hierarchical schedule (ISSUE 14,
+    DESIGN.md §5l) for nodes whose sizes differ (the uniform fast path
+    rides the plain reduce-scatter instead). Implemented as the ragged
+    reduce-scatter with ROOT-CONCENTRATED counts ``[N, 0, ..., 0]``:
+    the -1-shifted stream degenerates to a relay chain that folds the
+    whole buffer toward rank 0, frame-granularly pipelined through
+    ``_RingWire.stream`` like every other leg — so lanes, QoS credits,
+    codecs, tracing spans, and the epoch fence apply unchanged. Returns
+    the full reduction on rank 0, an empty array elsewhere."""
+    x = np.asarray(local).ravel()
+    counts = np.zeros(max(1, n_ranks), np.int64)
+    counts[0] = x.size
+    return ring_reduce_scatter_v_over_net(net, send_comm, recv_comm, x,
+                                          counts, rank, n_ranks, op=op,
+                                          timeout_s=timeout_s)
+
+
+def ring_chain_bcast_over_net(net, send_comm, recv_comm,
+                              local: np.ndarray, rank: int,
+                              n_ranks: int,
+                              timeout_s: float = 30.0) -> np.ndarray:
+    """Frame-pipelined relay broadcast FROM RING RANK 0 — the
+    node-local "allgather-shaped" leg of the hierarchical schedule for
+    unequal nodes (the dual of :func:`ring_chain_reduce_over_net`).
+    The ragged allgather with root-concentrated counts relays rank 0's
+    buffer around the ring, each hop's landed frames forwarded while
+    later frames are still in flight. ``local`` on every rank supplies
+    the size/dtype (the broadcast recv-buffer contract); only rank 0's
+    contents travel. Returns the broadcast buffer on every rank."""
+    x = np.asarray(local).ravel()
+    counts = np.zeros(max(1, n_ranks), np.int64)
+    counts[0] = x.size
+    segs = ring_allgatherv_over_net(net, send_comm, recv_comm,
+                                    x if rank == 0 else x[:0], counts,
+                                    rank, n_ranks, timeout_s=timeout_s)
+    return segs[0]
+
+
 def ring_alltoall_over_net(net, send_comm, recv_comm, local: np.ndarray,
                            rank: int, n_ranks: int,
                            timeout_s: float = 30.0) -> np.ndarray:

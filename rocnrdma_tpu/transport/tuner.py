@@ -948,6 +948,144 @@ def pick_bucket_bytes(n_ranks: int, small_bytes: int = 64 << 10,
     return min(b for b in cands if times[b] <= 1.1 * best)
 
 
+def _best_hop_time(model: HostWireModel, nbytes: int,
+                   world: int = 2,
+                   credit_bytes: int | None = None) -> float:
+    """Modeled seconds for ONE ring hop of ``nbytes`` on ``model``'s
+    plane at the model's own pick — the hop price every schedule cost
+    below is built from. Pure function of (inputs, committed model
+    version), like the pick it rides."""
+    if nbytes <= 0:
+        return 0.0
+    p = model.pick(nbytes, world=world, credit_bytes=credit_bytes)
+    return model.hop_time(nbytes, p.frame_bytes, p.pipeline_depth)
+
+
+def _ring_allreduce_time(model: HostWireModel, nbytes: int, world: int,
+                         credit_bytes: int | None = None) -> float:
+    """Modeled seconds for a generic ring allreduce of ``nbytes`` over
+    ``world`` ranks on ``model``'s plane: ``2(world-1)`` hops of the
+    max chunk. The 2-rank degenerate ring prices BOTH schedules the
+    wire can run (one whole-buffer exchange-and-fold vs two pipelined
+    half-hops — ``plugin.exchange_fold_preferred``'s arbitration) and
+    takes the cheaper, since that is what the wire will actually do."""
+    if world <= 1 or nbytes <= 0:
+        return 0.0
+    if world == 2:
+        half = -(-nbytes // 2)
+        return min(_best_hop_time(model, nbytes, 2, credit_bytes),
+                   2.0 * _best_hop_time(model, half, 2, credit_bytes))
+    chunk = -(-nbytes // world)
+    return 2.0 * (world - 1) * _best_hop_time(model, chunk, world,
+                                              credit_bytes)
+
+
+def pick_algorithm(nbytes: int, node_sizes, flat: HostWireModel,
+                   intra: HostWireModel,
+                   inter: HostWireModel | None = None,
+                   credit_bytes: int | None = None,
+                   verb: str = "allreduce") -> str:
+    """The node-aware ALGORITHM pick for a host-plane collective of
+    ``nbytes`` (ISSUE 14): ``"ring"`` — one flat ring over the plane
+    the comm was built on (``flat``) — or ``"hier"`` — the two-level
+    schedule of ``distributed.hier_*``: node-local legs over the
+    ``intra`` plane, cross-node legs over the ``inter`` plane (one
+    shard-parallel ring per local index when every node is the same
+    size; the leaders' full-buffer ring otherwise).
+
+    ``verb`` prices the schedule the caller will actually run — the
+    three verbs' wire patterns differ, and pricing everything as an
+    allreduce would deterministically pick the slower path for the
+    others (a flat reduce-scatter is HALF a flat allreduce, while the
+    hierarchical one runs the full allreduce schedule plus a slice;
+    a flat allgather of an ``nbytes`` contribution moves
+    ``(n-1)*nbytes``, not an allreduce's traffic):
+
+    - ``"allreduce"``: flat ``2(n-1)`` hops of the ~1/n chunk (2-rank
+      exchange-fold arbitration included) vs local RS + shard-parallel
+      cross AR + local AG (relay arms for unequal nodes);
+    - ``"reduce_scatter"``: flat ``(n-1)`` hops vs the FULL
+      hierarchical allreduce (the implementation slices its result);
+    - ``"allgather"``: ``nbytes`` is the per-rank CONTRIBUTION — flat
+      ``(n-1)`` hops of it vs local AG + cross AG of the node block
+      (+ the relay broadcast of the assembled rows when unequal).
+
+    ``node_sizes`` is the rank count per node of the CURRENT
+    membership (any deterministic order). ``inter`` defaults to
+    ``flat`` — the cross-node leg rides the same plane the flat ring
+    would have.
+
+    PURE function of (inputs, committed model versions) like every
+    pick here — the verdict must be identical on every rank (the hier
+    path wires sub-rings only when picked, so a split verdict would
+    strand half the group in a rendezvous) — and broadcast-committed
+    like every other pick: the models it prices from only change at
+    ``tune_wire``'s lockstep commit points, never per-rank. Ties keep
+    ``"ring"`` (the incumbent whose floors are committed); a >= 10%
+    modeled win is required to move, the same margin as the
+    exchange-fold arbitration."""
+    inter = flat if inter is None else inter
+    sizes = [int(s) for s in node_sizes if int(s) > 0]
+    n = sum(sizes)
+    m = len(sizes)
+    if n < 2 or m < 2 or nbytes <= 0:
+        return "ring"
+    if verb not in ("allreduce", "reduce_scatter", "allgather"):
+        raise ValueError(f"pick_algorithm: unknown verb {verb!r}")
+    uniform = len(set(sizes)) == 1
+    ln = sizes[0] if uniform else max(sizes)
+
+    def chain(model, size):
+        # (ln-1) frame-pipelined relay hops ~ one hop plus the extra
+        # hops' latency floors (the root-concentrated chain legs)
+        if ln <= 1 or size <= 0:
+            return 0.0
+        return (_best_hop_time(model, size, ln, credit_bytes)
+                + max(0, ln - 2) * model.params.alpha_hop_s)
+
+    if verb == "allgather":
+        # nbytes = the per-rank contribution; flat relays (n-1) chunks
+        t_flat = (n - 1) * _best_hop_time(flat, nbytes, n, credit_bytes)
+        if uniform:
+            # local AG, then each per-index cross ring carries only
+            # its 1/ln SHARD of the node block (== one contribution),
+            # then a second local AG reassembles the m shards
+            t_hier = ((ln - 1) * _best_hop_time(intra, nbytes, ln,
+                                                credit_bytes)
+                      + (m - 1) * _best_hop_time(inter, nbytes, m,
+                                                 credit_bytes))
+            if ln > 1:
+                t_hier += (ln - 1) * _best_hop_time(
+                    intra, m * nbytes, ln, credit_bytes)
+        else:
+            # leaders' ragged allgatherv of whole blocks + the relay
+            # broadcast of the assembled rows
+            t_hier = ((ln - 1) * _best_hop_time(intra, nbytes, ln,
+                                                credit_bytes)
+                      + (m - 1) * _best_hop_time(inter, ln * nbytes, m,
+                                                 credit_bytes)
+                      + chain(intra, n * nbytes))
+        return "hier" if t_hier < 0.9 * t_flat else "ring"
+    # the reducing verbs: the hierarchical arm is the allreduce
+    # schedule either way (reduce_scatter slices its result)
+    if uniform:
+        shard = -(-nbytes // ln) if ln > 1 else nbytes
+        t_local = 2.0 * (ln - 1) * _best_hop_time(intra, shard, ln,
+                                                  credit_bytes)
+        t_cross = _ring_allreduce_time(inter, shard, m, credit_bytes)
+    else:
+        t_local = 2.0 * chain(intra, nbytes)
+        t_cross = _ring_allreduce_time(inter, nbytes, m, credit_bytes)
+    t_hier = t_local + t_cross
+    if verb == "reduce_scatter":
+        # flat RS is the allreduce's first phase alone: (n-1) hops
+        chunk = -(-nbytes // n)
+        t_flat = (n - 1) * _best_hop_time(flat, chunk, n, credit_bytes)
+    else:
+        t_flat = _ring_allreduce_time(flat, nbytes, n, credit_bytes)
+    return "hier" if t_hier < 0.9 * t_flat else "ring"
+
+
 def _L(n: int) -> int:
     """ceil(log2 n) — step count of the log-depth schedules."""
     return max(1, math.ceil(math.log2(n)))

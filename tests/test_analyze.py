@@ -1272,3 +1272,73 @@ def test_purity_selftest_runs():
 
 def test_purity_repo_surface_is_clean():
     assert purity.run() == []
+
+
+# ---------------------------------------------------------------------------
+# pass #4 (hier) + pass #0 (hier verbs): the ISSUE-14 hierarchical
+# surface — module-level hier_* verbs must guarantee an abort flight
+# event, and must accept timeout_s like every blocking verb
+# ---------------------------------------------------------------------------
+
+
+def test_obs_flags_uninstrumented_hier_verb():
+    # hier_allreduce records-and-reraises; hier_allgather has no
+    # handler at all — only the latter is a finding
+    src = textwrap.dedent("""
+        def hier_allreduce(pg, h, x, op="sum", timeout_s=30.0):
+            try:
+                return _legs(pg, h, x, op)
+            except (TimeoutError, OSError) as e:
+                _FLIGHT.record("hier-abort", error=type(e).__name__)
+                raise
+
+        def hier_allgather(pg, h, x, timeout_s=30.0):
+            return _legs(pg, h, x, None)
+    """)
+    problems = obs.check_hier_source(src, "fix.py")
+    assert len(problems) == 1, problems
+    assert "hier_allgather guarantees no abort flight event" \
+        in problems[0], problems
+
+
+def test_obs_hier_rule_rejects_record_free_handler():
+    # a handler that tears down and re-raises WITHOUT recording is not
+    # instrumentation
+    src = textwrap.dedent("""
+        def hier_allreduce(pg, h, x, op="sum", timeout_s=30.0):
+            try:
+                return _legs(pg, h, x, op)
+            except (TimeoutError, OSError):
+                pg._hier_invalidate()
+                raise
+    """)
+    problems = obs.check_hier_source(src, "fix.py")
+    assert len(problems) == 1, problems
+    assert "hier_allreduce" in problems[0], problems
+
+
+def test_obs_hier_rule_flags_stale_surface():
+    # the repo file growing ZERO hier_* functions (a rename sweep) must
+    # surface as staleness, not silently shrink the checked surface
+    problems = obs.check_hier_source("def flat_only():\n    pass\n",
+                                     obs.HIER_FILE)
+    assert any("stale" in p for p in problems), problems
+
+
+def test_deadlines_hier_verbs_must_take_timeout(tmp_path):
+    bad = tmp_path / "distributed.py"
+    bad.write_text(textwrap.dedent("""
+        def hier_allreduce(pg, h, x, op="sum"):
+            return x
+
+        def hier_allgather(pg, h, x, timeout_s=30.0):
+            return x
+    """))
+    problems = deadlines.check_file(str(bad))
+    assert len(problems) == 1, problems
+    assert "hier_allreduce" in problems[0] \
+        and "timeout_s" in problems[0], problems
+
+
+def test_deadlines_hierarchy_on_pg_blocking_surface():
+    assert "hierarchy" in deadlines.PG_BLOCKING

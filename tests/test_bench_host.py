@@ -123,7 +123,14 @@ def test_smoke_perf_gate(tmp_path, capsys):
     gated on the int8 arm's best trial beating the committed fp32 tcp
     floor by the recorded multiple (mean held to the standard 0.8x
     allowance of the same bar) with the codec provably engaged and
-    zero steady-path copies."""
+    zero steady-path copies.
+
+    ISSUE 14 adds the HIER path: the node-aware two-level schedule on
+    a simulated 2-node x 2-rank mixed shm/tcp fleet — gated on the
+    hierarchical arm beating the same-run flat tcp ring by the
+    recorded multiple with the pick_algorithm verdict pinned on the
+    negotiation gauge, the bitwise oracle held, the per-leg codec arm
+    compressing the cross leg, and zero steady-path copies."""
     out = tmp_path / "smoke.jsonl"
     rc = bench_host.main(["--smoke", "--out", str(out)])
     assert rc == 0
@@ -134,15 +141,24 @@ def test_smoke_perf_gate(tmp_path, capsys):
     assert "smoke gate ok [lanes]" in printed
     assert "smoke gate ok [coalesce]" in printed
     assert "smoke gate ok [codec]" in printed
+    assert "smoke gate ok [hier]" in printed
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert [r["platform"] for r in rows] == ["host-shm", "host-tcp",
                                              "host-shm", "host-shm",
                                              "host-shm", "host-shm",
                                              "host-tcp", "host-tcp",
-                                             "host-tcp"]
+                                             "host-tcp", "host-tcp",
+                                             "host-tcp", "host-tcp"]
     assert [r["algo"] for r in rows] == ["ring", "ring", "ring_rdma",
                                          "lanes", "unbatched", "coalesced",
-                                         "ring", "codec-int8", "codec-fp8"]
+                                         "ring", "codec-int8", "codec-fp8",
+                                         "ring", "hier", "hier-codec"]
+    # the hier arm provably ran the two-level schedule with the
+    # verdict pinned (ISSUE 14) and the bitwise oracle held
+    hier = rows[10]
+    assert hier["extra"]["wire"]["algorithm"] == "hier"
+    assert hier["extra"]["wire"]["hier_ops"] > 0
+    assert hier["extra"]["hier"]["bitwise_ok"] is True
     for row in rows:
         # the coalesce pair shares one measurement window: its wire
         # delta rides the coalesced row only

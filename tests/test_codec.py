@@ -621,9 +621,13 @@ def test_moe_ffn_convergence_with_error_feedback(sidecar_2):
     (workloads.moe.ffn_expert: two einsums + gelu — the step the MFU
     profile counts) over a REAL 2-rank shm host wire: per-rank jax
     grads, gradient allreduce on (a) the fp32 wire and (b) an int8
-    codec lane with error feedback. The quantized trajectory must hold
-    the fp32 loss trajectory within tolerance — the acceptance gate
-    that error feedback preserves convergence."""
+    codec lane with error feedback, plus (c) the int8 lane on the
+    HIERARCHICAL schedule (node_of=[0, 1] — every rank a node leader,
+    the gradient allreduce riding the cross-node leg whose RS-phase
+    partial sum feeds the ISSUE-14 hier-xleg residual). Both quantized
+    trajectories must hold the fp32 loss trajectory within tolerance —
+    the acceptance gate that error feedback preserves convergence with
+    the hierarchical+codec path active too."""
     import jax
     import jax.numpy as jnp
 
@@ -652,7 +656,7 @@ def test_moe_ffn_convergence_with_error_feedback(sidecar_2):
                            .standard_normal((E, cap, d))
                            .astype(np.float32))
 
-    def train(pg, surface):
+    def train(pg, surface, algorithm=None):
         w_in = jnp.asarray(w_in0)
         w_out = jnp.asarray(w_out0)
         losses = []
@@ -661,7 +665,8 @@ def test_moe_ffn_convergence_with_error_feedback(sidecar_2):
                                           batch(pg.rank, step))
             flat = np.concatenate([np.asarray(g_in).ravel(),
                                    np.asarray(g_out).ravel()])
-            summed = surface.all_reduce(flat, op="avg")
+            summed = surface.all_reduce(flat, op="avg",
+                                        algorithm=algorithm)
             g_in = summed[:g_in.size].reshape(g_in.shape)
             g_out = summed[g_in.size:].reshape(g_out.shape)
             w_in = w_in - lr * g_in
@@ -677,16 +682,19 @@ def test_moe_ffn_convergence_with_error_feedback(sidecar_2):
         try:
             pg = dist.init_process_group(
                 rank=rank, world_size=n, store_handle=store_handle,
-                group_name=f"conv-{mode}", plane="shm")
+                group_name=f"conv-{mode}", plane="shm",
+                node_of=[0, 1] if mode == "hier-int8" else None)
             surface = (pg.channel("quant", codec="int8")
-                       if mode == "int8" else pg)
-            out[rank] = train(pg, surface)
+                       if mode != "fp32" else pg)
+            out[rank] = train(pg, surface,
+                              algorithm="hier" if mode == "hier-int8"
+                              else None)
         finally:
             if pg is not None:
                 pg.destroy()
 
     trajectories = {}
-    for mode in ("fp32", "int8"):
+    for mode in ("fp32", "int8", "hier-int8"):
         store = sidecar_2(n)
         outs = [None] * n
         threads = [threading.Thread(target=worker,
@@ -702,13 +710,16 @@ def test_moe_ffn_convergence_with_error_feedback(sidecar_2):
         np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
         trajectories[mode] = np.asarray(outs[0])
 
-    f, q = trajectories["fp32"], trajectories["int8"]
+    f = trajectories["fp32"]
     assert f[-1] < f[0] * 0.7  # the fp32 baseline genuinely trains
-    assert q[-1] < q[0] * 0.7  # ...and so does the quantized wire
-    # error feedback holds the loss trajectory within tolerance of the
-    # fp32 wire at every step
-    rel = np.abs(q - f) / np.maximum(1e-8, f)
-    assert float(rel.max()) < 0.15, (rel.max(), list(zip(f, q)))
+    for mode in ("int8", "hier-int8"):
+        q = trajectories[mode]
+        assert q[-1] < q[0] * 0.7, mode  # the quantized wire trains too
+        # error feedback holds the loss trajectory within tolerance of
+        # the fp32 wire at every step — flat AND hierarchical
+        rel = np.abs(q - f) / np.maximum(1e-8, f)
+        assert float(rel.max()) < 0.15, (mode, rel.max(),
+                                         list(zip(f, q)))
 
 
 @pytest.fixture

@@ -1,0 +1,576 @@
+"""The node-aware hierarchical host plane (ISSUE 14, DESIGN.md §5l):
+node-map agreement, the two-level schedules (uniform shard-parallel and
+unequal-node leader relay), per-leg codec arbitration with cross-leg
+error feedback, the pure flat-vs-hier algorithm pick, trace/digest
+coverage, heal-time repair (leader re-election) under chaos, and the
+committed hier_r01 artifact + sentinel floor."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import distributed as dist, native
+from rocnrdma_tpu.metrics import WIRE
+from rocnrdma_tpu.obs import trace as obs_trace
+from rocnrdma_tpu.transport import bootstrap, plugin, tuner
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native library not buildable")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# pick_algorithm: pure, topology-priced, deterministic
+# ---------------------------------------------------------------------------
+
+
+def test_pick_algorithm_mixed_topology_prefers_hier():
+    shm = tuner.HostWireModel("shm", params=tuner.PlaneParams.from_dict(
+        tuner.COMMITTED_HOST_PLANES["shm"]["params"]),
+        table=tuner.COMMITTED_HOST_PLANES["shm"]["table"])
+    tcp = tuner.HostWireModel("tcp", params=tuner.PlaneParams.from_dict(
+        tuner.COMMITTED_HOST_PLANES["tcp"]["params"]),
+        table=tuner.COMMITTED_HOST_PLANES["tcp"]["table"])
+    # mixed 2x2 at 1 MiB: the hierarchy crosses the slow plane once per
+    # shard in parallel instead of 6 sequential tcp hops
+    assert tuner.pick_algorithm(1 << 20, (2, 2), flat=tcp,
+                                intra=shm) == "hier"
+    # pure: same inputs, same verdict, twice
+    assert tuner.pick_algorithm(1 << 20, (2, 2), flat=tcp,
+                                intra=shm) == "hier"
+    # degenerate topologies keep the incumbent
+    assert tuner.pick_algorithm(1 << 20, (4,), flat=tcp,
+                                intra=shm) == "ring"
+    assert tuner.pick_algorithm(0, (2, 2), flat=tcp, intra=shm) == "ring"
+    # unequal nodes price the leader relay (whole buffer over the slow
+    # plane, twice through the chain): at big sizes the flat ring wins
+    assert tuner.pick_algorithm(16 << 20, (2, 1), flat=tcp,
+                                intra=shm) == "ring"
+
+
+def test_pick_algorithm_verb_arms_price_their_own_schedule():
+    """The three verbs' flat wire patterns differ: a flat
+    reduce-scatter is HALF a flat allreduce while the hierarchical one
+    runs the full allreduce schedule plus a slice — pricing everything
+    as an allreduce would deterministically pick the slower path
+    (review finding). On the committed mixed 2x2 at 1 MiB the
+    allreduce verdict is hier but the reduce_scatter verdict must be
+    ring."""
+    shm = tuner.host_wire_model("shm")
+    tcp = tuner.host_wire_model("tcp")
+    assert tuner.pick_algorithm(1 << 20, (2, 2), flat=tcp, intra=shm,
+                                verb="allreduce") == "hier"
+    assert tuner.pick_algorithm(1 << 20, (2, 2), flat=tcp, intra=shm,
+                                verb="reduce_scatter") == "ring"
+    # tiny sizes are alpha-dominated: fewer sequential slow-plane hops
+    # wins for every verb
+    assert tuner.pick_algorithm(4096, (2, 2), flat=tcp, intra=shm,
+                                verb="reduce_scatter") == "hier"
+    assert tuner.pick_algorithm(1 << 18, (2, 2), flat=tcp, intra=shm,
+                                verb="allgather") == "hier"
+    with pytest.raises(ValueError, match="unknown verb"):
+        tuner.pick_algorithm(1 << 20, (2, 2), flat=tcp, intra=shm,
+                             verb="broadcast")
+
+
+def test_pick_algorithm_is_on_the_purity_surface():
+    # the pick must be covered by the analyzer's purity pass (the
+    # name-contains-pick rule over tuner.py)
+    from tools.analyze import purity
+    assert purity._is_pick_surface("pick_algorithm", "pick_algorithm")
+
+
+# ---------------------------------------------------------------------------
+# in-process fleets (threads over a sidecar store)
+# ---------------------------------------------------------------------------
+
+
+def _run_group(n, node_of, fn, plane="shm", group="hier-t", server=None,
+               timeout=120):
+    own = server is None
+    if own:
+        server = bootstrap.BootstrapServer(n_ranks=n)
+    outs: list = [None] * n
+    errs: list = []
+
+    def worker(rank):
+        pg = None
+        try:
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=server.handle,
+                group_name=group, plane=plane, node_of=node_of,
+                timeout_s=60.0)
+            outs[rank] = fn(pg, rank)
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            import traceback
+            traceback.print_exc()
+            errs.append((rank, e))
+        finally:
+            if pg is not None:
+                pg.destroy()
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    if own:
+        server.close()
+    assert not errs, errs
+    return outs
+
+
+@needs_native
+def test_hier_allreduce_uniform_bitwise():
+    n = 4
+    xs = [np.random.default_rng(r).integers(-1000, 1000, 10001)
+          for r in range(n)]
+    want = np.sum(xs, axis=0)
+    base = WIRE.snapshot()
+    outs = _run_group(n, [0, 0, 1, 1],
+                      lambda pg, r: pg.all_reduce(xs[r],
+                                                  algorithm="hier"))
+    d = WIRE.delta(base)
+    for r in range(n):
+        np.testing.assert_array_equal(outs[r], want)
+    # the schedule genuinely ran (counted per completed hier collective)
+    assert d["hier_ops"] >= n
+    assert WIRE.negotiation()["algorithm"] == "hier"
+
+
+@needs_native
+def test_hier_allreduce_unequal_nodes_leader_relay():
+    # nodes of size 2 and 1: the relay path (chain reduce onto each
+    # node's leader, leaders' ring, chain broadcast) — the shape every
+    # post-heal shrunk node runs
+    n = 3
+    xs = [np.random.default_rng(10 + r).integers(-1000, 1000, 7777)
+          for r in range(n)]
+    want = np.sum(xs, axis=0)
+    outs = _run_group(n, [0, 0, 1],
+                      lambda pg, r: pg.all_reduce(xs[r],
+                                                  algorithm="hier"),
+                      group="hier-u")
+    for r in range(n):
+        np.testing.assert_array_equal(outs[r], want)
+
+
+@needs_native
+def test_hier_reduce_scatter_matches_flat_slices():
+    n = 4
+    xs = [np.random.default_rng(20 + r).integers(-1000, 1000, 10001)
+          for r in range(n)]
+    want = np.sum(xs, axis=0)
+    outs = _run_group(n, [0, 0, 1, 1],
+                      lambda pg, r: pg.reduce_scatter(xs[r],
+                                                      algorithm="hier"),
+                      group="hier-rs")
+    bounds = [10001 * i // n for i in range(n + 1)]
+    for r in range(n):
+        np.testing.assert_array_equal(outs[r], want[bounds[r]:bounds[r + 1]])
+
+
+@needs_native
+def test_hier_allgather_interleaved_map_reorders_to_rank_order():
+    # node map [0, 1, 0, 1]: node blocks concatenate in NODE order,
+    # which is NOT rank order — the reorder must restore it
+    n = 4
+    xs = [np.random.default_rng(30 + r).standard_normal(513)
+          .astype(np.float32) for r in range(n)]
+    want = np.stack(xs)
+    outs = _run_group(n, [0, 1, 0, 1],
+                      lambda pg, r: pg.all_gather(xs[r],
+                                                  algorithm="hier"),
+                      group="hier-ag")
+    for r in range(n):
+        np.testing.assert_array_equal(outs[r], want)
+
+
+@needs_native
+def test_same_epoch_rebuild_probes_past_burned_generation():
+    # an aborted hier collective at an UNCHANGED epoch (self_heal off)
+    # burns its rendezvous generation and invalidates; the retry must
+    # rebuild under a FRESH namespace — reusing the consumed one would
+    # fetch the dead build's closed listener handles and redial them
+    # until deadline
+    n = 4
+    gate = threading.Barrier(n)
+    x0 = np.arange(4096, dtype=np.float32)
+
+    def roundtrip(pg, r):
+        r1 = pg.all_reduce(x0 + r, algorithm="hier")
+        g1 = pg._hier.gen
+        gate.wait(timeout=60)
+        # the abort handlers' exact sequence, sans the raise
+        pg._hier_burn(pg._hier)
+        pg._hier_invalidate()
+        gate.wait(timeout=60)
+        r2 = pg.all_reduce(x0 + r, algorithm="hier")
+        return g1, pg._hier.gen, r1, r2
+
+    outs = _run_group(n, [0, 0, 1, 1], roundtrip, group="hier-gen")
+    for g1, g2, r1, r2 in outs:
+        assert (g1, g2) == (0, 1)
+        np.testing.assert_array_equal(r1, r2)
+
+
+@needs_native
+def test_hierarchy_accessor_and_leaders():
+    def info(pg, r):
+        return pg.hierarchy(timeout_s=60.0)
+    outs = _run_group(4, [0, 0, 1, 1], info, group="hier-i")
+    for h in outs:
+        assert h["leaders"] == [0, 2]
+        assert h["uniform"] is True
+        assert h["nodes"] == {"0": [0, 1], "1": [2, 3]}
+        assert h["intra_plane"] == "shm"
+    # every rank cross-wires on the uniform fast path
+    assert all(h["cross_wired"] for h in outs)
+
+
+@needs_native
+def test_node_map_disagreement_refuses_named():
+    n = 2
+    server = bootstrap.BootstrapServer(n_ranks=n)
+    errs: list = [None] * n
+
+    def worker(rank, node_of):
+        try:
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=server.handle,
+                group_name="hier-bad", plane="shm", node_of=node_of,
+                timeout_s=30.0)
+            pg.destroy()
+        except ValueError as e:
+            errs[rank] = str(e)
+
+    threads = [threading.Thread(target=worker, args=(0, [0, 1])),
+               threading.Thread(target=worker, args=(1, [0, 0]))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    server.close()
+    named = [e for e in errs if e is not None]
+    assert len(named) == 1, errs
+    assert "node map disagreement" in named[0]
+
+
+def test_node_map_length_validated():
+    with pytest.raises(ValueError, match="node_of must map every rank"):
+        dist.ProcessGroup(0, 1, None, None, group_name="hier-len",
+                          plane="shm", node_of=[0, 0])
+
+
+def test_algorithm_knob_validated():
+    pg = dist.ProcessGroup(0, 1, None, None, group_name="hier-k",
+                           plane="shm")
+    try:
+        with pytest.raises(ValueError, match="unknown algorithm"):
+            pg._pick_wire_algorithm(np.zeros(4, np.float32), "msg", "tree")
+        with pytest.raises(ValueError, match="rides the msg wire"):
+            pg._pick_wire_algorithm(np.zeros(4, np.float32), "rdma",
+                                    "hier")
+    finally:
+        pg.destroy()
+
+
+# ---------------------------------------------------------------------------
+# per-leg codec arbitration + cross-leg error feedback (mixed planes)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_per_leg_codec_compresses_only_the_cross_leg():
+    # group plane tcp (slow inter-node), intra shm: a codec="auto" lane
+    # must quantize the CROSS leg only — the committed models say int8
+    # on tcp, None on shm — and the re-encode error of the RS-phase
+    # partial sum feeds the ResidualStore (the digest moves)
+    n = 4
+    elems = 1 << 16
+    xs = [np.random.default_rng(40 + r).standard_normal(elems)
+          .astype(np.float32) for r in range(n)]
+    want = np.sum(xs, axis=0)
+    base = WIRE.snapshot()
+
+    def run(pg, r):
+        ch = pg.channel("q", codec="auto")
+        out = ch.all_reduce(xs[r], timeout_s=60.0, algorithm="hier")
+        out = ch.all_reduce(xs[r], timeout_s=60.0, algorithm="hier")
+        return out, pg.wire_stats()["codec_residual_digest"]
+
+    outs = _run_group(n, [0, 0, 1, 1], run, plane="tcp",
+                      group="hier-q")
+    d = WIRE.delta(base)
+    assert d["frames_encoded"] > 0
+    assert d["payload_bytes_saved"] > 0
+    assert d["payload_bytes_copied"] == 0
+    # cross-leg-only: each rank ships its ~1/ln shard across nodes
+    # twice (2 rounds) — if every leg had compressed, savings would be
+    # ~3x larger (2 shm legs move the full buffer per round)
+    cross_decoded = n * 2 * (elems // 2) * 4
+    assert d["payload_bytes_saved"] <= cross_decoded
+    tol = 0.05 * float(np.abs(want).max())
+    for out, digest in outs:
+        assert float(np.abs(out - want).max()) <= tol
+        # error feedback is live: the residual store holds state
+        from rocnrdma_tpu.transport.codec import ResidualStore
+        assert digest != ResidualStore().digest()
+
+
+@needs_native
+def test_explicit_codec_lane_binds_to_the_cross_leg_only():
+    # an EXPLICIT int8 lane on the hierarchical path must quantize the
+    # cross leg alone, like "auto"'s arbitrated verdict: an intra leg
+    # honoring it would quantize the node-local RS partial sums with
+    # no error feedback anywhere (the HIER_XLEG residual covers only
+    # the cross shard)
+    n = 4
+    elems = 1 << 14
+    xs = [np.random.default_rng(50 + r).standard_normal(elems)
+          .astype(np.float32) for r in range(n)]
+    want = np.sum(xs, axis=0)
+    base = WIRE.snapshot()
+
+    def run(pg, r):
+        ch = pg.channel("qx", codec="int8")
+        return ch.all_reduce(xs[r], timeout_s=60.0, algorithm="hier")
+
+    outs = _run_group(n, [0, 0, 1, 1], run, group="hier-qx")
+    d = WIRE.delta(base)
+    assert d["frames_encoded"] > 0
+    # savings bounded by the cross-leg decoded bytes alone (each rank
+    # ships its ~1/ln shard across nodes once): the shm legs moved the
+    # FULL buffer per rank, so any intra-leg encoding would blow this
+    cross_decoded = n * (elems // 2) * 4
+    assert 0 < d["payload_bytes_saved"] <= cross_decoded
+    tol = 0.05 * float(np.abs(want).max())
+    for out in outs:
+        assert float(np.abs(out - want).max()) <= tol
+
+
+def test_codec_feedback_hier_xleg_key_is_distinct():
+    from rocnrdma_tpu.transport import codec as C
+    assert C.HIER_XLEG_VERB == "hier-xleg"
+    # the key verb differs from the flat verbs, so a group mixing flat
+    # and hierarchical rounds carries independent residuals
+    assert C.HIER_XLEG_VERB not in ("all_reduce", "reduce_scatter")
+
+
+# ---------------------------------------------------------------------------
+# the chain legs (plugin) and trace coverage
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_chain_reduce_and_bcast_ride_the_stream():
+    from rocnrdma_tpu.transport.plugin import (
+        HostQPNet,
+        ring_chain_bcast_over_net,
+        ring_chain_reduce_over_net,
+    )
+    n = 3
+    net = HostQPNet()
+    net.init()
+    handles, listens = [], []
+    for _ in range(n):
+        h, l = net.listen()
+        handles.append(h)
+        listens.append(l)
+    xs = [np.random.default_rng(50 + r).integers(-100, 100, 70001)
+          for r in range(n)]
+    results: list = [None] * n
+    errs: list = []
+
+    def worker(rank):
+        try:
+            s = net.connect(0, handles[(rank + 1) % n])
+            r = net.accept(listens[rank])
+            red = ring_chain_reduce_over_net(net, s, r, xs[rank], rank, n)
+            got = ring_chain_bcast_over_net(
+                net, s, r, red if rank == 0 else np.empty_like(xs[0]),
+                rank, n)
+            results[rank] = (red, got)
+        except Exception as e:  # pragma: no cover
+            import traceback
+            traceback.print_exc()
+            errs.append((rank, e))
+
+    base = WIRE.snapshot()
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    net.close()
+    assert not errs, errs
+    want = np.sum(xs, axis=0)
+    np.testing.assert_array_equal(results[0][0], want)
+    assert results[1][0].size == 0 and results[2][0].size == 0
+    for r in range(n):
+        np.testing.assert_array_equal(results[r][1], want)
+    # the relay legs stream (zero staging copies)
+    d = WIRE.delta(base)
+    assert d["frames_streamed"] > 0
+    assert d["payload_bytes_copied"] == 0
+
+
+def test_trace_hier_records_skip_critical_path_and_digest_covers_legs():
+    # two fake single-rank records of one hier op: hop entries span two
+    # legs' namespaces; the assembler must keep walls/attribution but
+    # extract NO critical path (sub-ring `up` ids are not group ranks)
+    def rec(rank, up, legs):
+        return {"v": 1, "epoch": 0, "chan": 0, "op": 0,
+                "verb": "hier_allreduce", "rank": rank, "up": up,
+                "down": up, "members": 1, "hier_legs": legs,
+                "t_start": 0.0, "wall_s": 1.0, "n_frames": 2,
+                "hops": [[0, 1, 0.0, 0.5, 0.1],
+                         [1 << 16, 1, 0.5, 0.9, 0.6]],
+                "waits": {b: 0.0 for b in obs_trace.WAIT_BUCKETS}}
+
+    trees = obs_trace.assemble([rec(0, 1, 3), rec(1, 0, 3)], world=2)
+    assert len(trees) == 1
+    t = trees[0]
+    assert t["critical_path"] == [] and t["cp_rank"] is None
+    # walls and the five-bucket attribution survive (buckets sum to wall)
+    for info in t["ranks"].values():
+        assert abs(sum(info["attribution"].values()) - 1.0) < 1e-9
+    # the digest is structural over hier_legs: flat-vs-hier records of
+    # the same op must NOT hash equal
+    a = obs_trace.digest([rec(0, 1, 3)])
+    b = obs_trace.digest([rec(0, 1, 0)])
+    assert a != b
+
+
+def test_trace_leg_context_offsets_hops():
+    # inside leg k, frame events' hop ids lift into that leg's
+    # namespace — two legs' hop 1 must not collide in the op record
+    evs = []
+    with obs_trace.leg(1):
+        obs_trace.record("frame-posted", hop=1, frame=0)
+    with obs_trace.leg(2):
+        obs_trace.record("frame-posted", hop=1, frame=0)
+    # reconstruct via the flight ring's tail (the events carry the
+    # offset hop ids)
+    from rocnrdma_tpu.obs import FLIGHT
+    hops = [a["hop"] for _, kind, a in FLIGHT.events()
+            if kind == "frame-posted"][-2:]
+    assert hops[0] != hops[1]
+    assert hops[0] == 1 + (1 << 16) and hops[1] == 1 + (2 << 16)
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill a node leader mid-collective; heal re-elects and replays
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_kill_and_heal_hier_leader_reelects_replay_equal():
+    """The hierarchy x heal acceptance run (ISSUE 14): kill-and-heal
+    chaos with the round allreduces on the hierarchical schedule and
+    the kill landed on a NODE LEADER (rank 2 of node map [0,0,1,1]).
+    Survivors heal to epoch 1 on members [0,1,3] — node 1 shrinks to
+    {3}, whose lowest surviving original rank IS the re-elected leader
+    — the int64 bitwise oracle holds exactly-once on every committed
+    round, frames strand and fence, and two same-seed runs print
+    identical FAULTLOG/HEALLOG/TRACELOG/FLEET digests."""
+    from rocnrdma_tpu.runtime.multiprocess import run_workers
+
+    def _line(r, key):
+        for line in r.stdout.splitlines():
+            if line.startswith(key + " "):
+                return line[len(key) + 1:]
+        raise AssertionError(f"{key} missing from rank {r.process_id}:\n"
+                             f"{r.stdout}")
+
+    n, seed, rounds, victim = 4, 11, 6, 2
+    runs = [run_workers(n, "kill-and-heal", timeout_s=150.0, seed=seed,
+                        rounds=rounds, kill_ranks=str(victim),
+                        kill_ops="35", hier=True) for _ in range(2)]
+    for results in runs:
+        rc = {r.process_id: r.returncode for r in results}
+        assert rc[victim] == 7, results[victim].stdout
+        for r in results:
+            assert r.returncode != -9, \
+                f"rank {r.process_id} HUNG:\n{r.stderr}"
+            if r.process_id == victim:
+                continue
+            assert r.returncode == 0, \
+                f"survivor {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert _line(r, "EPOCH") == "1"
+            assert _line(r, "MEMBERS") == "[0, 1, 3]"
+        assert sum(int(_line(r, "FENCED")) for r in results
+                   if r.process_id != victim) > 0
+    for a, b in zip(*runs):
+        if a.process_id == victim:
+            continue
+        assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
+        assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
+        assert _line(a, "TRACELOG") == _line(b, "TRACELOG"), a.process_id
+        assert _line(a, "FLEET") == _line(b, "FLEET"), a.process_id
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact + sentinel floor
+# ---------------------------------------------------------------------------
+
+
+def test_committed_hier_record_schema():
+    path = os.path.join(REPO, "results", "hier_r01.json")
+    with open(path) as fp:
+        doc = json.load(fp)
+    assert doc["schema"] == "hier_r01"
+    assert doc["topology"]["node_map"] == [0, 0, 1, 1]
+    floors = doc["floors"]
+    assert floors["hier_min_x"] == 1.3
+    assert floors["at_bytes"] == 1 << 20
+    algos = [r["algo"] for r in doc["records"]]
+    assert algos == ["ring", "hier", "hier-codec"]
+    hier = doc["records"][1]
+    hx = hier["extra"]["hier"]
+    # the committed capability: hierarchical beat the flat tcp ring by
+    # the acceptance multiple at 1 MiB on the mixed topology, bitwise,
+    # with the verdict pinned and the schedule genuinely engaged
+    assert hx["speedup_best"] >= floors["hier_min_x"]
+    assert hx["bitwise_ok"] is True
+    assert hx["verdict"] == "hier"
+    assert hx["hier_ops"] > 0
+    assert hier["extra"]["wire"]["algorithm"] == "hier"
+    assert hier["extra"]["wire"]["payload_bytes_copied"] == 0
+    # ...and the per-leg codec arm compressed the cross leg only
+    codec = doc["records"][2]["extra"]["hier"]
+    assert codec["frames_encoded"] > 0
+    assert 0 < codec["bytes_saved"] <= codec["hier_ops"] * (1 << 20)
+
+
+def test_sentinel_hier_floor_fixed_point():
+    from tools import sentinel
+    path = os.path.join(sentinel.RESULTS, "hier_r01.json")
+    with open(path) as fp:
+        rows = json.load(fp)["records"]
+    assert sentinel.check_hier_floor(rows) == []
+    assert "hier_r01.json" in sentinel.COMMITTED_FILES
+    import copy
+    bad = copy.deepcopy(rows)
+    for r in bad:
+        hx = r.get("extra", {}).get("hier")
+        if hx:
+            hx["speedup_best"] = 1.0
+    assert sentinel.check_hier_floor(bad), \
+        "a sub-floor hier row must be a finding"
+    # a 'hier' row that silently fell back to the flat ring is ALSO a
+    # finding (its self-relative speedup proves nothing)
+    lazy = copy.deepcopy(rows)
+    for r in lazy:
+        hx = r.get("extra", {}).get("hier")
+        if hx:
+            hx["hier_ops"] = 0
+    assert any("hier_engaged" in f
+               for f in sentinel.check_hier_floor(lazy))

@@ -204,21 +204,30 @@ def test_format_table_shows_codec_column():
 def test_negotiation_gauges_record_and_reset():
     w = M.WireCounters()
     assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0,
-                               "tuner_version": None, "codec": None}
+                               "tuner_version": None, "codec": None,
+                               "algorithm": None}
     w.negotiated(524288, 2)
     assert w.negotiation() == {"frame_bytes": 524288, "pipeline_depth": 2,
-                               "tuner_version": None, "codec": None}
+                               "tuner_version": None, "codec": None,
+                               "algorithm": None}
     # the tuner's pick records the model version that chose it (PR 12),
-    # and the wire codec in force rides the same gauge (ISSUE 13)
+    # the wire codec in force rides the same gauge (ISSUE 13), and the
+    # node-aware flat-vs-hier verdict pins next to them (ISSUE 14)
     w.negotiated(524276, 3, tuner_version=4, codec="int8")
+    w.algorithm_picked("hier")
     assert w.negotiation() == {"frame_bytes": 524276,
                                "pipeline_depth": 3, "tuner_version": 4,
-                               "codec": "int8"}
+                               "codec": "int8", "algorithm": "hier"}
     # gauges, not counters: they never appear in the delta window
     assert "frame_bytes" not in w.delta(w.snapshot())
+    # ...while hier_ops is a real counter and does
+    w.hier()
+    assert w.delta({})["hier_ops"] == 1
     w.reset()
     assert w.negotiation() == {"frame_bytes": 0, "pipeline_depth": 0,
-                               "tuner_version": None, "codec": None}
+                               "tuner_version": None, "codec": None,
+                               "algorithm": None}
+    assert w.snapshot()["hier_ops"] == 0
 
 
 def test_verb_latency_log_buckets():

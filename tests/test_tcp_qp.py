@@ -38,6 +38,33 @@ def pair():
 
 
 @needs_native
+def test_close_during_blocked_recv_refuses_named(pair):
+    # a recv parked in the kernel (rtcp_wait_readable holds the raw
+    # Conn* inside C for up to one 50 ms beat) must survive a
+    # concurrent close(): the wait lock lets the beat finish before
+    # the native state is freed, and the next loop round refuses
+    # named instead of handing the freed handle to poll_cq
+    a, _b = pair
+    got: dict = {}
+
+    def blocked():
+        try:
+            a.recv(timeout_s=10.0)
+        except (OSError, TimeoutError) as e:
+            got["err"] = e
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    import time
+    time.sleep(0.1)          # let the recv reach its parked idle beat
+    a.close()                # frees the Conn under the parked poll
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert isinstance(got.get("err"), OSError)
+    assert "closed" in str(got["err"])
+
+
+@needs_native
 def test_tcp_roundtrip(pair):
     a, b = pair
     b.send(b"over the wire")

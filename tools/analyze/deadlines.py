@@ -82,7 +82,19 @@ PG_BLOCKING = {
     # the trace window from the store AND runs a broadcast commit —
     # both waits a caller must be able to bound
     "tune_wire",
+    # the node-aware hierarchy (ISSUE 14): hierarchy() builds the
+    # epoch's sub-rings — a group-wide store rendezvous plus per-leg
+    # ring wiring, every wait a caller must be able to bound
+    "hierarchy",
 }
+
+# RULE 3 (continued) — the hierarchical schedule surface (ISSUE 14):
+# the module-level ``hier_*`` functions in distributed.py each run a
+# multi-leg schedule of blocking ring collectives (and the leader
+# re-election happens implicitly in the rebuild they trigger on
+# abort), so every one must accept timeout_s; the ``ring_chain_*``
+# relay legs in plugin.py are covered by RING_VERB_RE already.
+HIER_VERB_RE = re.compile(r"^hier_\w+$")
 
 # RULE 3 (continued) — the multi-tenant lane surface (PR 9): a
 # ChannelHandle verb blocks exactly like the ProcessGroup verb it wraps
@@ -181,6 +193,9 @@ def check_file(path: str) -> list[str]:
                 # RULE 3: the named blocking surface always takes timeout_s
                 named = ((base_name == "plugin.py"
                           and RING_VERB_RE.match(child.name))
+                         or (base_name == "distributed.py"
+                             and not qual
+                             and HIER_VERB_RE.match(child.name))
                          or (base_name == "distributed.py"
                              and qual == ["ProcessGroup"]
                              and child.name in PG_BLOCKING)

@@ -178,6 +178,16 @@ CODEC_ENTRY_MARKERS = {"_codec_entry"}
 CODEC_ABORT_MARKERS = {"_codec_abort"}
 CODEC_SURFACE = ("encode", "decode_fold", "roundtrip", "ef_update")
 
+# the hierarchical schedule surface (ISSUE 14): every module-level
+# ``hier_*`` function in distributed.py runs a multi-leg schedule whose
+# abort must tear the hierarchy down AND leave its story on the flight
+# timeline (a silent leg failure is exactly the postmortem blind spot
+# that turns "the hierarchical collective hung" into guesswork) — each
+# must contain an except handler that both records (the abort markers)
+# and re-raises, the same guaranteed shape as the elastic rule.
+HIER_FILE = "rocnrdma_tpu/distributed.py"
+HIER_PREFIX = "hier_"
+
 ALLOW: dict[str, str] = {}
 
 
@@ -326,6 +336,47 @@ def elastic_problems(tree: ast.Module, where: str,
                 f"that records — _FLIGHT.record/_stall/postmortem — and "
                 f"re-raises, or ALLOW it with a reason); a silent "
                 f"grow/promote abort is untriageable after the fact")
+    return problems
+
+
+def hier_problems(tree: ast.Module, where: str,
+                  used: set | None = None) -> list[str]:
+    """The hierarchical-surface invariant (ISSUE 14): every MODULE-LEVEL
+    ``hier_*`` function must contain at least one ``except`` handler
+    that re-raises and records — the guaranteed-abort shape of the
+    elastic rule, because a hierarchical collective that dies in leg 2
+    of 3 must name the leg (and tear the hierarchy down) where the
+    postmortem can see it."""
+    problems = []
+    found = False
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith(HIER_PREFIX):
+            continue
+        found = True
+        key = node.name
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        instrumented = any(
+            isinstance(sub, ast.ExceptHandler)
+            and any(isinstance(s, ast.Raise) for s in ast.walk(sub))
+            and ({base.call_name(c) for c in ast.walk(sub)
+                  if isinstance(c, ast.Call)} & ABORT_MARKERS)
+            for sub in ast.walk(node))
+        if not instrumented:
+            problems.append(
+                f"{where}:{node.lineno}: hierarchical verb {key} "
+                f"guarantees no abort flight event (wrap the schedule "
+                f"in an except that records — _FLIGHT.record/_stall/"
+                f"postmortem — and re-raises, or ALLOW it with a "
+                f"reason); a silent leg failure is untriageable")
+    if not found and where == HIER_FILE:
+        problems.append(
+            f"{where}: no module-level {HIER_PREFIX}* functions found — "
+            f"the surface list in tools/analyze/obs.py is stale")
     return problems
 
 
@@ -568,6 +619,13 @@ def check_elastic_source(src: str, path: str = "<fixture>") -> list[str]:
     return elastic_problems(ast.parse(src, filename=path), path)
 
 
+def check_hier_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the hierarchical-surface invariant alone
+    (pass a non-HIER_FILE path so the found-nothing staleness guard
+    stays out of fixture runs)."""
+    return hier_problems(ast.parse(src, filename=path), path)
+
+
 def check_telemetry_source(src: str, path: str = "<fixture>") -> list[str]:
     """Fixture entry point for the telemetry-publish invariant alone."""
     return telemetry_problems(ast.parse(src, filename=path), path)
@@ -600,6 +658,7 @@ def run() -> list[str]:
         problems += abort_problems(base.parse_file(target), target, used)
     problems += elastic_problems(base.parse_file(ELASTIC_FILE),
                                  ELASTIC_FILE, used)
+    problems += hier_problems(base.parse_file(HIER_FILE), HIER_FILE, used)
     problems += telemetry_problems(base.parse_file(TELEMETRY_FILE),
                                    TELEMETRY_FILE, used)
     problems += lane_problems(base.parse_file(LANE_FILE), LANE_FILE, used)

@@ -54,7 +54,7 @@ RESULTS = os.path.join(REPO, "results")
 # committed record files whose rows are floor material; each entry
 # names the JSON path and how to pull BenchRecord-shaped rows out
 COMMITTED_FILES = ("coalesce_r01.json", "lanes_r01.json", "tune_r01.json",
-                   "tune_r02.json", "codec_r01.json")
+                   "tune_r02.json", "codec_r01.json", "hier_r01.json")
 
 # decay thresholds for the between-floors checks: the worst-rank verb
 # P99 may grow to this multiple of its committed twin before it is a
@@ -334,6 +334,53 @@ def check_codec_floor(current: list[dict],
     return findings
 
 
+def check_hier_floor(current: list[dict],
+                     results_dir: str = RESULTS) -> list[dict]:
+    """The hierarchical scenario's OWN ratchet (ISSUE 14): a current
+    hier row at or past the committed size must keep its best-trial
+    speedup over the same-run flat ring >= the committed ``hier_min_x``
+    floor (hierarchical-beats-flat on the mixed topology — the
+    acceptance multiple, not the measured headroom), and must have
+    genuinely run the two-level schedule (``hier_ops`` moved — a
+    'hierarchical' row that silently fell back to the flat ring would
+    otherwise trivially match its own baseline)."""
+    path = os.path.join(results_dir, "hier_r01.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as fp:
+        doc = json.load(fp)
+    floors = doc["floors"]
+    # committed twins by row identity: a regression finding carries the
+    # which-bucket-grew diff against ITS committed trace, like the
+    # row-wise ratchet's findings do
+    committed = {record_key(r): r for r in doc.get("records", [])}
+    findings = []
+    for rec in current:
+        hx = rec.get("extra", {}).get("hier")
+        if hx is None or rec.get("algo") != "hier":
+            continue
+        if rec.get("size_bytes", 0) < floors.get("at_bytes", 1 << 20):
+            continue
+        best = hx.get("speedup_best", hx.get("speedup", 0.0)) or 0.0
+        if not hx.get("hier_ops"):
+            findings.append({
+                "key": record_key(rec),
+                "hier_engaged": False,
+                "trace_diff": None,
+            })
+        elif best < floors["hier_min_x"]:
+            twin = committed.get(record_key(rec), {})
+            findings.append({
+                "key": record_key(rec),
+                "hier_speedup": best,
+                "floor": floors["hier_min_x"],
+                "trace_diff": attribution_diff(
+                    rec.get("extra", {}).get("trace"),
+                    twin.get("extra", {}).get("trace")),
+            })
+    return findings
+
+
 def check_current(current: list[dict],
                   results_dir: str = RESULTS,
                   ratio: float = 0.8) -> list[dict]:
@@ -345,6 +392,7 @@ def check_current(current: list[dict],
     return (compare(current, committed, ratio)
             + check_speedup_floor(current, results_dir)
             + check_codec_floor(current, results_dir)
+            + check_hier_floor(current, results_dir)
             + check_wp99_creep(current, committed)
             + check_cp_share_drift(current, committed))
 
@@ -369,6 +417,15 @@ def format_findings(findings: list[dict]) -> str:
                          f"exceeds the committed {f['err_ceil']} ceiling "
                          f"— a speedup bought by coarser quantization "
                          f"is a regression")
+        elif "hier_engaged" in f:
+            lines.append(f"  {key}: the 'hier' row never ran the "
+                         f"two-level schedule (hier_ops=0) — its "
+                         f"speedup proves nothing")
+        elif "hier_speedup" in f:
+            lines.append(f"  {key}: hierarchical best-trial speedup "
+                         f"{f['hier_speedup']}x over the flat ring "
+                         f"fell below the committed {f['floor']}x "
+                         f"floor on the mixed topology")
         elif "wp99_us" in f:
             lines.append(f"  {key}: worst-rank verb P99 crept to "
                          f"{f['wp99_us']}us — {f['factor']}x the "
