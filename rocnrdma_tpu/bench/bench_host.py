@@ -751,7 +751,7 @@ def _trace_summary(pg, collective: str) -> dict:
 
 def worker(args) -> int:
     from rocnrdma_tpu import distributed as dist
-    from rocnrdma_tpu.metrics import VERBS, WIRE
+    from rocnrdma_tpu.metrics import STORE, VERBS, WIRE
 
     node_of = ([int(v) for v in args.node_map.split(",")]
                if args.node_map else None)
@@ -805,6 +805,7 @@ def worker(args) -> int:
             # STEADY-state copy/stream/overlap telemetry of the timed loop
             wire_base = WIRE.snapshot()
             verb_base = VERBS.snapshot()
+            store_base = STORE.snapshot()
             spans = []
             for _ in range(args.repeats):
                 pg.barrier()
@@ -812,6 +813,11 @@ def worker(args) -> int:
                 for _ in range(args.iters):
                     _issue(pg, collective, x, args.transport, counts)
                 spans.append((time.perf_counter() - t0) / args.iters)
+            # the store-ops ledger window (ISSUE 15): how many bootstrap
+            # round-trips the timed loop's control plane cost, by class
+            # — the format_table sops column; a collective that grew
+            # store chatter is a regression even when the GB/s holds
+            store = STORE.delta(store_base)
             wire = WIRE.delta(wire_base)
             # windowed, same as every other gated counter: the lifetime
             # ratio would dilute the steady loop with the warmup's frames
@@ -868,7 +874,8 @@ def worker(args) -> int:
                     counts=ragged, iters=args.iters, repeats=args.repeats,
                     spread=[round(spread_gb[0], 4), round(spread_gb[-1], 4)],
                     wire=wire, verb_lat=VERBS.delta(verb_base),
-                    fleet=fleet, trace=_trace_summary(pg, collective)))
+                    store=store, fleet=fleet,
+                    trace=_trace_summary(pg, collective)))
     pg.barrier()
     pg.destroy()
     if pg.rank == 0:

@@ -37,7 +37,11 @@ import time
 
 import numpy as np
 
-from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
+from rocnrdma_tpu.metrics import (
+    STORE as _STORE_OPS,
+    VERBS as _VERB_LAT,
+    WIRE as _WIRE,
+)
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
 from rocnrdma_tpu.obs import fleet as _fleet
 from rocnrdma_tpu.obs import trace as _trace
@@ -884,6 +888,13 @@ class ProcessGroup:
         # publish() on its tick (piggybacking the liveness heartbeat);
         # publish_telemetry()/fleet_stats() are the explicit entries
         self._fleet_agent = _fleet.FleetAgent(self)
+        # the telemetry tree's per-node aggregator role (ISSUE 15):
+        # every rank holds one; tick() no-ops unless this rank is its
+        # node's elected agent (lowest surviving original in the node
+        # — the hier-ring leader's election, dead-set- and
+        # heal-re-elected). Rides the watchdog tick after the per-rank
+        # publish; strictly best-effort and bounded like it.
+        self._node_agent = _fleet.NodeAgent(self)
         self._p2p: dict[tuple, "plugin._RingWire"] = {}  # (peer, dir) -> wire
         # sequence counters are keyed by the peer's ORIGINAL rank (via
         # _pstate): a heal/grow renumbers peers but an unbroken pair's
@@ -3970,6 +3981,16 @@ class ProcessGroup:
         with self._health_lock:
             return [list(t) for t in self._health_log]
 
+    def confirmed_dead(self) -> list:
+        """The watchdog's confirmed-dead peers as ORIGINAL rank ids
+        (empty without a running watchdog) — the identity the telemetry
+        tree's agent election keys on: a dead agent's node re-elects
+        its next-lowest surviving original from these flags, without
+        waiting for the heal."""
+        with self._health_lock:
+            dead = list(self._dead)
+        return [self._ranks[p] for p in dead if p < len(self._ranks)]
+
     def publish_telemetry(self, timeout_s: float = 2.0) -> bool:
         """ONE explicit, bounded, best-effort publish of this rank's
         telemetry snapshot to the store (the watchdog tick does this
@@ -3980,18 +4001,32 @@ class ProcessGroup:
         if self._client is None or self._standby is not None \
                 or self._destroyed:
             return False
-        return self._fleet_agent.publish(self._client, timeout_s=timeout_s)
+        ok = self._fleet_agent.publish(self._client, timeout_s=timeout_s)
+        # the tree's aggregation pass rides the same explicit flush (a
+        # no-op on every rank that is not its node's elected agent) —
+        # best-effort: a failed tick degrades the node to direct
+        # per-rank reads at the observer, never fails the publish
+        if ok:
+            self._node_agent.tick(self._client, timeout_s=timeout_s)
+        return ok
 
-    def fleet_stats(self, timeout_s: float = 5.0) -> dict:
-        """The LIVE fleet snapshot: this rank's fresh local telemetry
-        merged with every other member's latest published snapshot from
-        the store (``obs.fleet.aggregate`` — wire counters summed
-        field-wise, verb latency histograms added bucket-wise so the
-        merged P50/P99 are bucket-exact, per-rank health and windowed
-        throughput alongside). Any member may call it; the natural
-        caller is the leader (or an operator via the
+    def fleet_stats(self, timeout_s: float = 5.0,
+                    flat: bool = False) -> dict:
+        """The LIVE fleet snapshot (``obs.fleet`` — wire counters
+        summed field-wise, verb latency histograms added bucket-wise so
+        the merged P50/P99 are bucket-exact, per-rank health and
+        windowed throughput alongside). Any member may call it; the
+        natural caller is the leader (or an operator via the
         ``python -m rocnrdma_tpu.obs.fleet`` CLI, which reads the same
         keys without being a member).
+
+        Read shape (ISSUE 15): the default path reads the telemetry
+        tree's ROOT subtree digest first — O(log n) store traffic on a
+        fleet whose node agents are publishing — and falls back to
+        direct per-rank snapshot reads (plus this rank's fresh local
+        telemetry) for exactly the members the digest does not cover:
+        a fleet with no agents degrades to precisely the old flat
+        read, and ``flat=True`` forces it (the escape hatch).
 
         Epoch fencing: only this generation's keys are read, and a
         payload stamped with another epoch is dropped and counted
@@ -4006,49 +4041,73 @@ class ProcessGroup:
             raise RuntimeError(
                 "fleet_stats: this rank is a standby (promotion pending); "
                 "it has no membership to aggregate over")
-        snaps: list = [self._fleet_agent.local_snapshot()]
-        snaps += self._fetch_member_snapshots(timeout_s)
-        return _fleet.aggregate(snaps, epoch=self.epoch,
-                                members=list(self._ranks))
+        deadline = time.monotonic() + timeout_s
+        root = None if flat else self._tree_root_digest(deadline)
+        covers = (set(root.get("covers", ()))
+                  if root is not None else set())
+        members = list(self._ranks)
+        me = members[self.rank] if members else -1
+        uncovered = [m for m in members if m not in covers]
+        snaps: list = ([self._fleet_agent.local_snapshot()]
+                       if me in uncovered or not members else [])
+        snaps += self._fetch_member_snapshots(
+            max(0.0, deadline - time.monotonic()),
+            origs=[m for m in uncovered if m != me])
+        digest = _fleet.merge_digests(
+            [root, _fleet.digest_of_snapshots(snaps, self.epoch,
+                                              uncovered)],
+            self.epoch)
+        return _fleet._assemble(digest, self.epoch, members)
 
-    def _fetch_member_snapshots(self, timeout_s: float) -> list:
-        """Every OTHER member's latest published telemetry payload,
-        parsed — the shared fetch of ``fleet_stats``/``trace_stats``.
-        One overall deadline; a rank whose key cannot be read (or
-        parsed) in time is simply absent, never waited for."""
-        out: list = []
+    def _tree_root_digest(self, deadline: float):
+        """The telemetry tree's root subtree digest for THIS epoch, or
+        None — the member-side wrapper of ``obs.fleet``'s ONE root
+        fetch (same epoch fence, same flight event), classed as
+        telemetry-read on the ledger. The caller falls back to
+        per-rank fetches for whatever it does not cover."""
         if self._client is None:
-            return out
+            return None
+        with bootstrap.store_traffic("telemetry-read"):
+            return _fleet.fetch_root_digest(
+                self._client, self.group_name, self.epoch,
+                max(0.0, deadline - time.monotonic()))
+
+    def _fetch_member_snapshots(self, timeout_s: float,
+                                origs=None) -> list:
+        """Published telemetry payloads for ``origs`` (default: every
+        OTHER member), parsed — the member-side wrapper of
+        ``obs.fleet``'s ONE per-rank fetch, shared by
+        ``fleet_stats``/``trace_stats`` (their flat path, and the
+        tree path's fallback for uncovered members). One overall
+        deadline; a rank whose key cannot be read (or parsed) in time
+        is simply absent, never waited for."""
+        if self._client is None:
+            return []
         deadline = time.monotonic() + timeout_s
         me = self._ranks[self.rank] if self._ranks else -1
-        for g in self._ranks:
-            if g == me or time.monotonic() >= deadline:
-                continue
-            try:
-                raw = self._client.try_get(
-                    _fleet.snapshot_key(self.group_name, self.epoch, g),
-                    timeout_s=deadline - time.monotonic())
-            except (OSError, TimeoutError):
-                raw = None  # reported as missing, never waited for
-            if raw is not None:
-                import json
-                try:
-                    out.append(json.loads(raw))
-                except ValueError:
-                    pass  # a torn write reads as missing
-        return out
+        targets = (origs if origs is not None
+                   else [g for g in self._ranks if g != me])
+        with bootstrap.store_traffic("telemetry-read"):
+            snaps = _fleet._fetch_snaps(
+                self._client, self.group_name, self.epoch, targets,
+                lambda: deadline - time.monotonic())
+        return [s for s in snaps if s is not None]
 
-    def trace_stats(self, timeout_s: float = 5.0) -> dict:
+    def trace_stats(self, timeout_s: float = 5.0,
+                    flat: bool = False) -> dict:
         """The assembled causal traces of recent SAMPLED collectives:
         this rank's op records (``obs.trace.TRACE``) merged with every
         other member's latest published records (they ride the fleet
-        telemetry snapshots — same store channel, same bounded
-        best-effort rules) into per-op cross-rank span trees with their
-        critical paths, plus the windowed straggler scoreboard. Only
-        ops for which EVERY current member's record is present are
-        assembled — a partial tree's critical path would blame whoever
-        happened to publish. Reads are bounded by ``timeout_s``
-        overall; nothing here touches the collective hot path."""
+        telemetry snapshots AND the tree digests — same store channel,
+        same bounded best-effort rules, same O(log n) root-digest read
+        with per-rank fallback as ``fleet_stats``; ``flat=True`` forces
+        the per-rank read) into per-op cross-rank span trees with
+        their critical paths, plus the windowed straggler scoreboard.
+        Only ops for which EVERY current member's record is present
+        are assembled — a partial tree's critical path would blame
+        whoever happened to publish. Reads are bounded by
+        ``timeout_s`` overall; nothing here touches the collective hot
+        path."""
         if self._standby is not None:
             raise RuntimeError(
                 "trace_stats: this rank is a standby (promotion "
@@ -4058,7 +4117,18 @@ class ProcessGroup:
         # would pair ranks that no longer neighbour each other
         records = [r for r in _trace.TRACE.snapshot()
                    if r.get("epoch") == self.epoch]
-        for s in self._fetch_member_snapshots(timeout_s):
+        deadline = time.monotonic() + timeout_s
+        root = None if flat else self._tree_root_digest(deadline)
+        if root is not None:
+            records.extend(r for r in root.get("trace", [])
+                           if r.get("epoch") == self.epoch)
+        covers = (set(root.get("covers", ()))
+                  if root is not None else set())
+        me = self._ranks[self.rank] if self._ranks else -1
+        uncovered = [m for m in self._ranks
+                     if m not in covers and m != me]
+        for s in self._fetch_member_snapshots(
+                max(0.0, deadline - time.monotonic()), origs=uncovered):
             if s.get("epoch") == self.epoch:
                 records.extend(r for r in s.get("trace", [])
                                if r.get("epoch") == self.epoch)
@@ -4119,7 +4189,8 @@ class ProcessGroup:
                 client = bootstrap.BootstrapClient(
                     self._store_handle, self.rank,
                     timeout_s=interval_s + timeout_s,
-                    scope=f"pg/{self.group_name}/ring")
+                    scope=f"pg/{self.group_name}/ring",
+                    traffic_class="heartbeat")
                 beat = 0
                 seen: dict[int, tuple] = {}  # target -> (value, stamp)
                 dead: set[int] = set()
@@ -4185,6 +4256,12 @@ class ProcessGroup:
                             last_publish = t_pub
                             self._fleet_agent.publish(
                                 client, timeout_s=publish_budget)
+                            # the telemetry tree's aggregation pass
+                            # (ISSUE 15): a no-op on every rank that
+                            # is not its node's elected agent; bounded
+                            # and absorbed like the publish itself
+                            self._node_agent.tick(
+                                client, timeout_s=publish_budget)
                     except TimeoutError:
                         pass  # one slow store RPC: keep ticking, not die
                     self._watchdog_stop.wait(interval_s)
@@ -4215,6 +4292,10 @@ class ProcessGroup:
         s["overlap_ratio"] = round(_WIRE.overlap_ratio(), 4)
         s.update(_WIRE.negotiation())
         s["verb_latency"] = _VERB_LAT.snapshot()
+        # the store-ops ledger (ISSUE 15): this rank's bootstrap-store
+        # round-trips per traffic class — the control plane's own cost
+        # next to the wire counters it exists to observe
+        s["store_ops"] = _STORE_OPS.snapshot()
         # the recovery gauges: which group generation this rank runs on
         # (frames_fenced in the snapshot above counts the stale frames
         # the epoch fence dropped), and how many heals got it here

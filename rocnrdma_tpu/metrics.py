@@ -456,6 +456,108 @@ class WireCounters:
 WIRE = WireCounters()
 
 
+# the traffic classes the store-ops ledger attributes round-trips to
+# (ISSUE 15): everything the control plane asks of the bootstrap store
+# falls into one of these, so "per-rank control traffic is O(1) per
+# window and observer traffic O(log n)" is a COUNTED invariant the
+# simfleet harness and the sentinel ratchet can hold, not a vibe.
+STORE_CLASSES = (
+    "heartbeat",          # watchdog beats, death keys, liveness probes
+    "telemetry-publish",  # fleet snapshot/meta/node-digest writes
+    "telemetry-read",     # fleet/trace observer + node-agent reads
+    "rendezvous",         # bootstrap/hier ring wiring, heal/grow protocol
+    "election",           # first-writer-wins proposals (agree/setnx)
+    "prune",              # epoch-bump store hygiene sweeps
+)
+
+
+class StoreCounters:
+    """Per-traffic-class ledger of bootstrap-store round-trips.
+
+    Counted at :meth:`transport.bootstrap.BootstrapClient._rpc` — the
+    ONE choke point every store conversation flows through — so every
+    request→reply (polls included: a blocking ``get`` that polls ten
+    times is ten round-trips of load on the store) lands in exactly one
+    class of :data:`STORE_CLASSES`. Per-op counts ride alongside under
+    ``class:op`` keys for postmortems; the class totals are the
+    contract surface (``wire_stats()``, fleet snapshots, the simfleet
+    harness, sentinel's ``check_store_traffic``).
+
+    Same lock/window/merge discipline as :class:`WireCounters`:
+    producers may run from the watchdog thread, consumers window with
+    ``snapshot()``/``delta()``, and cross-rank totals add key-wise
+    exactly (disjoint per-rank events)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_class: dict[str, int] = {}
+        self._by_op: dict[str, int] = {}
+
+    def count(self, traffic_class: str, op: str | None = None,
+              n: int = 1) -> None:
+        """Record ``n`` store round-trips of ``traffic_class`` (an
+        unknown class counts under itself — the ledger never drops
+        traffic it cannot name — and ``op`` attributes the RPC op for
+        the per-op split)."""
+        with self._lock:
+            self._by_class[traffic_class] = \
+                self._by_class.get(traffic_class, 0) + n
+            if op is not None:
+                key = f"{traffic_class}:{op}"
+                self._by_op[key] = self._by_op.get(key, 0) + n
+
+    def snapshot(self) -> dict:
+        """``{"ops": total, "classes": {...}, "by_op": {...}}`` — plain
+        JSON-able data, the wire_stats()/fleet-snapshot format."""
+        with self._lock:
+            return {"ops": sum(self._by_class.values()),
+                    "classes": dict(self._by_class),
+                    "by_op": dict(self._by_op)}
+
+    def delta(self, since: dict | None) -> dict:
+        """Ledger movement since a ``snapshot()`` — the measurement
+        window simfleet and the bench attach (key-wise, like the wire
+        counters' per-lane dicts)."""
+        return self.delta_of(self.snapshot(), since)
+
+    @staticmethod
+    def delta_of(cur: dict, since: dict | None) -> dict:
+        if since is None:
+            return {"ops": cur.get("ops", 0),
+                    "classes": dict(cur.get("classes", {})),
+                    "by_op": dict(cur.get("by_op", {}))}
+        out = {"ops": cur.get("ops", 0) - since.get("ops", 0)}
+        for field in ("classes", "by_op"):
+            base = since.get(field, {})
+            out[field] = {k: v - base.get(k, 0)
+                          for k, v in cur.get(field, {}).items()
+                          if v - base.get(k, 0)}
+        return out
+
+    @staticmethod
+    def merge(snapshots) -> dict:
+        """Cross-rank merge of ledger snapshots/deltas: exact key-wise
+        integer addition, like every counter merge here."""
+        out = {"ops": 0, "classes": {}, "by_op": {}}
+        for s in snapshots:
+            out["ops"] += s.get("ops", 0)
+            for field in ("classes", "by_op"):
+                m = out[field]
+                for k, v in s.get(field, {}).items():
+                    m[k] = m.get(k, 0) + v
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._by_class = {}
+            self._by_op = {}
+
+
+# THE process-wide store-ops ledger (one per rank process, like WIRE):
+# transport.bootstrap counts into it at the RPC choke point.
+STORE = StoreCounters()
+
+
 class VerbLatencies:
     """Per-verb latency histograms for the net-vtable blocking verbs.
 
@@ -777,17 +879,24 @@ def format_table(records: list) -> str:
     ``codec`` names the wire compression the row's streams ran under
     (``extra["wire"]["codec"]`` — the negotiated gauge, so it reports
     what the wire ACTUALLY did, including an ``auto`` knob the tuner
-    resolved to off); ``-`` for uncompressed rows."""
+    resolved to off); ``-`` for uncompressed rows.
+    ``sops`` is the store-ops ledger's window total for the
+    measurement (``extra["store"]["ops"]`` — how many bootstrap-store
+    round-trips the row's control plane cost, ISSUE 15): a collective
+    whose measurement grew store chatter is a control-plane regression
+    even when the GB/s holds; ``-`` for rows with no ledger window."""
     hdr = (f"{'collective':>13} {'algo':>12} {'ranks':>5} {'bytes':>14} "
            f"{'dtype':>9} {'tier':>18} {'lane':>9} {'time(us)':>12} "
            f"{'algbw GB/s':>11} {'busbw GB/s':>11} {'wp99(us)':>9} "
-           f"{'cp-rank':>8} {'bfill%':>7} {'picks':>10} {'codec':>6}")
+           f"{'cp-rank':>8} {'bfill%':>7} {'picks':>10} {'codec':>6} "
+           f"{'sops':>6}")
     lines = [hdr, "-" * len(hdr)]
     for r in records:
         wp99 = r.extra.get("fleet", {}).get("worst_p99_us")
         cp = r.extra.get("trace", {}).get("cp_rank")
         fill = r.extra.get("coalesce", {}).get("fill_pct")
         wire = r.extra.get("wire", {})
+        sops = r.extra.get("store", {}).get("ops")
         picks = "-"
         if wire.get("frame_bytes"):
             picks = (f"{wire['frame_bytes'] // 1024}K"
@@ -801,7 +910,8 @@ def format_table(records: list) -> str:
             f"{cp if cp is not None else '-':>8} "
             f"{fill if fill is not None else '-':>7} "
             f"{picks:>10} "
-            f"{wire.get('codec') or '-':>6}"
+            f"{wire.get('codec') or '-':>6} "
+            f"{sops if sops is not None else '-':>6}"
         )
     return "\n".join(lines)
 

@@ -336,6 +336,13 @@ def _events_to_record(events, *, epoch, chan, op, verb, rank,
             if bucket is not None:
                 waits[bucket] += args.get("dur", 0.0)
     base = min(hops) if hops else 0
+    if base >= (1 << 16):
+        # leg-namespaced (hierarchical) hops keep their ABSOLUTE leg
+        # ids: normalizing against this rank's own first leg would make
+        # leg decoding depend on which legs the rank happened to run —
+        # a singleton node skips the local legs, and its cross-ring
+        # hops must still read as leg 2 at the assembler
+        base = 0
 
     def rel(t):
         return None if t is None else round(t - sync, 9)
@@ -511,7 +518,9 @@ def assemble(records, world: int | None = None) -> list:
             continue
         with_hops = {r: rec for r, rec in per_rank.items()
                      if rec.get("hops")}
-        if any(rec.get("hier_legs") for rec in per_rank.values()):
+        hier_legs = max((rec.get("hier_legs", 0)
+                         for rec in per_rank.values()), default=0)
+        if hier_legs:
             # hierarchical op (ISSUE 14): the hop entries span several
             # sub-rings whose `up` neighbours are SUB-ring indices —
             # the single-ring upstream chain does not exist, and a
@@ -548,6 +557,15 @@ def assemble(records, world: int | None = None) -> list:
             "cp_rank": None,
             "worst_hop": None,
         }
+        if hier_legs:
+            # the hierarchical op's structural story (ISSUE 15
+            # satellite): no single-ring critical path exists, but the
+            # per-LEG walls do — the leg-namespaced hop entries carry
+            # each sub-ring's posting/landing times, so the table can
+            # say WHICH leg (local RS, cross ring, local AG) the wall
+            # went to instead of dropping the op entirely
+            tree["hier_legs"] = hier_legs
+            tree["legs"] = _leg_walls(per_rank)
         if with_hops:
             path = _critical_path(with_hops)
             share: dict[int, float] = {}
@@ -581,6 +599,42 @@ def assemble(records, world: int | None = None) -> list:
                                      "dur": worst["dur"]}
         out.append(tree)
     return out
+
+
+def _leg_walls(per_rank: dict[int, dict]) -> list:
+    """Cross-rank per-leg walls of one hierarchical op, from the
+    leg-namespaced hop entries (``trace.leg`` lifts each sub-ring's
+    hops into ``hop + leg << 16``, and the record builder keeps
+    hierarchical hops ABSOLUTE — normalizing per rank would misread a
+    rank that skipped the local legs, e.g. a singleton node whose only
+    hops are the cross ring's — so the leg index is ``hop >> 16``). A
+    leg's wall runs from the earliest post/send any rank recorded in
+    it to the latest landing — the whole-fleet span of that schedule
+    stage. Legs whose records carry no usable times report ``wall_s``
+    None (frames still counted): best-effort, never invented."""
+    legs: dict[int, dict] = {}
+    for rec in per_rank.values():
+        for entry in rec.get("hops", []):
+            h, frames, t_post, t_land = entry[0], entry[1], entry[2], \
+                entry[3]
+            t_sent = entry[4] if len(entry) > 4 else None
+            leg = h >> 16
+            cur = legs.setdefault(leg, {"frames": 0, "t0": None,
+                                        "t1": None})
+            cur["frames"] += frames
+            for t in (t_post, t_sent):
+                if t is not None and (cur["t0"] is None
+                                      or t < cur["t0"]):
+                    cur["t0"] = t
+            if t_land is not None and (cur["t1"] is None
+                                       or t_land > cur["t1"]):
+                cur["t1"] = t_land
+    return [{"leg": leg,
+             "frames": v["frames"],
+             "wall_s": (round(max(0.0, v["t1"] - v["t0"]), 9)
+                        if v["t0"] is not None and v["t1"] is not None
+                        else None)}
+            for leg, v in sorted(legs.items())]
 
 
 def _critical_path(per_rank: dict[int, dict]) -> list:
@@ -714,10 +768,21 @@ def format_trace(stats: dict) -> str:
     for tree in stats["ops"]:
         lines.append(
             f"  op e{tree['epoch']} c{tree['chan']} #{tree['op']} "
-            f"{tree['verb']}: wall {_us(tree['wall_s'])}  "
+            f"{tree['verb']}"
+            + (f" [hier x{tree['hier_legs']} legs]"
+               if tree.get("hier_legs") else "")
+            + f": wall {_us(tree['wall_s'])}  "
             f"cp {_us(tree['cp_total_s'])}  "
             + (f"cp-rank {tree['cp_rank']}" if tree["cp_rank"] is not None
                else "cp-rank -"))
+        if tree.get("legs"):
+            # hierarchical ops carry no single-ring critical path; the
+            # per-leg walls are the structural attribution instead —
+            # which schedule stage (local RS / cross ring / local AG)
+            # the op's wall actually went to
+            lines.append("    legs: " + "  ".join(
+                f"L{lg['leg']}={_us(lg['wall_s']) if lg['wall_s'] is not None else '?'}"
+                f" ({lg['frames']}f)" for lg in tree["legs"]))
         w = tree.get("worst_hop")
         if w is not None:
             lines.append(f"    worst hop: rank {w['src']} -> "
@@ -739,25 +804,20 @@ def format_trace(stats: dict) -> str:
 
 
 def read_trace(store_handle: str, group: str = "default",
-               timeout_s: float = 5.0) -> dict:
+               timeout_s: float = 5.0, flat: bool = False) -> dict:
     """One observer read of a group's published trace records: the
-    fleet meta pointer names the generation, every member's fleet
-    snapshot carries its trace buffer, and the assembler merges them.
-    Raises ``LookupError`` when the group has published nothing."""
+    fleet meta pointer names the generation, the records ride the
+    fleet snapshots AND the telemetry tree's digests (concatenated
+    unchanged up the agent tree — ``obs.fleet.read_records``, the same
+    O(log n) root read with per-rank fallback as the fleet CLI;
+    ``flat=True`` forces one read per member), and the assembler
+    merges them. Records are fenced per record (a survivor's buffer
+    still carries pre-heal ops whose trees would pair ranks that no
+    longer neighbour each other). Raises ``LookupError`` when the
+    group has published nothing."""
     from rocnrdma_tpu.obs import fleet as _fleet
-    epoch, members, snaps = _fleet.read_snapshots(store_handle, group,
-                                                  timeout_s)
-    records = []
-    for s in snaps:
-        if s is None or s.get("epoch") != epoch:
-            continue
-        # fenced PER RECORD too (the trace_stats contract): a survivor's
-        # buffer still carries pre-heal ops whose trees would pair ranks
-        # that no longer neighbour each other — and whose dead member's
-        # missing record would slip the partial-tree guard, since world
-        # is the CURRENT member count
-        records.extend(r for r in s.get("trace", [])
-                       if r.get("epoch") == epoch)
+    epoch, members, records = _fleet.read_records(store_handle, group,
+                                                  timeout_s, flat=flat)
     assembled = assemble(records, world=len(members))
     # the sampling stride is the PUBLISHING ranks' knob — a rank-less
     # observer cannot know it, only infer the spacing of what arrived
@@ -786,11 +846,17 @@ def main(argv=None) -> int:
                    help=argparse.SUPPRESS)  # test hook: bound --watch
     p.add_argument("--json", action="store_true",
                    help="print the assembled trace snapshot as JSON")
+    p.add_argument("--flat", action="store_true",
+                   help="read one fleet snapshot per rank (O(n)) "
+                        "instead of the telemetry tree's root digest "
+                        "(O(log n)) — the escape hatch when agents "
+                        "are suspect")
     args = p.parse_args(argv)
     shown = 0
     while True:
         try:
-            stats = read_trace(args.store, args.group, args.timeout)
+            stats = read_trace(args.store, args.group, args.timeout,
+                               flat=args.flat)
         except (LookupError, OSError, TimeoutError) as e:
             print(f"trace: {type(e).__name__}: {e}", file=sys.stderr)
             return 1

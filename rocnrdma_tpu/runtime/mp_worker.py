@@ -607,6 +607,31 @@ def _print_fleetsnap(pg) -> None:
         print(f"FLEETSNAP-FAILED {type(e).__name__}: {e}", flush=True)
 
 
+def _print_fleettree(pg) -> None:
+    """From the surviving LEADER of a clean run: the telemetry tree's
+    root-digest coverage (ISSUE 15) — proof the (possibly re-elected)
+    node agents published the healed generation's tree. The leader is
+    always the root node's agent (lowest surviving original), so one
+    extra explicit publish ticks its aggregation pass with every
+    child's digest already in the store (the FLEETSNAP barrier put
+    them there). ``root_covers`` null means no digest was published —
+    a node-mapped group asserting on this line catches a silently-dead
+    tree; best-effort like FLEETSNAP, never converts a clean run into
+    an abort."""
+    import json
+    try:
+        if pg.global_ranks[pg.rank] != min(pg.global_ranks):
+            return
+        pg.publish_telemetry()
+        root = pg._tree_root_digest(time.monotonic() + 5.0)
+        print("FLEETTREE " + json.dumps(
+            {"epoch": pg.epoch, "members": pg.global_ranks,
+             "root_covers": None if root is None
+             else root.get("covers")}), flush=True)
+    except (OSError, TimeoutError, RuntimeError) as e:
+        print(f"FLEETTREE-FAILED {type(e).__name__}: {e}", flush=True)
+
+
 def _verify_device_plane(args, members: list, my_orig: int,
                          epoch: int) -> None:
     """Prove the device plane is ALIVE end-to-end on the agreed
@@ -804,6 +829,7 @@ def _device_chaos_main(args) -> int:
             print(f"EPOCH {pg.epoch}", flush=True)
             print(f"MEMBERS {pg.global_ranks}", flush=True)
             _print_fleetsnap(pg)
+            _print_fleettree(pg)
             pg.stop_watchdog()
             # pg is deliberately KEPT after the graceful destroy:
             # destroy is idempotent (the finally's ungraceful call
@@ -944,6 +970,7 @@ def _heal_chaos_main(args) -> int:
             print(f"EPOCH {pg.epoch}", flush=True)
             print(f"MEMBERS {pg.global_ranks}", flush=True)
             _print_fleetsnap(pg)
+            _print_fleettree(pg)
             pg.stop_watchdog()
             # pg deliberately KEPT (destroy is idempotent): the finally
             # reads its durable health-transition log for HEALTH/FLEET

@@ -33,12 +33,14 @@ Usage::
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import threading
 import time
 
 from rocnrdma_tpu import native
+from rocnrdma_tpu.metrics import STORE as _STORE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
 from rocnrdma_tpu.transport.backoff import (
     poll_backoff,
@@ -54,6 +56,81 @@ from rocnrdma_tpu.transport.backoff import (
 # clears the prefixed footprint when an id is promoted (or burned).
 SPARE_RANK_BASE = 1 << 20
 JOINER_RANK_BASE = 1 << 21
+
+# -- the store-ops ledger (ISSUE 15) ---------------------------------------
+# Every client round-trip is attributed to ONE metrics.STORE_CLASSES
+# traffic class at the _rpc choke point. Resolution order: (1) ops whose
+# class is intrinsic (a prune is hygiene whoever sends it; hb/live are
+# the liveness protocol; setnx is a first-writer-wins election), (2) the
+# calling thread's store_traffic() override (the fleet agent's publishes
+# ride a heartbeat-classed watchdog client), (3) the client's own
+# default class (construction-time: what this connection exists for).
+
+_OP_CLASS = {"prune": "prune", "hb": "heartbeat", "live": "heartbeat",
+             "setnx": "election"}
+
+# -- large-value chunking ---------------------------------------------------
+# The store protocol's blocking receives post 64 KiB buffers (one per
+# connection — a bigger post would tax every idle watchdog client for
+# the rare big value), so any single RPC payload must stay under it.
+# Values that don't (the telemetry tree's root digest grows O(n) in
+# BYTES even though reading it is O(log n) in ROUND-TRIPS) are split
+# transparently: ``set`` writes ``key#chunk/<i>`` parts first and a
+# small ``__rocn_chunks__:<n>`` marker under the key LAST (readers see
+# the marker only once every part is durable; a reader racing a
+# re-publish can at worst join a torn value, which JSON consumers
+# already treat as missing — telemetry is best-effort by contract),
+# and ``try_get``/``get`` reassemble. Each part is one counted
+# round-trip — the ledger reports chunked traffic honestly.
+
+_CHUNK_BYTES = 48 << 10   # per-part budget on the ESCAPED (wire) size:
+#                           headroom under the 64 KiB posted-recv bound
+#                           for the rest of the JSON envelope
+_CHUNK_MAGIC = "__rocn_chunks__:"
+
+
+def _chunk_key(key: str, i: int) -> str:
+    # shares the value key's prefix, so every prefix-guarded kv sweep
+    # (the heal prune) retires a chunked value's parts with its marker
+    return f"{key}#chunk/{i}"
+
+
+def _split_value(value: str, budget: int = _CHUNK_BYTES) -> list:
+    """Split ``value`` so each part's JSON-ESCAPED wire size stays
+    under ``budget`` — the wire message is ``json.dumps(req)``, and a
+    quote/backslash-dense slice (a digest's rows are mostly quoted
+    short strings) can escape to well past its raw length; sizing on
+    raw bytes would overflow the 64 KiB posted recv exactly on the
+    payloads chunking exists for. Greedy: start at the raw budget and
+    shrink proportionally to the measured inflation (converges in a
+    couple of probes per part)."""
+    parts = []
+    i, n = 0, len(value)
+    while i < n:
+        j = min(n, i + budget)
+        while j > i + 1:
+            escaped = len(json.dumps(value[i:j]))
+            if escaped <= budget:
+                break
+            j = i + max(1, int((j - i) * budget / escaped))
+        parts.append(value[i:j])
+        i = j
+    return parts
+
+_TRAFFIC_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def store_traffic(traffic_class: str):
+    """Classify this thread's store round-trips as ``traffic_class``
+    for the duration of the block (nests and restores; intrinsic op
+    classes still win — see the resolution order above)."""
+    prev = getattr(_TRAFFIC_TLS, "cls", None)
+    _TRAFFIC_TLS.cls = traffic_class
+    try:
+        yield
+    finally:
+        _TRAFFIC_TLS.cls = prev
 
 
 class BootstrapServer:
@@ -259,13 +336,18 @@ class BootstrapClient:
     the wire protocol is strict request→reply lockstep."""
 
     def __init__(self, handle: str, rank: int, timeout_s: float = 30.0,
-                 scope: str = ""):
+                 scope: str = "", traffic_class: str = "rendezvous"):
         self.rank = rank
         self.timeout_s = timeout_s
         # liveness namespace: clients of one group pass one scope (the
         # ring's store namespace), so live/dead queries see only peers of
         # THAT group — rank numbers collide across groups, scopes don't
         self.scope = scope
+        # the store-ops ledger's default attribution for this
+        # connection's round-trips (metrics.STORE_CLASSES): what this
+        # client exists for — the watchdog's is "heartbeat", observers'
+        # "telemetry-read", the wiring/heal client "rendezvous"
+        self.traffic_class = traffic_class
         self._handle = handle
         self._said_bye = False
         self._qp = self._dial(timeout_s)
@@ -300,6 +382,15 @@ class BootstrapClient:
         round-trip is bounded by ``self.timeout_s`` as before."""
         req.setdefault("rank", self.rank)
         req.setdefault("scope", self.scope)
+        # ledger attribution resolved ONCE per call (op-intrinsic class,
+        # else the thread's store_traffic override, else this client's
+        # default); counted once per ATTEMPT below — a blocking poll or
+        # a reconnect replay is real load on the store, and the ledger
+        # exists to count load, not intentions
+        op = req.get("op")
+        traffic = (_OP_CLASS.get(op)
+                   or getattr(_TRAFFIC_TLS, "cls", None)
+                   or self.traffic_class)
         payload = json.dumps(req).encode()
         deadline = time.monotonic() + (self.timeout_s if _budget_s is None
                                        else max(0.0, _budget_s))
@@ -311,6 +402,7 @@ class BootstrapClient:
                           else max(min(1.0, self.timeout_s),
                                    min(self.timeout_s,
                                        deadline - time.monotonic())))
+                _STORE.count(traffic, op=op)
                 self._qp.send(payload)
                 return json.loads(self._qp.recv(timeout_s=recv_s))
             except (OSError, TimeoutError) as e:
@@ -338,11 +430,58 @@ class BootstrapClient:
             timeout_s: float | None = None) -> None:
         """``timeout_s``: optional retry budget for surviving a dropped
         connection (default: the client-level ``self.timeout_s``) — the
-        deadline-honoring callers (exchange) pass their remaining time."""
+        deadline-honoring callers (exchange) pass their remaining time.
+        Values past the wire's per-message bound are chunked
+        transparently (parts first, marker last — see the module's
+        chunking note); ``timeout_s`` bounds the WHOLE multi-part
+        write."""
+        # the chunk trigger is escape-aware like the split: a value
+        # whose RAW length fits can still escape past the wire bound
+        # (worst case 6 bytes per char for \\uXXXX); short values skip
+        # the measurement entirely — they cannot overflow even fully
+        # escaped
+        wire_len = (len(value) if len(value) * 6 + 2 <= _CHUNK_BYTES
+                    else len(json.dumps(value)))
+        if wire_len > _CHUNK_BYTES:
+            budget = self.timeout_s if timeout_s is None else timeout_s
+            deadline = time.monotonic() + budget
+            parts = _split_value(value)
+            for i, part in enumerate(parts):
+                resp = self._rpc(op="set", key=_chunk_key(key, i),
+                                 value=part,
+                                 _budget_s=max(0.0, deadline
+                                               - time.monotonic()))
+                if not resp.get("ok"):
+                    raise OSError(
+                        f"bootstrap set({key!r}) chunk {i} failed: "
+                        f"{resp}")
+            value = f"{_CHUNK_MAGIC}{len(parts)}"
+            timeout_s = max(0.0, deadline - time.monotonic())
         resp = self._rpc(op="set", key=key, value=value,
                          _budget_s=timeout_s)
         if not resp.get("ok"):
             raise OSError(f"bootstrap set({key!r}) failed: {resp}")
+
+    def _join_chunks(self, key: str, marker: str,
+                     timeout_s: float | None) -> str | None:
+        """Reassemble a chunked value (``try_get``/``get`` found the
+        marker). A missing part reads as the whole value ABSENT — the
+        torn-write disposition every JSON consumer here already has."""
+        try:
+            n = int(marker[len(_CHUNK_MAGIC):])
+        except ValueError:
+            return None  # a user value masquerading as a marker: torn
+        budget = self.timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + budget
+        parts = []
+        for i in range(n):
+            resp = self._rpc(op="get", key=_chunk_key(key, i),
+                             _budget_s=max(0.0, deadline
+                                           - time.monotonic()))
+            if not resp.get("ok"):
+                return None
+            parts.append(resp["value"])
+        return "".join(parts)
 
     def set_if_absent(self, key: str, value: str) -> str:
         """Atomic first-writer-wins: returns the value actually stored
@@ -360,7 +499,12 @@ class BootstrapClient:
         callers holding their own deadline (``fleet_stats``); default is
         the client-level ``self.timeout_s``."""
         resp = self._rpc(op="get", key=key, _budget_s=timeout_s)
-        return resp.get("value") if resp.get("ok") else None
+        if not resp.get("ok"):
+            return None
+        value = resp.get("value")
+        if isinstance(value, str) and value.startswith(_CHUNK_MAGIC):
+            return self._join_chunks(key, value, timeout_s)
+        return value
 
     def get(self, key: str, timeout_s: float = 30.0) -> str:
         """Blocking get: polls (jittered backoff) until the key appears or
@@ -371,7 +515,18 @@ class BootstrapClient:
             resp = self._rpc(op="get", key=key,
                              _budget_s=deadline - time.monotonic())
             if resp.get("ok"):
-                return resp["value"]
+                value = resp["value"]
+                if isinstance(value, str) \
+                        and value.startswith(_CHUNK_MAGIC):
+                    joined = self._join_chunks(
+                        key, value,
+                        max(0.0, deadline - time.monotonic()))
+                    if joined is not None:
+                        return joined
+                    # a part vanished under the marker (a re-publish in
+                    # flight): poll again like an absent key
+                else:
+                    return value
             if time.monotonic() >= deadline:
                 raise TimeoutError(f"bootstrap key {key!r} never published")
             back.pause()

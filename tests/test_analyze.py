@@ -629,21 +629,54 @@ def test_obs_flags_silently_dropped_publish():
     assert "not flight-evented on abort" in problems[0], problems
 
 
-def test_obs_telemetry_rule_ignores_reads_and_other_calls():
+def test_obs_telemetry_read_rule_requires_timeout(tmp_path):
+    """The ISSUE-15 extension to the NodeAgent surface: a try_get in
+    the fleet module without an explicit timeout_s is an unbounded
+    read on the watchdog thread — flagged; loops are allowed (the
+    shared-deadline per-member fetch is the pattern)."""
+    bad = textwrap.dedent("""
+        def agent_tick(client):
+            for orig in (0, 1):
+                raw = client.try_get(f"pg/g/fleet/e0/{orig}")
+            return raw
+    """)
+    problems = obs.check_telemetry_source(bad, "fleet.py")
+    assert len(problems) == 1
+    assert "telemetry store read" in problems[0]
+    assert "timeout_s" in problems[0]
+    good = textwrap.dedent("""
+        def agent_tick(client, timeout_s=1.0):
+            for orig in (0, 1):
+                raw = client.try_get(f"pg/g/fleet/e0/{orig}",
+                                     timeout_s=timeout_s)
+            return raw
+    """)
+    assert obs.check_telemetry_source(good, "fleet.py") == []
+
+
+def test_obs_telemetry_rule_ignores_builtin_and_blocking_gets():
+    """Only store-client METHOD calls are the rule's surface: the
+    builtin set()/dict-get shapes (which the tree code uses freely)
+    and the blocking client.get (its positional deadline is pass #0's
+    jurisdiction) stay out of scope."""
     src = textwrap.dedent("""
         def read_fleet(client, timeout_s=5.0):
-            raw = client.try_get("pg/g/fleet/meta")
+            covered = set(["a"])         # builtin set(), not a write
+            d = {}
+            raw = d.get("x")             # dict read, not a store read
             vals = [client.get(f"k{i}", timeout_s) for i in range(3)]
-            return raw, vals
+            return covered, raw, vals
     """)
     assert obs.check_telemetry_source(src, "fleet.py") == []
 
 
 def test_obs_telemetry_rule_covers_the_repo_fleet_module():
     # the repo surface itself complies (run() == [] pins it); sanity-
-    # check the target is the fleet module and the write set is sane
+    # check the target is the fleet module and the read/write sets are
+    # sane (the read half is the ISSUE-15 NodeAgent extension)
     assert obs.TELEMETRY_FILE == "rocnrdma_tpu/obs/fleet.py"
     assert "set" in obs.STORE_WRITES
+    assert "try_get" in obs.STORE_READS
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,656 @@
+"""Fleet-scale telemetry tree (ISSUE 15): node split / agent election /
+tree shape units, merge associativity at depth (the exactness property
+the tentpole rests on, pinned independently of the agent code), the
+NodeAgent end-to-end over a real store, degraded-mode fallback +
+re-election, the store-ops ledger (traffic classes, chunked values),
+the simfleet harness's O(1)/O(log n) invariants, and the sentinel's
+store-traffic ratchet fixed point."""
+
+import json
+import os
+
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.metrics import STORE, StoreCounters
+from rocnrdma_tpu.obs import fleet
+from rocnrdma_tpu.obs import trace as obs_trace
+from rocnrdma_tpu.transport import bootstrap
+from tools import simfleet
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# tree shape + election units
+# ---------------------------------------------------------------------------
+
+
+def test_split_nodes_orders_by_lowest_original():
+    nodes = fleet.split_nodes([0, 1, 2, 3], [1, 1, 0, 0])
+    # node ids keep their map values; ORDER is by lowest member orig
+    assert nodes == [(1, [0, 1]), (0, [2, 3])]
+    # node_of None: every member a singleton node (simfleet's shape)
+    assert fleet.split_nodes([5, 7], None) == [(5, [5]), (7, [7])]
+    # an orig past the map (grow joiner) runs as a singleton node
+    nodes = fleet.split_nodes([0, 1, 9], [0, 0])
+    assert nodes[0] == (0, [0, 1]) and nodes[1][1] == [9]
+
+
+def test_node_agents_elect_lowest_surviving_and_reelect_on_death():
+    nodes = fleet.split_nodes([0, 1, 2, 3], [0, 0, 1, 1])
+    assert fleet.node_agents(nodes) == {0: 0, 1: 2}
+    # the agent dies: the node's next-lowest surviving original takes
+    # over — same election as the hier-ring leader, no heal needed
+    assert fleet.node_agents(nodes, dead={2}) == {0: 0, 1: 3}
+    # the whole node dead: no agent (observers fall back per-rank)
+    assert fleet.node_agents(nodes, dead={2, 3}) == {0: 0, 1: None}
+
+
+def test_tree_children_and_depth():
+    assert fleet.tree_children(0, 6, 4) == [1, 2, 3, 4]
+    assert fleet.tree_children(1, 6, 4) == [5]
+    assert fleet.tree_children(5, 6, 4) == []
+    assert fleet.tree_depth(1, 4) == 0
+    assert fleet.tree_depth(4, 4) == 1
+    assert fleet.tree_depth(5, 4) == 1
+    assert fleet.tree_depth(6, 4) == 2
+    assert fleet.tree_depth(32, 4) == 3
+    # every child's parent is one level up: depth is consistent with
+    # the parent chain for a range of sizes/fanouts
+    for fanout in (2, 3, 4):
+        for n in (1, 2, 7, 20):
+            deepest = 0
+            for idx in range(n):
+                d, i = 0, idx
+                while i:
+                    i = (i - 1) // fanout
+                    d += 1
+                deepest = max(deepest, d)
+            assert fleet.tree_depth(n, fanout) == deepest, (n, fanout)
+
+
+def test_tree_fanout_env_knob(monkeypatch):
+    monkeypatch.delenv("ROCNRDMA_FLEET_FANOUT", raising=False)
+    assert fleet.tree_fanout() == fleet.DEFAULT_FANOUT
+    monkeypatch.setenv("ROCNRDMA_FLEET_FANOUT", "8")
+    assert fleet.tree_fanout() == 8
+    # fanout 1 would be a depth-n chain; malformed degrades to default
+    monkeypatch.setenv("ROCNRDMA_FLEET_FANOUT", "1")
+    assert fleet.tree_fanout() == 2
+    monkeypatch.setenv("ROCNRDMA_FLEET_FANOUT", "banana")
+    assert fleet.tree_fanout() == fleet.DEFAULT_FANOUT
+
+
+# ---------------------------------------------------------------------------
+# merge associativity at depth — the exactness property, pinned on
+# randomized corpora independent of the agent code
+# ---------------------------------------------------------------------------
+
+
+def _corpus(n=64, seed=0, epoch=0):
+    return [simfleet.synth_snapshot(o, epoch, seq=seed, seed=seed)
+            for o in range(n)]
+
+
+def _digest_tree(snaps, epoch, groups):
+    """Merge a snapshot corpus up an arbitrary tree: ``groups`` is a
+    nested structure of index lists — leaves digest their snapshots,
+    inner nodes merge their children."""
+    if isinstance(groups, list) and groups \
+            and isinstance(groups[0], int):
+        picked = [snaps[i] for i in groups]
+        return fleet.digest_of_snapshots(
+            picked, epoch, [s["orig"] for s in picked])
+    return fleet.merge_digests(
+        [_digest_tree(snaps, epoch, g) for g in groups], epoch)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_tree_merge_equals_flat_merge_on_every_shape(seed):
+    """THE exactness contract: a randomized 64-rank corpus merged flat
+    vs three different tree shapes agrees exactly — every wire
+    counter, every histogram bucket, the percentiles and worst-rank
+    P99, the per-rank rows. Float accumulations (total_s sums) are
+    order-dependent and deliberately outside the bit-exact claim;
+    ``fleet_views_equal`` compares them to tolerance."""
+    n = 64
+    snaps = _corpus(n, seed=seed)
+    members = list(range(n))
+    flat = fleet.aggregate(snaps, epoch=0, members=members)
+    shapes = [
+        # 8 nodes of 8, one root merge (the agent tree's natural shape)
+        [list(range(i, i + 8)) for i in range(0, n, 8)],
+        # a binary cascade: pairs of pairs of pairs
+        [[[[list(range(i, i + 8)), list(range(i + 8, i + 16))]
+           for i in (j,)][0] for j in range(k, k + 16, 16)][0]
+         for k in range(0, n, 16)],
+        # a skewed chain: one fat node and singletons folded in
+        [list(range(0, 40))] + [[i] for i in range(40, n)],
+    ]
+    for groups in shapes:
+        merged = _digest_tree(snaps, 0, groups)
+        tree = fleet._assemble(merged, 0, members)
+        verdict = simfleet.fleet_views_equal(tree, flat)
+        assert verdict["equal"], (groups, verdict)
+        # the bit-exact half, asserted directly too (not through the
+        # helper): counters and buckets are ==, not approx
+        assert tree["wire_totals"] == flat["wire_totals"]
+        for verb in flat["verb_latency"]:
+            assert (tree["verb_latency"][verb]["buckets"]
+                    == flat["verb_latency"][verb]["buckets"])
+        assert tree["verb_p99_us"] == flat["verb_p99_us"]
+        assert tree["worst_p99_us"] == flat["worst_p99_us"]
+        assert tree["ranks"] == flat["ranks"]
+
+
+def test_merge_digests_is_associative_and_fences():
+    snaps = _corpus(12, seed=3)
+    a = fleet.digest_of_snapshots(snaps[:4], 0, range(0, 4))
+    b = fleet.digest_of_snapshots(snaps[4:8], 0, range(4, 8))
+    c = fleet.digest_of_snapshots(snaps[8:], 0, range(8, 12))
+    left = fleet.merge_digests([fleet.merge_digests([a, b], 0), c], 0)
+    right = fleet.merge_digests([a, fleet.merge_digests([b, c], 0)], 0)
+    assert left["wire_totals"] == right["wire_totals"]
+    assert left["covers"] == right["covers"] == list(range(12))
+    assert left["rows"] == right["rows"]
+    # epoch fence: a stale digest is dropped whole and counted
+    stale = fleet.digest_of_snapshots(_corpus(2, seed=9, epoch=1),
+                                      1, range(2))
+    m = fleet.merge_digests([a, stale], 0)
+    assert m["covers"] == [0, 1, 2, 3] and m["stale_dropped"] == 1
+    # overlap fence: a digest re-covering merged ranks is dropped whole
+    # (double-counting a rank's counters would corrupt exact totals)
+    dup = fleet.digest_of_snapshots(snaps[2:6], 0, range(2, 6))
+    m = fleet.merge_digests([a, dup], 0)
+    assert m["covers"] == [0, 1, 2, 3]
+    assert m["wire_totals"] == a["wire_totals"]
+    assert m["stale_dropped"] == 1
+
+
+def test_trace_records_ride_digests_for_cp_assembly():
+    snaps = _corpus(4, seed=5)
+    for s in snaps:
+        s["trace"] = [{"epoch": 0, "chan": 0, "op": 8, "verb": "ar",
+                       "rank": s["orig"], "wall_s": 0.001,
+                       "t_start": 0.0, "hops": [], "waits": {}}]
+    a = fleet.digest_of_snapshots(snaps[:2], 0, range(0, 2))
+    b = fleet.digest_of_snapshots(snaps[2:], 0, range(2, 4))
+    merged = fleet.merge_digests([a, b], 0)
+    assert sorted(r["rank"] for r in merged["trace"]) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# the NodeAgent end-to-end over a real store: publish, tree read,
+# degraded-mode fallback, re-election
+# ---------------------------------------------------------------------------
+
+
+def _publish_fleet(client, members, epoch=0, seed=0,
+                   group=simfleet.GROUP, skip=()):
+    meta = json.dumps({"epoch": epoch, "members": list(members),
+                       "world": len(members), "group": group})
+    for orig in members:
+        if orig in skip:
+            continue
+        client.set(fleet.snapshot_key(group, epoch, orig),
+                   json.dumps(simfleet.synth_snapshot(orig, epoch, 0,
+                                                      seed)))
+    client.set(fleet.meta_key(group), meta)
+
+
+@needs_native
+def test_node_agent_ticks_and_tree_read_matches_flat():
+    """16 ranks on 4 nodes, fanout 2 (a depth-2 tree): agents tick
+    deepest-first, the observer's tree read costs a fraction of the
+    flat read's store ops (ledger-counted), and the two views agree
+    exactly."""
+    n, node_size, fanout = 16, 4, 2
+    members = list(range(n))
+    node_of = [g // node_size for g in members]
+    server = bootstrap.BootstrapServer(n_ranks=n)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=10.0)
+    try:
+        _publish_fleet(client, members)
+        nodes = fleet.split_nodes(members, node_of)
+        agents = fleet.node_agents(nodes)
+        for idx in simfleet._agent_order(len(nodes), fanout):
+            agent = fleet.NodeAgent(
+                simfleet._SimPG(agents[idx], members, node_of, 0),
+                fanout=fanout)
+            assert agent.tick(client, timeout_s=5.0)
+        base = STORE.snapshot()
+        tree = fleet.read_fleet(server.handle, simfleet.GROUP)
+        tree_ops = STORE.delta(base)["ops"]
+        base = STORE.snapshot()
+        flat = fleet.read_fleet(server.handle, simfleet.GROUP,
+                                flat=True)
+        flat_ops = STORE.delta(base)["ops"]
+    finally:
+        client.close()
+        server.close()
+    assert tree["missing"] == []
+    assert simfleet.fleet_views_equal(tree, flat)["equal"]
+    # the O(log n) point: 3 ops (meta + root + bye) vs n + 2
+    assert tree_ops == 3
+    assert flat_ops == n + 2
+
+
+@needs_native
+def test_dead_agent_degrades_node_to_direct_reads_then_reelects():
+    """Node 1's agent never ticks (dead): the observer's tree read
+    falls back to per-rank reads for exactly that node's ranks — same
+    truth, degraded cost — and the re-elected agent (the node's
+    next-lowest surviving original) restores tree coverage."""
+    n, node_size, fanout = 8, 4, 2
+    members = list(range(n))
+    node_of = [g // node_size for g in members]
+    server = bootstrap.BootstrapServer(n_ranks=n)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=10.0)
+    try:
+        # rank 4 (node 1's agent) is dead: snapshot missing, no tick
+        _publish_fleet(client, members, skip={4})
+        nodes = fleet.split_nodes(members, node_of)
+        agent0 = fleet.NodeAgent(simfleet._SimPG(0, members, node_of, 0),
+                                 fanout=fanout)
+        assert agent0.tick(client, timeout_s=5.0)
+        base = STORE.snapshot()
+        tree = fleet.read_fleet(server.handle, simfleet.GROUP)
+        degraded_ops = STORE.delta(base)["ops"]
+        flat = fleet.read_fleet(server.handle, simfleet.GROUP,
+                                flat=True)
+        assert simfleet.fleet_views_equal(tree, flat)["equal"]
+        # the dead rank is MISSING (reported, not invented) and the
+        # degraded read paid per-rank fallbacks for node 1 only:
+        # meta + root + 4 fallback reads + bye
+        assert tree["missing"] == [4]
+        assert degraded_ops == 3 + node_size
+        # re-election: rank 5 (next-lowest surviving in node 1) sees
+        # the death flag and takes the agent role over
+        agent5 = fleet.NodeAgent(
+            simfleet._SimPG(5, members, node_of, 0, dead=[4]),
+            fanout=fanout)
+        assert agent5.tick(client, timeout_s=5.0)
+        assert agent0.tick(client, timeout_s=5.0)  # root re-merges
+        base = STORE.snapshot()
+        tree2 = fleet.read_fleet(server.handle, simfleet.GROUP)
+        healed_ops = STORE.delta(base)["ops"]
+        # coverage is back to everyone alive: only the dead rank's
+        # snapshot falls back (its key truly is absent)
+        assert tree2["missing"] == [4]
+        assert healed_ops == 3 + 1
+        assert sorted(int(o) for o in tree2["ranks"]) == [0, 1, 2, 3,
+                                                          5, 6, 7]
+    finally:
+        client.close()
+        server.close()
+
+
+@needs_native
+def test_node_agent_tick_noop_on_non_agent_and_disabled(monkeypatch):
+    members = [0, 1]
+    server = bootstrap.BootstrapServer(n_ranks=2)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=5.0)
+    try:
+        # rank 1 is not node 0's agent: tick is a no-op
+        agent = fleet.NodeAgent(
+            simfleet._SimPG(1, members, [0, 0], 0), fanout=2)
+        assert agent.tick(client, timeout_s=2.0) is False
+        # the kill switch wins even on an agent rank
+        monkeypatch.setenv("ROCNRDMA_FLEET_TREE", "0")
+        agent0 = fleet.NodeAgent(
+            simfleet._SimPG(0, members, [0, 0], 0), fanout=2)
+        assert agent0.tick(client, timeout_s=2.0) is False
+        monkeypatch.delenv("ROCNRDMA_FLEET_TREE")
+        # a group with NO node map only runs the tree when forced
+        class _Flat(simfleet._SimPG):
+            def __init__(self):
+                super().__init__(0, members, [0, 0], 0)
+                self._node_of = None
+        assert fleet.NodeAgent(_Flat(), fanout=2).tick(
+            client, timeout_s=2.0) is False
+        monkeypatch.setenv("ROCNRDMA_FLEET_TREE", "1")
+        _publish_fleet(client, members)
+        assert fleet.NodeAgent(_Flat(), fanout=2).tick(
+            client, timeout_s=2.0) is True
+    finally:
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# the store-ops ledger: traffic classes at the RPC choke point
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_store_ledger_attributes_traffic_classes():
+    server = bootstrap.BootstrapServer(n_ranks=1)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=5.0)
+    try:
+        base = STORE.snapshot()
+        client.set("k", "v")                       # client default
+        client.try_get("k")
+        d = STORE.delta(base)
+        assert d["classes"] == {"rendezvous": 2}
+        assert d["by_op"] == {"rendezvous:set": 1, "rendezvous:get": 1}
+        # op-intrinsic classes win over the client default
+        base = STORE.snapshot()
+        client.heartbeat()
+        client.live_ages()
+        client.set_if_absent("e", "1")
+        client.prune([0], prefix="pg/x/")
+        d = STORE.delta(base)["classes"]
+        assert d == {"heartbeat": 2, "election": 1, "prune": 1}
+        # the thread-local override classifies whole blocks (the fleet
+        # publish path), still losing to op-intrinsic classes
+        base = STORE.snapshot()
+        with bootstrap.store_traffic("telemetry-publish"):
+            client.set("snap", "{}")
+            client.heartbeat()
+        d = STORE.delta(base)["classes"]
+        assert d == {"telemetry-publish": 1, "heartbeat": 1}
+    finally:
+        client.close()
+        server.close()
+    # close() said bye: counted under the client's default class
+    assert STORE.snapshot()["by_op"].get("rendezvous:bye", 0) >= 1
+
+
+@needs_native
+def test_chunked_values_roundtrip_transparently():
+    """Values past the wire's 64 KiB posted-recv bound (the telemetry
+    tree's root digest at hundreds of ranks) chunk on set and
+    reassemble on get/try_get — parts are counted round-trips, and a
+    small value stays a single op."""
+    server = bootstrap.BootstrapServer(n_ranks=1)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=10.0)
+    try:
+        big = "x" * (200 << 10) + "END"
+        base = STORE.snapshot()
+        client.set("big", big)
+        set_ops = STORE.delta(base)["ops"]
+        assert set_ops == 6  # 5 parts (48K each) + the marker
+        base = STORE.snapshot()
+        assert client.try_get("big") == big
+        assert STORE.delta(base)["ops"] == 6  # marker + 5 part reads
+        assert client.get("big", timeout_s=5.0) == big
+        # small values stay one op and exactly themselves
+        client.set("small", "v")
+        assert client.try_get("small") == "v"
+        # a marker whose parts vanished reads as ABSENT, not a crash
+        client.set("torn", f"{bootstrap._CHUNK_MAGIC}3")
+        assert client.try_get("torn") is None
+        # escape-dense payloads (a digest's rows are mostly quoted
+        # strings: every quote doubles on the wire) still round-trip —
+        # chunk sizing and the chunk TRIGGER both measure the escaped
+        # wire size, not the raw length
+        dense = "\\" * (40 << 10)  # raw 40K, escapes 2x to 80K on wire
+        assert len(dense) < bootstrap._CHUNK_BYTES  # raw fits...
+        assert len(json.dumps(dense)) > 64 << 10    # ...the wire won't
+        client.set("dense", dense)
+        assert client.try_get("dense") == dense
+        quoted = json.dumps([["ok", "degraded", 0]] * 8000)
+        for part in bootstrap._split_value(dense * 3) \
+                + bootstrap._split_value(quoted):
+            assert len(json.dumps(part)) <= bootstrap._CHUNK_BYTES
+        client.set("quoted", quoted)
+        assert client.try_get("quoted") == quoted
+    finally:
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# simfleet: the scaling harness's own invariants (small ladder — the
+# committed 256-rank record is results/fleettree_r01.json)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_simfleet_per_rank_constant_and_observer_log():
+    doc = simfleet.run_ladder((8, 16), node_size=4, fanout=2, windows=1)
+    assert simfleet.check_record(doc) == []
+    rows = doc["ladder"]
+    per_rank = [r["per_rank_ops_per_window"] for r in rows]
+    assert max(per_rank) - min(per_rank) <= 1.0
+    for r in rows:
+        assert r["equal"]["equal"], r["equal"]
+        assert r["observer_tree_ops"] < r["observer_flat_ops"]
+        # publishes and agent reads are the only classes moving
+        assert set(r["publish_classes"]) <= {"telemetry-publish",
+                                             "telemetry-read"}
+
+
+def test_simfleet_check_record_flags_doctored_regressions():
+    with open(os.path.join(REPO, "results",
+                           "fleettree_r01.json")) as fp:
+        doc = json.load(fp)
+    assert simfleet.check_record(doc) == []  # the committed fixed point
+    import copy
+    bad = copy.deepcopy(doc)
+    bad["ladder"][-1]["observer_tree_ops"] = \
+        bad["ladder"][-1]["ranks"] + 1  # an O(n) read path came back
+    assert any("O(log n)" in p for p in simfleet.check_record(bad))
+    bad = copy.deepcopy(doc)
+    bad["ladder"][0]["per_rank_ops_per_window"] += 5.0
+    assert any("not O(1)" in p for p in simfleet.check_record(bad))
+    bad = copy.deepcopy(doc)
+    bad["ladder"][1]["equal"]["equal"] = False
+    bad["ladder"][1]["equal"]["wire_totals"] = False
+    assert any("exactness" in p for p in simfleet.check_record(bad))
+
+
+def test_committed_fleettree_record_schema():
+    with open(os.path.join(REPO, "results",
+                           "fleettree_r01.json")) as fp:
+        doc = json.load(fp)
+    assert doc["bench"] == "simfleet"
+    ranks = [r["ranks"] for r in doc["ladder"]]
+    assert 256 in ranks  # the 256-rank host-plane dryrun rung
+    r256 = next(r for r in doc["ladder"] if r["ranks"] == 256)
+    assert r256["equal"]["equal"]  # tree-merged == flat-merged truth
+    assert r256["observer_tree_ops"] <= 2 * 5 + 2  # ~c·log2(32 nodes)
+    assert r256["observer_flat_ops"] >= 256
+    assert doc["floors"]["per_rank_spread_max"] == 1.0
+
+
+def test_sentinel_store_traffic_ratchet():
+    from tools import sentinel
+    with open(os.path.join(REPO, "results",
+                           "fleettree_r01.json")) as fp:
+        doc = json.load(fp)
+    # the committed record self-diffs clean (the all-zero fixed point)
+    assert sentinel.check_store_traffic(current=doc) == []
+    import copy
+    bad = copy.deepcopy(doc)
+    for row in bad["ladder"]:
+        row["per_rank_ops_per_window"] += 5.0
+    findings = sentinel.check_store_traffic(current=bad)
+    assert findings and any("per_rank_ops" in f for f in findings)
+    bad = copy.deepcopy(doc)
+    bad["ladder"][0]["observer_tree_ops"] = 999
+    findings = sentinel.check_store_traffic(current=bad)
+    assert any("observer_ops" in f or "store_traffic" in f
+               for f in findings)
+    text = sentinel.format_findings(findings)
+    assert "store ops" in text or "O(n)" in text
+
+
+# ---------------------------------------------------------------------------
+# surfaces: wire_stats / local snapshots / format_fleet / CLI --flat
+# ---------------------------------------------------------------------------
+
+
+def test_local_snapshot_carries_negotiation_and_store_ledger():
+    class _FakePG:
+        rank = 0
+        global_ranks = [0]
+        epoch = 0
+        plane = "shm"
+        group_name = "t15"
+        world_size = 1
+        heals = 0
+
+        def health(self):
+            return "ok"
+
+        def health_transitions(self):
+            return []
+
+    snap = fleet.FleetAgent(_FakePG()).local_snapshot()
+    assert "negotiation" in snap and "algorithm" in snap["negotiation"]
+    assert "store" in snap and "classes" in snap["store"]
+
+
+def test_wire_stats_exposes_store_ops():
+    from rocnrdma_tpu import distributed as dist
+    pg = dist.ProcessGroup(rank=0, world_size=1, store_handle="none:0",
+                           server=None, plane="shm")
+    try:
+        s = pg.wire_stats()
+        assert "store_ops" in s
+        assert set(s["store_ops"]) == {"ops", "classes", "by_op"}
+    finally:
+        pg.destroy()
+
+
+def test_format_fleet_renders_algorithm_gauge_and_hier_counter():
+    """The satellite: a silently-flat fleet is visible from the
+    observer CLI — the per-rank algo/codec columns carry the
+    negotiation gauges and the counters line carries hier_ops."""
+    snaps = [simfleet.synth_snapshot(o, 0, 0, seed=1) for o in (0, 1)]
+    snaps[0]["negotiation"]["algorithm"] = "hier"
+    snaps[0]["negotiation"]["codec"] = "int8"
+    snaps[1]["negotiation"]["algorithm"] = "ring"
+    snap = fleet.aggregate(snaps, epoch=0, members=[0, 1])
+    text = fleet.format_fleet(snap)
+    assert "algo" in text and "codec" in text
+    assert "hier" in text
+    assert f"hier {snap['wire_totals']['hier_ops']}" in text
+    assert "int8" in text
+    assert "store-ops:" in text
+    rows = [ln for ln in text.splitlines() if ln.strip().startswith(
+        ("0 ", "1 "))]
+    assert "hier" in rows[0] and "ring" in rows[1]
+
+
+@needs_native
+def test_cli_flat_escape_hatch_and_tree_default(capsys):
+    n = 4
+    members = list(range(n))
+    server = bootstrap.BootstrapServer(n_ranks=n)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=5.0)
+    try:
+        _publish_fleet(client, members, group="g15")
+        # no digests yet: the tree default silently degrades to the
+        # per-rank fallback — same table either way
+        for flag in ([], ["--flat"]):
+            rc = fleet.main(["--store", server.handle, "--group", "g15"]
+                            + flag)
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "fleet: epoch 0" in out
+        # with a digest published, the tree read serves the same view
+        agent = fleet.NodeAgent(
+            simfleet._SimPG(0, members, [0] * n, 0, group="g15"),
+            fanout=2)
+        assert agent.tick(client, timeout_s=5.0)
+        rc = fleet.main(["--store", server.handle, "--group", "g15",
+                         "--json"])
+        assert rc == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert snap["missing"] == []
+    finally:
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# obs.trace: hier ops keep their per-leg walls in the table
+# ---------------------------------------------------------------------------
+
+
+def _hier_rec(rank, legs=(1, 2)):
+    # hierarchical hops stay ABSOLUTE in the record (leg << 16 | hop —
+    # the builder skips the 0-based normalization for leg-namespaced
+    # hops, so leg decoding cannot depend on which legs a rank ran)
+    hops = [[leg << 16, 4, 0.001 * leg, 0.002 * leg, 0.0015 * leg]
+            for leg in legs]
+    return {"v": 1, "epoch": 0, "chan": 0, "op": 8, "verb": "allreduce",
+            "rank": rank, "up": 0, "down": 0, "members": 1,
+            "hier_legs": max(legs), "t_start": 0.0, "wall_s": 0.004,
+            "n_frames": 4 * len(legs), "hops": hops,
+            "waits": {b: 0.0 for b in obs_trace.WAIT_BUCKETS}}
+
+
+def test_assemble_extracts_per_leg_walls_for_hier_ops():
+    trees = obs_trace.assemble([_hier_rec(0), _hier_rec(1)], world=2)
+    assert len(trees) == 1
+    t = trees[0]
+    # no single-ring critical path (the PR-14 rule holds)...
+    assert t["critical_path"] == [] and t["cp_rank"] is None
+    # ...but the per-leg walls are extracted, not dropped
+    assert t["hier_legs"] == 2
+    legs = t["legs"]
+    assert [lg["leg"] for lg in legs] == [1, 2]
+    assert legs[0]["frames"] == 8
+    assert legs[0]["wall_s"] == pytest.approx(0.001)
+    assert legs[1]["wall_s"] == pytest.approx(0.002)
+    text = obs_trace.format_trace(
+        {"epoch": 0, "sample": 8, "ops": trees, "scoreboard": {}})
+    assert "[hier x2 legs]" in text
+    assert "legs: L1=" in text and "L2=" in text and "(8f)" in text
+
+
+def test_leg_walls_attribute_singleton_node_hops_to_their_leg():
+    """A rank that skipped the local legs (a singleton node runs only
+    the cross ring, leg 2) must still have its hops counted under leg
+    2 — leg decoding rides the record's absolute leg namespace, never
+    the rank's own first-leg offset."""
+    full = _hier_rec(0, legs=(1, 2, 3))
+    solo = _hier_rec(1, legs=(2,))
+    trees = obs_trace.assemble([full, solo], world=2)
+    legs = {lg["leg"]: lg for lg in trees[0]["legs"]}
+    assert sorted(legs) == [1, 2, 3]
+    # the singleton's 4 frames landed in leg 2, not leg 1
+    assert legs[1]["frames"] == 4
+    assert legs[2]["frames"] == 8
+    assert legs[3]["frames"] == 4
+
+
+def test_record_builder_keeps_hier_hops_absolute():
+    """The builder half of the same property: events recorded under
+    leg namespaces keep their absolute hop ids in the record (flat
+    ops keep the 0-based normalization)."""
+    events = [(10.0, "hier-leg", {"leg": 2}),
+              (10.001, "frame-posted", {"hop": (2 << 16) + 0}),
+              (10.002, "frame-landed", {"hop": (2 << 16) + 0})]
+    rec = obs_trace._events_to_record(
+        events, epoch=0, chan=0, op=8, verb="allreduce", rank=1,
+        t_start=10.0, wall_s=0.002, sync=10.0)
+    assert rec["hier_legs"] == 2
+    assert rec["hops"][0][0] == 2 << 16
+    # a flat op's hops still normalize 0-based
+    flat = obs_trace._events_to_record(
+        [(10.0, "frame-posted", {"hop": 3}),
+         (10.001, "frame-landed", {"hop": 3})],
+        epoch=0, chan=0, op=8, verb="allreduce", rank=0,
+        t_start=10.0, wall_s=0.001, sync=10.0)
+    assert flat["hops"][0][0] == 0
+
+
+def test_flat_ops_render_without_legs_line():
+    rec = {"v": 1, "epoch": 0, "chan": 0, "op": 8, "verb": "allreduce",
+           "rank": 0, "up": 0, "down": 0, "members": 1, "hier_legs": 0,
+           "t_start": 0.0, "wall_s": 0.001, "n_frames": 2,
+           "hops": [[0, 2, 0.0001, 0.0005, 0.0002]],
+           "waits": {b: 0.0 for b in obs_trace.WAIT_BUCKETS}}
+    trees = obs_trace.assemble([rec], world=1)
+    assert "legs" not in trees[0]
+    text = obs_trace.format_trace(
+        {"epoch": 0, "sample": 8, "ops": trees, "scoreboard": {}})
+    assert "legs:" not in text and "[hier" not in text

@@ -479,7 +479,17 @@ def test_kill_and_heal_hier_leader_reelects_replay_equal():
     {3}, whose lowest surviving original rank IS the re-elected leader
     — the int64 bitwise oracle holds exactly-once on every committed
     round, frames strand and fence, and two same-seed runs print
-    identical FAULTLOG/HEALLOG/TRACELOG/FLEET digests."""
+    identical FAULTLOG/HEALLOG/TRACELOG/FLEET digests.
+
+    This run is ALSO the kill-a-node-agent chaos gate (ISSUE 15): the
+    victim — node 1's leader — is node 1's elected telemetry agent, so
+    the surviving leader's FLEETTREE line must show the RE-ELECTED
+    agent (rank 3, node 1's lowest surviving original) publishing the
+    healed generation's tree with every survivor covered, and each
+    survivor's HEALTH walk must carry the degraded → healing → ok
+    transitions the FLEET digest pins replay-equal."""
+    import json as _json
+
     from rocnrdma_tpu.runtime.multiprocess import run_workers
 
     def _line(r, key):
@@ -506,8 +516,21 @@ def test_kill_and_heal_hier_leader_reelects_replay_equal():
                 f"{r.stdout}\n{r.stderr}"
             assert _line(r, "EPOCH") == "1"
             assert _line(r, "MEMBERS") == "[0, 1, 3]"
+            # the degraded-then-healed walk every survivor takes (the
+            # FLEET digest below pins it replay-equal across runs)
+            health = _json.loads(_line(r, "HEALTH"))
+            assert ["ok", "degraded", 0] in health, health
+            assert ["healing", "ok", 1] in health, health
         assert sum(int(_line(r, "FENCED")) for r in results
                    if r.process_id != victim) > 0
+        # the re-elected node agent (rank 3 took dead rank 2's role)
+        # published epoch 1's tree: the surviving leader's root digest
+        # covers every survivor
+        leader = next(r for r in results if r.process_id == 0)
+        tree = _json.loads(_line(leader, "FLEETTREE"))
+        assert tree["epoch"] == 1
+        assert tree["members"] == [0, 1, 3]
+        assert tree["root_covers"] == [0, 1, 3], tree
     for a, b in zip(*runs):
         if a.process_id == victim:
             continue

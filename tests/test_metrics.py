@@ -102,10 +102,10 @@ def test_format_table_shows_worst_rank_p99_column():
     table = M.format_table([with_fleet, without])
     assert "wp99(us)" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    # wp99 is fourth-from-last (cp-rank, bfill%, picks trail it,
-    # PR 10/11/12)
-    assert rows[0].split()[-5] == "2048"
-    assert rows[1].split()[-5] == "-"
+    # wp99 is fifth-from-last (cp-rank, bfill%, picks, codec, sops
+    # trail it, PR 10/11/12/13/15)
+    assert rows[0].split()[-6] == "2048"
+    assert rows[1].split()[-6] == "-"
 
 
 def test_format_table_shows_cp_rank_column():
@@ -119,9 +119,9 @@ def test_format_table_shows_cp_rank_column():
     table = M.format_table([with_trace, without])
     assert "cp-rank" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    # cp-rank is third-from-last (bfill% and picks trail it, PR 11/12)
-    assert rows[0].split()[-4] == "3"
-    assert rows[1].split()[-4] == "-"
+    # cp-rank is fourth-from-last (bfill%, picks, codec, sops trail it)
+    assert rows[0].split()[-5] == "3"
+    assert rows[1].split()[-5] == "-"
 
 
 def test_format_table_shows_bucket_fill_column():
@@ -135,9 +135,9 @@ def test_format_table_shows_bucket_fill_column():
     table = M.format_table([fused, plain])
     assert "bfill%" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    # bfill% is second-to-last (the picks column trails it, PR 12)
-    assert rows[0].split()[-3] == "87"
-    assert rows[1].split()[-3] == "-"
+    # bfill% is third-from-last (picks, codec, sops trail it)
+    assert rows[0].split()[-4] == "87"
+    assert rows[1].split()[-4] == "-"
 
 
 def test_format_table_shows_tier_column():
@@ -179,8 +179,8 @@ def test_format_table_shows_picks_column():
     table = M.format_table([tuned, plain])
     assert "picks" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    assert rows[0].split()[-2] == "511K/d2"
-    assert rows[1].split()[-2] == "-"
+    assert rows[0].split()[-3] == "511K/d2"
+    assert rows[1].split()[-3] == "-"
 
 
 def test_format_table_shows_codec_column():
@@ -197,8 +197,52 @@ def test_format_table_shows_codec_column():
     table = M.format_table([quant, plain])
     assert "codec" in table.splitlines()[0]
     rows = table.splitlines()[2:]
-    assert rows[0].split()[-1] == "int8"
+    assert rows[0].split()[-2] == "int8"
+    assert rows[1].split()[-2] == "-"
+
+
+def test_format_table_shows_store_ops_column():
+    """The store-ledger satellite (ISSUE 15): a record carrying a
+    ledger window prints the measurement's store round-trip total in
+    the trailing sops column; rows without one print '-'."""
+    counted = M.BenchRecord.measure(
+        "b", "allreduce", "ring", 2, 4096, "float32", 1e-6,
+        platform="host-shm",
+        store={"ops": 12, "classes": {"heartbeat": 12}})
+    plain = M.BenchRecord.measure("b", "allreduce", "ring", 2, 4096,
+                                  "float32", 1e-6, platform="host-shm")
+    table = M.format_table([counted, plain])
+    assert "sops" in table.splitlines()[0]
+    rows = table.splitlines()[2:]
+    assert rows[0].split()[-1] == "12"
     assert rows[1].split()[-1] == "-"
+
+
+def test_store_counters_count_window_and_merge():
+    """The store-ops ledger (ISSUE 15): class/op attribution, the
+    snapshot/delta window every measurement uses, and the exact
+    key-wise cross-rank merge."""
+    s = M.StoreCounters()
+    s.count("heartbeat", op="set")
+    s.count("heartbeat", op="get", n=3)
+    s.count("telemetry-publish", op="set")
+    base = s.snapshot()
+    assert base["ops"] == 5
+    assert base["classes"] == {"heartbeat": 4, "telemetry-publish": 1}
+    assert base["by_op"]["heartbeat:get"] == 3
+    # the window: only movement since the snapshot, zero entries dropped
+    s.count("telemetry-read", op="get", n=2)
+    d = s.delta(base)
+    assert d["ops"] == 2
+    assert d["classes"] == {"telemetry-read": 2}
+    assert d["by_op"] == {"telemetry-read:get": 2}
+    # cross-rank merge is exact key-wise addition
+    m = M.StoreCounters.merge([base, d])
+    assert m["ops"] == 7 and m["classes"]["heartbeat"] == 4
+    assert m["classes"]["telemetry-read"] == 2
+    # reset empties every ledger
+    s.reset()
+    assert s.snapshot() == {"ops": 0, "classes": {}, "by_op": {}}
 
 
 def test_negotiation_gauges_record_and_reset():

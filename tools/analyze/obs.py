@@ -124,6 +124,15 @@ ELASTIC_SURFACE = ("grow", "heal", "wait_promotion")
 # invariant)
 TELEMETRY_FILE = "rocnrdma_tpu/obs/fleet.py"
 STORE_WRITES = {"set", "set_if_absent", "exchange"}
+# ...and every store READ there too (ISSUE 15 — the NodeAgent's
+# aggregation pass and the tree/flat observer fetches read many keys
+# per pass): each must carry an explicit ``timeout_s`` so a slow store
+# costs a bounded slice of the caller's budget, never a default-30s
+# stall inside a watchdog tick. Reads MAY sit in loops (a fetch per
+# member under one shared remaining-budget deadline is the pattern);
+# the boundedness is the invariant. ``try_get`` only: ``get`` is the
+# universal dict method name and would false-positive everywhere.
+STORE_READS = {"try_get"}
 
 # the span-pairing surface (PR 10): the causal tracer
 # (``rocnrdma_tpu/obs/trace.py``) opens per-op spans with
@@ -380,6 +389,14 @@ def hier_problems(tree: ast.Module, where: str,
     return problems
 
 
+def _store_call(call: ast.Call, names: set) -> bool:
+    """A store client METHOD call (``client.set(...)`` — attribute
+    calls only: the bare-name builtins ``set``/``get`` would
+    false-positive on every set() construction and dict read)."""
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr in names)
+
+
 def telemetry_problems(tree: ast.Module, where: str,
                        used: set | None = None) -> list[str]:
     """The telemetry-publish invariant over the fleet module's store
@@ -399,7 +416,7 @@ def telemetry_problems(tree: ast.Module, where: str,
             for node in ast.walk(fn))
         for call in ast.walk(fn):
             if not (isinstance(call, ast.Call)
-                    and base.call_name(call) in STORE_WRITES):
+                    and _store_call(call, STORE_WRITES)):
                 continue
             key = f"{os.path.basename(where)}::{qual}"
             if key in ALLOW:
@@ -426,6 +443,27 @@ def telemetry_problems(tree: ast.Module, where: str,
                     f"an except that records — _FLIGHT.record — before "
                     f"absorbing; a silently dropped publish is a blind "
                     f"spot in the observability plane itself)")
+        # the read half (ISSUE 15): bounded, always — the NodeAgent's
+        # aggregation pass runs on the watchdog thread, and one
+        # unbounded try_get there is a stalled heartbeat waiting to
+        # happen (loops are fine; the shared-deadline fetch is the
+        # pattern)
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call)
+                    and _store_call(call, STORE_READS)):
+                continue
+            key = f"{os.path.basename(where)}::{qual}"
+            if key in ALLOW:
+                if used is not None:
+                    used.add(key)
+                continue
+            if not any(kw.arg == "timeout_s" for kw in call.keywords):
+                problems.append(
+                    f"{where}:{call.lineno}: telemetry store read in "
+                    f"{qual} has no explicit timeout_s — an unbounded "
+                    f"read in the agent/observer path turns a slow "
+                    f"store into a stalled watchdog tick (pass "
+                    f"timeout_s=, or ALLOW with a reason)")
     return problems
 
 

@@ -381,6 +381,66 @@ def check_hier_floor(current: list[dict],
     return findings
 
 
+def check_store_traffic(current: dict | None = None,
+                        results_dir: str = RESULTS,
+                        ladder=(8, 32)) -> list[dict]:
+    """The control-plane traffic ratchet (ISSUE 15): hold the telemetry
+    tree's scaling claims against the committed
+    ``results/fleettree_r01.json`` — a future PR that quietly
+    reintroduces an O(n) observer read (or inflates per-rank publish
+    chatter) fails tier-1 here, counted by the store-ops ledger.
+
+    ``current``: a ``tools.simfleet`` record doc; when None, a fresh
+    small-ladder simfleet run is measured in-process (seconds — real
+    store, real agent code). Three checks: (1) the current doc's own
+    invariants (per-rank ops constant ±1 across its ladder, observer
+    tree reads under the c·log₂(nodes) bound, tree-merged ==
+    flat-merged on every rung — ``simfleet.check_record``); (2) the
+    per-rank ops-per-window ratchet: no current rung may exceed the
+    committed max + the committed ±allowance; (3) the observer-ops
+    ratchet: a rung with a committed twin (same rank count) may not
+    read more keys than the twin did."""
+    path = os.path.join(results_dir, "fleettree_r01.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as fp:
+        committed = json.load(fp)
+    if current is None:
+        from tools import simfleet
+        current = simfleet.run_ladder(
+            ladder,
+            node_size=committed.get("node_size", 8),
+            fanout=committed.get("fanout", 4),
+            windows=committed.get("windows", 2),
+            seed=committed.get("seed", 0))
+    from tools.simfleet import check_record
+    findings = [{"key": ("simfleet", row_prob), "store_traffic": row_prob,
+                 "trace_diff": None}
+                for row_prob in check_record(current)]
+    floors = committed.get("floors", {})
+    ceiling = (floors.get("per_rank_ops_max", 0.0)
+               + floors.get("per_rank_spread_max", 1.0))
+    twins = {r["ranks"]: r for r in committed.get("ladder", [])}
+    for row in current.get("ladder", []):
+        if row["per_rank_ops_per_window"] > ceiling:
+            findings.append({
+                "key": ("simfleet", row["ranks"]),
+                "per_rank_ops": row["per_rank_ops_per_window"],
+                "ops_ceiling": round(ceiling, 3),
+                "trace_diff": None,
+            })
+        twin = twins.get(row["ranks"])
+        if twin is not None \
+                and row["observer_tree_ops"] > twin["observer_tree_ops"]:
+            findings.append({
+                "key": ("simfleet", row["ranks"]),
+                "observer_ops": row["observer_tree_ops"],
+                "committed_observer_ops": twin["observer_tree_ops"],
+                "trace_diff": None,
+            })
+    return findings
+
+
 def check_current(current: list[dict],
                   results_dir: str = RESULTS,
                   ratio: float = 0.8) -> list[dict]:
@@ -417,6 +477,19 @@ def format_findings(findings: list[dict]) -> str:
                          f"exceeds the committed {f['err_ceil']} ceiling "
                          f"— a speedup bought by coarser quantization "
                          f"is a regression")
+        elif "store_traffic" in f:
+            lines.append(f"  simfleet: {f['store_traffic']}")
+        elif "per_rank_ops" in f:
+            lines.append(f"  {key}: per-rank store ops per window grew "
+                         f"to {f['per_rank_ops']} — past the committed "
+                         f"{f['ops_ceiling']} ceiling (control-plane "
+                         f"chatter is a regression even when GB/s "
+                         f"holds)")
+        elif "observer_ops" in f:
+            lines.append(f"  {key}: the observer read cost "
+                         f"{f['observer_ops']} store ops vs the "
+                         f"committed {f['committed_observer_ops']} — "
+                         f"an O(n) read path crept back in")
         elif "hier_engaged" in f:
             lines.append(f"  {key}: the 'hier' row never ran the "
                          f"two-level schedule (hier_ops=0) — its "

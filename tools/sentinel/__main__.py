@@ -34,7 +34,18 @@ def main(argv=None) -> int:
                    help="regression threshold as a fraction of the "
                         "committed algbw (default 0.8, the smoke "
                         "gates' own noise allowance)")
+    p.add_argument("--store-traffic", action="store_true",
+                   help="run the simfleet store-traffic ratchet against "
+                        "the committed results/fleettree_r01.json "
+                        "(per-rank ops O(1), observer ops O(log n))")
     args = p.parse_args(argv)
+    if args.store_traffic:
+        if args.records or args.run_smoke:
+            p.error("--store-traffic runs alone")
+        findings = sentinel.check_store_traffic(
+            results_dir=args.results_dir)
+        print(sentinel.format_findings(findings))
+        return 1 if findings else 0
     if (args.records is None) == (not args.run_smoke):
         p.error("pass exactly one of --records / --run-smoke")
     path = args.records

@@ -1,0 +1,384 @@
+"""Simulated-fleet harness — the telemetry tree's scaling gate
+(ISSUE 15, DESIGN.md §6e).
+
+Hundreds of LIGHTWEIGHT in-process ranks (no process groups, no
+collectives — one real :class:`BootstrapServer` over the native TCP
+queue pairs, deterministic synthetic telemetry snapshots) drive the
+production fleet-plane code paths end to end: per-rank publishes
+(``obs.fleet`` snapshot/meta keys), the per-node :class:`NodeAgent`
+aggregation passes (the REAL agent class, over a stub pg), and both
+observer reads (tree root digest vs ``--flat`` per-rank). Every store
+round-trip lands in the :data:`metrics.STORE` ledger by traffic class,
+so the acceptance claims are COUNTED, not estimated:
+
+- per-rank control traffic per window stays O(1) — constant (±1) from
+  8 to 256 simulated ranks;
+- observer traffic is O(log n) — the tree read costs
+  ``meta + root (+ fallbacks)`` where the flat read costs ``n + 1``;
+- tree-merged equals flat-merged: every counter, histogram bucket and
+  percentile bit-for-bit (float accumulations like summed ``total_s``
+  compare to relative tolerance — they are sums in different orders,
+  exactly what the exactness contract scopes out).
+
+Within a window the harness ticks agents DEEPEST-FIRST, so one window
+fully propagates leaf digests to the root; a live fleet (agents ticking
+independently on their watchdogs) lags by up to ``depth`` windows
+instead — same keys, same totals, later. The committed record
+(``results/fleettree_r01.json``) is the 256-rank host-plane dryrun the
+sentinel's ``check_store_traffic`` ratchets against.
+
+CLI::
+
+    python -m tools.simfleet --ranks 8,64,256 --node-size 8 --json
+    python -m tools.simfleet --ranks 256 --out results/fleettree_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import random
+import sys
+import time
+
+from rocnrdma_tpu.metrics import STORE, StoreCounters
+from rocnrdma_tpu.obs import fleet
+from rocnrdma_tpu.transport import bootstrap
+
+GROUP = "simfleet"
+
+# synthetic verb-latency buckets drawn per rank (log2 labels on the
+# shared exponent grid, like the real recorder's)
+_BUCKET_LABELS = ("<=8us", "<=64us", "<=512us", "<=4096us", "<=32768us")
+
+
+def synth_snapshot(orig: int, epoch: int, seq: int, seed: int) -> dict:
+    """One rank's deterministic synthetic telemetry payload — the
+    schema ``FleetAgent.local_snapshot`` publishes, with counter values
+    that differ per (rank, window, seed) so an aggregation bug that
+    drops or double-counts a rank cannot hide behind uniform inputs."""
+    rng = random.Random((seed << 20) ^ (orig << 8) ^ seq)
+    streamed = rng.randrange(1, 1 << 20)
+    frames = rng.randrange(1, 512)
+    wire = {
+        "payload_bytes_copied": 0,
+        "payload_bytes_streamed": streamed,
+        "frames_streamed": frames,
+        "frames_copied": 0,
+        "frames_overlapped": rng.randrange(0, frames),
+        "frames_fenced": rng.randrange(0, 3),
+        "frames_resumed": 0,
+        "grows": 0,
+        "promotions": 0,
+        "hier_ops": rng.randrange(0, 4),
+        "channel_frames_streamed": {"bulk": rng.randrange(0, 64)},
+        "channel_bytes_streamed": {"bulk": rng.randrange(0, 1 << 16)},
+        "channel_frames_fenced": {},
+    }
+    verbs = {
+        "isend": {
+            "count": 0, "total_s": 0.0, "mean_us": 0.0,
+            "buckets": {},
+        }
+    }
+    for lbl in _BUCKET_LABELS:
+        n = rng.randrange(0, 50)
+        if n:
+            verbs["isend"]["buckets"][lbl] = n
+            verbs["isend"]["count"] += n
+            verbs["isend"]["total_s"] += n * 1e-6
+    verbs["isend"]["mean_us"] = (
+        verbs["isend"]["total_s"] / verbs["isend"]["count"] * 1e6
+        if verbs["isend"]["count"] else 0.0)
+    return {
+        "v": 1,
+        "rank": orig,
+        "orig": orig,
+        "epoch": epoch,
+        "seq": seq,
+        "plane": "sim",
+        "health": "ok",
+        "transitions": [],
+        "heals": 0,
+        "window_s": 1.0,
+        "wire": wire,
+        "wire_delta": {"payload_bytes_streamed": streamed,
+                       "channel_bytes_streamed": dict(
+                           wire["channel_bytes_streamed"])},
+        "negotiation": {"frame_bytes": 0, "pipeline_depth": 0,
+                        "tuner_version": None, "codec": None,
+                        "algorithm": "hier" if wire["hier_ops"] else None},
+        "store": {"ops": 0, "classes": {}, "by_op": {}},
+        "verb_latency": verbs,
+        "flight": {"recorded": seq, "capacity": 4096,
+                   "saturated": False},
+        "trace": [],
+    }
+
+
+class _SimPG:
+    """The minimal pg surface :class:`fleet.NodeAgent` consumes — a
+    simulated rank's identity, membership and node map (no transport,
+    no health machinery: simfleet ranks are all alive and epoch 0
+    unless the scenario says otherwise)."""
+
+    def __init__(self, orig: int, members: list, node_of: list,
+                 epoch: int, group: str = GROUP, dead=()):
+        self.rank = members.index(orig)
+        self.global_ranks = list(members)
+        self.epoch = epoch
+        self.group_name = group
+        self._node_of = node_of
+        self._dead = list(dead)
+
+    def confirmed_dead(self) -> list:
+        return list(self._dead)
+
+
+def _agent_order(n_nodes: int, fanout: int) -> list:
+    """Node indices deepest-first (ties by index), so one sequential
+    agent pass fully propagates leaf digests to the root."""
+    def depth(idx: int) -> int:
+        d = 0
+        while idx:
+            idx = (idx - 1) // fanout
+            d += 1
+        return d
+    return sorted(range(n_nodes), key=lambda i: (-depth(i), i))
+
+
+def _counters_equal(a: dict, b: dict) -> bool:
+    """Recursive exact equality over the integer half of two values
+    (ints compare ==, floats to 1e-9 relative, dicts/lists key/position
+    -wise)."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        return (set(a) == set(b)
+                and all(_counters_equal(a[k], b[k]) for k in a))
+    if isinstance(a, list) and isinstance(b, list):
+        return (len(a) == len(b)
+                and all(_counters_equal(x, y) for x, y in zip(a, b)))
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, float) or isinstance(b, float):
+        if not isinstance(a, (int, float)) \
+                or not isinstance(b, (int, float)):
+            return False
+        return math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-12)
+    return a == b
+
+
+def fleet_views_equal(tree: dict, flat: dict) -> dict:
+    """The exactness verdict between a tree-merged and a flat-merged
+    fleet snapshot: the contract fields (counters, every histogram
+    bucket, percentiles, per-rank rows, membership) must be
+    bit-identical; float accumulations (``total_s``/``mean_us``
+    sums, GB/s) compare to relative tolerance — they are sums taken
+    in different orders."""
+    buckets = lambda v: {verb: m.get("buckets", {})
+                         for verb, m in v.items()}
+    counts = lambda v: {verb: m.get("count") for verb, m in v.items()}
+    verdict = {
+        "wire_totals": tree["wire_totals"] == flat["wire_totals"],
+        "store_totals": tree.get("store_totals")
+                        == flat.get("store_totals"),
+        "verb_buckets": buckets(tree["verb_latency"])
+                        == buckets(flat["verb_latency"]),
+        "verb_counts": counts(tree["verb_latency"])
+                       == counts(flat["verb_latency"]),
+        "percentiles": (tree["verb_p50_us"] == flat["verb_p50_us"]
+                        and tree["verb_p99_us"] == flat["verb_p99_us"]
+                        and tree["worst_p99_us"]
+                        == flat["worst_p99_us"]),
+        "membership": (tree["members"] == flat["members"]
+                       and tree["missing"] == flat["missing"]
+                       and tree["health"] == flat["health"]),
+        "rows": _counters_equal(tree["ranks"], flat["ranks"]),
+        "rates": _counters_equal(tree["plane_GBps"], flat["plane_GBps"])
+                 and _counters_equal(tree["channel_GBps"],
+                                     flat["channel_GBps"]),
+    }
+    verdict["equal"] = all(verdict.values())
+    return verdict
+
+
+def run_point(n_ranks: int, node_size: int = 8, fanout: int = 4,
+              windows: int = 2, seed: int = 0, epoch: int = 0) -> dict:
+    """One ladder point: ``n_ranks`` simulated ranks publishing
+    ``windows`` telemetry windows through the real store + agent code,
+    every store op counted by class. Returns the point's record row."""
+    members = list(range(n_ranks))
+    node_of = [g // node_size for g in members]
+    nodes = fleet.split_nodes(members, node_of)
+    agents = fleet.node_agents(nodes)
+    order = _agent_order(len(nodes), fanout)
+    server = bootstrap.BootstrapServer(n_ranks=n_ranks)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=10.0,
+                                       scope=f"pg/{GROUP}/ring",
+                                       traffic_class="telemetry-publish")
+    publish_delta = None
+    try:
+        base = STORE.snapshot()
+        for w in range(windows):
+            meta = json.dumps({"epoch": epoch, "members": members,
+                               "world": n_ranks, "group": GROUP})
+            with bootstrap.store_traffic("telemetry-publish"):
+                for orig in members:
+                    client.set(fleet.snapshot_key(GROUP, epoch, orig),
+                               json.dumps(synth_snapshot(
+                                   orig, epoch, w, seed)),
+                               timeout_s=5.0)
+                    client.set(fleet.meta_key(GROUP), meta,
+                               timeout_s=5.0)
+            for idx in order:
+                agent = fleet.NodeAgent(
+                    _SimPG(agents[idx], members, node_of, epoch),
+                    fanout=fanout)
+                if not agent.tick(client, timeout_s=5.0):
+                    raise RuntimeError(
+                        f"simfleet: node {idx}'s agent tick failed")
+        publish_delta = STORE.delta(base)
+
+        obs_base = STORE.snapshot()
+        tree_view = fleet.read_fleet(server.handle, GROUP,
+                                     timeout_s=10.0)
+        tree_ops = STORE.delta(obs_base)
+        obs_base = STORE.snapshot()
+        flat_view = fleet.read_fleet(server.handle, GROUP,
+                                     timeout_s=10.0, flat=True)
+        flat_ops = STORE.delta(obs_base)
+    finally:
+        client.close()
+        server.close()
+
+    per_rank = (publish_delta["ops"] / windows / n_ranks)
+    return {
+        "ranks": n_ranks,
+        "nodes": len(nodes),
+        "node_size": node_size,
+        "fanout": fanout,
+        "depth": fleet.tree_depth(len(nodes), fanout),
+        "windows": windows,
+        # per-rank control traffic per window, ledger-counted: every
+        # publish/agent op over the run, divided down — the O(1) claim
+        "per_rank_ops_per_window": round(per_rank, 3),
+        "publish_classes": publish_delta["classes"],
+        # observer traffic per refresh, both shapes — the O(log n)
+        # claim is tree_ops vs flat_ops
+        "observer_tree_ops": tree_ops["ops"],
+        "observer_flat_ops": flat_ops["ops"],
+        "observer_tree_classes": tree_ops["classes"],
+        "missing_in_tree": tree_view["missing"],
+        "equal": fleet_views_equal(tree_view, flat_view),
+    }
+
+
+def run_ladder(ranks=(8, 32, 64, 256), node_size: int = 8,
+               fanout: int = 4, windows: int = 2, seed: int = 0) -> dict:
+    """The full scaling record: one :func:`run_point` per rung, plus
+    the floors the sentinel ratchets (``check_store_traffic``)."""
+    rows = [run_point(n, node_size=node_size, fanout=fanout,
+                      windows=windows, seed=seed) for n in ranks]
+    per_rank = [r["per_rank_ops_per_window"] for r in rows]
+    return {
+        "bench": "simfleet",
+        "v": 1,
+        "node_size": node_size,
+        "fanout": fanout,
+        "windows": windows,
+        "seed": seed,
+        "ladder": rows,
+        "floors": {
+            # the ±1 constancy bar on per-rank ops per window, and the
+            # absolute ceiling a future O(n) path would blow through
+            "per_rank_ops_max": round(max(per_rank), 3),
+            "per_rank_spread_max": 1.0,
+            # observer tree reads must stay under c·log2(nodes) (+ the
+            # 3-op floor of meta + root + bye on a single-node fleet)
+            "observer_log_c": 2.0,
+            "observer_ops_max": max(r["observer_tree_ops"]
+                                    for r in rows),
+        },
+        "ts": time.time(),
+    }
+
+
+def check_record(doc: dict) -> list:
+    """The record's SELF-invariants (shared with sentinel's
+    ``check_store_traffic``): per-rank ops constant (±ceiling) across
+    the ladder, observer tree reads under the log bound, and the
+    tree-vs-flat views equal on every rung."""
+    problems = []
+    floors = doc.get("floors", {})
+    rows = doc.get("ladder", [])
+    per_rank = [r["per_rank_ops_per_window"] for r in rows]
+    spread = (max(per_rank) - min(per_rank)) if per_rank else 0.0
+    if spread > floors.get("per_rank_spread_max", 1.0):
+        problems.append(
+            f"per-rank store ops per window are not O(1): spread "
+            f"{spread:.3f} across ranks={[r['ranks'] for r in rows]} "
+            f"(allowed ±{floors.get('per_rank_spread_max', 1.0)})")
+    c = floors.get("observer_log_c", 2.0)
+    for r in rows:
+        # floor of 3: meta + root digest + the client's bye round-trip
+        # (the ledger counts teardown honestly) on a single-node fleet
+        bound = max(3.0, c * math.log2(max(2, r["nodes"])))
+        if r["observer_tree_ops"] > bound:
+            problems.append(
+                f"observer tree read at ranks={r['ranks']} cost "
+                f"{r['observer_tree_ops']} store ops > the "
+                f"{bound:.1f} O(log n) bound (nodes={r['nodes']}, "
+                f"c={c}) — an O(n) read path crept back in")
+        if not r["equal"]["equal"]:
+            bad = [k for k, v in r["equal"].items()
+                   if k != "equal" and not v]
+            problems.append(
+                f"tree-merged != flat-merged at ranks={r['ranks']}: "
+                f"{bad} diverged — the exactness contract broke")
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.simfleet",
+        description="Simulated-fleet scaling harness for the telemetry "
+                    "tree: counts store ops per traffic class and "
+                    "checks tree-merged == flat-merged")
+    p.add_argument("--ranks", default="8,32,64,256",
+                   help="comma-separated ladder of simulated rank "
+                        "counts")
+    p.add_argument("--node-size", type=int, default=8)
+    p.add_argument("--fanout", type=int, default=4)
+    p.add_argument("--windows", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", action="store_true",
+                   help="print the record as JSON")
+    p.add_argument("--out", default=None,
+                   help="write the record to this path")
+    args = p.parse_args(argv)
+    ranks = [int(v) for v in args.ranks.split(",") if v]
+    doc = run_ladder(ranks, node_size=args.node_size,
+                     fanout=args.fanout, windows=args.windows,
+                     seed=args.seed)
+    problems = check_record(doc)
+    if args.out:
+        with open(args.out, "w") as fp:
+            json.dump(doc, fp, indent=1, sort_keys=True)
+            fp.write("\n")
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        for r in doc["ladder"]:
+            eq = "equal" if r["equal"]["equal"] else "DIVERGED"
+            print(f"ranks {r['ranks']:>4}  nodes {r['nodes']:>3}  "
+                  f"depth {r['depth']}  per-rank ops/window "
+                  f"{r['per_rank_ops_per_window']:>6.3f}  observer "
+                  f"tree {r['observer_tree_ops']} vs flat "
+                  f"{r['observer_flat_ops']}  tree-vs-flat {eq}")
+    for prob in problems:
+        print(f"simfleet: FAIL: {prob}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
