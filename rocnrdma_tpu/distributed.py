@@ -774,6 +774,12 @@ class ProcessGroup:
         self._standby = standby
         self._sid = None            # standby slot id in the store registry
         self._standby_listener = None
+        # predictive straggler evasion (ISSUE 16): the armed policy
+        # engine (transport/evasion.py), None until enable_evasion().
+        # The engine SCORES on rank 0 only; every tick broadcasts the
+        # decision + full engine state and all ranks adopt it, so the
+        # strike history survives promotions and reshapes in lockstep.
+        self._evasion = None
         self._server = server  # only rank 0 (or an external sidecar) owns one
         # the node-aware hierarchy (ISSUE 14, DESIGN.md §5l): the agreed
         # ORIGINAL-rank -> node-id map (None = flat-only group), the
@@ -3910,6 +3916,271 @@ class ProcessGroup:
         wd = info.get("watchdog")
         if wd:
             self.start_watchdog(*wd)
+
+    # -- predictive straggler evasion (ISSUE 16, DESIGN.md §5m) -------------
+    #
+    # The watchdog confirms DEATH; a degrading rank — slow-but-alive,
+    # heartbeating on schedule — drags every ring collective's critical
+    # path indefinitely without ever tripping it. The evasion engine
+    # (transport/evasion.py) closes the ROADMAP's "act on the scoreboard
+    # before the watchdog does" loop: the PR-10 windowed straggler
+    # scoreboard names the chronically cp-dominant rank, tier 1 rotates
+    # it off the critical chain (epoch-fenced same-member rewire +
+    # lane-credit cap + re-rooting), tier 2 drains it at an op boundary
+    # and promotes a warm spare into its ORIGINAL identity before any
+    # death confirmation. Decisions are a pure function of the trace
+    # stream: the engine scores on rank 0 only and every tick broadcasts
+    # decision + engine state for lockstep adoption (the tune_wire
+    # commit shape), so same-seed chaos runs replay digest-equal.
+
+    def enable_evasion(self, policy=None,
+                       timeout_s: float | None = None) -> dict:
+        """Arm predictive straggler evasion on this group. ``policy``:
+        an :class:`~rocnrdma_tpu.transport.evasion.EvasionPolicy`, a
+        dict of its fields, or None for the committed defaults. A
+        COLLECTIVE among members (the closing barrier pins that every
+        rank is armed before anyone ticks); a standby spare arms
+        locally only — its engine adopts the group's strike history
+        from the first post-promotion tick's broadcast. Returns the
+        armed policy constants as a dict."""
+        import dataclasses as _dc
+
+        from rocnrdma_tpu.transport import evasion as _evasion
+        t = self.timeout_s if timeout_s is None else timeout_s
+        if self._destroyed:
+            raise RuntimeError("cannot enable evasion on a destroyed group")
+        pol = (policy if isinstance(policy, _evasion.EvasionPolicy)
+               else _evasion.EvasionPolicy(**(policy or {})))
+        self._evasion = _evasion.EvasionEngine(pol)
+        _FLIGHT.record("evade-armed", window=pol.window_ops,
+                       share=pol.share_threshold,
+                       promote=pol.promote_threshold)
+        if self._standby is None and self.world_size > 1:
+            self.barrier(timeout_s=t)
+        return _dc.asdict(pol)
+
+    def evasion_tick(self, timeout_s: float | None = None) -> dict | None:
+        """One evasion policy tick — a COLLECTIVE protocol point, like
+        :meth:`tune_wire`: callers quiesce concurrent collectives around
+        it. Rank 0 scores the windowed straggler scoreboard
+        (:meth:`trace_stats`, last ``policy.window_ops`` assembled ops
+        of THIS epoch) plus the live-spare count, broadcasts the
+        decision and its full engine state, and every rank adopts both
+        before acting — a promoted spare inherits the strike history
+        instead of diverging. Returns the committed decision dict
+        (``action``/``victim``) or None.
+
+        After a tier-2 decision the VICTIM returns as a standby
+        (``is_standby`` True — it drained and parked in a spare slot);
+        survivors return with the warm spare already promoted into the
+        victim's original identity, world size unchanged."""
+        t = self.timeout_s if timeout_s is None else timeout_s
+        if self._evasion is None:
+            raise RuntimeError("evasion_tick: call enable_evasion() first")
+        if self._standby is not None:
+            raise RuntimeError("evasion_tick: a standby has no membership "
+                               "to score (wait_promotion first)")
+        eng = self._evasion
+        proposal = None
+        if self.rank == 0:
+            try:
+                stats = self.trace_stats(timeout_s=min(t, 5.0))
+                board = _trace.scoreboard(stats["ops"],
+                                          window=eng.policy.window_ops)
+            except (OSError, TimeoutError):
+                # a flaky store read scores nothing this tick — strikes
+                # hold (the engine's empty-window rule), never invented
+                board = {"ops": 0, "share": {}}
+            try:
+                spares = self.live_spares(timeout_s=min(t, 5.0))
+            except (OSError, TimeoutError):
+                spares = 0
+            if os.environ.get("ROCNRDMA_EVADE_DEBUG"):
+                print(f"EVADETICK {eng.tick + 1} ops={board.get('ops')} "
+                      f"share={board.get('share')} spares={spares}",
+                      flush=True)
+            decision = eng.observe(board, list(self._ranks), spares)
+            proposal = {"decision": decision, "state": eng.state()}
+        if self.world_size > 1:
+            proposal = self.broadcast_object(proposal, src=0)
+        if self.rank != 0:
+            eng.adopt(proposal["state"])
+        decision = proposal["decision"]
+        if decision is None:
+            return None
+        victim = int(decision["victim"])
+        try:
+            if decision["action"] == "reshape":
+                self._evade_reshape(victim, t)
+            else:
+                self._evade_promote(victim, t)
+        except BaseException as e:
+            # an aborted action must leave its story on the timeline —
+            # the postmortem for "the ring half-rotated" starts here
+            _FLIGHT.record("evade-abort", epoch=self.epoch, victim=victim,
+                           action=decision["action"],
+                           error=type(e).__name__)
+            raise
+        return dict(decision)
+
+    def _evade_reshape(self, victim: int, timeout_s: float) -> None:
+        """Tier 1: rotate ``victim`` (an ORIGINAL rank) to the TAIL of
+        the ring neighbour order, epoch-fenced through the exact heal
+        steps on an UNCHANGED membership — fence, hier invalidate, p2p
+        suspend (streams resume), permutation rewire (kept edges stay,
+        moved edges re-dial through per-epoch store keys), barrier,
+        watchdog re-arm. The victim additionally caps its OWN lane
+        credits at the gate (``LaneRegistry.cap_credits`` — the PR-9
+        shrink), and :meth:`preferred_root` re-roots rooted verbs away
+        from it from here on. In-flight stragglers of the old epoch
+        fence like a heal's."""
+        deadline = time.monotonic() + timeout_s
+        remaining = lambda: max(0.1, deadline - time.monotonic())
+        old_ranks = list(self._ranks)
+        if victim not in old_ranks:
+            return
+        epoch = self.epoch + 1
+        members = [m for m in old_ranks if m != victim] + [victim]
+        g = old_ranks[self.rank]
+        new_rank = members.index(g)
+        ns = f"pg/{self.group_name}/evade/e{epoch}"
+        _FLIGHT.record("evade-reshape", epoch=epoch, victim=victim,
+                       world=len(members))
+        was_watching = self._watchdog_params
+        self.stop_watchdog()
+        try:
+            self._net.set_epoch(epoch)
+            self.epoch = epoch
+            self._hier_invalidate()
+            self._suspend_p2p(members, fresh=frozenset())
+            self._rewire(members, new_rank, len(members), old_ranks, ns,
+                         remaining, fresh=frozenset())
+            self.rank = new_rank
+            self._ranks = members
+            self._barrier_no = 0
+            self._postmortemed = False
+            self._client.rank = new_rank
+            if g == victim:
+                reg = getattr(self._net, "lanes", None)
+                if reg is not None:
+                    cap = self._evasion.policy.credit_cap_bytes
+                    _FLIGHT.record("evade-credit-cap",
+                                   lanes=reg.cap_credits(cap), cap=cap)
+            self._client.barrier(f"{ns}/wired", len(members), remaining())
+        except BaseException as e:
+            _FLIGHT.record("evade-abort", epoch=epoch, victim=victim,
+                           action="reshape", error=type(e).__name__)
+            if was_watching is not None:
+                self.start_watchdog(*was_watching)
+            raise
+        _FLIGHT.mark_sync(ns=ns, rank=new_rank)
+        _WIRE.evaded_reshape()
+        if was_watching is not None:
+            self.start_watchdog(*was_watching)
+
+    def _evade_promote(self, victim: int, timeout_s: float) -> list | None:
+        """Tier 2: retire ``victim`` (an ORIGINAL rank) BEFORE death
+        confirmation. The victim drains itself to a standby slot
+        (:meth:`drain`); every survivor runs the heal protocol with the
+        victim pre-confirmed as the suspect — the grace window closes
+        as soon as the survivors rendezvous, and the PR-6 promotion
+        path splices the lowest-sid live warm spare into the victim's
+        ORIGINAL identity (world size, reshard shapes and rooted roots
+        preserved). Cheaper than a post-mortem heal: no watchdog
+        timeout is waited out, no collective has to abort first. If
+        the warm spare died since rank 0 counted it, the heal's own
+        assignment rule applies deterministically (the drained victim's
+        fresh slot — or a shrink) — never a hang."""
+        _FLIGHT.record("evade-promote", epoch=self.epoch + 1,
+                       victim=victim)
+        try:
+            if self._ranks[self.rank] == victim:
+                self.drain(timeout_s=timeout_s)
+                return None
+            victim_cur = self._ranks.index(victim)
+            members = self.heal(grace_s=1.0, timeout_s=timeout_s,
+                                _suspects={victim_cur})
+        except BaseException as e:
+            _FLIGHT.record("evade-abort", epoch=self.epoch, victim=victim,
+                           action="promote", error=type(e).__name__)
+            raise
+        _WIRE.evaded_promotion()
+        return members
+
+    def drain(self, timeout_s: float | None = None) -> None:
+        """Demote THIS member to a standby spare slot at an op boundary
+        — the victim's half of tier-2 evasion, also callable directly
+        for planned maintenance. Stops the watchdog, quiesces the ring
+        and p2p wiring (survivors epoch-fence any stale frames), and
+        registers in the spare registry under a fresh slot id (burned
+        slots are never reused, so the scan order stays deterministic).
+        Afterwards ``is_standby`` is True: collectives raise, and a
+        later heal/grow may re-admit this process via
+        :meth:`wait_promotion`."""
+        t = self.timeout_s if timeout_s is None else timeout_s
+        if self._destroyed:
+            raise RuntimeError("cannot drain a destroyed group")
+        if self._standby is not None:
+            raise RuntimeError("drain: this rank is already a standby")
+        g = self._ranks[self.rank] if self._ranks else -1
+        _FLIGHT.record("evade-drain", epoch=self.epoch, rank=g)
+        self.stop_watchdog()
+        try:
+            for comm in (self._send, self._recv):
+                if comm is not None:
+                    self._close_comm_quietly(comm)
+            self._send = self._recv = None
+            self._suspend_p2p(members=(), fresh=frozenset())
+            self._hier_invalidate()
+            self._standby = "spare"
+            self._set_health("resuming", cause="drained")
+            self._register_standby(t)
+        except BaseException as e:
+            _FLIGHT.record("evade-abort", epoch=self.epoch, rank=g,
+                           action="drain", error=type(e).__name__)
+            self._set_health("degraded", cause="drain-failed")
+            raise
+        _FLIGHT.record("evade-drained", rank=g, sid=self._sid)
+
+    def evasion_state(self) -> dict:
+        """The fleet-plane evasion summary this rank's telemetry
+        snapshots carry (``{"armed": False}`` until
+        :meth:`enable_evasion`): tick count, flagged original ranks,
+        actions taken, and the structural decision-log digest — the
+        EVASIONLOG the chaos replay check compares."""
+        if self._evasion is None:
+            return {"armed": False}
+        e = self._evasion
+        return {"armed": True, "tick": e.tick,
+                "reshaped": sorted(e.reshaped),
+                "promoted": sorted(e.promoted),
+                "actions": len(e.log), "digest": e.digest()}
+
+    def live_spares(self, timeout_s: float = 5.0) -> int:
+        """Count of live, unburned warm spares in the standby registry
+        right now — what gates a tier-2 promotion (evasion never
+        shrinks the world). Public so a harness can hold at a start
+        line until its spare's registration lands: the promote tick is
+        then a pure function of the trace stream, not of process spawn
+        order."""
+        deadline = time.monotonic() + timeout_s
+        remaining = lambda: max(0.1, deadline - time.monotonic())
+        return len(self._scan_standby_registry(
+            "spares", bootstrap.SPARE_RANK_BASE, "live_spares", remaining))
+
+    def preferred_root(self) -> int:
+        """The CURRENT rank rooted verbs should root at: the lowest
+        original rank the evasion engine has NOT flagged as reshaped
+        (a promoted slot runs fresh hardware and is eligible again).
+        Rank 0's slot — today's default root — whenever nothing is
+        flagged, so un-evaded groups see no change."""
+        if self._evasion is None or not self._ranks:
+            return 0
+        avoid = self._evasion.reshaped
+        for gid in sorted(self._ranks):
+            if gid not in avoid:
+                return self._ranks.index(gid)
+        return 0
 
     def _commit_counts(self) -> tuple:
         """``(total, {str(chan): count})`` read atomically under the

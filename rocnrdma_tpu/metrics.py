@@ -146,6 +146,13 @@ class WireCounters:
     #                                 stream continuation across a heal/grow
     grows: int = 0                  # grow() admissions this rank completed
     promotions: int = 0             # spare promotions this rank took part in
+    # predictive straggler evasion (ISSUE 16): policy actions taken
+    # BEFORE any death confirmation — tier-1 ring reshapes around a
+    # chronically cp-dominant rank, and tier-2 proactive drains/spare
+    # promotions it escalated to. Counted on every member at the
+    # action's lockstep commit, so same-seed chaos runs agree.
+    evasion_reshapes: int = 0       # tier-1 ring rotations committed
+    evasion_promotions: int = 0     # tier-2 proactive promotions committed
     # multi-tenant lane telemetry (PR 9). The scalar pair counts the
     # LaneGate's scheduling decisions (a pacing yield a credit lane
     # paid; an admit deferred behind higher-priority intent/backlog);
@@ -305,6 +312,19 @@ class WireCounters:
         with self._lock:
             self.promotions += n
 
+    def evaded_reshape(self, n: int = 1) -> None:
+        """Record tier-1 evasion reshapes (every member of the rotated
+        ring counts its own lockstep commit)."""
+        with self._lock:
+            self.evasion_reshapes += n
+
+    def evaded_promotion(self, n: int = 1) -> None:
+        """Record tier-2 proactive promotions (counted on the members
+        that drove the drain+promote, next to the ``promotions`` the
+        underlying heal path counts)."""
+        with self._lock:
+            self.evasion_promotions += n
+
     def hier(self, n: int = 1) -> None:
         """Record completed hierarchical (node-aware two-level)
         collectives — the ISSUE-14 schedule actually running, not
@@ -430,6 +450,8 @@ class WireCounters:
             self.frames_resumed = 0
             self.grows = 0
             self.promotions = 0
+            self.evasion_reshapes = 0
+            self.evasion_promotions = 0
             self.lane_yields = 0
             self.lane_waits = 0
             self.channel_frames_streamed = {}

@@ -106,7 +106,8 @@ HEALTH_STATES = ("ok", "degraded", "healing", "resuming")
 # wall-clock-shaped (how many frames landed before an abort's timeout
 # fired) and stay OUT of any replay-equality contract.
 DETERMINISTIC_COUNTERS = ("frames_fenced", "frames_resumed", "grows",
-                          "promotions", "channel_frames_fenced")
+                          "promotions", "evasion_reshapes",
+                          "evasion_promotions", "channel_frames_fenced")
 
 
 def _ns(group: str) -> str:
@@ -283,6 +284,14 @@ class FleetAgent:
             # causal tracer's cross-rank assembly rides THIS channel —
             # no extra store writes, same bounded best-effort publish
             "trace": _trace.TRACE.snapshot(),
+            # predictive straggler evasion (ISSUE 16): the armed
+            # engine's tick/flagged-ranks/actions summary plus the
+            # structural decision-log digest — how the fleet CLI shows
+            # WHO was reshaped/promoted-around before any death.
+            # getattr: test fakes predate the verb
+            "evasion": (pg.evasion_state()
+                        if hasattr(pg, "evasion_state")
+                        else {"armed": False}),
         }
 
     def publish(self, client, timeout_s: float = 1.0) -> bool:
@@ -466,6 +475,10 @@ def condense_rank(s: dict) -> dict:
         "transitions": s.get("transitions", []),
         "algo": neg.get("algorithm"),
         "codec": neg.get("codec"),
+        # the evasion engine's lockstep-adopted summary (ISSUE 16):
+        # every rank of a generation carries the same flagged sets,
+        # so any one row can label the whole membership
+        "evasion": s.get("evasion", {"armed": False}),
     }
 
 
@@ -595,6 +608,11 @@ def _assemble(digest: dict, epoch: int, members: list) -> dict:
                 channel_GBps[lane] = round(
                     channel_GBps.get(lane, 0.0) + nb / win / 1e9, 6)
         worst_p99 = max(worst_p99, r.get("p99_us", 0))
+        ev = r.get("evasion") or {}
+        evade = (None if not ev.get("armed")
+                 else "P" if orig in ev.get("promoted", ())
+                 else "R" if orig in ev.get("reshaped", ())
+                 else "-")
         ranks[str(orig)] = {
             "rank": r.get("rank"),
             "health": r.get("health"),
@@ -607,6 +625,10 @@ def _assemble(digest: dict, epoch: int, members: list) -> dict:
             "transitions": r.get("transitions", []),
             "algo": r.get("algo"),
             "codec": r.get("codec"),
+            # per-rank evasion flag (ISSUE 16): R = reshaped off the
+            # critical chain, P = slot proactively re-crewed by a
+            # promoted spare, '-' = armed and clean, None = not armed
+            "evade": evade,
         }
     return {
         "epoch": epoch,
@@ -674,6 +696,10 @@ def format_fleet(snap: dict) -> str:
         f"  fenced {w.get('frames_fenced', 0)}  "
         f"resumed {w.get('frames_resumed', 0)}  "
         f"grows {w.get('grows', 0)}  promotions {w.get('promotions', 0)}  "
+        # the predictive-evasion action counts (ISSUE 16) next to the
+        # reactive membership events they pre-empt
+        f"evade-R {w.get('evasion_reshapes', 0)}  "
+        f"evade-P {w.get('evasion_promotions', 0)}  "
         # the hier counter next to the per-rank algo/codec columns
         # below: hier_ops counts schedules that actually RAN — a fleet
         # whose every rank gauges algorithm=hier but whose hier_ops
@@ -706,7 +732,8 @@ def format_fleet(snap: dict) -> str:
             or "(none)"),
     ]
     hdr = (f"  {'orig':>5} {'rank':>5} {'health':>9} {'GB/s':>8} "
-           f"{'p99(us)':>8} {'algo':>6} {'codec':>6} {'flight':>12}")
+           f"{'p99(us)':>8} {'algo':>6} {'codec':>6} {'evade':>6} "
+           f"{'flight':>12}")
     lines += [hdr, "  " + "-" * (len(hdr) - 2)]
     for o in sorted(snap["ranks"], key=int):
         r = snap["ranks"][o]
@@ -718,6 +745,9 @@ def format_fleet(snap: dict) -> str:
             # resolved — a silently-flat fleet shows a column of
             # 'ring' here while the counters line's hier stays 0
             f"{r.get('algo') or '-':>6} {r.get('codec') or '-':>6} "
+            # the per-rank evasion flag (ISSUE 16): R reshaped,
+            # P proactively re-crewed, '-' armed+clean, 'off' unarmed
+            f"{r.get('evade') or 'off':>6} "
             f"{r['flight_recorded']}/{r['flight_capacity']}")
     for verb in sorted(snap["verb_latency"]):
         m = snap["verb_latency"][verb]

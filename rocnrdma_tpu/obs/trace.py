@@ -588,7 +588,10 @@ def assemble(records, world: int | None = None) -> list:
             tree["cp_share"] = {str(r): round(s, 9)
                                 for r, s in sorted(share.items())}
             if share:
-                tree["cp_rank"] = max(share, key=share.get)
+                # sorted() pins the tie-break: equal shares blame the
+                # LOWEST rank, so the evasion engine's decisions stay a
+                # pure function of the trace stream (ISSUE 16)
+                tree["cp_rank"] = max(sorted(share), key=share.get)
             if worst is not None:
                 blame = (worst["src"] if worst["hold"] >= worst["xfer"]
                          else worst["rank"])
@@ -702,11 +705,17 @@ def _critical_path(per_rank: dict[int, dict]) -> list:
     return path
 
 
-def scoreboard(assembled) -> dict:
+def scoreboard(assembled, window: int | None = None) -> dict:
     """The windowed straggler scoreboard over assembled ops: each
     rank's share of total critical-path time, a worst-hop histogram
     (how often each (rank, hop) was an op's single worst segment), and
-    the straggler — the rank holding the largest share."""
+    the straggler — the rank holding the largest share (ties broken to
+    the LOWEST rank, so consumers stay replay-pure). ``window`` keeps
+    only the most recent N assembled ops (``assemble`` sorts by
+    (epoch, chan, op), so the tail IS the newest work) — the sliding
+    view the evasion engine scores each tick."""
+    if window is not None and window > 0:
+        assembled = assembled[-window:]
     share: dict[int, float] = {}
     worst: dict[str, dict] = {}
     n = 0
@@ -728,7 +737,8 @@ def scoreboard(assembled) -> dict:
         "share": {str(r): round(s / total, 6) if total > 0 else 0.0
                   for r, s in sorted(share.items())},
         "worst_hop": worst,
-        "straggler": (max(share, key=share.get) if share else None),
+        "straggler": (max(sorted(share), key=share.get)
+                      if share else None),
     }
 
 

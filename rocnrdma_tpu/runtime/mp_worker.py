@@ -83,6 +83,24 @@ Tasks:
   attribution buckets sum to each op's wall span, and two same-seed
   runs digest identically.
 
+- ``evade-straggler``: the predictive-evasion acceptance run (ISSUE
+  16): a ``ProcessGroup`` fleet (shm plane, 1 trailing warm spare)
+  where ``--fault-rank`` is chronically DEGRADED (FaultNet
+  ``degrade_rank`` holds its receive completions every op — slow, not
+  dead; its watchdog heartbeats never stop). Every member runs
+  ``--rounds`` bitwise-checked int64 allreduces with an
+  ``evasion_tick`` at each round boundary (until one adoption tick
+  past the promotion — a healthy fleet's windows are pure scheduling
+  noise): the policy engine first
+  reshapes the straggler off the critical path (tier 1), then drains
+  it and promotes the warm spare into its ORIGINAL identity before
+  any death confirmation (tier 2) — the drained victim prints
+  ``DRAINED`` and exits 0, the proof no watchdog verdict was needed.
+  The leader prints per-phase ``DEGRADED_ALGBW``/``RECOVERED_ALGBW``
+  walls plus ``RECOVERY_RATIO``; every rank prints ``EVASIONLOG``
+  (the evade-* flight digest) and ``EVASTATE`` next to the usual
+  FAULTLOG/HEALLOG/FLEET replay lines — all replay-equal per seed.
+
 Every chaos task also prints a ``RINGFULL`` warning when the flight
 ring wrapped during the run (``flight-ring-saturated`` on the
 timeline): a wrapped ring may have evicted digest-relevant events, so
@@ -98,7 +116,7 @@ import sys
 import time
 
 CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective", "kill-and-heal",
-               "trace-delay")
+               "trace-delay", "evade-straggler")
 # tasks that drive BOTH planes: the host-plane chaos stack AND a real
 # jax coordination service (run_workers reserves a second port for it)
 DEVICE_TASKS = ("kill-a-host",)
@@ -1029,6 +1047,170 @@ def _heal_chaos_main(args) -> int:
     return status
 
 
+def _evade_chaos_main(args) -> int:
+    """The predictive-evasion acceptance task (module docstring:
+    ``evade-straggler``)."""
+    import json
+
+    import numpy as np
+
+    from rocnrdma_tpu import distributed as dist
+    from rocnrdma_tpu.transport import bootstrap
+    from rocnrdma_tpu.transport.faults import FaultSchedule
+
+    rank, total = args.process_id, args.num_processes
+    n = total - args.spares  # members first, warm spares trail
+    role = "member" if rank < n else "spare"
+    server = None
+    if rank == 0:
+        host, port = args.coordinator.rsplit(":", 1)
+        server = bootstrap.BootstrapServer(n_ranks=total, port=int(port),
+                                           host=host)
+    # chronic slowness, not death: every rank makes the same arming
+    # call and FaultSchedule arms it only on the victim. The hold is
+    # ~100 ms of completion-poll backoff per receive — far above the
+    # scheduler noise of a loaded box, far below any watchdog verdict
+    # (the victim's heartbeat thread never stops).
+    sched = FaultSchedule(args.seed, rank)
+    sched.degrade_rank(args.fault_rank, factor=1000, after_ops=0)
+    # committed ops per round: the allreduce plus evasion_tick's two
+    # lockstep broadcasts (broadcast_object = size + payload). Barriers
+    # and telemetry publishes are store-side, not committed collectives.
+    # A promoted spare divides its adopted op count by this to resume
+    # the round loop at the right index.
+    ops_per_round = 3
+    status = 0
+    pg = None
+    group = f"evade{args.seed}"
+    walls = []  # leader: (round, allreduce wall seconds)
+    promote_round = None
+    drained = False
+    # ticks left AFTER the tier-2 promotion: exactly one — the adoption
+    # tick the promoted spare joins (it inherits the engine's strike
+    # history from the broadcast, and with every counter freshly reset
+    # at the promote decision a single tick is provably action-free).
+    # Ticking past it would score pure scheduling noise on a healthy
+    # fleet — on a loaded box that can manufacture a non-replayable
+    # reshape. None = promotion not seen yet (keep ticking).
+    post_ticks = None
+    try:
+        if role == "member":
+            pg = dist.init_process_group(
+                rank=rank, world_size=n, store_handle=args.coordinator,
+                timeout_s=20.0, group_name=group, plane="shm",
+                fault_schedule=sched, self_heal=True)
+            pg.enable_evasion()
+            pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
+            # deterministic start line: hold until the warm spare's
+            # registration lands, so the promote tick is a pure
+            # function of the trace stream, not of process spawn order
+            if args.spares:
+                if pg.rank == 0:
+                    deadline = time.monotonic() + 30.0
+                    while pg.live_spares() < args.spares:
+                        if time.monotonic() >= deadline:
+                            raise TimeoutError(
+                                "warm spare never registered")
+                        time.sleep(0.05)
+                pg.barrier()
+            start = 0
+        else:  # warm spare
+            pg = dist.init_process_group(
+                world_size=n, store_handle=args.coordinator,
+                timeout_s=20.0, group_name=group, plane="shm",
+                fault_schedule=sched, self_heal=True, spare=True)
+            # arms locally (no barrier for a standby); the engine
+            # adopts the group's strike history at the first tick
+            pg.enable_evasion()
+            pg.wait_promotion(timeout_s=120.0)
+            start = pg.committed_ops // ops_per_round
+            post_ticks = 1  # join the survivors' one adoption tick
+        for rnd in range(start, args.rounds):
+            my_orig = pg.global_ranks[pg.rank]
+            local = _chaos_input(args.seed, my_orig, rnd, args.size)
+            t0 = time.monotonic()
+            got = pg.all_reduce(local, timeout_s=60.0)
+            walls.append((rnd, time.monotonic() - t0))
+            # original identities are preserved across reshapes AND the
+            # promotion (the spare adopts the victim's), so the oracle
+            # is the same full-membership sum every round
+            want = _chaos_input(args.seed, 0, rnd, args.size)
+            for r in range(1, n):
+                want = want + _chaos_input(args.seed, r, rnd, args.size)
+            if not np.array_equal(got, want):
+                print(f"BAD-RESULT: round {rnd} not bitwise-correct",
+                      flush=True)
+                status = 5
+                break
+            pg.publish_telemetry()
+            pg.barrier()
+            if post_ticks == 0:
+                continue  # promotion done, adoption tick spent
+            if post_ticks is not None:
+                post_ticks -= 1
+            decision = pg.evasion_tick(timeout_s=60.0)
+            if decision is not None and decision["action"] == "promote":
+                if int(decision["victim"]) == my_orig:
+                    # tier 2 already drained this rank (it is a standby
+                    # now): leave the round loop to the promoted spare
+                    drained = True
+                    break
+                promote_round = rnd
+                post_ticks = 1
+        if status == 0:
+            if drained:
+                print(f"DRAINED rank={args.fault_rank}", flush=True)
+            else:
+                print(f"OK rank={rank}/{total} rounds={args.rounds} "
+                      f"now-rank={pg.rank}/{pg.world_size}", flush=True)
+                print(f"EPOCH {pg.epoch}", flush=True)
+                print(f"MEMBERS {pg.global_ranks}", flush=True)
+            print(f"EVASTATE {json.dumps(pg.evasion_state())}", flush=True)
+            if rank == 0:
+                # phase walls: every pre-promote round ran against the
+                # degraded victim; every post-promote round runs on the
+                # promoted spare's fresh hardware
+                byt = args.size * 8
+                deg = [w for r, w in walls
+                       if promote_round is None or r <= promote_round]
+                rec = [w for r, w in walls
+                       if promote_round is not None and r > promote_round]
+                dbw = byt / (sum(deg) / len(deg)) / 1e6 if deg else 0.0
+                rbw = byt / (sum(rec) / len(rec)) / 1e6 if rec else 0.0
+                print(f"DEGRADED_ALGBW {dbw:.3f}", flush=True)
+                print(f"RECOVERED_ALGBW {rbw:.3f}", flush=True)
+                print(f"RECOVERY_RATIO "
+                      f"{(rbw / dbw if dbw > 0 else 0.0):.2f}", flush=True)
+            if not drained:
+                pg.stop_watchdog()
+                pg.destroy(graceful=True)
+    except (TimeoutError, OSError, RuntimeError) as e:
+        print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+        status = 4
+    finally:
+        print(f"FAULTS {sched.counters.to_json()}", flush=True)
+        print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        print(f"EVASIONLOG {_event_log(('evade-',))}", flush=True)
+        print(f"HEALLOG {_heal_log()}", flush=True)
+        from rocnrdma_tpu.obs import trace as _obs_trace
+        print(f"TRACELOG {_obs_trace.digest(_obs_trace.TRACE.snapshot())}",
+              flush=True)
+        _print_fleet(pg)
+        _print_ringfull()
+        from rocnrdma_tpu.obs import chrome
+        chrome.dump_if_env(rank)
+        if pg is not None:
+            try:
+                pg.destroy(graceful=False)
+            except (OSError, TimeoutError):
+                pass
+        if server is not None:
+            if status == 0:
+                server.wait_idle(timeout_s=5.0)
+            server.close()
+    return status
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="mp_worker")
     p.add_argument("--coordinator", required=True)
@@ -1113,6 +1295,8 @@ def main(argv=None) -> int:
         return _heal_chaos_main(args)  # host plane only: no jax
     if args.task == "trace-delay":
         return _trace_chaos_main(args)  # host plane only: no jax
+    if args.task == "evade-straggler":
+        return _evade_chaos_main(args)  # host plane only: no jax
     if args.task in CHAOS_TASKS:
         return _chaos_main(args)  # host plane only: no jax, no devices
 

@@ -273,6 +273,21 @@ class Transport:
         # telemetry: per-(verb, algo) dispatch counts and input bytes — the
         # RCCL debug-stats analogue, read via stats()/format_stats()
         self._stats: dict[tuple, dict] = {}
+        # re-rooting hook (ISSUE 16): an int or zero-arg callable naming
+        # the root grouped rooted verbs default to when the caller
+        # passes none — how the host plane's straggler evasion
+        # (ProcessGroup.preferred_root) steers rooted traffic off a
+        # degrading rank without touching call sites. None = rank 0,
+        # today's default.
+        self.root_hint = None
+
+    def _default_root(self) -> int:
+        """Resolve :attr:`root_hint` for a rooted verb issued with no
+        explicit root (0 when unset — the historical default)."""
+        hint = self.root_hint
+        if hint is None:
+            return 0
+        return int(hint() if callable(hint) else hint)
 
     # -- policy ------------------------------------------------------------
 

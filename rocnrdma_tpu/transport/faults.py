@@ -109,6 +109,14 @@ class FaultSchedule:
     chan_test_delay_p: dict | None = None   # lane -> completion-delay prob
     #   (overrides the global test_delay_p for that lane's receives;
     #   draws come from the lane's own rng stream)
+    # chronic degradation (ISSUE 16, armed via :meth:`degrade_rank`):
+    # EVERY irecv completion past ``after_ops`` data ops is held for a
+    # FIXED ``factor`` extra polls — slow-but-alive, the straggler the
+    # evasion engine exists for. Distinct from the one-shot probabilistic
+    # ``test_delay``: no rng draw (the hold count is a constant), so the
+    # injection log is a pure function of this rank's own recv sequence.
+    degrade_factor: int = 0         # extra polls per held completion
+    degrade_after_ops: int = 0      # data ops before degradation starts
 
     def __post_init__(self):
         self.counters = FaultCounters()
@@ -119,6 +127,7 @@ class FaultSchedule:
         self._join_attempts = 0
         self._test_draws = 0
         self._close_draws = 0
+        self._degrade_draws = 0
         self._rngs: dict[str, random.Random] = {}
         # per-lane streams (see the chan_* knobs): each lane's own data-op
         # and completion-draw counters — the coordinates its injections
@@ -277,6 +286,11 @@ class FaultSchedule:
             return self._test_delay_locked(lane)
 
     def _test_delay_locked(self, lane: str | None) -> int:
+        # the chronic hold stacks ON TOP of any one-shot delay draw: a
+        # degraded rank's flaky CQ is still flaky — and the one-shot
+        # streams advance exactly as they would undegraded, so arming
+        # degrade_rank never shifts the test_delay replay log
+        chronic = self._degrade_hold_locked()
         if lane is not None and lane in self.chan_test_delay_p:
             p = self.chan_test_delay_p[lane]
             rng = self._rng(f"chan:{lane}:test")
@@ -286,16 +300,43 @@ class FaultSchedule:
                 lo, hi = self.test_delay_polls
                 d = rng.randint(lo, hi)
                 self.record("chan-test-delayed", (lane, d), coord=n)
-                return d
-            return 0
+                return chronic + d
+            return chronic
         rng = self._rng("test")
         self._test_draws += 1
         if self.test_delay_p and rng.random() < self.test_delay_p:
             lo, hi = self.test_delay_polls
             d = rng.randint(lo, hi)
             self.record("test-delayed", d, coord=self._test_draws)
-            return d
-        return 0
+            return chronic + d
+        return chronic
+
+    def degrade_rank(self, rank: int, factor: int,
+                     after_ops: int = 0) -> bool:
+        """Arm chronic slowness on ``rank``: every irecv completion past
+        ``after_ops`` data ops is held ``factor`` extra polls (the slow
+        CQ that never recovers — a degrading host, not a dead one). The
+        chaos harness calls this on EVERY rank's schedule with the same
+        arguments; only the named rank's arms (returns True). Holds are
+        logged per completion at the degrade stream's own draw counter,
+        so ``fingerprint()`` stays replay-equal per seed."""
+        with self._lock:
+            if rank != self.rank:
+                return False
+            self.degrade_factor = int(factor)
+            self.degrade_after_ops = int(after_ops)
+            return True
+
+    def _degrade_hold_locked(self) -> int:
+        """The chronic hold in force for one irecv completion (0 when
+        disarmed) — deterministic, no rng: the fixed factor, logged at
+        this stream's own coordinate."""
+        if not self.degrade_factor or self.ops <= self.degrade_after_ops:
+            return 0
+        self._degrade_draws += 1
+        self.record("degraded", self.degrade_factor,
+                    coord=self._degrade_draws)
+        return self.degrade_factor
 
     def close_drop(self) -> bool:
         self._close_draws += 1

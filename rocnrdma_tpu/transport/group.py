@@ -96,18 +96,29 @@ class Group:
     def alltoall(self, x, algo: str = "auto") -> GroupHandle:
         return self._queue("alltoall", x, algo)
 
-    def broadcast(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
+    # Rooted verbs: ``root=None`` defers to the transport's re-rooting
+    # hook (``Transport.root_hint`` — ISSUE 16's evasion steer; resolves
+    # to 0 when unset, the historical default), an explicit int pins it.
+
+    def broadcast(self, x, algo: str = "auto",
+                  root: int | None = None) -> GroupHandle:
+        root = self._t._default_root() if root is None else root
         return self._queue("broadcast", x, algo, root=root)
 
-    def reduce(self, x, algo: str = "auto", root: int = 0, op: str = "sum",
-               acc=None, premul=None) -> GroupHandle:
+    def reduce(self, x, algo: str = "auto", root: int | None = None,
+               op: str = "sum", acc=None, premul=None) -> GroupHandle:
+        root = self._t._default_root() if root is None else root
         return self._queue("reduce", x, algo, root=root, op=op, acc=acc,
                            premul=premul)
 
-    def gather(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
+    def gather(self, x, algo: str = "auto",
+               root: int | None = None) -> GroupHandle:
+        root = self._t._default_root() if root is None else root
         return self._queue("gather", x, algo, root=root)
 
-    def scatter(self, x, algo: str = "auto", root: int = 0) -> GroupHandle:
+    def scatter(self, x, algo: str = "auto",
+                root: int | None = None) -> GroupHandle:
+        root = self._t._default_root() if root is None else root
         return self._queue("scatter", x, algo, root=root)
 
     def sendrecv(self, x, algo: str = "auto", shift: int = 1) -> GroupHandle:

@@ -158,6 +158,39 @@ class LaneRegistry:
             self.multi = True
             return lane
 
+    def set_credit(self, name: str, credit_bytes: int | None) -> Lane:
+        """Swap lane ``name``'s pacing credit in place (the evasion
+        engine's PR-9 shrink hook: a reshape caps the straggler's
+        credits so its frames stop monopolising the gate). The gate
+        re-reads the registry per admit, so the new credit takes effect
+        on the next post with no re-open; ``multi`` flips on when a
+        credit lands on the default lane, else the fast path would
+        bypass the gate the cap is meant to engage. A later identical
+        ``open`` still compares against the CURRENT knobs — a capped
+        lane's original opener re-opening is a conflict, named."""
+        with self._lock:
+            cur = self._by_name.get(name)
+            if cur is None:
+                raise KeyError(f"lane {name!r} not open")
+            lane = dataclasses.replace(cur, credit_bytes=credit_bytes)
+            self._by_name[name] = lane
+            self._by_id[lane.id] = lane
+            if credit_bytes is not None:
+                self.multi = True
+            return lane
+
+    def cap_credits(self, credit_bytes: int) -> list[str]:
+        """Cap EVERY open lane's credit to at most ``credit_bytes``
+        (unpaced lanes get the cap outright); returns the names whose
+        credit changed, name-sorted — the deterministic record the
+        evasion log carries."""
+        changed = []
+        for lane in self.snapshot():
+            if lane.credit_bytes is None or lane.credit_bytes > credit_bytes:
+                self.set_credit(lane.name, int(credit_bytes))
+                changed.append(lane.name)
+        return changed
+
     def get(self, channel: int) -> Lane | None:
         with self._lock:
             return self._by_id.get(channel)

@@ -562,6 +562,98 @@ def test_obs_elastic_rule_flags_stale_surface_list():
 
 
 # ---------------------------------------------------------------------------
+# pass #4c': evasion-surface coverage (ISSUE 16) — evasion_tick/drain/
+# _evade_reshape must leave an evade-* flight event AND guarantee an
+# abort event (a policy-driven reshape/retire with no timeline entry is
+# untriageable)
+# ---------------------------------------------------------------------------
+
+
+def test_obs_flags_eventless_evasion_verb():
+    # drain records AND re-raises (the elastic shape passes) but its
+    # event kind is not evade-* — the EVASIONLOG replay check and any
+    # postmortem grep on the prefix would both miss it
+    src = textwrap.dedent("""
+        class ProcessGroup:
+            def evasion_tick(self, timeout_s=None):
+                try:
+                    return self._tick_protocol()
+                except BaseException as e:
+                    _FLIGHT.record("evade-abort", error=type(e).__name__)
+                    raise
+
+            def drain(self, timeout_s=None):
+                try:
+                    return self._park_as_spare()
+                except BaseException as e:
+                    _FLIGHT.record("drain-abort", error=type(e).__name__)
+                    raise
+
+            def _evade_reshape(self, victim, timeout_s):
+                _FLIGHT.record("evade-reshape", victim=victim)
+                try:
+                    return self._rewire_tail(victim)
+                except BaseException as e:
+                    _FLIGHT.record("evade-abort", error=type(e).__name__)
+                    raise
+    """)
+    problems = obs.check_evasion_source(src, "fix.py")
+    assert len(problems) == 1, problems
+    assert "ProcessGroup.drain leaves no evade-* flight event" \
+        in problems[0], problems
+
+
+def test_obs_flags_uninstrumented_evasion_verb():
+    # evasion_tick leaves an entry event but has NO record-and-reraise
+    # handler: a tick that dies mid-reshape would leave the ring
+    # half-rotated with no abort on the timeline
+    src = textwrap.dedent("""
+        class ProcessGroup:
+            def evasion_tick(self, timeout_s=None):
+                _FLIGHT.record("evade-tick", tick=self._tick)
+                return self._tick_protocol()
+
+            def drain(self, timeout_s=None):
+                try:
+                    _FLIGHT.record("evade-drain")
+                    return self._park_as_spare()
+                except BaseException as e:
+                    _FLIGHT.record("evade-abort", error=type(e).__name__)
+                    raise
+
+            def _evade_reshape(self, victim, timeout_s):
+                try:
+                    _FLIGHT.record("evade-reshape", victim=victim)
+                    return self._rewire_tail(victim)
+                except BaseException as e:
+                    _FLIGHT.record("evade-abort", error=type(e).__name__)
+                    raise
+    """)
+    problems = obs.check_evasion_source(src, "fix.py")
+    assert len(problems) == 1, problems
+    assert "ProcessGroup.evasion_tick guarantees no abort flight event" \
+        in problems[0], problems
+
+
+def test_obs_evasion_rule_flags_stale_surface_list():
+    src = textwrap.dedent("""
+        class ProcessGroup:
+            def evasion_tick(self, timeout_s=None):
+                try:
+                    _FLIGHT.record("evade-tick")
+                    return self._tick_protocol()
+                except BaseException as e:
+                    _FLIGHT.record("evade-abort", error=type(e).__name__)
+                    raise
+    """)
+    problems = obs.check_evasion_source(src, "fix.py")
+    assert any("ProcessGroup.drain not found" in p for p in problems), \
+        problems
+    assert any("ProcessGroup._evade_reshape not found" in p
+               for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
 # pass #4d: telemetry-publish discipline (PR 8) — every store write in
 # the fleet module is non-blocking-bounded (explicit timeout, no retry
 # loop) and flight-evented on abort
@@ -700,6 +792,31 @@ def test_deadlines_flags_elastic_verb_without_timeout(tmp_path):
     assert any("grow must accept timeout_s" in p for p in problems), \
         problems
     assert not any("wait_promotion" in p for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
+# pass #0 extension (ISSUE 16): the predictive-evasion surface is on
+# the named blocking list — enable_evasion/evasion_tick/drain must
+# accept timeout_s
+# ---------------------------------------------------------------------------
+
+
+def test_deadlines_flags_evasion_verb_without_timeout(tmp_path):
+    assert {"enable_evasion", "evasion_tick", "drain"} \
+        <= deadlines.PG_BLOCKING
+    bad = tmp_path / "distributed.py"
+    bad.write_text(textwrap.dedent("""
+        class ProcessGroup:
+            def evasion_tick(self, timeout_s=None):
+                return self._tick_protocol()
+
+            def drain(self):
+                return self._park_as_spare()
+    """))
+    problems = deadlines.check_file(str(bad))
+    assert any("drain must accept timeout_s" in p for p in problems), \
+        problems
+    assert not any("evasion_tick" in p for p in problems), problems
 
 
 # ---------------------------------------------------------------------------

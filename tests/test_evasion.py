@@ -1,0 +1,361 @@
+"""Predictive straggler evasion (ISSUE 16): the policy engine's
+replay-pure scoring (strikes, tie-breaks, settle windows, the
+two-tier escalation order), the windowed scoreboard's edge cases, the
+FaultNet ``degrade_rank`` chronic-slowness injection, the lane-credit
+shrink hook, rooted-verb re-rooting — and THE acceptance run: a
+4-rank + 1-warm-spare shm fleet where one rank chronically degrades,
+tier 1 rotates it off the critical path, tier 2 drains it and
+promotes the spare into its ORIGINAL identity before any watchdog
+death confirmation, with bitwise-correct results every round and two
+same-seed runs digest-equal on every replay line."""
+
+import json
+import re
+
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.obs import trace
+from rocnrdma_tpu.transport.evasion import EvasionEngine, EvasionPolicy
+from rocnrdma_tpu.transport.faults import FaultSchedule
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable")
+
+
+def _board(share, ops=8):
+    """A scoreboard as the engine consumes it: share keyed by CURRENT
+    rank index (strings — JSON round-trips them that way)."""
+    return {"ops": ops, "share": {str(k): v for k, v in share.items()}}
+
+
+# ---------------------------------------------------------------------------
+# the engine: deterministic scoring
+# ---------------------------------------------------------------------------
+
+
+def test_engine_two_tier_escalation_order():
+    """Reshape after ``reshape_strikes`` dominant windows; both strike
+    counters reset there; the settle window sits out one tick; promote
+    lands only after ``promote_strikes`` fresh hard windows."""
+    e = EvasionEngine()
+    ranks = [0, 1, 2, 3]
+    hot = _board({2: 0.8, 0: 0.1, 1: 0.05, 3: 0.05})
+    assert e.observe(hot, ranks, 1) is None                    # strike 1
+    d = e.observe(hot, ranks, 1)                               # strike 2
+    assert d == {"tick": 2, "action": "reshape", "victim": 2}
+    assert e.observe(hot, ranks, 1) is None                    # settle
+    assert e.observe(hot, ranks, 1) is None                    # hard 1
+    d = e.observe(hot, ranks, 1)                               # hard 2
+    assert d == {"tick": 5, "action": "promote", "victim": 2}
+    assert e.promoted == {2} and e.reshaped == set()
+
+
+def test_engine_tie_breaks_to_lowest_rank():
+    e = EvasionEngine()
+    tied = _board({1: 0.5, 3: 0.5})
+    assert e.observe(tied, [0, 1, 2, 3], 0) is None
+    d = e.observe(tied, [0, 1, 2, 3], 0)
+    assert d["action"] == "reshape" and d["victim"] == 1
+    # one action per tick: rank 3's strikes held, it reshapes later
+    assert e.observe(tied, [0, 1, 2, 3], 0) is None  # settle
+    d = e.observe(tied, [0, 1, 2, 3], 0)
+    assert d["action"] == "reshape" and d["victim"] == 3
+
+
+def test_engine_empty_window_holds_strikes():
+    """No sampled ops is not exoneration: strikes neither advance nor
+    reset across an empty window."""
+    e = EvasionEngine()
+    hot = _board({1: 0.9})
+    assert e.observe(hot, [0, 1], 0) is None                   # strike 1
+    assert e.observe(_board({}, ops=0), [0, 1], 0) is None     # held
+    d = e.observe(hot, [0, 1], 0)                              # strike 2
+    assert d == {"tick": 3, "action": "reshape", "victim": 1}
+
+
+def test_engine_promote_needs_spare_and_prior_reshape():
+    e = EvasionEngine(EvasionPolicy(settle_ticks=0))
+    ranks = [0, 1]
+    hot = _board({1: 0.95})
+    e.observe(hot, ranks, 0)
+    assert e.observe(hot, ranks, 0)["action"] == "reshape"     # tier 1 first
+    # hard-dominant but NO live spare: evasion never shrinks the world
+    for _ in range(4):
+        assert e.observe(hot, ranks, 0) is None
+    assert e.observe(hot, ranks, 1)["action"] == "promote"     # spare landed
+
+
+def test_engine_maps_current_shares_to_original_ranks():
+    """Post-reshape the victim sits at the ring tail: share keys are
+    CURRENT indices, strikes and decisions stay keyed by ORIGINAL id."""
+    e = EvasionEngine(EvasionPolicy(reshape_strikes=1, settle_ticks=0))
+    d = e.observe(_board({3: 0.9}), [0, 1, 3, 2], 1)
+    assert d == {"tick": 1, "action": "reshape", "victim": 2}
+
+
+def test_engine_state_adopt_round_trip_and_digest():
+    a, b = EvasionEngine(), EvasionEngine()
+    hot = _board({1: 0.8})
+    a.observe(hot, [0, 1], 1)
+    a.observe(hot, [0, 1], 1)                                  # reshape
+    b.adopt(a.state())
+    assert b.state() == a.state()
+    assert b.digest() == a.digest()
+    # the adopted twin continues identically (settle included)
+    assert a.observe(hot, [0, 1], 1) == b.observe(hot, [0, 1], 1)
+    assert a.digest() == b.digest()
+    assert a.digest() != EvasionEngine().digest()  # log-bearing
+
+
+# ---------------------------------------------------------------------------
+# the windowed scoreboard: edge cases the engine leans on
+# ---------------------------------------------------------------------------
+
+
+def _tree(rank, sec):
+    return {"critical_path": [{"rank": rank}],
+            "cp_share": {str(rank): sec}}
+
+
+def test_scoreboard_window_keeps_the_newest_ops():
+    assembled = [_tree(0, 1.0)] * 5 + [_tree(1, 1.0)] * 3
+    sb = trace.scoreboard(assembled, window=3)
+    assert sb["ops"] == 3
+    assert sb["straggler"] == 1
+    assert sb["share"] == {"1": 1.0}
+
+
+def test_scoreboard_zero_ops_window():
+    sb = trace.scoreboard([], window=8)
+    assert sb["ops"] == 0 and sb["share"] == {}
+    assert sb["straggler"] is None
+
+
+def test_scoreboard_tie_breaks_to_lowest_rank():
+    sb = trace.scoreboard([_tree(2, 1.0), _tree(1, 1.0)])
+    assert sb["straggler"] == 1
+    assert sb["share"]["1"] == sb["share"]["2"] == 0.5
+
+
+def test_scoreboard_sample_zero_scores_nothing(monkeypatch):
+    """``ROCNRDMA_TRACE_SAMPLE=0`` disables span recording entirely:
+    the assembled window is empty and the engine's empty-window rule
+    (strikes hold) is what governs — nothing is invented."""
+    monkeypatch.setenv("ROCNRDMA_TRACE_SAMPLE", "0")
+    trace.TRACE.reset()
+    with trace.op_span(0, 0, 0, "ring_allreduce_over_net", 0):
+        trace.record("stream-start", hops=1, frame=64, depth=1,
+                     up=1, down=1)
+    sb = trace.scoreboard(trace.assemble(trace.TRACE.snapshot()), window=8)
+    assert sb["ops"] == 0 and sb["straggler"] is None
+    e = EvasionEngine()
+    e.observe(_board({1: 0.9}), [0, 1], 0)
+    assert e.observe(sb, [0, 1], 0) is None
+    assert e.observe(_board({1: 0.9}), [0, 1], 0)["action"] == "reshape"
+
+
+# ---------------------------------------------------------------------------
+# FaultNet degrade_rank: chronic slowness, replay-equal
+# ---------------------------------------------------------------------------
+
+
+def test_degrade_rank_arms_only_the_named_rank():
+    s0 = FaultSchedule(3, 0)
+    s2 = FaultSchedule(3, 2)
+    assert s0.degrade_rank(2, 700) is False
+    assert s2.degrade_rank(2, 700) is True
+    assert s0.degrade_factor == 0 and s2.degrade_factor == 700
+
+
+def test_degrade_stacks_without_shifting_oneshot_streams():
+    """The chronic hold adds to every completion past ``after_ops``
+    data ops, and the one-shot ``test_delay`` rng streams advance
+    exactly as they would undegraded — arming degradation never shifts
+    the pre-existing replay log."""
+    plain = FaultSchedule(9, 1, test_delay_p=1.0, test_delay_polls=(2, 5))
+    slow = FaultSchedule(9, 1, test_delay_p=1.0, test_delay_polls=(2, 5))
+    assert slow.degrade_rank(1, 400, after_ops=2)
+    for s in (plain, slow):
+        s.op_fault("irecv")                      # op 1: before the knee
+    assert slow.test_delay() == plain.test_delay()
+    for s in (plain, slow):
+        s.op_fault("irecv"), s.op_fault("irecv")  # ops 2, 3: past it
+    for _ in range(3):
+        assert slow.test_delay() == plain.test_delay() + 400
+    # held completions are logged at the degrade stream's own draw
+    # counter and counted — fingerprints replay-equal per seed
+    again = FaultSchedule(9, 1, test_delay_p=1.0, test_delay_polls=(2, 5))
+    again.degrade_rank(1, 400, after_ops=2)
+    for s in (again,):
+        s.op_fault("irecv"); s.test_delay()
+        s.op_fault("irecv"); s.op_fault("irecv")
+        for _ in range(3):
+            s.test_delay()
+    assert again.fingerprint() == slow.fingerprint()
+    assert again.fingerprint() != plain.fingerprint()
+    assert json.loads(slow.counters.to_json())["degraded"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the lane-credit shrink + rooted-verb steer (tier 1's side effects)
+# ---------------------------------------------------------------------------
+
+
+def test_lane_set_credit_and_cap():
+    from rocnrdma_tpu.transport.lanes import LaneRegistry
+    reg = LaneRegistry()
+    reg.open("bulk", priority=0, credit_bytes=1 << 20)
+    reg.open("latency", priority=8)                 # unpaced
+    changed = reg.cap_credits(1 << 16)
+    # the built-in default lane is unpaced, so the cap engages it too
+    assert changed == ["bulk", "default", "latency"]
+    assert reg.by_name("bulk").credit_bytes == 1 << 16
+    assert reg.by_name("latency").credit_bytes == 1 << 16
+    assert reg.cap_credits(1 << 16) == []           # idempotent
+    reg.set_credit("bulk", None)                    # uncap is explicit
+    assert reg.by_name("bulk").credit_bytes is None
+    with pytest.raises(KeyError):
+        reg.set_credit("ghost", 1)
+
+
+def test_preferred_root_steers_off_reshaped_ranks():
+    from rocnrdma_tpu.distributed import ProcessGroup
+    from rocnrdma_tpu.transport.api import Transport
+
+    class _PG:
+        pass
+
+    pg = _PG()
+    pg._evasion, pg._ranks = None, [0, 1, 2]
+    assert ProcessGroup.preferred_root(pg) == 0     # unarmed: no change
+    pg._evasion = EvasionEngine()
+    pg._ranks = [1, 3, 0, 2]                        # post-reshape order
+    pg._evasion.reshaped = {0, 1}
+    assert ProcessGroup.preferred_root(pg) == 3     # original 2's slot
+
+    class _T:
+        pass
+
+    t = _T()
+    t.root_hint = None
+    assert Transport._default_root(t) == 0
+    t.root_hint = 2
+    assert Transport._default_root(t) == 2
+    t.root_hint = lambda: 1                         # pg.preferred_root hook
+    assert Transport._default_root(t) == 1
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _line(result, key):
+    m = re.search(rf"^{key} (.+)$", result.stdout, re.M)
+    assert m, f"rank {result.process_id} printed no {key} line:\n" \
+              f"{result.stdout}\n{result.stderr}"
+    return m.group(1)
+
+
+@pytest.mark.chaos
+@needs_native
+def test_straggler_evaded_before_watchdog_fires(monkeypatch):
+    """4 members + 1 warm spare, rank 2 chronically degraded (slow,
+    never dead — its watchdog heartbeats keep flowing the whole run):
+    tier 1 must rotate it to the ring tail, tier 2 must drain it and
+    promote the spare into ORIGINAL rank 2 before any death
+    confirmation, every committed round stays bitwise-correct with
+    zero lost ops, recovered algbw clears 1.5x degraded, and two
+    same-seed runs replay digest-equal on every line."""
+    from rocnrdma_tpu.runtime.multiprocess import run_workers
+
+    monkeypatch.setenv("ROCNRDMA_TRACE_SAMPLE", "1")
+    n, seed, rounds, victim = 5, 11, 8, 2
+    runs = [run_workers(n, "evade-straggler", timeout_s=150.0,
+                        fault_rank=victim, seed=seed, rounds=rounds,
+                        size=4096, spares=1) for _ in range(2)]
+    for res in runs:
+        for r in res:
+            assert r.returncode == 0, \
+                f"rank {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert "BAD-RESULT" not in r.stdout      # zero lost ops
+            assert "CLEAN-ABORT" not in r.stdout
+        # the victim was drained ALIVE: it exits 0 through the tier-2
+        # path, not through a watchdog-confirmed death or named abort
+        assert f"DRAINED rank={victim}" in res[victim].stdout
+        assert json.loads(_line(res[victim], "FAULTS"))["degraded"] > 0
+        # the spare finished the victim's rounds under its identity
+        assert "OK rank=4/5" in res[n - 1].stdout
+        state = json.loads(_line(res[0], "EVASTATE"))
+        assert state["promoted"] == [victim]
+        assert state["actions"] == 2                 # reshape, then promote
+        # tier 1 rotated the victim's ORIGINAL id to the ring tail and
+        # the promotion preserved the membership (identity splice, no
+        # shrink); epoch 2 = one reshape fence + one promote heal
+        assert _line(res[0], "MEMBERS") == "[0, 1, 3, 2]"
+        assert _line(res[0], "EPOCH") == "2"
+        assert float(_line(res[0], "RECOVERY_RATIO")) >= 1.5
+        assert float(_line(res[0], "RECOVERED_ALGBW")) > 0.0
+    # replay equality: every structural line is a pure function of the
+    # seed, identical per rank across the two runs
+    for key in ("FAULTLOG", "EVASIONLOG", "HEALLOG", "FLEET"):
+        assert [_line(r, key) for r in runs[0]] == \
+            [_line(r, key) for r in runs[1]], key
+
+
+# ---------------------------------------------------------------------------
+# the sentinel ratchet: the committed results/evasion_r01.json floors
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_evasion_ratchet():
+    import copy
+    import os
+
+    from tools import sentinel
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "results", "evasion_r01.json")) as fp:
+        doc = json.load(fp)
+    # the committed record self-diffs clean (the all-zero fixed point
+    # — also what check_evasion() with no current doc runs in tier-1)
+    assert sentinel.check_evasion(current=doc) == []
+    assert sentinel.check_evasion() == []
+    # the oracle bar is absolute: one lost op is a finding
+    bad = copy.deepcopy(doc)
+    bad["lost_ops"] = 1
+    findings = sentinel.check_evasion(current=bad)
+    assert findings and any("lost_ops" in f for f in findings)
+    assert "data corruption" in sentinel.format_findings(findings)
+    # the acceptance multiple is absolute: below 1.5x flags even if
+    # the raw MB/s still clears the row-wise allowance
+    bad = copy.deepcopy(doc)
+    bad["recovery_ratio"] = 1.2
+    findings = sentinel.check_evasion(current=bad)
+    assert any("recovery_ratio" in f for f in findings)
+    # the recovered algbw ratchets row-wise (the sentinel's ratio)
+    bad = copy.deepcopy(doc)
+    bad["recovered_algbw_MBps"] = 0.5 * doc["recovered_algbw_MBps"]
+    findings = sentinel.check_evasion(current=bad)
+    assert any("recovered_MBps" in f for f in findings)
+    assert "MB/s" in sentinel.format_findings(findings)
+
+
+def test_committed_evasion_record_schema():
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "results", "evasion_r01.json")) as fp:
+        doc = json.load(fp)
+    assert doc["task"] == "evade-straggler"
+    assert doc["lost_ops"] == 0
+    assert doc["recovery_ratio"] >= doc["floors"]["ratio_min"] >= 1.5
+    # one reshape fence + one promote heal, victim rotated to the tail
+    # then identity-spliced by the spare (no shrink)
+    assert doc["epoch"] == 2
+    assert doc["members"] == [0, 1, 3, 2]
+    assert doc["evastate"]["promoted"] == [doc["params"]["fault_rank"]]
+    assert doc["replay"] == {"runs": 2, "digests_equal": True}
+    # every launched process left its three replay digests
+    assert sorted(doc["digests"]) == [str(i) for i in
+                                      range(doc["params"]["n"])]

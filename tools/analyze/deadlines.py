@@ -86,6 +86,11 @@ PG_BLOCKING = {
     # epoch's sub-rings — a group-wide store rendezvous plus per-leg
     # ring wiring, every wait a caller must be able to bound
     "hierarchy",
+    # the predictive-evasion surface (ISSUE 16): enable_evasion runs a
+    # member barrier, evasion_tick reads the trace window and runs a
+    # broadcast commit plus a possible reshape/heal, drain re-registers
+    # in the standby store — every wait a caller must be able to bound
+    "enable_evasion", "evasion_tick", "drain",
 }
 
 # RULE 3 (continued) — the hierarchical schedule surface (ISSUE 14):

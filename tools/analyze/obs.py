@@ -118,6 +118,16 @@ ELASTIC_FILE = "rocnrdma_tpu/distributed.py"
 ELASTIC_CLASS = "ProcessGroup"
 ELASTIC_SURFACE = ("grow", "heal", "wait_promotion")
 
+# the predictive-evasion surface (ISSUE 16): these ProcessGroup verbs
+# reshape membership or retire a live rank on a POLICY decision — each
+# must both leave an ``evade-*`` flight event (the EVASIONLOG replay
+# check and any postmortem start from it) and guarantee an abort event
+# via a record-and-reraise handler, the elastic rule's shape
+EVASION_FILE = ELASTIC_FILE
+EVASION_CLASS = "ProcessGroup"
+EVASION_SURFACE = ("evasion_tick", "drain", "_evade_reshape")
+EVASION_EVENT_PREFIX = "evade-"
+
 # the telemetry-publish surface: every store write in the fleet module
 # must be non-blocking-bounded (explicit timeout_s, no enclosing retry
 # loop) and flight-evented on abort (see the module docstring's fourth
@@ -345,6 +355,65 @@ def elastic_problems(tree: ast.Module, where: str,
                 f"that records — _FLIGHT.record/_stall/postmortem — and "
                 f"re-raises, or ALLOW it with a reason); a silent "
                 f"grow/promote abort is untriageable after the fact")
+    return problems
+
+
+def evasion_problems(tree: ast.Module, where: str,
+                     used: set | None = None) -> list[str]:
+    """The evasion-surface invariant (ISSUE 16): every verb in
+    ``EVASION_SURFACE`` must (a) leave an ``evade-*`` flight event —
+    these verbs rotate the ring or retire a LIVE rank on a policy
+    decision, and a membership change with no timeline entry is
+    untriageable — and (b) guarantee an abort event the elastic way
+    (an ``except`` handler that both records and re-raises)."""
+    problems = []
+    classes = {n.name: n for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef)}
+    cls = classes.get(EVASION_CLASS)
+    if cls is None:
+        return [f"{where}: evasion class {EVASION_CLASS} not found"]
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name in EVASION_SURFACE:
+        key = f"{EVASION_CLASS}.{name}"
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        fn = methods.get(name)
+        if fn is None:
+            problems.append(
+                f"{where}: evasion verb {key} not found — the surface "
+                f"list in tools/analyze/obs.py is stale")
+            continue
+        evented = any(
+            isinstance(node, ast.Call)
+            and base.call_name(node) in ABORT_MARKERS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith(EVASION_EVENT_PREFIX)
+            for node in ast.walk(fn))
+        if not evented:
+            problems.append(
+                f"{where}:{fn.lineno}: evasion verb {key} leaves no "
+                f"{EVASION_EVENT_PREFIX}* flight event — a policy-driven "
+                f"reshape/retire with no timeline entry is untriageable "
+                f"(record one, or ALLOW it with a reason)")
+        instrumented = any(
+            isinstance(node, ast.ExceptHandler)
+            and any(isinstance(s, ast.Raise) for s in ast.walk(node))
+            and ({base.call_name(sub) for sub in ast.walk(node)
+                  if isinstance(sub, ast.Call)} & ABORT_MARKERS)
+            for node in ast.walk(fn))
+        if not instrumented:
+            problems.append(
+                f"{where}:{fn.lineno}: evasion verb {key} guarantees no "
+                f"abort flight event (wrap the protocol in an except "
+                f"that records — _FLIGHT.record/_stall/postmortem — and "
+                f"re-raises, or ALLOW it with a reason); a silent "
+                f"reshape/drain abort leaves the ring half-rotated with "
+                f"no story")
     return problems
 
 
@@ -657,6 +726,11 @@ def check_elastic_source(src: str, path: str = "<fixture>") -> list[str]:
     return elastic_problems(ast.parse(src, filename=path), path)
 
 
+def check_evasion_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the evasion-surface invariant alone."""
+    return evasion_problems(ast.parse(src, filename=path), path)
+
+
 def check_hier_source(src: str, path: str = "<fixture>") -> list[str]:
     """Fixture entry point for the hierarchical-surface invariant alone
     (pass a non-HIER_FILE path so the found-nothing staleness guard
@@ -696,6 +770,8 @@ def run() -> list[str]:
         problems += abort_problems(base.parse_file(target), target, used)
     problems += elastic_problems(base.parse_file(ELASTIC_FILE),
                                  ELASTIC_FILE, used)
+    problems += evasion_problems(base.parse_file(EVASION_FILE),
+                                 EVASION_FILE, used)
     problems += hier_problems(base.parse_file(HIER_FILE), HIER_FILE, used)
     problems += telemetry_problems(base.parse_file(TELEMETRY_FILE),
                                    TELEMETRY_FILE, used)

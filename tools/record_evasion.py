@@ -1,0 +1,150 @@
+"""Record the predictive-evasion chaos outcome as a results/ artifact.
+
+Runs the ``evade-straggler`` acceptance scenario (DESIGN.md §5m) TWICE
+with the same seed — four members plus a warm spare, rank 2 chronically
+degraded through the fault plane — and persists what the robustness
+trajectory is judged on: the degraded vs recovered algbw (and their
+ratio — the tier-1 gate's >= 1.5x bar), the zero-lost-ops verdict of
+the bitwise oracle, the final epoch/member order the reshape + promote
+leave behind, and the per-rank replay digests
+(FAULTLOG/EVASIONLOG/HEALLOG), refusing to record at all unless the
+two runs are digest-equal on every rank. ``tools.sentinel
+--evasion`` ratchets later PRs against the committed floors.
+
+    python -m tools.record_evasion [--out results/evasion_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rocnrdma_tpu.runtime.multiprocess import run_workers  # noqa: E402
+
+OUT = "results/evasion_r01.json"
+
+# the replay-equality acceptance seeding (tests/test_evasion.py)
+PARAMS = dict(n=5, seed=11, rounds=8, size=4096, spares=1, fault_rank=2,
+              degrade_factor=1000)
+
+# per-rank digest families that must replay bitwise across same-seed
+# runs (EVASTATE's digest field rides along via the EVASTATE line)
+DIGESTS = ("FAULTLOG", "EVASIONLOG", "HEALLOG")
+
+
+def _line(result, key):
+    m = re.search(rf"^{key} (.+)$", result.stdout, re.M)
+    if not m:
+        raise SystemExit(
+            f"rank {result.process_id} (rc={result.returncode}) printed "
+            f"no {key} line:\n{result.stdout}\n{result.stderr}")
+    return m.group(1)
+
+
+def run_once() -> dict:
+    t0 = time.monotonic()
+    results = run_workers(PARAMS["n"], "evade-straggler", timeout_s=240.0,
+                          seed=PARAMS["seed"], rounds=PARAMS["rounds"],
+                          size=PARAMS["size"], spares=PARAMS["spares"],
+                          fault_rank=PARAMS["fault_rank"])
+    wall_s = time.monotonic() - t0
+    out = {"wall_s": round(wall_s, 2), "lost_ops": 0, "ranks": {}}
+    epochs, members, evastates = set(), set(), set()
+    victim_state = None
+    for r in results:
+        if r.returncode != 0:
+            raise SystemExit(
+                f"rank {r.process_id} exited {r.returncode} — refusing "
+                f"to record a failed run:\n{r.stdout}\n{r.stderr}")
+        out["lost_ops"] += r.stdout.count("BAD-RESULT")
+        if r.process_id == PARAMS["fault_rank"]:
+            if f"DRAINED rank={PARAMS['fault_rank']}" not in r.stdout:
+                raise SystemExit(
+                    f"victim {r.process_id} never drained:\n{r.stdout}")
+            # the drained victim's engine stops at the promote decision
+            # tick (survivors run one more adoption tick), so only its
+            # STRUCTURAL digest must agree, not the full state
+            victim_state = json.loads(_line(r, "EVASTATE"))
+        else:
+            epochs.add(int(_line(r, "EPOCH")))
+            members.add(_line(r, "MEMBERS"))
+            evastates.add(_line(r, "EVASTATE"))
+        out["ranks"][str(r.process_id)] = {
+            k.lower(): _line(r, k) for k in DIGESTS}
+        if r.process_id == 0:
+            out["degraded_algbw_MBps"] = float(_line(r, "DEGRADED_ALGBW"))
+            out["recovered_algbw_MBps"] = float(_line(r, "RECOVERED_ALGBW"))
+            out["recovery_ratio"] = float(_line(r, "RECOVERY_RATIO"))
+    if len(epochs) != 1 or len(members) != 1 or len(evastates) != 1:
+        raise SystemExit(f"ranks disagree (epochs={epochs}, "
+                         f"members={members}, evastates={evastates})")
+    out["epoch"] = epochs.pop()
+    out["members"] = json.loads(members.pop())
+    out["evastate"] = json.loads(evastates.pop())
+    if victim_state is not None \
+            and victim_state["digest"] != out["evastate"]["digest"]:
+        raise SystemExit(
+            f"victim decision-log digest diverged: {victim_state} vs "
+            f"{out['evastate']}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args(argv)
+    os.environ["ROCNRDMA_TRACE_SAMPLE"] = "1"  # the engine's eyes
+    print("running evade-straggler (run 1 of 2) ...", flush=True)
+    first = run_once()
+    print("running evade-straggler (run 2 of 2, replay check) ...",
+          flush=True)
+    second = run_once()
+    for rk, digs in first["ranks"].items():
+        if second["ranks"].get(rk) != digs:
+            raise SystemExit(
+                f"replay divergence on rank {rk}: {digs} vs "
+                f"{second['ranks'].get(rk)} — refusing to record a "
+                f"non-deterministic run")
+    if first["lost_ops"] or second["lost_ops"]:
+        raise SystemExit("bitwise oracle lost ops — refusing to record")
+    record = {
+        "record": "evasion_r01",
+        "task": "evade-straggler",
+        "params": PARAMS,
+        "wall_s": first["wall_s"],
+        "epoch": first["epoch"],
+        "members": first["members"],
+        "evastate": first["evastate"],
+        "lost_ops": 0,
+        "degraded_algbw_MBps": first["degraded_algbw_MBps"],
+        "recovered_algbw_MBps": first["recovered_algbw_MBps"],
+        "recovery_ratio": first["recovery_ratio"],
+        "digests": first["ranks"],
+        "replay": {"runs": 2, "digests_equal": True},
+        # the sentinel's floors: the oracle and the acceptance multiple
+        # are absolute bars; the recovered algbw ratchets row-wise (a
+        # current run must stay within the sentinel's ratio of it)
+        "floors": {
+            "lost_ops": 0,
+            "ratio_min": 1.5,
+            "recovered_algbw_MBps": first["recovered_algbw_MBps"],
+        },
+    }
+    path = args.out if os.path.isabs(args.out) else os.path.join(REPO,
+                                                                 args.out)
+    with open(path, "w") as fp:
+        json.dump(record, fp, indent=2)
+        fp.write("\n")
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,7 +54,8 @@ RESULTS = os.path.join(REPO, "results")
 # committed record files whose rows are floor material; each entry
 # names the JSON path and how to pull BenchRecord-shaped rows out
 COMMITTED_FILES = ("coalesce_r01.json", "lanes_r01.json", "tune_r01.json",
-                   "tune_r02.json", "codec_r01.json", "hier_r01.json")
+                   "tune_r02.json", "codec_r01.json", "hier_r01.json",
+                   "evasion_r01.json")
 
 # decay thresholds for the between-floors checks: the worst-rank verb
 # P99 may grow to this multiple of its committed twin before it is a
@@ -441,6 +442,61 @@ def check_store_traffic(current: dict | None = None,
     return findings
 
 
+def check_evasion(current: dict | None = None,
+                  results_dir: str = RESULTS,
+                  ratio: float = 0.8) -> list[dict]:
+    """The predictive-evasion ratchet (ISSUE 16): hold the chaos-run
+    recovery claims against the committed ``results/evasion_r01.json``
+    — a future PR that quietly weakens the straggler policy (recovery
+    below the committed floor, or ANY lost op on the bitwise oracle)
+    fails tier-1 here.
+
+    ``current``: a ``tools.record_evasion`` record doc; when None, the
+    committed doc self-diffs (the all-zero fixed point — this is the
+    cheap tier-1 shape; re-measuring is the recorder's job). Three
+    checks: (1) the oracle is absolute — ``lost_ops`` must equal the
+    committed floor (zero: a lost op is data corruption wearing a
+    recovery story); (2) the recovery multiple must stay >= the
+    committed ``ratio_min`` acceptance bar (1.5x the degraded algbw —
+    the bar, not the measured headroom); (3) the recovered algbw must
+    stay >= ``ratio`` x its committed twin, the row-wise allowance."""
+    path = os.path.join(results_dir, "evasion_r01.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as fp:
+        committed = json.load(fp)
+    if current is None:
+        current = committed
+    floors = committed.get("floors", {})
+    findings = []
+    if current.get("lost_ops", 0) != floors.get("lost_ops", 0):
+        findings.append({
+            "key": ("evasion", "lost_ops"),
+            "lost_ops": current.get("lost_ops"),
+            "lost_ops_floor": floors.get("lost_ops", 0),
+            "trace_diff": None,
+        })
+    ratio_min = floors.get("ratio_min", 1.5)
+    if current.get("recovery_ratio", 0.0) < ratio_min:
+        findings.append({
+            "key": ("evasion", "recovery_ratio"),
+            "recovery_ratio": current.get("recovery_ratio"),
+            "floor": ratio_min,
+            "trace_diff": None,
+        })
+    base_bw = floors.get("recovered_algbw_MBps", 0.0)
+    cur_bw = current.get("recovered_algbw_MBps", 0.0)
+    if base_bw > 0 and cur_bw < ratio * base_bw:
+        findings.append({
+            "key": ("evasion", "recovered_algbw"),
+            "recovered_MBps": round(cur_bw, 3),
+            "floor_MBps": round(ratio * base_bw, 3),
+            "committed_MBps": round(base_bw, 3),
+            "trace_diff": None,
+        })
+    return findings
+
+
 def check_current(current: list[dict],
                   results_dir: str = RESULTS,
                   ratio: float = 0.8) -> list[dict]:
@@ -477,6 +533,22 @@ def format_findings(findings: list[dict]) -> str:
                          f"exceeds the committed {f['err_ceil']} ceiling "
                          f"— a speedup bought by coarser quantization "
                          f"is a regression")
+        elif "lost_ops" in f:
+            lines.append(f"  {key}: the evasion chaos run LOST "
+                         f"{f['lost_ops']} op(s) against the bitwise "
+                         f"oracle (committed floor "
+                         f"{f['lost_ops_floor']}) — data corruption "
+                         f"wearing a recovery story")
+        elif "recovery_ratio" in f:
+            lines.append(f"  {key}: evasion recovered only "
+                         f"{f['recovery_ratio']}x the degraded algbw — "
+                         f"below the committed {f['floor']}x "
+                         f"acceptance bar")
+        elif "recovered_MBps" in f:
+            lines.append(f"  {key}: post-evasion algbw "
+                         f"{f['recovered_MBps']} MB/s fell below "
+                         f"{f['floor_MBps']} (committed "
+                         f"{f['committed_MBps']})")
         elif "store_traffic" in f:
             lines.append(f"  simfleet: {f['store_traffic']}")
         elif "per_rank_ops" in f:

@@ -10,6 +10,7 @@ attribution diff naming which bucket grew.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -38,12 +39,31 @@ def main(argv=None) -> int:
                    help="run the simfleet store-traffic ratchet against "
                         "the committed results/fleettree_r01.json "
                         "(per-rank ops O(1), observer ops O(log n))")
+    p.add_argument("--evasion", default=None, nargs="?", const="",
+                   metavar="RECORD.json",
+                   help="run the predictive-evasion ratchet against the "
+                        "committed results/evasion_r01.json (recovered "
+                        "algbw floor, 1.5x recovery bar, zero lost "
+                        "ops); pass a tools.record_evasion doc to diff "
+                        "a fresh run, or nothing to self-diff the "
+                        "committed record")
     args = p.parse_args(argv)
     if args.store_traffic:
-        if args.records or args.run_smoke:
+        if args.records or args.run_smoke or args.evasion is not None:
             p.error("--store-traffic runs alone")
         findings = sentinel.check_store_traffic(
             results_dir=args.results_dir)
+        print(sentinel.format_findings(findings))
+        return 1 if findings else 0
+    if args.evasion is not None:
+        if args.records or args.run_smoke:
+            p.error("--evasion runs alone")
+        current = None
+        if args.evasion:
+            with open(args.evasion) as fp:
+                current = json.load(fp)
+        findings = sentinel.check_evasion(current,
+                                          results_dir=args.results_dir)
         print(sentinel.format_findings(findings))
         return 1 if findings else 0
     if (args.records is None) == (not args.run_smoke):
