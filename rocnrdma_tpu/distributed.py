@@ -37,6 +37,7 @@ import time
 
 import numpy as np
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import (
     STORE as _STORE_OPS,
     VERBS as _VERB_LAT,
@@ -51,6 +52,7 @@ from rocnrdma_tpu.transport import (
     bootstrap,
     plugin,
 )
+from rocnrdma_tpu.transport import keyspace as _keyspace
 from rocnrdma_tpu.transport import lanes as _lanes
 
 _PLANES = {"tcp": TCPNet, "shm": HostQPNet}
@@ -507,11 +509,13 @@ class ChannelHandle:
                  bucket_timeout_s: float | None = None):
         self._pg = pg
         self._lane = lane
-        self._mutex = threading.Lock()
+        self._mutex = _lockwitness.make_lock(
+            "distributed.py::ChannelHandle._mutex")
         self._bucket_bytes = bucket_bytes
         self._bucket_timeout_s = bucket_timeout_s
         self._coalescer = None
-        self._coalescer_lock = threading.Lock()
+        self._coalescer_lock = _lockwitness.make_lock(
+            "distributed.py::ChannelHandle._coalescer_lock")
 
     @property
     def name(self) -> str:
@@ -733,14 +737,17 @@ class ProcessGroup:
         # machinery at a time — a second lane whose collective aborted
         # into the same failure waits here, re-checks the epoch, and
         # retries on the already-healed group instead of double-healing
-        self._op_lock = threading.Lock()
-        self._recovery_lock = threading.RLock()
+        self._op_lock = _lockwitness.make_lock(
+            "distributed.py::ProcessGroup._op_lock")
+        self._recovery_lock = _lockwitness.make_rlock(
+            "distributed.py::ProcessGroup._recovery_lock")
         # lane handles are cached ONE per name under their own lock: two
         # threads opening the same lane concurrently must get the SAME
         # handle (the per-lane mutex IS the one-collective-per-lane
         # contract — two handles would be two mutexes, and same-lane
         # collectives would tag-collide on the wire)
-        self._channels_lock = threading.Lock()
+        self._channels_lock = _lockwitness.make_lock(
+            "distributed.py::ProcessGroup._channels_lock")
         self._channels: dict[str, "ChannelHandle"] = {}
         # quantized-wire error feedback (ISSUE 13): per-(lane, verb,
         # shape, dtype) residuals carried across rounds by the codec
@@ -792,7 +799,8 @@ class ProcessGroup:
                              f"know {sorted(_PLANES)}")
         self._intra_plane = intra_plane
         self._hier: "_Hier | None" = None
-        self._hier_lock = threading.Lock()
+        self._hier_lock = _lockwitness.make_lock(
+            "distributed.py::ProcessGroup._hier_lock")
         self._hier_stale = False       # deferred-invalidate marker
         self._hier_sizes = None        # (epoch, node-sizes tuple) cache
         if plane not in _PLANES:
@@ -877,7 +885,8 @@ class ProcessGroup:
         # _watchdog_failed): the thread writes, every verb's _check_alive
         # reads — the race-discipline lint (tools/analyze/races.py) holds
         # every touch of thread-written attributes to this lock
-        self._health_lock = threading.Lock()
+        self._health_lock = _lockwitness.make_lock(
+            "distributed.py::ProcessGroup._health_lock")
         self._watchdog_failed = None
         self._dead: list[int] = []
         # the fleet plane's coarse health state (obs.fleet.HEALTH_STATES)
@@ -921,7 +930,8 @@ class ProcessGroup:
         # clobber the (peer, "tx") wire — one re-dial per peer is the
         # protocol (the receiver accepts exactly one). Non-blocking
         # acquire: a progress hook must never block on a sibling's turn.
-        self._p2p_service_lock = threading.Lock()
+        self._p2p_service_lock = _lockwitness.make_lock(
+            "distributed.py::ProcessGroup._p2p_service_lock")
         self._p2p_listen: dict | None = None    # peer -> listener, once used
         self._p2p_accepted: set[int] = set()
         self._split_no = 0
@@ -2946,6 +2956,7 @@ class ProcessGroup:
             raise RuntimeError("agree: this group has no store client "
                                "(single-rank group without a store)")
         full = f"pg/{self.group_name}/{key}"
+        _keyspace.check_key(full)  # die at mint time, not as an orphan
         if value is not None:
             return self._client.set_if_absent(full, value)
         return self._client.get(full, timeout_s)
@@ -3002,7 +3013,8 @@ class ProcessGroup:
             self._client.set_if_absent(f"{ns}/h/{slot}",
                                        prop["handles"][str(slot)])
             self._client.set(
-                f"pg/{self.group_name}/{registry}/admit/{sid}",
+                f"{_keyspace.registry_ns(self.group_name, registry)}"
+                f"/admit/{sid}",
                 json.dumps({"epoch": epoch, "members": members,
                             "slot": slot, "ops": int(prop["ops"]),
                             "lane_ops": prop.get("lane_ops", {}),
@@ -3274,14 +3286,14 @@ class ProcessGroup:
                                    prefix=f"pg/{self.group_name}/",
                                    spares=promoted_slots.values(),
                                    kv=tuple(
-                                       f"pg/{self.group_name}/deviceheal/e{k}/"
-                                       for k in range(epoch))
+                                       f"pg/{self.group_name}/deviceheal/e{old_epoch}/"
+                                       for old_epoch in range(epoch))
                                    + tuple(
-                                       f"pg/{self.group_name}/fleet/e{k}/"
-                                       for k in range(epoch))
+                                       f"pg/{self.group_name}/fleet/e{old_epoch}/"
+                                       for old_epoch in range(epoch))
                                    + tuple(
-                                       f"pg/{self.group_name}/hier/e{k}/"
-                                       for k in range(epoch)))
+                                       f"pg/{self.group_name}/hier/e{old_epoch}/"
+                                       for old_epoch in range(epoch)))
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness: stale ids age out of use
         # the wired barrier doubles as the new epoch's clock handshake
@@ -3451,7 +3463,7 @@ class ProcessGroup:
         # any healthy one's age is near zero; the generous floor only
         # guards against a scheduler stall branding a live standby dead
         window = 10.0
-        reg = f"pg/{self.group_name}/{sub}"
+        reg = _keyspace.registry_ns(self.group_name, sub)
         out = []
         sid = 0
         while True:
@@ -3671,14 +3683,14 @@ class ProcessGroup:
                 self._client.prune((), prefix=f"pg/{self.group_name}/",
                                    joiners=joined.values(),
                                    kv=tuple(
-                                       f"pg/{self.group_name}/deviceheal/e{k}/"
-                                       for k in range(epoch))
+                                       f"pg/{self.group_name}/deviceheal/e{old_epoch}/"
+                                       for old_epoch in range(epoch))
                                    + tuple(
-                                       f"pg/{self.group_name}/fleet/e{k}/"
-                                       for k in range(epoch))
+                                       f"pg/{self.group_name}/fleet/e{old_epoch}/"
+                                       for old_epoch in range(epoch))
                                    + tuple(
-                                       f"pg/{self.group_name}/hier/e{k}/"
-                                       for k in range(epoch)))
+                                       f"pg/{self.group_name}/hier/e{old_epoch}/"
+                                       for old_epoch in range(epoch)))
             except (OSError, TimeoutError):
                 pass  # hygiene, not correctness
         _FLIGHT.mark_sync(ns=ns, rank=new_rank)
@@ -3712,7 +3724,7 @@ class ProcessGroup:
 
         from rocnrdma_tpu.transport.backoff import retry_with_backoff
         sub = "spares" if self._standby == "spare" else "join"
-        reg = f"pg/{self.group_name}/{sub}"
+        reg = _keyspace.registry_ns(self.group_name, sub)
         token = _uuid.uuid4().hex
         sched = getattr(self._net, "schedule", None)
 
@@ -3767,7 +3779,8 @@ class ProcessGroup:
 
         from rocnrdma_tpu.transport.backoff import poll_backoff
         sub = "spares" if self._standby == "spare" else "join"
-        admit_key = f"pg/{self.group_name}/{sub}/admit/{self._sid}"
+        admit_key = (f"{_keyspace.registry_ns(self.group_name, sub)}"
+                     f"/admit/{self._sid}")
         deadline = time.monotonic() + timeout_s
         back = poll_backoff()
         kind = self._standby

@@ -38,7 +38,10 @@ import json
 import math
 import threading
 import time
+
 from typing import IO
+
+from rocnrdma_tpu import lockwitness as _lockwitness
 
 GiB = 1024**3
 MiB = 1024**2
@@ -194,7 +197,7 @@ class WireCounters:
 
     def __post_init__(self):
         # not a dataclass field: asdict()/snapshot() must stay pure counters
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("metrics.py::WireCounters._lock")
         # negotiation GAUGES (not counters — windowing them with delta()
         # would be nonsense): the frame size, pipeline depth, and wire-
         # model version the ring wire last picked, so a perf regression
@@ -511,7 +514,7 @@ class StoreCounters:
     exactly (disjoint per-rank events)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("metrics.py::StoreCounters._lock")
         self._by_class: dict[str, int] = {}
         self._by_op: dict[str, int] = {}
 
@@ -606,7 +609,7 @@ class VerbLatencies:
     _TOP = 26  # 2**26 us ~ 67 s: ceiling bucket
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("metrics.py::VerbLatencies._lock")
         # verb -> {"count": int, "total_s": float,
         #          "buckets": Counter{exponent: n}}
         self._verbs: dict[str, dict] = {}

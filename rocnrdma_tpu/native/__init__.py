@@ -22,23 +22,28 @@ import subprocess
 import threading
 import time
 
+from rocnrdma_tpu import lockwitness as _lockwitness
+
 _SRCS = [os.path.join(os.path.dirname(__file__), f)
          for f in ("rqp.cpp", "rtcp.cpp")]
 
-# Sanitizer build flavors (ROCNRDMA_SANITIZE=asan|ubsan): the same
+# Sanitizer build flavors (ROCNRDMA_SANITIZE=asan|ubsan|tsan): the same
 # sources, instrumented, cached in a per-flavor subdir of _build so the
-# plain .so is never clobbered. ASAN-instrumented code additionally needs
-# the asan runtime loaded FIRST in the process — a ctypes host (python)
-# must be launched with LD_PRELOAD pointing at libasan; sanitizer_env()
-# below builds that environment, and tests/test_native_sanitize.py is the
-# slow-marked CI driver that reruns the native test files under each
-# flavor.
+# plain .so is never clobbered. ASAN/TSAN-instrumented code additionally
+# needs its runtime loaded FIRST in the process — a ctypes host (python)
+# must be launched with LD_PRELOAD pointing at the runtime;
+# sanitizer_env() below builds that environment, and
+# tests/test_native_sanitize.py is the slow-marked CI driver that reruns
+# the native test files under each flavor (tsan only the two QP files —
+# it is the data-race flavor, and the QP poll/wait paths are where the
+# native threads actually share state).
 _SANITIZE = os.environ.get("ROCNRDMA_SANITIZE", "").strip().lower()
 _SAN_FLAGS = {
     "": [],
     "asan": ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"],
     "ubsan": ["-fsanitize=undefined", "-fno-sanitize-recover=undefined",
               "-g"],
+    "tsan": ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g"],
 }
 # the flavor nests INSIDE an explicit RQP_LIB_DIR too: a sanitizer run
 # must never pick up (or overwrite) the plain cached .so just because the
@@ -49,7 +54,7 @@ _LIB_DIR = os.path.join(
     _SANITIZE).rstrip("/")
 _LIB = os.path.join(_LIB_DIR, "librqp.so")
 
-_build_lock = threading.Lock()
+_build_lock = _lockwitness.make_lock("native/__init__.py::_build_lock")
 _lib = None
 
 
@@ -77,6 +82,15 @@ def sanitizer_env(flavor: str) -> dict:
                                + ":print_suppressed=0")
     elif flavor == "ubsan":
         env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    elif flavor == "tsan":
+        rt = subprocess.run(["g++", "-print-file-name=libtsan.so"],
+                            capture_output=True, text=True,
+                            check=True).stdout.strip()
+        env["LD_PRELOAD"] = rt
+        # halt_on_error: a detected race must fail the test run, not
+        # scroll past it. history_size at max: the QP poll loops are
+        # hot and the default ring drops the racing stack otherwise.
+        env["TSAN_OPTIONS"] = "halt_on_error=1:history_size=7"
     return env
 
 
@@ -320,7 +334,8 @@ class _QpBase(_Closeable):
         # concurrent close(): _guard's closed-check alone is a TOCTOU —
         # close() freeing the Conn under a parked poll() is a
         # use-after-free the pre-park sleep-beat never risked
-        self._wait_lock = threading.Lock()
+        self._wait_lock = _lockwitness.make_lock(
+            "native/__init__.py::_QpBase._wait_lock")
 
     def _fn(self, op: str):
         return getattr(_load(), f"{self._PREFIX}_{op}")

@@ -78,6 +78,7 @@ import sys
 import threading
 import time
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import (
     STORE as _STORE,
     VERBS as _VERBS,
@@ -234,7 +235,7 @@ class FleetAgent:
 
     def __init__(self, pg):
         self._pg = pg
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("fleet.py::FleetAgent._lock")
         self._last_wire: dict | None = None
         self._last_t: float | None = None
         self._seq = 0

@@ -38,6 +38,8 @@ import sys
 import threading
 import time
 
+from rocnrdma_tpu import lockwitness as _lockwitness
+
 
 class FlightRecorder:
     """Fixed-capacity event ring with a cheap thread-safe ``record``."""
@@ -47,7 +49,8 @@ class FlightRecorder:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock(
+            "recorder.py::FlightRecorder._lock")
         self._buf: list = [None] * capacity
         self._head = 0        # next write slot
         self._recorded = 0    # lifetime event count (wraps never reset it)

@@ -63,6 +63,7 @@ import sys
 import threading
 import time
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.obs.recorder import FLIGHT
 
 DEFAULT_SAMPLE = 8
@@ -423,7 +424,8 @@ class TraceBuffer:
 
     def __init__(self, capacity: int = 16):
         self.capacity = max(1, capacity)
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock(
+            "obs/trace.py::TraceBuffer._lock")
         self._recs: list = []
 
     def push(self, rec: dict) -> None:

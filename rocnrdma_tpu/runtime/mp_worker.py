@@ -1211,6 +1211,19 @@ def _evade_chaos_main(args) -> int:
     return status
 
 
+def _witnessed(code: int) -> int:
+    """Flush this worker's observed lock-acquisition edges the moment
+    the chaos task's verdict is known (``ROCNRDMA_LOCK_WITNESS_OUT``;
+    no-op when the witness is off). The atexit hook also dumps on clean
+    exits, but a worker a kill hook tears down with ``os._exit`` right
+    after the verdict would otherwise take its edges with it — and the
+    survivors' dumps are exactly what the kill-and-heal witness test
+    diffs against the static graph."""
+    from rocnrdma_tpu import lockwitness
+    lockwitness.dump()
+    return code
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="mp_worker")
     p.add_argument("--coordinator", required=True)
@@ -1290,15 +1303,15 @@ def main(argv=None) -> int:
         time.sleep(600)
         return 0
     if args.task == "kill-a-host":
-        return _device_chaos_main(args)  # both planes
+        return _witnessed(_device_chaos_main(args))  # both planes
     if args.task == "kill-and-heal":
-        return _heal_chaos_main(args)  # host plane only: no jax
+        return _witnessed(_heal_chaos_main(args))  # host plane only: no jax
     if args.task == "trace-delay":
-        return _trace_chaos_main(args)  # host plane only: no jax
+        return _witnessed(_trace_chaos_main(args))  # host plane only: no jax
     if args.task == "evade-straggler":
-        return _evade_chaos_main(args)  # host plane only: no jax
+        return _witnessed(_evade_chaos_main(args))  # host plane only: no jax
     if args.task in CHAOS_TASKS:
-        return _chaos_main(args)  # host plane only: no jax, no devices
+        return _witnessed(_chaos_main(args))  # host plane: no jax, no devices
 
     import jax
 

@@ -39,9 +39,11 @@ import json
 import threading
 import time
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu import native
 from rocnrdma_tpu.metrics import STORE as _STORE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
+from rocnrdma_tpu.transport import keyspace
 from rocnrdma_tpu.transport.backoff import (
     poll_backoff,
     retry_with_backoff,
@@ -149,7 +151,8 @@ class BootstrapServer:
         # (a split() child next to its parent) must not read each other's
         # ranks as their own (the rank numbers collide, the scopes don't)
         self._last_seen: dict[tuple, float] = {}
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock(
+            "bootstrap.py::BootstrapServer._lock")
         self._closed = False
         self._threads: list[threading.Thread] = []
         self._conn_ids = itertools.count()  # distinguishes rank-less clients
@@ -279,9 +282,11 @@ class BootstrapServer:
                 # and a prune that declares NO prefix may sweep none at
                 # all (an unprefixed request bypassing the guard would
                 # let any client of a shared store delete another
-                # group's live election).
+                # group's live election). The sweep must also target a
+                # REGISTERED namespace (transport/keyspace.py) — a
+                # typo'd prefix deletes nothing, not the wrong thing.
                 for sub_prefix in req.get("kv", ()):
-                    if not (prefix and sub_prefix.startswith(prefix)):
+                    if not keyspace.sweepable(sub_prefix, prefix):
                         continue
                     for k in [k for k in self._kv
                               if k.startswith(sub_prefix)]:

@@ -60,6 +60,7 @@ import time
 
 import numpy as np
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
 from rocnrdma_tpu.obs import trace as _trace
@@ -181,7 +182,7 @@ class Coalescer:
         self.lane_name = handle.name
         self.bucket_bytes = int(bucket_bytes)
         self.bucket_timeout_s = bucket_timeout_s
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("coalesce.py::Coalescer._lock")
         self._pending: dict[tuple, _Bucket] = {}
 
     # -- submission ---------------------------------------------------------

@@ -70,6 +70,7 @@ import time
 
 import numpy as np
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT
 from rocnrdma_tpu.obs import trace as _trace
 
@@ -569,7 +570,7 @@ class Fp8E4M3Codec(WireCodec):
 
 
 _CODECS: dict[str, WireCodec] = {}
-_CODECS_LOCK = threading.Lock()
+_CODECS_LOCK = _lockwitness.make_lock("codec.py::_CODECS_LOCK")
 
 
 def get(name: str) -> WireCodec:
@@ -636,7 +637,7 @@ class ResidualStore:
     """
 
     def __init__(self, cap: int = RESIDUAL_CAP):
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("codec.py::ResidualStore._lock")
         self._cap = max(1, cap)
         # key -> [epoch, residual, q_scratch, eff_scratch]: the two
         # scratch buffers are the per-key steady state — a round's

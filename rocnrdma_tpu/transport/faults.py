@@ -52,6 +52,7 @@ import hashlib
 import json
 import random
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import FaultCounters
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
 from rocnrdma_tpu.transport import lanes as _lanes
@@ -146,7 +147,7 @@ class FaultSchedule:
         # needs each thread's traffic on its own lane — the per-lane
         # streams — which is the documented chaos discipline.)
         import threading
-        self._lock = threading.RLock()
+        self._lock = _lockwitness.make_rlock("faults.py::FaultSchedule._lock")
 
     def _rng(self, stream: str) -> random.Random:
         # string seeding is sha512-based (process-stable), unlike hash()

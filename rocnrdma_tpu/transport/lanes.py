@@ -65,6 +65,7 @@ import threading
 import time
 import zlib
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT
 from rocnrdma_tpu.obs import trace as _trace
@@ -123,7 +124,7 @@ class LaneRegistry:
     from whatever thread first touches them."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("lanes.py::LaneRegistry._lock")
         d = Lane(0, DEFAULT_LANE, 0, None)
         self._by_name: dict[str, Lane] = {DEFAULT_LANE: d}
         self._by_id: dict[int, Lane] = {0: d}
@@ -287,7 +288,7 @@ class LaneGate:
 
     def __init__(self, registry: LaneRegistry):
         self.registry = registry
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("lanes.py::LaneGate._lock")
         # priority -> count of lanes currently INSIDE a collective
         # (ChannelHandle._run brackets every verb with busy_enter/exit):
         # a paced lane's yields become genuine GIL-releasing sleeps

@@ -40,6 +40,7 @@ import uuid
 
 import numpy as np
 
+from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
 from rocnrdma_tpu.obs import trace as _trace
@@ -173,7 +174,7 @@ class _HostComm:
         # locks, releases, then pauses), and progress hooks are called
         # unlocked — two comms' locks are never held at once, so lane
         # threads pumping each other's comms cannot deadlock.
-        self._lock = threading.RLock()
+        self._lock = _lockwitness.make_rlock("plugin.py::_HostComm._lock")
         # (chan, tag) -> payloads; entries are ZERO-COPY memoryviews of
         # the posted receive buffers (poll_cq's contract) with the
         # 12-byte tag+epoch+chan header sliced off — a consumer that

@@ -35,6 +35,8 @@ import math
 import os
 import threading
 
+from rocnrdma_tpu import lockwitness as _lockwitness
+
 # Default model constants (seconds, seconds/byte). These are order-of-
 # magnitude ICI figures (~1.5us dispatch+hop latency; ~1/(100 GB/s) per
 # link); the model's job is RANKING algorithms, and every ranking below is
@@ -323,7 +325,7 @@ class HostWireModel:
         # of the committed artifact (save/load_host_model), fixed at
         # construction like the pins.
         self.table = sorted((int(mx), int(f)) for mx, f in (table or ()))
-        self._lock = threading.Lock()
+        self._lock = _lockwitness.make_lock("tuner.py::HostWireModel._lock")
         # THE committed snapshot picks read: (version, params, epoch)
         self._state = (0, params or PlaneParams(), 0)
         self._pending: tuple | None = None  # (base_version, params, note)
@@ -832,7 +834,7 @@ COMMITTED_HOST_PLANES: dict[str, dict] = {
 #   ROCNRDMA_WIRE_FRAME=bytes  → pin every pick's frame (sweep corpus knob)
 #   ROCNRDMA_WIRE_DEPTH=n      → pin every pick's posting depth
 _HOST_MODELS: dict[str, HostWireModel] = {}
-_HOST_MODELS_LOCK = threading.Lock()
+_HOST_MODELS_LOCK = _lockwitness.make_lock("tuner.py::_HOST_MODELS_LOCK")
 
 
 def host_wire_model(plane: str) -> HostWireModel:

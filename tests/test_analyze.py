@@ -1492,3 +1492,328 @@ def test_deadlines_hier_verbs_must_take_timeout(tmp_path):
 
 def test_deadlines_hierarchy_on_pg_blocking_surface():
     assert "hierarchy" in deadlines.PG_BLOCKING
+
+
+# ---------------------------------------------------------------------------
+# pass #6: locks — the interprocedural acquisition-order graph. Each rule
+# proves it detects on a doctored fixture AND accepts the corrected
+# version; the repo surface itself must be clean.
+# ---------------------------------------------------------------------------
+
+from tools.analyze import keys, locks  # noqa: E402
+
+
+def test_locks_flags_acquisition_cycle():
+    src = textwrap.dedent("""
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def forward(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 1
+
+            def backward(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        return 2
+    """)
+    problems = locks.check_source(src, "pair.py")
+    assert any("cycle" in p for p in problems), problems
+
+
+def test_locks_accepts_consistent_order():
+    src = textwrap.dedent("""
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def forward(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 1
+
+            def also_forward(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return 2
+    """)
+    assert locks.check_source(src, "pair.py") == []
+
+
+def test_locks_cycle_seen_through_method_calls():
+    # the order inversion hides one hop down the call graph — a purely
+    # syntactic (single-function) checker cannot see it
+    src = textwrap.dedent("""
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def forward(self):
+                with self._a_lock:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b_lock:
+                    return 1
+
+            def backward(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        return 2
+    """)
+    problems = locks.check_source(src, "pair.py")
+    assert any("cycle" in p for p in problems), problems
+
+
+def test_locks_flags_blocking_call_under_lock():
+    src = textwrap.dedent("""
+        import threading
+
+        class Cache:
+            def __init__(self, client):
+                self._lock = threading.Lock()
+                self._client = client
+
+            def refresh(self, timeout_s=5.0):
+                with self._lock:
+                    return self._client.get("pg/g/ring/k", timeout_s)
+    """)
+    problems = locks.check_source(src, "cache.py")
+    assert any("convoy" in p or "blocking" in p for p in problems), problems
+
+
+def test_locks_accepts_snapshot_then_block():
+    # the repo's own discipline: snapshot under the lock, block outside
+    src = textwrap.dedent("""
+        import threading
+
+        class Cache:
+            def __init__(self, client):
+                self._lock = threading.Lock()
+                self._client = client
+
+            def refresh(self, timeout_s=5.0):
+                with self._lock:
+                    key = "pg/g/ring/k"
+                return self._client.get(key, timeout_s)
+    """)
+    assert locks.check_source(src, "cache.py") == []
+
+
+def test_locks_flags_untimed_acquire_under_deadline():
+    src = textwrap.dedent("""
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def admit(self, timeout_s=5.0):
+                self._lock.acquire()
+                try:
+                    return 1
+                finally:
+                    self._lock.release()
+    """)
+    problems = locks.check_source(src, "gate.py")
+    assert any("timeout" in p for p in problems), problems
+
+
+def test_locks_accepts_timed_acquire_under_deadline():
+    src = textwrap.dedent("""
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def admit(self, timeout_s=5.0):
+                if not self._lock.acquire(timeout=timeout_s):
+                    raise TimeoutError("gate lock")
+                try:
+                    return 1
+                finally:
+                    self._lock.release()
+    """)
+    assert locks.check_source(src, "gate.py") == []
+
+
+def test_locks_selftest_runs():
+    assert locks.selftest() == 0
+
+
+def test_locks_repo_surface_is_clean():
+    assert locks.run() == []
+
+
+def test_locks_graph_names_every_witnessed_lock():
+    # the witness names locks with the static node ids at construction
+    # time; the graph must know every one of them, or the runtime diff
+    # compares against a vocabulary the pass never built
+    _problems, _graph, prog = locks.analyze_paths(locks.TARGETS)
+    for nid in (
+        "distributed.py::ProcessGroup._recovery_lock",
+        "plugin.py::_HostComm._lock",
+        "native/__init__.py::_QpBase._wait_lock",
+        # basename collides with the schedule tracer (rocnrdma_tpu/
+        # trace.py) — shadowing once dropped this module entirely, so
+        # its dir-qualified id is pinned here
+        "obs/trace.py::TraceBuffer._lock",
+    ):
+        assert nid in prog.lock_kinds, (nid, sorted(prog.lock_kinds))
+
+
+def test_locks_hold_allow_entries_carry_reasons():
+    # HOLD_ALLOW is the locks pass's second allowlist (locks that MAY be
+    # held across blocking calls) — same hygiene as ALLOW: every entry
+    # needs a written reason, and run() dies on stale entries
+    assert locks.HOLD_ALLOW, "the hold-allowlist went empty — drop this"
+    for key, reason in locks.HOLD_ALLOW.items():
+        assert isinstance(reason, str) and reason.strip(), key
+
+
+# ---------------------------------------------------------------------------
+# pass #7: keys — the store-key grammar against transport/keyspace.py
+# ---------------------------------------------------------------------------
+
+
+def test_keys_flags_unregistered_namespace():
+    src = textwrap.dedent("""
+        def publish(client, group, rank):
+            client.set(f"pg/{group}/bogons/{rank}", "x")
+    """)
+    problems = keys.check_source(src, "fix.py")
+    assert any("unregistered namespace" in p for p in problems), problems
+
+
+def test_keys_accepts_registered_namespaces():
+    src = textwrap.dedent("""
+        def publish(client, group, rank, epoch):
+            client.set(f"pg/{group}/nodemap", "x")
+            client.set(f"pg/{group}/deviceheal/e{epoch}/coord", "x")
+            client.set(f"pg/{group}/split{epoch}/members", "x")
+    """)
+    assert keys.check_source(src, "fix.py") == []
+
+
+def test_keys_flags_unguarded_prune():
+    src = textwrap.dedent("""
+        def sweep(client, ranks):
+            client.prune(ranks, prefix="", kv=("pg/g/fleet/e0/",))
+    """)
+    problems = keys.check_source(src, "fix.py")
+    assert any("unguarded prune" in p for p in problems), problems
+
+
+def test_keys_accepts_prefix_guarded_epoch_bounded_prune():
+    src = textwrap.dedent("""
+        def sweep(client, group, ranks, epoch):
+            client.prune(
+                ranks, prefix=f"pg/{group}/",
+                kv=tuple(f"pg/{group}/fleet/e{old_epoch}/"
+                         for old_epoch in range(epoch)))
+    """)
+    assert keys.check_source(src, "fix.py") == []
+
+
+def test_keys_flags_epoch_sweep_not_bounded_by_epoch():
+    # a sweep generated over something that is NOT range(<epoch>) can
+    # delete the CURRENT epoch's keys — the grammar requires the bound
+    src = textwrap.dedent("""
+        def sweep(client, group, ranks, n):
+            client.prune(
+                ranks, prefix=f"pg/{group}/",
+                kv=tuple(f"pg/{group}/fleet/e{k}/" for k in range(n)))
+    """)
+    problems = keys.check_source(src, "fix.py")
+    assert problems, "unbounded epoch sweep accepted"
+
+
+def test_keys_selftest_runs():
+    assert keys.selftest() == 0
+
+
+def test_keys_repo_surface_is_clean():
+    assert keys.run() == []
+
+
+def test_keyspace_registry_round_trips():
+    # the runtime guard and the static pass read the SAME table — prove
+    # the helpers agree on the registered namespaces
+    sys.path.insert(0, REPO)
+    try:
+        from rocnrdma_tpu.transport import keyspace
+    finally:
+        sys.path.pop(0)
+    assert keyspace.check_key("pg/g/deviceheal/e3/coord") == "deviceheal"
+    assert keyspace.check_key("pg/g/split7/members") == "split"
+    with pytest.raises(ValueError):
+        keyspace.check_key("pg/g/bogons/x")
+    with pytest.raises(ValueError):
+        keyspace.check_key("not-a-group-key")
+    assert keyspace.sweepable("pg/g/fleet/e0/", "pg/g/")
+    assert not keyspace.sweepable("pg/g/bogons/", "pg/g/")
+    assert not keyspace.sweepable("pg/g/fleet/e0/", "")  # no prefix: no sweep
+    with pytest.raises(ValueError):
+        keyspace.registry_ns("g", "ring")  # not a standby registry
+
+
+# ---------------------------------------------------------------------------
+# --changed-only: the incremental CLI mode
+# ---------------------------------------------------------------------------
+
+
+def test_changed_only_json_schema_covers_all_passes():
+    """Incremental mode reports the SAME schema as a full run — every
+    pass name present in counts and problems (global passes ran in
+    full; file-local passes ran on the touched set, possibly empty)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--changed-only", "HEAD",
+         "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+    payload = json.loads(out.stdout)
+    assert set(payload) == {"counts", "problems"}
+    want = {p.NAME for p in analyze.PASSES}
+    assert set(payload["counts"]) == want
+    assert set(payload["problems"]) == want
+    assert {"locks", "keys"} <= want
+
+
+def test_changed_only_refuses_to_write_the_snapshot():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--changed-only", "HEAD",
+         "--write-snapshot"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode != 0
+    assert "snapshot" in out.stderr
+
+
+def test_changed_only_bad_ref_is_a_named_error():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--changed-only",
+         "no-such-ref-xyzzy"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode != 0
+    assert "git diff" in (out.stderr + out.stdout)
+
+
+def test_incremental_passes_filter_to_target_files():
+    # a file-local pass handed an empty changed set must do no per-file
+    # work (and no allowlist hygiene — that is a full-sweep property)
+    assert races.run(target_files=set()) == []
+    assert leaks.run(target_files=set()) == []
+    assert deadlines.run(target_files=set()) == []
+    assert purity.run(target_files=set()) == []
+    assert keys.run(target_files=set()) == []

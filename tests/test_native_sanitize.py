@@ -1,9 +1,12 @@
-"""Native sanitizer flavors (ROCNRDMA_SANITIZE=asan|ubsan): rebuild
+"""Native sanitizer flavors (ROCNRDMA_SANITIZE=asan|ubsan|tsan): rebuild
 rqp.cpp/rtcp.cpp instrumented and re-run the native qp / rtcp /
 irecv_into test files under them, so the C++ rx/tx paths (the PR 2
 rewrites: scatter-gather tx, direct-land rx, zero-copy poll_cq) get
-memory-error coverage CI can run. Slow-marked: two full rebuilds plus an
-interpreter running under ASAN interception.
+memory-error coverage CI can run — and, under tsan, the poll/wait paths
+get data-race coverage (tsan re-runs only the two QP files: that is
+where native threads share state, and tsan's ~5-15x slowdown prices the
+rest out of the budget). Slow-marked: full rebuilds plus an interpreter
+running under sanitizer interception.
 
 ASAN runs with leak detection ON — the interpreter's own allocations are
 suppressed (native/lsan.supp), so a leak report means librqp.so leaked.
@@ -36,16 +39,25 @@ NATIVE_TESTS = [
     "tests/test_irecv_into.py",
 ]
 
+# tsan's flavor-specific file set: the two QP surfaces whose completion
+# queues, wait paths, and connection teardown genuinely cross threads
+TSAN_TESTS = [
+    "tests/test_native_qp.py",
+    "tests/test_tcp_qp.py",
+]
+
 _REPORT_MARKERS = (
     "AddressSanitizer",         # ASAN error reports
     "LeakSanitizer",            # LSAN leak reports
     "runtime error:",           # UBSAN findings
+    "ThreadSanitizer",          # TSAN race reports
     "SUMMARY: ",                # any sanitizer summary line
 )
 
 
 def _toolchain_has(flavor: str) -> bool:
-    lib = {"asan": "libasan.so", "ubsan": "libubsan.so"}[flavor]
+    lib = {"asan": "libasan.so", "ubsan": "libubsan.so",
+           "tsan": "libtsan.so"}[flavor]
     try:
         out = subprocess.run(["g++", f"-print-file-name={lib}"],
                              capture_output=True, text=True, timeout=30)
@@ -55,16 +67,17 @@ def _toolchain_has(flavor: str) -> bool:
     return os.path.sep in path and os.path.exists(path)
 
 
-@pytest.mark.parametrize("flavor", ["asan", "ubsan"])
+@pytest.mark.parametrize("flavor", ["asan", "ubsan", "tsan"])
 def test_native_tests_pass_sanitized(flavor):
     if not _toolchain_has(flavor):
         pytest.skip(f"g++ has no {flavor} runtime on this machine")
+    tests = TSAN_TESTS if flavor == "tsan" else NATIVE_TESTS
     env = dict(os.environ)
     env.pop("RQP_LIB_DIR", None)   # flavor dirs, not an explicit override
     env.update(native.sanitizer_env(flavor))
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
-        [sys.executable, "-m", "pytest", *NATIVE_TESTS, "-q",
+        [sys.executable, "-m", "pytest", *tests, "-q",
          "-p", "no:cacheprovider", "-p", "no:randomly"],
         capture_output=True, text=True, cwd=REPO, env=env, timeout=900)
     text = out.stdout + out.stderr
@@ -75,11 +88,13 @@ def test_native_tests_pass_sanitized(flavor):
             f"({marker!r}):\n{text[-8000:]}")
     # a broken instrumented build makes native.available() False and every
     # test SKIP — a green exit code proving nothing. Require the suite to
-    # have genuinely run (the three files hold 40+ tests; leave slack for
-    # a few environment-dependent skips, not for wholesale skipping).
+    # have genuinely run (the three files hold 40+ tests, the two tsan
+    # files 20+; leave slack for a few environment-dependent skips, not
+    # for wholesale skipping).
     m = re.search(r"(\d+) passed", text)
     passed = int(m.group(1)) if m else 0
-    assert passed >= 30, (
+    floor = 15 if flavor == "tsan" else 30
+    assert passed >= floor, (
         f"{flavor} run passed only {passed} test(s) — the instrumented "
         f"build likely failed and the suite skipped itself green:"
         f"\n{text[-8000:]}")

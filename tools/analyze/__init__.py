@@ -1,6 +1,6 @@
 """tools.analyze — the repo's static-analysis suite, gating tier-1.
 
-Six passes over the transport stack, one shared AST/allowlist core
+Eight passes over the transport stack, one shared AST/allowlist core
 (``tools.analyze.base``); each pass enforces one machine-checkable
 invariant of the "named errors, never hangs, no silent corruption"
 contract:
@@ -20,6 +20,16 @@ contract:
   reads no clock, RNG, or environ at pick time — picks must be pure
   functions of (inputs, committed model version) or the two ends of a
   ring edge derive different frame tags and deadlock.
+- ``locks``: interprocedural lock-acquisition-order graph over the whole
+  package — cycles, blocking calls made while holding a lock off the
+  hold-allowlist, and ``acquire()`` without a timeout inside
+  deadline-carrying contexts. Cross-checked at runtime by the lock
+  witness (``ROCNRDMA_LOCK_WITNESS=1``, ``rocnrdma_tpu/lockwitness.py``).
+- ``keys``: store-key grammar — every ``pg/``-rooted key literal parses
+  against the namespace registry (``transport/keyspace.py``), prune
+  sweeps are prefix-guarded and epoch-bounded strictly below the minted
+  epoch, and epoch-qualified keys derive their epoch from the group's
+  committed value.
 
 Run all passes with ``python -m tools.analyze`` (exit 0 = clean). Every
 pass carries an ``ALLOW`` dict — empty by policy; an entry needs a
@@ -30,9 +40,25 @@ are ratcheted against ``results/analyze_pr3.json`` by
 
 from __future__ import annotations
 
-from tools.analyze import deadlines, leaks, obs, purity, races, vtable
+from tools.analyze import (
+    deadlines,
+    keys,
+    leaks,
+    locks,
+    obs,
+    purity,
+    races,
+    vtable,
+)
 
-PASSES = (deadlines, races, vtable, leaks, obs, purity)
+PASSES = (deadlines, races, vtable, leaks, obs, purity, locks, keys)
+
+# passes whose rules are file-local (a finding in file F depends only on
+# F's AST) — ``--changed-only`` narrows these to the touched files. The
+# rest (vtable's plane comparison, obs's fixed verb surface, locks's
+# whole-package acquisition graph) are global properties and always run
+# over their full surface.
+INCREMENTAL = (deadlines, races, leaks, purity, keys)
 
 SNAPSHOT = "results/analyze_pr3.json"
 
@@ -40,6 +66,16 @@ SNAPSHOT = "results/analyze_pr3.json"
 def run_all() -> dict:
     """pass name -> list of problem strings."""
     return {p.NAME: p.run() for p in PASSES}
+
+
+def run_changed(changed_files) -> dict:
+    """Incremental sweep for ``--changed-only``: file-local passes see
+    only ``changed_files`` (repo-relative paths); global passes run in
+    full. Allowlist hygiene stays full-sweep-only (see each pass)."""
+    changed = set(changed_files)
+    return {p.NAME: (p.run(target_files=changed) if p in INCREMENTAL
+                     else p.run())
+            for p in PASSES}
 
 
 def counts(results: dict | None = None) -> dict:

@@ -6,6 +6,11 @@ Options:
   --json                 machine-readable {pass: [problems]} on stdout
   --write-snapshot [P]   also write the ratchet snapshot (finding counts
                          per pass) to P (default: results/analyze_pr3.json)
+  --changed-only REF     incremental mode: file-local passes (deadlines,
+                         races, leaks, purity, keys) only scan files
+                         changed vs. git ref REF; global passes (vtable,
+                         obs, locks) still run in full. Refuses to write
+                         a snapshot — the ratchet is a full-sweep unit.
 """
 
 from __future__ import annotations
@@ -13,10 +18,24 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from tools import analyze
 from tools.analyze import base
+
+
+def changed_files(ref: str) -> list:
+    """Repo-relative paths of files changed vs. ``ref`` (committed diff
+    plus the working tree — an uncommitted edit must not dodge the
+    incremental sweep)."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        cwd=base.REPO, capture_output=True, text=True, timeout=30)
+    if out.returncode != 0:
+        raise SystemExit(f"git diff --name-only {ref} failed: "
+                         f"{out.stderr.strip()}")
+    return [ln for ln in out.stdout.splitlines() if ln.endswith(".py")]
 
 
 def main(argv=None) -> int:
@@ -25,9 +44,19 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--write-snapshot", nargs="?", const=analyze.SNAPSHOT,
                     default=None, metavar="PATH")
+    ap.add_argument("--changed-only", default=None, metavar="REF",
+                    help="scan only files changed vs. this git ref "
+                         "(global passes still run in full)")
     args = ap.parse_args(argv)
 
-    results = analyze.run_all()
+    if args.changed_only is not None:
+        if args.write_snapshot:
+            ap.error("--changed-only cannot write the ratchet snapshot "
+                     "(counts from a partial sweep are not comparable)")
+        changed = changed_files(args.changed_only)
+        results = analyze.run_changed(changed)
+    else:
+        results = analyze.run_all()
     counts = analyze.counts(results)
     total = sum(counts.values())
 

@@ -291,17 +291,26 @@ def selftest() -> int:
     return 0
 
 
-def run() -> list[str]:
+def run(target_files: list | None = None) -> list[str]:
+    """Full sweep, or — with ``target_files`` (incremental mode,
+    ``--changed-only``) — only the touched files. Allowlist hygiene
+    (reasons, unknown files) is a whole-surface property and only runs
+    on full sweeps."""
     problems = []
-    for path in TARGETS:
+    targets = TARGETS if target_files is None else \
+        [t for t in TARGETS if t in target_files]
+    init_targets = INIT_TARGETS if target_files is None else \
+        [t for t in INIT_TARGETS if t in target_files]
+    for path in targets:
         problems += check_file(path)
-    for path in INIT_TARGETS:
+    for path in init_targets:
         problems += check_init_sites(path)
-    for key in ALLOW:
-        f, _, qn = key.partition("::")
-        if not any(f == os.path.basename(t) for t in TARGETS):
-            problems.append(f"ALLOW entry {key!r} names an unknown file")
-    problems += base.allow_reason_problems(ALLOW, NAME)
+    if target_files is None:
+        for key in ALLOW:
+            f, _, qn = key.partition("::")
+            if not any(f == os.path.basename(t) for t in TARGETS):
+                problems.append(f"ALLOW entry {key!r} names an unknown file")
+        problems += base.allow_reason_problems(ALLOW, NAME)
     return problems
 
 

@@ -1,0 +1,357 @@
+"""Pass #7: store-key grammar — every key parses against the registry.
+
+The bootstrap store is the transport's only shared mutable namespace:
+rendezvous handles, barrier waves, heartbeats, standby registries,
+telemetry snapshots and election keys all live under ``pg/<group>/``.
+Historically each subsystem minted its keys with a raw f-string, and
+the only thing standing between a typo'd prefix and a prune sweep
+deleting another subsystem's live election was review. This pass makes
+the keyspace a checked grammar:
+
+1. **Namespace table.** Every ``pg/``-rooted key literal/f-string in the
+   package must parse against ``rocnrdma_tpu/transport/keyspace.py`` —
+   the ONE registry (DESIGN.md §6f) that the store server's prune guard
+   also reads at runtime. The segment after the group must be a
+   registered namespace token (format fields are wildcards; a key whose
+   namespace IS a runtime variable is a finding — route it through a
+   keyspace helper such as ``registry_ns`` or declare it in ``ALLOW``).
+
+2. **Epoch derivation.** An epoch-qualified segment (``.../e{X}/...``)
+   must derive ``X`` from an expression that NAMES an epoch — the
+   group's committed ``self.epoch``, a protocol function's ``epoch``
+   argument, a sweep's ``old_epoch`` bound — never an anonymous local.
+   Epoch provenance is the difference between "sweeps strictly below
+   the minted epoch" and "sweeps whatever ``k`` happened to be".
+
+3. **Prune discipline.** Every client-side ``prune(...)`` call must be
+   prefix-guarded (``prefix=`` is the caller's own group root,
+   ``pg/<group>/``) and every ``kv=`` sweep prefix must be a registered
+   namespace generated over ``range(<epoch>)`` — epoch-bounded STRICTLY
+   below the minted epoch, mechanically: the sweep's e-segment variable
+   must be the comprehension target of a ``range(...)`` whose bound
+   names an epoch.
+
+Scope: the whole package. Keys built by continuation (``f"{ns}/..."``)
+are covered at the site that built ``ns`` — the grammar checks every
+string that ROOTS a key (starts with the literal ``pg/``).
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+
+from tools.analyze import base
+
+NAME = "keys"
+DESCRIPTION = ("store keys parse against the namespace registry; prune "
+               "sweeps are prefix-guarded and epoch-bounded")
+
+TARGETS = base.package_targets()
+
+KEYSPACE_PATH = "rocnrdma_tpu/transport/keyspace.py"
+
+# "module::qualname" -> reason
+ALLOW: dict[str, str] = {
+    "distributed.py::ProcessGroup.agree":
+        "the cross-plane agreement primitive: the namespace segment is "
+        "the CALLER's (the device-plane heal elects its coordinator "
+        "under deviceheal/); the key is validated at runtime against "
+        "the same registry (keyspace.check_key) before it touches the "
+        "store, so an unregistered namespace dies at mint time",
+}
+
+_WILD = "\x00"
+
+
+def _keyspace():
+    """The registry module, loaded by file path — no package import, so
+    the analyzer stays runnable without jax in the environment."""
+    path = os.path.join(base.REPO, KEYSPACE_PATH)
+    spec = importlib.util.spec_from_file_location("_rocn_keyspace", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def modlabel(path: str) -> str:
+    b = os.path.basename(path)
+    if b == "__init__.py":
+        b = os.path.basename(os.path.dirname(path)) + "/__init__.py"
+    return b
+
+
+def _render(node) -> str | None:
+    """A string/f-string as a pattern: format fields become wildcards."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant):
+                out.append(str(part.value))
+            else:
+                out.append(_WILD)
+        return "".join(out)
+    return None
+
+
+def _pretty(pattern: str) -> str:
+    return pattern.replace(_WILD, "{…}")
+
+
+def _qual_of(node, parents, functions) -> str:
+    for anc in base.ancestors(node, parents):
+        for qual, fn, _owner in functions:
+            if fn is anc:
+                return qual
+    return "<module>"
+
+
+class _Checker:
+    def __init__(self, ks):
+        self.ks = ks
+        self.problems: list = []
+        self.used_allow: set = set()
+
+    def _problem(self, path, mod, qual, lineno, msg):
+        key = f"{mod}::{qual}"
+        if key in ALLOW:
+            self.used_allow.add(key)
+            return
+        self.problems.append(f"{path}:{lineno}: {msg}")
+
+    # -- rule 1: grammar ---------------------------------------------------
+    def check_grammar(self, path, mod, tree, parents, functions):
+        for node in ast.walk(tree):
+            s = _render(node)
+            if s is None or not s.startswith("pg/") or s == "pg/":
+                continue
+            if isinstance(node, ast.Constant) \
+                    and isinstance(parents.get(node), ast.JoinedStr):
+                continue  # a piece of an f-string already checked whole
+            qual = _qual_of(node, parents, functions)
+            segments = s.split("/")
+            if len(segments) < 3 or not segments[1]:
+                self._problem(
+                    path, mod, qual, node.lineno,
+                    f"store key {_pretty(s)!r} has no namespace segment "
+                    f"(want pg/<group>/<namespace>/...)")
+                continue
+            token = segments[2]
+            if token == "":
+                continue  # "pg/<group>/" — a group-root prefix, legal
+            if token == _WILD:
+                self._problem(
+                    path, mod, qual, node.lineno,
+                    f"store key {_pretty(s)!r}: the namespace segment is "
+                    f"a runtime variable — mint it through a keyspace "
+                    f"helper (registry_ns/check_key) or ALLOW it with "
+                    f"the reason the indirection is safe")
+                continue
+            if _WILD in token:
+                head = token.split(_WILD)[0]
+                ok = head in self.ks.NUMBERED
+            else:
+                ok = self.ks.is_registered(token)
+            if not ok:
+                self._problem(
+                    path, mod, qual, node.lineno,
+                    f"store key {_pretty(s)!r} uses unregistered "
+                    f"namespace {_pretty(token)!r} — register it in "
+                    f"transport/keyspace.py NAMESPACES or fix the key")
+
+    # -- rule 2: epoch provenance ------------------------------------------
+    def check_epochs(self, path, mod, tree, parents, functions):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.JoinedStr):
+                continue
+            parts = node.values
+            for i, part in enumerate(parts[:-1]):
+                if not (isinstance(part, ast.Constant)
+                        and isinstance(part.value, str)
+                        and part.value.endswith("/e")):
+                    continue
+                nxt = parts[i + 1]
+                if not isinstance(nxt, ast.FormattedValue):
+                    continue
+                expr = ast.unparse(nxt.value)
+                if "epoch" in expr:
+                    continue
+                qual = _qual_of(node, parents, functions)
+                self._problem(
+                    path, mod, qual, node.lineno,
+                    f"epoch-qualified segment e{{{expr}}} derives from "
+                    f"{expr!r}, which does not name an epoch — derive "
+                    f"it from the group's committed epoch (or name the "
+                    f"bound *_epoch) so provenance is visible at the "
+                    f"mint site")
+
+    # -- rule 3: prune discipline ------------------------------------------
+    def check_prunes(self, path, mod, tree, parents, functions):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and base.call_name(node) == "prune"
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            recv = node.func.value
+            rname = recv.attr if isinstance(recv, ast.Attribute) \
+                else (recv.id if isinstance(recv, ast.Name) else "")
+            if "client" not in rname.lower():
+                continue  # not a store-client prune call
+            qual = _qual_of(node, parents, functions)
+            kwargs = {kw.arg: kw.value for kw in node.keywords}
+            prefix = kwargs.get("prefix")
+            pfx = _render(prefix) if prefix is not None else None
+            if pfx is None or not pfx.startswith("pg/") \
+                    or not pfx.endswith("/"):
+                self._problem(
+                    path, mod, qual, node.lineno,
+                    "unguarded prune: prefix= must be this group's own "
+                    "root ('pg/<group>/') — without it the server "
+                    "refuses the kv sweep and the liveness sweep can "
+                    "cross group scopes")
+            if "kv" in kwargs:
+                self._check_kv(path, mod, qual, kwargs["kv"])
+
+    def _check_kv(self, path, mod, qual, kv):
+        sweeps = [n for n in ast.walk(kv) if isinstance(n, ast.JoinedStr)]
+        literals = [n for n in ast.walk(kv)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                    and not isinstance(n, ast.JoinedStr)]
+        kv_parents = base.parent_map(kv)
+        for lit in literals:
+            if isinstance(kv_parents.get(lit), ast.JoinedStr):
+                continue
+            if lit.value.startswith("pg/") or not lit.value:
+                self._problem(
+                    path, mod, qual, lit.lineno,
+                    f"kv sweep prefix {lit.value!r} is a bare literal — "
+                    f"a sweep must be generated over range(<epoch>) so "
+                    f"it is epoch-bounded strictly below the minted "
+                    f"epoch")
+        for js in sweeps:
+            s = _render(js)
+            if not s.startswith("pg/"):
+                self._problem(path, mod, qual, js.lineno,
+                              f"kv sweep prefix {_pretty(s)!r} is "
+                              f"outside the pg/ root")
+                continue
+            var = None
+            parts = js.values
+            for i, part in enumerate(parts[:-1]):
+                if isinstance(part, ast.Constant) \
+                        and str(part.value).endswith("/e") \
+                        and isinstance(parts[i + 1], ast.FormattedValue):
+                    v = parts[i + 1].value
+                    if isinstance(v, ast.Name):
+                        var = v.id
+            if var is None:
+                self._problem(
+                    path, mod, qual, js.lineno,
+                    f"kv sweep prefix {_pretty(s)!r} is not "
+                    f"epoch-qualified (no .../e{{<var>}}/ segment) — an "
+                    f"unbounded namespace sweep deletes the NEW epoch's "
+                    f"keys too")
+                continue
+            bounded = False
+            for comp in ast.walk(kv):
+                if not isinstance(comp, (ast.GeneratorExp, ast.ListComp)):
+                    continue
+                if js not in ast.walk(comp):
+                    continue
+                for gen in comp.generators:
+                    if isinstance(gen.target, ast.Name) \
+                            and gen.target.id == var \
+                            and isinstance(gen.iter, ast.Call) \
+                            and base.call_name(gen.iter) == "range" \
+                            and len(gen.iter.args) == 1 \
+                            and "epoch" in ast.unparse(gen.iter.args[0]):
+                        bounded = True
+            if not bounded:
+                self._problem(
+                    path, mod, qual, js.lineno,
+                    f"kv sweep prefix {_pretty(s)!r}: e-segment variable "
+                    f"{var!r} is not bounded by range(<epoch>) — the "
+                    f"sweep must run strictly below the minted epoch")
+
+
+def check_source(src: str, path: str = "<fixture>") -> list[str]:
+    ks = _keyspace()
+    tree = ast.parse(src, filename=path)
+    parents = base.parent_map(tree)
+    functions = base.iter_functions(tree)
+    mod = modlabel(path)
+    c = _Checker(ks)
+    c.check_grammar(path, mod, tree, parents, functions)
+    c.check_epochs(path, mod, tree, parents, functions)
+    c.check_prunes(path, mod, tree, parents, functions)
+    problems = list(c.problems)
+    problems += base.allow_stale_problems(
+        {k: v for k, v in ALLOW.items() if k.startswith(mod + "::")},
+        c.used_allow, NAME)
+    return problems
+
+
+def check_file(path: str) -> list[str]:
+    return check_source(base.read_source(path), path)
+
+
+SELFTEST_BAD = """
+class G:
+    def mint(self):
+        return f"pg/{self.group_name}/bogus/{self.rank}"
+
+    def sweep(self, epoch):
+        self._client.prune((), kv=("pg/g/fleet/",))
+"""
+
+
+def selftest() -> int:
+    problems = check_source(SELFTEST_BAD, "selftest_keys.py")
+    assert any("unregistered namespace" in p for p in problems), problems
+    assert any("unguarded prune" in p for p in problems), problems
+    return 0
+
+
+def run(target_files: list | None = None) -> list[str]:
+    selftest()
+    ks = _keyspace()
+    targets = TARGETS if target_files is None else \
+        [t for t in TARGETS if t in target_files]
+    c = _Checker(ks)
+    for path in targets:
+        try:
+            tree = base.parse_file(path)
+        except SyntaxError as e:
+            c.problems.append(f"{path}:{e.lineno}: unparsable: {e.msg}")
+            continue
+        parents = base.parent_map(tree)
+        functions = base.iter_functions(tree)
+        mod = modlabel(path)
+        c.check_grammar(path, mod, tree, parents, functions)
+        c.check_epochs(path, mod, tree, parents, functions)
+        c.check_prunes(path, mod, tree, parents, functions)
+    problems = list(c.problems)
+    problems += base.allow_reason_problems(ALLOW, NAME)
+    if target_files is None:
+        problems += base.allow_stale_problems(ALLOW, c.used_allow, NAME)
+        known = {modlabel(t) for t in TARGETS}
+        for key in ALLOW:
+            if key.partition("::")[0] not in known:
+                problems.append(f"{NAME}: ALLOW entry {key!r} names an "
+                                f"unknown module")
+    return problems
+
+
+def main() -> int:
+    problems = run()
+    for p in problems:
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,845 @@
+"""Pass #6: lock discipline — the package's lock-acquisition-order graph.
+
+The transport stack is a real multi-threaded program (collective caller
+threads, lane workers, the bootstrap server's acceptor/serve threads,
+the process-group watchdog), and three of its historical bugs — the
+resume-service deadlock, the close-vs-recv use-after-free, the lockstep
+adoption races — were lock-ORDER bugs the per-attribute race pass
+cannot see. This pass builds the interprocedural lock graph over the
+whole package and enforces three rules:
+
+(a) **No cycles.** Every ``with <lock>:`` block and explicit
+    ``acquire()`` is a node (``module::Class.attr`` for instance locks,
+    ``module::NAME`` for module globals — the SAME ids the runtime
+    witness ``rocnrdma_tpu/lockwitness.py`` stamps, so the two halves
+    diff without translation); an edge A → B means B is acquired while
+    A is held, transitively through the call graph. A cycle is a
+    deadlock waiting for the right interleaving.
+
+(b) **No blocking under an undeclared lock.** A call that can block —
+    a store RPC on a client, ``poll_cq``/``wait``/``sleep``/thread
+    ``join``, anything passing the repo's deadline kwargs, or any verb
+    on the deadline pass's blocking surface — made while holding a lock
+    is a convoy (every other thread on that lock now waits on the
+    slow I/O too) unless the lock is DECLARED in ``HOLD_ALLOW`` with a
+    written reason. Calls the static call graph cannot resolve (a
+    callable parameter, a stored callback) count as potentially
+    blocking: what the analyzer cannot bound, the author must declare.
+
+(c) **No untimed ``acquire()`` in deadline-carrying contexts.** A
+    function that accepts ``timeout_s``/``grace_s``/``deadline`` made a
+    promise; a bare ``lock.acquire()`` inside it can outwait any
+    deadline.
+
+Precision boundary, stated plainly: call-graph edges are resolved for
+``self.m()`` (through the module-local MRO), bare module functions,
+receivers declared in ``RECEIVER_TYPES``/``GLOBAL_RECEIVERS``, and the
+deadline pass's named blocking verbs. Everything else is either WILD
+(callable params / stored callbacks — the held lock is marked
+may-precede-anything, rule (b) fires) or invisible. The runtime witness
+exists exactly to audit this boundary: an edge observed live but absent
+here is a bug in THIS file's tables, and the witness test fails on it.
+
+Exceptions live in ``ALLOW`` (rule (c)/receiver findings) and
+``HOLD_ALLOW`` (rule (b), keyed by lock node id) — both empty-by-policy
+dicts where every entry needs a reason and stale entries are findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.analyze import base, deadlines
+
+NAME = "locks"
+DESCRIPTION = ("the lock-acquisition graph is acyclic, blocking under a "
+               "lock is declared, acquire() is timed under deadlines")
+
+TARGETS = base.package_targets()
+
+DEADLINE_PARAMS = deadlines.DEADLINE_PARAMS
+
+# rule (c) / unresolved-receiver exceptions: "module::qualname" -> reason
+ALLOW: dict[str, str] = {}
+
+# rule (b): locks DECLARED safe to hold across blocking/unbounded calls,
+# lock node id -> the written reason the convoy is the design
+HOLD_ALLOW: dict[str, str] = {
+    "distributed.py::ChannelHandle._mutex":
+        "the per-channel serialization mutex: held across the whole "
+        "collective (a dynamically-dispatched jitted call) BY DESIGN — "
+        "one in-flight op per channel is the channel contract, and the "
+        "op itself is deadline-bounded (pass #0) so the hold is too",
+    "distributed.py::ProcessGroup._p2p_service_lock":
+        "the p2p resume-service try-lock: exactly one thread serves "
+        "interrupted outbound streams (dial + RESUME + re-queue, all "
+        "deadline-bounded) while sibling lane threads bounce off the "
+        "acquire(blocking=False) and keep polling — nobody ever WAITS "
+        "on this lock, so a convoy cannot form by construction",
+    "distributed.py::ProcessGroup._recovery_lock":
+        "serializes heal/grow/shrink: the ENTIRE membership protocol "
+        "(store rendezvous, rewire, barrier) runs under it so a second "
+        "failure cannot start a competing recovery; every wait inside "
+        "is deadline-bounded and collective callers are parked on "
+        "purpose until the epoch is committed",
+    "distributed.py::ProcessGroup._channels_lock":
+        "the channel-map mutex: check-then-create of a named channel "
+        "must be atomic or two threads race the same lane open. The "
+        "held call (net.open_lane, plane-dispatched so the graph sees "
+        "it as wild) is local registry work plus the hierarchy lane "
+        "mirror — no store RPC, no wire wait; the runtime witness "
+        "observed exactly the registry-lock edge under it",
+    "distributed.py::ProcessGroup._hier_lock":
+        "serializes hierarchy (re)build: sub-ring rendezvous + wiring "
+        "runs under it so two callers cannot mint rival generations; "
+        "build waits are deadline-bounded, and _hier_invalidate takes "
+        "it with a timeout + deferred-teardown fallback, never bare",
+    "native/__init__.py::_build_lock":
+        "one compiler invocation per flavor, ever: the first caller "
+        "compiles librqp.so (seconds) while later callers wait for the "
+        "artifact rather than racing g++ on the same output path",
+    "native/__init__.py::_QpBase._wait_lock":
+        "serializing pollers IS this lock's job: the holder runs the "
+        "deadline-bounded poll_cq/progress loop, concurrent waiters "
+        "queue behind it (completion order is per-QP FIFO)",
+    "plugin.py::_HostComm._lock":
+        "the per-comm wire RLock: send/recv/flush hold it across the "
+        "deadline-bounded progress pump (post + poll_cq) so exactly one "
+        "thread drives a QP's completion queue at a time — the rccl-net "
+        "contract; concurrent verbs on one comm queue behind the pump "
+        "by design and every wait inside is deadline-bounded (pass #0)",
+}
+
+# receiver variable name -> lock-owning class, per module label: the
+# declared types for non-self lock receivers and cross-module callees.
+# An undeclared non-self lock receiver is a FINDING — the table must
+# stay complete for the graph to be honest.
+RECEIVER_TYPES: dict[str, dict[str, tuple[str, str]]] = {
+    "plugin.py": {
+        "comm": ("plugin.py", "_HostComm"),
+        "qp": ("native/__init__.py", "_QpBase"),
+        "l": ("native/__init__.py", "_QpBase"),
+    },
+    "distributed.py": {
+        "comm": ("plugin.py", "_HostComm"),
+        "gate": ("lanes.py", "LaneGate"),
+        "registry": ("lanes.py", "LaneRegistry"),
+    },
+}
+
+# module-singleton receivers (the observability/metric globals) ->
+# lock-owning class; lets the graph follow e.g. ``_FLIGHT.record(...)``
+GLOBAL_RECEIVERS: dict[str, tuple[str, str]] = {
+    "FLIGHT": ("recorder.py", "FlightRecorder"),
+    "_FLIGHT": ("recorder.py", "FlightRecorder"),
+    "_WIRE": ("metrics.py", "WireCounters"),
+    "WIRE": ("metrics.py", "WireCounters"),
+    "_STORE": ("metrics.py", "StoreCounters"),
+    "STORE": ("metrics.py", "StoreCounters"),
+    "VERBS": ("metrics.py", "VerbLatencies"),
+    "_VERB_LAT": ("metrics.py", "VerbLatencies"),
+    "FAULTS": ("metrics.py", "FaultCounters"),
+    "_FAULTS": ("metrics.py", "FaultCounters"),
+}
+
+# callee names that block by themselves (no deadline kwarg needed to
+# tell): stdlib waits plus the wire poll loops
+BLOCKING_NAMES = {"sleep", "pause", "poll_cq", "wait_idle",
+                  "bootstrap_ring", "monitored_barrier", "wait"}
+
+# store RPCs block when the receiver looks like a store client
+STORE_RPCS = {"get", "set", "try_get", "set_if_absent", "barrier",
+              "exchange", "prune", "heartbeat", "live_ages",
+              "dead_ranks"}
+
+# the deadline pass's named blocking surface: attribute calls with these
+# names are blocking wherever the graph cannot resolve the receiver
+SURFACE_BLOCKING = (set(deadlines.PG_BLOCKING)
+                    | set(deadlines.CHANNEL_BLOCKING)
+                    | set(deadlines.LANE_BLOCKING)
+                    | {name for _cls, name in deadlines.COALESCE_BLOCKING})
+
+_DEADLINE_KWARGS = {"timeout_s", "grace_s", "_budget_s", "deadline"}
+
+
+def modlabel(path: str) -> str:
+    b = os.path.basename(path)
+    if b == "__init__.py":
+        b = os.path.basename(os.path.dirname(path)) + "/__init__.py"
+    return b
+
+
+# ---------------------------------------------------------------------------
+# per-module model
+
+
+class _Func:
+    __slots__ = ("mod", "owner", "qual", "node", "params",
+                 "acquires", "blocks", "wild", "callees",
+                 "block_sites", "wild_sites")
+
+    def __init__(self, mod, owner, qual, node):
+        self.mod, self.owner, self.qual, self.node = mod, owner, qual, node
+        self.params = base.func_params(node)
+        self.acquires: set = set()     # direct lock nodes
+        self.blocks = False            # direct blocking call
+        self.wild = False              # direct unresolvable callable call
+        self.callees: list = []        # resolved _Func keys
+        self.block_sites: list = []    # (lineno, what) for messages
+        self.wild_sites: list = []
+
+
+class _Module:
+    def __init__(self, path: str, label: str | None = None):
+        self.path = path
+        self.mod = label or modlabel(path)
+        self.tree = base.parse_file(path)
+        self.parents = base.parent_map(self.tree)
+        self.functions = base.iter_functions(self.tree)
+        self.by_name: dict = {}            # (owner, name) -> node
+        for qual, node, owner in self.functions:
+            self.by_name[(owner, node.name)] = node
+        self.bases: dict = {}              # class -> local base names
+        self.classes: set = set()
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.ClassDef):
+                self.classes.add(n.name)
+                self.bases[n.name] = [b.id for b in n.bases
+                                      if isinstance(b, ast.Name)]
+        # who constructs self.X: (class, attr) assigned anywhere
+        self.assigns: set = set()
+        self.lock_kinds: dict = {}         # node id -> "lock" | "rlock"
+        self.module_funcs = {node.name for q, node, o in self.functions
+                             if o is None and "." not in q}
+        # import aliases, for typing self-attrs from construction sites:
+        # alias -> candidate module labels (a from-import of a module),
+        # and alias -> (candidate labels, class) (a from-import of a
+        # class). Candidates, because "lanes" may be lanes.py or
+        # lanes/__init__.py — resolved against the program's module map.
+        self.import_mods: dict = {}
+        self.import_classes: dict = {}
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.ImportFrom) and n.module:
+                tail = n.module.rsplit(".", 1)[-1]
+                for a in n.names:
+                    alias = a.asname or a.name
+                    self.import_mods[alias] = [a.name + ".py",
+                                               a.name + "/__init__.py"]
+                    self.import_classes[alias] = (
+                        [tail + ".py", tail + "/__init__.py"], a.name)
+
+    def mro(self, cls):
+        out, work = [], [cls]
+        while work:
+            c = work.pop(0)
+            if c in out or c not in self.classes and c != cls:
+                continue
+            out.append(c)
+            work.extend(self.bases.get(c, []))
+        return out
+
+    def owner_of_attr(self, cls, attr) -> str:
+        """The class (in cls's local MRO) that assigns self.<attr>."""
+        for c in self.mro(cls):
+            if (c, attr) in self.assigns:
+                return c
+        return cls
+
+
+def _lockish(expr) -> str | None:
+    """Like base.lock_name_of, plus the repo's ``_mutex`` spelling."""
+    name = base.lock_name_of(expr)
+    if name is not None:
+        return name
+    if isinstance(expr, ast.Attribute) and "mutex" in expr.attr.lower():
+        return expr.attr
+    if isinstance(expr, ast.Name) and "mutex" in expr.id.lower():
+        return expr.id
+    return None
+
+
+def _lock_node(m: _Module, expr, owner_class) -> str | None:
+    """The graph node id for a lock-shaped expression, or None (None for
+    an Attribute whose receiver the tables cannot type — the caller
+    reports that as a finding)."""
+    name = _lockish(expr)
+    if name is None:
+        return None
+    if isinstance(expr, ast.Attribute):
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            cls = m.owner_of_attr(owner_class, expr.attr) \
+                if owner_class else owner_class
+            return f"{m.mod}::{cls}.{expr.attr}" if cls \
+                else f"{m.mod}::{expr.attr}"
+        if isinstance(recv, ast.Name):
+            typed = RECEIVER_TYPES.get(m.mod, {}).get(recv.id) \
+                or GLOBAL_RECEIVERS.get(recv.id)
+            if typed:
+                tmod, tcls = typed
+                return f"{tmod}::{tcls}.{expr.attr}"
+        return None  # unresolvable receiver: caller reports
+    return f"{m.mod}::{name}"
+
+
+def _is_lock_ctor(call: ast.Call) -> str | None:
+    n = base.call_name(call)
+    if n in ("Lock", "make_lock"):
+        return "lock"
+    if n in ("RLock", "make_rlock"):
+        return "rlock"
+    return None
+
+
+def _recv_of(call: ast.Call):
+    return call.func.value if isinstance(call.func, ast.Attribute) else None
+
+
+def _recv_name(call: ast.Call) -> str | None:
+    r = _recv_of(call)
+    if isinstance(r, ast.Name):
+        return r.id
+    if isinstance(r, ast.Attribute):
+        return r.attr
+    return None
+
+
+def _is_blocking_call(call: ast.Call) -> str | None:
+    """A human-readable reason this call blocks, or None."""
+    name = base.call_name(call)
+    if name is None or name == "acquire":
+        return None  # acquires are graph edges, not convoy findings
+    kwargs = {kw.arg for kw in call.keywords}
+    if kwargs & _DEADLINE_KWARGS:
+        return f"{name}(...{sorted(kwargs & _DEADLINE_KWARGS)[0]}=...)"
+    if name == "join":
+        recv = _recv_of(call)
+        if isinstance(recv, ast.Constant) or (
+                isinstance(recv, ast.Attribute) and recv.attr == "path") \
+                or (isinstance(recv, ast.Name) and recv.id in ("os", "path")):
+            return None  # str.join / os.path.join
+        if call.args and isinstance(call.args[0],
+                                    (ast.GeneratorExp, ast.ListComp)):
+            return None  # "sep".join(generator) spelled via a variable
+        return "join()"
+    if name in BLOCKING_NAMES:
+        return f"{name}()"
+    rn = _recv_name(call)
+    if name in ("run", "check_call", "check_output", "call") \
+            and rn == "subprocess":
+        return f"subprocess.{name}()"
+    if name in STORE_RPCS and rn is not None \
+            and ("client" in rn.lower() or rn.lower() == "store"):
+        return f"store RPC {rn}.{name}()"
+    if isinstance(call.func, ast.Attribute) and name in SURFACE_BLOCKING \
+            and rn != "self":
+        return f"blocking-surface verb {name}()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# whole-program analysis
+
+
+class _Program:
+    """Parsed modules + converged function summaries + the lock graph."""
+
+    def __init__(self, paths: list):
+        self.modules: dict = {}
+        self.funcs: dict = {}              # id(node) -> _Func
+        self.method_index: dict = {}       # (mod, class, name) -> _Func
+        self.problems: list = []
+        self.used_allow: set = set()
+        self.used_hold: set = set()
+        self.edges: dict = {}              # (A, B) -> (path, lineno)
+        self.wild: dict = {}               # lock node -> (path, lineno)
+        self.lock_kinds: dict = {}
+        self.attr_types: dict = {}  # (mod, cls, attr) -> (labels, cls)
+        #                             or "ambiguous" (dynamic dispatch)
+        # module labels are basenames for readability, but two targets
+        # with the same basename (obs/trace.py vs. trace.py) must not
+        # shadow each other in the modules map — a shadowed module
+        # would silently vanish from the whole analysis. Ambiguous
+        # basenames get dir-qualified labels on BOTH sides.
+        counts: dict = {}
+        for p in paths:
+            counts[modlabel(p)] = counts.get(modlabel(p), 0) + 1
+        for p in paths:
+            label = modlabel(p)
+            if counts[label] > 1:
+                label = (os.path.basename(os.path.dirname(str(p)))
+                         + "/" + os.path.basename(str(p)))
+            try:
+                m = _Module(p, label)
+            except SyntaxError as e:
+                self.problems.append(f"{p}:{e.lineno}: unparsable: {e.msg}")
+                continue
+            self.modules[m.mod] = m
+        for m in self.modules.values():
+            self._collect_assigns(m)
+        for m in self.modules.values():
+            for qual, node, owner in m.functions:
+                f = _Func(m.mod, owner, qual, node)
+                self.funcs[id(node)] = f
+                if owner is not None:
+                    self.method_index.setdefault(
+                        (m.mod, owner, node.name), f)
+        for m in self.modules.values():
+            self._direct_facts(m)
+        self._fixpoint()
+
+    # -- construction-site scan (lock kinds + attr ownership) -------------
+    def _collect_assigns(self, m: _Module):
+        for qual, node, owner in m.functions:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and base.is_self_attr(sub.targets[0]) \
+                        and owner is not None:
+                    m.assigns.add((owner, sub.targets[0].attr))
+                    if isinstance(sub.value, ast.Call):
+                        kind = _is_lock_ctor(sub.value)
+                        if kind:
+                            nid = f"{m.mod}::{owner}.{sub.targets[0].attr}"
+                            self.lock_kinds[nid] = kind
+                        else:
+                            self._type_attr(m, owner,
+                                            sub.targets[0].attr, sub.value)
+        for stmt in m.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Call):
+                kind = _is_lock_ctor(stmt.value)
+                if kind:
+                    self.lock_kinds[f"{m.mod}::{stmt.targets[0].id}"] = kind
+
+    def _type_attr(self, m: _Module, owner: str, attr: str, call: ast.Call):
+        """Type ``self.<attr>`` from its construction site (``self._x =
+        SomeClass(...)``) so method calls THROUGH the attribute resolve
+        into the right class. An attr constructed through anything the
+        resolver cannot name (``_PLANES[plane]()``), or constructed as
+        two different types on different paths, is AMBIGUOUS: calls on
+        it are dynamically dispatched and must go WILD, not invisible —
+        invisibility here is how a held lock's real successors vanish
+        from the graph (the witness caught exactly that on
+        ``ProcessGroup._channels_lock``)."""
+        ctor = call.func
+        typed = None
+        if isinstance(ctor, ast.Name):
+            if ctor.id in m.classes:
+                typed = ([m.mod], ctor.id)
+            elif ctor.id in m.import_classes:
+                typed = m.import_classes[ctor.id]
+        elif isinstance(ctor, ast.Attribute) \
+                and isinstance(ctor.value, ast.Name) \
+                and ctor.value.id in m.import_mods:
+            typed = (m.import_mods[ctor.value.id], ctor.attr)
+        key = (m.mod, owner, attr)
+        prev = self.attr_types.get(key)
+        if typed is None or (prev is not None and prev != typed):
+            self.attr_types[key] = "ambiguous"
+        elif prev is None:
+            self.attr_types[key] = typed
+
+    # -- call resolution ---------------------------------------------------
+    def _resolve(self, m: _Module, f: _Func, call: ast.Call):
+        """-> ("func", _Func) | ("wild", label) | None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if (f.owner, fn.id) in m.by_name:
+                return ("func", self.funcs[id(m.by_name[(f.owner, fn.id)])])
+            if (None, fn.id) in m.by_name:
+                return ("func", self.funcs[id(m.by_name[(None, fn.id)])])
+            if fn.id in f.params:
+                return ("wild", f"{fn.id}()")
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        recv = fn.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if f.owner is not None:
+                for c in m.mro(f.owner):
+                    hit = self.method_index.get((m.mod, c, fn.attr))
+                    if hit is not None:
+                        return ("func", hit)
+            if (f.owner, fn.attr) in m.by_name:
+                return ("func", self.funcs[id(m.by_name[(f.owner, fn.attr)])])
+            # a stored callback (self._hook(...)): unbindable statically
+            if (f.owner, fn.attr) in m.assigns:
+                return ("wild", f"self.{fn.attr}()")
+            return None
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and f.owner is not None:
+            # a method call THROUGH a stored object (self._net.open_lane):
+            # resolve via the attr's construction-site type; a type the
+            # sites cannot pin down is dynamic dispatch -> WILD
+            for c in m.mro(f.owner):
+                t = self.attr_types.get((m.mod, c, recv.attr))
+                if t is None:
+                    continue
+                if t == "ambiguous":
+                    return ("wild", f"self.{recv.attr}.{fn.attr}()")
+                tmods, tcls = t
+                for tmod in tmods:
+                    tm = self.modules.get(tmod)
+                    if tm is None:
+                        continue
+                    for cc in tm.mro(tcls):
+                        hit = self.method_index.get((tmod, cc, fn.attr))
+                        if hit is not None:
+                            return ("func", hit)
+                # typed, but the method is not statically findable in
+                # the class (a wrapper's __getattr__, a mixin defined
+                # elsewhere) — still dynamic from where we stand
+                return ("wild", f"self.{recv.attr}.{fn.attr}()")
+            return None
+        rname = recv.id if isinstance(recv, ast.Name) else None
+        typed = (RECEIVER_TYPES.get(m.mod, {}).get(rname)
+                 or GLOBAL_RECEIVERS.get(rname)) if rname else None
+        if typed:
+            tmod, tcls = typed
+            tm = self.modules.get(tmod)
+            if tm is not None:
+                for c in tm.mro(tcls):
+                    hit = self.method_index.get((tmod, c, fn.attr))
+                    if hit is not None:
+                        return ("func", hit)
+        return None
+
+    # -- direct per-function facts ----------------------------------------
+    def _direct_facts(self, m: _Module):
+        for qual, node, owner in m.functions:
+            f = self.funcs[id(node)]
+            own_body = [s for s in ast.walk(node)
+                        if self._owning_fn(m, s) is node]
+            for sub in own_body:
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        nid = _lock_node(m, item.context_expr, owner)
+                        if nid:
+                            f.acquires.add(nid)
+                        elif base.lock_name_of(item.context_expr):
+                            self._receiver_problem(m, f, item.context_expr)
+                if isinstance(sub, ast.Call):
+                    if base.call_name(sub) == "acquire" \
+                            and isinstance(sub.func, ast.Attribute):
+                        nid = _lock_node(m, sub.func.value, owner)
+                        if nid:
+                            f.acquires.add(nid)
+                    why = _is_blocking_call(sub)
+                    if why:
+                        f.blocks = True
+                        f.block_sites.append((sub.lineno, why))
+                    got = self._resolve(m, f, sub)
+                    if got is None:
+                        continue
+                    kind, val = got
+                    if kind == "wild":
+                        f.wild = True
+                        f.wild_sites.append((sub.lineno, val))
+                    else:
+                        f.callees.append(val)
+
+    def _owning_fn(self, m: _Module, node):
+        for anc in base.ancestors(node, m.parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc
+        return None
+
+    # -- transitive closure ------------------------------------------------
+    def _fixpoint(self):
+        changed = True
+        while changed:
+            changed = False
+            for f in self.funcs.values():
+                for c in f.callees:
+                    if not f.acquires >= c.acquires:
+                        f.acquires |= c.acquires
+                        changed = True
+                    if c.blocks and not f.blocks:
+                        f.blocks = True
+                        changed = True
+                    if c.wild and not f.wild:
+                        f.wild = True
+                        changed = True
+
+    def _receiver_problem(self, m: _Module, f: _Func, expr):
+        key = f"{m.mod}::{f.qual}"
+        if key in ALLOW:
+            self.used_allow.add(key)
+            return
+        self.problems.append(
+            f"{m.path}:{expr.lineno}: cannot type the lock receiver in "
+            f"'with {ast.unparse(expr)}:' ({f.qual}) — declare it in "
+            f"locks.RECEIVER_TYPES so the graph stays honest")
+
+    # -- hold regions: edges + rule (b) ------------------------------------
+    def analyze_holds(self):
+        for m in self.modules.values():
+            for qual, node, owner in m.functions:
+                f = self.funcs[id(node)]
+                self._holds_in(m, f)
+
+    def _region_nodes(self, m, fn_node, start):
+        """Nodes of ``fn_node``'s own body (not nested defs) inside the
+        hold region ``start`` (a With body list, or a lineno floor)."""
+        if isinstance(start, list):
+            pool = [s for b in start for s in ast.walk(b)]
+        else:
+            pool = [s for s in ast.walk(fn_node)
+                    if getattr(s, "lineno", start) > start]
+        return [s for s in pool if self._owning_fn(m, s) is fn_node]
+
+    def _holds_in(self, m: _Module, f: _Func):
+        regions = []   # (lock node, region nodes, lineno)
+        for sub in ast.walk(f.node):
+            if self._owning_fn(m, sub) is not f.node:
+                continue
+            if isinstance(sub, ast.With):
+                held = []
+                for item in sub.items:
+                    nid = _lock_node(m, item.context_expr, f.owner)
+                    if nid:
+                        for prior in held:
+                            self._edge(prior, nid, m.path, sub.lineno)
+                        held.append(nid)
+                for nid in held:
+                    regions.append((nid, sub.body, sub.lineno))
+            elif isinstance(sub, ast.Call) \
+                    and base.call_name(sub) == "acquire" \
+                    and isinstance(sub.func, ast.Attribute):
+                nid = _lock_node(m, sub.func.value, f.owner)
+                if nid is None:
+                    continue
+                kwargs = {kw.arg for kw in sub.keywords}
+                # a try-lock (blocking=False) cannot hang a waiter, so
+                # rule (c) does not apply — but a SUCCESSFUL try-lock
+                # still opens a hold region (the witness caught
+                # _p2p_service_lock's region vanishing here), so the
+                # region is built either way
+                regions.append((nid, sub.lineno, sub.lineno))
+                if "blocking" not in kwargs:
+                    self._check_untimed(m, f, sub, nid)
+        for held, start, lineno in regions:
+            for s in self._region_nodes(m, f.node, start):
+                if isinstance(s, ast.With):
+                    for item in s.items:
+                        nid = _lock_node(m, item.context_expr, f.owner)
+                        if nid:
+                            self._edge(held, nid, m.path, s.lineno)
+                if not isinstance(s, ast.Call):
+                    continue
+                if base.call_name(s) == "acquire" \
+                        and isinstance(s.func, ast.Attribute):
+                    nid = _lock_node(m, s.func.value, f.owner)
+                    if nid:
+                        self._edge(held, nid, m.path, s.lineno)
+                why = _is_blocking_call(s)
+                if why:
+                    self._hold_block(m, held, s.lineno, why)
+                got = self._resolve(m, f, s)
+                if got is None:
+                    continue
+                kind, val = got
+                if kind == "wild":
+                    self.wild.setdefault(held, (m.path, s.lineno))
+                    self._hold_block(
+                        m, held, s.lineno,
+                        f"dynamically-dispatched {val} (the static graph "
+                        f"cannot bound it)")
+                else:
+                    for acq in val.acquires:
+                        self._edge(held, acq, m.path, s.lineno)
+                    if val.blocks:
+                        where = val.block_sites[0] if val.block_sites \
+                            else (s.lineno, "a blocking call")
+                        self._hold_block(
+                            m, held, s.lineno,
+                            f"{base.call_name(s)}() which reaches "
+                            f"{where[1]} (line {where[0]} of its def)")
+                    if val.wild:
+                        self.wild.setdefault(held, (m.path, s.lineno))
+
+    def _edge(self, a: str, b: str, path: str, lineno: int):
+        if a == b:
+            if self.lock_kinds.get(a) == "rlock":
+                return  # reentrant re-acquire: legal by construction
+            self.problems.append(
+                f"{path}:{lineno}: {a} is re-acquired while already held "
+                f"— self-deadlock on a non-reentrant lock")
+            return
+        self.edges.setdefault((a, b), (path, lineno))
+
+    def _hold_block(self, m: _Module, held: str, lineno: int, why: str):
+        if held in HOLD_ALLOW:
+            self.used_hold.add(held)
+            return
+        self.problems.append(
+            f"{m.path}:{lineno}: {why} while holding {held} — a convoy: "
+            f"move the call outside the lock or declare the lock in "
+            f"locks.HOLD_ALLOW with the reason the hold is the design")
+
+    def _check_untimed(self, m: _Module, f: _Func, call: ast.Call, nid):
+        kwargs = {kw.arg for kw in call.keywords}
+        if "timeout" in kwargs or "blocking" in kwargs or call.args:
+            return
+        if not (f.params & set(DEADLINE_PARAMS)):
+            return
+        key = f"{m.mod}::{f.qual}"
+        if key in ALLOW:
+            self.used_allow.add(key)
+            return
+        self.problems.append(
+            f"{m.path}:{call.lineno}: {nid}.acquire() without a timeout "
+            f"inside deadline-carrying {f.qual}({', '.join(sorted(f.params & set(DEADLINE_PARAMS)))}) "
+            f"— the promise a deadline makes dies here")
+
+    # -- rule (a): cycles --------------------------------------------------
+    def find_cycles(self):
+        graph: dict = {}
+        for a, b in self.edges:
+            graph.setdefault(a, set()).add(b)
+        index: dict = {}
+        low: dict = {}
+        on: set = set()
+        stack: list = []
+        sccs: list = []
+        counter = [0]
+
+        def strong(v):
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in graph.get(v, ()):
+                if w not in index:
+                    strong(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+        for v in list(graph):
+            if v not in index:
+                strong(v)
+        for comp in sccs:
+            a = comp[0]
+            b = next(x for x in comp if (a, x) in self.edges)
+            path, lineno = self.edges[(a, b)]
+            self.problems.append(
+                f"{path}:{lineno}: lock-order cycle among "
+                f"{{{', '.join(comp)}}} — a deadlock waiting for the "
+                f"right interleaving; pick ONE order and fix the "
+                f"back-edge")
+        return sccs
+
+
+def analyze_paths(paths: list):
+    """(problems, graph) over ``paths`` — the full machinery, reusable on
+    fixture files. graph = {"edges": {(a, b)}, "wild": {lock, ...}}."""
+    prog = _Program(paths)
+    prog.analyze_holds()
+    prog.find_cycles()
+    return prog.problems, {"edges": set(prog.edges),
+                           "wild": set(prog.wild)}, prog
+
+
+def build_graph():
+    """The repo's static lock graph — the witness test's reference. An
+    observed runtime edge (A, B) is statically explained iff (A, B) is
+    an edge or A is WILD (held across a dynamically-dispatched call)."""
+    _problems, graph, _prog = analyze_paths(TARGETS)
+    return graph
+
+
+def check_source(src: str, path: str = "<fixture>") -> list[str]:
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, os.path.basename(path) if path != "<fixture>"
+                         else "fixture.py")
+        with open(p, "w") as fp:
+            fp.write(src)
+        problems, _graph, _prog = analyze_paths([p])
+    return problems
+
+
+SELFTEST_BAD = """
+import threading
+
+class Chassis:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._client = object()
+
+    def one(self):
+        with self._a_lock:
+            self.take_b()
+
+    def take_b(self):
+        with self._b_lock:
+            pass
+
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+
+    def convoy(self):
+        with self._a_lock:
+            self._client.get("k", 5.0)
+
+    def untimed(self, timeout_s):
+        self._a_lock.acquire()
+"""
+
+
+def selftest() -> int:
+    """The machinery must see the planted cycle/convoy/untimed-acquire in
+    SELFTEST_BAD — a pass that cannot fail its own fixture proves
+    nothing about the tree."""
+    problems = check_source(SELFTEST_BAD, "selftest_locks.py")
+    assert any("cycle" in p for p in problems), problems
+    assert any("convoy" in p for p in problems), problems
+    assert any("without a timeout" in p for p in problems), problems
+    return 0
+
+
+def run() -> list[str]:
+    selftest()
+    prog = _Program(TARGETS)
+    prog.analyze_holds()
+    prog.find_cycles()
+    problems = list(prog.problems)
+    problems += base.allow_reason_problems(ALLOW, NAME)
+    problems += base.allow_reason_problems(HOLD_ALLOW, NAME)
+    problems += base.allow_stale_problems(ALLOW, prog.used_allow, NAME)
+    problems += base.allow_stale_problems(HOLD_ALLOW, prog.used_hold, NAME)
+    known = {modlabel(t) for t in TARGETS}
+    for key in list(ALLOW) + list(HOLD_ALLOW):
+        if key.partition("::")[0] not in known:
+            problems.append(f"{NAME}: ALLOW entry {key!r} names an "
+                            f"unknown module")
+    return problems
+
+
+def main() -> int:
+    problems = run()
+    for p in problems:
+        print(p)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

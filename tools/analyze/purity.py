@@ -125,14 +125,17 @@ def selftest() -> int:
     return 0
 
 
-def run() -> list[str]:
+def run(target_files: list | None = None) -> list[str]:
     problems = []
     used: set = set()
-    for path in TARGETS:
+    targets = TARGETS if target_files is None else \
+        [t for t in TARGETS if t in target_files]
+    for path in targets:
         problems += check_file(path)
-    problems += base.allow_reason_problems(ALLOW, NAME)
-    problems += base.allow_unknown_file_problems(ALLOW, TARGETS, NAME)
-    problems += base.allow_stale_problems(ALLOW, used, NAME)
+    if target_files is None:  # hygiene is a whole-surface property
+        problems += base.allow_reason_problems(ALLOW, NAME)
+        problems += base.allow_unknown_file_problems(ALLOW, TARGETS, NAME)
+        problems += base.allow_stale_problems(ALLOW, used, NAME)
     return problems
 
 
