@@ -751,7 +751,8 @@ def _trace_summary(pg, collective: str) -> dict:
 
 def worker(args) -> int:
     from rocnrdma_tpu import distributed as dist
-    from rocnrdma_tpu.metrics import STORE, VERBS, WIRE
+    from rocnrdma_tpu.metrics import CONF, STORE, VERBS, WIRE
+    from rocnrdma_tpu.obs import conformance as _conformance
 
     node_of = ([int(v) for v in args.node_map.split(",")]
                if args.node_map else None)
@@ -806,6 +807,7 @@ def worker(args) -> int:
             wire_base = WIRE.snapshot()
             verb_base = VERBS.snapshot()
             store_base = STORE.snapshot()
+            conf_base = CONF.snapshot()
             spans = []
             for _ in range(args.repeats):
                 pg.barrier()
@@ -868,6 +870,12 @@ def worker(args) -> int:
                 ragged = (counts.tolist()
                           if collective in ("allgatherv", "reducescatterv")
                           else None)
+                # the model-conformance block (ISSUE 19): this sweep
+                # point's own predicted-vs-measured cells (windowed,
+                # like every gated counter — the warmup's joins stay
+                # out), so a GB/s slide is attributable to "the model
+                # stopped predicting this bucket" right on the record
+                conf_delta = CONF.delta(conf_base)
                 records.append(M.BenchRecord.measure(
                     "bench_host", collective, algo, pg.world_size, actual,
                     "float32", sec, platform=f"host-{args.plane}",
@@ -875,6 +883,8 @@ def worker(args) -> int:
                     spread=[round(spread_gb[0], 4), round(spread_gb[-1], 4)],
                     wire=wire, verb_lat=VERBS.delta(verb_base),
                     store=store, fleet=fleet,
+                    conf={"cells": _conformance.summarize(conf_delta),
+                          "aux": conf_delta.get("aux", {})},
                     trace=_trace_summary(pg, collective)))
     pg.barrier()
     pg.destroy()

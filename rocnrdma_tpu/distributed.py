@@ -39,11 +39,14 @@ import numpy as np
 
 from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import (
+    CONF as _CONF,
     STORE as _STORE_OPS,
     VERBS as _VERB_LAT,
     WIRE as _WIRE,
+    ConformanceCounters,
 )
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
+from rocnrdma_tpu.obs import conformance as _conformance
 from rocnrdma_tpu.obs import fleet as _fleet
 from rocnrdma_tpu.obs import trace as _trace
 from rocnrdma_tpu.transport import (
@@ -657,9 +660,17 @@ class ChannelHandle:
                     # the pick reads THIS plane's committed wire model
                     # (ISSUE 12's consolidation: the coalescer and the
                     # frame picks share one fitted alpha/beta source)
+                    model = getattr(self._pg._net, "wire_model", None)
                     nbytes = _tuner.pick_bucket_bytes(
-                        self._pg.world_size,
-                        model=getattr(self._pg._net, "wire_model", None))
+                        self._pg.world_size, model=model)
+                    # verdict-only conformance coverage (ISSUE 19):
+                    # bucket sizing runs at coalescer construction,
+                    # outside any op span — counted, never ratioed
+                    _conformance.note_pick(
+                        getattr(model, "plane", "?"), "bucket",
+                        size_key=nbytes, world=self._pg.world_size,
+                        version=getattr(model, "version", None),
+                        sched=f"{nbytes // 1024}K")
                 self._coalescer = _coalesce.Coalescer(
                     self, nbytes, self._bucket_timeout_s)
             return self._coalescer
@@ -1259,6 +1270,11 @@ class ProcessGroup:
                 size_key = max(x.size * (i + 1) // n - x.size * i // n
                                for i in range(n)) * x.dtype.itemsize
             name = model.pick_codec(size_key, x.dtype.itemsize, world=n)
+            # verdict-only conformance coverage (ISSUE 19): the codec
+            # arbitration's verdict, recorded where it resolves
+            _conformance.note_pick(
+                model.plane, "codec", size_key=size_key, world=n,
+                version=model.version, sched=name or "off")
             if name is None:
                 return x, None
         codec = _codec.get(name)
@@ -1529,6 +1545,13 @@ class ProcessGroup:
                 intra=_tuner.host_wire_model(self._intra_plane),
                 credit_bytes=lane.credit_bytes
                 if lane is not None else None, verb=verb)
+            # verdict-only conformance coverage (ISSUE 19): the hier
+            # arbitration's verdict on the flat plane's model — the
+            # chosen schedule's stream prices itself downstream
+            _conformance.note_pick(
+                model.plane, "algorithm", size_key=x.nbytes,
+                world=self.world_size, version=model.version,
+                sched=algorithm)
         if self._node_of is not None or algorithm == "hier":
             _WIRE.algorithm_picked(algorithm)
         return algorithm
@@ -1985,10 +2008,24 @@ class ProcessGroup:
             # between here and the commit drops the pending proposal
             # AND invalidates the base token on every rank
             base = model.propose(params, "tune_wire")
-            proposal = (params.to_dict(), base, shares)
+            # the refit TRIGGER signal (ISSUE 19): rank 0's merged
+            # conformance table names every (plane, verb, size-bucket)
+            # cell whose median predicted/measured ratio left the
+            # committed band — computed once here and broadcast with
+            # the proposal, so every rank records the identical
+            # tuner-drift events (TUNERLOG replay-equality holds)
+            drift = _conformance.drift_report()
+            proposal = (params.to_dict(), base, shares, drift)
         if self.world_size > 1:
             proposal = self.broadcast_object(proposal, src=0)
-        params_d, base, shares = proposal
+        params_d, base, shares, drift = proposal
+        for cell, ratio in drift:
+            # the drifted plane+bucket, named in the TUNERLOG event
+            # stream (the cell key is "plane|verb|lgK"; the ratio is
+            # timing-shaped and stays off the structural projection)
+            _FLIGHT.record("tuner-drift", plane=cell.split("|", 1)[0],
+                           bucket=cell, epoch=self.epoch,
+                           version=model.version)
         new = model.commit(
             _tuner.PlaneParams.from_dict(params_d), base,
             note="tune_wire: " + ",".join(
@@ -2000,6 +2037,9 @@ class ProcessGroup:
             self.barrier(timeout_s=t)
         out = model.block()
         out["committed"] = new is not None
+        # the trigger's verdict on the returned block: which cells
+        # demanded this refit (empty = a routine window-driven refit)
+        out["drift"] = [[cell, ratio] for cell, ratio in drift]
         return out
 
     def _stall_shares(self, timeout_s: float) -> dict:
@@ -4342,6 +4382,52 @@ class ProcessGroup:
                                               uncovered)],
             self.epoch)
         return _fleet._assemble(digest, self.epoch, members)
+
+    def conformance_stats(self, timeout_s: float = 5.0,
+                          flat: bool = False) -> dict:
+        """The LIVE model-conformance view (ISSUE 19): every rank's
+        predicted-vs-measured cells (``metrics.CONF``, joined by
+        ``obs.conformance`` at op commit), merged EXACTLY across the
+        fleet — the same O(log n) tree-root read with per-rank
+        fallback as :meth:`fleet_stats` (``flat=True`` forces the
+        per-rank read), the same epoch fencing, the same bounded
+        ``timeout_s``. Returns the summarized table plus the drifting
+        cell keys and the worst offender (``top`` names the plane and
+        size bucket a refit should look at — the same cells
+        :meth:`tune_wire`'s trigger fires on)."""
+        if self._standby is not None:
+            raise RuntimeError(
+                "conformance_stats: this rank is a standby (promotion "
+                "pending); it has no membership to aggregate over")
+        deadline = time.monotonic() + timeout_s
+        root = None if flat else self._tree_root_digest(deadline)
+        covers = (set(root.get("covers", ()))
+                  if root is not None else set())
+        members = list(self._ranks)
+        me = members[self.rank] if members else -1
+        uncovered = [m for m in members if m not in covers]
+        snaps: list = ([self._fleet_agent.local_snapshot()]
+                       if me in uncovered or not members else [])
+        snaps += self._fetch_member_snapshots(
+            max(0.0, deadline - time.monotonic()),
+            origs=[m for m in uncovered if m != me])
+        digest = _fleet.merge_digests(
+            [root, _fleet.digest_of_snapshots(snaps, self.epoch,
+                                              uncovered)],
+            self.epoch)
+        conf = digest.get("conf_totals") or {"cells": {}, "aux": {}}
+        summary = _conformance.summarize(conf)
+        top = _conformance.top_drift(summary)
+        return {
+            "epoch": self.epoch,
+            "members": members,
+            "cells": conf.get("cells", {}),
+            "aux": conf.get("aux", {}),
+            "summary": summary,
+            "drift": [k for k, v in summary.items() if v["drift"]],
+            "top": ({"cell": top[0], "p50_ratio": top[1]["p50_ratio"],
+                     "n": top[1]["n"]} if top else None),
+        }
 
     def _tree_root_digest(self, deadline: float):
         """The telemetry tree's root subtree digest for THIS epoch, or

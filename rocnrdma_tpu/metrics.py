@@ -728,6 +728,248 @@ def bucket_percentile_us(buckets: dict, q: float) -> int:
 VERBS = VerbLatencies()
 
 
+class ConformanceCounters:
+    """Model-conformance cells: predicted-vs-measured cost for the pure
+    pick surface (ISSUE 19).
+
+    Every committed-model pick (tuner frame/depth, codec, algorithm,
+    bucket size, exchange-fold) predicts a cost; the trace op span
+    measures a wall. ``obs.conformance`` joins the two at collective
+    COMMIT (aborted attempts never join) and calls :meth:`joined` —
+    one observation per (plane, op). Cells are keyed
+    ``"{plane}|{verb}|lg{k}"`` with ``k`` the floor-log2 of the pick's
+    size_key, so a drifting model names its plane AND size regime.
+
+    Exact-merge discipline (the WIRE/VERBS contract): every merged
+    field is an integer count, an integer sum, an integer-keyed
+    histogram, or a min/max extreme — all associative — so
+    tree-merged equals flat-merged bit-for-bit on every cell
+    (``tests/test_fleettree.py`` pins it). The predicted/measured
+    RATIO is never stored as a float: each join lands one tick in the
+    quarter-octave log2 histogram ``q_hist`` (``q = round(4 *
+    log2(pred/meas))``), and P50/worst ratios are READ OFF the merged
+    histogram (:meth:`p50_ratio`/:meth:`worst_ratio`) — the same
+    read-off-the-merged-buckets honesty as the fleet verb P99s.
+
+    Digest hygiene (the chaos replay contract): ``n``/``picks``/
+    ``pred_us``/``vers``/``sched`` are STRUCTURAL — pure functions of
+    the seed's committed-op sequence and the committed model version —
+    and :meth:`structural` projects exactly them for the replay
+    digests. ``meas_us``/``q_hist``/``q_min``/``q_max`` carry wall
+    clock and stay timing-shaped (digest-excluded, like every wall
+    field in ``obs.trace``). ``aux`` counts pick events with no
+    joinable cost (bucket-size picks outside any op span, unsampled
+    ops' picks) — kept next to the cells but outside every digest.
+
+    Same lock discipline as every shared counter here; producers are
+    the op-span commit hook, consumers window with snapshot()/delta()
+    and merge cross-rank with :meth:`merge`.
+    """
+
+    Q_SCALE = 4    # quarter-octave log2 ratio resolution
+    Q_CLAMP = 64   # |q| cap: ratios beyond 2**16 collapse to the rim
+
+    def __init__(self):
+        self._lock = _lockwitness.make_lock(
+            "metrics.py::ConformanceCounters._lock")
+        self._cells: dict[str, dict] = {}
+        self._aux: dict[str, int] = {}
+
+    @staticmethod
+    def cell_key(plane, verb, size_key: int) -> str:
+        """THE cell identity: plane, verb, floor-log2 size bucket."""
+        n = max(1, int(size_key))
+        return f"{plane}|{verb}|lg{n.bit_length() - 1}"
+
+    @classmethod
+    def quantize(cls, pred_us: int, meas_us: int) -> int:
+        """The ratio tick one join lands: ``round(4 * log2(p/m))``,
+        clamped — 0 is perfect conformance, +4 is the model predicting
+        2x the measured cost, -4 half of it."""
+        q = round(cls.Q_SCALE * math.log2(max(1, pred_us)
+                                          / max(1, meas_us)))
+        return max(-cls.Q_CLAMP, min(cls.Q_CLAMP, q))
+
+    def joined(self, plane, verb, size_key: int, predicted_s: float,
+               measured_s: float, version, picks: int = 1,
+               sched: str | None = None) -> None:
+        """Record one committed join: a plane's summed predicted cost
+        for an op against the op span's measured wall. ``picks`` is
+        how many pick notes the join folded (structural); ``sched``
+        labels the picked schedule (e.g. ``"256K/d3"``)."""
+        key = self.cell_key(plane, verb, size_key)
+        pred_us = max(1, round(predicted_s * 1e6))
+        meas_us = max(1, round(measured_s * 1e6))
+        q = self.quantize(pred_us, meas_us)
+        with self._lock:
+            c = self._cells.get(key)
+            if c is None:
+                c = self._cells[key] = {
+                    "n": 0, "picks": 0, "pred_us": 0, "meas_us": 0,
+                    "q_min": q, "q_max": q, "q_hist": {}, "vers": {},
+                    "sched": {}}
+            c["n"] += 1
+            c["picks"] += picks
+            c["pred_us"] += pred_us
+            c["meas_us"] += meas_us
+            c["q_min"] = min(c["q_min"], q)
+            c["q_max"] = max(c["q_max"], q)
+            qk = str(q)
+            c["q_hist"][qk] = c["q_hist"].get(qk, 0) + 1
+            vk = str(version)
+            c["vers"][vk] = c["vers"].get(vk, 0) + 1
+            if sched is not None:
+                c["sched"][sched] = c["sched"].get(sched, 0) + 1
+
+    def noted(self, plane, kind: str, n: int = 1) -> None:
+        """Record a pick event with no joinable cost (an auxiliary
+        pick — bucket sizing, a codec/algorithm verdict outside any
+        sampled span). Kept for coverage accounting, outside every
+        digest."""
+        key = f"{plane}|{kind}"
+        with self._lock:
+            self._aux[key] = self._aux.get(key, 0) + n
+
+    def snapshot(self) -> dict:
+        """``{"cells": {key: cell}, "aux": {key: n}}`` — plain
+        JSON-able data (the fleet-snapshot / wire_stats format)."""
+        with self._lock:
+            return {"cells": {k: {f: (dict(v) if isinstance(v, dict)
+                                      else v) for f, v in c.items()}
+                              for k, c in self._cells.items()},
+                    "aux": dict(self._aux)}
+
+    def delta(self, since: dict | None) -> dict:
+        """Cell movement since a ``snapshot()`` (the bench window):
+        counts/sums/histograms subtract key-wise, unmoved cells drop;
+        the ``q_min``/``q_max`` extremes are cumulative (a window's
+        own extremes are not recoverable from two snapshots) and keep
+        their current values."""
+        return self.delta_of(self.snapshot(), since)
+
+    @staticmethod
+    def delta_of(cur: dict, since: dict | None) -> dict:
+        if since is None:
+            return cur
+        out_cells: dict = {}
+        base_cells = since.get("cells", {})
+        for k, c in cur.get("cells", {}).items():
+            b = base_cells.get(k, {})
+            n = c.get("n", 0) - b.get("n", 0)
+            if n <= 0 and c.get("picks", 0) <= b.get("picks", 0):
+                continue
+            cell = {"n": n,
+                    "picks": c.get("picks", 0) - b.get("picks", 0),
+                    "pred_us": c.get("pred_us", 0) - b.get("pred_us", 0),
+                    "meas_us": c.get("meas_us", 0) - b.get("meas_us", 0),
+                    "q_min": c.get("q_min", 0), "q_max": c.get("q_max", 0)}
+            for f in ("q_hist", "vers", "sched"):
+                bd = b.get(f, {})
+                cell[f] = {lbl: nn - bd.get(lbl, 0)
+                           for lbl, nn in c.get(f, {}).items()
+                           if nn - bd.get(lbl, 0)}
+            out_cells[k] = cell
+        base_aux = since.get("aux", {})
+        aux = {k: n - base_aux.get(k, 0)
+               for k, n in cur.get("aux", {}).items()
+               if n - base_aux.get(k, 0)}
+        return {"cells": out_cells, "aux": aux}
+
+    @staticmethod
+    def merge(snapshots) -> dict:
+        """Cross-rank merge of ``snapshot()``/``delta()`` dicts: cells
+        key-wise, counts and integer-µs sums by exact addition, ratio
+        histograms bucket-wise, extremes by min/max — every operator
+        associative, so any tree of merges equals the flat merge
+        bit-for-bit (the output's key order is sorted at every level
+        for the same reason)."""
+        cells: dict = {}
+        aux: dict = {}
+        for s in snapshots:
+            if not isinstance(s, dict):
+                continue
+            for k, c in s.get("cells", {}).items():
+                m = cells.get(k)
+                if m is None:
+                    m = cells[k] = {"n": 0, "picks": 0, "pred_us": 0,
+                                    "meas_us": 0, "q_min": None,
+                                    "q_max": None, "q_hist": {},
+                                    "vers": {}, "sched": {}}
+                for f in ("n", "picks", "pred_us", "meas_us"):
+                    m[f] += c.get(f, 0)
+                qn, qx = c.get("q_min", 0), c.get("q_max", 0)
+                m["q_min"] = qn if m["q_min"] is None \
+                    else min(m["q_min"], qn)
+                m["q_max"] = qx if m["q_max"] is None \
+                    else max(m["q_max"], qx)
+                for f in ("q_hist", "vers", "sched"):
+                    d = m[f]
+                    for lbl, nn in c.get(f, {}).items():
+                        d[lbl] = d.get(lbl, 0) + nn
+            for k, nn in s.get("aux", {}).items():
+                aux[k] = aux.get(k, 0) + nn
+        for m in cells.values():
+            m["q_hist"] = dict(sorted(m["q_hist"].items(),
+                                      key=lambda kv: int(kv[0])))
+            m["vers"] = dict(sorted(m["vers"].items()))
+            m["sched"] = dict(sorted(m["sched"].items()))
+        return {"cells": dict(sorted(cells.items())),
+                "aux": dict(sorted(aux.items()))}
+
+    @classmethod
+    def p50_ratio(cls, cell: dict) -> float:
+        """The cell's median predicted/measured ratio, read off the
+        merged quarter-octave histogram (1.0 = the model was right;
+        0.5 = the wire took twice the predicted time)."""
+        hist = cell.get("q_hist", {})
+        total = sum(hist.values())
+        if total <= 0:
+            return 1.0
+        want = 0.5 * total
+        seen = 0
+        for qk, n in sorted(hist.items(), key=lambda kv: int(kv[0])):
+            seen += n
+            if seen >= want:
+                return round(2.0 ** (int(qk) / cls.Q_SCALE), 4)
+        raise AssertionError("unreachable: seen reaches total")
+
+    @classmethod
+    def worst_ratio(cls, cell: dict) -> float:
+        """The cell's worst-conformance ratio: the merged extreme
+        (q_min or q_max) furthest from perfect."""
+        qn, qx = cell.get("q_min"), cell.get("q_max")
+        if qn is None or qx is None:
+            return 1.0
+        q = qn if abs(qn) >= abs(qx) else qx
+        return round(2.0 ** (q / cls.Q_SCALE), 4)
+
+    @staticmethod
+    def structural(snap: dict) -> dict:
+        """The digest-covered projection: per-cell sample counts at
+        commit, pick counts, the integer predicted-µs sum, the model-
+        version split, and the picked-schedule split — every field a
+        pure function of the seed's committed-op sequence. Walls,
+        ratio histograms, and the aux table are timing-shaped and
+        excluded (the FLEET/TRACELOG hygiene the chaos suite pins)."""
+        cells = snap.get("cells", {}) if isinstance(snap, dict) else {}
+        return {k: {"n": c.get("n", 0), "picks": c.get("picks", 0),
+                    "pred_us": c.get("pred_us", 0),
+                    "vers": dict(sorted(c.get("vers", {}).items())),
+                    "sched": dict(sorted(c.get("sched", {}).items()))}
+                for k, c in sorted(cells.items())}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cells = {}
+            self._aux = {}
+
+
+# THE process-wide conformance table (same one-per-rank-process scoping
+# as WIRE/VERBS above); obs.conformance's commit-side join observes
+# into it.
+CONF = ConformanceCounters()
+
+
 @dataclasses.dataclass
 class FaultCounters:
     """Named fault-event counters — the chaos-plane telemetry row.

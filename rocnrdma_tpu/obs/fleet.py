@@ -80,15 +80,18 @@ import time
 
 from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import (
+    CONF as _CONF,
     STORE as _STORE,
     VERBS as _VERBS,
     WIRE as _WIRE,
+    ConformanceCounters,
     StoreCounters,
     VerbLatencies,
     WireCounters,
     bucket_percentile_us,
 )
 from rocnrdma_tpu.obs.recorder import FLIGHT as _FLIGHT
+from rocnrdma_tpu.obs import conformance as _conformance
 from rocnrdma_tpu.obs import trace as _trace
 
 # the coarse per-rank health states the fleet plane reports. Transitions
@@ -293,6 +296,11 @@ class FleetAgent:
             "evasion": (pg.evasion_state()
                         if hasattr(pg, "evasion_state")
                         else {"armed": False}),
+            # model-conformance cells (ISSUE 19): predicted-vs-measured
+            # cost per (plane, verb, size bucket) — cumulative, so the
+            # tree's exact merge (ConformanceCounters.merge) holds the
+            # same cross-rank totals the flat read would
+            "conf": _CONF.snapshot(),
         }
 
     def publish(self, client, timeout_s: float = 1.0) -> bool:
@@ -480,6 +488,10 @@ def condense_rank(s: dict) -> dict:
         # every rank of a generation carries the same flagged sets,
         # so any one row can label the whole membership
         "evasion": s.get("evasion", {"armed": False}),
+        # this rank's worst out-of-band conformance ratio (ISSUE 19;
+        # None = conformant) — a pure function of the snapshot, so
+        # every aggregation path derives the identical row value
+        "drift": _conformance.rank_drift(s.get("conf")),
     }
 
 
@@ -528,6 +540,12 @@ def digest_of_snapshots(snapshots, epoch: int, members) -> dict:
         "store_totals": StoreCounters.merge(
             [s["store"] for s in ordered if isinstance(s.get("store"),
                                                        dict)]),
+        # the conformance cells' exact cross-rank merge (ISSUE 19):
+        # integer sums / bucket-wise histograms / min-max extremes —
+        # associative, so tree-merged == flat-merged on every cell
+        "conf_totals": ConformanceCounters.merge(
+            [s["conf"] for s in ordered if isinstance(s.get("conf"),
+                                                      dict)]),
         "rows": {str(s["orig"]): condense_rank(s) for s in ordered},
         "trace": traces,
     }
@@ -542,7 +560,7 @@ def merge_digests(digests, epoch: int) -> dict:
     a rank's counters would corrupt the exact totals the fence
     exists to protect)."""
     rows: dict[str, dict] = {}
-    wire, verbs, store, traces = [], [], [], []
+    wire, verbs, store, confs, traces = [], [], [], [], []
     covers: set = set()
     stale = 0
     for d in digests:
@@ -565,6 +583,7 @@ def merge_digests(digests, epoch: int) -> dict:
         wire.append(d.get("wire_totals", {}))
         verbs.append(d.get("verb_latency", {}))
         store.append(d.get("store_totals", {}))
+        confs.append(d.get("conf_totals", {}))
         traces.extend(d.get("trace", []))
     return {
         "v": 1,
@@ -574,6 +593,7 @@ def merge_digests(digests, epoch: int) -> dict:
         "wire_totals": WireCounters.merge(wire),
         "verb_latency": VerbLatencies.merge(verbs),
         "store_totals": StoreCounters.merge(store),
+        "conf_totals": ConformanceCounters.merge(confs),
         "rows": rows,
         "trace": traces,
     }
@@ -630,6 +650,9 @@ def _assemble(digest: dict, epoch: int, members: list) -> dict:
             # critical chain, P = slot proactively re-crewed by a
             # promoted spare, '-' = armed and clean, None = not armed
             "evade": evade,
+            # per-rank model drift (ISSUE 19): the rank's worst
+            # out-of-band P50 predicted/measured ratio, None conformant
+            "drift": r.get("drift"),
         }
     return {
         "epoch": epoch,
@@ -649,6 +672,12 @@ def _assemble(digest: dict, epoch: int, members: list) -> dict:
         "verb_p50_us": p50,
         "verb_p99_us": p99,
         "worst_p99_us": worst_p99,
+        # the fleet-level conformance table (ISSUE 19): the exactly-
+        # merged cells plus the drifting cell keys — what the
+        # conformance CLI and ProcessGroup.conformance_stats() read
+        "conf_totals": digest.get("conf_totals", {}),
+        "conf_drift": [k for k, v in _conformance.summarize(
+            digest.get("conf_totals", {})).items() if v["drift"]],
         "ranks": ranks,
     }
 
@@ -732,12 +761,15 @@ def format_fleet(snap: dict) -> str:
                                         {}).items()))
             or "(none)"),
     ]
+    if snap.get("conf_drift"):
+        lines.append("  conf-drift: " + " ".join(snap["conf_drift"]))
     hdr = (f"  {'orig':>5} {'rank':>5} {'health':>9} {'GB/s':>8} "
            f"{'p99(us)':>8} {'algo':>6} {'codec':>6} {'evade':>6} "
-           f"{'flight':>12}")
+           f"{'drift':>7} {'flight':>12}")
     lines += [hdr, "  " + "-" * (len(hdr) - 2)]
     for o in sorted(snap["ranks"], key=int):
         r = snap["ranks"][o]
+        drift = r.get("drift")
         lines.append(
             f"  {o:>5} {r['rank']:>5} {r['health']:>9} {r['GBps']:>8.3f} "
             f"{r['p99_us']:>8} "
@@ -749,6 +781,9 @@ def format_fleet(snap: dict) -> str:
             # the per-rank evasion flag (ISSUE 16): R reshaped,
             # P proactively re-crewed, '-' armed+clean, 'off' unarmed
             f"{r.get('evade') or 'off':>6} "
+            # the per-rank model drift (ISSUE 19): the worst
+            # out-of-band P50 predicted/measured ratio, '-' conformant
+            f"{f'{drift:.2f}x' if drift is not None else '-':>7} "
             f"{r['flight_recorded']}/{r['flight_capacity']}")
     for verb in sorted(snap["verb_latency"]):
         m = snap["verb_latency"][verb]

@@ -112,7 +112,7 @@ _TLS = threading.local()
 
 class _OpCtx:
     __slots__ = ("epoch", "chan", "op", "verb", "rank", "members", "t0",
-                 "events")
+                 "events", "conf")
 
     def __init__(self, epoch, chan, op, verb, rank, members=1):
         self.epoch = epoch
@@ -123,6 +123,12 @@ class _OpCtx:
         self.members = members
         self.t0 = 0.0
         self.events: list = []
+        # the pure picks' conformance notes (obs.conformance): appended
+        # by note_pick under this span, joined against the measured
+        # wall at COMMIT only — an aborted attempt's notes die with
+        # the context, which is what keeps the conformance stream
+        # replay-pure on its structural half
+        self.conf: list | None = None
 
 
 @contextlib.contextmanager
@@ -269,6 +275,14 @@ def op_span(epoch: int, chan: int, op: int, verb: str, rank: int):
         wall = _span_close("trace-op", ctx.t0, epoch=epoch, chan=chan,
                            op=op)
         TRACE.push(_op_record(ctx, wall))
+        if ctx.conf:
+            # the conformance join (ISSUE 19): the op's pick notes meet
+            # the measured wall under the same stable identity, on the
+            # COMMIT path only — the abort path above re-raises past
+            # this, so aborted attempts never join. Lazy import: trace
+            # must stay importable without the conformance layer.
+            from rocnrdma_tpu.obs import conformance as _conf
+            _conf.join_commit(ctx, wall)
     finally:
         _TLS.op = None
 

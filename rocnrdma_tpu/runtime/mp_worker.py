@@ -101,6 +101,22 @@ Tasks:
   (the evade-* flight digest) and ``EVASTATE`` next to the usual
   FAULTLOG/HEALLOG/FLEET replay lines — all replay-equal per seed.
 
+- ``conformance-drift``: the model-conformance acceptance run (ISSUE
+  19): a ``ProcessGroup`` fleet (shm plane) where ``--fault-rank`` is
+  chronically DEGRADED (``degrade_rank``, slow-not-dead) so every
+  collective's measured wall departs the committed wire model's
+  prediction by orders of magnitude while the structural pick story
+  stays a pure function of the seed. Every rank runs ``--rounds``
+  bitwise-checked int64 allreduces with full tracing (the task sets
+  ``ROCNRDMA_TRACE_SAMPLE=1`` so every op's predicted/measured pair
+  joins), then calls ``tune_wire()`` — the drift trigger must name
+  the drifted plane+bucket in ``TUNERLOG`` identically on every rank
+  — and prints ``CONFSTATS`` (the fleet-merged drift verdict:
+  drifting cell keys + the worst offender) plus ``CONFLOG`` (the
+  sha256 of the STRUCTURAL conformance projection — counts, picks,
+  predicted cost, model versions; never measured walls or ratio
+  histograms — replay-equal across two same-seed runs).
+
 Every chaos task also prints a ``RINGFULL`` warning when the flight
 ring wrapped during the run (``flight-ring-saturated`` on the
 timeline): a wrapped ring may have evicted digest-relevant events, so
@@ -116,7 +132,7 @@ import sys
 import time
 
 CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective", "kill-and-heal",
-               "trace-delay", "evade-straggler")
+               "trace-delay", "evade-straggler", "conformance-drift")
 # tasks that drive BOTH planes: the host-plane chaos stack AND a real
 # jax coordination service (run_workers reserves a second port for it)
 DEVICE_TASKS = ("kill-a-host",)
@@ -500,7 +516,7 @@ def _tuner_log() -> str:
 
     from rocnrdma_tpu.obs import FLIGHT
     evs = [[kind, a.get("plane"), a.get("epoch"), a.get("version"),
-            a.get("dropped_pending")]
+            a.get("dropped_pending"), a.get("bucket")]
            for _, kind, a in FLIGHT.events()
            if kind.startswith("tuner-")]
     return json.dumps(evs, sort_keys=True)
@@ -1211,6 +1227,103 @@ def _evade_chaos_main(args) -> int:
     return status
 
 
+def _conf_chaos_main(args) -> int:
+    """The model-conformance acceptance task (module docstring:
+    ``conformance-drift``)."""
+    import hashlib
+    import json
+
+    import numpy as np
+
+    from rocnrdma_tpu import distributed as dist
+    from rocnrdma_tpu.metrics import CONF, ConformanceCounters
+    from rocnrdma_tpu.transport import bootstrap
+    from rocnrdma_tpu.transport.faults import FaultSchedule
+
+    rank, n = args.process_id, args.num_processes
+    # every op joins its predicted/measured pair — the drift estimator
+    # must see the full round sequence, not a 1-in-8 sample
+    os.environ["ROCNRDMA_TRACE_SAMPLE"] = "1"
+    server = None
+    if rank == 0:
+        host, port = args.coordinator.rsplit(":", 1)
+        server = bootstrap.BootstrapServer(n_ranks=n, port=int(port),
+                                           host=host)
+    # chronic slowness, not death: the victim's held receive completions
+    # serialize the ring, so every rank's measured allreduce wall departs
+    # the committed model's prediction by orders of magnitude while the
+    # structural story (picks, sizes, versions) stays seed-pure
+    sched = FaultSchedule(args.seed, rank)
+    sched.degrade_rank(args.fault_rank, factor=1000, after_ops=0)
+    status = 0
+    pg = None
+    try:
+        pg = dist.init_process_group(
+            rank=rank, world_size=n, store_handle=args.coordinator,
+            timeout_s=20.0, group_name=f"conf{args.seed}", plane="shm",
+            fault_schedule=sched)
+        for rnd in range(args.rounds):
+            local = _chaos_input(args.seed, rank, rnd, args.size)
+            got = pg.all_reduce(local, timeout_s=60.0)
+            want = _chaos_input(args.seed, 0, rnd, args.size)
+            for r in range(1, n):
+                want = want + _chaos_input(args.seed, r, rnd, args.size)
+            if not np.array_equal(got, want):
+                print(f"BAD-RESULT: round {rnd} not bitwise-correct",
+                      flush=True)
+                status = 5
+                break
+            pg.publish_telemetry()
+            pg.barrier()
+        if status == 0:
+            # the closed loop's refit trigger: the drift table rides the
+            # broadcast proposal, so every rank records the identical
+            # tuner-drift events naming the drifted plane+bucket
+            tuned = pg.tune_wire(timeout_s=60.0)
+            view = pg.conformance_stats(timeout_s=10.0)
+            print("CONFSTATS " + json.dumps(
+                {"drift": view["drift"], "top": view["top"]},
+                sort_keys=True), flush=True)
+            if rank == 0:
+                # the recorder's band material: the full fleet-merged
+                # per-cell summary (ratios included — a recorded
+                # measurement, like algbw; never digest material)
+                print("CONFCELLS " + json.dumps(view["summary"],
+                                                sort_keys=True),
+                      flush=True)
+            print("TUNED-DRIFT " + json.dumps(
+                sorted(c for c, _ in tuned.get("drift", []))), flush=True)
+            pg.destroy(graceful=True)
+    except (TimeoutError, OSError, RuntimeError) as e:
+        print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+        status = 4
+    finally:
+        # the replay half: the STRUCTURAL projection of this rank's own
+        # cells (counts, picks, predicted cost, versions — never measured
+        # walls or ratio histograms) digests equal across same-seed runs
+        struct = ConformanceCounters.structural(CONF.snapshot())
+        print("CONFLOG " + hashlib.sha256(json.dumps(
+            struct, sort_keys=True).encode()).hexdigest(), flush=True)
+        print(f"TUNERLOG {_tuner_log()}", flush=True)
+        print(f"FAULTS {sched.counters.to_json()}", flush=True)
+        print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        from rocnrdma_tpu.obs import trace as _obs_trace
+        print(f"TRACELOG {_obs_trace.digest(_obs_trace.TRACE.snapshot())}",
+              flush=True)
+        _print_fleet(pg)
+        _print_ringfull()
+        if pg is not None:
+            try:
+                pg.destroy(graceful=False)
+            except (OSError, TimeoutError):
+                pass
+        if server is not None:
+            if status == 0:
+                server.wait_idle(timeout_s=5.0)
+            server.close()
+    return status
+
+
 def _witnessed(code: int) -> int:
     """Flush this worker's observed lock-acquisition edges the moment
     the chaos task's verdict is known (``ROCNRDMA_LOCK_WITNESS_OUT``;
@@ -1310,6 +1423,8 @@ def main(argv=None) -> int:
         return _witnessed(_trace_chaos_main(args))  # host plane only: no jax
     if args.task == "evade-straggler":
         return _witnessed(_evade_chaos_main(args))  # host plane only: no jax
+    if args.task == "conformance-drift":
+        return _witnessed(_conf_chaos_main(args))  # host plane only: no jax
     if args.task in CHAOS_TASKS:
         return _witnessed(_chaos_main(args))  # host plane: no jax, no devices
 

@@ -43,6 +43,7 @@ import numpy as np
 from rocnrdma_tpu import lockwitness as _lockwitness
 from rocnrdma_tpu.metrics import VERBS as _VERB_LAT, WIRE as _WIRE
 from rocnrdma_tpu.obs import FLIGHT as _FLIGHT, postmortem as _postmortem
+from rocnrdma_tpu.obs import conformance as _conformance
 from rocnrdma_tpu.obs import trace as _trace
 from rocnrdma_tpu.transport import codec as _wire_codec
 from rocnrdma_tpu.transport import lanes as _lanes
@@ -1422,6 +1423,13 @@ class _RingWire:
             name = self._model.pick_codec(
                 int(size_key), np.dtype(dtype).itemsize,
                 world=self.world or 2)
+            # verdict-only conformance note: the codec pick's cost
+            # rides the stream's priced note; here only the verdict
+            # coverage is recorded
+            _conformance.note_pick(
+                self._model.plane, "codec", size_key=int(size_key),
+                world=self.world or 2, version=self._model.version,
+                sched=name or "off")
             if name is None:
                 return None
         return _codec.get(name)
@@ -1634,6 +1642,17 @@ class _RingWire:
         _WIRE.negotiated(
             shown_frame if shown_frame is not None else self.frame, 1,
             shown.version if shown is not None else None)
+        if shown is not None:
+            # the conformance note for the non-streaming hop: one hop
+            # of the larger direction at the (path-preserved) frame,
+            # depth 1 — the schedule this path actually runs
+            nb = max(in_nbytes, len(out))
+            _conformance.note_pick(
+                self._model.plane, "exchange", size_key=nb,
+                world=self.world or 2, version=shown.version,
+                sched=f"{(shown_frame or self.frame) // 1024}K/d1",
+                predicted_s=self._model.hop_time(
+                    nb, shown_frame or self.frame, 1))
         got = np.empty(in_nbytes, np.uint8)
         # queue all chunked irecvs — landing straight in ``got`` on
         # recv_into-capable nets — then the isends, then drain; the plugin
@@ -1792,6 +1811,19 @@ class _RingWire:
         _trace.record("stream-start", hops=H, frame=frame, depth=depth,
                       up=up, down=down,
                       codec=codec.name if codec is not None else None)
+        if pick is not None:
+            # the conformance note (ISSUE 19): what the committed model
+            # PREDICTED this stream would cost — H hops at the picked
+            # (frame, depth), priced by the same hop formula the pick
+            # minimized — recorded against the op span so the measured
+            # wall can judge the model at commit. One thread-local
+            # read on unsampled ops; never a copy, never store traffic.
+            _conformance.note_pick(
+                self._model.plane, "stream", size_key=size_key,
+                world=self.world or 2, version=pick.version,
+                sched=f"{frame // 1024}K/d{depth}",
+                predicted_s=H * self._model.hop_time(size_key, frame,
+                                                     depth))
         hop_nos = [next(self._hops) for _ in range(H)]
         pending = collections.deque()  # posted recv Requests, arrival order
         send_pump = getattr(self.send_comm, "_pump", None)
@@ -1997,8 +2029,16 @@ def exchange_fold_preferred(model, nbytes: int,
 
 
 def _prefer_exchange_fold(wire: "_RingWire", nbytes: int) -> bool:
-    return exchange_fold_preferred(wire._model, nbytes,
-                                   wire._lane_credit())
+    verdict = exchange_fold_preferred(wire._model, nbytes,
+                                      wire._lane_credit())
+    if wire._model is not None:
+        # verdict-only conformance note (no priced cost — the chosen
+        # schedule's stream prices itself at its own pick site)
+        _conformance.note_pick(
+            wire._model.plane, "xfold", size_key=nbytes,
+            world=2, version=wire._model.version,
+            sched="fold" if verdict else "ring")
+    return verdict
 
 
 def allreduce_size_key(model, elems: int, itemsize: int, n: int,

@@ -772,6 +772,104 @@ def test_obs_telemetry_rule_covers_the_repo_fleet_module():
 
 
 # ---------------------------------------------------------------------------
+# pass #4 conformance rule (ISSUE 19): the conformance module's store
+# ops inherit the telemetry contract verbatim, and every PUBLIC
+# blocking entry (accepts timeout_s) records a conf-* flight event and
+# guarantees a conf-* record-and-reraise on abort
+# ---------------------------------------------------------------------------
+
+_CONF_GOOD = textwrap.dedent("""
+    def read_conformance(store_handle, group="default", timeout_s=5.0):
+        _FLIGHT.record("conf-read", group=group)
+        try:
+            raw = client.try_get("pg/g/fleet/e0/0", timeout_s=timeout_s)
+            return raw
+        except BaseException as e:
+            _FLIGHT.record("conf-abort", op="read", error=type(e).__name__)
+            raise
+""")
+
+
+def test_obs_accepts_evented_conformance_entry():
+    assert obs.check_conformance_source(_CONF_GOOD,
+                                        "conformance.py") == []
+
+
+def test_obs_flags_conformance_entry_without_abort_handler():
+    # the entry event alone is half the contract: a read dying inside
+    # the tree walk must still land on the timeline
+    src = textwrap.dedent("""
+        def read_conformance(store_handle, timeout_s=5.0):
+            _FLIGHT.record("conf-read")
+            return client.try_get("k", timeout_s=timeout_s)
+    """)
+    problems = obs.check_conformance_source(src, "conformance.py")
+    assert len(problems) == 1, problems
+    assert "guarantees no conf-* abort flight event" in problems[0]
+
+
+def test_obs_flags_conformance_entry_without_any_event():
+    src = textwrap.dedent("""
+        def read_conformance(store_handle, timeout_s=5.0):
+            return client.try_get("k", timeout_s=timeout_s)
+    """)
+    problems = obs.check_conformance_source(src, "conformance.py")
+    assert len(problems) == 2, problems
+    assert any("records no conf-* flight event" in p for p in problems)
+    assert any("guarantees no conf-* abort" in p for p in problems)
+
+
+def test_obs_conformance_rule_scopes_to_public_blocking_entries():
+    # private helpers and non-blocking functions stay out of scope; a
+    # non-conf marker does not satisfy the prefix requirement
+    src = textwrap.dedent("""
+        def _walk(store_handle, timeout_s=5.0):
+            return client.try_get("k", timeout_s=timeout_s)
+
+        def summarize(conf):
+            return dict(conf)
+    """)
+    assert obs.check_conformance_source(src, "conformance.py") == []
+    wrong = textwrap.dedent("""
+        def read_conformance(store_handle, timeout_s=5.0):
+            _FLIGHT.record("fleet-read")
+            try:
+                return client.try_get("k", timeout_s=timeout_s)
+            except BaseException as e:
+                _FLIGHT.record("fleet-abort", error=type(e).__name__)
+                raise
+    """)
+    problems = obs.check_conformance_source(wrong, "conformance.py")
+    assert len(problems) == 2, problems
+
+
+def test_obs_conformance_rule_inherits_telemetry_contract():
+    # the telemetry half rides along verbatim: an unbounded store
+    # write inside the conformance module is the same blind spot it
+    # is in the fleet module
+    src = textwrap.dedent("""
+        def read_conformance(store_handle, timeout_s=5.0):
+            _FLIGHT.record("conf-read")
+            try:
+                client.set("k", "{}")
+                return True
+            except BaseException as e:
+                _FLIGHT.record("conf-abort", error=type(e).__name__)
+                raise
+    """)
+    problems = obs.check_conformance_source(src, "conformance.py")
+    assert len(problems) == 1, problems
+    assert "no explicit timeout_s" in problems[0]
+
+
+def test_obs_conformance_rule_covers_the_repo_module():
+    # the repo surface itself complies (run() == [] pins it); sanity-
+    # check the target and the event prefix the rule keys on
+    assert obs.CONFORMANCE_FILE == "rocnrdma_tpu/obs/conformance.py"
+    assert obs.CONF_EVENT_PREFIX == "conf-"
+
+
+# ---------------------------------------------------------------------------
 # pass #0 extension (PR 6): the elastic PG surface is on the named
 # blocking list — grow/wait_promotion must accept timeout_s
 # ---------------------------------------------------------------------------

@@ -1,0 +1,453 @@
+"""Model-conformance telemetry (ISSUE 19): the quarter-octave ratio
+cells' exact-merge discipline, the pick-note/commit-join contract
+(aborted attempts never join — the structural half stays replay-pure),
+the drift verdicts and the refit trigger they feed, the rank-less CLI
+riding the fleet tree — and THE acceptance run: a 3-rank shm fleet
+with one chronically degraded member whose measured walls depart the
+committed model by orders of magnitude, the estimator naming the
+drifting plane+bucket, ``tune_wire`` consuming it as the refit
+trigger, and two same-seed runs digest-equal on every replay line
+with conformance ON."""
+
+import json
+import re
+
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.metrics import CONF, ConformanceCounters
+from rocnrdma_tpu.obs import conformance
+from rocnrdma_tpu.obs import fleet
+from rocnrdma_tpu.obs import trace
+from rocnrdma_tpu.transport import bootstrap
+from tools import simfleet
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable")
+
+
+# ---------------------------------------------------------------------------
+# the cells: identity, quantization, exact merge
+# ---------------------------------------------------------------------------
+
+
+def test_cell_key_names_plane_verb_and_log2_bucket():
+    assert ConformanceCounters.cell_key("shm", "allreduce", 4096) \
+        == "shm|allreduce|lg12"
+    assert ConformanceCounters.cell_key("tcp", "broadcast", 8191) \
+        == "tcp|broadcast|lg12"
+    assert ConformanceCounters.cell_key("tcp", "broadcast", 8192) \
+        == "tcp|broadcast|lg13"
+    # degenerate size keys collapse to the lg0 bucket, never crash
+    assert ConformanceCounters.cell_key("shm", "p2p", 0) == "shm|p2p|lg0"
+    assert ConformanceCounters.cell_key("shm", "p2p", 1) == "shm|p2p|lg0"
+
+
+def test_quantize_quarter_octave_resolution_and_clamp():
+    q = ConformanceCounters.quantize
+    assert q(100, 100) == 0          # perfect conformance
+    assert q(200, 100) == 4          # predicted 2x the measured: +4
+    assert q(100, 200) == -4
+    assert q(119, 100) == 1          # quarter-octave resolution
+    # ratios beyond 2**16 collapse to the rim, never overflow the hist
+    assert q(1, 10 ** 9) == -ConformanceCounters.Q_CLAMP
+    assert q(10 ** 9, 1) == ConformanceCounters.Q_CLAMP
+    assert q(0, 0) == 0              # zeros floor to 1us, not a crash
+
+
+def test_joined_snapshot_shape_and_structural_projection():
+    """The digest-hygiene pin: ``structural()`` projects EXACTLY the
+    seed-pure fields — walls, ratio histograms, extremes, and the aux
+    table are timing-shaped and must never reach a replay digest."""
+    c = ConformanceCounters()
+    c.joined("shm", "allreduce", 4096, 0.001, 0.002, version=3,
+             picks=2, sched="2048K/d2")
+    c.joined("shm", "allreduce", 4096, 0.001, 0.001, version=3)
+    c.noted("shm", "bucket")
+    snap = c.snapshot()
+    cell = snap["cells"]["shm|allreduce|lg12"]
+    assert cell["n"] == 2 and cell["picks"] == 3
+    assert cell["pred_us"] == 2000 and cell["meas_us"] == 3000
+    assert cell["q_hist"] == {"-4": 1, "0": 1}
+    assert cell["q_min"] == -4 and cell["q_max"] == 0
+    assert cell["vers"] == {"3": 2}
+    assert cell["sched"] == {"2048K/d2": 1}
+    assert snap["aux"] == {"shm|bucket": 1}
+    struct = ConformanceCounters.structural(snap)
+    assert set(struct) == {"shm|allreduce|lg12"}
+    assert set(struct["shm|allreduce|lg12"]) \
+        == {"n", "picks", "pred_us", "vers", "sched"}, \
+        "walls/ratios leaked into the structural (digest) projection"
+
+
+def _rand_counter(rng, planes=("shm", "tcp"), joins=12):
+    c = ConformanceCounters()
+    for _ in range(joins):
+        c.joined(rng.choice(planes), rng.choice(("allreduce", "bcast")),
+                 rng.choice((512, 4096, 1 << 17)),
+                 rng.uniform(1e-5, 1e-2), rng.uniform(1e-5, 1e-2),
+                 version=rng.randrange(3),
+                 picks=rng.randrange(1, 4),
+                 sched=rng.choice(("256K/d3", "2048K/d2", None)))
+    if rng.random() < 0.7:
+        c.noted(rng.choice(planes), "bucket", n=rng.randrange(1, 5))
+    return c.snapshot()
+
+
+def test_merge_tree_equals_flat_and_is_associative():
+    """The fleet-tree exactness contract on randomized corpora: any
+    merge tree equals the flat merge bit-for-bit (integer sums,
+    bucket-wise histograms, min/max extremes — no float ever merged)."""
+    import random
+    for seed in range(5):
+        rng = random.Random(seed)
+        snaps = [_rand_counter(rng) for _ in range(9)]
+        flat = ConformanceCounters.merge(snaps)
+        m = ConformanceCounters.merge
+        pairwise = m([m(snaps[0:3]), m(snaps[3:6]), m(snaps[6:9])])
+        lopsided = m([m([m(snaps[:8]), snaps[8]])])
+        assert json.dumps(pairwise, sort_keys=True) \
+            == json.dumps(flat, sort_keys=True)
+        assert json.dumps(lopsided, sort_keys=True) \
+            == json.dumps(flat, sort_keys=True)
+        assert flat["cells"], "corpus synthesized no cells"
+
+
+def test_delta_windowing_drops_unmoved_cells():
+    c = ConformanceCounters()
+    c.joined("shm", "allreduce", 4096, 0.001, 0.001, version=1)
+    c.noted("shm", "bucket")
+    base = c.snapshot()
+    d = c.delta(base)
+    assert d["cells"] == {} and d["aux"] == {}
+    c.joined("shm", "allreduce", 4096, 0.002, 0.001, version=2)
+    c.joined("tcp", "bcast", 512, 0.001, 0.001, version=1)
+    d = c.delta(base)
+    assert set(d["cells"]) == {"shm|allreduce|lg12", "tcp|bcast|lg9"}
+    moved = d["cells"]["shm|allreduce|lg12"]
+    assert moved["n"] == 1 and moved["pred_us"] == 2000
+    assert moved["vers"] == {"2": 1}      # unmoved version keys drop
+    assert d["aux"] == {}                  # unmoved aux drops too
+
+
+def test_ratio_readoff_p50_and_worst():
+    cell = {"q_hist": {"0": 1, "4": 2}, "q_min": -8, "q_max": 4}
+    # total 3, median falls in the +4 bucket: 2**(4/4) = 2.0
+    assert ConformanceCounters.p50_ratio(cell) == 2.0
+    # the extreme furthest from perfect wins: |-8| >= |4| -> 2**-2
+    assert ConformanceCounters.worst_ratio(cell) == 0.25
+    assert ConformanceCounters.p50_ratio({"q_hist": {}}) == 1.0
+    assert ConformanceCounters.worst_ratio({}) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# the pick-note / commit-join contract (rides obs.trace.op_span)
+# ---------------------------------------------------------------------------
+
+
+def test_note_pick_outside_any_span_degrades_to_aux():
+    base = CONF.snapshot()
+    conformance.note_pick("shm", "bucket", size_key=1 << 20,
+                          predicted_s=0.001)
+    d = CONF.delta(base)
+    assert d["cells"] == {}, "an un-joinable pick invented a wall"
+    assert d["aux"] == {"shm|bucket": 1}
+
+
+def test_notes_join_measured_wall_at_commit(monkeypatch):
+    monkeypatch.setenv("ROCNRDMA_TRACE_SAMPLE", "1")
+    base = CONF.snapshot()
+    with trace.op_span(0, 0, 8, "allreduce", 0) as ctx:
+        assert ctx is not None
+        conformance.note_pick("shm", "stream", size_key=4096, world=2,
+                              version=1, sched="256K/d3",
+                              predicted_s=0.001)
+        conformance.note_pick("shm", "xfold", size_key=512, world=2,
+                              version=1, predicted_s=0.0005)
+        # a verdict-only pick (no priced cost) counts as coverage,
+        # never pollutes the ratio cells
+        conformance.note_pick("shm", "codec", predicted_s=None)
+    d = CONF.delta(base)
+    assert d["aux"] == {"shm|codec": 1}
+    assert set(d["cells"]) == {"shm|allreduce|lg12"}
+    cell = d["cells"]["shm|allreduce|lg12"]
+    # the two priced notes folded into ONE join: summed prediction,
+    # pick count 2, the max size_key as the bucket, the last sched kept
+    assert cell["n"] == 1 and cell["picks"] == 2
+    assert cell["pred_us"] == 1500
+    assert cell["vers"] == {"1": 1}
+    assert cell["sched"] == {"256K/d3": 1}
+
+
+def test_aborted_attempt_never_joins(monkeypatch):
+    monkeypatch.setenv("ROCNRDMA_TRACE_SAMPLE", "1")
+    base = CONF.snapshot()
+    with pytest.raises(RuntimeError):
+        with trace.op_span(0, 0, 8, "allreduce", 0):
+            conformance.note_pick("shm", "stream", size_key=4096,
+                                  version=1, predicted_s=0.001)
+            raise RuntimeError("mid-collective death")
+    d = CONF.delta(base)
+    assert d["cells"] == {} and d["aux"] == {}, \
+        "an aborted attempt's notes joined — the structural stream " \
+        "is no longer replay-pure"
+
+
+def test_unsampled_op_notes_degrade_to_aux(monkeypatch):
+    monkeypatch.setenv("ROCNRDMA_TRACE_SAMPLE", "0")
+    base = CONF.snapshot()
+    with trace.op_span(0, 0, 8, "allreduce", 0) as ctx:
+        assert ctx is None
+        conformance.note_pick("shm", "stream", size_key=4096,
+                              version=1, predicted_s=0.001)
+    d = CONF.delta(base)
+    assert d["cells"] == {} and d["aux"] == {"shm|stream": 1}
+
+
+# ---------------------------------------------------------------------------
+# drift verdicts: summarize / drift_report / top_drift / rank_drift
+# ---------------------------------------------------------------------------
+
+
+def _cell(q, n):
+    return {"n": n, "picks": n, "pred_us": 100 * n, "meas_us": 100 * n,
+            "q_min": q, "q_max": q, "q_hist": {str(q): n},
+            "vers": {"1": n}, "sched": {}}
+
+
+def test_summarize_band_verdict_and_min_samples():
+    conf = {"cells": {
+        "shm|allreduce|lg12": _cell(0, 5),       # conformant
+        "shm|allreduce|lg13": _cell(-24, 5),     # p50 2**-6: drifting
+        "tcp|bcast|lg9": _cell(-24, 2),          # too few joins: held
+    }}
+    s = conformance.summarize(conf)
+    assert not s["shm|allreduce|lg12"]["drift"]
+    assert s["shm|allreduce|lg13"]["drift"]
+    # ratios are read off the merged histogram, rounded to 4 places
+    assert s["shm|allreduce|lg13"]["p50_ratio"] == round(2.0 ** -6, 4)
+    assert not s["tcp|bcast|lg9"]["drift"], \
+        "a single outlier wall fired the trigger (MIN_SAMPLES)"
+    rep = conformance.drift_report(conf)
+    assert rep == [("shm|allreduce|lg13", round(2.0 ** -6, 4))]
+    top = conformance.top_drift(s)
+    assert top[0] == "shm|allreduce|lg13"
+    assert conformance.rank_drift(conf) == round(2.0 ** -6, 4)
+    assert conformance.rank_drift({"cells": {
+        "shm|allreduce|lg12": _cell(0, 5)}}) is None
+    assert conformance.rank_drift(None) is None
+
+
+def test_drift_report_orders_worst_departure_first():
+    conf = {"cells": {
+        "a|x|lg1": _cell(-12, 5),    # 2**-3
+        "b|y|lg1": _cell(20, 5),     # 2**5: further from 1.0
+    }}
+    rep = conformance.drift_report(conf)
+    assert [k for k, _ in rep] == ["b|y|lg1", "a|x|lg1"]
+
+
+def test_format_conformance_names_the_drift():
+    conf = {"cells": {"shm|allreduce|lg13": _cell(-24, 5)},
+            "aux": {"shm|bucket": 3}}
+    summary = conformance.summarize(conf)
+    top = conformance.top_drift(summary)
+    view = {"epoch": 0, "members": [0, 1], "cells": conf["cells"],
+            "aux": conf["aux"], "summary": summary,
+            "drift": [k for k, v in summary.items() if v["drift"]],
+            "top": {"cell": top[0], "p50_ratio": top[1]["p50_ratio"],
+                    "n": top[1]["n"]}}
+    text = conformance.format_conformance(view)
+    assert "shm|allreduce|lg13" in text and "DRIFT" in text
+    assert "aux picks: shm|bucket=3" in text
+    assert "drift: shm|allreduce|lg13" in text
+    empty = conformance.format_conformance(
+        {"epoch": 0, "members": [], "summary": {}, "aux": {}})
+    assert "drift: none" in empty
+
+
+# ---------------------------------------------------------------------------
+# the rank-less observer CLI (rides the fleet tree; O(log n) reads)
+# ---------------------------------------------------------------------------
+
+
+def _publish_conf_fleet(client, members, group, epoch=0, seed=3):
+    meta = json.dumps({"epoch": epoch, "members": list(members),
+                       "world": len(members), "group": group})
+    for orig in members:
+        client.set(fleet.snapshot_key(group, epoch, orig),
+                   json.dumps(simfleet.synth_snapshot(orig, epoch, 0,
+                                                      seed)))
+    client.set(fleet.meta_key(group), meta)
+
+
+@needs_native
+def test_cli_tree_read_matches_flat_and_json(capsys):
+    n = 4
+    members = list(range(n))
+    server = bootstrap.BootstrapServer(n_ranks=n)
+    client = bootstrap.BootstrapClient(server.handle, 0, timeout_s=5.0)
+    try:
+        _publish_conf_fleet(client, members, group="g19")
+        agent = fleet.NodeAgent(
+            simfleet._SimPG(0, members, [0] * n, 0, group="g19"),
+            fanout=2)
+        assert agent.tick(client, timeout_s=5.0)
+        views = {}
+        for name, flags in (("tree", []), ("flat", ["--flat"])):
+            rc = conformance.main(["--store", server.handle, "--group",
+                                   "g19", "--json"] + flags)
+            assert rc == 0
+            views[name] = json.loads(capsys.readouterr().out)
+        # the tree's root digest serves the SAME cells as the O(n)
+        # per-rank read — the exactness contract, end to end
+        assert views["tree"]["cells"] == views["flat"]["cells"]
+        assert views["tree"]["cells"], "synth fleet published no cells"
+        assert views["tree"]["summary"] == views["flat"]["summary"]
+        # the human rendering carries the same table
+        rc = conformance.main(["--store", server.handle, "--group",
+                               "g19"])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "conformance: epoch 0" in text
+        for key in views["tree"]["cells"]:
+            assert key in text
+    finally:
+        client.close()
+        server.close()
+
+
+def test_cli_errors_cleanly_when_nothing_published(capsys):
+    rc = conformance.main(["--store", "127.0.0.1:1", "--group", "nope",
+                           "--timeout", "0.2"])
+    assert rc == 1
+    assert "conformance:" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run (ISSUE 19): seeded drift, end to end, twice
+# ---------------------------------------------------------------------------
+
+
+def _line(result, key):
+    m = re.search(rf"^{key} (.+)$", result.stdout, re.M)
+    assert m, f"rank {result.process_id} printed no {key} line:\n" \
+              f"{result.stdout}\n{result.stderr}"
+    return m.group(1)
+
+
+@pytest.mark.chaos
+@needs_native
+def test_seeded_drift_names_its_cell_and_replays_digest_equal():
+    """3 ranks, rank 1 chronically degraded 1000x: every measured
+    allreduce wall departs the committed model's prediction, the
+    merged estimator names the drifting ``plane|verb|lgK`` cell on
+    EVERY rank identically, ``tune_wire`` consumes the drift table as
+    its refit trigger (a ``tuner-drift`` flight event names the same
+    cell), the bitwise oracle loses zero ops — and two same-seed runs
+    replay digest-equal on every structural line with conformance ON
+    (the digest-hygiene satellite: walls and ratio histograms stay
+    out of CONFLOG/TRACELOG/FLEET)."""
+    from rocnrdma_tpu.runtime.multiprocess import run_workers
+
+    n, seed = 3, 23
+    runs = [run_workers(n, "conformance-drift", timeout_s=240.0,
+                        fault_rank=1, seed=seed, rounds=6, size=4096)
+            for _ in range(2)]
+    for res in runs:
+        for r in res:
+            assert r.returncode == 0, \
+                f"rank {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert "BAD-RESULT" not in r.stdout      # zero lost ops
+            assert "CLEAN-ABORT" not in r.stdout
+        # every rank derives the identical fleet-merged drift verdict
+        stats = [json.loads(_line(r, "CONFSTATS")) for r in res]
+        assert stats.count(stats[0]) == n
+        drift = stats[0]["drift"]
+        assert drift, "the seeded degrade produced no drift verdict"
+        assert all(c.startswith("shm|") for c in drift)
+        assert any("|lg13" in c for c in drift), \
+            "the 4096-float allreduce bucket is not the named cell"
+        assert stats[0]["top"]["cell"] in drift
+        # the closed loop: the refit trigger fired on the same cells
+        for r in res:
+            assert json.loads(_line(r, "TUNED-DRIFT")) == sorted(drift)
+    # replay equality, per rank, across the two same-seed runs — the
+    # conformance stream's structural half (CONFLOG) next to every
+    # pre-existing replay line, with conformance ON the whole run
+    for key in ("CONFLOG", "FAULTLOG", "TUNERLOG", "TRACELOG", "FLEET"):
+        assert [_line(r, key) for r in runs[0]] == \
+            [_line(r, key) for r in runs[1]], key
+
+
+# ---------------------------------------------------------------------------
+# the sentinel ratchet: the committed results/conformance_r01.json
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_model_drift_ratchet():
+    import copy
+    import os
+
+    from tools import sentinel
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "results",
+                           "conformance_r01.json")) as fp:
+        doc = json.load(fp)
+    # the committed record self-diffs clean (the all-zero fixed point
+    # — also what check_model_drift() with no doc runs in tier-1)
+    assert sentinel.check_model_drift(current=doc) == []
+    assert sentinel.check_model_drift() == []
+    # the oracle bar is absolute: one lost op is a finding
+    bad = copy.deepcopy(doc)
+    bad["lost_ops"] = 1
+    findings = sentinel.check_model_drift(current=bad)
+    assert findings and any("conf_lost_ops" in f for f in findings)
+    # detection is absolute: the seeded scenario going quiet means the
+    # loop went BLIND — both halves (estimator and trigger) are named
+    blind = copy.deepcopy(doc)
+    blind["drift"] = []
+    blind["tuned_drift"] = []
+    findings = sentinel.check_model_drift(current=blind)
+    kinds = {f["conf_blind"] for f in findings if "conf_blind" in f}
+    assert kinds == {"estimator", "tune_wire trigger"}
+    cell = doc["floors"]["drift_cells"][0]
+    assert any(f["key"] == ("conformance", cell) for f in findings)
+    text = sentinel.format_findings(findings)
+    assert "went blind" in text and cell in text
+    # the per-cell median ratchets band-wise, naming plane+bucket
+    bad = copy.deepcopy(doc)
+    cell = next(iter(bad["cells"]))
+    bad["cells"][cell]["p50_ratio"] *= 2 * doc["floors"]["band_spread"]
+    findings = sentinel.check_model_drift(current=bad)
+    assert any("conf_p50" in f and f["key"] == ("conformance", cell)
+               for f in findings)
+    assert cell in sentinel.format_findings(findings)
+    # new cells are measurements, not regressions
+    grew = copy.deepcopy(doc)
+    grew["cells"]["tcp|bcast|lg20"] = {"p50_ratio": 1.0, "n": 9}
+    assert sentinel.check_model_drift(current=grew) == []
+
+
+def test_committed_conformance_record_schema():
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "results",
+                           "conformance_r01.json")) as fp:
+        doc = json.load(fp)
+    assert doc["task"] == "conformance-drift"
+    assert doc["lost_ops"] == 0 == doc["floors"]["lost_ops"]
+    # the committed drift names at least the degraded allreduce bucket,
+    # and the trigger fired on every committed drift cell
+    assert doc["floors"]["drift_cells"] == sorted(doc["drift"])
+    assert doc["drift"] and set(doc["drift"]) <= set(doc["tuned_drift"])
+    assert all(c in doc["cells"] for c in doc["drift"])
+    for cell, info in doc["cells"].items():
+        assert info["n"] >= 1 and info["p50_ratio"] > 0
+    assert doc["replay"] == {"runs": 2, "digests_equal": True}
+    # every launched process left its replay digests, every kind
+    assert sorted(doc["digests"]) == [str(i) for i in
+                                      range(doc["params"]["n"])]
+    for per_rank in doc["digests"].values():
+        assert set(per_rank) == {"conflog", "faultlog", "tunerlog"}

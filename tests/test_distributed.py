@@ -280,7 +280,9 @@ def test_p2p_slow_producer_respects_caller_timeout(sidecar_store):
             _t.sleep(12.0)  # beyond the old hard-coded Request.wait default
             pg.send(np.array([3.0], np.float32), dst=1)
             return None
-        return pg.recv(np.empty(1, np.float32), src=0, timeout_s=30.0)
+        # the deadline is pure slack past the producer's 12 s: generous,
+        # so a loaded 1-CPU container can't starve the wait into a flake
+        return pg.recv(np.empty(1, np.float32), src=0, timeout_s=120.0)
 
     res = _run_group(n, fn, store_handle=store.handle)
     np.testing.assert_array_equal(res[1], [3.0])
@@ -290,18 +292,23 @@ def test_p2p_recv_retry_after_timeout(sidecar_store):
     """Regression: a timed-out recv must be cleanly retryable — the seq
     counter only advances on success, so the retry re-posts the SAME wire
     tag the (late) sender eventually stamps."""
-    import time as _t
     n = 2
     store = sidecar_store(n)
+    timed_out = threading.Event()
 
     def fn(pg):
         if pg.rank == 0:
-            _t.sleep(4.0)
+            # send only AFTER the receiver's first wait has provably
+            # timed out — a fixed sleep raced the loaded container's
+            # scheduler (the frame could land inside the 1 s window and
+            # turn the expected TimeoutError into a flaky success)
+            assert timed_out.wait(timeout=60.0)
             pg.send(np.array([5.0], np.float32), dst=1)
             return None
         with pytest.raises(TimeoutError):
             pg.recv(np.empty(1, np.float32), src=0, timeout_s=1.0)
-        return pg.recv(np.empty(1, np.float32), src=0, timeout_s=30.0)
+        timed_out.set()
+        return pg.recv(np.empty(1, np.float32), src=0, timeout_s=60.0)
 
     res = _run_group(n, fn, store_handle=store.handle)
     np.testing.assert_array_equal(res[1], [5.0])
@@ -1135,7 +1142,12 @@ def test_self_heal_auto_retries_collective(sidecar_store):
         if pg.rank == 1:
             pg.stop_watchdog()  # heartbeat stops: reads as dead
             return "dead"
-        out1 = pg.all_reduce(xs[pg.rank], timeout_s=2.5)  # heals inside
+        # the deadline covers the WHOLE pipeline — abort, watchdog
+        # confirmation (1.0 s), heal, retry; 2.5 s flaked under tier-1
+        # load on a 1-CPU container, so the bound is container-sized
+        # (the functional contract — heals inside, epoch 1 commits —
+        # is unchanged; the watchdog window still gates confirmation)
+        out1 = pg.all_reduce(xs[pg.rank], timeout_s=15.0)  # heals inside
         assert pg.epoch == 1 and pg.last_op_epoch == 1
         assert pg.global_ranks == [0, 2]
         pg.stop_watchdog()

@@ -145,6 +145,13 @@ def test_tree_merge_equals_flat_merge_on_every_shape(seed):
         assert tree["verb_p99_us"] == flat["verb_p99_us"]
         assert tree["worst_p99_us"] == flat["worst_p99_us"]
         assert tree["ranks"] == flat["ranks"]
+        # the ISSUE-19 drift tables ride the same exactness contract:
+        # every conformance cell — counts, integer-µs sums, every
+        # quarter-octave ratio bucket, the min/max extremes, version
+        # and schedule histograms — is ==, not approx, on every shape
+        assert tree["conf_totals"] == flat["conf_totals"]
+        assert tree["conf_totals"]["cells"], "corpus synthesized no cells"
+        assert tree["conf_drift"] == flat["conf_drift"]
 
 
 def test_merge_digests_is_associative_and_fences():
@@ -157,17 +164,27 @@ def test_merge_digests_is_associative_and_fences():
     assert left["wire_totals"] == right["wire_totals"]
     assert left["covers"] == right["covers"] == list(range(12))
     assert left["rows"] == right["rows"]
-    # epoch fence: a stale digest is dropped whole and counted
+    # the drift tables associate the same way (merge sorts every level,
+    # so the dict comparison is an exact bucket-by-bucket claim)
+    assert left["conf_totals"] == right["conf_totals"]
+    assert left["conf_totals"]["cells"]
+    # epoch fence: a stale digest is dropped whole and counted — its
+    # conformance cells must vanish with it (a pre-heal rank's ratio
+    # ticks never blend into a post-heal drift verdict)
     stale = fleet.digest_of_snapshots(_corpus(2, seed=9, epoch=1),
                                       1, range(2))
     m = fleet.merge_digests([a, stale], 0)
     assert m["covers"] == [0, 1, 2, 3] and m["stale_dropped"] == 1
+    assert m["conf_totals"] == a["conf_totals"]
     # overlap fence: a digest re-covering merged ranks is dropped whole
-    # (double-counting a rank's counters would corrupt exact totals)
+    # (double-counting a rank's counters would corrupt exact totals —
+    # the conformance sums included: a double-counted cell would halve
+    # or double the apparent drift)
     dup = fleet.digest_of_snapshots(snaps[2:6], 0, range(2, 6))
     m = fleet.merge_digests([a, dup], 0)
     assert m["covers"] == [0, 1, 2, 3]
     assert m["wire_totals"] == a["wire_totals"]
+    assert m["conf_totals"] == a["conf_totals"]
     assert m["stale_dropped"] == 1
 
 

@@ -80,6 +80,21 @@ that records the abort marker and re-raises (the record-and-reraise
 shape of the abort-path invariant). A dangling span reads as a
 still-running collective to every consumer of the trace.
 
+**Conformance-read discipline (ISSUE 19).** The model-conformance
+module (``rocnrdma_tpu/obs/conformance.py``) is an observer of
+observers: its fleet read joins the telemetry tree from a rank-less
+CLI and from ``tune_wire``'s trigger path. Sixth invariant, two
+halves: (a) every store write/read there follows the PR-8 telemetry
+contract verbatim (explicit ``timeout_s``, no enclosing retry loop,
+record-and-absorb except) — the module rides the same store the
+heartbeat does, and one unbounded read stalls the very loop that
+detects stalls; (b) every PUBLIC blocking entry point (accepts
+``timeout_s`` — the deadline-discipline marker) must record a
+``conf-*`` flight event on entry AND contain a handler that records a
+``conf-*`` abort marker and re-raises — a conformance read that dies
+inside the tree walk with no timeline entry would blind the drift
+postmortem exactly when the model and the fleet disagree.
+
 Exceptions live in ``ALLOW`` ("Class.verb" / "file.py::qualname" ->
 reason) — empty by policy.
 """
@@ -143,6 +158,14 @@ STORE_WRITES = {"set", "set_if_absent", "exchange"}
 # the boundedness is the invariant. ``try_get`` only: ``get`` is the
 # universal dict method name and would false-positive everywhere.
 STORE_READS = {"try_get"}
+
+# the conformance-read surface (ISSUE 19): the model-conformance
+# module's store ops follow the telemetry contract above, and its
+# public blocking entries (accept timeout_s) must leave a ``conf-*``
+# flight event plus a conf-* record-and-reraise abort handler — the
+# drift postmortem starts from that timeline entry
+CONFORMANCE_FILE = "rocnrdma_tpu/obs/conformance.py"
+CONF_EVENT_PREFIX = "conf-"
 
 # the span-pairing surface (PR 10): the causal tracer
 # (``rocnrdma_tpu/obs/trace.py``) opens per-op spans with
@@ -536,6 +559,61 @@ def telemetry_problems(tree: ast.Module, where: str,
     return problems
 
 
+def conformance_problems(tree: ast.Module, where: str,
+                         used: set | None = None) -> list[str]:
+    """The conformance-read invariant (ISSUE 19), both halves: the
+    module's store ops inherit the telemetry-publish contract verbatim
+    (bounded, loop-free writes, flight-evented aborts), and every
+    PUBLIC blocking entry (accepts ``timeout_s``) must record a
+    ``conf-*`` entry event and contain a conf-* record-and-reraise
+    abort handler — an unrecorded conformance read's death blinds the
+    drift postmortem exactly when model and fleet disagree."""
+    problems = telemetry_problems(tree, where, used)
+    for qual, fn, _owner in base.iter_functions(tree):
+        name = qual.rsplit(".", 1)[-1]
+        if name.startswith("_") or "timeout_s" not in base.func_params(fn):
+            continue
+        key = f"{os.path.basename(where)}::{qual}"
+        if key in ALLOW:
+            if used is not None:
+                used.add(key)
+            continue
+        evented = any(
+            isinstance(node, ast.Call)
+            and base.call_name(node) in ABORT_MARKERS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith(CONF_EVENT_PREFIX)
+            for node in ast.walk(fn))
+        if not evented:
+            problems.append(
+                f"{where}:{fn.lineno}: conformance entry point {qual} "
+                f"records no {CONF_EVENT_PREFIX}* flight event (record "
+                f"one at entry, or ALLOW it with a reason) — the drift "
+                f"postmortem keys on that timeline entry")
+        handler_ok = any(
+            isinstance(node, ast.ExceptHandler)
+            and any(isinstance(s, ast.Raise) for s in ast.walk(node))
+            and any(isinstance(s, ast.Call)
+                    and base.call_name(s) in ABORT_MARKERS
+                    and s.args
+                    and isinstance(s.args[0], ast.Constant)
+                    and isinstance(s.args[0].value, str)
+                    and s.args[0].value.startswith(CONF_EVENT_PREFIX)
+                    for s in ast.walk(node))
+            for node in ast.walk(fn))
+        if not handler_ok:
+            problems.append(
+                f"{where}:{fn.lineno}: conformance entry point {qual} "
+                f"guarantees no {CONF_EVENT_PREFIX}* abort flight event "
+                f"(wrap the read in an except that records a "
+                f"{CONF_EVENT_PREFIX}* marker and re-raises, or ALLOW "
+                f"it with a reason) — a conformance read dying inside "
+                f"the tree walk must land on the timeline")
+    return problems
+
+
 def lane_problems(tree: ast.Module, where: str,
                   used: set | None = None) -> list[str]:
     """The lane-scheduling invariant: every blocking function of the
@@ -748,6 +826,11 @@ def check_lane_source(src: str, path: str = "<fixture>") -> list[str]:
     return lane_problems(ast.parse(src, filename=path), path)
 
 
+def check_conformance_source(src: str, path: str = "<fixture>") -> list[str]:
+    """Fixture entry point for the conformance-read invariant alone."""
+    return conformance_problems(ast.parse(src, filename=path), path)
+
+
 def check_span_source(src: str, path: str = "<fixture>") -> list[str]:
     """Fixture entry point for the span-pairing invariant alone."""
     return span_problems(ast.parse(src, filename=path), path)
@@ -775,6 +858,8 @@ def run() -> list[str]:
     problems += hier_problems(base.parse_file(HIER_FILE), HIER_FILE, used)
     problems += telemetry_problems(base.parse_file(TELEMETRY_FILE),
                                    TELEMETRY_FILE, used)
+    problems += conformance_problems(base.parse_file(CONFORMANCE_FILE),
+                                     CONFORMANCE_FILE, used)
     problems += lane_problems(base.parse_file(LANE_FILE), LANE_FILE, used)
     problems += span_problems(base.parse_file(SPAN_FILE), SPAN_FILE, used)
     problems += coalesce_problems(base.parse_file(COALESCE_FILE),

@@ -1,0 +1,153 @@
+"""Record the model-conformance chaos outcome as a results/ artifact.
+
+Runs the ``conformance-drift`` acceptance scenario (DESIGN.md §6g)
+TWICE with the same seed — three members, rank 1 chronically degraded
+through the fault plane so every collective's measured wall departs
+the committed wire model's prediction — and persists what the
+conformance trajectory is judged on: the fleet-merged per-cell drift
+table (median + worst predicted/measured ratio per (plane, verb,
+size-bucket) cell), the drifting cell set the estimator named, the
+``tune_wire`` trigger's verdict (the same cells, named in TUNERLOG on
+every rank), and the per-rank structural replay digests
+(CONFLOG/FAULTLOG/TUNERLOG), refusing to record at all unless the two
+runs are digest-equal on every rank. ``tools.sentinel --model-drift``
+ratchets later PRs against the committed bands.
+
+    python -m tools.record_conformance [--out results/conformance_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rocnrdma_tpu.runtime.multiprocess import run_workers  # noqa: E402
+
+OUT = "results/conformance_r01.json"
+
+# the replay-equality acceptance seeding (tests/test_conformance.py)
+PARAMS = dict(n=3, seed=23, rounds=6, size=4096, fault_rank=1,
+              degrade_factor=1000)
+
+# per-rank digest families that must replay bitwise across same-seed
+# runs: the structural conformance projection, the fault schedule, and
+# the tuner event stream (the drift trigger rides the broadcast, so
+# TUNERLOG carries the named plane+bucket identically on every rank)
+DIGESTS = ("CONFLOG", "FAULTLOG", "TUNERLOG")
+
+# the committed band allowance: a later run's per-cell median ratio may
+# move this multiple away from the committed one before the sentinel
+# calls it a conformance regression (the 1-CPU container's scheduler
+# noise swings measured walls hard; the SIGN of the drift — orders of
+# magnitude under the degrade — survives any plausible noise)
+BAND_SPREAD = 8.0
+
+
+def _line(result, key):
+    m = re.search(rf"^{key} (.+)$", result.stdout, re.M)
+    if not m:
+        raise SystemExit(
+            f"rank {result.process_id} (rc={result.returncode}) printed "
+            f"no {key} line:\n{result.stdout}\n{result.stderr}")
+    return m.group(1)
+
+
+def run_once() -> dict:
+    t0 = time.monotonic()
+    results = run_workers(PARAMS["n"], "conformance-drift", timeout_s=240.0,
+                          seed=PARAMS["seed"], rounds=PARAMS["rounds"],
+                          size=PARAMS["size"],
+                          fault_rank=PARAMS["fault_rank"])
+    wall_s = time.monotonic() - t0
+    out = {"wall_s": round(wall_s, 2), "lost_ops": 0, "ranks": {}}
+    confstats, tuned = set(), set()
+    for r in results:
+        if r.returncode != 0:
+            raise SystemExit(
+                f"rank {r.process_id} exited {r.returncode} — refusing "
+                f"to record a failed run:\n{r.stdout}\n{r.stderr}")
+        out["lost_ops"] += r.stdout.count("BAD-RESULT")
+        confstats.add(_line(r, "CONFSTATS"))
+        tuned.add(_line(r, "TUNED-DRIFT"))
+        out["ranks"][str(r.process_id)] = {
+            k.lower(): _line(r, k) for k in DIGESTS}
+        if r.process_id == 0:
+            out["cells"] = json.loads(_line(r, "CONFCELLS"))
+    if len(confstats) != 1 or len(tuned) != 1:
+        raise SystemExit(f"ranks disagree on the drift verdict "
+                         f"(confstats={confstats}, tuned={tuned})")
+    out["confstats"] = json.loads(confstats.pop())
+    out["tuned_drift"] = json.loads(tuned.pop())
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args(argv)
+    print("running conformance-drift (run 1 of 2) ...", flush=True)
+    first = run_once()
+    print("running conformance-drift (run 2 of 2, replay check) ...",
+          flush=True)
+    second = run_once()
+    for rk, digs in first["ranks"].items():
+        if second["ranks"].get(rk) != digs:
+            raise SystemExit(
+                f"replay divergence on rank {rk}: {digs} vs "
+                f"{second['ranks'].get(rk)} — the STRUCTURAL half of the "
+                f"conformance story must be a pure function of the seed; "
+                f"refusing to record a non-deterministic run")
+    if first["lost_ops"] or second["lost_ops"]:
+        raise SystemExit("bitwise oracle lost ops — refusing to record")
+    if not first["confstats"]["drift"]:
+        raise SystemExit(
+            "the degraded scenario produced NO drifting cell — the "
+            "estimator went blind; refusing to record an empty band")
+    if not first["tuned_drift"]:
+        raise SystemExit(
+            "tune_wire's drift trigger never fired under a 1000x "
+            "degrade — refusing to record a dead trigger")
+    record = {
+        "record": "conformance_r01",
+        "task": "conformance-drift",
+        "params": PARAMS,
+        "wall_s": first["wall_s"],
+        "lost_ops": 0,
+        # the committed band material: per-cell median + worst ratios
+        # and sample counts from the fleet-merged table (timing-shaped
+        # measurements, recorded like algbw — never digest material)
+        "cells": first["cells"],
+        "drift": first["confstats"]["drift"],
+        "top": first["confstats"]["top"],
+        "tuned_drift": first["tuned_drift"],
+        "digests": first["ranks"],
+        "replay": {"runs": 2, "digests_equal": True},
+        # the sentinel's bars: the oracle and the detection verdict are
+        # absolute (a drifting scenario that stops drifting means the
+        # estimator or the trigger went blind); the per-cell medians
+        # ratchet band-wise (a current run's cell may move BAND_SPREAD
+        # x away from its committed twin before it is a finding)
+        "floors": {
+            "lost_ops": 0,
+            "band_spread": BAND_SPREAD,
+            "drift_cells": sorted(first["confstats"]["drift"]),
+        },
+    }
+    path = args.out if os.path.isabs(args.out) else os.path.join(REPO,
+                                                                 args.out)
+    with open(path, "w") as fp:
+        json.dump(record, fp, indent=2)
+        fp.write("\n")
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -497,6 +497,81 @@ def check_evasion(current: dict | None = None,
     return findings
 
 
+def check_model_drift(current: dict | None = None,
+                      results_dir: str = RESULTS) -> list[dict]:
+    """The model-conformance ratchet (ISSUE 19): hold the drift story
+    against the committed ``results/conformance_r01.json`` — a future
+    PR that quietly blinds the predicted-vs-measured estimator (the
+    seeded degrade scenario stops naming its drifting cells, or a
+    cell's median ratio walks beyond the committed band) fails tier-1
+    here, with the finding naming WHICH plane and size bucket moved.
+
+    ``current``: a ``tools.record_conformance`` record doc; when None,
+    the committed doc self-diffs (the all-zero fixed point — the cheap
+    tier-1 shape; re-measuring is the recorder's job). Three checks:
+    (1) the oracle is absolute — ``lost_ops`` must equal the committed
+    floor (zero); (2) detection is absolute — every committed drift
+    cell must still be named by the current run's estimator AND by the
+    ``tune_wire`` trigger (a drifting scenario that stops drifting
+    means the loop went blind, not that the fleet got faster); (3) the
+    per-cell median predicted/measured ratios ratchet band-wise — a
+    current cell may move ``band_spread`` x away from its committed
+    twin before it is a finding (measured walls are timing-shaped; the
+    allowance is generous by design)."""
+    path = os.path.join(results_dir, "conformance_r01.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as fp:
+        committed = json.load(fp)
+    if current is None:
+        current = committed
+    floors = committed.get("floors", {})
+    findings = []
+    if current.get("lost_ops", 0) != floors.get("lost_ops", 0):
+        findings.append({
+            "key": ("conformance", "lost_ops"),
+            "conf_lost_ops": current.get("lost_ops"),
+            "lost_ops_floor": floors.get("lost_ops", 0),
+            "trace_diff": None,
+        })
+    cur_drift = set(current.get("drift", []))
+    cur_trigger = set(current.get("tuned_drift", []))
+    for cell in floors.get("drift_cells", []):
+        if cell not in cur_drift:
+            findings.append({
+                "key": ("conformance", cell),
+                "conf_blind": "estimator",
+                "trace_diff": None,
+            })
+        if cell not in cur_trigger:
+            findings.append({
+                "key": ("conformance", cell),
+                "conf_blind": "tune_wire trigger",
+                "trace_diff": None,
+            })
+    spread = floors.get("band_spread", 8.0)
+    base_cells = committed.get("cells", {})
+    for cell, info in current.get("cells", {}).items():
+        twin = base_cells.get(cell)
+        if twin is None:
+            continue  # new cells are not regressions
+        cur_p50 = info.get("p50_ratio", 0.0)
+        base_p50 = twin.get("p50_ratio", 0.0)
+        if cur_p50 <= 0 or base_p50 <= 0:
+            continue
+        factor = max(cur_p50 / base_p50, base_p50 / cur_p50)
+        if factor > spread:
+            findings.append({
+                "key": ("conformance", cell),
+                "conf_p50": round(cur_p50, 4),
+                "committed_p50": round(base_p50, 4),
+                "band_factor": round(factor, 2),
+                "band_spread": spread,
+                "trace_diff": None,
+            })
+    return findings
+
+
 def check_current(current: list[dict],
                   results_dir: str = RESULTS,
                   ratio: float = 0.8) -> list[dict]:
@@ -551,6 +626,23 @@ def format_findings(findings: list[dict]) -> str:
                          f"{f['committed_MBps']})")
         elif "store_traffic" in f:
             lines.append(f"  simfleet: {f['store_traffic']}")
+        elif "conf_lost_ops" in f:
+            lines.append(f"  {key}: the conformance chaos run LOST "
+                         f"{f['conf_lost_ops']} op(s) against the "
+                         f"bitwise oracle (committed floor "
+                         f"{f['lost_ops_floor']})")
+        elif "conf_blind" in f:
+            lines.append(f"  {key}: the seeded degrade scenario no "
+                         f"longer names this plane+bucket — the "
+                         f"{f['conf_blind']} went blind (a drift the "
+                         f"model stops seeing is a conformance "
+                         f"regression, not a speedup)")
+        elif "conf_p50" in f:
+            lines.append(f"  {key}: median predicted/measured ratio "
+                         f"{f['conf_p50']} moved {f['band_factor']}x "
+                         f"from the committed {f['committed_p50']} — "
+                         f"past the {f['band_spread']}x band on this "
+                         f"plane+bucket")
         elif "per_rank_ops" in f:
             lines.append(f"  {key}: per-rank store ops per window grew "
                          f"to {f['per_rank_ops']} — past the committed "

@@ -47,7 +47,29 @@ def main(argv=None) -> int:
                         "ops); pass a tools.record_evasion doc to diff "
                         "a fresh run, or nothing to self-diff the "
                         "committed record")
+    p.add_argument("--model-drift", default=None, nargs="?", const="",
+                   metavar="RECORD.json",
+                   help="run the model-conformance ratchet against the "
+                        "committed results/conformance_r01.json (the "
+                        "seeded degrade scenario must still name its "
+                        "drifting plane+buckets, per-cell medians stay "
+                        "inside the committed band); pass a "
+                        "tools.record_conformance doc to diff a fresh "
+                        "run, or nothing to self-diff the committed "
+                        "record")
     args = p.parse_args(argv)
+    if args.model_drift is not None:
+        if args.records or args.run_smoke or args.store_traffic \
+                or args.evasion is not None:
+            p.error("--model-drift runs alone")
+        current = None
+        if args.model_drift:
+            with open(args.model_drift) as fp:
+                current = json.load(fp)
+        findings = sentinel.check_model_drift(
+            current, results_dir=args.results_dir)
+        print(sentinel.format_findings(findings))
+        return 1 if findings else 0
     if args.store_traffic:
         if args.records or args.run_smoke or args.evasion is not None:
             p.error("--store-traffic runs alone")

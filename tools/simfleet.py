@@ -91,6 +91,33 @@ def synth_snapshot(orig: int, epoch: int, seq: int, seed: int) -> dict:
     verbs["isend"]["mean_us"] = (
         verbs["isend"]["total_s"] / verbs["isend"]["count"] * 1e6
         if verbs["isend"]["count"] else 0.0)
+    # model-conformance cells (ISSUE 19): the same integer-count /
+    # integer-keyed-histogram / min-max-extreme discipline as the verb
+    # buckets, drawn per (rank, window, seed) so the tree==flat claim
+    # covers the drift tables on non-uniform inputs too
+    conf_cells = {}
+    for lg in (10, 13, 17):
+        if rng.random() < 0.4:
+            continue
+        joins = rng.randrange(1, 30)
+        hist: dict = {}
+        for _ in range(joins):
+            q = rng.randrange(-16, 17)
+            hist[str(q)] = hist.get(str(q), 0) + 1
+        qs = [int(k) for k in hist]
+        conf_cells[f"sim|ring_allreduce_over_net|lg{lg}"] = {
+            "n": joins, "picks": joins,
+            "pred_us": rng.randrange(100, 100000),
+            "meas_us": rng.randrange(100, 100000),
+            "q_min": min(qs), "q_max": max(qs),
+            "q_hist": hist,
+            "vers": {str(rng.randrange(0, 3)): joins},
+            "sched": {f"{1 << rng.randrange(6, 12)}K"
+                      f"/d{rng.randrange(1, 4)}": joins},
+        }
+    conf = {"cells": conf_cells,
+            "aux": ({"sim|codec": rng.randrange(1, 5)}
+                    if rng.random() < 0.5 else {})}
     return {
         "v": 1,
         "rank": orig,
@@ -111,6 +138,7 @@ def synth_snapshot(orig: int, epoch: int, seq: int, seed: int) -> dict:
                         "algorithm": "hier" if wire["hier_ops"] else None},
         "store": {"ops": 0, "classes": {}, "by_op": {}},
         "verb_latency": verbs,
+        "conf": conf,
         "flight": {"recorded": seq, "capacity": 4096,
                    "saturated": False},
         "trace": [],
