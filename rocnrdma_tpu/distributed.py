@@ -841,9 +841,14 @@ class ProcessGroup:
                 self._send = self._recv = None
                 self._register_standby(timeout_s)
             elif world_size > 1:
+                # the main store client consults the same fault schedule
+                # as the wire (store_conn_drop_ops — the store plane's
+                # op_fault analogue); an empty schedule costs one None
+                # check per RPC
                 self._send, self._recv, self._client = bootstrap.bootstrap_ring(
                     self._net, store_handle, rank, world_size, timeout_s,
-                    ns=f"pg/{group_name}/ring")
+                    ns=f"pg/{group_name}/ring",
+                    fault_schedule=fault_schedule)
             else:
                 self._send = self._recv = self._client = None
             if node_of is not None and standby is None:
@@ -956,6 +961,17 @@ class ProcessGroup:
         self._destroyed = False
         self._postmortemed = False  # one watchdog flight dump per group
         self._store_handle = store_handle
+        # the survivable store (DESIGN.md §5n): replica handles armed on
+        # every store client this group creates from now on (main client,
+        # watchdog client, split/shrink children adopt at their own init),
+        # the local replica/proxy servers this RANK hosts (closed on
+        # destroy), and the per-node proxy handle this rank's CLIENTS
+        # should prefer for high-rate control traffic (heartbeats,
+        # telemetry) once a proxy is adopted
+        self._store_failover: list = []
+        self._store_replica_server = None
+        self._node_proxy = None
+        self._store_proxy_handle = None
 
     # -- collectives (numpy in, numpy out) ---------------------------------
 
@@ -1787,7 +1803,8 @@ class ProcessGroup:
                 (h.local_send, h.local_recv,
                  h.local_client) = bootstrap.bootstrap_ring(
                     h.local_net, self._store_handle, lrank, h.local_n,
-                    rem(), ns=f"{ns}/n{node_idx}")
+                    rem(), ns=f"{ns}/n{node_idx}",
+                    failover=tuple(self._store_failover))
             if h.n_nodes > 1 and (uniform or lrank == 0):
                 # uniform: local index j's ring carries shard j across
                 # nodes (members: each node's j-th rank, node order);
@@ -1797,7 +1814,8 @@ class ProcessGroup:
                  h.inter_client) = bootstrap.bootstrap_ring(
                     h.inter_net, self._store_handle, node_idx,
                     h.n_nodes, rem(),
-                    ns=f"{ns}/x{lrank if uniform else 0}")
+                    ns=f"{ns}/x{lrank if uniform else 0}",
+                    failover=tuple(self._store_failover))
             # lanes opened before (or during) the build: mirror the
             # registry snapshot so every leg resolves the same QoS
             # credit and codec knob (later channel() calls mirror
@@ -4509,6 +4527,108 @@ class ProcessGroup:
 
     # -- watchdog (the ProcessGroupNCCL watchdog / RCCL heartbeat analogue) --
 
+    # -- survivable store (DESIGN.md §5n) ----------------------------------
+
+    def host_store_replica(self, timeout_s: float = 10.0) -> str:
+        """Called on the DETERMINISTIC SUCCESSOR rank (the agreed-a-priori
+        next store host — by convention the lowest-ranked member not
+        hosting the primary): start an EMPTY sidecar store and publish
+        its handle under ``pg/<g>/store/replica``. The primary's host
+        attaches it (``attach_store_replica``); from then on every
+        replicated-namespace ack implies the replica holds the write (or
+        the replica was declared dead and detached — flight-recorded),
+        and survivors re-point to it when the primary dies."""
+        if self._store_replica_server is None:
+            self._store_replica_server = bootstrap.BootstrapServer(
+                n_ranks=0)
+        self._client.set(f"pg/{self.group_name}/store/replica",
+                         self._store_replica_server.handle,
+                         timeout_s=timeout_s)
+        return self._store_replica_server.handle
+
+    def attach_store_replica(self, timeout_s: float = 10.0) -> str | None:
+        """Called on the rank hosting the primary (``self._server``): read
+        the published replica handle and attach it — the server installs
+        the live-replication pointer BEFORE snapshotting, so a mutation
+        racing the attach forwards or lands in the snapshot (possibly
+        both; the replica's merge-sync is non-destructive) — no ack can
+        race past the attach unreplicated. Returns the attached handle,
+        or None when this rank hosts no server or no replica is
+        published."""
+        if self._server is None:
+            return None
+        h = self._client.try_get(f"pg/{self.group_name}/store/replica",
+                                 timeout_s=timeout_s)
+        if h:
+            self._server.attach_replica(h, timeout_s=timeout_s)
+        return h or None
+
+    def arm_store_failover(self, handles=None,
+                           timeout_s: float = 5.0) -> list:
+        """Arm the survivable-store rotation on THIS rank. With
+        ``handles=None`` the published replica handle
+        (``pg/<g>/store/replica``) is read and armed. The main client
+        rotates on its next reconnect (the idempotent replay path);
+        watchdog clients created after this call dial with the list from
+        birth — re-arm the watchdog to take effect immediately. Returns
+        the armed list (empty when nothing is published: arming is then
+        a no-op, not an error — bring-up order must not matter)."""
+        if handles is None:
+            raw = self._client.try_get(
+                f"pg/{self.group_name}/store/replica", timeout_s=timeout_s)
+            handles = [raw] if raw else []
+        handles = [h for h in handles if h]
+        self._store_failover = list(handles)
+        self._client.arm_failover(handles)
+        return list(handles)
+
+    def elect_store_primary(self, successor: int) -> str:
+        """Convergent post-failover election: every survivor setnx-es the
+        SAME deterministic value (the successor's rank — agreed a priori
+        by the deterministic-successor rule, never a handle: ports are
+        run-local and would poison replay digests) under the
+        epoch-qualified election key. The winner is irrelevant — the
+        durable record is the point, and the key lives in a replicated
+        namespace so it survives the NEXT failover too."""
+        key = f"pg/{self.group_name}/store/primary/e{self.epoch}"
+        return self._client.set_if_absent(key, str(int(successor)))
+
+    def host_node_proxy(self, node: int, flush_s: float = 0.25,
+                        timeout_s: float = 10.0) -> str:
+        """Called on a node's elected agent rank (PR-15 election: the
+        node's lowest live rank): start a ``NodeProxyStore`` terminating
+        this node's heartbeats and telemetry snapshots locally —
+        condensed epoch-qualified summaries upstream — and publish its
+        handle under the epoch-qualified proxy key for node mates to
+        adopt. The proxy inherits this group's armed failover list: a
+        dead PRIMARY re-points the proxy's upstream while the node's
+        ranks never move."""
+        if self._node_proxy is None:
+            self._node_proxy = bootstrap.NodeProxyStore(
+                self._store_handle, node, flush_s=flush_s,
+                timeout_s=timeout_s,
+                failover=tuple(self._store_failover))
+        self._client.set(
+            f"pg/{self.group_name}/store/proxy/e{self.epoch}/{int(node)}",
+            self._node_proxy.handle, timeout_s=timeout_s)
+        self._store_proxy_handle = self._node_proxy.handle
+        return self._node_proxy.handle
+
+    def adopt_node_proxy(self, node: int,
+                         timeout_s: float = 5.0) -> str | None:
+        """Point this rank's HIGH-RATE control traffic (the watchdog's
+        heartbeat + telemetry client) at its node's published proxy.
+        Rendezvous and heal traffic stay on the primary: the proxy would
+        forward them verbatim anyway, and the low-rate plane keeps one
+        less hop. Takes effect on the next ``start_watchdog``. Returns
+        the adopted handle, or None when the node published none."""
+        h = self._client.try_get(
+            f"pg/{self.group_name}/store/proxy/e{self.epoch}/{int(node)}",
+            timeout_s=timeout_s)
+        if h:
+            self._store_proxy_handle = h
+        return h or None
+
     def start_watchdog(self, interval_s: float = 1.0,
                        timeout_s: float = 5.0) -> None:
         """Asynchronous failure detection: a daemon thread publishes this
@@ -4556,11 +4676,22 @@ class ProcessGroup:
                 # telemetry publish alike — never a default 30 s stall
                 # that lands our beat after the neighbour's death grace
                 # (the loop absorbs the TimeoutError and keeps ticking)
+                # high-rate control traffic prefers the node's proxy when
+                # one was adopted (adopt_node_proxy); rotation order is
+                # proxy -> primary -> replica(s), so a dead PROXY
+                # re-points only this node's ranks at the primary while
+                # a dead PRIMARY re-points everyone at the replica (§5n)
+                handle = self._store_proxy_handle or self._store_handle
+                fail = list(self._store_failover)
+                if handle != self._store_handle:
+                    fail = [self._store_handle, *fail]
                 client = bootstrap.BootstrapClient(
-                    self._store_handle, self.rank,
+                    handle, self.rank,
                     timeout_s=interval_s + timeout_s,
                     scope=f"pg/{self.group_name}/ring",
-                    traffic_class="heartbeat")
+                    traffic_class="heartbeat",
+                    failover=tuple(fail),
+                    tag=f"wd/{self.group_name}")
                 beat = 0
                 seen: dict[int, tuple] = {}  # target -> (value, stamp)
                 dead: set[int] = set()
@@ -4822,9 +4953,20 @@ class ProcessGroup:
                         pass
         self._hier_invalidate(wait_s=2.0)
         self._net.close()
+        if self._node_proxy is not None:
+            # BEFORE the primary: the proxy's upstream client counts
+            # against the primary's wait_idle (a rank hosting both would
+            # otherwise wait on itself)
+            self._node_proxy.close()
+            self._node_proxy = None
         if self._server is not None:
             self._server.wait_idle()  # all clients gone -> safe to close
-            self._server.close()
+            self._server.close()      # detaches its replica link (bye)
+        if self._store_replica_server is not None:
+            # AFTER the primary: close() above said bye on the
+            # replication link, so the sidecar winds down clean
+            self._store_replica_server.close()
+            self._store_replica_server = None
 
     def __enter__(self):
         return self

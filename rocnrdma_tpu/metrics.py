@@ -493,6 +493,8 @@ STORE_CLASSES = (
     "rendezvous",         # bootstrap/hier ring wiring, heal/grow protocol
     "election",           # first-writer-wins proposals (agree/setnx)
     "prune",              # epoch-bump store hygiene sweeps
+    "replication",        # primary -> replica critical-state forwards
+    "proxy-upstream",     # node proxy -> primary condensed/forwarded ops
 )
 
 

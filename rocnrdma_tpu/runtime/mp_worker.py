@@ -117,6 +117,24 @@ Tasks:
   predicted cost, model versions; never measured walls or ratio
   histograms — replay-equal across two same-seed runs).
 
+- ``kill-the-store``: the survivable-control-plane acceptance run
+  (ISSUE 20): a ``ProcessGroup`` fleet (shm plane, watchdog on,
+  ``self_heal=True``) brings up the sharded store — rank 1 hosts the
+  replica sidecar, every rank arms the failover rotation, rank 0's
+  primary attaches the replica — then ``--store-death`` picks the
+  death: ``host`` hard-kills rank 0 (store host AND member, via
+  ``--kill-ranks``/``--kill-ops``) so the in-flight heal must complete
+  against the replica; ``server`` closes the primary IN-PROCESS at
+  rank 0's ``--kill-store-op``-th data op (every client rotates, no
+  membership change); ``proxy`` gives each half-fleet node a
+  ``NodeProxyStore`` and closes node 1's at its agent's Nth data op —
+  ONLY node 1's ranks may re-point (to the primary). Rounds stay
+  bitwise (the kill-and-heal oracle); survivors print ``STOREWINNER``
+  (the convergent successor election) and ``STORELOG`` (the sorted
+  store-* flight digest — sorted, not ordered: failover events race
+  between the main and watchdog clients' threads) next to
+  FAULTLOG/HEALLOG — all replay-equal per seed.
+
 Every chaos task also prints a ``RINGFULL`` warning when the flight
 ring wrapped during the run (``flight-ring-saturated`` on the
 timeline): a wrapped ring may have evicted digest-relevant events, so
@@ -132,7 +150,8 @@ import sys
 import time
 
 CHAOS_TASKS = ("chaos-allreduce", "die-mid-collective", "kill-and-heal",
-               "trace-delay", "evade-straggler", "conformance-drift")
+               "trace-delay", "evade-straggler", "conformance-drift",
+               "kill-the-store")
 # tasks that drive BOTH planes: the host-plane chaos stack AND a real
 # jax coordination service (run_workers reserves a second port for it)
 DEVICE_TASKS = ("kill-a-host",)
@@ -263,6 +282,31 @@ def _grow_log() -> str:
     standby-registered — the elastic-grow half of the replay-equality
     contract next to HEALLOG."""
     return _event_log(("grow-", "promote-", "standby-"))
+
+
+def _store_log() -> str:
+    """The survivable-store timeline digest: store-* flight events
+    (failover rotations, replica attaches — deterministic args only:
+    ranks, tags, counts; never ports or wall times). Unlike
+    :func:`_event_log` this digest SORTS events before hashing: a
+    rank's failover events originate on CONCURRENT clients (the main
+    client and the watchdog's own thread race to discover a dead
+    primary), so set-equality is the replay contract, not
+    order-equality — FLIGHT event order between threads is
+    scheduler-shaped. The ``*-abort`` kinds are EXCLUDED: an abort
+    records that some async work (a proxy flush, a replication forward)
+    happened to be in flight when the injected death landed — a wall-
+    clock artifact, on the timeline for postmortems but outside the
+    replay contract."""
+    import hashlib
+    import json
+
+    from rocnrdma_tpu.obs import FLIGHT
+    events = sorted(
+        (kind, json.dumps(args, default=str, sort_keys=True))
+        for _, kind, args in FLIGHT.events()
+        if kind.startswith("store-") and not kind.endswith("-abort"))
+    return hashlib.sha256(json.dumps(events).encode()).hexdigest()
 
 
 def _chaos_rounds(args, pg, start: int, can_grow: bool,
@@ -564,15 +608,21 @@ def _trace_chaos_main(args) -> int:
         host, port = args.coordinator.rsplit(":", 1)
         server = bootstrap.BootstrapServer(n_ranks=n, port=int(port),
                                            host=host)
-    # ONLY the victim's receive completions are held — long enough
-    # (hundreds of polls: the wait loop's backoff turns them into tens
-    # of ms) to dominate the cross-rank clock-alignment skew, so the
-    # critical path's verdict is unambiguous. Decisions key off the
+    # ONLY the victim's receive completions are held — long enough to
+    # dominate BOTH the cross-rank clock-alignment skew and the other
+    # noise source this verdict races: a GIL-starved healthy rank on a
+    # loaded 1-CPU box stalls 60-80 ms without polling at all, and the
+    # old 600-900-poll hold (~15-30 ms of µs-scale wait-loop polls)
+    # lost the critical path to it. ~0.5 s per held completion keeps
+    # the victim's wall the longest by design margin — and since the
+    # hold is counted in the victim's OWN polls, load inflates it in
+    # proportion to the stalls it must outweigh, so the margin grows
+    # with contention instead of shrinking. Decisions key off the
     # rank's own op sequence: replay-equal per seed by construction.
     sched = FaultSchedule(
         args.seed, rank,
         test_delay_p=(1.0 if rank == args.fault_rank else 0.0),
-        test_delay_polls=(600, 900))
+        test_delay_polls=(4000, 6000))
     status = 0
     pg = None
     try:
@@ -1063,6 +1113,141 @@ def _heal_chaos_main(args) -> int:
     return status
 
 
+def _store_chaos_main(args) -> int:
+    """The survivable-store acceptance task (module docstring:
+    ``kill-the-store``)."""
+    from rocnrdma_tpu import distributed as dist
+    from rocnrdma_tpu.transport import bootstrap
+    from rocnrdma_tpu.transport.faults import FaultSchedule
+
+    rank, n = args.process_id, args.num_processes
+    mode = args.store_death
+    kill = dict(zip(
+        (int(r) for r in (args.kill_ranks or "").split(",") if r),
+        (int(o) for o in (args.kill_ops or "").split(",") if o)))
+    server = None
+    if rank == 0:
+        host, port = args.coordinator.rsplit(":", 1)
+        server = bootstrap.BootstrapServer(n_ranks=n, port=int(port),
+                                           host=host)
+    # the store chaos profile: the op-keyed hard kill (host mode) or an
+    # armed in-process store/proxy close (server/proxy modes — fired at
+    # the host rank's Nth DATA op, outside the schedule lock), plus
+    # seeded client-side drops of the store connection itself on the odd
+    # ranks — the reconnect-replay path must absorb those long before
+    # any death fires, at coordinates keyed to each client's own store-
+    # RPC stream, so the whole failure story replays per (seed, rank)
+    sched = FaultSchedule(
+        args.seed, rank,
+        kill_after_ops=kill.get(rank) if mode == "host" else None,
+        store_conn_drop_ops=(5,) if rank % 2 == 1 else (),
+        store_close_after_ops=(args.kill_store_op
+                               if mode == "server" and rank == 0
+                               else None),
+        proxy_close_after_ops=(args.kill_store_op
+                               if mode == "proxy" and rank == n // 2
+                               else None))
+    status = 0
+    pg = None
+    group = f"store{args.seed}"
+    node = rank * 2 // n  # two "nodes", the --hier convention
+    try:
+        pg = dist.init_process_group(
+            rank=rank, world_size=n, store_handle=args.coordinator,
+            timeout_s=20.0, group_name=group, plane="shm",
+            fault_schedule=sched, self_heal=True)
+        # survivable-store bring-up: the deterministic successor (rank 1)
+        # hosts the replica sidecar; every rank arms the rotation; the
+        # primary attaches AFTER the arm barrier — every key the
+        # snapshot must carry is in the store by then, and attach
+        # installs the live-replication pointer in the same critical
+        # section as the snapshot, so nothing acked can slip between
+        if rank == 1:
+            pg.host_store_replica()
+        pg._client.barrier(f"pg/{group}/store/arm", n, timeout_s=20.0)
+        pg.arm_store_failover()
+        if server is not None:
+            # the harness holds the primary directly (the pg was built
+            # on its handle, like every chaos task) — attach is the
+            # same call ProcessGroup.attach_store_replica makes for a
+            # group-owned server
+            server.attach_replica(pg._client.get(
+                f"pg/{group}/store/replica", timeout_s=10.0))
+        pg._client.barrier(f"pg/{group}/store/attached", n,
+                           timeout_s=20.0)
+        pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
+        if mode == "server" and server is not None:
+            # the primary dies IN-PROCESS at rank 0's Nth data op: the
+            # hosting RANK survives, every client rotates to the
+            # replica, membership never changes
+            sched.arm_store_death(server.close)
+        elif mode == "proxy":
+            # per-node proxies: each node's agent (lowest rank) hosts
+            # one, everyone adopts it and re-arms the watchdog so the
+            # heartbeat client dials the proxy from birth; node 1's
+            # proxy then dies at its agent's Nth data op — ONLY node
+            # 1's ranks may re-point (to the primary)
+            if rank in (0, n // 2):
+                pg.host_node_proxy(node)
+            pg._client.barrier(f"pg/{group}/store/proxy-up", n,
+                               timeout_s=20.0)
+            pg.adopt_node_proxy(node)
+            pg.stop_watchdog()
+            pg.start_watchdog(interval_s=0.3, timeout_s=2.0)
+            if rank == n // 2:
+                sched.arm_proxy_death(pg._node_proxy.close)
+        status = _chaos_rounds(args, pg, 0, can_grow=False)
+        if status == 0:
+            # the convergent successor election: every survivor
+            # setnx-es the SAME deterministic value (rank 1 — the
+            # successor rule), so the winner is identical whoever got
+            # there first, and the record rides a replicated namespace
+            winner = pg.elect_store_primary(1)
+            print(f"OK rank={rank}/{n} rounds={args.rounds} "
+                  f"now-rank={pg.rank}/{pg.world_size}", flush=True)
+            print(f"EPOCH {pg.epoch}", flush=True)
+            print(f"MEMBERS {pg.global_ranks}", flush=True)
+            print(f"STOREWINNER {winner}", flush=True)
+            pg.stop_watchdog()
+            pg.destroy(graceful=True)
+    except (TimeoutError, OSError, RuntimeError) as e:
+        print(f"CLEAN-ABORT: {type(e).__name__}: {e}", flush=True)
+        status = 4
+    finally:
+        import contextlib
+        print(f"FAULTS {sched.counters.to_json()}", flush=True)
+        print(f"FAULTLOG {sched.fingerprint()}", flush=True)
+        print(f"HEALLOG {_heal_log()}", flush=True)
+        print(f"STORELOG {_store_log()}", flush=True)
+        # counted AFTER teardown: the chaos rounds can outrun a 0.3 s
+        # heartbeat interval, so a rank whose only client on the dead
+        # proxy is the watchdog's may first touch the corpse at the
+        # close-time bye — the re-point is deterministic either way,
+        # and THIS count is the proxy-death acceptance (node 1's ranks
+        # re-point exactly once, node 0's never move)
+        from rocnrdma_tpu.obs import FLIGHT
+        npoint = sum(1 for _, kind, _a in FLIGHT.events()
+                     if kind == "store-failover")
+        print(f"STOREPOINT {npoint}", flush=True)
+        _print_ringfull()
+        from rocnrdma_tpu.obs import chrome
+        chrome.dump_if_env(rank)
+        if pg is not None:
+            try:
+                pg.destroy(graceful=False)
+            except (OSError, TimeoutError):
+                pass
+        if server is not None:
+            # server mode closed it mid-run; a second close is benign
+            # only when guarded — and in host mode this line is never
+            # reached (the hosting rank died at its kill op)
+            with contextlib.suppress(Exception):
+                if status == 0:
+                    server.wait_idle(timeout_s=5.0)
+                server.close()
+    return status
+
+
 def _evade_chaos_main(args) -> int:
     """The predictive-evasion acceptance task (module docstring:
     ``evade-straggler``)."""
@@ -1397,6 +1582,17 @@ def main(argv=None) -> int:
                         "first half node 0, second half node 1); kill a "
                         "node leader and the healed retry must re-elect "
                         "by lowest surviving original rank in the node")
+    p.add_argument("--store-death", default="host",
+                   choices=("host", "server", "proxy"),
+                   help="kill-the-store: what dies — the store-hosting "
+                        "RANK (os._exit via --kill-ranks/--kill-ops; "
+                        "survivors heal against the replica), the "
+                        "primary SERVER in-process (every client "
+                        "rotates, membership unchanged), or node 1's "
+                        "PROXY (only that node's ranks re-point)")
+    p.add_argument("--kill-store-op", type=int, default=6,
+                   help="kill-the-store: the host rank's data-op index "
+                        "at which the armed server/proxy close fires")
     p.add_argument("--coalesce", action="store_true",
                    help="kill-and-heal: issue each round's allreduces "
                         "ASYNC and flush them as one fused bucket (the "
@@ -1419,6 +1615,8 @@ def main(argv=None) -> int:
         return _witnessed(_device_chaos_main(args))  # both planes
     if args.task == "kill-and-heal":
         return _witnessed(_heal_chaos_main(args))  # host plane only: no jax
+    if args.task == "kill-the-store":
+        return _witnessed(_store_chaos_main(args))  # host plane only: no jax
     if args.task == "trace-delay":
         return _witnessed(_trace_chaos_main(args))  # host plane only: no jax
     if args.task == "evade-straggler":

@@ -101,6 +101,8 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                 coalesce: bool = False,
                 codec: str | None = None,
                 hier: bool = False,
+                store_death: str | None = None,
+                kill_store_op: int | None = None,
                 _retry_left: int = 1) -> list[WorkerResult]:
     """Spawn ``n`` worker processes running ``task``; wait for all.
 
@@ -143,7 +145,9 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                       ("--size", size), ("--kill-ranks", kill_ranks),
                       ("--kill-ops", kill_ops), ("--spares", spares),
                       ("--join", join), ("--grow-round", grow_round),
-                      ("--die-at-promotion", die_at_promotion)):
+                      ("--die-at-promotion", die_at_promotion),
+                      ("--store-death", store_death),
+                      ("--kill-store-op", kill_store_op)):
         if val is not None:
             extra += [flag, str(val)]
     if jax_port is not None:
@@ -200,5 +204,6 @@ def run_workers(n: int, task: str, timeout_s: float = 120.0,
                            size, kill_ranks, kill_ops, spares, join,
                            grow_round, die_at_promotion, device_heal_fail,
                            lanes, coalesce, codec, hier,
+                           store_death, kill_store_op,
                            _retry_left=_retry_left - 1)
     return results

@@ -22,6 +22,7 @@ from rocnrdma_tpu.transport.backoff import (  # noqa: F401
 from rocnrdma_tpu.transport.bootstrap import (  # noqa: F401
     BootstrapClient,
     BootstrapServer,
+    NodeProxyStore,
     bootstrap_ring,
 )
 from rocnrdma_tpu.transport.faults import FaultNet, FaultSchedule  # noqa: F401

@@ -140,6 +140,12 @@ class BootstrapServer:
     fan-in is small and short-lived); state is a dict + per-rank barrier
     arrival sets + a last-seen liveness table."""
 
+    # replica forwarding bounds: how often the condensed liveness sync
+    # piggybacks on mutation traffic, and the per-forward reply budget
+    # (a slow replica must not stall the primary's serve threads past it)
+    _REPL_LIVE_S = 0.25
+    _REPL_TIMEOUT_S = 2.0
+
     def __init__(self, n_ranks: int, port: int = 0, host: str | None = None):
         self.n_ranks = n_ranks
         self._listener = native.TcpListener(port=port, host=host)
@@ -153,6 +159,20 @@ class BootstrapServer:
         self._last_seen: dict[tuple, float] = {}
         self._lock = _lockwitness.make_lock(
             "bootstrap.py::BootstrapServer._lock")
+        # the per-shard store-ops ledger (server side of metrics.STORE):
+        # every request this store actually served, by op — the scale
+        # harness (tools/simfleet) proves the proxy condensation from
+        # exactly these counters
+        self._served_n = 0
+        self._served_by_op: dict[str, int] = {}
+        # replication plumbing (attach_replica): the shared replica
+        # client is lockstep, so forwards serialize under their own
+        # lock — NEVER nested inside self._lock (serve threads forward
+        # AFTER _handle returns; see _dispatch)
+        self._repl_lock = _lockwitness.make_lock(
+            "bootstrap.py::BootstrapServer._repl_lock")
+        self._replica: BootstrapClient | None = None
+        self._live_sync_t = 0.0
         self._closed = False
         self._threads: list[threading.Thread] = []
         self._conn_ids = itertools.count()  # distinguishes rank-less clients
@@ -188,17 +208,41 @@ class BootstrapServer:
                     continue
                 except OSError:
                     return  # client went away
-                conn.send(json.dumps(self._handle(req, conn_id)).encode())
+                resp = self._dispatch(req, conn_id)
+                if resp is None or self._closed:
+                    # the dispatcher dropped the conversation (a proxy
+                    # whose upstream is gone) or the store closed under
+                    # us: close the conn instead of answering, so the
+                    # client's reconnect-replay/failover path — not an
+                    # error reply it may not expect — takes over
+                    return
+                conn.send(json.dumps(resp).encode())
                 if req.get("op") == "bye":
                     return
         finally:
             conn.close()
+
+    def _dispatch(self, req: dict, conn_id: int) -> dict:
+        """Serve one request: the locked table mutation (``_handle``),
+        then — OUTSIDE the table lock — the replica forward. Ordering is
+        the replication contract: the client's ack is sent only after
+        the forward returns, so an acked critical mutation is on the
+        replica (or the replica has been declared dead and detached —
+        the one weakening, recorded on the flight timeline). Subclasses
+        (``NodeProxyStore``) override this to route between local
+        termination and upstream forwarding; returning ``None`` makes
+        ``_serve`` drop the conversation instead of replying."""
+        resp = self._handle(req, conn_id)
+        self._replicate(req, resp, conn_id)
+        return resp
 
     def _handle(self, req: dict, conn_id: int = -1) -> dict:
         op = req.get("op")
         rank = req.get("rank")
         scope = req.get("scope", "")
         with self._lock:
+            self._served_n += 1
+            self._served_by_op[op] = self._served_by_op.get(op, 0) + 1
             if rank is not None:
                 self._last_seen[(scope, int(rank))] = time.monotonic()
             if op == "set":
@@ -235,6 +279,50 @@ class BootstrapServer:
                                  if sc == scope}}
             if op == "hb":
                 return {"ok": True}  # the stamp above was the point
+            if op == "hb_bulk":
+                # condensed liveness: a node proxy (or a replicating
+                # primary) delivers its whole table in ONE round-trip —
+                # ``scopes`` maps scope -> {rank: age_s}, stamped back
+                # into monotonic time, never regressing a fresher stamp
+                # (the rank may have spoken here directly since the
+                # sender snapshotted). ``kv`` carries the batched beat
+                # keys so cross-node neighbour watching reads them from
+                # one place.
+                now = time.monotonic()
+                for sc, ages in (req.get("scopes") or {}).items():
+                    for r, age in ages.items():
+                        k = (sc, int(r))
+                        t = now - max(0.0, float(age))
+                        if t > self._last_seen.get(k, float("-inf")):
+                            self._last_seen[k] = t
+                self._kv.update(req.get("kv") or {})
+                return {"ok": True}
+            if op == "barrier_bulk":
+                # condensed arrivals: idempotent per rank set like
+                # barrier_arrive, so a replayed or re-flushed batch can
+                # never double-count
+                self._barriers.setdefault(req["key"], set()).update(
+                    int(r) for r in req.get("ranks", ()))
+                return {"ok": True}
+            if op == "sync":
+                # replica bootstrap (attach_replica): merge one batch of
+                # the primary's critical state. Non-destructive on
+                # purpose — a mutation forwarded DURING the attach
+                # window may already be here and is newer than the
+                # snapshot, so kv fills gaps only, barriers union, and
+                # liveness keeps the freshest stamp.
+                for k, v in (req.get("kv") or {}).items():
+                    self._kv.setdefault(k, v)
+                for k, ranks in (req.get("barriers") or {}).items():
+                    self._barriers.setdefault(k, set()).update(
+                        int(r) for r in ranks)
+                now = time.monotonic()
+                for sc, r, age in req.get("ages", ()):
+                    k = (sc, int(r))
+                    t = now - max(0.0, float(age))
+                    if t > self._last_seen.get(k, float("-inf")):
+                        self._last_seen[k] = t
+                return {"ok": True}
             if op == "prune":
                 # epoch-bump hygiene (ProcessGroup.heal): drop the named
                 # rank ids' liveness stamps for this scope and their
@@ -302,6 +390,131 @@ class BootstrapServer:
                 return {"ok": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
+    def stats(self) -> dict:
+        """The per-shard server-side ops ledger: how many requests this
+        store actually served, by op — the counterpart of the client-side
+        ``metrics.STORE`` ledger, counted where the load lands."""
+        with self._lock:
+            return {"served": self._served_n,
+                    "by_op": dict(self._served_by_op)}
+
+    def attach_replica(self, handle: str, timeout_s: float = 10.0) -> None:
+        """Attach the deterministic successor's store as this primary's
+        replica (DESIGN.md §5n): dial it, merge-sync the current critical
+        state (``keyspace.REPLICATED`` kv, their barrier arrivals, the
+        liveness table), and from then on forward every critical mutation
+        before acking it. The replica pointer is installed BEFORE the
+        snapshot is taken, so a racing mutation either sees the pointer
+        and forwards or lands in the snapshot — possibly both, which the
+        replica's non-destructive ``sync`` absorbs (kv fills gaps only,
+        so the forwarded/newer value wins). ``timeout_s`` bounds the
+        whole attach (dial plus every sync batch)."""
+        deadline = time.monotonic() + timeout_s
+        client = BootstrapClient(handle, rank=None, timeout_s=timeout_s,
+                                 traffic_class="replication")
+        try:
+            with self._repl_lock:
+                self._replica = client
+            with self._lock:
+                kv = {k: v for k, v in self._kv.items()
+                      if keyspace.replicated(k.partition("#chunk/")[0])}
+                barriers = {k: sorted(r for r in arr if isinstance(r, int))
+                            for k, arr in self._barriers.items()
+                            if keyspace.replicated(k)}
+                now = time.monotonic()
+                ages = [[sc, r, max(0.0, now - t)]
+                        for (sc, r), t in self._last_seen.items()]
+            with self._repl_lock:
+                batch, size = {}, 0
+                items = sorted(kv.items())
+                for i, (k, v) in enumerate(items):
+                    batch[k] = v
+                    size += len(k) + len(v)
+                    if size >= 32 << 10 or i == len(items) - 1:
+                        client._rpc(op="sync", kv=batch,
+                                    _budget_s=max(
+                                        0.1, deadline - time.monotonic()))
+                        batch, size = {}, 0
+                client._rpc(op="sync", barriers=barriers, ages=ages,
+                            _budget_s=max(0.1, deadline - time.monotonic()))
+        except (OSError, TimeoutError) as e:
+            with self._repl_lock:
+                self._replica = None
+            _close_quietly(client)
+            _FLIGHT.record("store-replica-abort", error=type(e).__name__)
+            raise
+        # no snapshot sizes in the event args: how many keys/barriers
+        # happened to exist at attach time is wall-clock-shaped (racing
+        # barrier arrivals land before or after the snapshot), and this
+        # event rides the replay-equal STORELOG digest — table sizes are
+        # queryable from the replica's stats() when a postmortem wants
+        # them
+        _FLIGHT.record("store-replica-attach")
+
+    def _drop_replica(self, err: Exception) -> None:
+        """Declare the replica dead and stop forwarding — the one
+        weakening of acked⇒replicated, always on the flight timeline."""
+        with self._repl_lock:
+            repl, self._replica = self._replica, None
+        if repl is not None:
+            _close_quietly(repl)
+            _FLIGHT.record("store-replica-abort", error=type(err).__name__)
+
+    def _replicate(self, req: dict, resp: dict, conn_id: int = -1) -> None:
+        """Forward one served mutation to the attached replica (called
+        from ``_dispatch`` AFTER ``_handle`` released the table lock).
+        Only ``keyspace.replicated`` namespaces forward; a ``setnx``
+        forwards the WINNING value as a plain set so the replica
+        converges regardless of forward interleaving. Piggybacked on the
+        same serialized forward: a condensed liveness sync at most every
+        ``_REPL_LIVE_S`` — the replica's table stays warm enough that a
+        post-failover ``dead_ranks`` names only the actually-dead."""
+        op = req.get("op")
+        fwd = None
+        if op in ("set", "setnx"):
+            key = req.get("key", "")
+            if resp.get("ok") and keyspace.replicated(
+                    key.split("#chunk/", 1)[0]):
+                fwd = {"op": "set", "key": key,
+                       "value": (req["value"] if op == "set"
+                                 else resp["value"])}
+        elif op == "barrier_arrive":
+            key = req.get("key", "")
+            if keyspace.replicated(key):
+                rank = req.get("rank")
+                # rank-less arrivals replicate under a synthetic id
+                # derived from the (stable for this conversation) conn
+                # id — counts stay right after a failover even for
+                # observer-style callers
+                fwd = {"op": "barrier_bulk", "key": key,
+                       "ranks": [int(rank) if rank is not None
+                                 else -(conn_id + 1)]}
+        elif op == "prune":
+            fwd = dict(req)
+        try:
+            with self._repl_lock:
+                repl = self._replica
+                if repl is None:
+                    return
+                now = time.monotonic()
+                live_due = now - self._live_sync_t >= self._REPL_LIVE_S
+                if fwd is None and not live_due:
+                    return
+                if live_due:
+                    self._live_sync_t = now
+                    with self._lock:
+                        snap = dict(self._last_seen)
+                    scopes: dict[str, dict] = {}
+                    for (sc, r), t in snap.items():
+                        scopes.setdefault(sc, {})[str(r)] = \
+                            max(0.0, now - t)
+                    repl._rpc(op="hb_bulk", scopes=scopes,
+                              _budget_s=self._REPL_TIMEOUT_S)
+                if fwd is not None:
+                    repl._rpc(_budget_s=self._REPL_TIMEOUT_S, **fwd)
+        except (OSError, TimeoutError) as e:
+            self._drop_replica(e)
+
     def wait_idle(self, timeout_s: float = 5.0) -> None:
         """Block until every client connection has wound down (sent ``bye``
         or disconnected) — the orderly-shutdown handshake: close the server
@@ -314,6 +527,14 @@ class BootstrapServer:
 
     def close(self):
         self._closed = True
+        # detach the replica link first (clean bye, no abort event): its
+        # connection counts against the REPLICA's own wait_idle, and a
+        # closing primary must not pin the surviving sidecar open
+        with self._repl_lock:
+            repl, self._replica = self._replica, None
+        if repl is not None:
+            with contextlib.suppress(Exception):
+                repl.close()
         # join the acceptor BEFORE closing the listener: it may be blocked
         # inside accept() on the native handle, and rtcp_close_listener
         # frees that handle — close-under-accept is a use-after-free, and
@@ -329,6 +550,188 @@ class BootstrapServer:
         self.close()
 
 
+class NodeProxyStore(BootstrapServer):
+    """Per-node shard of the bootstrap store (DESIGN.md §5n): the node's
+    elected agent hosts one of these, its ranks point their store
+    clients here, and the heartbeat/telemetry fan-in that used to land
+    O(world) on the primary's one socket terminates locally.
+
+    Termination rule (``keyspace.proxy_local``): heartbeat stamps, the
+    watchdog's per-rank beat keys, barrier arrivals, and the node's own
+    per-rank fleet snapshots are served from the proxy's tables; what
+    the rest of the fleet must see (beats for cross-node neighbour
+    watching, barrier arrivals) is batched upstream as ONE condensed
+    ``hb_bulk``/``barrier_bulk`` per flush window — per-node, not
+    per-rank, round-trips. Everything else (rendezvous, elections,
+    heal/grow admission, liveness QUERIES — the global table lives
+    upstream) forwards verbatim under the proxy's serialized upstream
+    client, which carries the caller's rank so the primary's liveness
+    stamping still sees the true origin.
+
+    Survivability composes: the upstream client accepts the same
+    ``arm_failover`` successor list as any other, so a primary death
+    re-points the whole node through its proxy in one place, and a
+    proxy death re-points only that node's ranks (their clients' own
+    failover lists name the primary) — no other node's traffic moves."""
+
+    def __init__(self, upstream: str, node: int, flush_s: float = 0.25,
+                 timeout_s: float = 10.0, port: int = 0,
+                 host: str | None = None, failover=()):
+        self.node = node
+        self._flush_s = flush_s
+        self._up_timeout_s = timeout_s
+        self._up_lock = _lockwitness.make_lock(
+            "bootstrap.py::NodeProxyStore._up_lock")
+        self._up = BootstrapClient(upstream, rank=None, timeout_s=timeout_s,
+                                   traffic_class="proxy-upstream",
+                                   tag=f"proxy-up/{node}")
+        if failover:
+            self._up.arm_failover(list(failover))
+        self._pending_beats: dict[str, str] = {}   # beat key -> value
+        self._pending_barriers: dict[str, set] = {}
+        self._last_flush = time.monotonic()
+        self.forwarded = 0
+        self.flushes = 0
+        super().__init__(n_ranks=0, port=port, host=host)
+
+    def _dispatch(self, req: dict, conn_id: int) -> dict:
+        op = req.get("op")
+        if op in ("set", "setnx", "get"):
+            loc = keyspace.proxy_local(req.get("key", ""))
+            if loc is not None:
+                if op == "get":
+                    resp = self._handle(req, conn_id)
+                    if resp.get("ok"):
+                        self._maybe_flush()
+                        return resp
+                    # absent in this shard: the key may belong to
+                    # ANOTHER node (cross-node neighbour watching reads
+                    # the boundary ranks' beats) — the condensed copy
+                    # lives upstream, at most one flush window stale
+                    return self._forward(req)
+                resp = self._handle(req, conn_id)
+                if loc == "beat" and op == "set":
+                    with self._lock:
+                        self._pending_beats[req["key"]] = req["value"]
+                self._maybe_flush()
+                return resp
+            return self._forward(req)
+        if op in ("hb", "bye"):
+            resp = self._handle(req, conn_id)  # local stamp is the point
+            self._maybe_flush()
+            return resp
+        if op == "barrier_arrive":
+            resp = self._handle(req, conn_id)  # idempotent local record
+            rank = req.get("rank")
+            with self._lock:
+                self._pending_barriers.setdefault(
+                    req["key"], set()).add(
+                        int(rank) if rank is not None else -(conn_id + 1))
+            return resp
+        if op == "barrier_done":
+            # a done-poll implies "my node's arrivals must be upstream":
+            # flush pending arrivals inline first, so barrier latency is
+            # one poll interval, not one flush window
+            self._flush_now(self._up_timeout_s)
+            return self._forward(req)
+        if op == "prune":
+            self._handle(req, conn_id)  # sweep the local shard too
+            return self._forward(req)
+        return self._forward(req)
+
+    def _stamp(self, req: dict) -> None:
+        rank, scope = req.get("rank"), req.get("scope", "")
+        if rank is not None:
+            with self._lock:
+                self._last_seen[(scope, int(rank))] = time.monotonic()
+
+    def _forward(self, req: dict, timeout_s: float | None = None) -> dict:
+        """One verbatim upstream round-trip (serialized — the upstream
+        client is lockstep). The caller's rank rides along, so the
+        primary's implicit liveness stamping is unchanged for the
+        low-frequency ops that still reach it. Upstream failure (after
+        the upstream client's own reconnect/failover budget) surfaces
+        by DROPPING the caller's conversation (``None`` return — see
+        ``_serve``): the client's own reconnect-replay/failover path
+        decides what answers next, and the abort is on the flight
+        timeline. A proxy with no store left is degraded, not wedged."""
+        self._stamp(req)
+        budget = self._up_timeout_s if timeout_s is None else timeout_s
+        with self._up_lock:
+            self.forwarded += 1
+            try:
+                return self._up._rpc(_budget_s=budget, **req)
+            except (OSError, TimeoutError) as e:
+                _FLIGHT.record("store-proxy-abort", node=self.node,
+                               op=req.get("op"), error=type(e).__name__)
+                return None
+
+    def _maybe_flush(self) -> None:
+        if time.monotonic() - self._last_flush >= self._flush_s:
+            self._flush_now(self._up_timeout_s)
+
+    def flush(self, timeout_s: float | None = None) -> None:
+        """Push the condensed window upstream now: one ``hb_bulk`` with
+        the node's whole liveness table plus batched beat keys, and one
+        ``barrier_bulk`` per barrier with pending arrivals. Failed
+        batches re-merge (arrivals MUST not be lost; ages are refreshed
+        next window anyway). ``timeout_s`` bounds the whole flush."""
+        self._flush_now(self._up_timeout_s if timeout_s is None
+                        else timeout_s)
+
+    def _flush_now(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            beats = dict(self._pending_beats)
+            self._pending_beats.clear()
+            barriers = {k: set(v)
+                        for k, v in self._pending_barriers.items() if v}
+            self._pending_barriers.clear()
+            now = time.monotonic()
+            scopes: dict[str, dict] = {}
+            for (sc, r), t in self._last_seen.items():
+                scopes.setdefault(sc, {})[str(r)] = max(0.0, now - t)
+        self._last_flush = time.monotonic()
+        with self._up_lock:
+            self.flushes += 1
+            try:
+                if scopes or beats:
+                    self._up._rpc(op="hb_bulk", scopes=scopes, kv=beats,
+                                  _budget_s=max(
+                                      0.1, deadline - time.monotonic()))
+                for k, ranks in sorted(barriers.items()):
+                    self._up._rpc(op="barrier_bulk", key=k,
+                                  ranks=sorted(ranks),
+                                  _budget_s=max(
+                                      0.1, deadline - time.monotonic()))
+                    barriers.pop(k)
+            except (OSError, TimeoutError) as e:
+                with self._lock:
+                    for k, ranks in barriers.items():
+                        self._pending_barriers.setdefault(
+                            k, set()).update(ranks)
+                _FLIGHT.record("store-proxy-abort", node=self.node,
+                               op="flush", error=type(e).__name__)
+
+    def arm_upstream_failover(self, handles) -> None:
+        """Name the upstream successor list (the replica): a primary
+        death re-points this whole node's traffic in one place."""
+        with self._up_lock:
+            self._up.arm_failover(list(handles))
+
+    def stats(self) -> dict:
+        s = super().stats()
+        s["forwarded"] = self.forwarded
+        s["flushes"] = self.flushes
+        return s
+
+    def close(self):
+        with contextlib.suppress(Exception):
+            with self._up_lock:
+                self._up.close()
+        super().close()
+
+
 class BootstrapClient:
     """One rank's connection to the store.
 
@@ -341,7 +744,8 @@ class BootstrapClient:
     the wire protocol is strict request→reply lockstep."""
 
     def __init__(self, handle: str, rank: int, timeout_s: float = 30.0,
-                 scope: str = "", traffic_class: str = "rendezvous"):
+                 scope: str = "", traffic_class: str = "rendezvous",
+                 failover=(), fault_schedule=None, tag: str | None = None):
         self.rank = rank
         self.timeout_s = timeout_s
         # liveness namespace: clients of one group pass one scope (the
@@ -354,8 +758,24 @@ class BootstrapClient:
         # "telemetry-read", the wiring/heal client "rendezvous"
         self.traffic_class = traffic_class
         self._handle = handle
+        # the ordered successor list (arm_failover): where to re-point
+        # when the current store stops answering — the survivable-store
+        # half of DESIGN.md §5n. ``tag`` names THIS connection in the
+        # deterministic store-failover flight events (ranks own several
+        # clients; digests must not depend on which one noticed first).
+        self._failover: list[str] = [h for h in failover
+                                     if h and h != handle]
+        self._tag = tag
+        self._faults = fault_schedule
         self._said_bye = False
-        self._qp = self._dial(timeout_s)
+        self._qp = (self._redial(time.monotonic() + timeout_s)
+                    if self._failover else self._dial(timeout_s))
+
+    def arm_failover(self, handles) -> None:
+        """Name the successor stores, in election order (today: the one
+        replica). Takes effect on the NEXT reconnect — the live
+        connection is never torn down preemptively."""
+        self._failover = [h for h in handles if h and h != self._handle]
 
     def _dial(self, timeout_s: float):
         # refused dials retry with backoff: rank 0 may still be binding the
@@ -365,6 +785,51 @@ class BootstrapClient:
                 self._handle, min(5.0, timeout_s)),
             timeout_s, f"bootstrap dial {self._handle}",
             retry_on=(OSError,))
+
+    def _redial(self, deadline: float):
+        """Reconnect, rotating through the armed successor list: the
+        current target gets a short dial budget per sweep, then each
+        successor in order; sweeps repeat under the shared jittered
+        backoff until the deadline. A successful dial to a successor
+        RE-POINTS the client (sticky — the old primary is dead, not
+        slow; the epoch discipline fences anything it might still say)
+        and leaves a deterministic ``store-failover`` event. With no
+        successors armed this is exactly the old single-target dial."""
+        if not self._failover:
+            return self._dial(max(0.1, deadline - time.monotonic()))
+        back = poll_backoff()
+        last: Exception | None = None
+        while True:
+            for h in [self._handle, *self._failover]:
+                # short per-target budget: the native dial retries
+                # refusals INTERNALLY until its timeout, so this budget
+                # is the floor on how long a dead target delays the
+                # sweep reaching the live successor
+                budget = min(0.35, max(0.1, deadline - time.monotonic()))
+                try:
+                    qp = native.TcpQueuePair.connect(h, budget)
+                except (OSError, TimeoutError) as e:
+                    last = e
+                    continue
+                try:
+                    if h != self._handle:
+                        self._failover = [x for x in self._failover
+                                          if x != h]
+                        self._handle = h
+                        _FLIGHT.record("store-failover", rank=self.rank,
+                                       tag=self._tag)
+                except BaseException:
+                    qp.close()
+                    _FLIGHT.record("store-dial-abort", rank=self.rank,
+                                   tag=self._tag)
+                    raise
+                return qp
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"bootstrap redial: no store answered "
+                    f"(primary + {len(self._failover)} successor(s)): "
+                    f"{last!r}") from last
+            back.pause()
 
     def _rpc(self, _budget_s: float | None = None, **req) -> dict:
         """One request→reply, surviving a dropped/hung connection by
@@ -397,6 +862,14 @@ class BootstrapClient:
                    or getattr(_TRAFFIC_TLS, "cls", None)
                    or self.traffic_class)
         payload = json.dumps(req).encode()
+        # seeded fault injection (FaultSchedule.store_fault): drop the
+        # live connection BEFORE the Nth store round-trip of this rank,
+        # so the reconnect-replay path below runs at a deterministic,
+        # replay-equal coordinate — the store plane's analogue of the
+        # data plane's op_fault
+        if self._faults is not None and self._faults.store_fault():
+            with contextlib.suppress(OSError):
+                self._qp.close()
         deadline = time.monotonic() + (self.timeout_s if _budget_s is None
                                        else max(0.0, _budget_s))
         back = None  # built on the FIRST failure: the happy path (every
@@ -428,8 +901,12 @@ class BootstrapClient:
                     self._qp.close()
                 except OSError:
                     pass
-                self._qp = self._dial(
-                    max(0.1, deadline - time.monotonic()))
+                # re-dial rotates through any armed successors: the
+                # replayed request lands wherever the control plane
+                # still answers (every op is idempotent per rank — the
+                # replay-over-failover guarantee is the same one
+                # reconnect-replay always had)
+                self._qp = self._redial(deadline)
 
     def set(self, key: str, value: str,
             timeout_s: float | None = None) -> None:
@@ -612,11 +1089,19 @@ class BootstrapClient:
 
     def close(self):
         try:
-            self._said_bye = True  # no reconnect-replay past this point
-            self._rpc(op="bye")
+            # deliver the goodbye to whoever still answers: with
+            # successors armed the bye itself may rotate once (small
+            # bounded budget — the bye clears this rank's liveness
+            # claim, and the SURVIVOR store is the one that must see
+            # it, or it later brands the departed rank dead). Without
+            # successors: one bounded try, never a full-timeout stall
+            # against a store that already died.
+            self._rpc(op="bye", _budget_s=1.0 if self._failover else 0.0)
         except Exception:
             pass
-        self._qp.close()
+        finally:
+            self._said_bye = True  # no reconnect-replay past this point
+            self._qp.close()
 
     def __enter__(self):
         return self
@@ -636,7 +1121,8 @@ def _close_quietly(res) -> None:
 
 
 def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
-                   timeout_s: float = 30.0, ns: str = "ring"):
+                   timeout_s: float = 30.0, ns: str = "ring",
+                   failover=(), fault_schedule=None):
     """Wire the ring every net collective here expects, from ONE shared
     address: listen, publish my handle, dial my successor, accept my
     predecessor. Returns ``(send_comm, recv_comm, client)`` — close the
@@ -651,10 +1137,19 @@ def bootstrap_ring(net, store_handle: str, rank: int, n_ranks: int,
 
     ``ns`` namespaces this ring's store keys: distinct groups sharing one
     long-lived store MUST use distinct namespaces (keys and barrier
-    counters persist for the store's lifetime)."""
+    counters persist for the store's lifetime).
+
+    ``failover``: replica handles for the survivable-store rotation
+    (DESIGN.md §5n) — a ring wired AFTER a primary's death (a healed
+    hierarchy rebuilding its sub-rings) must not hang dialing the dead
+    handle. ``fault_schedule``: the seeded chaos schedule whose
+    ``store_conn_drop_ops`` sever this client's connection at
+    deterministic points of its own RPC stream."""
     deadline = time.monotonic() + timeout_s
     remaining = lambda: max(0.1, deadline - time.monotonic())
-    client = BootstrapClient(store_handle, rank, timeout_s, scope=ns)
+    client = BootstrapClient(store_handle, rank, timeout_s, scope=ns,
+                             failover=failover,
+                             fault_schedule=fault_schedule)
     listener = send_comm = recv_comm = None
     try:
         handle, listener = net.listen()

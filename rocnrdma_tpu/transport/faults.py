@@ -110,6 +110,20 @@ class FaultSchedule:
     chan_test_delay_p: dict | None = None   # lane -> completion-delay prob
     #   (overrides the global test_delay_p for that lane's receives;
     #   draws come from the lane's own rng stream)
+    # store-plane faults (ISSUE 20, the survivable-control-plane
+    # surface). None of these are vtable verbs — like join_fault they
+    # are consulted directly by the store layer: store_conn_drop_ops by
+    # ``BootstrapClient._rpc`` (drop the live connection BEFORE the Nth
+    # store round-trip of THIS rank — the reconnect-replay/failover
+    # path runs at a deterministic coordinate of the rank's own
+    # store-op stream), and the two close knobs by the DATA-op stream
+    # (``op_fault``): at op N the armed server (the primary a
+    # store-hosting rank runs, or a node's proxy) is closed abruptly —
+    # keyed on the host rank's own op sequence, never wall clock, so a
+    # store-death chaos run replays byte-for-byte.
+    store_conn_drop_ops: tuple = ()        # drop conn before store op N
+    store_close_after_ops: int | None = None  # close armed store AT op N
+    proxy_close_after_ops: int | None = None  # close armed proxy AT op N
     # chronic degradation (ISSUE 16, armed via :meth:`degrade_rank`):
     # EVERY irecv completion past ``after_ops`` data ops is held for a
     # FIXED ``factor`` extra polls — slow-but-alive, the straggler the
@@ -129,6 +143,11 @@ class FaultSchedule:
         self._test_draws = 0
         self._close_draws = 0
         self._degrade_draws = 0
+        self._store_ops = 0
+        self.store_conn_drop_ops = tuple(
+            int(n) for n in (self.store_conn_drop_ops or ()))
+        self._store_close_fn = None
+        self._proxy_close_fn = None
         self._rngs: dict[str, random.Random] = {}
         # per-lane streams (see the chan_* knobs): each lane's own data-op
         # and completion-draw counters — the coordinates its injections
@@ -248,7 +267,62 @@ class FaultSchedule:
         lane's OWN op counter, so the decision is independent of how
         other lanes' traffic interleaves (replay-equal per seed)."""
         with self._lock:
-            return self._op_fault_locked(verb, lane)
+            mode = self._op_fault_locked(verb, lane)
+            fire = self._store_deaths_due_locked(verb)
+        # the armed closes run OUTSIDE the schedule lock: close() joins
+        # server threads, and a join under the decision lock would hold
+        # every other lane's fault decisions hostage to the teardown
+        for fn in fire:
+            fn()
+        return mode
+
+    def arm_store_death(self, close_fn) -> None:
+        """Arm ``store_close_after_ops``: at data op N of THIS rank,
+        ``close_fn`` (the primary store's close) runs — the
+        store-hosting rank's store dies at a deterministic point of its
+        own op sequence while the rank itself lives. The hard-death
+        variant (host rank AND store die together) is the existing
+        ``kill_after_ops`` on the hosting rank."""
+        with self._lock:
+            self._store_close_fn = close_fn
+
+    def arm_proxy_death(self, close_fn) -> None:
+        """Arm ``proxy_close_after_ops``: same discipline for a node's
+        proxy store — only that node's ranks lose their shard and must
+        re-point through their armed failover lists."""
+        with self._lock:
+            self._proxy_close_fn = close_fn
+
+    def _store_deaths_due_locked(self, verb: str) -> list:
+        fire = []
+        if (self._store_close_fn is not None
+                and self.store_close_after_ops is not None
+                and self.ops >= self.store_close_after_ops):
+            fire.append(self._store_close_fn)
+            self._store_close_fn = None
+            self.record("store-closed", verb)
+        if (self._proxy_close_fn is not None
+                and self.proxy_close_after_ops is not None
+                and self.ops >= self.proxy_close_after_ops):
+            fire.append(self._proxy_close_fn)
+            self._proxy_close_fn = None
+            self.record("proxy-closed", verb)
+        return fire
+
+    def store_fault(self) -> bool:
+        """One store round-trip of this rank's client
+        (``BootstrapClient._rpc``): True when the live connection must
+        be dropped FIRST — the reconnect-replay (and, with failover
+        armed, re-point) path runs at this coordinate of the rank's own
+        store-op stream. Deterministic like every other decision here:
+        the counter advances once per call, never by wall clock."""
+        with self._lock:
+            self._store_ops += 1
+            if self._store_ops in self.store_conn_drop_ops:
+                self.record("store-conn-dropped", self._store_ops,
+                            coord=self._store_ops)
+                return True
+            return False
 
     def _op_fault_locked(self, verb: str, lane: str | None) -> str | None:
         self.ops += 1

@@ -40,6 +40,9 @@ NAMESPACES = {
     "join": "elastic-grow joiner registry ({slot,admit,h}/<sid>)",
     "split": "split rendezvous, counter-suffixed (split<N>/...)",
     "shrink": "shrink rendezvous, counter-suffixed (shrink<N>/...)",
+    "store": "control-plane-of-the-control-plane: replica handle "
+             "(store/replica), primary election (store/primary/e<N>), "
+             "per-node proxy handles (store/proxy/e<N>/<node>)",
     "destroy": "teardown barrier",
     "e": "epoch-direct keys: barrier waves (e<N>/{b,mb}<i>) and p2p "
          "resume handles (e<N>/p2p/<lo>-<hi>)",
@@ -57,6 +60,68 @@ EPOCH_QUALIFIED = frozenset({"hier", "heal", "evade", "hb", "fleet",
 # the two standby registries (ProcessGroup._scan_standby_registry et al.
 # address them through registry_ns, never through raw f-strings)
 REGISTRIES = ("spares", "join")
+
+# namespaces whose kv mutations a primary store forwards to its attached
+# replica (DESIGN.md §5n): the state an in-flight heal/grow needs to
+# COMPLETE after the primary dies — admission registries, rendezvous
+# handles, grow generations, the nodemap, and the store plane's own
+# election keys. Deliberately NOT replicated: hb (liveness regenerates —
+# every surviving client's first post-failover RPC re-stamps it within
+# one watchdog tick), fleet/evade/hier/deviceheal/e (telemetry and
+# per-epoch scratch; best-effort by contract, re-published next tick or
+# re-minted under the next epoch).
+REPLICATED = frozenset({"ring", "nodemap", "heal", "grow", "spares",
+                        "join", "split", "shrink", "destroy", "store"})
+
+
+def replicated(key: str) -> bool:
+    """True iff a kv mutation on ``key`` must reach the replica before
+    the primary acks it (see REPLICATED). Never raises — the server
+    consults it per mutation and a malformed key simply isn't critical."""
+    if not key.startswith(GROUP_PREFIX):
+        return False
+    parts = key.split("/")
+    if len(parts) < 3:
+        return False
+    return namespace_of(parts[2]) in REPLICATED
+
+
+def proxy_local(key: str) -> str | None:
+    """Per-node proxy termination rule: which keys a ``NodeProxyStore``
+    may serve from its OWN tables instead of forwarding upstream.
+
+    Returns ``"beat"`` for watchdog heartbeat-beat keys
+    (``hb/e<N>/<rank>`` — stored locally AND batched upstream in the
+    next condensed flush, so cross-node neighbour watching still sees
+    them), ``"local"`` for per-rank fleet snapshot keys
+    (``fleet/e<N>/<orig>`` — read back only by the node's own agent;
+    never forwarded, the agent's tree digest is the condensed upstream
+    form), and ``None`` for everything else (forward verbatim). The
+    hb plane's shared flags (``dead/<p>``, ``dead_v``) and the fleet
+    tree/meta keys are global state every node must see — always
+    ``None``."""
+    if not key.startswith(GROUP_PREFIX):
+        return None
+    parts = key.split("/")
+    if len(parts) < 4:
+        return None
+    ns = namespace_of(parts[2])
+    if ns == "hb":
+        # pg/<g>/hb/e<N>/<rank> is a beat; dead/<p> and dead_v are global
+        if len(parts) == 5 and parts[4].isdigit():
+            return "beat"
+        return None
+    if ns == "fleet":
+        # pg/<g>/fleet/e<N>/<orig> is node-local; tree/<i> and meta are
+        # the condensed/global layer (chunk parts inherit the base key's
+        # locality so a chunked snapshot stays whole on one store)
+        base = key.split("#chunk/", 1)[0]
+        bparts = base.split("/")
+        if len(bparts) == 5 and bparts[3].startswith("e") \
+                and bparts[4].isdigit():
+            return "local"
+        return None
+    return None
 
 
 def namespace_of(token: str) -> str:

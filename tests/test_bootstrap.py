@@ -101,6 +101,14 @@ send, recv, client = bootstrap_ring(net, store, rank, n, timeout_s=60)
 local = np.full(30000, float(rank + 1), np.float32)
 got = ring_allreduce_over_net(net, send, recv, local, rank, n)
 assert np.allclose(got, sum(range(1, n + 1))), got[:4]
+# teardown discipline, same as ProcessGroup.destroy: arrive at a store
+# barrier BEFORE closing the wire. A rank whose last ring op is a SEND
+# completes locally (kernel buffer) while its peers still stream; closing
+# a socket that holds unread inbound bytes RSTs it, and an RST discards
+# the closing side's QUEUED outbound data too -- the peer then dies on
+# "peer closed/reset" mid-collective. The barrier pins every rank past
+# its last wire read first.
+client.barrier("done", n, timeout_s=60)
 client.close(); net.close()
 print(f"rank {rank} OK", flush=True)
 """

@@ -1,0 +1,566 @@
+"""Survivable sharded control plane (ISSUE 20): per-node proxy stores,
+primary->replica replication, client failover, and the store-plane fault
+injections — the threaded half of the chaos gate (the process-killing
+half lives in test_store_failover.py)."""
+
+import threading
+import time
+
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.transport import (
+    BootstrapClient,
+    BootstrapServer,
+    FaultSchedule,
+    NodeProxyStore,
+)
+from rocnrdma_tpu.transport import keyspace
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable")
+
+
+# ---------------------------------------------------------------------------
+# keyspace predicates: the two routing tables the sharded store runs on
+# ---------------------------------------------------------------------------
+
+
+def test_replicated_namespaces_cover_heal_admission_not_telemetry():
+    # what an in-flight heal needs post-failover replicates...
+    for key in ("pg/g/spares/slot/0", "pg/g/join/admit/1",
+                "pg/g/grow/g2/members", "pg/g/heal/e3/alive/1",
+                "pg/g/ring/h/0", "pg/g/nodemap/map",
+                "pg/g/store/primary/e1", "pg/g/shrink2/ack/0"):
+        assert keyspace.replicated(key), key
+    # ...regenerating/best-effort state does not
+    for key in ("pg/g/hb/e0/3", "pg/g/fleet/e0/7", "pg/g/evade/e1/plan",
+                "pg/g/hier/e0/g0/ready", "pg/g/e4/b0", "bare-key",
+                "pg/g/deviceheal/e0/coord"):
+        assert not keyspace.replicated(key), key
+
+
+def test_proxy_local_terminates_beats_and_snapshots_only():
+    assert keyspace.proxy_local("pg/g/hb/e2/17") == "beat"
+    assert keyspace.proxy_local("pg/g/fleet/e2/17") == "local"
+    # chunk parts inherit the base key's locality
+    assert keyspace.proxy_local("pg/g/fleet/e2/17#chunk/3") == "local"
+    # global state always forwards: dead flags, tree digests, meta,
+    # rendezvous, elections
+    for key in ("pg/g/hb/e2/dead/3", "pg/g/hb/e2/dead_v",
+                "pg/g/fleet/e2/tree/0", "pg/g/fleet/meta",
+                "pg/g/ring/h/0", "pg/g/spares/slot/0", "bare"):
+        assert keyspace.proxy_local(key) is None, key
+
+
+# ---------------------------------------------------------------------------
+# replication + failover
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_failover_preserves_critical_state_and_elections():
+    """The headline sequence: attach a replica, mutate, kill the primary
+    — the re-pointed client reads every critical key back, a replayed
+    election returns the ORIGINAL winner (first-writer-wins survives the
+    primary), and telemetry keys are honestly absent (documented
+    non-replicated)."""
+    prim = BootstrapServer(n_ranks=2)
+    repl = BootstrapServer(n_ranks=2)
+    c = BootstrapClient(prim.handle, 0, timeout_s=15.0, scope="pg/g/ring")
+    try:
+        c.set("pg/g/spares/slot/0", "sid0")          # pre-attach snapshot
+        assert c.set_if_absent("pg/g/store/primary/e0", "rank0") == "rank0"
+        prim.attach_replica(repl.handle, timeout_s=5.0)
+        c.set("pg/g/grow/g1/members", "[0,1]")       # post-attach forward
+        c.set("pg/g/fleet/e0/0", "snapshot")         # NOT critical
+        c.arm_failover([repl.handle])
+        prim.close()
+        t0 = time.monotonic()
+        assert c.try_get("pg/g/spares/slot/0", timeout_s=10.0) == "sid0"
+        wall = time.monotonic() - t0
+        assert wall < 5.0, f"failover took {wall:.1f}s"
+        assert c.try_get("pg/g/grow/g1/members", timeout_s=5.0) == "[0,1]"
+        assert c.try_get("pg/g/fleet/e0/0", timeout_s=5.0) is None
+        # the election's first writer stays won across the failover
+        assert c.set_if_absent("pg/g/store/primary/e0", "rank1") == "rank0"
+    finally:
+        c._said_bye = True
+        c._qp.close()
+        repl.close()
+
+
+@needs_native
+def test_failover_preserves_barrier_arrivals():
+    """Rank 0 arrives pre-death; rank 1 arrives post-failover on the
+    replica: the barrier completes with no double-arrive and no lost
+    arrival."""
+    prim = BootstrapServer(n_ranks=2)
+    repl = BootstrapServer(n_ranks=2)
+    prim.attach_replica(repl.handle, timeout_s=5.0)
+    a = BootstrapClient(prim.handle, 0, timeout_s=10.0, scope="pg/g/ring",
+                        failover=[repl.handle])
+    b = BootstrapClient(prim.handle, 1, timeout_s=10.0, scope="pg/g/ring",
+                        failover=[repl.handle])
+    try:
+        a._rpc(op="barrier_arrive", key="pg/g/ring/wired")
+        prim.close()
+        done = []
+        t = threading.Thread(target=lambda: (
+            a.barrier("pg/g/ring/wired", 2, timeout_s=15.0),
+            done.append("a")))
+        t.start()
+        b.barrier("pg/g/ring/wired", 2, timeout_s=15.0)
+        t.join(20.0)
+        assert done == ["a"], "rank 0's replicated arrival was lost"
+    finally:
+        for x in (a, b):
+            x._said_bye = True
+            x._qp.close()
+        repl.close()
+
+
+@needs_native
+def test_failover_liveness_names_only_the_dead():
+    """The condensed liveness sync keeps the replica's table warm: after
+    the primary (and its host rank 0) die, the survivors' post-failover
+    dead_ranks names rank 0 — not each other (the spurious-death source
+    a cold replica table would be) — once the survivors have re-stamped."""
+    prim = BootstrapServer(n_ranks=3)
+    repl = BootstrapServer(n_ranks=3)
+    sc = "pg/g/ring"
+    a = BootstrapClient(prim.handle, 0, timeout_s=10.0, scope=sc)
+    b = BootstrapClient(prim.handle, 1, timeout_s=10.0, scope=sc,
+                        failover=[repl.handle])
+    d = BootstrapClient(prim.handle, 2, timeout_s=10.0, scope=sc,
+                        failover=[repl.handle])
+    try:
+        for x in (a, b, d):
+            x.heartbeat()
+        prim.attach_replica(repl.handle, timeout_s=5.0)
+        b.set("pg/g/grow/g0/warm", "1")  # piggybacks the liveness sync
+        a._said_bye = True
+        a._qp.close()
+        prim.close()                     # rank 0 + primary die together
+        b.heartbeat()                    # re-points to the replica
+        d.heartbeat()
+        time.sleep(1.2)                  # rank 0's age climbs, b/d re-stamp
+        b.heartbeat(); d.heartbeat()
+        assert b.dead_ranks(3, max_age_s=1.0) == [0]
+    finally:
+        for x in (b, d):
+            x._said_bye = True
+            x._qp.close()
+        repl.close()
+
+
+@needs_native
+def test_replica_death_detaches_and_primary_lives_on():
+    """The documented weakening: the replica dying detaches it (flight
+    event) and the primary keeps serving — simultaneous primary+replica
+    death is the one thing §5n does not survive."""
+    prim = BootstrapServer(n_ranks=2)
+    repl = BootstrapServer(n_ranks=2)
+    c = BootstrapClient(prim.handle, 0, timeout_s=10.0, scope="pg/g/ring")
+    try:
+        prim.attach_replica(repl.handle, timeout_s=5.0)
+        repl.close()
+        deadline = time.monotonic() + 10.0
+        while prim._replica is not None and time.monotonic() < deadline:
+            c.set("pg/g/grow/g0/k", "v")  # forwards fail -> detach
+            time.sleep(0.05)
+        assert prim._replica is None, "dead replica never detached"
+        c.set("pg/g/grow/g0/k2", "v2")   # primary still serves
+        assert c.try_get("pg/g/grow/g0/k2") == "v2"
+    finally:
+        c.close()
+        prim.close()
+
+
+# ---------------------------------------------------------------------------
+# the per-node proxy
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_proxy_terminates_locally_and_condenses_upstream():
+    """Heartbeats, beat keys, and per-rank fleet snapshots stop at the
+    proxy; one flush later the beats and the node's liveness land
+    upstream as ONE hb_bulk — the primary's served-op count grows by
+    O(1) per window, not O(ranks_on_node)."""
+    prim = BootstrapServer(n_ranks=8)
+    px = NodeProxyStore(prim.handle, node=0, flush_s=60.0)  # manual flush
+    sc = "pg/g/ring"
+    clients = [BootstrapClient(px.handle, r, timeout_s=10.0, scope=sc)
+               for r in range(4)]
+    obs = BootstrapClient(prim.handle, None, timeout_s=10.0, scope=sc)
+    try:
+        base = prim.stats()["served"]
+        for r, c in enumerate(clients):
+            c.heartbeat()
+            c.set(f"pg/g/hb/e0/{r}", str(r))
+            c.set(f"pg/g/fleet/e0/{r}", f"snap{r}")
+        assert prim.stats()["served"] == base, \
+            "local termination leaked upstream"
+        # the node's own agent reads its ranks' snapshots from the proxy
+        assert clients[0].try_get("pg/g/fleet/e0/3") == "snap3"
+        px.flush(timeout_s=5.0)
+        served = prim.stats()["served"] - base
+        assert 1 <= served <= 2, f"condensed flush cost {served} ops"
+        for r in range(4):
+            assert obs.try_get(f"pg/g/hb/e0/{r}") == str(r)
+            assert obs.try_get(f"pg/g/fleet/e0/{r}") is None
+        # the proxied ranks are live in the GLOBAL table (scoped)
+        ages = obs.live_ages()
+        assert set(range(4)) <= set(ages), ages
+    finally:
+        for c in clients:
+            c.close()
+        obs.close()
+        px.close()
+        prim.close()
+
+
+@needs_native
+def test_proxy_forwards_rendezvous_and_completes_cross_shard_barrier():
+    """Rendezvous ops ride through verbatim (origin rank intact), and a
+    barrier spanning a proxied rank and a direct rank completes — the
+    done-poll flushes the node's pending arrivals inline."""
+    prim = BootstrapServer(n_ranks=4)
+    px = NodeProxyStore(prim.handle, node=0, flush_s=60.0)
+    sc = "pg/g/ring"
+    pc = BootstrapClient(px.handle, 0, timeout_s=10.0, scope=sc)
+    dc = BootstrapClient(prim.handle, 1, timeout_s=10.0, scope=sc)
+    try:
+        assert pc.set_if_absent("pg/g/store/primary/e0", "me") == "me"
+        assert dc.set_if_absent("pg/g/store/primary/e0", "no") == "me"
+        done = []
+        t = threading.Thread(target=lambda: (
+            pc.barrier("pg/g/ring/b", 2, timeout_s=15.0), done.append(1)))
+        t.start()
+        dc.barrier("pg/g/ring/b", 2, timeout_s=15.0)
+        t.join(20.0)
+        assert done, "cross-shard barrier hung"
+        s = px.stats()
+        assert s["forwarded"] >= 2 and s["served"] >= 1, s
+    finally:
+        pc.close()
+        dc.close()
+        px.close()
+        prim.close()
+
+
+@needs_native
+def test_proxy_death_repoints_only_its_node():
+    """Kill one node's proxy: that node's clients rotate to the primary
+    (their armed successor) and finish; another node's proxy and the
+    direct clients never notice — no cross-node disturbance."""
+    prim = BootstrapServer(n_ranks=4)
+    px0 = NodeProxyStore(prim.handle, node=0, flush_s=60.0)
+    px1 = NodeProxyStore(prim.handle, node=1, flush_s=60.0)
+    sc = "pg/g/ring"
+    c0 = BootstrapClient(px0.handle, 0, timeout_s=10.0, scope=sc,
+                         failover=[prim.handle])
+    c1 = BootstrapClient(px1.handle, 1, timeout_s=10.0, scope=sc,
+                         failover=[prim.handle])
+    try:
+        c0.set("pg/g/nodemap/a", "1")
+        c1.set("pg/g/nodemap/b", "2")
+        fwd1 = px1.stats()["forwarded"]
+        px0.close()
+        c0.set("pg/g/nodemap/a2", "3")   # re-points to the primary
+        assert c0.try_get("pg/g/nodemap/a2") == "3"
+        c1.set("pg/g/nodemap/b2", "4")   # still through its own proxy
+        assert px1.stats()["forwarded"] > fwd1
+        assert c1._handle == px1.handle, "node 1 re-pointed for no reason"
+        assert c0._handle == prim.handle
+    finally:
+        c0.close()
+        c1.close()
+        px1.close()
+        prim.close()
+
+
+@needs_native
+def test_proxy_upstream_failover_carries_whole_node():
+    """The other survivability axis: the PRIMARY dies, the proxy's own
+    upstream client rotates to the replica, and the node's ranks keep
+    talking to their proxy — zero client re-points."""
+    prim = BootstrapServer(n_ranks=2)
+    repl = BootstrapServer(n_ranks=2)
+    prim.attach_replica(repl.handle, timeout_s=5.0)
+    px = NodeProxyStore(prim.handle, node=0, flush_s=60.0,
+                        failover=[repl.handle])
+    c = BootstrapClient(px.handle, 0, timeout_s=15.0, scope="pg/g/ring")
+    try:
+        c.set("pg/g/grow/g0/pre", "1")
+        prim.close()
+        c.set("pg/g/grow/g0/post", "2")  # proxy re-points upstream
+        assert c.try_get("pg/g/grow/g0/pre") == "1"    # replicated
+        assert c.try_get("pg/g/grow/g0/post") == "2"
+        assert c._handle == px.handle, "client re-pointed; proxy should have"
+    finally:
+        c.close()
+        px.close()
+        repl.close()
+
+
+# ---------------------------------------------------------------------------
+# store-plane fault injection (satellite: prune guards + chunking under
+# faults, inherited by the sharded path)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_store_conn_drops_replay_equal_per_seed():
+    """Two same-seed runs of the same store-op sequence inject the same
+    drops at the same stream-local coordinates — fingerprint-equal, the
+    FaultSchedule contract extended to the store plane."""
+    def run():
+        sched = FaultSchedule(seed=11, rank=3,
+                              store_conn_drop_ops=(2, 5))
+        with BootstrapServer(n_ranks=1) as srv:
+            c = BootstrapClient(srv.handle, 3, timeout_s=15.0,
+                                scope="pg/g/ring", fault_schedule=sched)
+            for i in range(6):
+                c.set(f"pg/g/grow/g0/k{i}", str(i))
+            assert all(c.try_get(f"pg/g/grow/g0/k{i}") == str(i)
+                       for i in range(6))
+            c.close()
+        return sched.fingerprint(), len(sched.log)
+    fp1, n1 = run()
+    fp2, n2 = run()
+    assert fp1 == fp2 and n1 == 2, (fp1, fp2, n1, n2)
+
+
+@needs_native
+def test_prune_prefix_guard_holds_under_conn_drops():
+    """The prune guards (own-prefix only, registered namespaces only)
+    under injected connection drops: the replayed prune sweeps exactly
+    what a clean one would — no more (the guard), no less (the replay)."""
+    sched = FaultSchedule(seed=7, rank=0, store_conn_drop_ops=(4,))
+    with BootstrapServer(n_ranks=2) as srv:
+        c = BootstrapClient(srv.handle, 0, timeout_s=15.0, scope="pg/a/ring",
+                            fault_schedule=sched)
+        other = BootstrapClient(srv.handle, 0, timeout_s=10.0,
+                                scope="pg/b/ring")
+        c.set("pg/a/grow/g0/mine", "1")          # swept below
+        other.set("pg/b/grow/g0/theirs", "2")    # other group: guarded
+        c.set("pg/a/nodemap/map", "3")           # other namespace: untouched
+        # op 4 is the prune itself: dropped mid-flight, reconnect-replayed
+        c.prune([0], prefix="pg/a/", kv=["pg/a/grow/",
+                                         "pg/b/grow/",       # guard: not ours
+                                         "pg/a/nosuchns/"])  # guard: typo'd
+        assert c.try_get("pg/a/grow/g0/mine") is None
+        assert other.try_get("pg/b/grow/g0/theirs") == "2"
+        assert c.try_get("pg/a/nodemap/map") == "3"
+        assert any(k == "store-conn-dropped" for _, k, _ in sched.log)
+        c.close()
+        other.close()
+
+
+@needs_native
+def test_prune_guard_inherited_by_replica_after_failover():
+    """A prune forwarded to the replica applies the SAME guards there:
+    after failover, the swept prefix is gone and the guarded one is
+    not — the sharded path inherits the hygiene contract proven."""
+    prim = BootstrapServer(n_ranks=2)
+    repl = BootstrapServer(n_ranks=2)
+    c = BootstrapClient(prim.handle, 0, timeout_s=15.0, scope="pg/a/ring")
+    try:
+        c.set("pg/a/grow/g0/doomed", "1")
+        c.set("pg/a/spares/slot/0", "keep")
+        prim.attach_replica(repl.handle, timeout_s=5.0)
+        c.prune([0], prefix="pg/a/", kv=["pg/a/grow/", "pg/b/grow/"])
+        c.arm_failover([repl.handle])
+        prim.close()
+        assert c.try_get("pg/a/grow/g0/doomed", timeout_s=10.0) is None
+        assert c.try_get("pg/a/spares/slot/0", timeout_s=5.0) == "keep"
+    finally:
+        c._said_bye = True
+        c._qp.close()
+        repl.close()
+
+
+@needs_native
+def test_chunked_value_survives_conn_drops_and_failover():
+    """A chunked critical value (parts first, marker last) written under
+    injected connection drops reads back whole — through the original
+    store, and again from the replica after the primary dies (parts and
+    marker share the key prefix, so replication carries all of them)."""
+    big = "".join(f"row-{i:06d};" for i in range(12000))   # > 48 KiB
+    sched = FaultSchedule(seed=5, rank=1, store_conn_drop_ops=(2, 3))
+    prim = BootstrapServer(n_ranks=2)
+    repl = BootstrapServer(n_ranks=2)
+    prim.attach_replica(repl.handle, timeout_s=5.0)
+    c = BootstrapClient(prim.handle, 1, timeout_s=20.0, scope="pg/g/ring",
+                        fault_schedule=sched, failover=[repl.handle])
+    try:
+        c.set("pg/g/grow/g0/big", big, timeout_s=20.0)
+        assert c.try_get("pg/g/grow/g0/big", timeout_s=10.0) == big
+        assert any(k == "store-conn-dropped" for _, k, _ in sched.log)
+        prim.close()
+        assert c.try_get("pg/g/grow/g0/big", timeout_s=15.0) == big
+    finally:
+        c._said_bye = True
+        c._qp.close()
+        repl.close()
+
+
+@needs_native
+def test_chunked_value_through_proxy_stays_whole():
+    """The forwarded path chunks identically: a node-local chunked fleet
+    snapshot reassembles from the proxy, and a chunked forwarded value
+    reassembles upstream."""
+    big = "x" * (60 << 10)
+    prim = BootstrapServer(n_ranks=2)
+    px = NodeProxyStore(prim.handle, node=0, flush_s=60.0)
+    c = BootstrapClient(px.handle, 0, timeout_s=20.0, scope="pg/g/ring")
+    obs = BootstrapClient(prim.handle, None, timeout_s=10.0)
+    try:
+        c.set("pg/g/fleet/e0/0", big, timeout_s=15.0)       # local chunks
+        assert c.try_get("pg/g/fleet/e0/0", timeout_s=10.0) == big
+        assert obs.try_get("pg/g/fleet/e0/0") is None
+        c.set("pg/g/nodemap/big", big, timeout_s=15.0)      # forwarded chunks
+        assert obs.try_get("pg/g/nodemap/big", timeout_s=10.0) == big
+    finally:
+        c.close()
+        obs.close()
+        px.close()
+        prim.close()
+
+
+@needs_native
+def test_armed_store_and_proxy_deaths_fire_once_on_op_stream():
+    """The data-op-keyed close knobs: at op N the armed close runs
+    exactly once, outside the schedule lock, and lands in the injection
+    log at a deterministic coordinate."""
+    fired = []
+    sched = FaultSchedule(seed=1, rank=0, store_close_after_ops=2,
+                          proxy_close_after_ops=3)
+    sched.arm_store_death(lambda: fired.append("store"))
+    sched.arm_proxy_death(lambda: fired.append("proxy"))
+    for _ in range(5):
+        sched.op_fault("isend")
+    assert fired == ["store", "proxy"]
+    kinds = [k for _, k, _ in sched.log]
+    assert kinds.count("store-closed") == 1
+    assert kinds.count("proxy-closed") == 1
+
+
+# ---------------------------------------------------------------------------
+# the committed scale proof and its sentinel ratchet
+# ---------------------------------------------------------------------------
+
+
+def _shard_doc():
+    import json
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "results", "shardstore_r01.json")) as fp:
+        return json.load(fp)
+
+
+def test_committed_shardstore_record_schema():
+    """The 1024-rank dryrun record carries the full ladder, the ledger
+    claims, and the failover proof at every rung."""
+    doc = _shard_doc()
+    assert doc["bench"] == "shardstore" and doc["v"] == 1
+    assert [r["ranks"] for r in doc["ladder"]] == [64, 256, 1024]
+    assert doc["watchdog_window_s"] == 5.0
+    for r in doc["ladder"]:
+        assert r["nodes"] == r["ranks"] // r["node_size"]
+        # O(1) control chatter: single-digit store ops per rank/window
+        assert 0 < r["per_rank_ops_per_window"] < 10
+        # condensation: the primary sees beats/arrivals per NODE, so
+        # per-RANK fan-in is fractional (a flat plane would be >= 1)
+        assert r["fanin_per_rank_per_window"] < 1.0
+        assert r["local_fraction"] >= 0.5
+        assert r["tree_complete"] and r["streamed_exact"]
+        f = r["failover"]
+        assert f["repointed"] == f["expected"] == r["nodes"]
+        assert f["within_window"] and f["wall_s"] < 5.0
+        assert f["tree_complete"] and f["streamed_exact"]
+    # the largest rung is the headline: every one of its 64 proxies
+    # re-pointed, and the observer read stayed far under flat (1025)
+    top = doc["ladder"][-1]
+    assert top["failover"]["expected"] == 64
+    assert top["observer_tree_ops"] <= (top["ranks"] + 1) / 4
+    assert doc["replay"]["equal"] is True
+
+
+def test_sentinel_shardstore_ratchet():
+    """check_shardstore: the committed record self-diffs clean (the
+    all-zero fixed point tier-1 runs), and each survivability claim
+    flags when regressed in a fresh doc."""
+    import copy
+
+    from tools import sentinel
+    doc = _shard_doc()
+    assert sentinel.check_shardstore(current=doc) == []
+    assert sentinel.check_shardstore() == []
+    # an O(n) path: per-rank ops growing with the ladder blows both
+    # the spread bar and the committed absolute ceiling
+    bad = copy.deepcopy(doc)
+    bad["ladder"][-1]["per_rank_ops_per_window"] = \
+        bad["ladder"][-1]["ranks"] / 8.0
+    findings = sentinel.check_shardstore(current=bad)
+    assert any("not O(1)" in f.get("shardstore", "") for f in findings)
+    assert any("per_rank_ops" in f for f in findings)
+    assert "ceiling" in sentinel.format_findings(findings)
+    # the flat regression: beats landing per-rank on the primary
+    bad = copy.deepcopy(doc)
+    bad["ladder"][0]["fanin_per_rank_per_window"] = 2.0
+    findings = sentinel.check_shardstore(current=bad)
+    assert any("condensation regressed"
+               in f.get("shardstore", "") for f in findings)
+    # failover past the watchdog window
+    bad = copy.deepcopy(doc)
+    bad["ladder"][-1]["failover"]["wall_s"] = 7.5
+    bad["ladder"][-1]["failover"]["within_window"] = False
+    findings = sentinel.check_shardstore(current=bad)
+    assert any("watchdog window"
+               in f.get("shardstore", "") for f in findings)
+    # a proxy that never re-pointed
+    bad = copy.deepcopy(doc)
+    bad["ladder"][-1]["failover"]["repointed"] -= 1
+    findings = sentinel.check_shardstore(current=bad)
+    assert any("re-pointed" in f.get("shardstore", "") for f in findings)
+    # nondeterministic replay
+    bad = copy.deepcopy(doc)
+    bad["replay"]["equal"] = False
+    findings = sentinel.check_shardstore(current=bad)
+    assert any("not deterministic"
+               in f.get("shardstore", "") for f in findings)
+    assert "shardstore" in sentinel.format_findings(findings)
+
+
+def test_sentinel_shardstore_cli(tmp_path):
+    """--shardstore runs alone: exit 0 on the committed record, 1 on a
+    degraded doc, 2 when combined with another mode."""
+    import copy
+    import json
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.sentinel", "--shardstore"],
+        capture_output=True, text=True, cwd=repo, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "no perf regressions" in out.stdout
+    bad = copy.deepcopy(_shard_doc())
+    bad["replay"]["equal"] = False
+    rec = tmp_path / "bad.json"
+    rec.write_text(json.dumps(bad))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.sentinel", "--shardstore", str(rec)],
+        capture_output=True, text=True, cwd=repo, timeout=60)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "not deterministic" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.sentinel", "--shardstore",
+         "--run-smoke"],
+        capture_output=True, text=True, cwd=repo, timeout=60)
+    assert out.returncode == 2
+    assert "runs alone" in out.stderr

@@ -1,0 +1,145 @@
+"""Survivable control plane — the kill-the-store chaos gate (DESIGN.md
+§5n).
+
+Real OS processes over the multiprocess harness, the FULL robustness
+stack up (watchdog, self-heal, fleet telemetry), and the store itself is
+the victim:
+
+- ``host`` mode: the rank HOSTING the primary store is hard-killed
+  (``os._exit``, no FIN) mid-allreduce — store and member die together.
+  Survivors must re-elect the replica as primary, re-point every client
+  through the armed rotation, and complete the IN-FLIGHT heal against
+  the replica with the bitwise oracle of the shrunk group.
+- ``server`` mode: the primary dies IN-PROCESS at a deterministic data
+  op while its hosting rank lives — every rank's clients rotate to the
+  replica, membership never changes.
+- ``proxy`` mode: one node's ``NodeProxyStore`` dies — ONLY that node's
+  ranks re-point (to the primary); the other node's traffic never moves.
+
+All three stories must REPLAY: two same-seed runs produce identical
+FAULTLOG / HEALLOG / STORELOG digests on every rank (kills land in op
+space; store events carry ranks/tags, never ports or wall clock).
+"""
+
+import re
+
+import pytest
+
+from rocnrdma_tpu import native
+from rocnrdma_tpu.metrics import FaultCounters
+from rocnrdma_tpu.runtime.multiprocess import run_workers
+
+pytestmark = [
+    pytest.mark.chaos,
+    pytest.mark.skipif(not native.available(),
+                       reason="native rqp library not buildable"),
+]
+
+
+def _line(result, key):
+    m = re.search(rf"^{key} (.+)$", result.stdout, re.M)
+    assert m, f"rank {result.process_id} printed no {key} line:\n" \
+              f"{result.stdout}\n{result.stderr}"
+    return m.group(1)
+
+
+def _faults(result) -> FaultCounters:
+    return FaultCounters.from_json(_line(result, "FAULTS"))
+
+
+def _no_hangs(results):
+    for r in results:
+        assert r.returncode != -9, \
+            f"rank {r.process_id} HUNG to the harness kill:\n{r.stderr}"
+
+
+def test_store_host_death_heals_against_replica_replay_equal():
+    """Kill the store-hosting RANK (primary dies with it) mid-round:
+    survivors re-elect the replica, the in-flight heal completes against
+    it (epoch bump, shrunk membership, bitwise rounds), and the whole
+    failure story — fault, heal, AND store-event timelines — replays
+    byte-identical from the seed."""
+    n, seed, victim = 4, 3, 0  # rank 0 hosts the primary: store dies too
+    runs = [run_workers(n, "kill-the-store", timeout_s=150.0, seed=seed,
+                        rounds=8, size=256, kill_ranks=str(victim),
+                        kill_ops="6") for _ in range(2)]
+    for results in runs:
+        _no_hangs(results)
+        rc = {r.process_id: r.returncode for r in results}
+        assert rc[victim] == 7, results[victim].stdout
+        assert "FAULT: killed at op 6" in results[victim].stdout
+        for r in results:
+            if r.process_id == victim:
+                continue
+            assert r.returncode == 0, \
+                f"survivor {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert _line(r, "EPOCH") == "1"
+            assert _line(r, "MEMBERS") == "[1, 2, 3]"
+            # the convergent successor election: every survivor setnx-ed
+            # the deterministic successor (rank 1) and read ONE winner
+            # back from the replicated namespace
+            assert _line(r, "STOREWINNER") == "1"
+            # every survivor's clients re-pointed through the rotation
+            # (main + watchdog — at least the main client re-dialed the
+            # replica to run the heal)
+            assert int(_line(r, "STOREPOINT")) >= 1
+    for a, b in zip(*runs):
+        if a.process_id == victim:
+            continue
+        assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
+        assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
+        assert _line(a, "STORELOG") == _line(b, "STORELOG"), a.process_id
+
+
+def test_in_process_store_death_rotates_every_client():
+    """The primary closes IN-PROCESS at rank 0's Nth data op (the
+    hosting rank survives): every rank rotates to the replica — no
+    membership change, no heal, rounds stay bitwise — and the election
+    record lands on the survivor store."""
+    n = 4
+    results = run_workers(n, "kill-the-store", timeout_s=150.0, seed=3,
+                          rounds=8, size=256, store_death="server",
+                          kill_store_op=6)
+    _no_hangs(results)
+    for r in results:
+        assert r.returncode == 0, \
+            f"rank {r.process_id} exited {r.returncode}:\n" \
+            f"{r.stdout}\n{r.stderr}"
+        assert _line(r, "EPOCH") == "0"          # nobody died: no heal
+        assert _line(r, "MEMBERS") == "[0, 1, 2, 3]"
+        assert _line(r, "STOREWINNER") == "1"
+        assert int(_line(r, "STOREPOINT")) >= 1  # every rank re-pointed
+    r0 = next(r for r in results if r.process_id == 0)
+    assert _faults(r0).counts.get("store-closed") == 1
+
+
+def test_proxy_death_repoints_only_its_node_replay_equal():
+    """Node 1's proxy store dies at its agent's Nth data op: node 1's
+    ranks re-point to the primary EXACTLY once each; node 0's ranks —
+    whose proxy never died — must not move at all. Replay-equal like
+    every other chaos story."""
+    n = 4  # two nodes of two ranks; node 1's agent is rank n//2 = 2
+    runs = [run_workers(n, "kill-the-store", timeout_s=150.0, seed=3,
+                        rounds=8, size=256, store_death="proxy",
+                        kill_store_op=6) for _ in range(2)]
+    for results in runs:
+        _no_hangs(results)
+        for r in results:
+            assert r.returncode == 0, \
+                f"rank {r.process_id} exited {r.returncode}:\n" \
+                f"{r.stdout}\n{r.stderr}"
+            assert _line(r, "EPOCH") == "0"
+            assert _line(r, "MEMBERS") == "[0, 1, 2, 3]"
+            # the blast radius contract: a proxy death is a NODE-local
+            # event — exactly one re-point per node-1 rank, zero
+            # anywhere else
+            want = 1 if r.process_id >= n // 2 else 0
+            assert int(_line(r, "STOREPOINT")) == want, \
+                f"rank {r.process_id}: {r.stdout}"
+        agent = next(r for r in results if r.process_id == n // 2)
+        assert _faults(agent).counts.get("proxy-closed") == 1
+    for a, b in zip(*runs):
+        assert _line(a, "FAULTLOG") == _line(b, "FAULTLOG"), a.process_id
+        assert _line(a, "HEALLOG") == _line(b, "HEALLOG"), a.process_id
+        assert _line(a, "STORELOG") == _line(b, "STORELOG"), a.process_id

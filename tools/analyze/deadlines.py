@@ -125,6 +125,19 @@ COALESCE_BLOCKING = {
     ("Future", "wait"), ("Coalescer", "flush"), ("Coalescer", "submit"),
 }
 
+# RULE 3 (continued) — the sharded-store survivability surface
+# (ISSUE 20): BootstrapServer.attach_replica runs the catch-up copy
+# against the replica's socket (a dead replica must fail named, not
+# wedge the primary's accept loop), and NodeProxyStore.flush drains the
+# condensed batches upstream inline — the exact wait a barrier-done
+# poll amortizes, so the caller must be able to bound it. The client's
+# failover re-dial is bounded per-target by its own _rpc budget
+# (covered by rules 1-2); these two are the verbs a future refactor is
+# most likely to quietly strip.
+SHARD_BLOCKING = {
+    ("BootstrapServer", "attach_replica"), ("NodeProxyStore", "flush"),
+}
+
 
 # RULE 4's surface: the whole package (call sites of the device-plane
 # bootstrap live outside the transport stack — runtime/, bench/)
@@ -212,7 +225,10 @@ def check_file(path: str) -> list[str]:
                              and child.name in LANE_BLOCKING)
                          or (base_name == "coalesce.py"
                              and len(qual) == 1
-                             and (qual[0], child.name) in COALESCE_BLOCKING))
+                             and (qual[0], child.name) in COALESCE_BLOCKING)
+                         or (base_name == "bootstrap.py"
+                             and len(qual) == 1
+                             and (qual[0], child.name) in SHARD_BLOCKING))
                 if named and key not in ALLOW \
                         and "timeout_s" not in _params(child):
                     problems.append(

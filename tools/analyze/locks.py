@@ -95,6 +95,20 @@ HOLD_ALLOW: dict[str, str] = {
         "runs under it so two callers cannot mint rival generations; "
         "build waits are deadline-bounded, and _hier_invalidate takes "
         "it with a timeout + deferred-teardown fallback, never bare",
+    "bootstrap.py::BootstrapServer._repl_lock":
+        "the replication-channel mutex (ISSUE 20): the replica link is "
+        "ONE lockstep socket, so the catch-up sync and every forwarded "
+        "mutation must ride it in order — interleaving two forwards "
+        "would desync the request/reply framing. Every RPC under it is "
+        "budget-bounded (_REPL_TIMEOUT_S / the attach deadline) and a "
+        "failure drops the replica rather than wedging the holder",
+    "bootstrap.py::NodeProxyStore._up_lock":
+        "the proxy's upstream-channel mutex (ISSUE 20): the upstream "
+        "client is ONE lockstep socket shared by every serve thread on "
+        "the node, so forwards and condensed flushes serialize on it "
+        "by design; every RPC under it carries the caller's remaining "
+        "budget and an upstream failure surfaces as a dropped "
+        "conversation (store-proxy-abort), never an unbounded hold",
     "native/__init__.py::_build_lock":
         "one compiler invocation per flavor, ever: the first caller "
         "compiles librqp.so (seconds) while later callers wait for the "

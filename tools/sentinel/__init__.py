@@ -442,6 +442,54 @@ def check_store_traffic(current: dict | None = None,
     return findings
 
 
+def check_shardstore(current: dict | None = None,
+                     results_dir: str = RESULTS) -> list[dict]:
+    """The sharded-control-plane ratchet (ISSUE 20): hold the
+    survivability and condensation claims against the committed
+    ``results/shardstore_r01.json`` — the 1024-rank full-control-plane
+    dryrun over per-node proxy stores with a mid-run primary death. A
+    future PR that quietly regresses the shard path (per-rank control
+    chatter growing, beat/arrival fan-in landing per-rank on the
+    primary again, a proxy that stops terminating locally, failover
+    blowing the watchdog window, or a replay digest that stops being
+    deterministic) fails tier-1 here.
+
+    ``current``: a ``tools.simfleet --shard`` record doc; when None,
+    the committed doc self-diffs (the all-zero fixed point — the cheap
+    tier-1 shape shared with ``check_evasion``/``check_model_drift``;
+    re-measuring the 1024-rank ladder is the recorder's job). Every
+    check is ``simfleet.check_shard_record`` — the record's own
+    invariants ARE the ratchet (per-rank ops O(1) across the ladder,
+    fan-in per rank fractional, local termination >= the floor,
+    failover within the watchdog window with every proxy re-pointed
+    exactly once, pre- AND post-failover fleet views complete and
+    exact, same-seed replay digest-equal) — plus the committed
+    per-rank ceiling applied row-wise to a fresh record."""
+    path = os.path.join(results_dir, "shardstore_r01.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as fp:
+        committed = json.load(fp)
+    if current is None:
+        current = committed
+    from tools.simfleet import check_shard_record
+    findings = [{"key": ("shardstore", prob), "shardstore": prob,
+                 "trace_diff": None}
+                for prob in check_shard_record(current)]
+    floors = committed.get("floors", {})
+    ceiling = (floors.get("per_rank_ops_max", 0.0)
+               + floors.get("per_rank_spread_max", 2.0))
+    for row in current.get("ladder", []):
+        if row["per_rank_ops_per_window"] > ceiling:
+            findings.append({
+                "key": ("shardstore", row["ranks"]),
+                "per_rank_ops": row["per_rank_ops_per_window"],
+                "ops_ceiling": round(ceiling, 3),
+                "trace_diff": None,
+            })
+    return findings
+
+
 def check_evasion(current: dict | None = None,
                   results_dir: str = RESULTS,
                   ratio: float = 0.8) -> list[dict]:
@@ -626,6 +674,8 @@ def format_findings(findings: list[dict]) -> str:
                          f"{f['committed_MBps']})")
         elif "store_traffic" in f:
             lines.append(f"  simfleet: {f['store_traffic']}")
+        elif "shardstore" in f:
+            lines.append(f"  shardstore: {f['shardstore']}")
         elif "conf_lost_ops" in f:
             lines.append(f"  {key}: the conformance chaos run LOST "
                          f"{f['conf_lost_ops']} op(s) against the "

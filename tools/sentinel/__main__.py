@@ -57,7 +57,30 @@ def main(argv=None) -> int:
                         "tools.record_conformance doc to diff a fresh "
                         "run, or nothing to self-diff the committed "
                         "record")
+    p.add_argument("--shardstore", default=None, nargs="?", const="",
+                   metavar="RECORD.json",
+                   help="run the sharded-control-plane ratchet against "
+                        "the committed results/shardstore_r01.json "
+                        "(per-rank control ops O(1) across the "
+                        "64->1024 ladder, primary fan-in fractional, "
+                        "failover within the watchdog window, replay "
+                        "digest-equal); pass a tools.simfleet --shard "
+                        "doc to diff a fresh run, or nothing to "
+                        "self-diff the committed record")
     args = p.parse_args(argv)
+    if args.shardstore is not None:
+        if args.records or args.run_smoke or args.store_traffic \
+                or args.evasion is not None \
+                or args.model_drift is not None:
+            p.error("--shardstore runs alone")
+        current = None
+        if args.shardstore:
+            with open(args.shardstore) as fp:
+                current = json.load(fp)
+        findings = sentinel.check_shardstore(
+            current, results_dir=args.results_dir)
+        print(sentinel.format_findings(findings))
+        return 1 if findings else 0
     if args.model_drift is not None:
         if args.records or args.run_smoke or args.store_traffic \
                 or args.evasion is not None:

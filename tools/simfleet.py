@@ -27,15 +27,29 @@ instead — same keys, same totals, later. The committed record
 (``results/fleettree_r01.json``) is the 256-rank host-plane dryrun the
 sentinel's ``check_store_traffic`` ratchets against.
 
+``--shard`` switches the harness to the SHARDED control plane (ISSUE
+20, DESIGN.md §5n): one real :class:`NodeProxyStore` per simulated
+node over a primary with an attached replica, every node driven on its
+own thread, the full control plane per window (beats, death-key polls,
+snapshot publishes, barrier rendezvous, a replicated heal-admission
+election, agent ticks), and a mid-run PRIMARY DEATH whose recovery —
+every proxy re-pointing to the replica and the next fleet-wide barrier
+releasing — is measured against the watchdog window. The committed
+record (``results/shardstore_r01.json``) is the 1024-rank dryrun the
+sentinel's ``check_shardstore`` ratchets against.
+
 CLI::
 
     python -m tools.simfleet --ranks 8,64,256 --node-size 8 --json
     python -m tools.simfleet --ranks 256 --out results/fleettree_r01.json
+    python -m tools.simfleet --shard --out results/shardstore_r01.json
 """
 
 from __future__ import annotations
 
 import argparse
+import concurrent.futures
+import hashlib
 import json
 import math
 import random
@@ -43,7 +57,7 @@ import sys
 import time
 
 from rocnrdma_tpu.metrics import STORE, StoreCounters
-from rocnrdma_tpu.obs import fleet
+from rocnrdma_tpu.obs import FLIGHT, fleet
 from rocnrdma_tpu.transport import bootstrap
 
 GROUP = "simfleet"
@@ -366,35 +380,440 @@ def check_record(doc: dict) -> list:
     return problems
 
 
+# ---------------------------------------------------------------------------
+# Sharded control plane (ISSUE 20, DESIGN.md §5n): per-node proxy
+# stores over a replicated primary, driven at 1024 ranks.
+# ---------------------------------------------------------------------------
+
+# classes whose round-trips are INFRASTRUCTURE fan-in/fan-out (the
+# proxies' condensed upstream batches, the primary->replica forwards) —
+# excluded from the per-RANK control-traffic claim, counted separately
+_INFRA_CLASSES = ("proxy-upstream", "replication")
+
+# the primary-side ops that carry liveness beats and barrier arrivals:
+# in shard mode these must arrive as per-NODE condensed bulks, so their
+# count per rank per window collapses toward zero as the fleet grows —
+# a flat-path regression (every rank's arrive/beat landing upstream)
+# pushes it back to >= 1
+_FANIN_OPS = ("hb", "hb_bulk", "barrier_arrive", "barrier_bulk")
+
+
+def _flight_store_digest() -> str:
+    """Replay digest over the deterministic store events (same contract
+    as the chaos workers' STORELOG): sorted, not ordered — concurrent
+    clients interleave freely — and ``*-abort`` kinds excluded (an
+    abort marks async work in flight when a death landed, a wall-clock
+    artifact that stays on the timeline but outside replay equality)."""
+    events = sorted(
+        (kind, json.dumps(args, default=str, sort_keys=True))
+        for _, kind, args in FLIGHT.events()
+        if kind.startswith("store-") and not kind.endswith("-abort"))
+    return hashlib.sha256(json.dumps(events).encode()).hexdigest()
+
+
+def run_shard_point(n_ranks: int, node_size: int = 16, fanout: int = 4,
+                    windows: int = 2, seed: int = 0, epoch: int = 0,
+                    watchdog_window_s: float = 5.0,
+                    flush_s: float = 0.25) -> dict:
+    """One sharded-control-plane rung: the FULL control plane — per-rank
+    liveness beats, the watchdog's death-key polls, barrier rendezvous,
+    fleet snapshot publishes, agent aggregation ticks, and a replicated
+    heal-admission election — driven through one real
+    :class:`NodeProxyStore` per node over a primary with an attached
+    replica. Each node's traffic runs on its own thread (nodes are
+    independent hosts; serializing them would fake the fan-in).
+
+    After ``windows`` clean windows the PRIMARY IS CLOSED and one more
+    full window runs: every proxy's upstream client must rotate to the
+    replica (``store-failover``, one per node), the fleet barrier must
+    complete against the survivor, and the next observer read must see
+    the complete fleet from the replica. The recovery wall — primary
+    death to fleet-wide barrier release — is measured against the
+    ``watchdog_window_s`` acceptance."""
+    members = list(range(n_ranks))
+    node_of = [g // node_size for g in members]
+    nodes = fleet.split_nodes(members, node_of)
+    agents = fleet.node_agents(nodes)
+    order = _agent_order(len(nodes), fanout)
+    FLIGHT.reset()
+    primary = bootstrap.BootstrapServer(n_ranks=n_ranks)
+    replica = bootstrap.BootstrapServer(n_ranks=n_ranks)
+    proxies: list = []
+    clients: list = []
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=max(1, len(nodes)))
+    base = STORE.snapshot()
+
+    def window(idx: int, w: int) -> None:
+        """One node's share of control window ``w``: its ranks' beats,
+        death-key polls, snapshot publishes and barrier arrivals ride
+        the node's proxy client (per-rank attribution via the rank
+        override — the proxy's liveness table sees every true origin),
+        then the node's agent does the per-NODE work: one meta write,
+        one election proposal, and the barrier done-poll (which flushes
+        the node's pending arrivals upstream inline)."""
+        c = clients[idx]
+        _nid, origs = nodes[idx]
+        bkey = f"pg/{GROUP}/heal/e{epoch}/w{w}"
+        meta = json.dumps({"epoch": epoch, "members": members,
+                           "world": n_ranks, "group": GROUP})
+        with bootstrap.store_traffic("heartbeat"):
+            for orig in origs:
+                c._rpc(op="set", key=f"pg/{GROUP}/hb/e{epoch}/{orig}",
+                       value=str(w), rank=orig)
+                c._rpc(op="get", key=f"pg/{GROUP}/hb/e{epoch}/dead_v",
+                       rank=orig)
+        with bootstrap.store_traffic("telemetry-publish"):
+            for orig in origs:
+                c._rpc(op="set",
+                       key=fleet.snapshot_key(GROUP, epoch, orig),
+                       value=json.dumps(
+                           synth_snapshot(orig, epoch, w, seed)),
+                       rank=orig)
+            c._rpc(op="set", key=fleet.meta_key(GROUP), value=meta)
+        with bootstrap.store_traffic("rendezvous"):
+            for orig in origs:
+                c._rpc(op="barrier_arrive", key=bkey, rank=orig)
+            c._rpc(op="setnx",
+                   key=f"pg/{GROUP}/heal/e{epoch}/claim/{w}",
+                   value=str(c.rank))
+            deadline = time.monotonic() + 60.0
+            while True:
+                if c._rpc(op="barrier_done", key=bkey,
+                          n=n_ranks, _budget_s=5.0).get("ok"):
+                    return
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"simfleet shard: window {w} barrier stuck "
+                        f"(node {idx})")
+                time.sleep(0.05)
+
+    def fleet_window(w: int) -> None:
+        done = pool.map(window, range(len(nodes)), [w] * len(nodes))
+        list(done)  # propagate the first failure
+        # agent aggregation, deepest-first (same convention as the flat
+        # harness): each node's ONE digest write forwards upstream —
+        # the condensed-summary half of the proxy contract
+        for idx in order:
+            agent = fleet.NodeAgent(
+                _SimPG(agents[idx], members, node_of, epoch),
+                fanout=fanout)
+            if not agent.tick(clients[idx], timeout_s=5.0):
+                raise RuntimeError(
+                    f"simfleet shard: node {idx}'s agent tick failed")
+
+    def streamed_exact(view: dict, w: int) -> bool:
+        want = sum(synth_snapshot(o, epoch, w, seed)
+                   ["wire"]["payload_bytes_streamed"] for o in members)
+        return (view["wire_totals"]
+                    .get("payload_bytes_streamed") == want)
+
+    try:
+        primary.attach_replica(replica.handle)
+        for idx, (nid, _origs) in enumerate(nodes):
+            proxies.append(bootstrap.NodeProxyStore(
+                primary.handle, node=nid, flush_s=flush_s,
+                timeout_s=5.0, failover=(replica.handle,)))
+            clients.append(bootstrap.BootstrapClient(
+                proxies[-1].handle, agents[idx], timeout_s=10.0,
+                scope=f"pg/{GROUP}/ring",
+                traffic_class="telemetry-publish"))
+        for w in range(windows):
+            fleet_window(w)
+        pre_stats = primary.stats()
+        publish_delta = STORE.delta(base)
+        obs_base = STORE.snapshot()
+        tree1 = fleet.read_fleet(primary.handle, GROUP, timeout_s=10.0)
+        tree1_ops = STORE.delta(obs_base)["ops"]
+
+        # kill the primary, run one more FULL control window: the
+        # recovery wall is death -> fleet-wide barrier release
+        t0 = time.monotonic()
+        primary.close()
+        futs = [pool.submit(window, i, windows)
+                for i in range(len(nodes))]
+        for f in futs:
+            f.result()
+        wall = time.monotonic() - t0
+        repoints = [ts for ts, kind, _a in FLIGHT.events()
+                    if kind == "store-failover"]
+        for idx in order:
+            agent = fleet.NodeAgent(
+                _SimPG(agents[idx], members, node_of, epoch),
+                fanout=fanout)
+            if not agent.tick(clients[idx], timeout_s=5.0):
+                raise RuntimeError(
+                    f"simfleet shard: node {idx}'s post-failover tick "
+                    f"failed")
+        tree2 = fleet.read_fleet(replica.handle, GROUP, timeout_s=10.0)
+        proxy_stats = [p.stats() for p in proxies]
+        digest = _flight_store_digest()
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for p in proxies:
+            try:
+                p.close()
+            except Exception:
+                pass
+        for s in (replica, primary):
+            try:
+                s.close()
+            except Exception:
+                pass
+        pool.shutdown(wait=False)
+
+    classes = publish_delta["classes"]
+    rank_ops = sum(v for k, v in classes.items()
+                   if k not in _INFRA_CLASSES)
+    fanin = sum(pre_stats["by_op"].get(op_, 0) for op_ in _FANIN_OPS)
+    served = [s["served"] for s in proxy_stats]
+    forwarded = sum(s["forwarded"] for s in proxy_stats)
+    return {
+        "ranks": n_ranks,
+        "nodes": len(nodes),
+        "node_size": node_size,
+        "fanout": fanout,
+        "depth": fleet.tree_depth(len(nodes), fanout),
+        "windows": windows,
+        # the O(1) claim, ledger-counted: every RANK-side store op of
+        # the clean windows (beats, death polls, publishes, arrivals,
+        # election, done-polls, agent ticks), divided down — the
+        # proxies' condensed upstream batches are infrastructure and
+        # counted separately below
+        "per_rank_ops_per_window": round(rank_ops / windows / n_ranks,
+                                         3),
+        "publish_classes": classes,
+        # the condensation proof, counted where the load lands: how
+        # many beat/arrival-carrying ops the PRIMARY served per rank
+        # per window — per-node bulks collapse this toward zero; a
+        # flat-path regression pushes it back to >= 1
+        "fanin_per_rank_per_window": round(fanin / windows / n_ranks,
+                                           4),
+        "primary": {"served": pre_stats["served"],
+                    "by_op": pre_stats["by_op"]},
+        "proxies": {"count": len(proxy_stats),
+                    "served_total": sum(served),
+                    "served_min": min(served),
+                    "served_max": max(served),
+                    "forwarded_total": forwarded,
+                    "flushes_total": sum(s["flushes"]
+                                         for s in proxy_stats)},
+        # share of all proxy-seen ops terminated in the shard instead
+        # of forwarded upstream
+        "local_fraction": round(sum(served)
+                                / max(1, sum(served) + forwarded), 4),
+        "replica_served": replica.stats()["served"],
+        "observer_tree_ops": tree1_ops,
+        "tree_complete": tree1["missing"] == [],
+        "streamed_exact": streamed_exact(tree1, windows - 1),
+        "failover": {
+            # primary death -> every node's proxy re-pointed (flight
+            # timestamps) and -> fleet-wide barrier release (the whole
+            # control window healed against the replica)
+            "repoint_s": round(max(repoints) - t0, 3) if repoints
+                         else None,
+            "wall_s": round(wall, 3),
+            "repointed": len(repoints),
+            "expected": len(nodes),
+            "within_window": wall < watchdog_window_s,
+            "tree_complete": tree2["missing"] == [],
+            "streamed_exact": streamed_exact(tree2, windows),
+        },
+        "store_digest": digest,
+    }
+
+
+def run_shard_ladder(ranks=(64, 256, 1024), node_size: int = 16,
+                     fanout: int = 4, windows: int = 2, seed: int = 0,
+                     watchdog_window_s: float = 5.0) -> dict:
+    """The sharded scaling record (``results/shardstore_r01.json``):
+    one :func:`run_shard_point` per rung, a same-seed replay of the
+    smallest rung (store-event digests must match — the fault story is
+    deterministic, not merely survived), and the floors the sentinel's
+    ``check_shardstore`` ratchets."""
+    rows = [run_shard_point(n, node_size=node_size, fanout=fanout,
+                            windows=windows, seed=seed,
+                            watchdog_window_s=watchdog_window_s)
+            for n in ranks]
+    replay_row = run_shard_point(min(ranks), node_size=node_size,
+                                 fanout=fanout, windows=windows,
+                                 seed=seed,
+                                 watchdog_window_s=watchdog_window_s)
+    first = next(r for r in rows if r["ranks"] == min(ranks))
+    per_rank = [r["per_rank_ops_per_window"] for r in rows]
+    return {
+        "bench": "shardstore",
+        "v": 1,
+        "node_size": node_size,
+        "fanout": fanout,
+        "windows": windows,
+        "seed": seed,
+        "watchdog_window_s": watchdog_window_s,
+        "ladder": rows,
+        "replay": {"ranks": min(ranks),
+                   "digests": [first["store_digest"],
+                               replay_row["store_digest"]],
+                   "equal": first["store_digest"]
+                            == replay_row["store_digest"]},
+        "floors": {
+            "per_rank_ops_max": round(max(per_rank), 3),
+            # wider than the flat ladder's ±1: the barrier done-polls
+            # are wall-clock-paced, so the per-rank count carries a
+            # little timing noise — an O(n) regression shows up as a
+            # multiple, not a fraction
+            "per_rank_spread_max": 2.0,
+            # beat/arrival fan-in at the primary, per rank per window:
+            # condensed per-node bulks keep it fractional; a flat path
+            # is >= 1 by construction
+            "fanin_per_rank_max": 0.75,
+            # at least half of all proxy-seen ops must terminate in
+            # the shard (beats + snapshots + arrivals dominate)
+            "local_fraction_min": 0.5,
+            # observer tree reads stay structurally sublinear in ranks
+            # (the root digest is CHUNKED at scale, so round-trips are
+            # log(nodes) + bytes/chunk — the bound is vs the flat
+            # read's n+1, not pure log)
+            "observer_slope_div": 4.0,
+            "failover_wall_max_s": watchdog_window_s,
+        },
+        "ts": time.time(),
+    }
+
+
+def check_shard_record(doc: dict) -> list:
+    """Self-invariants of a sharded-control-plane record (shared with
+    sentinel's ``check_shardstore``)."""
+    problems = []
+    floors = doc.get("floors", {})
+    rows = doc.get("ladder", [])
+    per_rank = [r["per_rank_ops_per_window"] for r in rows]
+    spread = (max(per_rank) - min(per_rank)) if per_rank else 0.0
+    if spread > floors.get("per_rank_spread_max", 2.0):
+        problems.append(
+            f"per-rank store ops per window are not O(1): spread "
+            f"{spread:.3f} across ranks={[r['ranks'] for r in rows]} "
+            f"(allowed ±{floors.get('per_rank_spread_max', 2.0)})")
+    for r in rows:
+        fanin_max = floors.get("fanin_per_rank_max", 0.75)
+        if r["fanin_per_rank_per_window"] > fanin_max:
+            problems.append(
+                f"beat/arrival fan-in at the primary is per-RANK at "
+                f"ranks={r['ranks']}: {r['fanin_per_rank_per_window']} "
+                f"ops/rank/window > {fanin_max} — the per-node "
+                f"condensation regressed to the flat path")
+        lf_min = floors.get("local_fraction_min", 0.5)
+        if r["local_fraction"] < lf_min:
+            problems.append(
+                f"proxies terminate only {r['local_fraction']:.0%} of "
+                f"ops locally at ranks={r['ranks']} (floor "
+                f"{lf_min:.0%}) — the shard stopped absorbing its "
+                f"node's traffic")
+        div = floors.get("observer_slope_div", 4.0)
+        bound = max(8.0, (r["ranks"] + 1) / div)
+        if r["observer_tree_ops"] > bound:
+            problems.append(
+                f"observer tree read at ranks={r['ranks']} cost "
+                f"{r['observer_tree_ops']} store ops > {bound:.0f} "
+                f"(flat is {r['ranks'] + 1}) — an O(n) read path "
+                f"crept back in")
+        if not (r["tree_complete"] and r["streamed_exact"]):
+            problems.append(
+                f"pre-failover fleet view broken at "
+                f"ranks={r['ranks']}: complete={r['tree_complete']} "
+                f"exact={r['streamed_exact']}")
+        f = r["failover"]
+        wall_max = floors.get("failover_wall_max_s",
+                              doc.get("watchdog_window_s", 5.0))
+        if not f["within_window"] or f["wall_s"] >= wall_max:
+            problems.append(
+                f"failover recovery at ranks={r['ranks']} took "
+                f"{f['wall_s']}s — not within the {wall_max}s "
+                f"watchdog window")
+        if f["repointed"] != f["expected"]:
+            problems.append(
+                f"store failover at ranks={r['ranks']}: "
+                f"{f['repointed']} proxies re-pointed, expected "
+                f"{f['expected']} (one per node, exactly once)")
+        if not (f["tree_complete"] and f["streamed_exact"]):
+            problems.append(
+                f"post-failover fleet view broken at "
+                f"ranks={r['ranks']}: complete={f['tree_complete']} "
+                f"exact={f['streamed_exact']} — the replica did not "
+                f"assemble the full control plane")
+    rep = doc.get("replay", {})
+    if not rep.get("equal"):
+        problems.append(
+            f"same-seed replay at ranks={rep.get('ranks')} produced a "
+            f"DIFFERENT store-event digest: {rep.get('digests')} — "
+            f"the failover story is not deterministic")
+    return problems
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tools.simfleet",
         description="Simulated-fleet scaling harness for the telemetry "
                     "tree: counts store ops per traffic class and "
                     "checks tree-merged == flat-merged")
-    p.add_argument("--ranks", default="8,32,64,256",
+    p.add_argument("--ranks", default=None,
                    help="comma-separated ladder of simulated rank "
-                        "counts")
-    p.add_argument("--node-size", type=int, default=8)
+                        "counts (default 8,32,64,256; with --shard "
+                        "64,256,1024)")
+    p.add_argument("--node-size", type=int, default=None,
+                   help="ranks per simulated node (default 8; with "
+                        "--shard 16)")
     p.add_argument("--fanout", type=int, default=4)
     p.add_argument("--windows", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--shard", action="store_true",
+                   help="run the SHARDED control plane: per-node "
+                        "proxy stores over a replicated primary, "
+                        "plus the mid-run primary-death failover "
+                        "(ISSUE 20)")
+    p.add_argument("--watchdog-window", type=float, default=5.0,
+                   help="failover recovery acceptance, seconds "
+                        "(--shard only)")
     p.add_argument("--json", action="store_true",
                    help="print the record as JSON")
     p.add_argument("--out", default=None,
                    help="write the record to this path")
     args = p.parse_args(argv)
-    ranks = [int(v) for v in args.ranks.split(",") if v]
-    doc = run_ladder(ranks, node_size=args.node_size,
-                     fanout=args.fanout, windows=args.windows,
-                     seed=args.seed)
-    problems = check_record(doc)
+    default_ranks = "64,256,1024" if args.shard else "8,32,64,256"
+    ranks = [int(v) for v in (args.ranks or default_ranks).split(",")
+             if v]
+    node_size = args.node_size or (16 if args.shard else 8)
+    if args.shard:
+        doc = run_shard_ladder(ranks, node_size=node_size,
+                               fanout=args.fanout,
+                               windows=args.windows, seed=args.seed,
+                               watchdog_window_s=args.watchdog_window)
+        problems = check_shard_record(doc)
+    else:
+        doc = run_ladder(ranks, node_size=node_size,
+                         fanout=args.fanout, windows=args.windows,
+                         seed=args.seed)
+        problems = check_record(doc)
     if args.out:
         with open(args.out, "w") as fp:
             json.dump(doc, fp, indent=1, sort_keys=True)
             fp.write("\n")
     if args.json:
         print(json.dumps(doc))
+    elif args.shard:
+        for r in doc["ladder"]:
+            f = r["failover"]
+            print(f"ranks {r['ranks']:>5}  nodes {r['nodes']:>3}  "
+                  f"per-rank ops/window "
+                  f"{r['per_rank_ops_per_window']:>6.3f}  fan-in/rank "
+                  f"{r['fanin_per_rank_per_window']:>6.4f}  local "
+                  f"{r['local_fraction']:.0%}  failover "
+                  f"{f['repointed']}/{f['expected']} in "
+                  f"{f['wall_s']}s")
+        print(f"replay digest equal: {doc['replay']['equal']}")
     else:
         for r in doc["ladder"]:
             eq = "equal" if r["equal"]["equal"] else "DIVERGED"
